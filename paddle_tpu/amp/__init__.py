@@ -1,0 +1,27 @@
+"""paddle.amp — automatic mixed precision (reference: python/paddle/amp/
+auto_cast.py:20, grad_scaler.py:20; C++ trace-time cast
+imperative/amp_auto_cast.cc:27-47; op lists fluid/contrib/mixed_precision/
+fp16_lists.py).
+
+On TPU the native reduced precision is bfloat16 (MXU-preferred), so
+level='O1' defaults to bf16 and loss scaling is a no-op unless fp16 is
+requested explicitly. The cast hook lives in core.dispatch so eager and
+traced modes share the same per-op policy — the amp_auto_cast.cc analog.
+"""
+from .auto_cast import (  # noqa: F401
+    auto_cast, amp_guard, white_list, black_list, AMP_WHITE_LIST, AMP_BLACK_LIST,
+)
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """Dygraph decorate (reference: amp/auto_cast.py decorate). For O2 we
+    cast the model parameters to the amp dtype."""
+    if level == "O2":
+        models_ = models if isinstance(models, (list, tuple)) else [models]
+        for m in models_:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models
+    return models, optimizers

@@ -1,0 +1,106 @@
+"""GradScaler (reference: python/paddle/amp/grad_scaler.py:20 →
+dygraph/amp/loss_scaler.py:27 AmpScaler; kernels operators/amp/
+check_finite_and_unscale_op.cc, update_loss_scaling_op.cc).
+
+bfloat16 (TPU default) does not need loss scaling — the scaler becomes a
+transparent pass-through unless fp16 is in use, but keeps the dynamic
+loss-scaling state machine for API and fp16 parity.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class AmpScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._use_dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._use_dynamic
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        params = optimizer._params
+        inv = 1.0 / self._scale
+        found_inf = False
+        for p in params:
+            if p._grad is None:
+                continue
+            g = p._grad * inv
+            if bool(jnp.any(~jnp.isfinite(g))):
+                found_inf = True
+            p._grad = g
+        self._found_inf = found_inf
+
+    minimize_unscale = unscale_
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, loss, **kwargs):
+        loss.backward()
+        self.step(optimizer)
+        return [], []
+
+    def update(self):
+        if not (self._enable and self._use_dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n_nan_or_inf:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def get_loss_scaling(self):
+        return Tensor(np.asarray([self._scale], np.float32))
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, d):
+        self._scale = d["scale"]
+        self._good_steps = d["good_steps"]
+        self._bad_steps = d["bad_steps"]
+
+
+class GradScaler(AmpScaler):
+    pass

@@ -1,0 +1,5 @@
+"""paddle.autograd (reference: python/paddle/autograd/: PyLayer py_layer.py:21,
+backward; C++ imperative/py_layer_fwd.h)."""
+from ..core.tape import backward, grad  # noqa: F401
+from ..core.dispatch import no_grad_ctx as no_grad  # noqa: F401
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401

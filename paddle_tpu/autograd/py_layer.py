@@ -1,0 +1,102 @@
+"""PyLayer — user-defined forward/backward (reference:
+python/paddle/autograd/py_layer.py:21 PyLayerContext, :133 PyLayer).
+
+Implemented over the tape: the custom backward is invoked by a synthetic
+tape node whose "op function" defers to the user's static backward.
+"""
+from ..core import dispatch, tape
+from ..core.tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self.container = None
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self.container = tensors
+
+    def saved_tensor(self):
+        return self.container
+
+    def mark_not_inplace(self, *args):
+        pass
+
+    def mark_non_differentiable(self, *args):
+        pass
+
+    def set_materialize_grads(self, value):
+        self._materialize_grads = bool(value)
+
+
+class _PyLayerNode(tape.Node):
+    """Tape node whose backward calls the user's static backward()."""
+
+    __slots__ = ("cls", "ctx", "n_inputs")
+
+    def __init__(self, cls, ctx, in_tensors):
+        super().__init__(f"pylayer_{cls.__name__}", None, {}, (), tuple(
+            range(len(in_tensors))), in_tensors)
+        self.cls = cls
+        self.ctx = ctx
+
+    def run_backward(self, cts_by_outidx):
+        cts = []
+        for i, (shape, dt) in enumerate(self.out_avals):
+            ct = cts_by_outidx.get(i)
+            if ct is None:
+                import jax.numpy as jnp
+
+                ct = jnp.zeros(shape, dt)
+            cts.append(Tensor(ct, stop_gradient=True))
+        with dispatch.no_grad_ctx():
+            grads = self.cls.backward(self.ctx, *cts)
+        if not isinstance(grads, (tuple, list)):
+            grads = (grads,)
+        out = []
+        for g in grads:
+            out.append(g._value if isinstance(g, Tensor) else g)
+        return tuple(out)
+
+
+# teach the tape engine about PyLayer nodes
+_orig_run_node_backward = tape._run_node_backward
+
+
+def _run_node_backward(node, cts_by_outidx):
+    if isinstance(node, _PyLayerNode):
+        return node.run_backward(cts_by_outidx)
+    return _orig_run_node_backward(node, cts_by_outidx)
+
+
+tape._run_node_backward = _run_node_backward
+
+
+class PyLayer:
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        requires_grad = dispatch.tape_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+        with dispatch.no_grad_ctx():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outputs, (tuple, list))
+        outs = list(outputs) if multi else [outputs]
+        outs = [o if isinstance(o, Tensor) else Tensor(o) for o in outs]
+        if requires_grad:
+            node = _PyLayerNode(cls, ctx, tensor_inputs)
+            for i, o in enumerate(outs):
+                o.stop_gradient = False
+                o._node = node
+                o._out_idx = i
+            node.set_outputs(outs, multi=multi)
+        return tuple(outs) if multi else outs[0]
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError

@@ -1,0 +1,9 @@
+"""Core runtime: Tensor, tape autograd, dispatch, place, dtype, flags, RNG.
+
+This package replaces the reference's C++ framework core
+(paddle/fluid/framework/: Tensor/Variable/Scope/OperatorBase/executors)
+with a thin functional-core-over-JAX design — XLA is the graph IR,
+scheduler, memory planner, and fusion engine.
+"""
+from . import dispatch, dtype, errors, flags, place, random, tape, tensor  # noqa: F401
+from .tensor import Tensor, Parameter, to_tensor  # noqa: F401

@@ -1,0 +1,252 @@
+"""Central op dispatch — the single "op registry" serving both execution modes.
+
+The reference unifies static + dygraph execution through one C++ operator
+registry (reference: paddle/fluid/framework/op_registry.h:273, OpInfoMap
+op_info.h:131; dygraph fast path pybind/op_function_generator.cc:497).
+Here every op is one *pure JAX function* ``fn(*arrays, **static_kwargs)``
+and this module is the unification point:
+
+- **Eager (dygraph)**: ``apply_op`` unwraps Tensors, runs the op through a
+  cached ``jax.jit`` (the ``core.ops.*`` fast-path analog — compile once
+  per (op, shapes, statics), then C++-speed dispatch), and records a tape
+  node for autograd.
+- **Traced (to_static / jitted train step / pjit)**: inputs are JAX
+  tracers; the op function is invoked directly so it inlines into the
+  enclosing XLA computation. No tape is recorded — gradients come from
+  functional ``jax.grad`` over the whole step, which is how the MXU gets
+  one fused backward program instead of per-op launches.
+
+Convention: positional args are array-likes (Tensor / jax.Array / numpy /
+scalar / None); everything static (axes, strides, flags) must be a keyword
+argument and hashable-after-normalisation.
+"""
+import contextvars
+import functools
+
+import jax
+import numpy as np
+
+from . import flags
+
+# ---------------------------------------------------------------- mode state
+
+_TAPE_ENABLED = contextvars.ContextVar("tape_enabled", default=True)
+AMP_HOOK = None  # installed by paddle_tpu.amp (per-op cast policy)
+PROGRAM_HOOK = None  # installed by paddle_tpu.static program_guard (op recorder)
+_IN_TRACE = contextvars.ContextVar("in_trace", default=False)
+
+
+def tape_enabled():
+    return _TAPE_ENABLED.get() and not _IN_TRACE.get()
+
+
+class no_grad_ctx:
+    """paddle.no_grad — disables tape recording (dygraph only)."""
+
+    def __enter__(self):
+        self._token = _TAPE_ENABLED.set(False)
+        return self
+
+    def __exit__(self, *exc):
+        _TAPE_ENABLED.reset(self._token)
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad_ctx():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad_ctx:
+    def __enter__(self):
+        self._token = _TAPE_ENABLED.set(True)
+        return self
+
+    def __exit__(self, *exc):
+        _TAPE_ENABLED.reset(self._token)
+        return False
+
+
+class trace_mode:
+    """Mark that we are inside a jax trace (to_static / functional step)."""
+
+    def __enter__(self):
+        self._token = _IN_TRACE.set(True)
+        return self
+
+    def __exit__(self, *exc):
+        _IN_TRACE.reset(self._token)
+        return False
+
+
+def in_trace():
+    return _IN_TRACE.get()
+
+
+# ---------------------------------------------------------------- utilities
+
+
+def hashable(obj):
+    """Normalise static kwargs into a hashable cache key."""
+    if isinstance(obj, (list, tuple)):
+        return tuple(hashable(o) for o in obj)
+    if isinstance(obj, dict):
+        return tuple(sorted((k, hashable(v)) for k, v in obj.items()))
+    if isinstance(obj, set):
+        return tuple(sorted(hashable(o) for o in obj))
+    if isinstance(obj, np.dtype):
+        return obj.name
+    return obj
+
+
+_FWD_CACHE = {}
+
+
+def fn_key(name, fn):
+    """Stable cache key for an op function.
+
+    Op implementations are closures/lambdas recreated per API call, so
+    keying on identity would recompile every step and leak cache entries.
+    The dispatch convention (all statics in kwargs, closures capture
+    nothing) makes (op name, module, qualname) a correct stable key; ops
+    that DO capture state (to_static programs, recompute segments) pass a
+    discriminating uid kwarg.
+    """
+    return (name, getattr(fn, "__module__", None),
+            getattr(fn, "__qualname__", repr(fn)))
+
+
+def jitted(fn, kwargs, name=None):
+    """Cached jax.jit of fn with static kwargs closed over."""
+    key = (fn_key(name, fn) if name is not None else fn, hashable(kwargs))
+    got = _FWD_CACHE.get(key)
+    if got is None:
+        if kwargs:
+            got = jax.jit(lambda *a: fn(*a, **kwargs))
+        else:
+            got = jax.jit(fn)
+        _FWD_CACHE[key] = got
+    return got
+
+
+def _is_tracer(v):
+    return isinstance(v, jax.core.Tracer)
+
+
+def _check_nan_inf(name, arrays):
+    import jax.numpy as jnp
+
+    for a in arrays:
+        if hasattr(a, "dtype") and np.issubdtype(np.dtype(a.dtype), np.inexact):
+            if bool(jnp.any(~jnp.isfinite(a))):
+                from . import errors
+
+                raise errors.PreconditionNotMetError(
+                    f"NaN/Inf detected in output of op {name!r} "
+                    "(FLAGS_check_nan_inf; reference nan_inf_utils_detail.cc analog)"
+                )
+
+
+# ---------------------------------------------------------------- dispatch
+
+
+def apply_op(name, fn, *args, **kwargs):
+    """Execute one op. Returns Tensor or tuple-of-Tensor mirroring fn's output."""
+    from . import tensor as tensor_mod
+    from . import tape as tape_mod
+
+    Tensor = tensor_mod.Tensor
+
+    arrays = []
+    diff_argnums = []
+    in_tensors = []
+    requires_grad = False
+    record = tape_enabled()
+    for i, a in enumerate(args):
+        if isinstance(a, Tensor):
+            v = a._value
+            arrays.append(v)
+            if record and not a.stop_gradient and _is_float(v):
+                diff_argnums.append(i)
+                in_tensors.append(a)
+                requires_grad = True
+        else:
+            arrays.append(a)
+
+    if AMP_HOOK is not None:
+        arrays = AMP_HOOK(name, arrays)
+
+    traced = _IN_TRACE.get() or any(_is_tracer(v) for v in arrays if v is not None)
+
+    if traced:
+        out = fn(*arrays, **kwargs)
+        return _wrap_outputs(out, requires_grad=not _all_stop(args, Tensor), node=None)
+
+    if flags.get_flags("eager_jit_ops")["eager_jit_ops"]:
+        out = jitted(fn, kwargs, name=name)(*[v for v in arrays])
+    else:
+        out = fn(*arrays, **kwargs)
+
+    if flags.get_flags("check_nan_inf")["check_nan_inf"]:
+        _check_nan_inf(name, out if isinstance(out, (tuple, list)) else (out,))
+
+    node = None
+    if requires_grad:
+        node = tape_mod.Node(name, fn, kwargs, tuple(arrays), tuple(diff_argnums), in_tensors)
+
+    wrapped = _wrap_outputs(out, requires_grad=requires_grad, node=node)
+    if PROGRAM_HOOK is not None:
+        outs_list = list(wrapped) if isinstance(wrapped, tuple) else [wrapped]
+        PROGRAM_HOOK.record(fn, kwargs, args, outs_list)
+    return wrapped
+
+
+def _is_float(v):
+    try:
+        return np.issubdtype(np.dtype(v.dtype), np.floating) or str(v.dtype) == "bfloat16"
+    except Exception:
+        return isinstance(v, float)
+
+
+def _all_stop(args, Tensor):
+    for a in args:
+        if isinstance(a, Tensor) and not a.stop_gradient:
+            return False
+    return True
+
+
+def _wrap_outputs(out, requires_grad, node):
+    from .tensor import Tensor
+
+    if isinstance(out, (tuple, list)):
+        outs = []
+        for i, o in enumerate(out):
+            t = Tensor(o, stop_gradient=not requires_grad)
+            if node is not None:
+                t._node = node
+                t._out_idx = i
+            outs.append(t)
+        if node is not None:
+            node.set_outputs(outs, multi=True)
+        return tuple(outs)
+    t = Tensor(out, stop_gradient=not requires_grad)
+    if node is not None:
+        t._node = node
+        t._out_idx = 0
+        node.set_outputs([t], multi=False)
+    return t
+
+
+def def_op(name, fn):
+    """Define a user-facing op from a pure jax function (the REGISTER_OPERATOR analog)."""
+
+    @functools.wraps(fn)
+    def api(*args, **kwargs):
+        return apply_op(name, fn, *args, **kwargs)
+
+    api.__name__ = name
+    api.raw_fn = fn
+    return api

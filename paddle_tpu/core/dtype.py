@@ -1,0 +1,85 @@
+"""dtype registry.
+
+Maps the reference's VarType dtype enum (reference:
+paddle/fluid/framework/framework.proto:23-60) onto JAX/numpy dtypes.
+bfloat16 is first-class because it is the TPU MXU's native reduced
+precision (the reference treats fp16 as primary; on TPU bf16 is).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+# Canonical dtype objects (exposed as paddle.float32 etc.)
+bool = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR2DTYPE = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "bf16": jnp.bfloat16,
+    "fp16": jnp.float16,
+    "float32": jnp.float32,
+    "fp32": jnp.float32,
+    "float64": jnp.float64,
+    "fp64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+FLOAT_DTYPES = (jnp.float16, jnp.bfloat16, jnp.float32, jnp.float64)
+INT_DTYPES = (jnp.uint8, jnp.int8, jnp.int16, jnp.int32, jnp.int64)
+
+
+def convert_dtype(dtype):
+    """Normalize a string / numpy / jnp dtype spec to a numpy dtype object."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            dtype = _STR2DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f"unknown dtype {dtype!r}")
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype):
+    return np.dtype(dtype).name
+
+
+def is_floating(dtype):
+    d = np.dtype(dtype)
+    return d.kind == "f" or d == np.dtype(jnp.bfloat16)
+
+
+def is_integer(dtype):
+    return np.dtype(dtype).kind in ("i", "u")
+
+
+def get_default_dtype():
+    from . import flags
+
+    return flags.get_flags("default_dtype")["default_dtype"]
+
+
+def set_default_dtype(d):
+    from . import flags
+
+    name = dtype_name(convert_dtype(d))
+    if name not in ("float16", "bfloat16", "float32", "float64"):
+        raise ValueError(f"default dtype must be floating, got {name}")
+    flags.set_flags({"default_dtype": name})

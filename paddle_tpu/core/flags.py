@@ -1,0 +1,74 @@
+"""Runtime flag system.
+
+Analog of the reference's gflags registry + paddle.set_flags/get_flags
+(reference: paddle/fluid/platform/flags.cc:33-461,
+global_value_getter_setter.cc, python framework.py:6140). Flags are
+initialised from ``FLAGS_*`` environment variables at import, like the
+reference's init.cc env parsing.
+"""
+import os
+import threading
+
+_LOCK = threading.Lock()
+
+# name -> (default, parser)
+_REGISTRY = {}
+_VALUES = {}
+
+
+def _parse_bool(v):
+    if isinstance(v, str):
+        return v.lower() in ("1", "true", "yes", "on")
+    return bool(v)
+
+
+def define_flag(name, default, parser=None, help=""):
+    with _LOCK:
+        if name in _REGISTRY:
+            return
+        if parser is None:
+            if isinstance(default, bool):
+                parser = _parse_bool
+            elif isinstance(default, int):
+                parser = int
+            elif isinstance(default, float):
+                parser = float
+            else:
+                parser = str
+        _REGISTRY[name] = (default, parser, help)
+        env = os.environ.get("FLAGS_" + name)
+        _VALUES[name] = parser(env) if env is not None else default
+
+
+def set_flags(flags):
+    """paddle.set_flags — dict of name -> value."""
+    for name, value in flags.items():
+        key = name[6:] if name.startswith("FLAGS_") else name
+        if key not in _REGISTRY:
+            raise KeyError(f"flag {name!r} is not registered")
+        _VALUES[key] = _REGISTRY[key][1](value)
+
+
+def get_flags(flags):
+    """paddle.get_flags — name or list of names -> dict."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for name in flags:
+        key = name[6:] if name.startswith("FLAGS_") else name
+        if key not in _REGISTRY:
+            raise KeyError(f"flag {name!r} is not registered")
+        out[name] = _VALUES[key]
+    return out
+
+
+# Core flags (subset of the reference's 34 with TPU-meaningful semantics).
+define_flag("check_nan_inf", False, help="scan every eager op output for NaN/Inf (flags.cc:44 analog; jax debug_nans for traced mode)")
+define_flag("default_dtype", "float32", help="default floating dtype for creation ops")
+define_flag("eager_jit_ops", True, help="dispatch eager ops through cached jax.jit for speed")
+define_flag("benchmark", False, help="block_until_ready after each eager op for accurate timing")
+define_flag("cudnn_deterministic", False, help="compat no-op; XLA is deterministic by default")
+define_flag("use_pallas_kernels", True, help="use Pallas fused kernels (flash attention etc.) on TPU")
+define_flag("allocator_strategy", "auto_growth", help="compat: XLA owns HBM allocation")
+define_flag("fraction_of_gpu_memory_to_use", 0.92, help="compat no-op on TPU")
+define_flag("seed", 0, help="global RNG seed")

@@ -1,0 +1,96 @@
+"""RNG management.
+
+Analog of the reference's global/per-device Generator
+(reference: paddle/fluid/framework/generator.cc, python/paddle/fluid/framework.py seed
+plumbing). JAX RNG is functional (explicit keys); we bridge Paddle's
+stateful ``paddle.seed`` API to it:
+
+- Eager mode: a global stateful ``Generator`` splits its key per random op.
+- Traced mode (to_static / jitted train step): a ``rng_guard(key)`` scope
+  supplies a traced key; random ops ``fold_in`` a call counter so each
+  call site gets distinct randomness. This keeps random ops pure under
+  jit — the idiomatic JAX pattern rather than the reference's seed attrs.
+"""
+import contextlib
+import contextvars
+
+import jax
+
+from . import flags
+
+
+class Generator:
+    def __init__(self, seed=0):
+        self._seed = seed
+        self._key = None  # lazily created to avoid touching backend at import
+
+    def manual_seed(self, seed):
+        self._seed = int(seed)
+        self._key = None
+        return self
+
+    @property
+    def initial_seed(self):
+        return self._seed
+
+    def _ensure(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
+
+    def next_key(self):
+        self._ensure()
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def get_state(self):
+        self._ensure()
+        return self._key
+
+    def set_state(self, state):
+        self._key = state
+
+
+_GLOBAL_GENERATOR = Generator(0)
+
+# (key, [counter]) supplied by a jitted scope.
+_RNG_SCOPE = contextvars.ContextVar("rng_scope", default=None)
+
+
+def seed(s):
+    """paddle.seed — reseed the global generator."""
+    flags.set_flags({"seed": int(s)})
+    _GLOBAL_GENERATOR.manual_seed(int(s))
+    return _GLOBAL_GENERATOR
+
+
+def default_generator():
+    return _GLOBAL_GENERATOR
+
+
+@contextlib.contextmanager
+def rng_guard(key):
+    """Supply an explicit (possibly traced) PRNG key for the enclosed ops."""
+    token = _RNG_SCOPE.set((key, [0]))
+    try:
+        yield
+    finally:
+        _RNG_SCOPE.reset(token)
+
+
+def next_key():
+    """Get a fresh PRNG key for one random op."""
+    scope = _RNG_SCOPE.get()
+    if scope is not None:
+        key, counter = scope
+        sub = jax.random.fold_in(key, counter[0])
+        counter[0] += 1
+        return sub
+    return _GLOBAL_GENERATOR.next_key()
+
+
+def get_rng_state():
+    return _GLOBAL_GENERATOR.get_state()
+
+
+def set_rng_state(state):
+    _GLOBAL_GENERATOR.set_state(state)

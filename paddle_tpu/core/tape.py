@@ -1,0 +1,302 @@
+"""Eager-mode reverse autograd engine.
+
+TPU-native analog of the reference's dygraph BasicEngine
+(reference: paddle/fluid/imperative/basic_engine.cc:305 Execute,
+:235 PrepareDeps, gradient_accumulator.cc, tracer.cc:207
+CreateGradOpNode). Instead of per-op registered grad kernels, each tape
+node replays its pure op function under ``jax.vjp`` inside a cached
+``jax.jit`` — XLA differentiates and fuses the backward, so there is one
+compiled backward program per (op, shapes, statics) reused across steps.
+"""
+import weakref
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dispatch
+
+
+def _is_float_dtype(d):
+    return np.issubdtype(np.dtype(d), np.floating) or str(d) == "bfloat16"
+
+
+class Node:
+    """One recorded op application (grad-graph node)."""
+
+    __slots__ = (
+        "name",
+        "fn",
+        "kwargs",
+        "inputs",
+        "diff_argnums",
+        "in_tensors",
+        "out_refs",
+        "out_avals",
+        "multi",
+        "__weakref__",
+    )
+
+    def __init__(self, name, fn, kwargs, inputs, diff_argnums, in_tensors):
+        self.name = name
+        self.fn = fn
+        self.kwargs = kwargs
+        self.inputs = inputs  # raw arrays / scalars / None
+        self.diff_argnums = diff_argnums
+        self.in_tensors = list(in_tensors)  # Tensors at diff_argnums (strong refs)
+        self.out_refs = []
+        self.out_avals = []
+        self.multi = False
+
+    def set_outputs(self, tensors, multi):
+        self.multi = multi
+        self.out_refs = [weakref.ref(t) for t in tensors]
+        self.out_avals = [(t._value.shape, t._value.dtype) for t in tensors]
+
+    def release(self):
+        self.inputs = None
+        self.in_tensors = []
+
+
+_VJP_CACHE = {}
+
+
+def _vjp_fn(name, fn, kwargs, diff_argnums, n_inputs, float_out_idxs, multi):
+    key = (dispatch.fn_key(name, fn), dispatch.hashable(kwargs), diff_argnums,
+           n_inputs, float_out_idxs, multi)
+    got = _VJP_CACHE.get(key)
+    if got is None:
+
+        def bwd(inputs, cts):
+            diff_ins = tuple(inputs[i] for i in diff_argnums)
+
+            def f(*d):
+                full = list(inputs)
+                for j, i in enumerate(diff_argnums):
+                    full[i] = d[j]
+                out = fn(*full, **kwargs)
+                if not multi:
+                    return (out,)
+                return tuple(out[i] for i in float_out_idxs)
+
+            _, vjp = jax.vjp(f, *diff_ins)
+            return vjp(cts)
+
+        got = jax.jit(bwd)
+        _VJP_CACHE[key] = got
+    return got
+
+
+def _run_node_backward(node, cts_by_outidx):
+    """Compute grads of node's diff inputs given cotangents keyed by out idx."""
+    if node.multi:
+        float_out_idxs = tuple(
+            i for i, (shape, dt) in enumerate(node.out_avals) if _is_float_dtype(dt)
+        )
+    else:
+        float_out_idxs = (0,)
+    cts = []
+    for i in float_out_idxs:
+        shape, dt = node.out_avals[i]
+        ct = cts_by_outidx.get(i)
+        if ct is None:
+            ct = jnp.zeros(shape, dt)
+        cts.append(ct)
+    bwd = _vjp_fn(
+        node.name,
+        node.fn,
+        node.kwargs,
+        node.diff_argnums,
+        len(node.inputs),
+        float_out_idxs,
+        node.multi,
+    )
+    return bwd(tuple(node.inputs), tuple(cts))
+
+
+def _toposort(root_nodes):
+    """Reverse-topological order of reachable nodes (PrepareDeps analog)."""
+    visited = set()
+    order = []
+    stack = [(n, False) for n in root_nodes]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for t in node.in_tensors:
+            if t._node is not None and id(t._node) not in visited:
+                stack.append((t._node, False))
+    # order is topological (deps first); we consume reversed
+    return order
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False, _accumulate_leaf=True):
+    """Run reverse accumulation from ``tensors`` (the BasicEngine::Execute analog)."""
+    from .tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    # cotangent accumulation keyed by tensor id
+    cotangents = {}
+    keep = {}  # id -> tensor (keep alive)
+    root_nodes = []
+    with dispatch.no_grad_ctx():
+        for t, g in zip(tensors, grad_tensors):
+            if t.stop_gradient and t._node is None:
+                continue
+            if g is None:
+                if t._value.size != 1:
+                    from . import errors
+
+                    raise errors.InvalidArgumentError(
+                        "backward() on a non-scalar tensor requires grad_tensors"
+                    )
+                g_arr = jnp.ones_like(t._value)
+            else:
+                g_arr = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+            _accum(cotangents, keep, t, g_arr)
+            if t._node is not None:
+                root_nodes.append(t._node)
+            else:
+                _into_leaf(t, cotangents, keep, _accumulate_leaf)
+
+        order = _toposort(root_nodes)
+        for node in reversed(order):
+            # gather cotangents for this node's outputs
+            cts_by_outidx = {}
+            any_ct = False
+            for ref, (shape, dt) in zip(node.out_refs, node.out_avals):
+                t = ref()
+                if t is None or t._node is not node:
+                    continue
+                ct = cotangents.pop(id(t), None)
+                keep.pop(id(t), None)
+                if ct is not None:
+                    for hook in t._hooks:
+                        h = hook(Tensor(ct, stop_gradient=True))
+                        if h is not None:
+                            ct = h._value if isinstance(h, Tensor) else jnp.asarray(h)
+                    cts_by_outidx[t._out_idx] = ct
+                    any_ct = True
+            if not any_ct:
+                continue
+            grads = _run_node_backward(node, cts_by_outidx)
+            for g, t in zip(grads, node.in_tensors):
+                if g is None or t.stop_gradient:
+                    continue
+                if t._node is None:
+                    _accum(cotangents, keep, t, g)
+                    _into_leaf(t, cotangents, keep, _accumulate_leaf)
+                else:
+                    _accum(cotangents, keep, t, g)
+            if not retain_graph:
+                node.release()
+
+    if not retain_graph:
+        for t in tensors:
+            if isinstance(t, Tensor):
+                t._node = None
+
+
+def _accum(cotangents, keep, t, g):
+    if hasattr(g, "dtype") and g.dtype != t._value.dtype:
+        g = g.astype(t._value.dtype)
+    tid = id(t)
+    if tid in cotangents:
+        cotangents[tid] = cotangents[tid] + g
+    else:
+        cotangents[tid] = g
+        keep[tid] = t
+
+
+def _into_leaf(t, cotangents, keep, accumulate=True):
+    """Flush accumulated cotangent into a leaf tensor's .grad (GradientAccumulator analog)."""
+    ct = cotangents.pop(id(t), None)
+    keep.pop(id(t), None)
+    if ct is None:
+        return
+    for hook in t._hooks:
+        from .tensor import Tensor
+
+        h = hook(Tensor(ct, stop_gradient=True))
+        if h is not None:
+            ct = h._value if isinstance(h, Tensor) else jnp.asarray(h)
+    if not accumulate:
+        return
+    if t._grad is None:
+        t._grad = ct
+    else:
+        t._grad = t._grad + ct
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
+         only_inputs=True, allow_unused=False):
+    """paddle.grad — gradients of outputs w.r.t. an explicit set of inputs.
+
+    Reference: imperative/partial_grad_engine.cc, python/paddle/autograd.
+    create_graph (double grad) is not yet supported in eager mode; use the
+    functional `paddle_tpu.incubate.autograd` transforms for higher-order.
+    """
+    from .tensor import Tensor
+    from . import errors
+
+    if create_graph:
+        raise errors.UnimplementedError(
+            "create_graph=True (double grad) is not supported by the eager tape yet"
+        )
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if retain_graph is None:
+        retain_graph = False
+
+    # Save/restore leaf grads so paddle.grad doesn't pollute .grad
+    saved = [(t, t._grad) for t in inputs]
+    for t in inputs:
+        t._grad = None
+    results = {id(t): None for t in inputs}
+
+    hooks_added = []
+    for t in inputs:
+        def make_hook(tid):
+            def hook(g):
+                prev = results[tid]
+                results[tid] = g if prev is None else Tensor(prev._value + g._value, stop_gradient=True)
+                return None
+
+            return hook
+
+        h = make_hook(id(t))
+        t._hooks.append(h)
+        hooks_added.append((t, h))
+
+    try:
+        backward(outputs, grad_outputs, retain_graph=retain_graph,
+                 _accumulate_leaf=False)
+    finally:
+        for t, h in hooks_added:
+            t._hooks.remove(h)
+
+    out = []
+    for t, old in saved:
+        g = results[id(t)]
+        if g is None and t._grad is not None:
+            g = Tensor(t._grad, stop_gradient=True)
+        if g is None and not allow_unused:
+            raise errors.InvalidArgumentError(
+                "an input tensor received no gradient; pass allow_unused=True"
+            )
+        out.append(g)
+        t._grad = old
+    return out

@@ -1,0 +1,275 @@
+"""Tensor — the user-facing n-d array.
+
+TPU-native analog of the reference's dygraph VarBase wrapping a framework
+Tensor (reference: paddle/fluid/imperative/layer.h:66 VarBase,
+framework/tensor.h:89, python varbase_patch_methods.py). Here a Tensor
+wraps an immutable ``jax.Array`` (or a tracer under jit); "mutation"
+(set_value, optimizer updates, __setitem__) rebinds the wrapped value,
+which is the idiomatic functional-core/mutable-shell design for XLA.
+
+LoD (ragged) tensors are deliberately not reproduced: TPU/XLA wants
+static shapes, so ragged batches map to dense padding + explicit
+``seq_len`` masks (see paddle_tpu.text.ragged helpers).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dispatch, dtype as dtype_mod, place as place_mod, tape
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "_grad",
+        "_node",
+        "_out_idx",
+        "_hooks",
+        "name",
+        "persistable",
+        "__weakref__",
+        "__dict__",
+    )
+
+    def __init__(self, value, dtype=None, place=None, stop_gradient=True, name=None):
+        if isinstance(value, Tensor):
+            value = value._value
+        if not isinstance(value, (jax.Array, jax.core.Tracer)):
+            np_dtype = dtype_mod.convert_dtype(dtype)
+            arr = np.asarray(value)
+            if np_dtype is None and arr.dtype == np.float64:
+                np_dtype = np.dtype(dtype_mod.get_default_dtype())
+            if np_dtype is None and arr.dtype == np.int64 and arr.ndim == 0:
+                np_dtype = np.dtype(np.int64)
+            value = jnp.asarray(arr, dtype=np_dtype)
+            if place is not None:
+                value = jax.device_put(value, place.jax_device())
+        elif dtype is not None and not isinstance(value, jax.core.Tracer):
+            nd = dtype_mod.convert_dtype(dtype)
+            if np.dtype(value.dtype) != nd:
+                value = value.astype(nd)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._node = None
+        self._out_idx = 0
+        self._hooks = []
+        self.name = name
+        self.persistable = False
+
+    # ------------------------------------------------------------ properties
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        if isinstance(self._value, jax.core.Tracer):
+            return place_mod.current_place()
+        devs = getattr(self._value, "devices", None)
+        if devs is None:
+            return place_mod.current_place()
+        dev = next(iter(self._value.devices()))
+        if dev.platform == "tpu":
+            return place_mod.TPUPlace(dev.id)
+        if dev.platform == "gpu":
+            return place_mod.CUDAPlace(dev.id)
+        return place_mod.CPUPlace()
+
+    @property
+    def grad(self):
+        if self._grad is None:
+            return None
+        g = Tensor(self._grad, stop_gradient=True)
+        g.name = (self.name or "tensor") + "@GRAD"
+        return g
+
+    @grad.setter
+    def grad(self, value):
+        self._grad = None if value is None else (
+            value._value if isinstance(value, Tensor) else jnp.asarray(value)
+        )
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    # ------------------------------------------------------------ conversion
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        return self.numpy().item(*args)
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __len__(self):
+        if not self._value.shape:
+            raise TypeError("len() of a 0-D tensor")
+        return self._value.shape[0]
+
+    # ------------------------------------------------------------ autograd
+    def backward(self, grad_tensor=None, retain_graph=False):
+        tape.backward([self], None if grad_tensor is None else [grad_tensor],
+                      retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, hook):
+        self._hooks.append(hook)
+
+        class _Handle:
+            def remove(_self):
+                try:
+                    self._hooks.remove(hook)
+                except ValueError:
+                    pass
+
+        return _Handle()
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True)
+        t.name = self.name
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        from .. import tensor as ops
+
+        return ops.assign(self)
+
+    # ------------------------------------------------------------ mutation
+    def set_value(self, value):
+        """Rebind the wrapped array (breaks the autograd link, like the reference)."""
+        if isinstance(value, Tensor):
+            value = value._value
+        new = jnp.asarray(value, dtype=self._value.dtype)
+        if new.shape != self._value.shape:
+            from . import errors
+
+            raise errors.InvalidArgumentError(
+                f"set_value shape mismatch {new.shape} vs {self._value.shape}"
+            )
+        self._value = new
+        self._node = None
+
+    def _assign_result(self, t):
+        """Adopt another tensor's value + autograd node (in-place op support)."""
+        self._value = t._value
+        self._node = t._node
+        self._out_idx = t._out_idx
+        self.stop_gradient = t.stop_gradient
+
+    def copy_(self, other):
+        self.set_value(other)
+        return self
+
+    # ------------------------------------------------------------ devices
+    def cpu(self):
+        return Tensor(jax.device_put(self._value, jax.devices("cpu")[0]),
+                      stop_gradient=self.stop_gradient)
+
+    def cuda(self, device_id=0):
+        return self.to_device(place_mod.CUDAPlace(device_id))
+
+    def tpu(self, device_id=0):
+        return self.to_device(place_mod.TPUPlace(device_id))
+
+    def pin_memory(self):
+        return self
+
+    def to_device(self, place):
+        return Tensor(jax.device_put(self._value, place.jax_device()),
+                      stop_gradient=self.stop_gradient)
+
+    # ------------------------------------------------------------ misc
+    def __repr__(self):
+        if isinstance(self._value, jax.core.Tracer):
+            return (f"Tensor(shape={self.shape}, dtype={dtype_mod.dtype_name(self.dtype)}, "
+                    f"traced={self._value})")
+        return (
+            f"Tensor(shape={self.shape}, dtype={dtype_mod.dtype_name(self.dtype)}, "
+            f"place={self.place!r}, stop_gradient={self.stop_gradient},\n"
+            f"       {np.array2string(self.numpy(), prefix='       ')})"
+        )
+
+    def __hash__(self):
+        return id(self)
+
+    def __deepcopy__(self, memo):
+        t = Tensor(self._value, stop_gradient=self.stop_gradient)
+        t.name = self.name
+        t.persistable = self.persistable
+        memo[id(self)] = t
+        return t
+
+    def block_until_ready(self):
+        if hasattr(self._value, "block_until_ready"):
+            self._value.block_until_ready()
+        return self
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: framework.py:5727 ParamBase)."""
+
+    def __init__(self, value, trainable=True, name=None, **kw):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self.stop_gradient = not v
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor (reference: python/paddle/tensor/creation.py to_tensor)."""
+    if isinstance(data, Tensor):
+        t = Tensor(data._value, dtype=dtype, place=place, stop_gradient=stop_gradient)
+        return t
+    return Tensor(data, dtype=dtype, place=place or place_mod.current_place(),
+                  stop_gradient=stop_gradient)

@@ -1,0 +1,20 @@
+"""paddle.distributed (reference: python/paddle/distributed/)."""
+from .parallel import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, ParallelEnv, DataParallel, spawn,
+)
+from .collective import (  # noqa: F401
+    all_reduce, all_gather, broadcast, reduce, scatter, barrier, send, recv,
+    all_to_all, new_group, is_initialized, ReduceOp, Group,
+    psum, pmean, pmax, all_gather_spmd, ppermute, all_to_all_spmd,
+)
+from . import topology  # noqa: F401
+from .topology import (  # noqa: F401
+    HybridCommunicateGroup, CommunicateTopology, build_mesh, get_global_mesh,
+    set_global_mesh,
+)
+from . import fleet  # noqa: F401
+from . import spmd  # noqa: F401
+from . import meta_parallel  # noqa: F401
+from .spmd import build_train_step, shard_batch  # noqa: F401
+from . import sharding  # noqa: F401
+from .launch_mod import launch  # noqa: F401

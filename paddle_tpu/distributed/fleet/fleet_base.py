@@ -1,0 +1,184 @@
+"""Fleet facade (reference: fleet/base/fleet_base.py: init, worker_num,
+distributed_optimizer:661, minimize:1161 -> StrategyCompiler ->
+meta-optimizer rewrites).
+
+TPU-native: fleet.init builds the hybrid Mesh from strategy.hybrid_configs;
+distributed_optimizer returns a wrapper whose minimize/step applies the
+strategy *functionally* (amp scaler, recompute flag, sharding specs) —
+there are no program rewrites because there are no programs: XLA compiles
+the sharded step directly (meta-optimizer stack collapsed).
+"""
+import jax
+
+from ...optimizer import Optimizer
+from .distributed_strategy import DistributedStrategy
+from .. import topology as topo_mod
+from ..parallel import ParallelEnv
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy = None
+        self._hcg = None
+        self._is_collective = True
+        self._util = None
+
+    def init(self, role_maker=None, is_collective=False, strategy=None):
+        self._strategy = strategy or DistributedStrategy()
+        self._is_collective = is_collective or role_maker is None
+        hc = self._strategy.hybrid_configs
+        n_dev = len(jax.devices())
+        dp = hc.get("dp_degree", 1)
+        mp = hc.get("mp_degree", 1)
+        pp = hc.get("pp_degree", 1)
+        sh = hc.get("sharding_degree", 1)
+        if dp * mp * pp * sh <= 1:
+            dp, mp, pp, sh = n_dev, 1, 1, 1
+        self._hcg = topo_mod.HybridCommunicateGroup(dp=dp, mp=mp, pp=pp, sharding=sh)
+        topo_mod.set_hybrid_communicate_group(self._hcg)
+        return self
+
+    # --- role info (reference fleet_base) ---
+    def worker_num(self):
+        return jax.process_count()
+
+    def worker_index(self):
+        return jax.process_index()
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return jax.process_index() == 0
+
+    def worker_endpoints(self, to_string=False):
+        eps = ParallelEnv().trainer_endpoints
+        return ",".join(eps) if to_string else eps
+
+    def server_num(self):
+        return 0
+
+    def barrier_worker(self):
+        from ..collective import barrier
+
+        barrier()
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def _user_defined_strategy(self):
+        return self._strategy
+
+    # --- model/optimizer wrapping ---
+    def distributed_model(self, model):
+        """reference: fleet_base.py distributed_model — picks the wrapper by
+        parallel mode."""
+        mode = self._hcg.get_parallel_mode() if self._hcg else "data"
+        if mode == "pipe" or (self._strategy and self._strategy.pipeline):
+            from ..meta_parallel import PipelineParallel
+
+            return PipelineParallel(model, self._hcg, self._strategy)
+        if mode in ("model", "hybrid"):
+            from ..meta_parallel import ModelParallel
+
+            return ModelParallel(model, self._hcg, self._strategy)
+        from ..parallel import DataParallel
+
+        return DataParallel(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        if strategy is not None:
+            self._strategy = strategy
+        return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
+
+    # legacy static-mode entry
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        raise NotImplementedError(
+            "static fleet.minimize: build the model in dygraph and use "
+            "distributed_optimizer(...).minimize or distributed/spmd.py")
+
+
+class HybridParallelOptimizer(Optimizer):
+    """reference: fleet/meta_optimizers/dygraph_optimizer/
+    hybrid_parallel_optimizer.py:84 — wraps the inner optimizer; grad
+    sync & sharding come from SPMD so only amp/recompute/gradient-merge
+    behaviors remain."""
+
+    def __init__(self, inner, hcg=None, strategy=None):
+        self._inner = inner
+        self._hcg = hcg
+        self._strategy = strategy
+        self._merge_count = 0
+        self._k_steps = 1
+        if strategy is not None and strategy.gradient_merge:
+            self._k_steps = strategy.gradient_merge_configs.get("k_steps", 1)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        if self._k_steps > 1:
+            # gradient merge (reference GradientMergeOptimizer): accumulate
+            # k steps of grads in .grad, step on the k-th
+            self._merge_count += 1
+            if self._merge_count < self._k_steps:
+                return
+            self._merge_count = 0
+        self._inner.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return [], []
+
+    def clear_grad(self, set_to_zero=True):
+        if self._k_steps > 1 and self._merge_count != 0:
+            return  # keep accumulating
+        self._inner.clear_grad()
+
+    clear_gradients = clear_grad
+
+
+_fleet_singleton = Fleet()
+
+
+def init(role_maker=None, is_collective=False, strategy=None):
+    return _fleet_singleton.init(role_maker, is_collective, strategy)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return _fleet_singleton.distributed_optimizer(optimizer, strategy)
+
+
+def distributed_model(model):
+    return _fleet_singleton.distributed_model(model)
+
+
+def get_hybrid_communicate_group():
+    return _fleet_singleton.get_hybrid_communicate_group()
+
+
+def worker_num():
+    return _fleet_singleton.worker_num()
+
+
+def worker_index():
+    return _fleet_singleton.worker_index()
+
+
+def is_worker():
+    return _fleet_singleton.is_worker()
+
+
+def is_server():
+    return _fleet_singleton.is_server()
+
+
+def barrier_worker():
+    return _fleet_singleton.barrier_worker()

@@ -1,0 +1,24 @@
+"""Hybrid-parallel glue (reference: fleet/utils/hybrid_parallel_util.py:
+broadcast_mp_parameters:93, fused_allreduce_gradients:107).
+
+In the SPMD model gradient reduction across dp is performed by XLA inside
+the compiled step (grads of replicated params are psum'd automatically),
+and parameters are global arrays — already consistent across ranks. These
+functions are therefore consistency checks / no-ops kept for API parity.
+"""
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    return
+
+
+def broadcast_mp_parameters(model, hcg):
+    return
+
+
+def broadcast_dp_parameters(model, hcg):
+    return
+
+
+def broadcast_sharding_parameters(model, hcg):
+    return

@@ -1,0 +1,45 @@
+"""Launcher (reference: python/paddle/distributed/fleet/launch.py:215
+launch_collective, launch_utils.py:59 Cluster/Pod, watch_local_trainers:556).
+
+TPU-native: ONE process per host drives all local chips through the mesh
+(vs the reference's one-proc-per-GPU), so the local launcher just execs
+the script with PADDLE_* env set; multi-host pods use
+jax.distributed.initialize with the coordinator from PADDLE_MASTER.
+Failure handling mirrors watch_local_trainers: child exit tears down the
+pod.
+"""
+import os
+import subprocess
+import sys
+
+
+def launch(script=None, args=(), nnodes=1, node_rank=0, master=None):
+    env = dict(os.environ)
+    env["PADDLE_TRAINER_ID"] = str(node_rank)
+    env["PADDLE_TRAINERS_NUM"] = str(nnodes)
+    if master:
+        env["PADDLE_COORDINATOR"] = master
+    cmd = [sys.executable, script, *args]
+    proc = subprocess.Popen(cmd, env=env)
+    ret = proc.wait()
+    if ret != 0:
+        raise RuntimeError(f"trainer exited with code {ret}")
+    return ret
+
+
+def main():
+    import argparse
+
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", type=int, default=int(os.environ.get(
+        "PADDLE_TRAINER_ID", 0)))
+    p.add_argument("--master", default=os.environ.get("PADDLE_MASTER"))
+    p.add_argument("script")
+    p.add_argument("script_args", nargs="*")
+    ns = p.parse_args()
+    launch(ns.script, ns.script_args, ns.nnodes, ns.node_rank, ns.master)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,15 @@
+"""ModelParallel wrapper (reference: fleet/meta_parallel/model_parallel.py:21).
+With sharding-annotated mp layers there is no per-op communication to
+orchestrate — the wrapper only broadcasts (ensures identical) non-mp
+parameters, which in the global-view model is already guaranteed."""
+from ... import nn
+
+
+class ModelParallel(nn.Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)

@@ -1,0 +1,98 @@
+"""Pipeline layer description (reference: fleet/meta_parallel/
+parallel_layers/pp_layers.py:22 SegmentLayers, :61 PipelineLayer)."""
+import math
+
+from ... import nn
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """reference: pp_layers.py:22 — partition N layers into M stages."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self.layers_desc = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+
+    def do_segment(self):
+        n = len(self.layers_desc)
+        if self.method == "uniform":
+            base = n // self.num_parts
+            extra = n % self.num_parts
+            parts = [0]
+            for i in range(self.num_parts):
+                parts.append(parts[-1] + base + (1 if i < extra else 0))
+            return parts
+        raise ValueError(self.method)
+
+
+class PipelineLayer(nn.Layer):
+    """reference: pp_layers.py:61.
+
+    Holds the full layer list; ``segments`` exposes the stage partition.
+    In the TPU SPMD model all stages live in the one program — the pp
+    mesh axis decides which devices own which stage's weights (see
+    distributed/spmd.py stage sharding) — so forward here is the
+    sequential composition, and the microbatched 1F1B schedule is applied
+    by PipelineParallel.train_batch when tracing the distributed step.
+    """
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, **kwargs):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self._num_stages = num_stages or (topology.get_dim("pipe") if topology else 1)
+        self.layers_desc = list(layers)
+        self.run_functions = nn.LayerList()
+        for item in self.layers_desc:
+            if isinstance(item, LayerDesc):
+                self.run_functions.append(item.build_layer())
+            elif isinstance(item, nn.Layer):
+                self.run_functions.append(item)
+            else:  # a plain callable
+                self.run_functions.append(_FuncLayer(item))
+        seg = SegmentLayers(self.layers_desc, self._num_stages, seg_method)
+        self.segment_parts = seg.do_segment()
+
+    def get_stage_from_index(self, layer_idx):
+        for stage in range(self._num_stages):
+            if self.segment_parts[stage] <= layer_idx < self.segment_parts[stage + 1]:
+                return stage
+        return self._num_stages - 1
+
+    def stage_layers(self, stage):
+        lo, hi = self.segment_parts[stage], self.segment_parts[stage + 1]
+        return list(self.run_functions)[lo:hi]
+
+    def forward(self, x):
+        for layer in self.run_functions:
+            x = layer(x)
+        return x
+
+
+class _FuncLayer(nn.Layer):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)

@@ -1,0 +1,122 @@
+"""Process/env + dygraph DataParallel (reference: python/paddle/distributed/
+parallel.py:57 init_parallel_env, python/paddle/fluid/dygraph/parallel.py:380
+DataParallel; C++ imperative/reducer.cc).
+"""
+import os
+
+import jax
+
+from ..nn.layer import Layer
+from . import topology
+
+
+class ParallelEnv:
+    """reference: dygraph/parallel.py ParallelEnv (PADDLE_* env)."""
+
+    def __init__(self):
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+        self._world_size = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                              jax.process_count()))
+        self._device_id = 0
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def local_rank(self):
+        return self._rank
+
+    @property
+    def nranks(self):
+        return self._world_size
+
+    @property
+    def dev_id(self):
+        return self._device_id
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "127.0.0.1:6170")
+        return eps.split(",")
+
+
+def init_parallel_env():
+    """reference: distributed/parallel.py:57. On TPU this is
+    jax.distributed.initialize (multi-host) + building the global mesh —
+    the NCCL-ring bootstrap (gen_comm_id_helper.cc TCP exchange) is
+    replaced by the JAX coordination service.
+    """
+    if jax.process_count() == 1 and os.environ.get("PADDLE_TRAINERS_NUM"):
+        n = int(os.environ["PADDLE_TRAINERS_NUM"])
+        if n > 1 and os.environ.get("PADDLE_COORDINATOR"):
+            jax.distributed.initialize(
+                coordinator_address=os.environ["PADDLE_COORDINATOR"],
+                num_processes=n,
+                process_id=int(os.environ.get("PADDLE_TRAINER_ID", 0)))
+    mesh = topology.build_mesh(dp=len(jax.devices()))
+    topology.set_global_mesh(mesh)
+    return ParallelEnv()
+
+
+def get_rank(group=None):
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    return jax.process_count()
+
+
+class DataParallel(Layer):
+    """reference: dygraph/parallel.py:380 + reducer.cc bucketed allreduce.
+
+    TPU-native: with the global-view array model there is nothing to
+    reduce — the batch axis is sharded over 'dp', parameters are
+    replicated, and XLA inserts the gradient psum during the (traced or
+    eager-vjp) backward. scale_loss/apply_collective_grads are therefore
+    identities kept for API parity; gradient bucketing (reducer.cc's
+    raison d'être) is subsumed by XLA collective fusion.
+    """
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    @property
+    def parameters_attr(self):
+        return self._layers.parameters()
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """reference: distributed/spawn.py:317. One process drives all local TPU
+    chips via the mesh, so spawn degenerates to a direct call."""
+    func(*args)

@@ -1,0 +1,56 @@
+"""ZeRO-style sharding helpers (reference: fleet/meta_optimizers/
+sharding_optimizer.py:40 — 3k lines of static program surgery; dygraph
+group_sharded_parallel).
+
+TPU-native: optimizer-state (stage 1), gradient (stage 2) and parameter
+(stage 3) sharding are sharding specs over the 'sharding'/'dp' mesh axes
+applied to the state pytrees of the compiled train step — XLA handles the
+reduce-scatter/all-gather placement. See distributed/spmd.py
+``build_train_step(shard_optimizer=True)`` for stage 1 wired in.
+"""
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from . import topology
+
+
+def shard_arrays(tree, mesh=None, axes=("dp", "sharding")):
+    """Place every array in the pytree sharded over `axes` on its first
+    divisible dimension (ZeRO partitioning)."""
+    from .spmd import _zero1_spec
+
+    mesh = mesh or topology.get_global_mesh()
+    return jax.tree.map(lambda a: jax.device_put(a, _zero1_spec(a, mesh, axes)), tree)
+
+
+def group_sharded_parallel(model, optimizer, level="os", scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=2 ** 23,
+                           segment_size=2 ** 20, sync_comm=False):
+    """reference: python/paddle/distributed/sharding/group_sharded.py.
+    level: 'os' (ZeRO-1) | 'os_g' (ZeRO-2) | 'p_g_os' (ZeRO-3).
+
+    Dygraph adapter: marks the optimizer so its eager state arrays are
+    placed sharded; the fully-sharded path is the compiled spmd step.
+    """
+    optimizer._sharding_level = level
+    orig_step = optimizer.step
+
+    def stepped():
+        orig_step()
+        if getattr(optimizer, "_sharding_level", None):
+            mesh = topology.get_global_mesh()
+            for pid, state in list(optimizer._accumulators.items()):
+                optimizer._accumulators[pid] = tuple(
+                    shard_arrays(list(state), mesh))
+
+    optimizer.step = stepped
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    from .. import framework
+
+    framework.save(model.state_dict(), output + ".pdparams")
+    if optimizer is not None:
+        framework.save(optimizer.state_dict(), output + ".pdopt")

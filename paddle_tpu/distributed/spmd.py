@@ -1,0 +1,168 @@
+"""SPMD train-step builder — the TPU-native ParallelExecutor/meta-optimizer.
+
+Reference analogs: the multi-device SSA graph + allreduce op-handles
+(framework/details/), GraphExecutionOptimizer, sharding_optimizer.py's
+3k-line program surgery, TensorParallelOptimizer — all collapsed into:
+pick a Mesh, annotate shardings, jit, let XLA insert ICI collectives
+(the scaling-book recipe).
+
+``build_train_step`` returns one compiled function
+  (params, opt_state, batch, key, lr) -> (loss, params, opt_state)
+with:
+- batch sharded over 'dp' (data parallel: grad psum from SPMD),
+- params sharded per-tensor over 'mp' if the layer attached an ``mp_spec``
+  (tensor parallel), replicated otherwise,
+- optimizer states sharded over 'dp'/'sharding' (ZeRO-1) when
+  ``shard_optimizer=True``,
+- optional jax.checkpoint (recompute) around the loss fn.
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core import dispatch, random as random_core
+from ..core.tensor import Tensor
+from . import topology
+
+
+def param_sharding_spec(layer, mesh):
+    """Per-parameter PartitionSpec: mp_spec annotation if present, else
+    replicated. Returns dict name -> NamedSharding."""
+    specs = {}
+    for name, p in layer.named_parameters():
+        spec = getattr(p, "mp_spec", None)
+        specs[name] = NamedSharding(mesh, spec if spec is not None else P())
+    return specs
+
+
+def _zero1_spec(arr, mesh, axes=("dp", "sharding")):
+    """Shard the largest divisible dim of an optimizer-state array over the
+    dp/sharding axes (ZeRO-1; reference sharding_optimizer.py shards by
+    param — per-dim sharding is the XLA-friendly equivalent)."""
+    n = 1
+    for ax in axes:
+        n *= mesh.shape.get(ax, 1)
+    if n == 1 or arr.ndim == 0:
+        return NamedSharding(mesh, P())
+    for dim, size in enumerate(arr.shape):
+        if size % n == 0:
+            spec = [None] * arr.ndim
+            spec[dim] = axes if len(axes) > 1 else axes[0]
+            return NamedSharding(mesh, P(*spec))
+    return NamedSharding(mesh, P())
+
+
+def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
+                     shard_optimizer=False, donate=True):
+    """Compile the full distributed training step for `layer`.
+
+    loss_fn(model_out, label_array) -> scalar (pure jnp).
+    Returns (step_fn, init_fn) where init_fn() -> (params, opt_state) as
+    properly-sharded global arrays, and
+    step_fn(params, opt_state, x, y, key, lr) -> (loss, params, opt_state).
+    """
+    mesh = mesh or topology.get_global_mesh()
+    params0, buffers0 = layer.functional_state()
+    param_names = list(params0)
+    buffer_names = list(buffers0)
+    p_shardings = param_sharding_spec(layer, mesh)
+
+    def forward_loss(params, buffers, x, y, key):
+        saved_p = {n: p._value for n, p in layer.named_parameters()}
+        saved_b = dict(buffers0)
+        try:
+            with dispatch.trace_mode(), random_core.rng_guard(key):
+                layer.load_functional_state(params, buffers)
+                out = layer.forward(Tensor(x, stop_gradient=True))
+                out_arr = out._value if isinstance(out, Tensor) else out
+                return loss_fn(out_arr, y)
+        finally:
+            layer.load_functional_state(saved_p, saved_b)
+
+    if recompute:
+        forward_loss = jax.checkpoint(forward_loss, static_argnums=())
+
+    hypers = optimizer._hypers()
+    opt_update = type(optimizer)._update
+    grad_clip = optimizer._grad_clip
+
+    def step(params, opt_state, buffers, x, y, key, lr):
+        # batch stays dp-sharded via in_shardings; grads of replicated params
+        # get psum'd across dp by SPMD automatically.
+        loss, grads = jax.value_and_grad(
+            lambda p: forward_loss(p, buffers, x, y, key))(params)
+        if grad_clip is not None:
+            names = list(grads)
+            clipped = grad_clip.clip_arrays([grads[n] for n in names])
+            grads = dict(zip(names, clipped))
+        new_params, new_state = {}, {}
+        for name in param_names:
+            g = grads[name].astype(params[name].dtype)
+            out = opt_update(params[name], g, lr, *opt_state[name], **hypers)
+            new_params[name] = out[0]
+            new_state[name] = tuple(out[1:])
+        return loss, new_params, new_state
+
+    # shardings
+    param_shards = {n: p_shardings[n] for n in param_names}
+    repl = NamedSharding(mesh, P())
+    batch_shard = NamedSharding(mesh, P("dp"))
+
+    def init_fn():
+        params = {n: jax.device_put(params0[n], param_shards[n])
+                  for n in param_names}
+        opt_state = {}
+        for n in param_names:
+            st = optimizer._init_state(params0[n])
+            if shard_optimizer:
+                opt_state[n] = tuple(
+                    jax.device_put(a, _zero1_spec(a, mesh)) for a in st)
+            else:
+                opt_state[n] = tuple(jax.device_put(a, repl) for a in st)
+        return params, opt_state
+
+    opt_shardings = {}
+    p0, s0 = None, None
+
+    def make_step():
+        params_sh = param_shards
+        # opt-state shardings mirror init_fn's placement
+        dummy_state = {n: optimizer._init_state(
+            jax.ShapeDtypeStruct(params0[n].shape, params0[n].dtype))
+            if False else None for n in param_names}
+        in_shardings = (
+            params_sh,
+            None,  # let opt_state shardings propagate from inputs
+            {n: repl for n in buffer_names},
+            batch_shard,
+            batch_shard,
+            repl,
+            repl,
+        )
+        out_shardings = (repl, params_sh, None)
+        jit_kwargs = {}
+        return jax.jit(step, in_shardings=in_shardings,
+                       out_shardings=out_shardings, **jit_kwargs)
+
+    step_jit = make_step()
+
+    def step_fn(params, opt_state, x, y, key=None, lr=None):
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        if lr is None:
+            lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
+        buffers = {n: jnp.asarray(buffers0[n]) for n in buffer_names}
+        return step_jit(params, opt_state, buffers, x, y, key, lr)
+
+    return step_fn, init_fn
+
+
+def shard_batch(batch, mesh=None, axis="dp"):
+    """Place a host array as a dp-sharded global array."""
+    mesh = mesh or topology.get_global_mesh()
+    arr = batch._value if isinstance(batch, Tensor) else jnp.asarray(np.asarray(batch))
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.device_put(arr, sharding)

@@ -1,0 +1,119 @@
+"""paddle.distribution (reference: python/paddle/distribution.py —
+Normal/Uniform/Categorical)."""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core import random as random_core
+from .core.dispatch import apply_op
+from .core.tensor import Tensor
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(np.asarray(x, np.float32))
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+
+    def sample(self, shape=(), seed=0):
+        def _s(key, low, high, *, shape):
+            full = tuple(shape) + jnp.broadcast_shapes(low.shape, high.shape)
+            return jax.random.uniform(key, full) * (high - low) + low
+
+        return apply_op("uniform_sample", _s, random_core.next_key(), self.low,
+                        self.high, shape=tuple(shape))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        lb = (v > self.low).astype(jnp.float32)
+        ub = (v < self.high).astype(jnp.float32)
+        return Tensor(jnp.log(lb * ub) - jnp.log(self.high - self.low))
+
+    def probs(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._value))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def sample(self, shape=(), seed=0):
+        def _s(key, loc, scale, *, shape):
+            full = tuple(shape) + jnp.broadcast_shapes(loc.shape, scale.shape)
+            return loc + scale * jax.random.normal(key, full)
+
+        return apply_op("normal_sample", _s, random_core.next_key(), self.loc,
+                        self.scale, shape=tuple(shape))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var) -
+                      jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def probs(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._value))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale))
+
+    def kl_divergence(self, other):
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _arr(logits)
+
+    def sample(self, shape=()):
+        def _s(key, logits, *, shape):
+            return jax.random.categorical(key, logits, shape=tuple(shape) +
+                                          logits.shape[:-1]).astype(jnp.int64)
+
+        return apply_op("categorical_sample", _s, random_core.next_key(),
+                        self.logits, shape=tuple(shape))
+
+    def probs(self, value=None):
+        p = jax.nn.softmax(self.logits, axis=-1)
+        if value is None:
+            return Tensor(p)
+        idx = _arr(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(p, idx[..., None], axis=-1)[..., 0])
+
+    def log_prob(self, value):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        idx = _arr(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(logp, idx[..., None], axis=-1)[..., 0])
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return Tensor(-jnp.sum(jnp.exp(logp) * logp, axis=-1))
+
+    def kl_divergence(self, other):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        logq = jax.nn.log_softmax(other.logits, axis=-1)
+        return Tensor(jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1))

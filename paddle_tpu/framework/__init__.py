@@ -1,0 +1,76 @@
+"""paddle.framework — save/load + misc (reference:
+python/paddle/framework/io.py:492 save, :663 load)."""
+import os
+import pickle
+
+import numpy as np
+
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+from ..core.tensor import Tensor
+from ..core import place as place_mod
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(obj._value),
+                "name": obj.name, "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saveable(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            if return_numpy:
+                return obj["data"]
+            t = Tensor(obj["data"], stop_gradient=obj.get("stop_gradient", True))
+            t.name = obj.get("name")
+            return t
+        return {k: _from_saveable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_saveable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """paddle.save — pickle of (nested) state dicts with Tensors as numpy."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    """paddle.load — inverse of save."""
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_saveable(obj, return_numpy=configs.get("return_numpy", False))
+
+
+def get_default_dtype():
+    from ..core.dtype import get_default_dtype as g
+
+    return g()
+
+
+def set_default_dtype(d):
+    from ..core.dtype import set_default_dtype as s
+
+    return s(d)
+
+
+# compat names
+CPUPlace = place_mod.CPUPlace
+CUDAPlace = place_mod.CUDAPlace
+TPUPlace = place_mod.TPUPlace
+
+
+def in_dygraph_mode():
+    from ..jit import in_dynamic_mode
+
+    return in_dynamic_mode()

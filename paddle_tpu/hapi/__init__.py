@@ -1,0 +1,5 @@
+"""paddle.hapi — high-level Model API (reference: python/paddle/hapi/
+model.py:876 Model, fit:1519; callbacks.py, model_summary.py)."""
+from .model import Model  # noqa: F401
+from .model_summary import summary  # noqa: F401
+from . import callbacks  # noqa: F401

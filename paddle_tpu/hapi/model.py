@@ -1,0 +1,238 @@
+"""Model — Keras-like training facade (reference: hapi/model.py:876;
+train_batch:1013, fit:1519; DynamicGraphAdapter:659).
+
+The dual static/dynamic adapter pair collapses to one adapter: the eager
+path runs the dygraph step; to_static on the network gives the compiled
+path with the same code.
+"""
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io import DataLoader
+from ..metric import Metric
+from . import callbacks as callbacks_mod
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+        return self
+
+    # ------------------------------------------------------------ one batch
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is None or isinstance(labels, (list, tuple)) \
+            else [labels]
+        outputs = self.network(*[_to_tensor(i) for i in inputs])
+        losses = self._compute_loss(outputs, labels)
+        losses.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        return ([float(losses.numpy())], metrics) if metrics else [float(losses.numpy())]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        from ..core.dispatch import no_grad_ctx
+
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is None or isinstance(labels, (list, tuple)) \
+            else [labels]
+        with no_grad_ctx():
+            outputs = self.network(*[_to_tensor(i) for i in inputs])
+            losses = self._compute_loss(outputs, labels)
+        metrics = self._update_metrics(outputs, labels)
+        return ([float(losses.numpy())], metrics) if metrics else [float(losses.numpy())]
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..core.dispatch import no_grad_ctx
+
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        with no_grad_ctx():
+            outputs = self.network(*[_to_tensor(i) for i in inputs])
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        return [o.numpy() for o in outs]
+
+    def _compute_loss(self, outputs, labels):
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        lbls = [_to_tensor(l) for l in (labels or [])]
+        if self._loss is None:
+            return outs[0]
+        return self._loss(*outs, *lbls)
+
+    def _update_metrics(self, outputs, labels):
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        lbls = [_to_tensor(l) for l in (labels or [])]
+        res = []
+        for m in self._metrics:
+            computed = m.compute(*outs, *lbls)
+            if not isinstance(computed, (list, tuple)):
+                computed = [computed]
+            r = m.update(*computed)
+            res.append(r)
+        return res
+
+    # ------------------------------------------------------------ loops
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        train_loader = _as_loader(train_data, batch_size, shuffle, drop_last,
+                                  num_workers)
+        eval_loader = _as_loader(eval_data, batch_size, False, False, num_workers) \
+            if eval_data is not None else None
+        cbks = callbacks_mod.config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=_safe_len(train_loader),
+            log_freq=log_freq, save_freq=save_freq, save_dir=save_dir,
+            verbose=verbose, metrics=self._metrics_names())
+        cbks.on_begin("train")
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                if num_iters is not None and step >= num_iters:
+                    break
+                cbks.on_batch_begin("train", step, logs)
+                ins, lbls = _split_batch(batch)
+                result = self.train_batch(ins, lbls)
+                logs = self._make_logs(result, step)
+                cbks.on_batch_end("train", step, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self._run_eval(eval_loader)
+                logs.update({f"val_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/{epoch}")
+        cbks.on_end("train")
+        if save_dir:
+            self.save(f"{save_dir}/final")
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = _as_loader(eval_data, batch_size, False, False, num_workers)
+        logs = self._run_eval(loader, num_iters)
+        return logs
+
+    def _run_eval(self, loader, num_iters=None):
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(loader):
+            if num_iters is not None and step >= num_iters:
+                break
+            ins, lbls = _split_batch(batch)
+            result = self.eval_batch(ins, lbls)
+            loss = result[0] if isinstance(result, tuple) else result
+            losses.append(loss[0])
+        logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, (list, tuple)) else [vals]
+            logs.update(dict(zip(names, vals)))
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = _as_loader(test_data, batch_size, False, False, num_workers)
+        outputs = []
+        for batch in loader:
+            ins, _ = _split_batch(batch, has_labels=False)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in range(n_out)]
+        return outputs
+
+    def _make_logs(self, result, step):
+        logs = {}
+        if isinstance(result, tuple):
+            losses, metrics = result
+        else:
+            losses, metrics = result, []
+        logs["loss"] = losses[0]
+        for m, r in zip(self._metrics, metrics):
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = r if isinstance(r, (list, tuple)) else [r]
+            logs.update(dict(zip(names, vals)))
+        logs["step"] = step
+        return logs
+
+    def _metrics_names(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, list) else [n])
+        return names
+
+    # ------------------------------------------------------------ io
+    def save(self, path, training=True):
+        from .. import framework
+
+        framework.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            framework.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from .. import framework
+
+        state = framework.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        import os
+
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(framework.load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+
+        return summary(self.network, input_size, dtypes=dtype)
+
+
+def _to_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(np.asarray(x))
+
+
+def _split_batch(batch, has_labels=True):
+    if isinstance(batch, (list, tuple)):
+        if has_labels and len(batch) >= 2:
+            return list(batch[:-1]), [batch[-1]]
+        return list(batch), None
+    return [batch], None
+
+
+def _as_loader(data, batch_size, shuffle, drop_last, num_workers):
+    if data is None or isinstance(data, DataLoader):
+        return data
+    return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                      drop_last=drop_last, num_workers=num_workers)
+
+
+def _safe_len(loader):
+    try:
+        return len(loader)
+    except TypeError:
+        return None

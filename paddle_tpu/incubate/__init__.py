@@ -1,0 +1,4 @@
+"""paddle.incubate (reference: python/paddle/fluid/incubate/: fleet v1 API,
+auto_checkpoint)."""
+from . import autograd  # noqa: F401
+from .checkpoint import auto_checkpoint  # noqa: F401

@@ -1,0 +1,64 @@
+"""Functional autograd transforms (beyond the eager tape) — jvp/vjp/hessian
+(reference: python/paddle/autograd/functional.py in later revs; here they
+are direct jax transforms over functionalized callables)."""
+import jax
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+
+
+def _functionalize(fn):
+    def pure(*arrays):
+        with dispatch.trace_mode():
+            out = fn(*[Tensor(a, stop_gradient=True) for a in arrays])
+            if isinstance(out, (tuple, list)):
+                return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+            return out._value if isinstance(out, Tensor) else out
+
+    return pure
+
+
+def vjp(func, xs, v=None):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrs = [x._value for x in xs]
+    out, vjp_fn = jax.vjp(_functionalize(func), *arrs)
+    if v is None:
+        import jax.numpy as jnp
+
+        v = jnp.ones_like(out)
+    else:
+        v = v._value if isinstance(v, Tensor) else v
+    grads = vjp_fn(v)
+    return Tensor(out), [Tensor(g) for g in grads]
+
+
+def jvp(func, xs, v=None):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrs = [x._value for x in xs]
+    if v is None:
+        import jax.numpy as jnp
+
+        tangents = [jnp.ones_like(a) for a in arrs]
+    else:
+        vs = v if isinstance(v, (list, tuple)) else [v]
+        tangents = [t._value if isinstance(t, Tensor) else t for t in vs]
+    out, tangent_out = jax.jvp(_functionalize(func), tuple(arrs), tuple(tangents))
+    return Tensor(out), Tensor(tangent_out)
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrs = [x._value for x in xs_list]
+    jac = jax.jacrev(_functionalize(func), argnums=tuple(range(len(arrs))))(*arrs)
+    if not isinstance(xs, (list, tuple)):
+        return Tensor(jac[0])
+    return [Tensor(j) for j in jac]
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrs = [x._value for x in xs_list]
+    hess = jax.hessian(_functionalize(func), argnums=tuple(range(len(arrs))))(*arrs)
+    if not isinstance(xs, (list, tuple)):
+        return Tensor(hess[0][0])
+    return hess

@@ -1,0 +1,55 @@
+"""Auto-checkpoint (reference: python/paddle/fluid/incubate/checkpoint/
+auto_checkpoint.py:71 AutoCheckpointChecker — epoch-granular train-state
+snapshots to a shared FS for preemptible-cluster resume).
+"""
+import json
+import os
+import time
+
+
+class TrainEpochRange:
+    """``for epoch in auto_checkpoint.train_epoch_range(N, save_dir=...)``:
+    resumes from the last finished epoch recorded in the range's meta."""
+
+    def __init__(self, max_epoch_num, name="default", save_dir=None,
+                 checkpoint_inter=None, model=None, optimizer=None):
+        self.max_epoch_num = max_epoch_num
+        self.name = name
+        self.save_dir = save_dir or os.environ.get(
+            "PADDLE_TPU_CHECKPOINT_DIR", f"/tmp/paddle_tpu_autockpt/{name}")
+        self._model = model
+        self._optimizer = optimizer
+        self._meta_path = os.path.join(self.save_dir, "meta.json")
+        self._start = 0
+        if os.path.exists(self._meta_path):
+            with open(self._meta_path) as f:
+                meta = json.load(f)
+            self._start = meta.get("next_epoch", 0)
+            ckpt = os.path.join(self.save_dir, "ckpt")
+            if self._model is not None and os.path.exists(ckpt + ".pdparams"):
+                from .. import framework
+
+                self._model.set_state_dict(framework.load(ckpt + ".pdparams"))
+                if self._optimizer is not None and os.path.exists(ckpt + ".pdopt"):
+                    self._optimizer.set_state_dict(framework.load(ckpt + ".pdopt"))
+
+    def __iter__(self):
+        for epoch in range(self._start, self.max_epoch_num):
+            yield epoch
+            self._save(epoch)
+
+    def _save(self, epoch):
+        os.makedirs(self.save_dir, exist_ok=True)
+        ckpt = os.path.join(self.save_dir, "ckpt")
+        if self._model is not None:
+            from .. import framework
+
+            framework.save(self._model.state_dict(), ckpt + ".pdparams")
+            if self._optimizer is not None:
+                framework.save(self._optimizer.state_dict(), ckpt + ".pdopt")
+        with open(self._meta_path, "w") as f:
+            json.dump({"next_epoch": epoch + 1, "ts": time.time()}, f)
+
+
+class auto_checkpoint:
+    train_epoch_range = TrainEpochRange

@@ -1,0 +1,11 @@
+"""paddle.io — Dataset/DataLoader (reference: python/paddle/fluid/reader.py:149
+DataLoader, python/paddle/fluid/dataloader/)."""
+from .dataset import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    Subset, random_split,
+)
+from .sampler import (  # noqa: F401
+    Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
+    BatchSampler, DistributedBatchSampler,
+)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401

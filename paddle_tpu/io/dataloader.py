@@ -1,0 +1,274 @@
+"""DataLoader (reference: python/paddle/fluid/reader.py:149 DataLoader,
+dataloader/dataloader_iter.py, worker.py; C++ double-buffer
+operators/reader/buffered_reader.cc).
+
+TPU-native design: multiprocess workers feed a result queue (the
+reference's shared-memory + blocking-queue design collapses to an mp.Queue
+of numpy batches), and the iterator keeps a one-batch host->device
+prefetch in flight so H2D overlaps with the train step (the
+buffered_reader analog).
+"""
+import atexit
+import itertools
+import multiprocessing as mp
+import queue as queue_mod
+import threading
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import IterableDataset
+from .sampler import BatchSampler, SequenceSampler, RandomSampler
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset, seed):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    return _worker_info
+
+
+def default_collate_fn(batch):
+    """Stack list-of-samples into batch arrays (reference:
+    dataloader/collate.py default_collate_fn)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(s._value) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float)):
+        return np.asarray(batch)
+    if isinstance(sample, (str, bytes)):
+        return batch
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_collate_fn(list(items))
+                            for items in zip(*batch))
+    return np.asarray(batch)
+
+
+def _to_tensor_tree(obj):
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, dict):
+        return {k: _to_tensor_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_tensor_tree(v) for v in obj)
+    return obj
+
+
+def _worker_loop(dataset, index_queue, out_queue, collate_fn, worker_id,
+                 num_workers, seed, iterable):
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset, seed)
+    np.random.seed(seed)
+    try:
+        if iterable:
+            it = iter(dataset)
+            while True:
+                cmd = index_queue.get()
+                if cmd is None:
+                    break
+                batch_idx, batch_size = cmd
+                samples = list(itertools.islice(it, batch_size))
+                if not samples:
+                    out_queue.put((batch_idx, StopIteration()))
+                    break
+                out_queue.put((batch_idx, collate_fn(samples)))
+        else:
+            while True:
+                cmd = index_queue.get()
+                if cmd is None:
+                    break
+                batch_idx, indices = cmd
+                try:
+                    samples = [dataset[i] for i in indices]
+                    out_queue.put((batch_idx, collate_fn(samples)))
+                except Exception as e:  # noqa: BLE001
+                    out_queue.put((batch_idx, e))
+    except KeyboardInterrupt:
+        pass
+
+
+class _MultiprocessIter:
+    def __init__(self, loader):
+        self.loader = loader
+        self.ctx = mp.get_context("fork")
+        self.out_queue = self.ctx.Queue()
+        self.workers = []
+        self.index_queues = []
+        self.batches = iter(loader.batch_sampler)
+        self.send_idx = 0
+        self.rcvd_idx = 0
+        self.reorder = {}
+        self.done_sending = False
+        seed = np.random.randint(0, 2 ** 31)
+        for wid in range(loader.num_workers):
+            iq = self.ctx.Queue()
+            w = self.ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, iq, self.out_queue, loader.collate_fn, wid,
+                      loader.num_workers, seed + wid, False),
+                daemon=True)
+            w.start()
+            self.workers.append(w)
+            self.index_queues.append(iq)
+        atexit.register(self._shutdown)
+        for _ in range(loader.num_workers * 2):
+            self._send_next()
+
+    def _send_next(self):
+        if self.done_sending:
+            return
+        try:
+            indices = next(self.batches)
+        except StopIteration:
+            self.done_sending = True
+            return
+        wid = self.send_idx % len(self.workers)
+        self.index_queues[wid].put((self.send_idx, indices))
+        self.send_idx += 1
+
+    def __next__(self):
+        if self.rcvd_idx >= self.send_idx and self.done_sending:
+            self._shutdown()
+            raise StopIteration
+        while self.rcvd_idx not in self.reorder:
+            idx, data = self.out_queue.get()
+            self.reorder[idx] = data
+        data = self.reorder.pop(self.rcvd_idx)
+        self.rcvd_idx += 1
+        self._send_next()
+        if isinstance(data, Exception):
+            self._shutdown()
+            raise data
+        return _to_tensor_tree(data)
+
+    def _shutdown(self):
+        for iq in self.index_queues:
+            try:
+                iq.put(None)
+            except Exception:  # noqa: BLE001
+                pass
+        for w in self.workers:
+            w.join(timeout=1.0)
+            if w.is_alive():
+                w.terminate()
+        self.workers = []
+
+
+class _SingleProcessIter:
+    def __init__(self, loader):
+        self.loader = loader
+        self.batches = iter(loader.batch_sampler)
+
+    def __next__(self):
+        indices = next(self.batches)
+        samples = [self.loader.dataset[i] for i in indices]
+        return _to_tensor_tree(self.loader.collate_fn(samples))
+
+
+class _IterableDatasetIter:
+    def __init__(self, loader):
+        self.loader = loader
+        self.it = iter(loader.dataset)
+
+    def __next__(self):
+        samples = list(itertools.islice(self.it, self.loader.batch_size))
+        if not samples:
+            raise StopIteration
+        if self.loader.drop_last and len(samples) < self.loader.batch_size:
+            raise StopIteration
+        return _to_tensor_tree(self.loader.collate_fn(samples))
+
+
+class _PrefetchIter:
+    """One-batch lookahead on a background thread (buffered_reader analog)."""
+
+    def __init__(self, inner, depth=2):
+        self.inner = inner
+        self.q = queue_mod.Queue(maxsize=depth)
+        self.thread = threading.Thread(target=self._fill, daemon=True)
+        self.thread.start()
+
+    def _fill(self):
+        try:
+            while True:
+                self.q.put(("data", next(self.inner)))
+        except StopIteration:
+            self.q.put(("stop", None))
+        except Exception as e:  # noqa: BLE001
+            self.q.put(("error", e))
+
+    def __next__(self):
+        kind, payload = self.q.get()
+        if kind == "stop":
+            raise StopIteration
+        if kind == "error":
+            raise payload
+        return payload
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, use_buffer_reader=True,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 prefetch_factor=2, persistent_workers=False):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.use_buffer_reader = use_buffer_reader
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_sampler = None
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = batch_sampler.batch_size
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def __iter__(self):
+        if self._iterable:
+            inner = _IterableDatasetIter(self)
+        elif self.num_workers > 0:
+            inner = _MultiprocessIter(self)
+        else:
+            inner = _SingleProcessIter(self)
+        it = _PrefetchIter(inner) if self.use_buffer_reader else inner
+
+        class _Wrapper:
+            def __iter__(w):
+                return w
+
+            def __next__(w):
+                return next(it)
+
+        return _Wrapper()
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    # fluid-style constructors (reference: reader.py from_generator:432)
+    @staticmethod
+    def from_generator(feed_list=None, capacity=64, use_double_buffer=True,
+                       iterable=True, return_list=False, use_multiprocess=False,
+                       drop_last=True):
+        raise NotImplementedError(
+            "from_generator is a legacy static-graph API; use DataLoader(dataset)")

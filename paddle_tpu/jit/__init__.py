@@ -1,0 +1,44 @@
+"""paddle.jit — to_static / save / load (reference: python/paddle/fluid/
+dygraph/jit.py:161 declarative, dygraph_to_static/program_translator.py:233
+StaticFunction, :689 ProgramCache, partial_program.py).
+
+TPU-native design: instead of an AST transpiler emitting a ProgramDesc run
+by a run_program op, ``to_static`` functionalizes the Layer (params as
+pytree) and traces straight to XLA via jax.jit, with an input-spec-keyed
+compile cache (the ProgramCache analog). The whole compiled program then
+enters the eager tape as ONE op, so ``loss.backward()`` through a
+to_static model differentiates the whole XLA program at once — the
+PartialProgramLayer analog with XLA as the executor.
+Python control flow on tensors is supported the JAX way (trace-time
+unrolling; data-dependent branches via paddle.where / lax.cond helpers) —
+the reference's per-construct AST transforms are unnecessary because the
+tape/tracer executes real Python.
+"""
+from .static_function import (  # noqa: F401
+    to_static, declarative, StaticFunction, not_to_static, ignore_module,
+)
+from .save_load import save, load, TranslatedLayer  # noqa: F401
+
+_STATIC_MODE = False
+
+
+def enable_static():
+    global _STATIC_MODE
+    _STATIC_MODE = True
+
+
+def disable_static():
+    global _STATIC_MODE
+    _STATIC_MODE = False
+
+
+def in_dynamic_mode():
+    return not _STATIC_MODE
+
+
+def set_code_level(level=100):
+    pass
+
+
+def set_verbosity(level=0):
+    pass

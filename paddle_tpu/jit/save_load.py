@@ -1,0 +1,133 @@
+"""jit.save / jit.load (reference: dygraph/jit.py save, dygraph/io.py
+TranslatedLayer; format: save_inference_model's ProgramDesc+params).
+
+TPU-native format: serialized StableHLO (jax.export) + numpy params +
+a JSON signature — the portable compiled-program analog. Falls back to
+pickled params + a marker when export is unavailable for an input spec.
+"""
+import json
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from .static_function import StaticFunction, _flatten_tensors
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save — export layer.forward at the given input spec."""
+    from ..static import InputSpec
+
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (list of InputSpec or Tensors)")
+    specs = []
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            shape = [1 if d is None or d < 0 else d for d in s.shape]
+            specs.append(jax.ShapeDtypeStruct(tuple(shape), np.dtype(s.dtype)))
+        elif isinstance(s, Tensor):
+            specs.append(jax.ShapeDtypeStruct(tuple(s._value.shape),
+                                              np.dtype(s._value.dtype)))
+        else:
+            raise TypeError(f"bad input_spec entry {s!r}")
+
+    layer.eval()
+    params, buffers = layer.functional_state()
+    param_names = list(params)
+    buffer_names = list(buffers)
+
+    fwd = layer.forward
+    if isinstance(fwd, StaticFunction):
+        fwd = fwd._orig_fn
+
+    meta = {}
+
+    def infer_fn(param_list, buffer_list, *inputs):
+        saved_p = {n: p._value for n, p in layer.named_parameters()}
+        saved_b = dict(zip(buffer_names, [buffers[n] for n in buffer_names]))
+        try:
+            with dispatch.trace_mode():
+                layer.load_functional_state(dict(zip(param_names, param_list)),
+                                            dict(zip(buffer_names, buffer_list)))
+                out = fwd(*[Tensor(i, stop_gradient=True) for i in inputs])
+                out_tensors, skel, _ = _flatten_tensors(out)
+                meta["n_out"] = len(out_tensors)
+                return tuple(t._value for t in out_tensors)
+        finally:
+            layer.load_functional_state(saved_p, saved_b)
+
+    jitted = jax.jit(infer_fn)
+    param_specs = [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in params.values()]
+    buffer_specs = [jax.ShapeDtypeStruct(b.shape, b.dtype) for b in buffers.values()]
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    payload = {
+        "params": {n: np.asarray(a) for n, a in params.items()},
+        "buffers": {n: np.asarray(a) for n, a in buffers.items()},
+        "input_specs": [(list(s.shape), str(s.dtype)) for s in specs],
+    }
+    try:
+        from jax import export as jax_export
+
+        exported = jax_export.export(jitted)(param_specs, buffer_specs, *specs)
+        blob = exported.serialize()
+        with open(path + ".pdmodel", "wb") as f:
+            f.write(blob)
+        payload["format"] = "stablehlo"
+    except Exception as e:  # noqa: BLE001
+        payload["format"] = "pickle-only"
+        payload["export_error"] = repr(e)
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(payload, f, protocol=4)
+    with open(path + ".pdmeta.json", "w") as f:
+        json.dump({"format": payload["format"],
+                   "input_specs": payload["input_specs"]}, f)
+
+
+class TranslatedLayer(Layer):
+    """Loaded inference layer (reference: dygraph/io.py TranslatedLayer)."""
+
+    def __init__(self, call_fn, params, buffers):
+        super().__init__()
+        self._call_fn = call_fn
+        self._loaded_params = params
+        self._loaded_buffers = buffers
+        for i, (n, a) in enumerate(params.items()):
+            from ..core.tensor import Parameter
+
+            self.add_parameter(f"p_{i}", Parameter(jnp.asarray(a), name=n))
+
+    def forward(self, *inputs):
+        param_list = [p._value for p in self._parameters.values()]
+        buffer_list = [jnp.asarray(b) for b in self._loaded_buffers.values()]
+        arrays = [i._value if isinstance(i, Tensor) else jnp.asarray(i)
+                  for i in inputs]
+        out = self._call_fn(param_list, buffer_list, *arrays)
+        outs = tuple(Tensor(o) for o in out)
+        return outs[0] if len(outs) == 1 else outs
+
+
+def load(path, **configs):
+    """paddle.jit.load — rebuild a callable Layer from the exported module."""
+    with open(path + ".pdiparams", "rb") as f:
+        payload = pickle.load(f)
+    params = payload["params"]
+    buffers = payload["buffers"]
+    if payload.get("format") == "stablehlo" and os.path.exists(path + ".pdmodel"):
+        from jax import export as jax_export
+
+        with open(path + ".pdmodel", "rb") as f:
+            exported = jax_export.deserialize(f.read())
+
+        def call_fn(param_list, buffer_list, *inputs):
+            return exported.call(param_list, buffer_list, *inputs)
+
+        return TranslatedLayer(call_fn, params, buffers)
+    raise RuntimeError(
+        f"model at {path} was saved without a serialized program "
+        f"({payload.get('export_error')}); re-save with a supported spec")

@@ -1,0 +1,199 @@
+"""StaticFunction — the to_static engine (reference: dygraph_to_static/
+program_translator.py StaticFunction:233, ConcreteProgram:582,
+ProgramCache:689; partial_program.py PartialProgramLayer).
+"""
+import functools
+import inspect
+import itertools
+
+import numpy as np
+import jax
+
+from ..core import dispatch, random as random_core
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+def _spec_of(x):
+    if isinstance(x, Tensor):
+        return ("T", tuple(x._value.shape), str(x._value.dtype))
+    if isinstance(x, (list, tuple)):
+        return (type(x).__name__, tuple(_spec_of(v) for v in x))
+    if isinstance(x, dict):
+        return ("dict", tuple(sorted((k, _spec_of(v)) for k, v in x.items())))
+    if isinstance(x, np.ndarray):
+        return ("np", x.shape, str(x.dtype))
+    return ("const", x if isinstance(x, (int, float, bool, str, type(None))) else str(x))
+
+
+def _flatten_tensors(tree):
+    """-> (list of Tensors, rebuild(fn arrays->tree))."""
+    tensors = []
+
+    def scan(x):
+        if isinstance(x, Tensor):
+            tensors.append(x)
+            return ("T", len(tensors) - 1)
+        if isinstance(x, (list, tuple)):
+            return (type(x).__name__, [scan(v) for v in x])
+        if isinstance(x, dict):
+            return ("dict", {k: scan(v) for k, v in x.items()})
+        return ("C", x)
+
+    skeleton = scan(tree)
+
+    def rebuild(arrays, node):
+        kind = node[0]
+        if kind == "T":
+            return arrays[node[1]]
+        if kind in ("list", "tuple"):
+            vals = [rebuild(arrays, v) for v in node[1]]
+            return vals if kind == "list" else tuple(vals)
+        if kind == "dict":
+            return {k: rebuild(arrays, v) for k, v in node[1].items()}
+        return node[1]
+
+    return tensors, skeleton, rebuild
+
+
+class ConcreteProgram:
+    """One compiled (input-spec-specialised) instance (reference:
+    program_translator.py:582)."""
+
+    def __init__(self, pure_fn, param_names, n_inputs, out_skeleton_box, name):
+        self.pure_fn = pure_fn
+        self.param_names = param_names
+        self.n_inputs = n_inputs
+        self.out_skeleton_box = out_skeleton_box
+        self.name = name
+
+
+_SF_COUNTER = itertools.count()
+
+
+class StaticFunction:
+    def __init__(self, function, input_spec=None, build_strategy=None,
+                 property_=False):
+        self._orig_fn = function
+        self._input_spec = input_spec
+        self._cache = {}  # ProgramCache analog
+        self._layer = getattr(function, "__self__", None)
+        self._uid = next(_SF_COUNTER)  # disambiguates the jit-cache key
+        functools.wraps(function)(self)
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        bound = StaticFunction(self._orig_fn.__get__(instance, owner),
+                               self._input_spec)
+        bound._layer = instance
+        # cache the bound StaticFunction on the instance
+        object.__setattr__(instance, self._orig_fn.__name__, bound)
+        return bound
+
+    @property
+    def _is_layer_method(self):
+        return isinstance(self._layer, Layer)
+
+    def concrete_program_specs(self):
+        return list(self._cache)
+
+    def _build(self, key, args, kwargs):
+        layer = self._layer
+        fn = self._orig_fn
+        if layer is not None:
+            params, buffers = layer.functional_state()
+        else:
+            params, buffers = {}, {}
+        param_names = list(params)
+        buffer_names = list(buffers)
+        in_tensors, in_skel, rebuild_in = _flatten_tensors((args, kwargs))
+        n_params = len(param_names)
+        n_buffers = len(buffer_names)
+        training = layer.training if layer is not None else True
+        out_box = {}
+
+        def pure_fn(key_arr, *arrays, **_static):
+            p_arrs = arrays[:n_params]
+            b_arrs = arrays[n_params:n_params + n_buffers]
+            input_arrs = arrays[n_params + n_buffers:]
+            saved_p = saved_b = None
+            if layer is not None:
+                saved_p = {n: p._value for n, p in layer.named_parameters()}
+                saved_b = {}
+                for lname, sub in layer.named_sublayers(include_self=True):
+                    for bname, b in sub._buffers.items():
+                        if isinstance(b, Tensor):
+                            saved_b[f"{lname}.{bname}" if lname else bname] = b._value
+            try:
+                with dispatch.trace_mode(), random_core.rng_guard(key_arr):
+                    if layer is not None:
+                        layer.load_functional_state(
+                            dict(zip(param_names, p_arrs)),
+                            dict(zip(buffer_names, b_arrs)))
+                    t_inputs = [Tensor(a, stop_gradient=True) for a in input_arrs]
+                    a2, kw2 = rebuild_in(t_inputs, in_skel)
+                    out = fn(*a2, **kw2)
+                    out_tensors, out_skel, _ = _flatten_tensors(out)
+                    out_box["skel"] = out_skel
+                    out_box["rebuild"] = _flatten_tensors(out)[2]
+                    return tuple(t._value for t in out_tensors)
+            finally:
+                if layer is not None:
+                    layer.load_functional_state(saved_p, saved_b)
+
+        return ConcreteProgram(pure_fn, param_names, len(in_tensors), out_box,
+                               getattr(fn, "__name__", "fn")), buffer_names
+
+    def __call__(self, *args, **kwargs):
+        layer = self._layer
+        training = layer.training if layer is not None else True
+        key = (_spec_of(args), _spec_of(tuple(sorted(kwargs.items()))), training)
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(key, args, kwargs)
+            self._cache[key] = entry
+        program, buffer_names = entry
+        if layer is not None:
+            params, buffers = layer.functional_state()
+            p_tensors = [p for _, p in layer.named_parameters()]
+            b_arrays = [buffers[n] for n in buffer_names]
+        else:
+            p_tensors, b_arrays = [], []
+        in_tensors, _, _ = _flatten_tensors((args, kwargs))
+        rng = random_core.next_key()
+        out = dispatch.apply_op(
+            f"to_static::{program.name}::{self._uid}", program.pure_fn,
+            rng, *p_tensors, *[Tensor(b, stop_gradient=True) for b in b_arrays],
+            *in_tensors, __spec=dispatch.hashable(key))
+        outs = out if isinstance(out, tuple) else (out,)
+        rebuild = program.out_skeleton_box["rebuild"]
+        skel = program.out_skeleton_box["skel"]
+        return rebuild(list(outs), skel)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              **kwargs):
+    """@paddle.jit.to_static (reference: dygraph/jit.py:161 declarative)."""
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            fn.forward = StaticFunction(fn.forward, input_spec)
+            return fn
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+declarative = to_static
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    pass

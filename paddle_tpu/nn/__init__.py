@@ -1,0 +1,11 @@
+"""paddle.nn (reference: python/paddle/nn/__init__.py — 21k LoC layer zoo)."""
+from .layer import Layer  # noqa: F401
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .clip import (  # noqa: F401
+    ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue, clip_grad_norm_,
+)
+from .layers import *  # noqa: F401,F403
+from .layers.common import Linear, Embedding  # noqa: F401
+from .layers.container import Sequential, LayerList, ParameterList, LayerDict  # noqa: F401
+from ..framework.param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401

@@ -1,0 +1,111 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py:
+ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm)."""
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._dygraph_clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, apply_op("clip_by_value",
+                                    lambda g, *, lo, hi: jnp.clip(g, lo, hi),
+                                    g, lo=self.min, hi=self.max)))
+        return out
+
+    def clip_arrays(self, grads):
+        return [None if g is None else jnp.clip(g, self.min, self.max) for g in grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+
+            def _clip(g, *, c):
+                n = jnp.sqrt(jnp.sum(jnp.square(g)))
+                return jnp.where(n > c, g * (c / jnp.maximum(n, 1e-12)), g)
+
+            out.append((p, apply_op("clip_by_norm", _clip, g, c=self.clip_norm)))
+        return out
+
+    def clip_arrays(self, grads):
+        res = []
+        for g in grads:
+            if g is None:
+                res.append(None)
+                continue
+            n = jnp.sqrt(jnp.sum(jnp.square(g)))
+            res.append(jnp.where(n > self.clip_norm,
+                                 g * (self.clip_norm / jnp.maximum(n, 1e-12)), g))
+        return res
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global-norm clip. In the distributed traced step the norm is computed
+    on the global (sharded) grads, so the psum across shards comes out of
+    SPMD automatically — no special-case like the reference's sharding
+    gradient_clip_helper."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+
+    def _dygraph_clip(self, params_grads):
+        from ..core.tensor import Tensor
+
+        gs = [g for p, g in params_grads if g is not None and getattr(p, "need_clip", True)]
+        if not gs:
+            return params_grads
+        arrs = [g._value for g in gs]
+        clipped = self.clip_arrays(arrs)
+        mapping = {}
+        i = 0
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, Tensor(clipped[i], stop_gradient=True)))
+                i += 1
+        return out
+
+    def clip_arrays(self, grads):
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads if g is not None)
+        gnorm = jnp.sqrt(sq)
+        scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        return [None if g is None else (g * scale).astype(g.dtype) for g in grads]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    from ..core.tensor import Tensor
+
+    ps = [p for p in parameters if p._grad is not None]
+    if not ps:
+        return Tensor(jnp.zeros(()))
+    clip = ClipGradByGlobalNorm(max_norm)
+    grads = [p._grad for p in ps]
+    sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads)
+    total = jnp.sqrt(sq)
+    clipped = clip.clip_arrays(grads)
+    for p, g in zip(ps, clipped):
+        p._grad = g
+    return Tensor(total)

@@ -1,0 +1,1044 @@
+"""nn functional ops (reference: python/paddle/nn/functional/*; kernels
+operators/conv_op.cc, pool_op.cc, batch_norm_op.cc, layer_norm_op.cc,
+softmax_op.cc, cross_entropy_op.cc, dropout_op.cc, activation_op.cc).
+
+Convolutions/matmuls map onto the MXU via lax.conv_general_dilated /
+jnp.matmul; norms and activations are VPU element-wise code that XLA
+fuses into neighbors. Data layout: paddle defaults to NCHW at the API,
+but kernels transpose to NHWC internally when beneficial — XLA on TPU
+canonicalises layout anyway, so we keep the math in the API layout.
+"""
+import math as _pymath
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import random as random_core
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+# ------------------------------------------------------------- activations
+
+
+def _unary(op_name, fn):
+    def api(x, name=None):
+        return apply_op(op_name, fn, x)
+
+    api.__name__ = op_name
+    return api
+
+
+relu = _unary("relu", lambda x: jax.nn.relu(x))
+relu6 = _unary("relu6", lambda x: jax.nn.relu6(x))
+sigmoid = _unary("sigmoid", lambda x: jax.nn.sigmoid(x))
+tanh = _unary("tanh", lambda x: jnp.tanh(x))
+silu = _unary("silu", lambda x: jax.nn.silu(x))
+swish = silu
+mish = _unary("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+tanhshrink = _unary("tanhshrink", lambda x: x - jnp.tanh(x))
+softsign = _unary("softsign", lambda x: jax.nn.soft_sign(x))
+log_sigmoid = _unary("log_sigmoid", lambda x: jax.nn.log_sigmoid(x))
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op("gelu", lambda x, *, approx: jax.nn.gelu(x, approximate=approx),
+                    x, approx=bool(approximate))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op("leaky_relu",
+                    lambda x, *, slope: jax.nn.leaky_relu(x, negative_slope=slope),
+                    x, slope=float(negative_slope))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op("elu", lambda x, *, alpha: jax.nn.elu(x, alpha=alpha), x, alpha=float(alpha))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op("celu", lambda x, *, alpha: jax.nn.celu(x, alpha=alpha), x, alpha=float(alpha))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op(
+        "selu", lambda x, *, s, a: s * jnp.where(x > 0, x, a * jnp.expm1(x)),
+        x, s=float(scale), a=float(alpha))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        "hardshrink", lambda x, *, t: jnp.where(jnp.abs(x) > t, x, 0.0), x, t=float(threshold))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        "softshrink",
+        lambda x, *, t: jnp.where(x > t, x - t, jnp.where(x < -t, x + t, 0.0)),
+        x, t=float(threshold))
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply_op(
+        "hardsigmoid", lambda x, *, s, o: jnp.clip(s * x + o, 0.0, 1.0),
+        x, s=float(slope), o=float(offset))
+
+
+def hardswish(x, name=None):
+    return apply_op("hardswish", lambda x: x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0), x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op("hardtanh", lambda x, *, lo, hi: jnp.clip(x, lo, hi),
+                    x, lo=float(min), hi=float(max))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def _prelu(x, w, *, data_format):
+        if w.size == 1:
+            return jnp.where(x >= 0, x, w.reshape(()) * x)
+        shape = [1] * x.ndim
+        ch = 1 if data_format.startswith("NC") else x.ndim - 1
+        shape[ch] = w.size
+        return jnp.where(x >= 0, x, w.reshape(shape) * x)
+
+    return apply_op("prelu", _prelu, x, weight, data_format=data_format)
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    return apply_op(
+        "softplus",
+        lambda x, *, beta, threshold: jnp.where(
+            beta * x > threshold, x, jnp.log1p(jnp.exp(beta * x)) / beta),
+        x, beta=float(beta), threshold=float(threshold))
+
+
+def maxout(x, groups, axis=1, name=None):
+    def _maxout(x, *, groups, axis):
+        ax = axis % x.ndim
+        c = x.shape[ax]
+        new_shape = x.shape[:ax] + (c // groups, groups) + x.shape[ax + 1:]
+        return jnp.max(x.reshape(new_shape), axis=ax + 1)
+
+    return apply_op("maxout", _maxout, x, groups=int(groups), axis=int(axis))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    return apply_op("softmax", lambda x, *, axis: jax.nn.softmax(x, axis=axis), x, axis=int(axis))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    return apply_op("log_softmax", lambda x, *, axis: jax.nn.log_softmax(x, axis=axis),
+                    x, axis=int(axis))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    def _gs(key, x, *, tau, hard, axis):
+        g = jax.random.gumbel(key, x.shape, x.dtype)
+        y = jax.nn.softmax((x + g) / tau, axis=axis)
+        if hard:
+            # straight-through: hard one-hot forward, soft gradient
+            idx = jnp.argmax(y, axis=axis)
+            oh = jax.nn.one_hot(idx, y.shape[axis], axis=axis, dtype=y.dtype)
+            return oh + y - jax.lax.stop_gradient(y)
+        return y
+
+    return apply_op("gumbel_softmax", _gs, random_core.next_key(), x,
+                    tau=float(temperature), hard=bool(hard), axis=int(axis))
+
+
+# ------------------------------------------------------------- linear / embedding
+
+
+def linear(x, weight, bias=None, name=None):
+    """reference: operators/matmul_v2 + elementwise_add fusion (fc)."""
+
+    def _linear(x, w, b):
+        y = jnp.matmul(x, w)
+        if b is not None:
+            y = y + b
+        return y
+
+    return apply_op("linear", _linear, x, weight, bias)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """reference: operators/lookup_table_v2_op.cc. `sparse` is accepted for
+    API compat; on TPU the gather is dense and XLA-sharded."""
+
+    def _embedding(ids, w, *, padding_idx):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return apply_op("embedding", _embedding, x, weight,
+                    padding_idx=None if padding_idx is None else int(padding_idx))
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op(
+        "one_hot", lambda x, *, n: jax.nn.one_hot(x.astype(jnp.int32), n, dtype=jnp.float32),
+        x, n=int(num_classes))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def _ls(label, prior, *, eps):
+        n = label.shape[-1]
+        if prior is None:
+            return (1 - eps) * label + eps / n
+        return (1 - eps) * label + eps * prior
+
+    return apply_op("label_smooth", _ls, label, prior_dist, eps=float(epsilon))
+
+
+# ------------------------------------------------------------- dropout
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    """reference: operators/dropout_op.cc (upscale_in_train default;
+    downscale_in_infer scales by (1-p) at inference)."""
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and p > 0.0:
+            return apply_op("dropout_infer_downscale",
+                            lambda x, *, keep: x * keep, x, keep=1.0 - float(p))
+        return x if isinstance(x, Tensor) else Tensor(x)
+
+    ax = tuple(np.atleast_1d(axis).tolist()) if axis is not None else None
+
+    def _dropout(key, x, *, p, mode, axis):
+        shape = x.shape
+        if axis is not None:
+            shape = tuple(s if i in axis else 1 for i, s in enumerate(x.shape))
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, x / (1.0 - p), 0.0)
+        return jnp.where(keep, x, 0.0)
+
+    return apply_op("dropout", _dropout, random_core.next_key(), x,
+                    p=float(p), mode=mode, axis=ax)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+
+    def _ad(key, x, *, p):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+        a = (1.0 / _pymath.sqrt((alpha_p ** 2 * p + 1) * (1 - p))) if p < 1 else 0.0
+        b = -a * alpha_p * p
+        return a * jnp.where(keep, x, alpha_p) + b
+
+    return apply_op("alpha_dropout", _ad, random_core.next_key(), x, p=float(p))
+
+
+# ------------------------------------------------------------- conv
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _conv_nd(x, w, b, *, stride, padding, dilation, groups, data_format, nd):
+    chan_first = data_format in ("NCHW", "NCL", "NCDHW")
+    if chan_first:
+        dn_in = "NC" + "DHW"[3 - nd:]
+        dn_out = dn_in
+    else:
+        dn_in = "N" + "DHW"[3 - nd:] + "C"
+        dn_out = dn_in
+    dn_kernel = "OI" + "DHW"[3 - nd:]
+    if isinstance(padding, str):
+        pad = padding.upper()  # SAME / VALID
+    else:
+        pad = [(p, p) for p in padding] if not isinstance(padding[0], (list, tuple)) \
+            else [tuple(p) for p in padding]
+    y = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=stride,
+        padding=pad,
+        rhs_dilation=dilation,
+        dimension_numbers=(dn_in, dn_kernel, dn_out),
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+    )
+    if y.dtype != x.dtype:
+        y = y.astype(x.dtype)
+    if b is not None:
+        shape = [1] * y.ndim
+        shape[1 if chan_first else -1] = b.size
+        y = y + b.reshape(shape)
+    return y
+
+
+def _norm_padding(padding, nd):
+    if isinstance(padding, str):
+        return padding
+    if isinstance(padding, int):
+        return (int(padding),) * nd
+    flat = []
+    for p in padding:
+        if isinstance(p, (list, tuple)):
+            flat.append(tuple(int(v) for v in p))
+        else:
+            flat.append(int(p))
+    if len(flat) == 2 * nd and all(isinstance(p, int) for p in flat):
+        # paddle allows [pad_h_top, pad_h_bottom, pad_w_left, pad_w_right]
+        return tuple((flat[2 * i], flat[2 * i + 1]) for i in range(nd))
+    return tuple(flat)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return apply_op(
+        "conv1d", _conv_nd, x, weight, bias,
+        stride=_pair(stride, 1), padding=_norm_padding(padding, 1),
+        dilation=_pair(dilation, 1), groups=int(groups), data_format=data_format, nd=1)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    """reference: operators/conv_op.cc (conv2d). Maps to one MXU conv."""
+    return apply_op(
+        "conv2d", _conv_nd, x, weight, bias,
+        stride=_pair(stride), padding=_norm_padding(padding, 2),
+        dilation=_pair(dilation), groups=int(groups), data_format=data_format, nd=2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return apply_op(
+        "conv3d", _conv_nd, x, weight, bias,
+        stride=_pair(stride, 3), padding=_norm_padding(padding, 3),
+        dilation=_pair(dilation, 3), groups=int(groups), data_format=data_format, nd=3)
+
+
+def _conv_transpose_nd(x, w, b, *, stride, padding, output_padding, dilation, groups,
+                       data_format, nd):
+    chan_first = data_format in ("NCHW", "NCL", "NCDHW")
+    sp = "DHW"[3 - nd:]
+    dn_in = ("NC" + sp) if chan_first else ("N" + sp + "C")
+    dn_kernel = "IO" + sp
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        pad = [(p, p) if isinstance(p, int) else tuple(p) for p in padding]
+    y = jax.lax.conv_transpose(
+        x, w,
+        strides=stride,
+        padding=pad,
+        rhs_dilation=dilation,
+        dimension_numbers=(dn_in, dn_kernel, dn_in),
+        transpose_kernel=True,
+    )
+    if output_padding and any(output_padding):
+        pads = [(0, 0)] * y.ndim
+        for i, op_ in enumerate(output_padding):
+            dim = (2 + i) if chan_first else (1 + i)
+            pads[dim] = (0, op_)
+        y = jnp.pad(y, pads)
+    if b is not None:
+        shape = [1] * y.ndim
+        shape[1 if chan_first else -1] = b.size
+        y = y + b.reshape(shape)
+    return y
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCHW", output_size=None, name=None):
+    """reference: operators/conv_transpose_op.cc. groups>1 unsupported for now."""
+    return apply_op(
+        "conv2d_transpose", _conv_transpose_nd, x, weight, bias,
+        stride=_pair(stride), padding=_norm_padding(padding, 2),
+        output_padding=_pair(output_padding), dilation=_pair(dilation),
+        groups=int(groups), data_format=data_format, nd=2)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCL", output_size=None, name=None):
+    return apply_op(
+        "conv1d_transpose", _conv_transpose_nd, x, weight, bias,
+        stride=_pair(stride, 1), padding=_norm_padding(padding, 1),
+        output_padding=_pair(output_padding, 1), dilation=_pair(dilation, 1),
+        groups=int(groups), data_format=data_format, nd=1)
+
+
+# ------------------------------------------------------------- pooling
+
+
+def _pool_nd(x, *, ksize, stride, padding, mode, ceil_mode, data_format, nd,
+             exclusive=True):
+    chan_first = data_format in ("NCHW", "NCL", "NCDHW")
+    if chan_first:
+        window = (1, 1) + ksize
+        strides = (1, 1) + stride
+        pads = ((0, 0), (0, 0)) + tuple((p, p) if isinstance(p, int) else tuple(p)
+                                        for p in padding)
+    else:
+        window = (1,) + ksize + (1,)
+        strides = (1,) + stride + (1,)
+        pads = ((0, 0),) + tuple((p, p) if isinstance(p, int) else tuple(p)
+                                 for p in padding) + ((0, 0),)
+    if mode == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, strides, pads)
+    # avg
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+    if exclusive and any(p != (0, 0) for p in pads):
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+        return summed / counts
+    return summed / float(np.prod(ksize))
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    ksize = _pair(kernel_size)
+    stride = ksize if stride is None else _pair(stride)
+    pad = _norm_padding(padding, 2)
+    if isinstance(pad, str):
+        pad = (0, 0) if pad == "VALID" else pad
+    out = apply_op("max_pool2d", _pool_nd, x, ksize=ksize, stride=stride,
+                   padding=pad if not isinstance(pad, str) else (0, 0),
+                   mode="max", ceil_mode=bool(ceil_mode), data_format=data_format, nd=2)
+    if return_mask:
+        # indices not natively produced by reduce_window; compute via argmax trick
+        raise NotImplementedError("return_mask=True not yet supported")
+    return out
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    ksize = _pair(kernel_size)
+    stride = ksize if stride is None else _pair(stride)
+    pad = _norm_padding(padding, 2)
+    return apply_op("avg_pool2d", _pool_nd, x, ksize=ksize, stride=stride,
+                    padding=pad if not isinstance(pad, str) else (0, 0),
+                    mode="avg", ceil_mode=bool(ceil_mode), data_format=data_format,
+                    nd=2, exclusive=bool(exclusive))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    ksize = _pair(kernel_size, 1)
+    stride = ksize if stride is None else _pair(stride, 1)
+    return apply_op("max_pool1d", _pool_nd, x, ksize=ksize, stride=stride,
+                    padding=_norm_padding(padding, 1), mode="max",
+                    ceil_mode=bool(ceil_mode), data_format="NCL", nd=1)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    ksize = _pair(kernel_size, 1)
+    stride = ksize if stride is None else _pair(stride, 1)
+    return apply_op("avg_pool1d", _pool_nd, x, ksize=ksize, stride=stride,
+                    padding=_norm_padding(padding, 1), mode="avg",
+                    ceil_mode=bool(ceil_mode), data_format="NCL", nd=1,
+                    exclusive=bool(exclusive))
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    out_hw = _pair(output_size)
+
+    def _aap(x, *, out_hw, chan_first):
+        h_ax, w_ax = (2, 3) if chan_first else (1, 2)
+        ih, iw = x.shape[h_ax], x.shape[w_ax]
+        oh, ow = out_hw
+        if ih % oh == 0 and iw % ow == 0:
+            kh, kw = ih // oh, iw // ow
+            window = [1, 1, 1, 1]
+            window[h_ax], window[w_ax] = kh, kw
+            y = jax.lax.reduce_window(x, 0.0, jax.lax.add, tuple(window), tuple(window),
+                                      "VALID")
+            return y / (kh * kw)
+        # general path: mean over computed bins (static shapes)
+        hs = [(i * ih) // oh for i in range(oh)] + [ih]
+        ws = [(i * iw) // ow for i in range(ow)] + [iw]
+        rows = []
+        for i in range(oh):
+            cols = []
+            for j in range(ow):
+                sl = [slice(None)] * x.ndim
+                sl[h_ax] = slice(hs[i], hs[i + 1])
+                sl[w_ax] = slice(ws[j], ws[j + 1])
+                cols.append(jnp.mean(x[tuple(sl)], axis=(h_ax, w_ax), keepdims=True))
+            rows.append(jnp.concatenate(cols, axis=w_ax))
+        return jnp.concatenate(rows, axis=h_ax)
+
+    return apply_op("adaptive_avg_pool2d", _aap, x, out_hw=out_hw,
+                    chan_first=data_format == "NCHW")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out_hw = _pair(output_size)
+
+    def _amp(x, *, out_hw):
+        ih, iw = x.shape[2], x.shape[3]
+        oh, ow = out_hw
+        kh, kw = ih // oh, iw // ow
+        return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 1, kh, kw),
+                                     (1, 1, kh, kw), "VALID")
+
+    return apply_op("adaptive_max_pool2d", _amp, x, out_hw=out_hw)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    def _aap1(x, *, out):
+        il = x.shape[2]
+        k = il // out
+        y = jax.lax.reduce_window(x, 0.0, jax.lax.add, (1, 1, k), (1, 1, k), "VALID")
+        return y / k
+
+    return apply_op("adaptive_avg_pool1d", _aap1, x, out=int(output_size))
+
+
+# ------------------------------------------------------------- norms
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None,
+               name=None):
+    """reference: operators/batch_norm_op.cc.
+
+    Eager training mode updates running stats in-place on the passed
+    Tensors (mutable-shell); the traced path uses the functional core in
+    nn.layer.norm which threads state explicitly.
+    """
+    chan_ax = 1 if data_format.startswith("NC") and x.ndim > 1 else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != chan_ax)
+    use_batch = training and not (use_global_stats or False)
+
+    def _bn_infer(x, rm, rv, w, b, *, eps, chan_ax):
+        shape = [1] * x.ndim
+        shape[chan_ax] = -1
+        inv = jax.lax.rsqrt(rv.reshape(shape) + eps)
+        y = (x - rm.reshape(shape)) * inv
+        if w is not None:
+            y = y * w.reshape(shape)
+        if b is not None:
+            y = y + b.reshape(shape)
+        return y
+
+    if not use_batch:
+        return apply_op("batch_norm_infer", _bn_infer, x, running_mean, running_var,
+                        weight, bias, eps=float(epsilon), chan_ax=chan_ax)
+
+    def _bn_train(x, w, b, *, eps, axes, chan_ax):
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        shape = [1] * x.ndim
+        shape[chan_ax] = -1
+        inv = jax.lax.rsqrt(var.reshape(shape) + eps)
+        y = (x - mean.reshape(shape)) * inv
+        if w is not None:
+            y = y * w.reshape(shape)
+        if b is not None:
+            y = y + b.reshape(shape)
+        return y, mean, var
+
+    y, mean, var = apply_op("batch_norm_train", _bn_train, x, weight, bias,
+                            eps=float(epsilon), axes=axes, chan_ax=chan_ax)
+    # update running stats (no grad)
+    if isinstance(running_mean, Tensor) and not isinstance(
+            running_mean._value, jax.core.Tracer):
+        m = float(momentum)
+        with _no_grad():
+            running_mean.set_value(m * running_mean._value + (1 - m) * mean._value)
+            running_var.set_value(m * running_var._value + (1 - m) * var._value)
+    return y
+
+
+def _no_grad():
+    from ..core.dispatch import no_grad_ctx
+
+    return no_grad_ctx()
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    """reference: operators/layer_norm_op.cc."""
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n_axes = len(tuple(normalized_shape))
+
+    def _ln(x, w, b, *, eps, n_axes):
+        axes = tuple(range(x.ndim - n_axes, x.ndim))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.var(x, axis=axes, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + eps)
+        if w is not None:
+            y = y * w
+        if b is not None:
+            y = y + b
+        return y
+
+    return apply_op("layer_norm", _ln, x, weight, bias, eps=float(epsilon), n_axes=n_axes)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW",
+                  name=None):
+    def _in(x, w, b, *, eps):
+        axes = tuple(range(2, x.ndim))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.var(x, axis=axes, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + eps)
+        if w is not None:
+            shape = (1, -1) + (1,) * (x.ndim - 2)
+            y = y * w.reshape(shape)
+        if b is not None:
+            shape = (1, -1) + (1,) * (x.ndim - 2)
+            y = y + b.reshape(shape)
+        return y
+
+    return apply_op("instance_norm", _in, x, weight, bias, eps=float(eps))
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def _gn(x, w, b, *, groups, eps):
+        n, c = x.shape[0], x.shape[1]
+        xg = x.reshape((n, groups, c // groups) + x.shape[2:])
+        axes = tuple(range(2, xg.ndim))
+        mean = jnp.mean(xg, axis=axes, keepdims=True)
+        var = jnp.var(xg, axis=axes, keepdims=True)
+        y = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        if w is not None:
+            y = y * w.reshape(shape)
+        if b is not None:
+            y = y + b.reshape(shape)
+        return y
+
+    return apply_op("group_norm", _gn, x, weight, bias, groups=int(num_groups),
+                    eps=float(epsilon))
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW",
+                        name=None):
+    def _lrn(x, *, size, alpha, beta, k):
+        sq = jnp.square(x)
+        half = size // 2
+        c = x.shape[1]
+        pads = [(0, 0)] * x.ndim
+        pads[1] = (half, size - half - 1)
+        sq = jnp.pad(sq, pads)
+        window = [1] * x.ndim
+        window[1] = size
+        s = jax.lax.reduce_window(sq, 0.0, jax.lax.add, tuple(window), (1,) * x.ndim,
+                                  "VALID")
+        return x / jnp.power(k + alpha * s, beta)
+
+    return apply_op("lrn", _lrn, x, size=int(size), alpha=float(alpha),
+                    beta=float(beta), k=float(k))
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return apply_op(
+        "normalize",
+        lambda x, *, p, axis, eps: x / jnp.maximum(
+            jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=True), 1.0 / p), eps),
+        x, p=float(p), axis=int(axis), eps=float(epsilon))
+
+
+# ------------------------------------------------------------- losses
+
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, name=None):
+    """reference: operators/softmax_with_cross_entropy_op.cc."""
+
+    def _ce(logits, label, weight, *, ignore_index, reduction, soft_label, axis,
+            use_softmax):
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
+            jnp.clip(logits, 1e-30, None))
+        if soft_label:
+            loss = -jnp.sum(label * logp, axis=axis)
+            return _reduce_loss(loss, reduction)
+        lbl = label.astype(jnp.int32)
+        if lbl.ndim == logp.ndim:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        loss = -jnp.take_along_axis(logp, lbl[..., None], axis=axis)[..., 0]
+        valid = lbl != ignore_index
+        loss = jnp.where(valid, loss, 0.0)
+        if weight is not None:
+            wpc = jnp.take(weight, jnp.clip(lbl, 0, None), axis=0)
+            loss = loss * jnp.where(valid, wpc, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(valid, wpc, 0.0)), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("cross_entropy", _ce, input, label, weight,
+                    ignore_index=int(ignore_index), reduction=reduction,
+                    soft_label=bool(soft_label), axis=int(axis),
+                    use_softmax=bool(use_softmax))
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    from .. import tensor as pt
+
+    loss = pt.unsqueeze(loss, -1)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def _nll(logp, label, weight, *, ignore_index, reduction):
+        if logp.ndim > 2:
+            # paddle layout [N, C, d1, ...]: move the class axis last
+            logp = jnp.moveaxis(logp, 1, -1)
+        lbl = label.astype(jnp.int32)
+        loss = -jnp.take_along_axis(logp, lbl[..., None], axis=-1)[..., 0]
+        valid = lbl != ignore_index
+        loss = jnp.where(valid, loss, 0.0)
+        if weight is not None:
+            loss = loss * jnp.take(weight, jnp.clip(lbl, 0, None))
+        if reduction == "mean":
+            denom = jnp.sum(jnp.take(weight, jnp.clip(lbl, 0, None)) * valid) \
+                if weight is not None else jnp.sum(valid.astype(loss.dtype))
+            return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("nll_loss", _nll, input, label, weight,
+                    ignore_index=int(ignore_index), reduction=reduction)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op(
+        "mse_loss",
+        lambda x, y, *, reduction: _reduce_loss(jnp.square(x - y), reduction),
+        input, label, reduction=reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op(
+        "l1_loss",
+        lambda x, y, *, reduction: _reduce_loss(jnp.abs(x - y), reduction),
+        input, label, reduction=reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def _sl1(x, y, *, reduction, delta):
+        d = jnp.abs(x - y)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("smooth_l1_loss", _sl1, input, label, reduction=reduction,
+                    delta=float(delta))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def _bce(p, y, w, *, reduction):
+        p = jnp.clip(p, 1e-12, 1 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w is not None:
+            loss = loss * w
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("bce", _bce, input, label, weight, reduction=reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def _bcel(x, y, w, pw, *, reduction):
+        max_val = jnp.clip(-x, 0, None)
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            loss = (1 - y) * x + log_w * (jnp.log1p(jnp.exp(-jnp.abs(x))) + max_val)
+        else:
+            loss = (1 - y) * x + jnp.log1p(jnp.exp(-jnp.abs(x))) + max_val
+        if w is not None:
+            loss = loss * w
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("bce_logits", _bcel, logit, label, weight, pos_weight,
+                    reduction=reduction)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def _kl(logp, y, *, reduction):
+        loss = y * (jnp.log(jnp.clip(y, 1e-12, None)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("kl_div", _kl, input, label, reduction=reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return apply_op(
+        "margin_ranking_loss",
+        lambda x, o, y, *, margin, reduction: _reduce_loss(
+            jnp.clip(-y * (x - o) + margin, 0, None), reduction),
+        input, other, label, margin=float(margin), reduction=reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return apply_op(
+        "hinge_embedding_loss",
+        lambda x, y, *, margin, reduction: _reduce_loss(
+            jnp.where(y == 1, x, jnp.clip(margin - x, 0, None)), reduction),
+        input, label, margin=float(margin), reduction=reduction)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return apply_op(
+        "cosine_similarity",
+        lambda a, b, *, axis, eps: jnp.sum(a * b, axis=axis) / jnp.maximum(
+            jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis), eps),
+        x1, x2, axis=int(axis), eps=float(eps))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def _focal(x, y, norm, *, alpha, gamma, reduction):
+        p = jax.nn.sigmoid(x)
+        ce = (1 - y) * x + jnp.log1p(jnp.exp(-jnp.abs(x))) + jnp.clip(-x, 0, None)
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if norm is not None:
+            loss = loss / norm
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("sigmoid_focal_loss", _focal, logit, label, normalizer,
+                    alpha=float(alpha), gamma=float(gamma), reduction=reduction)
+
+
+def square_error_cost(input, label):
+    return apply_op("square_error_cost", lambda x, y: jnp.square(x - y), input, label)
+
+
+# ------------------------------------------------------------- attention
+
+
+def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """Fused attention entry point. Uses the Pallas flash kernel on TPU when
+    enabled (ops/pallas/flash_attention.py); otherwise a jnp reference that
+    XLA fuses well. Layout: [batch, heads, seq, head_dim]."""
+    from ..ops import attention as attn_ops
+
+    return attn_ops.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=dropout_p, is_causal=is_causal,
+        training=training)
+
+
+# ------------------------------------------------------------- vision misc
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    """reference: operators/interpolate_v2_op.cc (subset: nearest/bilinear)."""
+    if size is not None and scale_factor is not None:
+        raise ValueError("interpolate: pass exactly one of size / scale_factor")
+    if size is None and scale_factor is None:
+        raise ValueError("interpolate: one of size / scale_factor is required")
+    if size is not None:
+        size = _pair(size) if not isinstance(size, int) else (size, size)
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else (scale_factor,) * 2
+        in_h, in_w = (x.shape[2], x.shape[3]) if data_format == "NCHW" else (x.shape[1], x.shape[2])
+        size = (int(in_h * sf[0]), int(in_w * sf[1]))
+
+    def _interp(x, *, size, mode, align_corners, chan_first):
+        if chan_first:
+            n, c, h, w = x.shape
+            img = jnp.transpose(x, (0, 2, 3, 1))
+        else:
+            n, h, w, c = x.shape
+            img = x
+        method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
+                  "area": "linear"}[mode]
+        out = jax.image.resize(img, (n, size[0], size[1], c), method=method)
+        if chan_first:
+            out = jnp.transpose(out, (0, 3, 1, 2))
+        return out.astype(x.dtype)
+
+    return apply_op("interpolate", _interp, x, size=tuple(size), mode=mode,
+                    align_corners=bool(align_corners), chan_first=data_format == "NCHW")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    def _ps(x, *, r):
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return x.reshape(n, c // (r * r), h * r, w * r)
+
+    return apply_op("pixel_shuffle", _ps, x, r=int(upscale_factor))
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """reference: operators/unfold_op.cc (im2col)."""
+    ks = _pair(kernel_sizes)
+    st = _pair(strides)
+    pd = _pair(paddings)
+    dl = _pair(dilations)
+
+    def _unfold(x, *, ks, st, pd, dl):
+        n, c, h, w = x.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            x, filter_shape=ks, window_strides=st,
+            padding=[(pd[0], pd[0]), (pd[1], pd[1])], rhs_dilation=dl,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # patches: [n, c*kh*kw, oh, ow]
+        return patches.reshape(n, patches.shape[1], -1)
+
+    return apply_op("unfold", _unfold, x, ks=ks, st=st, pd=pd, dl=dl)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True,
+                name=None):
+    def _gs(x, grid, *, align_corners):
+        n, c, h, w = x.shape
+        gx = (grid[..., 0] + 1) * (w - 1) / 2 if align_corners else \
+            ((grid[..., 0] + 1) * w - 1) / 2
+        gy = (grid[..., 1] + 1) * (h - 1) / 2 if align_corners else \
+            ((grid[..., 1] + 1) * h - 1) / 2
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        x1 = x0 + 1
+        y1 = y0 + 1
+
+        def sample(ix, iy):
+            ixc = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+            iyc = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+            valid = ((ix >= 0) & (ix <= w - 1) & (iy >= 0) & (iy <= h - 1))
+            batch = jnp.arange(n)[:, None, None]
+            vals = x[batch, :, iyc, ixc]  # [n, gh, gw, c]
+            return jnp.where(valid[..., None], vals, 0.0)
+
+        wa = ((x1 - gx) * (y1 - gy))[..., None]
+        wb = ((x1 - gx) * (gy - y0))[..., None]
+        wc = ((gx - x0) * (y1 - gy))[..., None]
+        wd = ((gx - x0) * (gy - y0))[..., None]
+        out = (sample(x0, y0) * wa + sample(x0, y1) * wb + sample(x1, y0) * wc +
+               sample(x1, y1) * wd)
+        return jnp.transpose(out, (0, 3, 1, 2))
+
+    return apply_op("grid_sample", _gs, x, grid, align_corners=bool(align_corners))
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    shape = tuple(int(s) for s in (out_shape.numpy() if isinstance(out_shape, Tensor)
+                                   else out_shape))
+
+    def _ag(theta, *, shape, align_corners):
+        n, c, h, w = shape
+        if align_corners:
+            ys = jnp.linspace(-1, 1, h)
+            xs = jnp.linspace(-1, 1, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [h, w, 3]
+        return jnp.einsum("nij,hwj->nhwi", theta, base)
+
+    return apply_op("affine_grid", _ag, theta, shape=shape,
+                    align_corners=bool(align_corners))
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None, data_format="NCHW"):
+    def _ts(x, *, seg, ratio):
+        nt, c, h, w = x.shape
+        n = nt // seg
+        xr = x.reshape(n, seg, c, h, w)
+        fold = int(c * ratio)
+        out_a = jnp.concatenate([xr[:, 1:, :fold], jnp.zeros_like(xr[:, :1, :fold])], axis=1)
+        out_b = jnp.concatenate([jnp.zeros_like(xr[:, :1, fold:2 * fold]),
+                                 xr[:, :-1, fold:2 * fold]], axis=1)
+        out_c = xr[:, :, 2 * fold:]
+        return jnp.concatenate([out_a, out_b, out_c], axis=2).reshape(nt, c, h, w)
+
+    return apply_op("temporal_shift", _ts, x, seg=int(seg_num), ratio=float(shift_ratio))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def _npair(a, p, y, *, l2):
+        sim = a @ p.T
+        n = a.shape[0]
+        eq = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = eq / jnp.sum(eq, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+        reg = l2 * (jnp.mean(jnp.sum(jnp.square(a), axis=1)) +
+                    jnp.mean(jnp.sum(jnp.square(p), axis=1))) / 2
+        return ce + reg
+
+    return apply_op("npair_loss", _npair, anchor, positive, labels, l2=float(l2_reg))
+
+
+def glu(x, axis=-1, name=None):
+    def _glu(x, *, axis):
+        a, b = jnp.split(x, 2, axis=axis)
+        return a * jax.nn.sigmoid(b)
+
+    return apply_op("glu", _glu, x, axis=int(axis))
+
+
+def pad(x, pad_width, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ..tensor.manipulation import pad as _pad
+
+    return _pad(x, pad_width, mode, value, data_format)
+
+
+def unstack(x, axis=0, num=None):
+    from ..tensor.manipulation import unstack as _unstack
+
+    return _unstack(x, axis, num)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    def _de(x, *, offset):
+        return jax.vmap(lambda row: jnp.diag(row, k=offset))(x.reshape(-1, x.shape[-1])) \
+            .reshape(x.shape[:-1] + (x.shape[-1] + abs(offset), x.shape[-1] + abs(offset)))
+
+    return apply_op("diag_embed", _de, input, offset=int(offset))
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    if maxlen is None:
+        maxlen = int(np.asarray(x._value).max())
+
+    def _sm(x, *, maxlen, dtype):
+        r = jnp.arange(maxlen)
+        return (r[None, :] < x[..., None]).astype(np.dtype(dtype))
+
+    return apply_op("sequence_mask", _sm, x, maxlen=int(maxlen), dtype=str(dtype))

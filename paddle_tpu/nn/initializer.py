@@ -1,0 +1,187 @@
+"""Weight initializers (reference: python/paddle/nn/initializer/,
+fluid/initializer.py). Functional: each initializer generates a concrete
+jax array from the global (or scoped) PRNG, rather than emitting init ops
+into a startup program — XLA has no startup-program concept.
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import random as random_core
+
+
+class Initializer:
+    def _generate(self, shape, dtype):
+        raise NotImplementedError
+
+    def __call__(self, param):
+        """Re-initialize an existing Tensor/Parameter in place."""
+        value = self._generate(tuple(param.shape), np.dtype(param.dtype))
+        param.set_value(value)
+        return param
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def _generate(self, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean = mean
+        self.std = std
+
+    def _generate(self, shape, dtype):
+        k = random_core.next_key()
+        return self.mean + self.std * jax.random.normal(k, shape, dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean = mean
+        self.std = std
+
+    def _generate(self, shape, dtype):
+        k = random_core.next_key()
+        return self.mean + self.std * jax.random.truncated_normal(k, -2.0, 2.0, shape, dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0, name=None):
+        self.low = low
+        self.high = high
+
+    def _generate(self, shape, dtype):
+        k = random_core.next_key()
+        return jax.random.uniform(k, shape, dtype, self.low, self.high)
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels [out_c, in_c, *spatial] (paddle layout)
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, name=None):
+        self.fan_in = fan_in
+        self.fan_out = fan_out
+
+    def _generate(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = math.sqrt(2.0 / (fi + fo))
+        k = random_core.next_key()
+        return std * jax.random.normal(k, shape, dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, name=None):
+        self.fan_in = fan_in
+        self.fan_out = fan_out
+
+    def _generate(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = math.sqrt(6.0 / (fi + fo))
+        k = random_core.next_key()
+        return jax.random.uniform(k, shape, dtype, -limit, limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu", name=None):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _generate(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2)) \
+            if self.nonlinearity in ("relu", "leaky_relu") else 1.0
+        std = gain / math.sqrt(fi)
+        k = random_core.next_key()
+        return std * jax.random.normal(k, shape, dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu", name=None):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _generate(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2)) \
+            if self.nonlinearity in ("relu", "leaky_relu") else 1.0
+        limit = gain * math.sqrt(3.0 / fi)
+        k = random_core.next_key()
+        return jax.random.uniform(k, shape, dtype, -limit, limit)
+
+
+class Assign(Initializer):
+    def __init__(self, value, name=None):
+        self.value = value
+
+    def _generate(self, shape, dtype):
+        arr = np.asarray(self.value.numpy() if hasattr(self.value, "numpy")
+                         else self.value)
+        return jnp.asarray(arr, dtype).reshape(shape)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0, name=None):
+        self.gain = gain
+
+    def _generate(self, shape, dtype):
+        k = random_core.next_key()
+        return self.gain * jax.nn.initializers.orthogonal()(k, shape, dtype)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1, name=None):
+        self.groups = groups
+
+    def _generate(self, shape, dtype):
+        arr = np.zeros(shape, np.float32)
+        oc, ic = shape[0], shape[1]
+        spatial = shape[2:]
+        centers = tuple(s // 2 for s in spatial)
+        for i in range(min(oc, ic * self.groups)):
+            arr[(i, i % ic) + centers] = 1.0
+        return jnp.asarray(arr, dtype)
+
+
+# lowercase paddle 2.x aliases
+constant = Constant
+normal = Normal
+uniform = Uniform
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    global _GLOBAL_WEIGHT_INIT, _GLOBAL_BIAS_INIT
+    _GLOBAL_WEIGHT_INIT = weight_init
+    _GLOBAL_BIAS_INIT = bias_init
+
+
+_GLOBAL_WEIGHT_INIT = None
+_GLOBAL_BIAS_INIT = None
+
+
+def global_initializer(is_bias):
+    return _GLOBAL_BIAS_INIT if is_bias else _GLOBAL_WEIGHT_INIT

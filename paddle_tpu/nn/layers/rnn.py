@@ -1,0 +1,374 @@
+"""RNN layers (reference: python/paddle/nn/layer/rnn.py; operators/rnn_op,
+cudnn_lstm). TPU-native design: the multi-layer LSTM/GRU/SimpleRNN run as
+one fused ``lax.scan`` over time inside a single dispatched op, so XLA
+compiles a tight loop with MXU matmuls instead of per-step op dispatch
+(the cudnn_lstm analog). Cell classes remain eager/dygraph-friendly.
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import functional as F
+from ..layer import Layer
+from .. import initializer as I
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0,
+                           batch_dim_idx=0):
+        from ... import tensor as pt
+
+        batch = batch_ref.shape[batch_dim_idx]
+        hidden = self.hidden_size
+        return pt.full([batch, hidden], init_value, dtype or "float32")
+
+
+def _std_init(hidden_size):
+    std = 1.0 / math.sqrt(hidden_size)
+    return I.Uniform(-std, std)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        init = _std_init(hidden_size)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               attr=weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               attr=weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], attr=bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], attr=bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def _cell(x, h, wi, wh, bi, bh, *, act):
+            pre = x @ wi.T + bi + h @ wh.T + bh
+            return jnp.tanh(pre) if act == "tanh" else jax.nn.relu(pre)
+
+        h = apply_op("simple_rnn_cell", _cell, inputs, states, self.weight_ih,
+                     self.weight_hh, self.bias_ih, self.bias_hh, act=self.activation)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        init = _std_init(hidden_size)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               attr=weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               attr=weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], attr=bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], attr=bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+
+        def _cell(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+
+        h_new, c_new = apply_op("lstm_cell", _cell, inputs, h, c, self.weight_ih,
+                                self.weight_hh, self.bias_ih, self.bias_hh)
+        return h_new, (h_new, c_new)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        init = _std_init(hidden_size)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               attr=weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               attr=weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], attr=bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], attr=bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def _cell(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            r_i, z_i, n_i = jnp.split(gi, 3, axis=-1)
+            r_h, z_h, n_h = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(r_i + r_h)
+            z = jax.nn.sigmoid(z_i + z_h)
+            n = jnp.tanh(n_i + r * n_h)
+            return (1 - z) * n + z * h
+
+        h = apply_op("gru_cell", _cell, inputs, states, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Generic cell-runner (reference: nn/layer/rnn.py RNN). Python loop over
+    time — unrolls under trace; use the fused LSTM/GRU classes for long
+    sequences."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ... import tensor as pt
+
+        time_axis = 0 if self.time_major else 1
+        steps = inputs.shape[time_axis]
+        xs = pt.unstack(inputs, axis=time_axis)
+        if self.is_reverse:
+            xs = xs[::-1]
+        states = initial_states
+        outs = []
+        for x in xs:
+            out, states = self.cell(x, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        outputs = pt.stack(outs, axis=time_axis)
+        return outputs, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ... import tensor as pt
+
+        st_fw, st_bw = (initial_states if initial_states is not None else (None, None))
+        out_fw, fw_states = self.rnn_fw(inputs, st_fw)
+        out_bw, bw_states = self.rnn_bw(inputs, st_bw)
+        return pt.concat([out_fw, out_bw], axis=-1), (fw_states, bw_states)
+
+
+def _lstm_scan(x, h0, c0, *weights, num_layers, bidirectional, dropout_p):
+    """Fused multi-layer (bi)LSTM via lax.scan; x is time-major [T,B,I]."""
+    ndir = 2 if bidirectional else 1
+
+    def layer_run(x, h_init, c_init, wi, wh, bi, bh, reverse):
+        def step(carry, xt):
+            h, c = carry
+            gates = xt @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+
+        (h_fin, c_fin), ys = jax.lax.scan(step, (h_init, c_init), x, reverse=reverse)
+        return ys, h_fin, c_fin
+
+    out = x
+    h_finals, c_finals = [], []
+    for layer in range(num_layers):
+        dir_outs = []
+        for d in range(ndir):
+            idx = (layer * ndir + d) * 4
+            wi, wh, bi, bh = weights[idx:idx + 4]
+            ys, hf, cf = layer_run(out, h0[layer * ndir + d], c0[layer * ndir + d],
+                                   wi, wh, bi, bh, reverse=(d == 1))
+            dir_outs.append(ys)
+            h_finals.append(hf)
+            c_finals.append(cf)
+        out = dir_outs[0] if ndir == 1 else jnp.concatenate(dir_outs, axis=-1)
+    return out, jnp.stack(h_finals), jnp.stack(c_finals)
+
+
+def _gru_scan(x, h0, *weights, num_layers, bidirectional):
+    ndir = 2 if bidirectional else 1
+
+    def layer_run(x, h_init, wi, wh, bi, bh, reverse):
+        def step(h, xt):
+            gi = xt @ wi.T + bi
+            gh = h @ wh.T + bh
+            r_i, z_i, n_i = jnp.split(gi, 3, axis=-1)
+            r_h, z_h, n_h = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(r_i + r_h)
+            z = jax.nn.sigmoid(z_i + z_h)
+            n = jnp.tanh(n_i + r * n_h)
+            h_new = (1 - z) * n + z * h
+            return h_new, h_new
+
+        h_fin, ys = jax.lax.scan(step, h_init, x, reverse=reverse)
+        return ys, h_fin
+
+    out = x
+    h_finals = []
+    for layer in range(num_layers):
+        dir_outs = []
+        for d in range(ndir):
+            idx = (layer * ndir + d) * 4
+            wi, wh, bi, bh = weights[idx:idx + 4]
+            ys, hf = layer_run(out, h0[layer * ndir + d], wi, wh, bi, bh, reverse=(d == 1))
+            dir_outs.append(ys)
+            h_finals.append(hf)
+        out = dir_outs[0] if ndir == 1 else jnp.concatenate(dir_outs, axis=-1)
+    return out, jnp.stack(h_finals)
+
+
+def _rnn_scan(x, h0, *weights, num_layers, bidirectional, activation):
+    ndir = 2 if bidirectional else 1
+
+    def layer_run(x, h_init, wi, wh, bi, bh, reverse):
+        def step(h, xt):
+            pre = xt @ wi.T + bi + h @ wh.T + bh
+            h_new = jnp.tanh(pre) if activation == "tanh" else jax.nn.relu(pre)
+            return h_new, h_new
+
+        h_fin, ys = jax.lax.scan(step, h_init, x, reverse=reverse)
+        return ys, h_fin
+
+    out = x
+    h_finals = []
+    for layer in range(num_layers):
+        dir_outs = []
+        for d in range(ndir):
+            idx = (layer * ndir + d) * 4
+            wi, wh, bi, bh = weights[idx:idx + 4]
+            ys, hf = layer_run(out, h0[layer * ndir + d], wi, wh, bi, bh, reverse=(d == 1))
+            dir_outs.append(ys)
+            h_finals.append(hf)
+        out = dir_outs[0] if ndir == 1 else jnp.concatenate(dir_outs, axis=-1)
+    return out, jnp.stack(h_finals)
+
+
+class _RNNBase(Layer):
+    MODE = "LSTM"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.time_major = time_major
+        self.dropout = dropout
+        ndir = 2 if self.bidirectional else 1
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}[self.MODE]
+        init = _std_init(hidden_size)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(ndir):
+                in_size = input_size if layer == 0 else hidden_size * ndir
+                suffix = f"_l{layer}" + ("_rev" if d else "")
+                wi = self.create_parameter([gate_mult * hidden_size, in_size],
+                                           attr=weight_ih_attr, default_initializer=init)
+                wh = self.create_parameter([gate_mult * hidden_size, hidden_size],
+                                           attr=weight_hh_attr, default_initializer=init)
+                bi = self.create_parameter([gate_mult * hidden_size], attr=bias_ih_attr,
+                                           is_bias=True, default_initializer=init)
+                bh = self.create_parameter([gate_mult * hidden_size], attr=bias_hh_attr,
+                                           is_bias=True, default_initializer=init)
+                for nm, p in zip(("weight_ih", "weight_hh", "bias_ih", "bias_hh"),
+                                 (wi, wh, bi, bh)):
+                    self.add_parameter(nm + suffix, p)
+                self._all_weights += [wi, wh, bi, bh]
+
+    def _zero_state(self, x_bt):
+        from ... import tensor as pt
+
+        ndir = 2 if self.bidirectional else 1
+        batch = x_bt.shape[1 if self.time_major else 0]
+        return pt.zeros([self.num_layers * ndir, batch, self.hidden_size])
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ... import tensor as pt
+
+        x = inputs if self.time_major else pt.transpose(inputs, [1, 0, 2])
+        if self.MODE == "LSTM":
+            if initial_states is None:
+                h0 = self._zero_state(inputs)
+                c0 = self._zero_state(inputs)
+            else:
+                h0, c0 = initial_states
+            out, h_fin, c_fin = apply_op(
+                "fused_lstm", _lstm_scan, x, h0, c0, *self._all_weights,
+                num_layers=self.num_layers, bidirectional=self.bidirectional,
+                dropout_p=0.0)
+            if not self.time_major:
+                out = pt.transpose(out, [1, 0, 2])
+            return out, (h_fin, c_fin)
+        h0 = initial_states if initial_states is not None else self._zero_state(inputs)
+        if self.MODE == "GRU":
+            out, h_fin = apply_op("fused_gru", _gru_scan, x, h0, *self._all_weights,
+                                  num_layers=self.num_layers,
+                                  bidirectional=self.bidirectional)
+        else:
+            out, h_fin = apply_op(
+                "fused_rnn", _rnn_scan, x, h0, *self._all_weights,
+                num_layers=self.num_layers, bidirectional=self.bidirectional,
+                activation="tanh" if self.MODE == "RNN_TANH" else "relu")
+        if not self.time_major:
+            out = pt.transpose(out, [1, 0, 2])
+        return out, h_fin
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        self.__class__.MODE = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(input_size, hidden_size, num_layers, direction, time_major,
+                         dropout, **kwargs)

@@ -1,0 +1,10 @@
+"""paddle.onnx (reference: python/paddle/onnx/export.py). ONNX export from
+XLA requires an ONNX writer dependency not in this image; the API is
+present and raises with guidance (jit.save's StableHLO is the portable
+interchange format here)."""
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "onnx export requires the onnx package (not in this environment); "
+        "use paddle_tpu.jit.save for portable StableHLO export")

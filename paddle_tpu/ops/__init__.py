@@ -1,0 +1,7 @@
+"""Fused / hand-written kernels (Pallas) and their reference implementations.
+
+The reference keeps fused CUDA kernels under paddle/fluid/operators/fused/
+and operators/math/bert_encoder_functor.cu; here the analog is Pallas TPU
+kernels with jnp reference fallbacks (used on CPU and for numerics tests).
+"""
+from . import attention  # noqa: F401

@@ -1,0 +1,71 @@
+"""Scaled-dot-product attention: jnp reference + Pallas flash kernel switch.
+
+The reference has no flash attention (SURVEY §5 long-context: absent) —
+its closest analog is the fused BERT encoder functor
+(reference: paddle/fluid/operators/math/bert_encoder_functor.cu). Here the
+TPU-native design is a Pallas blockwise-softmax kernel (ops/pallas/
+flash_attention.py) selected on TPU, with this jnp implementation as the
+portable reference; XLA already fuses it into few kernels on TPU.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import flags, random as random_core
+from ..core.dispatch import apply_op
+
+
+def _sdpa_ref(q, k, v, mask, key, *, scale, dropout_p, is_causal):
+    # q,k,v: [batch, heads, seq, head_dim]
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if is_causal:
+        s_q, s_k = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        logits = jnp.where(causal, logits, jnp.finfo(logits.dtype).min)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def _use_pallas():
+    if not flags.get_flags("use_pallas_kernels")["use_pallas_kernels"]:
+        return False
+    from ..core.place import is_tpu_available
+
+    try:
+        return is_tpu_available()
+    except Exception:
+        return False
+
+
+def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True):
+    head_dim = q.shape[-1]
+    scale = 1.0 / math.sqrt(head_dim)
+    p = float(dropout_p) if training else 0.0
+    key = random_core.next_key() if p > 0.0 else None
+
+    if _use_pallas() and attn_mask is None and p == 0.0:
+        from .pallas import flash_attention
+
+        try:
+            return apply_op(
+                "flash_attention",
+                lambda q, k, v, *, scale, is_causal: flash_attention.mha(
+                    q, k, v, scale=scale, causal=is_causal),
+                q, k, v, scale=scale, is_causal=bool(is_causal))
+        except Exception:
+            pass  # fall back to reference path
+
+    return apply_op(
+        "sdpa", _sdpa_ref, q, k, v, attn_mask, key,
+        scale=scale, dropout_p=p, is_causal=bool(is_causal))

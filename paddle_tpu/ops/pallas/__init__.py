@@ -1,0 +1,2 @@
+"""Pallas TPU kernels. Selected when running on real TPU hardware
+(FLAGS_use_pallas_kernels); CPU tests exercise the jnp reference paths."""

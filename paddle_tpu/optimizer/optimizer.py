@@ -1,0 +1,481 @@
+"""Optimizers (reference: python/paddle/optimizer/*.py; kernels
+operators/optimizers/{sgd,momentum,adam,adamw,adagrad,adadelta,rmsprop,
+lamb}_op.cc).
+
+Design: each optimizer is a *functional core* — a pure per-parameter
+``_update(p, g, lr, *state, **hypers) -> (new_p, *new_state)`` — plus a
+mutable-shell ``step()`` for eager mode. The same functional core is used
+verbatim inside jitted/pjit train steps (`apply_gradients_arrays`), so
+dygraph and compiled training share one optimizer definition, mirroring
+how the reference shares optimizer ops between executors.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ..core.dispatch import no_grad_ctx
+from . import lr as lr_mod
+
+
+class _L2DecayStub:
+    def __init__(self, coeff):
+        self.coeff = coeff
+
+
+class Optimizer:
+    _hyper_defaults = {}
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._name = name
+        if weight_decay is None:
+            self._coupled_wd = 0.0
+        elif isinstance(weight_decay, float):
+            self._coupled_wd = weight_decay
+        else:  # regularizer.L2Decay
+            self._coupled_wd = getattr(weight_decay, "_coeff",
+                                       getattr(weight_decay, "coeff", 0.0))
+        self._accumulators = {}
+        self._step_count = 0
+
+    # ------------------------------------------------------------ lr
+    def get_lr(self):
+        if isinstance(self._learning_rate, lr_mod.LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    @property
+    def _lr_scheduler(self):
+        return self._learning_rate if isinstance(self._learning_rate,
+                                                 lr_mod.LRScheduler) else None
+
+    # ------------------------------------------------------------ functional core
+    def _init_state(self, p_arr):
+        """Return the tuple of state arrays for one parameter."""
+        return ()
+
+    @staticmethod
+    def _update(p, g, lr, *state, **hypers):
+        raise NotImplementedError
+
+    def _hypers(self, param=None):
+        h = dict(self._hyper_defaults)
+        h["l2"] = self._coupled_wd
+        if param is not None and getattr(param, "regularizer", None) is not None:
+            h["l2"] = getattr(param.regularizer, "_coeff",
+                              getattr(param.regularizer, "coeff", h["l2"]))
+        return h
+
+    # ------------------------------------------------------------ eager step
+    @property
+    def _params(self):
+        if self._parameter_list is None:
+            raise ValueError(
+                "this optimizer was built without a `parameters` list "
+                "(static-graph style); pass parameters= in dygraph mode")
+        return self._parameter_list
+
+    def step(self):
+        self._step_count += 1
+        params = [p for p in self._params if not p.stop_gradient and p._grad is not None]
+        if not params:
+            return
+        with no_grad_ctx():
+            grads = [p._grad for p in params]
+            if self._grad_clip is not None:
+                grads = self._grad_clip.clip_arrays(grads)
+            lr_arr = jnp.asarray(self.get_lr(), jnp.float32)
+            for p, g in zip(params, grads):
+                plr = p.optimize_attr.get("learning_rate", 1.0) if hasattr(
+                    p, "optimize_attr") else 1.0
+                state = self._accumulators.get(id(p))
+                if state is None:
+                    state = self._init_state(p._value)
+                hypers = self._hypers(p)
+                fn = dispatch.jitted(type(self)._update, hypers)
+                out = fn(p._value, g, lr_arr * plr, *state)
+                new_p, new_state = out[0], tuple(out[1:])
+                p._value = new_p
+                self._accumulators[id(p)] = new_state
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._params:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..jit import in_dynamic_mode
+
+        if not in_dynamic_mode():
+            # static facade: attach a functional train step to the program
+            from ..static import program as prog_mod
+
+            prog = prog_mod._RECORDER.get() or prog_mod.default_main_program()
+            prog.train_attach = (self, loss)
+            return [], []
+        loss.backward()
+        self.step()
+        return [], []
+
+    def backward(self, loss, **kw):
+        loss.backward()
+
+    def apply_gradients(self, params_grads):
+        with no_grad_ctx():
+            if self._grad_clip is not None:
+                params_grads = self._grad_clip(params_grads)
+            lr_arr = jnp.asarray(self.get_lr(), jnp.float32)
+            for p, g in params_grads:
+                if g is None:
+                    continue
+                g_arr = g._value if isinstance(g, Tensor) else g
+                state = self._accumulators.get(id(p))
+                if state is None:
+                    state = self._init_state(p._value)
+                fn = dispatch.jitted(type(self)._update, self._hypers(p))
+                out = fn(p._value, g_arr, lr_arr, *state)
+                p._value = out[0]
+                self._accumulators[id(p)] = tuple(out[1:])
+
+    # ------------------------------------------------------------ pure/traced API
+    def init_state_arrays(self, params):
+        """params: dict name -> array. Returns opt state pytree (for jit/pjit)."""
+        return {name: self._init_state(arr) for name, arr in params.items()}
+
+    def apply_gradients_arrays(self, params, grads, state, lr=None):
+        """Pure update over dict pytrees — usable inside jit/pjit/shard_map."""
+        if lr is None:
+            lr = self.get_lr()
+        lr = jnp.asarray(lr, jnp.float32)
+        if self._grad_clip is not None:
+            names = list(grads)
+            clipped = self._grad_clip.clip_arrays([grads[n] for n in names])
+            grads = dict(zip(names, clipped))
+        hypers = self._hypers()
+        new_params, new_state = {}, {}
+        for name, p in params.items():
+            g = grads.get(name)
+            if g is None:
+                new_params[name] = p
+                new_state[name] = state[name]
+                continue
+            out = type(self)._update(p, g.astype(p.dtype) if g.dtype != p.dtype else g,
+                                     lr, *state[name], **hypers)
+            new_params[name] = out[0]
+            new_state[name] = tuple(out[1:])
+        return new_params, new_state
+
+    # ------------------------------------------------------------ state dict
+    def state_dict(self):
+        d = {"step_count": self._step_count, "accumulators": {}}
+        name_of = {id(p): (p.name or f"param_{i}")
+                   for i, p in enumerate(self._params)}
+        for pid, state in self._accumulators.items():
+            if pid in name_of:
+                d["accumulators"][name_of[pid]] = [np.asarray(a) for a in state]
+        if self._lr_scheduler is not None:
+            d["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        return d
+
+    def set_state_dict(self, state_dict):
+        self._step_count = state_dict.get("step_count", 0)
+        by_name = {(p.name or f"param_{i}"): p for i, p in enumerate(self._params)}
+        for name, arrs in state_dict.get("accumulators", {}).items():
+            if name in by_name:
+                self._accumulators[id(by_name[name])] = tuple(
+                    jnp.asarray(a) for a in arrs)
+        if "LR_Scheduler" in state_dict and self._lr_scheduler is not None:
+            self._lr_scheduler.set_state_dict(state_dict["LR_Scheduler"])
+
+    load_dict = set_state_dict
+
+
+class SGD(Optimizer):
+    """reference: operators/optimizers/sgd_op.cc."""
+
+    @staticmethod
+    def _update(p, g, lr, *, l2=0.0):
+        if l2:
+            g = g + l2 * p
+        return (p - lr.astype(p.dtype) * g.astype(p.dtype),)
+
+
+class Momentum(Optimizer):
+    """reference: operators/optimizers/momentum_op.cc (+ LARS variant
+    lars_momentum_op.cc via use_lars)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None,
+                 use_lars=False, lars_coeff=0.001, lars_weight_decay=0.0005,
+                 multi_precision=False, rescale_grad=1.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+        self._use_lars = use_lars
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _hypers(self, param=None):
+        h = super()._hypers(param)
+        h.update(mu=self._momentum, nesterov=self._use_nesterov,
+                 lars=self._use_lars, lars_coeff=self._lars_coeff,
+                 lars_wd=self._lars_weight_decay)
+        return h
+
+    def _init_state(self, p_arr):
+        return (jnp.zeros_like(p_arr),)
+
+    @staticmethod
+    def _update(p, g, lr, velocity, *, mu=0.9, nesterov=False, l2=0.0, lars=False,
+                lars_coeff=0.001, lars_wd=0.0005):
+        g = g.astype(p.dtype)
+        lr = lr.astype(p.dtype)
+        if lars:
+            p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+            g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            local_lr = jnp.where(
+                (p_norm > 0) & (g_norm > 0),
+                lars_coeff * p_norm / (g_norm + lars_wd * p_norm + 1e-12), 1.0)
+            lr = lr * local_lr
+            g = g + lars_wd * p
+        elif l2:
+            g = g + l2 * p
+        v_new = mu * velocity + g
+        if nesterov:
+            p_new = p - lr * (g + mu * v_new)
+        else:
+            p_new = p - lr * v_new
+        return p_new, v_new
+
+
+class Adam(Optimizer):
+    """reference: operators/optimizers/adam_op.cc."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1 if isinstance(beta1, float) else float(beta1.numpy())
+        self._beta2 = beta2 if isinstance(beta2, float) else float(beta2.numpy())
+        self._epsilon = epsilon
+
+    def _hypers(self, param=None):
+        h = super()._hypers(param)
+        h.update(b1=self._beta1, b2=self._beta2, eps=self._epsilon)
+        return h
+
+    def _init_state(self, p_arr):
+        return (jnp.zeros_like(p_arr), jnp.zeros_like(p_arr),
+                jnp.zeros((), jnp.float32))
+
+    @staticmethod
+    def _update(p, g, lr, m, v, t, *, b1=0.9, b2=0.999, eps=1e-8, l2=0.0):
+        g = g.astype(p.dtype)
+        if l2:
+            g = g + l2 * p
+        t_new = t + 1
+        m_new = b1 * m + (1 - b1) * g
+        v_new = b2 * v + (1 - b2) * jnp.square(g)
+        mhat = m_new / (1 - b1 ** t_new).astype(p.dtype)
+        vhat = v_new / (1 - b2 ** t_new).astype(p.dtype)
+        p_new = p - lr.astype(p.dtype) * mhat / (jnp.sqrt(vhat) + eps)
+        return p_new, m_new, v_new, t_new
+
+
+class AdamW(Adam):
+    """reference: operators/optimizers/adamw (decoupled decay)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None,
+                         grad_clip, lazy_mode, multi_precision, name)
+        self._wd = weight_decay if isinstance(weight_decay, float) else 0.01
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _hypers(self, param=None):
+        h = super()._hypers(param)
+        wd = self._wd
+        if (param is not None and self._apply_decay_param_fun is not None
+                and not self._apply_decay_param_fun(param.name)):
+            wd = 0.0
+        h.update(wd=wd)
+        h["l2"] = 0.0
+        return h
+
+    @staticmethod
+    def _update(p, g, lr, m, v, t, *, b1=0.9, b2=0.999, eps=1e-8, wd=0.01, l2=0.0):
+        g = g.astype(p.dtype)
+        t_new = t + 1
+        m_new = b1 * m + (1 - b1) * g
+        v_new = b2 * v + (1 - b2) * jnp.square(g)
+        mhat = m_new / (1 - b1 ** t_new).astype(p.dtype)
+        vhat = v_new / (1 - b2 ** t_new).astype(p.dtype)
+        lr = lr.astype(p.dtype)
+        p_new = p - lr * (mhat / (jnp.sqrt(vhat) + eps) + wd * p)
+        return p_new, m_new, v_new, t_new
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _hypers(self, param=None):
+        h = super()._hypers(param)
+        h.update(b1=self._beta1, b2=self._beta2, eps=self._epsilon)
+        return h
+
+    def _init_state(self, p_arr):
+        return (jnp.zeros_like(p_arr), jnp.zeros_like(p_arr),
+                jnp.zeros((), jnp.float32))
+
+    @staticmethod
+    def _update(p, g, lr, m, u, t, *, b1=0.9, b2=0.999, eps=1e-8, l2=0.0):
+        g = g.astype(p.dtype)
+        if l2:
+            g = g + l2 * p
+        t_new = t + 1
+        m_new = b1 * m + (1 - b1) * g
+        u_new = jnp.maximum(b2 * u, jnp.abs(g))
+        p_new = p - (lr.astype(p.dtype) / (1 - b1 ** t_new).astype(p.dtype)) * \
+            m_new / (u_new + eps)
+        return p_new, m_new, u_new, t_new
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_value = initial_accumulator_value
+
+    def _hypers(self, param=None):
+        h = super()._hypers(param)
+        h.update(eps=self._epsilon)
+        return h
+
+    def _init_state(self, p_arr):
+        return (jnp.full_like(p_arr, self._init_value),)
+
+    @staticmethod
+    def _update(p, g, lr, acc, *, eps=1e-6, l2=0.0):
+        g = g.astype(p.dtype)
+        if l2:
+            g = g + l2 * p
+        acc_new = acc + jnp.square(g)
+        p_new = p - lr.astype(p.dtype) * g / (jnp.sqrt(acc_new) + eps)
+        return p_new, acc_new
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _hypers(self, param=None):
+        h = super()._hypers(param)
+        h.update(eps=self._epsilon, rho=self._rho)
+        return h
+
+    def _init_state(self, p_arr):
+        return (jnp.zeros_like(p_arr), jnp.zeros_like(p_arr))
+
+    @staticmethod
+    def _update(p, g, lr, avg_sq_grad, avg_sq_update, *, eps=1e-6, rho=0.95, l2=0.0):
+        g = g.astype(p.dtype)
+        if l2:
+            g = g + l2 * p
+        avg_sq_grad_new = rho * avg_sq_grad + (1 - rho) * jnp.square(g)
+        update = g * jnp.sqrt(avg_sq_update + eps) / jnp.sqrt(avg_sq_grad_new + eps)
+        avg_sq_update_new = rho * avg_sq_update + (1 - rho) * jnp.square(update)
+        return p - lr.astype(p.dtype) * update, avg_sq_grad_new, avg_sq_update_new
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _hypers(self, param=None):
+        h = super()._hypers(param)
+        h.update(rho=self._rho, eps=self._epsilon, mu=self._momentum,
+                 centered=self._centered)
+        return h
+
+    def _init_state(self, p_arr):
+        return (jnp.zeros_like(p_arr), jnp.zeros_like(p_arr), jnp.zeros_like(p_arr))
+
+    @staticmethod
+    def _update(p, g, lr, mean_sq, mean_g, mom, *, rho=0.95, eps=1e-6, mu=0.0,
+                centered=False, l2=0.0):
+        g = g.astype(p.dtype)
+        if l2:
+            g = g + l2 * p
+        mean_sq_new = rho * mean_sq + (1 - rho) * jnp.square(g)
+        if centered:
+            mean_g_new = rho * mean_g + (1 - rho) * g
+            denom = jnp.sqrt(mean_sq_new - jnp.square(mean_g_new) + eps)
+        else:
+            mean_g_new = mean_g
+            denom = jnp.sqrt(mean_sq_new + eps)
+        mom_new = mu * mom + lr.astype(p.dtype) * g / denom
+        return p - mom_new, mean_sq_new, mean_g_new, mom_new
+
+
+class Lamb(Optimizer):
+    """reference: operators/optimizers/lamb_op.cc."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _hypers(self, param=None):
+        h = super()._hypers(param)
+        wd = self._lamb_wd
+        if param is not None and self._exclude_fn is not None and self._exclude_fn(param):
+            wd = 0.0
+        h.update(b1=self._beta1, b2=self._beta2, eps=self._epsilon, wd=wd)
+        h["l2"] = 0.0
+        return h
+
+    def _init_state(self, p_arr):
+        return (jnp.zeros_like(p_arr), jnp.zeros_like(p_arr),
+                jnp.zeros((), jnp.float32))
+
+    @staticmethod
+    def _update(p, g, lr, m, v, t, *, b1=0.9, b2=0.999, eps=1e-6, wd=0.01, l2=0.0):
+        g = g.astype(p.dtype)
+        t_new = t + 1
+        m_new = b1 * m + (1 - b1) * g
+        v_new = b2 * v + (1 - b2) * jnp.square(g)
+        mhat = m_new / (1 - b1 ** t_new).astype(p.dtype)
+        vhat = v_new / (1 - b2 ** t_new).astype(p.dtype)
+        r = mhat / (jnp.sqrt(vhat) + eps) + wd * p
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        return p - lr.astype(p.dtype) * trust * r, m_new, v_new, t_new

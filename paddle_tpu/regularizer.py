@@ -1,0 +1,17 @@
+"""Regularizers (reference: python/paddle/fluid/regularizer.py)."""
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+        self.coeff = self._coeff
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+        self.coeff = self._coeff
+
+
+L2DecayRegularizer = L2Decay
+L1DecayRegularizer = L1Decay

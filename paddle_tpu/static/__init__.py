@@ -1,0 +1,57 @@
+"""paddle.static — static-graph facade (reference: python/paddle/static/,
+fluid/framework.py Program:4301, executor.py Executor:916).
+
+TPU-native design: a Program is a *recorded op list* (captured by running
+user graph-building code eagerly on placeholder arrays through the shared
+dispatch layer), and Executor.run replays it as one jitted pure function
+of (params, feeds) — XLA is the executor, ParallelExecutor, and memory
+planner in one. optimizer.minimize() under static mode attaches a
+functional train step (grads via jax.grad over the replay + optimizer
+update), the append_backward analog.
+"""
+from .program import (  # noqa: F401
+    Program, program_guard, default_main_program, default_startup_program,
+    Executor, data, append_backward, gradients, name_scope, global_scope,
+    scope_guard, cpu_places, cuda_places, tpu_places, device_guard,
+    save_inference_model, load_inference_model, normalize_program,
+)
+from .input_spec import InputSpec  # noqa: F401
+from .. import nn  # noqa: F401  (paddle.static.nn compat shim below)
+
+
+class _StaticNN:
+    """paddle.static.nn compat namespace (fc, conv2d ... minimal)."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None, **kw):
+        from .. import tensor as pt
+        from ..nn import functional as F
+        from ..tensor import creation
+
+        in_dim = 1
+        for d in x.shape[num_flatten_dims:]:
+            in_dim *= d
+        flat = pt.reshape(x, list(x.shape[:num_flatten_dims]) + [in_dim])
+        w = creation.create_parameter([in_dim, size], "float32")
+        b = creation.create_parameter([size], "float32", is_bias=True)
+        out = F.linear(flat, w, b)
+        if activation:
+            out = getattr(F, activation)(out)
+        return out
+
+    @staticmethod
+    def batch_norm(x, **kw):
+        from ..nn.layers.norm import BatchNorm
+
+        bn = BatchNorm(x.shape[1])
+        return bn(x)
+
+    @staticmethod
+    def conv2d(x, num_filters, filter_size, stride=1, padding=0, **kw):
+        from ..nn.layers.conv import Conv2D
+
+        conv = Conv2D(x.shape[1], num_filters, filter_size, stride, padding)
+        return conv(x)
+
+
+nn_compat = _StaticNN()

@@ -1,0 +1,326 @@
+"""Program / Executor (reference: fluid/framework.py Program:4301,
+executor.py Executor:916 -> C++ executor.cc:166; backward.py
+append_backward).
+
+A Program records (fn, kwargs, input-refs, output-refs) tuples captured
+from the dispatch layer while user graph-building code runs on
+placeholder arrays. Executor.run replays the list as a pure jitted
+function keyed by feed shapes.
+"""
+import contextlib
+import contextvars
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tensor import Tensor, Parameter
+
+_RECORDER = contextvars.ContextVar("program_recorder", default=None)
+
+
+class _OpRecord:
+    __slots__ = ("fn", "kwargs", "in_refs", "out_ids")
+
+    def __init__(self, fn, kwargs, in_refs, out_ids):
+        self.fn = fn
+        self.kwargs = kwargs
+        self.in_refs = in_refs  # list of ("var", id) | ("const", value)
+        self.out_ids = out_ids
+
+
+class Program:
+    def __init__(self):
+        self.ops = []
+        self.placeholders = {}  # name -> Tensor(dummy)
+        self.params = {}  # id -> Parameter
+        self.var_names = {}  # id -> name (fetch support)
+        self.keep = []  # keep recorded tensors alive (ids stable)
+        self.id2tensor = {}
+        self.train_attach = None  # (optimizer, loss_tensor)
+        self.random_seed = 0
+        self._is_start_up = False
+
+    # -- recording callbacks (from dispatch hook) --
+    def record(self, fn, kwargs, args, outs):
+        in_refs = []
+        for a in args:
+            if isinstance(a, Tensor):
+                in_refs.append(("var", id(a)))
+                self.keep.append(a)
+                self.id2tensor[id(a)] = a
+                if isinstance(a, Parameter) or (not a.stop_gradient and a._node is None
+                                                and a.persistable):
+                    self.params[id(a)] = a
+            else:
+                in_refs.append(("const", a))
+        out_ids = []
+        for o in outs:
+            out_ids.append(id(o))
+            self.keep.append(o)
+            self.id2tensor[id(o)] = o
+        self.ops.append(_OpRecord(fn, kwargs, in_refs, out_ids))
+
+    # -- program API compat --
+    def global_block(self):
+        return self
+
+    def all_parameters(self):
+        return list(self.params.values())
+
+    def list_vars(self):
+        return list(self.placeholders.values())
+
+    def clone(self, for_test=False):
+        return self
+
+    def var(self, name):
+        return self.placeholders[name]
+
+    # -- replay --
+    def _replay(self, param_arrays, feed_arrays, placeholder_ids, param_ids):
+        env = {}
+        for pid, arr in zip(placeholder_ids, feed_arrays):
+            env[pid] = arr
+        for pid, arr in zip(param_ids, param_arrays):
+            env[pid] = arr
+        for op in self.ops:
+            ins = []
+            for kind, v in op.in_refs:
+                if kind == "var":
+                    if v in env:
+                        ins.append(env[v])
+                    else:
+                        t = self.id2tensor.get(v)
+                        ins.append(None if t is None else t._value)
+                else:
+                    ins.append(v)
+            outs = op.fn(*ins, **op.kwargs)
+            if not isinstance(outs, (list, tuple)):
+                outs = (outs,)
+            for oid, o in zip(op.out_ids, outs):
+                env[oid] = o
+        return env
+
+
+class Executor:
+    """reference: executor.py:916. Compiles the recorded program with
+    jax.jit per (feed-spec, fetch-set); the XLA executable is the
+    ParallelExecutor analog (sharded feeds parallelize over the mesh)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, use_program_cache=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        if program._is_start_up or not program.ops:
+            return []
+
+        feed_names = sorted(feed.keys())
+        placeholder_ids = [id(program.placeholders[n]) for n in feed_names
+                           if n in program.placeholders]
+        feed_arrays = [jnp.asarray(np.asarray(feed[n])) for n in feed_names
+                       if n in program.placeholders]
+        param_items = sorted(program.params.items())
+        param_ids = [pid for pid, _ in param_items]
+        param_tensors = [p for _, p in param_items]
+        fetch_ids = tuple(id(f) if isinstance(f, Tensor) else f for f in fetch_list)
+        spec = tuple((a.shape, str(a.dtype)) for a in feed_arrays)
+        cache_key = (id(program), tuple(feed_names), fetch_ids, spec,
+                     program.train_attach is not None, len(program.ops))
+
+        compiled = self._cache.get(cache_key)
+        if compiled is None:
+            compiled = self._compile(program, placeholder_ids, param_ids, fetch_ids)
+            self._cache[cache_key] = compiled
+
+        param_arrays = [p._value for p in param_tensors]
+        if program.train_attach is not None:
+            opt = program.train_attach[0]
+            opt_state = getattr(program, "_opt_state", None)
+            if opt_state is None:
+                opt_state = [opt._init_state(a) for a in param_arrays]
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            fetches, new_params, new_state = compiled(param_arrays, feed_arrays,
+                                                      opt_state, lr)
+            for p, a in zip(param_tensors, new_params):
+                p._value = a
+            program._opt_state = new_state
+            if opt._lr_scheduler is not None:
+                pass  # user steps the scheduler explicitly
+        else:
+            fetches = compiled(param_arrays, feed_arrays)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor(f) for f in fetches]
+
+    def _compile(self, program, placeholder_ids, param_ids, fetch_ids):
+        train = program.train_attach is not None
+        if not train:
+            def infer_fn(param_arrays, feed_arrays):
+                with dispatch.trace_mode():
+                    env = program._replay(param_arrays, feed_arrays,
+                                          placeholder_ids, param_ids)
+                return tuple(env[fid] for fid in fetch_ids)
+
+            return jax.jit(infer_fn)
+
+        opt, loss_t = program.train_attach
+        loss_id = id(loss_t)
+
+        def train_fn(param_arrays, feed_arrays, opt_state, lr):
+            def loss_of(params):
+                with dispatch.trace_mode():
+                    env = program._replay(params, feed_arrays, placeholder_ids,
+                                          param_ids)
+                return env[loss_id].sum(), env
+
+            (loss_val, env), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                list(param_arrays))
+            if opt._grad_clip is not None:
+                grads = opt._grad_clip.clip_arrays(grads)
+            hypers = opt._hypers()
+            new_params, new_state = [], []
+            for p, g, st in zip(param_arrays, grads, opt_state):
+                out = type(opt)._update(p, g.astype(p.dtype), lr, *st, **hypers)
+                new_params.append(out[0])
+                new_state.append(tuple(out[1:]))
+            fetches = tuple(env[fid] for fid in fetch_ids)
+            return fetches, new_params, new_state
+
+        return jax.jit(train_fn)
+
+    def close(self):
+        pass
+
+
+_default_main = Program()
+_default_startup = Program()
+_default_startup._is_start_up = True
+
+
+def default_main_program():
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _default_main, _default_startup
+    old_main, old_startup = _default_main, _default_startup
+    _default_main = main_program
+    if startup_program is not None:
+        _default_startup = startup_program
+    token = _RECORDER.set(main_program)
+    dispatch.PROGRAM_HOOK = main_program
+    try:
+        yield
+    finally:
+        _RECORDER.reset(token)
+        dispatch.PROGRAM_HOOK = old_main if _recording_active(old_main) else None
+        _default_main = old_main
+        _default_startup = old_startup
+
+
+def _recording_active(prog):
+    return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """paddle.static.data — placeholder (reference: static/input.py data)."""
+    import numpy as np
+
+    shape = [1 if (s is None or s == -1) else int(s) for s in shape]
+    dummy = Tensor(np.zeros(shape, np.dtype(dtype) if dtype != "bfloat16" else np.float32))
+    dummy.name = name
+    prog = _RECORDER.get() or default_main_program()
+    prog.placeholders[name] = dummy
+    prog.keep.append(dummy)
+    return dummy
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None):
+    """Marks loss for the functional grad pass (reference: backward.py:1009)."""
+    prog = _RECORDER.get() or default_main_program()
+    prog._backward_loss = loss
+    return []
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..core import tape
+
+    return tape.grad(targets, inputs, target_gradients, allow_unused=True)
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+class _Scope:
+    def find_var(self, name):
+        return None
+
+
+_global_scope = _Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    yield
+
+
+def cpu_places(device_count=None):
+    from ..core.place import CPUPlace
+
+    return [CPUPlace()]
+
+
+def cuda_places(device_ids=None):
+    from ..core.place import CUDAPlace
+
+    ids = device_ids or range(1)
+    return [CUDAPlace(i) for i in ids]
+
+
+def tpu_places(device_ids=None):
+    from ..core.place import TPUPlace
+    import jax as _jax
+
+    if device_ids is None:
+        device_ids = range(len(_jax.devices()))
+    return [TPUPlace(i) for i in device_ids]
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    yield
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, program=None):
+    raise NotImplementedError(
+        "static save_inference_model: use paddle.jit.save on a Layer "
+        "(StableHLO export) — the static facade stores no ProgramDesc")
+
+
+def load_inference_model(path_prefix, executor):
+    from ..jit import load as jit_load
+
+    layer = jit_load(path_prefix)
+    return [layer, [], []]
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    return program

@@ -1,0 +1,224 @@
+"""paddle.tensor namespace — aggregates all op modules and patches the
+method surface onto Tensor (the reference's monkey_patch_varbase /
+math_op_patch analog: python/paddle/fluid/dygraph/varbase_patch_methods.py,
+math_op_patch.py).
+"""
+import builtins
+import numpy as np
+
+from ..core.tensor import Tensor, Parameter, to_tensor  # noqa: F401
+from ..core.dispatch import apply_op
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+from .attribute import shape, rank, is_floating_point, is_integer, is_complex  # noqa: F401
+
+from . import (  # noqa: F401
+    creation, math, manipulation, logic, search, linalg, stat, random, attribute,
+)
+
+# --------------------------------------------------------------- indexing
+
+
+def _norm_index(item):
+    if not isinstance(item, tuple):
+        item = (item,)
+    pattern = []
+    tensors = []
+    for it in item:
+        if isinstance(it, Tensor):
+            pattern.append("T")
+            tensors.append(it)
+        elif isinstance(it, builtins.slice):
+            def _c(v):
+                return int(v.numpy()) if isinstance(v, Tensor) else v
+            pattern.append(("slice", _c(it.start), _c(it.stop), _c(it.step)))
+        elif it is Ellipsis:
+            pattern.append("...")
+        elif it is None:
+            pattern.append("None")
+        elif isinstance(it, (int, np.integer)):
+            pattern.append(("int", int(it)))
+        elif isinstance(it, (list, np.ndarray)):
+            pattern.append("T")
+            tensors.append(Tensor(np.asarray(it)))
+        elif isinstance(it, (bool, np.bool_)):
+            pattern.append("None" if it else ("int", 0))  # rare; bool scalar index
+        else:
+            raise TypeError(f"unsupported index {it!r}")
+    return tuple(pattern), tensors
+
+
+def _build_index(pattern, tens):
+    idx = []
+    k = 0
+    for p in pattern:
+        if p == "T":
+            idx.append(tens[k])
+            k += 1
+        elif p == "...":
+            idx.append(Ellipsis)
+        elif p == "None":
+            idx.append(None)
+        elif p[0] == "slice":
+            idx.append(builtins.slice(p[1], p[2], p[3]))
+        else:
+            idx.append(p[1])
+    return tuple(idx)
+
+
+def _tensor_getitem(self, item):
+    pattern, tensors = _norm_index(item)
+    if builtins.any(np.dtype(t.dtype) == np.bool_ for t in tensors):
+        # boolean-mask indexing has data-dependent shape: eager-only numpy path
+        arr = np.asarray(self._value)
+        return Tensor(arr[tuple(np.asarray(t._value) if isinstance(t, Tensor) else t
+                                for t in _build_index_eager(pattern, tensors))])
+
+    def _getitem(x, *tens, pattern):
+        return x[_build_index(pattern, tens)]
+
+    return apply_op("getitem", _getitem, self, *tensors, pattern=pattern)
+
+
+def _build_index_eager(pattern, tensors):
+    idx = []
+    k = 0
+    for p in pattern:
+        if p == "T":
+            idx.append(tensors[k])
+            k += 1
+        elif p == "...":
+            idx.append(Ellipsis)
+        elif p == "None":
+            idx.append(None)
+        elif p[0] == "slice":
+            idx.append(builtins.slice(p[1], p[2], p[3]))
+        else:
+            idx.append(p[1])
+    return idx
+
+
+def _tensor_setitem(self, item, value):
+    pattern, tensors = _norm_index(item)
+    if not isinstance(value, Tensor):
+        value = Tensor(np.asarray(value), dtype=str(np.dtype(self.dtype)) if np.dtype(self.dtype).name != "bfloat16" else "bfloat16")
+
+    def _setitem(x, v, *tens, pattern):
+        import jax.numpy as jnp
+
+        return x.at[_build_index(pattern, tens)].set(v.astype(x.dtype))
+
+    out = apply_op("setitem", _setitem, self, value, *tensors, pattern=pattern)
+    self._assign_result(out)
+
+
+# --------------------------------------------------------------- dunders
+
+Tensor.__getitem__ = _tensor_getitem
+Tensor.__setitem__ = _tensor_setitem
+Tensor.__add__ = lambda s, o: math.add(s, o)
+Tensor.__radd__ = lambda s, o: math.add(o, s)
+Tensor.__sub__ = lambda s, o: math.subtract(s, o)
+Tensor.__rsub__ = lambda s, o: math.subtract(o, s)
+Tensor.__mul__ = lambda s, o: math.multiply(s, o)
+Tensor.__rmul__ = lambda s, o: math.multiply(o, s)
+Tensor.__truediv__ = lambda s, o: math.divide(s, o)
+Tensor.__rtruediv__ = lambda s, o: math.divide(o, s)
+Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+Tensor.__mod__ = lambda s, o: math.mod(s, o)
+Tensor.__pow__ = lambda s, o: math.pow(s, o)
+Tensor.__rpow__ = lambda s, o: math.pow(o, s)
+Tensor.__matmul__ = lambda s, o: math.matmul(s, o)
+Tensor.__neg__ = lambda s: math.scale(s, -1.0)
+Tensor.__abs__ = lambda s: math.abs(s)
+Tensor.__invert__ = lambda s: logic.logical_not(s)
+Tensor.__eq__ = lambda s, o: logic.equal(s, o)
+Tensor.__ne__ = lambda s, o: logic.not_equal(s, o)
+Tensor.__lt__ = lambda s, o: logic.less_than(s, o)
+Tensor.__le__ = lambda s, o: logic.less_equal(s, o)
+Tensor.__gt__ = lambda s, o: logic.greater_than(s, o)
+Tensor.__ge__ = lambda s, o: logic.greater_equal(s, o)
+Tensor.__hash__ = lambda s: id(s)
+
+# --------------------------------------------------------------- methods
+
+_NO_METHOD = {
+    "shape", "rank", "to_tensor", "is_tensor", "broadcast_shape", "meshgrid",
+    "full", "zeros", "ones", "empty", "arange", "linspace", "eye", "full_like",
+    "zeros_like", "ones_like", "empty_like", "tril_indices", "triu_indices",
+    "uniform", "rand", "randn", "randint", "randperm", "normal", "gaussian",
+    "standard_normal", "create_parameter", "assign", "multi_dot", "einsum",
+    "scatter_nd", "broadcast_tensors",
+}
+
+_INPLACE = {
+    "add": "add_", "subtract": "subtract_", "multiply": "multiply_",
+    "clip": "clip_", "scale": "scale_", "ceil": "ceil_", "floor": "floor_",
+    "exp": "exp_", "sqrt": "sqrt_", "reshape": "reshape_", "squeeze": "squeeze_",
+    "unsqueeze": "unsqueeze_", "flatten": "flatten_", "tanh": "tanh_",
+    "cast": "cast_", "round": "round_",
+}
+
+
+def _attach_methods():
+    mods = [math, manipulation, logic, search, linalg, stat, attribute, creation]
+    for mod in mods:
+        for name in dir(mod):
+            if name.startswith("_") or name in _NO_METHOD:
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn) or isinstance(fn, type):
+                continue
+            if not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
+    # in-place variants
+    for base, iname in _INPLACE.items():
+        fn = getattr(math, base, None) or getattr(manipulation, base, None)
+        if fn is None:
+            continue
+
+        def make_inplace(f):
+            def method(self, *a, **kw):
+                out = f(self, *a, **kw)
+                self._assign_result(out)
+                return self
+
+            return method
+
+        if not hasattr(Tensor, iname):
+            setattr(Tensor, iname, make_inplace(fn))
+    # aliases
+    Tensor.astype = lambda self, dtype: manipulation.cast(self, dtype)
+    Tensor.dim = lambda self: self.ndim
+    Tensor.numel = lambda self: self.size
+    Tensor.fill_ = lambda self, v: self._assign_result(creation.full_like(self, v)) or self
+    Tensor.zero_ = lambda self: self.fill_(0)
+    Tensor.uniform_ = _uniform_
+    Tensor.normal_ = _normal_
+
+
+def _uniform_(self, min=-1.0, max=1.0, seed=0):
+    from . import random as rnd
+
+    out = rnd.uniform(tuple(self.shape), str(np.dtype(self.dtype)), min, max)
+    self._assign_result(out)
+    return self
+
+
+def _normal_(self, mean=0.0, std=1.0):
+    from . import random as rnd
+
+    out = rnd.normal(mean, std, tuple(self.shape))
+    self._assign_result(out)
+    return self
+
+
+_attach_methods()

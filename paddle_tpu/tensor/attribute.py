@@ -1,0 +1,28 @@
+"""Attribute ops (reference: python/paddle/tensor/attribute.py)."""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op, in_trace
+from ..core.tensor import Tensor
+from ..core import dtype as dtype_mod
+
+
+def shape(input):
+    """Returns the shape as a 1-D int32 tensor (static under jit)."""
+    return Tensor(np.asarray(input.shape, np.int32))
+
+
+def rank(input):
+    return Tensor(np.asarray(input.ndim, np.int32))
+
+
+def is_floating_point(x):
+    return dtype_mod.is_floating(x.dtype)
+
+
+def is_integer(x):
+    return dtype_mod.is_integer(x.dtype)
+
+
+def is_complex(x):
+    return np.dtype(x.dtype).kind == "c"

@@ -1,0 +1,200 @@
+"""Creation ops (reference: python/paddle/tensor/creation.py)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch, dtype as dtype_mod
+from ..core.tensor import Tensor, to_tensor  # noqa: F401
+
+
+def _norm_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy().reshape(-1))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    out = []
+    for s in shape:
+        if isinstance(s, Tensor):
+            out.append(int(s.numpy()))
+        else:
+            out.append(int(s))
+    return tuple(out)
+
+
+def _norm_dtype(dtype, default_float=True):
+    d = dtype_mod.convert_dtype(dtype)
+    if d is None and default_float:
+        d = np.dtype(dtype_mod.get_default_dtype())
+    return None if d is None else d.name if d.name != "bfloat16" else "bfloat16"
+
+
+def _dt(dtype):
+    """kwargs-safe dtype token -> jnp dtype."""
+    return jnp.bfloat16 if dtype == "bfloat16" else np.dtype(dtype) if dtype else None
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    shape = _norm_shape(shape)
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = "bool"
+        elif isinstance(fill_value, int):
+            dtype = "int64"
+        else:
+            dtype = dtype_mod.get_default_dtype()
+    dtype = _norm_dtype(dtype)
+    return dispatch.apply_op(
+        "full", lambda *, shape, value, dtype: jnp.full(shape, value, _dt(dtype)),
+        shape=shape, value=fill_value, dtype=dtype)
+
+
+def zeros(shape, dtype=None, name=None):
+    return full(shape, 0.0, dtype or dtype_mod.get_default_dtype())
+
+
+def ones(shape, dtype=None, name=None):
+    return full(shape, 1.0, dtype or dtype_mod.get_default_dtype())
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def _like_dtype(x, dtype):
+    return _norm_dtype(dtype) if dtype is not None else str(np.dtype(x.dtype)) if np.dtype(x.dtype).name != "bfloat16" else "bfloat16"
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    dtype = None if dtype is None else _norm_dtype(dtype)
+    return dispatch.apply_op(
+        "full_like",
+        lambda x, *, value, dtype: jnp.full_like(x, value, dtype=_dt(dtype)),
+        x, value=fill_value, dtype=dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return full_like(x, 0, dtype)
+
+
+def ones_like(x, dtype=None, name=None):
+    return full_like(x, 1, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise NotImplementedError("tensor bounds for arange: pass python numbers")
+    if dtype is None:
+        dtype = ("int64" if all(isinstance(v, (int, np.integer)) for v in (start, end, step))
+                 else dtype_mod.get_default_dtype())
+    dtype = _norm_dtype(dtype)
+    return dispatch.apply_op(
+        "arange", lambda *, start, end, step, dtype: jnp.arange(start, end, step, _dt(dtype)),
+        start=start, end=end, step=step, dtype=dtype)
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    dtype = _norm_dtype(dtype)
+    if isinstance(start, Tensor):
+        start = start.item()
+    if isinstance(stop, Tensor):
+        stop = stop.item()
+    if isinstance(num, Tensor):
+        num = int(num.item())
+    return dispatch.apply_op(
+        "linspace", lambda *, start, stop, num, dtype: jnp.linspace(start, stop, num, dtype=_dt(dtype)),
+        start=start, stop=stop, num=num, dtype=dtype)
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    dtype = _norm_dtype(dtype)
+    return dispatch.apply_op(
+        "eye", lambda *, n, m, dtype: jnp.eye(n, m, dtype=_dt(dtype)),
+        n=int(num_rows), m=None if num_columns is None else int(num_columns), dtype=dtype)
+
+
+def assign(x, output=None):
+    src = x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+    out = dispatch.apply_op("assign", lambda v: jnp.asarray(v) + 0, src)
+    if output is not None:
+        output._assign_result(out)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def _diag(x, *, offset, padding_value):
+        if x.ndim == 1:
+            d = jnp.diag(x, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(d.shape[0], dtype=bool)
+                mask = jnp.roll(mask, offset, axis=1) if offset else mask
+                d = jnp.where(mask, d, padding_value)
+            return d
+        return jnp.diagonal(x, offset=offset)
+
+    return dispatch.apply_op("diag", _diag, x, offset=offset, padding_value=padding_value)
+
+
+def diagflat(x, offset=0, name=None):
+    return dispatch.apply_op(
+        "diagflat", lambda x, *, offset: jnp.diagflat(x, k=offset), x, offset=offset)
+
+
+def tril(x, diagonal=0, name=None):
+    return dispatch.apply_op("tril", lambda x, *, k: jnp.tril(x, k), x, k=diagonal)
+
+
+def triu(x, diagonal=0, name=None):
+    return dispatch.apply_op("triu", lambda x, *, k: jnp.triu(x, k), x, k=diagonal)
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    outs = dispatch.apply_op(
+        "meshgrid", lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")), *args)
+    return list(outs)
+
+
+def numel(x, name=None):
+    return dispatch.apply_op("numel", lambda x: jnp.asarray(x.size, jnp.int64), x)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(np.stack([r, c]).astype(np.dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(np.stack([r, c]).astype(np.dtype(dtype)))
+
+
+def complex(real, imag, name=None):
+    return dispatch.apply_op("complex", lambda r, i: jax.lax.complex(r, i), real, imag)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False, default_initializer=None):
+    """paddle.create_parameter — standalone trainable parameter."""
+    from ..core.tensor import Parameter
+    from ..nn import initializer as init_mod
+
+    if default_initializer is None:
+        default_initializer = (init_mod.Constant(0.0) if is_bias
+                               else init_mod.XavierNormal())
+    value = default_initializer._generate(_norm_shape(shape), dtype_mod.convert_dtype(dtype))
+    p = Parameter(value, name=name)
+    return p

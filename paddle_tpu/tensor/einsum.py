@@ -1,0 +1,10 @@
+"""einsum (reference: python/paddle/tensor/einsum.py) — direct jnp mapping;
+XLA lowers contractions onto the MXU."""
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+
+
+def einsum(equation, *operands):
+    return apply_op(
+        "einsum", lambda *xs, eq: jnp.einsum(eq, *xs), *operands, eq=equation)

@@ -1,0 +1,185 @@
+"""Linear algebra (reference: python/paddle/tensor/linalg.py; operators/
+matmul_v2_op.cc, norm ops, svd/qr/cholesky ops)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op
+from .math import matmul, bmm, dot, t  # noqa: F401 (re-export, matches paddle layout)
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+
+    def _norm(x, *, p, axis, keepdim):
+        if p == "fro" or (p == 2 and axis is None):
+            return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+        return jnp.power(
+            jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim), 1.0 / p)
+
+    return apply_op("p_norm", _norm, x, p=p, axis=ax, keepdim=bool(keepdim))
+
+
+def cond(x, p=None, name=None):
+    return apply_op("cond", lambda x, *, p: jnp.linalg.cond(x, p=p), x, p=p)
+
+
+def cholesky(x, upper=False, name=None):
+    def _chol(x, *, upper):
+        L = jnp.linalg.cholesky(x)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+
+    return apply_op("cholesky", _chol, x, upper=bool(upper))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    return apply_op(
+        "cholesky_solve",
+        lambda x, y, *, upper: jax.scipy.linalg.cho_solve((y, not upper), x),
+        x, y, upper=bool(upper))
+
+
+def inverse(x, name=None):
+    return apply_op("inverse", lambda x: jnp.linalg.inv(x), x)
+
+
+inv = inverse
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op(
+        "pinv", lambda x, *, rcond, hermitian: jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian),
+        x, rcond=float(rcond), hermitian=bool(hermitian))
+
+
+def det(x, name=None):
+    return apply_op("det", lambda x: jnp.linalg.det(x), x)
+
+
+def slogdet(x, name=None):
+    def _slogdet(x):
+        sign, logabs = jnp.linalg.slogdet(x)
+        return jnp.stack([sign, logabs])
+
+    return apply_op("slogdet", _slogdet, x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply_op(
+        "matrix_rank",
+        lambda x, *, tol, hermitian: jnp.linalg.matrix_rank(x, rtol=tol).astype(jnp.int64),
+        x, tol=tol, hermitian=bool(hermitian))
+
+
+def matrix_power(x, n, name=None):
+    return apply_op("matrix_power", lambda x, *, n: jnp.linalg.matrix_power(x, n), x, n=int(n))
+
+
+def qr(x, mode="reduced", name=None):
+    return apply_op("qr", lambda x, *, mode: tuple(jnp.linalg.qr(x, mode=mode)), x, mode=mode)
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply_op(
+        "svd", lambda x, *, fm: tuple(jnp.linalg.svd(x, full_matrices=fm)),
+        x, fm=bool(full_matrices))
+
+
+def eig(x, name=None):
+    return apply_op("eig", lambda x: tuple(jnp.linalg.eig(x)), x)
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply_op("eigh", lambda x, *, uplo: tuple(jnp.linalg.eigh(x, UPLO=uplo)), x, uplo=UPLO)
+
+
+def eigvals(x, name=None):
+    return apply_op("eigvals", lambda x: jnp.linalg.eigvals(x), x)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op("eigvalsh", lambda x, *, uplo: jnp.linalg.eigvalsh(x, UPLO=uplo), x, uplo=UPLO)
+
+
+def solve(x, y, name=None):
+    return apply_op("solve", lambda x, y: jnp.linalg.solve(x, y), x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return apply_op(
+        "triangular_solve",
+        lambda x, y, *, upper, trans, unit: jax.scipy.linalg.solve_triangular(
+            x, y, lower=not upper, trans=1 if trans else 0, unit_diagonal=unit),
+        x, y, upper=bool(upper), trans=bool(transpose), unit=bool(unitriangular))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def _lstsq(x, y, *, rcond):
+        sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+        return sol, res, rank.astype(jnp.int64), sv
+
+    return apply_op("lstsq", _lstsq, x, y, rcond=rcond)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def _lu(x):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(x)
+        return lu_mat, (piv + 1).astype(jnp.int32)
+
+    outs = apply_op("lu", _lu, x)
+    if get_infos:
+        from .creation import zeros
+
+        return outs[0], outs[1], zeros([1], "int32")
+    return outs
+
+
+def multi_dot(x, name=None):
+    return apply_op("multi_dot", lambda *xs: jnp.linalg.multi_dot(xs), *x)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    def _hist(x, *, bins, min, max):
+        rng = None if (min == 0 and max == 0) else (min, max)
+        h, _ = jnp.histogram(x.reshape(-1), bins=bins, range=rng)
+        return h.astype(jnp.int64)
+
+    return apply_op("histogram", _hist, input, bins=int(bins), min=min, max=max)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    def _bincount(x, w, *, minlength, length):
+        return jnp.bincount(x.reshape(-1), weights=None if w is None else w.reshape(-1),
+                            minlength=minlength, length=length)
+
+    length = int(np.asarray(x._value).max()) + 1 if x.size else 0
+    length = max(length, minlength)
+    return apply_op("bincount", _bincount, x, weights, minlength=int(minlength), length=length)
+
+
+def cross(x, y, axis=9, name=None):
+    def _cross(x, y, *, axis):
+        ax = axis
+        if ax == 9:
+            ax = next((i for i, s in enumerate(x.shape) if s == 3), -1)
+        return jnp.cross(x, y, axis=ax)
+
+    return apply_op("cross", _cross, x, y, axis=int(axis))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op("corrcoef", lambda x, *, rowvar: jnp.corrcoef(x, rowvar=rowvar), x, rowvar=bool(rowvar))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply_op(
+        "cov",
+        lambda x, fw, aw, *, rowvar, ddof: jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                                                   fweights=fw, aweights=aw),
+        x, fweights, aweights, rowvar=bool(rowvar), ddof=bool(ddof))

@@ -1,0 +1,63 @@
+"""Comparison/logical ops (reference: python/paddle/tensor/logic.py;
+operators/controlflow/compare_op.cc, logical_op.cc)."""
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+
+def _binary(op_name, fn):
+    def api(x, y, name=None):
+        return apply_op(op_name, fn, x, y)
+
+    api.__name__ = op_name
+    return api
+
+
+equal = _binary("equal", lambda x, y: jnp.equal(x, y))
+not_equal = _binary("not_equal", lambda x, y: jnp.not_equal(x, y))
+greater_than = _binary("greater_than", lambda x, y: jnp.greater(x, y))
+greater_equal = _binary("greater_equal", lambda x, y: jnp.greater_equal(x, y))
+less_than = _binary("less_than", lambda x, y: jnp.less(x, y))
+less_equal = _binary("less_equal", lambda x, y: jnp.less_equal(x, y))
+logical_and = _binary("logical_and", lambda x, y: jnp.logical_and(x, y))
+logical_or = _binary("logical_or", lambda x, y: jnp.logical_or(x, y))
+logical_xor = _binary("logical_xor", lambda x, y: jnp.logical_xor(x, y))
+bitwise_and = _binary("bitwise_and", lambda x, y: jnp.bitwise_and(x, y))
+bitwise_or = _binary("bitwise_or", lambda x, y: jnp.bitwise_or(x, y))
+bitwise_xor = _binary("bitwise_xor", lambda x, y: jnp.bitwise_xor(x, y))
+
+
+def logical_not(x, name=None):
+    return apply_op("logical_not", lambda x: jnp.logical_not(x), x)
+
+
+def bitwise_not(x, name=None):
+    return apply_op("bitwise_not", lambda x: jnp.bitwise_not(x), x)
+
+
+def equal_all(x, y, name=None):
+    return apply_op("equal_all", lambda x, y: jnp.array_equal(x, y), x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(
+        "allclose",
+        lambda x, y, *, rtol, atol, equal_nan: jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        x, y, rtol=float(rtol), atol=float(atol), equal_nan=bool(equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(
+        "isclose",
+        lambda x, y, *, rtol, atol, equal_nan: jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        x, y, rtol=float(rtol), atol=float(atol), equal_nan=bool(equal_nan))
+
+
+def is_empty(x, name=None):
+    return Tensor(np.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,348 @@
+"""Math ops (reference: python/paddle/tensor/math.py; kernels
+paddle/fluid/operators/elementwise/, reduce_ops/, activation_op.cc).
+
+Every op is a pure jnp/lax function registered through core.dispatch, so
+it serves eager mode (cached jit per shape) and traced mode (inlines into
+the surrounding XLA program) from one definition.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+
+def _axis_norm(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = axis.numpy().reshape(-1)
+        return tuple(int(v) for v in a) if a.size > 1 else int(a)
+    if isinstance(axis, (list, tuple)):
+        if len(axis) == 0:
+            return None
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# ----------------------------------------------------------------- binary
+
+
+def _binary(op_name, fn):
+    def api(x, y, name=None):
+        return apply_op(op_name, fn, x, y)
+
+    api.__name__ = op_name
+    return api
+
+
+add = _binary("add", lambda x, y: jnp.add(x, y))
+subtract = _binary("subtract", lambda x, y: jnp.subtract(x, y))
+multiply = _binary("multiply", lambda x, y: jnp.multiply(x, y))
+mod = _binary("mod", lambda x, y: jnp.mod(x, y))
+remainder = mod
+floor_mod = mod
+floor_divide = _binary("floor_divide", lambda x, y: jnp.floor_divide(x, y))
+maximum = _binary("maximum", lambda x, y: jnp.maximum(x, y))
+minimum = _binary("minimum", lambda x, y: jnp.minimum(x, y))
+fmax = _binary("fmax", lambda x, y: jnp.fmax(x, y))
+fmin = _binary("fmin", lambda x, y: jnp.fmin(x, y))
+logaddexp = _binary("logaddexp", lambda x, y: jnp.logaddexp(x, y))
+inner = _binary("inner", lambda x, y: jnp.inner(x, y))
+outer = _binary("outer", lambda x, y: jnp.outer(x, y))
+kron = _binary("kron", lambda x, y: jnp.kron(x, y))
+gcd = _binary("gcd", lambda x, y: jnp.gcd(x, y))
+lcm = _binary("lcm", lambda x, y: jnp.lcm(x, y))
+heaviside = _binary("heaviside", lambda x, y: jnp.heaviside(x, y))
+nextafter = _binary("nextafter", lambda x, y: jnp.nextafter(x, y))
+copysign = _binary("copysign", lambda x, y: jnp.copysign(x, y))
+atan2 = _binary("atan2", lambda x, y: jnp.arctan2(x, y))
+
+
+def divide(x, y, name=None):
+    def _div(x, y):
+        xf = x.astype(jnp.float32) if jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer) else x
+        yf = y.astype(jnp.float32) if jnp.issubdtype(jnp.asarray(y).dtype, jnp.integer) else y
+        return jnp.true_divide(xf, yf)
+
+    return apply_op("divide", _div, x, y)
+
+
+def pow(x, y, name=None):
+    return apply_op("pow", lambda x, y: jnp.power(x, y), x, y)
+
+
+def multiplex(inputs, index, name=None):
+    def _mux(index, *xs):
+        stacked = jnp.stack(xs, axis=0)
+        idx = index.reshape(-1).astype(jnp.int32)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx, rows]
+
+    return apply_op("multiplex", _mux, index, *inputs)
+
+
+# ----------------------------------------------------------------- unary
+
+
+def _unary(op_name, fn):
+    def api(x, name=None):
+        return apply_op(op_name, fn, x)
+
+    api.__name__ = op_name
+    return api
+
+
+abs = _unary("abs", lambda x: jnp.abs(x))
+ceil = _unary("ceil", lambda x: jnp.ceil(x))
+floor = _unary("floor", lambda x: jnp.floor(x))
+round = _unary("round", lambda x: jnp.round(x))
+trunc = _unary("trunc", lambda x: jnp.trunc(x))
+frac = _unary("frac", lambda x: x - jnp.trunc(x))
+exp = _unary("exp", lambda x: jnp.exp(x))
+expm1 = _unary("expm1", lambda x: jnp.expm1(x))
+log = _unary("log", lambda x: jnp.log(x))
+log2 = _unary("log2", lambda x: jnp.log2(x))
+log10 = _unary("log10", lambda x: jnp.log10(x))
+log1p = _unary("log1p", lambda x: jnp.log1p(x))
+sqrt = _unary("sqrt", lambda x: jnp.sqrt(x))
+rsqrt = _unary("rsqrt", lambda x: jax.lax.rsqrt(x))
+square = _unary("square", lambda x: jnp.square(x))
+sign = _unary("sign", lambda x: jnp.sign(x))
+sin = _unary("sin", lambda x: jnp.sin(x))
+cos = _unary("cos", lambda x: jnp.cos(x))
+tan = _unary("tan", lambda x: jnp.tan(x))
+asin = _unary("asin", lambda x: jnp.arcsin(x))
+acos = _unary("acos", lambda x: jnp.arccos(x))
+atan = _unary("atan", lambda x: jnp.arctan(x))
+sinh = _unary("sinh", lambda x: jnp.sinh(x))
+cosh = _unary("cosh", lambda x: jnp.cosh(x))
+tanh = _unary("tanh", lambda x: jnp.tanh(x))
+asinh = _unary("asinh", lambda x: jnp.arcsinh(x))
+acosh = _unary("acosh", lambda x: jnp.arccosh(x))
+atanh = _unary("atanh", lambda x: jnp.arctanh(x))
+erf = _unary("erf", lambda x: jax.lax.erf(x))
+erfinv = _unary("erfinv", lambda x: jax.lax.erf_inv(x))
+reciprocal = _unary("reciprocal", lambda x: 1.0 / x)
+neg = _unary("neg", lambda x: jnp.negative(x))
+digamma = _unary("digamma", lambda x: jax.lax.digamma(x))
+lgamma = _unary("lgamma", lambda x: jax.lax.lgamma(x))
+angle = _unary("angle", lambda x: jnp.angle(x))
+conj = _unary("conj", lambda x: jnp.conj(x))
+real = _unary("real", lambda x: jnp.real(x))
+imag = _unary("imag", lambda x: jnp.imag(x))
+i0 = _unary("i0", lambda x: jnp.i0(x))
+deg2rad = _unary("deg2rad", lambda x: jnp.deg2rad(x))
+rad2deg = _unary("rad2deg", lambda x: jnp.rad2deg(x))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """reference: operators/scale_op.cc semantics."""
+
+    def _scale(x, s, b, *, after):
+        return x * s + b if after else (x + b) * s
+
+    out = apply_op("scale", _scale, x, scale, bias, after=bool(bias_after_scale))
+    if act is not None:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def increment(x, value=1.0, name=None):
+    out = apply_op("increment", lambda x, v: x + v, x, value)
+    x._assign_result(out)
+    return x
+
+
+def clip(x, min=None, max=None, name=None):
+    if isinstance(min, Tensor):
+        min = min.item()
+    if isinstance(max, Tensor):
+        max = max.item()
+    return apply_op("clip", lambda x, *, lo, hi: jnp.clip(x, lo, hi), x, lo=min, hi=max)
+
+
+def lerp(x, y, weight, name=None):
+    return apply_op("lerp", lambda x, y, w: x + w * (y - x), x, y, weight)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op("stanh", lambda x, *, a, b: b * jnp.tanh(a * x), x, a=scale_a, b=scale_b)
+
+
+def rsqrt_(x):
+    out = rsqrt(x)
+    x._assign_result(out)
+    return x
+
+
+# ----------------------------------------------------------------- reductions
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..core.dtype import convert_dtype
+
+    d = convert_dtype(dtype)
+    dname = None if d is None else d.name
+
+    def _sum(x, *, axis, keepdim, dtype):
+        dt = None
+        if dtype is not None:
+            dt = jnp.bfloat16 if dtype == "bfloat16" else np.dtype(dtype)
+        elif jnp.issubdtype(x.dtype, jnp.bool_):
+            dt = jnp.int64
+        return jnp.sum(x, axis=axis, keepdims=keepdim, dtype=dt)
+
+    return apply_op("sum", _sum, x, axis=_axis_norm(axis), keepdim=bool(keepdim), dtype=dname)
+
+
+def _reduction(op_name, fn):
+    def api(x, axis=None, keepdim=False, name=None):
+        return apply_op(op_name, fn, x, axis=_axis_norm(axis), keepdim=bool(keepdim))
+
+    api.__name__ = op_name
+    return api
+
+
+mean = _reduction("mean", lambda x, *, axis, keepdim: jnp.mean(x, axis=axis, keepdims=keepdim))
+max = _reduction("max", lambda x, *, axis, keepdim: jnp.max(x, axis=axis, keepdims=keepdim))
+min = _reduction("min", lambda x, *, axis, keepdim: jnp.min(x, axis=axis, keepdims=keepdim))
+prod = _reduction("prod", lambda x, *, axis, keepdim: jnp.prod(x, axis=axis, keepdims=keepdim))
+amax = max
+amin = min
+all = _reduction("all", lambda x, *, axis, keepdim: jnp.all(x, axis=axis, keepdims=keepdim))
+any = _reduction("any", lambda x, *, axis, keepdim: jnp.any(x, axis=axis, keepdims=keepdim))
+logsumexp = _reduction(
+    "logsumexp", lambda x, *, axis, keepdim: jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+)
+nansum = _reduction("nansum", lambda x, *, axis, keepdim: jnp.nansum(x, axis=axis, keepdims=keepdim))
+nanmean = _reduction("nanmean", lambda x, *, axis, keepdim: jnp.nanmean(x, axis=axis, keepdims=keepdim))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        "count_nonzero",
+        lambda x, *, axis, keepdim: jnp.count_nonzero(x, axis=axis, keepdims=keepdim).astype(jnp.int64),
+        x, axis=_axis_norm(axis), keepdim=bool(keepdim))
+
+
+# ----------------------------------------------------------------- cumulative
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def _cumsum(x, *, axis):
+        if axis is None:
+            return jnp.cumsum(x.reshape(-1))
+        return jnp.cumsum(x, axis=axis)
+
+    return apply_op("cumsum", _cumsum, x, axis=_axis_norm(axis))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return apply_op("cumprod", lambda x, *, axis: jnp.cumprod(x, axis=axis), x, axis=_axis_norm(dim))
+
+
+def _cumm_extreme(x, *, axis, mode):
+    """values + indices of the running max/min (paddle cummax/cummin)."""
+    idx0 = jax.lax.broadcasted_iota(jnp.int64, x.shape, axis)
+
+    def combine(a, b):
+        av, ai = a
+        bv, bi = b
+        if mode == "max":
+            take_b = bv >= av
+        else:
+            take_b = bv <= av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    v, i = jax.lax.associative_scan(combine, (x, idx0), axis=axis)
+    return v, i
+
+
+def cummax(x, axis=None, name=None):
+    return apply_op("cummax", _cumm_extreme, x, axis=_axis_norm(axis) or 0, mode="max")
+
+
+def cummin(x, axis=None, name=None):
+    return apply_op("cummin", _cumm_extreme, x, axis=_axis_norm(axis) or 0, mode="min")
+
+
+# ----------------------------------------------------------------- linalg-lite (paddle.* level)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """reference: operators/matmul_v2_op.cc. Maps straight onto the MXU."""
+
+    def _matmul(x, y, *, tx, ty):
+        if tx:
+            x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+        if ty:
+            y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+        return jnp.matmul(x, y)
+
+    return apply_op("matmul", _matmul, x, y, tx=bool(transpose_x), ty=bool(transpose_y))
+
+
+mm = matmul
+
+
+def dot(x, y, name=None):
+    def _dot(x, y):
+        return jnp.sum(x * y, axis=-1)
+
+    return apply_op("dot", _dot, x, y)
+
+
+def bmm(x, y, name=None):
+    return apply_op("bmm", lambda x, y: jnp.matmul(x, y), x, y)
+
+
+def t(x, name=None):
+    return apply_op("t", lambda x: x.T, x)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op(
+        "addmm", lambda i, x, y, *, alpha, beta: beta * i + alpha * (x @ y),
+        input, x, y, alpha=alpha, beta=beta)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return apply_op(
+        "diff",
+        lambda x, prepend, append, *, n, axis: jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append),
+        x, prepend, append, n=n, axis=axis)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(
+        "trace",
+        lambda x, *, offset, a1, a2: jnp.trace(x, offset=offset, axis1=a1, axis2=a2),
+        x, offset=offset, a1=axis1, a2=axis2)
+
+
+def isfinite(x, name=None):
+    return apply_op("isfinite", lambda x: jnp.isfinite(x), x)
+
+
+def isinf(x, name=None):
+    return apply_op("isinf", lambda x: jnp.isinf(x), x)
+
+
+def isnan(x, name=None):
+    return apply_op("isnan", lambda x: jnp.isnan(x), x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op(
+        "nan_to_num",
+        lambda x, *, nan, posinf, neginf: jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf),
+        x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))

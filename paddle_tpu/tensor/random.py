@@ -1,0 +1,122 @@
+"""Random ops (reference: python/paddle/tensor/random.py; operators/
+uniform_random_op.cc, gaussian_random_op.cc, randint_op.cc ...).
+
+Each op takes a fresh PRNG key from core.random (stateful generator in
+eager mode; traced key via rng_guard inside jit), so random ops stay pure
+jax functions — the idiomatic TPU design (no device-side mutable RNG
+state outside the op).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import random as random_core
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from .creation import _norm_shape, _norm_dtype, _dt
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    shape = _norm_shape(shape)
+    dtype = _norm_dtype(dtype)
+    return apply_op(
+        "uniform",
+        lambda key, *, shape, dtype, lo, hi: jax.random.uniform(
+            key, shape, _dt(dtype), lo, hi),
+        random_core.next_key(), shape=shape, dtype=dtype, lo=float(min), hi=float(max))
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        def _normal_t(key, mean, std):
+            return mean + std * jax.random.normal(key, jnp.broadcast_shapes(
+                jnp.shape(mean), jnp.shape(std)), jnp.result_type(float))
+
+        return apply_op("gaussian", _normal_t, random_core.next_key(), mean, std)
+    shape = _norm_shape(shape if shape is not None else [1])
+    dtype = _norm_dtype(None)
+    return apply_op(
+        "gaussian",
+        lambda key, *, shape, dtype, mean, std: mean + std * jax.random.normal(key, shape, _dt(dtype)),
+        random_core.next_key(), shape=shape, dtype=dtype, mean=float(mean), std=float(std))
+
+
+gaussian = normal
+
+
+def randn(shape, dtype=None, name=None):
+    shape = _norm_shape(shape)
+    dtype = _norm_dtype(dtype)
+    return apply_op(
+        "randn",
+        lambda key, *, shape, dtype: jax.random.normal(key, shape, _dt(dtype)),
+        random_core.next_key(), shape=shape, dtype=dtype)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    shape = _norm_shape(shape)
+    dtype = _norm_dtype(dtype, default_float=False) or "int64"
+    return apply_op(
+        "randint",
+        lambda key, *, shape, dtype, lo, hi: jax.random.randint(key, shape, lo, hi, _dt(dtype)),
+        random_core.next_key(), shape=shape, dtype=dtype, lo=int(low), hi=int(high))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, tuple(x.shape), dtype or str(np.dtype(x.dtype)))
+
+
+def randperm(n, dtype="int64", name=None):
+    dtype = _norm_dtype(dtype, default_float=False) or "int64"
+    return apply_op(
+        "randperm",
+        lambda key, *, n, dtype: jax.random.permutation(key, n).astype(_dt(dtype)),
+        random_core.next_key(), n=int(n), dtype=dtype)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    def _multinomial(key, x, *, n, replacement):
+        logits = jnp.log(jnp.clip(x, 1e-30, None))
+        if replacement:
+            return jax.random.categorical(key, logits, axis=-1,
+                                          shape=(n,) + x.shape[:-1]).T.astype(jnp.int64)
+        # without replacement: Gumbel top-k trick
+        g = jax.random.gumbel(key, x.shape, x.dtype)
+        _, idx = jax.lax.top_k(logits + g, n)
+        return idx.astype(jnp.int64)
+
+    return apply_op("multinomial", _multinomial, random_core.next_key(), x,
+                    n=int(num_samples), replacement=bool(replacement))
+
+
+def bernoulli(x, name=None):
+    return apply_op(
+        "bernoulli",
+        lambda key, x: jax.random.bernoulli(key, x).astype(x.dtype),
+        random_core.next_key(), x)
+
+
+def poisson(x, name=None):
+    return apply_op(
+        "poisson",
+        lambda key, x: jax.random.poisson(key, x).astype(x.dtype),
+        random_core.next_key(), x)
+
+
+def exponential_(x, lam=1.0, name=None):
+    out = apply_op(
+        "exponential",
+        lambda key, x, *, lam: jax.random.exponential(key, x.shape, x.dtype) / lam,
+        random_core.next_key(), x, lam=float(lam))
+    x._assign_result(out)
+    return x

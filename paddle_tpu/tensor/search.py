@@ -1,0 +1,139 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py;
+operators/arg_min_max_op_base.h, top_k_v2_op.cc, argsort_op.cc,
+where_op.cc, nonzero 'where_index')."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op, in_trace
+from ..core.tensor import Tensor
+from ..core import errors
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def _argmax(x, *, axis, keepdim):
+        if axis is None:
+            return jnp.argmax(x.reshape(-1)).astype(jnp.int64)
+        out = jnp.argmax(x, axis=axis).astype(jnp.int64)
+        return jnp.expand_dims(out, axis) if keepdim else out
+
+    return apply_op("argmax", _argmax, x,
+                    axis=None if axis is None else int(axis), keepdim=bool(keepdim))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def _argmin(x, *, axis, keepdim):
+        if axis is None:
+            return jnp.argmin(x.reshape(-1)).astype(jnp.int64)
+        out = jnp.argmin(x, axis=axis).astype(jnp.int64)
+        return jnp.expand_dims(out, axis) if keepdim else out
+
+    return apply_op("argmin", _argmin, x,
+                    axis=None if axis is None else int(axis), keepdim=bool(keepdim))
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def _argsort(x, *, axis, descending):
+        idx = jnp.argsort(-x if descending else x, axis=axis, stable=True)
+        return idx.astype(jnp.int64)
+
+    return apply_op("argsort", _argsort, x, axis=int(axis), descending=bool(descending))
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def _sort(x, *, axis, descending):
+        s = jnp.sort(x, axis=axis)
+        return jnp.flip(s, axis=axis) if descending else s
+
+    return apply_op("sort", _sort, x, axis=int(axis), descending=bool(descending))
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.numpy())
+
+    def _topk2(x, *, k, axis, largest):
+        ax = x.ndim - 1 if axis is None else axis % x.ndim
+        xm = jnp.moveaxis(x, ax, -1)
+        if largest:
+            v, i = jax.lax.top_k(xm, k)
+        else:
+            v, i = jax.lax.top_k(-xm, k)
+            v = -v
+        return (jnp.moveaxis(v, -1, ax), jnp.moveaxis(i.astype(jnp.int64), -1, ax))
+
+    return apply_op("topk", _topk2, x, k=int(k),
+                    axis=None if axis is None else int(axis), largest=bool(largest))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    return apply_op("where", lambda c, x, y: jnp.where(c, x, y), condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    if in_trace():
+        raise errors.UnimplementedError(
+            "nonzero has a data-dependent output shape; not traceable under jit")
+    arr = np.asarray(x._value)
+    idxs = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(i.astype(np.int64).reshape(-1, 1)) for i in idxs)
+    return Tensor(np.stack(idxs, axis=1).astype(np.int64))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    return apply_op(
+        "searchsorted",
+        lambda s, v, *, side, dtype32: jnp.searchsorted(s, v, side=side).astype(
+            jnp.int32 if dtype32 else jnp.int64),
+        sorted_sequence, values, side="right" if right else "left", dtype32=bool(out_int32))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+
+    return _ms(x, mask)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def _kth(x, *, k, axis, keepdim):
+        s = jnp.sort(x, axis=axis)
+        i = jnp.argsort(x, axis=axis, stable=True).astype(jnp.int64)
+        v = jnp.take(s, k - 1, axis=axis)
+        ix = jnp.take(i, k - 1, axis=axis)
+        if keepdim:
+            v = jnp.expand_dims(v, axis)
+            ix = jnp.expand_dims(ix, axis)
+        return v, ix
+
+    return apply_op("kthvalue", _kth, x, k=int(k), axis=int(axis), keepdim=bool(keepdim))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    if in_trace():
+        raise errors.UnimplementedError("mode not traceable yet")
+    arr = np.asarray(x._value)
+    ax = axis % arr.ndim
+    moved = np.moveaxis(arr, ax, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], arr.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for r in range(flat.shape[0]):
+        uniq, counts = np.unique(flat[r], return_counts=True)
+        best = uniq[np.argmax(counts)]
+        vals[r] = best
+        idxs[r] = np.where(flat[r] == best)[0][-1]
+    shape = moved.shape[:-1]
+    v = vals.reshape(shape)
+    i = idxs.reshape(shape)
+    if keepdim:
+        v = np.expand_dims(v, ax)
+        i = np.expand_dims(i, ax)
+    return Tensor(v), Tensor(i)
+

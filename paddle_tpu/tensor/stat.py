@@ -1,0 +1,55 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from .math import _axis_norm, mean  # noqa: F401
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op(
+        "std",
+        lambda x, *, axis, ddof, keepdim: jnp.std(x, axis=axis, ddof=ddof, keepdims=keepdim),
+        x, axis=_axis_norm(axis), ddof=1 if unbiased else 0, keepdim=bool(keepdim))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op(
+        "var",
+        lambda x, *, axis, ddof, keepdim: jnp.var(x, axis=axis, ddof=ddof, keepdims=keepdim),
+        x, axis=_axis_norm(axis), ddof=1 if unbiased else 0, keepdim=bool(keepdim))
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        "median",
+        lambda x, *, axis, keepdim: jnp.median(x, axis=axis, keepdims=keepdim),
+        x, axis=_axis_norm(axis), keepdim=bool(keepdim))
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        "nanmedian",
+        lambda x, *, axis, keepdim: jnp.nanmedian(x, axis=axis, keepdims=keepdim),
+        x, axis=_axis_norm(axis), keepdim=bool(keepdim))
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    if isinstance(q, (list, tuple)):
+        q = tuple(float(v) for v in q)
+    else:
+        q = float(q)
+    return apply_op(
+        "quantile",
+        lambda x, *, q, axis, keepdim: jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim),
+        x, q=q, axis=_axis_norm(axis), keepdim=bool(keepdim))
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    if isinstance(q, (list, tuple)):
+        q = tuple(float(v) for v in q)
+    else:
+        q = float(q)
+    return apply_op(
+        "nanquantile",
+        lambda x, *, q, axis, keepdim: jnp.nanquantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim),
+        x, q=q, axis=_axis_norm(axis), keepdim=bool(keepdim))

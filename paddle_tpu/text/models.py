@@ -1,0 +1,297 @@
+"""Transformer model family for the training benchmarks.
+
+BERT matches the PaddleNLP/ERNIE architecture the north-star names
+(BASELINE.json config 3); GPT/Llama are the stretch decoder family
+(config 5). Built entirely on paddle_tpu.nn layers so they exercise the
+framework's own transformer stack (nn/layers/transformer.py ->
+Pallas flash attention on TPU).
+"""
+import math
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, vocab_size, hidden_size, max_position_embeddings=512,
+                 type_vocab_size=2, hidden_dropout_prob=0.1):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(vocab_size, hidden_size)
+        self.position_embeddings = nn.Embedding(max_position_embeddings, hidden_size)
+        self.token_type_embeddings = nn.Embedding(type_vocab_size, hidden_size)
+        self.layer_norm = nn.LayerNorm(hidden_size)
+        self.dropout = nn.Dropout(hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        from .. import tensor as pt
+
+        if position_ids is None:
+            position_ids = pt.arange(input_ids.shape[1], dtype="int64")
+            position_ids = pt.expand(pt.unsqueeze(position_ids, 0),
+                                     [input_ids.shape[0], input_ids.shape[1]])
+        if token_type_ids is None:
+            token_type_ids = pt.zeros_like(input_ids)
+        emb = (self.word_embeddings(input_ids) +
+               self.position_embeddings(position_ids) +
+               self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertPooler(nn.Layer):
+    def __init__(self, hidden_size):
+        super().__init__()
+        self.dense = nn.Linear(hidden_size, hidden_size)
+
+    def forward(self, hidden_states):
+        return F.tanh(self.dense(hidden_states[:, 0]))
+
+
+class BertModel(nn.Layer):
+    """BERT-base default config (12L, 768H, 12 heads)."""
+
+    def __init__(self, vocab_size=30522, hidden_size=768, num_hidden_layers=12,
+                 num_attention_heads=12, intermediate_size=3072,
+                 hidden_act="gelu", hidden_dropout_prob=0.1,
+                 attention_probs_dropout_prob=0.1, max_position_embeddings=512,
+                 type_vocab_size=2, initializer_range=0.02, pad_token_id=0,
+                 with_pool=True):
+        super().__init__()
+        self.embeddings = BertEmbeddings(vocab_size, hidden_size,
+                                         max_position_embeddings, type_vocab_size,
+                                         hidden_dropout_prob)
+        enc_layer = nn.TransformerEncoderLayer(
+            hidden_size, num_attention_heads, intermediate_size,
+            dropout=hidden_dropout_prob, activation=hidden_act,
+            attn_dropout=attention_probs_dropout_prob, act_dropout=0.0)
+        self.encoder = nn.TransformerEncoder(enc_layer, num_hidden_layers)
+        self.pooler = BertPooler(hidden_size) if with_pool else None
+        self.hidden_size = hidden_size
+        self.vocab_size = vocab_size
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        emb = self.embeddings(input_ids, token_type_ids, position_ids)
+        seq = self.encoder(emb, attention_mask)
+        if self.pooler is not None:
+            return seq, self.pooler(seq)
+        return seq
+
+
+class BertLMPredictionHead(nn.Layer):
+    def __init__(self, hidden_size, vocab_size, embedding_weights=None):
+        super().__init__()
+        self.transform = nn.Linear(hidden_size, hidden_size)
+        self.layer_norm = nn.LayerNorm(hidden_size)
+        self.decoder_weight = embedding_weights  # tied
+        self.decoder_bias = self.create_parameter([vocab_size], is_bias=True)
+
+    def forward(self, hidden_states):
+        from .. import tensor as pt
+
+        x = self.layer_norm(F.gelu(self.transform(hidden_states)))
+        logits = pt.matmul(x, self.decoder_weight, transpose_y=True) + \
+            self.decoder_bias
+        return logits
+
+
+class BertForPretraining(nn.Layer):
+    """MLM + NSP heads (the ERNIE/BERT pretraining benchmark model)."""
+
+    def __init__(self, bert=None, **bert_kwargs):
+        super().__init__()
+        self.bert = bert or BertModel(**bert_kwargs)
+        self.cls = BertLMPredictionHead(
+            self.bert.hidden_size, self.bert.vocab_size,
+            self.bert.embeddings.word_embeddings.weight)
+        self.nsp = nn.Linear(self.bert.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids,
+                                attention_mask=attention_mask)
+        return self.cls(seq), self.nsp(pooled)
+
+
+def bert_pretraining_loss(mlm_logits, nsp_logits, mlm_labels, nsp_labels,
+                          ignore_index=-100):
+    """Masked-LM + NSP loss (pure Tensor ops; reference PaddleNLP
+    BertPretrainingCriterion semantics)."""
+    mlm_loss = F.cross_entropy(mlm_logits, mlm_labels, ignore_index=ignore_index,
+                               reduction="mean", axis=-1)
+    nsp_loss = F.cross_entropy(nsp_logits, nsp_labels, reduction="mean")
+    return mlm_loss + nsp_loss
+
+
+class GPTDecoderLayer(nn.Layer):
+    def __init__(self, hidden_size, num_heads, intermediate_size, dropout=0.0,
+                 act="gelu"):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(hidden_size)
+        self.attn = nn.MultiHeadAttention(hidden_size, num_heads, dropout)
+        self.ln2 = nn.LayerNorm(hidden_size)
+        self.fc1 = nn.Linear(hidden_size, intermediate_size)
+        self.fc2 = nn.Linear(intermediate_size, hidden_size)
+        self.act = act
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x, mask=None):
+        h = self.ln1(x)
+        x = x + self.attn(h, h, h, mask)
+        h = self.ln2(x)
+        x = x + self.dropout(self.fc2(getattr(F, self.act)(self.fc1(h))))
+        return x
+
+
+class GPTModel(nn.Layer):
+    """Pre-norm causal decoder (GPT-2 style)."""
+
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=None, max_seq_len=1024,
+                 dropout=0.0):
+        super().__init__()
+        intermediate_size = intermediate_size or 4 * hidden_size
+        self.wte = nn.Embedding(vocab_size, hidden_size)
+        self.wpe = nn.Embedding(max_seq_len, hidden_size)
+        self.blocks = nn.LayerList([
+            GPTDecoderLayer(hidden_size, num_heads, intermediate_size, dropout)
+            for _ in range(num_layers)])
+        self.ln_f = nn.LayerNorm(hidden_size)
+        self.max_seq_len = max_seq_len
+
+    def forward(self, input_ids):
+        from .. import tensor as pt
+
+        b, t = input_ids.shape
+        pos = pt.expand(pt.unsqueeze(pt.arange(t, dtype="int64"), 0), [b, t])
+        x = self.wte(input_ids) + self.wpe(pos)
+        mask = nn.Transformer.generate_square_subsequent_mask(t)
+        for blk in self.blocks:
+            x = blk(x, mask)
+        x = self.ln_f(x)
+        return pt.matmul(x, self.wte.weight, transpose_y=True)
+
+
+class RMSNorm(nn.Layer):
+    def __init__(self, hidden_size, eps=1e-6):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [hidden_size], default_initializer=nn.initializer.Constant(1.0))
+        self.eps = eps
+
+    def forward(self, x):
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.dispatch import apply_op
+
+        def _rms(x, w, *, eps):
+            var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+            return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * w
+
+        return apply_op("rms_norm", _rms, x, self.weight, eps=self.eps)
+
+
+def _rope(x, base=10000.0):
+    import jax.numpy as jnp
+
+    # x: [B, H, T, D]
+    d = x.shape[-1]
+    t = x.shape[-2]
+    inv = 1.0 / (base ** (jnp.arange(0, d, 2) / d))
+    freqs = jnp.outer(jnp.arange(t), inv)
+    cos = jnp.cos(freqs)[None, None]
+    sin = jnp.sin(freqs)[None, None]
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    return jnp.stack([out1, out2], axis=-1).reshape(x.shape)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, hidden_size, num_heads, num_kv_heads=None):
+        super().__init__()
+        self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads or num_heads
+        self.head_dim = hidden_size // num_heads
+        self.q_proj = nn.Linear(hidden_size, hidden_size, bias_attr=False)
+        self.k_proj = nn.Linear(hidden_size, self.num_kv_heads * self.head_dim,
+                                bias_attr=False)
+        self.v_proj = nn.Linear(hidden_size, self.num_kv_heads * self.head_dim,
+                                bias_attr=False)
+        self.o_proj = nn.Linear(hidden_size, hidden_size, bias_attr=False)
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        from ..core.dispatch import apply_op
+
+        def _attn(x, wq, wk, wv, wo, *, nh, nkv, hd):
+            b, t, _ = x.shape
+            q = (x @ wq).reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
+            k = (x @ wk).reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
+            v = (x @ wv).reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
+            q = _rope(q)
+            k = _rope(k)
+            if nkv != nh:
+                rep = nh // nkv
+                k = jnp.repeat(k, rep, axis=1)
+                v = jnp.repeat(v, rep, axis=1)
+            logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
+            causal = jnp.tril(jnp.ones((t, t), bool))
+            logits = jnp.where(causal, logits, jnp.finfo(logits.dtype).min)
+            probs = jnp.asarray(jnp.exp(logits - jnp.max(logits, -1, keepdims=True)))
+            probs = probs / jnp.sum(probs, -1, keepdims=True)
+            out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+            return out.transpose(0, 2, 1, 3).reshape(b, t, nh * hd) @ wo
+
+        return apply_op("llama_attention", _attn, x, self.q_proj.weight,
+                        self.k_proj.weight, self.v_proj.weight, self.o_proj.weight,
+                        nh=self.num_heads, nkv=self.num_kv_heads, hd=self.head_dim)
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, hidden_size, intermediate_size):
+        super().__init__()
+        self.gate_proj = nn.Linear(hidden_size, intermediate_size, bias_attr=False)
+        self.up_proj = nn.Linear(hidden_size, intermediate_size, bias_attr=False)
+        self.down_proj = nn.Linear(intermediate_size, hidden_size, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, hidden_size, num_heads, intermediate_size, num_kv_heads=None):
+        super().__init__()
+        self.input_layernorm = RMSNorm(hidden_size)
+        self.self_attn = LlamaAttention(hidden_size, num_heads, num_kv_heads)
+        self.post_attention_layernorm = RMSNorm(hidden_size)
+        self.mlp = LlamaMLP(hidden_size, intermediate_size)
+
+    def forward(self, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(nn.Layer):
+    """Llama-2 architecture (7B default dims; shrink via kwargs for tests)."""
+
+    def __init__(self, vocab_size=32000, hidden_size=4096, num_layers=32,
+                 num_heads=32, intermediate_size=11008, num_kv_heads=None,
+                 max_seq_len=4096):
+        super().__init__()
+        self.embed_tokens = nn.Embedding(vocab_size, hidden_size)
+        self.layers = nn.LayerList([
+            LlamaDecoderLayer(hidden_size, num_heads, intermediate_size,
+                              num_kv_heads)
+            for _ in range(num_layers)])
+        self.norm = RMSNorm(hidden_size)
+        self.lm_head = nn.Linear(hidden_size, vocab_size, bias_attr=False)
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x)
+        return self.lm_head(self.norm(x))

@@ -1,0 +1,73 @@
+"""Ragged-sequence helpers — the LoD replacement.
+
+The reference threads ragged batches through LoDTensor
+(paddle/fluid/framework/lod_tensor.h:109) and sequence_* ops. XLA wants
+static shapes, so the TPU-native representation is (dense padded array,
+lengths) with mask-aware reductions; these helpers convert between the
+two and implement the sequence-op semantics the API surface needs.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+
+def pad_sequences(seqs, maxlen=None, dtype="int64", pad_value=0):
+    """list-of-1D-arrays -> (padded [B, L], lengths [B])."""
+    lengths = np.asarray([len(s) for s in seqs], np.int64)
+    maxlen = maxlen or int(lengths.max())
+    out = np.full((len(seqs), maxlen), pad_value, np.dtype(dtype))
+    for i, s in enumerate(seqs):
+        n = min(len(s), maxlen)
+        out[i, :n] = np.asarray(s[:n])
+    return Tensor(out), Tensor(np.minimum(lengths, maxlen))
+
+
+def length_mask(lengths, maxlen, dtype="float32"):
+    def _mask(lengths, *, maxlen, dtype):
+        r = jnp.arange(maxlen)
+        return (r[None, :] < lengths[:, None]).astype(np.dtype(dtype))
+
+    return apply_op("length_mask", _mask, lengths, maxlen=int(maxlen), dtype=str(dtype))
+
+
+def sequence_pool(x, lengths, pool_type="sum"):
+    """Masked pooling over the time axis (reference: sequence_pool_op)."""
+
+    def _pool(x, lengths, *, pool_type):
+        L = x.shape[1]
+        mask = (jnp.arange(L)[None, :] < lengths[:, None])
+        m = mask[..., None].astype(x.dtype) if x.ndim == 3 else mask.astype(x.dtype)
+        if pool_type == "sum":
+            return jnp.sum(x * m, axis=1)
+        if pool_type == "average" or pool_type == "mean":
+            denom = jnp.maximum(lengths.astype(x.dtype), 1)
+            denom = denom[:, None] if x.ndim == 3 else denom
+            return jnp.sum(x * m, axis=1) / denom
+        if pool_type == "max":
+            neg = jnp.where(m > 0, x, jnp.finfo(x.dtype).min)
+            return jnp.max(neg, axis=1)
+        if pool_type == "sqrt":
+            denom = jnp.sqrt(jnp.maximum(lengths.astype(x.dtype), 1))
+            denom = denom[:, None] if x.ndim == 3 else denom
+            return jnp.sum(x * m, axis=1) / denom
+        if pool_type == "last":
+            idx = jnp.clip(lengths - 1, 0, L - 1)
+            return x[jnp.arange(x.shape[0]), idx]
+        if pool_type == "first":
+            return x[:, 0]
+        raise ValueError(pool_type)
+
+    return apply_op("sequence_pool", _pool, x, lengths, pool_type=pool_type)
+
+
+def attention_mask_from_lengths(lengths, maxlen):
+    """[B] lengths -> additive [B, 1, 1, L] mask (0 keep / -inf drop)."""
+
+    def _am(lengths, *, maxlen):
+        keep = jnp.arange(maxlen)[None, :] < lengths[:, None]
+        m = jnp.where(keep, 0.0, jnp.float32(jnp.finfo(jnp.float32).min))
+        return m[:, None, None, :]
+
+    return apply_op("attention_mask_from_lengths", _am, lengths, maxlen=int(maxlen))

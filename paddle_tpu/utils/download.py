@@ -1,0 +1,32 @@
+"""Download helper (reference: python/paddle/utils/download.py). This image
+has zero network egress, so get_path_from_url only resolves local paths /
+caches and raises otherwise."""
+import hashlib
+import os
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/hapi/weights")
+
+
+def md5check(fullname, md5sum=None):
+    if md5sum is None:
+        return True
+    md5 = hashlib.md5()
+    with open(fullname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            md5.update(chunk)
+    return md5.hexdigest() == md5sum
+
+
+def get_path_from_url(url, root_dir=WEIGHTS_HOME, md5sum=None, check_exist=True):
+    fname = os.path.join(root_dir, url.split("/")[-1])
+    if os.path.exists(fname) and md5check(fname, md5sum):
+        return fname
+    if os.path.exists(url):
+        return url
+    raise RuntimeError(
+        f"cannot download {url}: this environment has no network egress; "
+        f"place the file at {fname} manually")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

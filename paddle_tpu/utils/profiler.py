@@ -1,0 +1,49 @@
+"""Profiler (reference: paddle/fluid/platform/profiler.* RecordEvent +
+DeviceTracer/CUPTI; python fluid/profiler.py).
+
+TPU-native: jax.profiler produces XPlane traces viewable in TensorBoard /
+Perfetto (the chrome-trace analog); RecordEvent spans map to
+jax.profiler.TraceAnnotation (host) which the XLA runtime correlates with
+device timelines — CUPTI's role is played by the TPU runtime itself.
+"""
+import contextlib
+
+import jax
+
+
+class RecordEvent:
+    """RAII span (reference: profiler.h:127)."""
+
+    def __init__(self, name):
+        self._ann = jax.profiler.TraceAnnotation(name)
+
+    def __enter__(self):
+        self._ann.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._ann.__exit__(*exc)
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
+             tracer_option="Default"):
+    """paddle.utils.profiler.profiler context (fluid/profiler.py analog)."""
+    jax.profiler.start_trace(profile_path)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def start_profiler(state="All", tracer_option="Default",
+                   profile_path="/tmp/profile"):
+    jax.profiler.start_trace(profile_path)
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    jax.profiler.stop_trace()
+
+
+def cuda_profiler(*args, **kwargs):
+    raise NotImplementedError("use jax.profiler traces on TPU")

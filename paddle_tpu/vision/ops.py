@@ -1,0 +1,81 @@
+"""Vision ops (reference: operators/detection/: yolo_box, roi_align, nms...).
+Round-1 subset: roi_align, nms, yolo helpers later."""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op, in_trace
+from ..core.tensor import Tensor
+from ..core import errors
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None,
+        top_k=None):
+    """Host-side NMS (data-dependent output shape — eager only)."""
+    if in_trace():
+        raise errors.UnimplementedError("nms is not traceable (dynamic shape)")
+    b = np.asarray(boxes._value)
+    s = np.asarray(scores._value) if scores is not None else np.ones(len(b))
+    order = np.argsort(-s)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        xx1 = np.maximum(b[i, 0], b[order[1:], 0])
+        yy1 = np.maximum(b[i, 1], b[order[1:], 1])
+        xx2 = np.minimum(b[i, 2], b[order[1:], 2])
+        yy2 = np.minimum(b[i, 3], b[order[1:], 3])
+        inter = np.clip(xx2 - xx1, 0, None) * np.clip(yy2 - yy1, 0, None)
+        area_i = (b[i, 2] - b[i, 0]) * (b[i, 3] - b[i, 1])
+        area_o = (b[order[1:], 2] - b[order[1:], 0]) * \
+                 (b[order[1:], 3] - b[order[1:], 1])
+        iou = inter / (area_i + area_o - inter + 1e-12)
+        order = order[1:][iou <= iou_threshold]
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(keep)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """Bilinear ROI-Align (reference: operators/detection/roi_align_op.cc)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+
+    def _roi_align(x, boxes, *, out_hw, scale, aligned):
+        oh, ow = out_hw
+        n, c, h, w = x.shape
+
+        def one_roi(box):
+            off = 0.5 if aligned else 0.0
+            x1 = box[0] * scale - off
+            y1 = box[1] * scale - off
+            x2 = box[2] * scale - off
+            y2 = box[3] * scale - off
+            rw = jnp.maximum(x2 - x1, 1.0)
+            rh = jnp.maximum(y2 - y1, 1.0)
+            ys = y1 + (jnp.arange(oh) + 0.5) * rh / oh
+            xs = x1 + (jnp.arange(ow) + 0.5) * rw / ow
+            y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+            y1i = jnp.clip(y0 + 1, 0, h - 1)
+            x1i = jnp.clip(x0 + 1, 0, w - 1)
+            wy = jnp.clip(ys - y0, 0, 1)
+            wx = jnp.clip(xs - x0, 0, 1)
+            img = x[0]
+            va = img[:, y0][:, :, x0]
+            vb = img[:, y0][:, :, x1i]
+            vc = img[:, y1i][:, :, x0]
+            vd = img[:, y1i][:, :, x1i]
+            top = va * (1 - wx)[None, None, :] + vb * wx[None, None, :]
+            bot = vc * (1 - wx)[None, None, :] + vd * wx[None, None, :]
+            return top * (1 - wy)[None, :, None] + bot * wy[None, :, None]
+
+        import jax
+
+        return jax.vmap(one_roi)(boxes)
+
+    return apply_op("roi_align", _roi_align, x, boxes, out_hw=tuple(output_size),
+                    scale=float(spatial_scale), aligned=bool(aligned))

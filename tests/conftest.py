@@ -1,0 +1,30 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE any jax
+backend initialisation (SURVEY §4: tests run CPU-backed; multi-chip tests
+use the forced host-platform device count).
+
+The axon sitecustomize force-selects jax_platforms='axon,cpu' at
+interpreter start; we override back to cpu here — conftest imports before
+any test module touches jax, and no backend is initialised yet.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    np.random.seed(0)
+    paddle.seed(0)
+    yield

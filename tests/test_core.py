@@ -1,0 +1,245 @@
+"""Core runtime tests: Tensor, autograd tape, dispatch, flags, places.
+Modeled on the reference's op_test.py numeric-gradient rigor
+(reference: python/paddle/fluid/tests/unittests/op_test.py:255)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(f, x, eps=1e-3):
+    """Finite-difference gradient (op_test.py get_numeric_gradient:110 analog)."""
+    x = np.asarray(x, np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy()
+        xp[idx] += eps
+        xm = x.copy()
+        xm[idx] -= eps
+        g[idx] = (f(xp.astype(np.float32)) - f(xm.astype(np.float32))) / (2 * eps)
+        it.iternext()
+    return g
+
+
+class TestTensor:
+    def test_creation(self):
+        t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert t.shape == [2, 2]
+        assert str(np.dtype(t.dtype)) == "float32"
+        np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+    def test_dtype_conversion(self):
+        t = paddle.to_tensor([1, 2, 3])
+        assert np.dtype(t.dtype) == np.int64
+        f = t.astype("float32")
+        assert np.dtype(f.dtype) == np.float32
+
+    def test_item_and_scalar(self):
+        t = paddle.to_tensor(3.5)
+        assert abs(t.item() - 3.5) < 1e-6
+        assert float(t) == pytest.approx(3.5)
+
+    def test_operators(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        b = paddle.to_tensor([3.0, 4.0])
+        np.testing.assert_allclose((a + b).numpy(), [4, 6])
+        np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+        np.testing.assert_allclose((a * b).numpy(), [3, 8])
+        np.testing.assert_allclose((b / a).numpy(), [3, 2])
+        np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+        np.testing.assert_allclose((-a).numpy(), [-1, -2])
+        np.testing.assert_allclose((2.0 - a).numpy(), [1, 0])
+        assert bool((a < b).all().numpy())
+
+    def test_getitem_setitem(self):
+        t = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        np.testing.assert_allclose(t[1].numpy(), [4, 5, 6, 7])
+        np.testing.assert_allclose(t[0:2, 1].numpy(), [1, 5])
+        t[0, 0] = 99.0
+        assert t.numpy()[0, 0] == 99.0
+        idx = paddle.to_tensor([0, 2])
+        np.testing.assert_allclose(t[idx].numpy()[1], [8, 9, 10, 11])
+
+    def test_bool_mask_index(self):
+        t = paddle.to_tensor(np.arange(6, dtype=np.float32))
+        mask = t > 2
+        sel = t[mask]
+        np.testing.assert_allclose(sel.numpy(), [3, 4, 5])
+
+    def test_clone_detach(self):
+        t = paddle.to_tensor([1.0], stop_gradient=False)
+        d = t.detach()
+        assert d.stop_gradient
+        c = t.clone()
+        assert not c.stop_gradient
+
+    def test_set_value(self):
+        t = paddle.to_tensor([1.0, 2.0])
+        t.set_value(np.array([5.0, 6.0], np.float32))
+        np.testing.assert_allclose(t.numpy(), [5, 6])
+
+
+class TestAutograd:
+    def test_simple_backward(self):
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+    def test_chain(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x
+        z = y * x  # x^3, dz/dx = 3x^2 = 12
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0], rtol=1e-5)
+
+    def test_fan_out_accumulation(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        z = y + y  # d/dx = 4
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+    def test_grad_accumulates_across_backwards(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).backward()
+        (x * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_no_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_stop_gradient_blocks(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * 2).detach()
+        z = y * 3
+        assert z.stop_gradient
+
+    def test_matmul_grad_vs_numeric(self):
+        rng = np.random.RandomState(0)
+        a_np = rng.rand(3, 4).astype(np.float32)
+        b_np = rng.rand(4, 2).astype(np.float32)
+        a = paddle.to_tensor(a_np, stop_gradient=False)
+        b = paddle.to_tensor(b_np, stop_gradient=False)
+        loss = paddle.matmul(a, b).sum()
+        loss.backward()
+        ng = numeric_grad(lambda av: float((av @ b_np).sum()), a_np)
+        np.testing.assert_allclose(a.grad.numpy(), ng, rtol=1e-2, atol=1e-3)
+
+    def test_softmax_ce_grad_vs_numeric(self):
+        rng = np.random.RandomState(1)
+        x_np = rng.rand(4, 5).astype(np.float32)
+        lbl = np.array([0, 1, 2, 3])
+        import paddle_tpu.nn.functional as F
+
+        x = paddle.to_tensor(x_np, stop_gradient=False)
+        loss = F.cross_entropy(x, paddle.to_tensor(lbl))
+        loss.backward()
+
+        def f(xv):
+            e = np.exp(xv - xv.max(-1, keepdims=True))
+            p = e / e.sum(-1, keepdims=True)
+            return float(-np.mean(np.log(p[np.arange(4), lbl])))
+
+        ng = numeric_grad(f, x_np)
+        np.testing.assert_allclose(x.grad.numpy(), ng, rtol=1e-2, atol=1e-3)
+
+    def test_grad_api(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = x * x
+        (gx,) = paddle.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [6.0])
+        assert x.grad is None  # paddle.grad must not pollute .grad
+
+    def test_register_hook(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        seen = []
+
+        def hook(g):
+            seen.append(g.numpy().copy())
+            return g * 2
+
+        x.register_hook(hook)
+        (x * 3).backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+    def test_multi_output_op_grad(self):
+        x = paddle.to_tensor(np.array([3.0, 1.0, 2.0], np.float32),
+                             stop_gradient=False)
+        vals, idx = paddle.topk(x, 2)
+        vals.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])
+
+    def test_backward_nonscalar_requires_grad_tensors(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 2
+        with pytest.raises(Exception):
+            y.backward()
+        y2 = x * 2
+        y2.backward(paddle.to_tensor([1.0, 1.0]))
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, grad):
+                (x,) = ctx.saved_tensor()
+                return grad * 3 * x * x
+
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = Cube.apply(x)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+class TestFlagsPlaces:
+    def test_flags(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        assert paddle.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_nan_check(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            x = paddle.to_tensor([1.0, 0.0])
+            with pytest.raises(Exception):
+                _ = paddle.log(x * 0 - 1)  # log(-1) = nan
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_places(self):
+        p = paddle.CPUPlace()
+        assert p.jax_device().platform == "cpu"
+        paddle.set_device("cpu")
+        assert paddle.get_device() == "cpu"
+
+    def test_seed_reproducible(self):
+        paddle.seed(42)
+        a = paddle.randn([4]).numpy()
+        paddle.seed(42)
+        b = paddle.randn([4]).numpy()
+        np.testing.assert_allclose(a, b)
+
+
+class TestDefaultDtype:
+    def test_default(self):
+        assert paddle.get_default_dtype() == "float32"
+        t = paddle.to_tensor([1.5])
+        assert np.dtype(t.dtype) == np.float32

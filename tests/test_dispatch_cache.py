@@ -1,0 +1,78 @@
+"""Dispatch/vjp cache stability — eager steps must not recompile
+(the core.ops.* fast-path property; guards the fn_key design)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.core import dispatch, tape
+
+
+def test_forward_cache_stable_across_steps():
+    x = paddle.to_tensor(np.random.rand(4, 8).astype(np.float32))
+    lin = nn.Linear(8, 8)
+    lin(x)
+    n0 = len(dispatch._FWD_CACHE)
+    for _ in range(5):
+        lin(x)
+    assert len(dispatch._FWD_CACHE) == n0, "forward jit cache grew across identical calls"
+
+
+def test_vjp_cache_stable_across_steps():
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    opt = optimizer.SGD(0.1, parameters=model.parameters())
+    x = paddle.to_tensor(np.random.rand(2, 4).astype(np.float32))
+
+    def step():
+        loss = model(x).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+    step()
+    n0 = len(tape._VJP_CACHE)
+    for _ in range(5):
+        step()
+    assert len(tape._VJP_CACHE) == n0, "backward vjp cache grew across identical steps"
+
+
+def test_distinct_ops_do_not_collide():
+    """add/multiply lambdas share qualname '<lambda>' — the op name must
+    disambiguate them (regression: fan-out grad doubled)."""
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    ((x * x) * x).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])
+    x2 = paddle.to_tensor([1.0], stop_gradient=False)
+    y2 = x2 * 2
+    (y2 + y2).backward()
+    np.testing.assert_allclose(x2.grad.numpy(), [4.0])
+    a = paddle.to_tensor([3.0], stop_gradient=False)
+    (a - a * 2).backward()
+    np.testing.assert_allclose(a.grad.numpy(), [-1.0])
+
+
+def test_review_fixes():
+    import paddle_tpu.nn.functional as F
+
+    # dropout downscale_in_infer scales at eval
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    out = F.dropout(x, 0.25, training=False, mode="downscale_in_infer")
+    np.testing.assert_allclose(out.numpy(), 0.75)
+    # cummax returns (values, indices)
+    v, i = paddle.tensor.math.cummax(paddle.to_tensor(
+        np.array([1.0, 3.0, 2.0], np.float32)), axis=0)
+    np.testing.assert_allclose(v.numpy(), [1, 3, 3])
+    np.testing.assert_allclose(i.numpy(), [0, 1, 1])
+    # fill_diagonal honors offset
+    m = paddle.to_tensor(np.zeros((3, 4), np.float32))
+    paddle.tensor.manipulation.fill_diagonal(m, 5.0, offset=1)
+    np.testing.assert_allclose(m.numpy()[0], [0, 5, 0, 0])
+    # interpolate validates args
+    import pytest
+
+    with pytest.raises(ValueError):
+        F.interpolate(x.reshape([1, 1, 4, 4]), size=(2, 2), scale_factor=2.0)
+    # nll_loss with [N, C, d] layout
+    logp = paddle.to_tensor(np.log(np.full((2, 3, 4), 1 / 3, np.float32)))
+    lbl = paddle.to_tensor(np.zeros((2, 4), np.int64))
+    loss = F.nll_loss(logp, lbl)
+    np.testing.assert_allclose(loss.numpy(), np.log(3), rtol=1e-5)

@@ -1,0 +1,304 @@
+"""nn layer + functional tests (reference: unittests test_layers.py et al)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def t(x, **kw):
+    return paddle.to_tensor(np.asarray(x), **kw)
+
+
+class TestFunctional:
+    def test_activations(self):
+        x = np.array([-1.0, 0.0, 2.0], np.float32)
+        np.testing.assert_allclose(F.relu(t(x)).numpy(), [0, 0, 2])
+        np.testing.assert_allclose(F.sigmoid(t(x)).numpy(), 1 / (1 + np.exp(-x)),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(F.leaky_relu(t(x), 0.1).numpy(),
+                                   np.where(x > 0, x, 0.1 * x), rtol=1e-6)
+        g = F.gelu(t(x)).numpy()
+        assert g[0] < 0 and abs(g[1]) < 1e-6 and g[2] > 1.9
+
+    def test_softmax_logsoftmax(self):
+        x = np.random.RandomState(0).rand(2, 5).astype(np.float32)
+        s = F.softmax(t(x), axis=-1).numpy()
+        np.testing.assert_allclose(s.sum(-1), [1, 1], rtol=1e-5)
+        np.testing.assert_allclose(F.log_softmax(t(x)).numpy(), np.log(s),
+                                   rtol=1e-5)
+
+    def test_linear(self):
+        x = np.random.rand(4, 3).astype(np.float32)
+        w = np.random.rand(3, 5).astype(np.float32)
+        b = np.random.rand(5).astype(np.float32)
+        r = F.linear(t(x), t(w), t(b))
+        np.testing.assert_allclose(r.numpy(), x @ w + b, rtol=1e-5)
+
+    def test_conv2d_identity_kernel(self):
+        x = np.random.rand(1, 1, 5, 5).astype(np.float32)
+        w = np.zeros((1, 1, 3, 3), np.float32)
+        w[0, 0, 1, 1] = 1.0  # identity
+        r = F.conv2d(t(x), t(w), padding=1)
+        np.testing.assert_allclose(r.numpy(), x, rtol=1e-5)
+
+    def test_conv2d_vs_manual(self):
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 3, 6, 6).astype(np.float32)
+        w = rng.rand(4, 3, 3, 3).astype(np.float32)
+        r = F.conv2d(t(x), t(w), stride=1, padding=0).numpy()
+        # manual correlation at one spatial position
+        manual = (x[0, :, 0:3, 0:3] * w[1]).sum()
+        np.testing.assert_allclose(r[0, 1, 0, 0], manual, rtol=1e-4)
+        assert r.shape == (2, 4, 4, 4)
+
+    def test_conv2d_groups(self):
+        x = np.random.rand(1, 4, 5, 5).astype(np.float32)
+        w = np.random.rand(4, 1, 3, 3).astype(np.float32)  # depthwise
+        r = F.conv2d(t(x), t(w), padding=1, groups=4)
+        assert r.shape == [1, 4, 5, 5]
+
+    def test_pools(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        mp = F.max_pool2d(t(x), 2, 2).numpy()
+        np.testing.assert_allclose(mp[0, 0], [[5, 7], [13, 15]])
+        ap = F.avg_pool2d(t(x), 2, 2).numpy()
+        np.testing.assert_allclose(ap[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+        aap = F.adaptive_avg_pool2d(t(x), 1).numpy()
+        np.testing.assert_allclose(aap[0, 0, 0, 0], x.mean())
+
+    def test_batch_norm_train_and_stats(self):
+        np.random.seed(0)
+        bn = nn.BatchNorm2D(3)
+        x = t(np.random.rand(4, 3, 2, 2).astype(np.float32))
+        bn.train()
+        y = bn(x)
+        out = y.numpy()
+        assert abs(out.mean()) < 1e-5
+        assert abs(out.std() - 1) < 0.05
+        # running stats moved toward batch stats
+        assert not np.allclose(bn._mean.numpy(), 0)
+        bn.eval()
+        y2 = bn(x)
+        assert y2.shape == list(x.shape)
+
+    def test_layer_norm(self):
+        x = np.random.rand(2, 5).astype(np.float32)
+        ln = nn.LayerNorm(5)
+        y = ln(t(x)).numpy()
+        np.testing.assert_allclose(y.mean(-1), 0, atol=1e-5)
+        np.testing.assert_allclose(y.std(-1), 1, atol=0.15)
+
+    def test_group_norm(self):
+        x = np.random.rand(2, 4, 3, 3).astype(np.float32)
+        gn = nn.GroupNorm(2, 4)
+        y = gn(t(x))
+        assert y.shape == [2, 4, 3, 3]
+
+    def test_dropout_modes(self):
+        x = t(np.ones((100, 100), np.float32))
+        y = F.dropout(x, 0.5, training=True)
+        arr = y.numpy()
+        frac_zero = (arr == 0).mean()
+        assert 0.4 < frac_zero < 0.6
+        kept = arr[arr != 0]
+        np.testing.assert_allclose(kept, 2.0, rtol=1e-5)  # upscale_in_train
+        y_eval = F.dropout(x, 0.5, training=False)
+        np.testing.assert_allclose(y_eval.numpy(), 1.0)
+
+    def test_losses(self):
+        logits = np.random.RandomState(0).rand(4, 3).astype(np.float32)
+        labels = np.array([0, 1, 2, 0])
+        l = F.cross_entropy(t(logits), t(labels)).numpy()
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.mean(np.log(p[np.arange(4), labels]))
+        np.testing.assert_allclose(l, ref, rtol=1e-5)
+        np.testing.assert_allclose(
+            F.mse_loss(t(logits), t(logits)).numpy(), 0, atol=1e-7)
+        np.testing.assert_allclose(
+            F.l1_loss(t(np.array([1.0])), t(np.array([3.0]))).numpy(), 2.0)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.rand(4, 3).astype(np.float32)
+        labels = np.array([0, -100, 2, -100])
+        l = F.cross_entropy(t(logits), t(labels), ignore_index=-100).numpy()
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.mean(np.log(p[[0, 2], [0, 2]]))
+        np.testing.assert_allclose(l, ref, rtol=1e-5)
+
+    def test_embedding(self):
+        w = np.random.rand(10, 4).astype(np.float32)
+        ids = np.array([[1, 3], [5, 7]])
+        r = F.embedding(t(ids), t(w))
+        np.testing.assert_allclose(r.numpy(), w[ids], rtol=1e-6)
+
+    def test_one_hot_interpolate(self):
+        oh = F.one_hot(t(np.array([0, 2])), 3).numpy()
+        np.testing.assert_allclose(oh, [[1, 0, 0], [0, 0, 1]])
+        x = np.random.rand(1, 1, 2, 2).astype(np.float32)
+        up = F.interpolate(t(x), size=(4, 4), mode="nearest")
+        assert up.shape == [1, 1, 4, 4]
+
+    def test_sdpa_matches_reference(self):
+        rng = np.random.RandomState(0)
+        q = rng.rand(2, 2, 4, 8).astype(np.float32)
+        k = rng.rand(2, 2, 4, 8).astype(np.float32)
+        v = rng.rand(2, 2, 4, 8).astype(np.float32)
+        out = F.scaled_dot_product_attention(t(q), t(k), t(v)).numpy()
+        scale = 1 / np.sqrt(8)
+        logits = np.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = np.einsum("bhqk,bhkd->bhqd", p, v)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestLayerInfra:
+    def test_parameters_and_state_dict(self):
+        layer = nn.Linear(4, 3)
+        params = layer.parameters()
+        assert len(params) == 2
+        sd = layer.state_dict()
+        assert "weight" in sd and "bias" in sd
+        new = nn.Linear(4, 3)
+        new.set_state_dict(sd)
+        np.testing.assert_allclose(new.weight.numpy(), layer.weight.numpy())
+
+    def test_nested_layers(self):
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        assert len(model.parameters()) == 4
+        names = [n for n, _ in model.named_parameters()]
+        assert "0.weight" in names and "2.bias" in names
+        x = t(np.random.rand(2, 4).astype(np.float32))
+        assert model(x).shape == [2, 2]
+
+    def test_train_eval_propagates(self):
+        model = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        model.eval()
+        assert not model[1].training
+        model.train()
+        assert model[1].training
+
+    def test_buffers(self):
+        bn = nn.BatchNorm1D(4)
+        assert "_mean" in bn.state_dict()
+
+    def test_forward_hooks(self):
+        layer = nn.Linear(2, 2)
+        calls = []
+        h = layer.register_forward_post_hook(
+            lambda l, inp, out: calls.append(1) or out)
+        layer(t(np.ones((1, 2), np.float32)))
+        assert calls
+        h.remove()
+
+    def test_layerlist_dict(self):
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3
+        ll.append(nn.Linear(2, 2))
+        assert len(ll.parameters()) == 8
+        ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+        assert "a" in ld
+
+    def test_apply_and_to(self):
+        model = nn.Linear(2, 2)
+        model.to(dtype="bfloat16")
+        assert str(model.weight.dtype) == "bfloat16"
+        model.to(dtype="float32")
+
+    def test_initializers(self):
+        from paddle_tpu.nn import initializer as I
+
+        lin = nn.Linear(100, 50,
+                        weight_attr=paddle.nn.ParamAttr(initializer=I.Constant(2.0)))
+        np.testing.assert_allclose(lin.weight.numpy(), 2.0)
+        k = I.KaimingNormal()._generate((100, 100), np.float32)
+        assert abs(float(np.asarray(k).std()) - np.sqrt(2.0 / 100)) < 0.01
+
+
+class TestRNN:
+    def test_lstm_shapes(self):
+        lstm = nn.LSTM(input_size=4, hidden_size=8, num_layers=2)
+        x = t(np.random.rand(3, 5, 4).astype(np.float32))
+        out, (h, c) = lstm(x)
+        assert out.shape == [3, 5, 8]
+        assert h.shape == [2, 3, 8] and c.shape == [2, 3, 8]
+
+    def test_bilstm(self):
+        lstm = nn.LSTM(4, 8, direction="bidirect")
+        x = t(np.random.rand(2, 5, 4).astype(np.float32))
+        out, (h, c) = lstm(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_gru_and_simple(self):
+        gru = nn.GRU(4, 8)
+        out, h = gru(t(np.random.rand(2, 5, 4).astype(np.float32)))
+        assert out.shape == [2, 5, 8]
+        rnn = nn.SimpleRNN(4, 8)
+        out, h = rnn(t(np.random.rand(2, 5, 4).astype(np.float32)))
+        assert out.shape == [2, 5, 8]
+
+    def test_lstm_cell_and_rnn_wrapper(self):
+        cell = nn.LSTMCell(4, 8)
+        rnn = nn.RNN(cell)
+        x = t(np.random.rand(2, 5, 4).astype(np.float32))
+        out, (h, c) = rnn(x)
+        assert out.shape == [2, 5, 8]
+
+    def test_lstm_grad_flows(self):
+        lstm = nn.LSTM(4, 8)
+        x = t(np.random.rand(2, 5, 4).astype(np.float32))
+        out, _ = lstm(x)
+        out.sum().backward()
+        for p in lstm.parameters():
+            assert p._grad is not None
+
+
+class TestTransformer:
+    def test_mha(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = t(np.random.rand(2, 5, 16).astype(np.float32))
+        out = mha(x, x, x)
+        assert out.shape == [2, 5, 16]
+
+    def test_encoder_decoder(self):
+        enc_layer = nn.TransformerEncoderLayer(16, 4, 32)
+        enc = nn.TransformerEncoder(enc_layer, 2)
+        src = t(np.random.rand(2, 5, 16).astype(np.float32))
+        mem = enc(src)
+        assert mem.shape == [2, 5, 16]
+        dec_layer = nn.TransformerDecoderLayer(16, 4, 32)
+        dec = nn.TransformerDecoder(dec_layer, 2)
+        tgt = t(np.random.rand(2, 3, 16).astype(np.float32))
+        out = dec(tgt, mem)
+        assert out.shape == [2, 3, 16]
+
+    def test_full_transformer_grad(self):
+        model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=1,
+                               num_decoder_layers=1, dim_feedforward=32)
+        src = t(np.random.rand(2, 4, 16).astype(np.float32))
+        tgt = t(np.random.rand(2, 4, 16).astype(np.float32))
+        out = model(src, tgt)
+        out.mean().backward()
+        grads = [p for p in model.parameters() if p._grad is not None]
+        assert len(grads) == len(model.parameters())
+
+
+class TestClip:
+    def test_global_norm_clip(self):
+        from paddle_tpu.nn import ClipGradByGlobalNorm
+
+        g1 = np.array([3.0, 4.0], np.float32)  # norm 5
+        clip = ClipGradByGlobalNorm(1.0)
+        out = clip.clip_arrays([g1])
+        np.testing.assert_allclose(np.asarray(out[0]), g1 / 5.0, rtol=1e-5)
+
+    def test_value_clip(self):
+        from paddle_tpu.nn import ClipGradByValue
+
+        clip = ClipGradByValue(0.5)
+        out = clip.clip_arrays([np.array([-2.0, 2.0], np.float32)])
+        np.testing.assert_allclose(np.asarray(out[0]), [-0.5, 0.5])

@@ -1,0 +1,252 @@
+"""Op zoo correctness vs numpy (OpTest analog, reference op_test.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(x, **kw):
+    return paddle.to_tensor(np.asarray(x), **kw)
+
+
+class TestMath:
+    def test_binary_ops(self):
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([4.0, 5.0, 6.0], np.float32)
+        np.testing.assert_allclose(paddle.add(t(a), t(b)).numpy(), a + b)
+        np.testing.assert_allclose(paddle.maximum(t(a), t(b)).numpy(),
+                                   np.maximum(a, b))
+        np.testing.assert_allclose(paddle.multiply(t(a), t(b)).numpy(), a * b)
+        np.testing.assert_allclose(paddle.mod(t(b), t(a)).numpy(), b % a)
+
+    def test_divide_int_promotes(self):
+        r = paddle.divide(t([3]), t([2]))
+        assert np.dtype(r.dtype).kind == "f"
+        np.testing.assert_allclose(r.numpy(), [1.5])
+
+    def test_unary(self):
+        x = np.array([0.5, 1.0, 2.0], np.float32)
+        np.testing.assert_allclose(paddle.exp(t(x)).numpy(), np.exp(x), rtol=1e-6)
+        np.testing.assert_allclose(paddle.log(t(x)).numpy(), np.log(x), rtol=1e-6)
+        np.testing.assert_allclose(paddle.rsqrt(t(x)).numpy(), 1 / np.sqrt(x),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(paddle.tanh(t(x)).numpy(), np.tanh(x), rtol=1e-6)
+
+    def test_scale(self):
+        x = np.array([1.0, 2.0], np.float32)
+        np.testing.assert_allclose(paddle.scale(t(x), 2.0, 1.0).numpy(), x * 2 + 1)
+        np.testing.assert_allclose(
+            paddle.scale(t(x), 2.0, 1.0, bias_after_scale=False).numpy(),
+            (x + 1) * 2)
+
+    def test_reductions(self):
+        x = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.sum(t(x)).numpy(), x.sum(), rtol=1e-5)
+        np.testing.assert_allclose(paddle.sum(t(x), axis=1).numpy(), x.sum(1),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.mean(t(x), axis=0, keepdim=True).numpy(),
+                                   x.mean(0, keepdims=True), rtol=1e-5)
+        np.testing.assert_allclose(paddle.max(t(x)).numpy(), x.max())
+        np.testing.assert_allclose(paddle.prod(t(x), axis=1).numpy(), x.prod(1),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.logsumexp(t(x)).numpy(),
+                                   np.log(np.exp(x).sum()), rtol=1e-5)
+
+    def test_matmul_transpose_flags(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(3, 5).astype(np.float32)
+        r = paddle.matmul(t(a), t(b), transpose_x=True)
+        np.testing.assert_allclose(r.numpy(), a.T @ b, rtol=1e-5)
+
+    def test_cumsum_clip(self):
+        x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        np.testing.assert_allclose(paddle.cumsum(t(x), axis=1).numpy(),
+                                   np.cumsum(x, 1))
+        np.testing.assert_allclose(paddle.clip(t(x), 1.5, 3.5).numpy(),
+                                   np.clip(x, 1.5, 3.5))
+
+    def test_einsum(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(3, 4).astype(np.float32)
+        r = paddle.einsum("ij,jk->ik", t(a), t(b))
+        np.testing.assert_allclose(r.numpy(), a @ b, rtol=1e-5)
+
+
+class TestCreation:
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        np.testing.assert_allclose(paddle.full([2], 7.0).numpy(), [7, 7])
+
+    def test_arange_linspace_eye(self):
+        np.testing.assert_allclose(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(paddle.arange(1, 2, 0.5).numpy(),
+                                   [1.0, 1.5], rtol=1e-6)
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                                   np.linspace(0, 1, 5), rtol=1e-6)
+        np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+
+    def test_like_variants(self):
+        x = t(np.ones((2, 2), np.float32))
+        assert paddle.zeros_like(x).numpy().sum() == 0
+        assert paddle.ones_like(x).numpy().sum() == 4
+
+    def test_tril_triu_diag(self):
+        x = np.arange(9, dtype=np.float32).reshape(3, 3)
+        np.testing.assert_allclose(paddle.tril(t(x)).numpy(), np.tril(x))
+        np.testing.assert_allclose(paddle.triu(t(x), 1).numpy(), np.triu(x, 1))
+        np.testing.assert_allclose(paddle.diag(t(np.array([1.0, 2.0]))).numpy(),
+                                   np.diag([1.0, 2.0]))
+
+
+class TestManipulation:
+    def test_concat_split_stack(self):
+        a = np.ones((2, 3), np.float32)
+        b = 2 * np.ones((2, 3), np.float32)
+        c = paddle.concat([t(a), t(b)], axis=0)
+        assert c.shape == [4, 3]
+        parts = paddle.split(c, 2, axis=0)
+        np.testing.assert_allclose(parts[1].numpy(), b)
+        parts = paddle.split(c, [1, 3], axis=0)
+        assert parts[1].shape == [3, 3]
+        parts = paddle.split(c, [1, -1], axis=0)
+        assert parts[1].shape == [3, 3]
+        s = paddle.stack([t(a), t(b)], axis=0)
+        assert s.shape == [2, 2, 3]
+
+    def test_reshape_transpose_squeeze(self):
+        x = t(np.arange(6, dtype=np.float32).reshape(2, 3))
+        assert paddle.reshape(x, [3, 2]).shape == [3, 2]
+        assert paddle.reshape(x, [-1]).shape == [6]
+        assert paddle.transpose(x, [1, 0]).shape == [3, 2]
+        y = t(np.ones((1, 2, 1), np.float32))
+        assert paddle.squeeze(y).shape == [2]
+        assert paddle.squeeze(y, axis=0).shape == [2, 1]
+        assert paddle.unsqueeze(x, [0, 2]).shape == [1, 2, 1, 3]
+
+    def test_flatten_tile_expand(self):
+        x = t(np.ones((2, 3, 4), np.float32))
+        assert paddle.flatten(x, 1).shape == [2, 12]
+        assert paddle.tile(t(np.ones((2,), np.float32)), [3]).shape == [6]
+        assert paddle.expand(t(np.ones((1, 3), np.float32)), [4, 3]).shape == [4, 3]
+
+    def test_gather_scatter(self):
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        idx = np.array([0, 2])
+        g = paddle.gather(t(x), t(idx))
+        np.testing.assert_allclose(g.numpy(), x[[0, 2]])
+        upd = np.full((2, 3), 9.0, np.float32)
+        s = paddle.scatter(t(x), t(idx), t(upd))
+        assert s.numpy()[0, 0] == 9.0 and s.numpy()[2, 0] == 9.0
+        s2 = paddle.scatter(t(x), t(idx), t(upd), overwrite=False)
+        np.testing.assert_allclose(s2.numpy()[0], [9, 9, 9])
+
+    def test_gather_nd(self):
+        x = np.arange(8, dtype=np.float32).reshape(2, 2, 2)
+        idx = np.array([[0, 1], [1, 0]])
+        r = paddle.gather_nd(t(x), t(idx))
+        np.testing.assert_allclose(r.numpy(), [[2, 3], [4, 5]])
+
+    def test_pad_roll_flip(self):
+        x = t(np.ones((2, 2), np.float32))
+        p = paddle.tensor.manipulation.pad(x, [1, 1, 0, 0])
+        assert p.shape == [4, 2]
+        r = paddle.roll(t(np.arange(4, dtype=np.float32)), 1)
+        np.testing.assert_allclose(r.numpy(), [3, 0, 1, 2])
+        f = paddle.flip(t(np.arange(4, dtype=np.float32)), 0)
+        np.testing.assert_allclose(f.numpy(), [3, 2, 1, 0])
+
+    def test_cast(self):
+        x = paddle.cast(t(np.array([1.7])), "int32")
+        assert np.dtype(x.dtype) == np.int32
+
+    def test_masked_select_eager(self):
+        x = t(np.arange(4, dtype=np.float32))
+        m = x > 1
+        np.testing.assert_allclose(paddle.masked_select(x, m).numpy(), [2, 3])
+
+
+class TestSearch:
+    def test_argmax_sort_topk(self):
+        x = np.array([[3.0, 1.0, 2.0]], np.float32)
+        assert paddle.argmax(t(x), axis=1).numpy()[0] == 0
+        s = paddle.sort(t(x), axis=1, descending=True)
+        np.testing.assert_allclose(s.numpy(), [[3, 2, 1]])
+        vals, idx = paddle.topk(t(x), 2, axis=1)
+        np.testing.assert_allclose(vals.numpy(), [[3, 2]])
+        np.testing.assert_allclose(idx.numpy(), [[0, 2]])
+
+    def test_where_nonzero(self):
+        c = t(np.array([True, False, True]))
+        r = paddle.where(c, t(np.array([1.0, 1, 1])), t(np.array([2.0, 2, 2])))
+        np.testing.assert_allclose(r.numpy(), [1, 2, 1])
+        nz = paddle.nonzero(t(np.array([0, 3, 0, 5])))
+        np.testing.assert_allclose(nz.numpy(), [[1], [3]])
+
+
+class TestLinalg:
+    def test_inverse_solve_det(self):
+        a = np.array([[2.0, 0.0], [0.0, 4.0]], np.float32)
+        np.testing.assert_allclose(paddle.inverse(t(a)).numpy(),
+                                   np.linalg.inv(a), rtol=1e-5)
+        np.testing.assert_allclose(paddle.linalg.det(t(a)).numpy(), 8.0, rtol=1e-5)
+        b = np.array([[2.0], [4.0]], np.float32)
+        np.testing.assert_allclose(paddle.linalg.solve(t(a), t(b)).numpy(),
+                                   np.linalg.solve(a, b), rtol=1e-5)
+
+    def test_norm_svd_qr(self):
+        x = np.random.RandomState(0).rand(3, 3).astype(np.float32)
+        np.testing.assert_allclose(paddle.norm(t(x)).numpy(),
+                                   np.linalg.norm(x), rtol=1e-5)
+        u, s, vt = paddle.linalg.svd(t(x))
+        np.testing.assert_allclose((u.numpy() * s.numpy()) @ vt.numpy(), x,
+                                   rtol=1e-4, atol=1e-4)
+        q, r = paddle.linalg.qr(t(x))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), x, rtol=1e-4, atol=1e-4)
+
+    def test_cholesky(self):
+        a = np.array([[4.0, 2.0], [2.0, 3.0]], np.float32)
+        L = paddle.linalg.cholesky(t(a))
+        np.testing.assert_allclose(L.numpy() @ L.numpy().T, a, rtol=1e-5)
+
+
+class TestRandomOps:
+    def test_shapes_and_ranges(self):
+        u = paddle.uniform([100], min=0.0, max=1.0)
+        assert u.shape == [100]
+        arr = u.numpy()
+        assert arr.min() >= 0 and arr.max() <= 1
+        r = paddle.randint(0, 10, [50])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 10
+        p = paddle.randperm(10)
+        assert sorted(p.numpy().tolist()) == list(range(10))
+
+    def test_bernoulli_multinomial(self):
+        p = paddle.bernoulli(paddle.full([1000], 0.3))
+        assert 0.15 < p.numpy().mean() < 0.45
+        m = paddle.multinomial(paddle.to_tensor(
+            np.array([0.1, 0.0, 0.9], np.float32)), 20, replacement=True)
+        assert 1 not in m.numpy()
+
+
+class TestLogic:
+    def test_compare_and_logical(self):
+        a = t(np.array([1, 2, 3]))
+        b = t(np.array([3, 2, 1]))
+        np.testing.assert_array_equal(paddle.equal(a, b).numpy(),
+                                      [False, True, False])
+        np.testing.assert_array_equal(paddle.greater_than(a, b).numpy(),
+                                      [False, False, True])
+        assert bool(paddle.allclose(t([1.0]), t([1.0 + 1e-9])).numpy())
+        assert bool(paddle.equal_all(a, a).numpy())
+
+
+class TestStat:
+    def test_std_var_median(self):
+        x = np.random.RandomState(0).rand(10).astype(np.float32)
+        np.testing.assert_allclose(paddle.std(t(x)).numpy(), x.std(ddof=1),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.var(t(x), unbiased=False).numpy(),
+                                   x.var(), rtol=1e-5)
+        np.testing.assert_allclose(paddle.median(t(x)).numpy(), np.median(x),
+                                   rtol=1e-5)

@@ -1,0 +1,224 @@
+"""Optimizer + LR scheduler tests (reference: unittests test_sgd_op.py,
+test_adam_op.py, test_lr_scheduler.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _quad_problem(opt_factory, steps=60):
+    """Minimize ||x - target||^2 with each optimizer; must converge."""
+    paddle.seed(0)
+    target = np.array([1.0, -2.0, 3.0], np.float32)
+    x = paddle.to_tensor(np.zeros(3, np.float32), stop_gradient=False)
+    from paddle_tpu.core.tensor import Parameter
+
+    p = Parameter(x._value)
+    opt = opt_factory([p])
+    for _ in range(steps):
+        loss = ((p - paddle.to_tensor(target)) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return p.numpy(), target
+
+
+class TestOptimizers:
+    def test_sgd(self):
+        got, tgt = _quad_problem(lambda ps: optimizer.SGD(0.1, parameters=ps))
+        np.testing.assert_allclose(got, tgt, atol=1e-2)
+
+    def test_momentum(self):
+        got, tgt = _quad_problem(
+            lambda ps: optimizer.Momentum(0.05, 0.9, parameters=ps), steps=150)
+        np.testing.assert_allclose(got, tgt, atol=1e-2)
+
+    def test_momentum_nesterov(self):
+        got, tgt = _quad_problem(
+            lambda ps: optimizer.Momentum(0.05, 0.9, parameters=ps,
+                                          use_nesterov=True))
+        np.testing.assert_allclose(got, tgt, atol=1e-2)
+
+    def test_adam(self):
+        got, tgt = _quad_problem(
+            lambda ps: optimizer.Adam(0.3, parameters=ps), steps=100)
+        np.testing.assert_allclose(got, tgt, atol=5e-2)
+
+    def test_adamw(self):
+        got, tgt = _quad_problem(
+            lambda ps: optimizer.AdamW(0.3, parameters=ps, weight_decay=0.0),
+            steps=100)
+        np.testing.assert_allclose(got, tgt, atol=5e-2)
+
+    def test_rmsprop(self):
+        got, tgt = _quad_problem(
+            lambda ps: optimizer.RMSProp(0.1, parameters=ps), steps=150)
+        np.testing.assert_allclose(got, tgt, atol=0.1)
+
+    def test_adagrad(self):
+        got, tgt = _quad_problem(
+            lambda ps: optimizer.Adagrad(0.9, parameters=ps), steps=200)
+        np.testing.assert_allclose(got, tgt, atol=0.15)
+
+    def test_adadelta(self):
+        got, tgt = _quad_problem(
+            lambda ps: optimizer.Adadelta(10.0, parameters=ps), steps=300)
+        np.testing.assert_allclose(got, tgt, atol=0.5)
+
+    def test_adamax(self):
+        got, tgt = _quad_problem(
+            lambda ps: optimizer.Adamax(0.3, parameters=ps), steps=150)
+        np.testing.assert_allclose(got, tgt, atol=0.1)
+
+    def test_lamb_one_step_formula(self):
+        """LAMB trust-ratio update vs hand-computed (lamb_op.cc semantics)."""
+        from paddle_tpu.core.tensor import Parameter
+
+        p_np = np.array([1.0, 2.0], np.float32)
+        g_np = np.array([0.1, -0.2], np.float32)
+        p = Parameter(p_np.copy())
+        opt = optimizer.Lamb(0.01, lamb_weight_decay=0.05, parameters=[p])
+        p._grad = paddle.to_tensor(g_np)._value
+        opt.step()
+        m = 0.1 * g_np
+        v = 0.001 * g_np ** 2
+        mhat = m / (1 - 0.9)
+        vhat = v / (1 - 0.999)
+        r = mhat / (np.sqrt(vhat) + 1e-6) + 0.05 * p_np
+        trust = np.linalg.norm(p_np) / np.linalg.norm(r)
+        expected = p_np - 0.01 * trust * r
+        np.testing.assert_allclose(p.numpy(), expected, rtol=1e-4)
+
+    def test_adam_matches_reference_formula(self):
+        """One Adam step vs hand-computed update (test_adam_op.py analog)."""
+        from paddle_tpu.core.tensor import Parameter
+
+        p_np = np.array([1.0, 2.0], np.float32)
+        g_np = np.array([0.1, -0.2], np.float32)
+        p = Parameter(p_np.copy())
+        opt = optimizer.Adam(learning_rate=0.01, parameters=[p])
+        p._grad = paddle.to_tensor(g_np)._value
+        opt.step()
+        m = 0.1 * g_np
+        v = 0.001 * g_np ** 2
+        mhat = m / (1 - 0.9)
+        vhat = v / (1 - 0.999)
+        expected = p_np - 0.01 * mhat / (np.sqrt(vhat) + 1e-8)
+        np.testing.assert_allclose(p.numpy(), expected, rtol=1e-5)
+
+    def test_weight_decay_l2(self):
+        from paddle_tpu.core.tensor import Parameter
+
+        p = Parameter(np.array([1.0], np.float32))
+        opt = optimizer.SGD(0.1, parameters=[p], weight_decay=0.5)
+        p._grad = paddle.to_tensor(np.array([0.0], np.float32))._value
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [1.0 - 0.1 * 0.5], rtol=1e-6)
+
+    def test_grad_clip_in_optimizer(self):
+        from paddle_tpu.core.tensor import Parameter
+
+        p = Parameter(np.array([0.0], np.float32))
+        opt = optimizer.SGD(1.0, parameters=[p],
+                            grad_clip=nn.ClipGradByGlobalNorm(0.1))
+        p._grad = paddle.to_tensor(np.array([10.0], np.float32))._value
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [-0.1], rtol=1e-5)
+
+    def test_state_dict_roundtrip(self):
+        model = nn.Linear(2, 2)
+        opt = optimizer.Adam(0.1, parameters=model.parameters())
+        x = paddle.to_tensor(np.ones((1, 2), np.float32))
+        model(x).sum().backward()
+        opt.step()
+        sd = opt.state_dict()
+        opt2 = optimizer.Adam(0.1, parameters=model.parameters())
+        opt2.set_state_dict(sd)
+        k1 = list(opt._accumulators.values())[0]
+        k2 = list(opt2._accumulators.values())[0]
+        np.testing.assert_allclose(np.asarray(k1[0]), np.asarray(k2[0]))
+
+    def test_minimize(self):
+        model = nn.Linear(2, 1)
+        opt = optimizer.SGD(0.1, parameters=model.parameters())
+        before = model.weight.numpy().copy()
+        x = paddle.to_tensor(np.ones((4, 2), np.float32))
+        loss = model(x).sum()
+        opt.minimize(loss)
+        assert not np.allclose(model.weight.numpy(), before)
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        from paddle_tpu.optimizer import lr
+
+        s = lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        vals = [s()]
+        for _ in range(4):
+            s.step()
+            vals.append(s())
+        np.testing.assert_allclose(vals[:5], [0.1, 0.1, 0.05, 0.05, 0.025],
+                                   rtol=1e-6)
+
+    def test_multistep(self):
+        from paddle_tpu.optimizer import lr
+
+        s = lr.MultiStepDecay(1.0, milestones=[2, 4], gamma=0.1)
+        vals = []
+        for _ in range(5):
+            vals.append(s())
+            s.step()
+        np.testing.assert_allclose(vals, [1.0, 1.0, 0.1, 0.1, 0.01], rtol=1e-6)
+
+    def test_cosine(self):
+        from paddle_tpu.optimizer import lr
+
+        s = lr.CosineAnnealingDecay(1.0, T_max=10)
+        v0 = s()
+        for _ in range(10):
+            s.step()
+        assert v0 == pytest.approx(1.0)
+        assert s() == pytest.approx(0.0, abs=1e-6)
+
+    def test_noam_warmup(self):
+        from paddle_tpu.optimizer import lr
+
+        s = lr.NoamDecay(d_model=512, warmup_steps=10, learning_rate=1.0)
+        vals = []
+        for _ in range(20):
+            vals.append(s())
+            s.step()
+        peak = int(np.argmax(vals))
+        assert 8 <= peak <= 11
+
+    def test_linear_warmup_wraps_scheduler(self):
+        from paddle_tpu.optimizer import lr
+
+        inner = lr.StepDecay(0.1, step_size=100)
+        s = lr.LinearWarmup(inner, warmup_steps=5, start_lr=0.0, end_lr=0.1)
+        vals = []
+        for _ in range(7):
+            vals.append(s())
+            s.step()
+        assert vals[0] == pytest.approx(0.0)
+        assert vals[4] < 0.1
+        assert vals[6] == pytest.approx(0.1)
+
+    def test_reduce_on_plateau(self):
+        from paddle_tpu.optimizer import lr
+
+        s = lr.ReduceOnPlateau(0.1, patience=1, factor=0.5)
+        for _ in range(5):
+            s.step(metrics=1.0)
+        assert s() < 0.1
+
+    def test_scheduler_with_optimizer(self):
+        from paddle_tpu.optimizer import lr
+
+        model = nn.Linear(2, 2)
+        sched = lr.StepDecay(0.1, step_size=1, gamma=0.1)
+        opt = optimizer.SGD(sched, parameters=model.parameters())
+        assert opt.get_lr() == pytest.approx(0.1)
+        sched.step()
+        assert opt.get_lr() == pytest.approx(0.01)
