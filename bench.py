@@ -1,0 +1,138 @@
+"""Benchmark: BERT-base MLM pretraining throughput (tokens/sec/chip).
+
+Flagship config from BASELINE.md (PaddleNLP BERT-base/ERNIE pretraining,
+north-star config 3). Runs the full jitted training step (fwd + bwd +
+AdamW) on one chip and reports tokens/sec.
+
+Baseline: A100 80GB BERT-base seq128 mixed-precision pretraining is
+~2700 seq/s ≈ 345k tokens/s per chip (NVIDIA DeepLearningExamples
+order-of-magnitude; the reference repo publishes no numbers — see
+BASELINE.md). vs_baseline = value / 345600; the target is ≥ 0.8.
+
+Prints exactly ONE json line to stdout.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+A100_BERT_BASE_TOKENS_PER_SEC = 345600.0
+
+BATCH = int(os.environ.get("BENCH_BATCH", "32"))
+SEQ = int(os.environ.get("BENCH_SEQ", "128"))
+WARMUP = int(os.environ.get("BENCH_WARMUP", "3"))
+STEPS = int(os.environ.get("BENCH_STEPS", "10"))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+
+    if os.environ.get("BENCH_CPU") == "1":
+        # hermetic smoke mode: skip the axon tunnel entirely
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        devs = jax.devices()
+    except RuntimeError as e:
+        log("TPU backend unavailable, falling back to CPU:", e)
+        jax.config.update("jax_platforms", "cpu")
+        devs = jax.devices()
+    log("devices:", devs)
+    on_tpu = devs[0].platform in ("tpu", "axon")
+
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed import spmd, topology
+    from paddle_tpu.text.models import BertForPretraining
+
+    paddle.seed(0)
+    tiny = not on_tpu and os.environ.get("BENCH_FULL") != "1"
+    if tiny:
+        log("CPU fallback: tiny config (numbers not meaningful)")
+        model = BertForPretraining(
+            vocab_size=1024, hidden_size=128, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=256,
+            hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1)
+        batch, seq = 8, 64
+    else:
+        model = BertForPretraining(
+            hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1)
+        batch, seq = BATCH, SEQ
+
+    opt = optimizer.AdamW(1e-4, parameters=model.parameters(), weight_decay=0.01,
+                          grad_clip=nn.ClipGradByGlobalNorm(1.0))
+
+    vocab = model.bert.vocab_size
+
+    class TrainWrapper(nn.Layer):
+        """forward(batch_ids_and_labels) -> (mlm_logits, nsp_logits).
+
+        build_train_step passes one input tensor; pack ids/labels along a
+        leading axis of 2 rows is awkward — instead close over labels via
+        loss_fn taking the packed y."""
+
+        def __init__(self, inner):
+            super().__init__()
+            self.inner = inner
+
+        def forward(self, ids):
+            mlm_logits, nsp_logits = self.inner(ids)
+            return mlm_logits
+
+    wrapper = TrainWrapper(model)
+
+    def loss_fn(mlm_logits, labels):
+        # labels: [B, S] with -100 = unmasked positions (15% masked)
+        logp = jax.nn.log_softmax(mlm_logits.astype(jnp.float32), axis=-1)
+        lbl = jnp.clip(labels, 0, None)
+        picked = jnp.take_along_axis(logp, lbl[..., None], axis=-1)[..., 0]
+        mask = (labels >= 0).astype(jnp.float32)
+        return -jnp.sum(picked * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    mesh = topology.build_mesh(dp=1)
+    topology.set_global_mesh(mesh)
+    step_fn, init_fn = spmd.build_train_step(wrapper, loss_fn, opt, mesh=mesh)
+    params, opt_state = init_fn()
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, vocab, (batch, seq)).astype(np.int32))
+    labels_np = rng.randint(0, vocab, (batch, seq)).astype(np.int32)
+    mask = rng.rand(batch, seq) < 0.15
+    labels_np = np.where(mask, labels_np, -100).astype(np.int32)
+    labels = jnp.asarray(labels_np)
+
+    log(f"compiling + warmup ({WARMUP} steps), batch={batch} seq={seq} ...")
+    key = jax.random.PRNGKey(0)
+    t0 = time.time()
+    for i in range(WARMUP):
+        loss, params, opt_state = step_fn(params, opt_state, ids, labels,
+                                          key=jax.random.fold_in(key, i))
+    jax.block_until_ready(loss)
+    log(f"warmup done in {time.time() - t0:.1f}s, loss={float(loss):.4f}")
+
+    t0 = time.time()
+    for i in range(STEPS):
+        loss, params, opt_state = step_fn(params, opt_state, ids, labels,
+                                          key=jax.random.fold_in(key, 100 + i))
+    jax.block_until_ready(loss)
+    dt = time.time() - t0
+    tokens_per_sec = batch * seq * STEPS / dt
+    log(f"{STEPS} steps in {dt:.2f}s -> {tokens_per_sec:.0f} tokens/s, "
+        f"final loss {float(loss):.4f}")
+
+    print(json.dumps({
+        "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tokens_per_sec / A100_BERT_BASE_TOKENS_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

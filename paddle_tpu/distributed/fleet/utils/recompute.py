@@ -3,15 +3,12 @@ RecomputeFunction; static analog backward.py:729
 _append_backward_ops_with_checkpoints_).
 
 TPU-native: in traced mode this is literally ``jax.checkpoint`` — XLA
-rematerialises the segment in backward. In eager mode the tape *already*
-recomputes each op's forward inside its vjp, so activations of the
-recomputed segment are not retained beyond the op boundary; we wrap the
-segment as a single tape node so the whole block's intermediates are
-dropped and recomputed in one jitted backward — same memory effect.
+rematerialises the segment in backward. In eager mode recompute is the
+identity: the tape's per-op cached vjps already recompute each op's
+forward inside the backward (inherent rematerialisation), and wrapping
+the segment as one opaque op would hide captured Layer parameters from
+the tape.
 """
-import itertools
-import weakref
-
 import jax
 
 from ....core import dispatch
@@ -34,29 +31,8 @@ def recompute(function, *args, **kwargs):
             return tuple(Tensor(o) for o in out)
         return Tensor(out)
 
-    # eager: one tape node wrapping the whole segment; jax.checkpoint applies
-    # inside the cached vjp, so backward rematerialises instead of storing.
-    def segment_fn(*xs, **static):
-        outs = function(*[Tensor(x, stop_gradient=False) for x in xs], **kwargs)
-        if isinstance(outs, (tuple, list)):
-            return tuple(o._value if isinstance(o, Tensor) else o for o in outs)
-        return outs._value if isinstance(outs, Tensor) else outs
-
-    wrapped = jax.checkpoint(segment_fn)
-    return dispatch.apply_op(f"recompute_segment::{_segment_uid(function)}",
-                             wrapped, *args)
-
-
-_UID_MAP = weakref.WeakKeyDictionary()
-_UID_COUNTER = itertools.count()
-
-
-def _segment_uid(fn):
-    try:
-        uid = _UID_MAP.get(fn)
-        if uid is None:
-            uid = next(_UID_COUNTER)
-            _UID_MAP[fn] = uid
-        return uid
-    except TypeError:  # unhashable/unweakrefable callable
-        return id(fn)
+    # Eager: run the segment normally. The tape's per-op vjps already
+    # recompute each op's forward inside the cached backward (inherent
+    # rematerialisation), and wrapping the segment as one op would hide
+    # captured Layer parameters from the tape (their grads would be lost).
+    return function(*args, **kwargs)

@@ -40,9 +40,12 @@ def pipeline_spmd_fn(stage_apply, num_stages, num_micro):
         # (input microbatches replicated; only stage 0 consumes them)
         stage = jax.lax.axis_index("pp")
         p_slice = jax.tree.map(lambda a: a[0], params_local)
-        carry_in = jnp.zeros_like(micro_local[0])
-        outputs = jnp.zeros((num_micro,) + micro_local.shape[1:],
-                            micro_local[0].dtype)
+        # mark carries as device-varying over pp (shard_map vma tracking)
+        carry_in = jax.lax.pcast(jnp.zeros_like(micro_local[0]), ("pp",), to="varying")
+        outputs = jax.lax.pcast(
+            jnp.zeros((num_micro,) + micro_local.shape[1:], micro_local[0].dtype),
+            ("pp",), to="varying")
+        micro_local = jax.lax.pcast(micro_local, ("pp",), to="varying")
         perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
 
         def tick(state, t):

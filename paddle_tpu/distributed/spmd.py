@@ -106,10 +106,11 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
             new_state[name] = tuple(out[1:])
         return loss, new_params, new_state
 
-    # shardings
+    # shardings: batch over dp(+sharding) — ZeRO groups subdivide dp
     param_shards = {n: p_shardings[n] for n in param_names}
     repl = NamedSharding(mesh, P())
-    batch_shard = NamedSharding(mesh, P("dp"))
+    data_axes = tuple(ax for ax in ("dp", "sharding") if mesh.shape.get(ax, 1) > 1)
+    batch_shard = NamedSharding(mesh, P(data_axes)) if data_axes else repl
 
     def init_fn():
         params = {n: jax.device_put(params0[n], param_shards[n])
@@ -124,30 +125,18 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
                 opt_state[n] = tuple(jax.device_put(a, repl) for a in st)
         return params, opt_state
 
-    opt_shardings = {}
-    p0, s0 = None, None
-
-    def make_step():
-        params_sh = param_shards
-        # opt-state shardings mirror init_fn's placement
-        dummy_state = {n: optimizer._init_state(
-            jax.ShapeDtypeStruct(params0[n].shape, params0[n].dtype))
-            if False else None for n in param_names}
-        in_shardings = (
-            params_sh,
-            None,  # let opt_state shardings propagate from inputs
-            {n: repl for n in buffer_names},
-            batch_shard,
-            batch_shard,
-            repl,
-            repl,
-        )
-        out_shardings = (repl, params_sh, None)
-        jit_kwargs = {}
-        return jax.jit(step, in_shardings=in_shardings,
-                       out_shardings=out_shardings, **jit_kwargs)
-
-    step_jit = make_step()
+    in_shardings = (
+        param_shards,
+        None,  # opt_state shardings propagate from the input arrays (init_fn)
+        {n: repl for n in buffer_names},
+        batch_shard,
+        batch_shard,
+        repl,
+        repl,
+    )
+    out_shardings = (repl, param_shards, None)
+    step_jit = jax.jit(step, in_shardings=in_shardings,
+                       out_shardings=out_shardings)
 
     def step_fn(params, opt_state, x, y, key=None, lr=None):
         if key is None:
@@ -160,9 +149,13 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
     return step_fn, init_fn
 
 
-def shard_batch(batch, mesh=None, axis="dp"):
-    """Place a host array as a dp-sharded global array."""
+def shard_batch(batch, mesh=None, axis=None):
+    """Place a host array sharded on dim 0 over the data axes (dp+sharding)."""
     mesh = mesh or topology.get_global_mesh()
     arr = batch._value if isinstance(batch, Tensor) else jnp.asarray(np.asarray(batch))
-    sharding = NamedSharding(mesh, P(axis))
-    return jax.device_put(arr, sharding)
+    if axis is None:
+        axes = tuple(ax for ax in ("dp", "sharding") if mesh.shape.get(ax, 1) > 1)
+        spec = P(axes) if axes else P()
+    else:
+        spec = P(axis)
+    return jax.device_put(arr, NamedSharding(mesh, spec))

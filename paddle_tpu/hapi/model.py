@@ -16,6 +16,8 @@ from . import callbacks as callbacks_mod
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
+        self._inputs = inputs if inputs is None or isinstance(inputs, (list, tuple)) \
+            else [inputs]
         self._optimizer = None
         self._loss = None
         self._metrics = []
@@ -154,7 +156,13 @@ class Model:
         loader = _as_loader(test_data, batch_size, False, False, num_workers)
         outputs = []
         for batch in loader:
-            ins, _ = _split_batch(batch, has_labels=False)
+            if self._inputs is not None and isinstance(batch, (list, tuple)):
+                # Model(inputs=...) spec decides the input arity (paddle way)
+                ins = list(batch[:len(self._inputs)])
+            else:
+                # heuristic: datasets commonly yield (inputs..., label) even at
+                # predict time; drop the trailing label like fit/evaluate do
+                ins, _ = _split_batch(batch, has_labels=True)
             outputs.append(self.predict_batch(ins))
         if stack_outputs and outputs:
             n_out = len(outputs[0])

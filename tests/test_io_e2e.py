@@ -1,0 +1,289 @@
+"""Data pipeline + end-to-end model tests (north-star config 1: MNIST
+LeNet dygraph smoke; reference: test_imperative_mnist convergence tests)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.io import (DataLoader, Dataset, TensorDataset, BatchSampler,
+                           DistributedBatchSampler, IterableDataset)
+
+
+class _SquaresDataset(Dataset):
+    def __init__(self, n=64):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32(i), np.float32(i * i)
+
+    def __len__(self):
+        return self.n
+
+
+class TestDataLoader:
+    def test_basic_batching(self):
+        loader = DataLoader(_SquaresDataset(10), batch_size=4)
+        batches = list(loader)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == [4]
+        np.testing.assert_allclose(y.numpy(), [0, 1, 4, 9])
+
+    def test_drop_last_and_shuffle(self):
+        loader = DataLoader(_SquaresDataset(10), batch_size=4, drop_last=True,
+                            shuffle=True)
+        batches = list(loader)
+        assert len(batches) == 2
+        seen = np.concatenate([b[0].numpy() for b in batches])
+        assert len(set(seen.tolist())) == 8
+
+    def test_multiprocess_workers(self):
+        loader = DataLoader(_SquaresDataset(32), batch_size=4, num_workers=2)
+        batches = list(loader)
+        assert len(batches) == 8
+        allx = np.sort(np.concatenate([b[0].numpy() for b in batches]))
+        np.testing.assert_allclose(allx, np.arange(32))
+
+    def test_iterable_dataset(self):
+        class Gen(IterableDataset):
+            def __iter__(self):
+                for i in range(10):
+                    yield np.float32(i)
+
+        loader = DataLoader(Gen(), batch_size=3)
+        batches = list(loader)
+        assert len(batches) == 4
+        np.testing.assert_allclose(batches[0].numpy(), [0, 1, 2])
+
+    def test_tensor_dataset_and_samplers(self):
+        xs = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(6, 2))
+        ys = paddle.to_tensor(np.arange(6, dtype=np.int64))
+        ds = TensorDataset([xs, ys])
+        assert len(ds) == 6
+        bs = BatchSampler(ds, batch_size=2)
+        assert len(bs) == 3
+        dbs = DistributedBatchSampler(ds, batch_size=1, num_replicas=2, rank=0)
+        idxs = [i for batch in dbs for i in batch]
+        assert idxs == [0, 2, 4]
+
+    def test_dict_collate(self):
+        class DictDs(Dataset):
+            def __getitem__(self, i):
+                return {"a": np.float32(i), "b": np.ones(2, np.float32)}
+
+            def __len__(self):
+                return 4
+
+        batch = next(iter(DataLoader(DictDs(), batch_size=4)))
+        assert batch["a"].shape == [4]
+        assert batch["b"].shape == [4, 2]
+
+
+class TestMNISTConvergence:
+    def test_lenet_learns(self):
+        from paddle_tpu.vision.datasets import MNIST
+        from paddle_tpu.vision.models import LeNet
+
+        paddle.seed(1)
+        np.random.seed(1)
+        train = MNIST(mode="train")
+        loader = DataLoader(train, batch_size=128, shuffle=True)
+        model = LeNet(num_classes=10)
+        opt = optimizer.Adam(1e-3, parameters=model.parameters())
+        losses = []
+        for step, (img, label) in enumerate(loader):
+            loss = nn.functional.cross_entropy(model(img), label)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+            if step >= 30:
+                break
+        assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+        test = MNIST(mode="test")
+        correct = n = 0
+        model.eval()
+        with paddle.no_grad():
+            for img, label in DataLoader(test, batch_size=256):
+                pred = model(img).numpy().argmax(-1)
+                correct += int((pred == label.numpy()).sum())
+                n += len(pred)
+        assert correct / n > 0.8, correct / n
+
+
+class TestVisionModels:
+    @pytest.mark.parametrize("factory,size", [
+        ("resnet18", 32), ("mobilenet_v2", 32), ("vgg11", 32),
+    ])
+    def test_forward_shapes(self, factory, size):
+        from paddle_tpu.vision import models
+
+        paddle.seed(0)
+        m = getattr(models, factory)(num_classes=7)
+        m.eval()
+        x = paddle.to_tensor(np.random.rand(1, 3, size, size).astype(np.float32))
+        out = m(x)
+        assert out.shape == [1, 7]
+
+    def test_resnet_grad_flows(self):
+        from paddle_tpu.vision.models import resnet18
+
+        m = resnet18(num_classes=4)
+        x = paddle.to_tensor(np.random.rand(2, 3, 32, 32).astype(np.float32))
+        m(x).sum().backward()
+        n_with_grad = sum(1 for p in m.parameters() if p._grad is not None)
+        assert n_with_grad == len(m.parameters())
+
+
+class TestTextModels:
+    def test_bert_forward_and_grad(self):
+        from paddle_tpu.text.models import BertForPretraining, bert_pretraining_loss
+
+        paddle.seed(0)
+        model = BertForPretraining(
+            vocab_size=128, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, intermediate_size=64,
+            max_position_embeddings=32)
+        ids = paddle.to_tensor(np.random.randint(0, 128, (2, 16)))
+        mlm, nsp = model(ids)
+        assert mlm.shape == [2, 16, 128]
+        assert nsp.shape == [2, 2]
+        mlm_labels = paddle.to_tensor(np.random.randint(0, 128, (2, 16)))
+        nsp_labels = paddle.to_tensor(np.array([0, 1]))
+        loss = bert_pretraining_loss(mlm, nsp, mlm_labels, nsp_labels)
+        loss.backward()
+        assert model.bert.embeddings.word_embeddings.weight._grad is not None
+
+    def test_gpt_causal(self):
+        from paddle_tpu.text.models import GPTModel
+
+        m = GPTModel(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+                     max_seq_len=32)
+        ids = paddle.to_tensor(np.random.randint(0, 64, (2, 8)))
+        out = m(ids)
+        assert out.shape == [2, 8, 64]
+
+    def test_llama_tiny(self):
+        from paddle_tpu.text.models import LlamaModel
+
+        m = LlamaModel(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+                       intermediate_size=64)
+        ids = paddle.to_tensor(np.random.randint(0, 64, (1, 8)))
+        out = m(ids)
+        assert out.shape == [1, 8, 64]
+        out.mean().backward()
+
+    def test_ragged_helpers(self):
+        from paddle_tpu.text import ragged
+
+        padded, lengths = ragged.pad_sequences([[1, 2, 3], [4]], maxlen=4)
+        assert padded.shape == [2, 4]
+        np.testing.assert_allclose(lengths.numpy(), [3, 1])
+        pooled = ragged.sequence_pool(
+            paddle.to_tensor(np.ones((2, 4, 3), np.float32)), lengths, "sum")
+        np.testing.assert_allclose(pooled.numpy()[0], 3.0)
+        np.testing.assert_allclose(pooled.numpy()[1], 1.0)
+
+
+class TestHapi:
+    def test_fit_eval_predict(self):
+        from paddle_tpu.metric import Accuracy
+
+        paddle.seed(0)
+
+        class XorDs(paddle.io.Dataset):
+            def __init__(self):
+                rng = np.random.RandomState(0)
+                self.x = rng.rand(256, 8).astype(np.float32)
+                w = rng.rand(8).astype(np.float32)
+                self.y = (self.x @ w > w.sum() / 2).astype(np.int64)
+
+            def __getitem__(self, i):
+                return self.x[i], self.y[i]
+
+            def __len__(self):
+                return len(self.x)
+
+        model = paddle.Model(nn.Sequential(nn.Linear(8, 32), nn.ReLU(),
+                                           nn.Linear(32, 2)))
+        model.prepare(optimizer.Adam(1e-2, parameters=model.parameters()),
+                      nn.CrossEntropyLoss(), Accuracy())
+        model.fit(XorDs(), batch_size=32, epochs=6, verbose=0)
+        logs = model.evaluate(XorDs(), batch_size=64)
+        assert logs["acc"] > 0.8
+        preds = model.predict(XorDs(), batch_size=64, stack_outputs=True)
+        assert preds[0].shape == (256, 2)
+
+    def test_save_load(self):
+        model = paddle.Model(nn.Linear(4, 2))
+        model.prepare(optimizer.SGD(0.1, parameters=model.parameters()))
+        with tempfile.TemporaryDirectory() as d:
+            model.save(os.path.join(d, "ckpt"))
+            w0 = model.network.weight.numpy().copy()
+            model.network.weight.set_value(np.zeros_like(w0))
+            model.load(os.path.join(d, "ckpt"))
+            np.testing.assert_allclose(model.network.weight.numpy(), w0)
+
+    def test_summary(self):
+        stats = paddle.summary(nn.Linear(4, 2), (1, 4))
+        assert stats["total_params"] == 10
+
+
+class TestCheckpointing:
+    def test_auto_checkpoint_resume(self):
+        from paddle_tpu.incubate.checkpoint import TrainEpochRange
+
+        with tempfile.TemporaryDirectory() as d:
+            model = nn.Linear(2, 2)
+            seen = []
+            for epoch in TrainEpochRange(3, save_dir=d, model=model):
+                seen.append(epoch)
+            assert seen == [0, 1, 2]
+            # resume: all epochs done -> no more iterations
+            seen2 = []
+            for epoch in TrainEpochRange(3, save_dir=d, model=model):
+                seen2.append(epoch)
+            assert seen2 == []
+
+    def test_paddle_save_load_nested(self):
+        state = {"model": {"w": paddle.to_tensor([1.0, 2.0])},
+                 "step": 7, "list": [paddle.to_tensor([3.0])]}
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "ck.pdparams")
+            paddle.save(state, path)
+            loaded = paddle.load(path)
+            np.testing.assert_allclose(loaded["model"]["w"].numpy(), [1, 2])
+            assert loaded["step"] == 7
+
+
+class TestDistribution:
+    def test_normal(self):
+        from paddle_tpu.distribution import Normal
+
+        d = Normal(0.0, 1.0)
+        s = d.sample([1000])
+        assert abs(float(s.numpy().mean())) < 0.2
+        lp = d.log_prob(paddle.to_tensor([0.0]))
+        np.testing.assert_allclose(lp.numpy(), -0.5 * np.log(2 * np.pi), rtol=1e-5)
+
+    def test_categorical(self):
+        from paddle_tpu.distribution import Categorical
+
+        d = Categorical(paddle.to_tensor(np.log([0.1, 0.1, 0.8]).astype(np.float32)))
+        s = d.sample([500])
+        frac2 = (s.numpy() == 2).mean()
+        assert frac2 > 0.6
+        e = d.entropy()
+        assert 0 < float(e.numpy()) < np.log(3)
+
+    def test_uniform(self):
+        from paddle_tpu.distribution import Uniform
+
+        d = Uniform(1.0, 3.0)
+        s = d.sample([200])
+        arr = s.numpy()
+        assert arr.min() >= 1.0 and arr.max() <= 3.0

@@ -1,0 +1,233 @@
+"""to_static / static facade / AMP tests (reference: dygraph_to_static
+suite asserting dygraph-vs-static numeric equality; mixed_precision tests)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+import paddle_tpu.nn.functional as F
+
+
+def t(x, **kw):
+    return paddle.to_tensor(np.asarray(x), **kw)
+
+
+class TestToStatic:
+    def test_forward_equality(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = t(np.random.rand(3, 4).astype(np.float32))
+        eager_out = net(x).numpy()
+
+        class W(nn.Layer):
+            def __init__(self, inner):
+                super().__init__()
+                self.inner = inner
+
+            @paddle.jit.to_static
+            def forward(self, x):
+                return self.inner(x)
+
+        w = W(net)
+        np.testing.assert_allclose(w(x).numpy(), eager_out, rtol=1e-6)
+
+    def test_train_trajectory_equality(self):
+        """dygraph-vs-static loss-sequence equality (dygraph_to_static suite
+        oracle)."""
+
+        def build():
+            paddle.seed(7)
+            return nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 2))
+
+        x_np = np.random.RandomState(0).rand(4, 8).astype(np.float32)
+        lbl = np.array([0, 1, 0, 1])
+
+        def run(fwd, params):
+            opt = optimizer.SGD(0.5, parameters=params)
+            losses = []
+            for _ in range(5):
+                loss = F.cross_entropy(fwd(t(x_np)), t(lbl))
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss.numpy()))
+            return losses
+
+        net1 = build()
+        eager = run(net1, net1.parameters())
+
+        net2 = build()
+
+        class W(nn.Layer):
+            def __init__(self, inner):
+                super().__init__()
+                self.inner = inner
+
+            def forward(self, x):
+                return self.inner(x)
+
+        w = W(net2)
+        w.forward = paddle.jit.to_static(w.forward)
+        static = run(w, net2.parameters())
+        np.testing.assert_allclose(eager, static, rtol=1e-5)
+
+    def test_python_control_flow_unrolls(self):
+        class Looper(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4)
+
+            @paddle.jit.to_static
+            def forward(self, x):
+                for _ in range(3):  # static python loop -> unrolled
+                    x = F.relu(self.lin(x))
+                return x
+
+        m = Looper()
+        x = t(np.random.rand(2, 4).astype(np.float32))
+        out = m(x)
+        ref = x
+        for _ in range(3):
+            ref = F.relu(m.lin(ref))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-6)
+
+    def test_input_spec_cache_keyed_on_shape(self):
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4)
+
+            @paddle.jit.to_static
+            def forward(self, x):
+                return self.lin(x)
+
+        m = M()
+        m(t(np.random.rand(2, 4).astype(np.float32)))
+        m(t(np.random.rand(2, 4).astype(np.float32)))
+        assert len(m.forward._cache) == 1
+        m(t(np.random.rand(5, 4).astype(np.float32)))
+        assert len(m.forward._cache) == 2
+
+    def test_jit_save_load(self):
+        from paddle_tpu.static import InputSpec
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = t(np.random.rand(3, 4).astype(np.float32))
+        ref = net(x).numpy()
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "model")
+            paddle.jit.save(net, path, input_spec=[InputSpec([3, 4], "float32")])
+            assert os.path.exists(path + ".pdmodel")
+            loaded = paddle.jit.load(path)
+            out = loaded(x)
+            np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+class TestStaticFacade:
+    def test_linear_regression_trains(self):
+        paddle.enable_static()
+        try:
+            from paddle_tpu import static
+
+            main = static.Program()
+            startup = static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [None, 4], "float32")
+                y = static.data("y", [None, 1], "float32")
+                lin = nn.Linear(4, 1)
+                loss = paddle.mean((lin(x) - y) ** 2)
+                optimizer.SGD(0.1).minimize(loss)
+            exe = static.Executor(paddle.CPUPlace())
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            w = np.array([[1.0], [2.0], [3.0], [4.0]], np.float32)
+            first = last = None
+            for i in range(40):
+                xb = rng.rand(16, 4).astype(np.float32)
+                out = exe.run(main, feed={"x": xb, "y": xb @ w},
+                              fetch_list=[loss])
+                if first is None:
+                    first = out[0]
+                last = out[0]
+            assert last < first * 0.1
+        finally:
+            paddle.disable_static()
+
+    def test_inference_fetch(self):
+        paddle.enable_static()
+        try:
+            from paddle_tpu import static
+
+            main = static.Program()
+            with static.program_guard(main):
+                x = static.data("x", [None, 3], "float32")
+                out = paddle.scale(x, 2.0, 1.0)
+            exe = static.Executor()
+            res = exe.run(main, feed={"x": np.ones((2, 3), np.float32)},
+                          fetch_list=[out])
+            np.testing.assert_allclose(res[0], 3.0)
+        finally:
+            paddle.disable_static()
+
+
+class TestAMP:
+    def test_auto_cast_white_black(self):
+        a = t(np.ones((4, 4), np.float32))
+        with paddle.amp.auto_cast():
+            mm = paddle.matmul(a, a)
+            s = paddle.exp(a)
+        assert str(mm.dtype) == "bfloat16"
+        assert str(s.dtype) == "float32"
+
+    def test_custom_lists(self):
+        a = t(np.ones((4, 4), np.float32))
+        with paddle.amp.auto_cast(custom_black_list={"matmul"}):
+            mm = paddle.matmul(a, a)
+        assert str(mm.dtype) == "float32"
+
+    def test_grad_scaler_roundtrip(self):
+        model = nn.Linear(4, 2)
+        opt = optimizer.SGD(0.1, parameters=model.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+        x = t(np.random.rand(2, 4).astype(np.float32))
+        with paddle.amp.auto_cast():
+            loss = model(x).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        before = model.weight.numpy().copy()
+        scaler.step(opt)
+        assert not np.allclose(model.weight.numpy(), before)
+        # grads were unscaled before the step: magnitude sane
+        assert np.abs(model.weight.numpy() - before).max() < 1.0
+
+    def test_scaler_skips_on_inf(self):
+        from paddle_tpu.core.tensor import Parameter
+
+        p = Parameter(np.array([1.0], np.float32))
+        opt = optimizer.SGD(0.1, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        p._grad = paddle.to_tensor(np.array([np.inf], np.float32))._value
+        scaler.step(opt)
+        np.testing.assert_allclose(p.numpy(), [1.0])  # step skipped
+        assert scaler._scale < 4.0  # dynamic backoff
+
+    def test_training_with_amp_converges(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+        opt = optimizer.Adam(1e-2, parameters=model.parameters())
+        x = t(np.random.RandomState(0).rand(16, 8).astype(np.float32))
+        lbl = t(np.random.RandomState(1).randint(0, 2, 16))
+        first = None
+        for i in range(60):
+            with paddle.amp.auto_cast():
+                loss = F.cross_entropy(model(x), lbl)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = float(loss.numpy())
+        assert float(loss.numpy()) < first * 0.75
