@@ -110,13 +110,15 @@ def main():
     log(f"compiling + warmup ({WARMUP} steps), batch={batch} seq={seq} ...")
     key = jax.random.PRNGKey(0)
     t0 = time.time()
-    for i in range(WARMUP):
+    loss = None
+    for i in range(max(1, WARMUP)):
         loss, params, opt_state = step_fn(params, opt_state, ids, labels,
                                           key=jax.random.fold_in(key, i))
     jax.block_until_ready(loss)
     log(f"warmup done in {time.time() - t0:.1f}s, loss={float(loss):.4f}")
 
     t0 = time.time()
+    STEPS = max(1, STEPS)
     for i in range(STEPS):
         loss, params, opt_state = step_fn(params, opt_state, ids, labels,
                                           key=jax.random.fold_in(key, 100 + i))

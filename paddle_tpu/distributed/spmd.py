@@ -138,13 +138,15 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
     step_jit = jax.jit(step, in_shardings=in_shardings,
                        out_shardings=out_shardings)
 
+    # buffers are step-invariant: upload once, not per step
+    buffers_dev = {n: jnp.asarray(buffers0[n]) for n in buffer_names}
+
     def step_fn(params, opt_state, x, y, key=None, lr=None):
         if key is None:
             key = jax.random.PRNGKey(0)
         if lr is None:
             lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
-        buffers = {n: jnp.asarray(buffers0[n]) for n in buffer_names}
-        return step_jit(params, opt_state, buffers, x, y, key, lr)
+        return step_jit(params, opt_state, buffers_dev, x, y, key, lr)
 
     return step_fn, init_fn
 

@@ -160,9 +160,10 @@ class Model:
                 # Model(inputs=...) spec decides the input arity (paddle way)
                 ins = list(batch[:len(self._inputs)])
             else:
-                # heuristic: datasets commonly yield (inputs..., label) even at
-                # predict time; drop the trailing label like fit/evaluate do
-                ins, _ = _split_batch(batch, has_labels=True)
+                # no inputs spec: the whole batch is the input (predict-time
+                # datasets yield inputs only; pass Model(inputs=...) when a
+                # trailing label must be dropped)
+                ins, _ = _split_batch(batch, has_labels=False)
             outputs.append(self.predict_batch(ins))
         if stack_outputs and outputs:
             n_out = len(outputs[0])

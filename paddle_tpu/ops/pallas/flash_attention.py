@@ -1,0 +1,318 @@
+"""Flash (blockwise-softmax) multi-head attention as a Pallas TPU kernel.
+
+TPU-native replacement for the reference's fused attention math
+(reference: paddle/fluid/operators/math/bert_encoder_functor.cu,
+paddle/fluid/operators/fused/multihead_matmul_op.cu) — those are CUDA
+softmax-fused matmuls; here the idiomatic TPU design is the standard
+flash-attention online-softmax recurrence tiled for the MXU:
+
+- grid over (batch*heads, q_blocks); each program holds one q tile in
+  VMEM plus the full K/V for that head (K/V for one head are small:
+  seq*head_dim, e.g. 4096*128*2B = 1MB bf16) and loops over k tiles
+  with `lax.fori_loop`, keeping running max/sum in f32.
+- backward follows the standard two-kernel flash backward: dq via a
+  q-tile grid, dk/dv via a k-tile grid, both recomputing probabilities
+  from the saved logsumexp (no S*S materialisation anywhere).
+
+All matmuls request `preferred_element_type=float32` so the MXU
+accumulates in f32 even for bf16 inputs. On CPU the same kernels run in
+Pallas interpret mode (used by the test-suite); on TPU they compile via
+Mosaic.
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret():
+    return jax.default_backend() not in ("tpu",)
+
+
+def _i32(x):
+    return jnp.asarray(x, jnp.int32)
+
+
+class _no_x64:
+    """Trace pallas_calls with jax_enable_x64 off: the framework enables
+    x64 globally (Paddle int64 semantics) but Mosaic index math must be
+    32-bit; x64 literals in index maps fail to legalize."""
+
+    def __enter__(self):
+        self.prev = jax.config.jax_enable_x64
+        jax.config.update("jax_enable_x64", False)
+
+    def __exit__(self, *a):
+        jax.config.update("jax_enable_x64", self.prev)
+
+
+def _block(seq, want):
+    """Largest block size <= want that divides seq (>=8 when possible)."""
+    for b in (want, 256, 128, 64, 32, 16, 8):
+        if b <= want and seq % b == 0:
+            return b
+    return seq  # tiny/odd seq: single block
+
+
+# ---------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_q, block_k, seq_k, offset):
+    qi = _i32(pl.program_id(1))
+    q = q_ref[0].astype(jnp.float32) * scale           # [block_q, d]
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+
+    num_kb = seq_k // block_k
+    q_start = qi * _i32(block_q)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(kb * _i32(block_k), block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * _i32(block_k), block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [block_q, block_k]
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kb * _i32(block_k) + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows + _i32(offset) >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    if causal:
+        # only k blocks whose start <= q block end + offset contribute
+        last = (q_start + _i32(block_q + offset + block_k - 1)) // _i32(block_k)
+        num_kb = jnp.minimum(_i32(num_kb), last)
+    m, l, acc = jax.lax.fori_loop(_i32(0), _i32(num_kb) if isinstance(num_kb, int) else num_kb, body, (m, l, acc))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)                        # [block_q, 1]
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k):
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    grid = (bh, seq_q // block_q)
+    out_shape = (
+        jax.ShapeDtypeStruct(q.shape, q.dtype),
+        # lse kept 3-d with trailing dim 1: TPU block shapes must tile
+        # (8,128) or match the array dims exactly
+        jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
+    )
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_k=seq_k,
+        offset=seq_k - seq_q)
+    with _no_x64():
+        o, lse = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            ],
+            out_specs=(
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            ),
+            out_shape=out_shape,
+            interpret=_interpret(),
+            cost_estimate=pl.CostEstimate(
+                flops=4 * seq_q * seq_k * d,
+                bytes_accessed=(seq_q + 2 * seq_k) * d * q.dtype.itemsize,
+                transcendentals=seq_q * seq_k),
+        )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------- backward
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale, causal, block_q, block_k, seq_k, offset):
+    qi = _i32(pl.program_id(1))
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]                                    # [block_q, 1]
+    delta = delta_ref[0]
+    dq = jnp.zeros_like(q)
+    q_start = qi * _i32(block_q)
+
+    num_kb = seq_k // block_k
+
+    def body(kb, dq):
+        k = k_ref[0, pl.ds(kb * _i32(block_k), block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * _i32(block_k), block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kb * _i32(block_k) + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows + _i32(offset) >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                            # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        last = (q_start + _i32(block_q + offset + block_k - 1)) // _i32(block_k)
+        num_kb = jnp.minimum(_i32(num_kb), last)
+    dq = jax.lax.fori_loop(_i32(0), _i32(num_kb) if isinstance(num_kb, int) else num_kb, body, dq)
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, block_q, block_k,
+                    seq_q, offset):
+    ki = _i32(pl.program_id(1))
+    k = k_ref[0].astype(jnp.float32)                    # [block_k, d]
+    v = v_ref[0].astype(jnp.float32)
+    dk = jnp.zeros_like(k)
+    dv = jnp.zeros_like(v)
+    k_start = ki * _i32(block_k)
+
+    num_qb = seq_q // block_q
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * _i32(block_q), block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qb * _i32(block_q), block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * _i32(block_q), block_q), :]   # [block_q, 1]
+        delta = delta_ref[0, pl.ds(qb * _i32(block_q), block_q), :]
+        qs = q * scale
+        s = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = qb * _i32(block_q) + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows + _i32(offset) >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(ds, qs, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    start_qb = _i32(0)
+    if causal:
+        # q rows < k_start - offset are fully masked for this k block
+        start_qb = jnp.maximum(
+            _i32(0), (k_start - _i32(offset)) // _i32(block_q))
+    dk, dv = jax.lax.fori_loop(start_qb, _i32(num_qb), body, (dk, dv))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)            # [bh, seq_q, 1]
+
+    with _no_x64():
+        dq = pl.pallas_call(
+            functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                              block_q=block_q, block_k=block_k, seq_k=seq_k,
+                              offset=seq_k - seq_q),
+            grid=(bh, seq_q // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            interpret=_interpret(),
+        )(q, k, v, do, lse, delta)
+
+        dk, dv = pl.pallas_call(
+            functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                              block_q=block_q, block_k=block_k, seq_q=seq_q,
+                              offset=seq_k - seq_q),
+            grid=(bh, seq_k // block_k),
+            in_specs=[
+                pl.BlockSpec((1, seq_q, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, seq_q, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, seq_q, 1), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, seq_q, 1), lambda b, i: (b, 0, 0)),
+            ],
+            out_specs=(
+                pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            ),
+            out_shape=(
+                jax.ShapeDtypeStruct(k.shape, k.dtype),
+                jax.ShapeDtypeStruct(v.shape, v.dtype),
+            ),
+            interpret=_interpret(),
+        )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, block_q, block_k):
+    o, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return o
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    o, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, res, do):
+    return _bwd(scale, causal, block_q, block_k, res, do)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def mha(q, k, v, *, scale=None, causal=False, block_q=128, block_k=128):
+    """Flash attention. q,k,v: [batch, heads, seq, head_dim] (or 3-d
+    [batch*heads, seq, head_dim]). Returns same shape as q."""
+    squeeze = q.ndim == 3
+    if squeeze:
+        q, k, v = q[None], k[None], v[None]
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    bq = _block(sq, block_q)
+    bk = _block(sk, block_k)
+    q3 = q.reshape(b * h, sq, d)
+    k3 = k.reshape(b * h, sk, d)
+    v3 = v.reshape(b * h, sk, d)
+    o = _flash(q3, k3, v3, float(scale), bool(causal), bq, bk)
+    o = o.reshape(b, h, sq, d)
+    return o[0] if squeeze else o
