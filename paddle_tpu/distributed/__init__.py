@@ -18,3 +18,4 @@ from . import meta_parallel  # noqa: F401
 from .spmd import build_train_step, shard_batch  # noqa: F401
 from . import sharding  # noqa: F401
 from .launch_mod import launch  # noqa: F401
+from ..ops.ring_attention import ring_attention  # noqa: F401

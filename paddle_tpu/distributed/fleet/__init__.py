@@ -8,6 +8,11 @@ from .fleet_base import (  # noqa: F401
     worker_num, worker_index, is_worker, is_server, barrier_worker, _fleet_singleton,
 )
 from . import utils  # noqa: F401
+from . import dataset  # noqa: F401
+from .dataset import DatasetBase, InMemoryDataset, QueueDataset  # noqa: F401
+from .role_maker import (  # noqa: F401
+    Role, RoleMakerBase, PaddleCloudRoleMaker, UserDefinedRoleMaker,
+)
 from ..meta_parallel import (  # noqa: F401
     VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
     PipelineLayer, LayerDesc, SharedLayerDesc,
@@ -15,10 +20,29 @@ from ..meta_parallel import (  # noqa: F401
 from ..meta_parallel.mp_layers import get_rng_state_tracker  # noqa: F401
 
 
-class UserDefinedRoleMaker:
-    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
-        self._is_collective = is_collective
+def set_ps_tables(table_configs):
+    return _fleet_singleton.set_ps_tables(table_configs)
 
 
-class PaddleCloudRoleMaker(UserDefinedRoleMaker):
-    pass
+def init_server(*a, **k):
+    return _fleet_singleton.init_server(*a, **k)
+
+
+def run_server(*a, **k):
+    return _fleet_singleton.run_server(*a, **k)
+
+
+def stop_server():
+    return _fleet_singleton.stop_server()
+
+
+def init_worker(*a, **k):
+    return _fleet_singleton.init_worker(*a, **k)
+
+
+def ps_client():
+    return _fleet_singleton.ps_client()
+
+
+def stop_worker():
+    return _fleet_singleton.stop_worker()

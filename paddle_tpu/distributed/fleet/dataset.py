@@ -1,0 +1,120 @@
+"""Fleet Dataset API over the native C++ data feed.
+
+Reference: python/paddle/distributed/fleet/dataset/dataset.py —
+DatasetBase:22, InMemoryDataset:241 (load_into_memory, local/global
+shuffle), QueueDataset:1068 — wrapping the C++ data_feed/data_set
+(framework/data_feed.h:120,305, data_set.cc). Here the C++ side is
+paddle_tpu/native/data_feed.cc; batches come back as padded numpy arrays
+ready for the jitted dense model.
+"""
+import numpy as np
+
+from ... import native
+
+
+class DatasetBase:
+    """reference dataset.py:22."""
+
+    def __init__(self):
+        self._slots = []
+        self._batch_size = 1
+        self._handle = None
+        self._max_per_slot = 1
+        self._pad_id = -1
+
+    def init(self, batch_size=1, use_var=None, slots=None, max_per_slot=1,
+             pad_id=-1, **kwargs):
+        self._batch_size = batch_size
+        if slots is None and use_var is not None:
+            slots = [getattr(v, "name", str(v)) for v in use_var]
+        self._slots = list(slots or [])
+        self._max_per_slot = max_per_slot
+        self._pad_id = pad_id
+        lib = native.get_lib()
+        self._handle = lib.pt_dataset_create(
+            ",".join(self._slots).encode(), batch_size)
+
+    def set_filelist(self, files):
+        lib = native.get_lib()
+        self._files = list(files)
+        rc = lib.pt_dataset_set_filelist(self._handle,
+                                         ",".join(files).encode())
+        assert rc == 0
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = batch_size
+        rc = native.get_lib().pt_dataset_set_batch_size(self._handle,
+                                                        batch_size)
+        assert rc == 0
+
+    def _next_batch(self):
+        lib = native.get_lib()
+        labels = np.zeros(self._batch_size, np.float32)
+        ids = np.zeros(len(self._slots) * self._batch_size *
+                       self._max_per_slot, np.int64)
+        rows = lib.pt_dataset_next_batch(self._handle,
+                                         native.f32_ptr(labels),
+                                         native.i64_ptr(ids),
+                                         self._max_per_slot, self._pad_id)
+        if rows <= 0:
+            return None
+        ids = ids.reshape(len(self._slots), self._batch_size,
+                          self._max_per_slot)
+        return labels[:rows], {s: ids[i, :rows]
+                               for i, s in enumerate(self._slots)}
+
+    def __iter__(self):
+        lib = native.get_lib()
+        lib.pt_dataset_reset_epoch(self._handle)
+        while True:
+            b = self._next_batch()
+            if b is None:
+                return
+            yield b
+
+    def release_memory(self):
+        """Drop loaded records; the dataset stays usable (reference
+        InMemoryDataset pattern: train -> release -> reload next pass)."""
+        if self._handle is not None:
+            native.get_lib().pt_dataset_release_memory(self._handle)
+
+    def destroy(self):
+        if self._handle is not None:
+            native.get_lib().pt_dataset_destroy(self._handle)
+            self._handle = None
+
+
+class InMemoryDataset(DatasetBase):
+    """reference dataset.py:241 — load files to memory, shuffle, iterate."""
+
+    def load_into_memory(self):
+        n = native.get_lib().pt_dataset_load_into_memory(self._handle)
+        assert n >= 0, "load_into_memory failed (missing files?)"
+        self._n_records = int(n)
+        return self._n_records
+
+    def local_shuffle(self, seed=0):
+        native.get_lib().pt_dataset_local_shuffle(self._handle, seed)
+
+    def global_shuffle(self, fleet=None, thread_num=12, seed=0):
+        # single-host: global == local; multi-host exchange comes with the
+        # distributed file assignment (each worker reads its own shard)
+        self.local_shuffle(seed)
+
+    def get_memory_data_size(self, fleet=None):
+        return getattr(self, "_n_records", 0)
+
+
+class QueueDataset(DatasetBase):
+    """reference dataset.py:1068 — streaming reads, no shuffle. The native
+    feed loads per-epoch on demand."""
+
+    def __iter__(self):
+        lib = native.get_lib()
+        lib.pt_dataset_load_into_memory(self._handle)
+        lib.pt_dataset_reset_epoch(self._handle)
+        while True:
+            b = self._next_batch()
+            if b is None:
+                return
+            yield b

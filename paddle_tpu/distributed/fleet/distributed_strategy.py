@@ -45,7 +45,7 @@ _DEFAULTS = {
     "cudnn_exhaustive_search": False,
     "conv_workspace_size_limit": 512,
     "cudnn_batchnorm_spatial_persistent": False,
-    "hybrid_configs": {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+    "hybrid_configs": {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1, "sep_degree": 1,
                        "sharding_degree": 1},
     "heter_ccl_mode": False,
     "find_unused_parameters": False,

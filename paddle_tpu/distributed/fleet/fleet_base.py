@@ -22,36 +22,63 @@ class Fleet:
         self._hcg = None
         self._is_collective = True
         self._util = None
+        self._role_maker = None
+        self._ps_server = None
+        self._ps_client = None
+        self._table_configs = None
 
     def init(self, role_maker=None, is_collective=False, strategy=None):
         self._strategy = strategy or DistributedStrategy()
-        self._is_collective = is_collective or role_maker is None
+        # a role maker constructed with is_collective=True keeps collective
+        # semantics (reference: PaddleCloudRoleMaker(is_collective=True))
+        self._is_collective = (is_collective or role_maker is None or
+                               getattr(role_maker, "_is_collective", False))
+        self._role_maker = None if self._is_collective else role_maker
+        self._ps_server = None
+        self._ps_client = None
+        self._table_configs = None
+        if role_maker is not None and not is_collective:
+            # PS (a_sync) mode: no device mesh is needed on servers; workers
+            # still get the trivial mesh below for their dense jit step
+            pass
         hc = self._strategy.hybrid_configs
         n_dev = len(jax.devices())
         dp = hc.get("dp_degree", 1)
         mp = hc.get("mp_degree", 1)
         pp = hc.get("pp_degree", 1)
         sh = hc.get("sharding_degree", 1)
-        if dp * mp * pp * sh <= 1:
-            dp, mp, pp, sh = n_dev, 1, 1, 1
-        self._hcg = topo_mod.HybridCommunicateGroup(dp=dp, mp=mp, pp=pp, sharding=sh)
+        sp = hc.get("sep_degree", hc.get("sp_degree", 1))
+        if dp * mp * pp * sh * sp <= 1:
+            dp, mp, pp, sh, sp = n_dev, 1, 1, 1, 1
+        self._hcg = topo_mod.HybridCommunicateGroup(dp=dp, mp=mp, pp=pp,
+                                                    sharding=sh, sp=sp)
         topo_mod.set_hybrid_communicate_group(self._hcg)
         return self
 
     # --- role info (reference fleet_base) ---
     def worker_num(self):
+        if self._role_maker is not None:
+            return self._role_maker.worker_num()
         return jax.process_count()
 
     def worker_index(self):
+        if self._role_maker is not None:
+            return self._role_maker.worker_index()
         return jax.process_index()
 
     def is_worker(self):
+        if self._role_maker is not None:
+            return self._role_maker.is_worker()
         return True
 
     def is_server(self):
+        if self._role_maker is not None:
+            return self._role_maker.is_server()
         return False
 
     def is_first_worker(self):
+        if self._role_maker is not None:
+            return self._role_maker.is_first_worker()
         return jax.process_index() == 0
 
     def worker_endpoints(self, to_string=False):
@@ -59,7 +86,61 @@ class Fleet:
         return ",".join(eps) if to_string else eps
 
     def server_num(self):
+        if self._role_maker is not None:
+            return self._role_maker.server_num()
         return 0
+
+    # --- PS runtime (reference: fleet/runtime/the_one_ps.py over the brpc
+    # PS; here over paddle_tpu/native ps_service) ---
+    def set_ps_tables(self, table_configs):
+        """Declare the PS table layout (both server and worker sides)."""
+        self._table_configs = list(table_configs)
+
+    def init_server(self, *args, **kwargs):
+        from .. import ps as ps_mod
+
+        assert self.is_server(), "init_server on a non-server role"
+        assert self._table_configs, "call set_ps_tables(configs) first"
+        eps = self._role_maker.get_pserver_endpoints()
+        port = 0
+        if eps:
+            me = eps[min(self._role_maker.server_index(), len(eps) - 1)]
+            port = int(me.rsplit(":", 1)[1])
+        self._ps_server = ps_mod.PSServer(self._table_configs, port=port)
+        return self._ps_server
+
+    def run_server(self, block=False):
+        assert self._ps_server is not None, "init_server first"
+        if block:
+            import time
+
+            while self._ps_server.handle is not None:
+                time.sleep(0.2)
+
+    def stop_server(self):
+        if self._ps_server is not None:
+            self._ps_server.stop()
+
+    def init_worker(self, *args, **kwargs):
+        from .. import ps as ps_mod
+
+        assert self._table_configs, "call set_ps_tables(configs) first"
+        eps = self._role_maker.get_pserver_endpoints()             if self._role_maker else []
+        if eps:
+            host, port = eps[0].rsplit(":", 1)
+            self._ps_client = ps_mod.RpcPSClient(self._table_configs,
+                                                 host=host, port=int(port))
+        else:
+            self._ps_client = ps_mod.LocalPSClient(self._table_configs)
+        return self._ps_client
+
+    def ps_client(self):
+        return self._ps_client
+
+    def stop_worker(self):
+        if self._ps_client is not None:
+            self._ps_client.close()
+            self._ps_client = None
 
     def barrier_worker(self):
         from ..collective import barrier

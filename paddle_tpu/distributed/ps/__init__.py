@@ -1,0 +1,253 @@
+"""Parameter-server runtime over the native C++ tables/service.
+
+Reference: the "the-one-PS" stack — paddle/fluid/distributed/ (brpc
+services + tables, SURVEY §2.6) orchestrated from Python by
+fleet/runtime/the_one_ps.py. Here the table/optimizer core and the RPC
+service are C++ (paddle_tpu/native/ps_core.cc, ps_service.cc) bound via
+ctypes; the device-side dense model remains a jitted XLA program and
+embeddings flow host-side around it (pull → jit step → push), which is
+the same worker loop the reference uses for sparse models.
+
+Two client modes:
+- LocalPSClient: tables in-process (reference ps_local_client.h) —
+  single-node training and tests.
+- RpcPSClient: TCP to a PSServer, possibly remote (brpc_ps_client analog).
+"""
+import os
+
+import numpy as np
+
+from ... import native
+
+SGD, ADAGRAD, ADAM = 0, 1, 2
+_OPT_NAMES = {"sgd": SGD, "adagrad": ADAGRAD, "adam": ADAM}
+
+
+class TableConfig:
+    def __init__(self, name, is_sparse, size=0, emb_dim=0, optimizer="sgd",
+                 lr=0.01, init_range=0.1, seed=0):
+        self.name = name
+        self.is_sparse = is_sparse
+        self.size = size
+        self.emb_dim = emb_dim
+        self.optimizer = _OPT_NAMES[optimizer] if isinstance(optimizer, str) \
+            else optimizer
+        self.lr = lr
+        self.init_range = init_range
+        self.seed = seed
+
+
+def _create_tables(configs):
+    lib = native.get_lib()
+    handles = []
+    for c in configs:
+        if c.is_sparse:
+            h = lib.pt_table_create_sparse(c.emb_dim, c.optimizer, c.lr,
+                                           c.init_range, c.seed)
+        else:
+            h = lib.pt_table_create_dense(c.size, c.optimizer, c.lr)
+        handles.append(h)
+    return handles
+
+
+class PSServer:
+    """Hosts tables and serves them over TCP (brpc_ps_server analog)."""
+
+    def __init__(self, table_configs, port=0):
+        self.lib = native.get_lib()
+        self.configs = list(table_configs)
+        self.tables = _create_tables(self.configs)
+        arr = np.asarray(self.tables, np.int64)
+        self.handle = self.lib.pt_server_start(port, native.i64_ptr(arr),
+                                               len(self.tables))
+        if self.handle < 0:
+            raise RuntimeError("failed to start PS server")
+        self.port = self.lib.pt_server_port(self.handle)
+
+    def stop(self):
+        if self.handle is not None:
+            self.lib.pt_server_stop(self.handle)
+            self.handle = None
+        for t in self.tables:
+            self.lib.pt_table_destroy(t)
+        self.tables = []
+
+    def save(self, table_idx, path):
+        return self.lib.pt_table_save(self.tables[table_idx],
+                                      path.encode()) == 0
+
+
+class LocalPSClient:
+    """In-process tables (reference: distributed/service/ps_local_client.h)."""
+
+    def __init__(self, table_configs):
+        self.lib = native.get_lib()
+        self.configs = list(table_configs)
+        self.tables = _create_tables(self.configs)
+
+    def pull_dense(self, idx):
+        c = self.configs[idx]
+        out = np.zeros(c.size, np.float32)
+        rc = self.lib.pt_dense_pull(self.tables[idx], native.f32_ptr(out),
+                                    c.size)
+        assert rc == 0
+        return out
+
+    def push_dense(self, idx, grad):
+        grad = np.ascontiguousarray(grad, np.float32)
+        rc = self.lib.pt_dense_push(self.tables[idx], native.f32_ptr(grad),
+                                    grad.size)
+        assert rc == 0
+
+    def set_dense(self, idx, values):
+        values = np.ascontiguousarray(values, np.float32)
+        rc = self.lib.pt_dense_set(self.tables[idx], native.f32_ptr(values),
+                                   values.size)
+        assert rc == 0
+
+    def pull_sparse(self, idx, ids):
+        c = self.configs[idx]
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        out = np.zeros((ids.size, c.emb_dim), np.float32)
+        rc = self.lib.pt_sparse_pull(self.tables[idx], native.i64_ptr(ids),
+                                     ids.size, native.f32_ptr(out), 1)
+        assert rc == 0
+        return out
+
+    def push_sparse(self, idx, ids, grads):
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        grads = np.ascontiguousarray(grads, np.float32)
+        rc = self.lib.pt_sparse_push(self.tables[idx], native.i64_ptr(ids),
+                                     ids.size, native.f32_ptr(grads))
+        assert rc == 0
+
+    def barrier(self):
+        pass
+
+    def save(self, idx, path):
+        return self.lib.pt_table_save(self.tables[idx], path.encode()) == 0
+
+    def load(self, idx, path):
+        return self.lib.pt_table_load(self.tables[idx], path.encode()) == 0
+
+    def close(self):
+        for t in self.tables:
+            self.lib.pt_table_destroy(t)
+        self.tables = []
+
+
+class RpcPSClient:
+    """TCP client to a PSServer (reference: brpc_ps_client.cc)."""
+
+    def __init__(self, table_configs, host="127.0.0.1", port=0):
+        self.lib = native.get_lib()
+        self.configs = list(table_configs)
+        self.handle = self.lib.pt_client_connect(host.encode(), port)
+        if self.handle < 0:
+            raise RuntimeError(f"cannot connect PS at {host}:{port}")
+
+    def pull_dense(self, idx):
+        c = self.configs[idx]
+        out = np.zeros(c.size, np.float32)
+        rc = self.lib.pt_client_dense_pull(self.handle, idx,
+                                           native.f32_ptr(out), c.size)
+        assert rc == 0
+        return out
+
+    def push_dense(self, idx, grad):
+        grad = np.ascontiguousarray(grad, np.float32)
+        rc = self.lib.pt_client_dense_push(self.handle, idx,
+                                           native.f32_ptr(grad), grad.size)
+        assert rc == 0
+
+    def pull_sparse(self, idx, ids):
+        c = self.configs[idx]
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        out = np.zeros((ids.size, c.emb_dim), np.float32)
+        rc = self.lib.pt_client_sparse_pull(
+            self.handle, idx, native.i64_ptr(ids), ids.size,
+            native.f32_ptr(out), c.emb_dim)
+        assert rc == 0
+        return out
+
+    def push_sparse(self, idx, ids, grads):
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        grads = np.ascontiguousarray(grads, np.float32)
+        c = self.configs[idx]
+        rc = self.lib.pt_client_sparse_push(
+            self.handle, idx, native.i64_ptr(ids), ids.size,
+            native.f32_ptr(grads), c.emb_dim)
+        assert rc == 0
+
+    def barrier(self):
+        assert self.lib.pt_client_barrier(self.handle) == 0
+
+    def save(self, idx, path):
+        return self.lib.pt_client_save(self.handle, idx, path.encode()) == 0
+
+    def close(self):
+        if self.handle is not None:
+            self.lib.pt_client_close(self.handle)
+            self.handle = None
+
+
+# ---------------------------------------------------------------- eager op
+
+def sparse_embedding(ids, client, table_idx, pooling=None, pad_id=-1):
+    """Distributed embedding lookup against a PS table, differentiable in
+    dygraph: backward pushes gradients to the table's sparse optimizer
+    (reference op: operators/pscore/distributed_lookup_table_op).
+
+    ids: int Tensor/array [...]; rows for pad_id come back zero and send
+    no gradient. pooling='sum'/'mean' reduces the last ids axis.
+    """
+    from ...autograd import PyLayer
+    from ...core.tensor import Tensor
+
+    # the table is the "parameter": anchor the output into the tape with a
+    # persistent requires-grad scalar so backward reaches push_sparse even
+    # though ids themselves are non-differentiable
+    anchor = getattr(client, "_grad_anchor", None)
+    if anchor is None:
+        anchor = Tensor(np.zeros((), np.float32), stop_gradient=False)
+        client._grad_anchor = anchor
+
+    class _Lookup(PyLayer):
+        @staticmethod
+        def forward(ctx, ids_t, _anchor):
+            idv = np.asarray(ids_t.numpy() if isinstance(ids_t, Tensor)
+                             else ids_t, np.int64)
+            flat = idv.ravel()
+            mask = flat != pad_id
+            rows = np.zeros((flat.size, client.configs[table_idx].emb_dim),
+                            np.float32)
+            if mask.any():
+                rows[mask] = client.pull_sparse(table_idx, flat[mask])
+            ctx.ids = flat
+            ctx.mask = mask
+            out = rows.reshape(idv.shape +
+                               (client.configs[table_idx].emb_dim,))
+            return Tensor(out)
+
+        @staticmethod
+        def backward(ctx, grad_out):
+            g = np.asarray(grad_out.numpy(), np.float32)
+            g = g.reshape(ctx.ids.size, -1)
+            if ctx.mask.any():
+                client.push_sparse(table_idx, ctx.ids[ctx.mask],
+                                   g[ctx.mask])
+            # ids are not differentiable; anchor gets a zero grad
+            return None, np.zeros((), np.float32)
+
+    emb = _Lookup.apply(
+        ids if isinstance(ids, Tensor) else Tensor(
+            np.asarray(ids, np.int64), stop_gradient=True),
+        anchor)
+    if pooling == "sum":
+        emb = emb.sum(axis=-2)
+    elif pooling == "mean":
+        import paddle_tpu as paddle
+        idv = np.asarray(ids.numpy() if hasattr(ids, "numpy") else ids)
+        cnt = np.maximum((idv != pad_id).sum(-1, keepdims=True), 1)
+        emb = emb.sum(axis=-2) / paddle.to_tensor(cnt.astype(np.float32))
+    return emb

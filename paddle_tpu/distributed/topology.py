@@ -16,19 +16,19 @@ from jax.sharding import Mesh
 _HYBRID_GROUP = None
 _GLOBAL_MESH = None
 
-AXIS_ORDER = ("dp", "pp", "sharding", "mp")
+AXIS_ORDER = ("dp", "pp", "sharding", "sp", "mp")
 
 
-def build_mesh(dp=1, mp=1, pp=1, sharding=1, devices=None):
+def build_mesh(dp=1, mp=1, pp=1, sharding=1, sp=1, devices=None):
     devices = devices if devices is not None else jax.devices()
-    n = dp * mp * pp * sharding
+    n = dp * mp * pp * sharding * sp
     if n == 1 and len(devices) > 1:
         dp = len(devices)
         n = dp
     if n > len(devices):
-        raise ValueError(f"topology dp{dp}xpp{pp}xsharding{sharding}xmp{mp}={n} "
-                         f"needs {n} devices, have {len(devices)}")
-    arr = np.asarray(devices[:n]).reshape(dp, pp, sharding, mp)
+        raise ValueError(f"topology dp{dp}xpp{pp}xsharding{sharding}xsp{sp}"
+                         f"xmp{mp}={n} needs {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(dp, pp, sharding, sp, mp)
     return Mesh(arr, AXIS_ORDER)
 
 
@@ -41,7 +41,7 @@ def get_global_mesh():
     global _GLOBAL_MESH
     if _GLOBAL_MESH is None:
         devs = jax.devices()
-        _GLOBAL_MESH = Mesh(np.asarray(devs).reshape(len(devs), 1, 1, 1), AXIS_ORDER)
+        _GLOBAL_MESH = Mesh(np.asarray(devs).reshape(len(devs), 1, 1, 1, 1), AXIS_ORDER)
     return _GLOBAL_MESH
 
 
@@ -100,17 +100,22 @@ class HybridCommunicateGroup:
     """reference: topology.py:111. Mesh-backed: per-axis 'groups' are mesh
     axis names usable directly in psum/ppermute/shard_map."""
 
-    def __init__(self, topology=None, dp=1, mp=1, pp=1, sharding=1):
+    def __init__(self, topology=None, dp=1, mp=1, pp=1, sharding=1, sp=1):
         if topology is not None:
             dims = [topology.get_dim(n) for n in topology.get_hybrid_group_names()]
-            dp, pp, sharding, mp = dims
+            if len(dims) == 4:
+                dp, pp, sharding, mp = dims
+            else:
+                dp, pp, sharding, sp, mp = dims
         self._dp_degree = dp
         self._mp_degree = mp
         self._pp_degree = pp
         self._sharding_degree = sharding
-        self._topo = CommunicateTopology(("data", "pipe", "sharding", "model"),
-                                         (dp, pp, sharding, mp))
-        self.mesh = build_mesh(dp=dp, mp=mp, pp=pp, sharding=sharding)
+        self._sp_degree = sp
+        self._topo = CommunicateTopology(
+            ("data", "pipe", "sharding", "sep", "model"),
+            (dp, pp, sharding, sp, mp))
+        self.mesh = build_mesh(dp=dp, mp=mp, pp=pp, sharding=sharding, sp=sp)
         set_global_mesh(self.mesh)
         self.global_rank = jax.process_index()
         self._coord = self._topo.get_coord(min(self.global_rank,
@@ -128,6 +133,14 @@ class HybridCommunicateGroup:
 
     def get_sharding_parallel_world_size(self):
         return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        """Sequence (context) parallel degree — green-field: the reference
+        has no sequence parallelism (SURVEY §5 long-context: absent)."""
+        return self._sp_degree
+
+    def get_sep_parallel_group(self):
+        return "sp"
 
     def get_data_parallel_rank(self):
         return self._coord[0]

@@ -160,10 +160,11 @@ class Model:
                 # Model(inputs=...) spec decides the input arity (paddle way)
                 ins = list(batch[:len(self._inputs)])
             else:
-                # no inputs spec: the whole batch is the input (predict-time
-                # datasets yield inputs only; pass Model(inputs=...) when a
-                # trailing label must be dropped)
-                ins, _ = _split_batch(batch, has_labels=False)
+                # no inputs spec: fit-style datasets yield (inputs..., label)
+                # — drop the trailing element like fit/evaluate do. For
+                # unlabeled multi-input data pass Model(inputs=[...]) so the
+                # spec decides arity instead of this heuristic.
+                ins, _ = _split_batch(batch, has_labels=True)
             outputs.append(self.predict_batch(ins))
         if stack_outputs and outputs:
             n_out = len(outputs[0])

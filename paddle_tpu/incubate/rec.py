@@ -1,0 +1,117 @@
+"""Sparse recommendation models: wide&deep and DeepFM over PS embeddings.
+
+North-star "Sparse" config (BASELINE.md): wide&deep / DeepFM training with
+the sparse embedding path. The reference ships these as PaddleRec configs
+on top of distributed_lookup_table + the brpc PS (SURVEY §2.6, §2.9); here
+the lookup is paddle_tpu.distributed.ps.sparse_embedding (host-side C++
+tables) and the dense tower is ordinary paddle_tpu.nn running on TPU.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import ps
+
+
+class WideDeep(nn.Layer):
+    """wide&deep: wide = linear over sparse one-hot (a 1-dim embedding),
+    deep = MLP over concatenated slot embeddings."""
+
+    def __init__(self, client, slot_names, emb_dim=8, hidden=(64, 32),
+                 wide_table=0, deep_table=1):
+        super().__init__()
+        self.client = client
+        self.slots = list(slot_names)
+        self.emb_dim = emb_dim
+        self.wide_table = wide_table
+        self.deep_table = deep_table
+        layers = []
+        in_dim = emb_dim * len(self.slots)
+        for h in hidden:
+            layers += [nn.Linear(in_dim, h), nn.ReLU()]
+            in_dim = h
+        layers.append(nn.Linear(in_dim, 1))
+        self.deep = nn.Sequential(*layers)
+
+    def forward(self, slot_ids):
+        # slot_ids: dict slot -> int64 [batch, max_per]
+        wide_logit = 0.0
+        deep_parts = []
+        for s in self.slots:
+            ids = slot_ids[s]
+            wide_logit = wide_logit + ps.sparse_embedding(
+                ids, self.client, self.wide_table, pooling="sum")
+            deep_parts.append(ps.sparse_embedding(
+                ids, self.client, self.deep_table, pooling="sum"))
+        deep_in = paddle.concat(deep_parts, axis=-1)
+        logit = self.deep(deep_in) + wide_logit
+        return logit.squeeze(-1)
+
+
+class DeepFM(nn.Layer):
+    """DeepFM: FM second-order interactions over slot embeddings + first
+    order (1-dim table) + deep MLP, shared embeddings."""
+
+    def __init__(self, client, slot_names, emb_dim=8, hidden=(64, 32),
+                 first_table=0, emb_table=1):
+        super().__init__()
+        self.client = client
+        self.slots = list(slot_names)
+        self.emb_dim = emb_dim
+        self.first_table = first_table
+        self.emb_table = emb_table
+        layers = []
+        in_dim = emb_dim * len(self.slots)
+        for h in hidden:
+            layers += [nn.Linear(in_dim, h), nn.ReLU()]
+            in_dim = h
+        layers.append(nn.Linear(in_dim, 1))
+        self.deep = nn.Sequential(*layers)
+
+    def forward(self, slot_ids):
+        first = 0.0
+        embs = []
+        for s in self.slots:
+            ids = slot_ids[s]
+            first = first + ps.sparse_embedding(
+                ids, self.client, self.first_table, pooling="sum")
+            embs.append(ps.sparse_embedding(
+                ids, self.client, self.emb_table, pooling="sum"))
+        # FM: 0.5 * ((sum v)^2 - sum v^2), summed over emb dim
+        stack = paddle.stack(embs, axis=1)            # [b, slots, dim]
+        sum_sq = paddle.square(stack.sum(axis=1))
+        sq_sum = paddle.square(stack).sum(axis=1)
+        fm = 0.5 * (sum_sq - sq_sum).sum(axis=-1, keepdim=True)
+        deep_in = paddle.concat(embs, axis=-1)
+        logit = self.deep(deep_in) + fm + first
+        return logit.squeeze(-1)
+
+
+def make_ps_tables(emb_dim=8, optimizer="adagrad", lr=0.05):
+    """Standard 2-table layout: table 0 = 1-dim (wide/first-order),
+    table 1 = emb_dim (deep/FM embeddings)."""
+    return [
+        ps.TableConfig("wide", is_sparse=True, emb_dim=1,
+                       optimizer=optimizer, lr=lr, seed=1),
+        ps.TableConfig("deep_emb", is_sparse=True, emb_dim=emb_dim,
+                       optimizer=optimizer, lr=lr, seed=2),
+    ]
+
+
+def synthetic_ctr_files(path, n_files=2, rows_per_file=512, n_users=100,
+                        n_items=200, seed=0):
+    """Write slot-format CTR data ('label user:id item:id item:id') with a
+    learnable structure: label = 1 iff (user+item) even for the first item."""
+    rng = np.random.RandomState(seed)
+    files = []
+    for fi in range(n_files):
+        fp = f"{path}/ctr_{fi}.txt"
+        with open(fp, "w") as f:
+            for _ in range(rows_per_file):
+                u = rng.randint(0, n_users)
+                items = rng.randint(0, n_items, rng.randint(1, 4))
+                label = int((u + items[0]) % 2 == 0)
+                toks = [f"user:{u}"] + [f"item:{i}" for i in items]
+                f.write(f"{label} " + " ".join(toks) + "\n")
+        files.append(fp)
+    return files

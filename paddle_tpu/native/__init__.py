@@ -1,0 +1,115 @@
+"""ctypes bindings for the native runtime (PS tables/service, data feed).
+
+The environment has no pybind11, so the binding layer (reference:
+paddle/fluid/pybind/) is a flat C ABI loaded with ctypes. The shared
+library is built from the .cc sources on first import with g++ and cached
+next to the sources (keyed by a source hash).
+"""
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["ps_core.cc", "ps_service.cc", "data_feed.cc"]
+_LOCK = threading.Lock()
+_LIB = None
+
+
+def _source_hash():
+    h = hashlib.sha256()
+    for src in _SOURCES + ["native_api.h"]:
+        with open(os.path.join(_DIR, src), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def build_lib(verbose=False):
+    """Compile (if needed) and return the path to the shared library."""
+    tag = _source_hash()
+    build_dir = os.path.join(_DIR, "_build")
+    lib_path = os.path.join(build_dir, f"libpaddle_tpu_native_{tag}.so")
+    if os.path.exists(lib_path):
+        return lib_path
+    os.makedirs(build_dir, exist_ok=True)
+    srcs = [os.path.join(_DIR, s) for s in _SOURCES]
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-I", _DIR, "-o", lib_path + ".tmp"] + srcs
+    if verbose:
+        print("building native lib:", " ".join(cmd))
+    subprocess.run(cmd, check=True, capture_output=not verbose)
+    os.replace(lib_path + ".tmp", lib_path)
+    return lib_path
+
+
+def get_lib():
+    """Load (building if necessary) the native library; thread-safe."""
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    with _LOCK:
+        if _LIB is None:
+            lib = ctypes.CDLL(build_lib())
+            _declare(lib)
+            _LIB = lib
+    return _LIB
+
+
+def _declare(lib):
+    i64, i32, u64 = ctypes.c_int64, ctypes.c_int, ctypes.c_uint64
+    f32p = ctypes.POINTER(ctypes.c_float)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    cstr = ctypes.c_char_p
+    sig = {
+        "pt_table_create_dense": (i64, [i64, i32, ctypes.c_float]),
+        "pt_table_create_sparse": (i64, [i64, i32, ctypes.c_float,
+                                         ctypes.c_float, u64]),
+        "pt_table_destroy": (None, [i64]),
+        "pt_dense_pull": (i32, [i64, f32p, i64]),
+        "pt_dense_push": (i32, [i64, f32p, i64]),
+        "pt_dense_set": (i32, [i64, f32p, i64]),
+        "pt_sparse_pull": (i32, [i64, i64p, i64, f32p, i32]),
+        "pt_sparse_push": (i32, [i64, i64p, i64, f32p]),
+        "pt_sparse_size": (i64, [i64]),
+        "pt_table_save": (i32, [i64, cstr]),
+        "pt_table_load": (i32, [i64, cstr]),
+        "pt_server_start": (i64, [i32, i64p, i32]),
+        "pt_server_stop": (None, [i64]),
+        "pt_server_port": (i32, [i64]),
+        "pt_client_connect": (i64, [cstr, i32]),
+        "pt_client_close": (None, [i64]),
+        "pt_client_dense_pull": (i32, [i64, i32, f32p, i64]),
+        "pt_client_dense_push": (i32, [i64, i32, f32p, i64]),
+        "pt_client_sparse_pull": (i32, [i64, i32, i64p, i64, f32p, i64]),
+        "pt_client_sparse_push": (i32, [i64, i32, i64p, i64, f32p, i64]),
+        "pt_client_barrier": (i32, [i64]),
+        "pt_client_save": (i32, [i64, i32, cstr]),
+        "pt_dataset_create": (i64, [cstr, i32]),
+        "pt_dataset_destroy": (None, [i64]),
+        "pt_dataset_set_filelist": (i32, [i64, cstr]),
+        "pt_dataset_load_into_memory": (i64, [i64]),
+        "pt_dataset_local_shuffle": (i32, [i64, u64]),
+        "pt_dataset_next_batch": (i32, [i64, f32p, i64p, i32, i64]),
+        "pt_dataset_reset_epoch": (None, [i64]),
+        "pt_dataset_release_memory": (None, [i64]),
+        "pt_dataset_set_batch_size": (i32, [i64, i32]),
+        "pt_sparse_dim": (i64, [i64]),
+        "pt_dataset_num_slots": (i32, [i64]),
+    }
+    for name, (res, args) in sig.items():
+        fn = getattr(lib, name)
+        fn.restype = res
+        fn.argtypes = args
+
+
+def f32_ptr(arr):
+    import numpy as np
+    assert arr.dtype == np.float32 and arr.flags["C_CONTIGUOUS"]
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def i64_ptr(arr):
+    import numpy as np
+    assert arr.dtype == np.int64 and arr.flags["C_CONTIGUOUS"]
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))

@@ -1,0 +1,185 @@
+// Slot-record data feed: text parsing + in-memory records + global shuffle
+// + batch assembly (reference behaviors: paddle/fluid/framework/
+// data_feed.h:120 DataFeed, :305 InMemoryDataFeed, :664 MultiSlotDataFeed,
+// data_set.cc InMemoryDataset load/shuffle).
+//
+// Line format (MultiSlot "slot:feasign" style):
+//   <label> <slot_name>:<id> <slot_name>:<id> ...
+// Records are parsed into per-slot id lists, held in memory, shuffled,
+// and emitted as fixed-size padded batches for the XLA-side dense model.
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <mutex>
+#include <random>
+#include <sstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "native_api.h"
+
+namespace {
+
+struct Record {
+  float label;
+  // per-slot ids, indexed by slot position
+  std::vector<std::vector<int64_t>> slot_ids;
+};
+
+struct Dataset {
+  std::vector<std::string> slots;
+  std::unordered_map<std::string, int> slot_index;
+  std::vector<std::string> files;
+  std::vector<Record> records;
+  size_t cursor = 0;
+  int batch_size;
+  std::mutex mu;
+};
+
+std::mutex g_mu;
+std::unordered_map<int64_t, Dataset*> g_datasets;
+int64_t g_next = 1;
+
+Dataset* get(int64_t h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_datasets.find(h);
+  return it == g_datasets.end() ? nullptr : it->second;
+}
+
+std::vector<std::string> split_csv(const char* csv) {
+  std::vector<std::string> out;
+  std::stringstream ss(csv);
+  std::string item;
+  while (std::getline(ss, item, ','))
+    if (!item.empty()) out.push_back(item);
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t pt_dataset_create(const char* slot_names_csv, int batch_size) {
+  auto* d = new Dataset();
+  d->slots = split_csv(slot_names_csv);
+  for (size_t i = 0; i < d->slots.size(); i++)
+    d->slot_index[d->slots[i]] = (int)i;
+  d->batch_size = batch_size;
+  std::lock_guard<std::mutex> g(g_mu);
+  int64_t h = g_next++;
+  g_datasets[h] = d;
+  return h;
+}
+
+void pt_dataset_destroy(int64_t ds) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_datasets.find(ds);
+  if (it != g_datasets.end()) { delete it->second; g_datasets.erase(it); }
+}
+
+int pt_dataset_set_filelist(int64_t ds, const char* files_csv) {
+  Dataset* d = get(ds);
+  if (!d) return -1;
+  std::lock_guard<std::mutex> lock(d->mu);
+  d->files = split_csv(files_csv);
+  return 0;
+}
+
+int64_t pt_dataset_load_into_memory(int64_t ds) {
+  Dataset* d = get(ds);
+  if (!d) return -1;
+  std::lock_guard<std::mutex> lock(d->mu);
+  d->records.clear();
+  d->cursor = 0;
+  for (auto& path : d->files) {
+    std::ifstream in(path);
+    if (!in) return -1;
+    std::string line;
+    while (std::getline(in, line)) {
+      if (line.empty()) continue;
+      std::stringstream ss(line);
+      Record r;
+      r.slot_ids.resize(d->slots.size());
+      if (!(ss >> r.label)) continue;
+      std::string tok;
+      while (ss >> tok) {
+        size_t colon = tok.rfind(':');
+        if (colon == std::string::npos) continue;
+        auto it = d->slot_index.find(tok.substr(0, colon));
+        if (it == d->slot_index.end()) continue;  // unknown slot: drop
+        r.slot_ids[it->second].push_back(
+            std::strtoll(tok.c_str() + colon + 1, nullptr, 10));
+      }
+      d->records.push_back(std::move(r));
+    }
+  }
+  return (int64_t)d->records.size();
+}
+
+int pt_dataset_local_shuffle(int64_t ds, uint64_t seed) {
+  Dataset* d = get(ds);
+  if (!d) return -1;
+  std::lock_guard<std::mutex> lock(d->mu);
+  std::mt19937_64 rng(seed);
+  std::shuffle(d->records.begin(), d->records.end(), rng);
+  d->cursor = 0;
+  return 0;
+}
+
+int pt_dataset_next_batch(int64_t ds, float* labels, int64_t* slot_ids,
+                          int max_per_slot, int64_t pad_id) {
+  Dataset* d = get(ds);
+  if (!d) return -1;
+  std::lock_guard<std::mutex> lock(d->mu);
+  int rows = 0;
+  size_t n_slots = d->slots.size();
+  for (; rows < d->batch_size && d->cursor < d->records.size();
+       rows++, d->cursor++) {
+    const Record& r = d->records[d->cursor];
+    labels[rows] = r.label;
+    for (size_t s = 0; s < n_slots; s++) {
+      int64_t* out =
+          slot_ids + (s * d->batch_size + rows) * (size_t)max_per_slot;
+      const auto& ids = r.slot_ids[s];
+      int m = std::min((int)ids.size(), max_per_slot);
+      for (int i = 0; i < m; i++) out[i] = ids[i];
+      for (int i = m; i < max_per_slot; i++) out[i] = pad_id;
+    }
+  }
+  return rows;
+}
+
+void pt_dataset_release_memory(int64_t ds) {
+  Dataset* d = get(ds);
+  if (d) {
+    std::lock_guard<std::mutex> lock(d->mu);
+    d->records.clear();
+    d->records.shrink_to_fit();
+    d->cursor = 0;
+  }
+}
+
+int pt_dataset_set_batch_size(int64_t ds, int batch_size) {
+  Dataset* d = get(ds);
+  if (!d || batch_size <= 0) return -1;
+  std::lock_guard<std::mutex> lock(d->mu);
+  d->batch_size = batch_size;
+  return 0;
+}
+
+void pt_dataset_reset_epoch(int64_t ds) {
+  Dataset* d = get(ds);
+  if (d) {
+    std::lock_guard<std::mutex> lock(d->mu);
+    d->cursor = 0;
+  }
+}
+
+int pt_dataset_num_slots(int64_t ds) {
+  Dataset* d = get(ds);
+  return d ? (int)d->slots.size() : -1;
+}
+
+}  // extern "C"

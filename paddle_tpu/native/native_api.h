@@ -1,0 +1,78 @@
+// C API for the paddle_tpu native runtime (ctypes-bound; the environment
+// has no pybind11 — SURVEY §2.11's pybind layer maps to this flat C ABI).
+//
+// Components:
+//  - Parameter-server tables: dense + sparse-hash embedding with built-in
+//    sparse optimizers (reference: paddle/fluid/distributed/table/
+//    common_dense_table.cc, common_sparse_table.cc).
+//  - PS TCP service: brpc_ps_server/brpc_ps_client equivalent over a
+//    length-prefixed socket protocol (reference: paddle/fluid/distributed/
+//    service/brpc_ps_server.h:40-97).
+//  - Data feed: slot-record parsing + in-memory shuffle channels
+//    (reference: paddle/fluid/framework/data_feed.h:120,305, data_set.cc).
+#pragma once
+#include <cstdint>
+
+extern "C" {
+
+// ---------------- tables ----------------
+// optimizer: 0=sgd 1=adagrad 2=adam; returns table handle (>=0) or -1
+int64_t pt_table_create_dense(int64_t size, int optimizer, float lr);
+int64_t pt_table_create_sparse(int64_t emb_dim, int optimizer, float lr,
+                               float init_range, uint64_t seed);
+void pt_table_destroy(int64_t table);
+
+// dense: values/grads are float[size]
+int pt_dense_pull(int64_t table, float* out, int64_t size);
+int pt_dense_push(int64_t table, const float* grad, int64_t size);
+int pt_dense_set(int64_t table, const float* values, int64_t size);
+
+// sparse: ids int64[n]; out float[n*emb_dim]; grads float[n*emb_dim]
+int pt_sparse_pull(int64_t table, const int64_t* ids, int64_t n, float* out,
+                   int init_if_missing);
+int pt_sparse_push(int64_t table, const int64_t* ids, int64_t n,
+                   const float* grads);
+int64_t pt_sparse_size(int64_t table);
+int64_t pt_sparse_dim(int64_t table);
+// save/load a table to a binary file; returns 0 on success
+int pt_table_save(int64_t table, const char* path);
+int pt_table_load(int64_t table, const char* path);
+
+// ---------------- PS service ----------------
+// serve the given tables on a port; returns server handle
+int64_t pt_server_start(int port, const int64_t* tables, int n_tables);
+void pt_server_stop(int64_t server);
+int pt_server_port(int64_t server);  // actual port (0 -> ephemeral)
+
+// client: connect to host:port; returns client handle or -1
+int64_t pt_client_connect(const char* host, int port);
+void pt_client_close(int64_t client);
+int pt_client_dense_pull(int64_t client, int table_idx, float* out,
+                         int64_t size);
+int pt_client_dense_push(int64_t client, int table_idx, const float* grad,
+                         int64_t size);
+int pt_client_sparse_pull(int64_t client, int table_idx, const int64_t* ids,
+                          int64_t n, float* out, int64_t emb_dim);
+int pt_client_sparse_push(int64_t client, int table_idx, const int64_t* ids,
+                          int64_t n, const float* grads, int64_t emb_dim);
+int pt_client_barrier(int64_t client);
+int pt_client_save(int64_t client, int table_idx, const char* path);
+
+// ---------------- data feed ----------------
+// slot-record dataset: text lines "label slot:sign slot:sign ..." or
+// configurable dense/sparse slots. Returns dataset handle.
+int64_t pt_dataset_create(const char* slot_names_csv, int batch_size);
+void pt_dataset_destroy(int64_t ds);
+int pt_dataset_set_filelist(int64_t ds, const char* files_csv);
+int64_t pt_dataset_load_into_memory(int64_t ds);     // returns #records
+int pt_dataset_local_shuffle(int64_t ds, uint64_t seed);
+// next batch: fills label float[batch]; per-slot ids int64[batch*max_per]
+// (padded with pad_id) ; returns actual batch rows, 0 at epoch end
+int pt_dataset_next_batch(int64_t ds, float* labels, int64_t* slot_ids,
+                          int max_per_slot, int64_t pad_id);
+void pt_dataset_reset_epoch(int64_t ds);
+void pt_dataset_release_memory(int64_t ds);  // drop records, keep handle
+int pt_dataset_set_batch_size(int64_t ds, int batch_size);
+int pt_dataset_num_slots(int64_t ds);
+
+}  // extern "C"

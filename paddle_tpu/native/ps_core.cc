@@ -1,0 +1,339 @@
+// Parameter-server table core: dense table + sparse hash embedding table
+// with per-row sparse optimizers (sgd/adagrad/adam), sharded locking.
+//
+// TPU-native framework's host-side sparse stack — XLA has no sparse
+// embedding world, so this lives in C++ beside the device program
+// (reference behaviors: paddle/fluid/distributed/table/common_dense_table.cc
+// pull/push + optimizers; common_sparse_table.cc hash embedding with
+// on-demand row init; SURVEY §2.6).
+#include <atomic>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <unordered_map>
+#include <vector>
+
+#include "native_api.h"
+
+namespace {
+
+constexpr int kShards = 16;
+
+enum Opt { kSGD = 0, kAdagrad = 1, kAdam = 2 };
+
+struct DenseTable {
+  std::vector<float> w;
+  std::vector<float> m0;  // adagrad accum / adam m
+  std::vector<float> m1;  // adam v
+  int opt;
+  float lr;
+  int64_t step = 0;
+  std::mutex mu;
+};
+
+// per-row payload: emb_dim weights followed by optimizer state
+struct SparseShard {
+  std::unordered_map<int64_t, std::vector<float>> rows;
+  mutable std::mutex mu;
+};
+
+struct SparseTable {
+  int64_t dim;
+  int opt;
+  float lr;
+  float init_range;
+  uint64_t seed;
+  std::atomic<int64_t> step{0};
+  SparseShard shards[kShards];
+
+  size_t row_width() const {
+    // sgd: dim; adagrad: dim + dim(G); adam: dim + 2*dim(m,v)
+    return opt == kSGD ? dim : (opt == kAdagrad ? 2 * dim : 3 * dim);
+  }
+};
+
+struct Registry {
+  std::mutex mu;
+  std::unordered_map<int64_t, DenseTable*> dense;
+  std::unordered_map<int64_t, SparseTable*> sparse;
+  int64_t next = 1;
+};
+
+Registry& reg() {
+  static Registry r;
+  return r;
+}
+
+DenseTable* get_dense(int64_t h) {
+  std::lock_guard<std::mutex> g(reg().mu);
+  auto it = reg().dense.find(h);
+  return it == reg().dense.end() ? nullptr : it->second;
+}
+
+SparseTable* get_sparse(int64_t h) {
+  std::lock_guard<std::mutex> g(reg().mu);
+  auto it = reg().sparse.find(h);
+  return it == reg().sparse.end() ? nullptr : it->second;
+}
+
+void apply_dense(DenseTable* t, const float* g, int64_t n) {
+  std::lock_guard<std::mutex> lock(t->mu);
+  t->step++;
+  switch (t->opt) {
+    case kSGD:
+      for (int64_t i = 0; i < n; i++) t->w[i] -= t->lr * g[i];
+      break;
+    case kAdagrad:
+      if (t->m0.empty()) t->m0.assign(n, 0.f);
+      for (int64_t i = 0; i < n; i++) {
+        t->m0[i] += g[i] * g[i];
+        t->w[i] -= t->lr * g[i] / (std::sqrt(t->m0[i]) + 1e-6f);
+      }
+      break;
+    case kAdam: {
+      if (t->m0.empty()) t->m0.assign(n, 0.f);
+      if (t->m1.empty()) t->m1.assign(n, 0.f);
+      const float b1 = 0.9f, b2 = 0.999f, eps = 1e-8f;
+      float bc1 = 1.f - std::pow(b1, (float)t->step);
+      float bc2 = 1.f - std::pow(b2, (float)t->step);
+      for (int64_t i = 0; i < n; i++) {
+        t->m0[i] = b1 * t->m0[i] + (1 - b1) * g[i];
+        t->m1[i] = b2 * t->m1[i] + (1 - b2) * g[i] * g[i];
+        t->w[i] -= t->lr * (t->m0[i] / bc1) /
+                   (std::sqrt(t->m1[i] / bc2) + eps);
+      }
+      break;
+    }
+  }
+}
+
+inline uint64_t mix(uint64_t x) {
+  x ^= x >> 33; x *= 0xff51afd7ed558ccdULL;
+  x ^= x >> 33; x *= 0xc4ceb9fe1a85ec53ULL;
+  x ^= x >> 33; return x;
+}
+
+std::vector<float>& ensure_row(SparseTable* t, SparseShard& sh, int64_t id) {
+  auto it = sh.rows.find(id);
+  if (it != sh.rows.end()) return it->second;
+  auto& row = sh.rows[id];
+  row.assign(t->row_width(), 0.f);
+  // deterministic per-id init, uniform(-init_range, init_range)
+  uint64_t s = mix((uint64_t)id ^ t->seed);
+  for (int64_t i = 0; i < t->dim; i++) {
+    s = s * 6364136223846793005ULL + 1442695040888963407ULL;
+    float u = (float)((s >> 11) * (1.0 / 9007199254740992.0));  // [0,1)
+    row[i] = (2.f * u - 1.f) * t->init_range;
+  }
+  return row;
+}
+
+void apply_sparse_row(SparseTable* t, std::vector<float>& row,
+                      const float* g, int64_t step) {
+  int64_t d = t->dim;
+  switch (t->opt) {
+    case kSGD:
+      for (int64_t i = 0; i < d; i++) row[i] -= t->lr * g[i];
+      break;
+    case kAdagrad:
+      for (int64_t i = 0; i < d; i++) {
+        row[d + i] += g[i] * g[i];
+        row[i] -= t->lr * g[i] / (std::sqrt(row[d + i]) + 1e-6f);
+      }
+      break;
+    case kAdam: {
+      const float b1 = 0.9f, b2 = 0.999f, eps = 1e-8f;
+      float bc1 = 1.f - std::pow(b1, (float)step);
+      float bc2 = 1.f - std::pow(b2, (float)step);
+      for (int64_t i = 0; i < d; i++) {
+        row[d + i] = b1 * row[d + i] + (1 - b1) * g[i];
+        row[2 * d + i] = b2 * row[2 * d + i] + (1 - b2) * g[i] * g[i];
+        row[i] -= t->lr * (row[d + i] / bc1) /
+                  (std::sqrt(row[2 * d + i] / bc2) + eps);
+      }
+      break;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t pt_table_create_dense(int64_t size, int optimizer, float lr) {
+  auto* t = new DenseTable();
+  t->w.assign(size, 0.f);
+  t->opt = optimizer;
+  t->lr = lr;
+  std::lock_guard<std::mutex> g(reg().mu);
+  int64_t h = reg().next++;
+  reg().dense[h] = t;
+  return h;
+}
+
+int64_t pt_table_create_sparse(int64_t emb_dim, int optimizer, float lr,
+                               float init_range, uint64_t seed) {
+  auto* t = new SparseTable();
+  t->dim = emb_dim;
+  t->opt = optimizer;
+  t->lr = lr;
+  t->init_range = init_range;
+  t->seed = seed;
+  std::lock_guard<std::mutex> g(reg().mu);
+  int64_t h = reg().next++;
+  reg().sparse[h] = t;
+  return h;
+}
+
+void pt_table_destroy(int64_t table) {
+  std::lock_guard<std::mutex> g(reg().mu);
+  auto it = reg().dense.find(table);
+  if (it != reg().dense.end()) { delete it->second; reg().dense.erase(it); return; }
+  auto it2 = reg().sparse.find(table);
+  if (it2 != reg().sparse.end()) { delete it2->second; reg().sparse.erase(it2); }
+}
+
+int pt_dense_pull(int64_t table, float* out, int64_t size) {
+  DenseTable* t = get_dense(table);
+  if (!t || (int64_t)t->w.size() != size) return -1;
+  std::lock_guard<std::mutex> lock(t->mu);
+  std::memcpy(out, t->w.data(), size * sizeof(float));
+  return 0;
+}
+
+int pt_dense_push(int64_t table, const float* grad, int64_t size) {
+  DenseTable* t = get_dense(table);
+  if (!t || (int64_t)t->w.size() != size) return -1;
+  apply_dense(t, grad, size);
+  return 0;
+}
+
+int pt_dense_set(int64_t table, const float* values, int64_t size) {
+  DenseTable* t = get_dense(table);
+  if (!t || (int64_t)t->w.size() != size) return -1;
+  std::lock_guard<std::mutex> lock(t->mu);
+  std::memcpy(t->w.data(), values, size * sizeof(float));
+  return 0;
+}
+
+int pt_sparse_pull(int64_t table, const int64_t* ids, int64_t n, float* out,
+                   int init_if_missing) {
+  SparseTable* t = get_sparse(table);
+  if (!t) return -1;
+  for (int64_t i = 0; i < n; i++) {
+    int64_t id = ids[i];
+    SparseShard& sh = t->shards[mix((uint64_t)id) % kShards];
+    std::lock_guard<std::mutex> lock(sh.mu);
+    if (init_if_missing) {
+      auto& row = ensure_row(t, sh, id);
+      std::memcpy(out + i * t->dim, row.data(), t->dim * sizeof(float));
+    } else {
+      auto it = sh.rows.find(id);
+      if (it == sh.rows.end())
+        std::memset(out + i * t->dim, 0, t->dim * sizeof(float));
+      else
+        std::memcpy(out + i * t->dim, it->second.data(),
+                    t->dim * sizeof(float));
+    }
+  }
+  return 0;
+}
+
+int pt_sparse_push(int64_t table, const int64_t* ids, int64_t n,
+                   const float* grads) {
+  SparseTable* t = get_sparse(table);
+  if (!t) return -1;
+  int64_t step = ++t->step;
+  for (int64_t i = 0; i < n; i++) {
+    int64_t id = ids[i];
+    SparseShard& sh = t->shards[mix((uint64_t)id) % kShards];
+    std::lock_guard<std::mutex> lock(sh.mu);
+    auto& row = ensure_row(t, sh, id);
+    apply_sparse_row(t, row, grads + i * t->dim, step);
+  }
+  return 0;
+}
+
+int64_t pt_sparse_dim(int64_t table) {
+  SparseTable* t = get_sparse(table);
+  return t ? t->dim : -1;
+}
+
+int64_t pt_sparse_size(int64_t table) {
+  SparseTable* t = get_sparse(table);
+  if (!t) return -1;
+  int64_t n = 0;
+  for (auto& sh : t->shards) {
+    std::lock_guard<std::mutex> lock(sh.mu);
+    n += (int64_t)sh.rows.size();
+  }
+  return n;
+}
+
+int pt_table_save(int64_t table, const char* path) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  if (DenseTable* t = get_dense(table)) {
+    std::lock_guard<std::mutex> lock(t->mu);
+    uint64_t kind = 0, n = t->w.size();
+    std::fwrite(&kind, 8, 1, f);
+    std::fwrite(&n, 8, 1, f);
+    std::fwrite(t->w.data(), 4, n, f);
+  } else if (SparseTable* t = get_sparse(table)) {
+    uint64_t kind = 1, dim = t->dim, width = t->row_width();
+    std::fwrite(&kind, 8, 1, f);
+    std::fwrite(&dim, 8, 1, f);
+    std::fwrite(&width, 8, 1, f);
+    for (auto& sh : t->shards) {
+      std::lock_guard<std::mutex> lock(sh.mu);
+      for (auto& kv : sh.rows) {
+        std::fwrite(&kv.first, 8, 1, f);
+        std::fwrite(kv.second.data(), 4, width, f);
+      }
+    }
+  } else {
+    std::fclose(f);
+    return -1;
+  }
+  std::fclose(f);
+  return 0;
+}
+
+int pt_table_load(int64_t table, const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  uint64_t kind;
+  if (std::fread(&kind, 8, 1, f) != 1) { std::fclose(f); return -1; }
+  int rc = 0;
+  if (kind == 0) {
+    DenseTable* t = get_dense(table);
+    uint64_t n;
+    if (!t || std::fread(&n, 8, 1, f) != 1 || n != t->w.size()) rc = -1;
+    else {
+      std::lock_guard<std::mutex> lock(t->mu);
+      rc = std::fread(t->w.data(), 4, n, f) == n ? 0 : -1;
+    }
+  } else {
+    SparseTable* t = get_sparse(table);
+    uint64_t dim, width;
+    if (!t || std::fread(&dim, 8, 1, f) != 1 ||
+        std::fread(&width, 8, 1, f) != 1 ||
+        (int64_t)dim != t->dim || width != t->row_width()) rc = -1;
+    else {
+      int64_t id;
+      std::vector<float> buf(width);
+      while (std::fread(&id, 8, 1, f) == 1) {
+        if (std::fread(buf.data(), 4, width, f) != width) { rc = -1; break; }
+        SparseShard& sh = t->shards[mix((uint64_t)id) % kShards];
+        std::lock_guard<std::mutex> lock(sh.mu);
+        sh.rows[id] = buf;
+      }
+    }
+  }
+  std::fclose(f);
+  return rc;
+}
+
+}  // extern "C"

@@ -1,0 +1,395 @@
+// PS TCP service: serves table pull/push over a length-prefixed binary
+// protocol — the brpc PS server/client equivalent (reference:
+// paddle/fluid/distributed/service/brpc_ps_server.h:40-97,
+// brpc_ps_client.cc, sendrecv.proto) without the brpc dependency.
+//
+// wire format (little-endian):
+//   request:  u32 body_len | u8 cmd | u8 table_idx | u64 n | payload
+//   response: u32 body_len | u8 status | payload
+// cmds: 1 dense_pull(n=size) 2 dense_push(payload f32[n])
+//       3 sparse_pull(payload i64[n]; resp f32[n*dim])
+//       4 sparse_push(payload i64[n] + f32[n*dim])
+//       5 barrier 6 save(payload path bytes) 7 stop
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "native_api.h"
+
+namespace {
+
+bool read_all(int fd, void* buf, size_t n) {
+  char* p = (char*)buf;
+  while (n) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  const char* p = (const char*)buf;
+  while (n) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::vector<int64_t> tables;
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+  std::atomic<bool> stop{false};
+  // barrier: all currently-connected clients must arrive
+  std::mutex bmu;
+  std::condition_variable bcv;
+  int barrier_waiting = 0;
+  uint64_t barrier_gen = 0;
+  std::atomic<int> n_clients{0};
+  std::mutex fds_mu;
+  std::vector<int> client_fds;
+
+  ~Server() { shutdown(); }
+
+  void shutdown() {
+    stop = true;
+    if (listen_fd >= 0) { ::shutdown(listen_fd, SHUT_RDWR); ::close(listen_fd); listen_fd = -1; }
+    {
+      // unblock handler threads parked in read() or the barrier wait
+      std::lock_guard<std::mutex> g(fds_mu);
+      for (int fd : client_fds) ::shutdown(fd, SHUT_RDWR);
+    }
+    bcv.notify_all();
+    if (accept_thread.joinable()) accept_thread.join();
+    for (auto& w : workers)
+      if (w.joinable()) w.join();
+    workers.clear();
+  }
+
+  void handle(int fd) {
+    n_clients++;
+    {
+      std::lock_guard<std::mutex> g(fds_mu);
+      client_fds.push_back(fd);
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::vector<char> body;
+    while (!stop) {
+      uint32_t len;
+      if (!read_all(fd, &len, 4)) break;
+      body.resize(len);
+      if (len && !read_all(fd, body.data(), len)) break;
+      if (len < 10) break;
+      uint8_t cmd = (uint8_t)body[0];
+      uint8_t tidx = (uint8_t)body[1];
+      uint64_t n;
+      std::memcpy(&n, body.data() + 2, 8);
+      const char* payload = body.data() + 10;
+      size_t payload_len = len - 10;
+      int64_t table = tidx < tables.size() ? tables[tidx] : -1;
+
+      std::vector<char> resp(1, 0);
+      auto fail = [&]() { resp.assign(1, 1); };
+      switch (cmd) {
+        case 1: {  // dense_pull
+          resp.resize(1 + n * 4);
+          if (pt_dense_pull(table, (float*)(resp.data() + 1), (int64_t)n))
+            fail();
+          break;
+        }
+        case 2:
+          if (payload_len != n * 4 ||
+              pt_dense_push(table, (const float*)payload, (int64_t)n))
+            fail();
+          break;
+        case 3: {  // sparse_pull: payload = i64 dim, i64 ids[n]
+          if (payload_len != 8 + n * 8) { fail(); break; }
+          int64_t dim;
+          std::memcpy(&dim, payload, 8);
+          if (dim != pt_sparse_dim(table)) { fail(); break; }  // config skew
+          resp.resize(1 + n * dim * 4);
+          if (pt_sparse_pull(table, (const int64_t*)(payload + 8), (int64_t)n,
+                             (float*)(resp.data() + 1), 1))
+            fail();
+          break;
+        }
+        case 4: {  // sparse_push: payload = i64 dim, i64 ids[n], f32 g[n*dim]
+          if (payload_len < 8 + n * 8) { fail(); break; }
+          int64_t dim;
+          std::memcpy(&dim, payload, 8);
+          if (dim != pt_sparse_dim(table) ||
+              payload_len != 8 + n * 8 + n * (uint64_t)dim * 4 ||
+              pt_sparse_push(table, (const int64_t*)(payload + 8), (int64_t)n,
+                             (const float*)(payload + 8 + n * 8)))
+            fail();
+          break;
+        }
+        case 5: {  // barrier across all connected clients
+          std::unique_lock<std::mutex> lk(bmu);
+          uint64_t gen = barrier_gen;
+          if (++barrier_waiting >= n_clients.load()) {
+            barrier_waiting = 0;
+            barrier_gen++;
+            bcv.notify_all();
+          } else {
+            bcv.wait(lk, [&] { return barrier_gen != gen || stop.load(); });
+          }
+          break;
+        }
+        case 6: {  // save
+          std::string path(payload, payload_len);
+          if (pt_table_save(table, path.c_str())) fail();
+          break;
+        }
+        case 7:
+          stop = true;
+          break;
+        default:
+          fail();
+      }
+      uint32_t rlen = (uint32_t)resp.size();
+      if (!write_all(fd, &rlen, 4) || !write_all(fd, resp.data(), rlen))
+        break;
+      if (cmd == 7) break;
+    }
+    ::close(fd);
+    {
+      std::lock_guard<std::mutex> g(fds_mu);
+      client_fds.erase(std::find(client_fds.begin(), client_fds.end(), fd));
+    }
+    n_clients--;
+    bcv.notify_all();
+  }
+};
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;
+
+  bool request(const std::vector<char>& body, std::vector<char>& resp) {
+    std::lock_guard<std::mutex> g(mu);
+    uint32_t len = (uint32_t)body.size();
+    if (!write_all(fd, &len, 4) || !write_all(fd, body.data(), len))
+      return false;
+    uint32_t rlen;
+    if (!read_all(fd, &rlen, 4)) return false;
+    resp.resize(rlen);
+    return rlen == 0 || read_all(fd, resp.data(), rlen);
+  }
+};
+
+std::mutex g_mu;
+std::unordered_map<int64_t, Server*> g_servers;
+std::unordered_map<int64_t, Client*> g_clients;
+int64_t g_next = 1;
+
+std::vector<char> make_req(uint8_t cmd, uint8_t tidx, uint64_t n,
+                           const void* payload, size_t payload_len) {
+  std::vector<char> b(10 + payload_len);
+  b[0] = (char)cmd;
+  b[1] = (char)tidx;
+  std::memcpy(b.data() + 2, &n, 8);
+  if (payload_len) std::memcpy(b.data() + 10, payload, payload_len);
+  return b;
+}
+
+Client* get_client(int64_t h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_clients.find(h);
+  return it == g_clients.end() ? nullptr : it->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t pt_server_start(int port, const int64_t* tables, int n_tables) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)port);
+  if (::bind(fd, (sockaddr*)&addr, sizeof(addr)) < 0 ||
+      ::listen(fd, 64) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, (sockaddr*)&addr, &alen);
+
+  auto* s = new Server();
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->tables.assign(tables, tables + n_tables);
+  s->accept_thread = std::thread([s] {
+    while (!s->stop) {
+      int cfd = ::accept(s->listen_fd, nullptr, nullptr);
+      if (cfd < 0) break;
+      s->workers.emplace_back([s, cfd] { s->handle(cfd); });
+    }
+  });
+  std::lock_guard<std::mutex> g(g_mu);
+  int64_t h = g_next++;
+  g_servers[h] = s;
+  return h;
+}
+
+void pt_server_stop(int64_t server) {
+  Server* s;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_servers.find(server);
+    if (it == g_servers.end()) return;
+    s = it->second;
+    g_servers.erase(it);
+  }
+  s->shutdown();
+  delete s;
+}
+
+int pt_server_port(int64_t server) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_servers.find(server);
+  return it == g_servers.end() ? -1 : it->second->port;
+}
+
+int64_t pt_client_connect(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  if (::connect(fd, (sockaddr*)&addr, sizeof(addr)) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto* c = new Client();
+  c->fd = fd;
+  std::lock_guard<std::mutex> g(g_mu);
+  int64_t h = g_next++;
+  g_clients[h] = c;
+  return h;
+}
+
+void pt_client_close(int64_t client) {
+  Client* c;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_clients.find(client);
+    if (it == g_clients.end()) return;
+    c = it->second;
+    g_clients.erase(it);
+  }
+  ::close(c->fd);
+  delete c;
+}
+
+int pt_client_dense_pull(int64_t client, int table_idx, float* out,
+                         int64_t size) {
+  Client* c = get_client(client);
+  if (!c) return -1;
+  std::vector<char> resp;
+  if (!c->request(make_req(1, (uint8_t)table_idx, (uint64_t)size, nullptr, 0),
+                  resp) ||
+      resp.size() != 1 + (size_t)size * 4 || resp[0] != 0)
+    return -1;
+  std::memcpy(out, resp.data() + 1, size * 4);
+  return 0;
+}
+
+int pt_client_dense_push(int64_t client, int table_idx, const float* grad,
+                         int64_t size) {
+  Client* c = get_client(client);
+  if (!c) return -1;
+  std::vector<char> resp;
+  if (!c->request(make_req(2, (uint8_t)table_idx, (uint64_t)size, grad,
+                           size * 4), resp) ||
+      resp.empty() || resp[0] != 0)
+    return -1;
+  return 0;
+}
+
+int pt_client_sparse_pull(int64_t client, int table_idx, const int64_t* ids,
+                          int64_t n, float* out, int64_t emb_dim) {
+  Client* c = get_client(client);
+  if (!c) return -1;
+  std::vector<char> payload(8 + n * 8);
+  std::memcpy(payload.data(), &emb_dim, 8);
+  std::memcpy(payload.data() + 8, ids, n * 8);
+  std::vector<char> resp;
+  if (!c->request(make_req(3, (uint8_t)table_idx, (uint64_t)n,
+                           payload.data(), payload.size()), resp) ||
+      resp.size() != 1 + (size_t)(n * emb_dim) * 4 || resp[0] != 0)
+    return -1;
+  std::memcpy(out, resp.data() + 1, n * emb_dim * 4);
+  return 0;
+}
+
+int pt_client_sparse_push(int64_t client, int table_idx, const int64_t* ids,
+                          int64_t n, const float* grads, int64_t emb_dim) {
+  Client* c = get_client(client);
+  if (!c) return -1;
+  std::vector<char> payload(8 + n * 8 + n * emb_dim * 4);
+  std::memcpy(payload.data(), &emb_dim, 8);
+  std::memcpy(payload.data() + 8, ids, n * 8);
+  std::memcpy(payload.data() + 8 + n * 8, grads, n * emb_dim * 4);
+  std::vector<char> resp;
+  if (!c->request(make_req(4, (uint8_t)table_idx, (uint64_t)n,
+                           payload.data(), payload.size()), resp) ||
+      resp.empty() || resp[0] != 0)
+    return -1;
+  return 0;
+}
+
+int pt_client_barrier(int64_t client) {
+  Client* c = get_client(client);
+  if (!c) return -1;
+  std::vector<char> resp;
+  if (!c->request(make_req(5, 0, 0, nullptr, 0), resp) || resp.empty() ||
+      resp[0] != 0)
+    return -1;
+  return 0;
+}
+
+int pt_client_save(int64_t client, int table_idx, const char* path) {
+  Client* c = get_client(client);
+  if (!c) return -1;
+  std::vector<char> resp;
+  if (!c->request(make_req(6, (uint8_t)table_idx, 0, path,
+                           std::strlen(path)), resp) ||
+      resp.empty() || resp[0] != 0)
+    return -1;
+  return 0;
+}
+
+}  // extern "C"

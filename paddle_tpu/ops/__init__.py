@@ -5,3 +5,4 @@ and operators/math/bert_encoder_functor.cu; here the analog is Pallas TPU
 kernels with jnp reference fallbacks (used on CPU and for numerics tests).
 """
 from . import attention  # noqa: F401
+from . import ring_attention  # noqa: F401

@@ -1,0 +1,178 @@
+"""Parameter-server stack: native C++ tables + TCP service + fleet PS mode
++ InMemoryDataset + wide&deep/DeepFM sparse training.
+
+Reference: SURVEY §2.6 (brpc PS tables/services), §2.9 (a_sync strategy,
+fleet dataset), north-star "Sparse" config in BASELINE.md.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import ps
+from paddle_tpu.distributed.fleet.dataset import InMemoryDataset
+from paddle_tpu.incubate import rec
+
+
+@pytest.fixture()
+def ctr_data(tmp_path):
+    return rec.synthetic_ctr_files(str(tmp_path), n_files=2,
+                                   rows_per_file=300)
+
+
+def _table_cfgs(dim=8):
+    return rec.make_ps_tables(emb_dim=dim, optimizer="adagrad", lr=0.1)
+
+
+class TestNativeTables:
+    def test_dense_sgd(self):
+        c = ps.LocalPSClient([ps.TableConfig("w", False, size=4,
+                                             optimizer="sgd", lr=0.5)])
+        c.set_dense(0, np.array([1, 2, 3, 4], np.float32))
+        c.push_dense(0, np.ones(4, np.float32))
+        np.testing.assert_allclose(c.pull_dense(0), [0.5, 1.5, 2.5, 3.5])
+        c.close()
+
+    def test_sparse_deterministic_init(self):
+        c = ps.LocalPSClient([ps.TableConfig("e", True, emb_dim=4, seed=7)])
+        a = c.pull_sparse(0, np.array([11, 12, 11]))
+        assert np.allclose(a[0], a[2]) and not np.allclose(a[0], a[1])
+        c.close()
+
+    def test_sparse_push_changes_rows(self):
+        c = ps.LocalPSClient([ps.TableConfig("e", True, emb_dim=4,
+                                             optimizer="sgd", lr=1.0)])
+        ids = np.array([3, 4])
+        before = c.pull_sparse(0, ids)
+        c.push_sparse(0, ids, np.ones((2, 4), np.float32))
+        after = c.pull_sparse(0, ids)
+        np.testing.assert_allclose(after, before - 1.0, atol=1e-6)
+        c.close()
+
+    def test_save_load_roundtrip(self, tmp_path):
+        c = ps.LocalPSClient([ps.TableConfig("e", True, emb_dim=4)])
+        ids = np.array([1, 2, 3])
+        rows = c.pull_sparse(0, ids)
+        path = str(tmp_path / "t.bin")
+        assert c.save(0, path)
+        c2 = ps.LocalPSClient([ps.TableConfig("e", True, emb_dim=4, seed=9)])
+        assert c2.load(0, path)
+        np.testing.assert_allclose(c2.pull_sparse(0, ids), rows)
+        c.close(); c2.close()
+
+
+class TestPSService:
+    def test_rpc_pull_push(self):
+        cfgs = _table_cfgs()
+        server = ps.PSServer(cfgs, port=0)
+        try:
+            client = ps.RpcPSClient(cfgs, port=server.port)
+            ids = np.array([7, 8])
+            rows = client.pull_sparse(1, ids)
+            assert rows.shape == (2, 8)
+            client.push_sparse(1, ids, np.ones((2, 8), np.float32))
+            rows2 = client.pull_sparse(1, ids)
+            assert not np.allclose(rows, rows2)
+            client.barrier()
+            client.close()
+        finally:
+            server.stop()
+
+    def test_server_stop_with_connected_client(self):
+        # shutdown must unblock handler threads parked in read()
+        cfgs = _table_cfgs()
+        server = ps.PSServer(cfgs, port=0)
+        client = ps.RpcPSClient(cfgs, port=server.port)
+        client.pull_sparse(1, np.array([1]))
+        import threading, time
+        done = threading.Event()
+        t = threading.Thread(target=lambda: (server.stop(), done.set()))
+        t.start()
+        assert done.wait(timeout=10), "server.stop() hung with open client"
+        t.join()
+        client.close()
+
+    def test_fleet_ps_mode(self):
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet import (
+            Role, UserDefinedRoleMaker)
+
+        cfgs = _table_cfgs()
+        # server side
+        server_fleet = fleet.Fleet()
+        server_fleet.init(role_maker=UserDefinedRoleMaker(
+            role=Role.SERVER, server_endpoints=["127.0.0.1:0"]))
+        server_fleet.set_ps_tables(cfgs)
+        srv = server_fleet.init_server()
+        try:
+            # worker side
+            worker_fleet = fleet.Fleet()
+            worker_fleet.init(role_maker=UserDefinedRoleMaker(
+                role=Role.WORKER, worker_num=1,
+                server_endpoints=[f"127.0.0.1:{srv.port}"]))
+            assert worker_fleet.is_worker() and not worker_fleet.is_server()
+            worker_fleet.set_ps_tables(cfgs)
+            client = worker_fleet.init_worker()
+            out = client.pull_sparse(1, np.array([1, 2]))
+            assert out.shape == (2, 8)
+            worker_fleet.stop_worker()
+        finally:
+            server_fleet.stop_server()
+
+
+class TestDataset:
+    def test_inmemory_load_shuffle_iterate(self, ctr_data):
+        ds = InMemoryDataset()
+        ds.init(batch_size=32, slots=["user", "item"], max_per_slot=3,
+                pad_id=-1)
+        ds.set_filelist(ctr_data)
+        n = ds.load_into_memory()
+        assert n == 600
+        ds.local_shuffle(seed=1)
+        total = 0
+        for labels, slot_ids in ds:
+            assert set(slot_ids) == {"user", "item"}
+            assert slot_ids["user"].shape[1] == 3
+            total += len(labels)
+        assert total == 600
+        # release_memory drops records but keeps the dataset reloadable
+        ds.release_memory()
+        assert ds.load_into_memory() == 600
+        ds.set_batch_size(16)
+        labels, _ = next(iter(ds))
+        assert len(labels) == 16
+        ds.destroy()
+
+
+class TestSparseModels:
+    def _train(self, model_cls, ctr_data, **kwargs):
+        paddle.seed(0)
+        cfgs = _table_cfgs()
+        client = ps.LocalPSClient(cfgs)
+        ds = InMemoryDataset()
+        ds.init(batch_size=64, slots=["user", "item"], max_per_slot=3,
+                pad_id=-1)
+        ds.set_filelist(ctr_data)
+        ds.load_into_memory()
+        model = model_cls(client, ["user", "item"], emb_dim=8)
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=model.parameters())
+        bce = nn.BCEWithLogitsLoss()
+        losses = []
+        for epoch in range(3):
+            ds.local_shuffle(seed=epoch)
+            for labels, slot_ids in ds:
+                loss = bce(model(slot_ids), paddle.to_tensor(labels))
+                loss.backward()
+                opt.step(); opt.clear_grad()
+                losses.append(float(loss.numpy()))
+        client.close()
+        return losses
+
+    def test_widedeep_learns(self, ctr_data):
+        losses = self._train(rec.WideDeep, ctr_data)
+        assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.08
+
+    def test_deepfm_learns(self, ctr_data):
+        losses = self._train(rec.DeepFM, ctr_data)
+        assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.08
