@@ -97,7 +97,9 @@ def main():
 
     mesh = topology.build_mesh(dp=1)
     topology.set_global_mesh(mesh)
-    step_fn, init_fn = spmd.build_train_step(wrapper, loss_fn, opt, mesh=mesh)
+    amp_level = os.environ.get("BENCH_AMP", "O1")  # bf16 mixed precision
+    step_fn, init_fn = spmd.build_train_step(wrapper, loss_fn, opt, mesh=mesh,
+                                             amp_level=amp_level)
     params, opt_state = init_fn()
 
     rng = np.random.RandomState(0)
@@ -118,14 +120,14 @@ def main():
     log(f"warmup done in {time.time() - t0:.1f}s, loss={float(loss):.4f}")
 
     t0 = time.time()
-    STEPS = max(1, STEPS)
-    for i in range(STEPS):
+    steps = max(1, STEPS)
+    for i in range(steps):
         loss, params, opt_state = step_fn(params, opt_state, ids, labels,
                                           key=jax.random.fold_in(key, 100 + i))
     jax.block_until_ready(loss)
     dt = time.time() - t0
-    tokens_per_sec = batch * seq * STEPS / dt
-    log(f"{STEPS} steps in {dt:.2f}s -> {tokens_per_sec:.0f} tokens/s, "
+    tokens_per_sec = batch * seq * steps / dt
+    log(f"{steps} steps in {dt:.2f}s -> {tokens_per_sec:.0f} tokens/s, "
         f"final loss {float(loss):.4f}")
 
     print(json.dumps({

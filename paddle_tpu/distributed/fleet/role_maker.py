@@ -20,6 +20,7 @@ class RoleMakerBase:
         self._worker_num = 1
         self._server_endpoints = []
         self._worker_endpoints = []
+        self._is_collective = False
 
     def is_worker(self):
         return self._role == Role.WORKER
@@ -83,8 +84,10 @@ class UserDefinedRoleMaker(RoleMakerBase):
     """Explicit construction (reference role_maker.py UserDefinedRoleMaker)."""
 
     def __init__(self, current_id=0, role=Role.WORKER, worker_num=1,
-                 server_endpoints=None, worker_endpoints=None, **kwargs):
+                 server_endpoints=None, worker_endpoints=None,
+                 is_collective=False, **kwargs):
         super().__init__()
+        self._is_collective = bool(is_collective)
         self._current_id = current_id
         self._role = role
         self._worker_num = worker_num

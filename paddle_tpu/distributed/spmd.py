@@ -16,6 +16,7 @@ with:
   ``shard_optimizer=True``,
 - optional jax.checkpoint (recompute) around the loss fn.
 """
+import contextlib
 import functools
 
 import numpy as np
@@ -56,25 +57,41 @@ def _zero1_spec(arr, mesh, axes=("dp", "sharding")):
 
 
 def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
-                     shard_optimizer=False, donate=True):
+                     shard_optimizer=False, donate=True, amp_level="O0",
+                     amp_dtype="bfloat16"):
     """Compile the full distributed training step for `layer`.
 
     loss_fn(model_out, label_array) -> scalar (pure jnp).
     Returns (step_fn, init_fn) where init_fn() -> (params, opt_state) as
     properly-sharded global arrays, and
     step_fn(params, opt_state, x, y, key, lr) -> (loss, params, opt_state).
+
+    amp_level "O1"/"O2" traces the forward under ``paddle.amp.auto_cast``
+    (white/black-listed op casting, reference amp_auto_cast.cc) with
+    fp32 master weights; bf16 needs no loss scaling on TPU, and grads come
+    out fp32 via the loss. The cast decision is trace-time, so the compiled
+    step has bf16 matmuls on the MXU with no per-step Python cost.
     """
     mesh = mesh or topology.get_global_mesh()
     params0, buffers0 = layer.functional_state()
     param_names = list(params0)
     buffer_names = list(buffers0)
     p_shardings = param_sharding_spec(layer, mesh)
+    if amp_level not in ("O0", "O1", "O2"):
+        raise ValueError(f"amp_level must be 'O0'|'O1'|'O2', got {amp_level!r}")
+    amp_enabled = amp_level in ("O1", "O2")
 
     def forward_loss(params, buffers, x, y, key):
         saved_p = {n: p._value for n, p in layer.named_parameters()}
         saved_b = dict(buffers0)
         try:
-            with dispatch.trace_mode(), random_core.rng_guard(key):
+            with contextlib.ExitStack() as stack:
+                stack.enter_context(dispatch.trace_mode())
+                stack.enter_context(random_core.rng_guard(key))
+                if amp_enabled:
+                    from ..amp.auto_cast import auto_cast as _auto_cast
+                    stack.enter_context(_auto_cast(
+                        enable=True, level=amp_level, dtype=amp_dtype))
                 layer.load_functional_state(params, buffers)
                 out = layer.forward(Tensor(x, stop_gradient=True))
                 out_arr = out._value if isinstance(out, Tensor) else out

@@ -1,0 +1,18 @@
+"""paddle.inference — deployment API (reference: paddle/fluid/inference/
+api/analysis_predictor.cc AnalysisPredictor, api/paddle_api.h,
+paddle_inference_api.h Config/Predictor/Tensor).
+
+TPU-native design: the reference's analysis pipeline (ir fusion passes,
+memory-optimize, TensorRT/Lite subgraph capture) collapses into XLA —
+models are stored as serialized StableHLO (jax.export) produced by
+``paddle.jit.save`` / ``paddle.static.save_inference_model``, and the
+predictor compiles them once per input-shape signature, then runs with
+device-resident inputs/outputs (the ZeroCopyRun analog).
+"""
+from .config import Config, PrecisionType, PlaceType
+from .predictor import Predictor, Tensor as PredictorTensor, create_predictor
+
+__all__ = [
+    "Config", "Predictor", "PredictorTensor", "create_predictor",
+    "PrecisionType", "PlaceType",
+]

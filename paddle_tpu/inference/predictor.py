@@ -1,0 +1,181 @@
+"""Predictor — the AnalysisPredictor analog (reference:
+paddle/fluid/inference/api/analysis_predictor.cc: Init -> load program ->
+OptimizeInferenceProgram -> PrepareExecutor(NaiveExecutor) -> Run /
+ZeroCopyRun:860; handle API paddle_api.h ZeroCopyTensor).
+
+TPU-native: "load program" = deserialize StableHLO (jax.export) saved by
+``paddle.jit.save``; "analysis passes + NaiveExecutor" = XLA compile of
+that module, cached per input-shape signature; "ZeroCopyRun" = inputs
+stay device-resident between copy_from_cpu and run, outputs are fetched
+lazily by copy_to_cpu.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .config import Config, PrecisionType
+
+
+class Tensor:
+    """Input/output handle (reference: ZeroCopyTensor, paddle_api.h)."""
+
+    def __init__(self, name, role, predictor):
+        self._name = name
+        self._role = role  # "input" | "output"
+        self._pred = predictor
+        self._shape = None
+
+    def name(self):
+        return self._name
+
+    def reshape(self, shape):
+        self._shape = tuple(int(s) for s in shape)
+
+    def copy_from_cpu(self, arr):
+        if self._role != "input":
+            raise RuntimeError(f"{self._name} is an output handle")
+        arr = np.asarray(arr)
+        if self._shape is not None:
+            arr = arr.reshape(self._shape)
+        # device upload happens here, once — run() consumes the resident copy
+        self._pred._inputs[self._name] = jax.device_put(arr, self._pred._device)
+
+    def share_external_data(self, arr):
+        """Adopt an already-device-resident (or numpy) array without copy."""
+        if self._role != "input":
+            raise RuntimeError(f"{self._name} is an output handle")
+        self._pred._inputs[self._name] = (
+            arr if isinstance(arr, jax.Array) else jnp.asarray(arr))
+
+    def copy_to_cpu(self):
+        if self._role != "output":
+            raise RuntimeError(f"{self._name} is an input handle")
+        out = self._pred._outputs.get(self._name)
+        if out is None:
+            raise RuntimeError("run() has not produced outputs yet")
+        return np.asarray(out)
+
+    def shape(self):
+        src = (self._pred._inputs if self._role == "input"
+               else self._pred._outputs)
+        a = src.get(self._name)
+        if a is not None:
+            return list(a.shape)
+        return list(self._shape) if self._shape else []
+
+    def type(self):
+        src = (self._pred._inputs if self._role == "input"
+               else self._pred._outputs)
+        a = src.get(self._name)
+        return str(a.dtype) if a is not None else "unknown"
+
+
+class Predictor:
+    """reference: AnalysisPredictor. Load once, run many; clone() shares the
+    loaded module + weights and gets its own input/output slots (the
+    reference's thread-sharing pattern, analysis_predictor.cc Clone)."""
+
+    def __init__(self, config, _shared=None):
+        self._config = config
+        if _shared is not None:
+            (self._layer, self._in_names, self._out_names, self._device) = _shared
+        else:
+            from ..jit import load as jit_load
+
+            prefix = config.model_prefix()
+            self._layer = jit_load(prefix)
+            self._in_names = [f"x{i}"
+                              for i in range(len(self._layer._input_specs))]
+            self._out_names = None  # discovered at first run
+            self._device = self._pick_device()
+            # commit weights to the chosen device once; run() then never
+            # re-transfers the parameter set (ZeroCopyRun property)
+            self._layer.to_device(self._device)
+        self._inputs = {}
+        self._outputs = {}
+
+    # ----------------------------------------------------------- internals
+    def _pick_device(self):
+        kind = "cpu" if not self._config.use_gpu() else None
+        devs = jax.devices()
+        if kind == "cpu":
+            cpus = [d for d in devs if d.platform == "cpu"]
+            if cpus:
+                return cpus[0]
+        return devs[min(self._config.gpu_device_id(), len(devs) - 1)]
+
+    # Note on precision: it is a compile/save-time property under XLA — a
+    # serialized StableHLO module has fixed input avals, so runtime input
+    # casting would be rejected by exported.call. bf16/int8 serving comes
+    # from saving the model under amp.auto_cast / quantization instead; the
+    # Config knob is kept for introspection only.
+
+    # ----------------------------------------------------------- handle API
+    def get_input_names(self):
+        return list(self._in_names)
+
+    def get_output_names(self):
+        if self._out_names is None:
+            return []
+        return list(self._out_names)
+
+    def get_input_handle(self, name):
+        if name not in self._in_names:
+            raise KeyError(f"unknown input {name!r}; inputs: {self._in_names}")
+        return Tensor(name, "input", self)
+
+    def get_input_tensor(self, name):  # 1.x spelling
+        return self.get_input_handle(name)
+
+    def get_output_handle(self, name):
+        if self._out_names is not None and name not in self._out_names:
+            raise KeyError(
+                f"unknown output {name!r}; outputs: {self._out_names}")
+        return Tensor(name, "output", self)
+
+    def get_output_tensor(self, name):
+        return self.get_output_handle(name)
+
+    # ----------------------------------------------------------- run
+    def run(self, inputs=None):
+        """ZeroCopyRun analog. With `inputs` (list of numpy arrays) behaves
+        like the reference's Run(feed) convenience; otherwise consumes
+        handles set via copy_from_cpu."""
+        if inputs is not None:
+            if len(inputs) != len(self._in_names):
+                raise ValueError(
+                    f"run() got {len(inputs)} inputs, model has "
+                    f"{len(self._in_names)}: {self._in_names}")
+            for name, arr in zip(self._in_names, inputs):
+                self.get_input_handle(name).copy_from_cpu(arr)
+        missing = [n for n in self._in_names if n not in self._inputs]
+        if missing:
+            raise RuntimeError(f"inputs not set: {missing}")
+        args = [self._inputs[n] for n in self._in_names]
+        out = self._layer(*args)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        arrays = [o._value if hasattr(o, "_value") else o for o in outs]
+        if self._out_names is None:
+            self._out_names = [f"out{i}" for i in range(len(arrays))]
+        self._outputs = dict(zip(self._out_names, arrays))
+        if inputs is not None:
+            return [np.asarray(a) for a in arrays]
+        return True
+
+    def clone(self):
+        shared = (self._layer, self._in_names, self._out_names, self._device)
+        return Predictor(self._config, _shared=shared)
+
+    def clear_intermediate_tensor(self):
+        self._outputs = {}
+
+    def try_shrink_memory(self):
+        self._inputs = {}
+        self._outputs = {}
+
+
+def create_predictor(config):
+    """reference: CreatePaddlePredictor / paddle_infer::CreatePredictor."""
+    if not isinstance(config, Config):
+        raise TypeError("create_predictor expects an inference.Config")
+    return Predictor(config)

@@ -65,16 +65,26 @@ def save(layer, path, input_spec=None, **configs):
     param_specs = [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in params.values()]
     buffer_specs = [jax.ShapeDtypeStruct(b.shape, b.dtype) for b in buffers.values()]
 
+    write_artifacts(path, jitted, (param_specs, buffer_specs), specs,
+                    {n: np.asarray(a) for n, a in params.items()},
+                    {n: np.asarray(a) for n, a in buffers.items()})
+
+
+def write_artifacts(path, jitted_fn, state_specs, input_specs, params, buffers):
+    """Serialize the single on-disk model format (<prefix>.pdmodel StableHLO +
+    .pdiparams pickle + .pdmeta.json sidecar) shared by jit.save and
+    static.save_inference_model. ``jitted_fn(params_like, buffers_like,
+    *inputs)``; state_specs = (param_specs, buffer_specs)."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     payload = {
-        "params": {n: np.asarray(a) for n, a in params.items()},
-        "buffers": {n: np.asarray(a) for n, a in buffers.items()},
-        "input_specs": [(list(s.shape), str(s.dtype)) for s in specs],
+        "params": params,
+        "buffers": buffers,
+        "input_specs": [(list(s.shape), str(s.dtype)) for s in input_specs],
     }
     try:
         from jax import export as jax_export
 
-        exported = jax_export.export(jitted)(param_specs, buffer_specs, *specs)
+        exported = jax_export.export(jitted_fn)(*state_specs, *input_specs)
         blob = exported.serialize()
         with open(path + ".pdmodel", "wb") as f:
             f.write(blob)
@@ -92,15 +102,24 @@ def save(layer, path, input_spec=None, **configs):
 class TranslatedLayer(Layer):
     """Loaded inference layer (reference: dygraph/io.py TranslatedLayer)."""
 
-    def __init__(self, call_fn, params, buffers):
+    def __init__(self, call_fn, params, buffers, input_specs=None):
         super().__init__()
         self._call_fn = call_fn
         self._loaded_params = params
         self._loaded_buffers = buffers
+        self._input_specs = input_specs or []
         for i, (n, a) in enumerate(params.items()):
             from ..core.tensor import Parameter
 
             self.add_parameter(f"p_{i}", Parameter(jnp.asarray(a), name=n))
+
+    def to_device(self, device):
+        """Commit weights/buffers to `device` (a jax.Device) once, so run()
+        never re-transfers them (Predictor device placement)."""
+        for p in self._parameters.values():
+            p._value = jax.device_put(p._value, device)
+        self._loaded_buffers = {n: jax.device_put(jnp.asarray(b), device)
+                                for n, b in self._loaded_buffers.items()}
 
     def forward(self, *inputs):
         param_list = [p._value for p in self._parameters.values()]
@@ -127,7 +146,8 @@ def load(path, **configs):
         def call_fn(param_list, buffer_list, *inputs):
             return exported.call(param_list, buffer_list, *inputs)
 
-        return TranslatedLayer(call_fn, params, buffers)
+        return TranslatedLayer(call_fn, params, buffers,
+                               input_specs=payload.get("input_specs", []))
     raise RuntimeError(
         f"model at {path} was saved without a serialized program "
         f"({payload.get('export_error')}); re-save with a supported spec")

@@ -34,12 +34,15 @@ def build_lib(verbose=False):
         return lib_path
     os.makedirs(build_dir, exist_ok=True)
     srcs = [os.path.join(_DIR, s) for s in _SOURCES]
+    # per-process temp name: concurrent builds (PS server + worker procs on
+    # one host) must not interleave writes before the atomic rename
+    tmp_path = f"{lib_path}.{os.getpid()}.tmp"
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           "-I", _DIR, "-o", lib_path + ".tmp"] + srcs
+           "-I", _DIR, "-o", tmp_path] + srcs
     if verbose:
         print("building native lib:", " ".join(cmd))
     subprocess.run(cmd, check=True, capture_output=not verbose)
-    os.replace(lib_path + ".tmp", lib_path)
+    os.replace(tmp_path, lib_path)
     return lib_path
 
 

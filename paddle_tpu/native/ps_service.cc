@@ -178,7 +178,16 @@ struct Server {
       std::lock_guard<std::mutex> g(fds_mu);
       client_fds.erase(std::find(client_fds.begin(), client_fds.end(), fd));
     }
-    n_clients--;
+    {
+      // a departing client must release a barrier the remaining clients can
+      // now satisfy, or the waiters' predicate never flips and they hang
+      std::lock_guard<std::mutex> lk(bmu);
+      n_clients--;
+      if (barrier_waiting > 0 && barrier_waiting >= n_clients.load()) {
+        barrier_waiting = 0;
+        barrier_gen++;
+      }
+    }
     bcv.notify_all();
   }
 };

@@ -269,6 +269,9 @@ def _conv_nd(x, w, b, *, stride, padding, dilation, groups, data_format, nd):
     else:
         pad = [(p, p) for p in padding] if not isinstance(padding[0], (list, tuple)) \
             else [tuple(p) for p in padding]
+    # no preferred_element_type: the MXU accumulates bf16 convs in fp32 in
+    # hardware, and mixed primitive-output dtype breaks the conv transpose
+    # rule under value_and_grad (cotangent fp32 vs bf16 operands)
     y = jax.lax.conv_general_dilated(
         x, w,
         window_strides=stride,
@@ -276,10 +279,7 @@ def _conv_nd(x, w, b, *, stride, padding, dilation, groups, data_format, nd):
         rhs_dilation=dilation,
         dimension_numbers=(dn_in, dn_kernel, dn_out),
         feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
     )
-    if y.dtype != x.dtype:
-        y = y.astype(x.dtype)
     if b is not None:
         shape = [1] * y.ndim
         shape[1 if chan_first else -1] = b.size

@@ -310,16 +310,50 @@ def device_guard(device=None):
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, program=None):
-    raise NotImplementedError(
-        "static save_inference_model: use paddle.jit.save on a Layer "
-        "(StableHLO export) — the static facade stores no ProgramDesc")
+    """reference: static/io.py save_inference_model (prune program to
+    feed/fetch + save persistables via save ops). TPU-native: export the
+    recorded program's replay closed over feeds/fetches as StableHLO in the
+    jit.save format, so ``load_inference_model`` / ``inference.Predictor``
+    can serve it."""
+    from ..jit.save_load import write_artifacts
+
+    program = program or default_main_program()
+    if not isinstance(feed_vars, (list, tuple)):
+        feed_vars = [feed_vars]
+    if not isinstance(fetch_vars, (list, tuple)):
+        fetch_vars = [fetch_vars]
+    placeholder_ids = [id(v) for v in feed_vars]
+    param_items = sorted(program.params.items())
+    param_ids = [pid for pid, _ in param_items]
+    param_tensors = [p for _, p in param_items]
+    fetch_ids = [id(f) for f in fetch_vars]
+
+    def infer_fn(param_list, buffer_list, *feeds):
+        del buffer_list  # static programs carry no buffers
+        with dispatch.trace_mode():
+            env = program._replay(list(param_list), list(feeds),
+                                  placeholder_ids, param_ids)
+        return tuple(env[fid] for fid in fetch_ids)
+
+    param_names = [getattr(p, "name", None) or f"param_{i}"
+                   for i, p in enumerate(param_tensors)]
+    param_arrays = [p._value for p in param_tensors]
+    param_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in param_arrays]
+    feed_specs = [jax.ShapeDtypeStruct(v._value.shape, v._value.dtype)
+                  for v in feed_vars]
+    write_artifacts(path_prefix, jax.jit(infer_fn), (param_specs, []), feed_specs,
+                    {n: np.asarray(a) for n, a in zip(param_names, param_arrays)},
+                    {})
 
 
 def load_inference_model(path_prefix, executor):
+    """Returns [program(callable layer), feed_target_names, fetch_targets]
+    (reference: static/io.py load_inference_model)."""
     from ..jit import load as jit_load
 
     layer = jit_load(path_prefix)
-    return [layer, [], []]
+    feed_names = [f"x{i}" for i in range(len(layer._input_specs))]
+    return [layer, feed_names, []]
 
 
 def normalize_program(program, feed_vars, fetch_vars):

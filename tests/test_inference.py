@@ -1,0 +1,202 @@
+"""Inference stack tests (reference test analog:
+paddle/fluid/inference/tests/api/ analyzer tests + python inference API
+tests): save via jit.save / static.save_inference_model, serve via
+Config/create_predictor/Predictor, handle API, clone, precision.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference, nn
+from paddle_tpu.static import InputSpec
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 3)
+
+    def forward(self, x):
+        return nn.functional.softmax(self.fc2(nn.functional.relu(self.fc1(x))),
+                                     axis=-1)
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    paddle.seed(7)
+    m = SmallNet()
+    m.eval()
+    prefix = str(tmp_path_factory.mktemp("infer") / "model")
+    paddle.jit.save(m, prefix, input_spec=[InputSpec([4, 8], "float32")])
+    x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    import jax.numpy as jnp
+    from paddle_tpu.core.tensor import Tensor
+
+    expected = np.asarray(m(Tensor(jnp.asarray(x)))._value)
+    return prefix, x, expected
+
+
+class TestConfig:
+    def test_prefix_roundtrip(self, saved_model):
+        prefix, _, _ = saved_model
+        cfg = inference.Config(prefix)
+        assert cfg.model_prefix() == prefix
+        assert cfg.is_valid()
+
+    def test_dir_discovery(self, saved_model):
+        prefix, _, _ = saved_model
+        cfg = inference.Config(os.path.dirname(prefix))
+        assert cfg.model_prefix() == prefix
+
+    def test_device_switches(self, saved_model):
+        prefix, _, _ = saved_model
+        cfg = inference.Config(prefix)
+        cfg.disable_gpu()
+        assert not cfg.use_gpu()
+        cfg.enable_use_gpu(100, 0)
+        assert cfg.use_gpu()
+        assert "model_prefix" in cfg.summary()
+
+    def test_engine_knobs_recorded(self, saved_model):
+        prefix, _, _ = saved_model
+        cfg = inference.Config(prefix)
+        cfg.enable_tensorrt_engine(precision_mode=inference.PrecisionType.Bfloat16)
+        assert cfg.tensorrt_engine_enabled()
+        assert cfg.precision() == inference.PrecisionType.Bfloat16
+
+
+class TestPredictor:
+    def test_handle_roundtrip(self, saved_model):
+        prefix, x, expected = saved_model
+        cfg = inference.Config(prefix)
+        cfg.disable_gpu()
+        pred = inference.create_predictor(cfg)
+        names = pred.get_input_names()
+        assert names == ["x0"]
+        h = pred.get_input_handle("x0")
+        h.copy_from_cpu(x)
+        assert h.shape() == [4, 8]
+        assert pred.run()
+        out_name = pred.get_output_names()[0]
+        out = pred.get_output_handle(out_name).copy_to_cpu()
+        np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-5)
+
+    def test_run_list_convenience(self, saved_model):
+        prefix, x, expected = saved_model
+        pred = inference.create_predictor(inference.Config(prefix))
+        outs = pred.run([x])
+        np.testing.assert_allclose(outs[0], expected, rtol=1e-5, atol=1e-5)
+
+    def test_clone_shares_weights(self, saved_model):
+        prefix, x, expected = saved_model
+        pred = inference.create_predictor(inference.Config(prefix))
+        pred.run([x])
+        c = pred.clone()
+        outs = c.run([x])
+        np.testing.assert_allclose(outs[0], expected, rtol=1e-5, atol=1e-5)
+
+    def test_bad_input_name(self, saved_model):
+        prefix, _, _ = saved_model
+        pred = inference.create_predictor(inference.Config(prefix))
+        with pytest.raises(KeyError):
+            pred.get_input_handle("nope")
+
+    def test_missing_feed_raises(self, saved_model):
+        prefix, _, _ = saved_model
+        pred = inference.create_predictor(inference.Config(prefix))
+        with pytest.raises(RuntimeError):
+            pred.run()
+
+
+class TestStaticSaveInference:
+    def test_static_save_load(self, tmp_path):
+        from paddle_tpu import static
+
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            startup = static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [4, 6], "float32")
+                w = paddle.create_parameter([6, 3], "float32", name="w_si")
+                y = paddle.matmul(x, w)
+            exe = static.Executor()
+            prefix = str(tmp_path / "static_model")
+            static.save_inference_model(prefix, [x], [y], exe, program=main)
+            assert os.path.exists(prefix + ".pdmodel")
+
+            layer, feed_names, _ = static.load_inference_model(prefix, exe)
+            assert feed_names == ["x0"]
+            xv = np.random.RandomState(1).randn(4, 6).astype(np.float32)
+            out = layer(xv)
+            arr = np.asarray(out._value if hasattr(out, "_value") else out)
+            assert arr.shape == (4, 3)
+        finally:
+            paddle.disable_static()
+
+    def test_predictor_serves_static_model(self, tmp_path):
+        from paddle_tpu import static
+
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main):
+                x = static.data("x", [2, 5], "float32")
+                w = paddle.create_parameter([5, 2], "float32", name="w_si2")
+                y = paddle.matmul(x, w)
+            exe = static.Executor()
+            prefix = str(tmp_path / "static_model2")
+            static.save_inference_model(prefix, [x], [y], exe, program=main)
+        finally:
+            paddle.disable_static()
+        pred = inference.create_predictor(inference.Config(prefix))
+        outs = pred.run([np.ones((2, 5), np.float32)])
+        assert outs[0].shape == (2, 2)
+
+
+class TestAmpTrainStep:
+    @pytest.mark.parametrize("level", ["O1", "O2"])
+    def test_spmd_amp_levels(self, level):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu import optimizer
+        from paddle_tpu.distributed import spmd, topology
+
+        paddle.seed(0)
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.l1 = nn.Linear(16, 32)
+                self.l2 = nn.Linear(32, 4)
+
+            def forward(self, x):
+                return self.l2(nn.functional.relu(self.l1(x)))
+
+        m = M()
+        opt = optimizer.AdamW(1e-3, parameters=m.parameters())
+
+        def loss_fn(logits, y):
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            oh = jax.nn.one_hot(y, 4)
+            return -jnp.mean(jnp.sum(oh * logp, -1))
+
+        mesh = topology.build_mesh(dp=2)
+        topology.set_global_mesh(mesh)
+        step, init = spmd.build_train_step(m, loss_fn, opt, mesh=mesh,
+                                           amp_level=level)
+        p, s = init()
+        rng = np.random.RandomState(0)
+        x = spmd.shard_batch(rng.randn(8, 16).astype(np.float32), mesh)
+        y = spmd.shard_batch(rng.randint(0, 4, (8,)), mesh)
+        l0, p, s = step(p, s, x, y)
+        for _ in range(4):
+            l, p, s = step(p, s, x, y)
+        assert np.isfinite(float(l0))
+        assert float(l) < float(l0)  # trains under mixed precision
+        # master weights stay fp32
+        assert all(a.dtype == np.float32 for a in p.values())
