@@ -5,6 +5,8 @@ GPT/Llama-style decoder) built on paddle_tpu.nn."""
 from . import ragged  # noqa: F401
 from .models import BertModel, BertForPretraining, GPTModel, LlamaModel  # noqa: F401
 from . import models  # noqa: F401
+from . import generation  # noqa: F401
+from .generation import generate, llama_generate  # noqa: F401
 
 
 class UCIHousing:

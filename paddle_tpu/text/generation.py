@@ -1,0 +1,314 @@
+"""Autoregressive generation (reference capability: PaddleNLP
+generation_utils.py GenerationMixin.generate — greedy/sampling/top-k/top-p;
+the reference repo itself ships the transformer API nn/layer/transformer.py
+and leaves decoding to model zoos).
+
+TPU-native design: decoding is compiled, not per-step Python.
+- generic path (any causal LM whose forward(ids)->logits): one jitted
+  step over a static max_length-padded id buffer — a single compile serves
+  every step; the per-step cost is one forward at full width (fine for
+  short generations and models without cache plumbing).
+- llama path: pre-allocated KV cache + `lax.scan` over decode steps, the
+  whole prefill+decode loop inside ONE jit. Static shapes, dynamic_update_
+  slice cache writes, masked attention over the cache — the idiomatic XLA
+  decode loop (no data-dependent Python control flow).
+"""
+import functools
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+
+# per-model jit caches: repeated generate() calls with the same shapes and
+# sampling config reuse the compiled step/decode instead of re-jitting.
+# Stored in the model's __dict__ (the compiled fns close over the model, so
+# a WeakKeyDictionary would never release its entries).
+_CACHE_ATTR = "_generation_jit_cache"
+
+
+def _model_cache(model):
+    cache = model.__dict__.get(_CACHE_ATTR)
+    if cache is None:
+        cache = {}
+        object.__setattr__(model, _CACHE_ATTR, cache)
+    return cache
+
+
+# ------------------------------------------------------------------ sampling
+
+
+def _apply_top_k(logits, k):
+    kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
+    return jnp.where(logits < kth, jnp.finfo(logits.dtype).min, logits)
+
+
+def _apply_top_p(logits, p):
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep the smallest prefix with cumulative prob >= p (always >= 1 token)
+    cutoff_idx = jnp.sum(cum < p, axis=-1, keepdims=True)
+    cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+    return jnp.where(logits < cutoff, jnp.finfo(logits.dtype).min, logits)
+
+
+def sample_next(logits, key, do_sample=False, temperature=1.0, top_k=0,
+                top_p=1.0):
+    """logits [B, V] -> token ids [B] (pure jnp; safe inside jit)."""
+    logits = logits.astype(jnp.float32)
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1)
+    if temperature != 1.0:
+        logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k and top_k > 0:
+        logits = _apply_top_k(logits, int(top_k))
+    if top_p < 1.0:
+        logits = _apply_top_p(logits, float(top_p))
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+# ------------------------------------------------------- generic decode path
+
+
+def _functional_forward(model):
+    """(param_dict, ids_array) -> logits array, running model.forward under
+    trace mode (same mechanism as distributed.spmd.build_train_step)."""
+    params0, buffers0 = model.functional_state()
+
+    def fwd(params, ids):
+        saved_p = {n: p._value for n, p in model.named_parameters()}
+        saved_b = dict(buffers0)
+        try:
+            with dispatch.trace_mode():
+                model.load_functional_state(params, buffers0)
+                out = model.forward(Tensor(ids, stop_gradient=True))
+                return out._value if isinstance(out, Tensor) else out
+        finally:
+            model.load_functional_state(saved_p, saved_b)
+
+    return fwd, params0
+
+
+def generate(model, input_ids, max_new_tokens=32, max_length=None,
+             do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
+             eos_token_id=None, pad_token_id=0, seed=0):
+    """Decode continuation tokens for `model` (any forward(ids)->logits
+    causal LM). Returns np.ndarray of width up to prompt_len +
+    max_new_tokens: rows that hit eos early are padded with pad_token_id,
+    and the result is truncated at the longest row once EVERY row has
+    finished (so the width is prompt + tokens actually generated).
+    """
+    ids = np.asarray(input_ids._value if isinstance(input_ids, Tensor)
+                     else input_ids)
+    if ids.ndim == 1:
+        ids = ids[None, :]
+    ids = ids.astype(np.int32)
+    b, t0 = ids.shape
+    total = max_length or (t0 + max_new_tokens)
+    steps = total - t0
+    if steps <= 0:
+        return ids
+
+    was_training = model.training
+    model.eval()
+    try:
+        cache_key = ("generic", b, total)
+        step = _model_cache(model).get(cache_key)
+        if step is None:
+            fwd, _ = _functional_forward(model)
+
+            @functools.partial(jax.jit, static_argnames=(
+                "do_sample", "top_k", "temperature", "top_p"))
+            def step(params, buf, cur_len, key, *, do_sample, top_k,
+                     temperature, top_p):
+                logits = fwd(params, buf)  # [B, total, V]
+                last = jnp.take_along_axis(
+                    logits, (cur_len - 1)[None, None, None].astype(jnp.int32) *
+                    jnp.ones((b, 1, 1), jnp.int32), axis=1)[:, 0]
+                return sample_next(last, key, do_sample=do_sample,
+                                   temperature=temperature, top_k=top_k,
+                                   top_p=top_p)
+
+            _model_cache(model)[cache_key] = step
+
+        # static-shape buffer: pad ids to `total`, advance a cursor
+        buf = np.full((b, total), pad_token_id, np.int32)
+        buf[:, :t0] = ids
+        params = {n: p._value for n, p in model.named_parameters()}
+        key = jax.random.PRNGKey(seed)
+        buf_dev = jnp.asarray(buf)
+        done = np.zeros((b,), bool)
+        cur = t0
+        for i in range(steps):
+            key, sub = jax.random.split(key)
+            nxt = step(params, buf_dev, jnp.asarray(cur), sub,
+                       do_sample=do_sample, top_k=int(top_k),
+                       temperature=float(temperature), top_p=float(top_p))
+            nxt_np = np.asarray(nxt)
+            if eos_token_id is not None:
+                nxt_np = np.where(done, pad_token_id, nxt_np)
+                done |= nxt_np == eos_token_id
+            buf_dev = buf_dev.at[:, cur].set(jnp.asarray(nxt_np))
+            cur += 1
+            if eos_token_id is not None and done.all():
+                break
+    finally:
+        if was_training:
+            model.train()
+    return np.asarray(buf_dev)[:, :cur]
+
+
+# ------------------------------------------------------ llama cached decode
+
+
+def _collect_llama_params(model):
+    """Structured per-layer weight pytree from a text.models.LlamaModel."""
+    p = {n: t._value for n, t in model.named_parameters()}
+    n_layers = len(model.layers)
+    layers = []
+    for i in range(n_layers):
+        pre = f"layers.{i}."
+        layers.append({
+            "ln1": p[pre + "input_layernorm.weight"],
+            "wq": p[pre + "self_attn.q_proj.weight"],
+            "wk": p[pre + "self_attn.k_proj.weight"],
+            "wv": p[pre + "self_attn.v_proj.weight"],
+            "wo": p[pre + "self_attn.o_proj.weight"],
+            "ln2": p[pre + "post_attention_layernorm.weight"],
+            "gate": p[pre + "mlp.gate_proj.weight"],
+            "up": p[pre + "mlp.up_proj.weight"],
+            "down": p[pre + "mlp.down_proj.weight"],
+        })
+    return {
+        "embed": p["embed_tokens.weight"],
+        "norm": p["norm.weight"],
+        "head": p["lm_head.weight"],
+        "layers": layers,
+    }
+
+
+def llama_generate(model, input_ids, max_new_tokens=32, do_sample=False,
+                   temperature=1.0, top_k=0, top_p=1.0, seed=0):
+    """KV-cached decode for text.models.LlamaModel: prefill + lax.scan
+    decode entirely inside one jit (static shapes; cache updates via
+    dynamic_update_slice; attention masked by absolute position).
+    Returns np.ndarray [B, prompt+max_new_tokens].
+
+    Uses the model's own rms_norm/_rope kernels (text/models.py) so the
+    cached path cannot drift from model.forward.
+    """
+    from .models import _rope, rms_norm
+
+    ids = np.asarray(input_ids._value if isinstance(input_ids, Tensor)
+                     else input_ids).astype(np.int32)
+    if ids.ndim == 1:
+        ids = ids[None, :]
+    b, t0 = ids.shape
+    total = t0 + max_new_tokens
+    params = _collect_llama_params(model)
+    cache_key = ("llama", b, t0, max_new_tokens, bool(do_sample),
+                 float(temperature), int(top_k), float(top_p))
+    cached = _model_cache(model).get(cache_key)
+    if cached is not None:
+        was_training = model.training
+        model.eval()
+        try:
+            new_tokens = cached(params, jnp.asarray(ids),
+                                jax.random.PRNGKey(seed))
+        finally:
+            if was_training:
+                model.train()
+        return np.concatenate([ids, np.asarray(new_tokens)], axis=1)
+
+    _rms = rms_norm
+    _rope_at = lambda x, positions: _rope(x, positions=positions)  # noqa: E731
+    nh = model.layers[0].self_attn.num_heads
+    nkv = model.layers[0].self_attn.num_kv_heads
+    hd = model.layers[0].self_attn.head_dim
+    n_layers = len(params["layers"])
+    scale = 1.0 / math.sqrt(hd)
+
+    def attend(q, k_cache, v_cache, n_valid):
+        """q [B,H,Tq,D] over cache [B,KV,total,D], masked to < n_valid (+row)."""
+        if nkv != nh:
+            rep = nh // nkv
+            k_cache = jnp.repeat(k_cache, rep, axis=1)
+            v_cache = jnp.repeat(v_cache, rep, axis=1)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache) * scale
+        tq = q.shape[2]
+        kpos = jnp.arange(total)[None, :]
+        qpos = (n_valid - tq) + jnp.arange(tq)[:, None]
+        mask = kpos <= qpos  # causal + cache-validity in one predicate
+        logits = jnp.where(mask[None, None], logits,
+                           jnp.finfo(logits.dtype).min)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v_cache.dtype),
+                          v_cache)
+
+    def layer_fwd(lp, x, caches, li, positions, n_valid):
+        h = _rms(x, lp["ln1"])
+        t = h.shape[1]
+        q = (h @ lp["wq"]).reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
+        k = (h @ lp["wk"]).reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
+        v = (h @ lp["wv"]).reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
+        q = _rope_at(q, positions)
+        k = _rope_at(k, positions)
+        kc = jax.lax.dynamic_update_slice(
+            caches[0][li], k, (0, 0, n_valid - t, 0))
+        vc = jax.lax.dynamic_update_slice(
+            caches[1][li], v, (0, 0, n_valid - t, 0))
+        out = attend(q, kc, vc, n_valid)
+        x = x + out.transpose(0, 2, 1, 3).reshape(b, t, nh * hd) @ lp["wo"]
+        h2 = _rms(x, lp["ln2"])
+        x = x + (jax.nn.silu(h2 @ lp["gate"]) * (h2 @ lp["up"])) @ lp["down"]
+        return x, kc, vc
+
+    def forward_with_cache(params, token_ids, caches, positions, n_valid):
+        x = params["embed"][token_ids]
+        new_k, new_v = [], []
+        for li, lp in enumerate(params["layers"]):
+            x, kc, vc = layer_fwd(lp, x, caches, li, positions, n_valid)
+            new_k.append(kc)
+            new_v.append(vc)
+        logits = _rms(x, params["norm"]) @ params["head"]
+        return logits, (jnp.stack(new_k), jnp.stack(new_v))
+
+    @jax.jit
+    def decode(params, prompt, key):
+        caches = (jnp.zeros((n_layers, b, nkv, total, hd), jnp.float32),
+                  jnp.zeros((n_layers, b, nkv, total, hd), jnp.float32))
+        # prefill
+        logits, caches = forward_with_cache(
+            params, prompt, caches, jnp.arange(t0), jnp.asarray(t0))
+        first = sample_next(logits[:, -1], key, do_sample=do_sample,
+                            temperature=temperature, top_k=top_k, top_p=top_p)
+
+        def body(carry, i):
+            caches, tok, key = carry
+            key, sub = jax.random.split(key)
+            # `tok` occupies absolute position t0 + i - 1
+            logits, caches = forward_with_cache(
+                params, tok[:, None], caches, (t0 + i - 1)[None], t0 + i)
+            nxt = sample_next(logits[:, -1], sub, do_sample=do_sample,
+                              temperature=temperature, top_k=top_k,
+                              top_p=top_p)
+            return (caches, nxt, key), tok
+
+        (caches, last, _), toks = jax.lax.scan(
+            body, (caches, first, key), jnp.arange(1, max_new_tokens))
+        # toks holds tokens emitted BEFORE each step: [first, ..., last-1]
+        return jnp.concatenate([toks.transpose(1, 0), last[:, None]], axis=1)
+
+    _model_cache(model)[cache_key] = decode
+    was_training = model.training
+    model.eval()
+    try:
+        new_tokens = decode(params, jnp.asarray(ids), jax.random.PRNGKey(seed))
+    finally:
+        if was_training:
+            model.train()
+    return np.concatenate([ids, np.asarray(new_tokens)], axis=1)

@@ -171,6 +171,11 @@ class GPTModel(nn.Layer):
         x = self.ln_f(x)
         return pt.matmul(x, self.wte.weight, transpose_y=True)
 
+    def generate(self, input_ids, **kwargs):
+        from .generation import generate as _generate
+
+        return _generate(self, input_ids, **kwargs)
+
 
 class RMSNorm(nn.Layer):
     def __init__(self, hidden_size, eps=1e-6):
@@ -180,28 +185,34 @@ class RMSNorm(nn.Layer):
         self.eps = eps
 
     def forward(self, x):
-        import jax
-        import jax.numpy as jnp
-
         from ..core.dispatch import apply_op
 
-        def _rms(x, w, *, eps):
-            var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
-            return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * w
-
-        return apply_op("rms_norm", _rms, x, self.weight, eps=self.eps)
+        return apply_op("rms_norm", rms_norm, x, self.weight, eps=self.eps)
 
 
-def _rope(x, base=10000.0):
+def rms_norm(x, w, *, eps=1e-6):
+    """Shared RMSNorm kernel (also used by the cached decode path in
+    generation.py — single source of truth for the Llama math)."""
+    import jax
     import jax.numpy as jnp
 
-    # x: [B, H, T, D]
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * w
+
+
+def _rope(x, base=10000.0, positions=None):
+    """Rotary embedding. x: [B, H, T, D]; positions: [T] absolute positions
+    (defaults to 0..T-1). Shared with generation.py's cached decode."""
+    import jax.numpy as jnp
+
     d = x.shape[-1]
     t = x.shape[-2]
-    inv = 1.0 / (base ** (jnp.arange(0, d, 2) / d))
-    freqs = jnp.outer(jnp.arange(t), inv)
-    cos = jnp.cos(freqs)[None, None]
-    sin = jnp.sin(freqs)[None, None]
+    if positions is None:
+        positions = jnp.arange(t)
+    inv = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = jnp.outer(positions, inv)
+    cos = jnp.cos(freqs)[None, None].astype(x.dtype)
+    sin = jnp.sin(freqs)[None, None].astype(x.dtype)
     x1, x2 = x[..., ::2], x[..., 1::2]
     out1 = x1 * cos - x2 * sin
     out2 = x2 * cos + x1 * sin
@@ -295,3 +306,18 @@ class LlamaModel(nn.Layer):
         for layer in self.layers:
             x = layer(x)
         return self.lm_head(self.norm(x))
+
+    def generate(self, input_ids, use_cache=True, **kwargs):
+        """KV-cached scan decode by default; use_cache=False falls back to
+        the generic full-width path (cross-checks the cache in tests)."""
+        from .generation import generate as _generate
+        from .generation import llama_generate as _llama_generate
+
+        # early-eos stopping needs host-side control flow -> generic path
+        if (use_cache and kwargs.get("eos_token_id") is None
+                and kwargs.get("max_length") is None):
+            kwargs.pop("eos_token_id", None)
+            kwargs.pop("max_length", None)
+            kwargs.pop("pad_token_id", None)
+            return _llama_generate(self, input_ids, **kwargs)
+        return _generate(self, input_ids, **kwargs)
