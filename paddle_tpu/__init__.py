@@ -67,6 +67,7 @@ from . import hapi  # noqa: F401
 from . import text  # noqa: F401
 from . import incubate  # noqa: F401
 from . import inference  # noqa: F401
+from . import quantization  # noqa: F401
 from . import onnx  # noqa: F401
 from . import utils  # noqa: F401
 from .hapi import Model, summary  # noqa: F401

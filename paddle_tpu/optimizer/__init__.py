@@ -2,4 +2,5 @@
 from . import lr  # noqa: F401
 from .optimizer import (  # noqa: F401
     Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta, RMSProp, Lamb,
+    Lars, LarsMomentum, Ftrl, DecayedAdagrad,
 )

@@ -243,14 +243,18 @@ class Momentum(Optimizer):
         g = g.astype(p.dtype)
         lr = lr.astype(p.dtype)
         if lars:
+            # lars_momentum semantics: the lr-scaled step enters the velocity
+            # (v = mu*v + local_lr*(g + wd*p); p -= v), so past momentum keeps
+            # the trust ratio it was accumulated with
             p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
             g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
             local_lr = jnp.where(
                 (p_norm > 0) & (g_norm > 0),
-                lars_coeff * p_norm / (g_norm + lars_wd * p_norm + 1e-12), 1.0)
-            lr = lr * local_lr
-            g = g + lars_wd * p
-        elif l2:
+                lr * lars_coeff * p_norm / (g_norm + lars_wd * p_norm + 1e-12),
+                lr)
+            v_new = mu * velocity + local_lr * (g + lars_wd * p)
+            return p - v_new, v_new
+        if l2:
             g = g + l2 * p
         v_new = mu * velocity + g
         if nesterov:
@@ -479,3 +483,89 @@ class Lamb(Optimizer):
         r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
         trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
         return p - lr.astype(p.dtype) * trust * r, m_new, v_new, t_new
+
+
+class Lars(Momentum):
+    """LARS momentum (reference: operators/optimizers/lars_momentum_op.cc,
+    fluid/optimizer.py LarsMomentumOptimizer:1612) — Momentum with the
+    layer-adaptive trust ratio always on."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 lars_coeff=0.001, lars_weight_decay=0.0005, weight_decay=None,
+                 grad_clip=None, name=None, **kwargs):
+        if weight_decay is not None:
+            raise ValueError(
+                "Lars regularizes via lars_weight_decay (it enters the trust "
+                "ratio); a separate weight_decay would be silently ignored")
+        super().__init__(learning_rate, momentum, parameters=parameters,
+                         grad_clip=grad_clip,
+                         name=name, use_lars=True, lars_coeff=lars_coeff,
+                         lars_weight_decay=lars_weight_decay, **kwargs)
+
+
+LarsMomentum = Lars
+
+
+class Ftrl(Optimizer):
+    """FTRL-proximal (reference: operators/optimizers/ftrl_op.cc; fluid
+    FtrlOptimizer). States: squared accum, linear accum."""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._l1 = l1
+        self._ftrl_l2 = l2
+        self._lr_power = lr_power
+
+    def _hypers(self, param=None):
+        h = super()._hypers(param)
+        h.update(l1=self._l1, ftrl_l2=self._ftrl_l2, lr_power=self._lr_power)
+        return h
+
+    def _init_state(self, p_arr):
+        return (jnp.full_like(p_arr, 1e-10), jnp.zeros_like(p_arr))
+
+    @staticmethod
+    def _update(p, g, lr, sq_accum, lin_accum, *, l1=0.0, ftrl_l2=0.0,
+                lr_power=-0.5, l2=0.0):
+        g = g.astype(p.dtype)
+        if l2:
+            g = g + l2 * p
+        lr = lr.astype(p.dtype)
+        new_sq = sq_accum + jnp.square(g)
+        # sigma = (new_sq^{-lr_power} - sq^{-lr_power}) / lr
+        sigma = (jnp.power(new_sq, -lr_power) -
+                 jnp.power(sq_accum, -lr_power)) / lr
+        new_lin = lin_accum + g - sigma * p
+        x = l1 * jnp.sign(new_lin) - new_lin
+        y = jnp.power(new_sq, -lr_power) / lr + 2.0 * ftrl_l2
+        p_new = jnp.where(jnp.abs(new_lin) > l1, x / y, jnp.zeros_like(p))
+        return p_new, new_sq, new_lin
+
+
+class DecayedAdagrad(Optimizer):
+    """reference: operators/optimizers/decayed_adagrad_op.cc (fluid
+    DecayedAdagradOptimizer): exponentially-decayed squared-grad accum."""
+
+    def __init__(self, learning_rate=0.001, decay=0.95, epsilon=1e-6,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def _hypers(self, param=None):
+        h = super()._hypers(param)
+        h.update(decay=self._decay, eps=self._epsilon)
+        return h
+
+    def _init_state(self, p_arr):
+        return (jnp.zeros_like(p_arr),)
+
+    @staticmethod
+    def _update(p, g, lr, acc, *, decay=0.95, eps=1e-6, l2=0.0):
+        g = g.astype(p.dtype)
+        if l2:
+            g = g + l2 * p
+        acc_new = decay * acc + (1 - decay) * jnp.square(g)
+        p_new = p - lr.astype(p.dtype) * g / (jnp.sqrt(acc_new) + eps)
+        return p_new, acc_new

@@ -1,0 +1,22 @@
+"""paddle.quantization — QAT + post-training quantization (reference:
+python/paddle/fluid/contrib/slim/quantization/: imperative/qat.py
+ImperativeQuantAware, quantization_pass.py fake_quant/dequant insertion,
+post_training_quantization.py PostTrainingQuantization).
+
+TPU-native design: the reference rewrites graphs to insert fake_quant/
+dequant *ops*; here quantization is functional — fake-quant is a pure op
+with a straight-through-estimator gradient (identity through round), QAT
+swaps layers for Quanted* wrappers (the imperative/qat.py model), and PTQ
+calibrates activation scales then freezes int8 weights. int8 storage
+halves/quarters HBM traffic; compute stays in the float domain after
+dequant (the MXU path), matching how int8 serving works under XLA.
+"""
+from .imperative import (
+    ImperativeQuantAware, QuantedConv2D, QuantedLinear, fake_quant,
+)
+from .post_training import PostTrainingQuantization, quantize_weights
+
+__all__ = [
+    "ImperativeQuantAware", "QuantedLinear", "QuantedConv2D", "fake_quant",
+    "PostTrainingQuantization", "quantize_weights",
+]

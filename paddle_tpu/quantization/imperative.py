@@ -1,0 +1,140 @@
+"""Imperative (dygraph) quantization-aware training.
+
+Reference: fluid/contrib/slim/quantization/imperative/qat.py
+(ImperativeQuantAware._quantize swaps Linear/Conv2D for Quanted* layers;
+fake_quantize_dequantize ops with moving-average abs-max scales).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..nn import functional as F
+
+
+def _fake_quant_fn(x, scale, *, bits, per_channel_axis):
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-9) / qmax
+    if per_channel_axis is not None:
+        shape = [1] * x.ndim
+        shape[per_channel_axis] = -1
+        s = s.reshape(shape)
+    q = jnp.clip(jnp.round(x / s), -qmax, qmax) * s
+    # straight-through estimator: forward = quantized, grad = identity
+    return x + jax.lax.stop_gradient(q - x)
+
+
+def fake_quant(x, scale, bits=8, per_channel_axis=None):
+    """Simulated quantize->dequantize with STE gradient (reference:
+    fake_quantize_dequantize_moving_average_abs_max op)."""
+    return apply_op("fake_quant", _fake_quant_fn, x, scale, bits=bits,
+                    per_channel_axis=per_channel_axis)
+
+
+def _abs_max(arr, keep_axis=None):
+    if keep_axis is None:
+        return jnp.max(jnp.abs(arr))
+    axes = tuple(i for i in range(arr.ndim) if i != keep_axis)
+    return jnp.max(jnp.abs(arr), axis=axes)
+
+
+class _QuantedBase(nn.Layer):
+    """Shared QAT machinery: per-channel weight abs-max fake-quant + moving
+    average activation scale (updated in train mode, frozen in eval)."""
+
+    def __init__(self, inner, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_channel_axis=None):
+        super().__init__()
+        self.inner = inner
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._rate = moving_rate
+        self._w_axis = weight_channel_axis
+        self.register_buffer("act_scale", jnp.asarray(0.0, jnp.float32))
+
+    def _quant_inputs(self, x):
+        cur = _abs_max(x._value if isinstance(x, Tensor) else jnp.asarray(x))
+        if self.training:
+            # moving-average abs-max (reference: moving_average_abs_max_scale)
+            prev = self.act_scale
+            new = jnp.where(prev > 0, self._rate * prev + (1 - self._rate) * cur,
+                            cur)
+            self.act_scale = new.astype(jnp.float32)
+            scale = jnp.maximum(self.act_scale, cur)
+        else:
+            # uncalibrated eval (act_scale still 0) falls back to the live
+            # abs-max instead of quantizing everything to ~0
+            scale = jnp.where(self.act_scale > 0, self.act_scale, cur)
+        return fake_quant(x, scale, bits=self._abits)
+
+    def _quant_weight(self, w):
+        wscale = _abs_max(w._value, keep_axis=self._w_axis)
+        return fake_quant(w, wscale, bits=self._wbits,
+                          per_channel_axis=self._w_axis)
+
+
+class QuantedLinear(_QuantedBase):
+    """reference: imperative/qat.py QuantizedLinear. weight [in, out] ->
+    per-channel scales on the out axis (1)."""
+
+    def __init__(self, inner, **kw):
+        super().__init__(inner, weight_channel_axis=1, **kw)
+
+    def forward(self, x):
+        xq = self._quant_inputs(x)
+        wq = self._quant_weight(self.inner.weight)
+        return F.linear(xq, wq, self.inner.bias)
+
+
+class QuantedConv2D(_QuantedBase):
+    """reference: imperative/qat.py QuantizedConv2D. weight [O, I, kh, kw]
+    -> per-channel scales on the O axis (0)."""
+
+    def __init__(self, inner, **kw):
+        super().__init__(inner, weight_channel_axis=0, **kw)
+
+    def forward(self, x):
+        xq = self._quant_inputs(x)
+        wq = self._quant_weight(self.inner.weight)
+        return F.conv2d(xq, wq, self.inner.bias, self.inner._stride,
+                        self.inner._padding, self.inner._dilation,
+                        self.inner._groups)
+
+
+_QUANTABLE = {nn.Linear: QuantedLinear, nn.Conv2D: QuantedConv2D}
+
+
+class ImperativeQuantAware:
+    """reference: imperative/qat.py ImperativeQuantAware: quantize(model)
+    swaps quantizable sublayers in place; save_quantized_model exports via
+    jit.save (the fake-quant ops bake into the StableHLO program)."""
+
+    def __init__(self, weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 quantizable_layer_type=("Linear", "Conv2D")):
+        self._kw = dict(weight_bits=weight_bits, activation_bits=activation_bits,
+                        moving_rate=moving_rate)
+        self._types = tuple(
+            t for t in _QUANTABLE
+            if t.__name__ in set(quantizable_layer_type))
+
+    def quantize(self, model):
+        """In-place: replace every quantizable sublayer with its Quanted*
+        wrapper. Returns the model."""
+        for name, sub in list(model._sub_layers.items()):
+            if isinstance(sub, _QuantedBase):
+                continue
+            if isinstance(sub, self._types):
+                wrapper = next(q for t, q in _QUANTABLE.items()
+                               if isinstance(sub, t))
+                model._sub_layers[name] = wrapper(sub, **self._kw)
+            else:
+                self.quantize(sub)
+        return model
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        from .. import jit
+
+        model.eval()
+        jit.save(model, path, input_spec=input_spec)

@@ -1,0 +1,166 @@
+"""Post-training quantization.
+
+Reference: fluid/contrib/slim/quantization/post_training_quantization.py
+(PostTrainingQuantization: feed calibration data, collect abs-max /
+histogram stats, compute scales, save a quantized program). The TPU-native
+version calibrates activation scales by running the model eagerly over a
+sample generator, then freezes weights to true int8 storage with
+per-channel scales (weight-only int8 — the HBM-bandwidth win on TPU;
+compute dequantizes into the float/MXU domain).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..nn import functional as F
+
+
+class Int8Linear(nn.Layer):
+    """Weight-only int8 linear: int8 weight + per-out-channel fp32 scale,
+    dequantized at compute (XLA fuses the dequant into the matmul read)."""
+
+    def __init__(self, qweight, scale, bias):
+        super().__init__()
+        self.register_buffer("qweight", jnp.asarray(qweight, jnp.int8))
+        self.register_buffer("w_scale", jnp.asarray(scale, jnp.float32))
+        self.bias = bias
+
+    def forward(self, x):
+        w = self.qweight.astype(jnp.float32) * self.w_scale[None, :]
+        return F.linear(x, Tensor(w, stop_gradient=True), self.bias)
+
+
+class Int8Conv2D(nn.Layer):
+    def __init__(self, qweight, scale, bias, stride, padding, dilation, groups):
+        super().__init__()
+        self.register_buffer("qweight", jnp.asarray(qweight, jnp.int8))
+        self.register_buffer("w_scale", jnp.asarray(scale, jnp.float32))
+        self.bias = bias
+        self._conv_args = (stride, padding, dilation, groups)
+
+    def forward(self, x):
+        w = self.qweight.astype(jnp.float32) * \
+            self.w_scale[:, None, None, None]
+        return F.conv2d(x, Tensor(w, stop_gradient=True), self.bias,
+                        *self._conv_args)
+
+
+def _quantize_array(w, channel_axis):
+    axes = tuple(i for i in range(w.ndim) if i != channel_axis)
+    amax = np.max(np.abs(w), axis=axes)
+    scale = np.maximum(amax, 1e-9) / 127.0
+    shape = [1] * w.ndim
+    shape[channel_axis] = -1
+    q = np.clip(np.round(w / scale.reshape(shape)), -127, 127).astype(np.int8)
+    return q, scale.astype(np.float32)
+
+
+def quantize_weights(model):
+    """In-place weight-only int8 conversion of every Linear/Conv2D.
+    Returns (model, stats dict name->scale)."""
+    stats = {}
+
+    def _walk(layer, prefix=""):
+        from .imperative import _QuantedBase
+
+        for name, sub in list(layer._sub_layers.items()):
+            full = f"{prefix}{name}"
+            if isinstance(sub, _QuantedBase):
+                # QAT wrappers own their inner layer's quantization; swapping
+                # the inner for Int8* would break the wrapper's forward
+                continue
+            if isinstance(sub, nn.Linear):
+                w = np.asarray(sub.weight._value)
+                q, s = _quantize_array(w, channel_axis=1)
+                layer._sub_layers[name] = Int8Linear(q, s, sub.bias)
+                stats[full] = s
+            elif isinstance(sub, nn.Conv2D):
+                w = np.asarray(sub.weight._value)
+                q, s = _quantize_array(w, channel_axis=0)
+                layer._sub_layers[name] = Int8Conv2D(
+                    q, s, sub.bias, sub._stride, sub._padding, sub._dilation,
+                    sub._groups)
+                stats[full] = s
+            else:
+                _walk(sub, full + ".")
+
+    _walk(model)
+    return model, stats
+
+
+class PostTrainingQuantization:
+    """reference: post_training_quantization.py PostTrainingQuantization.
+
+    ptq = PostTrainingQuantization(model, sample_generator)
+    qmodel = ptq.quantize()          # calibrate + freeze int8 weights
+    ptq.save_quantized_model(path, input_spec=[...])
+    """
+
+    def __init__(self, model, sample_generator=None, batch_nums=8,
+                 algo="abs_max"):
+        self._model = model
+        self._samples = sample_generator
+        self._batch_nums = batch_nums
+        if algo not in ("abs_max", "avg"):
+            raise ValueError(f"unsupported calibration algo {algo!r}")
+        self._algo = algo
+        self._act_scales = {}
+        self._quantized = None
+
+    def _calibrate(self):
+        """Run calibration batches, recording per-quantizable-layer input
+        abs-max via forward hooks (the analysis pass analog)."""
+        handles = []
+        scales = self._act_scales
+
+        def make_hook(name):
+            def hook(layer, inputs):
+                x = inputs[0]
+                arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+                cur = float(np.max(np.abs(arr)))
+                if self._algo == "abs_max":
+                    scales[name] = max(scales.get(name, 0.0), cur)
+                else:
+                    prev, n = scales.get(name, (0.0, 0))
+                    scales[name] = ((prev * n + cur) / (n + 1), n + 1)
+                return None
+
+            return hook
+
+        for name, sub in self._model.named_sublayers():
+            if isinstance(sub, (nn.Linear, nn.Conv2D)):
+                handles.append(sub.register_forward_pre_hook(make_hook(name)))
+        try:
+            self._model.eval()
+            for i, batch in enumerate(self._samples()):
+                if i >= self._batch_nums:
+                    break
+                x = batch[0] if isinstance(batch, (tuple, list)) else batch
+                self._model(x if isinstance(x, Tensor) else Tensor(jnp.asarray(np.asarray(x))))
+        finally:
+            for h in handles:
+                h.remove()
+        if self._algo == "avg":
+            self._act_scales = {k: v[0] for k, v in scales.items()}
+
+    def quantize(self):
+        if self._samples is not None:
+            self._calibrate()
+        self._quantized, self._weight_scales = quantize_weights(self._model)
+        return self._quantized
+
+    @property
+    def activation_scales(self):
+        return dict(self._act_scales)
+
+    @property
+    def weight_scales(self):
+        return dict(getattr(self, "_weight_scales", {}))
+
+    def save_quantized_model(self, path, input_spec=None):
+        from .. import jit
+
+        model = self._quantized or self.quantize()
+        model.eval()
+        jit.save(model, path, input_spec=input_spec)

@@ -1,0 +1,179 @@
+"""Custom C++ op runtime (reference: python/paddle/utils/cpp_extension/
+extension_utils.py + cpp_extension.py `load()` ninja-JIT build, and the C++
+side framework/custom_operator.cc RegisterOperatorWithMetaInfo /
+PD_BUILD_OP in extension/include/ext_op_meta_info.h).
+
+TPU-native design: custom C++ kernels are host ops. They compile with g++
+into a dlopen'd .so (no pybind11 in the image — ctypes is the binding
+layer) and enter the graph through `jax.pure_callback`, so they work both
+eagerly and inside jit-compiled programs; an optional `<name>_grad` symbol
+supplies the VJP (registered via jax.custom_vjp, so `paddle.grad`/
+`backward()` differentiate through the custom op). Pure-device custom
+kernels belong in Pallas instead (ops/pallas/) — this path is for host
+logic the reference would run as a CPU custom op.
+
+C ABI (one op per exported symbol):
+    extern "C" void <name>(const float* x, float* y, int64_t n);
+    extern "C" void <name>_grad(const float* x, const float* dy,
+                                float* dx, int64_t n);   // optional
+Elementwise contract: y has x's shape. (The reference's multi-tensor meta
+infos collapse to this for the common custom-activation case; richer
+signatures can compose multiple ops.)
+"""
+import ctypes
+import hashlib
+import os
+import subprocess
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+
+_DEFAULT_BUILD_ROOT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "_extension_cache")
+
+
+def _hash_sources(sources, flags):
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(flags).encode())
+    return h.hexdigest()[:16]
+
+
+def _list_symbols(lib_path):
+    out = subprocess.run(["nm", "-D", "--defined-only", lib_path],
+                         check=True, capture_output=True, text=True).stdout
+    syms = []
+    for line in out.splitlines():
+        parts = line.split()
+        if len(parts) >= 3 and parts[-2] == "T":
+            syms.append(parts[-1])
+    return syms
+
+
+class CustomOpModule:
+    """Holds the loaded library; each op is an attribute taking/returning
+    framework Tensors (or raw arrays) and differentiable when a `_grad`
+    symbol exists."""
+
+    def __init__(self, name, lib_path):
+        self._name = name
+        self._lib = ctypes.CDLL(lib_path)
+        self._lib_path = lib_path
+        self.op_names = []
+        syms = [s for s in _list_symbols(lib_path) if not s.startswith("_")]
+        grads = {s for s in syms if s.endswith("_grad")}
+        for sym in syms:
+            if sym in grads:
+                continue
+            self._register(sym, has_grad=(sym + "_grad") in grads)
+            self.op_names.append(sym)
+
+    def _register(self, sym, has_grad):
+        f32p = ctypes.POINTER(ctypes.c_float)
+        cfn = getattr(self._lib, sym)
+        cfn.restype = None
+        cfn.argtypes = [f32p, f32p, ctypes.c_int64]
+        gfn = None
+        if has_grad:
+            gfn = getattr(self._lib, sym + "_grad")
+            gfn.restype = None
+            gfn.argtypes = [f32p, f32p, f32p, ctypes.c_int64]
+
+        def host_fwd(x):
+            x = np.ascontiguousarray(x, np.float32)
+            y = np.empty_like(x)
+            cfn(x.ctypes.data_as(f32p), y.ctypes.data_as(f32p), x.size)
+            return y
+
+        def host_bwd(x, dy):
+            x = np.ascontiguousarray(x, np.float32)
+            dy = np.ascontiguousarray(dy, np.float32)
+            dx = np.empty_like(x)
+            gfn(x.ctypes.data_as(f32p), dy.ctypes.data_as(f32p),
+                dx.ctypes.data_as(f32p), x.size)
+            return dx
+
+        @jax.custom_vjp
+        def op(x):
+            return jax.pure_callback(
+                host_fwd, jax.ShapeDtypeStruct(x.shape, jnp.float32), x,
+                vmap_method="sequential")
+
+        def op_fwd(x):
+            return op(x), x
+
+        def op_bwd(x, dy):
+            if gfn is None:
+                raise NotImplementedError(
+                    f"custom op {sym!r} has no {sym}_grad symbol")
+            dx = jax.pure_callback(
+                host_bwd, jax.ShapeDtypeStruct(x.shape, jnp.float32), x, dy,
+                vmap_method="sequential")
+            return (dx,)
+
+        op.defvjp(op_fwd, op_bwd)
+
+        def tensor_op(x, name=None):
+            return apply_op(f"custom_{sym}", op, x)
+
+        tensor_op.__name__ = sym
+        setattr(self, sym, tensor_op)
+
+
+def load(name, sources, extra_cxx_cflags=None, extra_cflags=None,
+         build_directory=None, verbose=False, **kwargs):
+    """JIT-compile `sources` and return a CustomOpModule (reference:
+    cpp_extension.load:710 — ninja build + import; here g++ + ctypes)."""
+    flags = ["-O2", "-std=c++17", "-shared", "-fPIC"]
+    flags += list(extra_cxx_cflags or extra_cflags or [])
+    sources = [os.path.abspath(s) for s in sources]
+    tag = _hash_sources(sources, flags)
+    build_dir = build_directory or os.path.join(_DEFAULT_BUILD_ROOT, name)
+    os.makedirs(build_dir, exist_ok=True)
+    lib_path = os.path.join(build_dir, f"{name}_{tag}.so")
+    if not os.path.exists(lib_path):
+        tmp = f"{lib_path}.{os.getpid()}.tmp"
+        cmd = ["g++"] + flags + ["-o", tmp] + sources
+        if verbose:
+            print("compiling custom ops:", " ".join(cmd))
+        try:
+            subprocess.run(cmd, check=True, capture_output=not verbose)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"custom op build failed:\n{(e.stderr or b'').decode()}") from e
+        os.replace(tmp, lib_path)
+    return CustomOpModule(name, lib_path)
+
+
+class CppExtension:
+    """setup()-style declaration (reference: cpp_extension.py CppExtension).
+    Carries sources/flags; `setup` builds them with the same JIT pipeline."""
+
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = sources
+        self.extra_compile_args = kwargs.get("extra_compile_args", [])
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    """CUDA custom ops don't exist on TPU; accept and build the C++ parts
+    (reference API parity: cpp_extension.py CUDAExtension)."""
+    cpp_sources = [s for s in sources if not s.endswith((".cu", ".cuh"))]
+    return CppExtension(cpp_sources, *args, **kwargs)
+
+
+def setup(name="paddle_tpu_custom_ops", ext_modules=None, **kwargs):
+    """Build every extension now and return the loaded modules (the
+    reference runs a full setuptools build; JIT-load is the TPU-native
+    equivalent since there is no separate install step)."""
+    exts = ext_modules or []
+    if not isinstance(exts, (list, tuple)):
+        exts = [exts]
+    return [load(f"{name}_{i}", e.sources,
+                 extra_cxx_cflags=e.extra_compile_args)
+            for i, e in enumerate(exts)]

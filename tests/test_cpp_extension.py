@@ -1,0 +1,111 @@
+"""Custom C++ op tests (reference test analog:
+fluid/tests/custom_op/test_custom_relu_op_jit.py — build with load(),
+check forward + backward against native impl, in both dygraph and jit).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import cpp_extension
+
+RELU2_SRC = r"""
+#include <cstdint>
+// y = x^2 for x > 0 else 0 (a custom activation)
+extern "C" void relu2(const float* x, float* y, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) y[i] = x[i] > 0 ? x[i] * x[i] : 0.f;
+}
+extern "C" void relu2_grad(const float* x, const float* dy, float* dx,
+                           int64_t n) {
+  for (int64_t i = 0; i < n; ++i) dx[i] = x[i] > 0 ? 2.f * x[i] * dy[i] : 0.f;
+}
+// no grad symbol for this one
+extern "C" void plus_one(const float* x, float* y, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) y[i] = x[i] + 1.f;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def ops(tmp_path_factory):
+    d = tmp_path_factory.mktemp("custom_op")
+    src = d / "relu2.cc"
+    src.write_text(RELU2_SRC)
+    return cpp_extension.load("test_ops", [str(src)],
+                              build_directory=str(d / "build"))
+
+
+class TestCustomOp:
+    def test_symbols_discovered(self, ops):
+        assert set(ops.op_names) == {"relu2", "plus_one"}
+
+    def test_forward(self, ops):
+        x = np.array([-1.0, 0.5, 2.0], np.float32)
+        out = ops.relu2(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out._value), [0.0, 0.25, 4.0])
+
+    def test_backward_through_tape(self, ops):
+        x = paddle.to_tensor(np.array([-1.0, 0.5, 2.0], np.float32),
+                             stop_gradient=False)
+        y = ops.relu2(x)
+        y.sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad._value),
+                                   [0.0, 1.0, 4.0])
+
+    def test_inside_jit(self, ops):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.core import dispatch
+        from paddle_tpu.core.tensor import Tensor
+
+        def f(arr):
+            with dispatch.trace_mode():
+                return ops.relu2(Tensor(arr))._value
+
+        out = jax.jit(f)(jnp.asarray([3.0, -2.0], jnp.float32))
+        np.testing.assert_allclose(np.asarray(out), [9.0, 0.0])
+
+    def test_grad_inside_jit(self, ops):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.core import dispatch
+        from paddle_tpu.core.tensor import Tensor
+
+        def loss(arr):
+            with dispatch.trace_mode():
+                return ops.relu2(Tensor(arr))._value.sum()
+
+        g = jax.jit(jax.grad(loss))(jnp.asarray([3.0, -2.0], jnp.float32))
+        np.testing.assert_allclose(np.asarray(g), [6.0, 0.0])
+
+    def test_missing_grad_raises(self, ops):
+        x = paddle.to_tensor(np.array([1.0], np.float32),
+                             stop_gradient=False)
+        y = ops.plus_one(x)
+        np.testing.assert_allclose(np.asarray(y._value), [2.0])
+        with pytest.raises(NotImplementedError):
+            y.sum().backward()
+
+    def test_build_cache_reused(self, ops, tmp_path):
+        # same sources -> same hash -> no rebuild (mtime unchanged)
+        import os
+
+        lib = ops._lib_path
+        mtime = os.path.getmtime(lib)
+        again = cpp_extension.load("test_ops", [
+            os.path.join(os.path.dirname(lib), "..", "relu2.cc")],
+            build_directory=os.path.dirname(lib))
+        assert os.path.getmtime(again._lib_path) == mtime
+
+    def test_setup_api(self, tmp_path):
+        src = tmp_path / "neg.cc"
+        src.write_text(
+            '#include <cstdint>\nextern "C" void negate(const float* x,'
+            ' float* y, int64_t n) { for (int64_t i = 0; i < n; ++i)'
+            ' y[i] = -x[i]; }\n')
+        mods = cpp_extension.setup(
+            name="neg_ops",
+            ext_modules=cpp_extension.CppExtension(sources=[str(src)]))
+        out = mods[0].negate(paddle.to_tensor(np.array([1.5], np.float32)))
+        np.testing.assert_allclose(np.asarray(out._value), [-1.5])

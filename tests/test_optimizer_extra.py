@@ -1,0 +1,135 @@
+"""Lars/Ftrl/DecayedAdagrad tests (reference test analog:
+unittests/test_ftrl_op.py, test_momentum_op.py TestLarsMomentumOp,
+test_decayed_adagrad_op.py — numpy-formula oracles)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _one_param_model(init):
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.w = self.create_parameter(
+                list(init.shape),
+                default_initializer=nn.initializer.Assign(init))
+
+        def forward(self, x):
+            return x * self.w
+
+    return M()
+
+
+def _run_steps(opt_cls, init, grads, **kw):
+    m = _one_param_model(init)
+    opt = opt_cls(parameters=m.parameters(), **kw)
+    for g in grads:
+        out = (m(paddle.to_tensor(np.asarray(g, np.float32))) ).sum()
+        out.backward()
+        opt.step()
+        opt.clear_grad()
+    return np.asarray(m.w._value)
+
+
+class TestFtrl:
+    def test_matches_numpy_formula(self):
+        init = np.array([0.5, -0.3], np.float32)
+        lr, l1, l2, lr_power = 0.1, 0.01, 0.1, -0.5
+        grads = [np.array([1.0, 1.0], np.float32)] * 3
+        # numpy oracle (ftrl_op.cc semantics)
+        p = init.copy()
+        sq = np.full_like(p, 1e-10)
+        lin = np.zeros_like(p)
+        for gmul in grads:
+            # model out = sum(g * w) -> dL/dw = g
+            g = gmul
+            new_sq = sq + g * g
+            sigma = (new_sq ** (-lr_power) - sq ** (-lr_power)) / lr
+            lin = lin + g - sigma * p
+            x = l1 * np.sign(lin) - lin
+            y = new_sq ** (-lr_power) / lr + 2 * l2
+            p = np.where(np.abs(lin) > l1, x / y, 0.0).astype(np.float32)
+            sq = new_sq
+        got = _run_steps(optimizer.Ftrl, init, grads, learning_rate=lr,
+                         l1=l1, l2=l2, lr_power=lr_power)
+        np.testing.assert_allclose(got, p, rtol=1e-5, atol=1e-6)
+
+
+class TestDecayedAdagrad:
+    def test_matches_numpy_formula(self):
+        init = np.array([1.0, -2.0], np.float32)
+        lr, decay, eps = 0.05, 0.9, 1e-6
+        grads = [np.array([0.5, -1.0], np.float32)] * 4
+        p = init.copy()
+        acc = np.zeros_like(p)
+        for g in grads:
+            acc = decay * acc + (1 - decay) * g * g
+            p = p - lr * g / (np.sqrt(acc) + eps)
+        got = _run_steps(optimizer.DecayedAdagrad, init, grads,
+                         learning_rate=lr, decay=decay, epsilon=eps)
+        np.testing.assert_allclose(got, p, rtol=1e-5, atol=1e-6)
+
+
+class TestLars:
+    def test_trust_ratio_scales_update(self):
+        init = np.array([10.0, 10.0], np.float32)
+        g = np.array([1.0, 1.0], np.float32)
+        lr, mu, coeff, wd = 0.1, 0.9, 0.001, 0.0005
+        p = init.copy()
+        v = np.zeros_like(p)
+        p_norm = np.sqrt((p ** 2).sum())
+        g_norm = np.sqrt((g ** 2).sum())
+        local_lr = lr * coeff * p_norm / (g_norm + wd * p_norm + 1e-12)
+        geff = g + wd * p
+        v = mu * v + geff
+        p_exp = p - local_lr * v
+        got = _run_steps(optimizer.Lars, init, [g], learning_rate=lr,
+                         momentum=mu, lars_coeff=coeff, lars_weight_decay=wd)
+        np.testing.assert_allclose(got, p_exp, rtol=1e-5, atol=1e-6)
+
+    def test_multi_step_velocity_carries_trust_ratio(self):
+        # reference lars_momentum: v = mu*v + local_lr*(g + wd*p); p -= v
+        init = np.array([10.0, -4.0], np.float32)
+        grads = [np.array([1.0, 0.5], np.float32),
+                 np.array([-0.2, 2.0], np.float32),
+                 np.array([0.7, -0.1], np.float32)]
+        lr, mu, coeff, wd = 0.1, 0.9, 0.001, 0.0005
+        p = init.copy()
+        v = np.zeros_like(p)
+        for g in grads:
+            p_norm = np.sqrt((p ** 2).sum())
+            g_norm = np.sqrt((g ** 2).sum())
+            local_lr = lr * coeff * p_norm / (g_norm + wd * p_norm + 1e-12)
+            v = mu * v + local_lr * (g + wd * p)
+            p = p - v
+        got = _run_steps(optimizer.Lars, init, grads, learning_rate=lr,
+                         momentum=mu, lars_coeff=coeff, lars_weight_decay=wd)
+        np.testing.assert_allclose(got, p, rtol=1e-5, atol=1e-6)
+
+    def test_weight_decay_rejected(self):
+        m = _one_param_model(np.ones(2, np.float32))
+        with pytest.raises(ValueError):
+            optimizer.Lars(parameters=m.parameters(), weight_decay=0.01)
+
+    def test_alias(self):
+        assert optimizer.LarsMomentum is optimizer.Lars
+
+    def test_converges_on_quadratic(self):
+        paddle.seed(0)
+        m = nn.Linear(4, 1)
+        opt = optimizer.Lars(learning_rate=0.5, lars_coeff=0.1,
+                             parameters=m.parameters())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(32, 4).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(32, 1).astype(np.float32))
+        first = None
+        for _ in range(30):
+            loss = ((m(x) - y) * (m(x) - y)).mean()
+            if first is None:
+                first = float(loss)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss) < first

@@ -1,0 +1,151 @@
+"""Quantization tests (reference test analog: slim/tests
+test_imperative_qat.py — QAT trains and converges; test_post_training_
+quantization_*: quantized model accuracy stays close to fp32)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.quantization import (
+    ImperativeQuantAware, PostTrainingQuantization, QuantedConv2D,
+    QuantedLinear, fake_quant, quantize_weights,
+)
+
+
+class SmallConvNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2D(1, 4, 3, padding=1)
+        self.fc = nn.Linear(4 * 8 * 8, 10)
+
+    def forward(self, x):
+        h = nn.functional.relu(self.conv(x))
+        return self.fc(h.reshape((h.shape[0], -1)))
+
+
+class TestFakeQuant:
+    def test_values_on_grid(self):
+        import jax.numpy as jnp
+
+        x = paddle.to_tensor(np.linspace(-1, 1, 11).astype(np.float32))
+        out = fake_quant(x, jnp.asarray(1.0), bits=8)
+        step = 1.0 / 127
+        grid = np.round(np.asarray(out._value) / step) * step
+        np.testing.assert_allclose(np.asarray(out._value), grid, atol=1e-7)
+
+    def test_ste_gradient_identity(self):
+        x = paddle.to_tensor(np.array([0.3, -0.7], np.float32),
+                             stop_gradient=False)
+        import jax.numpy as jnp
+
+        y = fake_quant(x, jnp.asarray(1.0))
+        y.sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad._value), [1.0, 1.0])
+
+    def test_per_channel(self):
+        import jax.numpy as jnp
+
+        w = paddle.to_tensor(
+            np.array([[0.5, 100.0], [-0.25, -50.0]], np.float32))
+        scales = jnp.asarray([0.5, 100.0])
+        out = np.asarray(fake_quant(w, scales, per_channel_axis=1)._value)
+        # column 0 quantized with its own small scale -> fine resolution
+        assert abs(out[1, 0] + 0.25) < 0.5 / 127 + 1e-6
+        assert abs(out[1, 1] + 50.0) < 100.0 / 127 + 1e-6
+
+
+class TestQAT:
+    def test_quantize_swaps_layers(self):
+        paddle.seed(0)
+        m = SmallConvNet()
+        ImperativeQuantAware().quantize(m)
+        assert isinstance(m._sub_layers["conv"], QuantedConv2D)
+        assert isinstance(m._sub_layers["fc"], QuantedLinear)
+
+    def test_qat_trains(self):
+        paddle.seed(0)
+        m = SmallConvNet()
+        ImperativeQuantAware().quantize(m)
+        opt = optimizer.Adam(1e-3, parameters=m.parameters())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(16, 1, 8, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 10, (16,)))
+        losses = []
+        for _ in range(15):
+            loss = nn.functional.cross_entropy(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.8, losses
+        # moving-average act scale was calibrated during training
+        assert float(m._sub_layers["conv"].act_scale) > 0
+
+    def test_eval_close_to_fp32(self):
+        paddle.seed(1)
+        m = SmallConvNet()
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randn(4, 1, 8, 8).astype(np.float32))
+        ref = np.asarray(m(x)._value)
+        ImperativeQuantAware().quantize(m)
+        m.eval()
+        out = np.asarray(m(x)._value)
+        # int8 simulation error stays small relative to activations
+        assert np.max(np.abs(out - ref)) < 0.15 * np.max(np.abs(ref))
+
+    def test_save_quantized_model(self, tmp_path):
+        from paddle_tpu.static import InputSpec
+
+        paddle.seed(2)
+        m = SmallConvNet()
+        q = ImperativeQuantAware()
+        q.quantize(m)
+        prefix = str(tmp_path / "qat_model")
+        q.save_quantized_model(m, prefix,
+                               input_spec=[InputSpec([2, 1, 8, 8], "float32")])
+        loaded = paddle.jit.load(prefix)
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 1, 8, 8).astype(np.float32)
+        served = np.asarray(loaded(x)._value)
+        direct = np.asarray(m(paddle.to_tensor(x))._value)
+        np.testing.assert_allclose(served, direct, rtol=1e-4, atol=1e-4)
+
+
+class TestPTQ:
+    def test_weight_only_int8(self):
+        paddle.seed(3)
+        m = SmallConvNet()
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(4, 1, 8, 8).astype(np.float32))
+        ref = np.asarray(m(x)._value)
+        _, stats = quantize_weights(m)
+        assert set(stats) == {"conv", "fc"}
+        import jax.numpy as jnp
+
+        assert m._sub_layers["conv"].qweight.dtype == jnp.int8
+        out = np.asarray(m(x)._value)
+        assert np.max(np.abs(out - ref)) < 0.1 * np.max(np.abs(ref))
+
+    def test_ptq_calibration_and_save(self, tmp_path):
+        from paddle_tpu.static import InputSpec
+
+        paddle.seed(4)
+        m = SmallConvNet()
+        rng = np.random.RandomState(5)
+
+        def samples():
+            for _ in range(4):
+                yield rng.randn(2, 1, 8, 8).astype(np.float32)
+
+        ptq = PostTrainingQuantization(m, samples, batch_nums=3)
+        ptq.quantize()
+        assert "conv" in ptq.activation_scales
+        assert ptq.activation_scales["conv"] > 0
+        assert "fc" in ptq.weight_scales
+        prefix = str(tmp_path / "ptq_model")
+        ptq.save_quantized_model(prefix,
+                                 input_spec=[InputSpec([2, 1, 8, 8], "float32")])
+        loaded = paddle.jit.load(prefix)
+        x = rng.randn(2, 1, 8, 8).astype(np.float32)
+        out = np.asarray(loaded(x)._value)
+        assert out.shape == (2, 10)
