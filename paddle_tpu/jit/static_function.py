@@ -99,8 +99,16 @@ class StaticFunction:
         return list(self._cache)
 
     def _build(self, key, args, kwargs):
+        from . import dy2static
+
         layer = self._layer
         fn = self._orig_fn
+        # rewrite `if tensor:` / `while tensor:` into lax.cond/while_loop
+        # (reference: DygraphToStaticAst in program_translator.py:582)
+        if inspect.ismethod(fn):
+            fn = dy2static.ast_transform(fn.__func__).__get__(fn.__self__)
+        else:
+            fn = dy2static.ast_transform(fn)
         if layer is not None:
             params, buffers = layer.functional_state()
         else:
@@ -134,9 +142,9 @@ class StaticFunction:
                     t_inputs = [Tensor(a, stop_gradient=True) for a in input_arrs]
                     a2, kw2 = rebuild_in(t_inputs, in_skel)
                     out = fn(*a2, **kw2)
-                    out_tensors, out_skel, _ = _flatten_tensors(out)
+                    out_tensors, out_skel, rebuild_out = _flatten_tensors(out)
                     out_box["skel"] = out_skel
-                    out_box["rebuild"] = _flatten_tensors(out)[2]
+                    out_box["rebuild"] = rebuild_out
                     return tuple(t._value for t in out_tensors)
             finally:
                 if layer is not None:

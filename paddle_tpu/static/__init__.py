@@ -55,3 +55,15 @@ class _StaticNN:
 
 
 nn_compat = _StaticNN()
+
+from . import nn_control_flow  # noqa: E402
+from .nn_control_flow import case, cond, switch_case, while_loop  # noqa: F401,E402
+
+# expose the control-flow layers on the static.nn namespace (reference:
+# paddle.static.nn.cond / while_loop / case / switch_case). static.nn is
+# the main nn module here, so attach there as well as on the fc/conv shim.
+for _cf_name, _cf in (("cond", cond), ("while_loop", while_loop),
+                      ("case", case), ("switch_case", switch_case)):
+    nn_compat.__dict__[_cf_name] = _cf
+    if not hasattr(nn, _cf_name):
+        setattr(nn, _cf_name, _cf)

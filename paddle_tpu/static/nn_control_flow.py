@@ -1,0 +1,130 @@
+"""Explicit control-flow ops (reference: operators/controlflow/
+conditional_block_op.cc + while_op.cc, exposed as
+python/paddle/fluid/layers/control_flow.py cond:*, while_loop:*, case,
+switch_case; re-exported by paddle.static.nn).
+
+TPU-native: cond -> lax.cond, while_loop -> lax.while_loop,
+switch_case -> lax.switch — compiled XLA control flow, usable both in
+dygraph (concrete predicates short-circuit to Python) and under
+jit/to_static (traced predicates compile).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..jit.dy2static import _pred_value
+
+
+def _flatten_out(out, box):
+    leaves, treedef = jax.tree_util.tree_flatten(
+        out, is_leaf=lambda x: isinstance(x, Tensor))
+    box["treedef"] = treedef
+    box["is_tensor"] = [isinstance(l, Tensor) for l in leaves]
+    return tuple(l._value if isinstance(l, Tensor) else jnp.asarray(l)
+                 for l in leaves)
+
+
+def _rebuild_out(flat, box):
+    leaves = [Tensor(a, stop_gradient=True) for a in flat]
+    return jax.tree_util.tree_unflatten(box["treedef"], leaves)
+
+
+def _traced_select(chooser, fns):
+    """Shared lax.cond/lax.switch plumbing: wrap no-arg branch callables
+    into flat-array branches sharing one output skeleton."""
+    box = {}
+
+    def wrap(fn):
+        def g(_):
+            return _flatten_out(fn(), box)
+
+        return g
+
+    flat = chooser([wrap(f) for f in fns])
+    return _rebuild_out(flat, box)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """reference: layers.cond — branch callables take no args; both must
+    return structurally-identical outputs. A None branch is a no-op
+    returning None (reference cond allows None when the other branch
+    returns nothing)."""
+    true_fn = true_fn or (lambda: None)
+    false_fn = false_fn or (lambda: None)
+    kind, p = _pred_value(pred)
+    if kind == "py":
+        return true_fn() if p else false_fn()
+    return _traced_select(
+        lambda fns: jax.lax.cond(p != 0, fns[0], fns[1], ()),
+        [true_fn, false_fn])
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None,
+               maximum_iterations=None):
+    """reference: layers.while_loop — body returns the next loop_vars list;
+    shapes/dtypes must be loop-invariant (while_op contract).
+
+    Pass `maximum_iterations` to make the traced loop reverse-mode
+    differentiable: it lowers to a lax.scan of that many cond-masked steps
+    (the while_grad analog — XLA cannot differentiate a dynamic trip
+    count, so the bound buys the backward pass)."""
+    from ..jit.dy2static import convert_while
+
+    vals = tuple(loop_vars)
+    body = lambda *vs: tuple(body_fn(*vs))  # noqa: E731
+    out = convert_while(lambda *vs: cond_fn(*vs), body, vals,
+                        maximum_iterations=maximum_iterations)
+    return list(out)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """reference: layers.case — first matching predicate wins."""
+    if not pred_fn_pairs:
+        raise ValueError("case: pred_fn_pairs must be non-empty")
+    pred, fn = pred_fn_pairs[0]
+    rest = pred_fn_pairs[1:]
+    if not rest:
+        if default is None:
+            return cond(pred, fn, fn)
+        return cond(pred, fn, default)
+    return cond(pred, fn, lambda: case(rest, default))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """reference: layers.switch_case -> lax.switch (native XLA multi-way)."""
+    if not branch_fns:
+        raise ValueError("switch_case: branch_fns must be non-empty")
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns)) if callable(branch_fns[0]) \
+            else sorted(branch_fns)
+    keys = [k for k, _ in items]
+    fns = [f for _, f in items]
+    idx_arr = branch_index._value if isinstance(branch_index, Tensor) \
+        else jnp.asarray(branch_index)
+    idx_arr = jnp.squeeze(idx_arr)
+
+    dense = keys == list(range(len(keys)))
+    traced = isinstance(idx_arr, jax.core.Tracer)
+    if not traced:
+        i = int(idx_arr)
+        if i in keys:
+            return fns[keys.index(i)]()
+        if default is not None:
+            return default()
+        return fns[-1]()  # reference falls back to the max-key branch
+
+    branch_list = list(fns) + ([default] if default is not None else [])
+    default_pos = len(branch_list) - 1
+    if dense:
+        pos = jnp.clip(idx_arr, 0, len(fns) - 1)
+        pos = jnp.where((idx_arr >= 0) & (idx_arr < len(fns)), pos,
+                        default_pos)
+    else:
+        pos = jnp.asarray(default_pos)
+        for j, k in enumerate(keys):
+            pos = jnp.where(idx_arr == k, j, pos)
+    return _traced_select(lambda wrapped: jax.lax.switch(pos, wrapped, ()),
+                          branch_list)

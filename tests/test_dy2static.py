@@ -1,0 +1,290 @@
+"""dygraph_to_static control-flow tests (reference test analog:
+unittests/dygraph_to_static/test_ifelse.py, test_loop.py,
+unittests/test_cond.py, test_while_loop_op.py — dygraph-vs-static numeric
+equality on data-dependent control flow)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+from paddle_tpu.jit import to_static
+
+
+def relu_abs(x):
+    # data-dependent branch: takes a different path per call
+    if paddle.sum(x) > 0:
+        y = x * 2.0
+    else:
+        y = -x
+    return y + 1.0
+
+
+class TestIfElse:
+    def test_matches_eager_both_paths(self):
+        f = to_static(relu_abs)
+        for sign in (1.0, -1.0):
+            x = np.full((3,), sign, np.float32)
+            got = np.asarray(f(paddle.to_tensor(x))._value)
+            ref = np.asarray(relu_abs(paddle.to_tensor(x))._value)
+            np.testing.assert_allclose(got, ref)
+
+    def test_one_compile_serves_both_branches(self):
+        calls = {"n": 0}
+
+        def g(x):
+            calls["n"] += 1
+            if paddle.mean(x) > 0:
+                out = x + 10.0
+            else:
+                out = x - 10.0
+            return out
+
+        f = to_static(g)
+        a = np.asarray(f(paddle.to_tensor(np.ones(2, np.float32)))._value)
+        b = np.asarray(f(paddle.to_tensor(-np.ones(2, np.float32)))._value)
+        np.testing.assert_allclose(a, [11.0, 11.0])
+        np.testing.assert_allclose(b, [-11.0, -11.0])
+        assert calls["n"] == 1  # same spec -> traced once, lax.cond inside
+
+    def test_new_var_defined_in_both_branches(self):
+        def g(x):
+            if paddle.sum(x) > 0:
+                flag = x * 1.0
+            else:
+                flag = x * 0.0
+            return flag
+
+        f = to_static(g)
+        out = np.asarray(f(paddle.to_tensor(np.ones(2, np.float32)))._value)
+        np.testing.assert_allclose(out, [1.0, 1.0])
+
+    def test_elif_chain(self):
+        def g(x):
+            s = paddle.sum(x)
+            if s > 10:
+                out = x * 3.0
+            elif s > 0:
+                out = x * 2.0
+            else:
+                out = x * 0.0
+            return out
+
+        f = to_static(g)
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor(np.full(2, 10.0, np.float32)))._value),
+            [30.0, 30.0])
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor(np.full(2, 1.0, np.float32)))._value),
+            [2.0, 2.0])
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor(np.full(2, -1.0, np.float32)))._value),
+            [0.0, 0.0])
+
+    def test_python_pred_untouched(self):
+        def g(x, flag=True):
+            if flag:  # plain python predicate keeps python semantics
+                return x + 1.0
+            return x - 1.0
+
+        f = to_static(g)
+        out = np.asarray(f(paddle.to_tensor(np.zeros(2, np.float32)))._value)
+        np.testing.assert_allclose(out, [1.0, 1.0])
+
+
+class TestWhile:
+    def test_data_dependent_while(self):
+        def g(x):
+            while paddle.sum(x) < 100.0:
+                x = x * 2.0
+            return x
+
+        f = to_static(g)
+        x = np.ones(4, np.float32)
+        got = np.asarray(f(paddle.to_tensor(x))._value)
+        ref = x.copy()
+        while ref.sum() < 100:
+            ref = ref * 2
+        np.testing.assert_allclose(got, ref)
+
+    def test_while_with_counter(self):
+        def g(x):
+            i = 0
+            while paddle.max(x) < 50.0:
+                x = x + float(1.0)
+                i = i + 1
+            return x, i
+
+        f = to_static(g)
+        out, i = f(paddle.to_tensor(np.zeros(2, np.float32)))
+        assert float(np.asarray(out._value)[0]) == 50.0
+        assert int(np.asarray(i._value)) == 50
+
+    def test_nested_if_in_while(self):
+        def g(x):
+            while paddle.sum(x) < 10.0:
+                if paddle.min(x) < 1.0:
+                    x = x + 1.0
+                else:
+                    x = x * 1.5
+            return x
+
+        f = to_static(g)
+        got = np.asarray(f(paddle.to_tensor(np.zeros(2, np.float32)))._value)
+        ref = np.zeros(2, np.float32)
+        while ref.sum() < 10:
+            ref = ref + 1 if ref.min() < 1 else ref * 1.5
+        np.testing.assert_allclose(got, ref)
+
+
+class TestExplicitControlFlowOps:
+    def test_cond_eager(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        out = static.nn.cond(paddle.sum(x) > 1,
+                             lambda: x * 2, lambda: x * 3)
+        np.testing.assert_allclose(np.asarray(out._value), [4.0])
+
+    def test_cond_traced(self):
+        import jax
+
+        from paddle_tpu.core import dispatch
+        from paddle_tpu.core.tensor import Tensor
+
+        def f(arr):
+            with dispatch.trace_mode():
+                t = Tensor(arr)
+                out = static.cond(paddle.sum(t) > 0, lambda: t + 1,
+                                  lambda: t - 1)
+                return out._value
+
+        np.testing.assert_allclose(
+            np.asarray(jax.jit(f)(np.ones(2, np.float32))), [2.0, 2.0])
+        np.testing.assert_allclose(
+            np.asarray(jax.jit(f)(-np.ones(2, np.float32))), [-2.0, -2.0])
+
+    def test_while_loop_api(self):
+        i = paddle.to_tensor(np.asarray(0))
+        ten = paddle.to_tensor(np.asarray(10))
+
+        out = static.nn.while_loop(
+            lambda i: i < ten, lambda i: [i + 1], [i])
+        assert int(np.asarray(out[0]._value)) == 10
+
+    def test_while_loop_bounded_is_differentiable(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.core import dispatch
+        from paddle_tpu.core.tensor import Tensor
+
+        def loss(arr):
+            with dispatch.trace_mode():
+                h = Tensor(arr)
+                out = static.nn.while_loop(
+                    lambda v: paddle.max(v) > 4.0,
+                    lambda v: [v * 0.5],
+                    [h], maximum_iterations=8)[0]
+                return out._value.sum()
+
+        x = np.asarray([16.0, 2.0], np.float32)
+        g = jax.jit(jax.grad(loss))(jnp.asarray(x))
+        # 16 halves twice (16->8->4), so d(out)/dx = 0.25 for both lanes
+        np.testing.assert_allclose(np.asarray(g), [0.25, 0.25])
+
+    def test_case_api(self):
+        x = paddle.to_tensor(np.asarray(0.3, np.float32))
+        out = static.nn.case(
+            [(x < 0.1, lambda: paddle.to_tensor(np.asarray(1.0, np.float32))),
+             (x < 0.5, lambda: paddle.to_tensor(np.asarray(2.0, np.float32)))],
+            default=lambda: paddle.to_tensor(np.asarray(3.0, np.float32)))
+        assert float(np.asarray(out._value)) == 2.0
+
+    def test_switch_case_eager_and_traced(self):
+        import jax
+
+        from paddle_tpu.core import dispatch
+        from paddle_tpu.core.tensor import Tensor
+
+        fns = [lambda: paddle.to_tensor(np.asarray(10.0, np.float32)),
+               lambda: paddle.to_tensor(np.asarray(20.0, np.float32)),
+               lambda: paddle.to_tensor(np.asarray(30.0, np.float32))]
+        out = static.nn.switch_case(paddle.to_tensor(np.asarray(1)), fns)
+        assert float(np.asarray(out._value)) == 20.0
+
+        def f(idx):
+            with dispatch.trace_mode():
+                t = Tensor(idx)
+                fns2 = [lambda: t * 0 + 10.0, lambda: t * 0 + 20.0,
+                        lambda: t * 0 + 30.0]
+                return static.switch_case(t, fns2)._value
+
+        assert float(jax.jit(f)(np.asarray(2))) == 30.0
+        assert float(jax.jit(f)(np.asarray(7))) == 30.0  # out of range -> last
+
+
+_module_scale = 100.0
+
+
+class TestScopingAndEdgeCases:
+    def test_closure_shadows_module_global(self):
+        _module_scale_local = None  # noqa: F841
+
+        def outer():
+            _module_scale = 2.0  # same name as the module global
+
+            def inner(x):
+                if paddle.sum(x) > 0:
+                    y = x * _module_scale
+                else:
+                    y = x
+                return y
+
+            return inner
+
+        f = to_static(outer())
+        out = np.asarray(f(paddle.to_tensor(np.ones(2, np.float32)))._value)
+        np.testing.assert_allclose(out, [2.0, 2.0])  # closure wins, not 100.0
+
+    def test_cond_none_branch(self):
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        assert static.nn.cond(paddle.sum(x) < 0, lambda: x * 2) is None
+
+    def test_switch_case_empty_raises(self):
+        with pytest.raises(ValueError):
+            static.nn.switch_case(paddle.to_tensor(np.asarray(0)), [])
+
+    def test_del_in_branch_keeps_python_semantics(self):
+        def g(x, flag=True):
+            if flag:
+                tmp = x + 1.0
+                out = tmp * 2.0
+                del tmp
+            else:
+                out = x
+            return out
+
+        f = to_static(g)
+        out = np.asarray(f(paddle.to_tensor(np.zeros(2, np.float32)))._value)
+        np.testing.assert_allclose(out, [2.0, 2.0])
+
+
+class TestLayerToStatic:
+    def test_layer_with_data_dependent_branch(self):
+        class Gate(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                if paddle.mean(h) > 0:
+                    out = h * 2.0
+                else:
+                    out = h * 0.5
+                return out
+
+        paddle.seed(0)
+        m = Gate()
+        ref_pos = np.asarray(m(paddle.to_tensor(np.ones((2, 4), np.float32)))._value)
+        to_static(m)
+        got_pos = np.asarray(m(paddle.to_tensor(np.ones((2, 4), np.float32)))._value)
+        np.testing.assert_allclose(got_pos, ref_pos, rtol=1e-5)
