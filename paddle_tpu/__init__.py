@@ -65,6 +65,7 @@ from . import distributed  # noqa: F401
 from . import distribution  # noqa: F401
 from . import hapi  # noqa: F401
 from . import text  # noqa: F401
+from . import dataset  # noqa: F401
 from . import incubate  # noqa: F401
 from . import inference  # noqa: F401
 from . import quantization  # noqa: F401

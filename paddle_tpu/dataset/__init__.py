@@ -1,0 +1,14 @@
+"""paddle.dataset — legacy reader-generator corpora (reference:
+python/paddle/dataset/: mnist.py, cifar.py, imdb.py, uci_housing.py,
+movielens.py, conll05.py, wmt14/16.py — download-and-parse readers used by
+the book examples and old tests).
+
+Zero-egress image: when the real corpus file is absent the readers fall
+back to deterministic synthetic data with the same shapes/vocab structure
+(learnable class-conditional templates, mirroring vision/datasets). Each
+submodule keeps the reference's generator-of-samples contract:
+``train()``/``test()`` return a callable yielding sample tuples.
+"""
+from . import cifar, common, imdb, mnist, movielens, uci_housing  # noqa: F401
+
+__all__ = ["mnist", "cifar", "imdb", "uci_housing", "movielens", "common"]

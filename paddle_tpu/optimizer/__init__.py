@@ -4,3 +4,6 @@ from .optimizer import (  # noqa: F401
     Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta, RMSProp, Lamb,
     Lars, LarsMomentum, Ftrl, DecayedAdagrad,
 )
+from .wrappers import (  # noqa: F401
+    ExponentialMovingAverage, LookAhead, ModelAverage,
+)

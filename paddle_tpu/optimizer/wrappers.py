@@ -1,0 +1,155 @@
+"""Parameter-averaging optimizer wrappers (reference: fluid/optimizer.py
+ExponentialMovingAverage, ModelAverage, LookaheadOptimizer:5545).
+
+Eager-mode wrappers over Layer parameters: they keep host-side shadow
+state as device arrays and swap it in/out around evaluation — the same
+contract as the reference's apply()/restore() program guards, without the
+program surgery.
+"""
+import contextlib
+
+import jax.numpy as jnp
+
+
+class ExponentialMovingAverage:
+    """reference: fluid ExponentialMovingAverage — shadow = decay*shadow +
+    (1-decay)*param after each update; apply() swaps EMA weights in."""
+
+    def __init__(self, decay=0.999, thres_steps=None, parameters=None,
+                 layer=None, name=None):
+        if layer is not None:
+            parameters = list(layer.parameters())
+        if not parameters:
+            raise ValueError("EMA needs parameters= or layer=")
+        self._params = list(parameters)
+        self._decay = decay
+        self._step = 0
+        self._shadow = {id(p): jnp.asarray(p._value) for p in self._params}
+        self._backup = {}
+
+    def update(self):
+        self._step += 1
+        # zero-debias via min(decay, (1+t)/(10+t)) like the TF/ref formula
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in self._params:
+            self._shadow[id(p)] = (d * self._shadow[id(p)] +
+                                   (1 - d) * p._value)
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap EMA weights in; returns a context manager when used via
+        `with ema.apply():` (restores on exit if need_restore)."""
+        self._backup = {id(p): p._value for p in self._params}
+        for p in self._params:
+            p._value = self._shadow[id(p)]
+
+        ema = self
+
+        @contextlib.contextmanager
+        def guard():
+            try:
+                yield
+            finally:
+                if need_restore:
+                    ema.restore()
+
+        return guard()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._value = self._backup[id(p)]
+        self._backup = {}
+
+
+class LookAhead:
+    """reference: LookaheadOptimizer — fast weights step every iteration;
+    every k steps slow += alpha * (fast - slow), fast = slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be in [0, 1]")
+        self.inner_optimizer = inner_optimizer
+        self._alpha = alpha
+        self._k = int(k)
+        self._step = 0
+        self._params = list(inner_optimizer._parameter_list or [])
+        self._slow = {id(p): jnp.asarray(p._value) for p in self._params}
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step += 1
+        if self._step % self._k == 0:
+            for p in self._params:
+                slow = self._slow[id(p)] + self._alpha * (p._value -
+                                                          self._slow[id(p)])
+                self._slow[id(p)] = slow
+                p._value = slow
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """reference: fluid ModelAverage — windowed running average of params;
+    apply() swaps the average in for evaluation."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None, layer=None,
+                 min_average_window=10000, max_average_window=10000000,
+                 name=None):
+        if layer is not None:
+            parameters = list(layer.parameters())
+        if not parameters:
+            raise ValueError("ModelAverage needs parameters= or layer=")
+        self._params = list(parameters)
+        self._rate = average_window_rate
+        self._min_w = min_average_window
+        self._max_w = max_average_window
+        self._n = 0
+        self._sum = {id(p): jnp.zeros_like(p._value) for p in self._params}
+        self._backup = {}
+
+    def update(self):
+        self._n += 1
+        window = max(self._min_w, min(self._max_w,
+                                      int(self._n * self._rate) or 1))
+        for p in self._params:
+            s = self._sum[id(p)] + p._value
+            # restart accumulation when the window is exceeded (reference
+            # average_accumulates_op's window shuffle, simplified)
+            if self._n > window * 2:
+                s = p._value.astype(s.dtype)
+            self._sum[id(p)] = s
+        if self._n > window * 2:
+            self._n = 1
+
+    def apply(self, executor=None, need_restore=True):
+        n = max(self._n, 1)
+        self._backup = {id(p): p._value for p in self._params}
+        for p in self._params:
+            p._value = (self._sum[id(p)] / n).astype(p._value.dtype)
+
+        ma = self
+
+        @contextlib.contextmanager
+        def guard():
+            try:
+                yield
+            finally:
+                if need_restore:
+                    ma.restore()
+
+        return guard()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._value = self._backup[id(p)]
+        self._backup = {}

@@ -1,0 +1,166 @@
+"""hapi Model.fit + legacy paddle.dataset + averaging-wrapper tests
+(reference test analogs: tests/unittests/test_model.py — fit/evaluate/
+predict on MNIST; dataset readers; test_lookahead.py, test_ema.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import Model, dataset, io, nn, optimizer
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+
+
+class TestHapiModel:
+    @pytest.fixture(scope="class")
+    def fitted(self):
+        paddle.seed(0)
+        net = LeNet()
+        model = Model(net)
+        model.prepare(optimizer.Adam(1e-3, parameters=net.parameters()),
+                      nn.CrossEntropyLoss(), Accuracy())
+        train = _Subset(MNIST(mode="train"), 2048)
+        val = _Subset(MNIST(mode="test"), 256)
+        model.fit(train, val, batch_size=64, epochs=2, verbose=0)
+        return model, val
+
+    def test_fit_learns(self, fitted):
+        model, val = fitted
+        res = model.evaluate(val, batch_size=64, verbose=0)
+        assert res["acc"] > 0.9, res
+
+    def test_predict_shapes(self, fitted):
+        model, val = fitted
+        out = model.predict(val, batch_size=64, verbose=0)
+        assert out[0][0].shape[-1] == 10
+
+    def test_train_eval_batch(self, fitted):
+        model, val = fitted
+        x, y = val[0]
+        loss = model.eval_batch([np.asarray(x)[None]], [np.asarray(y).reshape(1, 1)])
+        assert np.isfinite(loss[0][0])
+
+    def test_save_load_roundtrip(self, fitted, tmp_path):
+        model, val = fitted
+        path = str(tmp_path / "hapi_ckpt")
+        model.save(path)
+        paddle.seed(123)
+        net2 = LeNet()
+        m2 = Model(net2)
+        m2.prepare(optimizer.Adam(1e-3, parameters=net2.parameters()),
+                   nn.CrossEntropyLoss(), Accuracy())
+        m2.load(path)
+        res = m2.evaluate(_Subset(MNIST(mode="test"), 128), batch_size=64,
+                          verbose=0)
+        assert res["acc"] > 0.9
+
+
+class _Subset:
+    def __init__(self, ds, n):
+        self.ds = ds
+        self.n = min(n, len(ds))
+
+    def __getitem__(self, i):
+        return self.ds[i]
+
+    def __len__(self):
+        return self.n
+
+
+class TestLegacyDataset:
+    def test_mnist_reader_contract(self):
+        r = dataset.mnist.train()
+        x, y = next(iter(r()))
+        assert x.shape == (784,) and x.dtype == np.float32
+        assert -1.0 <= x.min() and x.max() <= 1.0
+        assert 0 <= y < 10
+
+    def test_cifar_reader(self):
+        x, y = next(iter(dataset.cifar.train10()()))
+        assert x.shape == (3072,)
+        assert 0 <= y < 10
+
+    def test_imdb_learnable(self):
+        # a unigram count classifier must beat chance on the synthetic corpus
+        wd = dataset.imdb.word_dict()
+        V = len(wd)
+        counts = np.zeros((2, V))
+        for seq, label in dataset.imdb.train()():
+            np.add.at(counts[label], np.asarray(seq), 1)
+        logp = np.log(counts + 1.0) - np.log(counts.sum(1, keepdims=True) + V)
+        correct = total = 0
+        for seq, label in dataset.imdb.test()():
+            pred = int(logp[:, np.asarray(seq)].sum(1).argmax())
+            correct += pred == label
+            total += 1
+        assert correct / total > 0.8, correct / total
+
+    def test_uci_housing(self):
+        x, y = next(iter(dataset.uci_housing.train()()))
+        assert x.shape == (13,)
+
+    def test_movielens_latent_structure(self):
+        rows = list(dataset.movielens.train()())
+        assert len(rows) == 4000
+        scores = np.asarray([r[-1] for r in rows])
+        assert 1.0 <= scores.min() and scores.max() <= 5.0
+        assert dataset.movielens.max_user_id() == 944
+
+    def test_download_disabled_raises(self, tmp_path):
+        with pytest.raises(RuntimeError):
+            dataset.common.download("http://example.com/x.tgz", "x")
+
+
+class TestAveragingWrappers:
+    def _setup(self):
+        paddle.seed(0)
+        m = nn.Linear(4, 2)
+        opt = optimizer.SGD(0.1, parameters=m.parameters())
+        x = paddle.to_tensor(np.random.RandomState(0).randn(8, 4).astype(np.float32))
+        return m, opt, x
+
+    def test_ema_apply_restore(self):
+        m, opt, x = self._setup()
+        ema = optimizer.ExponentialMovingAverage(0.9, parameters=m.parameters())
+        for _ in range(5):
+            m(x).mean().backward()
+            opt.step()
+            opt.clear_grad()
+            ema.update()
+        live = np.asarray(m.weight._value)
+        with ema.apply():
+            shadow = np.asarray(m.weight._value)
+            assert not np.allclose(live, shadow)
+        np.testing.assert_allclose(np.asarray(m.weight._value), live)
+
+    def test_lookahead_syncs_every_k(self):
+        m, opt, x = self._setup()
+        # NB alpha=0.5 with a constant gradient would land the sync exactly
+        # on w1 (w0 - 0.2g scaled by 0.5 = w0 - 0.1g); 0.8 separates them
+        la = optimizer.LookAhead(opt, alpha=0.8, k=2)
+        w0 = np.asarray(m.weight._value).copy()
+        m(x).mean().backward()
+        la.step(); la.clear_grad()
+        w1 = np.asarray(m.weight._value)
+        m(x).mean().backward()
+        la.step(); la.clear_grad()   # k=2 -> slow/fast sync here
+        w2 = np.asarray(m.weight._value)
+        assert not np.allclose(w1, w2)
+        # after the k-step sync the slow weights equal the live weights
+        np.testing.assert_allclose(
+            np.asarray(la._slow[id(m.weight)]), w2)
+
+    def test_model_average(self):
+        m, opt, x = self._setup()
+        ma = optimizer.ModelAverage(parameters=m.parameters(),
+                                    min_average_window=2)
+        snapshots = []
+        for _ in range(4):
+            m(x).mean().backward()
+            opt.step(); opt.clear_grad()
+            ma.update()
+            snapshots.append(np.asarray(m.weight._value).copy())
+        with ma.apply():
+            avg = np.asarray(m.weight._value)
+        np.testing.assert_allclose(avg, np.mean(snapshots, axis=0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(m.weight._value), snapshots[-1])
