@@ -5,9 +5,16 @@ north-star config 3). Runs the full jitted training step (fwd + bwd +
 AdamW) on one chip and reports tokens/sec.
 
 Baseline: A100 80GB BERT-base seq128 mixed-precision pretraining is
-~2700 seq/s ≈ 345k tokens/s per chip (NVIDIA DeepLearningExamples
-order-of-magnitude; the reference repo publishes no numbers — see
-BASELINE.md). vs_baseline = value / 345600; the target is ≥ 0.8.
+~2700 seq/s ~= 345k tokens/s per chip (NVIDIA DeepLearningExamples
+order-of-magnitude; the reference repo publishes no numbers -- see
+BASELINE.md). vs_baseline = value / 345600; the target is >= 0.8.
+
+TPU init policy: the axon tunnel can take many minutes to come up, so we
+retry jax.devices() with backoff for BENCH_INIT_TIMEOUT seconds (default
+30 min). If the TPU never materialises we print a DISTINCT FAILURE
+record (error field, value 0) and exit non-zero -- never a silent
+tiny-CPU number. BENCH_CPU=1 is the explicit hermetic smoke mode and is
+marked "smoke": true in the output.
 
 Prints exactly ONE json line to stdout.
 """
@@ -19,31 +26,79 @@ import time
 import numpy as np
 
 A100_BERT_BASE_TOKENS_PER_SEC = 345600.0
+METRIC = "bert_base_pretrain_tokens_per_sec_per_chip"
 
 BATCH = int(os.environ.get("BENCH_BATCH", "32"))
 SEQ = int(os.environ.get("BENCH_SEQ", "128"))
 WARMUP = int(os.environ.get("BENCH_WARMUP", "3"))
-STEPS = int(os.environ.get("BENCH_STEPS", "10"))
+STEPS = int(os.environ.get("BENCH_STEPS", "20"))
+INIT_TIMEOUT = float(os.environ.get("BENCH_INIT_TIMEOUT", "1800"))
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def fail(msg):
+    print(json.dumps({
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "error": msg,
+    }))
+    sys.exit(1)
+
+
+def init_tpu_patiently():
+    """Init the TPU backend, retrying for up to INIT_TIMEOUT seconds.
+
+    Returns the device list, or None if the TPU backend never came up.
+    A single jax.devices() call may itself block for minutes during
+    tunnel setup -- that is fine; we only bound total wall clock.
+    """
+    import jax
+
+    t0 = time.time()
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            log(f"TPU init attempt {attempt} (t={time.time() - t0:.0f}s) ...")
+            devs = jax.devices()
+            if devs and devs[0].platform in ("tpu", "axon"):
+                log(f"TPU up after {time.time() - t0:.0f}s: {devs}")
+                return devs
+            raise RuntimeError(f"no TPU platform in {devs}")
+        except RuntimeError as e:
+            remaining = INIT_TIMEOUT - (time.time() - t0)
+            log(f"attempt {attempt} failed ({e}); {remaining:.0f}s budget left")
+            if remaining <= 0:
+                return None
+            try:  # drop any cached failed backend so the next try is real
+                import jax.extend.backend
+
+                jax.extend.backend.clear_backends()
+            except Exception as ce:
+                log(f"clear_backends failed ({ce}); retrying anyway")
+            time.sleep(min(30.0, max(5.0, remaining / 10.0)))
+
+
 def main():
     import jax
 
-    if os.environ.get("BENCH_CPU") == "1":
-        # hermetic smoke mode: skip the axon tunnel entirely
-        jax.config.update("jax_platforms", "cpu")
-    try:
-        devs = jax.devices()
-    except RuntimeError as e:
-        log("TPU backend unavailable, falling back to CPU:", e)
+    smoke = os.environ.get("BENCH_CPU") == "1"
+    if smoke:
         jax.config.update("jax_platforms", "cpu")
         devs = jax.devices()
+        platform = "cpu"
+    else:
+        devs = init_tpu_patiently()
+        if devs is None:
+            fail(f"tpu_unavailable: axon backend did not initialise within "
+                 f"{INIT_TIMEOUT:.0f}s")
+        platform = devs[0].platform
     log("devices:", devs)
-    on_tpu = devs[0].platform in ("tpu", "axon")
 
     import jax.numpy as jnp
     import paddle_tpu as paddle
@@ -52,9 +107,8 @@ def main():
     from paddle_tpu.text.models import BertForPretraining
 
     paddle.seed(0)
-    tiny = not on_tpu and os.environ.get("BENCH_FULL") != "1"
-    if tiny:
-        log("CPU fallback: tiny config (numbers not meaningful)")
+    if smoke:
+        log("BENCH_CPU=1 smoke mode: tiny config (numbers not meaningful)")
         model = BertForPretraining(
             vocab_size=1024, hidden_size=128, num_hidden_layers=2,
             num_attention_heads=4, intermediate_size=256,
@@ -71,12 +125,6 @@ def main():
     vocab = model.bert.vocab_size
 
     class TrainWrapper(nn.Layer):
-        """forward(batch_ids_and_labels) -> (mlm_logits, nsp_logits).
-
-        build_train_step passes one input tensor; pack ids/labels along a
-        leading axis of 2 rows is awkward — instead close over labels via
-        loss_fn taking the packed y."""
-
         def __init__(self, inner):
             super().__init__()
             self.inner = inner
@@ -109,7 +157,8 @@ def main():
     labels_np = np.where(mask, labels_np, -100).astype(np.int32)
     labels = jnp.asarray(labels_np)
 
-    log(f"compiling + warmup ({WARMUP} steps), batch={batch} seq={seq} ...")
+    log(f"compiling + warmup ({WARMUP} steps), batch={batch} seq={seq} "
+        f"amp={amp_level} platform={platform} ...")
     key = jax.random.PRNGKey(0)
     t0 = time.time()
     loss = None
@@ -130,12 +179,15 @@ def main():
     log(f"{steps} steps in {dt:.2f}s -> {tokens_per_sec:.0f} tokens/s, "
         f"final loss {float(loss):.4f}")
 
-    print(json.dumps({
-        "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+    rec = {
+        "metric": METRIC,
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(tokens_per_sec / A100_BERT_BASE_TOKENS_PER_SEC, 4),
-    }))
+    }
+    if smoke:
+        rec["smoke"] = True
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

@@ -8,13 +8,11 @@ facade, ``paddle.distributed``/fleet for mesh parallelism.
 
 __version__ = "0.1.0"
 
-import jax as _jax
-
-# Paddle semantics: int64 is the default integer dtype (VarType.INT64) and
-# explicit dtypes are honored. jax's 32-bit default would silently downcast,
-# so enable x64; floats still default to float32 via core.dtype.
-_jax.config.update("jax_enable_x64", True)
-
+# TPU dtype policy: compute stays 32-bit (x64 OFF — int64/float64 index and
+# embedding traffic double HBM bandwidth and block Mosaic lowering). Paddle's
+# int64/float64 API names remain accepted everywhere and canonicalize to the
+# 32-bit equivalents via core.dtype.convert_dtype — the per-op dtype policy
+# replacing the reference's VarType.INT64 default (framework.proto:23-60).
 from .core import dispatch as _dispatch
 from .core import dtype as _dtype
 from .core import errors, flags as _flags

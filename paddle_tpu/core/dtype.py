@@ -45,8 +45,27 @@ FLOAT_DTYPES = (jnp.float16, jnp.bfloat16, jnp.float32, jnp.float64)
 INT_DTYPES = (jnp.uint8, jnp.int8, jnp.int16, jnp.int32, jnp.int64)
 
 
+# TPU canonicalization: 64-bit compute dtypes double HBM traffic (index /
+# embedding loads) and break Mosaic index-math lowering, so the reference's
+# VarType.INT64-default semantics become "the name is accepted, the compute
+# dtype is 32-bit" — mirroring jax's own no-x64 canonicalization but applied
+# at the framework's dtype funnel so no jax warnings fire.
+_CANONICAL = {
+    np.dtype(np.int64): np.dtype(np.int32),
+    np.dtype(np.uint64): np.dtype(np.uint32),
+    np.dtype(np.float64): np.dtype(np.float32),
+    np.dtype(np.complex128): np.dtype(np.complex64),
+}
+
+
+def canonicalize_dtype(dtype):
+    d = np.dtype(dtype)
+    return _CANONICAL.get(d, d)
+
+
 def convert_dtype(dtype):
-    """Normalize a string / numpy / jnp dtype spec to a numpy dtype object."""
+    """Normalize a string / numpy / jnp dtype spec to a (canonical 32-bit)
+    numpy dtype object."""
     if dtype is None:
         return None
     if isinstance(dtype, str):
@@ -54,7 +73,7 @@ def convert_dtype(dtype):
             dtype = _STR2DTYPE[dtype]
         except KeyError:
             raise ValueError(f"unknown dtype {dtype!r}")
-    return np.dtype(dtype)
+    return canonicalize_dtype(dtype)
 
 
 def dtype_name(dtype):

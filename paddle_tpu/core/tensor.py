@@ -40,8 +40,6 @@ class Tensor:
             arr = np.asarray(value)
             if np_dtype is None and arr.dtype == np.float64:
                 np_dtype = np.dtype(dtype_mod.get_default_dtype())
-            if np_dtype is None and arr.dtype == np.int64 and arr.ndim == 0:
-                np_dtype = np.dtype(np.int64)
             value = jnp.asarray(arr, dtype=np_dtype)
             if place is not None:
                 value = jax.device_put(value, place.jax_device())

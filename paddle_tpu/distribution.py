@@ -92,7 +92,7 @@ class Categorical(Distribution):
     def sample(self, shape=()):
         def _s(key, logits, *, shape):
             return jax.random.categorical(key, logits, shape=tuple(shape) +
-                                          logits.shape[:-1]).astype(jnp.int64)
+                                          logits.shape[:-1]).astype(jnp.int32)
 
         return apply_op("categorical_sample", _s, random_core.next_key(),
                         self.logits, shape=tuple(shape))

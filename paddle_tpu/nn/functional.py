@@ -1038,7 +1038,9 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
         maxlen = int(np.asarray(x._value).max())
 
     def _sm(x, *, maxlen, dtype):
+        from ..core.dtype import convert_dtype
+
         r = jnp.arange(maxlen)
-        return (r[None, :] < x[..., None]).astype(np.dtype(dtype))
+        return (r[None, :] < x[..., None]).astype(convert_dtype(dtype))
 
     return apply_op("sequence_mask", _sm, x, maxlen=int(maxlen), dtype=str(dtype))

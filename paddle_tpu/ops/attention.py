@@ -54,15 +54,19 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     p = float(dropout_p) if training else 0.0
     key = random_core.next_key() if p > 0.0 else None
 
-    if _use_pallas() and attn_mask is None and p == 0.0:
+    if _use_pallas() and attn_mask is None:
         from .pallas import flash_attention
+
+        def _flash(q, k, v, key, *, scale, is_causal, dropout_p):
+            seed = (None if key is None else
+                    jax.random.key_data(key).reshape(-1)[-1].astype(jnp.int32))
+            return flash_attention.mha(q, k, v, scale=scale, causal=is_causal,
+                                       dropout_p=dropout_p, seed=seed)
 
         try:
             return apply_op(
-                "flash_attention",
-                lambda q, k, v, *, scale, is_causal: flash_attention.mha(
-                    q, k, v, scale=scale, causal=is_causal),
-                q, k, v, scale=scale, is_causal=bool(is_causal))
+                "flash_attention", _flash, q, k, v, key,
+                scale=scale, is_causal=bool(is_causal), dropout_p=p)
         except Exception:
             pass  # fall back to reference path
 

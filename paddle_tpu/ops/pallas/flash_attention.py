@@ -38,19 +38,6 @@ def _i32(x):
     return jnp.asarray(x, jnp.int32)
 
 
-class _no_x64:
-    """Trace pallas_calls with jax_enable_x64 off: the framework enables
-    x64 globally (Paddle int64 semantics) but Mosaic index math must be
-    32-bit; x64 literals in index maps fail to legalize."""
-
-    def __enter__(self):
-        self.prev = jax.config.jax_enable_x64
-        jax.config.update("jax_enable_x64", False)
-
-    def __exit__(self, *a):
-        jax.config.update("jax_enable_x64", self.prev)
-
-
 def _block(seq, want):
     """Largest block size <= want that divides seq (>=8 when possible)."""
     for b in (want, 256, 128, 64, 32, 16, 8):
@@ -59,11 +46,30 @@ def _block(seq, want):
     return seq  # tiny/odd seq: single block
 
 
+def _keep_mask(seed, b, rows, cols, seq_q, seq_k, keep_thresh):
+    """Counter-based dropout mask: a murmur-style hash of the global element
+    index (b, row, col), so forward and both backward kernels regenerate
+    bit-identical masks from the same seed with no PRNG state — pure uint32
+    vector math that lowers on both Mosaic and interpret mode (the pltpu
+    hardware PRNG has no interpret-mode lowering)."""
+    idx = ((b * _i32(seq_q) + rows) * _i32(seq_k) + cols).astype(jnp.uint32)
+    h = idx * jnp.uint32(0x9E3779B1) ^ seed
+    h ^= h >> jnp.uint32(16)
+    h *= jnp.uint32(0x85EBCA6B)
+    h ^= h >> jnp.uint32(13)
+    h *= jnp.uint32(0xC2B2AE35)
+    h ^= h >> jnp.uint32(16)
+    return h < jnp.uint32(keep_thresh)
+
+
 # ---------------------------------------------------------------- forward
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_q, block_k, seq_k, offset):
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
+                causal, block_q, block_k, seq_q, seq_k, offset, dropout_p,
+                keep_thresh):
+    bi = _i32(pl.program_id(0))
     qi = _i32(pl.program_id(1))
+    seed = seed_ref[0, 0].astype(jnp.uint32)
     q = q_ref[0].astype(jnp.float32) * scale           # [block_q, d]
     m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l = jnp.zeros((block_q, 1), jnp.float32)
@@ -79,16 +85,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # [block_q, block_k]
+        rows = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = kb * _i32(block_k) + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
         if causal:
-            rows = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = kb * _i32(block_k) + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows + _i32(offset) >= cols, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
+        # dropout applies to softmax probs: l accumulates the undropped sum
+        # (the normalizer), acc the dropped numerator
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed, bi, rows, cols, seq_q, seq_k, keep_thresh)
+            p = jnp.where(keep, p * (1.0 / (1.0 - dropout_p)), 0.0)
         acc = acc * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -104,7 +115,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     lse_ref[0] = m + jnp.log(l)                        # [block_q, 1]
 
 
-def _fwd(q, k, v, scale, causal, block_q, block_k):
+def _keep_thresh(dropout_p):
+    return min(int((1.0 - dropout_p) * 4294967296.0), 4294967295)
+
+
+def _fwd(q, k, v, seed, scale, causal, block_q, block_k, dropout_p):
     bh, seq_q, d = q.shape
     seq_k = k.shape[1]
     grid = (bh, seq_q // block_q)
@@ -116,36 +131,40 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
     )
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, seq_k=seq_k,
-        offset=seq_k - seq_q)
-    with _no_x64():
-        o, lse = pl.pallas_call(
-            kernel,
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-            ],
-            out_specs=(
-                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-            ),
-            out_shape=out_shape,
-            interpret=_interpret(),
-            cost_estimate=pl.CostEstimate(
-                flops=4 * seq_q * seq_k * d,
-                bytes_accessed=(seq_q + 2 * seq_k) * d * q.dtype.itemsize,
-                transcendentals=seq_q * seq_k),
-        )(q, k, v)
+        block_q=block_q, block_k=block_k, seq_q=seq_q, seq_k=seq_k,
+        offset=seq_k - seq_q, dropout_p=dropout_p,
+        keep_thresh=_keep_thresh(dropout_p))
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ),
+        out_shape=out_shape,
+        interpret=_interpret(),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * seq_q * seq_k * d,
+            bytes_accessed=(seq_q + 2 * seq_k) * d * q.dtype.itemsize,
+            transcendentals=seq_q * seq_k),
+    )(seed, q, k, v)
     return o, lse
 
 
 # ---------------------------------------------------------------- backward
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale, causal, block_q, block_k, seq_k, offset):
+def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, scale, causal, block_q, block_k, seq_q, seq_k,
+                   offset, dropout_p, keep_thresh):
+    bi = _i32(pl.program_id(0))
     qi = _i32(pl.program_id(1))
+    seed = seed_ref[0, 0].astype(jnp.uint32)
     q = q_ref[0].astype(jnp.float32) * scale
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0]                                    # [block_q, 1]
@@ -160,15 +179,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         v = v_ref[0, pl.ds(kb * _i32(block_k), block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        rows = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = kb * _i32(block_k) + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
         if causal:
-            rows = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = kb * _i32(block_k) + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows + _i32(offset) >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)                            # [bq, bk]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed, bi, rows, cols, seq_q, seq_k, keep_thresh)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_p)), 0.0)
         ds = p * (dp - delta)
         return dq + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -181,10 +203,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, scale, causal, block_q, block_k,
-                    seq_q, offset):
+                    seq_q, seq_k, offset, dropout_p, keep_thresh):
+    bi = _i32(pl.program_id(0))
     ki = _i32(pl.program_id(1))
+    seed = seed_ref[0, 0].astype(jnp.uint32)
     k = k_ref[0].astype(jnp.float32)                    # [block_k, d]
     v = v_ref[0].astype(jnp.float32)
     dk = jnp.zeros_like(k)
@@ -202,17 +226,25 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         qs = q * scale
         s = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        rows = qb * _i32(block_q) + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
         if causal:
-            rows = qb * _i32(block_q) + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows + _i32(offset) >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed, bi, rows, cols, seq_q, seq_k, keep_thresh)
+            inv = 1.0 / (1.0 - dropout_p)
+            p_d = jnp.where(keep, p * inv, 0.0)
+        else:
+            p_d = p
+        dv = dv + jax.lax.dot_general(p_d, do, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            dp = jnp.where(keep, dp * inv, 0.0)
         ds = p * (dp - delta)
         dk = dk + jax.lax.dot_general(ds, qs, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
@@ -228,79 +260,90 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, block_q, block_k, res, do):
-    q, k, v, o, lse = res
+def _bwd(scale, causal, block_q, block_k, dropout_p, res, do):
+    q, k, v, o, lse, seed = res
     bh, seq_q, d = q.shape
     seq_k = k.shape[1]
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)            # [bh, seq_q, 1]
 
-    with _no_x64():
-        dq = pl.pallas_call(
-            functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                              block_q=block_q, block_k=block_k, seq_k=seq_k,
-                              offset=seq_k - seq_q),
-            grid=(bh, seq_q // block_q),
-            in_specs=[
-                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-            ],
-            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-            interpret=_interpret(),
-        )(q, k, v, do, lse, delta)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_q=seq_q,
+                          seq_k=seq_k, offset=seq_k - seq_q,
+                          dropout_p=dropout_p,
+                          keep_thresh=_keep_thresh(dropout_p)),
+        grid=(bh, seq_q // block_q),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_interpret(),
+    )(seed, q, k, v, do, lse, delta)
 
-        dk, dv = pl.pallas_call(
-            functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                              block_q=block_q, block_k=block_k, seq_q=seq_q,
-                              offset=seq_k - seq_q),
-            grid=(bh, seq_k // block_k),
-            in_specs=[
-                pl.BlockSpec((1, seq_q, d), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, seq_q, d), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, seq_q, 1), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, seq_q, 1), lambda b, i: (b, 0, 0)),
-            ],
-            out_specs=(
-                pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            ),
-            out_shape=(
-                jax.ShapeDtypeStruct(k.shape, k.dtype),
-                jax.ShapeDtypeStruct(v.shape, v.dtype),
-            ),
-            interpret=_interpret(),
-        )(q, k, v, do, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_q=seq_q,
+                          seq_k=seq_k, offset=seq_k - seq_q,
+                          dropout_p=dropout_p,
+                          keep_thresh=_keep_thresh(dropout_p)),
+        grid=(bh, seq_k // block_k),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, seq_q, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_q, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_q, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_q, 1), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ),
+        interpret=_interpret(),
+    )(seed, q, k, v, do, lse, delta)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, scale, causal, block_q, block_k):
-    o, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, seed, scale, causal, block_q, block_k, dropout_p):
+    o, _ = _fwd(q, k, v, seed, scale, causal, block_q, block_k, dropout_p)
     return o
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
-    o, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, seed, scale, causal, block_q, block_k, dropout_p):
+    o, lse = _fwd(q, k, v, seed, scale, causal, block_q, block_k, dropout_p)
+    return o, (q, k, v, o, lse, seed)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, res, do):
-    return _bwd(scale, causal, block_q, block_k, res, do)
+def _flash_bwd(scale, causal, block_q, block_k, dropout_p, res, do):
+    dq, dk, dv = _bwd(scale, causal, block_q, block_k, dropout_p, res, do)
+    return dq, dk, dv, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def mha(q, k, v, *, scale=None, causal=False, block_q=128, block_k=128):
+def mha(q, k, v, *, scale=None, causal=False, dropout_p=0.0, seed=None,
+        block_q=128, block_k=128):
     """Flash attention. q,k,v: [batch, heads, seq, head_dim] (or 3-d
-    [batch*heads, seq, head_dim]). Returns same shape as q."""
+    [batch*heads, seq, head_dim]). Returns same shape as q.
+
+    dropout_p > 0 applies dropout to the attention probabilities inside the
+    kernel (counter-based mask keyed by ``seed``, an int32 scalar array —
+    pass a fresh seed per step; same seed -> same mask)."""
     squeeze = q.ndim == 3
     if squeeze:
         q, k, v = q[None], k[None], v[None]
@@ -313,6 +356,10 @@ def mha(q, k, v, *, scale=None, causal=False, block_q=128, block_k=128):
     q3 = q.reshape(b * h, sq, d)
     k3 = k.reshape(b * h, sk, d)
     v3 = v.reshape(b * h, sk, d)
-    o = _flash(q3, k3, v3, float(scale), bool(causal), bq, bk)
+    if seed is None:
+        seed = jnp.zeros((), jnp.int32)
+    seed2d = jnp.asarray(seed, jnp.int32).reshape(1, 1)
+    o = _flash(q3, k3, v3, seed2d, float(scale), bool(causal), bq, bk,
+               float(dropout_p))
     o = o.reshape(b, h, sq, d)
     return o[0] if squeeze else o

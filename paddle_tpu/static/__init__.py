@@ -16,11 +16,16 @@ from .program import (  # noqa: F401
     save_inference_model, load_inference_model, normalize_program,
 )
 from .input_spec import InputSpec  # noqa: F401
-from .. import nn  # noqa: F401  (paddle.static.nn compat shim below)
+from .. import nn as _nn_module
 
 
 class _StaticNN:
-    """paddle.static.nn compat namespace (fc, conv2d ... minimal)."""
+    """paddle.static.nn compat namespace (reference: python/paddle/static/nn):
+    fc/conv2d/batch_norm program-building helpers, falling back to the main
+    paddle.nn module for everything else."""
+
+    def __getattr__(self, name):
+        return getattr(_nn_module, name)
 
     @staticmethod
     def fc(x, size, num_flatten_dims=1, activation=None, name=None, **kw):
@@ -31,7 +36,10 @@ class _StaticNN:
         in_dim = 1
         for d in x.shape[num_flatten_dims:]:
             in_dim *= d
-        flat = pt.reshape(x, list(x.shape[:num_flatten_dims]) + [in_dim])
+        # leading (batch) dim stays symbolic: the program is replayed with
+        # real feed shapes, so bake -1 rather than the placeholder's dim
+        lead = [-1] + list(x.shape[1:num_flatten_dims])
+        flat = pt.reshape(x, lead + [in_dim])
         w = creation.create_parameter([in_dim, size], "float32")
         b = creation.create_parameter([size], "float32", is_bias=True)
         out = F.linear(flat, w, b)
@@ -54,7 +62,8 @@ class _StaticNN:
         return conv(x)
 
 
-nn_compat = _StaticNN()
+nn = _StaticNN()
+nn_compat = nn  # back-compat alias
 
 from . import nn_control_flow  # noqa: E402
 from .nn_control_flow import case, cond, switch_case, while_loop  # noqa: F401,E402

@@ -170,7 +170,7 @@ def meshgrid(*args, **kwargs):
 
 
 def numel(x, name=None):
-    return dispatch.apply_op("numel", lambda x: jnp.asarray(x.size, jnp.int64), x)
+    return dispatch.apply_op("numel", lambda x: jnp.asarray(x.size, jnp.int32), x)
 
 
 def tril_indices(row, col, offset=0, dtype="int64"):

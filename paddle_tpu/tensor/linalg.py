@@ -73,7 +73,7 @@ def slogdet(x, name=None):
 def matrix_rank(x, tol=None, hermitian=False, name=None):
     return apply_op(
         "matrix_rank",
-        lambda x, *, tol, hermitian: jnp.linalg.matrix_rank(x, rtol=tol).astype(jnp.int64),
+        lambda x, *, tol, hermitian: jnp.linalg.matrix_rank(x, rtol=tol).astype(jnp.int32),
         x, tol=tol, hermitian=bool(hermitian))
 
 
@@ -122,7 +122,7 @@ def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, nam
 def lstsq(x, y, rcond=None, driver=None, name=None):
     def _lstsq(x, y, *, rcond):
         sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
-        return sol, res, rank.astype(jnp.int64), sv
+        return sol, res, rank.astype(jnp.int32), sv
 
     return apply_op("lstsq", _lstsq, x, y, rcond=rcond)
 
@@ -148,7 +148,7 @@ def histogram(input, bins=100, min=0, max=0, name=None):
     def _hist(x, *, bins, min, max):
         rng = None if (min == 0 and max == 0) else (min, max)
         h, _ = jnp.histogram(x.reshape(-1), bins=bins, range=rng)
-        return h.astype(jnp.int64)
+        return h.astype(jnp.int32)
 
     return apply_op("histogram", _hist, input, bins=int(bins), min=min, max=max)
 

@@ -194,7 +194,7 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):
         if dtype is not None:
             dt = jnp.bfloat16 if dtype == "bfloat16" else np.dtype(dtype)
         elif jnp.issubdtype(x.dtype, jnp.bool_):
-            dt = jnp.int64
+            dt = jnp.int32
         return jnp.sum(x, axis=axis, keepdims=keepdim, dtype=dt)
 
     return apply_op("sum", _sum, x, axis=_axis_norm(axis), keepdim=bool(keepdim), dtype=dname)
@@ -226,7 +226,7 @@ nanmean = _reduction("nanmean", lambda x, *, axis, keepdim: jnp.nanmean(x, axis=
 def count_nonzero(x, axis=None, keepdim=False, name=None):
     return apply_op(
         "count_nonzero",
-        lambda x, *, axis, keepdim: jnp.count_nonzero(x, axis=axis, keepdims=keepdim).astype(jnp.int64),
+        lambda x, *, axis, keepdim: jnp.count_nonzero(x, axis=axis, keepdims=keepdim).astype(jnp.int32),
         x, axis=_axis_norm(axis), keepdim=bool(keepdim))
 
 
@@ -248,7 +248,7 @@ def cumprod(x, dim=None, dtype=None, name=None):
 
 def _cumm_extreme(x, *, axis, mode):
     """values + indices of the running max/min (paddle cummax/cummin)."""
-    idx0 = jax.lax.broadcasted_iota(jnp.int64, x.shape, axis)
+    idx0 = jax.lax.broadcasted_iota(jnp.int32, x.shape, axis)
 
     def combine(a, b):
         av, ai = a

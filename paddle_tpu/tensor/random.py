@@ -89,11 +89,11 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
         logits = jnp.log(jnp.clip(x, 1e-30, None))
         if replacement:
             return jax.random.categorical(key, logits, axis=-1,
-                                          shape=(n,) + x.shape[:-1]).T.astype(jnp.int64)
+                                          shape=(n,) + x.shape[:-1]).T.astype(jnp.int32)
         # without replacement: Gumbel top-k trick
         g = jax.random.gumbel(key, x.shape, x.dtype)
         _, idx = jax.lax.top_k(logits + g, n)
-        return idx.astype(jnp.int64)
+        return idx.astype(jnp.int32)
 
     return apply_op("multinomial", _multinomial, random_core.next_key(), x,
                     n=int(num_samples), replacement=bool(replacement))

@@ -13,8 +13,8 @@ from ..core import errors
 def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
     def _argmax(x, *, axis, keepdim):
         if axis is None:
-            return jnp.argmax(x.reshape(-1)).astype(jnp.int64)
-        out = jnp.argmax(x, axis=axis).astype(jnp.int64)
+            return jnp.argmax(x.reshape(-1)).astype(jnp.int32)
+        out = jnp.argmax(x, axis=axis).astype(jnp.int32)
         return jnp.expand_dims(out, axis) if keepdim else out
 
     return apply_op("argmax", _argmax, x,
@@ -24,8 +24,8 @@ def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
 def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
     def _argmin(x, *, axis, keepdim):
         if axis is None:
-            return jnp.argmin(x.reshape(-1)).astype(jnp.int64)
-        out = jnp.argmin(x, axis=axis).astype(jnp.int64)
+            return jnp.argmin(x.reshape(-1)).astype(jnp.int32)
+        out = jnp.argmin(x, axis=axis).astype(jnp.int32)
         return jnp.expand_dims(out, axis) if keepdim else out
 
     return apply_op("argmin", _argmin, x,
@@ -35,7 +35,7 @@ def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
 def argsort(x, axis=-1, descending=False, name=None):
     def _argsort(x, *, axis, descending):
         idx = jnp.argsort(-x if descending else x, axis=axis, stable=True)
-        return idx.astype(jnp.int64)
+        return idx.astype(jnp.int32)
 
     return apply_op("argsort", _argsort, x, axis=int(axis), descending=bool(descending))
 
@@ -60,7 +60,7 @@ def topk(x, k, axis=None, largest=True, sorted=True, name=None):
         else:
             v, i = jax.lax.top_k(-xm, k)
             v = -v
-        return (jnp.moveaxis(v, -1, ax), jnp.moveaxis(i.astype(jnp.int64), -1, ax))
+        return (jnp.moveaxis(v, -1, ax), jnp.moveaxis(i.astype(jnp.int32), -1, ax))
 
     return apply_op("topk", _topk2, x, k=int(k),
                     axis=None if axis is None else int(axis), largest=bool(largest))
@@ -84,11 +84,11 @@ def nonzero(x, as_tuple=False):
 
 
 def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    # out_int32 kept for API compatibility; index dtype is always int32 on TPU
     return apply_op(
         "searchsorted",
-        lambda s, v, *, side, dtype32: jnp.searchsorted(s, v, side=side).astype(
-            jnp.int32 if dtype32 else jnp.int64),
-        sorted_sequence, values, side="right" if right else "left", dtype32=bool(out_int32))
+        lambda s, v, *, side: jnp.searchsorted(s, v, side=side).astype(jnp.int32),
+        sorted_sequence, values, side="right" if right else "left")
 
 
 def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
@@ -104,7 +104,7 @@ def masked_select(x, mask, name=None):
 def kthvalue(x, k, axis=-1, keepdim=False, name=None):
     def _kth(x, *, k, axis, keepdim):
         s = jnp.sort(x, axis=axis)
-        i = jnp.argsort(x, axis=axis, stable=True).astype(jnp.int64)
+        i = jnp.argsort(x, axis=axis, stable=True).astype(jnp.int32)
         v = jnp.take(s, k - 1, axis=axis)
         ix = jnp.take(i, k - 1, axis=axis)
         if keepdim:

@@ -26,8 +26,10 @@ def pad_sequences(seqs, maxlen=None, dtype="int64", pad_value=0):
 
 def length_mask(lengths, maxlen, dtype="float32"):
     def _mask(lengths, *, maxlen, dtype):
+        from ..core.dtype import convert_dtype
+
         r = jnp.arange(maxlen)
-        return (r[None, :] < lengths[:, None]).astype(np.dtype(dtype))
+        return (r[None, :] < lengths[:, None]).astype(convert_dtype(dtype))
 
     return apply_op("length_mask", _mask, lengths, maxlen=int(maxlen), dtype=str(dtype))
 

@@ -31,10 +31,16 @@ class TestTensor:
         np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
 
     def test_dtype_conversion(self):
+        # int compute is canonicalized to 32-bit on TPU (dtype policy:
+        # int64 names are accepted, storage is int32 — core/dtype.py)
         t = paddle.to_tensor([1, 2, 3])
-        assert np.dtype(t.dtype) == np.int64
+        assert np.dtype(t.dtype) == np.int32
+        t64 = paddle.to_tensor([1, 2, 3], dtype="int64")
+        assert np.dtype(t64.dtype) == np.int32
         f = t.astype("float32")
         assert np.dtype(f.dtype) == np.float32
+        d = paddle.to_tensor([1.0, 2.0], dtype="float64")
+        assert np.dtype(d.dtype) == np.float32
 
     def test_item_and_scalar(self):
         t = paddle.to_tensor(3.5)

@@ -93,6 +93,72 @@ def test_flash_decode_single_query():
                                rtol=2e-4, atol=2e-4)
 
 
+def _hash_keep_np(seed, b, rows, cols, seq_q, seq_k, dropout_p):
+    """numpy twin of fa._keep_mask for exact-match testing."""
+    idx = ((b * seq_q + rows) * seq_k + cols).astype(np.uint32)
+    with np.errstate(over="ignore"):
+        h = idx * np.uint32(0x9E3779B1) ^ np.uint32(seed)
+        h ^= h >> np.uint32(16)
+        h *= np.uint32(0x85EBCA6B)
+        h ^= h >> np.uint32(13)
+        h *= np.uint32(0xC2B2AE35)
+        h ^= h >> np.uint32(16)
+    thresh = np.uint32(min(int((1.0 - dropout_p) * 2**32), 2**32 - 1))
+    return h < thresh
+
+
+def _ref_dropout(q, k, v, seed, dropout_p):
+    """Reference attention applying the SAME counter-hash dropout mask."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+    p = jax.nn.softmax(s, axis=-1)
+    bh_idx = np.arange(b * h).reshape(b, h, 1, 1)
+    rows = np.arange(sq).reshape(1, 1, sq, 1)
+    cols = np.arange(sk).reshape(1, 1, 1, sk)
+    keep = _hash_keep_np(seed, bh_idx, rows, cols, sq, sk, dropout_p)
+    p = jnp.where(jnp.asarray(keep), p / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def test_flash_dropout_matches_hash_reference():
+    rng = np.random.RandomState(5)
+    B, H, S, D = 1, 2, 64, 16
+    p_drop, seed = 0.2, 1234
+    q = jnp.array(rng.randn(B, H, S, D), jnp.float32)
+    k = jnp.array(rng.randn(B, H, S, D), jnp.float32)
+    v = jnp.array(rng.randn(B, H, S, D), jnp.float32)
+    o = fa.mha(q, k, v, dropout_p=p_drop, seed=jnp.int32(seed),
+               block_q=32, block_k=32)
+    r = _ref_dropout(q, k, v, seed, p_drop)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(r),
+                               rtol=2e-4, atol=2e-4)
+    # dropout actually drops something
+    o0 = fa.mha(q, k, v, block_q=32, block_k=32)
+    assert not np.allclose(np.asarray(o), np.asarray(o0))
+
+
+def test_flash_dropout_grads_match_hash_reference():
+    rng = np.random.RandomState(6)
+    B, H, S, D = 1, 1, 64, 16
+    p_drop, seed = 0.15, 77
+    q = jnp.array(rng.randn(B, H, S, D), jnp.float32)
+    k = jnp.array(rng.randn(B, H, S, D), jnp.float32)
+    v = jnp.array(rng.randn(B, H, S, D), jnp.float32)
+
+    def loss_f(fn):
+        return lambda q, k, v: jnp.sum(jnp.sin(fn(q, k, v)))
+
+    gf = jax.grad(loss_f(lambda q, k, v: fa.mha(
+        q, k, v, dropout_p=p_drop, seed=jnp.int32(seed),
+        block_q=32, block_k=32)), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_f(lambda q, k, v: _ref_dropout(q, k, v, seed, p_drop)),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3)
+
+
 def test_flash_bfloat16():
     rng = np.random.RandomState(3)
     q = jnp.array(rng.randn(1, 1, 64, 16), jnp.bfloat16)
