@@ -50,12 +50,35 @@ def fail(msg):
     sys.exit(1)
 
 
+def _devices_with_timeout(timeout):
+    """jax.devices() in a watchdogged daemon thread: the call itself can
+    block for minutes (or wedge forever) during axon tunnel setup."""
+    import threading
+
+    import jax
+
+    result = {}
+
+    def target():
+        try:
+            result["devs"] = jax.devices()
+        except Exception as e:  # noqa: BLE001 - report any init error
+            result["err"] = e
+
+    th = threading.Thread(target=target, daemon=True)
+    th.start()
+    th.join(timeout)
+    if th.is_alive():
+        raise TimeoutError(f"jax.devices() still blocked after {timeout:.0f}s")
+    if "err" in result:
+        raise result["err"]
+    return result["devs"]
+
+
 def init_tpu_patiently():
     """Init the TPU backend, retrying for up to INIT_TIMEOUT seconds.
 
     Returns the device list, or None if the TPU backend never came up.
-    A single jax.devices() call may itself block for minutes during
-    tunnel setup -- that is fine; we only bound total wall clock.
     """
     import jax
 
@@ -63,17 +86,21 @@ def init_tpu_patiently():
     attempt = 0
     while True:
         attempt += 1
+        remaining = INIT_TIMEOUT - (time.time() - t0)
+        if remaining <= 0:
+            return None
         try:
             log(f"TPU init attempt {attempt} (t={time.time() - t0:.0f}s) ...")
-            devs = jax.devices()
+            devs = _devices_with_timeout(remaining)
             if devs and devs[0].platform in ("tpu", "axon"):
                 log(f"TPU up after {time.time() - t0:.0f}s: {devs}")
                 return devs
             raise RuntimeError(f"no TPU platform in {devs}")
-        except RuntimeError as e:
+        except Exception as e:  # noqa: BLE001 - any init failure retries
             remaining = INIT_TIMEOUT - (time.time() - t0)
-            log(f"attempt {attempt} failed ({e}); {remaining:.0f}s budget left")
-            if remaining <= 0:
+            log(f"attempt {attempt} failed ({type(e).__name__}: {e}); "
+                f"{remaining:.0f}s budget left")
+            if remaining <= 0 or isinstance(e, TimeoutError):
                 return None
             try:  # drop any cached failed backend so the next try is real
                 import jax.extend.backend
@@ -191,4 +218,12 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except SystemExit:
+        raise
+    except Exception as e:  # guarantee ONE json line even on crash
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        fail(f"bench_crashed: {type(e).__name__}: {e}")

@@ -4,8 +4,9 @@ from .parallel import (  # noqa: F401
 )
 from .collective import (  # noqa: F401
     all_reduce, all_gather, broadcast, reduce, scatter, barrier, send, recv,
-    all_to_all, new_group, is_initialized, ReduceOp, Group,
-    psum, pmean, pmax, all_gather_spmd, ppermute, all_to_all_spmd,
+    all_to_all, alltoall_single, split, new_group, is_initialized, ReduceOp,
+    Group, get_rank_in, psum, pmean, pmax, all_gather_spmd, ppermute,
+    all_to_all_spmd,
 )
 from . import topology  # noqa: F401
 from .topology import (  # noqa: F401

@@ -189,25 +189,103 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     return tensor_list
 
 
+@functools.lru_cache(maxsize=256)
+def _eager_broadcast(axis, mesh_id, ndim, src):
+    mesh = topology.get_global_mesh()
+    spec = _first_dim_spec(axis, ndim)
+
+    def fn(x):
+        # every shard replaces its block with src's block
+        return jax.lax.all_gather(x, axis)[src]
+
+    return jax.jit(shard_map(fn, mesh=mesh, in_specs=(spec,), out_specs=spec))
+
+
 def broadcast(tensor, src=0, group=None, sync_op=True):
-    """reference: collective.py:338. Replicated arrays are already identical
-    on every device; sharded arrays re-materialise from src shard."""
+    """reference: collective.py:338 / c_broadcast op.
+
+    Sharded-over-axis arrays ("rank rows" along dim 0): every shard's
+    block becomes src's block. Replicated arrays are already identical on
+    every device — the broadcast result by definition."""
+    axis = _axis_of(group)
+    if in_trace():
+        out = jax.lax.all_gather(tensor._value, axis)[src]
+        tensor._assign_result(Tensor(out, stop_gradient=tensor.stop_gradient))
+        return tensor
+    if not _is_sharded_over(tensor._value, axis):
+        return tensor
+    fn = _eager_broadcast(axis, id(topology.get_global_mesh()),
+                          tensor._value.ndim, int(src))
+    tensor._value = fn(tensor._value)
     return tensor
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """reference: collective.py (c_reduce). In the global-array model the
+    reduced value lands on every shard (dst included); semantically a
+    superset of rank-dst-only placement."""
     return all_reduce(tensor, op, group, sync_op)
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
-    if tensor_list:
-        rank = get_rank_in(group)
-        tensor._assign_result(tensor_list[rank])
+    """reference: collective.py:658 / c_scatter op.
+
+    Sharded convention (dim 0 = rank index over the group axis): the
+    stacked tensor_list becomes the new sharded value, so shard r holds
+    tensor_list[r]. Replicated convention: this process's view becomes its
+    own rank's element."""
+    axis = _axis_of(group)
+    mesh = topology.get_global_mesh()
+    n = mesh.shape.get(axis, 1)
+    if not tensor_list:
+        return tensor
+    if len(tensor_list) != n:
+        raise ValueError(f"scatter needs {n} tensors for axis {axis!r}, "
+                         f"got {len(tensor_list)}")
+    if _is_sharded_over(tensor._value, axis):
+        stacked = jnp.stack([t._value if isinstance(t, Tensor) else jnp.asarray(t)
+                             for t in tensor_list])
+        if stacked.size != tensor._value.size:
+            raise ValueError(
+                f"scatter shape mismatch: {n} x {stacked.shape[1:]} elements "
+                f"!= target {tuple(tensor._value.shape)}")
+        val = stacked.reshape(tensor._value.shape)
+        tensor._value = jax.device_put(
+            val, NamedSharding(mesh, _first_dim_spec(axis, val.ndim)))
+        return tensor
+    tensor._assign_result(tensor_list[get_rank_in(group)])
     return tensor
 
 
 def get_rank_in(group=None):
-    return 0
+    """This process's rank along the group axis. Single-process mesh SPMD
+    has one controller (rank 0); under jax.distributed the process index
+    maps onto the axis via the hybrid topology when one is configured."""
+    axis = _axis_of(group)
+    if jax.process_count() == 1:
+        return 0
+    try:
+        from .fleet import get_hybrid_communicate_group
+
+        hcg = get_hybrid_communicate_group()
+    except Exception:
+        hcg = None
+    if hcg is not None:
+        getter = {"dp": "get_data_parallel_rank", "mp": "get_model_parallel_rank",
+                  "pp": "get_stage_id"}.get(axis)
+        if getter and hasattr(hcg, getter):
+            return getattr(hcg, getter)()
+    # mesh axis stride arithmetic: divide out the axes inner to `axis`
+    # before the modulo (a bare modulo is only right for the innermost axis)
+    mesh = topology.get_global_mesh()
+    inner = 1
+    seen = False
+    for name in mesh.axis_names:
+        if seen:
+            inner *= mesh.shape.get(name, 1)
+        if name == axis:
+            seen = True
+    return (jax.process_index() // inner) % mesh.shape.get(axis, 1)
 
 
 def barrier(group=None):
@@ -217,26 +295,113 @@ def barrier(group=None):
 
 
 def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
-    out_tensor_list.extend(Tensor(t._value) for t in in_tensor_list)
+    """reference: collective.py (alltoall). Rank j's out[i] = rank i's
+    in[j]. With replicated single-process ranks every peer holds the same
+    list, so out[i] = in[my_rank] for all i."""
+    rank = get_rank_in(group)
+    axis = _axis_of(group)
+    mesh = topology.get_global_mesh()
+    n = mesh.shape.get(axis, 1)
+    if len(in_tensor_list) != n:
+        raise ValueError(f"all_to_all needs {n} tensors for axis {axis!r}, "
+                         f"got {len(in_tensor_list)}")
+    out_tensor_list.extend(Tensor(in_tensor_list[rank]._value)
+                           for _ in range(n))
     return out_tensor_list
 
 
+def alltoall_single(out_tensor, in_tensor, group=None, sync_op=True):
+    """All-to-all on a dim-0 sharded array (reference alltoall over a
+    ring): shard r's k-th block goes to shard k's r-th block."""
+    axis = _axis_of(group)
+    mesh = topology.get_global_mesh()
+    n = mesh.shape.get(axis, 1)
+    if n == 1 or not _is_sharded_over(in_tensor._value, axis):
+        out_tensor._value = in_tensor._value
+        return out_tensor
+    f = _eager_alltoall_single(axis, id(mesh), in_tensor._value.ndim)
+    out_tensor._value = f(in_tensor._value)
+    return out_tensor
+
+
+@functools.lru_cache(maxsize=256)
+def _eager_alltoall_single(axis, mesh_id, ndim):
+    mesh = topology.get_global_mesh()
+    spec = _first_dim_spec(axis, ndim)
+
+    def fn(x):
+        return jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                                  tiled=True)
+
+    return jax.jit(shard_map(fn, mesh=mesh, in_specs=(spec,), out_specs=spec))
+
+
+# P2P: XLA has no eager point-to-point primitive — in-graph P2P is
+# ppermute (see meta_parallel/pipeline for the real use). The eager API
+# pairs send/recv through a process-local mailbox so matched calls have
+# reference semantics (send_v2/recv_v2) in tests and single-host runs.
+_P2P_MAILBOX = {}
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
-    """P2P send (reference send_v2). Outside SPMD tracing this is the
-    single-process identity; pipeline parallel uses ppermute inside the
-    traced schedule instead (see meta_parallel/pipeline)."""
+    """reference: collective.py:1253 / send_v2 op (see P2P note above)."""
+    key = (_axis_of(group), get_rank_in(group), dst)
+    _P2P_MAILBOX.setdefault(key, []).append(tensor._value)
     return tensor
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
+    """reference: collective.py:1302 / recv_v2 op (see P2P note above)."""
+    key = (_axis_of(group), src, get_rank_in(group))
+    box = _P2P_MAILBOX.get(key)
+    if box:
+        val = box.pop(0)
+        tensor._value = val.astype(tensor._value.dtype) \
+            if val.dtype != tensor._value.dtype else val
     return tensor
 
 
-def split(x, size, operation, axis=0, num_partitions=1, gather_out=True, **kwargs):
-    """reference: collective.py:1021 paddle.distributed.split — sharded
-    fc/embedding. Maps to the mp_layers sharded layers."""
-    from .meta_parallel import mp_layers
+_SPLIT_LAYERS = {}
 
-    raise NotImplementedError(
-        "use paddle_tpu.distributed.meta_parallel.{ColumnParallelLinear,"
-        "RowParallelLinear,VocabParallelEmbedding} — sharding-annotated layers")
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """reference: collective.py:1021 paddle.distributed.split — build and
+    apply a tensor-parallel fc/embedding sharded over the 'mp' mesh axis.
+
+    operation='linear': axis=0 shards the input dim (RowParallelLinear),
+    axis=1 shards the output dim (ColumnParallelLinear).
+    operation='embedding': vocab-sharded VocabParallelEmbedding.
+    Layers are cached by `name` so repeated dygraph calls reuse weights.
+    """
+    from .meta_parallel import (ColumnParallelLinear, RowParallelLinear,
+                                VocabParallelEmbedding)
+
+    layer = _SPLIT_LAYERS.get(name) if name else None
+    if layer is None:
+        if operation == "linear":
+            in_f, out_f = size
+            if axis == 1:
+                layer = ColumnParallelLinear(
+                    in_f, out_f, has_bias=bias_attr is not False,
+                    gather_output=gather_out)
+            elif axis == 0:
+                layer = RowParallelLinear(
+                    in_f, out_f, has_bias=bias_attr is not False,
+                    input_is_parallel=False)
+            else:
+                raise ValueError(f"linear split axis must be 0 or 1, got {axis}")
+        elif operation == "embedding":
+            vocab, dim = size
+            layer = VocabParallelEmbedding(vocab, dim)
+        else:
+            raise ValueError(f"unknown split operation {operation!r}")
+        if name:  # anonymous layers are not cached (fresh weights per call)
+            _SPLIT_LAYERS[name] = layer
+    # eager inputs may be committed to one device; the sharded layer
+    # computes over the whole mesh
+    mesh = topology.get_global_mesh()
+    if isinstance(x, Tensor) and not isinstance(x._value, jax.core.Tracer):
+        x = Tensor(jax.device_put(x._value, NamedSharding(mesh, P())),
+                   stop_gradient=x.stop_gradient)
+    return layer(x)

@@ -9,9 +9,10 @@ ticks, each tick = receive activation from the left neighbor via
 ``ppermute``, apply the local stage, emit to the right. jax.grad through
 the scan + ppermute yields the transposed (backward) pipeline
 automatically — the 1F1B wave emerges from XLA's schedule rather than a
-hand-written SectionWorker loop. See distributed/spmd.py
-``pipeline_spmd_fn`` for the primitive; this class adapts the dygraph
-train_batch API on top.
+hand-written SectionWorker loop. ``pipeline_spmd_fn`` below is the
+forward primitive; full TRAINING (fwd+bwd+optimizer over the pp axis)
+lives in distributed/pipeline.py ``build_pipeline_train_step``, which
+``PipelineParallel.train_batch`` drives when the global mesh has pp>1.
 """
 import numpy as np
 import jax
@@ -93,14 +94,120 @@ class PipelineParallel(nn.Layer):
         if strategy is not None:
             acc = strategy.pipeline_configs.get("accumulate_steps", 1)
         self._micro_batches = max(acc, 1)
+        self._spmd = None
+        self._spmd_key = None  # (optimizer, mesh) the step was built for
+        self._dirty = False    # functional params newer than Layer tensors
+        self._step_count = 0
 
     def forward(self, *args, **kwargs):
+        self._sync_params()
         return self._layers(*args, **kwargs)
+
+    def state_dict(self, *a, **kw):
+        self._sync_params()
+        return super().state_dict(*a, **kw)
+
+    def _ensure_spmd(self, optimizer):
+        """Build the pp-sharded SPMD train step when the global mesh has
+        pp > 1 and the module has a homogeneous trunk. Rebuilt if the
+        optimizer instance or global mesh changes (hyperparameters and
+        grad_clip are captured at build time)."""
+        from .. import pipeline as pipe
+        from ...core import dispatch
+
+        mesh = topology.get_global_mesh()
+        # strong refs in the key: identity survives GC, so a recycled id()
+        # can never serve a stale step
+        if self._spmd_key is not None and self._spmd_key[0] is optimizer \
+                and self._spmd_key[1] is mesh:
+            return self._spmd
+        self._sync_params()  # fold any prior functional state into layers
+        self._spmd = None
+        self._spmd_key = (optimizer, mesh)
+        pp = int(mesh.shape.get("pp", 1))
+        if pp <= 1:
+            return None
+        layers = (list(self._layers.run_functions)
+                  if hasattr(self._layers, "run_functions")
+                  else [self._layers])
+        try:
+            pre, trunk, post = pipe.split_pre_trunk_post(layers, pp)
+        except ValueError:
+            return None  # no homogeneous trunk: sequential path
+        raw_loss = self._layers._loss_fn
+
+        def loss_fn(out, y):
+            with dispatch.trace_mode():
+                res = raw_loss(Tensor(out), Tensor(y, stop_gradient=True))
+            return res._value if isinstance(res, Tensor) else res
+
+        step, init = pipe.build_pipeline_train_step(
+            pre, trunk, post, loss_fn, optimizer, mesh=mesh,
+            num_micro=self._micro_batches)
+        params, state = init()
+        lps = len(trunk) // pp
+        self._spmd = {"step": step, "params": params, "state": state,
+                      "pre": pre, "trunk": trunk, "post": post, "lps": lps}
+        return self._spmd
+
+    def _sync_params(self):
+        """Lazily sync updated functional params into the Layer tensors
+        (deferred off the train hot loop; pp-sharded stack slices gather
+        here, not per step)."""
+        if not self._dirty or self._spmd is None:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        def pull(arr):
+            # mesh-sharded -> default-device array so eager ops can mix
+            # layer params with freshly-created tensors
+            return jnp.asarray(jax.device_get(arr))
+
+        ctx = self._spmd
+        params = ctx["params"]
+        for i, layer in enumerate(ctx["pre"]):
+            for n, p in layer.named_parameters():
+                p._value = pull(params[f"pre.{i}.{n}"])
+        for i, layer in enumerate(ctx["post"]):
+            for n, p in layer.named_parameters():
+                p._value = pull(params[f"post.{i}.{n}"])
+        lps = ctx["lps"]
+        for idx, layer in enumerate(ctx["trunk"]):
+            s, l = divmod(idx, lps)
+            for n, p in layer.named_parameters():
+                p._value = pull(params[f"stages.{n}"][s, l])
+        self._dirty = False
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """reference: pipeline_parallel.py:85 — F-then-B over micro-batches
-        with grad accumulation, then one optimizer step."""
+        with grad accumulation, then one optimizer step. On a pp>1 mesh this
+        drives the fused SPMD pipeline (distributed/pipeline.py); batch
+        sizes must be divisible by micro_batches*dp (use
+        DataLoader(drop_last=True)) — non-divisible batches raise."""
         x, y = data
+        ctx = self._ensure_spmd(optimizer)
+        if ctx is not None:
+            mesh = topology.get_global_mesh()
+            need = self._micro_batches * int(mesh.shape.get("dp", 1)) * \
+                int(mesh.shape.get("sharding", 1))
+            if x.shape[0] % need != 0:
+                # same contract as the reference (batch % accumulate_steps
+                # asserts); a clear error beats a cryptic reshape failure —
+                # use DataLoader(drop_last=True) for the tail batch
+                raise ValueError(
+                    f"pipeline train_batch needs batch size divisible by "
+                    f"micro_batches*dp ({need}); got {x.shape[0]}")
+            import jax
+
+            self._step_count += 1
+            key = jax.random.PRNGKey(self._step_count)
+            loss, ctx["params"], ctx["state"] = ctx["step"](
+                ctx["params"], ctx["state"], x._value, y._value, key=key)
+            self._dirty = True
+            if lr_scheduler is not None:
+                lr_scheduler.step()
+            return Tensor(loss)
         n_micro = min(self._micro_batches, x.shape[0])
         xs = np.array_split(np.asarray(x._value), n_micro)
         ys = np.array_split(np.asarray(y._value), n_micro)
@@ -118,6 +225,7 @@ class PipelineParallel(nn.Layer):
         return Tensor(np.asarray(total / n_micro, np.float32))
 
     def eval_batch(self, data, compute_loss=True):
+        self._sync_params()
         x, y = data
         out = self._layers.forward(x)
         if compute_loss:

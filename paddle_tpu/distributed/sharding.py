@@ -24,16 +24,28 @@ def shard_arrays(tree, mesh=None, axes=("dp", "sharding")):
     return jax.tree.map(lambda a: jax.device_put(a, _zero1_spec(a, mesh, axes)), tree)
 
 
+LEVEL_TO_STAGE = {"os": 1, "os_g": 2, "p_g_os": 3}
+
+
 def group_sharded_parallel(model, optimizer, level="os", scaler=None, group=None,
                            offload=False, sync_buffers=False, buffer_max_size=2 ** 23,
                            segment_size=2 ** 20, sync_comm=False):
     """reference: python/paddle/distributed/sharding/group_sharded.py.
     level: 'os' (ZeRO-1) | 'os_g' (ZeRO-2) | 'p_g_os' (ZeRO-3).
 
-    Dygraph adapter: marks the optimizer so its eager state arrays are
-    placed sharded; the fully-sharded path is the compiled spmd step.
+    Dygraph adapter: tags the model/optimizer with the ZeRO stage so
+    compiled train steps pick it up (spmd.build_train_step
+    ``sharding_stage``: 2 = grads reduce-scattered, 3 = params stored
+    sharded between steps), and re-places eager optimizer state sharded
+    after each eager step.
     """
+    stage = LEVEL_TO_STAGE.get(level)
+    if stage is None:
+        raise ValueError(f"level must be one of {sorted(LEVEL_TO_STAGE)}, "
+                         f"got {level!r}")
     optimizer._sharding_level = level
+    optimizer._sharding_stage = stage
+    model._sharding_stage = stage
     orig_step = optimizer.step
 
     def stepped():

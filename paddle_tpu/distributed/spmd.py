@@ -57,8 +57,8 @@ def _zero1_spec(arr, mesh, axes=("dp", "sharding")):
 
 
 def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
-                     shard_optimizer=False, donate=True, amp_level="O0",
-                     amp_dtype="bfloat16"):
+                     shard_optimizer=False, sharding_stage=None, donate=True,
+                     amp_level="O0", amp_dtype="bfloat16"):
     """Compile the full distributed training step for `layer`.
 
     loss_fn(model_out, label_array) -> scalar (pure jnp).
@@ -71,8 +71,23 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
     fp32 master weights; bf16 needs no loss scaling on TPU, and grads come
     out fp32 via the loss. The cast decision is trace-time, so the compiled
     step has bf16 matmuls on the MXU with no per-step Python cost.
+
+    sharding_stage (ZeRO; reference sharding_optimizer.py:40,84,180 does
+    this with 3k lines of program surgery — here it is sharding specs):
+      1: optimizer states sharded over dp+sharding (= shard_optimizer=True)
+      2: + gradients sharding-constrained to the same spec, so XLA emits
+         reduce-scatter for the grad psum instead of all-reduce
+      3: + parameters STORED sharded between steps (all-gathered at use
+         inside the step); param memory scales 1/N at rest
     """
     mesh = mesh or topology.get_global_mesh()
+    if sharding_stage is None:
+        # group_sharded_parallel() tags the model with its ZeRO stage
+        sharding_stage = getattr(layer, "_sharding_stage", None) or \
+            (1 if shard_optimizer else 0)
+    if sharding_stage not in (0, 1, 2, 3):
+        raise ValueError(f"sharding_stage must be 0..3, got {sharding_stage}")
+    shard_optimizer = sharding_stage >= 1
     params0, buffers0 = layer.functional_state()
     param_names = list(params0)
     buffer_names = list(buffers0)
@@ -106,11 +121,38 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
     opt_update = type(optimizer)._update
     grad_clip = optimizer._grad_clip
 
+    # shardings: batch over dp(+sharding) — ZeRO groups subdivide dp
+    repl = NamedSharding(mesh, P())
+    zero_specs = {n: _zero1_spec(params0[n], mesh) for n in param_names}
+    named = dict(layer.named_parameters())
+    has_mp = {n: getattr(named[n], "mp_spec", None) is not None
+              for n in param_names}
+    if sharding_stage >= 3:
+        # params at REST live sharded (ZeRO-3); mp-annotated params keep
+        # their tensor-parallel layout
+        param_shards = {n: (p_shardings[n] if has_mp[n] else zero_specs[n])
+                        for n in param_names}
+    else:
+        param_shards = {n: p_shardings[n] for n in param_names}
+    data_axes = tuple(ax for ax in ("dp", "sharding") if mesh.shape.get(ax, 1) > 1)
+    batch_shard = NamedSharding(mesh, P(data_axes)) if data_axes else repl
+
     def step(params, opt_state, buffers, x, y, key, lr):
         # batch stays dp-sharded via in_shardings; grads of replicated params
         # get psum'd across dp by SPMD automatically.
+        if sharding_stage >= 3:
+            # gather sharded params once up front (XLA fuses/dedups the
+            # all-gathers); keeps the forward's own layouts (mp) intact
+            params = {n: (params[n] if has_mp[n] else
+                          jax.lax.with_sharding_constraint(params[n], p_shardings[n]))
+                      for n in param_names}
         loss, grads = jax.value_and_grad(
             lambda p: forward_loss(p, buffers, x, y, key))(params)
+        if sharding_stage >= 2:
+            # constrain grads to the shard layout -> reduce-scatter
+            grads = {n: (grads[n] if has_mp[n] else
+                         jax.lax.with_sharding_constraint(grads[n], zero_specs[n]))
+                     for n in param_names}
         if grad_clip is not None:
             names = list(grads)
             clipped = grad_clip.clip_arrays([grads[n] for n in names])
@@ -122,12 +164,6 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
             new_params[name] = out[0]
             new_state[name] = tuple(out[1:])
         return loss, new_params, new_state
-
-    # shardings: batch over dp(+sharding) — ZeRO groups subdivide dp
-    param_shards = {n: p_shardings[n] for n in param_names}
-    repl = NamedSharding(mesh, P())
-    data_axes = tuple(ax for ax in ("dp", "sharding") if mesh.shape.get(ax, 1) > 1)
-    batch_shard = NamedSharding(mesh, P(data_axes)) if data_axes else repl
 
     def init_fn():
         params = {n: jax.device_put(params0[n], param_shards[n])

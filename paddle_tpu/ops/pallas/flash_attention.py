@@ -51,9 +51,16 @@ def _keep_mask(seed, b, rows, cols, seq_q, seq_k, keep_thresh):
     index (b, row, col), so forward and both backward kernels regenerate
     bit-identical masks from the same seed with no PRNG state — pure uint32
     vector math that lowers on both Mosaic and interpret mode (the pltpu
-    hardware PRNG has no interpret-mode lowering)."""
-    idx = ((b * _i32(seq_q) + rows) * _i32(seq_k) + cols).astype(jnp.uint32)
-    h = idx * jnp.uint32(0x9E3779B1) ^ seed
+    hardware PRNG has no interpret-mode lowering).
+
+    The batch-head index is folded into the seed by its own hash round
+    (not a flat linear index) so masks stay decorrelated even when
+    bh * seq_q * seq_k exceeds 2^32."""
+    bseed = seed ^ (b.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B))
+    bseed ^= bseed >> jnp.uint32(13)
+    bseed *= jnp.uint32(0xC2B2AE35)
+    idx = (rows * _i32(seq_k) + cols).astype(jnp.uint32)
+    h = idx * jnp.uint32(0x9E3779B1) ^ bseed
     h ^= h >> jnp.uint32(16)
     h *= jnp.uint32(0x85EBCA6B)
     h ^= h >> jnp.uint32(13)

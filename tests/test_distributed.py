@@ -317,3 +317,292 @@ class TestPipeline:
         for s in range(num_stages):
             ref = np.tanh(ref @ Ws[s])
         np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+class TestPipelineTraining:
+    """Pipeline-parallel TRAINING parity: pp=4 (and dp2xpp2) SPMD pipeline
+    loss/params == sequential single-device training (reference oracle:
+    test_dist_base.py:682 loss-match harness)."""
+
+    @staticmethod
+    def _loss_fn():
+        import jax
+        import jax.numpy as jnp
+
+        def loss_fn(out, y):
+            logp = jax.nn.log_softmax(out.astype(jnp.float32), -1)
+            oh = jax.nn.one_hot(y, out.shape[-1], dtype=jnp.float32)
+            return -jnp.mean(jnp.sum(oh * logp, -1))
+
+        return loss_fn
+
+    @staticmethod
+    def _build():
+        class Block(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(16, 16)
+
+            def forward(self, x):
+                return paddle.tanh(self.fc(x))
+
+        paddle.seed(3)
+        pre = [nn.Linear(8, 16)]
+        blocks = [Block() for _ in range(4)]
+        post = [nn.Linear(16, 4)]
+        return pre, blocks, post
+
+    def _run_sequential(self, x, y, steps):
+        import jax
+
+        pre, blocks, post = self._build()
+        model = nn.Sequential(*(pre + blocks + post))
+        opt = optimizer.SGD(0.1, parameters=model.parameters())
+        mesh1 = topology.build_mesh(dp=1, devices=__import__("jax").devices()[:1])
+        step, init = spmd.build_train_step(model, self._loss_fn(), opt,
+                                           mesh=mesh1)
+        params, st = init()
+        losses = []
+        for i in range(steps):
+            loss, params, st = step(params, st, x, y,
+                                    key=jax.random.PRNGKey(0))
+            losses.append(float(loss))
+        return losses, params
+
+    def _run_pipeline(self, x, y, steps, dp, pp, num_micro):
+        import jax
+        from paddle_tpu.distributed import pipeline as pipe
+
+        pre, blocks, post = self._build()
+        all_params = [p for l in pre + blocks + post for p in l.parameters()]
+        opt = optimizer.SGD(0.1, parameters=all_params)
+        mesh = topology.build_mesh(dp=dp, pp=pp)
+        topology.set_global_mesh(mesh)
+        step, init = pipe.build_pipeline_train_step(
+            pre, blocks, post, self._loss_fn(), opt, mesh=mesh,
+            num_micro=num_micro)
+        params, st = init()
+        losses = []
+        for i in range(steps):
+            loss, params, st = step(params, st, x, y,
+                                    key=jax.random.PRNGKey(0))
+            losses.append(float(loss))
+        return losses, params
+
+    def test_pp4_matches_sequential(self):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(8, 8).astype(np.float32))
+        y = jnp.asarray(rng.randint(0, 4, 8).astype(np.int32))
+        seq_losses, seq_params = self._run_sequential(x, y, 3)
+        pp_losses, pp_params = self._run_pipeline(x, y, 3, dp=1, pp=4,
+                                                  num_micro=4)
+        np.testing.assert_allclose(pp_losses, seq_losses, rtol=2e-4,
+                                   atol=1e-5)
+        # updated trunk weights match the stacked pipeline params
+        import numpy as _np
+        stacked = _np.asarray(pp_params["stages.fc.weight"]).reshape(4, 16, 16)
+        for i in range(4):
+            seq_w = _np.asarray(seq_params[f"{1 + i}.fc.weight"])
+            _np.testing.assert_allclose(stacked[i], seq_w, rtol=2e-4,
+                                        atol=1e-5)
+
+    def test_dp2xpp2_matches_sequential(self):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(8, 8).astype(np.float32))
+        y = jnp.asarray(rng.randint(0, 4, 8).astype(np.int32))
+        seq_losses, _ = self._run_sequential(x, y, 3)
+        pp_losses, _ = self._run_pipeline(x, y, 3, dp=2, pp=2, num_micro=2)
+        np.testing.assert_allclose(pp_losses, seq_losses, rtol=2e-4,
+                                   atol=1e-5)
+
+    def test_split_pre_trunk_post(self):
+        from paddle_tpu.distributed.pipeline import split_pre_trunk_post
+
+        pre, blocks, post = self._build()
+        layers = pre + blocks + post
+        p, tr, po = split_pre_trunk_post(layers, 4)
+        assert len(p) == 1 and len(tr) == 4 and len(po) == 1
+        p, tr, po = split_pre_trunk_post(layers, 2)
+        assert len(tr) == 4  # 4 divisible by 2
+
+    def test_pipeline_parallel_train_batch_spmd(self):
+        """PipelineParallel.train_batch on a pp=4 mesh == sequential path."""
+        import jax
+        from paddle_tpu.distributed.meta_parallel import (PipelineLayer,
+                                                          PipelineParallel)
+
+        class Block(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(16, 16)
+
+            def forward(self, x):
+                return paddle.tanh(self.fc(x))
+
+        def build_pp(num_stages, devices=None):
+            mesh = topology.build_mesh(dp=1, pp=num_stages, devices=devices)
+            topology.set_global_mesh(mesh)
+            paddle.seed(11)
+            pl = PipelineLayer(
+                [nn.Linear(8, 16)] + [Block() for _ in range(4)] +
+                [nn.Linear(16, 4)],
+                num_stages=num_stages, loss_fn=nn.CrossEntropyLoss())
+            strategy = fleet.DistributedStrategy()
+            strategy.pipeline_configs = {"accumulate_steps": 4}
+            pp = PipelineParallel(pl, None, strategy)
+            opt = optimizer.SGD(0.1, parameters=pl.parameters())
+            return pp, opt
+
+        rng = np.random.RandomState(5)
+        x = t(rng.randn(8, 8).astype(np.float32))
+        y = t(rng.randint(0, 4, 8).astype(np.int32))
+
+        pp4, opt4 = build_pp(4)
+        assert pp4._ensure_spmd(opt4) is not None  # really takes SPMD path
+        l4 = [float(pp4.train_batch((x, y), opt4).numpy()) for _ in range(5)]
+
+        pp1, opt1 = build_pp(1, devices=jax.devices()[:1])
+        l1 = [float(pp1.train_batch((x, y), opt1).numpy()) for _ in range(5)]
+        np.testing.assert_allclose(l4, l1, rtol=2e-4, atol=1e-5)
+        # params lazily synced into Layer tensors on state_dict access
+        sd4 = {k: v.numpy() for k, v in pp4.state_dict().items()}
+        sd1 = {k: v.numpy() for k, v in pp1.state_dict().items()}
+        for k in sd1:
+            np.testing.assert_allclose(sd4[k], sd1[k], rtol=2e-4, atol=1e-5)
+
+
+class TestShardingStages:
+    """ZeRO stages 1/2/3 (reference: fleet/meta_optimizers/
+    sharding_optimizer.py:40,84,180) — parity vs unsharded + placement
+    assertions."""
+
+    @staticmethod
+    def _run(stage, steps=3):
+        import jax
+        import jax.numpy as jnp
+
+        mesh = topology.build_mesh(dp=2, sharding=2)
+        topology.set_global_mesh(mesh)
+        paddle.seed(21)
+        model = nn.Sequential(nn.Linear(16, 64), nn.Tanh(), nn.Linear(64, 8))
+        opt = optimizer.AdamW(1e-2, parameters=model.parameters())
+
+        def loss_fn(out, y):
+            return jnp.mean((out - y) ** 2)
+
+        step, init = spmd.build_train_step(model, loss_fn, opt, mesh=mesh,
+                                           sharding_stage=stage)
+        params, st = init()
+        rng = np.random.RandomState(0)
+        x = spmd.shard_batch(rng.randn(16, 16).astype(np.float32), mesh)
+        y = spmd.shard_batch(rng.randn(16, 8).astype(np.float32), mesh)
+        losses = []
+        for i in range(steps):
+            loss, params, st = step(params, st, x, y,
+                                    key=jax.random.PRNGKey(0))
+            losses.append(float(loss))
+        return losses, params, st
+
+    def test_stage2_and_3_match_unsharded(self):
+        l0, _, _ = self._run(0)
+        l2, _, _ = self._run(2)
+        l3, _, _ = self._run(3)
+        np.testing.assert_allclose(l2, l0, rtol=2e-4, atol=1e-6)
+        np.testing.assert_allclose(l3, l0, rtol=2e-4, atol=1e-6)
+
+    def test_stage3_param_placement(self):
+        _, params, st = self._run(3, steps=1)
+        sharded = [n for n, a in params.items()
+                   if any(ax in str(a.sharding.spec) for ax in ("dp", "sharding"))]
+        assert sharded, {n: str(a.sharding.spec) for n, a in params.items()}
+        # optimizer states sharded too (stage >= 1)
+        st_specs = [str(a.sharding.spec) for tup in st.values() for a in tup
+                    if a.ndim > 0]
+        assert any("dp" in s or "sharding" in s for s in st_specs), st_specs
+
+    def test_stage1_opt_state_sharded_params_replicated(self):
+        _, params, st = self._run(1, steps=1)
+        for n, a in params.items():
+            assert str(a.sharding.spec) == "PartitionSpec()", (n, a.sharding)
+
+
+class TestEagerCollectives:
+    """Real eager collectives over sharded 'rank-row' arrays
+    (reference: collective.py:338 broadcast, :658 scatter, :1253/:1302
+    send/recv, :1021 split; operators/collective/)."""
+
+    def test_broadcast_sharded(self, mesh8):
+        x = np.arange(8, dtype=np.float32).reshape(2, 4)
+        xs = spmd.shard_batch(t(x), mesh8, axis="dp")
+        tt = paddle.Tensor(xs)
+        dist.broadcast(tt, src=1)
+        expected = np.tile(x[1][None, :], (2, 1))
+        np.testing.assert_allclose(tt.numpy(), expected)
+
+    def test_broadcast_replicated_identity(self):
+        mesh = topology.build_mesh(dp=8)
+        topology.set_global_mesh(mesh)
+        x = t([1.0, 2.0])
+        dist.broadcast(x, src=0)
+        np.testing.assert_allclose(x.numpy(), [1.0, 2.0])
+
+    def test_scatter_sharded(self, mesh8):
+        x = np.zeros((2, 4), np.float32)
+        xs = spmd.shard_batch(t(x), mesh8, axis="dp")
+        tt = paddle.Tensor(xs)
+        parts = [t(np.full(4, float(i + 1), np.float32)) for i in range(2)]
+        dist.scatter(tt, parts, src=0)
+        expected = np.stack([np.full(4, 1.0), np.full(4, 2.0)])
+        np.testing.assert_allclose(tt.numpy(), expected)
+        assert "dp" in str(tt._value.sharding.spec)
+
+    def test_send_recv_pair(self):
+        mesh = topology.build_mesh(dp=8)
+        topology.set_global_mesh(mesh)
+        src = t(np.arange(4, dtype=np.float32))
+        dst = t(np.zeros(4, np.float32))
+        dist.send(src, dst=0)
+        dist.recv(dst, src=0)
+        np.testing.assert_allclose(dst.numpy(), src.numpy())
+
+    def test_all_to_all_replicated(self):
+        mesh = topology.build_mesh(dp=2)
+        topology.set_global_mesh(mesh)
+        ins = [t(np.full(3, float(i), np.float32)) for i in range(2)]
+        outs = []
+        dist.all_to_all(outs, ins)
+        # single controller is rank 0: every peer sends us in_list[0]
+        assert len(outs) == 2
+        for o in outs:
+            np.testing.assert_allclose(o.numpy(), ins[0].numpy())
+
+    def test_alltoall_single_sharded(self, mesh8):
+        # 2 shards x 2 blocks: block exchange transposes the block matrix
+        x = np.arange(8, dtype=np.float32).reshape(4, 2)
+        xs = spmd.shard_batch(t(x), mesh8, axis="dp")
+        tt_in = paddle.Tensor(xs)
+        tt_out = paddle.Tensor(xs)
+        dist.alltoall_single(tt_out, tt_in)
+        # shard0=[r0,r1], shard1=[r2,r3] -> shard0=[r0,r2], shard1=[r1,r3]
+        expected = x[[0, 2, 1, 3]]
+        np.testing.assert_allclose(tt_out.numpy(), expected)
+
+    def test_split_linear_column(self, mesh8):
+        paddle.seed(0)
+        x = t(np.random.RandomState(0).rand(4, 8).astype(np.float32))
+        out = dist.split(x, size=(8, 16), operation="linear", axis=1,
+                         num_partitions=2, name="col_test")
+        assert out.shape == [4, 16]
+        out2 = dist.split(x, size=(8, 16), operation="linear", axis=1,
+                          num_partitions=2, name="col_test")
+        np.testing.assert_allclose(out.numpy(), out2.numpy())  # cached weights
+
+    def test_split_embedding(self, mesh8):
+        ids = t(np.array([[0, 1], [2, 3]], np.int32))
+        out = dist.split(ids, size=(16, 8), operation="embedding",
+                         num_partitions=2, name="emb_test")
+        assert out.shape == [2, 2, 8]

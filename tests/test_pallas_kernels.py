@@ -95,9 +95,12 @@ def test_flash_decode_single_query():
 
 def _hash_keep_np(seed, b, rows, cols, seq_q, seq_k, dropout_p):
     """numpy twin of fa._keep_mask for exact-match testing."""
-    idx = ((b * seq_q + rows) * seq_k + cols).astype(np.uint32)
     with np.errstate(over="ignore"):
-        h = idx * np.uint32(0x9E3779B1) ^ np.uint32(seed)
+        bseed = np.uint32(seed) ^ (b.astype(np.uint32) * np.uint32(0x85EBCA6B))
+        bseed ^= bseed >> np.uint32(13)
+        bseed *= np.uint32(0xC2B2AE35)
+        idx = (rows * seq_k + cols).astype(np.uint32)
+        h = idx * np.uint32(0x9E3779B1) ^ bseed
         h ^= h >> np.uint32(16)
         h *= np.uint32(0x85EBCA6B)
         h ^= h >> np.uint32(13)
