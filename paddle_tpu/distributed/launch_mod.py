@@ -1,30 +1,112 @@
 """Launcher (reference: python/paddle/distributed/fleet/launch.py:215
-launch_collective, launch_utils.py:59 Cluster/Pod, watch_local_trainers:556).
+launch_collective, launch_utils.py:59 Cluster, :173 Pod, get_cluster:268,
+watch_local_trainers:556, terminate_local_procs:309).
 
-TPU-native: ONE process per host drives all local chips through the mesh
-(vs the reference's one-proc-per-GPU), so the local launcher just execs
-the script with PADDLE_* env set; multi-host pods use
-jax.distributed.initialize with the coordinator from PADDLE_MASTER.
-Failure handling mirrors watch_local_trainers: child exit tears down the
-pod.
+TPU-native layout: ONE process per host drives all local chips through
+the mesh (vs the reference's one-proc-per-GPU), so a production pod is
+nnodes processes rendezvousing via jax.distributed. ``nproc_per_node``
+exists for CPU-backend testing (the reference's 2-trainer localhost
+harness, test_dist_base.py:682): each local proc gets a distinct global
+rank and a single virtual CPU device.
 """
 import os
+import signal
+import socket
 import subprocess
 import sys
+import time
 
 
-def launch(script=None, args=(), nnodes=1, node_rank=0, master=None):
+def find_free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TrainerProc:
+    def __init__(self, proc, rank, log_path=None):
+        self.proc = proc
+        self.rank = rank
+        self.log_path = log_path
+
+
+def get_cluster_env(rank, world_size, master, local_rank=0):
+    """The PADDLE_* contract init_parallel_env reads (reference
+    launch_utils.py pod env: PADDLE_TRAINER_ID/PADDLE_CURRENT_ENDPOINT/
+    PADDLE_TRAINERS_NUM)."""
     env = dict(os.environ)
-    env["PADDLE_TRAINER_ID"] = str(node_rank)
-    env["PADDLE_TRAINERS_NUM"] = str(nnodes)
-    if master:
-        env["PADDLE_COORDINATOR"] = master
-    cmd = [sys.executable, script, *args]
-    proc = subprocess.Popen(cmd, env=env)
-    ret = proc.wait()
-    if ret != 0:
-        raise RuntimeError(f"trainer exited with code {ret}")
-    return ret
+    env["PADDLE_TRAINER_ID"] = str(rank)
+    env["PADDLE_TRAINERS_NUM"] = str(world_size)
+    env["PADDLE_COORDINATOR"] = master
+    env["PADDLE_LOCAL_RANK"] = str(local_rank)
+    return env
+
+
+def watch_local_trainers(procs, poll_interval=0.5):
+    """Block until all trainers exit; on any non-zero exit, terminate the
+    rest of the pod (reference: launch_utils.py:556)."""
+    try:
+        while True:
+            alive = False
+            for tp in procs:
+                ret = tp.proc.poll()
+                if ret is None:
+                    alive = True
+                elif ret != 0:
+                    terminate_local_procs(procs)
+                    raise RuntimeError(
+                        f"trainer rank {tp.rank} exited with code {ret}")
+            if not alive:
+                return 0
+            time.sleep(poll_interval)
+    except KeyboardInterrupt:
+        terminate_local_procs(procs)
+        raise
+
+
+def terminate_local_procs(procs, grace=3.0):
+    """reference: launch_utils.py:309."""
+    for tp in procs:
+        if tp.proc.poll() is None:
+            tp.proc.terminate()
+    deadline = time.time() + grace
+    for tp in procs:
+        while tp.proc.poll() is None and time.time() < deadline:
+            time.sleep(0.1)
+        if tp.proc.poll() is None:
+            tp.proc.send_signal(signal.SIGKILL)
+
+
+def launch_collective(script, args=(), nproc_per_node=1, nnodes=1,
+                      node_rank=0, master=None, log_dir=None,
+                      extra_env=None):
+    """Spawn nproc_per_node trainer processes on this node and watch them
+    (reference: launch.py:215 launch_collective)."""
+    world = nnodes * nproc_per_node
+    master = master or f"127.0.0.1:{find_free_port()}"
+    procs = []
+    for local_rank in range(nproc_per_node):
+        rank = node_rank * nproc_per_node + local_rank
+        env = get_cluster_env(rank, world, master, local_rank)
+        if extra_env:
+            env.update({k: str(v) for k, v in extra_env.items()})
+        stdout = None
+        log_path = None
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            log_path = os.path.join(log_dir, f"workerlog.{rank}")
+            stdout = open(log_path, "w")
+        proc = subprocess.Popen([sys.executable, script, *map(str, args)],
+                                env=env, stdout=stdout,
+                                stderr=subprocess.STDOUT if stdout else None)
+        procs.append(TrainerProc(proc, rank, log_path))
+    return watch_local_trainers(procs)
+
+
+def launch(script=None, args=(), nnodes=1, node_rank=0, master=None,
+           nproc_per_node=1, log_dir=None):
+    return launch_collective(script, args, nproc_per_node, nnodes,
+                             node_rank, master, log_dir)
 
 
 def main():
@@ -32,13 +114,16 @@ def main():
 
     p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
     p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--nproc_per_node", type=int, default=1)
     p.add_argument("--node_rank", type=int, default=int(os.environ.get(
         "PADDLE_TRAINER_ID", 0)))
     p.add_argument("--master", default=os.environ.get("PADDLE_MASTER"))
+    p.add_argument("--log_dir", default=None)
     p.add_argument("script")
     p.add_argument("script_args", nargs="*")
     ns = p.parse_args()
-    launch(ns.script, ns.script_args, ns.nnodes, ns.node_rank, ns.master)
+    launch_collective(ns.script, ns.script_args, ns.nproc_per_node,
+                      ns.nnodes, ns.node_rank, ns.master, ns.log_dir)
 
 
 if __name__ == "__main__":

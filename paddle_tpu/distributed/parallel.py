@@ -116,7 +116,52 @@ class DataParallel(Layer):
         return self._layers.parameters(include_sublayers)
 
 
-def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
-    """reference: distributed/spawn.py:317. One process drives all local TPU
-    chips via the mesh, so spawn degenerates to a direct call."""
+def _spawn_target(func, args, rank, nprocs, master, backend):
+    # runs in a FRESH interpreter (spawn context): set the cluster env
+    # before any jax backend touch, then rendezvous and call user code
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["PADDLE_COORDINATOR"] = master
+    if backend == "cpu":
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     func(*args)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, backend=None,
+          **options):
+    """reference: distributed/spawn.py:317.
+
+    nprocs <= 1: one process already drives all local TPU chips via the
+    mesh, so this is a direct call. nprocs > 1: real multiprocessing
+    spawn — one process per rank rendezvousing through jax.distributed
+    (func should call init_parallel_env() first, like the reference).
+    backend='cpu' forces a single virtual CPU device per rank (the
+    2-trainer localhost test harness)."""
+    if nprocs is None or nprocs <= 1:
+        func(*args)
+        return None
+    import multiprocessing as mp
+
+    from .launch_mod import find_free_port
+
+    ctx = mp.get_context("spawn")
+    master = f"127.0.0.1:{find_free_port()}"
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_spawn_target,
+                        args=(func, args, rank, nprocs, master, backend),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if not join:
+        return procs
+    for p in procs:
+        p.join()
+    bad = [p.exitcode for p in procs if p.exitcode != 0]
+    if bad:
+        raise RuntimeError(f"spawned trainers failed with exit codes {bad}")
+    return None

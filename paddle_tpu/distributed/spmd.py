@@ -205,7 +205,11 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
 
 
 def shard_batch(batch, mesh=None, axis=None):
-    """Place a host array sharded on dim 0 over the data axes (dp+sharding)."""
+    """Place a host array sharded on dim 0 over the data axes (dp+sharding).
+
+    Multi-process (jax.distributed) runs follow the reference's trainer
+    contract: each process passes its LOCAL batch and the global array is
+    assembled across processes (global dim 0 = local * num_processes)."""
     mesh = mesh or topology.get_global_mesh()
     arr = batch._value if isinstance(batch, Tensor) else jnp.asarray(np.asarray(batch))
     if axis is None:
@@ -213,4 +217,10 @@ def shard_batch(batch, mesh=None, axis=None):
         spec = P(axes) if axes else P()
     else:
         spec = P(axis)
-    return jax.device_put(arr, NamedSharding(mesh, spec))
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() > 1 and spec != P():
+        local = np.asarray(arr)
+        global_shape = (local.shape[0] * jax.process_count(),) + local.shape[1:]
+        return jax.make_array_from_process_local_data(sharding, local,
+                                                      global_shape)
+    return jax.device_put(arr, sharding)

@@ -1,0 +1,67 @@
+"""2-trainer worker script (reference: the model scripts driven by
+test_dist_base.py:682 — dist_mnist.py etc. implement run_trainer and the
+harness compares loss sequences between 1-proc and 2-proc runs).
+
+Launched by paddle_tpu.distributed.launch with PADDLE_* env; each rank
+feeds its LOCAL half of the fixed global batch; rank 0 writes the loss
+sequence to argv[1].
+"""
+import json
+import os
+import sys
+
+# one virtual CPU device per rank, BEFORE any jax backend touch
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn, optimizer  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.distributed import spmd, topology  # noqa: E402
+
+
+def main():
+    out_path = sys.argv[1]
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    assert world == 2, f"expected 2 trainers, got {world}"
+
+    import jax.numpy as jnp
+
+    paddle.seed(3)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = optimizer.SGD(0.1, parameters=model.parameters())
+    mesh = topology.get_global_mesh()
+
+    def loss_fn(out, y):
+        return jnp.mean((out - y) ** 2)
+
+    step, init = spmd.build_train_step(model, loss_fn, opt, mesh=mesh)
+    params, st = init()
+
+    x = np.random.RandomState(0).rand(16, 8).astype(np.float32)
+    y = np.random.RandomState(1).rand(16, 4).astype(np.float32)
+    half = 16 // world
+    xl = x[rank * half:(rank + 1) * half]
+    yl = y[rank * half:(rank + 1) * half]
+    xg = spmd.shard_batch(xl, mesh)
+    yg = spmd.shard_batch(yl, mesh)
+
+    losses = []
+    for _ in range(3):
+        loss, params, st = step(params, st, xg, yg)
+        losses.append(float(jax.device_get(loss)))
+    if rank == 0:
+        with open(out_path, "w") as f:
+            json.dump(losses, f)
+    print(f"rank {rank} losses {losses}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

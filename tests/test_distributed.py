@@ -606,3 +606,56 @@ class TestEagerCollectives:
         out = dist.split(ids, size=(16, 8), operation="embedding",
                          num_partitions=2, name="emb_test")
         assert out.shape == [2, 2, 8]
+
+
+class TestMultiProcess:
+    """Real 2-process launcher test (reference: test_dist_base.py:682
+    check_with_place — 2 trainer procs on localhost, loss sequences must
+    match the 1-proc run)."""
+
+    def test_launch_2proc_loss_match(self, tmp_path):
+        import json
+        import jax
+        from paddle_tpu.distributed import launch_mod
+
+        out = tmp_path / "losses.json"
+        worker = os.path.join(os.path.dirname(__file__), "dist_worker.py")
+        launch_mod.launch_collective(worker, [str(out)], nproc_per_node=2,
+                                     log_dir=str(tmp_path / "logs"))
+        two_proc = json.load(open(out))
+
+        # 1-proc reference on a single local device
+        import jax.numpy as jnp
+
+        mesh = topology.build_mesh(dp=1, devices=jax.devices()[:1])
+        topology.set_global_mesh(mesh)
+        paddle.seed(3)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        opt = optimizer.SGD(0.1, parameters=model.parameters())
+        step, init = spmd.build_train_step(
+            model, lambda o, y: jnp.mean((o - y) ** 2), opt, mesh=mesh)
+        params, st = init()
+        x = np.random.RandomState(0).rand(16, 8).astype(np.float32)
+        y = np.random.RandomState(1).rand(16, 4).astype(np.float32)
+        xg = spmd.shard_batch(x, mesh)
+        yg = spmd.shard_batch(y, mesh)
+        one_proc = []
+        for _ in range(3):
+            loss, params, st = step(params, st, xg, yg)
+            one_proc.append(float(loss))
+        np.testing.assert_allclose(two_proc, one_proc, rtol=2e-5, atol=1e-6)
+
+    def test_watch_kills_pod_on_failure(self, tmp_path):
+        from paddle_tpu.distributed import launch_mod
+
+        bad = tmp_path / "bad.py"
+        bad.write_text("import sys, time\n"
+                       "import os\n"
+                       "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+                       "if rank == 1:\n"
+                       "    sys.exit(7)\n"
+                       "time.sleep(60)\n")
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="exited with code 7"):
+            launch_mod.launch_collective(str(bad), [], nproc_per_node=2)
