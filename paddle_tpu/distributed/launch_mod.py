@@ -39,6 +39,16 @@ def get_cluster_env(rank, world_size, master, local_rank=0):
     env["PADDLE_TRAINERS_NUM"] = str(world_size)
     env["PADDLE_COORDINATOR"] = master
     env["PADDLE_LOCAL_RANK"] = str(local_rank)
+    # Children must be able to import paddle_tpu even when it isn't
+    # pip-installed: prepend the repo root (parent of this package) to
+    # PYTHONPATH, since the child's sys.path[0] is the script's dir.
+    # Skip when installed into site-packages (importable anyway, and
+    # prepending it would let it shadow the user's own PYTHONPATH).
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if os.path.basename(pkg_root) not in ("site-packages", "dist-packages"):
+        prev = env.get("PYTHONPATH")
+        env["PYTHONPATH"] = pkg_root + (os.pathsep + prev if prev else "")
     return env
 
 

@@ -49,19 +49,33 @@ class ParallelEnv:
         return eps.split(",")
 
 
+_distributed_initialized = False
+
+
 def init_parallel_env():
     """reference: distributed/parallel.py:57. On TPU this is
     jax.distributed.initialize (multi-host) + building the global mesh —
     the NCCL-ring bootstrap (gen_comm_id_helper.cc TCP exchange) is
     replaced by the JAX coordination service.
+
+    Ordering is load-bearing: the cluster shape is read from PADDLE_*
+    env vars ONLY (never from jax.process_count(), which would
+    initialize the XLA backend) so that jax.distributed.initialize runs
+    before any backend-touching JAX call, as it requires.
     """
-    if jax.process_count() == 1 and os.environ.get("PADDLE_TRAINERS_NUM"):
-        n = int(os.environ["PADDLE_TRAINERS_NUM"])
-        if n > 1 and os.environ.get("PADDLE_COORDINATOR"):
-            jax.distributed.initialize(
-                coordinator_address=os.environ["PADDLE_COORDINATOR"],
-                num_processes=n,
-                process_id=int(os.environ.get("PADDLE_TRAINER_ID", 0)))
+    global _distributed_initialized
+    try:
+        n = int(os.environ.get("PADDLE_TRAINERS_NUM") or 1)
+    except ValueError:
+        n = 1
+    coordinator = os.environ.get("PADDLE_COORDINATOR")
+    if (n > 1 and coordinator and not _distributed_initialized
+            and not jax.distributed.is_initialized()):
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=n,
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID") or 0))
+        _distributed_initialized = True
     mesh = topology.build_mesh(dp=len(jax.devices()))
     topology.set_global_mesh(mesh)
     return ParallelEnv()

@@ -1,6 +1,8 @@
 """Distributed tests on the 8-device virtual CPU mesh (SURVEY §4: the
 reference uses 2-proc subprocess harnesses; mesh-SPMD makes in-process
 multi-device tests possible)."""
+import os
+
 import numpy as np
 import pytest
 
