@@ -58,6 +58,24 @@ class _PyLayerNode(tape.Node):
             out.append(g._value if isinstance(g, Tensor) else g)
         return tuple(out)
 
+    def run_backward_recorded(self, cts_by_outidx):
+        """create_graph path: run the user backward with the tape ON and
+        Tensor cotangents, so grad-of-grad records through it."""
+        import jax.numpy as jnp
+
+        cts = []
+        for i, (shape, dt) in enumerate(self.out_avals):
+            ct = cts_by_outidx.get(i)
+            if ct is None:
+                ct = Tensor(jnp.zeros(shape, dt), stop_gradient=True)
+            cts.append(ct)
+        grads = self.cls.backward(self.ctx, *cts)
+        if not isinstance(grads, (tuple, list)):
+            grads = (grads,)
+        return tuple(g if isinstance(g, Tensor) or g is None
+                     else Tensor(jnp.asarray(g), stop_gradient=True)
+                     for g in grads)
+
 
 # teach the tape engine about PyLayer nodes
 _orig_run_node_backward = tape._run_node_backward

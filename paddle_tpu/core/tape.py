@@ -239,25 +239,162 @@ def _into_leaf(t, cotangents, keep, accumulate=True):
         t._grad = t._grad + ct
 
 
+_RECBWD_CACHE = {}
+
+
+def _recordable_bwd(name, fn, kwargs, diff_argnums, n_inputs, float_out_idxs,
+                    multi):
+    """A backward fn shaped for dispatch.apply_op, so running it RECORDS
+    grad-of-grad nodes on the tape (the PartialGradEngine create_graph
+    path; reference: imperative/partial_grad_engine.cc). Cached per op
+    signature so the per-(op,shape) jit cache in dispatch hits."""
+    key = (dispatch.fn_key(name, fn), dispatch.hashable(kwargs), diff_argnums,
+           n_inputs, float_out_idxs, multi)
+    got = _RECBWD_CACHE.get(key)
+    if got is None:
+
+        def bwd(*arrs, **_sig):
+            inputs = arrs[:n_inputs]
+            cts = arrs[n_inputs:]
+            diff_ins = tuple(inputs[i] for i in diff_argnums)
+
+            def f(*d):
+                full = list(inputs)
+                for j, i in enumerate(diff_argnums):
+                    full[i] = d[j]
+                out = fn(*full, **kwargs)
+                if not multi:
+                    return (out,)
+                return tuple(out[i] for i in float_out_idxs)
+
+            _, vjp = jax.vjp(f, *diff_ins)
+            g = vjp(tuple(cts))
+            return g if len(g) > 1 else g[0]
+
+        _RECBWD_CACHE[key] = got = bwd
+    return got, dispatch.hashable(key)
+
+
+def _record_node_backward(node, cts_by_outidx):
+    """Like _run_node_backward but through apply_op: outputs are Tensors
+    wired into the tape, so the result is differentiable again."""
+    from .tensor import Tensor
+
+    rec = getattr(node, "run_backward_recorded", None)
+    if rec is not None:  # e.g. PyLayer nodes define their own
+        return rec(cts_by_outidx)
+    if node.multi:
+        float_out_idxs = tuple(
+            i for i, (shape, dt) in enumerate(node.out_avals)
+            if _is_float_dtype(dt))
+    else:
+        float_out_idxs = (0,)
+    cts = []
+    for i in float_out_idxs:
+        shape, dt = node.out_avals[i]
+        ct = cts_by_outidx.get(i)
+        if ct is None:
+            ct = Tensor(jnp.zeros(shape, dt), stop_gradient=True)
+        cts.append(ct)
+    bwd, sig = _recordable_bwd(node.name, node.fn, node.kwargs,
+                               node.diff_argnums, len(node.inputs),
+                               float_out_idxs, node.multi)
+    # diff positions carry the live input Tensors (differentiable);
+    # the rest are the recorded raw values
+    args = list(node.inputs)
+    for j, i in enumerate(node.diff_argnums):
+        args[i] = node.in_tensors[j]
+    out = dispatch.apply_op(f"grad::{node.name}", bwd, *args, *cts, __sig=sig)
+    return out if isinstance(out, tuple) else (out,)
+
+
+def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
+    """Differentiable paddle.grad: cotangents stay Tensors and every
+    backward op is recorded, enabling double (and higher) grad."""
+    from .tensor import Tensor
+    from . import errors
+
+    def accum(cot, t, g):
+        if g._value.dtype != t._value.dtype:
+            g = Tensor(g._value.astype(t._value.dtype),
+                       stop_gradient=g.stop_gradient)
+        prev = cot.get(id(t))
+        cot[id(t)] = g if prev is None else prev + g
+
+    cot = {}
+    roots = []
+    for t, g in zip(outputs, grad_outputs):
+        if g is None:
+            if t._value.size != 1:
+                raise errors.InvalidArgumentError(
+                    "grad() on a non-scalar output requires grad_outputs")
+            g = Tensor(jnp.ones_like(t._value), stop_gradient=True)
+        elif not isinstance(g, Tensor):
+            g = Tensor(jnp.asarray(g), stop_gradient=True)
+        accum(cot, t, g)
+        if t._node is not None:
+            roots.append(t._node)
+
+    wanted = {id(t) for t in inputs}
+    stashed = {}
+    order = _toposort(roots)
+    for node in reversed(order):
+        cts_by_outidx = {}
+        any_ct = False
+        for ref, _aval in zip(node.out_refs, node.out_avals):
+            t = ref()
+            if t is None or t._node is not node:
+                continue
+            ct = cot.get(id(t))
+            if ct is not None:
+                # reverse-topo order: every consumer contribution has
+                # already accumulated, so the ct is final here
+                if id(t) in wanted:
+                    stashed[id(t)] = ct
+                del cot[id(t)]
+                cts_by_outidx[t._out_idx] = ct
+                any_ct = True
+        if not any_ct:
+            continue
+        grads = _record_node_backward(node, cts_by_outidx)
+        for g, t in zip(grads, node.in_tensors):
+            if g is None or t.stop_gradient:
+                continue
+            accum(cot, t, g)
+
+    results = []
+    for t in inputs:
+        g = stashed.get(id(t), cot.get(id(t)))
+        if g is None and not allow_unused:
+            raise errors.InvalidArgumentError(
+                "an input tensor received no gradient; pass allow_unused=True")
+        results.append(g)
+    return results
+
+
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
          only_inputs=True, allow_unused=False):
     """paddle.grad — gradients of outputs w.r.t. an explicit set of inputs.
 
-    Reference: imperative/partial_grad_engine.cc, python/paddle/autograd.
-    create_graph (double grad) is not yet supported in eager mode; use the
-    functional `paddle_tpu.incubate.autograd` transforms for higher-order.
+    Reference: imperative/partial_grad_engine.cc (bound at
+    pybind/imperative.cc:1579), python/paddle/autograd. create_graph=True
+    records the backward ops back onto the tape (grads are themselves
+    differentiable — the double-grad path used by WGAN-GP-style
+    gradient penalties).
     """
     from .tensor import Tensor
     from . import errors
 
-    if create_graph:
-        raise errors.UnimplementedError(
-            "create_graph=True (double grad) is not supported by the eager tape yet"
-        )
     if isinstance(outputs, Tensor):
         outputs = [outputs]
     if isinstance(inputs, Tensor):
         inputs = [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    if create_graph:
+        return _grad_create_graph(outputs, inputs, grad_outputs, allow_unused)
     if retain_graph is None:
         retain_graph = False
 

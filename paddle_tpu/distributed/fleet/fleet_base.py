@@ -122,16 +122,42 @@ class Fleet:
             self._ps_server.stop()
 
     def init_worker(self, *args, **kwargs):
+        """Connect this worker to the PS. strategy.a_sync selects the
+        trainer-side send mode (reference: communicator.h modes wired by
+        the_one_ps.py): a_sync=False -> sync pushes; a_sync=True ->
+        AsyncCommunicator queue+merge; a_sync_configs.geo_sgd_mode ->
+        the returned client additionally exposes ``geo_communicator``."""
         from .. import ps as ps_mod
+        from ..ps.communicator import CommunicatorClient, GeoCommunicator
 
         assert self._table_configs, "call set_ps_tables(configs) first"
         eps = self._role_maker.get_pserver_endpoints()             if self._role_maker else []
         if eps:
             host, port = eps[0].rsplit(":", 1)
-            self._ps_client = ps_mod.RpcPSClient(self._table_configs,
-                                                 host=host, port=int(port))
+            base = ps_mod.RpcPSClient(self._table_configs,
+                                      host=host, port=int(port))
         else:
-            self._ps_client = ps_mod.LocalPSClient(self._table_configs)
+            base = ps_mod.LocalPSClient(self._table_configs)
+        s = self._strategy
+        if s is not None and s.a_sync:
+            cfg = s.a_sync_configs
+            if cfg.get("geo_sgd_mode"):
+                dense = [i for i, c in enumerate(self._table_configs)
+                         if not c.is_sparse]
+                sparse = [i for i, c in enumerate(self._table_configs)
+                          if c.is_sparse]
+                base.geo_communicator = GeoCommunicator(
+                    base, dense_tables=dense, sparse_tables=sparse,
+                    need_push_nums=int(cfg.get("geo_sgd_need_push_nums",
+                                               100)))
+                self._ps_client = base
+            else:
+                self._ps_client = CommunicatorClient(
+                    base,
+                    send_queue_size=int(cfg.get("send_queue_size", 16)),
+                    max_merge_var_num=int(cfg.get("max_merge_var_num", 4)))
+        else:
+            self._ps_client = base
         return self._ps_client
 
     def ps_client(self):

@@ -2,6 +2,8 @@
 parallel_layers/pp_layers.py:22 SegmentLayers, :61 PipelineLayer)."""
 import math
 
+import numpy as np
+
 from ... import nn
 
 
@@ -40,6 +42,56 @@ class SegmentLayers:
             parts = [0]
             for i in range(self.num_parts):
                 parts.append(parts[-1] + base + (1 if i < extra else 0))
+            return parts
+        if self.method.startswith("layer:"):
+            # reference pp_layers.py: balance by occurrences of the named
+            # layer class (e.g. "layer:TransformerEncoderLayer"), so each
+            # stage holds an equal share of the heavy blocks
+            cls_name = self.method.split(":", 1)[1]
+            marks = [i for i, d in enumerate(self.layers_desc)
+                     if type(d).__name__ == cls_name
+                     or getattr(getattr(d, "layer_func", None), "__name__",
+                                None) == cls_name]
+            if len(marks) < self.num_parts:
+                raise ValueError(
+                    f"{len(marks)} '{cls_name}' layers cannot fill "
+                    f"{self.num_parts} stages")
+            per = len(marks) // self.num_parts
+            extra = len(marks) % self.num_parts
+            parts = [0]
+            taken = 0
+            for i in range(self.num_parts - 1):
+                taken += per + (1 if i < extra else 0)
+                parts.append(marks[taken - 1] + 1)
+            parts.append(n)
+            return parts
+        if self.method == "param":
+            # weight boundaries by per-layer parameter count so stages
+            # carry comparable memory (SegmentLayers 'uniform' by weights)
+            weights = []
+            for d in self.layers_desc:
+                layer = d.build_layer() if hasattr(d, "build_layer") else d
+                w = sum(int(np.prod(p.shape))
+                        for _, p in layer.named_parameters()) \
+                    if hasattr(layer, "named_parameters") else 0
+                weights.append(max(w, 1))
+            total = sum(weights)
+            target = total / self.num_parts
+            parts = [0]
+            acc = 0
+            for i, w in enumerate(weights):
+                acc += w
+                # keep >=1 layer available for every remaining stage so a
+                # tail-heavy model can't produce an empty last stage
+                latest = n - (self.num_parts - len(parts))
+                if (len(parts) < self.num_parts and acc >= target * len(parts)
+                        and parts[-1] < i + 1 <= latest):
+                    parts.append(i + 1)
+            while len(parts) < self.num_parts:
+                parts.append(min(parts[-1] + 1,
+                                 n - (self.num_parts - len(parts))))
+            parts.append(n)
+            assert all(b > a for a, b in zip(parts, parts[1:])), parts
             return parts
         raise ValueError(self.method)
 

@@ -35,15 +35,19 @@ from . import topology
 
 def _functional_apply(layer, params, x, key):
     """Run layer.forward(x) as a pure function of `params` (same
-    mutation-bracket trick as spmd.build_train_step)."""
+    mutation-bracket trick as spmd.build_train_step). Buffers are
+    snapshotted and restored too: BatchNorm-style layers write traced
+    stats into their buffers during a traced forward, and those tracers
+    must not outlive the trace."""
     saved = {n: p._value for n, p in layer.named_parameters()}
+    _, saved_b = layer.functional_state()
     try:
         with dispatch.trace_mode(), random_core.rng_guard(key):
             layer.load_functional_state(params)
             out = layer.forward(Tensor(x, stop_gradient=True))
             return out._value if isinstance(out, Tensor) else out
     finally:
-        layer.load_functional_state(saved)
+        layer.load_functional_state(saved, saved_b)
 
 
 def _layer_signature(layer):
@@ -258,4 +262,25 @@ def build_pipeline_train_step(pre_layers, trunk_layers, post_layers, loss_fn,
         y = jax.device_put(jnp.asarray(y), batch_spec)
         return step_jit(params, opt_state, x, y, key, lr)
 
+    step_fn.jitted = step_jit  # AOT access (schedule/memory introspection)
+    step_fn.schedule = schedule_stats(num_stages, num_micro)
     return step_fn, init_fn
+
+
+def schedule_stats(num_stages, num_micro):
+    """Analytic schedule properties of the ppermute-scan pipeline.
+
+    The scan runs exactly ``num_micro + num_stages - 1`` ticks; each tick
+    every stage is busy except during ramp-up/drain, giving the classic
+    GPipe bubble fraction (S-1)/(M+S-1) (reference:
+    section_worker.cc:135 startup_steps = num_stages - stage_id - 1 has
+    the same ramp geometry). Raising num_micro amortises the bubble;
+    recompute bounds activation memory per stage at one microbatch.
+    """
+    ticks = num_micro + num_stages - 1
+    return {
+        "num_stages": int(num_stages),
+        "num_micro": int(num_micro),
+        "ticks": int(ticks),
+        "bubble_fraction": float((num_stages - 1) / ticks),
+    }

@@ -121,6 +121,20 @@ class LocalPSClient:
                                      ids.size, native.f32_ptr(grads))
         assert rc == 0
 
+    def dense_apply_delta(self, idx, delta):
+        delta = np.ascontiguousarray(delta, np.float32)
+        rc = self.lib.pt_dense_apply_delta(self.tables[idx],
+                                           native.f32_ptr(delta), delta.size)
+        assert rc == 0
+
+    def sparse_apply_delta(self, idx, ids, delta):
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        delta = np.ascontiguousarray(delta, np.float32)
+        rc = self.lib.pt_sparse_apply_delta(self.tables[idx],
+                                            native.i64_ptr(ids), ids.size,
+                                            native.f32_ptr(delta))
+        assert rc == 0
+
     def barrier(self):
         pass
 
@@ -177,6 +191,21 @@ class RpcPSClient:
         rc = self.lib.pt_client_sparse_push(
             self.handle, idx, native.i64_ptr(ids), ids.size,
             native.f32_ptr(grads), c.emb_dim)
+        assert rc == 0
+
+    def dense_apply_delta(self, idx, delta):
+        delta = np.ascontiguousarray(delta, np.float32)
+        rc = self.lib.pt_client_dense_apply_delta(
+            self.handle, idx, native.f32_ptr(delta), delta.size)
+        assert rc == 0
+
+    def sparse_apply_delta(self, idx, ids, delta):
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        delta = np.ascontiguousarray(delta, np.float32)
+        c = self.configs[idx]
+        rc = self.lib.pt_client_sparse_apply_delta(
+            self.handle, idx, native.i64_ptr(ids), ids.size,
+            native.f32_ptr(delta), c.emb_dim)
         assert rc == 0
 
     def barrier(self):

@@ -1,0 +1,240 @@
+"""Trainer-side Communicator: sync / async / geo send modes.
+
+Reference: paddle/fluid/distributed/service/communicator.{h,cc} —
+AsyncCommunicator keeps one send queue per variable, a background thread
+merges up to ``max_merge_var_num`` queued gradients and pushes the sum
+to the PS; GeoCommunicator pushes parameter DELTAS (trainer-local param
+minus the last synced base) every ``geo_need_push_nums`` steps, and the
+server applies raw += delta (SparseGeoTable).
+
+The client here is any object with the LocalPSClient/RpcPSClient surface
+(push_dense/push_sparse/pull_* and the *_apply_delta geo ops).
+"""
+import queue
+import threading
+
+import numpy as np
+
+
+class AsyncCommunicator:
+    """Per-table send queues + merging sender thread (communicator.h
+    AsyncCommunicator). ``sync=True`` degrades to synchronous pushes with
+    a flush barrier per step (the reference's sync mode)."""
+
+    def __init__(self, client, send_queue_size=16, max_merge_var_num=4,
+                 sync=False):
+        self.client = client
+        self.sync = sync
+        self.max_merge = max(1, int(max_merge_var_num))
+        self._q = queue.Queue(maxsize=max(1, int(send_queue_size)))
+        self._stop = threading.Event()
+        self._inflight = 0
+        self._cv = threading.Condition()
+        self._thread = None
+        self._exc = None
+        if not sync:
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+    # ---------------------------------------------------------- trainer API
+    def push_dense(self, table_idx, grad):
+        self._send(("dense", table_idx, np.asarray(grad, np.float32), None))
+
+    def push_sparse(self, table_idx, ids, grads):
+        self._send(("sparse", table_idx,
+                    np.asarray(grads, np.float32),
+                    np.asarray(ids, np.int64).ravel()))
+
+    def flush(self):
+        """Block until every queued push has reached the PS."""
+        if self.sync:
+            return
+        with self._cv:
+            self._cv.wait_for(lambda: self._inflight == 0 and
+                              self._q.empty())
+        if self._exc:
+            raise self._exc
+
+    def stop(self):
+        if self._thread is not None:
+            self.flush()
+            self._stop.set()
+            self._q.put(None)
+            self._thread.join()
+            self._thread = None
+
+    # ------------------------------------------------------------- internals
+    def _send(self, item):
+        if self.sync:
+            self._push(item)
+            return
+        with self._cv:
+            self._inflight += 1
+        self._q.put(item)
+
+    def _push(self, item):
+        kind, idx, payload, ids = item
+        if kind == "dense":
+            self.client.push_dense(idx, payload)
+        else:
+            self.client.push_sparse(idx, ids, payload)
+
+    def _merge(self, items):
+        """Sum gradients destined for the same table (communicator.cc
+        MergeVars): dense adds arrays; sparse concatenates (the table's
+        per-row optimizer applies each contribution)."""
+        merged = {}
+        order = []
+        for kind, idx, payload, ids in items:
+            key = (kind, idx)
+            if key not in merged:
+                merged[key] = [payload, ids]
+                order.append(key)
+            elif kind == "dense":
+                merged[key][0] = merged[key][0] + payload
+            else:
+                merged[key][0] = np.concatenate([merged[key][0], payload])
+                merged[key][1] = np.concatenate([merged[key][1], ids])
+        return [(k[0], k[1], v[0], v[1]) for k, v in
+                ((k, merged[k]) for k in order)]
+
+    def _run(self):
+        while not self._stop.is_set():
+            items = []
+            item = self._q.get()
+            if item is None:
+                break
+            items.append(item)
+            while len(items) < self.max_merge:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._stop.set()
+                    break
+                items.append(nxt)
+            try:
+                for m in self._merge(items):
+                    self._push(m)
+            except Exception as e:  # noqa: BLE001 - surfaced on flush()
+                self._exc = e
+            finally:
+                with self._cv:
+                    self._inflight -= len(items)
+                    self._cv.notify_all()
+
+
+class CommunicatorClient:
+    """PS-client facade that routes pushes through an AsyncCommunicator
+    while delegating pulls/metadata to the underlying client — drop-in
+    for sparse_embedding & model code (the reference trainer binds the
+    communicator the same way: send ops enqueue, pull ops hit the PS)."""
+
+    def __init__(self, client, send_queue_size=16, max_merge_var_num=4,
+                 sync=False):
+        self._client = client
+        self.comm = AsyncCommunicator(client, send_queue_size,
+                                      max_merge_var_num, sync=sync)
+
+    @property
+    def configs(self):
+        return self._client.configs
+
+    def pull_dense(self, idx):
+        return self._client.pull_dense(idx)
+
+    def pull_sparse(self, idx, ids):
+        return self._client.pull_sparse(idx, ids)
+
+    def push_dense(self, idx, grad):
+        self.comm.push_dense(idx, grad)
+
+    def push_sparse(self, idx, ids, grads):
+        self.comm.push_sparse(idx, ids, grads)
+
+    def barrier(self):
+        self.comm.flush()
+        self._client.barrier()
+
+    def save(self, idx, path):
+        self.comm.flush()
+        return self._client.save(idx, path)
+
+    def close(self):
+        self.comm.stop()
+        self._client.close()
+
+
+class GeoCommunicator:
+    """Geo-SGD (communicator.h GeoCommunicator + SparseGeoTable): the
+    trainer optimizes LOCAL copies of the parameters; every
+    ``need_push_nums`` steps it sends (local - base) deltas to the PS,
+    re-pulls the merged global value, and rebases. Multiple trainers'
+    deltas add up server-side."""
+
+    def __init__(self, client, dense_tables=(), sparse_tables=(),
+                 need_push_nums=100):
+        self.client = client
+        self.need_push = max(1, int(need_push_nums))
+        self._step = 0
+        self._dense = {}       # idx -> trainer-local values
+        self._dense_base = {}  # idx -> last synced global snapshot
+        self._sparse = {}      # idx -> {id: {"base": row, "local": row}}
+        for idx in dense_tables:
+            v = client.pull_dense(idx).copy()
+            self._dense[idx] = v
+            self._dense_base[idx] = v.copy()
+        for idx in sparse_tables:
+            self._sparse[idx] = {}
+
+    def pull_dense(self, idx):
+        """Trainer-local view (the base snapshot, trainer applies its own
+        optimizer on top)."""
+        return self._dense[idx]
+
+    def sparse_rows(self, idx, ids):
+        """Local rows for ids, pulling not-yet-seen ids from the PS."""
+        store = self._sparse[idx]
+        ids = np.asarray(ids, np.int64).ravel()
+        missing = [i for i in ids.tolist() if i not in store]
+        if missing:
+            rows = self.client.pull_sparse(idx, np.asarray(missing, np.int64))
+            for i, mid in enumerate(missing):
+                store[mid] = {"base": rows[i].copy(),
+                              "local": rows[i].copy()}
+        return np.stack([store[i]["local"] for i in ids.tolist()])
+
+    def update_sparse_local(self, idx, ids, new_rows):
+        store = self._sparse[idx]
+        ids = np.asarray(ids, np.int64).ravel()
+        for i, mid in enumerate(ids.tolist()):
+            store[mid]["local"] = np.asarray(new_rows[i], np.float32)
+
+    def update_dense_local(self, idx, new_values):
+        self._dense[idx] = np.asarray(new_values, np.float32)
+
+    def step(self):
+        """Advance the geo counter; on the boundary, push deltas and
+        rebase from the merged global tables."""
+        self._step += 1
+        if self._step % self.need_push:
+            return False
+        for idx, local in self._dense.items():
+            delta = local - self._dense_base[idx]
+            self.client.dense_apply_delta(idx, delta)
+            merged = self.client.pull_dense(idx).copy()
+            self._dense[idx] = merged
+            self._dense_base[idx] = merged.copy()
+        for idx, store in self._sparse.items():
+            if not store:
+                continue
+            ids = np.asarray(list(store.keys()), np.int64)
+            delta = np.stack([store[i]["local"] - store[i]["base"]
+                              for i in ids.tolist()])
+            self.client.sparse_apply_delta(idx, ids, delta)
+            merged = self.client.pull_sparse(idx, ids)
+            for i, mid in enumerate(ids.tolist()):
+                store[mid] = {"base": merged[i].copy(),
+                              "local": merged[i].copy()}
+        return True

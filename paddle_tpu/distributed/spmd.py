@@ -57,8 +57,10 @@ def _zero1_spec(arr, mesh, axes=("dp", "sharding")):
 
 
 def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
-                     shard_optimizer=False, sharding_stage=None, donate=True,
-                     amp_level="O0", amp_dtype="bfloat16"):
+                     shard_optimizer=False, sharding_stage=None, donate=False,
+                     amp_level="O0", amp_dtype="bfloat16",
+                     fp16_allreduce=False, dgc_configs=None, strategy=None,
+                     offload=False):
     """Compile the full distributed training step for `layer`.
 
     loss_fn(model_out, label_array) -> scalar (pure jnp).
@@ -81,6 +83,40 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
          inside the step); param memory scales 1/N at rest
     """
     mesh = mesh or topology.get_global_mesh()
+    if strategy is not None:
+        # fleet DistributedStrategy knobs -> functional options (the
+        # meta-optimizer stack of fleet_base.py:1242 collapsed into one
+        # entry point; knobs without an implementation raise, never no-op)
+        if strategy.adaptive_localsgd:
+            raise NotImplementedError(
+                "adaptive_localsgd is not implemented; use localsgd with "
+                "a fixed k_steps")
+        if strategy.localsgd:
+            unsupported = [k for k in ("recompute", "dgc", "fp16_allreduce",
+                                       "sharding")
+                           if getattr(strategy, k)]
+            if unsupported:
+                raise NotImplementedError(
+                    f"localsgd does not compose with {unsupported}; "
+                    f"disable them or drop localsgd")
+            from . import comm_opt
+
+            return comm_opt.build_localsgd_train_step(
+                layer, loss_fn, optimizer, mesh=mesh,
+                k_steps=int(strategy.localsgd_configs.get("k_steps", 1) or 1),
+                amp_level="O1" if strategy.amp else amp_level,
+                amp_dtype=amp_dtype)
+        if strategy.amp and amp_level == "O0":
+            amp_level = "O2" if strategy.amp_configs.get("use_pure_fp16") \
+                else "O1"
+        recompute = recompute or strategy.recompute
+        fp16_allreduce = fp16_allreduce or strategy.fp16_allreduce
+        if strategy.dgc and dgc_configs is None:
+            dgc_configs = dict(strategy.dgc_configs)
+        if strategy.sharding and sharding_stage is None:
+            sharding_stage = int(
+                strategy.sharding_configs.get("stage", 1) or 1)
+        offload = offload or bool(strategy.sharding_configs.get("offload"))
     if sharding_stage is None:
         # group_sharded_parallel() tags the model with its ZeRO stage
         sharding_stage = getattr(layer, "_sharding_stage", None) or \
@@ -110,7 +146,13 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
                 layer.load_functional_state(params, buffers)
                 out = layer.forward(Tensor(x, stop_gradient=True))
                 out_arr = out._value if isinstance(out, Tensor) else out
-                return loss_fn(out_arr, y)
+                loss = loss_fn(out_arr, y)
+                # capture in-forward buffer updates (BatchNorm running
+                # stats, QAT moving scales) so they thread through the
+                # compiled step instead of silently freezing at init
+                _, new_buffers = layer.functional_state()
+                return loss, {n: new_buffers.get(n, buffers[n])
+                              for n in buffer_names}
         finally:
             layer.load_functional_state(saved_p, saved_b)
 
@@ -124,6 +166,17 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
     # shardings: batch over dp(+sharding) — ZeRO groups subdivide dp
     repl = NamedSharding(mesh, P())
     zero_specs = {n: _zero1_spec(params0[n], mesh) for n in param_names}
+    # per-state-array shardings (used by host offload to bounce each
+    # state leaf host<->device; reference: sharding/offload_helper.py)
+    opt_state_specs = {}
+    if offload:
+        if dgc_configs is not None:
+            raise NotImplementedError("offload does not compose with dgc")
+        for n in param_names:
+            st = optimizer._init_state(params0[n])
+            opt_state_specs[n] = tuple(
+                (_zero1_spec(a, mesh) if sharding_stage >= 1 else repl)
+                for a in st)
     named = dict(layer.named_parameters())
     has_mp = {n: getattr(named[n], "mp_spec", None) is not None
               for n in param_names}
@@ -137,17 +190,61 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
     data_axes = tuple(ax for ax in ("dp", "sharding") if mesh.shape.get(ax, 1) > 1)
     batch_shard = NamedSharding(mesh, P(data_axes)) if data_axes else repl
 
+    use_local_grads = fp16_allreduce or dgc_configs is not None
+    if use_local_grads:
+        if any(has_mp.values()):
+            raise NotImplementedError(
+                "dgc/fp16_allreduce compose with data parallelism only "
+                "(reference dgc_optimizer.py has the same constraint)")
+        if sharding_stage >= 2:
+            raise NotImplementedError(
+                "dgc/fp16_allreduce replace the gradient allreduce and "
+                "cannot combine with ZeRO-2/3 reduce-scatter")
+        if not data_axes:
+            raise ValueError(
+                "dgc/fp16_allreduce need a data-parallel mesh axis > 1")
+        from . import comm_opt
+
+        local_grad_fn = comm_opt.make_local_grad_fn(
+            forward_loss, data_axes, param_names,
+            fp16_allreduce=fp16_allreduce, dgc_configs=dgc_configs)
+        from jax import shard_map as _shard_map
+
+        pspec = P(data_axes)
+        local_grads_smapped = _shard_map(
+            local_grad_fn, mesh=mesh,
+            in_specs=({n: P() for n in param_names},
+                      {n: P() for n in buffer_names},
+                      pspec, pspec, P(),
+                      {n: (pspec, pspec) for n in param_names}
+                      if dgc_configs is not None else {}),
+            out_specs=(P(), {n: P() for n in param_names},
+                       {n: P() for n in buffer_names},
+                       {n: (pspec, pspec) for n in param_names}
+                       if dgc_configs is not None else {}),
+            # vma tracking auto-psums grads of replicated params during
+            # transpose — these optimizers exist to intercept the LOCAL
+            # grad before any collective, so keep grads per-worker
+            check_vma=False)
+
     def step(params, opt_state, buffers, x, y, key, lr):
         # batch stays dp-sharded via in_shardings; grads of replicated params
         # get psum'd across dp by SPMD automatically.
-        if sharding_stage >= 3:
-            # gather sharded params once up front (XLA fuses/dedups the
-            # all-gathers); keeps the forward's own layouts (mp) intact
-            params = {n: (params[n] if has_mp[n] else
-                          jax.lax.with_sharding_constraint(params[n], p_shardings[n]))
-                      for n in param_names}
-        loss, grads = jax.value_and_grad(
-            lambda p: forward_loss(p, buffers, x, y, key))(params)
+        # ZeRO-3 note: params arrive SHARDED (param_shards) and are NOT
+        # gathered here — GSPMD inserts an all-gather at each weight's
+        # use site, so peak live memory holds one layer's gathered
+        # weights, not the full parameter set (the reference stages
+        # per-segment broadcasts for the same reason,
+        # sharding_optimizer.py segment logic). With recompute=True the
+        # backward re-gathers instead of keeping gathered copies alive.
+        if use_local_grads:
+            comm_state = opt_state.get("__comm__", {})
+            loss, grads, new_buffers, new_comm = local_grads_smapped(
+                params, buffers, x, y, key, comm_state)
+        else:
+            (loss, new_buffers), grads = jax.value_and_grad(
+                lambda p: forward_loss(p, buffers, x, y, key),
+                has_aux=True)(params)
         if sharding_stage >= 2:
             # constrain grads to the shard layout -> reduce-scatter
             grads = {n: (grads[n] if has_mp[n] else
@@ -163,19 +260,44 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
             out = opt_update(params[name], g, lr, *opt_state[name], **hypers)
             new_params[name] = out[0]
             new_state[name] = tuple(out[1:])
-        return loss, new_params, new_state
+        if use_local_grads and dgc_configs is not None:
+            new_state["__comm__"] = new_comm
+        return loss, new_params, new_state, new_buffers
 
     def init_fn():
-        params = {n: jax.device_put(params0[n], param_shards[n])
-                  for n in param_names}
+        # Always copy: (a) cloned layers (TransformerEncoder-style
+        # deepcopy) share init arrays, and device_put would alias them
+        # into one buffer — donating the same buffer twice is an error;
+        # (b) with donate=True the training params must not alias the
+        # layer's own ._value arrays, or step 1 would delete the layer's
+        # weights out from under eager readers.
+        params = {}
+        seen_ids = set()
+        for n in param_names:
+            src = params0[n]
+            if donate or id(src) in seen_ids:
+                src = jnp.array(src, copy=True)
+            else:
+                seen_ids.add(id(src))
+            params[n] = jax.device_put(src, param_shards[n])
         opt_state = {}
         for n in param_names:
             st = optimizer._init_state(params0[n])
-            if shard_optimizer:
+            if offload:
+                opt_state[n] = tuple(
+                    jax.device_put(a, s.with_memory_kind("pinned_host")
+                                   if a.ndim else s)
+                    for a, s in zip(st, opt_state_specs[n]))
+            elif shard_optimizer:
                 opt_state[n] = tuple(
                     jax.device_put(a, _zero1_spec(a, mesh)) for a in st)
             else:
                 opt_state[n] = tuple(jax.device_put(a, repl) for a in st)
+        if use_local_grads and dgc_configs is not None:
+            from . import comm_opt
+
+            opt_state["__comm__"] = comm_opt.init_dgc_state(
+                params0, mesh, data_axes)
         return params, opt_state
 
     in_shardings = (
@@ -187,20 +309,46 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
         repl,
         repl,
     )
-    out_shardings = (repl, param_shards, None)
+    out_shardings = (repl, param_shards, None, {n: repl for n in buffer_names})
+    # donate params + opt_state: the step returns their replacements, so
+    # XLA can update in place instead of holding both copies in HBM
+    # (no-op on CPU backends, which don't implement donation)
     step_jit = jax.jit(step, in_shardings=in_shardings,
-                       out_shardings=out_shardings)
+                       out_shardings=out_shardings,
+                       donate_argnums=(0, 1) if donate else ())
 
-    # buffers are step-invariant: upload once, not per step
-    buffers_dev = {n: jnp.asarray(buffers0[n]) for n in buffer_names}
+    # buffers thread through the step (BN stats / QAT scales update);
+    # the latest values live in this cell and are synced back onto the
+    # layer after every step so state_dict()/eval observe them
+    buffers_cell = {"cur": {n: jnp.asarray(buffers0[n]) for n in buffer_names}}
+
+    def _bounce(opt_state, kind):
+        """Host<->device move of the non-scalar optimizer-state arrays
+        (reference: sharding/offload_helper.py keeps optimizer state in
+        host memory and copies it in around the update)."""
+        return {
+            n: tuple(
+                jax.device_put(a, s.with_memory_kind(kind)) if a.ndim else a
+                for a, s in zip(opt_state[n], opt_state_specs[n]))
+            for n in opt_state}
 
     def step_fn(params, opt_state, x, y, key=None, lr=None):
         if key is None:
             key = jax.random.PRNGKey(0)
         if lr is None:
             lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
-        return step_jit(params, opt_state, buffers_dev, x, y, key, lr)
+        if offload:
+            opt_state = _bounce(opt_state, "device")
+        loss, new_params, new_state, new_buffers = step_jit(
+            params, opt_state, buffers_cell["cur"], x, y, key, lr)
+        if offload:
+            new_state = _bounce(new_state, "pinned_host")
+        buffers_cell["cur"] = new_buffers
+        if buffer_names:
+            layer.load_functional_state(None, new_buffers)
+        return loss, new_params, new_state
 
+    step_fn.jitted = step_jit  # AOT/lowering access (tests, memory checks)
     return step_fn, init_fn
 
 
@@ -224,3 +372,172 @@ def shard_batch(batch, mesh=None, axis=None):
         return jax.make_array_from_process_local_data(sharding, local,
                                                       global_shape)
     return jax.device_put(arr, sharding)
+
+
+def build_fsdp_train_step(layers, loss_fn, optimizer, mesh=None,
+                          recompute=True, amp_level="O0",
+                          amp_dtype="bfloat16", donate=False):
+    """ZeRO-3 with a scan-over-layers trunk (FSDP; reference:
+    sharding_optimizer.py:180 per-segment broadcast staging).
+
+    ``layers``: an nn.Sequential (or list of Layers) whose longest
+    contiguous run of structurally-identical blocks becomes the scanned
+    trunk. Trunk parameters are stacked [L, ...] and sharded over the
+    dp+sharding axes; the scan body gathers ONE layer's weights
+    (with_sharding_constraint -> all-gather at use), applies the block
+    under jax.checkpoint, and lets the gathered copy die — peak live
+    parameter memory is a single layer, not the model (the property the
+    up-front gather of plain sharding_stage=3 cannot guarantee).
+
+    Returns (step_fn, init_fn) with the build_train_step contract.
+    Trunk params live under 'trunk.<name>' stacked; pre/post layers keep
+    'pre.<i>.<name>' / 'post.<i>.<name>' replicated entries.
+    """
+    from .pipeline import split_pre_trunk_post, _functional_apply
+
+    if hasattr(layers, "_sub_layers"):
+        layer_list = [l for l in layers._sub_layers.values() if l is not None]
+    else:
+        layer_list = list(layers)
+    for l in layer_list:
+        if any(bn for _, sub in l.named_sublayers(include_self=True)
+               for bn in sub._buffers):
+            raise NotImplementedError(
+                "build_fsdp_train_step does not thread layer buffers; "
+                "use build_train_step(sharding_stage=3) for models with "
+                "BatchNorm-style state")
+    pre, trunk, post = split_pre_trunk_post(layer_list, 1)
+    mesh = mesh or topology.get_global_mesh()
+    data_axes = tuple(ax for ax in ("dp", "sharding")
+                      if mesh.shape.get(ax, 1) > 1)
+    world = 1
+    for ax in data_axes:
+        world *= mesh.shape[ax]
+    template = trunk[0]
+    L = len(trunk)
+    amp_enabled = amp_level in ("O1", "O2")
+
+    def _apply(layer, params, x, key):
+        # buffer-free by the guard above, so params-only restore is safe
+        if not amp_enabled:
+            return _functional_apply(layer, params, x, key)
+        from ..amp.auto_cast import auto_cast as _auto_cast
+
+        saved = {n: p._value for n, p in layer.named_parameters()}
+        try:
+            with dispatch.trace_mode(), random_core.rng_guard(key), \
+                    _auto_cast(enable=True, level=amp_level, dtype=amp_dtype):
+                layer.load_functional_state(params)
+                out = layer.forward(Tensor(x, stop_gradient=True))
+                return out._value if isinstance(out, Tensor) else out
+        finally:
+            layer.load_functional_state(saved)
+
+    # ---- param pytree: pre.<i>.<n> / trunk.<n> stacked [L,...] / post.<i>.<n>
+    def _lp(l):
+        return {n: p._value for n, p in l.named_parameters()}
+
+    trunk_names = list(_lp(template))
+    params0 = {}
+    for i, l in enumerate(pre):
+        for n, a in _lp(l).items():
+            params0[f"pre.{i}.{n}"] = a
+    for n in trunk_names:
+        params0[f"trunk.{n}"] = jnp.stack([jnp.asarray(_lp(l)[n])
+                                           for l in trunk])
+    for i, l in enumerate(post):
+        for n, a in _lp(l).items():
+            params0[f"post.{i}.{n}"] = a
+    param_names = list(params0)
+
+    repl = NamedSharding(mesh, P())
+
+    def _stacked_spec(arr):
+        # shard a per-layer dim (never the stacked L dim) over data axes
+        if world == 1:
+            return repl
+        for dim in range(1, arr.ndim):
+            if arr.shape[dim] % world == 0:
+                spec = [None] * arr.ndim
+                spec[dim] = data_axes if len(data_axes) > 1 else data_axes[0]
+                return NamedSharding(mesh, P(*spec))
+        return repl
+
+    param_shards = {}
+    for n in param_names:
+        param_shards[n] = (_stacked_spec(params0[n]) if n.startswith("trunk.")
+                           else repl)
+
+    def forward_loss(params, x, y, key):
+        h = x
+        for i, l in enumerate(pre):
+            h = _apply(l, {n: params[f"pre.{i}.{n}"] for n in _lp(l)}, h,
+                       jax.random.fold_in(key, 1000 + i))
+
+        def body(h, xs):
+            sliced, k = xs
+            gathered = {n: jax.lax.with_sharding_constraint(a, repl)
+                        for n, a in sliced.items()}
+            return _apply(template, gathered, h, k), None
+
+        if recompute:
+            body = jax.checkpoint(body)
+        stacked = {n: params[f"trunk.{n}"] for n in trunk_names}
+        keys = jax.random.split(jax.random.fold_in(key, 7), L)
+        h, _ = jax.lax.scan(body, h, (stacked, keys))
+        for i, l in enumerate(post):
+            h = _apply(l, {n: params[f"post.{i}.{n}"] for n in _lp(l)}, h,
+                       jax.random.fold_in(key, 2000 + i))
+        return loss_fn(h, y)
+
+    hypers = optimizer._hypers()
+    opt_update = type(optimizer)._update
+    grad_clip = optimizer._grad_clip
+    batch_shard = NamedSharding(mesh, P(data_axes)) if data_axes else repl
+
+    def step(params, opt_state, x, y, key, lr):
+        loss, grads = jax.value_and_grad(
+            lambda p: forward_loss(p, x, y, key))(params)
+        # keep grads in the shard layout -> reduce-scatter, ZeRO-2 style
+        grads = {n: jax.lax.with_sharding_constraint(g, param_shards[n])
+                 for n, g in grads.items()}
+        if grad_clip is not None:
+            names = list(grads)
+            clipped = grad_clip.clip_arrays([grads[n] for n in names])
+            grads = dict(zip(names, clipped))
+        new_params, new_state = {}, {}
+        for n in param_names:
+            g = grads[n].astype(params[n].dtype)
+            out = opt_update(params[n], g, lr, *opt_state[n], **hypers)
+            new_params[n] = out[0]
+            new_state[n] = tuple(out[1:])
+        return loss, new_params, new_state
+
+    step_jit = jax.jit(
+        step,
+        in_shardings=(param_shards, None, batch_shard, batch_shard, repl,
+                      repl),
+        out_shardings=(repl, param_shards, None),
+        donate_argnums=(0, 1) if donate else ())
+
+    def init_fn():
+        params = {n: jax.device_put(params0[n], param_shards[n])
+                  for n in param_names}
+        opt_state = {}
+        for n in param_names:
+            st = optimizer._init_state(np.asarray(params0[n]))
+            opt_state[n] = tuple(
+                jax.device_put(a, _stacked_spec(a)
+                               if n.startswith("trunk.") else repl)
+                for a in st)
+        return params, opt_state
+
+    def step_fn(params, opt_state, x, y, key=None, lr=None):
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        if lr is None:
+            lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
+        return step_jit(params, opt_state, x, y, key, lr)
+
+    step_fn.jitted = step_jit
+    return step_fn, init_fn

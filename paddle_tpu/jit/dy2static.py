@@ -161,8 +161,13 @@ def convert_while(cond_fn, body_fn, vals, maximum_iterations=None):
     reference while_op)."""
     kind, p = _pred_value(cond_fn(*vals))
     if kind == "py":
+        iters = 0
         while p:
+            if maximum_iterations is not None and \
+                    iters >= int(maximum_iterations):
+                break  # honor the bound on the eager path too
             vals = body_fn(*vals)
+            iters += 1
             if not isinstance(vals, tuple):
                 vals = (vals,)
             kind, p = _pred_value(cond_fn(*vals))
@@ -197,7 +202,11 @@ def _traced_while(cond_fn, body_fn, vals, maximum_iterations=None):
         return tuple(out)
 
     def cond_w(carry):
-        _, p = _pred_value(cond_fn(*rebuild(carry)))
+        kind, p = _pred_value(cond_fn(*rebuild(carry)))
+        if kind == "py":
+            # condition independent of the carry (e.g. `while flag:` over
+            # a python constant) — a plain bool has no .dtype; lift it
+            return jnp.asarray(bool(p))
         return p != 0 if p.dtype != jnp.bool_ else p
 
     def body_w(carry):

@@ -86,6 +86,11 @@ def _declare(lib):
         "pt_client_dense_push": (i32, [i64, i32, f32p, i64]),
         "pt_client_sparse_pull": (i32, [i64, i32, i64p, i64, f32p, i64]),
         "pt_client_sparse_push": (i32, [i64, i32, i64p, i64, f32p, i64]),
+        "pt_dense_apply_delta": (i32, [i64, f32p, i64]),
+        "pt_sparse_apply_delta": (i32, [i64, i64p, i64, f32p]),
+        "pt_client_dense_apply_delta": (i32, [i64, i32, f32p, i64]),
+        "pt_client_sparse_apply_delta": (i32, [i64, i32, i64p, i64, f32p,
+                                               i64]),
         "pt_client_barrier": (i32, [i64]),
         "pt_client_save": (i32, [i64, i32, cstr]),
         "pt_dataset_create": (i64, [cstr, i32]),

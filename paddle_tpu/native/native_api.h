@@ -28,6 +28,11 @@ int pt_dense_push(int64_t table, const float* grad, int64_t size);
 int pt_dense_set(int64_t table, const float* values, int64_t size);
 
 // sparse: ids int64[n]; out float[n*emb_dim]; grads float[n*emb_dim]
+// geo-SGD delta application (w += delta; no server-side optimizer)
+int pt_dense_apply_delta(int64_t table, const float* delta, int64_t size);
+int pt_sparse_apply_delta(int64_t table, const int64_t* ids, int64_t n,
+                          const float* delta);
+
 int pt_sparse_pull(int64_t table, const int64_t* ids, int64_t n, float* out,
                    int init_if_missing);
 int pt_sparse_push(int64_t table, const int64_t* ids, int64_t n,
@@ -55,6 +60,11 @@ int pt_client_sparse_pull(int64_t client, int table_idx, const int64_t* ids,
                           int64_t n, float* out, int64_t emb_dim);
 int pt_client_sparse_push(int64_t client, int table_idx, const int64_t* ids,
                           int64_t n, const float* grads, int64_t emb_dim);
+int pt_client_dense_apply_delta(int64_t client, int table_idx,
+                                const float* delta, int64_t size);
+int pt_client_sparse_apply_delta(int64_t client, int table_idx,
+                                 const int64_t* ids, int64_t n,
+                                 const float* delta, int64_t emb_dim);
 int pt_client_barrier(int64_t client);
 int pt_client_save(int64_t client, int table_idx, const char* path);
 

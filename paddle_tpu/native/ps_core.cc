@@ -218,6 +218,33 @@ int pt_dense_set(int64_t table, const float* values, int64_t size) {
   return 0;
 }
 
+int pt_dense_apply_delta(int64_t table, const float* delta, int64_t size) {
+  // geo-SGD: server applies raw parameter deltas (w += delta), no
+  // optimizer (reference: table/ SparseGeoTable dense analog — trainers
+  // own the optimization, the server merges divergences)
+  DenseTable* t = get_dense(table);
+  if (!t || (int64_t)t->w.size() != size) return -1;
+  std::lock_guard<std::mutex> lock(t->mu);
+  for (int64_t i = 0; i < size; i++) t->w[i] += delta[i];
+  return 0;
+}
+
+int pt_sparse_apply_delta(int64_t table, const int64_t* ids, int64_t n,
+                          const float* delta) {
+  // geo-SGD sparse: row[id] += delta (rows created on demand)
+  SparseTable* t = get_sparse(table);
+  if (!t) return -1;
+  for (int64_t i = 0; i < n; i++) {
+    int64_t id = ids[i];
+    SparseShard& sh = t->shards[mix((uint64_t)id) % kShards];
+    std::lock_guard<std::mutex> lock(sh.mu);
+    auto& row = ensure_row(t, sh, id);
+    const float* d = delta + i * t->dim;
+    for (int64_t j = 0; j < t->dim; j++) row[j] += d[j];
+  }
+  return 0;
+}
+
 int pt_sparse_pull(int64_t table, const int64_t* ids, int64_t n, float* out,
                    int init_if_missing) {
   SparseTable* t = get_sparse(table);

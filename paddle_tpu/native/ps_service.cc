@@ -10,6 +10,8 @@
 //       3 sparse_pull(payload i64[n]; resp f32[n*dim])
 //       4 sparse_push(payload i64[n] + f32[n*dim])
 //       5 barrier 6 save(payload path bytes) 7 stop
+//       8 dense_apply_delta(payload f32[n])
+//       9 sparse_apply_delta(payload i64 dim + i64[n] + f32[n*dim])
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -165,6 +167,23 @@ struct Server {
         case 7:
           stop = true;
           break;
+        case 8:  // geo dense delta
+          if (payload_len != n * 4 ||
+              pt_dense_apply_delta(table, (const float*)payload, (int64_t)n))
+            fail();
+          break;
+        case 9: {  // geo sparse delta: payload = i64 dim, i64 ids[n], f32[n*dim]
+          if (payload_len < 8 + n * 8) { fail(); break; }
+          int64_t dim;
+          std::memcpy(&dim, payload, 8);
+          if (dim != pt_sparse_dim(table) ||
+              payload_len != 8 + n * 8 + n * (uint64_t)dim * 4 ||
+              pt_sparse_apply_delta(table, (const int64_t*)(payload + 8),
+                                    (int64_t)n,
+                                    (const float*)(payload + 8 + n * 8)))
+            fail();
+          break;
+        }
         default:
           fail();
       }
@@ -374,6 +393,35 @@ int pt_client_sparse_push(int64_t client, int table_idx, const int64_t* ids,
   std::memcpy(payload.data() + 8 + n * 8, grads, n * emb_dim * 4);
   std::vector<char> resp;
   if (!c->request(make_req(4, (uint8_t)table_idx, (uint64_t)n,
+                           payload.data(), payload.size()), resp) ||
+      resp.empty() || resp[0] != 0)
+    return -1;
+  return 0;
+}
+
+int pt_client_dense_apply_delta(int64_t client, int table_idx,
+                                const float* delta, int64_t size) {
+  Client* c = get_client(client);
+  if (!c) return -1;
+  std::vector<char> resp;
+  if (!c->request(make_req(8, (uint8_t)table_idx, (uint64_t)size, delta,
+                           size * 4), resp) ||
+      resp.empty() || resp[0] != 0)
+    return -1;
+  return 0;
+}
+
+int pt_client_sparse_apply_delta(int64_t client, int table_idx,
+                                 const int64_t* ids, int64_t n,
+                                 const float* delta, int64_t emb_dim) {
+  Client* c = get_client(client);
+  if (!c) return -1;
+  std::vector<char> payload(8 + n * 8 + n * emb_dim * 4);
+  std::memcpy(payload.data(), &emb_dim, 8);
+  std::memcpy(payload.data() + 8, ids, n * 8);
+  std::memcpy(payload.data() + 8 + n * 8, delta, n * emb_dim * 4);
+  std::vector<char> resp;
+  if (!c->request(make_req(9, (uint8_t)table_idx, (uint64_t)n,
                            payload.data(), payload.size()), resp) ||
       resp.empty() || resp[0] != 0)
     return -1;

@@ -554,13 +554,20 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
 
     y, mean, var = apply_op("batch_norm_train", _bn_train, x, weight, bias,
                             eps=float(epsilon), axes=axes, chan_ax=chan_ax)
-    # update running stats (no grad)
-    if isinstance(running_mean, Tensor) and not isinstance(
-            running_mean._value, jax.core.Tracer):
+    # update running stats (no grad). Under trace this writes tracers into
+    # the buffer Tensors on purpose: the managed trace paths
+    # (spmd.build_train_step forward_loss, jit static_function pure_fn)
+    # snapshot+restore buffers around the trace and thread the updated
+    # values out functionally, so the moving averages keep calibrating
+    # inside compiled training steps instead of freezing at init.
+    if isinstance(running_mean, Tensor):
         m = float(momentum)
         with _no_grad():
-            running_mean.set_value(m * running_mean._value + (1 - m) * mean._value)
-            running_var.set_value(m * running_var._value + (1 - m) * var._value)
+            stop = jax.lax.stop_gradient
+            running_mean.set_value(m * running_mean._value +
+                                   (1 - m) * stop(mean._value))
+            running_var.set_value(m * running_var._value +
+                                  (1 - m) * stop(var._value))
     return y
 
 

@@ -229,13 +229,19 @@ class Layer:
     # ------------------------------------------------------------ functional view
     def functional_state(self):
         """(param_arrays, buffer_arrays) pytrees keyed by structured name —
-        the bridge from mutable Layer to pure-function training steps."""
+        the bridge from mutable Layer to pure-function training steps.
+        Covers Tensor buffers (BatchNorm stats) and raw-array buffers
+        (QAT scales) alike."""
         params = {name: p._value for name, p in self.named_parameters()}
         buffers = {}
         for name, layer in self.named_sublayers(include_self=True):
             for bname, b in layer._buffers.items():
+                key = f"{name}.{bname}" if name else bname
                 if isinstance(b, Tensor):
-                    buffers[f"{name}.{bname}" if name else bname] = b._value
+                    buffers[key] = b._value
+                elif isinstance(b, np.ndarray) or \
+                        type(b).__module__.startswith("jax"):
+                    buffers[key] = b
         return params, buffers
 
     def load_functional_state(self, params=None, buffers=None):
@@ -247,12 +253,17 @@ class Layer:
         if buffers:
             blookup = {}
             for name, layer in self.named_sublayers(include_self=True):
-                for bname, b in layer._buffers.items():
-                    if isinstance(b, Tensor):
-                        blookup[f"{name}.{bname}" if name else bname] = b
+                for bname in layer._buffers:
+                    blookup[f"{name}.{bname}" if name else bname] = \
+                        (layer, bname)
             for name, arr in buffers.items():
                 if name in blookup:
-                    blookup[name]._value = arr
+                    layer, bname = blookup[name]
+                    cur = layer._buffers[bname]
+                    if isinstance(cur, Tensor):
+                        cur._value = arr
+                    else:
+                        layer._buffers[bname] = arr
 
     # ------------------------------------------------------------ hooks
     def register_forward_pre_hook(self, hook):

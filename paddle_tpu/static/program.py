@@ -335,8 +335,19 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, program=N
                                   placeholder_ids, param_ids)
         return tuple(env[fid] for fid in fetch_ids)
 
-    param_names = [getattr(p, "name", None) or f"param_{i}"
-                   for i, p in enumerate(param_tensors)]
+    param_names = []
+    used_names = set()
+    for i, p in enumerate(param_tensors):
+        name = getattr(p, "name", None) or f"param_{i}"
+        # duplicate names would collapse in the saved params dict and
+        # silently drop weights; uniquify deterministically
+        if name in used_names:
+            k = 1
+            while f"{name}__dup{k}" in used_names:
+                k += 1
+            name = f"{name}__dup{k}"
+        used_names.add(name)
+        param_names.append(name)
     param_arrays = [p._value for p in param_tensors]
     param_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in param_arrays]
     feed_specs = [jax.ShapeDtypeStruct(v._value.shape, v._value.dtype)

@@ -279,8 +279,11 @@ def llama_generate(model, input_ids, max_new_tokens=32, do_sample=False,
 
     @jax.jit
     def decode(params, prompt, key):
-        caches = (jnp.zeros((n_layers, b, nkv, total, hd), jnp.float32),
-                  jnp.zeros((n_layers, b, nkv, total, hd), jnp.float32))
+        # cache dtype must follow the params (bf16 weights -> bf16 cache);
+        # a hardcoded f32 cache upcasts every attend under bf16 decode
+        cdtype = params["embed"].dtype
+        caches = (jnp.zeros((n_layers, b, nkv, total, hd), cdtype),
+                  jnp.zeros((n_layers, b, nkv, total, hd), cdtype))
         # prefill
         logits, caches = forward_with_cache(
             params, prompt, caches, jnp.arange(t0), jnp.asarray(t0))

@@ -107,9 +107,20 @@ class BertForPretraining(nn.Layer):
             self.bert.embeddings.word_embeddings.weight)
         self.nsp = nn.Linear(self.bert.hidden_size, 2)
 
-    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_positions=None):
+        """masked_positions: optional [B, P] int positions of the masked
+        tokens; when given, only those rows go through the vocab
+        projection (reference: PaddleNLP BertPretrainingHeads gathers
+        masked_positions before the decoder matmul — at 15% masking this
+        cuts the 30k-vocab logits work ~6x)."""
         seq, pooled = self.bert(input_ids, token_type_ids,
                                 attention_mask=attention_mask)
+        if masked_positions is not None:
+            from .. import tensor as pt
+
+            idx = pt.unsqueeze(masked_positions, -1)  # [B, P, 1]
+            seq = pt.take_along_axis(seq, idx, axis=1)  # [B, P, H]
         return self.cls(seq), self.nsp(pooled)
 
 

@@ -1,0 +1,87 @@
+"""OpTest-style gradient checking harness (reference:
+python/paddle/fluid/tests/unittests/op_test.py:255 OpTest,
+get_numeric_gradient:110, check_grad:1372; tolerance whitelists in
+unittests/white_list/op_accuracy_white_list.py).
+
+check_grad(fn, inputs, ...) compares the eager tape's analytic gradient
+of a randomly-weighted sum of fn's outputs against central finite
+differences, per differentiable input.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def _as_tuple(x):
+    return x if isinstance(x, (tuple, list)) else (x,)
+
+
+def _weighted_sum_np(outs, weights):
+    return sum(float((np.asarray(o, np.float64) * w).sum())
+               for o, w in zip(outs, weights))
+
+
+def numeric_grad(fn, arrays, wrt, weights, eps):
+    """Central-difference dL/d(arrays[wrt]) where
+    L = sum_i (fn(*arrays)_i * weights_i).sum()
+    (reference: op_test.py get_numeric_gradient)."""
+    base = [np.array(a, np.float32) for a in arrays]
+    g = np.zeros_like(base[wrt], dtype=np.float64)
+    flat = base[wrt].reshape(-1)
+    gflat = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        hi = _weighted_sum_np(_run_np(fn, base), weights)
+        flat[i] = orig - eps
+        lo = _weighted_sum_np(_run_np(fn, base), weights)
+        flat[i] = orig
+        gflat[i] = (hi - lo) / (2.0 * eps)
+    return g
+
+
+def _run_np(fn, arrays):
+    outs = _as_tuple(fn(*[paddle.to_tensor(a, stop_gradient=True)
+                          for a in arrays]))
+    return [np.asarray(o._value if hasattr(o, "_value") else o)
+            for o in outs]
+
+
+def check_grad(fn, inputs, wrt=None, eps=1e-2, rtol=1e-2, atol=1e-3,
+               seed=0, name=""):
+    """Assert tape gradients of fn match finite differences.
+
+    fn: callable over Tensors returning a Tensor or tuple of Tensors.
+    inputs: list of np arrays (float inputs get grad-checked).
+    wrt: indices of inputs to check (default: every float input).
+    """
+    rng = np.random.RandomState(seed)
+    arrays = [np.asarray(a) for a in inputs]
+    if wrt is None:
+        wrt = [i for i, a in enumerate(arrays)
+               if np.issubdtype(a.dtype, np.floating)]
+
+    tensors = [paddle.to_tensor(
+        a, stop_gradient=not (i in wrt and
+                              np.issubdtype(a.dtype, np.floating)))
+        for i, a in enumerate(arrays)]
+    outs = _as_tuple(fn(*tensors))
+    out_np = [np.asarray(o._value) for o in outs]
+    weights = [rng.rand(*o.shape).astype(np.float32) if o.ndim else
+               np.float32(1.0) for o in out_np]
+    loss = None
+    for o, w in zip(outs, weights):
+        term = (o * paddle.to_tensor(w, stop_gradient=True)).sum()
+        loss = term if loss is None else loss + term
+    grads = paddle.grad(loss, [tensors[i] for i in wrt], allow_unused=True)
+
+    for k, i in enumerate(wrt):
+        g_num = numeric_grad(fn, arrays, i, weights, eps)
+        g_ana = (np.zeros_like(g_num) if grads[k] is None
+                 else np.asarray(grads[k]._value, np.float64))
+        denom = np.maximum(np.abs(g_num), np.maximum(np.abs(g_ana), 1.0))
+        err = np.max(np.abs(g_ana - g_num) / denom)
+        assert err <= max(rtol, atol), (
+            f"{name or fn}: grad mismatch on input {i}: max scaled error "
+            f"{err:.4g} > {max(rtol, atol)}\nanalytic:\n{g_ana}\n"
+            f"numeric:\n{g_num}")

@@ -1,0 +1,150 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+1. KV caches must follow the params dtype (bf16 cached decode).
+2. save_inference_model must not silently drop duplicate-named params.
+3. QAT moving-average calibration must update under traced training.
+4. dy2static while with a carry-independent python condition.
+Plus the buffer-threading fix the QAT item exposed: BatchNorm running
+stats must update through spmd.build_train_step.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class TestKVCacheDtype:
+    def test_bf16_cached_decode_matches_uncached(self):
+        """Old code hardcoded f32 caches; bf16 params then crashed
+        dynamic_update_slice (dtype mismatch) or upcast every attend."""
+        import jax.numpy as jnp
+        from paddle_tpu.text import LlamaModel, generation
+
+        paddle.seed(3)
+        model = LlamaModel(vocab_size=97, hidden_size=32, num_layers=2,
+                           num_heads=4, intermediate_size=64, max_seq_len=64)
+        for p in model.parameters():
+            p._value = p._value.astype(jnp.bfloat16)
+        prompt = np.array([[5, 17, 3, 9]], np.int32)
+        cached = generation.llama_generate(model, prompt, max_new_tokens=6)
+        uncached = generation.generate(model, prompt, max_new_tokens=6)
+        np.testing.assert_array_equal(cached, np.asarray(uncached))
+
+
+class TestSaveInferenceModelDupNames:
+    def test_duplicate_param_names_roundtrip(self, tmp_path):
+        from paddle_tpu import static
+
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            startup = static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [4, 6], "float32")
+                w1 = paddle.create_parameter([6, 5], "float32", name="w")
+                w2 = paddle.create_parameter([5, 3], "float32", name="w")
+                y = paddle.matmul(paddle.matmul(x, w1), w2)
+            exe = static.Executor()
+            prefix = str(tmp_path / "dup_model")
+            static.save_inference_model(prefix, [x], [y], exe, program=main)
+            layer, _, _ = static.load_inference_model(prefix, exe)
+            xv = np.random.RandomState(1).randn(4, 6).astype(np.float32)
+            out = layer(xv)
+            arr = np.asarray(out._value if hasattr(out, "_value") else out)
+            ref = xv @ np.asarray(w1._value) @ np.asarray(w2._value)
+            np.testing.assert_allclose(arr, ref, rtol=1e-5, atol=1e-5)
+        finally:
+            paddle.disable_static()
+
+
+class TestQATCalibrationUnderTrace:
+    def test_act_scale_updates_through_train_step(self):
+        import jax.numpy as jnp
+        from paddle_tpu.distributed import spmd, topology
+        from paddle_tpu.quantization.imperative import ImperativeQuantAware
+
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        ImperativeQuantAware().quantize(model)
+        model.train()
+        opt = optimizer.SGD(0.05, parameters=model.parameters())
+        mesh = topology.build_mesh(dp=1)
+        step, init = spmd.build_train_step(
+            model, lambda o, y: jnp.mean((o - y) ** 2), opt, mesh=mesh)
+        params, st = init()
+        x = np.random.RandomState(0).rand(8, 8).astype(np.float32)
+        y = np.random.RandomState(1).rand(8, 4).astype(np.float32)
+        for _ in range(2):
+            loss, params, st = step(params, st, x, y)
+        scales = [np.asarray(b) for name, b in model.named_buffers()
+                  if name.endswith("act_scale")]
+        assert scales, "quantized model should expose act_scale buffers"
+        assert all(s > 0 for s in scales), \
+            f"act_scale never calibrated under traced training: {scales}"
+
+
+class TestBatchNormStatsUnderTrace:
+    def test_running_stats_update_through_train_step(self):
+        import jax.numpy as jnp
+        from paddle_tpu.distributed import spmd, topology
+
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(6, 8), nn.BatchNorm1D(8))
+        model.train()
+        opt = optimizer.SGD(0.05, parameters=model.parameters())
+        mesh = topology.build_mesh(dp=1)
+        step, init = spmd.build_train_step(
+            model, lambda o, y: jnp.mean((o - y) ** 2), opt, mesh=mesh)
+        params, st = init()
+        x = (np.random.RandomState(0).rand(16, 6).astype(np.float32) * 3 + 5)
+        y = np.random.RandomState(1).rand(16, 8).astype(np.float32)
+        before = {n: np.array(b._value) for n, b in model.named_buffers()
+                  if n.endswith(("_mean", "_variance"))}
+        for _ in range(3):
+            loss, params, st = step(params, st, x, y)
+        after = {n: np.asarray(b._value) for n, b in model.named_buffers()
+                 if n.endswith(("_mean", "_variance"))}
+        assert before and any(
+            not np.allclose(before[n], after[n]) for n in before), \
+            "BatchNorm running stats froze under traced training"
+
+
+class TestWhileCondPyBool:
+    def test_constant_false_cond_under_trace(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.jit import dy2static
+
+        def run(x):
+            # cond independent of the carry -> _pred_value yields a
+            # python bool; old code died on `p.dtype`
+            out = dy2static.convert_while(
+                lambda i: False, lambda i: (i + 1,), (x,))
+            return out[0]
+
+        res = jax.jit(lambda v: run(v))(jnp.asarray(3.0))
+        val = res._value if hasattr(res, "_value") else res
+        assert float(val) == 3.0
+
+    def test_constant_true_cond_with_max_iters(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.jit import dy2static
+
+        def run(x):
+            out = dy2static.convert_while(
+                lambda i: True, lambda i: (i + 1,), (x,),
+                maximum_iterations=4)
+            return out[0]
+
+        res = jax.jit(lambda v: run(v))(jnp.asarray(1.0))
+        val = res._value if hasattr(res, "_value") else res
+        assert float(val) == 5.0

@@ -1,0 +1,152 @@
+"""Comm-efficiency meta-optimizers: DGC / LocalSGD / FP16AllReduce
+(reference: fleet/meta_optimizers/dgc_optimizer.py + operators/dgc_op.cc,
+localsgd_optimizer.py, fp16_allreduce_optimizer.py). Convergence-parity
+tests on the virtual 8-device CPU mesh, per VERDICT r2 #6."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import spmd, topology, comm_opt
+from paddle_tpu.distributed.fleet import DistributedStrategy
+
+
+@pytest.fixture
+def mesh4():
+    mesh = topology.build_mesh(dp=4)
+    topology.set_global_mesh(mesh)
+    return mesh
+
+
+def _data():
+    x = np.random.RandomState(0).rand(16, 8).astype(np.float32)
+    y = np.random.RandomState(1).rand(16, 4).astype(np.float32)
+    return x, y
+
+
+def _train(mesh, steps=12, **kw):
+    import jax.numpy as jnp
+
+    paddle.seed(7)
+    m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    opt = optimizer.SGD(0.2, parameters=m.parameters())
+    step, init = spmd.build_train_step(
+        m, lambda o, t: jnp.mean((o - t) ** 2), opt, mesh=mesh, **kw)
+    params, st = init()
+    x, y = _data()
+    xg, yg = spmd.shard_batch(x, mesh), spmd.shard_batch(y, mesh)
+    losses = []
+    for _ in range(steps):
+        loss, params, st = step(params, st, xg, yg)
+        losses.append(float(loss))
+    return losses, params, st, m
+
+
+class TestFP16AllReduce:
+    def test_tracks_fp32_baseline(self, mesh4):
+        base, *_ = _train(mesh4)
+        fp16, *_ = _train(mesh4, fp16_allreduce=True)
+        # fp16 rounding of the summed grads only — trajectories stay close
+        np.testing.assert_allclose(fp16, base, rtol=0.02, atol=1e-3)
+
+    def test_strategy_knob_consumed(self, mesh4):
+        s = DistributedStrategy()
+        s.fp16_allreduce = True
+        losses, *_ = _train(mesh4, strategy=s)
+        assert losses[-1] < losses[0] * 0.5
+
+
+class TestDGC:
+    def test_converges_with_sparsity(self, mesh4):
+        base, *_ = _train(mesh4, steps=20)
+        dgc, _, st, _ = _train(mesh4, steps=20,
+                               dgc_configs={"sparsity": [0.8],
+                                            "momentum": 0.9})
+        assert dgc[-1] < base[0] * 0.5, dgc[::5]
+
+    def test_error_feedback_state_threads(self, mesh4):
+        _, _, st, _ = _train(mesh4, steps=3,
+                             dgc_configs={"sparsity": [0.9]})
+        assert "__comm__" in st
+        u, v = next(iter(st["__comm__"].values()))
+        assert u.shape[0] == 4  # per-worker leading axis
+        # error accumulator must be non-zero (residuals held back)
+        assert float(np.abs(np.asarray(v)).sum()) > 0
+
+    def test_sparsify_masks_topk(self):
+        import jax.numpy as jnp
+
+        g = jnp.asarray(np.array([0.1, -5.0, 0.2, 3.0], np.float32))
+        u = jnp.zeros(4)
+        v = jnp.zeros(4)
+        send, nu, nv = comm_opt.dgc_sparsify(g, u, v, momentum=0.9,
+                                             sparsity=0.5)
+        sent = np.asarray(send)
+        # top-2 by |v| are -5 and 3; the rest stay in the accumulator
+        np.testing.assert_allclose(sent, [0.0, -5.0, 0.0, 3.0])
+        np.testing.assert_allclose(np.asarray(nv), [0.1, 0.0, 0.2, 0.0])
+        np.testing.assert_allclose(np.asarray(nu), [0.1, 0.0, 0.2, 0.0])
+
+    def test_rejects_zero2(self, mesh4):
+        import jax.numpy as jnp
+
+        paddle.seed(7)
+        m = nn.Sequential(nn.Linear(8, 4))
+        opt = optimizer.SGD(0.1, parameters=m.parameters())
+        with pytest.raises(NotImplementedError):
+            spmd.build_train_step(m, lambda o, t: jnp.mean(o), opt,
+                                  mesh=mesh4, sharding_stage=2,
+                                  dgc_configs={"sparsity": [0.9]})
+
+
+class TestLocalSGD:
+    def test_converges_and_averages(self, mesh4):
+        import jax.numpy as jnp
+
+        s = DistributedStrategy()
+        s.localsgd = True
+        s.localsgd_configs = {"k_steps": 4}
+        losses, params, _, m = _train(mesh4, strategy=s)
+        assert losses[-1] < losses[0] * 0.5, losses[::4]
+        # params carry the per-worker leading axis
+        first = next(iter(params.values()))
+        assert first.shape[0] == 4
+        avg = comm_opt.average_params(params, m)
+        assert next(iter(avg.values())).shape == first.shape[1:]
+        # layer got the averaged weights written back
+        pname, pval = next(iter(avg.items()))
+        got = dict(m.named_parameters())[pname]._value
+        np.testing.assert_allclose(np.asarray(got), np.asarray(pval))
+
+    def test_sync_at_k_makes_replicas_equal(self, mesh4):
+        s = DistributedStrategy()
+        s.localsgd = True
+        s.localsgd_configs = {"k_steps": 3}
+        # 3 steps = exactly one sync boundary -> replicas identical
+        _, params, _, _ = _train(mesh4, steps=3, strategy=s)
+        for n, p in params.items():
+            arr = np.asarray(p)
+            for d in range(1, arr.shape[0]):
+                np.testing.assert_allclose(arr[d], arr[0], rtol=1e-6,
+                                           err_msg=n)
+
+    def test_replicas_diverge_between_syncs(self, mesh4):
+        s = DistributedStrategy()
+        s.localsgd = True
+        s.localsgd_configs = {"k_steps": 4}
+        _, params, _, _ = _train(mesh4, steps=2, strategy=s)
+        diverged = any(
+            not np.allclose(np.asarray(p)[1], np.asarray(p)[0])
+            for p in params.values())
+        assert diverged, "local replicas should differ before the sync step"
+
+    def test_adaptive_raises(self, mesh4):
+        import jax.numpy as jnp
+
+        s = DistributedStrategy()
+        s.adaptive_localsgd = True
+        m = nn.Sequential(nn.Linear(8, 4))
+        opt = optimizer.SGD(0.1, parameters=m.parameters())
+        with pytest.raises(NotImplementedError):
+            spmd.build_train_step(m, lambda o, t: jnp.mean(o), opt,
+                                  mesh=mesh4, strategy=s)

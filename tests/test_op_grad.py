@@ -1,0 +1,191 @@
+"""Gradient sweep over the op zoo via the OpTest harness (reference:
+op_test.py check_grad swept across operator unit tests; VERDICT r2 #5
+asks for >=50 ops). Inputs are chosen away from non-differentiable kinks
+(|x|, relu, max ties), mirroring the reference's op-specific test data.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+from paddle_tpu import tensor as pt
+
+from op_test import check_grad
+
+R = np.random.RandomState
+
+
+def a(shape, seed=0, lo=-1.0, hi=1.0):
+    return (R(seed).rand(*shape) * (hi - lo) + lo).astype(np.float32)
+
+
+def pos(shape, seed=0, lo=0.2, hi=2.0):
+    return a(shape, seed, lo, hi)
+
+
+# (id, fn, inputs, kwargs for check_grad)
+OPS = [
+    # ---- elementwise math
+    ("add", lambda x, y: x + y, [a((2, 3)), a((2, 3), 1)], {}),
+    ("subtract", lambda x, y: x - y, [a((2, 3)), a((2, 3), 1)], {}),
+    ("multiply", lambda x, y: x * y, [a((2, 3)), a((2, 3), 1)], {}),
+    ("divide", lambda x, y: x / y, [a((2, 3)), pos((2, 3), 1)], {}),
+    ("pow", lambda x: x ** 3, [a((2, 3))], {}),
+    ("exp", lambda x: paddle.exp(x), [a((2, 3))], {}),
+    ("log", lambda x: paddle.log(x), [pos((2, 3))], {}),
+    ("sqrt", lambda x: paddle.sqrt(x), [pos((2, 3))], {}),
+    ("rsqrt", lambda x: paddle.rsqrt(x), [pos((2, 3))], {}),
+    ("tanh", lambda x: paddle.tanh(x), [a((2, 3))], {}),
+    ("sigmoid", lambda x: F.sigmoid(x), [a((2, 3))], {}),
+    ("sin", lambda x: paddle.sin(x), [a((2, 3))], {}),
+    ("cos", lambda x: paddle.cos(x), [a((2, 3))], {}),
+    ("square", lambda x: paddle.square(x), [a((2, 3))], {}),
+    ("reciprocal", lambda x: paddle.reciprocal(x), [pos((2, 3))], {}),
+    ("clip", lambda x: pt.clip(x, -0.5, 0.5),
+     [a((3, 4)) * 2 + 0.03], {}),
+    ("lerp", lambda x, y, w: pt.lerp(x, y, w),
+     [a((2, 3)), a((2, 3), 1), pos((2, 3), 2, 0.1, 0.9)], {}),
+    ("scale", lambda x: pt.scale(x, 2.5, bias=0.5), [a((2, 3))], {}),
+    ("cumsum", lambda x: pt.cumsum(x, axis=1), [a((2, 4))], {}),
+    ("cumprod", lambda x: pt.cumprod(x, dim=1), [pos((2, 4))], {}),
+    ("maximum", lambda x, y: paddle.maximum(x, y),
+     [a((2, 3)), a((2, 3), 1) + 0.013], {}),
+    ("minimum", lambda x, y: paddle.minimum(x, y),
+     [a((2, 3)), a((2, 3), 1) + 0.013], {}),
+    # ---- reductions
+    ("sum", lambda x: pt.sum(x, axis=1), [a((3, 4))], {}),
+    ("mean", lambda x: paddle.mean(x, axis=0), [a((3, 4))], {}),
+    # distinct-valued data: FD at argmax/argmin ties is meaningless
+    ("max_reduce", lambda x: paddle.max(x, axis=1),
+     [np.arange(12, dtype=np.float32).reshape(3, 4)[:, ::-1] * 0.37 - 2.1],
+     {}),
+    ("min_reduce", lambda x: paddle.min(x, axis=1),
+     [np.arange(12, dtype=np.float32).reshape(3, 4) * 0.41 - 2.3], {}),
+    ("prod", lambda x: paddle.prod(x, axis=1), [pos((3, 3))], {}),
+    ("logsumexp", lambda x: paddle.logsumexp(x, axis=1), [a((3, 4))], {}),
+    ("std", lambda x: pt.std(x, axis=1), [a((3, 4))], {}),
+    ("var", lambda x: pt.var(x, axis=1), [a((3, 4))], {}),
+    # ---- linalg
+    ("matmul", lambda x, y: pt.matmul(x, y), [a((2, 3)), a((3, 4), 1)], {}),
+    ("matmul_t", lambda x, y: pt.matmul(x, y, transpose_y=True),
+     [a((2, 3)), a((4, 3), 1)], {}),
+    ("bmm", lambda x, y: pt.bmm(x, y), [a((2, 2, 3)), a((2, 3, 2), 1)], {}),
+    ("dot", lambda x, y: pt.dot(x, y), [a((4,)), a((4,), 1)], {}),
+    ("norm", lambda x: pt.norm(x, p=2), [a((3, 4))], {}),
+    ("trace", lambda x: pt.trace(x), [a((3, 3))], {}),
+    ("addmm", lambda x, y, z: pt.addmm(x, y, z),
+     [a((2, 4)), a((2, 3), 1), a((3, 4), 2)], {}),
+    ("cross", lambda x, y: pt.cross(x, y), [a((2, 3)), a((2, 3), 1)], {}),
+    # ---- manipulation
+    ("reshape", lambda x: pt.reshape(x, [3, 2]), [a((2, 3))], {}),
+    ("transpose", lambda x: pt.transpose(x, [1, 0]), [a((2, 3))], {}),
+    ("concat", lambda x, y: pt.concat([x, y], axis=1),
+     [a((2, 3)), a((2, 2), 1)], {}),
+    ("stack", lambda x, y: pt.stack([x, y], axis=0),
+     [a((2, 3)), a((2, 3), 1)], {}),
+    ("split", lambda x: pt.split(x, 2, axis=1)[0], [a((2, 4))], {}),
+    ("squeeze", lambda x: pt.squeeze(x, axis=1), [a((2, 1, 3))], {}),
+    ("unsqueeze", lambda x: pt.unsqueeze(x, axis=1), [a((2, 3))], {}),
+    ("flatten", lambda x: pt.flatten(x), [a((2, 3))], {}),
+    ("tile", lambda x: pt.tile(x, [2, 1]), [a((2, 3))], {}),
+    ("flip", lambda x: pt.flip(x, axis=[1]), [a((2, 3))], {}),
+    ("roll", lambda x: pt.roll(x, 1, axis=1), [a((2, 3))], {}),
+    ("pad", lambda x: pt.pad(x, [1, 1, 0, 2]), [a((2, 3))], {}),
+    ("gather", lambda x: pt.gather(x, paddle.to_tensor(
+        np.array([0, 2], np.int32)), axis=0), [a((3, 4))], {}),
+    ("index_select", lambda x: pt.index_select(x, paddle.to_tensor(
+        np.array([1, 0], np.int32)), axis=1), [a((3, 3))], {}),
+    ("slice", lambda x: x[:, 1:3], [a((3, 4))], {}),
+    ("masked_fill", lambda x: pt.masked_fill(
+        x, paddle.to_tensor(np.array([[True, False, True]] * 2)), 0.0),
+     [a((2, 3))], {}),
+    ("take_along_axis", lambda x: pt.take_along_axis(
+        x, paddle.to_tensor(np.array([[0], [1]], np.int32)), axis=1),
+     [a((2, 3))], {}),
+    # ---- activations
+    ("relu", lambda x: F.relu(x), [a((3, 4)) + 0.011], {}),
+    ("gelu", lambda x: F.gelu(x), [a((3, 4))], {}),
+    ("leaky_relu", lambda x: F.leaky_relu(x), [a((3, 4)) + 0.011], {}),
+    ("elu", lambda x: F.elu(x), [a((3, 4)) + 0.011], {}),
+    ("selu", lambda x: F.selu(x), [a((3, 4)) + 0.011], {}),
+    ("softplus", lambda x: F.softplus(x), [a((3, 4))], {}),
+    ("hardswish", lambda x: F.hardswish(x), [a((3, 4)) * 2 + 0.017], {}),
+    ("silu", lambda x: F.silu(x), [a((3, 4))], {}),
+    ("softmax", lambda x: F.softmax(x, axis=-1), [a((3, 4))], {}),
+    ("log_softmax", lambda x: F.log_softmax(x, axis=-1), [a((3, 4))], {}),
+    ("glu", lambda x: F.glu(x, axis=-1), [a((3, 4))], {}),
+    # ---- nn layers / losses
+    ("linear", lambda x, w, b: F.linear(x, w, b),
+     [a((2, 3)), a((3, 4), 1), a((4,), 2)], {}),
+    ("embedding_w", lambda w: F.embedding(paddle.to_tensor(
+        np.array([[0, 2], [1, 1]], np.int64)), w), [a((4, 3))], {}),
+    ("conv2d", lambda x, w: F.conv2d(x, w, stride=1, padding=1),
+     [a((1, 2, 5, 5)), a((3, 2, 3, 3), 1)], {"eps": 2e-2, "rtol": 2e-2}),
+    ("conv1d", lambda x, w: F.conv1d(x, w, padding=1),
+     [a((1, 2, 6)), a((3, 2, 3), 1)], {"eps": 2e-2, "rtol": 2e-2}),
+    ("max_pool2d", lambda x: F.max_pool2d(x, kernel_size=2, stride=2),
+     [a((1, 2, 4, 4), lo=0.0, hi=4.0)], {}),
+    ("avg_pool2d", lambda x: F.avg_pool2d(x, kernel_size=2, stride=2),
+     [a((1, 2, 4, 4))], {}),
+    ("adaptive_avg_pool2d", lambda x: F.adaptive_avg_pool2d(x, 2),
+     [a((1, 2, 4, 4))], {}),
+    ("layer_norm", lambda x, w, b: F.layer_norm(x, 4, w, b),
+     [a((3, 4)), pos((4,), 1), a((4,), 2)], {"eps": 2e-2, "rtol": 2e-2}),
+    ("batch_norm_train",
+     lambda x, w, b: F.batch_norm(
+         x, paddle.to_tensor(np.zeros(4, np.float32), stop_gradient=True),
+         paddle.to_tensor(np.ones(4, np.float32), stop_gradient=True),
+         w, b, training=True),
+     [a((6, 4)), pos((4,), 1), a((4,), 2)], {"eps": 2e-2, "rtol": 2e-2}),
+    ("group_norm", lambda x, w, b: F.group_norm(x, 2, weight=w, bias=b),
+     [a((2, 4, 3, 3)), pos((4,), 1), a((4,), 2)],
+     {"eps": 2e-2, "rtol": 2e-2}),
+    ("mse_loss", lambda x, y: F.mse_loss(x, y),
+     [a((3, 4)), a((3, 4), 1)], {}),
+    ("l1_loss", lambda x, y: F.l1_loss(x, y),
+     [a((3, 4)), a((3, 4), 1) + 0.017], {}),
+    ("smooth_l1", lambda x, y: F.smooth_l1_loss(x, y),
+     [a((3, 4)), a((3, 4), 1)], {}),
+    ("bce_logits", lambda x, y: F.binary_cross_entropy_with_logits(x, y),
+     [a((3, 4)), None], {"wrt": [0]}),
+    ("kl_div", lambda x, y: F.kl_div(F.log_softmax(x, axis=-1),
+                                     F.softmax(y, axis=-1)),
+     [a((3, 4)), a((3, 4), 1)], {}),
+    ("cross_entropy", lambda x: F.cross_entropy(
+        x, paddle.to_tensor(np.array([0, 2, 1], np.int64))),
+     [a((3, 4))], {}),
+    ("nll_loss", lambda x: F.nll_loss(F.log_softmax(x, axis=-1),
+                                      paddle.to_tensor(
+                                          np.array([0, 2], np.int64))),
+     [a((2, 4))], {}),
+    ("cosine_similarity", lambda x, y: F.cosine_similarity(x, y),
+     [pos((2, 4)), pos((2, 4), 1)], {}),
+    ("sdpa", lambda q, k, v: F.scaled_dot_product_attention(q, k, v),
+     [a((1, 3, 2, 4)), a((1, 3, 2, 4), 1), a((1, 3, 2, 4), 2)],
+     {"eps": 2e-2, "rtol": 2e-2}),
+    ("interpolate", lambda x: F.interpolate(x, scale_factor=2,
+                                            mode="nearest"),
+     [a((1, 2, 3, 3))], {}),
+    ("normalize", lambda x: F.normalize(x, axis=-1), [pos((3, 4))], {}),
+    ("one_hot_matmul", lambda w: pt.matmul(paddle.to_tensor(
+        np.eye(3, dtype=np.float32), stop_gradient=True), w),
+     [a((3, 4))], {}),
+]
+
+# bce_logits target is data, not a grad input — fill it here
+for i, (name, fn, inputs, kw) in enumerate(OPS):
+    if name == "bce_logits":
+        OPS[i] = (name, fn,
+                  [inputs[0], R(3).randint(0, 2, (3, 4)).astype(np.float32)],
+                  kw)
+
+
+@pytest.mark.parametrize("name,fn,inputs,kw", OPS,
+                         ids=[o[0] for o in OPS])
+def test_op_grad(name, fn, inputs, kw):
+    check_grad(fn, inputs, name=name, **kw)
+
+
+def test_sweep_covers_50_ops():
+    assert len(OPS) >= 50, len(OPS)
