@@ -298,6 +298,12 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     """reference: collective.py (alltoall). Rank j's out[i] = rank i's
     in[j]. With replicated single-process ranks every peer holds the same
     list, so out[i] = in[my_rank] for all i."""
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "eager list-form all_to_all is single-process only (each "
+            "process would need its peers' lists); use alltoall_single "
+            "on a sharded array, or jax.lax.all_to_all inside a "
+            "compiled step")
     rank = get_rank_in(group)
     axis = _axis_of(group)
     mesh = topology.get_global_mesh()
@@ -343,8 +349,17 @@ def _eager_alltoall_single(axis, mesh_id, ndim):
 _P2P_MAILBOX = {}
 
 
+def _require_single_process(what):
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            f"eager {what} pairs through a process-local mailbox and "
+            f"cannot cross process boundaries; use ppermute inside a "
+            f"compiled step (distributed/pipeline.py) for real P2P")
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
     """reference: collective.py:1253 / send_v2 op (see P2P note above)."""
+    _require_single_process("send()")
     key = (_axis_of(group), get_rank_in(group), dst)
     _P2P_MAILBOX.setdefault(key, []).append(tensor._value)
     return tensor
@@ -352,6 +367,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
 
 def recv(tensor, src=0, group=None, sync_op=True):
     """reference: collective.py:1302 / recv_v2 op (see P2P note above)."""
+    _require_single_process("recv()")
     key = (_axis_of(group), src, get_rank_in(group))
     box = _P2P_MAILBOX.get(key)
     if box:

@@ -1,0 +1,74 @@
+"""Dataset-driven multithreaded trainer loop — the MultiTrainer /
+HogwildWorker analog (reference: paddle/fluid/framework/trainer.h:52
+MultiTrainer, device_worker.h:150 HogwildWorker; wired by
+executor.train_from_dataset).
+
+Workers share the model parameters lock-free (hogwild): each thread
+pulls a batch from the shared dataset channel, runs fwd/bwd eagerly and
+applies the optimizer. Sparse lookups hit the (thread-safe, sharded)
+native PS tables exactly like DownpourWorker's pull/push. The python
+threads interleave on the GIL but the heavy array ops release it, which
+is the same coarse parallelism profile as the reference's CPU hogwild
+trainer.
+"""
+import queue
+import threading
+
+
+class HogwildWorker(threading.Thread):
+    def __init__(self, wid, batch_q, train_one, results):
+        super().__init__(daemon=True, name=f"hogwild-{wid}")
+        self.wid = wid
+        self._q = batch_q
+        self._train_one = train_one
+        self._results = results
+        self.exc = None
+
+    def run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            try:
+                loss = self._train_one(*item)
+                self._results.append(float(loss))
+            except Exception as e:  # noqa: BLE001 - surfaced by join
+                self.exc = e
+                return
+
+
+class MultiTrainer:
+    """train_from_dataset over N hogwild workers.
+
+    train_one(*batch) -> scalar loss runs one optimization step; it must
+    be safe under concurrent calls (eager steps on a shared model are:
+    parameter reads/writes are whole-array swaps)."""
+
+    def __init__(self, train_one, num_threads=2, queue_capacity=64):
+        self.train_one = train_one
+        self.num_threads = max(1, int(num_threads))
+        self.queue_capacity = queue_capacity
+
+    def train_from_dataset(self, dataset):
+        """Iterate the fleet Dataset once, dispatching batches to the
+        worker pool; returns the per-batch losses (completion order)."""
+        batch_q = queue.Queue(maxsize=self.queue_capacity)
+        results = []
+        workers = [HogwildWorker(i, batch_q, self.train_one, results)
+                   for i in range(self.num_threads)]
+        for w in workers:
+            w.start()
+        try:
+            for batch in dataset:
+                if not isinstance(batch, tuple):
+                    batch = (batch,)
+                batch_q.put(batch)
+        finally:
+            for _ in workers:
+                batch_q.put(None)
+            for w in workers:
+                w.join()
+        for w in workers:
+            if w.exc is not None:
+                raise w.exc
+        return results

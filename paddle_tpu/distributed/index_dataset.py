@@ -1,0 +1,98 @@
+"""Tree index for tree-based retrieval models (TDM-style).
+
+Reference: paddle/fluid/distributed/index_dataset/ (index_wrapper.h
+TreeIndex: items live at the leaves of a complete b-ary tree; training
+samples per-layer positives along the item's root path plus random
+same-layer negatives). The structure here is a dense complete tree over
+numpy — the per-layer code arithmetic replaces the reference's protobuf
+node store.
+"""
+import numpy as np
+
+
+class TreeIndex:
+    """Complete b-ary tree over a set of item ids.
+
+    Node codes are heap-style: root = 0, children of c are
+    c*branch+1 .. c*branch+branch. Leaves hold items (padded leaves get
+    id -1)."""
+
+    def __init__(self, item_ids, branch=2):
+        self.branch = int(branch)
+        items = np.asarray(sorted(set(int(i) for i in item_ids)), np.int64)
+        if items.size == 0:
+            raise ValueError("TreeIndex needs at least one item")
+        self.height = 0  # layers below the root
+        while self.branch ** self.height < items.size:
+            self.height += 1
+        n_leaves = self.branch ** self.height
+        self.leaf_codes_start = (self.branch ** self.height - 1) // \
+            (self.branch - 1) if self.branch > 1 else self.height
+        leaves = np.full(n_leaves, -1, np.int64)
+        leaves[:items.size] = items
+        self._leaf_items = leaves
+        self._item_to_leaf = {int(it): self.leaf_codes_start + i
+                              for i, it in enumerate(items)}
+
+    # ------------------------------------------------------------- lookup
+    def total_layers(self):
+        return self.height + 1
+
+    def layer_codes(self, layer):
+        """All node codes at `layer` (0 = root)."""
+        if not 0 <= layer <= self.height:
+            raise ValueError(f"layer {layer} out of range")
+        b = self.branch
+        start = (b ** layer - 1) // (b - 1)
+        return np.arange(start, start + b ** layer, dtype=np.int64)
+
+    def travel_codes(self, item):
+        """Root-to-leaf path codes for an item (reference
+        get_travel_codes), leaf first like the reference."""
+        code = self._item_to_leaf[int(item)]
+        path = []
+        while True:
+            path.append(code)
+            if code == 0:
+                break
+            code = (code - 1) // self.branch
+        return np.asarray(path, np.int64)
+
+    def ancestor_code(self, item, layer):
+        """The item's ancestor at `layer`."""
+        path = self.travel_codes(item)[::-1]  # root..leaf
+        return int(path[layer])
+
+    def children_codes(self, code):
+        b = self.branch
+        first = code * b + 1
+        return np.arange(first, first + b, dtype=np.int64)
+
+    def leaf_item(self, code):
+        idx = code - self.leaf_codes_start
+        if not 0 <= idx < self._leaf_items.size:
+            raise ValueError(f"{code} is not a leaf code")
+        return int(self._leaf_items[idx])
+
+    # ------------------------------------------------------------ sampling
+    def sample_layer(self, items, n_negative, seed=0):
+        """Per-layer (positive, negatives) pairs for TDM training
+        (reference index_sampler.cc LayerWiseSampler): for each item and
+        each non-root layer, the positive is the item's ancestor and the
+        negatives are uniform other codes of that layer.
+
+        Returns list over layers 1..height of
+        (positives [n_items], negatives [n_items, n_negative])."""
+        rng = np.random.RandomState(seed)
+        out = []
+        for layer in range(1, self.height + 1):
+            codes = self.layer_codes(layer)
+            pos = np.asarray([self.ancestor_code(it, layer)
+                              for it in items], np.int64)
+            neg = np.empty((len(items), n_negative), np.int64)
+            for i, p in enumerate(pos):
+                pool = codes[codes != p]
+                neg[i] = rng.choice(pool, size=n_negative,
+                                    replace=pool.size < n_negative)
+            out.append((pos, neg))
+        return out

@@ -67,13 +67,23 @@ class SegmentLayers:
             return parts
         if self.method == "param":
             # weight boundaries by per-layer parameter count so stages
-            # carry comparable memory (SegmentLayers 'uniform' by weights)
+            # carry comparable memory (SegmentLayers 'uniform' by weights).
+            # LayerDesc entries are materialized ONE at a time and freed
+            # immediately — never the whole model at once (that is the
+            # situation pipeline segmentation exists to avoid).
             weights = []
             for d in self.layers_desc:
-                layer = d.build_layer() if hasattr(d, "build_layer") else d
+                if hasattr(d, "named_parameters"):
+                    layer = d
+                elif hasattr(d, "build_layer"):
+                    layer = d.build_layer()
+                else:
+                    layer = None
                 w = sum(int(np.prod(p.shape))
                         for _, p in layer.named_parameters()) \
-                    if hasattr(layer, "named_parameters") else 0
+                    if layer is not None else 0
+                if layer is not None and layer is not d:
+                    del layer  # free the transient build before the next
                 weights.append(max(w, 1))
             total = sum(weights)
             target = total / self.num_parts

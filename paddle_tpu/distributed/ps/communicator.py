@@ -46,22 +46,29 @@ class AsyncCommunicator:
                     np.asarray(ids, np.int64).ravel()))
 
     def flush(self):
-        """Block until every queued push has reached the PS."""
+        """Block until every queued push has reached the PS. Raises (and
+        clears) any error the sender thread hit, so a recovered PS can
+        keep being used."""
         if self.sync:
             return
         with self._cv:
             self._cv.wait_for(lambda: self._inflight == 0 and
                               self._q.empty())
         if self._exc:
-            raise self._exc
+            exc, self._exc = self._exc, None
+            raise exc
 
     def stop(self):
+        """Shut the sender thread down unconditionally (even when a push
+        failed), then surface any pending error once."""
         if self._thread is not None:
-            self.flush()
             self._stop.set()
             self._q.put(None)
             self._thread.join()
             self._thread = None
+        if self._exc:
+            exc, self._exc = self._exc, None
+            raise exc
 
     # ------------------------------------------------------------- internals
     def _send(self, item):
@@ -162,8 +169,10 @@ class CommunicatorClient:
         return self._client.save(idx, path)
 
     def close(self):
-        self.comm.stop()
-        self._client.close()
+        try:
+            self.comm.stop()
+        finally:
+            self._client.close()
 
 
 class GeoCommunicator:

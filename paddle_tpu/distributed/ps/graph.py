@@ -1,0 +1,72 @@
+"""GraphTable — GNN graph storage + neighbor sampling over the native
+core (reference: paddle/fluid/distributed/table/common_graph_table.cc
+behind fleet's graph service; SURVEY §2.6 graph tables row)."""
+import numpy as np
+
+from ... import native
+
+
+class GraphTable:
+    """Directed weighted graph with per-node features; sampling feeds
+    GraphSAGE-style minibatch GNN training (ids stay host-side, the
+    gathered features enter the XLA program as dense arrays)."""
+
+    def __init__(self, feat_dim=0):
+        self.lib = native.get_lib()
+        self.feat_dim = int(feat_dim)
+        self.handle = self.lib.pt_graph_create(self.feat_dim)
+
+    def add_edges(self, src, dst, weight=None):
+        src = np.ascontiguousarray(src, np.int64).ravel()
+        dst = np.ascontiguousarray(dst, np.int64).ravel()
+        assert src.size == dst.size
+        if weight is not None:
+            weight = np.ascontiguousarray(weight, np.float32).ravel()
+            wptr = native.f32_ptr(weight)
+        else:
+            wptr = None
+        rc = self.lib.pt_graph_add_edges(self.handle, native.i64_ptr(src),
+                                         native.i64_ptr(dst), wptr,
+                                         src.size)
+        assert rc == 0
+
+    def degree(self, node):
+        return int(self.lib.pt_graph_degree(self.handle, int(node)))
+
+    def num_nodes(self):
+        return int(self.lib.pt_graph_num_nodes(self.handle))
+
+    def sample_neighbors(self, ids, k, seed=0, weighted=False):
+        """-> (neighbors [n, k] int64 (-1 pads), counts [n] int64)."""
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        out = np.empty((ids.size, k), np.int64)
+        counts = np.empty(ids.size, np.int64)
+        rc = self.lib.pt_graph_sample_neighbors(
+            self.handle, native.i64_ptr(ids), ids.size, int(k), int(seed),
+            1 if weighted else 0, native.i64_ptr(out.reshape(-1)),
+            native.i64_ptr(counts))
+        assert rc == 0
+        return out, counts
+
+    def set_node_feat(self, ids, feats):
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        feats = np.ascontiguousarray(feats, np.float32)\
+            .reshape(ids.size, self.feat_dim)
+        rc = self.lib.pt_graph_set_node_feat(
+            self.handle, native.i64_ptr(ids), ids.size,
+            native.f32_ptr(feats.reshape(-1)))
+        assert rc == 0
+
+    def get_node_feat(self, ids):
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        out = np.zeros((ids.size, self.feat_dim), np.float32)
+        rc = self.lib.pt_graph_get_node_feat(
+            self.handle, native.i64_ptr(ids), ids.size,
+            native.f32_ptr(out.reshape(-1)))
+        assert rc == 0
+        return out
+
+    def close(self):
+        if self.handle is not None:
+            self.lib.pt_graph_destroy(self.handle)
+            self.handle = None

@@ -57,7 +57,7 @@ def _zero1_spec(arr, mesh, axes=("dp", "sharding")):
 
 
 def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
-                     shard_optimizer=False, sharding_stage=None, donate=False,
+                     shard_optimizer=False, sharding_stage=None, donate=True,
                      amp_level="O0", amp_dtype="bfloat16",
                      fp16_allreduce=False, dgc_configs=None, strategy=None,
                      offload=False):
@@ -95,6 +95,16 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
             unsupported = [k for k in ("recompute", "dgc", "fp16_allreduce",
                                        "sharding")
                            if getattr(strategy, k)]
+            if recompute:
+                unsupported.append("recompute=True")
+            if fp16_allreduce:
+                unsupported.append("fp16_allreduce=True")
+            if dgc_configs is not None:
+                unsupported.append("dgc_configs")
+            if offload:
+                unsupported.append("offload=True")
+            if sharding_stage:
+                unsupported.append(f"sharding_stage={sharding_stage}")
             if unsupported:
                 raise NotImplementedError(
                     f"localsgd does not compose with {unsupported}; "
@@ -339,6 +349,15 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
             lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
         if offload:
             opt_state = _bounce(opt_state, "device")
+        if buffer_names:
+            # pick up buffers loaded onto the layer since the last step
+            # (set_state_dict from a checkpoint etc.) — the cell only
+            # tracks values this step_fn wrote itself
+            _, live = layer.functional_state()
+            cur = buffers_cell["cur"]
+            if any(live.get(n) is not cur.get(n) for n in buffer_names):
+                buffers_cell["cur"] = {n: jnp.asarray(live[n])
+                                       for n in buffer_names}
         loss, new_params, new_state, new_buffers = step_jit(
             params, opt_state, buffers_cell["cur"], x, y, key, lr)
         if offload:

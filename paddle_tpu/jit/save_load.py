@@ -76,10 +76,13 @@ def write_artifacts(path, jitted_fn, state_specs, input_specs, params, buffers):
     static.save_inference_model. ``jitted_fn(params_like, buffers_like,
     *inputs)``; state_specs = (param_specs, buffer_specs)."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    from ..framework import op_version
+
     payload = {
         "params": params,
         "buffers": buffers,
         "input_specs": [(list(s.shape), str(s.dtype)) for s in input_specs],
+        "op_versions": op_version.all_op_versions(),
     }
     try:
         from jax import export as jax_export
@@ -135,6 +138,9 @@ def load(path, **configs):
     """paddle.jit.load — rebuild a callable Layer from the exported module."""
     with open(path + ".pdiparams", "rb") as f:
         payload = pickle.load(f)
+    from ..framework import op_version
+
+    op_version.check_compat(payload.get("op_versions"), where=path)
     params = payload["params"]
     buffers = payload["buffers"]
     if payload.get("format") == "stablehlo" and os.path.exists(path + ".pdmodel"):

@@ -12,7 +12,8 @@ import subprocess
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["ps_core.cc", "ps_service.cc", "data_feed.cc"]
+_SOURCES = ["ps_core.cc", "ps_service.cc", "data_feed.cc",
+            "graph_table.cc"]
 _LOCK = threading.Lock()
 _LIB = None
 
@@ -104,6 +105,15 @@ def _declare(lib):
         "pt_dataset_set_batch_size": (i32, [i64, i32]),
         "pt_sparse_dim": (i64, [i64]),
         "pt_dataset_num_slots": (i32, [i64]),
+        "pt_graph_create": (i64, [i64]),
+        "pt_graph_destroy": (None, [i64]),
+        "pt_graph_add_edges": (i32, [i64, i64p, i64p, f32p, i64]),
+        "pt_graph_degree": (i64, [i64, i64]),
+        "pt_graph_sample_neighbors": (i32, [i64, i64p, i64, i64, u64, i32,
+                                            i64p, i64p]),
+        "pt_graph_set_node_feat": (i32, [i64, i64p, i64, f32p]),
+        "pt_graph_get_node_feat": (i32, [i64, i64p, i64, f32p]),
+        "pt_graph_num_nodes": (i64, [i64]),
     }
     for name, (res, args) in sig.items():
         fn = getattr(lib, name)

@@ -1,0 +1,193 @@
+// Graph table: in-memory directed graph with weighted edges + neighbor
+// sampling for GNN training/serving.
+//
+// Reference behaviors: paddle/fluid/distributed/table/common_graph_table.cc
+// (GraphTable::add_graph_node, random_sample_neighbors with weighted
+// sampling, get_node_feat) — rebuilt as a sharded adjacency store with
+// per-shard locks and alias-free weighted sampling (linear CDF walk per
+// sample; degrees are typically small in minibatch GNN sampling).
+#include <algorithm>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <unordered_map>
+#include <vector>
+
+#include "native_api.h"
+
+namespace {
+
+struct GraphShard {
+  std::unordered_map<int64_t, std::vector<std::pair<int64_t, float>>> adj;
+  std::unordered_map<int64_t, std::vector<float>> feat;
+  mutable std::mutex mu;
+};
+
+constexpr int kGShards = 16;
+
+struct Graph {
+  GraphShard shards[kGShards];
+  int64_t feat_dim = 0;
+
+  GraphShard& shard_of(int64_t id) {
+    uint64_t x = (uint64_t)id;
+    x ^= x >> 33;
+    x *= 0xff51afd7ed558ccdULL;
+    x ^= x >> 33;
+    return shards[x % kGShards];
+  }
+};
+
+std::mutex g_mu;
+std::unordered_map<int64_t, Graph*> g_graphs;
+int64_t g_next = 1;
+
+Graph* get_graph(int64_t h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_graphs.find(h);
+  return it == g_graphs.end() ? nullptr : it->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t pt_graph_create(int64_t feat_dim) {
+  std::lock_guard<std::mutex> g(g_mu);
+  int64_t h = g_next++;
+  auto* gr = new Graph();
+  gr->feat_dim = feat_dim;
+  g_graphs[h] = gr;
+  return h;
+}
+
+void pt_graph_destroy(int64_t h) {
+  Graph* gr = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_graphs.find(h);
+    if (it == g_graphs.end()) return;
+    gr = it->second;
+    g_graphs.erase(it);
+  }
+  delete gr;
+}
+
+int pt_graph_add_edges(int64_t h, const int64_t* src, const int64_t* dst,
+                       const float* weight, int64_t n) {
+  Graph* gr = get_graph(h);
+  if (!gr) return -1;
+  for (int64_t i = 0; i < n; i++) {
+    GraphShard& sh = gr->shard_of(src[i]);
+    std::lock_guard<std::mutex> lock(sh.mu);
+    sh.adj[src[i]].emplace_back(dst[i], weight ? weight[i] : 1.f);
+  }
+  return 0;
+}
+
+int64_t pt_graph_degree(int64_t h, int64_t id) {
+  Graph* gr = get_graph(h);
+  if (!gr) return -1;
+  GraphShard& sh = gr->shard_of(id);
+  std::lock_guard<std::mutex> lock(sh.mu);
+  auto it = sh.adj.find(id);
+  return it == sh.adj.end() ? 0 : (int64_t)it->second.size();
+}
+
+// Sample up to k neighbors per query id. weighted!=0: probability
+// proportional to edge weight (with replacement); else uniform without
+// replacement when degree >= k. out_ids is [n*k]; absent slots = -1.
+// out_counts[i] = actual sample count for ids[i].
+int pt_graph_sample_neighbors(int64_t h, const int64_t* ids, int64_t n,
+                              int64_t k, uint64_t seed, int weighted,
+                              int64_t* out_ids, int64_t* out_counts) {
+  Graph* gr = get_graph(h);
+  if (!gr) return -1;
+  std::mt19937_64 rng(seed);
+  for (int64_t i = 0; i < n; i++) {
+    int64_t* row = out_ids + i * k;
+    for (int64_t j = 0; j < k; j++) row[j] = -1;
+    GraphShard& sh = gr->shard_of(ids[i]);
+    std::lock_guard<std::mutex> lock(sh.mu);
+    auto it = sh.adj.find(ids[i]);
+    if (it == sh.adj.end() || it->second.empty()) {
+      out_counts[i] = 0;
+      continue;
+    }
+    const auto& nbrs = it->second;
+    int64_t deg = (int64_t)nbrs.size();
+    if (weighted) {
+      double total = 0;
+      for (const auto& e : nbrs) total += e.second;
+      std::uniform_real_distribution<double> u(0.0, total);
+      for (int64_t j = 0; j < k; j++) {
+        double r = u(rng), acc = 0;
+        int64_t pick = deg - 1;
+        for (int64_t m = 0; m < deg; m++) {
+          acc += nbrs[m].second;
+          if (r <= acc) { pick = m; break; }
+        }
+        row[j] = nbrs[pick].first;
+      }
+      out_counts[i] = k;
+    } else if (deg <= k) {
+      for (int64_t m = 0; m < deg; m++) row[m] = nbrs[m].first;
+      out_counts[i] = deg;
+    } else {
+      // partial Fisher-Yates over an index vector
+      std::vector<int64_t> idx(deg);
+      for (int64_t m = 0; m < deg; m++) idx[m] = m;
+      for (int64_t j = 0; j < k; j++) {
+        std::uniform_int_distribution<int64_t> u(j, deg - 1);
+        std::swap(idx[j], idx[u(rng)]);
+        row[j] = nbrs[idx[j]].first;
+      }
+      out_counts[i] = k;
+    }
+  }
+  return 0;
+}
+
+int pt_graph_set_node_feat(int64_t h, const int64_t* ids, int64_t n,
+                           const float* feats) {
+  Graph* gr = get_graph(h);
+  if (!gr || gr->feat_dim <= 0) return -1;
+  for (int64_t i = 0; i < n; i++) {
+    GraphShard& sh = gr->shard_of(ids[i]);
+    std::lock_guard<std::mutex> lock(sh.mu);
+    auto& f = sh.feat[ids[i]];
+    f.assign(feats + i * gr->feat_dim, feats + (i + 1) * gr->feat_dim);
+  }
+  return 0;
+}
+
+int pt_graph_get_node_feat(int64_t h, const int64_t* ids, int64_t n,
+                           float* out) {
+  Graph* gr = get_graph(h);
+  if (!gr || gr->feat_dim <= 0) return -1;
+  for (int64_t i = 0; i < n; i++) {
+    GraphShard& sh = gr->shard_of(ids[i]);
+    std::lock_guard<std::mutex> lock(sh.mu);
+    auto it = sh.feat.find(ids[i]);
+    if (it == sh.feat.end()) {
+      std::memset(out + i * gr->feat_dim, 0, gr->feat_dim * sizeof(float));
+    } else {
+      std::memcpy(out + i * gr->feat_dim, it->second.data(),
+                  gr->feat_dim * sizeof(float));
+    }
+  }
+  return 0;
+}
+
+int64_t pt_graph_num_nodes(int64_t h) {
+  Graph* gr = get_graph(h);
+  if (!gr) return -1;
+  int64_t n = 0;
+  for (auto& sh : gr->shards) {
+    std::lock_guard<std::mutex> lock(sh.mu);
+    n += (int64_t)sh.adj.size();
+  }
+  return n;
+}
+
+}  // extern "C"

@@ -71,6 +71,21 @@ int pt_client_save(int64_t client, int table_idx, const char* path);
 // ---------------- data feed ----------------
 // slot-record dataset: text lines "label slot:sign slot:sign ..." or
 // configurable dense/sparse slots. Returns dataset handle.
+// graph table (GNN adjacency + features + neighbor sampling)
+int64_t pt_graph_create(int64_t feat_dim);
+void pt_graph_destroy(int64_t h);
+int pt_graph_add_edges(int64_t h, const int64_t* src, const int64_t* dst,
+                       const float* weight, int64_t n);
+int64_t pt_graph_degree(int64_t h, int64_t id);
+int pt_graph_sample_neighbors(int64_t h, const int64_t* ids, int64_t n,
+                              int64_t k, uint64_t seed, int weighted,
+                              int64_t* out_ids, int64_t* out_counts);
+int pt_graph_set_node_feat(int64_t h, const int64_t* ids, int64_t n,
+                           const float* feats);
+int pt_graph_get_node_feat(int64_t h, const int64_t* ids, int64_t n,
+                           float* out);
+int64_t pt_graph_num_nodes(int64_t h);
+
 int64_t pt_dataset_create(const char* slot_names_csv, int batch_size);
 void pt_dataset_destroy(int64_t ds);
 int pt_dataset_set_filelist(int64_t ds, const char* files_csv);

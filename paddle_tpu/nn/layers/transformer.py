@@ -10,7 +10,26 @@ from .container import LayerList
 
 
 def _convert_attention_mask(attn_mask, dtype=None):
-    return attn_mask
+    """bool/int masks -> additive float masks (reference:
+    python/paddle/nn/layer/transformer.py:90-105): True/nonzero keeps a
+    position, False/0 masks it with a large negative bias. Float masks
+    pass through (already additive)."""
+    if attn_mask is None:
+        return None
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ...core.tensor import Tensor
+
+    arr = attn_mask._value if isinstance(attn_mask, Tensor) else attn_mask
+    kind = jnp.result_type(arr)
+    if jnp.issubdtype(kind, jnp.floating):
+        return attn_mask
+    target = jnp.dtype(dtype) if dtype is not None else jnp.float32
+    additive = jnp.where(jnp.asarray(arr).astype(bool), 0.0, -1e9)\
+        .astype(target)
+    return Tensor(additive, stop_gradient=True) \
+        if isinstance(attn_mask, Tensor) else additive
 
 
 class MultiHeadAttention(Layer):
@@ -85,8 +104,8 @@ class MultiHeadAttention(Layer):
                 v = pt.concat([cache.v, v], axis=2)
                 cache = self.Cache(k, v)
         out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask, dropout_p=self.dropout,
-            training=self.training)
+            q, k, v, attn_mask=_convert_attention_mask(attn_mask),
+            dropout_p=self.dropout, training=self.training)
         out = self.out_proj(self._merge_heads(out))
         outs = [out]
         if self.need_weights:

@@ -25,8 +25,12 @@ def _sdpa_ref(q, k, v, mask, key, *, scale, dropout_p, is_causal):
         causal = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
         logits = jnp.where(causal, logits, jnp.finfo(logits.dtype).min)
     if mask is not None:
-        if mask.dtype == jnp.bool_:
-            logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+        if not jnp.issubdtype(mask.dtype, jnp.floating):
+            # bool/int keep-masks (reference converts via
+            # _convert_attention_mask; adding raw 0/1 ints would bias
+            # logits instead of masking)
+            logits = jnp.where(mask.astype(bool), logits,
+                               jnp.finfo(logits.dtype).min)
         else:
             logits = logits + mask
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)

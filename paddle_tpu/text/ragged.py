@@ -7,6 +7,7 @@ lengths) with mask-aware reductions; these helpers convert between the
 two and implement the sequence-op semantics the API surface needs.
 """
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..core.dispatch import apply_op
@@ -73,3 +74,99 @@ def attention_mask_from_lengths(lengths, maxlen):
         return m[:, None, None, :]
 
     return apply_op("attention_mask_from_lengths", _am, lengths, maxlen=int(maxlen))
+
+
+def sequence_reverse(x, lengths):
+    """Reverse each sequence within its valid length (reference:
+    operators/sequence_ops/sequence_reverse_op.cc over LoD; here dense
+    [B, T, ...] + lengths [B])."""
+    def _rev(x, lengths):
+        t = x.shape[1]
+        idx = jnp.arange(t)[None, :]                      # [1, T]
+        src = lengths[:, None] - 1 - idx                   # reversed pos
+        src = jnp.where(idx < lengths[:, None], src, idx)  # pad stays put
+        return jnp.take_along_axis(
+            x, src.reshape(src.shape + (1,) * (x.ndim - 2))
+                 .astype(jnp.int32), axis=1) \
+            if x.ndim > 2 else jnp.take_along_axis(x, src.astype(jnp.int32),
+                                                   axis=1)
+
+    return apply_op("sequence_reverse", _rev, x, lengths)
+
+
+def sequence_softmax(x, lengths):
+    """Masked softmax per sequence (reference:
+    sequence_ops/sequence_softmax_op.cc): padding positions get 0."""
+    def _ssm(x, lengths):
+        t = x.shape[1]
+        mask = jnp.arange(t)[None, :] < lengths[:, None]
+        logits = jnp.where(mask, x, -jnp.inf)
+        p = jax.nn.softmax(logits.astype(jnp.float32), axis=1)
+        return jnp.where(mask, p, 0.0).astype(x.dtype)
+
+    return apply_op("sequence_softmax", _ssm, x, lengths)
+
+
+def sequence_expand(x, lengths, ref_lengths):
+    """Repeat each row i of x ref_lengths[i] times along a new time axis,
+    padded to max(ref_lengths) (reference:
+    sequence_ops/sequence_expand_op.cc; dense analog of LoD expand)."""
+    def _exp(x, ref, *, maxlen):
+        idx = jnp.arange(maxlen)[None, :]
+        mask = idx < ref[:, None]
+        rep = jnp.repeat(x[:, None], maxlen, axis=1)
+        return rep * mask.reshape(mask.shape + (1,) * (x.ndim - 1))
+
+    maxlen = int(np.max(np.asarray(
+        ref_lengths._value if isinstance(ref_lengths, Tensor)
+        else ref_lengths)))
+    return apply_op("sequence_expand", _exp, x, ref_lengths, maxlen=maxlen)
+
+
+def sequence_concat(xs, lengths_list):
+    """Concatenate ragged sequences row-wise (reference:
+    sequence_ops/sequence_concat_op.cc): result row b holds
+    x1[b,:l1[b]] ++ x2[b,:l2[b]] ++ ..., padded to the max total."""
+    arrs = [x._value if isinstance(x, Tensor) else jnp.asarray(x)
+            for x in xs]
+    lens = [np.asarray(l._value if isinstance(l, Tensor) else l)
+            for l in lengths_list]
+    total = np.stack(lens).sum(0)
+    out_t = int(total.max())
+    b = arrs[0].shape[0]
+    feat = arrs[0].shape[2:] if arrs[0].ndim > 2 else ()
+    out = np.zeros((b, out_t) + feat, np.asarray(arrs[0]).dtype)
+    for bi in range(b):
+        pos = 0
+        for a, l in zip(arrs, lens):
+            n = int(l[bi])
+            out[bi, pos:pos + n] = np.asarray(a)[bi, :n]
+            pos += n
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(total.astype(
+        np.int32)))
+
+
+def sequence_pad(x_rows, lengths, maxlen=None, pad_value=0.0):
+    """Flat packed rows [sum(len), ...] + lengths -> dense [B, T, ...]
+    (reference: sequence_ops/sequence_pad_op.cc)."""
+    lens = np.asarray(lengths._value if isinstance(lengths, Tensor)
+                      else lengths).astype(np.int64)
+    arr = np.asarray(x_rows._value if isinstance(x_rows, Tensor)
+                     else x_rows)
+    t = int(maxlen or lens.max())
+    out = np.full((len(lens), t) + arr.shape[1:], pad_value, arr.dtype)
+    pos = 0
+    for i, n in enumerate(lens):
+        out[i, :n] = arr[pos:pos + int(n)]
+        pos += int(n)
+    return Tensor(jnp.asarray(out))
+
+
+def sequence_unpad(x, lengths):
+    """Dense [B, T, ...] + lengths -> flat packed rows (reference:
+    sequence_ops/sequence_unpad_op.cc)."""
+    lens = np.asarray(lengths._value if isinstance(lengths, Tensor)
+                      else lengths).astype(np.int64)
+    arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+    rows = [arr[i, :int(n)] for i, n in enumerate(lens)]
+    return Tensor(jnp.asarray(np.concatenate(rows, axis=0)))

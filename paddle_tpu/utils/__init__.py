@@ -1,6 +1,8 @@
 """paddle.utils (reference: python/paddle/utils/)."""
 from . import cpp_extension  # noqa: F401
+from . import dlpack  # noqa: F401
 from . import download  # noqa: F401
+from . import monitor  # noqa: F401
 from . import profiler  # noqa: F401
 from .lazy_import import try_import  # noqa: F401
 

@@ -1,0 +1,37 @@
+"""DLPack interop (reference: paddle/fluid/framework/dlpack_tensor.cc,
+python paddle.utils.dlpack): zero-copy exchange with torch/numpy/any
+DLPack consumer via jax's dlpack support."""
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def to_dlpack(tensor):
+    """Tensor -> DLPack capsule (dlpack_tensor.cc ToDLPack analog)."""
+    arr = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    return arr.__dlpack__()
+
+
+class _CapsuleHolder:
+    """Adapt a raw legacy capsule to the __dlpack__ protocol jax expects.
+    Raw capsules carry no device info, so this path is host/CPU-only
+    (matches the reference's from_dlpack host-tensor use)."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, **kwargs):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)  # kDLCPU
+
+
+def from_dlpack(capsule_or_ext):
+    """DLPack capsule / __dlpack__-bearing object -> Tensor (zero-copy
+    where the producer's device is visible to jax)."""
+    if not hasattr(capsule_or_ext, "__dlpack__"):
+        capsule_or_ext = _CapsuleHolder(capsule_or_ext)
+    arr = jnp.from_dlpack(capsule_or_ext)
+    return Tensor(arr, stop_gradient=True)

@@ -7,22 +7,67 @@ jax.profiler.TraceAnnotation (host) which the XLA runtime correlates with
 device timelines — CUPTI's role is played by the TPU runtime itself.
 """
 import contextlib
+import threading
+import time
 
 import jax
 
+# -------------------------------------------------- host span aggregation
+_SPANS = {}
+_SPANS_LOCK = threading.Lock()
+
 
 class RecordEvent:
-    """RAII span (reference: profiler.h:127)."""
+    """RAII span (reference: profiler.h:127): feeds the TraceAnnotation
+    (device-correlated XPlane span) AND the host-side aggregation that
+    backs ``summary()`` (the profiler.cc summary-table analog)."""
 
     def __init__(self, name):
+        self.name = name
         self._ann = jax.profiler.TraceAnnotation(name)
+        self._t0 = None
 
     def __enter__(self):
         self._ann.__enter__()
+        self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        with _SPANS_LOCK:
+            rec = _SPANS.setdefault(self.name,
+                                    [0, 0.0, 0.0, float("inf")])
+            rec[0] += 1
+            rec[1] += dt
+            rec[2] = max(rec[2], dt)
+            rec[3] = min(rec[3], dt)
         return self._ann.__exit__(*exc)
+
+
+def reset_summary():
+    with _SPANS_LOCK:
+        _SPANS.clear()
+
+
+def summary(sorted_by="total", printer=print):
+    """Aggregated span table (reference: profiler.cc PrintProfiler /
+    'sorted by total time'). Returns the rows; also prints a table."""
+    with _SPANS_LOCK:
+        rows = [{"name": n, "calls": c, "total": tot, "avg": tot / c,
+                 "max": mx, "min": mn}
+                for n, (c, tot, mx, mn) in _SPANS.items()]
+    key = {"total": "total", "calls": "calls", "avg": "avg",
+           "max": "max", "min": "min"}.get(sorted_by, "total")
+    rows.sort(key=lambda r: r[key], reverse=True)
+    if printer is not None and rows:
+        w = max(len(r["name"]) for r in rows)
+        printer(f"{'Event':<{w}}  {'Calls':>7} {'Total(s)':>10} "
+                f"{'Avg(s)':>10} {'Max(s)':>10} {'Min(s)':>10}")
+        for r in rows:
+            printer(f"{r['name']:<{w}}  {r['calls']:>7} "
+                    f"{r['total']:>10.6f} {r['avg']:>10.6f} "
+                    f"{r['max']:>10.6f} {r['min']:>10.6f}")
+    return rows
 
 
 @contextlib.contextmanager

@@ -1,6 +1,7 @@
 """Vision ops (reference: operators/detection/: yolo_box, roi_align, nms...).
 Round-1 subset: roi_align, nms, yolo helpers later."""
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..core.dispatch import apply_op, in_trace
@@ -79,3 +80,184 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
 
     return apply_op("roi_align", _roi_align, x, boxes, out_hw=tuple(output_size),
                     scale=float(spatial_scale), aligned=bool(aligned))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLOv3 head output to boxes+scores (reference:
+    operators/detection/yolo_box_op.cc). Pure jnp (traceable): returns
+    (boxes [N, H*W*A, 4] in xyxy image coords, scores [N, H*W*A, C]);
+    the conf_thresh zeroes low-confidence scores instead of filtering
+    (static shapes for XLA)."""
+    anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
+
+    def _yolo(x, img_size, *, an, cnum, conf, ds, clip, sxy):
+        an = jnp.asarray(an, jnp.float32)  # hashable tuple -> array
+        n, c, h, w = x.shape
+        a = an.shape[0]
+        x = x.reshape(n, a, cnum + 5, h, w)
+        gx = (jnp.arange(w, dtype=jnp.float32))[None, None, None, :]
+        gy = (jnp.arange(h, dtype=jnp.float32))[None, None, :, None]
+        sig = jax.nn.sigmoid
+        bx = (sig(x[:, :, 0]) * sxy - 0.5 * (sxy - 1) + gx) / w
+        by = (sig(x[:, :, 1]) * sxy - 0.5 * (sxy - 1) + gy) / h
+        bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / (w * ds)
+        bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / (h * ds)
+        obj = sig(x[:, :, 4])
+        cls = sig(x[:, :, 5:])
+        imh = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+        imw = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * imw
+        y1 = (by - bh / 2) * imh
+        x2 = (bx + bw / 2) * imw
+        y2 = (by + bh / 2) * imh
+        if clip:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
+        scores = (obj[..., None] * jnp.moveaxis(cls, 2, -1))
+        scores = jnp.where(obj[..., None] > conf, scores, 0.0)
+        return boxes, scores.reshape(n, -1, cnum)
+
+    return apply_op("yolo_box", _yolo, x, img_size,
+                    an=tuple(map(tuple, anchors.tolist())),
+                    cnum=int(class_num), conf=float(conf_thresh),
+                    ds=float(downsample_ratio), clip=bool(clip_bbox),
+                    sxy=float(scale_x_y))
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior (anchor) boxes (reference:
+    operators/detection/prior_box_op.cc). Returns (boxes [H, W, A, 4]
+    normalized xyxy, variances same shape)."""
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - e) > 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    min_sizes = [float(m) for m in np.atleast_1d(min_sizes)]
+    max_sizes = [float(m) for m in np.atleast_1d(max_sizes)] \
+        if max_sizes is not None else []
+
+    def _prior(feat, img, *, ars, mins, maxs, var, steps, offset, clip):
+        fh, fw = feat.shape[2], feat.shape[3]
+        ih, iw = img.shape[2], img.shape[3]
+        sw = steps[0] or iw / fw
+        sh = steps[1] or ih / fh
+        cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * sw
+        cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * sh
+        whs = []
+        for k, ms in enumerate(mins):
+            whs.append((ms, ms))
+            if k < len(maxs):
+                s = float(np.sqrt(ms * maxs[k]))
+                whs.append((s, s))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        wh = jnp.asarray(whs, jnp.float32)  # [A, 2]
+        cxg, cyg = jnp.meshgrid(cx, cy)     # [fh, fw]
+        x1 = (cxg[..., None] - wh[None, None, :, 0] / 2) / iw
+        y1 = (cyg[..., None] - wh[None, None, :, 1] / 2) / ih
+        x2 = (cxg[..., None] + wh[None, None, :, 0] / 2) / iw
+        y2 = (cyg[..., None] + wh[None, None, :, 1] / 2) / ih
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        variances = jnp.broadcast_to(jnp.asarray(var, jnp.float32),
+                                     boxes.shape)
+        return boxes, variances
+
+    return apply_op("prior_box", _prior, input, image, ars=tuple(ars),
+                    mins=tuple(min_sizes), maxs=tuple(max_sizes),
+                    var=tuple(float(v) for v in variance),
+                    steps=tuple(float(s) for s in steps),
+                    offset=float(offset), clip=bool(clip))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (reference:
+    operators/detection/box_coder_op.cc)."""
+    ct = code_type.lower()
+    if ct not in ("encode_center_size", "decode_center_size"):
+        raise ValueError(code_type)
+
+    def _coder(prior, pvar, target, *, decode, norm):
+        off = 0.0 if norm else 1.0
+        pw = prior[:, 2] - prior[:, 0] + off
+        ph = prior[:, 3] - prior[:, 1] + off
+        pcx = prior[:, 0] + pw / 2
+        pcy = prior[:, 1] + ph / 2
+        if pvar is None:
+            pvar = jnp.ones_like(prior)
+        if not decode:
+            # output [N_target, N_prior, 4] (reference box_coder_op.cc
+            # EncodeCenterSize layout)
+            tw = target[:, 2] - target[:, 0] + off
+            th = target[:, 3] - target[:, 1] + off
+            tcx = target[:, 0] + tw / 2
+            tcy = target[:, 1] + th / 2
+            out = jnp.stack([
+                (tcx[:, None] - pcx[None, :]) / pw[None, :],
+                (tcy[:, None] - pcy[None, :]) / ph[None, :],
+                jnp.log(tw[:, None] / pw[None, :]),
+                jnp.log(th[:, None] / ph[None, :]),
+            ], axis=-1) / pvar[None, :, :]
+            return out
+        # decode: target [N, A, 4] deltas -> boxes
+        d = target * pvar[None, :, :] if target.ndim == 3 else \
+            (target * pvar)[None]
+        cx = d[..., 0] * pw + pcx
+        cy = d[..., 1] * ph + pcy
+        w = jnp.exp(d[..., 2]) * pw
+        h = jnp.exp(d[..., 3]) * ph
+        return jnp.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - off, cy + h / 2 - off], axis=-1)
+
+    return apply_op("box_coder", _coder, prior_box, prior_box_var,
+                    target_box, decode=(ct == "decode_center_size"),
+                    norm=bool(box_normalized))
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=-1,
+                   keep_top_k=-1, nms_threshold=0.3, normalized=True,
+                   background_label=-1, name=None):
+    """Per-class NMS over [N_box, 4] boxes + [C, N_box] scores (reference:
+    operators/detection/multiclass_nms_op.cc, single-image form).
+    Host-side (dynamic output shape — eager only). Returns
+    [M, 6] rows of (class, score, x1, y1, x2, y2)."""
+    if in_trace():
+        raise errors.UnimplementedError(
+            "multiclass_nms is not traceable (dynamic shape)")
+    b = np.asarray(bboxes._value if isinstance(bboxes, Tensor) else bboxes)
+    s = np.asarray(scores._value if isinstance(scores, Tensor) else scores)
+    out = []
+    for c in range(s.shape[0]):
+        if c == background_label:
+            continue
+        sel = np.where(s[c] > score_threshold)[0]
+        if sel.size == 0:
+            continue
+        order = sel[np.argsort(-s[c][sel])]
+        if nms_top_k > 0:
+            order = order[:nms_top_k]
+        keep = np.asarray(nms(Tensor(b[order]),
+                              iou_threshold=nms_threshold,
+                              scores=Tensor(s[c][order]))._value)
+        for i in keep:
+            gi = order[i]
+            out.append([c, s[c][gi], *b[gi]])
+    out.sort(key=lambda r: -r[1])
+    if keep_top_k > 0:
+        out = out[:keep_top_k]
+    return Tensor(np.asarray(out, np.float32).reshape(-1, 6))

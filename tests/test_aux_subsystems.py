@@ -1,0 +1,125 @@
+"""Aux subsystems (SURVEY §2.1/§2.4 misc rows): op version registry,
+monitor/stat registry, profiler summary tables, DLPack interop,
+attention-mask conversion, and loud cross-process errors for the eager
+P2P fictions."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.utils import dlpack, monitor, profiler
+
+
+class TestOpVersionRegistry:
+    def test_register_and_bump(self):
+        from paddle_tpu.framework import op_version as ov
+
+        e = ov.register_op_version("test_op_xyz")
+        assert ov.get_op_version("test_op_xyz") == 1
+        e.mod("changed semantics")
+        assert ov.get_op_version("test_op_xyz") == 2
+        assert "test_op_xyz" in ov.all_op_versions()
+
+    def test_check_compat_warns(self):
+        from paddle_tpu.framework import op_version as ov
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            bad = ov.check_compat({"batch_norm_train": 99})
+        assert "batch_norm_train" in bad
+        assert any("version mismatch" in str(x.message) for x in w)
+
+    def test_versions_saved_into_artifacts(self, tmp_path):
+        import json
+        import pickle
+
+        import paddle_tpu.jit as jit
+        from paddle_tpu.static.input_spec import InputSpec
+
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        prefix = str(tmp_path / "m")
+        jit.save(net, prefix, input_spec=[InputSpec([2, 4], "float32")])
+        payload = pickle.load(open(prefix + ".pdiparams", "rb"))
+        assert "batch_norm_train" in payload["op_versions"]
+        jit.load(prefix)  # matching versions: no warning required
+
+
+class TestMonitor:
+    def test_stat_add_get_reset(self):
+        monitor.stat_reset()
+        monitor.stat_add("reader_queue", 5)
+        monitor.stat_add("reader_queue", 2)
+        monitor.stat_sub("reader_queue", 1)
+        assert monitor.stat_get("reader_queue") == 6
+        assert monitor.stat_registry() == {"reader_queue": 6}
+        monitor.stat_reset("reader_queue")
+        assert monitor.stat_get("reader_queue") == 0
+
+
+class TestProfilerSummary:
+    def test_summary_table(self):
+        profiler.reset_summary()
+        for _ in range(3):
+            with profiler.RecordEvent("my_span"):
+                pass
+        rows = profiler.summary(printer=None)
+        assert rows and rows[0]["name"] == "my_span"
+        assert rows[0]["calls"] == 3
+        assert rows[0]["total"] >= rows[0]["max"] >= rows[0]["min"] >= 0
+        profiler.reset_summary()
+        assert profiler.summary(printer=None) == []
+
+
+class TestDLPack:
+    def test_roundtrip(self):
+        t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        cap = dlpack.to_dlpack(t)
+        back = dlpack.from_dlpack(cap)
+        np.testing.assert_allclose(np.asarray(back._value),
+                                   np.asarray(t._value))
+
+    def test_from_torch(self):
+        torch = pytest.importorskip("torch")
+        src = torch.arange(8, dtype=torch.float32).reshape(2, 4)
+        back = dlpack.from_dlpack(src)
+        np.testing.assert_allclose(np.asarray(back._value),
+                                   src.numpy())
+
+
+class TestAttentionMaskConversion:
+    def test_int_mask_converts_to_additive(self):
+        from paddle_tpu.nn.layers.transformer import \
+            _convert_attention_mask
+
+        m = paddle.to_tensor(np.array([[1, 0, 1]], np.int32))
+        out = _convert_attention_mask(m)
+        arr = np.asarray(out._value)
+        assert arr.dtype == np.float32
+        np.testing.assert_allclose(arr, [[0.0, -1e9, 0.0]])
+
+    def test_float_mask_passthrough(self):
+        from paddle_tpu.nn.layers.transformer import \
+            _convert_attention_mask
+
+        m = paddle.to_tensor(np.array([[0.0, -1e9]], np.float32))
+        assert _convert_attention_mask(m) is m
+
+    def test_int_mask_equals_bool_mask_in_mha(self):
+        paddle.seed(0)
+        mha = nn.MultiHeadAttention(8, 2)
+        mha.eval()
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .rand(1, 4, 8).astype(np.float32))
+        mask_bool = paddle.to_tensor(
+            np.tril(np.ones((1, 1, 4, 4))).astype(bool))
+        mask_int = paddle.to_tensor(
+            np.tril(np.ones((1, 1, 4, 4))).astype(np.int32))
+        out_b = np.asarray(mha(x, attn_mask=mask_bool)._value)
+        out_i = np.asarray(mha(x, attn_mask=mask_int)._value)
+        np.testing.assert_allclose(out_i, out_b, rtol=1e-6)
+        # and masking actually does something
+        out_none = np.asarray(mha(x)._value)
+        assert not np.allclose(out_b, out_none)

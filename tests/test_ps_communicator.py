@@ -200,3 +200,47 @@ class TestFleetASyncWiring:
         assert isinstance(client.geo_communicator, GeoCommunicator)
         assert client.geo_communicator.need_push == 7
         f.stop_worker()
+
+
+class TestMultiTrainerHogwild:
+    def test_widedeep_trains_multithreaded(self, tmp_path):
+        """MultiTrainer/HogwildWorker analog (reference trainer.h:52,
+        device_worker.h:150): 2 workers share the model + PS tables."""
+        from paddle_tpu.distributed.fleet.trainer import MultiTrainer
+
+        files = rec.synthetic_ctr_files(str(tmp_path), n_files=2,
+                                        rows_per_file=200)
+        paddle.seed(0)
+        cfgs = rec.make_ps_tables(emb_dim=8, optimizer="adagrad", lr=0.1)
+        client = ps.LocalPSClient(cfgs)
+        ds = InMemoryDataset()
+        ds.init(batch_size=64, slots=["user", "item"], max_per_slot=3,
+                pad_id=-1)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        model = rec.WideDeep(client, ["user", "item"], emb_dim=8)
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=model.parameters())
+        bce = nn.BCEWithLogitsLoss()
+        lock = __import__("threading").Lock()
+
+        def train_one(labels, slot_ids):
+            # eager tape state is per-model; serialize the bwd/step pair
+            # (hogwild applies to the PS tables + param arrays)
+            with lock:
+                loss = bce(model(slot_ids), paddle.to_tensor(labels))
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            return loss.numpy()
+
+        trainer = MultiTrainer(train_one, num_threads=2)
+        all_losses = []
+        for epoch in range(3):
+            ds.local_shuffle(seed=epoch)
+            all_losses.extend(trainer.train_from_dataset(ds))
+        client.close()
+        assert len(all_losses) >= 6
+        assert (np.mean(all_losses[-4:])
+                < np.mean(all_losses[:4]) - 0.05), (
+            all_losses[:4], all_losses[-4:])
