@@ -35,14 +35,22 @@ class Place:
         return f"Place({self._kind}:{self.device_id})"
 
     def jax_device(self):
-        """Resolve to a live jax.Device."""
+        """Resolve to a live jax.Device. Multi-process (jax.distributed)
+        runs must resolve to an ADDRESSABLE device: jax.devices() lists
+        every process's devices and only the local ones accept puts
+        (the reference's Place is likewise process-local)."""
         plat = self._platform()
         plats = (plat,) if plat != "tpu" else TPU_PLATFORMS
-        devs = [d for d in jax.devices() if d.platform in plats]
+        devs = [d for d in jax.local_devices() if d.platform in plats]
         if not devs:
             # CPU always exists as fallback, mirroring the reference's
-            # CPU-universal-fallback behavior.
-            devs = jax.devices("cpu")
+            # CPU-universal-fallback behavior (addressable devices only:
+            # jax.devices("cpu") would list other processes' CPUs too).
+            try:
+                devs = jax.local_devices(backend="cpu")
+            except RuntimeError:
+                devs = [d for d in jax.devices("cpu")
+                        if d.process_index == jax.process_index()]
         errors.enforce(
             self.device_id < len(devs),
             f"{self!r}: device index out of range ({len(devs)} present)",

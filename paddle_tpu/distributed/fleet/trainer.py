@@ -62,8 +62,28 @@ class MultiTrainer:
             for batch in dataset:
                 if not isinstance(batch, tuple):
                     batch = (batch,)
-                batch_q.put(batch)
+                # bounded put that aborts if every consumer died (a
+                # train_one bug must raise, not wedge the producer on a
+                # full queue)
+                while True:
+                    if not any(w.is_alive() for w in workers):
+                        raise next((w.exc for w in workers if w.exc),
+                                   None) or RuntimeError(
+                            "all hogwild workers exited")
+                    try:
+                        batch_q.put(batch, timeout=0.5)
+                        break
+                    except queue.Full:
+                        continue
         finally:
+            # drain leftovers so the sentinels are reachable even when
+            # workers died mid-stream
+            if not any(w.is_alive() for w in workers):
+                while True:
+                    try:
+                        batch_q.get_nowait()
+                    except queue.Empty:
+                        break
             for _ in workers:
                 batch_q.put(None)
             for w in workers:

@@ -19,6 +19,8 @@ class TreeIndex:
 
     def __init__(self, item_ids, branch=2):
         self.branch = int(branch)
+        if self.branch < 2:
+            raise ValueError(f"branch must be >= 2, got {branch}")
         items = np.asarray(sorted(set(int(i) for i in item_ids)), np.int64)
         if items.size == 0:
             raise ValueError("TreeIndex needs at least one item")

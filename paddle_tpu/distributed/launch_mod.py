@@ -113,6 +113,38 @@ def launch_collective(script, args=(), nproc_per_node=1, nnodes=1,
     return watch_local_trainers(procs)
 
 
+def launch_elastic(script, args=(), nproc_per_node=1, nnodes=1,
+                   node_rank=0, log_dir=None, max_restarts=3,
+                   extra_env=None, master_fn=None):
+    """Elastic supervision (reference: DistributedStrategy.elastic +
+    launch_utils respawn; this rev of the reference also restarts whole
+    pods rather than hot-swapping ranks): on any trainer failure the pod
+    is torn down (watch_local_trainers) and relaunched with a FRESH
+    rendezvous master, up to max_restarts times.
+
+    Single-node only unless ``master_fn`` is given: each attempt needs a
+    NEW coordinator that every node agrees on, so multi-node callers must
+    supply ``master_fn(attempt) -> "host:port"`` (an external
+    rendezvous); without it nnodes>1 raises."""
+    if nnodes > 1 and master_fn is None:
+        raise ValueError(
+            "launch_elastic with nnodes>1 needs master_fn(attempt) so all "
+            "nodes rendezvous on the same fresh coordinator per restart")
+    last_err = None
+    for attempt in range(int(max_restarts) + 1):
+        master = master_fn(attempt) if master_fn is not None else None
+        try:
+            return launch_collective(script, args, nproc_per_node, nnodes,
+                                     node_rank, master=master,
+                                     log_dir=log_dir, extra_env=extra_env)
+        except RuntimeError as e:
+            last_err = e
+            print(f"[elastic] pod failed (attempt {attempt + 1}/"
+                  f"{max_restarts + 1}): {e}", file=sys.stderr, flush=True)
+    raise RuntimeError(
+        f"elastic launch exhausted {max_restarts} restarts") from last_err
+
+
 def launch(script=None, args=(), nnodes=1, node_rank=0, master=None,
            nproc_per_node=1, log_dir=None):
     return launch_collective(script, args, nproc_per_node, nnodes,

@@ -5,6 +5,8 @@ DataParallel; C++ imperative/reducer.cc).
 import os
 
 import jax
+import numpy as np
+import jax.numpy as jnp
 
 from ..nn.layer import Layer
 from . import topology
@@ -89,15 +91,33 @@ def get_world_size(group=None):
     return jax.process_count()
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=8)
+def _grad_mean_fn(mesh):
+    """One jitted mean-over-processes per mesh: the jit wrapper owns the
+    executable cache, so rebuilding it per call would recompile every
+    step."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.jit(lambda a: jnp.mean(a, axis=0),
+                   out_shardings=NamedSharding(mesh, P()))
+
+
 class DataParallel(Layer):
     """reference: dygraph/parallel.py:380 + reducer.cc bucketed allreduce.
 
-    TPU-native: with the global-view array model there is nothing to
-    reduce — the batch axis is sharded over 'dp', parameters are
-    replicated, and XLA inserts the gradient psum during the (traced or
-    eager-vjp) backward. scale_loss/apply_collective_grads are therefore
-    identities kept for API parity; gradient bucketing (reducer.cc's
-    raison d'être) is subsumed by XLA collective fusion.
+    TPU-native: in the compiled SPMD path there is nothing to reduce —
+    the batch axis is sharded over 'dp', parameters are replicated, and
+    XLA inserts the gradient psum during the traced backward, so
+    scale_loss/apply_collective_grads are identities there (gradient
+    bucketing, reducer.cc's raison d'être, is subsumed by XLA collective
+    fusion). In EAGER multi-process runs (one device per process, like
+    the reference's one-proc-per-GPU trainers) each process holds local
+    gradients, and apply_collective_grads really averages them across
+    processes after backward() — the Reducer.MarkGroupReady/
+    FusedAllReduceSchedule analog, batched per call instead of bucketed.
     """
 
     def __init__(self, layers, strategy=None, comm_buffer_size=25,
@@ -114,7 +134,22 @@ class DataParallel(Layer):
         return loss
 
     def apply_collective_grads(self):
-        pass
+        if jax.process_count() == 1:
+            return
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = topology.get_global_mesh()
+        n = jax.process_count()
+        stack_sh = NamedSharding(mesh, P("dp"))
+        mean0 = _grad_mean_fn(mesh)  # cached: compiled once per mesh
+        for _, p in self._layers.named_parameters():
+            if getattr(p, "_grad", None) is None:
+                continue
+            local = np.asarray(p._grad)[None]
+            garr = jax.make_array_from_process_local_data(
+                stack_sh, local, (n,) + local.shape[1:])
+            out = mean0(garr)  # compiled psum over the process mesh
+            p._grad = jnp.asarray(out.addressable_shards[0].data)
 
     def state_dict(self, *args, **kwargs):
         return self._layers.state_dict(*args, **kwargs)

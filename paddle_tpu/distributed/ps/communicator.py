@@ -27,7 +27,6 @@ class AsyncCommunicator:
         self.sync = sync
         self.max_merge = max(1, int(max_merge_var_num))
         self._q = queue.Queue(maxsize=max(1, int(send_queue_size)))
-        self._stop = threading.Event()
         self._inflight = 0
         self._cv = threading.Condition()
         self._thread = None
@@ -59,10 +58,10 @@ class AsyncCommunicator:
             raise exc
 
     def stop(self):
-        """Shut the sender thread down unconditionally (even when a push
-        failed), then surface any pending error once."""
+        """Shut the sender thread down after it drains every queued push
+        (the None sentinel is FIFO-ordered behind them), then surface
+        any pending error once."""
         if self._thread is not None:
-            self._stop.set()
             self._q.put(None)
             self._thread.join()
             self._thread = None
@@ -106,11 +105,12 @@ class AsyncCommunicator:
                 ((k, merged[k]) for k in order)]
 
     def _run(self):
-        while not self._stop.is_set():
+        done = False
+        while not done:
             items = []
             item = self._q.get()
             if item is None:
-                break
+                return
             items.append(item)
             while len(items) < self.max_merge:
                 try:
@@ -118,7 +118,7 @@ class AsyncCommunicator:
                 except queue.Empty:
                     break
                 if nxt is None:
-                    self._stop.set()
+                    done = True  # finish this merge batch, then exit
                     break
                 items.append(nxt)
             try:

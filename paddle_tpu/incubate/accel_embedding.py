@@ -1,0 +1,71 @@
+"""Accelerator-resident sparse embedding — the HeterPS/BoxPS capability
+(reference: paddle/fluid/framework/fleet/heter_ps/ hashtable.h +
+heter_comm.h + optimizer.cuh.h, ps_gpu_wrapper.cc: billions of sparse
+rows held ON the accelerator boxes so the training loop never round-trips
+to a CPU parameter server).
+
+TPU-native redesign: no hash table and no RPC — the table is one dense
+[capacity, emb_dim] parameter ROW-SHARDED over a mesh axis; feature ids
+hash (multiply-shift, mod capacity) into rows; lookups are XLA gathers
+and the backward is a scatter-add, all inside the one compiled SPMD
+train step, with the gradient/update traffic riding ICI instead of
+PCIe/brpc. Collisions are accepted exactly as in the reference's
+mod-sharded accessors — capacity is provisioned above the live id count.
+"""
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import nn
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+def hash_ids(ids, capacity):
+    """Deterministic id -> row mapping (murmur-style 32-bit finalizer
+    then mod; the framework's dtype policy is 32-bit, so the mix stays
+    in uint32)."""
+    def _h(ids, *, cap):
+        x = ids.astype(jnp.uint32)
+        x = x * jnp.uint32(0x9E3779B1)
+        x = x ^ (x >> jnp.uint32(15))
+        x = x * jnp.uint32(0x85EBCA77)
+        x = x ^ (x >> jnp.uint32(13))
+        return (x % jnp.uint32(cap)).astype(jnp.int32)
+
+    return apply_op("hash_ids", _h, ids, cap=int(capacity))
+
+
+class AccelSparseEmbedding(nn.Layer):
+    """Sharded on-device embedding table with hashed ids.
+
+    shard_axis: mesh axis holding the rows ('mp' pairs with the
+    tensor-parallel layout; 'sharding' spreads over the ZeRO group).
+    Adam/Adagrad-style optimizers update only touched rows in effect
+    (zero gradient rows have zero moments), matching the reference's
+    per-row sparse optimizers.
+    """
+
+    def __init__(self, capacity, emb_dim, shard_axis="mp",
+                 init_range=0.05, pad_id=None, name=None):
+        super().__init__()
+        self.capacity = int(capacity)
+        self.emb_dim = int(emb_dim)
+        self.pad_id = pad_id
+        self.weight = self.create_parameter(
+            [self.capacity, self.emb_dim],
+            default_initializer=nn.initializer.Uniform(-init_range,
+                                                       init_range))
+        # row-sharded over the chosen mesh axis (spmd.build_train_step
+        # honors mp_spec for placement + keeps the update sharded)
+        self.weight.mp_spec = P(shard_axis)
+
+    def forward(self, ids):
+        rows = hash_ids(ids, self.capacity)
+        emb = nn.functional.embedding(rows, self.weight)
+        if self.pad_id is not None:
+            def _mask(emb, ids, *, pad):
+                return emb * (ids != pad)[..., None].astype(emb.dtype)
+
+            emb = apply_op("accel_emb_pad_mask", _mask, emb, ids,
+                           pad=int(self.pad_id))
+        return emb

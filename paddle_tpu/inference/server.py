@@ -1,0 +1,142 @@
+"""Inference server: serves a saved model over the same length-prefixed
+TCP framing as the PS service, so the C API (native/c_api.cc), Go/R
+clients, or any socket speaker can run predictions against the TPU
+process.
+
+Reference: paddle/fluid/inference/capi/ + go/paddle/predictor.go talk to
+an in-process C++ predictor; on TPU the predictor owns device state and
+compiled programs, so out-of-process callers go through this service
+instead (the architecture real TPU serving uses).
+
+wire format (little-endian):
+  request:  u32 body_len | u8 cmd | payload
+  cmds: 1 infer  payload = u8 n_inputs, per input:
+            u8 dtype (0=f32, 1=i32) | u8 ndim | i64 dims[ndim] | data
+        7 stop
+  response: u32 body_len | u8 status | (cmd 1: same per-output encoding)
+"""
+import socket
+import struct
+import threading
+
+import numpy as np
+
+_DTYPES = {0: np.float32, 1: np.int32}
+_DTYPE_CODES = {np.dtype(np.float32): 0, np.dtype(np.int32): 1}
+
+
+def _read_all(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _encode_arrays(arrays):
+    out = [struct.pack("<B", len(arrays))]
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        code = _DTYPE_CODES.get(a.dtype)
+        if code is None:
+            a = a.astype(np.float32)
+            code = 0
+        out.append(struct.pack("<BB", code, a.ndim))
+        out.append(struct.pack(f"<{a.ndim}q", *a.shape))
+        out.append(a.tobytes())
+    return b"".join(out)
+
+
+def _decode_arrays(payload):
+    off = 0
+    (n,) = struct.unpack_from("<B", payload, off)
+    off += 1
+    arrays = []
+    for _ in range(n):
+        code, ndim = struct.unpack_from("<BB", payload, off)
+        off += 2
+        dims = struct.unpack_from(f"<{ndim}q", payload, off)
+        off += 8 * ndim
+        dt = _DTYPES[code]
+        count = int(np.prod(dims)) if dims else 1
+        arr = np.frombuffer(payload, dt, count, off).reshape(dims)
+        off += arr.nbytes
+        arrays.append(arr)
+    return arrays
+
+
+class PredictorServer:
+    """Serve `predictor` (an inference.Predictor or any callable taking
+    numpy arrays and returning a list of numpy arrays) on a TCP port."""
+
+    def __init__(self, run_fn, port=0, host="127.0.0.1"):
+        self._run = run_fn
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while not self._stop.is_set():
+                (blen,) = struct.unpack("<I", _read_all(conn, 4))
+                body = _read_all(conn, blen)
+                cmd = body[0]
+                if cmd == 7:
+                    conn.sendall(struct.pack("<IB", 1, 0))
+                    self.stop()
+                    return
+                if cmd != 1:
+                    conn.sendall(struct.pack("<IB", 1, 1))
+                    continue
+                try:
+                    inputs = _decode_arrays(body[1:])
+                    outputs = self._run(*inputs)
+                    if not isinstance(outputs, (list, tuple)):
+                        outputs = [outputs]
+                    outputs = [np.asarray(o._value if hasattr(o, "_value")
+                                          else o) for o in outputs]
+                    enc = _encode_arrays(outputs)
+                    conn.sendall(struct.pack("<IB", 1 + len(enc), 0) + enc)
+                except Exception:  # noqa: BLE001 - protocol error status
+                    conn.sendall(struct.pack("<IB", 1, 1))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def serve_model(path_prefix, port=0):
+    """Load a jit-saved model and serve it (the C API's server side)."""
+    from ..jit import load as jit_load
+
+    layer = jit_load(path_prefix)
+
+    def run(*arrays):
+        out = layer(*arrays)
+        return out if isinstance(out, (list, tuple)) else [out]
+
+    return PredictorServer(run, port=port)

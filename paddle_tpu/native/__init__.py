@@ -13,7 +13,7 @@ import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SOURCES = ["ps_core.cc", "ps_service.cc", "data_feed.cc",
-            "graph_table.cc"]
+            "graph_table.cc", "c_api.cc"]
 _LOCK = threading.Lock()
 _LIB = None
 
@@ -114,6 +114,18 @@ def _declare(lib):
         "pt_graph_set_node_feat": (i32, [i64, i64p, i64, f32p]),
         "pt_graph_get_node_feat": (i32, [i64, i64p, i64, f32p]),
         "pt_graph_num_nodes": (i64, [i64]),
+        "PD_PredictorCreate": (i64, [cstr, i32]),
+        "PD_PredictorDestroy": (None, [i64]),
+        "PD_PredictorRun": (i32, [i64, i32, ctypes.POINTER(ctypes.c_int),
+                                  ctypes.POINTER(ctypes.c_int),
+                                  ctypes.POINTER(ctypes.POINTER(
+                                      ctypes.c_int64)),
+                                  ctypes.POINTER(ctypes.c_void_p)]),
+        "PD_PredictorNumOutputs": (i32, [i64]),
+        "PD_PredictorOutputNdim": (i32, [i64, i32]),
+        "PD_PredictorOutputDims": (i32, [i64, i32, i64p]),
+        "PD_PredictorOutputDtype": (i32, [i64, i32]),
+        "PD_PredictorOutputData": (i32, [i64, i32, ctypes.c_void_p, i64]),
     }
     for name, (res, args) in sig.items():
         fn = getattr(lib, name)

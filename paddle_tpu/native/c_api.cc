@@ -1,0 +1,207 @@
+// C inference API: a pure-C ABI for running predictions against a
+// paddle_tpu inference server (inference/server.py) — the capi surface
+// for C/Go/R callers (reference: paddle/fluid/inference/capi/,
+// go/paddle/predictor.go). The reference embeds the predictor
+// in-process; on TPU the predictor owns device state + compiled
+// programs, so external languages talk to the serving port instead.
+//
+// Protocol (little-endian), mirrors inference/server.py:
+//   request  u32 len | u8 cmd(1=infer) | u8 n_inputs |
+//            per input: u8 dtype(0=f32,1=i32) u8 ndim i64 dims[] data
+//   response u32 len | u8 status | same encoding of outputs
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+bool rd(int fd, void* p, size_t n) {
+  char* c = (char*)p;
+  while (n) {
+    ssize_t r = ::read(fd, c, n);
+    if (r <= 0) return false;
+    c += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+bool wr(int fd, const void* p, size_t n) {
+  const char* c = (const char*)p;
+  while (n) {
+    ssize_t r = ::write(fd, c, n);
+    if (r <= 0) return false;
+    c += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+struct CPredictor {
+  int fd = -1;
+  std::mutex mu;
+  // last response's outputs (owned here; valid until next Run/destroy)
+  std::vector<std::vector<char>> out_data;
+  std::vector<std::vector<int64_t>> out_dims;
+  std::vector<int> out_dtype;
+};
+
+std::mutex g_mu;
+std::unordered_map<int64_t, CPredictor*> g_preds;
+int64_t g_next = 1;
+
+CPredictor* get(int64_t h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_preds.find(h);
+  return it == g_preds.end() ? nullptr : it->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+// PD_* naming follows the reference capi surface.
+int64_t PD_PredictorCreate(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+      ::connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto* p = new CPredictor();
+  p->fd = fd;
+  std::lock_guard<std::mutex> g(g_mu);
+  int64_t h = g_next++;
+  g_preds[h] = p;
+  return h;
+}
+
+void PD_PredictorDestroy(int64_t h) {
+  CPredictor* p = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_preds.find(h);
+    if (it == g_preds.end()) return;
+    p = it->second;
+    g_preds.erase(it);  // no NEW Run can reach p past this point
+  }
+  // unblock any Run parked in a socket read, then wait for it to
+  // release the predictor mutex before freeing (delete under a held
+  // mutex would be use-after-free + destroying a locked mutex)
+  if (p->fd >= 0) ::shutdown(p->fd, SHUT_RDWR);
+  {
+    std::lock_guard<std::mutex> lock(p->mu);
+    if (p->fd >= 0) ::close(p->fd);
+    p->fd = -1;
+  }
+  delete p;
+}
+
+// Run inference. Inputs: n_inputs tensors, each described by dtype
+// (0=f32, 1=i32), ndim, dims, and a data pointer. Returns 0 on success;
+// outputs are held by the predictor until the next call.
+int PD_PredictorRun(int64_t h, int n_inputs, const int* dtypes,
+                    const int* ndims, const int64_t* const* dims,
+                    const void* const* data) {
+  CPredictor* p = get(h);
+  if (!p || n_inputs < 0 || n_inputs > 255) return -1;
+  std::lock_guard<std::mutex> lock(p->mu);
+  std::vector<char> body;
+  body.push_back((char)1);
+  body.push_back((char)n_inputs);
+  for (int i = 0; i < n_inputs; i++) {
+    body.push_back((char)dtypes[i]);
+    body.push_back((char)ndims[i]);
+    size_t count = 1;
+    for (int d = 0; d < ndims[i]; d++) {
+      int64_t v = dims[i][d];
+      body.insert(body.end(), (char*)&v, (char*)&v + 8);
+      count *= (size_t)v;
+    }
+    size_t bytes = count * 4;  // f32 and i32 are both 4 bytes
+    body.insert(body.end(), (const char*)data[i],
+                (const char*)data[i] + bytes);
+  }
+  uint32_t blen = (uint32_t)body.size();
+  if (!wr(p->fd, &blen, 4) || !wr(p->fd, body.data(), blen)) return -1;
+  uint32_t rlen;
+  if (!rd(p->fd, &rlen, 4) || rlen < 1) return -1;
+  std::vector<char> resp(rlen);
+  if (!rd(p->fd, resp.data(), rlen)) return -1;
+  if (resp[0] != 0) return -2;
+  p->out_data.clear();
+  p->out_dims.clear();
+  p->out_dtype.clear();
+  size_t off = 1;
+  if (off >= resp.size()) return -1;
+  int n_out = (unsigned char)resp[off++];
+  for (int i = 0; i < n_out; i++) {
+    if (off + 2 > resp.size()) return -1;
+    int dt = (unsigned char)resp[off++];
+    int nd = (unsigned char)resp[off++];
+    std::vector<int64_t> ds(nd);
+    size_t count = 1;
+    for (int d = 0; d < nd; d++) {
+      if (off + 8 > resp.size()) return -1;
+      std::memcpy(&ds[d], resp.data() + off, 8);
+      off += 8;
+      count *= (size_t)ds[d];
+    }
+    size_t bytes = count * 4;
+    if (off + bytes > resp.size()) return -1;
+    p->out_dtype.push_back(dt);
+    p->out_dims.push_back(std::move(ds));
+    p->out_data.emplace_back(resp.begin() + off,
+                             resp.begin() + off + bytes);
+    off += bytes;
+  }
+  return 0;
+}
+
+int PD_PredictorNumOutputs(int64_t h) {
+  CPredictor* p = get(h);
+  return p ? (int)p->out_data.size() : -1;
+}
+
+int PD_PredictorOutputNdim(int64_t h, int i) {
+  CPredictor* p = get(h);
+  if (!p || i < 0 || i >= (int)p->out_dims.size()) return -1;
+  return (int)p->out_dims[i].size();
+}
+
+int PD_PredictorOutputDims(int64_t h, int i, int64_t* out) {
+  CPredictor* p = get(h);
+  if (!p || i < 0 || i >= (int)p->out_dims.size()) return -1;
+  std::memcpy(out, p->out_dims[i].data(), p->out_dims[i].size() * 8);
+  return 0;
+}
+
+int PD_PredictorOutputDtype(int64_t h, int i) {
+  CPredictor* p = get(h);
+  if (!p || i < 0 || i >= (int)p->out_dtype.size()) return -1;
+  return p->out_dtype[i];
+}
+
+int PD_PredictorOutputData(int64_t h, int i, void* out, int64_t bytes) {
+  CPredictor* p = get(h);
+  if (!p || i < 0 || i >= (int)p->out_data.size()) return -1;
+  if ((int64_t)p->out_data[i].size() != bytes) return -1;
+  std::memcpy(out, p->out_data[i].data(), bytes);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,60 @@
+"""2-trainer EAGER DataParallel worker (the dygraph DDP path; reference:
+test_parallel_dygraph_* scripts + imperative/reducer.cc): each rank runs
+eager fwd/bwd on its local half-batch, apply_collective_grads averages
+gradients across processes, then a local optimizer step. Rank 0 writes
+the loss sequence to argv[1]."""
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn, optimizer  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+
+
+def main():
+    out_path = sys.argv[1]
+    dist.init_parallel_env()
+    rank, world = dist.get_rank(), dist.get_world_size()
+    assert world == 2
+
+    paddle.seed(5)
+    model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    ddp = dist.DataParallel(model)
+    opt = optimizer.SGD(0.1, parameters=model.parameters())
+    mse = nn.MSELoss()
+
+    x = np.random.RandomState(0).rand(16, 8).astype(np.float32)
+    y = np.random.RandomState(1).rand(16, 4).astype(np.float32)
+    half = 16 // world
+    xl = x[rank * half:(rank + 1) * half]
+    yl = y[rank * half:(rank + 1) * half]
+
+    losses = []
+    for _ in range(3):
+        loss = mse(ddp(paddle.to_tensor(xl)), paddle.to_tensor(yl))
+        loss.backward()
+        ddp.apply_collective_grads()
+        opt.step()
+        opt.clear_grad()
+        # the GLOBAL loss is the mean of local losses; gather for the oracle
+        from jax.experimental import multihost_utils
+
+        g = multihost_utils.process_allgather(loss._value)
+        losses.append(float(np.mean(np.asarray(g))))
+    if rank == 0:
+        with open(out_path, "w") as f:
+            json.dump(losses, f)
+    print(f"rank {rank} losses {losses}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

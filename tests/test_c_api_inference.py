@@ -1,0 +1,93 @@
+"""C inference API end-to-end (reference: paddle/fluid/inference/capi/,
+go/paddle/predictor.go): jit-save a model, serve it, run predictions
+through the native C client (PD_* ABI via ctypes — any C/Go/R program
+links the same .so)."""
+import ctypes
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, native
+from paddle_tpu.inference.server import PredictorServer, serve_model
+
+
+@pytest.fixture(scope="module")
+def served_model(tmp_path_factory):
+    import paddle_tpu.jit as jit
+    from paddle_tpu.static.input_spec import InputSpec
+
+    paddle.seed(4)
+    net = nn.Sequential(nn.Linear(6, 8), nn.Tanh(), nn.Linear(8, 3))
+    prefix = str(tmp_path_factory.mktemp("capi") / "model")
+    jit.save(net, prefix, input_spec=[InputSpec([2, 6], "float32")])
+    server = serve_model(prefix)
+    yield server, net
+    server.stop()
+
+
+def _c_run(lib, h, arr):
+    dtypes = (ctypes.c_int * 1)(0)
+    ndims = (ctypes.c_int * 1)(arr.ndim)
+    dims_arr = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+    dims = (ctypes.POINTER(ctypes.c_int64) * 1)(dims_arr)
+    data = (ctypes.c_void_p * 1)(arr.ctypes.data_as(ctypes.c_void_p))
+    rc = lib.PD_PredictorRun(h, 1, dtypes, ndims, dims, data)
+    assert rc == 0, rc
+    n = lib.PD_PredictorNumOutputs(h)
+    outs = []
+    for i in range(n):
+        nd = lib.PD_PredictorOutputNdim(h, i)
+        ds = np.zeros(nd, np.int64)
+        lib.PD_PredictorOutputDims(h, i, native.i64_ptr(ds))
+        dt = lib.PD_PredictorOutputDtype(h, i)
+        out = np.zeros(ds, np.float32 if dt == 0 else np.int32)
+        rc = lib.PD_PredictorOutputData(
+            h, i, out.ctypes.data_as(ctypes.c_void_p), out.nbytes)
+        assert rc == 0
+        outs.append(out)
+    return outs
+
+
+class TestCAPI:
+    def test_predict_matches_local(self, served_model):
+        server, net = served_model
+        lib = native.get_lib()
+        h = lib.PD_PredictorCreate(b"127.0.0.1", server.port)
+        assert h > 0
+        try:
+            x = np.random.RandomState(0).rand(2, 6).astype(np.float32)
+            (out,) = _c_run(lib, h, x)
+            ref = np.asarray(net(paddle.to_tensor(x))._value)
+            np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+            # second call reuses the connection
+            (out2,) = _c_run(lib, h, x * 2)
+            assert not np.allclose(out2, out)
+        finally:
+            lib.PD_PredictorDestroy(h)
+
+    def test_bad_connect_returns_error(self):
+        lib = native.get_lib()
+        assert lib.PD_PredictorCreate(b"127.0.0.1", 1) < 0
+
+    def test_server_rejects_garbage_cmd(self, served_model):
+        import socket
+        import struct
+
+        server, _ = served_model
+        s = socket.create_connection(("127.0.0.1", server.port))
+        s.sendall(struct.pack("<IB", 1, 99))
+        resp = s.recv(16)
+        assert resp[4] == 1  # status=error
+        s.close()
+
+    def test_python_roundtrip_codec(self):
+        from paddle_tpu.inference.server import (_decode_arrays,
+                                                 _encode_arrays)
+
+        arrs = [np.arange(6, dtype=np.float32).reshape(2, 3),
+                np.array([1, 2, 3], np.int32)]
+        back = _decode_arrays(_encode_arrays(arrs))
+        for a, b in zip(arrs, back):
+            np.testing.assert_array_equal(a, b)
+            assert a.dtype == b.dtype

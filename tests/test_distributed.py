@@ -661,3 +661,61 @@ class TestMultiProcess:
 
         with _pytest.raises(RuntimeError, match="exited with code 7"):
             launch_mod.launch_collective(str(bad), [], nproc_per_node=2)
+
+
+class TestElasticLaunch:
+    def test_restarts_pod_until_success(self, tmp_path):
+        from paddle_tpu.distributed import launch_mod
+
+        marker = tmp_path / "failed_once"
+        script = tmp_path / "flaky.py"
+        script.write_text(
+            "import os, sys\n"
+            f"m = {str(marker)!r}\n"
+            "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+            "if rank == 1 and not os.path.exists(m):\n"
+            "    open(m, 'w').close()\n"
+            "    sys.exit(3)\n")
+        rc = launch_mod.launch_elastic(str(script), nproc_per_node=2,
+                                       max_restarts=2)
+        assert rc == 0
+        assert marker.exists()
+
+    def test_exhausted_restarts_raise(self, tmp_path):
+        from paddle_tpu.distributed import launch_mod
+
+        script = tmp_path / "always_fail.py"
+        script.write_text("import sys\nsys.exit(5)\n")
+        with pytest.raises(RuntimeError, match="exhausted"):
+            launch_mod.launch_elastic(str(script), nproc_per_node=2,
+                                      max_restarts=1)
+
+
+class TestEagerDDP2Proc:
+    def test_eager_ddp_matches_single_process(self, tmp_path):
+        """Eager DataParallel across 2 real processes == 1-proc full-batch
+        training (reducer.cc grad-averaging semantics)."""
+        import json
+        from paddle_tpu.distributed import launch_mod
+
+        out = tmp_path / "ddp_losses.json"
+        worker = os.path.join(os.path.dirname(__file__),
+                              "dist_eager_ddp_worker.py")
+        launch_mod.launch_collective(worker, [str(out)], nproc_per_node=2,
+                                     log_dir=str(tmp_path / "logs"))
+        two_proc = json.load(open(out))
+
+        paddle.seed(5)
+        model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+        opt = optimizer.SGD(0.1, parameters=model.parameters())
+        mse = nn.MSELoss()
+        x = np.random.RandomState(0).rand(16, 8).astype(np.float32)
+        y = np.random.RandomState(1).rand(16, 4).astype(np.float32)
+        one_proc = []
+        for _ in range(3):
+            loss = mse(model(t(x)), t(y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            one_proc.append(float(loss.numpy()))
+        np.testing.assert_allclose(two_proc, one_proc, rtol=2e-5, atol=1e-6)
