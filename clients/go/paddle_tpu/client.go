@@ -1,0 +1,109 @@
+// Package paddletpu is a Go client for the paddle_tpu inference server
+// (reference analog: go/paddle/predictor.go — the reference embeds the
+// C++ predictor via cgo; on TPU the predictor owns device state, so
+// external languages speak the serving protocol instead).
+//
+// Protocol (little-endian), see paddle_tpu/inference/server.py:
+//   request:  u32 body_len | u8 cmd(1=infer) | u8 n_inputs |
+//             per input: u8 dtype(0=f32,1=i32) u8 ndim i64 dims[] data
+//   response: u32 body_len | u8 status | same encoding of outputs
+package paddletpu
+
+import (
+	"encoding/binary"
+	"fmt"
+	"io"
+	"math"
+	"net"
+)
+
+// Tensor is a dense f32 row-major array.
+type Tensor struct {
+	Dims []int64
+	Data []float32
+}
+
+// Predictor holds one connection to a PredictorServer.
+type Predictor struct {
+	conn net.Conn
+}
+
+func NewPredictor(addr string) (*Predictor, error) {
+	conn, err := net.Dial("tcp", addr)
+	if err != nil {
+		return nil, err
+	}
+	return &Predictor{conn: conn}, nil
+}
+
+func (p *Predictor) Close() error { return p.conn.Close() }
+
+// Run sends the inputs and returns the model outputs.
+func (p *Predictor) Run(inputs []Tensor) ([]Tensor, error) {
+	body := []byte{1, byte(len(inputs))}
+	for _, t := range inputs {
+		body = append(body, 0, byte(len(t.Dims)))
+		for _, d := range t.Dims {
+			body = binary.LittleEndian.AppendUint64(body, uint64(d))
+		}
+		for _, v := range t.Data {
+			body = binary.LittleEndian.AppendUint32(body, math.Float32bits(v))
+		}
+	}
+	hdr := binary.LittleEndian.AppendUint32(nil, uint32(len(body)))
+	if _, err := p.conn.Write(append(hdr, body...)); err != nil {
+		return nil, err
+	}
+	var rlenBuf [4]byte
+	if _, err := io.ReadFull(p.conn, rlenBuf[:]); err != nil {
+		return nil, err
+	}
+	resp := make([]byte, binary.LittleEndian.Uint32(rlenBuf[:]))
+	if _, err := io.ReadFull(p.conn, resp); err != nil {
+		return nil, err
+	}
+	if len(resp) < 1 {
+		return nil, fmt.Errorf("empty response")
+	}
+	if resp[0] != 0 {
+		return nil, fmt.Errorf("inference failed (status %d)", resp[0])
+	}
+	if len(resp) < 2 {
+		return nil, fmt.Errorf("truncated response header")
+	}
+	off := 1
+	n := int(resp[off])
+	off++
+	outs := make([]Tensor, 0, n)
+	for i := 0; i < n; i++ {
+		if off+2 > len(resp) {
+			return nil, fmt.Errorf("truncated output %d header", i)
+		}
+		dtype := resp[off]
+		if dtype != 0 {
+			return nil, fmt.Errorf("output %d has dtype %d; this client decodes f32 only", i, dtype)
+		}
+		ndim := int(resp[off+1])
+		off += 2
+		dims := make([]int64, ndim)
+		count := 1
+		for d := 0; d < ndim; d++ {
+			if off+8 > len(resp) {
+				return nil, fmt.Errorf("truncated dims of output %d", i)
+			}
+			dims[d] = int64(binary.LittleEndian.Uint64(resp[off:]))
+			off += 8
+			count *= int(dims[d])
+		}
+		if off+count*4 > len(resp) {
+			return nil, fmt.Errorf("truncated data of output %d", i)
+		}
+		data := make([]float32, count)
+		for j := 0; j < count; j++ {
+			data[j] = math.Float32frombits(binary.LittleEndian.Uint32(resp[off:]))
+			off += 4
+		}
+		outs = append(outs, Tensor{Dims: dims, Data: data})
+	}
+	return outs, nil
+}

@@ -1,0 +1,58 @@
+# R client for the paddle_tpu inference server (reference analog: the
+# reference's r/ demo client; here a pure-socket client with no python
+# dependency). Protocol: see paddle_tpu/inference/server.py —
+#   request:  u32 body_len | u8 cmd(1) | u8 n_inputs |
+#             per input: u8 dtype(0=f32) u8 ndim i64 dims[] f32 data
+#   response: u32 body_len | u8 status | same encoding of outputs
+
+pd_connect <- function(host = "127.0.0.1", port) {
+  socketConnection(host, port, blocking = TRUE, open = "r+b")
+}
+
+.write_i64 <- function(buf, v) {
+  # little-endian int64 as lo/hi 32-bit words (dims fit in 32 bits)
+  writeBin(as.integer(v), buf, size = 4, endian = "little")
+  writeBin(0L, buf, size = 4, endian = "little")
+}
+
+pd_predict <- function(con, x) {
+  dims <- if (is.null(dim(x))) length(x) else dim(x)
+  # R stores column-major; the wire format is row-major — aperm handles
+  # any rank (t() would fail beyond matrices)
+  data <- if (is.null(dim(x))) as.numeric(x) else
+    as.numeric(aperm(x, rev(seq_along(dims))))
+  buf <- rawConnection(raw(0), "w")
+  writeBin(as.raw(c(1, 1, 0, length(dims))), buf)
+  for (d in dims) .write_i64(buf, d)
+  writeBin(data, buf, size = 4, endian = "little")
+  body <- rawConnectionValue(buf)
+  close(buf)
+  writeBin(length(body), con, size = 4, endian = "little")
+  writeBin(body, con)
+  flush(con)
+
+  rlen <- readBin(con, "integer", size = 4, endian = "little")
+  resp <- readBin(con, "raw", n = rlen)
+  stopifnot(as.integer(resp[1]) == 0)
+  off <- 2
+  n_out <- as.integer(resp[off]); off <- off + 1
+  outs <- vector("list", n_out)
+  for (i in seq_len(n_out)) {
+    ndim <- as.integer(resp[off + 1]); off <- off + 2
+    odims <- integer(ndim)
+    for (d in seq_len(ndim)) {
+      odims[d] <- readBin(resp[off:(off + 3)], "integer", size = 4,
+                          endian = "little")
+      off <- off + 8
+    }
+    count <- prod(odims)
+    vals <- readBin(resp[off:(off + count * 4 - 1)], "numeric", n = count,
+                    size = 4, endian = "little")
+    off <- off + count * 4
+    # wire is row-major: fill a reversed array then permute back
+    outs[[i]] <- if (ndim >= 2)
+      aperm(array(vals, rev(odims)), rev(seq_len(ndim))) else
+      array(vals, odims)
+  }
+  if (n_out == 1) outs[[1]] else outs
+}

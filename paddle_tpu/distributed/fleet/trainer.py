@@ -76,16 +76,26 @@ class MultiTrainer:
                     except queue.Full:
                         continue
         finally:
-            # drain leftovers so the sentinels are reachable even when
-            # workers died mid-stream
-            if not any(w.is_alive() for w in workers):
-                while True:
-                    try:
-                        batch_q.get_nowait()
-                    except queue.Empty:
-                        break
-            for _ in workers:
-                batch_q.put(None)
+            # sentinels with the same bounded-put discipline: workers may
+            # die between the liveness check and the put, so drain-and-
+            # retry instead of a blocking put that could wedge forever
+            pending = len(workers)
+            while pending:
+                if not any(w.is_alive() for w in workers):
+                    while True:
+                        try:
+                            batch_q.get_nowait()
+                        except queue.Empty:
+                            break
+                    while pending:
+                        batch_q.put(None)
+                        pending -= 1
+                    break
+                try:
+                    batch_q.put(None, timeout=0.5)
+                    pending -= 1
+                except queue.Full:
+                    continue
             for w in workers:
                 w.join()
         for w in workers:

@@ -138,6 +138,12 @@ class DataParallel(Layer):
             return
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        if len(jax.local_devices()) != 1:
+            raise NotImplementedError(
+                "eager DataParallel assumes one device per process (the "
+                "reference's one-proc-per-GPU trainer model); with "
+                "multiple local chips use spmd.build_train_step, which "
+                "shards over the whole mesh")
         mesh = topology.get_global_mesh()
         n = jax.process_count()
         stack_sh = NamedSharding(mesh, P("dp"))

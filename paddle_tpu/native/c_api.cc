@@ -58,10 +58,16 @@ std::mutex g_mu;
 std::unordered_map<int64_t, CPredictor*> g_preds;
 int64_t g_next = 1;
 
-CPredictor* get(int64_t h) {
+// Acquire the predictor WITH its mutex held, bridged under g_mu: Run/
+// accessors lock p->mu before g_mu is released, so Destroy (which
+// erases under g_mu first) can never free a predictor in the window
+// between lookup and lock.
+CPredictor* acquire(int64_t h, std::unique_lock<std::mutex>& lk) {
   std::lock_guard<std::mutex> g(g_mu);
   auto it = g_preds.find(h);
-  return it == g_preds.end() ? nullptr : it->second;
+  if (it == g_preds.end()) return nullptr;
+  lk = std::unique_lock<std::mutex>(it->second->mu);
+  return it->second;
 }
 
 }  // namespace
@@ -117,9 +123,10 @@ void PD_PredictorDestroy(int64_t h) {
 int PD_PredictorRun(int64_t h, int n_inputs, const int* dtypes,
                     const int* ndims, const int64_t* const* dims,
                     const void* const* data) {
-  CPredictor* p = get(h);
-  if (!p || n_inputs < 0 || n_inputs > 255) return -1;
-  std::lock_guard<std::mutex> lock(p->mu);
+  if (n_inputs < 0 || n_inputs > 255) return -1;
+  std::unique_lock<std::mutex> lock;
+  CPredictor* p = acquire(h, lock);
+  if (!p) return -1;
   std::vector<char> body;
   body.push_back((char)1);
   body.push_back((char)n_inputs);
@@ -173,31 +180,36 @@ int PD_PredictorRun(int64_t h, int n_inputs, const int* dtypes,
 }
 
 int PD_PredictorNumOutputs(int64_t h) {
-  CPredictor* p = get(h);
+  std::unique_lock<std::mutex> lock;
+  CPredictor* p = acquire(h, lock);
   return p ? (int)p->out_data.size() : -1;
 }
 
 int PD_PredictorOutputNdim(int64_t h, int i) {
-  CPredictor* p = get(h);
+  std::unique_lock<std::mutex> lock;
+  CPredictor* p = acquire(h, lock);
   if (!p || i < 0 || i >= (int)p->out_dims.size()) return -1;
   return (int)p->out_dims[i].size();
 }
 
 int PD_PredictorOutputDims(int64_t h, int i, int64_t* out) {
-  CPredictor* p = get(h);
+  std::unique_lock<std::mutex> lock;
+  CPredictor* p = acquire(h, lock);
   if (!p || i < 0 || i >= (int)p->out_dims.size()) return -1;
   std::memcpy(out, p->out_dims[i].data(), p->out_dims[i].size() * 8);
   return 0;
 }
 
 int PD_PredictorOutputDtype(int64_t h, int i) {
-  CPredictor* p = get(h);
+  std::unique_lock<std::mutex> lock;
+  CPredictor* p = acquire(h, lock);
   if (!p || i < 0 || i >= (int)p->out_dtype.size()) return -1;
   return p->out_dtype[i];
 }
 
 int PD_PredictorOutputData(int64_t h, int i, void* out, int64_t bytes) {
-  CPredictor* p = get(h);
+  std::unique_lock<std::mutex> lock;
+  CPredictor* p = acquire(h, lock);
   if (!p || i < 0 || i >= (int)p->out_data.size()) return -1;
   if ((int64_t)p->out_data[i].size() != bytes) return -1;
   std::memcpy(out, p->out_data[i].data(), bytes);

@@ -1,0 +1,118 @@
+"""Real-model dygraph-vs-to_static numeric equality (reference:
+unittests/dygraph_to_static/ compiles ResNet/BERT/seq2seq and asserts
+dygraph == static numerics; SURVEY §4 API/layer tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _train_traj(model_fn, data_fn, steps=4, use_to_static=False, seed=21,
+                opt_fn=None):
+    paddle.seed(seed)
+    model = model_fn()
+    opt = (opt_fn(model) if opt_fn is not None
+           else optimizer.Adam(1e-3, parameters=model.parameters()))
+    fwd = paddle.jit.to_static(model) if use_to_static else model
+    losses = []
+    for i in range(steps):
+        x, y, loss_fn = data_fn(i)
+        loss = loss_fn(fwd(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+class TestRealModelParity:
+    def test_bert_tiny_dygraph_equals_to_static(self):
+        from paddle_tpu.text.models import BertModel
+
+        def model_fn():
+            bert = BertModel(vocab_size=128, hidden_size=32,
+                             num_hidden_layers=2, num_attention_heads=2,
+                             intermediate_size=64,
+                             hidden_dropout_prob=0.0,
+                             attention_probs_dropout_prob=0.0)
+
+            class Head(nn.Layer):
+                def __init__(self):
+                    super().__init__()
+                    self.bert = bert
+                    self.cls = nn.Linear(32, 2)
+
+                def forward(self, ids):
+                    seq, pooled = self.bert(ids)
+                    return self.cls(pooled)
+
+            return Head()
+
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 128, (4, 16)).astype(np.int32)
+        labels = rng.randint(0, 2, (4,)).astype(np.int64)
+        ce = nn.CrossEntropyLoss()
+
+        def data_fn(i):
+            return (paddle.to_tensor(ids), paddle.to_tensor(labels),
+                    lambda out, y: ce(out, y))
+
+        eager = _train_traj(model_fn, data_fn)
+        static = _train_traj(model_fn, data_fn, use_to_static=True)
+        np.testing.assert_allclose(static, eager, rtol=1e-5, atol=1e-6)
+
+    def test_resnet18_forward_parity_and_both_train(self):
+        """conv+BN chains reorder float math under whole-graph fusion vs
+        per-op eager kernels (the reference's dy2static ResNet test gets
+        1e-5 only because both paths share the same cuDNN kernels), and
+        the BN variance normalization amplifies the reorder — so the
+        oracle here is forward parity at fusion tolerance plus training
+        convergence in both modes, not bitwise trajectory equality."""
+        from paddle_tpu.vision.models import resnet18
+
+        rng = np.random.RandomState(1)
+        x = rng.rand(4, 3, 32, 32).astype(np.float32)
+        y = rng.randint(0, 4, (4,)).astype(np.int64)
+        ce = nn.CrossEntropyLoss()
+
+        paddle.seed(21)
+        m = resnet18(num_classes=4)
+        out_e = np.asarray(m(paddle.to_tensor(x))._value)
+        fwd = paddle.jit.to_static(m)
+        out_s = np.asarray(fwd(paddle.to_tensor(x))._value)
+        scale = np.max(np.abs(out_e)) + 1e-6
+        assert np.max(np.abs(out_e - out_s)) / scale < 5e-3
+
+        def model_fn():
+            return resnet18(num_classes=4)
+
+        def data_fn(i):
+            return (paddle.to_tensor(x), paddle.to_tensor(y),
+                    lambda out, t: ce(out, t))
+
+        sgd = lambda mm: optimizer.SGD(0.05, parameters=mm.parameters())
+        eager = _train_traj(model_fn, data_fn, steps=6, opt_fn=sgd)
+        static = _train_traj(model_fn, data_fn, steps=6,
+                             use_to_static=True, opt_fn=sgd)
+        assert eager[-1] < eager[0] * 0.8, eager
+        assert static[-1] < static[0] * 0.8, static
+        # first-step losses agree to fusion tolerance
+        np.testing.assert_allclose(static[0], eager[0], rtol=2e-3)
+
+    def test_gpt_tiny_generation_same_tokens(self):
+        from paddle_tpu.text import GPTModel, generation
+
+        paddle.seed(7)
+        model = GPTModel(vocab_size=61, hidden_size=32, num_layers=2,
+                         num_heads=4, max_seq_len=32)
+        prompt = np.array([[5, 9, 2]], np.int32)
+        eager_out = generation.generate(model, prompt, max_new_tokens=5)
+        fwd = paddle.jit.to_static(model)
+        # manual greedy over the to_static forward
+        ids = prompt.copy()
+        for _ in range(5):
+            logits = np.asarray(fwd(paddle.to_tensor(ids))._value)
+            nxt = logits[:, -1].argmax(-1).astype(np.int32)
+            ids = np.concatenate([ids, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(eager_out), ids)
