@@ -17,10 +17,12 @@ import (
 	"net"
 )
 
-// Tensor is a dense f32 row-major array.
+// Tensor is a dense row-major array: set Data for f32 payloads or
+// IntData for i32 payloads (token ids etc.) — exactly one of the two.
 type Tensor struct {
-	Dims []int64
-	Data []float32
+	Dims    []int64
+	Data    []float32
+	IntData []int32
 }
 
 // Predictor holds one connection to a PredictorServer.
@@ -41,13 +43,26 @@ func (p *Predictor) Close() error { return p.conn.Close() }
 // Run sends the inputs and returns the model outputs.
 func (p *Predictor) Run(inputs []Tensor) ([]Tensor, error) {
 	body := []byte{1, byte(len(inputs))}
-	for _, t := range inputs {
-		body = append(body, 0, byte(len(t.Dims)))
+	for i, t := range inputs {
+		if (t.Data != nil) == (t.IntData != nil) {
+			return nil, fmt.Errorf("input %d: set exactly one of Data / IntData", i)
+		}
+		dtype := byte(0)
+		if t.IntData != nil {
+			dtype = 1
+		}
+		body = append(body, dtype, byte(len(t.Dims)))
 		for _, d := range t.Dims {
 			body = binary.LittleEndian.AppendUint64(body, uint64(d))
 		}
-		for _, v := range t.Data {
-			body = binary.LittleEndian.AppendUint32(body, math.Float32bits(v))
+		if t.IntData != nil {
+			for _, v := range t.IntData {
+				body = binary.LittleEndian.AppendUint32(body, uint32(v))
+			}
+		} else {
+			for _, v := range t.Data {
+				body = binary.LittleEndian.AppendUint32(body, math.Float32bits(v))
+			}
 		}
 	}
 	hdr := binary.LittleEndian.AppendUint32(nil, uint32(len(body)))
@@ -80,30 +95,45 @@ func (p *Predictor) Run(inputs []Tensor) ([]Tensor, error) {
 			return nil, fmt.Errorf("truncated output %d header", i)
 		}
 		dtype := resp[off]
-		if dtype != 0 {
-			return nil, fmt.Errorf("output %d has dtype %d; this client decodes f32 only", i, dtype)
+		if dtype > 1 {
+			return nil, fmt.Errorf("output %d has unknown dtype %d", i, dtype)
 		}
 		ndim := int(resp[off+1])
 		off += 2
 		dims := make([]int64, ndim)
-		count := 1
+		count := int64(1)
+		maxCount := int64(len(resp)-off) / 4
 		for d := 0; d < ndim; d++ {
 			if off+8 > len(resp) {
 				return nil, fmt.Errorf("truncated dims of output %d", i)
 			}
 			dims[d] = int64(binary.LittleEndian.Uint64(resp[off:]))
 			off += 8
-			count *= int(dims[d])
+			// bound before multiplying: corrupt dims must error, not
+			// overflow past the length check and panic in make()
+			if dims[d] < 0 || (dims[d] > 0 && count > maxCount/dims[d]) {
+				return nil, fmt.Errorf("output %d dims exceed payload", i)
+			}
+			count *= dims[d]
 		}
-		if off+count*4 > len(resp) {
+		if off+int(count)*4 > len(resp) {
 			return nil, fmt.Errorf("truncated data of output %d", i)
 		}
-		data := make([]float32, count)
-		for j := 0; j < count; j++ {
-			data[j] = math.Float32frombits(binary.LittleEndian.Uint32(resp[off:]))
-			off += 4
+		out := Tensor{Dims: dims}
+		if dtype == 1 {
+			out.IntData = make([]int32, count)
+			for j := range out.IntData {
+				out.IntData[j] = int32(binary.LittleEndian.Uint32(resp[off:]))
+				off += 4
+			}
+		} else {
+			out.Data = make([]float32, count)
+			for j := range out.Data {
+				out.Data[j] = math.Float32frombits(binary.LittleEndian.Uint32(resp[off:]))
+				off += 4
+			}
 		}
-		outs = append(outs, Tensor{Dims: dims, Data: data})
+		outs = append(outs, out)
 	}
 	return outs, nil
 }

@@ -15,16 +15,22 @@ pd_connect <- function(host = "127.0.0.1", port) {
   writeBin(0L, buf, size = 4, endian = "little")
 }
 
-pd_predict <- function(con, x) {
+pd_predict <- function(con, x, dtype = c("float32", "int32")) {
+  dtype <- match.arg(dtype)
   dims <- if (is.null(dim(x))) length(x) else dim(x)
   # R stores column-major; the wire format is row-major — aperm handles
   # any rank (t() would fail beyond matrices)
   data <- if (is.null(dim(x))) as.numeric(x) else
     as.numeric(aperm(x, rev(seq_along(dims))))
+  code <- if (dtype == "int32") 1 else 0
   buf <- rawConnection(raw(0), "w")
-  writeBin(as.raw(c(1, 1, 0, length(dims))), buf)
+  writeBin(as.raw(c(1, 1, code, length(dims))), buf)
   for (d in dims) .write_i64(buf, d)
-  writeBin(data, buf, size = 4, endian = "little")
+  if (dtype == "int32") {
+    writeBin(as.integer(data), buf, size = 4, endian = "little")
+  } else {
+    writeBin(data, buf, size = 4, endian = "little")
+  }
   body <- rawConnectionValue(buf)
   close(buf)
   writeBin(length(body), con, size = 4, endian = "little")
@@ -38,6 +44,7 @@ pd_predict <- function(con, x) {
   n_out <- as.integer(resp[off]); off <- off + 1
   outs <- vector("list", n_out)
   for (i in seq_len(n_out)) {
+    out_code <- as.integer(resp[off])
     ndim <- as.integer(resp[off + 1]); off <- off + 2
     odims <- integer(ndim)
     for (d in seq_len(ndim)) {
@@ -46,8 +53,11 @@ pd_predict <- function(con, x) {
       off <- off + 8
     }
     count <- prod(odims)
-    vals <- readBin(resp[off:(off + count * 4 - 1)], "numeric", n = count,
-                    size = 4, endian = "little")
+    vals <- if (out_code == 1)
+      readBin(resp[off:(off + count * 4 - 1)], "integer", n = count,
+              size = 4, endian = "little") else
+      readBin(resp[off:(off + count * 4 - 1)], "numeric", n = count,
+              size = 4, endian = "little")
     off <- off + count * 4
     # wire is row-major: fill a reversed array then permute back
     outs[[i]] <- if (ndim >= 2)

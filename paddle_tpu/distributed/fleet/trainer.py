@@ -77,19 +77,12 @@ class MultiTrainer:
                         continue
         finally:
             # sentinels with the same bounded-put discipline: workers may
-            # die between the liveness check and the put, so drain-and-
-            # retry instead of a blocking put that could wedge forever
+            # die between the liveness check and the put. Dead workers
+            # need no sentinel at all (they already exited), so the
+            # all-dead branch just stops producing.
             pending = len(workers)
             while pending:
                 if not any(w.is_alive() for w in workers):
-                    while True:
-                        try:
-                            batch_q.get_nowait()
-                        except queue.Empty:
-                            break
-                    while pending:
-                        batch_q.put(None)
-                        pending -= 1
                     break
                 try:
                     batch_q.put(None, timeout=0.5)

@@ -1,0 +1,96 @@
+"""Legacy fleet v1 compat facade (reference:
+python/paddle/fluid/incubate/fleet/ — the pre-2.0 fleet API that old
+user scripts still import: fleet.init(role), fleet.distributed_optimizer,
+init_server/init_worker/stop_worker, is_first_worker, worker_index...).
+
+Everything delegates to the modern stack (distributed.fleet +
+distributed.ps); the old program-rewrite backends (DistributeTranspiler
+program surgery, pslib) have no TPU analog — their capability lives in
+the XLA SPMD step and the native PS tables instead.
+"""
+from ..distributed import fleet as _fleet_mod
+from ..distributed.fleet import DistributedStrategy  # noqa: F401
+from ..distributed.fleet import Role, UserDefinedRoleMaker  # noqa: F401
+
+_inner = None
+
+
+def _get():
+    global _inner
+    if _inner is None:
+        _inner = _fleet_mod.Fleet()
+    return _inner
+
+
+def init(role_maker=None, is_collective=False, strategy=None):
+    return _get().init(role_maker=role_maker, is_collective=is_collective,
+                       strategy=strategy)
+
+
+def is_first_worker():
+    f = _get()
+    return f.worker_index() == 0
+
+
+def worker_index():
+    return _get().worker_index()
+
+
+def worker_num():
+    return _get().worker_num()
+
+
+def is_worker():
+    return _get().is_worker()
+
+
+def is_server():
+    return _get().is_server()
+
+
+def init_server(*args, **kwargs):
+    return _get().init_server(*args, **kwargs)
+
+
+def run_server(*args, **kwargs):
+    return _get().run_server(*args, **kwargs)
+
+
+def init_worker(*args, **kwargs):
+    return _get().init_worker(*args, **kwargs)
+
+
+def stop_worker():
+    return _get().stop_worker()
+
+
+def stop_server():
+    return _get().stop_server()
+
+
+def set_ps_tables(cfgs):
+    return _get().set_ps_tables(cfgs)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return _get().distributed_optimizer(optimizer, strategy=strategy)
+
+
+class DistributeTranspiler:
+    """reference: fluid/transpiler/distribute_transpiler.py — rewrote
+    programs into trainer/pserver halves around send/recv ops. The TPU
+    framework has no program surgery: collective training is the SPMD
+    step and PS training is the distributed.ps client/server pair, so
+    transpile() is a loud pointer, not a silent no-op."""
+
+    def __init__(self, config=None):
+        self.config = config
+
+    def transpile(self, trainer_id, program=None, pservers="", trainers=1,
+                  sync_mode=True, startup_program=None,
+                  current_endpoint=""):
+        raise NotImplementedError(
+            "program transpilation does not exist on TPU: use "
+            "distributed.spmd.build_train_step for collective training, "
+            "or distributed.fleet init_server()/init_worker() (tables in "
+            "distributed.ps) for parameter-server training")

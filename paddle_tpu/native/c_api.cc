@@ -15,6 +15,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
 #include <mutex>
@@ -47,6 +48,7 @@ bool wr(int fd, const void* p, size_t n) {
 
 struct CPredictor {
   int fd = -1;
+  int refs = 0;  // in-flight Run/accessor count (guarded by g_mu)
   std::mutex mu;
   // last response's outputs (owned here; valid until next Run/destroy)
   std::vector<std::vector<char>> out_data;
@@ -55,19 +57,37 @@ struct CPredictor {
 };
 
 std::mutex g_mu;
+std::condition_variable g_cv;
 std::unordered_map<int64_t, CPredictor*> g_preds;
 int64_t g_next = 1;
 
-// Acquire the predictor WITH its mutex held, bridged under g_mu: Run/
-// accessors lock p->mu before g_mu is released, so Destroy (which
-// erases under g_mu first) can never free a predictor in the window
-// between lookup and lock.
-CPredictor* acquire(int64_t h, std::unique_lock<std::mutex>& lk) {
-  std::lock_guard<std::mutex> g(g_mu);
-  auto it = g_preds.find(h);
-  if (it == g_preds.end()) return nullptr;
-  lk = std::unique_lock<std::mutex>(it->second->mu);
-  return it->second;
+// Refcounted access: a Guard pins the predictor (refs++ under g_mu,
+// so Destroy waits for refs==0 before freeing) and then takes its
+// per-predictor mutex WITHOUT holding g_mu — a slow inference never
+// stalls the registry, and Destroy's shutdown() can always run to
+// unblock a parked read.
+struct Guard {
+  CPredictor* p = nullptr;
+  std::unique_lock<std::mutex> lk;
+
+  ~Guard() {
+    if (!p) return;
+    if (lk.owns_lock()) lk.unlock();  // before the unpin, not after
+    std::lock_guard<std::mutex> g(g_mu);
+    if (--p->refs == 0) g_cv.notify_all();
+  }
+};
+
+CPredictor* acquire(int64_t h, Guard& gd) {
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_preds.find(h);
+    if (it == g_preds.end()) return nullptr;
+    gd.p = it->second;
+    gd.p->refs++;
+  }
+  gd.lk = std::unique_lock<std::mutex>(gd.p->mu);
+  return gd.p;
 }
 
 }  // namespace
@@ -105,15 +125,14 @@ void PD_PredictorDestroy(int64_t h) {
     p = it->second;
     g_preds.erase(it);  // no NEW Run can reach p past this point
   }
-  // unblock any Run parked in a socket read, then wait for it to
-  // release the predictor mutex before freeing (delete under a held
-  // mutex would be use-after-free + destroying a locked mutex)
+  // unblock any Run parked in a socket read, then wait until every
+  // pinned Guard drops before freeing
   if (p->fd >= 0) ::shutdown(p->fd, SHUT_RDWR);
   {
-    std::lock_guard<std::mutex> lock(p->mu);
-    if (p->fd >= 0) ::close(p->fd);
-    p->fd = -1;
+    std::unique_lock<std::mutex> g(g_mu);
+    g_cv.wait(g, [&] { return p->refs == 0; });
   }
+  if (p->fd >= 0) ::close(p->fd);
   delete p;
 }
 
@@ -124,8 +143,8 @@ int PD_PredictorRun(int64_t h, int n_inputs, const int* dtypes,
                     const int* ndims, const int64_t* const* dims,
                     const void* const* data) {
   if (n_inputs < 0 || n_inputs > 255) return -1;
-  std::unique_lock<std::mutex> lock;
-  CPredictor* p = acquire(h, lock);
+  Guard gd;
+  CPredictor* p = acquire(h, gd);
   if (!p) return -1;
   std::vector<char> body;
   body.push_back((char)1);
@@ -180,36 +199,36 @@ int PD_PredictorRun(int64_t h, int n_inputs, const int* dtypes,
 }
 
 int PD_PredictorNumOutputs(int64_t h) {
-  std::unique_lock<std::mutex> lock;
-  CPredictor* p = acquire(h, lock);
+  Guard gd;
+  CPredictor* p = acquire(h, gd);
   return p ? (int)p->out_data.size() : -1;
 }
 
 int PD_PredictorOutputNdim(int64_t h, int i) {
-  std::unique_lock<std::mutex> lock;
-  CPredictor* p = acquire(h, lock);
+  Guard gd;
+  CPredictor* p = acquire(h, gd);
   if (!p || i < 0 || i >= (int)p->out_dims.size()) return -1;
   return (int)p->out_dims[i].size();
 }
 
 int PD_PredictorOutputDims(int64_t h, int i, int64_t* out) {
-  std::unique_lock<std::mutex> lock;
-  CPredictor* p = acquire(h, lock);
+  Guard gd;
+  CPredictor* p = acquire(h, gd);
   if (!p || i < 0 || i >= (int)p->out_dims.size()) return -1;
   std::memcpy(out, p->out_dims[i].data(), p->out_dims[i].size() * 8);
   return 0;
 }
 
 int PD_PredictorOutputDtype(int64_t h, int i) {
-  std::unique_lock<std::mutex> lock;
-  CPredictor* p = acquire(h, lock);
+  Guard gd;
+  CPredictor* p = acquire(h, gd);
   if (!p || i < 0 || i >= (int)p->out_dtype.size()) return -1;
   return p->out_dtype[i];
 }
 
 int PD_PredictorOutputData(int64_t h, int i, void* out, int64_t bytes) {
-  std::unique_lock<std::mutex> lock;
-  CPredictor* p = acquire(h, lock);
+  Guard gd;
+  CPredictor* p = acquire(h, gd);
   if (!p || i < 0 || i >= (int)p->out_data.size()) return -1;
   if ((int64_t)p->out_data[i].size() != bytes) return -1;
   std::memcpy(out, p->out_data[i].data(), bytes);

@@ -195,6 +195,42 @@ class Executor:
 
         return jax.jit(train_fn)
 
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=1, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Dataset-channel training loop (reference: executor.py
+        train_from_dataset -> MultiTrainer + HogwildWorker, trainer.h:52).
+        Dataset batches (labels, {slot: ids}) feed the program's
+        placeholders by slot name plus 'label'. ``thread`` workers
+        overlap dataset decode/feed conversion; the optimization steps
+        themselves serialize on the program lock (Program replay swaps
+        parameter state non-atomically — unlike the reference's C++
+        scopes, concurrent replay is not safe, so this trades the
+        reference's lock-free hogwild updates for pipeline overlap
+        only). Returns the per-batch fetch results in completion
+        order."""
+        import threading as _threading
+
+        from ..distributed.fleet.trainer import MultiTrainer
+
+        program = program or default_main_program()
+        fetch_list = fetch_list or []
+        results = []
+        lock = _threading.Lock()
+
+        def train_one(labels, slots):
+            feed = dict(slots)
+            feed["label"] = np.asarray(labels, np.float32).reshape(-1, 1)
+            with lock:  # program replay mutates params; hogwild applies
+                out = self.run(program, feed=feed, fetch_list=fetch_list)
+            results.append(out)
+            return float(np.asarray(out[0]).ravel()[0]) if out else 0.0
+
+        MultiTrainer(train_one,
+                     num_threads=max(1, int(thread))).train_from_dataset(
+            dataset)
+        return results
+
     def close(self):
         pass
 

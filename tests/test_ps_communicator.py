@@ -244,3 +244,24 @@ class TestMultiTrainerHogwild:
         assert (np.mean(all_losses[-4:])
                 < np.mean(all_losses[:4]) - 0.05), (
             all_losses[:4], all_losses[-4:])
+
+
+class TestIncubateFleetV1Compat:
+    def test_v1_facade_delegates(self):
+        from paddle_tpu.incubate import fleet as fleet_v1
+
+        fleet_v1.init(role_maker=fleet_v1.UserDefinedRoleMaker(
+            role=fleet_v1.Role.WORKER, worker_num=1, server_endpoints=[]))
+        assert fleet_v1.is_worker() and not fleet_v1.is_server()
+        assert fleet_v1.is_first_worker()
+        cfgs = rec.make_ps_tables(emb_dim=4)
+        fleet_v1.set_ps_tables(cfgs)
+        client = fleet_v1.init_worker()
+        assert client.pull_sparse(1, np.array([3])).shape == (1, 4)
+        fleet_v1.stop_worker()
+
+    def test_transpiler_raises_loudly(self):
+        from paddle_tpu.incubate.fleet import DistributeTranspiler
+
+        with pytest.raises(NotImplementedError, match="spmd"):
+            DistributeTranspiler().transpile(0)

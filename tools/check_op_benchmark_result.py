@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Compare two op_bench result files and flag regressions (reference:
+tools/check_op_benchmark_result.py CI gate).
+
+Usage: python tools/check_op_benchmark_result.py base.json new.json \
+           [--threshold 0.15]
+Exit 1 when any op slowed down by more than threshold."""
+import argparse
+import json
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("baseline")
+    ap.add_argument("candidate")
+    ap.add_argument("--threshold", type=float, default=0.15)
+    ns = ap.parse_args()
+    base = {(r["op"], json.dumps(r["shapes"])): r["latency_us"]
+            for r in json.load(open(ns.baseline))}
+    cand = {(r["op"], json.dumps(r["shapes"])): r["latency_us"]
+            for r in json.load(open(ns.candidate))}
+    failures = []
+    for key, b in base.items():
+        c = cand.get(key)
+        if c is None:
+            continue
+        ratio = (c - b) / b
+        status = "REGRESSED" if ratio > ns.threshold else "ok"
+        print(f"{key[0]:<16} {key[1]:<36} {b:>9.2f} -> {c:>9.2f} us "
+              f"({ratio:+.1%}) {status}")
+        if ratio > ns.threshold:
+            failures.append(key)
+    if failures:
+        print(f"{len(failures)} op(s) regressed past "
+              f"{ns.threshold:.0%}", file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

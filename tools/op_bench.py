@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Config-driven op micro-benchmark harness (reference:
+paddle/fluid/operators/benchmark/op_tester.cc + op_tester_config; CI
+gate tools/check_op_benchmark_result.py).
+
+Config: JSON list of cases, each
+  {"op": "matmul", "shapes": [[1024,1024],[1024,1024]], "dtype":
+   "float32", "kwargs": {...}, "repeat": 50}
+`op` resolves against paddle_tpu.tensor / paddle_tpu.nn.functional /
+paddle_tpu. Timing is the jitted steady state (compile excluded), the
+same protocol bench.py uses.
+
+Usage:
+  python tools/op_bench.py --config cases.json --out result.json
+  python tools/op_bench.py --quick            # built-in smoke set
+"""
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+QUICK = [
+    {"op": "matmul", "shapes": [[512, 512], [512, 512]]},
+    {"op": "add", "shapes": [[1024, 1024], [1024, 1024]]},
+    {"op": "softmax", "shapes": [[256, 1024]], "kwargs": {"axis": -1}},
+    {"op": "layer_norm", "shapes": [[256, 1024]],
+     "kwargs": {"normalized_shape": 1024}},
+    {"op": "relu", "shapes": [[1024, 1024]]},
+]
+
+
+def _resolve(op):
+    import paddle_tpu as paddle
+    from paddle_tpu import tensor as pt
+    from paddle_tpu.nn import functional as F
+
+    for mod in (pt, F, paddle):
+        fn = getattr(mod, op, None)
+        if fn is not None:
+            return fn
+    raise KeyError(f"op {op!r} not found in tensor/functional/paddle")
+
+
+def run_case(case):
+    import paddle_tpu as paddle
+
+    fn = _resolve(case["op"])
+    dtype = case.get("dtype", "float32")
+    rng = np.random.RandomState(0)
+    args = [paddle.to_tensor((rng.rand(*s) + 0.1).astype(dtype))
+            for s in case["shapes"]]
+    kwargs = case.get("kwargs", {})
+    repeat = int(case.get("repeat", 50))
+
+    def call():
+        out = fn(*args, **kwargs)
+        return out[0] if isinstance(out, tuple) else out
+
+    out = call()  # compile
+    import jax
+
+    jax.block_until_ready(out._value if hasattr(out, "_value") else out)
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        out = call()
+    jax.block_until_ready(out._value if hasattr(out, "_value") else out)
+    dt = (time.perf_counter() - t0) / repeat
+    return {"op": case["op"], "shapes": case["shapes"],
+            "latency_us": round(dt * 1e6, 2)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config")
+    ap.add_argument("--out")
+    ap.add_argument("--quick", action="store_true")
+    ns = ap.parse_args()
+    cases = QUICK if ns.quick or not ns.config else \
+        json.load(open(ns.config))
+    results = []
+    for case in cases:
+        r = run_case(case)
+        results.append(r)
+        print(f"{r['op']:<16} {str(r['shapes']):<36} "
+              f"{r['latency_us']:>10.2f} us", file=sys.stderr)
+    if ns.out:
+        json.dump(results, open(ns.out, "w"), indent=1)
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()
