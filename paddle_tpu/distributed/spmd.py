@@ -157,6 +157,15 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
                 out = layer.forward(Tensor(x, stop_gradient=True))
                 out_arr = out._value if isinstance(out, Tensor) else out
                 loss = loss_fn(out_arr, y)
+                # auxiliary losses emitted during the forward (MoE
+                # load-balancing etc.) join the objective here — and are
+                # cleared so no tracer outlives the trace
+                for _, sub in layer.named_sublayers(include_self=True):
+                    aux = getattr(sub, "aux_loss", None)
+                    if aux is not None:
+                        loss = loss + (aux._value if isinstance(aux, Tensor)
+                                       else aux)
+                        sub.aux_loss = None
                 # capture in-forward buffer updates (BatchNorm running
                 # stats, QAT moving scales) so they thread through the
                 # compiled step instead of silently freezing at init

@@ -16,19 +16,20 @@ from jax.sharding import Mesh
 _HYBRID_GROUP = None
 _GLOBAL_MESH = None
 
-AXIS_ORDER = ("dp", "pp", "sharding", "sp", "mp")
+AXIS_ORDER = ("dp", "pp", "sharding", "sp", "ep", "mp")
 
 
-def build_mesh(dp=1, mp=1, pp=1, sharding=1, sp=1, devices=None):
+def build_mesh(dp=1, mp=1, pp=1, sharding=1, sp=1, ep=1, devices=None):
     devices = devices if devices is not None else jax.devices()
-    n = dp * mp * pp * sharding * sp
+    n = dp * mp * pp * sharding * sp * ep
     if n == 1 and len(devices) > 1:
         dp = len(devices)
         n = dp
     if n > len(devices):
         raise ValueError(f"topology dp{dp}xpp{pp}xsharding{sharding}xsp{sp}"
-                         f"xmp{mp}={n} needs {n} devices, have {len(devices)}")
-    arr = np.asarray(devices[:n]).reshape(dp, pp, sharding, sp, mp)
+                         f"xep{ep}xmp{mp}={n} needs {n} devices, have "
+                         f"{len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(dp, pp, sharding, sp, ep, mp)
     return Mesh(arr, AXIS_ORDER)
 
 
@@ -41,7 +42,8 @@ def get_global_mesh():
     global _GLOBAL_MESH
     if _GLOBAL_MESH is None:
         devs = jax.devices()
-        _GLOBAL_MESH = Mesh(np.asarray(devs).reshape(len(devs), 1, 1, 1, 1), AXIS_ORDER)
+        _GLOBAL_MESH = Mesh(np.asarray(devs).reshape(
+            (len(devs),) + (1,) * (len(AXIS_ORDER) - 1)), AXIS_ORDER)
     return _GLOBAL_MESH
 
 

@@ -27,7 +27,8 @@ def mesh8():
 
 class TestTopology:
     def test_mesh_shapes(self, mesh8):
-        assert dict(mesh8.shape) == {"dp": 2, "pp": 1, "sharding": 2, "sp": 1, "mp": 2}
+        assert dict(mesh8.shape) == {"dp": 2, "pp": 1, "sharding": 2,
+                                     "sp": 1, "ep": 1, "mp": 2}
 
     def test_communicate_topology(self):
         topo = topology.CommunicateTopology(("data", "pipe", "sharding", "model"),

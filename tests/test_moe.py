@@ -1,0 +1,144 @@
+"""MoE + expert parallelism over the 'ep' mesh axis."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import spmd, topology
+from paddle_tpu.incubate.moe import MoELayer
+
+
+class TestMoELayer:
+    def test_topk_gating_math(self):
+        """With a forced one-hot gate, MoE output equals that single
+        expert's FFN."""
+        import jax
+        import jax.numpy as jnp
+
+        paddle.seed(0)
+        moe = MoELayer(8, 16, num_experts=4, top_k=1)
+        # rig the gate toward expert 2
+        gw = np.zeros((8, 4), np.float32)
+        gw[:, 2] = 5.0
+        moe.gate.weight.set_value(gw)
+        moe.gate.bias.set_value(np.array([0, 0, 50.0, 0], np.float32))
+        x = np.random.RandomState(0).rand(2, 3, 8).astype(np.float32)
+        out = np.asarray(moe(paddle.to_tensor(x))._value)
+        w_up = np.asarray(moe.w_up._value)[2]
+        w_down = np.asarray(moe.w_down._value)[2]
+        ref = np.asarray(jax.nn.gelu(jnp.asarray(x @ w_up))) @ w_down
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+        assert moe.aux_loss is not None
+
+    def test_trains_with_ep_sharding(self):
+        import jax.numpy as jnp
+
+        mesh = topology.build_mesh(dp=2, ep=4)
+        topology.set_global_mesh(mesh)
+        paddle.seed(1)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.inp = nn.Linear(8, 8)
+                self.moe = MoELayer(8, 16, num_experts=4, top_k=2)
+                self.out = nn.Linear(8, 4)
+
+            def forward(self, x):
+                h = self.inp(x)
+                h = h + self.moe(h)
+                return self.out(h)
+
+        net = Net()
+        opt = optimizer.Adam(5e-3, parameters=net.parameters())
+
+        def loss_fn(out, y):
+            return jnp.mean((out - y) ** 2)
+
+        step, init = spmd.build_train_step(net, loss_fn, opt, mesh=mesh)
+        params, st = init()
+        # expert weights sharded over ep
+        w = params["moe.w_up"]
+        assert w.sharding.spec == spmd.P("ep")
+        assert w.addressable_shards[0].data.shape[0] == 1  # 4 experts / 4
+        x = np.random.RandomState(0).rand(8, 3, 8).astype(np.float32)
+        y = np.random.RandomState(1).rand(8, 3, 4).astype(np.float32)
+        losses = []
+        for _ in range(12):
+            loss, params, st = step(params, st, x, y)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5, losses[::4]
+
+    def test_ep_matches_single_device(self):
+        """ep-sharded training == unsharded training (expert-parallel
+        parity, the dp-vs-single oracle applied to 'ep')."""
+        import jax.numpy as jnp
+
+        def build_and_train(ep):
+            import jax
+
+            mesh = topology.build_mesh(dp=1, ep=ep,
+                                       devices=jax.devices()[:ep])
+            topology.set_global_mesh(mesh)
+            paddle.seed(3)
+            net = MoELayer(8, 16, num_experts=4, top_k=2)
+            opt = optimizer.SGD(0.1, parameters=net.parameters())
+            step, init = spmd.build_train_step(
+                net, lambda o, t: jnp.mean((o - t) ** 2), opt, mesh=mesh)
+            params, st = init()
+            x = np.random.RandomState(0).rand(4, 3, 8).astype(np.float32)
+            y = np.random.RandomState(1).rand(4, 3, 8).astype(np.float32)
+            out = []
+            for _ in range(3):
+                loss, params, st = step(params, st, x, y)
+                out.append(float(loss))
+            return out
+
+        ref = build_and_train(1)
+        ep4 = build_and_train(4)
+        np.testing.assert_allclose(ep4, ref, rtol=2e-5, atol=1e-7)
+
+
+class TestMoEReviewRegressions:
+    def test_uniform_probs_select_exactly_topk(self):
+        import jax
+        import jax.numpy as jnp
+
+        paddle.seed(0)
+        moe = MoELayer(8, 16, num_experts=4, top_k=1)
+        moe.gate.weight.set_value(np.zeros((8, 4), np.float32))
+        moe.gate.bias.set_value(np.zeros(4, np.float32))
+        x = np.zeros((1, 1, 8), np.float32)  # padding token, uniform gate
+        out = np.asarray(moe(paddle.to_tensor(x))._value)
+        # exactly ONE expert (index 0 wins ties), gate weight renorms to 1
+        w_up = np.asarray(moe.w_up._value)[0]
+        w_down = np.asarray(moe.w_down._value)[0]
+        ref = np.asarray(jax.nn.gelu(jnp.asarray(x @ w_up))) @ w_down
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_aux_loss_joins_compiled_objective_and_leaves_no_tracer(self):
+        import jax.numpy as jnp
+
+        mesh = topology.build_mesh(dp=1, ep=4)
+        topology.set_global_mesh(mesh)
+        paddle.seed(2)
+        moe = MoELayer(8, 16, num_experts=4, top_k=2, aux_weight=0.5)
+        opt = optimizer.SGD(0.1, parameters=moe.parameters())
+        step, init = spmd.build_train_step(
+            moe, lambda o, t: jnp.mean((o - t) ** 2), opt, mesh=mesh)
+        params, st = init()
+        x = np.random.RandomState(0).rand(4, 3, 8).astype(np.float32)
+        loss_w, _, _ = step(params, st, x, x)
+        # aux cleared: no leaked tracer on the layer
+        assert moe.aux_loss is None
+        # aux actually contributes: same model with aux_weight=0 gives a
+        # strictly smaller compiled loss
+        paddle.seed(2)
+        moe0 = MoELayer(8, 16, num_experts=4, top_k=2, aux_weight=0.0)
+        opt0 = optimizer.SGD(0.1, parameters=moe0.parameters())
+        step0, init0 = spmd.build_train_step(
+            moe0, lambda o, t: jnp.mean((o - t) ** 2), opt0, mesh=mesh)
+        p0, s0 = init0()
+        loss_0, _, _ = step0(p0, s0, x, x)
+        assert float(loss_w) > float(loss_0) + 1e-4, (float(loss_w),
+                                                      float(loss_0))
