@@ -170,10 +170,13 @@ def build_localsgd_train_step(layer, loss_fn, optimizer, mesh=None,
                     from ..amp.auto_cast import auto_cast as _auto_cast
                     stack.enter_context(_auto_cast(
                         enable=True, level=amp_level, dtype=amp_dtype))
+                from ..nn.aux_loss import collect_aux_losses, total_aux_loss
+
                 layer.load_functional_state(params, buffers0)
-                out = layer.forward(Tensor(x, stop_gradient=True))
+                with collect_aux_losses() as auxes:
+                    out = layer.forward(Tensor(x, stop_gradient=True))
                 out_arr = out._value if isinstance(out, Tensor) else out
-                return loss_fn(out_arr, y)
+                return loss_fn(out_arr, y) + total_aux_loss(auxes)
         finally:
             layer.load_functional_state(saved_p, saved_b)
 

@@ -153,19 +153,16 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
                     from ..amp.auto_cast import auto_cast as _auto_cast
                     stack.enter_context(_auto_cast(
                         enable=True, level=amp_level, dtype=amp_dtype))
+                from ..nn.aux_loss import collect_aux_losses, total_aux_loss
+
                 layer.load_functional_state(params, buffers)
-                out = layer.forward(Tensor(x, stop_gradient=True))
-                out_arr = out._value if isinstance(out, Tensor) else out
-                loss = loss_fn(out_arr, y)
                 # auxiliary losses emitted during the forward (MoE
-                # load-balancing etc.) join the objective here — and are
-                # cleared so no tracer outlives the trace
-                for _, sub in layer.named_sublayers(include_self=True):
-                    aux = getattr(sub, "aux_loss", None)
-                    if aux is not None:
-                        loss = loss + (aux._value if isinstance(aux, Tensor)
-                                       else aux)
-                        sub.aux_loss = None
+                # load-balancing etc.) join the objective; routing them
+                # through the collector keeps tracers off the Layer
+                with collect_aux_losses() as auxes:
+                    out = layer.forward(Tensor(x, stop_gradient=True))
+                out_arr = out._value if isinstance(out, Tensor) else out
+                loss = loss_fn(out_arr, y) + total_aux_loss(auxes)
                 # capture in-forward buffer updates (BatchNorm running
                 # stats, QAT moving scales) so they thread through the
                 # compiled step instead of silently freezing at init

@@ -24,12 +24,15 @@ class MoELayer(nn.Layer):
     """Top-k gated expert FFN block (pre-norm residual not included).
 
     forward: [B, S, H] -> [B, S, H]. Gate scores are softmaxed over the
-    selected top_k experts (renormalized, Switch/GShard style); an
-    auxiliary load-balancing loss (GShard aux) is stored on
-    ``self.aux_loss`` after each forward. In eager training add it to
-    the objective yourself; ``spmd.build_train_step`` collects every
-    sublayer's pending ``aux_loss`` into the compiled loss
-    automatically (and clears it, so no tracer outlives the trace).
+    selected top_k experts (renormalized, Switch/GShard style); the
+    auxiliary load-balancing loss (GShard aux) is routed through
+    ``nn.aux_loss.emit_aux_loss``: in eager mode it lands on
+    ``self.aux_loss`` (add it to the objective yourself); inside
+    ``spmd.build_train_step`` / ``comm_opt`` train steps it is collected
+    into the compiled loss automatically; in inference traces
+    (jit.save / onnx.export / generation) it is dropped so no tracer
+    escapes onto the layer. Pipeline/FSDP per-stage applies currently
+    drop it too — add the aux term explicitly there if it matters.
     """
 
     def __init__(self, hidden_size, ffn_hidden, num_experts, top_k=2,
@@ -85,5 +88,7 @@ class MoELayer(nn.Layer):
 
         out, aux = apply_op("moe_ffn", _moe, x, logits, self.w_up,
                             self.w_down, top_k=self.top_k)
-        self.aux_loss = aux * self.aux_weight
+        from ..nn.aux_loss import emit_aux_loss
+
+        emit_aux_loss(self, aux * self.aux_weight)
         return out

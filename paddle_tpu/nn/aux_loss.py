@@ -1,0 +1,56 @@
+"""Auxiliary-loss plumbing for layers whose forward emits a side loss
+(MoE load balancing — reference: incubate/distributed/models/moe in later
+Paddle revs; GShard aux loss).
+
+The hazard: a layer storing ``self.aux_loss`` during a jax trace leaves
+an escaped tracer on the (mutable, long-lived) Layer object, which blows
+up the next time anyone touches it. So emission is routed by context:
+
+- under an active ``collect_aux_losses()`` block (train-step builders:
+  spmd/comm_opt), values go to the collector and join the objective;
+- under a bare trace (jit.save, onnx.export, generation), values are
+  DROPPED — inference traces must not retain training-only tracers;
+- in eager mode, the concrete value is stored on ``layer.aux_loss`` for
+  the user to add to their loss by hand.
+"""
+import contextlib
+import contextvars
+
+from ..core import dispatch
+
+_COLLECTOR = contextvars.ContextVar("aux_loss_collector", default=None)
+
+
+@contextlib.contextmanager
+def collect_aux_losses():
+    """Collect every aux loss emitted by layers during the block; yields
+    the list (of raw arrays) to add to the training objective."""
+    acc = []
+    token = _COLLECTOR.set(acc)
+    try:
+        yield acc
+    finally:
+        _COLLECTOR.reset(token)
+
+
+def emit_aux_loss(layer, value):
+    """Called by a Layer's forward with its auxiliary loss contribution."""
+    from ..core.tensor import Tensor
+
+    raw = value._value if isinstance(value, Tensor) else value
+    acc = _COLLECTOR.get()
+    if acc is not None:
+        acc.append(raw)
+        layer.aux_loss = None
+    elif dispatch.in_trace():
+        layer.aux_loss = None
+    else:
+        layer.aux_loss = value
+
+
+def total_aux_loss(collected):
+    """Sum a collector's list (0.0 when nothing was emitted)."""
+    total = None
+    for v in collected:
+        total = v if total is None else total + v
+    return 0.0 if total is None else total

@@ -1,10 +1,9 @@
-"""paddle.onnx (reference: python/paddle/onnx/export.py). ONNX export from
-XLA requires an ONNX writer dependency not in this image; the API is
-present and raises with guidance (jit.save's StableHLO is the portable
-interchange format here)."""
+"""paddle.onnx (reference: python/paddle/onnx/export.py). The reference
+delegates to paddle2onnx; here the Layer is traced to a jaxpr and lowered
+directly to ONNX (wire.py hand-encodes the protobuf — the onnx package is
+not in this image). runner.py is a numpy evaluator for exported models.
+"""
+from .export import export, export_bytes, UnsupportedOp  # noqa: F401
+from .runner import load, run  # noqa: F401
 
-
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    raise NotImplementedError(
-        "onnx export requires the onnx package (not in this environment); "
-        "use paddle_tpu.jit.save for portable StableHLO export")
+__all__ = ["export", "export_bytes", "load", "run", "UnsupportedOp"]
