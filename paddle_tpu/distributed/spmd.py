@@ -153,7 +153,9 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
                     from ..amp.auto_cast import auto_cast as _auto_cast
                     stack.enter_context(_auto_cast(
                         enable=True, level=amp_level, dtype=amp_dtype))
-                from ..nn.aux_loss import collect_aux_losses, total_aux_loss
+                from ..nn.aux_loss import (collect_aux_losses,
+                                           sweep_direct_aux_losses,
+                                           total_aux_loss)
 
                 layer.load_functional_state(params, buffers)
                 # auxiliary losses emitted during the forward (MoE
@@ -161,6 +163,7 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
                 # through the collector keeps tracers off the Layer
                 with collect_aux_losses() as auxes:
                     out = layer.forward(Tensor(x, stop_gradient=True))
+                    sweep_direct_aux_losses(layer, auxes)
                 out_arr = out._value if isinstance(out, Tensor) else out
                 loss = loss_fn(out_arr, y) + total_aux_loss(auxes)
                 # capture in-forward buffer updates (BatchNorm running

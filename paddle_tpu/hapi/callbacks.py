@@ -82,7 +82,11 @@ class EarlyStopping(Callback):
         self.min_delta = min_delta
         self.best = None
         self.wait = 0
-        self.mode = "min" if mode in ("auto", "min") else "max"
+        if mode == "auto":
+            mode = "max" if any(s in monitor.lower()
+                                for s in ("acc", "auc", "f1", "precision",
+                                          "recall")) else "min"
+        self.mode = "max" if mode == "max" else "min"
 
     def on_epoch_end(self, epoch, logs=None):
         value = (logs or {}).get(self.monitor)
@@ -119,6 +123,112 @@ class LRScheduler(Callback):
         s = self._sched()
         if self.by_epoch and s is not None:
             s.step()
+
+
+class VisualDL(Callback):
+    """Scalar logger (reference: hapi/callbacks.py:838 writes VisualDL
+    event files). The visualdl package isn't in this image, so scalars
+    are appended as JSON lines under ``log_dir`` — one file per mode —
+    which TensorBoard-style tooling (or a 5-line script) can ingest."""
+
+    def __init__(self, log_dir):
+        super().__init__()
+        import os
+
+        self.log_dir = log_dir
+        self._step = {}
+        self._files = {}
+        os.makedirs(log_dir, exist_ok=True)
+
+    def _write(self, mode, payload):
+        import json
+        import os
+
+        f = self._files.get(mode)
+        if f is None:
+            f = self._files[mode] = open(
+                os.path.join(self.log_dir, f"{mode}.jsonl"), "a")
+        f.write(json.dumps(payload) + "\n")
+        f.flush()
+
+    def on_end(self, mode, logs=None):
+        for f in self._files.values():
+            f.close()
+        self._files.clear()
+
+    def _log(self, mode, step, logs):
+        scalars = {k: float(v) for k, v in (logs or {}).items()
+                   if isinstance(v, (int, float)) and k != "step"}
+        if scalars:
+            self._write(mode, {**scalars, "step": step})
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step["train"] = self._step.get("train", -1) + 1
+        self._log("train", self._step["train"], logs)
+
+    def on_eval_batch_end(self, step, logs=None):
+        self._step["eval"] = self._step.get("eval", -1) + 1
+        self._log("eval", self._step["eval"], logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._log("epoch", epoch, logs)
+
+
+class ReduceLROnPlateau(Callback):
+    """Scale the LR down when the monitored metric plateaus (reference:
+    hapi/callbacks.py:953)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        if factor >= 1.0:
+            raise ValueError("factor must be < 1.0")
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == "auto":
+            # reference heuristic: accuracy-like monitors maximize
+            mode = "max" if any(s in monitor.lower()
+                                for s in ("acc", "auc", "f1", "precision",
+                                          "recall")) else "min"
+        self.mode = "max" if mode == "max" else "min"
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        value = (logs or {}).get(self.monitor)
+        if value is None:
+            return
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        better = (self.best is None or
+                  (self.mode == "min" and
+                   value < self.best - self.min_delta) or
+                  (self.mode == "max" and
+                   value > self.best + self.min_delta))
+        if better:
+            self.best = value
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = getattr(self.model, "_optimizer", None)
+                if opt is not None:
+                    old = float(opt.get_lr())
+                    new = max(old * self.factor, self.min_lr)
+                    if old - new > 1e-12:
+                        opt.set_lr(new)
+                        if self.verbose:
+                            print(f"Epoch {epoch}: ReduceLROnPlateau "
+                                  f"reducing learning rate to {new}.")
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
 
 
 class CallbackList:

@@ -1,4 +1,6 @@
 """paddle.incubate (reference: python/paddle/fluid/incubate/: fleet v1 API,
-auto_checkpoint)."""
+auto_checkpoint; python/paddle/incubate/optimizer: LookAhead,
+ModelAverage)."""
 from . import autograd  # noqa: F401
+from . import optimizer  # noqa: F401
 from .checkpoint import auto_checkpoint  # noqa: F401

@@ -1,0 +1,5 @@
+"""paddle.incubate.optimizer (reference: python/paddle/incubate/optimizer/
+lookahead.py, modelaverage.py) — re-exports of the wrapper optimizers."""
+from ...optimizer import LookAhead, ModelAverage  # noqa: F401
+
+__all__ = ["LookAhead", "ModelAverage"]

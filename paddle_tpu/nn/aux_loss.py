@@ -54,3 +54,18 @@ def total_aux_loss(collected):
     for v in collected:
         total = v if total is None else total + v
     return 0.0 if total is None else total
+
+
+def sweep_direct_aux_losses(layer, collected):
+    """Legacy contract: layers that assign ``self.aux_loss`` directly
+    (without emit_aux_loss) still get their term collected — and cleared,
+    so the tracer never outlives the trace. Call after the forward, while
+    still inside the trace. emit_aux_loss users are excluded naturally:
+    under a collector it nulls ``layer.aux_loss`` itself."""
+    from ..core.tensor import Tensor
+
+    for _, sub in layer.named_sublayers(include_self=True):
+        aux = getattr(sub, "aux_loss", None)
+        if aux is not None:
+            collected.append(aux._value if isinstance(aux, Tensor) else aux)
+            sub.aux_loss = None

@@ -13,9 +13,11 @@ import numpy as np
 from . import wire
 
 
-def export(layer, path, input_spec=None, opset_version=11, **configs):
+def export(layer, path, input_spec=None, opset_version=12, **configs):
     """Export ``layer`` to ``path + '.onnx'`` (reference signature:
-    python/paddle/onnx/export.py:20)."""
+    python/paddle/onnx/export.py:20). Supported opsets: 11 and 12 — the
+    emitted Clip/Pad/Slice forms need >=11, GreaterOrEqual/LessOrEqual
+    need >=12, and the ReduceSum axes-as-attribute form needs <=12."""
     if input_spec is None:
         raise ValueError(
             "input_spec is required: pass a list of InputSpec / Tensor / "
@@ -28,14 +30,19 @@ def export(layer, path, input_spec=None, opset_version=11, **configs):
     return out_path
 
 
-def export_bytes(layer, input_spec, opset_version=11, **configs):
+def export_bytes(layer, input_spec, opset_version=12, **configs):
     import jax
 
+    if opset_version not in (11, 12):
+        raise ValueError(
+            f"opset_version {opset_version} unsupported: this exporter "
+            f"emits opset 11/12 op forms (Clip/Pad/Slice inputs >=11, "
+            f"ReduceSum axes-attribute <=12)")
     arrs = _example_arrays(input_spec)
     closed, param_names, param_vals = _trace(layer, [a for _, a in arrs])
     jaxpr = closed.jaxpr
 
-    cv = _Converter()
+    cv = _Converter(opset_version)
     # params + trace-closure constants (eval-mode buffers) → initializers
     n_params = len(param_names)
     for var, pname, val in zip(jaxpr.invars[:n_params], param_names,
@@ -113,7 +120,8 @@ class UnsupportedOp(NotImplementedError):
 
 
 class _Converter:
-    def __init__(self):
+    def __init__(self, opset=12):
+        self.opset = opset
         self.nodes = []            # serialized NodeProto bytes, in order
         self.initializers = {}     # name -> ndarray
         self._names = {}           # jaxpr Var -> onnx value name
@@ -464,6 +472,17 @@ def _h_argminmax(op_type):
     return h
 
 
+def _h_opset12(op_type):
+    def h(cv, eqn):
+        if cv.opset < 12:
+            raise UnsupportedOp(
+                f"{op_type} requires opset >= 12 (export with "
+                f"opset_version=12)")
+        outs = cv.add_node(op_type, [cv.name_of(v) for v in eqn.invars])
+        cv.out(eqn, outs[0])
+    return h
+
+
 def _h_rem(cv, eqn):
     # lax.rem is C-style truncated remainder (sign of dividend) = fmod;
     # ONNX Mod defaults to floored modulo and requires fmod=1 for floats
@@ -503,7 +522,7 @@ _HANDLERS = {
     "is_finite": None,  # replaced below to raise clearly
     "stop_gradient": _simple("Identity"), "copy": _simple("Identity"),
     "gt": _simple("Greater"), "lt": _simple("Less"),
-    "ge": _simple("GreaterOrEqual"), "le": _simple("LessOrEqual"),
+    "ge": _h_opset12("GreaterOrEqual"), "le": _h_opset12("LessOrEqual"),
     "eq": _simple("Equal"), "ne": _h_ne,
     "and": _simple("And"), "or": _simple("Or"), "not": _simple("Not"),
     "xor": _simple("Xor"),

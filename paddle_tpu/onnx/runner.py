@@ -189,8 +189,11 @@ _OPS = {
     "Round": _unary(np.round), "Erf": _unary(_erf_like),
     "Reciprocal": _unary(np.reciprocal), "Not": _unary(np.logical_not),
     "Add": _binary(np.add), "Sub": _binary(np.subtract),
+    # integer Div truncates toward zero (C semantics, matching jax's
+    # `div` primitive and the ONNX spec) — numpy // floors instead
     "Mul": _binary(np.multiply), "Div": _binary(
-        lambda a, b: a // b if a.dtype.kind in "iu" else a / b),
+        lambda a, b: (np.trunc(np.divide(a, b)).astype(a.dtype)
+                      if a.dtype.kind in "iu" else a / b)),
     "Max": _binary(np.maximum), "Min": _binary(np.minimum),
     "Pow": _binary(np.power),
     "Mod": lambda ins, attrs: (np.fmod if attrs.get("fmod") else np.mod)(

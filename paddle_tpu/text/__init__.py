@@ -7,22 +7,7 @@ from .models import BertModel, BertForPretraining, GPTModel, LlamaModel  # noqa:
 from . import models  # noqa: F401
 from . import generation  # noqa: F401
 from .generation import generate, llama_generate  # noqa: F401
-
-
-class UCIHousing:
-    """reference: text/datasets — synthetic fallback (zero-egress image)."""
-
-    def __init__(self, mode="train"):
-        import numpy as np
-
-        rng = np.random.RandomState(1)
-        n = 404 if mode == "train" else 102
-        self.x = rng.rand(n, 13).astype("float32")
-        w = rng.rand(13, 1).astype("float32")
-        self.y = (self.x @ w + 0.1 * rng.randn(n, 1)).astype("float32")
-
-    def __getitem__(self, idx):
-        return self.x[idx], self.y[idx]
-
-    def __len__(self):
-        return len(self.x)
+from . import datasets  # noqa: F401
+from .datasets import (  # noqa: F401
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)

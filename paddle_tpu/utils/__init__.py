@@ -2,40 +2,9 @@
 from . import cpp_extension  # noqa: F401
 from . import dlpack  # noqa: F401
 from . import download  # noqa: F401
+from . import install_check  # noqa: F401
 from . import monitor  # noqa: F401
 from . import profiler  # noqa: F401
+from .deprecated import deprecated  # noqa: F401
+from .install_check import run_check  # noqa: F401
 from .lazy_import import try_import  # noqa: F401
-
-
-def run_check():
-    """paddle.utils.run_check (reference: utils/install_check.py run_check) —
-    tiny train on 1 device + a sharded matmul across all local devices."""
-    import numpy as np
-    import jax
-
-    import paddle_tpu as paddle
-    from paddle_tpu import nn, optimizer
-
-    model = nn.Linear(4, 2)
-    opt = optimizer.SGD(0.1, parameters=model.parameters())
-    x = paddle.to_tensor(np.random.rand(8, 4).astype(np.float32))
-    y = model(x)
-    loss = paddle.mean(y)
-    loss.backward()
-    opt.step()
-    n = len(jax.devices())
-    if n > 1:
-        from paddle_tpu.distributed import shard_batch, topology
-
-        mesh = topology.build_mesh(dp=n)
-        topology.set_global_mesh(mesh)
-        xb = shard_batch(paddle.to_tensor(np.random.rand(n * 2, 4).astype(np.float32)))
-        jax.jit(lambda a: a @ np.ones((4, 4), np.float32))(xb).block_until_ready()
-    print(f"paddle_tpu is installed successfully! {n} device(s) usable.")
-
-
-def deprecated(update_to="", since="", reason=""):
-    def decorator(fn):
-        return fn
-
-    return decorator

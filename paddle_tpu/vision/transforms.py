@@ -151,14 +151,22 @@ class Transpose(BaseTransform):
         return np.transpose(np.asarray(img), self.order)
 
 
+def _jitter_alpha(value):
+    """Blend factor from [max(0, 1-value), 1+value] (reference
+    transforms.py _check_input clamps the low end at 0 so value > 1
+    cannot produce negative/inverting factors)."""
+    return np.random.uniform(max(0.0, 1.0 - value), 1.0 + value)
+
+
 class BrightnessTransform(BaseTransform):
     def __init__(self, value, keys=None):
+        if value < 0:
+            raise ValueError("brightness value must be non-negative")
         self.value = value
 
     def _apply_image(self, img):
         img = np.asarray(img, np.float32)
-        alpha = 1 + np.random.uniform(-self.value, self.value)
-        return np.clip(img * alpha, 0, img.max())
+        return np.clip(img * _jitter_alpha(self.value), 0, img.max())
 
 
 class Pad(BaseTransform):
@@ -169,6 +177,167 @@ class Pad(BaseTransform):
         img = _chw(np.asarray(img))
         p = self.padding
         return np.pad(img, ((0, 0), (p, p), (p, p)))
+
+
+def _gray(img):
+    """Luminance over a CHW image (Rec.601 weights, reference:
+    transforms/functional_tensor.py to_grayscale)."""
+    if img.shape[0] == 1:
+        return img[0]
+    w = np.asarray([0.299, 0.587, 0.114], np.float32)
+    return np.tensordot(w, img[:3].astype(np.float32), axes=1)
+
+
+class ContrastTransform(BaseTransform):
+    """reference: transforms.py:737 — blend with the mean gray level."""
+
+    def __init__(self, value, keys=None):
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        img = _chw(np.asarray(img, np.float32))
+        alpha = _jitter_alpha(self.value)
+        mean = _gray(img).mean()
+        return np.clip(alpha * img + (1 - alpha) * mean, 0,
+                       255.0 if img.max() > 1.5 else 1.0)
+
+
+class SaturationTransform(BaseTransform):
+    """reference: transforms.py:775 — blend with per-pixel grayscale."""
+
+    def __init__(self, value, keys=None):
+        if value < 0:
+            raise ValueError("saturation value must be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        img = _chw(np.asarray(img, np.float32))
+        alpha = _jitter_alpha(self.value)
+        gray = _gray(img)[None]
+        return np.clip(alpha * img + (1 - alpha) * gray, 0,
+                       255.0 if img.max() > 1.5 else 1.0)
+
+
+def _rgb_to_hsv(img):
+    """img: [3, H, W] in [0, 1] -> h, s, v arrays."""
+    r, g, b = img
+    maxc = np.max(img, axis=0)
+    minc = np.min(img, axis=0)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0.0)
+    safe = np.maximum(delta, 1e-12)
+    h = np.where(maxc == r, (g - b) / safe % 6,
+                 np.where(maxc == g, (b - r) / safe + 2,
+                          (r - g) / safe + 4)) / 6.0
+    return np.where(delta == 0, 0.0, h), s, v
+
+
+def _hsv_to_rgb(h, s, v):
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int64) % 6
+    choices = [(v, t, p), (q, v, p), (p, v, t), (p, q, v), (t, p, v),
+               (v, p, q)]
+    r = np.select([i == k for k in range(6)], [c[0] for c in choices])
+    g = np.select([i == k for k in range(6)], [c[1] for c in choices])
+    b = np.select([i == k for k in range(6)], [c[2] for c in choices])
+    return np.stack([r, g, b]).astype(np.float32)
+
+
+class HueTransform(BaseTransform):
+    """reference: transforms.py:811 — shift hue in HSV space."""
+
+    def __init__(self, value, keys=None):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        img = _chw(np.asarray(img, np.float32))
+        if img.shape[0] == 1:
+            return img
+        scale = 255.0 if img.max() > 1.5 else 1.0
+        h, s, v = _rgb_to_hsv(img[:3] / scale)
+        shift = np.random.uniform(-self.value, self.value)
+        out = _hsv_to_rgb((h + shift) % 1.0, s, v) * scale
+        return np.concatenate([out, img[3:]]) if img.shape[0] > 3 else out
+
+
+class ColorJitter(BaseTransform):
+    """reference: transforms.py:848 — random-order composition of
+    brightness/contrast/saturation/hue perturbations."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.parts = []
+        if brightness:
+            self.parts.append(BrightnessTransform(brightness))
+        if contrast:
+            self.parts.append(ContrastTransform(contrast))
+        if saturation:
+            self.parts.append(SaturationTransform(saturation))
+        if hue:
+            self.parts.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        for k in np.random.permutation(len(self.parts)):
+            img = self.parts[k]._apply_image(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    """reference: transforms.py:1176."""
+
+    def __init__(self, num_output_channels=1, keys=None):
+        if num_output_channels not in (1, 3):
+            raise ValueError("num_output_channels must be 1 or 3")
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        img = _chw(np.asarray(img))
+        g = _gray(img.astype(np.float32))[None]
+        if self.num_output_channels == 3:
+            g = np.repeat(g, 3, axis=0)
+        return g.astype(img.dtype) if img.dtype == np.uint8 else g
+
+
+class RandomRotation(BaseTransform):
+    """reference: transforms.py:1090 — rotate by a random angle in
+    ``degrees`` about the center (nearest-neighbor resampling,
+    expand=False semantics: output keeps the input size)."""
+
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, (int, float)):
+            if degrees < 0:
+                raise ValueError("degrees must be non-negative")
+            self.degrees = (-degrees, degrees)
+        else:
+            self.degrees = tuple(degrees)
+        self.fill = fill
+
+    def _apply_image(self, img):
+        img = _chw(np.asarray(img))
+        angle = np.deg2rad(np.random.uniform(*self.degrees))
+        c, h, w = img.shape
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        yy, xx = np.mgrid[0:h, 0:w]
+        # inverse mapping: sample source = R(-angle) @ (dst - center)
+        cos, sin = np.cos(angle), np.sin(angle)
+        sy = cos * (yy - cy) - sin * (xx - cx) + cy
+        sx = sin * (yy - cy) + cos * (xx - cx) + cx
+        syi = np.round(sy).astype(np.int64)
+        sxi = np.round(sx).astype(np.int64)
+        valid = (syi >= 0) & (syi < h) & (sxi >= 0) & (sxi < w)
+        out = np.full_like(img, self.fill)
+        out[:, valid] = img[:, syi[valid], sxi[valid]]
+        return out
 
 
 def to_tensor(pic, data_format="CHW"):
@@ -189,3 +358,37 @@ def hflip(img):
 
 def vflip(img):
     return _chw(np.asarray(img))[:, ::-1].copy()
+
+
+def to_grayscale(img, num_output_channels=1):
+    return Grayscale(num_output_channels)(img)
+
+
+def adjust_brightness(img, brightness_factor):
+    img = _chw(np.asarray(img, np.float32))
+    return np.clip(img * brightness_factor, 0,
+                   255.0 if img.max() > 1.5 else 1.0)
+
+
+def adjust_contrast(img, contrast_factor):
+    img = _chw(np.asarray(img, np.float32))
+    mean = _gray(img).mean()
+    return np.clip(contrast_factor * img + (1 - contrast_factor) * mean, 0,
+                   255.0 if img.max() > 1.5 else 1.0)
+
+
+def adjust_hue(img, hue_factor):
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    img = _chw(np.asarray(img, np.float32))
+    if img.shape[0] == 1:
+        return img
+    scale = 255.0 if img.max() > 1.5 else 1.0
+    h, s, v = _rgb_to_hsv(img[:3] / scale)
+    return _hsv_to_rgb((h + hue_factor) % 1.0, s, v) * scale
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    t = RandomRotation((angle, angle), fill=fill)
+    return t._apply_image(np.asarray(img))

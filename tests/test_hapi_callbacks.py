@@ -1,0 +1,86 @@
+"""VisualDL + ReduceLROnPlateau callbacks (reference:
+python/paddle/hapi/callbacks.py:838,953)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.hapi import Model
+from paddle_tpu.hapi.callbacks import ReduceLROnPlateau, VisualDL
+from paddle_tpu.io.dataset import Dataset
+
+
+class _Toy(Dataset):
+    def __init__(self, n=64):
+        rng = np.random.RandomState(0)
+        self.x = rng.rand(n, 4).astype(np.float32)
+        w = rng.rand(4, 1).astype(np.float32)
+        self.y = (self.x @ w).astype(np.float32)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _fit(callbacks, epochs=2, lr=0.1):
+    paddle.seed(0)
+    net = nn.Linear(4, 1)
+    model = Model(net)
+    opt = optimizer.SGD(lr, parameters=net.parameters())
+    model.prepare(opt, nn.loss.MSELoss())
+    model.fit(_Toy(), batch_size=16, epochs=epochs, verbose=0,
+              callbacks=callbacks)
+    return model, opt
+
+
+class TestVisualDL:
+    def test_writes_scalar_jsonl(self, tmp_path):
+        log_dir = str(tmp_path / "vdl")
+        _fit([VisualDL(log_dir)])
+        train_log = os.path.join(log_dir, "train.jsonl")
+        assert os.path.exists(train_log)
+        rows = [json.loads(l) for l in open(train_log)]
+        assert rows and all("step" in r and "loss" in r for r in rows)
+        steps = [r["step"] for r in rows]
+        assert steps == sorted(steps)
+        epoch_log = os.path.join(log_dir, "epoch.jsonl")
+        assert os.path.exists(epoch_log)
+
+
+class TestReduceLROnPlateau:
+    def test_reduces_lr_when_flat(self):
+        # monitor a key that never improves -> LR must shrink
+        cb = ReduceLROnPlateau(monitor="flat", factor=0.5, patience=1,
+                               verbose=0)
+        model, opt = _fit([cb], epochs=1)
+        cb.set_model(model)
+        lr0 = float(opt.get_lr())
+        cb.on_epoch_end(0, {"flat": 1.0})
+        cb.on_epoch_end(1, {"flat": 1.0})
+        assert float(opt.get_lr()) == pytest.approx(lr0 * 0.5)
+
+    def test_keeps_lr_when_improving(self):
+        cb = ReduceLROnPlateau(monitor="m", factor=0.5, patience=1,
+                               verbose=0)
+        model, opt = _fit([cb], epochs=1)
+        cb.set_model(model)
+        lr0 = float(opt.get_lr())
+        for e, v in enumerate([1.0, 0.5, 0.25, 0.1]):
+            cb.on_epoch_end(e, {"m": v})
+        assert float(opt.get_lr()) == pytest.approx(lr0)
+
+    def test_min_lr_floor_and_factor_validation(self):
+        with pytest.raises(ValueError):
+            ReduceLROnPlateau(factor=1.5)
+        cb = ReduceLROnPlateau(monitor="flat", factor=0.1, patience=0,
+                               min_lr=0.05, verbose=0)
+        model, opt = _fit([cb], epochs=1, lr=0.1)
+        cb.set_model(model)
+        cb.on_epoch_end(0, {"flat": 1.0})
+        cb.on_epoch_end(1, {"flat": 1.0})
+        assert float(opt.get_lr()) == pytest.approx(0.05)

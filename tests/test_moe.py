@@ -183,3 +183,39 @@ class TestAuxLossRouting:
         # a bare trace drops the aux loss instead of leaking a tracer
         assert moe.aux_loss is None
         moe(paddle.to_tensor(x))  # and eager use afterwards still works
+
+    def test_direct_assignment_contract_still_collected(self):
+        """Layers that set self.aux_loss directly (without emit_aux_loss)
+        keep working: the term joins the compiled loss and no tracer
+        stays on the layer (regression for the collector refactor)."""
+        import jax.numpy as jnp
+        from paddle_tpu.distributed import spmd, topology
+
+        class DirectAux(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = paddle.nn.Linear(4, 4)
+                self.aux_loss = None
+
+            def forward(self, x):
+                out = self.fc(x)
+                self.aux_loss = (out * out).mean() * 0.1
+                return out
+
+        mesh = topology.build_mesh(dp=1)
+        topology.set_global_mesh(mesh)
+        paddle.seed(3)
+        net = DirectAux()
+        opt = optimizer.SGD(0.0, parameters=net.parameters())  # lr 0: pure read
+        step, init = spmd.build_train_step(
+            net, lambda o, t: jnp.mean((o - t) ** 2), opt, mesh=mesh)
+        params, st = init()
+        x = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+        loss, _, _ = step(params, st, x, np.zeros_like(x))
+        assert net.aux_loss is None  # cleared, no escaped tracer
+        # compare against the same model run eagerly: loss must include aux
+        out = net(paddle.to_tensor(x))
+        base = float(((out - paddle.to_tensor(np.zeros_like(x))) ** 2)
+                     .mean().numpy())
+        aux = float(net.aux_loss.numpy())
+        np.testing.assert_allclose(float(loss), base + aux, rtol=1e-5)

@@ -80,7 +80,7 @@ class TestOnnxExport:
                             input_spec=[InputSpec([1, 4], "float32")])
         assert path.endswith(".onnx")
         model = ponnx.load(path)
-        assert model["opset"] == 11 and model["ir_version"] == 7
+        assert model["opset"] == 12 and model["ir_version"] == 7
         assert model["graph"]["outputs"], "graph must declare outputs"
 
     def test_requires_input_spec(self):

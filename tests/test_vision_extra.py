@@ -1,0 +1,109 @@
+"""New vision surfaces: FashionMNIST/VOC2012/DatasetFolder/ImageFolder
+datasets + color/rotation transforms (reference:
+python/paddle/vision/datasets/{mnist,voc2012,folder}.py,
+vision/transforms/transforms.py)."""
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.vision.datasets import (DatasetFolder, FashionMNIST,
+                                        ImageFolder, MNIST, VOC2012)
+
+
+class TestDatasets:
+    def test_fashion_mnist_distinct_from_mnist(self):
+        m = MNIST(mode="test")
+        f = FashionMNIST(mode="test")
+        assert len(f) == len(m) == 1024
+        # distinct template seeds: per-class mean images must differ
+        mm = np.stack([m.images[m.labels == k].mean(0) for k in range(10)])
+        ff = np.stack([f.images[f.labels == k].mean(0) for k in range(10)])
+        assert np.abs(mm.astype(np.float32) - ff.astype(np.float32)).mean() > 5
+
+    def test_voc2012_mask_image_consistent(self):
+        ds = VOC2012(mode="train")
+        img, mask = ds[0]
+        assert img.shape == (3, 64, 64) and mask.shape == (64, 64)
+        assert mask.dtype == np.int64 and mask.max() < VOC2012.NUM_CLASSES
+        # background pixels are dark, object pixels brighter
+        if (mask > 0).any():
+            assert img[:, mask > 0].mean() > img[:, mask == 0].mean()
+        with pytest.raises(ValueError):
+            VOC2012(mode="bogus")
+
+    def test_dataset_folder_and_image_folder(self, tmp_path):
+        from PIL import Image
+
+        rng = np.random.RandomState(0)
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(3):
+                arr = rng.randint(0, 255, (8, 8, 3), dtype=np.uint8)
+                Image.fromarray(arr).save(d / f"{i}.png")
+        (tmp_path / "notes.txt").write_text("ignored")
+
+        ds = DatasetFolder(str(tmp_path))
+        assert ds.classes == ["cat", "dog"]
+        assert len(ds) == 6
+        img, label = ds[0]
+        assert img.shape == (8, 8, 3) and label in (0, 1)
+
+        flat = ImageFolder(str(tmp_path))
+        assert len(flat) == 6
+        (img2,) = flat[0]
+        assert img2.shape == (8, 8, 3)
+
+    def test_dataset_folder_empty_raises(self, tmp_path):
+        with pytest.raises(RuntimeError):
+            DatasetFolder(str(tmp_path))
+
+
+class TestTransforms:
+    def setup_method(self):
+        np.random.seed(0)
+        self.img = np.random.RandomState(1).rand(3, 16, 16) \
+            .astype(np.float32)
+
+    def test_grayscale(self):
+        g1 = T.Grayscale(1)(self.img)
+        g3 = T.Grayscale(3)(self.img)
+        assert g1.shape == (1, 16, 16) and g3.shape == (3, 16, 16)
+        np.testing.assert_allclose(g3[0], g3[1])
+        with pytest.raises(ValueError):
+            T.Grayscale(2)
+
+    def test_hue_roundtrip_identity(self):
+        out = T.adjust_hue(self.img, 0.0)
+        np.testing.assert_allclose(out, self.img, atol=1e-5)
+        shifted = T.adjust_hue(self.img, 0.25)
+        assert np.abs(shifted - self.img).max() > 0.01
+        # full-circle shift (+0.5 twice) returns to the original
+        back = T.adjust_hue(T.adjust_hue(self.img, 0.5), 0.5)
+        np.testing.assert_allclose(back, self.img, atol=1e-4)
+
+    def test_adjust_contrast_extremes(self):
+        flat = T.adjust_contrast(self.img, 0.0)
+        assert np.allclose(flat, flat.mean(), atol=1e-5)
+        same = T.adjust_contrast(self.img, 1.0)
+        np.testing.assert_allclose(same, self.img, atol=1e-5)
+
+    def test_color_jitter_runs_and_changes(self):
+        jitter = T.ColorJitter(brightness=0.4, contrast=0.4,
+                               saturation=0.4, hue=0.2)
+        out = jitter(self.img)
+        assert out.shape == self.img.shape
+
+    def test_rotation_90_exact(self):
+        rot = T.rotate(self.img, 90.0)
+        # 90° about the center with NN sampling == transpose+flip
+        np.testing.assert_allclose(rot, np.rot90(self.img, k=-1,
+                                                 axes=(1, 2)), atol=1e-6)
+
+    def test_random_rotation_zero_identity(self):
+        out = T.RandomRotation(0.0)(self.img)
+        np.testing.assert_allclose(out, self.img)
+        with pytest.raises(ValueError):
+            T.RandomRotation(-5)
