@@ -153,7 +153,8 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
                     from ..amp.auto_cast import auto_cast as _auto_cast
                     stack.enter_context(_auto_cast(
                         enable=True, level=amp_level, dtype=amp_dtype))
-                from ..nn.aux_loss import (collect_aux_losses,
+                from ..nn.aux_loss import (clear_direct_aux_losses,
+                                           collect_aux_losses,
                                            sweep_direct_aux_losses,
                                            total_aux_loss)
 
@@ -162,6 +163,7 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
                 # load-balancing etc.) join the objective; routing them
                 # through the collector keeps tracers off the Layer
                 with collect_aux_losses() as auxes:
+                    clear_direct_aux_losses(layer)
                     out = layer.forward(Tensor(x, stop_gradient=True))
                     sweep_direct_aux_losses(layer, auxes)
                 out_arr = out._value if isinstance(out, Tensor) else out

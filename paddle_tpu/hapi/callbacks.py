@@ -2,6 +2,14 @@
 import time
 
 
+def _auto_mode(monitor):
+    """'auto' monitor-mode heuristic (reference: callbacks.py EarlyStopping
+    /ReduceLROnPlateau): accuracy-like metrics maximize, losses minimize."""
+    return "max" if any(s in monitor.lower()
+                        for s in ("acc", "auc", "f1", "precision",
+                                  "recall")) else "min"
+
+
 class Callback:
     def __init__(self):
         self.model = None
@@ -82,11 +90,8 @@ class EarlyStopping(Callback):
         self.min_delta = min_delta
         self.best = None
         self.wait = 0
-        if mode == "auto":
-            mode = "max" if any(s in monitor.lower()
-                                for s in ("acc", "auc", "f1", "precision",
-                                          "recall")) else "min"
-        self.mode = "max" if mode == "max" else "min"
+        self.mode = _auto_mode(monitor) if mode == "auto" else (
+            "max" if mode == "max" else "min")
 
     def on_epoch_end(self, epoch, logs=None):
         value = (logs or {}).get(self.monitor)
@@ -190,12 +195,8 @@ class ReduceLROnPlateau(Callback):
         self.min_delta = min_delta
         self.cooldown = cooldown
         self.min_lr = min_lr
-        if mode == "auto":
-            # reference heuristic: accuracy-like monitors maximize
-            mode = "max" if any(s in monitor.lower()
-                                for s in ("acc", "auc", "f1", "precision",
-                                          "recall")) else "min"
-        self.mode = "max" if mode == "max" else "min"
+        self.mode = _auto_mode(monitor) if mode == "auto" else (
+            "max" if mode == "max" else "min")
         self.best = None
         self.wait = 0
         self.cooldown_counter = 0

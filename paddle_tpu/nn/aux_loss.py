@@ -56,12 +56,24 @@ def total_aux_loss(collected):
     return 0.0 if total is None else total
 
 
+def clear_direct_aux_losses(layer):
+    """Null every sublayer's ``aux_loss`` BEFORE a traced forward, so the
+    post-forward sweep only sees losses emitted by *this* trace — not a
+    concrete leftover from an earlier eager run of a branch the traced
+    forward never executes (which would bake a constant into the jitted
+    loss)."""
+    for _, sub in layer.named_sublayers(include_self=True):
+        if getattr(sub, "aux_loss", None) is not None:
+            sub.aux_loss = None
+
+
 def sweep_direct_aux_losses(layer, collected):
     """Legacy contract: layers that assign ``self.aux_loss`` directly
     (without emit_aux_loss) still get their term collected — and cleared,
-    so the tracer never outlives the trace. Call after the forward, while
-    still inside the trace. emit_aux_loss users are excluded naturally:
-    under a collector it nulls ``layer.aux_loss`` itself."""
+    so the tracer never outlives the trace. Call clear_direct_aux_losses
+    before the forward and this after it, while still inside the trace.
+    emit_aux_loss users are excluded naturally: under a collector it
+    nulls ``layer.aux_loss`` itself."""
     from ..core.tensor import Tensor
 
     for _, sub in layer.named_sublayers(include_self=True):

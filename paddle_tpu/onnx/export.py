@@ -497,6 +497,16 @@ def _h_ne(cv, eqn):
     cv.out(eqn, outs[0])
 
 
+def _h_split(cv, eqn):
+    sizes = [int(s) for s in eqn.params["sizes"]]
+    axis = int(eqn.params["axis"])
+    outs = cv.add_node("Split", [cv.name_of(eqn.invars[0])],
+                       outputs=[cv.fresh("split") for _ in sizes],
+                       attrs={"axis": axis, "split": sizes})
+    for var, name in zip(eqn.outvars, outs):
+        cv.bind(var, name)
+
+
 def _h_rev(cv, eqn):
     dims = [int(d) for d in eqn.params["dimensions"]]
     shape = list(eqn.invars[0].aval.shape)
@@ -537,7 +547,7 @@ _HANDLERS = {
     "broadcast_in_dim": _h_broadcast_in_dim,
     "reshape": _h_reshape, "squeeze": _h_squeeze,
     "transpose": _h_transpose, "concatenate": _h_concatenate,
-    "slice": _h_slice, "pad": _h_pad,
+    "slice": _h_slice, "pad": _h_pad, "split": _h_split,
     "convert_element_type": _h_convert,
     "select_n": _h_select_n, "gather": _h_gather, "iota": _h_iota,
     "rev": _h_rev,

@@ -216,6 +216,9 @@ _OPS = {
     "Concat": lambda ins, attrs: np.concatenate(
         ins, axis=int(attrs["axis"])),
     "Slice": _slice, "Pad": _pad, "Cast": _cast,
+    "Split": lambda ins, attrs: np.split(
+        ins[0], np.cumsum([int(s) for s in attrs["split"]])[:-1],
+        axis=int(attrs.get("axis", 0))),
     "Where": lambda ins, attrs: np.where(ins[0], ins[1], ins[2]),
     "Gather": lambda ins, attrs: np.take(
         ins[0], ins[1].astype(np.int64), axis=int(attrs.get("axis", 0))),

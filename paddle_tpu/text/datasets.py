@@ -62,9 +62,12 @@ class Imdb(Dataset):
 
 class Imikolov(Dataset):
     """PTB-style n-gram / sequence rows (reference:
-    text/datasets/imikolov.py): data_type='NGRAM' yields window_size-
-    grams; 'SEQ' yields <s>-padded sequences."""
+    text/datasets/imikolov.py). Ids 0/1/2 are the reserved <s>/<e>/<unk>
+    markers (the reference's word dict reserves the same three); word ids
+    start at 3. 'SEQ' rows are <s> ... <e>-wrapped sentences; 'NGRAM'
+    rows are window_size-grams over the wrapped sentence."""
 
+    BOS, EOS, UNK = 0, 1, 2
     N_VOCAB = 2048
 
     def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
@@ -75,28 +78,28 @@ class Imikolov(Dataset):
                              f"got {data_type!r}")
         if data_type == "NGRAM" and window_size < 1:
             raise ValueError("NGRAM needs window_size >= 1")
-        # bigram language with a fixed template transition table: the
-        # next word is predictable from the current one, so LM perplexity
-        # actually drops during training
+        # bigram language with a fixed template transition table over the
+        # word ids (3..V-1): the next word is predictable from the
+        # current one, so LM perplexity actually drops during training
+        n_words = self.N_VOCAB - 3
         trng = np.random.RandomState(13)
-        table = trng.dirichlet(np.ones(self.N_VOCAB) * 0.02,
-                               size=self.N_VOCAB)
+        table = trng.dirichlet(np.ones(n_words) * 0.02, size=n_words)
         rng = np.random.RandomState(0 if mode == "train" else 1)
         n_sent = 800 if mode == "train" else 160
         self.data = []
         for _ in range(n_sent):
             length = int(rng.randint(8, 24))
-            sent = [int(rng.randint(self.N_VOCAB))]
+            sent = [int(rng.randint(n_words))]
             for _ in range(length - 1):
-                sent.append(int(rng.choice(self.N_VOCAB,
-                                           p=table[sent[-1]])))
+                sent.append(int(rng.choice(n_words, p=table[sent[-1]])))
+            wrapped = [self.BOS] + [w + 3 for w in sent] + [self.EOS]
             if data_type == "NGRAM":
-                for i in range(window_size - 1, len(sent)):
+                for i in range(window_size - 1, len(wrapped)):
                     self.data.append(tuple(
                         np.int64(w)
-                        for w in sent[i - window_size + 1:i + 1]))
+                        for w in wrapped[i - window_size + 1:i + 1]))
             else:
-                self.data.append(np.asarray(sent, dtype=np.int64))
+                self.data.append(np.asarray(wrapped, dtype=np.int64))
 
     def __getitem__(self, idx):
         return self.data[idx]

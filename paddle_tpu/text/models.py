@@ -298,11 +298,20 @@ class LlamaDecoderLayer(nn.Layer):
 
 
 class LlamaModel(nn.Layer):
-    """Llama-2 architecture (7B default dims; shrink via kwargs for tests)."""
+    """Llama-2 architecture (7B default dims; shrink via kwargs for tests).
+
+    ``tensor_parallel=True`` stamps Megatron-style shardings onto the
+    weights (q/k/v/gate/up column-parallel over the 'mp' mesh axis,
+    o/down row-parallel, vocab-parallel embedding + lm_head) — the
+    TPU-native tensor parallelism (SURVEY §7): shard specs go in,
+    XLA GSPMD propagates them through the attention/MLP einsums and
+    inserts the psum on row-parallel contractions, replacing the
+    reference's explicit c_identity/c_allreduce op pairs
+    (tensor_parallel_optimizer.py:134-211)."""
 
     def __init__(self, vocab_size=32000, hidden_size=4096, num_layers=32,
                  num_heads=32, intermediate_size=11008, num_kv_heads=None,
-                 max_seq_len=4096):
+                 max_seq_len=4096, tensor_parallel=False):
         super().__init__()
         self.embed_tokens = nn.Embedding(vocab_size, hidden_size)
         self.layers = nn.LayerList([
@@ -311,6 +320,21 @@ class LlamaModel(nn.Layer):
             for _ in range(num_layers)])
         self.norm = RMSNorm(hidden_size)
         self.lm_head = nn.Linear(hidden_size, vocab_size, bias_attr=False)
+        if tensor_parallel:
+            self._stamp_tensor_parallel()
+
+    def _stamp_tensor_parallel(self, axis="mp"):
+        from ..distributed.spmd import P
+
+        self.embed_tokens.weight.mp_spec = P(axis, None)   # vocab-parallel
+        self.lm_head.weight.mp_spec = P(None, axis)
+        for layer in self.layers:
+            attn, mlp = layer.self_attn, layer.mlp
+            for col in (attn.q_proj, attn.k_proj, attn.v_proj,
+                        mlp.gate_proj, mlp.up_proj):
+                col.weight.mp_spec = P(None, axis)          # shard heads/ffn
+            for row in (attn.o_proj, mlp.down_proj):
+                row.weight.mp_spec = P(axis, None)          # psum on contract
 
     def forward(self, input_ids):
         x = self.embed_tokens(input_ids)

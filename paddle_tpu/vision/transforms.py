@@ -151,22 +151,34 @@ class Transpose(BaseTransform):
         return np.transpose(np.asarray(img), self.order)
 
 
-def _jitter_alpha(value):
-    """Blend factor from [max(0, 1-value), 1+value] (reference
-    transforms.py _check_input clamps the low end at 0 so value > 1
-    cannot produce negative/inverting factors)."""
-    return np.random.uniform(max(0.0, 1.0 - value), 1.0 + value)
+def _jitter_range(value, name, center=1.0):
+    """Normalize a jitter knob to a (lo, hi) sample range (reference
+    transforms.py _check_input): a float v maps to
+    [max(0, center-v), center+v]; a (lo, hi) pair is used directly."""
+    if isinstance(value, (list, tuple)):
+        lo, hi = float(value[0]), float(value[1])
+        if lo > hi or lo < 0:
+            raise ValueError(f"{name} range must satisfy 0 <= lo <= hi, "
+                             f"got {value}")
+        return lo, hi
+    if value < 0:
+        raise ValueError(f"{name} value must be non-negative")
+    return max(0.0, center - float(value)), center + float(value)
+
+
+def _ceiling(img):
+    """Images are either [0, 1] floats or [0, 255]; clip to the range."""
+    return 255.0 if img.max() > 1.5 else 1.0
 
 
 class BrightnessTransform(BaseTransform):
     def __init__(self, value, keys=None):
-        if value < 0:
-            raise ValueError("brightness value must be non-negative")
-        self.value = value
+        self.range = _jitter_range(value, "brightness")
 
     def _apply_image(self, img):
         img = np.asarray(img, np.float32)
-        return np.clip(img * _jitter_alpha(self.value), 0, img.max())
+        return np.clip(img * np.random.uniform(*self.range), 0,
+                       _ceiling(img))
 
 
 class Pad(BaseTransform):
@@ -192,32 +204,26 @@ class ContrastTransform(BaseTransform):
     """reference: transforms.py:737 — blend with the mean gray level."""
 
     def __init__(self, value, keys=None):
-        if value < 0:
-            raise ValueError("contrast value must be non-negative")
-        self.value = value
+        self.range = _jitter_range(value, "contrast")
 
     def _apply_image(self, img):
         img = _chw(np.asarray(img, np.float32))
-        alpha = _jitter_alpha(self.value)
+        alpha = np.random.uniform(*self.range)
         mean = _gray(img).mean()
-        return np.clip(alpha * img + (1 - alpha) * mean, 0,
-                       255.0 if img.max() > 1.5 else 1.0)
+        return np.clip(alpha * img + (1 - alpha) * mean, 0, _ceiling(img))
 
 
 class SaturationTransform(BaseTransform):
     """reference: transforms.py:775 — blend with per-pixel grayscale."""
 
     def __init__(self, value, keys=None):
-        if value < 0:
-            raise ValueError("saturation value must be non-negative")
-        self.value = value
+        self.range = _jitter_range(value, "saturation")
 
     def _apply_image(self, img):
         img = _chw(np.asarray(img, np.float32))
-        alpha = _jitter_alpha(self.value)
+        alpha = np.random.uniform(*self.range)
         gray = _gray(img)[None]
-        return np.clip(alpha * img + (1 - alpha) * gray, 0,
-                       255.0 if img.max() > 1.5 else 1.0)
+        return np.clip(alpha * img + (1 - alpha) * gray, 0, _ceiling(img))
 
 
 def _rgb_to_hsv(img):
@@ -254,17 +260,24 @@ class HueTransform(BaseTransform):
     """reference: transforms.py:811 — shift hue in HSV space."""
 
     def __init__(self, value, keys=None):
-        if not 0 <= value <= 0.5:
-            raise ValueError("hue value must be in [0, 0.5]")
-        self.value = value
+        if isinstance(value, (list, tuple)):
+            lo, hi = float(value[0]), float(value[1])
+            if not -0.5 <= lo <= hi <= 0.5:
+                raise ValueError(f"hue range must be within [-0.5, 0.5], "
+                                 f"got {value}")
+            self.range = (lo, hi)
+        else:
+            if not 0 <= value <= 0.5:
+                raise ValueError("hue value must be in [0, 0.5]")
+            self.range = (-float(value), float(value))
 
     def _apply_image(self, img):
         img = _chw(np.asarray(img, np.float32))
         if img.shape[0] == 1:
             return img
-        scale = 255.0 if img.max() > 1.5 else 1.0
+        scale = _ceiling(img)
         h, s, v = _rgb_to_hsv(img[:3] / scale)
-        shift = np.random.uniform(-self.value, self.value)
+        shift = np.random.uniform(*self.range)
         out = _hsv_to_rgb((h + shift) % 1.0, s, v) * scale
         return np.concatenate([out, img[3:]]) if img.shape[0] > 3 else out
 

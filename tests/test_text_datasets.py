@@ -29,7 +29,11 @@ class TestTextDatasets:
         row = ng[0]
         assert len(row) == 3
         seq = Imikolov(data_type="SEQ", mode="test")
-        assert seq[0].ndim == 1
+        row = seq[0]
+        assert row.ndim == 1
+        # <s> ... <e> wrapping with reserved ids; word ids start at 3
+        assert row[0] == Imikolov.BOS and row[-1] == Imikolov.EOS
+        assert (row[1:-1] >= 3).all()
         with pytest.raises(ValueError):
             Imikolov(data_type="NGRAM", window_size=-1)
 

@@ -107,3 +107,16 @@ class TestTransforms:
         np.testing.assert_allclose(out, self.img)
         with pytest.raises(ValueError):
             T.RandomRotation(-5)
+
+    def test_jitter_tuple_ranges(self):
+        """Reference API accepts (lo, hi) ranges as well as floats."""
+        out = T.ColorJitter(brightness=(0.9, 1.1), contrast=(0.8, 1.2),
+                            saturation=(1.0, 1.0), hue=(-0.1, 0.1))(self.img)
+        assert out.shape == self.img.shape
+        # fixed-point range: alpha is exactly 1 -> identity
+        same = T.ContrastTransform((1.0, 1.0))(self.img)
+        np.testing.assert_allclose(same, self.img, atol=1e-5)
+        with pytest.raises(ValueError):
+            T.BrightnessTransform((1.2, 0.8))  # lo > hi
+        with pytest.raises(ValueError):
+            T.HueTransform((-0.9, 0.2))  # outside [-0.5, 0.5]
