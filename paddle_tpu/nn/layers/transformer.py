@@ -119,10 +119,6 @@ class MultiHeadAttention(Layer):
             q = self._split_heads(q)
             k = self._split_heads(k)
             v = self._split_heads(v)
-            if isinstance(cache, self.Cache):
-                k = pt.concat([cache.k, k], axis=2)
-                v = pt.concat([cache.v, v], axis=2)
-                cache = self.Cache(k, v)
         else:
             q = self._split_heads(self.q_proj(query))
             if isinstance(cache, self.StaticCache):
@@ -130,10 +126,10 @@ class MultiHeadAttention(Layer):
             else:
                 k = self._split_heads(self.k_proj(key))
                 v = self._split_heads(self.v_proj(value))
-                if isinstance(cache, self.Cache):
-                    k = pt.concat([cache.k, k], axis=2)
-                    v = pt.concat([cache.v, v], axis=2)
-                    cache = self.Cache(k, v)
+        if isinstance(cache, self.Cache):
+            k = pt.concat([cache.k, k], axis=2)
+            v = pt.concat([cache.v, v], axis=2)
+            cache = self.Cache(k, v)
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=_convert_attention_mask(attn_mask),
             dropout_p=self.dropout, training=self.training)

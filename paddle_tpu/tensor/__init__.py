@@ -19,6 +19,7 @@ from .stat import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .einsum import einsum  # noqa: F401
 from .attribute import shape, rank, is_floating_point, is_integer, is_complex  # noqa: F401
+from .to_string import set_printoptions  # noqa: F401
 
 from . import (  # noqa: F401
     creation, math, manipulation, logic, search, linalg, stat, random, attribute,
@@ -164,7 +165,7 @@ _INPLACE = {
     "clip": "clip_", "scale": "scale_", "ceil": "ceil_", "floor": "floor_",
     "exp": "exp_", "sqrt": "sqrt_", "reshape": "reshape_", "squeeze": "squeeze_",
     "unsqueeze": "unsqueeze_", "flatten": "flatten_", "tanh": "tanh_",
-    "cast": "cast_", "round": "round_",
+    "cast": "cast_", "round": "round_", "scatter": "scatter_",
 }
 
 
@@ -222,3 +223,23 @@ def _normal_(self, mean=0.0, std=1.0):
 
 
 _attach_methods()
+
+
+def _module_inplace(iname):
+    """Top-level ``paddle.reshape_(x, ...)`` functions (the reference
+    exports the inplace variants at package level) delegating to the
+    patched Tensor methods."""
+    def fn(x, *args, **kwargs):
+        return getattr(x, iname)(*args, **kwargs)
+
+    fn.__name__ = iname
+    fn.__doc__ = (f"In-place variant of paddle.{iname[:-1]} (reference: "
+                  f"python/paddle/tensor — {iname}).")
+    return fn
+
+
+reshape_ = _module_inplace("reshape_")
+scatter_ = _module_inplace("scatter_")
+squeeze_ = _module_inplace("squeeze_")
+unsqueeze_ = _module_inplace("unsqueeze_")
+tanh_ = _module_inplace("tanh_")

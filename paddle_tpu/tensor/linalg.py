@@ -183,3 +183,23 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
         lambda x, fw, aw, *, rowvar, ddof: jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
                                                    fweights=fw, aweights=aw),
         x, fweights, aweights, rowvar=bool(rowvar), ddof=bool(ddof))
+
+
+def dist(x, y, p=2, name=None):
+    """p-norm of (x - y) (reference: tensor/linalg.py:446)."""
+    def _dist(x, y, *, p):
+        d = jnp.abs(x - y)
+        if p == float("inf"):
+            return jnp.max(d)
+        if p == float("-inf"):
+            return jnp.min(d)
+        if p == 0:
+            return jnp.sum((d != 0).astype(x.dtype)).astype(x.dtype)
+        return jnp.sum(d ** p) ** (1.0 / p)
+
+    return apply_op("dist", _dist, x, y, p=float(p))
+
+
+def mv(x, vec, name=None):
+    """Matrix-vector product [M,N]x[N]->[M] (reference: linalg.py:882)."""
+    return apply_op("mv", lambda x, v: jnp.matmul(x, v), x, vec)

@@ -461,3 +461,12 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
 
     return apply_op("shard_index", _shard, input, index_num=index_num, nshards=nshards,
                     shard_id=shard_id, ignore_value=ignore_value)
+
+
+def tolist(x):
+    """Nested Python list of the tensor's values (reference:
+    tensor/manipulation.py:45)."""
+    import numpy as np
+
+    arr = x.numpy() if hasattr(x, "numpy") else np.asarray(x)
+    return arr.tolist()

@@ -5,6 +5,8 @@ Every op is a pure jnp/lax function registered through core.dispatch, so
 it serves eager mode (cached jit per shape) and traced mode (inlines into
 the surrounding XLA program) from one definition.
 """
+import functools
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -346,3 +348,16 @@ def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
 
 def broadcast_shape(x_shape, y_shape):
     return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a tensor list (reference: tensor/math.py:716
+    add_n / sum_op). Accepts a single Tensor or a list of same-shape
+    Tensors; always returns a NEW tensor (never an alias of an input,
+    matching the reference's out-of-place sum op)."""
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    if not inputs:
+        raise ValueError("add_n expects at least one input tensor")
+    return apply_op("add_n", lambda *xs: functools.reduce(jnp.add, xs),
+                    *inputs)

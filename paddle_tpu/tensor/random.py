@@ -120,3 +120,27 @@ def exponential_(x, lam=1.0, name=None):
         random_core.next_key(), x, lam=float(lam))
     x._assign_result(out)
     return x
+
+
+def check_shape(shape):
+    """Validate a shape argument (reference: fluid/layers/utils.py:364) —
+    list/tuple of non-negative ints, or a Tensor of int32/int64."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    if isinstance(shape, Tensor):
+        if np.dtype(shape.dtype) not in (np.dtype("int32"),
+                                         np.dtype("int64")):
+            raise TypeError("shape tensor must be int32 or int64, "
+                            f"got {shape.dtype}")
+        return
+    for ele in shape:
+        if isinstance(ele, Tensor):
+            continue
+        if not isinstance(ele, (int, np.integer)):
+            raise TypeError("All elements in ``shape`` must be integers "
+                            "when it's a list or tuple")
+        if ele < 0:
+            raise ValueError("All elements in ``shape`` must be positive "
+                             "when it's a list or tuple")

@@ -1,0 +1,78 @@
+"""Top-level tensor API parity additions: add_n/dist/mv/tolist/
+check_shape/set_printoptions + module-level inplace variants (reference:
+python/paddle/__init__.py export list)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestTensorApiExtra:
+    def test_add_n(self):
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        y = paddle.to_tensor(np.full((2, 3), 2.0, np.float32))
+        out = paddle.add_n([x, y, y])
+        np.testing.assert_allclose(out.numpy(), np.full((2, 3), 5.0))
+        # always a fresh tensor, never an alias of an input (reference
+        # add_n is an out-of-place sum op)
+        single = paddle.add_n([x])
+        assert single is not x
+        np.testing.assert_allclose(single.numpy(), x.numpy())
+        with pytest.raises(ValueError):
+            paddle.add_n([])
+
+    def test_dist_norms(self):
+        x = paddle.to_tensor(np.asarray([[3.0, 3.0], [3.0, 3.0]], np.float32))
+        y = paddle.to_tensor(np.asarray([[3.0, 3.0], [3.0, 1.0]], np.float32))
+        assert float(paddle.dist(x, y, 2).numpy()) == pytest.approx(2.0)
+        assert float(paddle.dist(x, y, float("inf")).numpy()) == \
+            pytest.approx(2.0)
+        assert float(paddle.dist(x, y, 0).numpy()) == pytest.approx(1.0)
+
+    def test_mv(self):
+        m = np.arange(6, dtype=np.float32).reshape(2, 3)
+        v = np.asarray([1.0, 2.0, 3.0], np.float32)
+        out = paddle.mv(paddle.to_tensor(m), paddle.to_tensor(v))
+        np.testing.assert_allclose(out.numpy(), m @ v)
+
+    def test_tolist_and_method(self):
+        x = paddle.to_tensor(np.arange(4, dtype=np.int32).reshape(2, 2))
+        assert paddle.tolist(x) == [[0, 1], [2, 3]]
+        assert x.tolist() == [[0, 1], [2, 3]]
+
+    def test_module_level_inplace(self):
+        x = paddle.to_tensor(np.zeros((2, 3), np.float32))
+        out = paddle.reshape_(x, [3, 2])
+        assert out is x and x.shape == [3, 2]
+        paddle.unsqueeze_(x, 0)
+        assert x.shape == [1, 3, 2]
+        paddle.squeeze_(x, 0)
+        assert x.shape == [3, 2]
+        y = paddle.to_tensor(np.full((2,), 0.5, np.float32))
+        paddle.tanh_(y)
+        np.testing.assert_allclose(y.numpy(), np.tanh(0.5), rtol=1e-6)
+
+    def test_scatter_inplace(self):
+        x = paddle.to_tensor(np.ones((3, 2), np.float32))
+        index = paddle.to_tensor(np.asarray([1], np.int64))
+        updates = paddle.to_tensor(np.full((1, 2), 9.0, np.float32))
+        paddle.scatter_(x, index, updates)
+        np.testing.assert_allclose(x.numpy()[1], [9.0, 9.0])
+        np.testing.assert_allclose(x.numpy()[0], [1.0, 1.0])
+
+    def test_check_shape(self):
+        paddle.check_shape([2, 3])
+        with pytest.raises(ValueError):
+            paddle.check_shape([-2, 3])
+        with pytest.raises(TypeError):
+            paddle.check_shape([2.5, 3])
+        with pytest.raises(TypeError):
+            paddle.check_shape(paddle.to_tensor(
+                np.asarray([2.0], np.float32)))
+
+    def test_set_printoptions(self):
+        paddle.set_printoptions(precision=3)
+        try:
+            assert np.get_printoptions()["precision"] == 3
+        finally:
+            np.set_printoptions(precision=8)
