@@ -8,4 +8,7 @@ from .clip import (  # noqa: F401
 from .layers import *  # noqa: F401,F403
 from .layers.common import Linear, Embedding  # noqa: F401
 from .layers.container import Sequential, LayerList, ParameterList, LayerDict  # noqa: F401
+from . import utils  # noqa: F401
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
+from .utils import spectral_norm  # noqa: F401
 from ..framework.param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401

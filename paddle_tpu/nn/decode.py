@@ -1,0 +1,138 @@
+"""Seq2seq decoding (reference: python/paddle/nn/decode.py —
+BeamSearchDecoder + dynamic_decode over an RNNCell; the reference runs a
+while_op, here an eager loop drives jitted cell steps, and the final
+backtrack reuses F.gather_tree (operators/gather_tree_op.cc analog)).
+"""
+import collections
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+BeamSearchOutput = collections.namedtuple(
+    "BeamSearchOutput", ["predicted_ids", "scores", "parent_ids"])
+
+
+def _np(x):
+    return np.asarray(x._value if isinstance(x, Tensor) else x)
+
+
+class BeamSearchDecoder:
+    """reference: nn/decode.py:BeamSearchDecoder. cell: an RNNCell whose
+    forward(inputs, states) -> (out, new_states); embedding_fn maps id
+    tensors to cell inputs; output_fn maps cell outputs to vocab
+    logits."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*beam, ...] by repeating each batch row."""
+        arr = _np(x)
+        return Tensor(np.repeat(arr, beam_size, axis=0))
+
+    def _step(self, ids_flat, states):
+        """One cell step over [B*beam] token ids."""
+        inputs = Tensor(ids_flat)
+        if self.embedding_fn is not None:
+            inputs = self.embedding_fn(inputs)
+        out, new_states = self.cell(inputs, states)
+        logits = self.output_fn(out) if self.output_fn is not None else out
+        return _np(logits), new_states
+
+
+def _map_states(states, fn):
+    if isinstance(states, (tuple, list)):
+        return type(states)(_map_states(s, fn) for s in states)
+    return fn(states)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """reference: nn/decode.py:dynamic_decode. Runs the decoder to
+    max_step_num (or until every beam emits end_token); returns
+    (BeamSearchOutput, final_states) with predicted_ids [B, T, beam]
+    ([T, B, beam] when output_time_major), already gather_tree'd."""
+    if max_step_num is None:
+        raise ValueError("max_step_num is required")
+    beam = decoder.beam_size
+    # infer batch from the initial state leaves
+    leaves = []
+    _map_states(inits, lambda s: leaves.append(_np(s)) or s)
+    if not leaves:
+        raise ValueError("inits (initial cell states) are required")
+    batch = leaves[0].shape[0]
+
+    # tile states to [B*beam, ...]
+    states = _map_states(
+        inits, lambda s: Tensor(np.repeat(_np(s), beam, axis=0)))
+    # beam scores: first beam 0, rest -inf so step 1 picks distinct tokens
+    scores = np.full((batch, beam), -1e9, np.float32)
+    scores[:, 0] = 0.0
+    ids = np.full((batch * beam,), decoder.start_token, np.int64)
+    finished = np.zeros((batch, beam), bool)
+
+    step_ids, step_parents, step_scores = [], [], []
+    for _t in range(int(max_step_num)):
+        logits, new_states = decoder._step(ids, states)
+        logp = logits - _logsumexp(logits)  # [B*beam, V]
+        V = logp.shape[-1]
+        logp = logp.reshape(batch, beam, V)
+        # finished beams only extend with end_token at zero cost
+        eos_only = np.full((1, 1, V), -1e9, np.float32)
+        eos_only[0, 0, decoder.end_token] = 0.0
+        logp = np.where(finished[:, :, None], eos_only, logp)
+        total = scores[:, :, None] + logp             # [B, beam, V]
+        flat = total.reshape(batch, beam * V)
+        top = np.argsort(-flat, axis=1)[:, :beam]     # [B, beam]
+        scores = np.take_along_axis(flat, top, axis=1)
+        parents = top // V
+        tokens = top % V
+        finished = np.take_along_axis(finished, parents, axis=1) | \
+            (tokens == decoder.end_token)
+        # reorder states by parent beam
+        gather = (np.arange(batch)[:, None] * beam + parents).reshape(-1)
+        states = _map_states(new_states,
+                             lambda s: Tensor(_np(s)[gather]))
+        ids = tokens.reshape(-1).astype(np.int64)
+        step_ids.append(tokens)
+        step_parents.append(parents)
+        step_scores.append(scores)
+        if finished.all():
+            break
+
+    from . import functional as F
+
+    ids_t = np.stack(step_ids)           # [T, B, beam]
+    parents_t = np.stack(step_parents)
+    final = _np(F.gather_tree(Tensor(ids_t.astype(np.int64)),
+                              Tensor(parents_t.astype(np.int64))))
+    if not output_time_major:
+        final = np.transpose(final, (1, 0, 2))       # [B, T, beam]
+    out = BeamSearchOutput(Tensor(final),
+                           Tensor(step_scores[-1]),
+                           Tensor(parents_t.astype(np.int64)))
+    if return_length:
+        # length = first end_token position + 1 (or T)
+        T = ids_t.shape[0]
+        seq = final if output_time_major else np.transpose(final, (1, 0, 2))
+        is_eos = seq == decoder.end_token
+        any_eos = is_eos.any(axis=0)
+        first = np.where(any_eos, is_eos.argmax(axis=0) + 1, T)
+        return out, states, Tensor(first.astype(np.int64))
+    return out, states
+
+
+def _logsumexp(x):
+    m = x.max(axis=-1, keepdims=True)
+    return m + np.log(np.exp(x - m).sum(axis=-1, keepdims=True))

@@ -8,6 +8,7 @@ fuses into neighbors. Data layout: paddle defaults to NCHW at the API,
 but kernels transpose to NHWC internally when beneficial — XLA on TPU
 canonicalises layout anyway, so we keep the math in the API layout.
 """
+import functools as _pyfunctools
 import math as _pymath
 
 import numpy as np
@@ -331,28 +332,43 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
 def _conv_transpose_nd(x, w, b, *, stride, padding, output_padding, dilation, groups,
                        data_format, nd):
+    """Transposed conv as the explicit input-gradient construction:
+    lhs-dilate x by stride, pad each spatial side by d·(k−1)−p (plus
+    output_padding on the high side), and run a stride-1 conv with the
+    spatially-flipped kernel. This reproduces the reference/torch output
+    size (i−1)·s − 2p + d·(k−1) + 1 + op exactly for all channel counts
+    (jax.lax.conv_transpose's padding convention differs, and its
+    transpose_kernel path mis-contracts when in != out for the paddle
+    [in, out, *k] weight layout)."""
+    if groups != 1:
+        raise NotImplementedError("conv transpose with groups>1")
     chan_first = data_format in ("NCHW", "NCL", "NCDHW")
     sp = "DHW"[3 - nd:]
     dn_in = ("NC" + sp) if chan_first else ("N" + sp + "C")
-    dn_kernel = "IO" + sp
     if isinstance(padding, str):
-        pad = padding.upper()
-    else:
-        pad = [(p, p) if isinstance(p, int) else tuple(p) for p in padding]
-    y = jax.lax.conv_transpose(
-        x, w,
-        strides=stride,
-        padding=pad,
+        if padding.upper() == "VALID":
+            padding = [(0, 0)] * nd
+        else:
+            raise NotImplementedError(
+                f"string padding {padding!r} for conv transpose (SAME is "
+                f"ambiguous for transposed convs; pass explicit ints)")
+    pads = [(p, p) if isinstance(p, int) else tuple(p) for p in padding]
+    ksp = [w.shape[2 + i] for i in range(nd)]
+    out_pad = output_padding if output_padding else (0,) * nd
+    pad_cfg = [(dilation[i] * (ksp[i] - 1) - pads[i][0],
+                dilation[i] * (ksp[i] - 1) - pads[i][1] + out_pad[i])
+               for i in range(nd)]
+    spatial_axes = tuple(range(2, 2 + nd))
+    w_flipped = jnp.flip(w, axis=spatial_axes)
+    # kernel [in, out, *k]: contraction over dim0 (=I), outputs dim1 (=O)
+    y = jax.lax.conv_general_dilated(
+        x, w_flipped,
+        window_strides=(1,) * nd,
+        padding=pad_cfg,
+        lhs_dilation=stride,
         rhs_dilation=dilation,
-        dimension_numbers=(dn_in, dn_kernel, dn_in),
-        transpose_kernel=True,
+        dimension_numbers=(dn_in, "IO" + sp, dn_in),
     )
-    if output_padding and any(output_padding):
-        pads = [(0, 0)] * y.ndim
-        for i, op_ in enumerate(output_padding):
-            dim = (2 + i) if chan_first else (1 + i)
-            pads[dim] = (0, op_)
-        y = jnp.pad(y, pads)
     if b is not None:
         shape = [1] * y.ndim
         shape[1 if chan_first else -1] = b.size
@@ -360,22 +376,53 @@ def _conv_transpose_nd(x, w, b, *, stride, padding, output_padding, dilation, gr
     return y
 
 
+def _resolve_output_padding(x, weight, output_size, output_padding, stride,
+                            padding, dilation, nd, data_format):
+    """Derive output_padding from a requested output_size (reference:
+    conv_transpose_op.cc InferShape): op = out - ((i-1)s - 2p + d(k-1) + 1),
+    valid when 0 <= op < stride."""
+    if output_size is None:
+        return _pair(output_padding, nd)
+    sizes = list(output_size)[-nd:]
+    chan_first = data_format in ("NCHW", "NCL", "NCDHW")
+    xs = x.shape[2:2 + nd] if chan_first else x.shape[1:1 + nd]
+    ks = weight.shape[2:2 + nd]
+    ops = []
+    for i in range(nd):
+        p = padding[i]
+        plo, phi = (p, p) if isinstance(p, int) else tuple(p)
+        base = (xs[i] - 1) * stride[i] - plo - phi + \
+            dilation[i] * (ks[i] - 1) + 1
+        op = int(sizes[i]) - base
+        if not 0 <= op < stride[i]:
+            raise ValueError(
+                f"output_size[{i}]={sizes[i]} unreachable: base {base}, "
+                f"stride {stride[i]} (need base <= size < base+stride)")
+        ops.append(op)
+    return tuple(ops)
+
+
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
                      groups=1, dilation=1, data_format="NCHW", output_size=None, name=None):
     """reference: operators/conv_transpose_op.cc. groups>1 unsupported for now."""
+    stride_, pad_, dil_ = _pair(stride), _norm_padding(padding, 2), _pair(dilation)
+    op_ = _resolve_output_padding(x, weight, output_size, output_padding,
+                                  stride_, pad_, dil_, 2, data_format)
     return apply_op(
         "conv2d_transpose", _conv_transpose_nd, x, weight, bias,
-        stride=_pair(stride), padding=_norm_padding(padding, 2),
-        output_padding=_pair(output_padding), dilation=_pair(dilation),
+        stride=stride_, padding=pad_, output_padding=op_, dilation=dil_,
         groups=int(groups), data_format=data_format, nd=2)
 
 
 def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
                      groups=1, dilation=1, data_format="NCL", output_size=None, name=None):
+    stride_, pad_, dil_ = (_pair(stride, 1), _norm_padding(padding, 1),
+                           _pair(dilation, 1))
+    op_ = _resolve_output_padding(x, weight, output_size, output_padding,
+                                  stride_, pad_, dil_, 1, data_format)
     return apply_op(
         "conv1d_transpose", _conv_transpose_nd, x, weight, bias,
-        stride=_pair(stride, 1), padding=_norm_padding(padding, 1),
-        output_padding=_pair(output_padding, 1), dilation=_pair(dilation, 1),
+        stride=stride_, padding=pad_, output_padding=op_, dilation=dil_,
         groups=int(groups), data_format=data_format, nd=1)
 
 
@@ -383,24 +430,43 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
 
 
 def _pool_nd(x, *, ksize, stride, padding, mode, ceil_mode, data_format, nd,
-             exclusive=True):
+             exclusive=True, divisor=None):
     chan_first = data_format in ("NCHW", "NCL", "NCDHW")
     if chan_first:
         window = (1, 1) + ksize
         strides = (1, 1) + stride
-        pads = ((0, 0), (0, 0)) + tuple((p, p) if isinstance(p, int) else tuple(p)
-                                        for p in padding)
+        spatial = tuple(range(2, 2 + nd))
     else:
         window = (1,) + ksize + (1,)
         strides = (1,) + stride + (1,)
-        pads = ((0, 0),) + tuple((p, p) if isinstance(p, int) else tuple(p)
-                                 for p in padding) + ((0, 0),)
+        spatial = tuple(range(1, 1 + nd))
+    if isinstance(padding, str):
+        pads = padding.upper()  # reduce_window accepts "SAME"/"VALID"
+        had_pad = padding.upper() == "SAME"
+    else:
+        sp_pads = [(p, p) if isinstance(p, int) else tuple(p)
+                   for p in padding]
+        if ceil_mode:
+            # widen the high-side pad so the last (partial) window counts:
+            # out_ceil = ceil((i + lo + hi - k)/s) + 1
+            sp_pads = list(sp_pads)
+            for i, ax in enumerate(spatial):
+                span = x.shape[ax] + sp_pads[i][0] + sp_pads[i][1] - ksize[i]
+                extra = (-span) % stride[i]
+                sp_pads[i] = (sp_pads[i][0], sp_pads[i][1] + extra)
+        pads = [(0, 0)] * x.ndim
+        for i, ax in enumerate(spatial):
+            pads[ax] = sp_pads[i]
+        had_pad = any(p != (0, 0) for p in pads)
+        pads = tuple(pads)
     if mode == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         return jax.lax.reduce_window(x, init, jax.lax.max, window, strides, pads)
     # avg
     summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
-    if exclusive and any(p != (0, 0) for p in pads):
+    if divisor is not None:
+        return summed / float(divisor)
+    if exclusive and had_pad:
         ones = jnp.ones_like(x)
         counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
         return summed / counts
@@ -412,11 +478,9 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     ksize = _pair(kernel_size)
     stride = ksize if stride is None else _pair(stride)
     pad = _norm_padding(padding, 2)
-    if isinstance(pad, str):
-        pad = (0, 0) if pad == "VALID" else pad
     out = apply_op("max_pool2d", _pool_nd, x, ksize=ksize, stride=stride,
-                   padding=pad if not isinstance(pad, str) else (0, 0),
-                   mode="max", ceil_mode=bool(ceil_mode), data_format=data_format, nd=2)
+                   padding=pad, mode="max", ceil_mode=bool(ceil_mode),
+                   data_format=data_format, nd=2)
     if return_mask:
         # indices not natively produced by reduce_window; compute via argmax trick
         raise NotImplementedError("return_mask=True not yet supported")
@@ -429,9 +493,9 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     stride = ksize if stride is None else _pair(stride)
     pad = _norm_padding(padding, 2)
     return apply_op("avg_pool2d", _pool_nd, x, ksize=ksize, stride=stride,
-                    padding=pad if not isinstance(pad, str) else (0, 0),
-                    mode="avg", ceil_mode=bool(ceil_mode), data_format=data_format,
-                    nd=2, exclusive=bool(exclusive))
+                    padding=pad, mode="avg", ceil_mode=bool(ceil_mode),
+                    data_format=data_format, nd=2, exclusive=bool(exclusive),
+                    divisor=divisor_override)
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
@@ -1051,3 +1115,337 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
         return (r[None, :] < x[..., None]).astype(convert_dtype(dtype))
 
     return apply_op("sequence_mask", _sm, x, maxlen=int(maxlen), dtype=str(dtype))
+
+
+# ---------------------------------------------------- 3-D pooling family
+# (reference: operators/pool_op.cc 3-D kernels + adaptive variants; all
+# ride the generic _pool_nd reduce_window path)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    if return_mask:
+        raise NotImplementedError("return_mask=True not yet supported")
+    ksize = _pair(kernel_size, 3)
+    stride = ksize if stride is None else _pair(stride, 3)
+    pad = _norm_padding(padding, 3)
+    return apply_op("max_pool3d", _pool_nd, x, ksize=ksize, stride=stride,
+                    padding=pad, mode="max", ceil_mode=bool(ceil_mode),
+                    data_format=data_format, nd=3)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    ksize = _pair(kernel_size, 3)
+    stride = ksize if stride is None else _pair(stride, 3)
+    pad = _norm_padding(padding, 3)
+    return apply_op("avg_pool3d", _pool_nd, x, ksize=ksize, stride=stride,
+                    padding=pad, mode="avg", ceil_mode=bool(ceil_mode),
+                    data_format=data_format, nd=3, exclusive=bool(exclusive),
+                    divisor=divisor_override)
+
+
+def _adaptive_pool_nd(x, *, out_sizes, spatial_axes, mode):
+    """General adaptive pooling: divisible fast path via reduce_window,
+    else static per-bin reduction (shapes are compile-time constants)."""
+    reducer = jnp.max if mode == "max" else jnp.mean
+    in_sizes = [x.shape[a] for a in spatial_axes]
+    if all(i % o == 0 for i, o in zip(in_sizes, out_sizes)):
+        window = [1] * x.ndim
+        for a, i, o in zip(spatial_axes, in_sizes, out_sizes):
+            window[a] = i // o
+        if mode == "max":
+            return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max,
+                                         tuple(window), tuple(window),
+                                         "VALID")
+        y = jax.lax.reduce_window(x, 0.0, jax.lax.add, tuple(window),
+                                  tuple(window), "VALID")
+        return y / float(np.prod([window[a] for a in spatial_axes]))
+
+    def bins(i, o):
+        edges = [(k * i) // o for k in range(o)] + [i]
+        return list(zip(edges[:-1], edges[1:]))
+
+    def rec(axis_idx, slices):
+        if axis_idx == len(spatial_axes):
+            sl = [slice(None)] * x.ndim
+            for a, (lo, hi) in zip(spatial_axes, slices):
+                sl[a] = slice(lo, hi)
+            return reducer(x[tuple(sl)], axis=tuple(spatial_axes),
+                           keepdims=True)
+        parts = [rec(axis_idx + 1, slices + [b])
+                 for b in bins(in_sizes[axis_idx], out_sizes[axis_idx])]
+        return jnp.concatenate(parts, axis=spatial_axes[axis_idx])
+
+    return rec(0, [])
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    out = _pair(output_size, 3)
+    axes = (2, 3, 4) if data_format == "NCDHW" else (1, 2, 3)
+    return apply_op("adaptive_avg_pool3d", _adaptive_pool_nd, x,
+                    out_sizes=out, spatial_axes=axes, mode="avg")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError("return_mask=True not yet supported")
+    out = _pair(output_size, 3)
+    return apply_op("adaptive_max_pool3d", _adaptive_pool_nd, x,
+                    out_sizes=out, spatial_axes=(2, 3, 4), mode="max")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError("return_mask=True not yet supported")
+    return apply_op("adaptive_max_pool1d", _adaptive_pool_nd, x,
+                    out_sizes=(int(output_size),), spatial_axes=(2,),
+                    mode="max")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCDHW", output_size=None, name=None):
+    """reference: operators/conv_transpose_op.cc (3-D)."""
+    stride_, pad_, dil_ = (_pair(stride, 3), _norm_padding(padding, 3),
+                           _pair(dilation, 3))
+    op_ = _resolve_output_padding(x, weight, output_size, output_padding,
+                                  stride_, pad_, dil_, 3, data_format)
+    return apply_op(
+        "conv3d_transpose", _conv_transpose_nd, x, weight, bias,
+        stride=stride_, padding=pad_, output_padding=op_, dilation=dil_,
+        groups=int(groups), data_format=data_format, nd=3)
+
+
+# --------------------------------------------------- small activations
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply_op("thresholded_relu",
+                    lambda x, *, t: jnp.where(x > t, x, 0.0).astype(x.dtype),
+                    x, t=float(threshold))
+
+
+def _inplace_unary(fn):
+    def inner(x, *args, **kwargs):
+        x._assign_result(fn(x, *args, **kwargs))
+        return x
+
+    inner.__name__ = fn.__name__ + "_"
+    inner.__doc__ = f"In-place variant of F.{fn.__name__}."
+    return inner
+
+
+relu_ = _inplace_unary(relu)
+elu_ = _inplace_unary(elu)
+tanh_ = _inplace_unary(tanh)
+softmax_ = _inplace_unary(softmax)
+
+
+# --------------------------------------------------------- extra losses
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """Bilinear tensor product [B,in1]x[out,in1,in2]x[B,in2] -> [B,out]
+    (reference: operators/bilinear_tensor_product_op.cc)."""
+
+    def _bil(x1, x2, w, b):
+        y = jnp.einsum("bi,oij,bj->bo", x1, w, x2)
+        return y if b is None else y + b
+
+    return apply_op("bilinear", _bil, x1, x2, weight, bias)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """reference: fluid/layers/nn.py dice_loss — 1 - 2|X∩Y|/(|X|+|Y|),
+    label one-hotted over input's last dim."""
+
+    def _dice(x, y, *, eps):
+        oh = jax.nn.one_hot(y[..., 0], x.shape[-1], dtype=x.dtype)
+        reduce_dims = tuple(range(1, x.ndim))
+        inter = jnp.sum(x * oh, axis=reduce_dims)
+        union = jnp.sum(x, axis=reduce_dims) + jnp.sum(oh, axis=reduce_dims)
+        return jnp.mean(1.0 - (2.0 * inter + eps) / (union + eps))
+
+    return apply_op("dice_loss", _dice, input, label, eps=float(epsilon))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """reference: operators/log_loss_op.cc — elementwise negative log
+    likelihood of a probability: -y*log(p+eps) - (1-y)*log(1-p+eps)."""
+
+    def _ll(p, y, *, eps):
+        return -(y * jnp.log(p + eps) + (1.0 - y) * jnp.log(1.0 - p + eps))
+
+    return apply_op("log_loss", _ll, input, label, eps=float(epsilon))
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss (reference: nn/functional/loss.py:1000 → warpctc op).
+
+    log_probs: [T, B, C]; labels: [B, L] int; per-sample lengths.
+    Log-domain alpha recursion over the extended label sequence
+    (Graves 2006) as a lax.scan — TPU-native replacement for warp-ctc.
+    log_softmax is applied internally (idempotent on already-normalized
+    inputs, so both raw-logit and log-prob conventions work)."""
+
+    def _ctc(lp, lab, in_len, lab_len, *, blank, norm_by_times):
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        T, B, C = lp.shape
+        L = lab.shape[1]
+        S = 2 * L + 1
+        neg_inf = jnp.asarray(-1e30, jnp.float32)
+        # extended labels: [blank, l1, blank, l2, ..., blank]
+        ext = jnp.full((B, S), blank, dtype=lab.dtype)
+        ext = ext.at[:, 1::2].set(lab)
+        # allow the s-2 skip where ext[s] != blank and ext[s] != ext[s-2]
+        ext_prev2 = jnp.pad(ext, ((0, 0), (2, 0)),
+                            constant_values=blank)[:, :S]
+        can_skip = (ext != blank) & (ext != ext_prev2)
+        pos = jnp.arange(S)[None, :]
+
+        def emit(t_lp):  # [B, S] log p_t(ext_s)
+            return jnp.take_along_axis(t_lp, ext, axis=1)
+
+        alpha0 = jnp.full((B, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(emit(lp[0])[:, 0])
+        alpha0 = alpha0.at[:, 1].set(jnp.where(lab_len > 0,
+                                               emit(lp[0])[:, 1], neg_inf))
+
+        def step(alpha, t_lp):
+            a1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                         constant_values=-1e30)[:, :S]
+            a2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                         constant_values=-1e30)[:, :S]
+            a2 = jnp.where(can_skip, a2, neg_inf)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2)
+            return merged + emit(t_lp), None
+
+        def scan_step(carry, xs):
+            alpha, t = carry
+            new_alpha, _ = step(alpha, xs)
+            # freeze alpha once t >= input_length (per sample)
+            live = (t < in_len)[:, None]
+            return (jnp.where(live, new_alpha, alpha), t + 1), None
+
+        (alpha, _), _ = jax.lax.scan(scan_step, (alpha0, jnp.asarray(1)),
+                                     lp[1:])
+        # final: logaddexp of positions 2*lab_len and 2*lab_len - 1
+        sl = 2 * lab_len
+        last = jnp.take_along_axis(alpha, sl[:, None], axis=1)[:, 0]
+        prev = jnp.take_along_axis(
+            alpha, jnp.maximum(sl - 1, 0)[:, None], axis=1)[:, 0]
+        ll = jnp.where(lab_len > 0, jnp.logaddexp(last, prev), last)
+        loss = -ll
+        if norm_by_times:
+            loss = loss / jnp.maximum(in_len.astype(loss.dtype), 1.0)
+        return loss
+
+    out = apply_op("ctc_loss", _ctc, log_probs, labels, input_lengths,
+                   label_lengths, blank=int(blank),
+                   norm_by_times=bool(norm_by_times))
+    if reduction == "mean":
+        # reference semantics: per-sample loss divided by label length,
+        # then batch-meaned
+        return apply_op(
+            "ctc_mean",
+            lambda l, n: jnp.mean(l / jnp.maximum(
+                n.astype(l.dtype), 1.0)), out, label_lengths)
+    if reduction == "sum":
+        from .. import tensor as pt
+
+        return pt.sum(out)
+    return out
+
+
+@_pyfunctools.lru_cache(maxsize=32)
+def _hsigmoid_default_tree(num_classes):
+    """Complete-binary-heap path tables for the default hsigmoid tree:
+    (table, code, mask) numpy arrays [num_classes, depth], built once per
+    num_classes and passed as positional (traced) args — rebuilding and
+    hashing them per call would dominate the op at large class counts."""
+    depth = int(np.ceil(np.log2(max(num_classes, 2))))
+    table = np.zeros((num_classes, depth), np.int64)
+    code = np.zeros((num_classes, depth), np.float32)
+    mask = np.zeros((num_classes, depth), np.float32)
+    for c in range(num_classes):
+        node = c + num_classes
+        path = []
+        while node > 1:
+            path.append((node // 2, float(node & 1)))
+            node //= 2
+        path.reverse()
+        for d, (n, bit) in enumerate(path):
+            table[c, d] = n - 1   # weight row (internal nodes 1-based)
+            code[c, d] = bit
+            mask[c, d] = 1.0
+    return table, code, mask
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference: nn/functional/loss.py
+    hsigmoid_loss → hierarchical_sigmoid_op). Default tree: complete
+    binary heap over num_classes leaves (internal nodes 1..K-1, leaf c =
+    c + num_classes in heap numbering); custom trees via
+    path_table/path_code [B, D]."""
+    if path_table is None:
+        table, code, mask = _hsigmoid_default_tree(int(num_classes))
+
+        def _hs(x, lab, w, b, table, code, mask):
+            t = table[lab]                       # [B, D] weight rows
+            cd = code[lab]                       # [B, D] targets
+            mk = mask[lab]                       # [B, D] valid steps
+            wrows = w[t]                         # [B, D, F]
+            logits = jnp.einsum("bdf,bf->bd", wrows, x)
+            if b is not None:
+                logits = logits + b.reshape(-1)[t]
+            # BCE with logits against the path code, masked
+            per = jnp.maximum(logits, 0) - logits * cd + \
+                jnp.log1p(jnp.exp(-jnp.abs(logits)))
+            return jnp.sum(per * mk, axis=1, keepdims=True)
+
+        return apply_op("hsigmoid_loss", _hs, input, label, weight, bias,
+                        table, code, mask)
+
+    def _hs_custom(x, lab, w, b, pt_, pc):
+        valid = (pt_ >= 0).astype(x.dtype)
+        rows = jnp.maximum(pt_, 0)
+        wrows = w[rows]
+        logits = jnp.einsum("bdf,bf->bd", wrows, x)
+        if b is not None:
+            logits = logits + b.reshape(-1)[rows]
+        cd = pc.astype(x.dtype)
+        per = jnp.maximum(logits, 0) - logits * cd + \
+            jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        return jnp.sum(per * valid, axis=1, keepdims=True)
+
+    return apply_op("hsigmoid_loss_custom", _hs_custom, input, label,
+                    weight, bias, path_table, path_code)
+
+
+def gather_tree(ids, parents):
+    """Beam-search ancestry walk (reference: operators/gather_tree_op.cc):
+    ids/parents [T, B, beam]; returns the full sequences obtained by
+    backtracking each final beam through its parent pointers."""
+
+    def _gt(ids, parents):
+        T = ids.shape[0]
+        beams = jnp.arange(ids.shape[2])[None, :] * jnp.ones(
+            (ids.shape[1], 1), ids.dtype)
+
+        def back(carry, xs):
+            beam_idx = carry
+            step_ids, step_parents = xs
+            out = jnp.take_along_axis(step_ids, beam_idx, axis=1)
+            nxt = jnp.take_along_axis(step_parents, beam_idx, axis=1)
+            return nxt, out
+
+        _, rev = jax.lax.scan(back, beams.astype(ids.dtype),
+                              (ids[::-1], parents[::-1]))
+        return rev[::-1]
+
+    return apply_op("gather_tree", _gt, ids, parents)

@@ -1,0 +1,29 @@
+"""Distance layers (reference: python/paddle/nn/layer/distance.py)."""
+from .. import functional as F
+from ..layer import Layer
+
+
+class PairwiseDistance(Layer):
+    """p-norm of (x - y) along the last dim (reference:
+    nn/layer/distance.py:24)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        import jax.numpy as jnp
+
+        from ...core.dispatch import apply_op
+
+        def _pd(x, y, *, p, eps, keepdim):
+            d = jnp.abs(x - y) + eps
+            if p == float("inf"):
+                return jnp.max(d, axis=-1, keepdims=keepdim)
+            return jnp.sum(d ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+        return apply_op("pairwise_distance", _pd, x, y, p=float(self.p),
+                        eps=float(self.epsilon),
+                        keepdim=bool(self.keepdim))

@@ -108,3 +108,48 @@ class HingeEmbeddingLoss(Layer):
 
     def forward(self, input, label):
         return F.hinge_embedding_loss(input, label, self.margin, self.reduction)
+
+
+class CTCLoss(Layer):
+    """reference: nn/layer/loss.py CTCLoss → F.ctc_loss (warpctc)."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          self.blank, self.reduction, norm_by_times)
+
+
+class HSigmoidLoss(Layer):
+    """reference: nn/layer/loss.py HSigmoidLoss — owns the internal-node
+    weight table [num_classes-1, feature] (+ optional bias)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        import numpy as np
+
+        from ..initializer import Uniform
+
+        k = 1.0 / np.sqrt(feature_size)
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size],
+            default_initializer=Uniform(-k, k), attr=weight_attr)
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [num_classes - 1, 1], is_bias=True, attr=bias_attr)
+        else:
+            self.bias = None
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table, path_code)

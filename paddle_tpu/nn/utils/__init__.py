@@ -1,0 +1,5 @@
+"""paddle.nn.utils (reference: python/paddle/nn/utils/ —
+spectral_norm_hook.py, weight_norm_hook.py)."""
+from .spectral_norm_hook import spectral_norm  # noqa: F401
+
+__all__ = ["spectral_norm"]

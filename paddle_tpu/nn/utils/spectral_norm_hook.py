@@ -1,0 +1,101 @@
+"""Spectral normalization hook (reference:
+python/paddle/nn/utils/spectral_norm_hook.py; op:
+operators/spectral_norm_op.cc).
+
+``spectral_norm(layer)`` moves the wrapped parameter to
+``<name>_orig`` (which stays the trainable Parameter) and recomputes
+``layer.<name> = W / sigma`` in a forward-pre-hook, where sigma is the
+top singular value estimated by power iteration on persistent u/v
+buffers. Matching the reference op (CalcMatrixSigmaAndNormWeight),
+sigma is computed from the *current* u/v without back-propagating
+through the iteration — u/v are buffers, not parameters.
+"""
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["spectral_norm"]
+
+
+class _SpectralNorm:
+    def __init__(self, name, n_power_iterations, eps, dim):
+        self.name = name
+        self.n_power_iterations = int(n_power_iterations)
+        self.eps = float(eps)
+        self.dim = int(dim)
+
+    def _reshape_to_matrix(self, w):
+        if self.dim != 0:
+            perm = [self.dim] + [d for d in range(w.ndim)
+                                 if d != self.dim]
+            w = np.transpose(w, perm)
+        return w.reshape(w.shape[0], -1)
+
+    def compute(self, layer, training):
+        orig = layer._parameters[self.name + "_orig"]
+        w = np.asarray(orig._value, np.float32)
+        mat = self._reshape_to_matrix(w)
+        u = layer._buffers[self.name + "_u"]
+        v = layer._buffers[self.name + "_v"]
+        u = np.asarray(u._value if isinstance(u, Tensor) else u)
+        v = np.asarray(v._value if isinstance(v, Tensor) else v)
+        if training:
+            for _ in range(self.n_power_iterations):
+                v = mat.T @ u
+                v = v / (np.linalg.norm(v) + self.eps)
+                u = mat @ v
+                u = u / (np.linalg.norm(u) + self.eps)
+            layer._buffers[self.name + "_u"] = Tensor(
+                u.astype(np.float32), stop_gradient=True)
+            layer._buffers[self.name + "_v"] = Tensor(
+                v.astype(np.float32), stop_gradient=True)
+        sigma = float(u @ (mat @ v))
+        # sigma is a stop-gradient scalar (matches the reference op);
+        # scaling the Parameter keeps the autograd path W_orig -> loss
+        scaled = orig * (1.0 / max(sigma, self.eps))
+        object.__setattr__(layer, self.name, scaled)
+
+    def __call__(self, layer, inputs):
+        self.compute(layer, layer.training)
+        return None
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Apply spectral normalization to ``layer.<name>`` (reference
+    signature: nn/utils/spectral_norm_hook.py:spectral_norm)."""
+    if name + "_orig" in layer._parameters:
+        raise RuntimeError(f"spectral_norm already applied to {name}")
+    weight = layer._parameters.get(name)
+    if weight is None:
+        raise ValueError(f"layer has no parameter {name!r}")
+    if dim is None:
+        # reference default: 1 for Linear-style [in, out] weights, else 0
+        dim = 1 if type(layer).__name__ == "Linear" else 0
+
+    fn = _SpectralNorm(name, n_power_iterations, eps, dim)
+    del layer._parameters[name]
+    layer._parameters[name + "_orig"] = weight
+
+    w = np.asarray(weight._value, np.float32)
+    mat = fn._reshape_to_matrix(w)
+    rng = np.random.RandomState(0)
+    u = rng.randn(mat.shape[0]).astype(np.float32)
+    u /= (np.linalg.norm(u) + eps)
+    v = rng.randn(mat.shape[1]).astype(np.float32)
+    v /= (np.linalg.norm(v) + eps)
+    layer._buffers[name + "_u"] = Tensor(u, stop_gradient=True)
+    layer._buffers[name + "_v"] = Tensor(v, stop_gradient=True)
+
+    # warm-start the power iteration at apply time: with fresh random u/v
+    # the Rayleigh quotient u·(Wv) can be negative or tiny, which would
+    # divide the weight by ~eps; iterating makes u = Wv/|Wv|, so sigma is
+    # a non-negative (and converged) top-singular-value estimate before
+    # the first forward — including eval-only use where the hook never
+    # iterates again.
+    fn.n_power_iterations, warm = max(fn.n_power_iterations, 15), \
+        fn.n_power_iterations
+    fn.compute(layer, training=True)
+    fn.n_power_iterations = warm
+    layer.register_forward_pre_hook(fn)
+    return layer
