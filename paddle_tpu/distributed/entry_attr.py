@@ -1,0 +1,51 @@
+"""Sparse-table entry admission policies (reference:
+python/paddle/distributed/entry_attr.py — config objects consumed by the
+PS sparse tables to decide when a new feature id is admitted)."""
+
+__all__ = ["ProbabilityEntry", "CountFilterEntry"]
+
+
+class EntryAttr:
+    def __init__(self):
+        self._name = None
+
+    def _to_attr(self):
+        raise NotImplementedError
+
+
+class ProbabilityEntry(EntryAttr):
+    """Admit a new sparse feature with the given probability (reference:
+    entry_attr.py:59)."""
+
+    def __init__(self, probability):
+        super().__init__()
+        if not 0 < probability <= 1:
+            raise ValueError("probability must be in (0, 1]")
+        self._name = "probability_entry"
+        self._probability = float(probability)
+
+    @property
+    def probability(self):
+        return self._probability
+
+    def _to_attr(self):
+        return f"probability_entry:{self._probability}"
+
+
+class CountFilterEntry(EntryAttr):
+    """Admit a sparse feature once it has been seen ``count`` times
+    (reference: entry_attr.py:100)."""
+
+    def __init__(self, count):
+        super().__init__()
+        if count < 1:
+            raise ValueError("count must be >= 1")
+        self._name = "count_filter_entry"
+        self._count = int(count)
+
+    @property
+    def count(self):
+        return self._count
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self._count}"

@@ -16,7 +16,9 @@ tape/tracer executes real Python.
 """
 from .static_function import (  # noqa: F401
     to_static, declarative, StaticFunction, not_to_static, ignore_module,
+    enable_to_static,
 )
+from .translator import ProgramTranslator, TracedLayer  # noqa: F401
 from .save_load import save, load, TranslatedLayer  # noqa: F401
 
 _STATIC_MODE = False

@@ -70,6 +70,15 @@ class ConcreteProgram:
 
 _SF_COUNTER = itertools.count()
 
+# mutable cell so bound StaticFunctions share the global switch
+_TO_STATIC_ENABLED = [True]
+
+
+def enable_to_static(flag):
+    """Globally enable/disable to_static tracing (reference:
+    ProgramTranslator.enable / paddle.jit.enable_to_static)."""
+    _TO_STATIC_ENABLED[0] = bool(flag)
+
 
 class StaticFunction:
     def __init__(self, function, input_spec=None, build_strategy=None,
@@ -154,6 +163,10 @@ class StaticFunction:
                                getattr(fn, "__name__", "fn")), buffer_names
 
     def __call__(self, *args, **kwargs):
+        if not _TO_STATIC_ENABLED[0]:
+            # ProgramTranslator.enable(False): run the original dygraph
+            # code untraced (reference: program_translator.py enable)
+            return self._orig_fn(*args, **kwargs)
         layer = self._layer
         training = layer.training if layer is not None else True
         key = (_spec_of(args), _spec_of(tuple(sorted(kwargs.items()))), training)

@@ -383,6 +383,11 @@ def _resolve_output_padding(x, weight, output_size, output_padding, stride,
     valid when 0 <= op < stride."""
     if output_size is None:
         return _pair(output_padding, nd)
+    if isinstance(padding, str):
+        if padding.upper() != "VALID":
+            raise NotImplementedError(
+                f"output_size with string padding {padding!r}")
+        padding = [(0, 0)] * nd
     sizes = list(output_size)[-nd:]
     chan_first = data_format in ("NCHW", "NCL", "NCDHW")
     xs = x.shape[2:2 + nd] if chan_first else x.shape[1:1 + nd]
@@ -1396,6 +1401,8 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
         table, code, mask = _hsigmoid_default_tree(int(num_classes))
 
         def _hs(x, lab, w, b, table, code, mask):
+            if lab.ndim == 2:                    # paddle-convention [N, 1]
+                lab = lab[:, 0]
             t = table[lab]                       # [B, D] weight rows
             cd = code[lab]                       # [B, D] targets
             mk = mask[lab]                       # [B, D] valid steps
@@ -1412,6 +1419,8 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
                         table, code, mask)
 
     def _hs_custom(x, lab, w, b, pt_, pc):
+        if lab.ndim == 2:
+            lab = lab[:, 0]
         valid = (pt_ >= 0).astype(x.dtype)
         rows = jnp.maximum(pt_, 0)
         wrows = w[rows]

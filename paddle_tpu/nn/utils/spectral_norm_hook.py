@@ -70,8 +70,16 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
     if weight is None:
         raise ValueError(f"layer has no parameter {name!r}")
     if dim is None:
-        # reference default: 1 for Linear-style [in, out] weights, else 0
-        dim = 1 if type(layer).__name__ == "Linear" else 0
+        # reference default (spectral_norm_hook.py): dim=1 for layers
+        # whose weight stores the output dim second — Linear [in, out]
+        # and ConvNDTranspose [in, out, *k] — else dim=0
+        from ..layers.common import Linear
+        from ..layers.conv import (Conv1DTranspose, Conv2DTranspose,
+                                   Conv3DTranspose)
+
+        dim = 1 if isinstance(layer, (Linear, Conv1DTranspose,
+                                      Conv2DTranspose,
+                                      Conv3DTranspose)) else 0
 
     fn = _SpectralNorm(name, n_power_iterations, eps, dim)
     del layer._parameters[name]

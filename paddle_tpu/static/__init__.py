@@ -16,6 +16,12 @@ from .program import (  # noqa: F401
     save_inference_model, load_inference_model, normalize_program,
 )
 from .input_spec import InputSpec  # noqa: F401
+from .compat import (  # noqa: F401
+    BuildStrategy, CompiledProgram, ExecutionStrategy, ParallelExecutor,
+    Print, Variable, create_global_var, load, load_program_state, py_func,
+    save, set_program_state,
+)
+from ..framework.param_attr import WeightNormParamAttr  # noqa: F401
 from .. import nn as _nn_module
 
 

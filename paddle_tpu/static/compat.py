@@ -1,0 +1,249 @@
+"""Static-graph compat surface (reference: python/paddle/static/
+__init__.py exports backed by fluid/compiler.py CompiledProgram,
+framework/parallel_executor.cc, fluid/io.py save/load,
+fluid/layers/nn.py py_func / Print).
+
+Design stance (SURVEY §7): BuildStrategy/ExecutionStrategy tuned the
+reference's SSA-graph executors; XLA performs those passes (fusion,
+memory planning, scheduling) automatically, so here they are accepted,
+validated config carriers and CompiledProgram/ParallelExecutor are thin
+aliases over the jit-compiling Executor — the documented, not silent,
+delegation."""
+import os
+
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+__all__ = [
+    "BuildStrategy", "ExecutionStrategy", "CompiledProgram",
+    "ParallelExecutor", "Print", "Variable", "create_global_var",
+    "save", "load", "load_program_state", "set_program_state", "py_func",
+]
+
+# a static-graph "Variable" IS a recorded Tensor in this design
+Variable = Tensor
+
+
+class BuildStrategy:
+    """reference: framework/details/build_strategy.h. Every knob is a
+    plain attribute; XLA's compiler performs the corresponding passes
+    (fusion, memory reuse, allreduce fusion) unconditionally, so the
+    knobs carry intent for API compat rather than toggling behavior."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.debug_graphviz_path = ""
+        self.enable_sequential_execution = False
+        self.fuse_broadcast_ops = False
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_all_optimizer_ops = False
+        self.fuse_all_reduce_ops = False
+        self.memory_optimize = None
+        self.enable_inplace = True
+        self.build_cuda_graph = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class ExecutionStrategy:
+    """reference: framework/details/execution_strategy.h."""
+
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 100
+        self.num_iteration_per_run = 1
+        self.use_thread_barrier = False
+
+
+class CompiledProgram:
+    """reference: fluid/compiler.py CompiledProgram. Executor.run accepts
+    it transparently — compilation happens in the jit cache either way;
+    with_data_parallel records the intent (XLA shards over the mesh)."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._exec_strategy = None
+        self._loss_name = None
+        self._is_data_parallel = False
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        self._loss_name = loss_name
+        self._build_strategy = build_strategy or self._build_strategy
+        self._exec_strategy = exec_strategy or ExecutionStrategy()
+        self._is_data_parallel = True
+        return self
+
+
+class ParallelExecutor:
+    """reference: framework/parallel_executor.cc (legacy API). Thin
+    facade: one XLA executable spans all local devices."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        from .program import Executor, default_main_program
+
+        self._program = main_program or default_main_program()
+        self._loss_name = loss_name
+        self._executor = Executor()
+
+    def run(self, fetch_list, feed=None, feed_dict=None,
+            return_numpy=True):
+        feed = feed if feed is not None else feed_dict
+        from .program import default_main_program
+
+        program = self._program or default_main_program()
+        names = list(fetch_list or [])
+        resolved = [program.var(n) if isinstance(n, str) else n
+                    for n in names]
+        return self._executor.run(program, feed=feed,
+                                  fetch_list=resolved,
+                                  return_numpy=return_numpy)
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """reference: fluid/layers/control_flow.py Print op — runtime tensor
+    printing that survives jit (jax.debug.print rides the XLA program)."""
+    import jax
+
+    from ..core.dispatch import apply_op
+
+    msg = message or "Print"
+
+    def _print(x, *, msg):
+        jax.debug.print(msg + " {x}", x=x)
+        return x
+
+    return apply_op("print_op", _print, input, msg=str(msg))
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """reference: fluid/layers/tensor.py create_global_var — a
+    persistable filled variable living in the global scope."""
+    from .program import global_scope
+
+    arr = np.full(shape, value, dtype=np.dtype(dtype)
+                  if dtype != "bfloat16" else np.float32)
+    var = Parameter(arr, name=name)
+    var.persistable = True
+    var.stop_gradient = False
+    scope = global_scope()
+    scope.vars[var.name or f"global_var_{id(var)}"] = var
+    return var
+
+
+def _named_params(program):
+    """Stable name→param map: explicit names where set, else positional
+    (all_parameters() order is the recording order, deterministic for a
+    given program build)."""
+    out = {}
+    for i, p in enumerate(program.all_parameters()):
+        out[p.name or f"param_{i}"] = p
+    return out
+
+
+def _param_state(program):
+    return {name: np.asarray(p._value)
+            for name, p in _named_params(program).items()}
+
+
+def save(program, model_path, protocol=4, **configs):
+    """reference: fluid/io.py static save — parameters to
+    ``model_path + '.pdparams'``."""
+    state = _param_state(program)
+    path = model_path + ".pdparams"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.savez(path, **state)
+    # numpy appends .npz; normalize to the paddle extension
+    os.replace(path + ".npz", path)
+    return path
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """reference: fluid/io.py static load."""
+    set_program_state(program, load_program_state(model_path,
+                                                  var_list=var_list))
+
+
+def load_program_state(model_path, var_list=None):
+    """reference: fluid/io.py load_program_state — dict name→ndarray."""
+    path = model_path if model_path.endswith(".pdparams") \
+        else model_path + ".pdparams"
+    with np.load(path) as data:
+        state = {k: data[k] for k in data.files}
+    if var_list is not None:
+        keep = {getattr(v, "name", v) for v in var_list}
+        state = {k: v for k, v in state.items() if k in keep}
+    return state
+
+
+def set_program_state(program, state_dict):
+    """reference: fluid/io.py set_program_state."""
+    by_name = _named_params(program)
+    missing = []
+    for name, arr in state_dict.items():
+        p = by_name.get(name)
+        if p is None:
+            missing.append(name)
+            continue
+        p._value = np.asarray(arr)
+    if missing:
+        raise ValueError(f"state entries not found in program: {missing}")
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference: fluid/layers/nn.py py_func — run arbitrary Python in
+    the graph. Mapped to jax.pure_callback (host callback inside the XLA
+    program); ``out`` provides the result template(s). backward_func is
+    unsupported (use PyLayer for custom gradients)."""
+    import jax
+
+    from ..core.dispatch import apply_op
+
+    if backward_func is not None:
+        raise NotImplementedError(
+            "py_func backward_func: use paddle.autograd.PyLayer for "
+            "custom gradients on TPU")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    templates = tuple(
+        jax.ShapeDtypeStruct(tuple(o.shape), np.dtype(str(o.dtype))
+                             if str(o.dtype) != "bfloat16" else np.float32)
+        for o in outs)
+
+    def _py(*arrs):
+        import jax.numpy as jnp
+
+        def host(*vals):
+            res = func(*[Tensor(np.asarray(v)) for v in vals])
+            rs = res if isinstance(res, (list, tuple)) else [res]
+            return tuple(np.asarray(r._value if isinstance(r, Tensor)
+                                    else r) for r in rs)
+
+        return jax.pure_callback(host, templates, *arrs)
+
+    result = apply_op("py_func", _py, *xs)
+    results = result if isinstance(result, (list, tuple)) else [result]
+    for o, r in zip(outs, results):
+        o._value = r._value
+    return out

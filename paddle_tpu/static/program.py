@@ -73,10 +73,33 @@ class Program:
         return list(self.placeholders.values())
 
     def clone(self, for_test=False):
-        return self
+        """for_test=True strips the optimizer attachment (reference:
+        framework.py Program.clone pruning backward+optimize ops), so
+        Executor.run on the clone is inference-only. Ops/params/
+        placeholders are shared with the original — static programs are
+        immutable after recording."""
+        if not for_test:
+            return self
+        import copy
+
+        test = copy.copy(self)
+        test.train_attach = None
+        return test
 
     def var(self, name):
-        return self.placeholders[name]
+        if name in self.placeholders:
+            return self.placeholders[name]
+        # recorded (computed) variables: resolve by Tensor.name
+        for vid, vname in self.var_names.items():
+            if vname == name:
+                return self.id2tensor.get(vid)
+        for t in self.id2tensor.values():
+            if getattr(t, "name", None) == name:
+                return t
+        raise KeyError(
+            f"no variable named {name!r} in program (placeholders: "
+            f"{sorted(self.placeholders)}; recorded tensors are fetchable "
+            f"by object, or by name when Tensor.name is set)")
 
     # -- replay --
     def _replay(self, param_arrays, feed_arrays, placeholder_ids, param_ids):
@@ -116,6 +139,8 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True):
         program = program or default_main_program()
+        if hasattr(program, "_program"):  # CompiledProgram wrapper
+            program = program._program
         feed = feed or {}
         fetch_list = fetch_list or []
         if program._is_start_up or not program.ops:
@@ -302,8 +327,11 @@ def name_scope(prefix=None):
 
 
 class _Scope:
+    def __init__(self):
+        self.vars = {}
+
     def find_var(self, name):
-        return None
+        return self.vars.get(name)
 
 
 _global_scope = _Scope()

@@ -1,0 +1,236 @@
+"""static compat surface: CompiledProgram/ParallelExecutor/save/load/
+py_func/Print/create_global_var + jit ProgramTranslator/TracedLayer
+(reference: python/paddle/static/__init__.py, fluid/compiler.py,
+fluid/io.py, dygraph_to_static/program_translator.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+
+
+def _build_linreg():
+    paddle.enable_static()
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 3])
+        y = static.data("y", [None, 1])
+        pred = static.nn.fc(x, 1)
+        loss = paddle.mean((pred - y) ** 2)
+        opt = paddle.optimizer.SGD(0.1)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+class TestCompiledProgram:
+    def teardown_method(self):
+        paddle.disable_static()
+
+    def test_compiled_program_runs_via_executor(self):
+        main, startup, loss = _build_linreg()
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        xs = rng.rand(16, 3).astype(np.float32)
+        w = rng.rand(3, 1).astype(np.float32)
+        ys = xs @ w
+        compiled = static.CompiledProgram(
+            main, build_strategy=static.BuildStrategy()) \
+            .with_data_parallel(loss_name="loss")
+        first = last = None
+        for i in range(20):
+            out, = exe.run(compiled._program, feed={"x": xs, "y": ys},
+                           fetch_list=[loss])
+            last = float(np.asarray(out).mean())
+            first = last if first is None else first
+        assert last < first / 10
+
+    def test_parallel_executor_facade(self):
+        main, startup, loss = _build_linreg()
+        static.Executor().run(startup)
+        pe = static.ParallelExecutor(loss_name="loss", main_program=main)
+        rng = np.random.RandomState(1)
+        xs = rng.rand(8, 3).astype(np.float32)
+        ys = rng.rand(8, 1).astype(np.float32)
+        out, = pe.run(fetch_list=[loss], feed={"x": xs, "y": ys})
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_save_load_program_state(self, tmp_path):
+        main, startup, loss = _build_linreg()
+        exe = static.Executor()
+        exe.run(startup)
+        path = str(tmp_path / "model")
+        static.save(main, path)
+        state = static.load_program_state(path)
+        assert state and all(isinstance(v, np.ndarray)
+                             for v in state.values())
+        # perturb then restore
+        before = [np.asarray(p._value).copy()
+                  for p in main.all_parameters()]
+        for p in main.all_parameters():
+            p._value = np.zeros_like(np.asarray(p._value))
+        static.load(main, path)
+        for p, want in zip(main.all_parameters(), before):
+            np.testing.assert_allclose(np.asarray(p._value), want)
+        with pytest.raises(ValueError):
+            static.set_program_state(main, {"nonexistent": np.zeros(2)})
+
+    def test_create_global_var(self):
+        paddle.enable_static()
+        v = static.create_global_var([2, 3], 1.5, "float32", name="gv")
+        assert v.persistable and v.shape == [2, 3]
+        np.testing.assert_allclose(np.asarray(v._value), 1.5)
+        assert static.global_scope().find_var("gv") is v
+        paddle.disable_static()
+
+
+class TestPyFuncAndPrint:
+    def test_py_func_eager(self):
+        def doubler(t):
+            return paddle.to_tensor(t.numpy() * 2.0)
+
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        out_t = paddle.to_tensor(np.zeros((2, 2), np.float32))
+        res = static.py_func(doubler, x, out_t)
+        np.testing.assert_allclose(res.numpy(), 2.0)
+        with pytest.raises(NotImplementedError):
+            static.py_func(doubler, x, out_t, backward_func=doubler)
+
+    def test_print_passthrough(self, capfd):
+        x = paddle.to_tensor(np.asarray([1.0, 2.0], np.float32))
+        out = static.Print(x, message="dbg")
+        np.testing.assert_allclose(out.numpy(), [1.0, 2.0])
+
+
+class TestProgramTranslator:
+    def test_enable_disable_controls_tracing(self):
+        from paddle_tpu import jit
+
+        calls = []
+
+        @jit.to_static
+        def f(x):
+            calls.append(1)  # python side effect: visible when untraced
+            return x * 2
+
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        pt = jit.ProgramTranslator()
+        assert pt is jit.ProgramTranslator.get_instance()
+        pt.enable(False)
+        try:
+            n0 = len(calls)
+            f(x)
+            f(x)
+            assert len(calls) == n0 + 2  # ran eagerly every time
+        finally:
+            pt.enable(True)
+        assert pt.enable_to_static is True
+        np.testing.assert_allclose(f(x).numpy(), 2.0)
+
+    def test_traced_layer_roundtrip(self, tmp_path):
+        from paddle_tpu import jit
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 3), nn.Tanh())
+        net.eval()
+        x = paddle.to_tensor(np.random.RandomState(0).rand(2, 4)
+                             .astype(np.float32))
+        out, traced = jit.TracedLayer.trace(net, [x])
+        np.testing.assert_allclose(traced(x).numpy(), out.numpy(),
+                                   rtol=1e-6)
+        path = traced.save_inference_model(str(tmp_path / "traced"))
+        loaded = jit.load(path)
+        np.testing.assert_allclose(loaded(x).numpy(), out.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestCloneForTest:
+    def teardown_method(self):
+        paddle.disable_static()
+
+    def test_clone_for_test_is_inference_only(self):
+        """Regression: clone(for_test=True) must strip the optimizer
+        attachment so Executor.run stops training (reference:
+        framework.py Program.clone)."""
+        main, startup, loss = _build_linreg()
+        exe = static.Executor()
+        exe.run(startup)
+        test_prog = main.clone(for_test=True)
+        assert test_prog.train_attach is None
+        assert main.train_attach is not None  # original untouched
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.rand(4, 3).astype(np.float32),
+                "y": rng.rand(4, 1).astype(np.float32)}
+        a, = exe.run(test_prog, feed=feed, fetch_list=[loss])
+        b, = exe.run(test_prog, feed=feed, fetch_list=[loss])
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # while the train program changes params every run
+        c, = exe.run(main, feed=feed, fetch_list=[loss])
+        d, = exe.run(main, feed=feed, fetch_list=[loss])
+        assert float(np.asarray(d).mean()) < float(np.asarray(c).mean())
+
+
+class TestCompatReviewRegressions:
+    def teardown_method(self):
+        paddle.disable_static()
+
+    def test_executor_accepts_compiled_program_directly(self):
+        main, startup, loss = _build_linreg()
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.rand(8, 3).astype(np.float32),
+                "y": rng.rand(8, 1).astype(np.float32)}
+        compiled = static.CompiledProgram(main).with_data_parallel(
+            loss_name="loss")
+        out, = exe.run(compiled, feed=feed, fetch_list=[loss])
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_parallel_executor_fetch_by_name(self):
+        main, startup, loss = _build_linreg()
+        static.Executor().run(startup)
+        loss.name = "my_loss"
+        pe = static.ParallelExecutor(loss_name="my_loss",
+                                     main_program=main)
+        rng = np.random.RandomState(1)
+        out, = pe.run(fetch_list=["my_loss"],
+                      feed={"x": rng.rand(4, 3).astype(np.float32),
+                            "y": rng.rand(4, 1).astype(np.float32)})
+        assert np.isfinite(np.asarray(out)).all()
+        with pytest.raises(KeyError):
+            main.var("nonexistent_var")
+
+    def test_hsigmoid_column_labels(self):
+        paddle.seed(0)
+        layer = nn.HSigmoidLoss(8, 6)
+        x = paddle.to_tensor(np.random.RandomState(0).rand(4, 8)
+                             .astype(np.float32))
+        flat = layer(x, paddle.to_tensor(
+            np.asarray([0, 2, 4, 5], np.int64)))
+        col = layer(x, paddle.to_tensor(
+            np.asarray([[0], [2], [4], [5]], np.int64)))
+        np.testing.assert_allclose(col.numpy(), flat.numpy())
+
+    def test_conv_transpose_valid_padding_with_output_size(self):
+        from paddle_tpu.nn import functional as F
+
+        paddle.seed(0)
+        w = paddle.to_tensor(np.random.RandomState(0)
+                             .rand(2, 3, 3, 3).astype(np.float32))
+        x = paddle.to_tensor(np.random.RandomState(1)
+                             .rand(1, 2, 4, 4).astype(np.float32))
+        out = F.conv2d_transpose(x, w, stride=2, padding="VALID",
+                                 output_size=[10, 10])
+        assert out.shape == [1, 3, 10, 10]
+
+    def test_spectral_norm_conv_transpose_dim(self):
+        paddle.seed(0)
+        layer = nn.Conv2DTranspose(4, 8, 3)
+        w0 = np.asarray(layer.weight.numpy()).copy()
+        nn.spectral_norm(layer, n_power_iterations=30)
+        # sigma must be the top singular value of the dim=1 matricization
+        mat = np.transpose(w0, (1, 0, 2, 3)).reshape(8, -1)
+        sigma = np.linalg.svd(mat, compute_uv=False)[0]
+        np.testing.assert_allclose(np.asarray(layer.weight.numpy()),
+                                   w0 / sigma, rtol=1e-2, atol=1e-3)
