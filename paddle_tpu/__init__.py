@@ -96,3 +96,61 @@ def set_grad_enabled(mode):
             _dispatch._TAPE_ENABLED.reset(self._tok)
 
     return _Ctx()
+
+
+# ------------------------------------------------- top-level API parity
+# (reference: python/paddle/__init__.py exports)
+from . import fluid  # noqa: F401 (1.x-era compat namespace)
+from . import hub  # noqa: F401
+from .core.tensor import Tensor as VarBase  # noqa: F401 (legacy alias)
+from .framework.param_attr import ParamAttr  # noqa: F401
+from .framework import in_dygraph_mode  # noqa: F401
+from .hapi import callbacks  # noqa: F401
+from .hapi.flops import flops  # noqa: F401
+
+import numpy as _np
+
+dtype = _np.dtype  # paddle.dtype: the type of Tensor.dtype values
+
+
+def enable_dygraph(place=None):
+    """Legacy alias (reference: fluid/dygraph/base.py enable_dygraph)."""
+    disable_static()
+
+
+def disable_dygraph():
+    enable_static()
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Wrap a sample reader into a batch reader (reference:
+    python/paddle/batch.py)."""
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader
+
+
+def get_cudnn_version():
+    return None  # not a CUDA build
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def get_cuda_rng_state():
+    """Device RNG state (TPU analog of the CUDA generator state)."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    set_rng_state(state)

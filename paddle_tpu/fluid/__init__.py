@@ -1,0 +1,49 @@
+"""paddle.fluid compat namespace (reference: python/paddle/fluid/ — the
+1.x-era primary API, still the import path most reference-era code uses).
+
+This is a re-export shim over the 2.0-style modules this framework
+implements natively: fluid.layers → static.nn + functional/tensor ops,
+fluid.dygraph → the eager Layer runtime, fluid.io → static save/load.
+Symbols keep their 2.0 semantics (which the reference's fluid symbols
+already share in this revision)."""
+from .. import nn as _nn
+from .. import optimizer as _optimizer
+from .. import tensor as _tensor
+from ..core.place import CPUPlace, CUDAPlace, TPUPlace  # noqa: F401
+from ..core.tensor import Tensor as Variable  # noqa: F401
+from ..framework.param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+from ..static import (  # noqa: F401
+    BuildStrategy, CompiledProgram, ExecutionStrategy, Executor,
+    ParallelExecutor, Program, append_backward, data, default_main_program,
+    default_startup_program, global_scope, program_guard, scope_guard,
+)
+from ..static.compat import (  # noqa: F401
+    create_global_var, load_program_state, set_program_state,
+)
+from ..framework import in_dygraph_mode  # noqa: F401
+from ..jit import enable_static as _enable_static  # noqa: F401
+
+initializer = _nn.initializer
+optimizer = _optimizer
+from .. import regularizer  # noqa: F401
+
+from . import layers  # noqa: E402,F401
+from . import dygraph  # noqa: E402,F401
+from . import io  # noqa: E402,F401
+
+
+class core:
+    """Minimal fluid.core stand-in: the place types and feature probes
+    reference-era code touches (the real fluid.core is the pybind C++
+    module — SURVEY §2.11 — whose roles XLA/jax fill here)."""
+
+    CPUPlace = CPUPlace
+    CUDAPlace = CUDAPlace
+
+    @staticmethod
+    def is_compiled_with_cuda():
+        return False
+
+    @staticmethod
+    def get_cuda_device_count():
+        return 0

@@ -1,0 +1,50 @@
+"""fluid.dygraph compat (reference: python/paddle/fluid/dygraph/)."""
+import contextlib
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer  # noqa: F401
+from ..nn.layers.common import Embedding, Linear  # noqa: F401
+from ..nn.layers.container import LayerList, Sequential  # noqa: F401
+from ..distributed.parallel import DataParallel  # noqa: F401
+from ..jit import TracedLayer  # noqa: F401
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """reference: dygraph/base.py guard — eager mode context. Eager is
+    this framework's default; the guard just ensures static mode is off
+    inside the block."""
+    import paddle_tpu as paddle
+
+    was_static = not paddle.in_dynamic_mode()
+    paddle.disable_static()
+    try:
+        yield
+    finally:
+        if was_static:
+            paddle.enable_static()
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    """reference: dygraph/base.py to_variable."""
+    arr = np.asarray(value)
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    return Tensor(arr, name=name)
+
+
+def no_grad(func=None):
+    from ..core import dispatch
+
+    if func is None:
+        return dispatch.no_grad_ctx()
+    import functools
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with dispatch.no_grad_ctx():
+            return func(*args, **kwargs)
+
+    return wrapper

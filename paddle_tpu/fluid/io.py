@@ -1,0 +1,8 @@
+"""fluid.io compat (reference: python/paddle/fluid/io.py)."""
+from ..io.dataloader import DataLoader  # noqa: F401
+from ..static import (  # noqa: F401
+    load_inference_model, save_inference_model,
+)
+from ..static.compat import (  # noqa: F401
+    load, load_program_state, save, set_program_state,
+)

@@ -130,7 +130,13 @@ def Print(input, first_n=-1, message=None, summarize=20,
     msg = message or "Print"
 
     def _print(x, *, msg):
-        jax.debug.print(msg + " {x}", x=x)
+        # jax.debug.callback instead of debug.print: the message is
+        # arbitrary user text (braces would be parsed as format fields,
+        # and jax's escaped-brace handling is broken)
+        def host(v):
+            print(msg, v)
+
+        jax.debug.callback(host, x)
         return x
 
     return apply_op("print_op", _print, input, msg=str(msg))

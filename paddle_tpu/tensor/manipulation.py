@@ -470,3 +470,32 @@ def tolist(x):
 
     arr = x.numpy() if hasattr(x, "numpy") else np.asarray(x)
     return arr.tolist()
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    """Crop ``shape``-sized window at ``offsets`` (reference:
+    fluid/layers/nn.py crop_tensor / operators/crop_tensor_op.cc).
+    -1 in shape means "to the end of that dim"."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply_op
+
+    xnd = len(x.shape)
+    shape = list(shape if shape is not None else x.shape)
+    offsets = list(offsets if offsets is not None else [0] * xnd)
+
+    def _crop(x, *, shape, offsets):
+        import builtins
+
+        sl = tuple(
+            builtins.slice(o, x.shape[i] if s == -1 else o + s)
+            for i, (o, s) in enumerate(zip(offsets, shape)))
+        return x[sl]
+
+    return apply_op("crop_tensor", _crop, x, shape=tuple(int(s) for s in shape),
+                    offsets=tuple(int(o) for o in offsets))
+
+
+def reverse(x, axis, name=None):
+    """Legacy alias of flip (reference: fluid/layers/nn.py reverse)."""
+    return flip(x, axis)

@@ -1,0 +1,114 @@
+"""paddle.fluid 1.x-era compat shim (reference: python/paddle/fluid/ —
+the import path most reference-era user code actually uses)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+fluid = paddle.fluid
+
+
+class TestFluidStatic:
+    def teardown_method(self):
+        paddle.disable_static()
+
+    def test_classic_fluid_training_workflow(self):
+        paddle.enable_static()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [None, 3])
+            y = fluid.layers.data("y", [None, 1])
+            pred = fluid.layers.fc(x, 1)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square(pred - y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        xs = rng.rand(16, 3).astype(np.float32)
+        w = rng.rand(3, 1).astype(np.float32)
+        hist = []
+        for _ in range(25):
+            out, = exe.run(main, feed={"x": xs, "y": xs @ w},
+                           fetch_list=[loss])
+            hist.append(float(np.asarray(out).mean()))
+        assert hist[-1] < hist[0] / 10
+
+    def test_layers_namespace(self):
+        x = paddle.to_tensor(np.asarray([[1.0, -2.0]], np.float32))
+        np.testing.assert_allclose(fluid.layers.relu(x).numpy(),
+                                   [[1.0, 0.0]])
+        fc_out = fluid.layers.fill_constant([2, 2], "float32", 3.0)
+        np.testing.assert_allclose(fc_out.numpy(), 3.0)
+        s = fluid.layers.reduce_sum(
+            paddle.to_tensor(np.ones((2, 3), np.float32)), dim=1)
+        np.testing.assert_allclose(s.numpy(), [3.0, 3.0])
+        flags = paddle.to_tensor(np.asarray([True, False]))
+        assert bool(fluid.layers.reduce_any(flags).numpy())
+        assert not bool(fluid.layers.reduce_all(flags).numpy())
+
+
+class TestFluidDygraph:
+    def test_guard_and_to_variable(self):
+        with fluid.dygraph.guard():
+            v = fluid.dygraph.to_variable(
+                np.ones((2, 2), np.float32))
+            assert isinstance(v, paddle.Tensor)
+            lin = fluid.dygraph.Linear(2, 3)
+            assert lin(v).shape == [2, 3]
+
+    def test_no_grad_decorator(self):
+        @fluid.dygraph.no_grad
+        def f(x):
+            return x * 2
+
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        x.stop_gradient = False
+        out = f(x)
+        assert out.stop_gradient
+
+    def test_core_probes(self):
+        assert not fluid.core.is_compiled_with_cuda()
+        assert fluid.core.get_cuda_device_count() == 0
+        assert fluid.CPUPlace is not None
+
+    def test_io_reexports(self):
+        assert fluid.io.save_inference_model is not None
+        assert fluid.io.load is paddle.static.load
+
+
+class TestFluidReviewRegressions:
+    def test_pool2d_legacy_signature(self):
+        x = paddle.to_tensor(np.arange(16, dtype=np.float32)
+                             .reshape(1, 1, 4, 4))
+        mx = fluid.layers.pool2d(x, 2, "max", pool_stride=2)
+        av = fluid.layers.pool2d(x, 2, pool_type="avg", pool_stride=2)
+        np.testing.assert_allclose(mx.numpy()[0, 0], [[5, 7], [13, 15]])
+        np.testing.assert_allclose(av.numpy()[0, 0],
+                                   [[2.5, 4.5], [10.5, 12.5]])
+        g = fluid.layers.pool2d(x, global_pooling=True, pool_type="avg")
+        assert g.shape == [1, 1, 1, 1]
+        with pytest.raises(ValueError):
+            fluid.layers.pool2d(x, 2, "median")
+
+    def test_embedding_builder(self):
+        paddle.seed(0)
+        ids = paddle.to_tensor(np.asarray([[0, 2], [1, 3]], np.int64))
+        emb = fluid.layers.embedding(ids, size=[8, 4])
+        assert emb.shape == [2, 2, 4]
+
+    def test_print_with_braces(self):
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        out = paddle.static.Print(x, message="step {0} {dict}")
+        np.testing.assert_allclose(out.numpy(), 1.0)
+
+    def test_flops_leaf_model_and_transpose_conv(self):
+        from paddle_tpu import nn
+
+        lin = nn.Linear(8, 4)
+        assert paddle.flops(lin, [1, 8]) == 2 * (8 * 4 + 4)
+        # transpose conv counts cin-based taps, not cout^2
+        net = nn.Sequential(nn.Conv2DTranspose(6, 2, 3, padding=1))
+        f = paddle.flops(net, [1, 6, 4, 4])
+        # out [1,2,4,4] positions = 32; taps = cin(6) * 9
+        assert f == 2 * 32 * 6 * 9

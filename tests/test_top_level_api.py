@@ -1,0 +1,68 @@
+"""Top-level paddle.* parity additions: batch/crop_tensor/reverse/flops/
+hub/rng aliases/legacy names (reference: python/paddle/__init__.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class TestTopLevel:
+    def test_batch_reader(self):
+        r = paddle.batch(lambda: iter(range(7)), 3)
+        assert [len(b) for b in r()] == [3, 3, 1]
+        r2 = paddle.batch(lambda: iter(range(7)), 3, drop_last=True)
+        assert [len(b) for b in r2()] == [3, 3]
+
+    def test_crop_tensor_and_reverse(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        c = paddle.crop_tensor(x, shape=[2, -1], offsets=[1, 2])
+        np.testing.assert_allclose(c.numpy(), [[6, 7], [10, 11]])
+        np.testing.assert_allclose(paddle.reverse(x, 0).numpy(),
+                                   np.asarray(x.numpy())[::-1])
+
+    def test_flops_formulas(self):
+        net = nn.Sequential(nn.Conv2D(1, 4, 3, padding=1), nn.ReLU(),
+                            nn.Flatten(), nn.Linear(4 * 8 * 8, 10))
+        f = paddle.flops(net, [1, 1, 8, 8])
+        # conv: 256 out-positions x 9 MACs; relu 256; linear 2560 + 10 bias
+        assert f == 2 * (2304 + 256 + 2560 + 10)
+
+    def test_legacy_aliases(self):
+        assert paddle.VarBase is paddle.Tensor
+        assert paddle.get_cudnn_version() is None
+        assert paddle.is_compiled_with_npu() is False
+        state = paddle.get_cuda_rng_state()
+        paddle.set_cuda_rng_state(state)
+        paddle.enable_dygraph()
+        assert paddle.in_dynamic_mode()
+
+    def test_dtype_alias(self):
+        x = paddle.to_tensor(np.zeros(2, np.float32))
+        assert np.dtype(x.dtype) == np.float32
+        assert paddle.dtype("float32") == np.float32
+
+
+class TestHub:
+    def test_local_hubconf(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny_model(scale=1.0):\n"
+            "    '''A tiny test model.'''\n"
+            "    import paddle_tpu as paddle\n"
+            "    from paddle_tpu import nn\n"
+            "    net = nn.Linear(4, 2)\n"
+            "    return net\n")
+        assert paddle.hub.list(str(tmp_path)) == ["tiny_model"]
+        assert "tiny test model" in paddle.hub.help(str(tmp_path),
+                                                    "tiny_model")
+        net = paddle.hub.load(str(tmp_path), "tiny_model")
+        assert isinstance(net, nn.Layer)
+
+    def test_remote_sources_rejected(self, tmp_path):
+        with pytest.raises(NotImplementedError):
+            paddle.hub.load("user/repo", "m", source="github")
+
+    def test_missing_entrypoint(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text("x = 1\n")
+        with pytest.raises(ValueError):
+            paddle.hub.load(str(tmp_path), "nope")
