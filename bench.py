@@ -160,6 +160,14 @@ def main():
         platform = devs[0].platform
     log("devices:", devs)
 
+    if os.environ.get("BENCH_NO_PALLAS") == "1":
+        # A/B: XLA-fused attention vs the Pallas flash kernel (at seq 128
+        # a single 128x128 block may favor plain XLA fusion)
+        import paddle_tpu as _p
+
+        _p.set_flags({"use_pallas_kernels": False})
+        log("BENCH_NO_PALLAS=1: Pallas kernels disabled for this run")
+
     if MODEL == "resnet50":
         return run_resnet50(smoke, platform)
 

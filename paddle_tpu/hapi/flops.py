@@ -11,8 +11,6 @@ def _shape(t):
 
 
 def _count(layer, inputs, output):
-    from ..nn import layers as L
-
     name = type(layer).__name__
     in_shape = _shape(inputs[0]) if inputs else ()
     out_shape = _shape(output if not isinstance(output, (tuple, list))
@@ -34,7 +32,9 @@ def _count(layer, inputs, output):
             kernel_macs = int(np.prod(w.shape[1:]))
         out_positions = int(np.prod(out_shape[2:])) * out_shape[1] \
             * out_shape[0]
-        return out_positions * kernel_macs
+        bias_ops = (out_positions
+                    if getattr(layer, "bias", None) is not None else 0)
+        return out_positions * kernel_macs + bias_ops
     if name in ("BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D",
                 "LayerNorm", "InstanceNorm2D", "GroupNorm", "SyncBatchNorm"):
         return 2 * int(np.prod(out_shape))
@@ -55,19 +55,18 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
     records = []
     handles = []
 
-    def make_hook(layer):
-        def hook(lyr, inputs, output):
-            fn = custom_ops.get(type(lyr))
-            n = fn(lyr, inputs, output) if fn else _count(lyr, inputs,
-                                                          output)
-            records.append((type(lyr).__name__, n))
-            return output
+    def hook(lyr, inputs, output):
+        fn = custom_ops.get(type(lyr))
+        n = fn(lyr, inputs, output) if fn else _count(lyr, inputs, output)
+        records.append((type(lyr).__name__, n))
+        return output
 
-        return hook
-
+    seen = set()  # a weight-tied layer appears once per reference; hook once
     for _, sub in net.named_sublayers(include_self=True):
-        if not list(sub.sublayers()):  # leaves only (incl. a leaf net)
-            handles.append(sub.register_forward_post_hook(make_hook(sub)))
+        if id(sub) in seen or list(sub.sublayers()):
+            continue
+        seen.add(id(sub))
+        handles.append(sub.register_forward_post_hook(hook))
     was_training = net.training
     net.eval()
     try:

@@ -476,8 +476,6 @@ def crop_tensor(x, shape=None, offsets=None, name=None):
     """Crop ``shape``-sized window at ``offsets`` (reference:
     fluid/layers/nn.py crop_tensor / operators/crop_tensor_op.cc).
     -1 in shape means "to the end of that dim"."""
-    import jax.numpy as jnp
-
     from ..core.dispatch import apply_op
 
     xnd = len(x.shape)

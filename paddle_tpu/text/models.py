@@ -247,8 +247,9 @@ class LlamaAttention(nn.Layer):
         import jax.numpy as jnp
 
         from ..core.dispatch import apply_op
+        from ..ops.attention import scaled_dot_product_attention as _sdpa
 
-        def _attn(x, wq, wk, wv, wo, *, nh, nkv, hd):
+        def _qkv(x, wq, wk, wv, *, nh, nkv, hd):
             b, t, _ = x.shape
             q = (x @ wq).reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
             k = (x @ wk).reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
@@ -259,17 +260,22 @@ class LlamaAttention(nn.Layer):
                 rep = nh // nkv
                 k = jnp.repeat(k, rep, axis=1)
                 v = jnp.repeat(v, rep, axis=1)
-            logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
-            causal = jnp.tril(jnp.ones((t, t), bool))
-            logits = jnp.where(causal, logits, jnp.finfo(logits.dtype).min)
-            probs = jnp.asarray(jnp.exp(logits - jnp.max(logits, -1, keepdims=True)))
-            probs = probs / jnp.sum(probs, -1, keepdims=True)
-            out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+            return q, k, v
+
+        q, k, v = apply_op("llama_qkv_rope", _qkv, x, self.q_proj.weight,
+                           self.k_proj.weight, self.v_proj.weight,
+                           nh=self.num_heads, nkv=self.num_kv_heads,
+                           hd=self.head_dim)
+        # causal attention through the dispatching sdpa: Pallas flash
+        # kernel on TPU (blockwise softmax), XLA-fused jnp path elsewhere
+        out = _sdpa(q, k, v, is_causal=True, training=self.training)
+
+        def _merge(out, wo, *, nh, hd):
+            b, h, t, d = out.shape
             return out.transpose(0, 2, 1, 3).reshape(b, t, nh * hd) @ wo
 
-        return apply_op("llama_attention", _attn, x, self.q_proj.weight,
-                        self.k_proj.weight, self.v_proj.weight, self.o_proj.weight,
-                        nh=self.num_heads, nkv=self.num_kv_heads, hd=self.head_dim)
+        return apply_op("llama_attn_out", _merge, out, self.o_proj.weight,
+                        nh=self.num_heads, hd=self.head_dim)
 
 
 class LlamaMLP(nn.Layer):

@@ -107,8 +107,8 @@ class TestFluidReviewRegressions:
 
         lin = nn.Linear(8, 4)
         assert paddle.flops(lin, [1, 8]) == 2 * (8 * 4 + 4)
-        # transpose conv counts cin-based taps, not cout^2
+        # transpose conv counts cin-based taps, not cout^2 (+ bias adds)
         net = nn.Sequential(nn.Conv2DTranspose(6, 2, 3, padding=1))
         f = paddle.flops(net, [1, 6, 4, 4])
-        # out [1,2,4,4] positions = 32; taps = cin(6) * 9
-        assert f == 2 * 32 * 6 * 9
+        # out [1,2,4,4] positions = 32; taps = cin(6) * 9; bias 1/position
+        assert f == 2 * (32 * 6 * 9 + 32)

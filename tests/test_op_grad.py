@@ -171,6 +171,25 @@ OPS = [
     ("one_hot_matmul", lambda w: pt.matmul(paddle.to_tensor(
         np.eye(3, dtype=np.float32), stop_gradient=True), w),
      [a((3, 4))], {}),
+    # ---- round-3 additions (dist/mv/bilinear/3d pools/ctc/hsigmoid)
+    ("dist_l2", lambda x, y: pt.dist(x, y, 2),
+     [a((2, 3)), a((2, 3), 1) + 0.017], {}),
+    ("mv", lambda m, v: pt.mv(m, v), [a((3, 4)), a((4,), 1)], {}),
+    ("bilinear", lambda x1, x2, w: F.bilinear(x1, x2, w),
+     [a((2, 3)), a((2, 4), 1), a((2, 3, 4), 2)], {}),
+    # strictly distinct values: FD at argmax ties is meaningless
+    ("max_pool3d", lambda x: F.max_pool3d(x, 2),
+     [(R(9).permutation(64).astype(np.float32) / 64.0)
+      .reshape(1, 1, 4, 4, 4)], {}),
+    ("avg_pool3d", lambda x: F.avg_pool3d(x, 2), [a((1, 1, 4, 4, 4))], {}),
+    ("conv3d_transpose",
+     lambda x, w: F.conv3d_transpose(x, w, stride=2),
+     [a((1, 2, 3, 3, 3)), a((2, 2, 2, 2, 2), 1)], {}),
+    ("thresholded_relu", lambda x: F.thresholded_relu(x, 0.513),
+     [a((3, 4)) * 2], {}),
+    ("log_loss", lambda p: F.log_loss(p, paddle.to_tensor(
+        R(5).randint(0, 2, (3, 1)).astype(np.float32))),
+     [pos((3, 1), 0, 0.1, 0.9)], {}),
 ]
 
 # bce_logits target is data, not a grad input — fill it here

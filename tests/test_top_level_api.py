@@ -25,8 +25,15 @@ class TestTopLevel:
         net = nn.Sequential(nn.Conv2D(1, 4, 3, padding=1), nn.ReLU(),
                             nn.Flatten(), nn.Linear(4 * 8 * 8, 10))
         f = paddle.flops(net, [1, 1, 8, 8])
-        # conv: 256 out-positions x 9 MACs; relu 256; linear 2560 + 10 bias
-        assert f == 2 * (2304 + 256 + 2560 + 10)
+        # conv: 256 out-positions x (9 MACs + 1 bias); relu 256;
+        # linear 2560 + 10 bias
+        assert f == 2 * (2304 + 256 + 256 + 2560 + 10)
+
+    def test_flops_shared_layer_counted_per_call_not_per_hook(self):
+        shared = nn.Linear(4, 4)
+        net = nn.Sequential(shared, nn.ReLU(), shared)
+        # two forward calls of the shared layer -> 2x(16+4) + relu 4
+        assert paddle.flops(net, [1, 4]) == 2 * (2 * 20 + 4)
 
     def test_legacy_aliases(self):
         assert paddle.VarBase is paddle.Tensor
