@@ -15,6 +15,7 @@ from .topology import (  # noqa: F401
 )
 from . import fleet  # noqa: F401
 from .entry_attr import CountFilterEntry, ProbabilityEntry  # noqa: F401
+from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401
 from . import spmd  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from .spmd import build_train_step, shard_batch  # noqa: F401

@@ -160,3 +160,11 @@ def accuracy(input, label, k=1, correct=None, total=None):
         label_np = label_np[:, 0]
     acc = float(np.mean(np.any(idx == label_np[:, None], axis=1)))
     return Tensor(np.asarray([acc], np.float32))
+
+
+import sys as _sys
+
+metrics = _sys.modules[__name__]  # reference exposes metric.metrics submodule
+
+# register in sys.modules so dotted import statements (import paddle.x.y.z) resolve
+_sys.modules[__name__ + '.metrics'] = _sys.modules[__name__]

@@ -3,7 +3,8 @@ from .layer import Layer  # noqa: F401
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from .clip import (  # noqa: F401
-    ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue, clip_grad_norm_,
+    ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue, clip_by_norm,
+    clip_grad_norm_,
 )
 from .layers import *  # noqa: F401,F403
 from .layers.common import Linear, Embedding  # noqa: F401

@@ -109,3 +109,18 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=Fals
     for p, g in zip(ps, clipped):
         p._grad = g
     return Tensor(total)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """Clip a tensor to max L2 norm (reference: fluid/layers/nn.py
+    clip_by_norm / operators/clip_by_norm_op.cc)."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply_op
+
+    def _cbn(x, *, max_norm):
+        norm = jnp.sqrt(jnp.sum(x.astype(jnp.float32) ** 2))
+        scale = jnp.minimum(max_norm / jnp.maximum(norm, 1e-12), 1.0)
+        return (x * scale.astype(x.dtype))
+
+    return apply_op("clip_by_norm", _cbn, x, max_norm=float(max_norm))

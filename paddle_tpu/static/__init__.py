@@ -18,9 +18,13 @@ from .program import (  # noqa: F401
 from .input_spec import InputSpec  # noqa: F401
 from .compat import (  # noqa: F401
     BuildStrategy, CompiledProgram, ExecutionStrategy, ParallelExecutor,
-    Print, Variable, create_global_var, load, load_program_state, py_func,
-    save, set_program_state,
+    Print, Variable, accuracy, auc, create_global_var, create_parameter,
+    deserialize_persistables, deserialize_program, load, load_from_file,
+    load_program_state, py_func, save, save_to_file, serialize_persistables,
+    load_vars, save_vars, serialize_program, set_program_state, xpu_places,
 )
+from .program import _Scope as Scope  # noqa: F401
+from .. import amp  # noqa: F401 (paddle.static.amp alias)
 from ..framework.param_attr import WeightNormParamAttr  # noqa: F401
 from .. import nn as _nn_module
 

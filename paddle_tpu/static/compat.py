@@ -253,3 +253,262 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     for o, r in zip(outs, results):
         o._value = r._value
     return out
+
+
+# ------------------------------------------------ serialization family
+# (reference: static/io.py serialize_program/serialize_persistables/
+# deserialize_* / save_to_file / load_from_file — protobuf bytes there,
+# the StableHLO+pdiparams artifact bytes here)
+
+
+# serialized blobs use a length-prefixed tagged container, NOT pickle:
+# model bytes may come from untrusted sources, and unpickling untrusted
+# data is arbitrary code execution. Layout: magic, then per entry a
+# json-encoded {"ext", "size"} header line + raw bytes.
+_SER_MAGIC = b"PDTPU1\n"
+
+
+def _pack(blob):
+    import json as _json
+
+    out = [_SER_MAGIC]
+    for ext, data in blob.items():
+        out.append(_json.dumps({"ext": ext, "size": len(data)})
+                   .encode() + b"\n")
+        out.append(data)
+    return b"".join(out)
+
+
+def _unpack(data):
+    import json as _json
+
+    if not data.startswith(_SER_MAGIC):
+        raise ValueError("not a paddle_tpu serialized artifact")
+    pos = len(_SER_MAGIC)
+    blob = {}
+    while pos < len(data):
+        nl = data.index(b"\n", pos)
+        head = _json.loads(data[pos:nl].decode())
+        pos = nl + 1
+        blob[head["ext"]] = data[pos:pos + head["size"]]
+        pos += head["size"]
+    return blob
+
+
+def _export_artifacts(feed_vars, fetch_vars, program):
+    """Export once, read every artifact into memory, clean up the temp
+    dir. Cached per (program, feeds, fetches) so the standard
+    serialize_program + serialize_persistables pair traces once."""
+    import shutil
+    import tempfile
+
+    from .program import default_main_program, save_inference_model
+
+    program = program or default_main_program()
+    key = (id(program), tuple(id(v) for v in feed_vars),
+           tuple(id(v) for v in fetch_vars), len(program.ops))
+    cached = _EXPORT_CACHE.get(key)
+    if cached is not None:
+        return cached
+    d = tempfile.mkdtemp(prefix="pdtpu_ser_")
+    try:
+        prefix = os.path.join(d, "model")
+        save_inference_model(prefix, list(feed_vars), list(fetch_vars),
+                             None, program=program)
+        blob = {}
+        for ext in (".pdmodel", ".pdmeta.json", ".pdiparams"):
+            p = prefix + ext
+            if os.path.exists(p):
+                with open(p, "rb") as f:
+                    blob[ext] = f.read()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    _EXPORT_CACHE[key] = blob
+    if len(_EXPORT_CACHE) > 8:
+        _EXPORT_CACHE.pop(next(iter(_EXPORT_CACHE)))
+    return blob
+
+
+_EXPORT_CACHE = {}
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    """Portable program bytes (StableHLO + meta, no params)."""
+    blob = _export_artifacts(feed_vars, fetch_vars, program)
+    return _pack({k: v for k, v in blob.items() if k != ".pdiparams"})
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None,
+                           program=None, **kwargs):
+    """Parameter bytes matching serialize_program's artifact."""
+    blob = _export_artifacts(feed_vars, fetch_vars, program)
+    return _pack({".pdiparams": blob[".pdiparams"]})
+
+
+class _DeserializedProgram:
+    """Callable handle over deserialized artifacts; params attach via
+    deserialize_persistables. Run it directly, or through
+    Executor.run(feed=..., fetch_list=None) duck-typing."""
+
+    def __init__(self, blob):
+        import shutil
+        import tempfile
+        import weakref
+
+        self._dir = tempfile.mkdtemp(prefix="pdtpu_deser_")
+        self._prefix = os.path.join(self._dir, "model")
+        weakref.finalize(self, shutil.rmtree, self._dir,
+                         ignore_errors=True)
+        self._write(blob)
+        self.layer = None
+
+    def _write(self, blob):
+        for ext, data in blob.items():
+            with open(self._prefix + ext, "wb") as f:
+                f.write(data)
+
+    def _load(self):
+        from ..jit import load as jit_load
+
+        self.layer = jit_load(self._prefix)
+        return self.layer
+
+    def __call__(self, *inputs):
+        if self.layer is None:
+            raise RuntimeError(
+                "deserialize_persistables must attach parameters before "
+                "running the program")
+        return self.layer(*inputs)
+
+
+def deserialize_program(data):
+    return _DeserializedProgram(_unpack(bytes(data)))
+
+
+def deserialize_persistables(program, data, executor=None):
+    if not isinstance(program, _DeserializedProgram):
+        raise TypeError("program must come from deserialize_program")
+    program._write(_unpack(bytes(data)))
+    return program._load()
+
+
+def save_to_file(path, content):
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """reference: fluid/layers/tensor.py create_parameter."""
+    from ..nn import initializer as I
+
+    init = default_initializer or (I.Constant(0.0) if is_bias
+                                   else I.XavierNormal())
+    if attr is not None:
+        name = name or getattr(attr, "name", None)
+        init = getattr(attr, "initializer", None) or init
+    arr = np.zeros(shape, np.dtype(dtype) if dtype != "bfloat16"
+                   else np.float32)
+    p = Parameter(arr, name=name)
+    init(p)
+    return p
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Batch top-k accuracy tensor (reference:
+    fluid/layers/metric_op.py accuracy)."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply_op
+
+    def _acc(logits, y, *, k):
+        topk = jnp.argsort(-logits, axis=-1)[..., :k]
+        y = y.reshape(-1, 1)
+        hit = (topk == y).any(axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return apply_op("accuracy", _acc, input, label, k=int(k))
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Batch AUC tensor via the rank statistic (reference:
+    fluid/layers/metric_op.py auc — there a stateful op accumulating
+    confusion bins; here the exact batch AUC, stateless under jit)."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply_op
+
+    def _auc(probs, y):
+        # probability of the positive class
+        p = probs[:, 1] if probs.ndim == 2 and probs.shape[1] == 2 \
+            else probs.reshape(-1)
+        y = y.reshape(-1).astype(jnp.float32)
+        # tie-corrected (average) ranks: ordinal ranks would make the
+        # statistic order-dependent whenever scores tie (a constant
+        # predictor must score exactly 0.5)
+        sorted_p = jnp.sort(p)
+        lo = jnp.searchsorted(sorted_p, p, side="left")
+        hi = jnp.searchsorted(sorted_p, p, side="right")
+        ranks = (lo + hi + 1).astype(jnp.float32) / 2.0
+        n_pos = jnp.sum(y)
+        n_neg = y.shape[0] - n_pos
+        sum_ranks_pos = jnp.sum(ranks * y)
+        denom = jnp.maximum(n_pos * n_neg, 1.0)
+        return (sum_ranks_pos - n_pos * (n_pos + 1) / 2.0) / denom
+
+    return apply_op("auc", _auc, input, label)
+
+
+def xpu_places(device_ids=None):
+    raise RuntimeError(
+        "xpu_places: not compiled with XPU (this is the TPU-native build; "
+        "use paddle.static.tpu_places)")
+
+
+def save_vars(executor=None, dirname=None, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """reference: fluid/io.py save_vars — save a (filtered) subset of a
+    program's parameters under ``dirname``."""
+    from .program import default_main_program
+
+    program = main_program or default_main_program()
+    named = _named_params(program)
+    if vars is not None:
+        keep = {getattr(v, "name", v) for v in vars}
+        named = {n: p for n, p in named.items() if n in keep}
+    elif predicate is not None:
+        named = {n: p for n, p in named.items() if predicate(p)}
+    os.makedirs(dirname, exist_ok=True)
+    target = os.path.join(dirname, filename or "vars.npz")
+    np.savez(target, **{n: np.asarray(p._value) for n, p in named.items()})
+    base, ext = os.path.splitext(target)
+    if ext != ".npz":  # numpy always appends .npz
+        os.replace(target + ".npz", target)
+    return target
+
+
+def load_vars(executor=None, dirname=None, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """reference: fluid/io.py load_vars."""
+    from .program import default_main_program
+
+    program = main_program or default_main_program()
+    target = os.path.join(dirname, filename or "vars.npz")
+    with np.load(target) as data:
+        state = {k: data[k] for k in data.files}
+    named = _named_params(program)
+    if vars is not None:
+        keep = {getattr(v, "name", v) for v in vars}
+        state = {k: v for k, v in state.items() if k in keep}
+    for n, arr in state.items():
+        p = named.get(n)
+        if p is None or (predicate is not None and not predicate(p)):
+            continue
+        p._value = arr

@@ -405,3 +405,14 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
            fill=0):
     t = RandomRotation((angle, angle), fill=fill)
     return t._apply_image(np.asarray(img))
+
+
+import sys as _sys
+
+# reference packages these as submodules; single-module org here
+functional = _sys.modules[__name__]
+transforms = _sys.modules[__name__]
+
+# register in sys.modules so dotted import statements (import paddle.x.y.z) resolve
+_sys.modules[__name__ + '.functional'] = _sys.modules[__name__]
+_sys.modules[__name__ + '.transforms'] = _sys.modules[__name__]

@@ -1,0 +1,181 @@
+"""Pin full export parity against the reference tree (tools/api_parity.py)
+and exercise the round-3 additions it drove: static serialization family,
+accuracy/auc, clip_by_norm, save_vars/load_vars."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+
+_REF = "/root/reference"
+
+
+@pytest.mark.skipif(not os.path.isdir(_REF), reason="reference not mounted")
+def test_zero_missing_exports():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "api_parity", os.path.join(os.path.dirname(__file__), "..",
+                                   "tools", "api_parity.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    failures = mod.check(_REF, verbose=False)
+    assert not failures, failures
+
+
+class TestSerializationFamily:
+    def teardown_method(self):
+        paddle.disable_static()
+
+    def test_serialize_roundtrip(self, tmp_path):
+        paddle.enable_static()
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 3])
+            h = static.nn.fc(x, 2)
+        exe = static.Executor()
+        exe.run(startup)
+        prog_bytes = static.serialize_program([x], [h], program=main)
+        param_bytes = static.serialize_persistables([x], [h], exe,
+                                                    program=main)
+        static.save_to_file(str(tmp_path / "m.pdmodel"), prog_bytes)
+        static.save_to_file(str(tmp_path / "m.pdiparams"), param_bytes)
+
+        feed = np.random.RandomState(0).rand(4, 3).astype(np.float32)
+        want, = exe.run(main, feed={"x": feed}, fetch_list=[h])
+
+        prog = static.deserialize_program(
+            static.load_from_file(str(tmp_path / "m.pdmodel")))
+        with pytest.raises(RuntimeError):
+            prog(paddle.to_tensor(feed))  # params not attached yet
+        static.deserialize_persistables(
+            prog, static.load_from_file(str(tmp_path / "m.pdiparams")),
+            exe)
+        got = prog(paddle.to_tensor(feed))
+        np.testing.assert_allclose(np.asarray(got.numpy()),
+                                   np.asarray(want), rtol=1e-5, atol=1e-6)
+
+    def test_save_load_vars(self, tmp_path):
+        paddle.enable_static()
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 3])
+            static.nn.fc(x, 2)
+        static.Executor().run(startup)
+        d = str(tmp_path / "vars")
+        static.save_vars(dirname=d, main_program=main)
+        before = [np.asarray(p._value).copy()
+                  for p in main.all_parameters()]
+        for p in main.all_parameters():
+            p._value = np.zeros_like(np.asarray(p._value))
+        static.load_vars(dirname=d, main_program=main)
+        for p, want in zip(main.all_parameters(), before):
+            np.testing.assert_allclose(np.asarray(p._value), want)
+
+
+class TestStaticMetricsAndClip:
+    def test_accuracy(self):
+        logits = paddle.to_tensor(np.asarray(
+            [[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]], np.float32))
+        labels = paddle.to_tensor(np.asarray([0, 1, 1], np.int64))
+        acc = static.accuracy(logits, labels)
+        assert float(acc.numpy()) == pytest.approx(2 / 3)
+        acc2 = static.accuracy(logits, labels, k=2)
+        assert float(acc2.numpy()) == pytest.approx(1.0)
+
+    def test_auc_matches_sklearn_formula(self):
+        rng = np.random.RandomState(0)
+        p = rng.rand(64).astype(np.float32)
+        y = (rng.rand(64) > 0.5).astype(np.int64)
+        got = float(static.auc(paddle.to_tensor(p),
+                               paddle.to_tensor(y)).numpy())
+        # rank-statistic oracle
+        order = np.argsort(p)
+        ranks = np.empty(64)
+        ranks[order] = np.arange(1, 65)
+        n_pos = y.sum()
+        want = (ranks[y == 1].sum() - n_pos * (n_pos + 1) / 2) / \
+            (n_pos * (64 - n_pos))
+        assert got == pytest.approx(want, rel=1e-5)
+
+    def test_clip_by_norm(self):
+        x = paddle.to_tensor(np.asarray([3.0, 4.0], np.float32))
+        clipped = nn.clip_by_norm(x, 1.0)
+        np.testing.assert_allclose(clipped.numpy(), [0.6, 0.8], rtol=1e-5)
+        same = nn.clip_by_norm(x, 10.0)
+        np.testing.assert_allclose(same.numpy(), [3.0, 4.0])
+
+    def test_create_parameter_and_scope(self):
+        paddle.enable_static()
+        p = static.create_parameter([3, 2], "float32")
+        assert p.shape == [3, 2]
+        assert isinstance(static.global_scope(), static.Scope)
+        with pytest.raises(RuntimeError):
+            static.xpu_places()
+        paddle.disable_static()
+
+
+class TestSerializationReviewRegressions:
+    def teardown_method(self):
+        paddle.disable_static()
+
+    def test_blob_is_not_pickle(self):
+        paddle.enable_static()
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 3])
+            h = static.nn.fc(x, 2)
+        static.Executor().run(startup)
+        blob = static.serialize_program([x], [h], program=main)
+        assert blob.startswith(b"PDTPU1\n")  # tagged container, no pickle
+        with pytest.raises(ValueError):
+            static.deserialize_program(b"arbitrary bytes")
+
+    def test_auc_constant_predictor_is_half(self):
+        p = paddle.to_tensor(np.full(32, 0.7, np.float32))
+        y = paddle.to_tensor((np.arange(32) % 2).astype(np.int64))
+        assert float(static.auc(p, y).numpy()) == pytest.approx(0.5)
+
+    def test_dotted_submodule_imports(self):
+        import importlib
+
+        m = importlib.import_module("paddle_tpu.vision.transforms.functional")
+        assert hasattr(m, "to_tensor")
+        d = importlib.import_module("paddle_tpu.vision.datasets.mnist")
+        assert hasattr(d, "MNIST")
+        mm = importlib.import_module("paddle_tpu.metric.metrics")
+        assert hasattr(mm, "Accuracy")
+
+    def test_create_parameter_attr_name(self):
+        from paddle_tpu import ParamAttr
+
+        paddle.enable_static()
+        p = static.create_parameter([2, 2], "float32",
+                                    attr=ParamAttr(name="w0"))
+        assert p.name == "w0"
+        paddle.disable_static()
+
+    def test_load_vars_predicate(self, tmp_path):
+        paddle.enable_static()
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 3])
+            static.nn.fc(x, 2)
+        static.Executor().run(startup)
+        d = str(tmp_path / "v")
+        static.save_vars(dirname=d, main_program=main)
+        before = [np.asarray(p._value).copy()
+                  for p in main.all_parameters()]
+        for p in main.all_parameters():
+            p._value = np.zeros_like(np.asarray(p._value))
+        # predicate excluding everything -> nothing restored
+        static.load_vars(dirname=d, main_program=main,
+                         predicate=lambda p: False)
+        for p in main.all_parameters():
+            np.testing.assert_allclose(np.asarray(p._value), 0.0)
+        static.load_vars(dirname=d, main_program=main,
+                         predicate=lambda p: True)
+        for p, want in zip(main.all_parameters(), before):
+            np.testing.assert_allclose(np.asarray(p._value), want)

