@@ -3,3 +3,4 @@ backward; C++ imperative/py_layer_fwd.h)."""
 from ..core.tape import backward, grad  # noqa: F401
 from ..core.dispatch import no_grad_ctx as no_grad  # noqa: F401
 from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+from . import backward_mode  # noqa: F401

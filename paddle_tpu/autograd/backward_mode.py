@@ -1,0 +1,6 @@
+"""paddle.autograd.backward_mode (reference:
+python/paddle/autograd/backward_mode.py) — the reverse-mode entry point
+re-exported as its own submodule."""
+from ..core.tape import backward  # noqa: F401
+
+__all__ = ["backward"]

@@ -1,0 +1,10 @@
+"""Register a module under dotted child names in sys.modules so
+reference-style ``import paddle.x.y.z`` statements resolve when this
+framework packs several reference submodules into one module."""
+import sys
+
+def alias_submodules(module_name, *child_names):
+    mod = sys.modules[module_name]
+    for child in child_names:
+        sys.modules[f"{module_name}.{child}"] = mod
+        setattr(mod, child, mod)

@@ -3,11 +3,10 @@ TranslatedLayer; format: save_inference_model's ProgramDesc+params).
 
 TPU-native format: serialized StableHLO (jax.export) + numpy params +
 a JSON signature — the portable compiled-program analog. Falls back to
-pickled params + a marker when export is unavailable for an input spec.
+npz params + a marker when export is unavailable for an input spec.
 """
 import json
 import os
-import pickle
 
 import numpy as np
 import jax
@@ -72,7 +71,7 @@ def save(layer, path, input_spec=None, **configs):
 
 def write_artifacts(path, jitted_fn, state_specs, input_specs, params, buffers):
     """Serialize the single on-disk model format (<prefix>.pdmodel StableHLO +
-    .pdiparams pickle + .pdmeta.json sidecar) shared by jit.save and
+    .pdiparams npz + .pdmeta.json sidecar) shared by jit.save and
     static.save_inference_model. ``jitted_fn(params_like, buffers_like,
     *inputs)``; state_specs = (param_specs, buffer_specs)."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -93,13 +92,31 @@ def write_artifacts(path, jitted_fn, state_specs, input_specs, params, buffers):
             f.write(blob)
         payload["format"] = "stablehlo"
     except Exception as e:  # noqa: BLE001
-        payload["format"] = "pickle-only"
+        payload["format"] = "params-only"
         payload["export_error"] = repr(e)
+    # .pdiparams is an npz (never pickle: loaded models may come from
+    # untrusted sources, and np.load defaults to allow_pickle=False);
+    # bfloat16 arrays round-trip as uint16 views since numpy's npz
+    # format has no native bf16
+    arrays = {}
+    for prefix, d in (("p", payload["params"]), ("b", payload["buffers"])):
+        for n, a in d.items():
+            a = np.asarray(a)
+            if a.dtype.name == "bfloat16":
+                arrays[f"{prefix}:bf16:{n}"] = a.view(np.uint16)
+            else:
+                arrays[f"{prefix}:raw:{n}"] = a
+    import io as _io
+
+    buf = _io.BytesIO()
+    np.savez(buf, **arrays)
     with open(path + ".pdiparams", "wb") as f:
-        pickle.dump(payload, f, protocol=4)
+        f.write(buf.getvalue())
     with open(path + ".pdmeta.json", "w") as f:
         json.dump({"format": payload["format"],
-                   "input_specs": payload["input_specs"]}, f)
+                   "input_specs": payload["input_specs"],
+                   "op_versions": payload["op_versions"],
+                   "export_error": payload.get("export_error")}, f)
 
 
 class TranslatedLayer(Layer):
@@ -134,15 +151,29 @@ class TranslatedLayer(Layer):
         return outs[0] if len(outs) == 1 else outs
 
 
+def _split_arrays(npz):
+    params, buffers = {}, {}
+    for key in npz.files:
+        prefix, enc, name = key.split(":", 2)
+        arr = npz[key]
+        if enc == "bf16":
+            import ml_dtypes
+
+            arr = arr.view(ml_dtypes.bfloat16)
+        (params if prefix == "p" else buffers)[name] = arr
+    return params, buffers
+
+
 def load(path, **configs):
     """paddle.jit.load — rebuild a callable Layer from the exported module."""
-    with open(path + ".pdiparams", "rb") as f:
-        payload = pickle.load(f)
+    with open(path + ".pdmeta.json") as f:
+        payload = json.load(f)
+    # allow_pickle stays False (default): params may be untrusted input
+    with np.load(path + ".pdiparams") as npz:
+        params, buffers = _split_arrays(npz)
     from ..framework import op_version
 
     op_version.check_compat(payload.get("op_versions"), where=path)
-    params = payload["params"]
-    buffers = payload["buffers"]
     if payload.get("format") == "stablehlo" and os.path.exists(path + ".pdmodel"):
         from jax import export as jax_export
 

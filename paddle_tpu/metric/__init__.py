@@ -162,9 +162,6 @@ def accuracy(input, label, k=1, correct=None, total=None):
     return Tensor(np.asarray([acc], np.float32))
 
 
-import sys as _sys
+from ..core.module_alias import alias_submodules as _alias
 
-metrics = _sys.modules[__name__]  # reference exposes metric.metrics submodule
-
-# register in sys.modules so dotted import statements (import paddle.x.y.z) resolve
-_sys.modules[__name__ + '.metrics'] = _sys.modules[__name__]
+_alias(__name__, "metrics")

@@ -185,3 +185,28 @@ _GLOBAL_BIAS_INIT = None
 
 def global_initializer(is_bias):
     return _GLOBAL_BIAS_INIT if is_bias else _GLOBAL_WEIGHT_INIT
+
+
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel init for transposed-conv upsampling
+    weights [c_out, c_in, k, k] or [c_in, c_out, k, k] (reference:
+    fluid/initializer.py:729 BilinearInitializer): each spatial kernel is
+    the bilinear upsample stencil, so a freshly-initialized
+    Conv2DTranspose(stride=f, kernel=2f-f%2, padding=ceil((f-1)/2))
+    performs bilinear interpolation."""
+
+    def _generate(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D weight, "
+                             f"got shape {shape}")
+        k = shape[3]
+        factor = (k + 1) // 2
+        center = factor - 1.0 if k % 2 == 1 else factor - 0.5
+        og = np.ogrid[:k, :k]
+        filt = ((1 - np.abs(og[0] - center) / factor)
+                * (1 - np.abs(og[1] - center) / factor))
+        weight = np.zeros(shape, np.float32)
+        for i in range(shape[0]):  # stencil on each (i, i % c_in) pair
+            weight[i, i % shape[1]] = filt
+        return jnp.asarray(weight.astype(np.float32 if np.dtype(dtype).kind
+                                         != "f" else dtype))

@@ -261,9 +261,10 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
 # the StableHLO+pdiparams artifact bytes here)
 
 
-# serialized blobs use a length-prefixed tagged container, NOT pickle:
-# model bytes may come from untrusted sources, and unpickling untrusted
-# data is arbitrary code execution. Layout: magic, then per entry a
+# serialized blobs use a length-prefixed tagged container; the payloads
+# inside are themselves pickle-free (StableHLO bytes, json meta, npz
+# params loaded with allow_pickle=False), so untrusted model bytes can
+# fail to parse but cannot execute code. Layout: magic, then per entry a
 # json-encoded {"ext", "size"} header line + raw bytes.
 _SER_MAGIC = b"PDTPU1\n"
 
@@ -296,20 +297,16 @@ def _unpack(data):
 
 
 def _export_artifacts(feed_vars, fetch_vars, program):
-    """Export once, read every artifact into memory, clean up the temp
-    dir. Cached per (program, feeds, fetches) so the standard
-    serialize_program + serialize_persistables pair traces once."""
+    """Export and read every artifact into memory, cleaning up the temp
+    dir. Deliberately uncached: params live in mutable Tensors, so any
+    cache key short of hashing every weight would serve stale bytes
+    after a training step (checkpointing the wrong weights silently)."""
     import shutil
     import tempfile
 
     from .program import default_main_program, save_inference_model
 
     program = program or default_main_program()
-    key = (id(program), tuple(id(v) for v in feed_vars),
-           tuple(id(v) for v in fetch_vars), len(program.ops))
-    cached = _EXPORT_CACHE.get(key)
-    if cached is not None:
-        return cached
     d = tempfile.mkdtemp(prefix="pdtpu_ser_")
     try:
         prefix = os.path.join(d, "model")
@@ -323,13 +320,7 @@ def _export_artifacts(feed_vars, fetch_vars, program):
                     blob[ext] = f.read()
     finally:
         shutil.rmtree(d, ignore_errors=True)
-    _EXPORT_CACHE[key] = blob
-    if len(_EXPORT_CACHE) > 8:
-        _EXPORT_CACHE.pop(next(iter(_EXPORT_CACHE)))
     return blob
-
-
-_EXPORT_CACHE = {}
 
 
 def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):

@@ -8,3 +8,45 @@ from . import profiler  # noqa: F401
 from .deprecated import deprecated  # noqa: F401
 from .install_check import run_check  # noqa: F401
 from .lazy_import import try_import  # noqa: F401
+from . import image_util  # noqa: F401
+from . import unique_name  # noqa: F401
+from .profiler import Profiler, ProfilerOptions, get_profiler  # noqa: F401
+
+
+def require_version(min_version, max_version=None):
+    """reference: fluid/framework.py require_version — validate the
+    installed framework version against a range. This TPU-native build
+    reports itself as 2.1.0-compatible."""
+    current = (2, 1, 0)
+
+    def parse(v):
+        import re as _re
+
+        parts = str(v).split(".")
+        nums = []
+        for p in (parts + ["0", "0"])[:3]:
+            m = _re.match(r"\d+", p)  # '0rc1'/'dev0' -> numeric prefix
+            nums.append(int(m.group()) if m else 0)
+        return tuple(nums)
+
+    if parse(min_version) > current:
+        raise Exception(
+            f"paddle_tpu (compat 2.1.0) does not satisfy minimum "
+            f"required version {min_version}")
+    if max_version is not None and parse(max_version) < current:
+        raise Exception(
+            f"paddle_tpu (compat 2.1.0) exceeds maximum "
+            f"required version {max_version}")
+
+
+class OpLastCheckpointChecker:
+    """reference: utils/op_version.py — query the last upgrade
+    checkpoint recorded for an op (backed by framework.op_version)."""
+
+    def __init__(self):
+        from ..framework import op_version
+
+        self.checkpoints_map = dict(op_version.all_op_versions())
+
+    def get_version(self, op_name, default=1):
+        return self.checkpoints_map.get(op_name, default)

@@ -92,3 +92,64 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
 
 def cuda_profiler(*args, **kwargs):
     raise NotImplementedError("use jax.profiler traces on TPU")
+
+
+# --------------------------------------------- legacy fluid-profiler API
+# (reference: python/paddle/utils/profiler.py ProfilerOptions/Profiler/
+# get_profiler wrapping fluid.profiler start/stop)
+
+
+class ProfilerOptions:
+    def __init__(self, options=None):
+        self.options = {
+            "state": "All", "sorted_key": "default",
+            "tracer_level": "Default", "batch_range": [0, 100],
+            "output_thread_detail": False, "profile_path": "none",
+            "timeline_path": "none", "op_summary_path": "none",
+        }
+        if options is not None:
+            self.options.update(options)
+
+    def with_state(self, state):
+        new = ProfilerOptions(dict(self.options))
+        new.options["state"] = state
+        return new
+
+    def __getitem__(self, name):
+        return self.options[name]
+
+
+class Profiler:
+    """Context-manager profiler (reference: utils/profiler.py Profiler):
+    start/stop the jax trace + host span aggregation."""
+
+    def __init__(self, enabled=True, options=None):
+        self.enabled = enabled
+        self.profiler_options = options or ProfilerOptions()
+        self._span = None
+
+    def __enter__(self):
+        if self.enabled:
+            reset_summary()
+            self._span = RecordEvent("Profiler")
+            self._span.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        if self._span is not None:
+            self._span.__exit__(*exc)
+            self._span = None
+        return False
+
+    def reset(self):
+        reset_summary()
+
+
+_profiler = None
+
+
+def get_profiler(options=None):
+    global _profiler
+    if _profiler is None:
+        _profiler = Profiler(options=options)
+    return _profiler

@@ -1,0 +1,42 @@
+"""paddle.utils.unique_name (reference: fluid/unique_name.py) — process-
+wide unique name generation with guard/switch scoping."""
+import contextlib
+import itertools
+import threading
+
+__all__ = ["generate", "guard", "switch"]
+
+
+class _Generator:
+    def __init__(self):
+        self.ids = {}
+        self.lock = threading.Lock()
+
+    def unique(self, key):
+        with self.lock:
+            counter = self.ids.setdefault(key, itertools.count(0))
+            return f"{key}_{next(counter)}"
+
+
+_generator = _Generator()
+
+
+def generate(key):
+    return _generator.unique(key)
+
+
+def switch(new_generator=None):
+    global _generator
+    old = _generator
+    _generator = new_generator or _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        global _generator
+        _generator = old

@@ -272,14 +272,6 @@ class Flowers(Dataset):
         return len(self.labels)
 
 
-import sys as _sys
+from ..core.module_alias import alias_submodules as _alias
 
-# reference submodule names (vision/datasets/{mnist,cifar,...}.py)
-mnist = cifar = flowers = folder = voc2012 = _sys.modules[__name__]
-
-# register in sys.modules so dotted import statements (import paddle.x.y.z) resolve
-_sys.modules[__name__ + '.mnist'] = _sys.modules[__name__]
-_sys.modules[__name__ + '.cifar'] = _sys.modules[__name__]
-_sys.modules[__name__ + '.flowers'] = _sys.modules[__name__]
-_sys.modules[__name__ + '.folder'] = _sys.modules[__name__]
-_sys.modules[__name__ + '.voc2012'] = _sys.modules[__name__]
+_alias(__name__, "mnist", "cifar", "flowers", "folder", "voc2012")

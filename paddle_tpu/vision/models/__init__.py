@@ -6,3 +6,13 @@ from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenet import (  # noqa: F401
     MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2,
 )
+
+
+from . import mobilenet  # noqa: F401
+
+# reference file names mobilenetv1/mobilenetv2 both map to mobilenet here
+import sys as _s
+
+_s.modules[__name__ + ".mobilenetv1"] = mobilenet
+_s.modules[__name__ + ".mobilenetv2"] = mobilenet
+mobilenetv1 = mobilenetv2 = mobilenet

@@ -407,12 +407,6 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
     return t._apply_image(np.asarray(img))
 
 
-import sys as _sys
+from ..core.module_alias import alias_submodules as _alias
 
-# reference packages these as submodules; single-module org here
-functional = _sys.modules[__name__]
-transforms = _sys.modules[__name__]
-
-# register in sys.modules so dotted import statements (import paddle.x.y.z) resolve
-_sys.modules[__name__ + '.functional'] = _sys.modules[__name__]
-_sys.modules[__name__ + '.transforms'] = _sys.modules[__name__]
+_alias(__name__, "functional", "transforms")

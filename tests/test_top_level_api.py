@@ -73,3 +73,70 @@ class TestHub:
         (tmp_path / "hubconf.py").write_text("x = 1\n")
         with pytest.raises(ValueError):
             paddle.hub.load(str(tmp_path), "nope")
+
+
+class TestUtilsParity:
+    def test_unique_name(self):
+        from paddle_tpu.utils import unique_name
+
+        a = unique_name.generate("fc")
+        b = unique_name.generate("fc")
+        assert a != b and a.startswith("fc_")
+        with unique_name.guard():
+            c = unique_name.generate("fc")
+            assert c == "fc_0"  # fresh generator inside the guard
+        d = unique_name.generate("fc")
+        assert d not in (a, b, c)
+
+    def test_require_version(self):
+        paddle.utils.require_version("2.0")
+        with pytest.raises(Exception):
+            paddle.utils.require_version("9.9")
+        with pytest.raises(Exception):
+            paddle.utils.require_version("1.0", "1.8")
+
+    def test_profiler_context_and_checker(self):
+        from paddle_tpu.utils import (OpLastCheckpointChecker, Profiler,
+                                      ProfilerOptions, profiler)
+
+        opts = ProfilerOptions({"state": "CPU"})
+        assert opts["state"] == "CPU"
+        with Profiler(options=opts):
+            x = paddle.to_tensor(np.ones(4, np.float32))
+            (x * 2).numpy()
+        checker = OpLastCheckpointChecker()
+        assert checker.get_version("nonexistent_op", default=7) == 7
+
+    def test_image_util(self):
+        from paddle_tpu.utils import image_util
+
+        img = np.random.RandomState(0).rand(3, 8, 8).astype(np.float32)
+        assert image_util.resize_image(img, 4).shape == (3, 4, 4)
+        assert image_util.crop_img(img, 4).shape == (3, 4, 4)
+        np.testing.assert_allclose(image_util.flip_image(img),
+                                   img[:, :, ::-1])
+
+
+class TestBilinearInitializer:
+    def test_transpose_conv_becomes_bilinear_upsampler(self):
+        from paddle_tpu import nn
+        from paddle_tpu.nn.initializer import Bilinear
+
+        factor = 2
+        k = 2 * factor - factor % 2
+        layer = nn.Conv2DTranspose(
+            1, 1, k, stride=factor, padding=int(np.ceil((factor - 1) / 2)),
+            weight_attr=paddle.ParamAttr(initializer=Bilinear()),
+            bias_attr=False)
+        Bilinear()(layer.weight)
+        # upsampling a constant image must reproduce it (interior exact)
+        x = paddle.to_tensor(np.full((1, 1, 4, 4), 3.0, np.float32))
+        out = np.asarray(layer(x).numpy())
+        assert out.shape == (1, 1, 8, 8)
+        np.testing.assert_allclose(out[0, 0, 2:-2, 2:-2], 3.0, rtol=1e-5)
+
+    def test_requires_4d(self):
+        from paddle_tpu.nn.initializer import Bilinear
+
+        with pytest.raises(ValueError):
+            Bilinear()._generate((3, 3), np.float32)

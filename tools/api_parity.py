@@ -30,6 +30,12 @@ _TRACKED = {
     "vision.transforms": "python/paddle/vision/transforms/__init__.py",
     "vision.datasets": "python/paddle/vision/datasets/__init__.py",
     "text.datasets": "python/paddle/text/datasets/__init__.py",
+    "optimizer": "python/paddle/optimizer/__init__.py",
+    "optimizer.lr": "python/paddle/optimizer/lr.py",
+    "vision.models": "python/paddle/vision/models/__init__.py",
+    "nn.initializer": "python/paddle/nn/initializer/__init__.py",
+    "autograd": "python/paddle/autograd/__init__.py",
+    "utils": "python/paddle/utils/__init__.py",
 }
 
 # names that are internal/accidental exports in the reference, or
@@ -42,6 +48,7 @@ _WAIVED = {
         "check_import_scipy",     # windows import workaround, internal
     },
     "nn": {"diag_embed"},         # lives in paddle.tensor here, as in 2.x
+    "optimizer.lr": {"Tensor"},   # accidental export in the reference file
     "distributed": set(),
 }
 
@@ -54,7 +61,19 @@ def reference_exports(ref_root, rel_path):
     m = re.search(r"__all__\s*(?:\+?=)\s*\[(.*?)\]", src, re.S)
     if m:
         names |= set(re.findall(r"['\"]([\w.]+)['\"]", m.group(1)))
-    names |= set(re.findall(r"^from [.\w]+ import (\w+)", src, re.M))
+    # from-import fallback: every name, incl. comma lists and
+    # parenthesized multi-line imports, honoring "x as y" aliases
+    for clause in re.findall(r"^from [.\w]+ import +(\([^)]*\)|[^\n]+)",
+                             src, re.M):
+        body = clause.strip("()")
+        body = re.sub(r"#[^\n]*", "", body)
+        for part in body.replace("\n", ",").split(","):
+            toks = part.strip().split()
+            if not toks:
+                continue
+            name = toks[-1] if "as" in toks else toks[0]
+            if re.fullmatch(r"\w+", name):
+                names.add(name)
     for extra in re.findall(r"__all__\s*\+=\s*\[(.*?)\]", src, re.S):
         names |= set(re.findall(r"['\"]([\w.]+)['\"]", extra))
     return {n for n in names
