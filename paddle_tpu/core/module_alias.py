@@ -3,8 +3,11 @@ reference-style ``import paddle.x.y.z`` statements resolve when this
 framework packs several reference submodules into one module."""
 import sys
 
-def alias_submodules(module_name, *child_names):
+def alias_submodules(module_name, *child_names, target=None):
+    """Alias dotted child names of ``module_name`` to ``target`` (default:
+    the module itself)."""
     mod = sys.modules[module_name]
+    tgt = target if target is not None else mod
     for child in child_names:
-        sys.modules[f"{module_name}.{child}"] = mod
-        setattr(mod, child, mod)
+        sys.modules[f"{module_name}.{child}"] = tgt
+        setattr(mod, child, tgt)

@@ -8,14 +8,15 @@ __all__ = ["generate", "guard", "switch"]
 
 
 class _Generator:
-    def __init__(self):
+    def __init__(self, prefix=""):
+        self.prefix = prefix
         self.ids = {}
         self.lock = threading.Lock()
 
     def unique(self, key):
         with self.lock:
             counter = self.ids.setdefault(key, itertools.count(0))
-            return f"{key}_{next(counter)}"
+            return f"{self.prefix}{key}_{next(counter)}"
 
 
 _generator = _Generator()
@@ -28,6 +29,9 @@ def generate(key):
 def switch(new_generator=None):
     global _generator
     old = _generator
+    if isinstance(new_generator, str):
+        # reference API: guard("prefix/") prefixes generated names
+        new_generator = _Generator(new_generator)
     _generator = new_generator or _Generator()
     return old
 

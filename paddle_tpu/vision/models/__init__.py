@@ -9,10 +9,7 @@ from .mobilenet import (  # noqa: F401
 
 
 from . import mobilenet  # noqa: F401
+from ...core.module_alias import alias_submodules as _alias
 
 # reference file names mobilenetv1/mobilenetv2 both map to mobilenet here
-import sys as _s
-
-_s.modules[__name__ + ".mobilenetv1"] = mobilenet
-_s.modules[__name__ + ".mobilenetv2"] = mobilenet
-mobilenetv1 = mobilenetv2 = mobilenet
+_alias(__name__, "mobilenetv1", "mobilenetv2", target=mobilenet)

@@ -261,3 +261,271 @@ def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=-1,
     if keep_top_k > 0:
         out = out[:keep_top_k]
     return Tensor(np.asarray(out, np.float32).reshape(-1, 6))
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios=(1.0,),
+                     variances=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0),
+                     offset=0.5):
+    """Dense anchors per feature-map cell (reference:
+    operators/detection/anchor_generator_op.h GenAnchors): for each
+    (h, w) cell, anchors of every (size, ratio) centered at
+    (w*stride_w + offset*(stride_w-1), ...). Returns (anchors [H,W,A,4]
+    xyxy, variances [H,W,A,4])."""
+
+    def _gen(x, *, sizes, ratios, variances, stride, offset):
+        H, W = x.shape[2], x.shape[3]
+        sw, sh = stride
+        xc = jnp.arange(W, dtype=jnp.float32) * sw + offset * (sw - 1)
+        yc = jnp.arange(H, dtype=jnp.float32) * sh + offset * (sh - 1)
+        combos = []
+        for r in ratios:
+            for s in sizes:
+                # reference: area = stride_w*stride_h scaled; anchor w/h
+                # derived from size and sqrt(ratio)
+                ar = jnp.sqrt(jnp.asarray(r, jnp.float32))
+                w_a = s / ar
+                h_a = s * ar
+                combos.append((w_a, h_a))
+        A = len(combos)
+        ws = jnp.asarray([c[0] for c in combos], jnp.float32)
+        hs = jnp.asarray([c[1] for c in combos], jnp.float32)
+        xg = xc[None, :, None]
+        yg = yc[:, None, None]
+        out = jnp.stack([
+            jnp.broadcast_to(xg - 0.5 * ws, (H, W, A)),
+            jnp.broadcast_to(yg - 0.5 * hs, (H, W, A)),
+            jnp.broadcast_to(xg + 0.5 * ws, (H, W, A)),
+            jnp.broadcast_to(yg + 0.5 * hs, (H, W, A)),
+        ], axis=-1)
+        var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                               (H, W, A, 4))
+        return out, var
+
+    return apply_op("anchor_generator", _gen, input,
+                    sizes=tuple(float(s) for s in anchor_sizes),
+                    ratios=tuple(float(r) for r in aspect_ratios),
+                    variances=tuple(float(v) for v in variances),
+                    stride=tuple(float(s) for s in stride),
+                    offset=float(offset))
+
+
+def iou_similarity(x, y, box_normalized=True):
+    """Pairwise IoU matrix [N,M] (reference:
+    operators/detection/iou_similarity_op.h)."""
+
+    def _iou(x, y, *, norm):
+        off = 0.0 if norm else 1.0
+        ax1, ay1, ax2, ay2 = x[:, 0], x[:, 1], x[:, 2], x[:, 3]
+        bx1, by1, bx2, by2 = y[:, 0], y[:, 1], y[:, 2], y[:, 3]
+        area_a = (ax2 - ax1 + off) * (ay2 - ay1 + off)
+        area_b = (bx2 - bx1 + off) * (by2 - by1 + off)
+        ix1 = jnp.maximum(ax1[:, None], bx1[None, :])
+        iy1 = jnp.maximum(ay1[:, None], by1[None, :])
+        ix2 = jnp.minimum(ax2[:, None], bx2[None, :])
+        iy2 = jnp.minimum(ay2[:, None], by2[None, :])
+        inter = jnp.clip(ix2 - ix1 + off, 0) * jnp.clip(iy2 - iy1 + off, 0)
+        return inter / jnp.maximum(
+            area_a[:, None] + area_b[None, :] - inter, 1e-10)
+
+    return apply_op("iou_similarity", _iou, x, y, norm=bool(box_normalized))
+
+
+def box_clip(input, im_info):
+    """Clip xyxy boxes to image bounds (reference:
+    operators/detection/box_clip_op.h): im_info rows are
+    [height, width, scale]."""
+
+    def _clip(boxes, info):
+        h = info[..., 0:1] / info[..., 2:3] - 1.0
+        w = info[..., 1:2] / info[..., 2:3] - 1.0
+        x1 = jnp.clip(boxes[..., 0::4], 0.0, w)
+        y1 = jnp.clip(boxes[..., 1::4], 0.0, h)
+        x2 = jnp.clip(boxes[..., 2::4], 0.0, w)
+        y2 = jnp.clip(boxes[..., 3::4], 0.0, h)
+        out = jnp.stack([x1, y1, x2, y2], axis=-1)
+        return out.reshape(boxes.shape)
+
+    return apply_op("box_clip", _clip, input, im_info)
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False, step=0.0,
+                      offset=0.5):
+    """SSD density prior boxes (reference:
+    operators/detection/density_prior_box_op.h): per cell, a density x
+    density grid of shifted centers for each (fixed_size, ratio)."""
+
+    def _dpb(x, img, *, densities, sizes, ratios, variance, step, offset,
+             clip):
+        H, W = x.shape[2], x.shape[3]
+        img_h, img_w = img.shape[2], img.shape[3]
+        step_w = float(step) or img_w / W
+        step_h = float(step) or img_h / H
+        boxes = []
+        for size, density in zip(sizes, densities):
+            for ratio in ratios:
+                bw = size * np.sqrt(ratio)
+                bh = size / np.sqrt(ratio)
+                shift = size / density
+                for dy in range(density):
+                    for dx in range(density):
+                        cx_off = (dx + 0.5) * shift - size / 2.0
+                        cy_off = (dy + 0.5) * shift - size / 2.0
+                        boxes.append((bw, bh, cx_off, cy_off))
+        A = len(boxes)
+        params = jnp.asarray(boxes, jnp.float32)  # [A, 4]
+        xs = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+        ys = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+        cx = xs[None, :, None] + params[None, None, :, 2]
+        cy = ys[:, None, None] + params[None, None, :, 3]
+        bw = jnp.broadcast_to(params[None, None, :, 0], (H, W, A))
+        bh = jnp.broadcast_to(params[None, None, :, 1], (H, W, A))
+        out = jnp.stack([(cx - bw / 2.0) / img_w, (cy - bh / 2.0) / img_h,
+                         (cx + bw / 2.0) / img_w, (cy + bh / 2.0) / img_h],
+                        axis=-1)
+        if clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                               (H, W, A, 4))
+        return out, var
+
+    return apply_op(
+        "density_prior_box", _dpb, input, image,
+        densities=tuple(int(d) for d in densities),
+        sizes=tuple(float(s) for s in fixed_sizes),
+        ratios=tuple(float(r) for r in fixed_ratios),
+        variance=tuple(float(v) for v in variance),
+        step=float(step), offset=float(offset), clip=bool(clip))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False):
+    """Matrix NMS (reference: operators/detection/matrix_nms_op.cc,
+    SOLOv2): instead of hard suppression, each box's score decays by the
+    strongest higher-scored overlap — fully dense, traceable, no
+    data-dependent shapes until the final host-side filter.
+
+    bboxes [N, 4]; scores [C, N]. Returns [M, 6] rows
+    (class, score, x1, y1, x2, y2) sorted by decayed score (eager)."""
+    if in_trace():
+        raise errors.UnimplementedError(
+            "matrix_nms output shape is data-dependent (eager only)")
+
+    def _np_iou(bb, off):
+        # host-side pairwise IoU: this whole op is eager numpy, so a
+        # device round-trip per class (and per distinct box count, each
+        # an XLA compile) would dominate the op
+        x1, y1, x2, y2 = bb[:, 0], bb[:, 1], bb[:, 2], bb[:, 3]
+        area = (x2 - x1 + off) * (y2 - y1 + off)
+        ix1 = np.maximum(x1[:, None], x1[None, :])
+        iy1 = np.maximum(y1[:, None], y1[None, :])
+        ix2 = np.minimum(x2[:, None], x2[None, :])
+        iy2 = np.minimum(y2[:, None], y2[None, :])
+        inter = np.clip(ix2 - ix1 + off, 0, None) * \
+            np.clip(iy2 - iy1 + off, 0, None)
+        return inter / np.maximum(area[:, None] + area[None, :] - inter,
+                                  1e-10)
+
+    b = np.asarray(bboxes._value if isinstance(bboxes, Tensor) else bboxes)
+    s = np.asarray(scores._value if isinstance(scores, Tensor) else scores)
+    out_rows = []
+    out_index = []
+    for c in range(s.shape[0]):
+        if c == background_label:
+            continue
+        cls_scores = s[c]
+        keep = cls_scores > score_threshold
+        if not keep.any():
+            continue
+        idx = np.where(keep)[0]
+        order = idx[np.argsort(-cls_scores[idx])]
+        if nms_top_k > 0:
+            order = order[:nms_top_k]
+        bb = b[order]
+        sc = cls_scores[order]
+        n = len(order)
+        iou = _np_iou(bb.astype(np.float32), 0.0 if normalized else 1.0)
+        tri = np.triu(iou, k=1)          # tri[i, j] = iou(i, j), i < j
+        # SOLOv2 matrix NMS (reference matrix_nms_op.cc): each box j is
+        # decayed by min over suppressors i<j of f(iou_ij)/f(comp_i),
+        # where comp_i is i's own strongest suppressor overlap
+        comp = np.concatenate([[0.0], tri[:, 1:].max(axis=0)]) \
+            if n > 1 else np.zeros(n)    # comp[i] = max_{k<i} iou(k, i)
+        if use_gaussian:
+            decay_mat = np.exp(-(tri ** 2 - comp[:, None] ** 2)
+                               / gaussian_sigma)
+        else:
+            decay_mat = (1.0 - tri) / np.maximum(1.0 - comp[:, None],
+                                                 1e-10)
+        # only i<j entries are real suppressor terms
+        decay_mat = np.where(np.triu(np.ones((n, n), bool), k=1),
+                             decay_mat, np.inf)
+        decay = np.minimum(decay_mat.min(axis=0), 1.0) if n > 1 else \
+            np.ones(n)
+        decayed = sc * decay
+        ok = decayed > post_threshold
+        for i in np.where(ok)[0]:
+            out_rows.append((float(c), float(decayed[i]), *bb[i].tolist()))
+            out_index.append(int(order[i]))
+    ranking = sorted(range(len(out_rows)), key=lambda k: -out_rows[k][1])
+    if keep_top_k > 0:
+        ranking = ranking[:keep_top_k]
+    rows = [out_rows[k] for k in ranking]
+    result = Tensor(np.asarray(rows, np.float32).reshape(-1, 6)
+                    if rows else np.zeros((0, 6), np.float32))
+    if return_index:
+        index = Tensor(np.asarray([out_index[k] for k in ranking],
+                                  np.int64))
+        return result, index
+    return result
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None):
+    """Assign RoIs to FPN levels by scale (reference:
+    operators/detection/distribute_fpn_proposals_op.h): level =
+    floor(refer_level + log2(sqrt(area)/refer_scale)), clipped. Eager
+    (outputs are per-level variable-length lists)."""
+    if in_trace():
+        raise errors.UnimplementedError(
+            "distribute_fpn_proposals outputs are variable-length "
+            "(eager only)")
+    rois = np.asarray(fpn_rois._value if isinstance(fpn_rois, Tensor)
+                      else fpn_rois)
+    w = np.maximum(rois[:, 2] - rois[:, 0], 0.0)
+    h = np.maximum(rois[:, 3] - rois[:, 1], 0.0)
+    scale = np.sqrt(w * h)
+    lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-8))
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi_rois = []
+    restore_parts = []
+    for level in range(min_level, max_level + 1):
+        idx = np.where(lvl == level)[0]
+        multi_rois.append(Tensor(rois[idx].astype(np.float32)))
+        restore_parts.append(idx)
+    order = np.concatenate(restore_parts) if restore_parts else \
+        np.zeros(0, np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(len(order))
+    return multi_rois, Tensor(restore.astype(np.int64))
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None):
+    """Merge per-level proposals and keep the global top-k by score
+    (reference: operators/detection/collect_fpn_proposals_op.h)."""
+    if in_trace():
+        raise errors.UnimplementedError(
+            "collect_fpn_proposals output is top-k variable (eager only)")
+    rois = np.concatenate([np.asarray(r._value if isinstance(r, Tensor)
+                                      else r).reshape(-1, 4)
+                           for r in multi_rois]) if multi_rois else \
+        np.zeros((0, 4), np.float32)
+    scores = np.concatenate([np.asarray(s._value if isinstance(s, Tensor)
+                                        else s).reshape(-1)
+                             for s in multi_scores]) if multi_scores else \
+        np.zeros(0, np.float32)
+    order = np.argsort(-scores)[:post_nms_top_n]
+    return Tensor(rois[order].astype(np.float32))

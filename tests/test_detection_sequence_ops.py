@@ -145,3 +145,132 @@ class TestSequenceOps:
         assert np.asarray(dense._value).shape == (2, 3, 2)
         flat = ragged.sequence_unpad(dense, t(lens, np.int32))
         np.testing.assert_allclose(np.asarray(flat._value), rows)
+
+
+ops = vops
+
+
+class TestDetectionTier2:
+    def test_anchor_generator(self):
+        x = paddle.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+        anchors, var = ops.anchor_generator(
+            x, anchor_sizes=[32.0, 64.0], aspect_ratios=[1.0, 2.0],
+            stride=[16.0, 16.0])
+        assert anchors.shape == [4, 4, 4, 4] and var.shape == [4, 4, 4, 4]
+        a = np.asarray(anchors.numpy())
+        # cell (0,0) ratio=1 size=32: centered at offset*(stride-1)=7.5
+        np.testing.assert_allclose(a[0, 0, 0], [-8.5, -8.5, 23.5, 23.5],
+                                   rtol=1e-5)
+        # ratio-outer/size-inner ordering (reference GenAnchors loop):
+        # index 2 = (ratio=2, size=32) with w = s/sqrt(r), h = s*sqrt(r)
+        w = a[..., 2] - a[..., 0]
+        h = a[..., 3] - a[..., 1]
+        np.testing.assert_allclose(w[0, 0, 2], 32.0 / np.sqrt(2), rtol=1e-5)
+        np.testing.assert_allclose(h[0, 0, 2], 32.0 * np.sqrt(2), rtol=1e-5)
+
+    def test_iou_similarity(self):
+        x = paddle.to_tensor(np.asarray([[0, 0, 2, 2]], np.float32))
+        y = paddle.to_tensor(np.asarray([[0, 0, 2, 2], [1, 1, 3, 3],
+                                         [5, 5, 6, 6]], np.float32))
+        iou = np.asarray(ops.iou_similarity(x, y).numpy())
+        np.testing.assert_allclose(iou[0], [1.0, 1.0 / 7.0, 0.0],
+                                   rtol=1e-5)
+
+    def test_box_clip(self):
+        boxes = paddle.to_tensor(np.asarray(
+            [[-5.0, -5.0, 30.0, 40.0]], np.float32))
+        im_info = paddle.to_tensor(np.asarray([20.0, 25.0, 1.0],
+                                              np.float32))
+        out = np.asarray(ops.box_clip(boxes, im_info).numpy())
+        np.testing.assert_allclose(out[0], [0.0, 0.0, 24.0, 19.0])
+
+    def test_density_prior_box(self):
+        x = paddle.to_tensor(np.zeros((1, 8, 2, 2), np.float32))
+        img = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+        boxes, var = ops.density_prior_box(
+            x, img, densities=[2], fixed_sizes=[16.0], fixed_ratios=[1.0],
+            clip=True)
+        assert boxes.shape == [2, 2, 4, 4]
+        b = np.asarray(boxes.numpy())
+        assert (b >= 0).all() and (b <= 1).all()
+        # density 2 => 4 shifted anchors per cell, all same size
+        w = b[..., 2] - b[..., 0]
+        assert np.allclose(w[w > 0.2], 0.5, atol=0.3)
+
+    def test_matrix_nms_decay(self):
+        # two overlapping boxes + one far box, single class
+        bboxes = np.asarray([[0, 0, 10, 10], [1, 1, 11, 11],
+                             [50, 50, 60, 60]], np.float32)
+        scores = np.asarray([[0.0, 0.0, 0.0],
+                             [0.9, 0.8, 0.7]], np.float32)
+        out = np.asarray(ops.matrix_nms(
+            paddle.to_tensor(bboxes), paddle.to_tensor(scores),
+            score_threshold=0.1).numpy())
+        assert out.shape[1] == 6 and out.shape[0] == 3
+        # top box keeps its score; the overlapped one decays; far box not
+        assert out[0, 1] == pytest.approx(0.9)
+        decayed = out[np.argsort(out[:, 2])]  # sort by x1: [0,1,50]
+        assert decayed[1, 1] < 0.8  # overlap decayed
+        assert decayed[2, 1] == pytest.approx(0.7)  # isolated box intact
+        with pytest.raises(Exception):
+            from paddle_tpu.core import dispatch
+
+            with dispatch.trace_mode():
+                ops.matrix_nms(paddle.to_tensor(bboxes),
+                               paddle.to_tensor(scores), 0.1)
+
+    def test_distribute_and_collect_fpn_proposals(self):
+        rois = np.asarray([[0, 0, 16, 16],       # small -> low level
+                           [0, 0, 224, 224],     # refer scale -> level 4
+                           [0, 0, 500, 500]],    # large -> high level
+                          np.float32)
+        multi, restore = ops.distribute_fpn_proposals(
+            paddle.to_tensor(rois), min_level=2, max_level=5,
+            refer_level=4, refer_scale=224)
+        assert len(multi) == 4
+        sizes = [m.shape[0] for m in multi]
+        assert sum(sizes) == 3
+        assert multi[0].shape[0] == 1    # the 16x16 roi at level 2
+        assert multi[2].shape[0] == 1    # the 224 roi at level 4
+        # restore index reorders the concatenation back to input order
+        cat = np.concatenate([np.asarray(m.numpy()).reshape(-1, 4)
+                              for m in multi])
+        np.testing.assert_allclose(cat[np.asarray(restore.numpy())
+                                       .argsort()].ravel()[:4],
+                                   rois[np.argsort([0, 1, 2])][0])
+        scores = [paddle.to_tensor(np.asarray([0.9] * s, np.float32))
+                  for s in sizes]
+        top = ops.collect_fpn_proposals(multi, scores, 2, 5,
+                                        post_nms_top_n=2)
+        assert top.shape == [2, 4]
+
+    def test_matrix_nms_chain_decay_and_flags(self):
+        """Review regression: B overlapping both a higher-scored A and a
+        lower-scored C must still decay by its overlap with A (the old
+        formula divided by B's own suppressee overlap and clamped)."""
+        bboxes = np.asarray([[0, 0, 10, 10],     # A
+                             [0, 5, 10, 15],     # B: iou(A,B)=1/3
+                             [0, 5.5, 10, 15.5]  # C: iou(B,C) huge
+                             ], np.float32)
+        scores = np.asarray([[0.9, 0.8, 0.7]], np.float32)
+        out = np.asarray(ops.matrix_nms(
+            paddle.to_tensor(bboxes), paddle.to_tensor(scores),
+            score_threshold=0.0, background_label=-1).numpy())
+        by_y = out[np.argsort(out[:, 3])]  # sort by y1: A, B, C
+        assert by_y[0, 1] == pytest.approx(0.9)
+        # B decays by (1-iou(A,B)) = 2/3 -> 0.8*2/3, NOT clamped to 0.8
+        assert by_y[1, 1] == pytest.approx(0.8 * (1 - 1 / 3), rel=1e-4)
+        # keep_top_k=-1 keeps everything
+        assert out.shape[0] == 3
+        # return_index gives original box indices
+        o2, idx = ops.matrix_nms(
+            paddle.to_tensor(bboxes), paddle.to_tensor(scores),
+            score_threshold=0.0, background_label=-1, return_index=True)
+        assert sorted(np.asarray(idx.numpy()).tolist()) == [0, 1, 2]
+
+    def test_unique_name_string_guard(self):
+        from paddle_tpu.utils import unique_name
+
+        with unique_name.guard("blk/"):
+            assert unique_name.generate("w") == "blk/w_0"
+            assert unique_name.generate("w") == "blk/w_1"
