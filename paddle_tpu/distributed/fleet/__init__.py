@@ -3,6 +3,15 @@ fleet_base.py Fleet facade, base/distributed_strategy.py over
 framework/distributed_strategy.proto:146-193).
 """
 from .distributed_strategy import DistributedStrategy  # noqa: F401
+from . import data_generator  # noqa: F401
+from . import metrics  # noqa: F401
+from .data_generator import (  # noqa: F401
+    MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+)
+from .util import UtilBase  # noqa: F401
+from ..topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup,
+)
 from .fleet_base import (  # noqa: F401
     Fleet, init, distributed_optimizer, distributed_model, get_hybrid_communicate_group,
     worker_num, worker_index, is_worker, is_server, barrier_worker, _fleet_singleton,

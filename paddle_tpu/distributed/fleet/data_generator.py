@@ -1,0 +1,87 @@
+"""Fleet data generators (reference:
+python/paddle/distributed/fleet/data_generator/data_generator.py) —
+user-subclassed slot-record emitters whose text output feeds the PS
+Dataset pipeline (native/data_feed.cc slot format:
+"count v1 v2 ... count v1 ..." per configured slot)."""
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        """Override: map one input line to
+        [(slot_name, [values...]), ...] or a generator of such rows."""
+        raise NotImplementedError(
+            "generate_sample must be overridden (return "
+            "[(name, [feasign, ...]), ...])")
+
+    def generate_batch(self, samples):
+        """Optional override: batch-level post-processing."""
+        def local_iter():
+            for sample in samples:
+                yield sample
+
+        return local_iter
+
+    def _gen_str(self, line):
+        raise NotImplementedError(
+            "use MultiSlotDataGenerator or MultiSlotStringDataGenerator")
+
+    def run_from_stdin(self):
+        for line in sys.stdin:
+            for processed in self._iter_samples(line):
+                sys.stdout.write(self._gen_str(processed))
+
+    def run_from_memory(self, lines=None):
+        """Returns the formatted records (driver for tests/local runs)."""
+        out = []
+        for line in (lines if lines is not None else [None]):
+            for processed in self._iter_samples(line):
+                out.append(self._gen_str(processed))
+        return out
+
+    def _iter_samples(self, line):
+        produced = self.generate_sample(line)
+        if produced is None:
+            return
+        if callable(produced):
+            produced = produced()
+        if isinstance(produced, (list, tuple)) and produced and \
+                isinstance(produced[0], (list, tuple)) and \
+                isinstance(produced[0][0], str):
+            yield produced  # single sample
+            return
+        batch = []
+        for sample in produced:
+            batch.append(sample)
+            if len(batch) == self.batch_size_:
+                yield from self.generate_batch(batch)()
+                batch = []
+        if batch:
+            yield from self.generate_batch(batch)()
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Numeric slots: each sample row serializes as
+    "<count> <v1> ... <count> <v1> ..." (reference _gen_str)."""
+
+    def _gen_str(self, line):
+        parts = []
+        for _name, values in line:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """String slots: same "count values..." framing; str() passes string
+    feasigns through untouched."""

@@ -1,0 +1,69 @@
+"""Fleet util (reference:
+python/paddle/distributed/fleet/base/util_factory.py UtilBase — gloo
+collectives over trainers + file sharding helpers; here the process mesh
+plays gloo's role, and single-process runs reduce to identities)."""
+import numpy as np
+
+__all__ = ["UtilBase"]
+
+
+class UtilBase:
+    def __init__(self, role_maker=None):
+        self.role_maker = role_maker
+
+    def _world(self):
+        import jax
+
+        return jax.process_count(), jax.process_index()
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        """Reduce a host value across trainers (reference
+        util_factory.py:60). Single-process: identity."""
+        if mode not in ("sum", "max", "min"):
+            raise ValueError(f"all_reduce mode must be sum/max/min, "
+                             f"got {mode!r}")
+        n, _ = self._world()
+        arr = np.asarray(input)
+        if n == 1:
+            return arr
+        from .. import collective as C
+        from ...core.tensor import Tensor
+
+        # float64 end-to-end: metric counts above 2^24 would lose
+        # integer precision in float32
+        t = Tensor(arr.astype(np.float64))
+        C.all_reduce(t, op=getattr(C.ReduceOp, mode.upper()))
+        return np.asarray(t.numpy())
+
+    def all_gather(self, input, comm_world="worker"):
+        n, _ = self._world()
+        if n == 1:
+            return [input]
+        from .. import collective as C
+        from ...core.tensor import Tensor
+
+        out = []
+        C.all_gather(out, Tensor(np.asarray(input)))
+        return [np.asarray(o.numpy()) for o in out]
+
+    def barrier(self, comm_world="worker"):
+        from .. import collective as C
+
+        C.barrier()
+
+    def get_file_shard(self, files):
+        """Split a file list evenly across trainers (reference
+        util_factory.py:206): trainer i takes blocks[i]."""
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file paths")
+        n, rank = self._world()
+        base = len(files) // n
+        rem = len(files) % n
+        blocks = [base + (1 if i < rem else 0) for i in range(n)]
+        start = sum(blocks[:rank])
+        return files[start:start + blocks[rank]]
+
+    def print_on_rank(self, message, rank_id=0):
+        _, rank = self._world()
+        if rank == rank_id:
+            print(message)

@@ -11,8 +11,21 @@ device-resident inputs/outputs (the ZeroCopyRun analog).
 """
 from .config import Config, PrecisionType, PlaceType
 from .predictor import Predictor, Tensor as PredictorTensor, create_predictor
+from .predictor import Tensor  # noqa: F401 (reference exports it plainly)
+
+
+class DataType:
+    """reference: paddle_infer.DataType enum."""
+
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+
 
 __all__ = [
-    "Config", "Predictor", "PredictorTensor", "create_predictor",
+    "Config", "DataType", "Predictor", "PredictorTensor", "Tensor",
+    "create_predictor",
     "PrecisionType", "PlaceType",
 ]

@@ -336,8 +336,9 @@ def box_clip(input, im_info):
     [height, width, scale]."""
 
     def _clip(boxes, info):
-        h = info[..., 0:1] / info[..., 2:3] - 1.0
-        w = info[..., 1:2] / info[..., 2:3] - 1.0
+        # reference box_clip_op.h rounds h/w/scale before the -1
+        h = jnp.round(info[..., 0:1] / info[..., 2:3]) - 1.0
+        w = jnp.round(info[..., 1:2] / info[..., 2:3]) - 1.0
         x1 = jnp.clip(boxes[..., 0::4], 0.0, w)
         y1 = jnp.clip(boxes[..., 1::4], 0.0, h)
         x2 = jnp.clip(boxes[..., 2::4], 0.0, w)
@@ -357,21 +358,25 @@ def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
 
     def _dpb(x, img, *, densities, sizes, ratios, variance, step, offset,
              clip):
+        del clip  # reference clamps to [0, 1] unconditionally (max/min)
         H, W = x.shape[2], x.shape[3]
         img_h, img_w = img.shape[2], img.shape[3]
         step_w = float(step) or img_w / W
         step_h = float(step) or img_h / H
+        # reference density_prior_box_op.h: sub-centers tile the STRIDE
+        # cell (step_average/density shifts), not the box size
+        step_average = int(0.5 * (step_w + step_h))
         boxes = []
         for size, density in zip(sizes, densities):
+            shift = int(step_average / density)
             for ratio in ratios:
                 bw = size * np.sqrt(ratio)
                 bh = size / np.sqrt(ratio)
-                shift = size / density
-                for dy in range(density):
-                    for dx in range(density):
-                        cx_off = (dx + 0.5) * shift - size / 2.0
-                        cy_off = (dy + 0.5) * shift - size / 2.0
-                        boxes.append((bw, bh, cx_off, cy_off))
+                base = -step_average / 2.0 + shift / 2.0
+                for di in range(density):
+                    for dj in range(density):
+                        boxes.append((bw, bh, base + dj * shift,
+                                      base + di * shift))
         A = len(boxes)
         params = jnp.asarray(boxes, jnp.float32)  # [A, 4]
         xs = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
@@ -383,8 +388,7 @@ def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
         out = jnp.stack([(cx - bw / 2.0) / img_w, (cy - bh / 2.0) / img_h,
                          (cx + bw / 2.0) / img_w, (cy + bh / 2.0) / img_h],
                         axis=-1)
-        if clip:
-            out = jnp.clip(out, 0.0, 1.0)
+        out = jnp.clip(out, 0.0, 1.0)
         var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
                                (H, W, A, 4))
         return out, var
@@ -454,8 +458,9 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
         comp = np.concatenate([[0.0], tri[:, 1:].max(axis=0)]) \
             if n > 1 else np.zeros(n)    # comp[i] = max_{k<i} iou(k, i)
         if use_gaussian:
-            decay_mat = np.exp(-(tri ** 2 - comp[:, None] ** 2)
-                               / gaussian_sigma)
+            # reference matrix_nms_op.cc: exp((comp^2 - iou^2) * sigma)
+            decay_mat = np.exp((comp[:, None] ** 2 - tri ** 2)
+                               * gaussian_sigma)
         else:
             decay_mat = (1.0 - tri) / np.maximum(1.0 - comp[:, None],
                                                  1e-10)

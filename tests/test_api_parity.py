@@ -179,3 +179,50 @@ class TestSerializationReviewRegressions:
                          predicate=lambda p: True)
         for p, want in zip(main.all_parameters(), before):
             np.testing.assert_allclose(np.asarray(p._value), want)
+
+
+class TestFleetExtras:
+    def test_multislot_data_generator(self):
+        from paddle_tpu.distributed.fleet import MultiSlotDataGenerator
+
+        class G(MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                return [("words", [3, 1, 4]), ("label", [1])]
+
+        g = G()
+        out = g.run_from_memory(["ignored"])
+        assert out == ["3 3 1 4 1 1\n"]
+
+        class GGen(MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def it():
+                    for i in range(2):
+                        yield [("f", [i])]
+                return it
+
+        rows = GGen().run_from_memory(["x"])
+        assert rows == ["1 0\n", "1 1\n"]
+
+    def test_util_base_single_process(self):
+        from paddle_tpu.distributed.fleet import UtilBase
+
+        u = UtilBase()
+        assert float(u.all_reduce(np.asarray(3.0))) == 3.0
+        assert u.get_file_shard(["a", "b", "c"]) == ["a", "b", "c"]
+        with pytest.raises(TypeError):
+            u.get_file_shard("not-a-list")
+
+    def test_fleet_metrics(self):
+        from paddle_tpu.distributed.fleet import metrics as M
+
+        assert M.sum(np.asarray([1.0, 2.0])) == 3.0
+        assert M.max(np.asarray([1.0, 5.0])) == 5.0
+        assert M.acc(np.asarray(8.0), np.asarray(10.0)) == pytest.approx(0.8)
+        assert M.mae(np.asarray([2.0, 4.0]), 4) == pytest.approx(1.5)
+        assert M.rmse(np.asarray([8.0]), 2) == pytest.approx(2.0)
+        # perfect separation bins -> auc 1; uniform -> 0.5
+        pos = np.asarray([0.0, 0.0, 10.0])   # positives at high threshold
+        neg = np.asarray([10.0, 0.0, 0.0])   # negatives at low threshold
+        assert M.auc(pos, neg) == pytest.approx(1.0)
+        assert M.auc(np.asarray([1.0, 1.0]), np.asarray([1.0, 1.0])) == \
+            pytest.approx(0.5)

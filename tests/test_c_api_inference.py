@@ -91,3 +91,43 @@ class TestCAPI:
         for a, b in zip(arrs, back):
             np.testing.assert_array_equal(a, b)
             assert a.dtype == b.dtype
+
+
+class TestConcurrentServing:
+    def test_parallel_clients_get_correct_results(self, served_model):
+        """The serving endpoint must stay correct under concurrent
+        clients (reference: AnalysisPredictor is cloned per thread;
+        here one XLA executable serves all connections)."""
+        import threading
+
+        server, net = served_model
+        lib = native.get_lib()
+        rng = np.random.RandomState(1)
+        inputs = [rng.rand(2, 6).astype(np.float32) for _ in range(8)]
+        expected = [np.asarray(net(paddle.to_tensor(x)).numpy())
+                    for x in inputs]
+        results = [None] * len(inputs)
+        errors = []
+
+        def client(i):
+            try:
+                h = lib.PD_PredictorCreate(b"127.0.0.1", server.port)
+                assert h > 0
+                try:
+                    (out,) = _c_run(lib, h, inputs[i])
+                    results[i] = out
+                finally:
+                    lib.PD_PredictorDestroy(h)
+            except Exception as e:  # noqa: BLE001
+                errors.append((i, repr(e)))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(inputs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        for i, (got, want) in enumerate(zip(results, expected)):
+            assert got is not None, f"client {i} got no result"
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

@@ -274,3 +274,29 @@ class TestDetectionTier2:
         with unique_name.guard("blk/"):
             assert unique_name.generate("w") == "blk/w_0"
             assert unique_name.generate("w") == "blk/w_1"
+
+    def test_density_prior_box_reference_centers(self):
+        """Sub-centers tile the STRIDE cell (step_average/density), not
+        the box size (review regression; reference
+        density_prior_box_op.h)."""
+        x = paddle.to_tensor(np.zeros((1, 8, 2, 2), np.float32))
+        img = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+        boxes, _ = ops.density_prior_box(
+            x, img, densities=[2], fixed_sizes=[8.0], fixed_ratios=[1.0])
+        b = np.asarray(boxes.numpy())
+        # cell (0,0): center 8; step_average 16, shift 8 -> centers 4, 12
+        cx = (b[0, 0, :, 0] + b[0, 0, :, 2]) / 2 * 32
+        np.testing.assert_allclose(sorted(set(np.round(cx, 3))), [4.0, 12.0])
+
+    def test_matrix_nms_gaussian_reference_decay(self):
+        bboxes = np.asarray([[0, 0, 10, 10], [0, 3, 10, 13]], np.float32)
+        iou = 7.0 / 13.0
+        scores = np.asarray([[0.9, 0.8]], np.float32)
+        out = np.asarray(ops.matrix_nms(
+            paddle.to_tensor(bboxes), paddle.to_tensor(scores),
+            score_threshold=0.0, background_label=-1, use_gaussian=True,
+            gaussian_sigma=2.0).numpy())
+        by_y = out[np.argsort(out[:, 3])]
+        # reference decay: exp((0 - iou^2) * sigma)
+        want = 0.8 * np.exp(-(iou ** 2) * 2.0)
+        assert by_y[1, 1] == pytest.approx(want, rel=1e-4)

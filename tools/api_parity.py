@@ -36,6 +36,8 @@ _TRACKED = {
     "nn.initializer": "python/paddle/nn/initializer/__init__.py",
     "autograd": "python/paddle/autograd/__init__.py",
     "utils": "python/paddle/utils/__init__.py",
+    "distributed.fleet": "python/paddle/distributed/fleet/__init__.py",
+    "inference": "python/paddle/inference/__init__.py",
 }
 
 # names that are internal/accidental exports in the reference, or
