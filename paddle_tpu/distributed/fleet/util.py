@@ -16,35 +16,69 @@ class UtilBase:
 
         return jax.process_count(), jax.process_index()
 
+    def _stack_over_processes(self, arr):
+        """[local...] -> global array [n, ...] with one shard per process
+        (the eager-DDP pattern: make_array_from_process_local_data over a
+        process mesh; every process must call this collectively)."""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        n = jax.process_count()
+        devs = np.asarray([jax.local_devices(p)[0] for p in range(n)])
+        mesh = Mesh(devs, ("proc",))
+        sh = NamedSharding(mesh, PartitionSpec("proc"))
+        local = arr[None]
+        return jax.make_array_from_process_local_data(
+            sh, local, (n,) + arr.shape), mesh
+
     def all_reduce(self, input, mode="sum", comm_world="worker"):
         """Reduce a host value across trainers (reference
-        util_factory.py:60). Single-process: identity."""
-        if mode not in ("sum", "max", "min"):
+        util_factory.py:60). Cross-process reduction stacks the local
+        values over the process mesh and reduces the leading axis, so
+        every rank sees the same global value; single-process: identity.
+
+        float32 on device (TPUs have no f64); exact for metric counts
+        below 2^24 per shard — the reference gloo path is f64, noted in
+        MIGRATION.md."""
+        reducers = {"sum": np.add.reduce, "max": np.maximum.reduce,
+                    "min": np.minimum.reduce}
+        if mode not in reducers:
             raise ValueError(f"all_reduce mode must be sum/max/min, "
                              f"got {mode!r}")
         n, _ = self._world()
         arr = np.asarray(input)
         if n == 1:
             return arr
-        from .. import collective as C
-        from ...core.tensor import Tensor
+        import functools
 
-        # float64 end-to-end: metric counts above 2^24 would lose
-        # integer precision in float32
-        t = Tensor(arr.astype(np.float64))
-        C.all_reduce(t, op=getattr(C.ReduceOp, mode.upper()))
-        return np.asarray(t.numpy())
+        import jax
+        import jax.numpy as jnp
+
+        garr, mesh = self._stack_over_processes(
+            arr.astype(np.float32))
+        red = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}[mode]
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        out = jax.jit(functools.partial(red, axis=0),
+                      out_shardings=NamedSharding(
+                          mesh, PartitionSpec()))(garr)
+        return np.asarray(out.addressable_shards[0].data)
 
     def all_gather(self, input, comm_world="worker"):
         n, _ = self._world()
         if n == 1:
-            return [input]
-        from .. import collective as C
-        from ...core.tensor import Tensor
+            return [np.asarray(input)]
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
 
-        out = []
-        C.all_gather(out, Tensor(np.asarray(input)))
-        return [np.asarray(o.numpy()) for o in out]
+        garr, mesh = self._stack_over_processes(
+            np.asarray(input, np.float32))
+        out = jax.jit(lambda a: a,
+                      out_shardings=NamedSharding(
+                          mesh, PartitionSpec()))(garr)
+        full = np.asarray(out.addressable_shards[0].data)
+        return [full[i] for i in range(n)]
 
     def barrier(self, comm_world="worker"):
         from .. import collective as C

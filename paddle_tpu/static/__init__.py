@@ -73,16 +73,34 @@ class _StaticNN:
 
 
 nn = _StaticNN()
+
+# LayerHelper-style builders (reference: python/paddle/static/nn)
+from . import nn_extra as _nn_extra  # noqa: E402
+
+for _name in ("embedding", "sparse_embedding", "conv2d_transpose",
+              "conv3d", "conv3d_transpose", "layer_norm", "group_norm",
+              "instance_norm", "prelu", "bilinear_tensor_product",
+              "data_norm", "row_conv", "crf_decoding", "deform_conv2d",
+              "multi_box_head", "nce"):
+    setattr(_StaticNN, _name, staticmethod(getattr(_nn_extra, _name)))
+# sequence ops ride the ragged module (LoD -> padding+lengths design)
+from ..text import ragged as _ragged  # noqa: E402
+
+for _name in ("sequence_softmax", "sequence_reverse", "sequence_pad",
+              "sequence_unpad", "sequence_expand", "sequence_concat"):
+    if hasattr(_ragged, _name):
+        setattr(_StaticNN, _name, staticmethod(getattr(_ragged, _name)))
+setattr(_StaticNN, "py_func", staticmethod(py_func))
+setattr(_StaticNN, "create_parameter", staticmethod(create_parameter))
+setattr(_StaticNN, "spectral_norm", staticmethod(_nn_extra.spectral_norm))
+
 nn_compat = nn  # back-compat alias
 
 from . import nn_control_flow  # noqa: E402
 from .nn_control_flow import case, cond, switch_case, while_loop  # noqa: F401,E402
 
 # expose the control-flow layers on the static.nn namespace (reference:
-# paddle.static.nn.cond / while_loop / case / switch_case). static.nn is
-# the main nn module here, so attach there as well as on the fc/conv shim.
+# paddle.static.nn.cond / while_loop / case / switch_case)
 for _cf_name, _cf in (("cond", cond), ("while_loop", while_loop),
                       ("case", case), ("switch_case", switch_case)):
-    nn_compat.__dict__[_cf_name] = _cf
-    if not hasattr(nn, _cf_name):
-        setattr(nn, _cf_name, _cf)
+    setattr(_StaticNN, _cf_name, staticmethod(_cf))

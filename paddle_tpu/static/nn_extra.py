@@ -1,0 +1,261 @@
+"""Additional paddle.static.nn builders (reference:
+python/paddle/static/nn/__init__.py — LayerHelper-style functions that
+create parameters inside the active program and apply the op). Each
+builder instantiates the corresponding nn.Layer so parameter recording
+rides the normal dispatch hook."""
+import numpy as np
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """reference: static/nn/common.py embedding."""
+    from ..nn.layers.common import Embedding
+
+    layer = Embedding(size[0], size[1], padding_idx=padding_idx,
+                      weight_attr=param_attr)
+    return layer(input)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, param_attr=None, dtype="float32"):
+    """reference: fluid/contrib/sparse_embedding — PS-backed lookup. In
+    the single-program static path this builds a dense table; the PS
+    path (distributed/ps.sparse_embedding) serves the huge-vocab case,
+    and `entry` admission configs apply there."""
+    return embedding(input, size, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, **kw):
+    from ..nn.layers.conv import Conv2DTranspose
+
+    layer = Conv2DTranspose(input.shape[1], num_filters, filter_size,
+                            stride, padding, output_padding, groups,
+                            dilation, weight_attr=param_attr,
+                            bias_attr=bias_attr)
+    return layer(input)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None, **kw):
+    from ..nn.layers.conv import Conv3D
+
+    layer = Conv3D(input.shape[1], num_filters, filter_size, stride,
+                   padding, dilation, groups, weight_attr=param_attr,
+                   bias_attr=bias_attr)
+    return layer(input)
+
+
+def conv3d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, **kw):
+    from ..nn.layers.conv import Conv3DTranspose
+
+    layer = Conv3DTranspose(input.shape[1], num_filters, filter_size,
+                            stride, padding, output_padding, groups,
+                            dilation, weight_attr=param_attr,
+                            bias_attr=bias_attr)
+    return layer(input)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, **kw):
+    from ..nn.layers.norm import LayerNorm
+
+    shape = list(input.shape[begin_norm_axis:])
+    layer = LayerNorm(shape, epsilon=epsilon,
+                      weight_attr=False if not scale else param_attr,
+                      bias_attr=False if not shift else bias_attr)
+    return layer(input)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, data_layout="NCHW", **kw):
+    from ..nn.layers.norm import GroupNorm
+
+    layer = GroupNorm(groups, input.shape[1], epsilon=epsilon)
+    return layer(input)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  **kw):
+    from ..nn.layers.norm import InstanceNorm2D
+
+    layer = InstanceNorm2D(input.shape[1], epsilon=epsilon)
+    return layer(input)
+
+
+def prelu(x, mode="all", param_attr=None, **kw):
+    """reference: static/nn/common.py prelu (mode: all/channel/element)."""
+    from ..nn import functional as F
+    from ..tensor import creation
+
+    if mode == "all":
+        shape = [1]
+    elif mode == "channel":
+        shape = [x.shape[1]]
+    elif mode == "element":
+        shape = list(x.shape[1:])
+    else:
+        raise ValueError(f"prelu mode must be all/channel/element, "
+                         f"got {mode!r}")
+    alpha = creation.create_parameter(shape, "float32")
+    alpha.set_value(np.full(shape, 0.25, np.float32))
+    return F.prelu(x, alpha)
+
+
+def bilinear_tensor_product(x, y, size, param_attr=None, bias_attr=None,
+                            **kw):
+    """reference: fluid/layers/nn.py bilinear_tensor_product."""
+    from ..nn import functional as F
+    from ..tensor import creation
+
+    w = creation.create_parameter([size, x.shape[-1], y.shape[-1]],
+                                  "float32")
+    b = creation.create_parameter([size], "float32", is_bias=True)
+    return F.bilinear(x, y, w, b)
+
+
+def data_norm(input, epsilon=1e-5, param_attr=None, **kw):
+    """reference: fluid/layers/nn.py data_norm — normalize by accumulated
+    batch statistics (batch_size/batch_sum/batch_square_sum buffers)."""
+    from ..core.dispatch import apply_op
+    from ..tensor import creation
+
+    d = input.shape[-1]
+    size = creation.create_parameter([d], "float32")
+    size.set_value(np.full([d], 1e4, np.float32))
+    size.stop_gradient = True
+    ssum = creation.create_parameter([d], "float32")
+    ssum.set_value(np.zeros([d], np.float32))
+    ssum.stop_gradient = True
+    sqsum = creation.create_parameter([d], "float32")
+    sqsum.set_value(np.full([d], 1e4, np.float32))
+    sqsum.stop_gradient = True
+
+    def _dn(x, n, s, sq, *, eps):
+        import jax.numpy as jnp
+
+        # reference data_norm_op.cc:302: mean = sum/size,
+        # scale = sqrt(size / square_sum) — square_sum is pre-seeded so
+        # no mean subtraction happens in the op
+        del eps
+        mean = s / n
+        scale = jnp.sqrt(n / sq)
+        return (x - mean) * scale
+
+    return apply_op("data_norm", _dn, input, size, ssum, sqsum,
+                    eps=float(epsilon))
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """reference: operators/row_conv_op.cc — lookahead convolution over
+    the time dim: out[t] = sum_{k=0..K} w[k] * x[t+k]."""
+    from ..core.dispatch import apply_op
+    from ..tensor import creation
+
+    K = int(future_context_size)
+    d = input.shape[-1]
+    w = creation.create_parameter([K + 1, d], "float32")
+
+    def _rc(x, w):
+        import jax.numpy as jnp
+
+        T = x.shape[-2]
+        out = jnp.zeros_like(x)
+        for k in range(w.shape[0]):
+            seg = x[..., k:T, :] * w[k]
+            out = out.at[..., :T - k, :].add(seg)
+        return out
+
+    out = apply_op("row_conv", _rc, input, w)
+    if act:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def crf_decoding(potentials, transition_params=None, lengths=None,
+                 **kw):
+    """Viterbi decode of linear-chain CRF unary potentials (reference:
+    operators/crf_decoding_op.h; paddle.text.ViterbiDecoder semantics):
+    returns the argmax tag path [B, T]."""
+    from ..core.dispatch import apply_op
+
+    if transition_params is None:
+        raise ValueError("crf_decoding needs transition_params [N+2, N] "
+                         "or [N, N]")
+
+    def _viterbi(unary, trans):
+        import jax
+        import jax.numpy as jnp
+
+        # paddle layout [N+2, N] (crf_decoding_op.h): row 0 = start
+        # weights, row 1 = stop weights, rows 2.. = pairwise transitions;
+        # a bare [N, N] is pairwise-only
+        n = unary.shape[-1]
+        if trans.shape[0] == n + 2:
+            start, stop, pair = trans[0], trans[1], trans[2:]
+        else:
+            start = jnp.zeros(n)
+            stop = jnp.zeros(n)
+            pair = trans[:n, :n]
+
+        def step(carry, emit):
+            score = carry  # [B, N]
+            cand = score[:, :, None] + pair[None, :, :]  # [B, from, to]
+            best = jnp.max(cand, axis=1) + emit
+            back = jnp.argmax(cand, axis=1)
+            return best, back
+
+        first = unary[:, 0] + start[None, :]
+        score, backs = jax.lax.scan(step, first,
+                                    jnp.swapaxes(unary[:, 1:], 0, 1))
+        last = jnp.argmax(score + stop[None, :], axis=-1)  # [B]
+
+        def backtrack(carry, back):
+            tag = carry
+            prev = jnp.take_along_axis(back, tag[:, None], axis=1)[:, 0]
+            return prev, tag
+
+        # reverse scan emits the tag at step t+1 into slot t; the final
+        # carry is the step-0 tag
+        tag0, path = jax.lax.scan(backtrack, last, backs, reverse=True)
+        return jnp.concatenate([tag0[:, None],
+                                jnp.swapaxes(path, 0, 1)], axis=1)
+
+    return apply_op("crf_decoding", _viterbi, potentials,
+                    transition_params)
+
+
+def deform_conv2d(*args, **kwargs):
+    raise NotImplementedError(
+        "deform_conv2d: deformable sampling is data-dependent gather — "
+        "not yet implemented on TPU (use conv2d or roi_align)")
+
+
+def multi_box_head(*args, **kwargs):
+    raise NotImplementedError(
+        "multi_box_head: compose prior_box/density_prior_box + conv2d "
+        "heads directly (see paddle_tpu.vision.ops)")
+
+
+def nce(*args, **kwargs):
+    raise NotImplementedError(
+        "nce: use sampled softmax via paddle.nn.functional.cross_entropy "
+        "over sampled candidates, or HSigmoidLoss for hierarchical "
+        "softmax")
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Static spectral_norm (reference: fluid/layers/nn.py:3631) —
+    instantiates nn.SpectralNorm so the power iteration shares the one
+    maintained implementation (persistent u/v ride its buffers)."""
+    from ..nn.layers.norm import SpectralNorm
+
+    layer = SpectralNorm(list(weight.shape), dim=dim,
+                         power_iters=power_iters, eps=eps)
+    return layer(weight)

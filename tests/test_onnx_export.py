@@ -101,3 +101,23 @@ class TestOnnxExport:
                 assert name in known, f"{node['op_type']} uses unknown {name}"
             known.update(node["output"])
         assert {o["name"] for o in g["outputs"]} <= known
+
+
+class TestOnnxRealModels:
+    def test_resnet18_eval_roundtrip(self):
+        """BatchNorm eval stats fold into the trace as constants; the
+        exported graph must match the live model."""
+        paddle.seed(0)
+        from paddle_tpu.vision.models import resnet18
+
+        net = resnet18(num_classes=10)
+        net.eval()
+        x = np.random.RandomState(0).rand(1, 3, 32, 32).astype(np.float32)
+        blob = ponnx.export_bytes(
+            net, [InputSpec([1, 3, 32, 32], "float32", "img")])
+        model = ponnx.load(blob)
+        got = ponnx.run(model, {"img": x})[0]
+        want = net(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-3)
+        ops_used = {n["op_type"] for n in model["graph"]["nodes"]}
+        assert "Conv" in ops_used

@@ -234,3 +234,100 @@ class TestCompatReviewRegressions:
         sigma = np.linalg.svd(mat, compute_uv=False)[0]
         np.testing.assert_allclose(np.asarray(layer.weight.numpy()),
                                    w0 / sigma, rtol=1e-2, atol=1e-3)
+
+
+class TestStaticNNBuilders:
+    def teardown_method(self):
+        paddle.disable_static()
+
+    def test_builders_in_program(self):
+        paddle.enable_static()
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            ids = static.data("ids", [4, 6], dtype="int64")
+            emb = static.nn.embedding(ids, size=[32, 8])
+            ln = static.nn.layer_norm(emb, begin_norm_axis=2)
+            x = static.data("x", [4, 3, 8, 8])
+            ct = static.nn.conv2d_transpose(x, 2, 2, stride=2)
+            pr = static.nn.prelu(x, mode="channel")
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        out = exe.run(main, feed={
+            "ids": rng.randint(0, 32, (4, 6)).astype(np.int64),
+            "x": rng.rand(4, 3, 8, 8).astype(np.float32)},
+            fetch_list=[emb, ln, ct, pr])
+        assert np.asarray(out[0]).shape == (4, 6, 8)
+        assert np.asarray(out[2]).shape == (4, 2, 16, 16)
+        assert np.asarray(out[3]).shape == (4, 3, 8, 8)
+        # layer_norm normalized the last axis
+        np.testing.assert_allclose(np.asarray(out[1]).mean(-1), 0.0,
+                                   atol=1e-5)
+
+    def test_bilinear_and_row_conv_and_data_norm(self):
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .rand(3, 4).astype(np.float32))
+        y = paddle.to_tensor(np.random.RandomState(1)
+                             .rand(3, 5).astype(np.float32))
+        out = static.nn.bilinear_tensor_product(x, y, size=2)
+        assert out.shape == [3, 2]
+        seq = paddle.to_tensor(np.random.RandomState(2)
+                               .rand(2, 6, 4).astype(np.float32))
+        rc = static.nn.row_conv(seq, future_context_size=2)
+        assert rc.shape == [2, 6, 4]
+        dn = static.nn.data_norm(x)
+        assert dn.shape == [3, 4]
+
+    def test_crf_decoding_viterbi(self):
+        # 3 tags; transitions force alternation 0->1->0...
+        N, T, B = 3, 5, 2
+        trans = np.full((N, N), -5.0, np.float32)
+        trans[0, 1] = trans[1, 0] = 2.0
+        unary = np.zeros((B, T, N), np.float32)
+        unary[:, 0, 0] = 3.0  # start at tag 0
+        path = np.asarray(static.nn.crf_decoding(
+            paddle.to_tensor(unary), paddle.to_tensor(trans)).numpy())
+        np.testing.assert_array_equal(path[0], [0, 1, 0, 1, 0])
+
+    def test_unimplemented_raise_with_guidance(self):
+        with pytest.raises(NotImplementedError):
+            static.nn.deform_conv2d()
+        with pytest.raises(NotImplementedError):
+            static.nn.nce()
+
+    def test_crf_decoding_paddle_layout(self):
+        """[N+2, N] layout (review regression): row 0 start, row 1 stop,
+        rows 2.. pairwise (reference crf_decoding_op.h)."""
+        N = 3
+        trans = np.zeros((N + 2, N), np.float32)
+        trans[0] = [5.0, 0.0, 0.0]          # start strongly prefers tag 0
+        trans[1] = [0.0, 0.0, 5.0]          # stop strongly prefers tag 2
+        trans[2:] = -5.0
+        trans[2 + 0, 1] = 2.0               # 0 -> 1
+        trans[2 + 1, 2] = 2.0               # 1 -> 2
+        trans[2 + 2, 0] = 2.0               # 2 -> 0
+        unary = np.zeros((1, 3, N), np.float32)
+        path = np.asarray(static.nn.crf_decoding(
+            paddle.to_tensor(unary), paddle.to_tensor(trans)).numpy())
+        np.testing.assert_array_equal(path[0], [0, 1, 2])
+
+    def test_data_norm_reference_formula(self):
+        """scale = sqrt(n / square_sum), no mean-centering of the square
+        sum (review regression; reference data_norm_op.cc:302)."""
+        paddle.enable_static()
+        x = paddle.to_tensor(np.asarray([[2.0, 4.0]], np.float32))
+        out = static.nn.data_norm(x)
+        # default stats: n=1e4, sum=0, sqsum=1e4 -> mean 0, scale 1
+        np.testing.assert_allclose(out.numpy(), [[2.0, 4.0]], rtol=1e-5)
+        paddle.disable_static()
+
+    def test_layer_norm_no_affine(self):
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .rand(2, 4).astype(np.float32))
+        paddle.enable_static()
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            inp = static.data("inp", [2, 4])
+            out = static.nn.layer_norm(inp, scale=False, shift=False)
+        assert len(main.all_parameters()) == 0  # no gamma/beta created
+        paddle.disable_static()
