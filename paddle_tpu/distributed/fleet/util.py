@@ -40,9 +40,7 @@ class UtilBase:
         float32 on device (TPUs have no f64); exact for metric counts
         below 2^24 per shard — the reference gloo path is f64, noted in
         MIGRATION.md."""
-        reducers = {"sum": np.add.reduce, "max": np.maximum.reduce,
-                    "min": np.minimum.reduce}
-        if mode not in reducers:
+        if mode not in ("sum", "max", "min"):
             raise ValueError(f"all_reduce mode must be sum/max/min, "
                              f"got {mode!r}")
         n, _ = self._world()
@@ -69,15 +67,18 @@ class UtilBase:
         if n == 1:
             return [np.asarray(input)]
         import jax
-        import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec
 
-        garr, mesh = self._stack_over_processes(
-            np.asarray(input, np.float32))
+        arr = np.asarray(input)
+        # device transport is 32-bit (TPU x64 off): ints ride int32,
+        # floats float32; the result is cast back to the input dtype
+        wire = arr.astype(np.int32 if arr.dtype.kind in "iu"
+                          else np.float32)
+        garr, mesh = self._stack_over_processes(wire)
         out = jax.jit(lambda a: a,
                       out_shardings=NamedSharding(
                           mesh, PartitionSpec()))(garr)
-        full = np.asarray(out.addressable_shards[0].data)
+        full = np.asarray(out.addressable_shards[0].data).astype(arr.dtype)
         return [full[i] for i in range(n)]
 
     def barrier(self, comm_world="worker"):

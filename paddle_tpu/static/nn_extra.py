@@ -26,26 +26,45 @@ def sparse_embedding(input, size, padding_idx=None, is_test=False,
                      param_attr=param_attr, dtype=dtype)
 
 
+def _check_nchw(kw, builder):
+    fmt = kw.get("data_format", kw.get("data_layout", "NCHW"))
+    if fmt not in ("NCHW", "NCDHW", "NCL"):
+        raise NotImplementedError(
+            f"{builder}: data_format {fmt!r} unsupported (channel-first "
+            f"only; XLA canonicalizes layout on TPU anyway)")
+
+
+def _apply_act(out, kw):
+    act = kw.get("act")
+    if act:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
 def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1,
                      param_attr=None, bias_attr=None, **kw):
     from ..nn.layers.conv import Conv2DTranspose
 
+    _check_nchw(kw, "conv2d_transpose")
     layer = Conv2DTranspose(input.shape[1], num_filters, filter_size,
                             stride, padding, output_padding, groups,
                             dilation, weight_attr=param_attr,
                             bias_attr=bias_attr)
-    return layer(input)
+    return _apply_act(layer(input), kw)
 
 
 def conv3d(input, num_filters, filter_size, stride=1, padding=0,
            dilation=1, groups=1, param_attr=None, bias_attr=None, **kw):
     from ..nn.layers.conv import Conv3D
 
+    _check_nchw(kw, "conv3d")
     layer = Conv3D(input.shape[1], num_filters, filter_size, stride,
                    padding, dilation, groups, weight_attr=param_attr,
                    bias_attr=bias_attr)
-    return layer(input)
+    return _apply_act(layer(input), kw)
 
 
 def conv3d_transpose(input, num_filters, filter_size, stride=1, padding=0,
@@ -53,11 +72,12 @@ def conv3d_transpose(input, num_filters, filter_size, stride=1, padding=0,
                      param_attr=None, bias_attr=None, **kw):
     from ..nn.layers.conv import Conv3DTranspose
 
+    _check_nchw(kw, "conv3d_transpose")
     layer = Conv3DTranspose(input.shape[1], num_filters, filter_size,
                             stride, padding, output_padding, groups,
                             dilation, weight_attr=param_attr,
                             bias_attr=bias_attr)
-    return layer(input)
+    return _apply_act(layer(input), kw)
 
 
 def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
@@ -75,7 +95,9 @@ def group_norm(input, groups, epsilon=1e-5, param_attr=None,
                bias_attr=None, data_layout="NCHW", **kw):
     from ..nn.layers.norm import GroupNorm
 
-    layer = GroupNorm(groups, input.shape[1], epsilon=epsilon)
+    _check_nchw({"data_layout": data_layout}, "group_norm")
+    layer = GroupNorm(groups, input.shape[1], epsilon=epsilon,
+                      weight_attr=param_attr, bias_attr=bias_attr)
     return layer(input)
 
 
@@ -83,7 +105,8 @@ def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
                   **kw):
     from ..nn.layers.norm import InstanceNorm2D
 
-    layer = InstanceNorm2D(input.shape[1], epsilon=epsilon)
+    layer = InstanceNorm2D(input.shape[1], epsilon=epsilon,
+                           weight_attr=param_attr, bias_attr=bias_attr)
     return layer(input)
 
 
@@ -103,6 +126,17 @@ def prelu(x, mode="all", param_attr=None, **kw):
                          f"got {mode!r}")
     alpha = creation.create_parameter(shape, "float32")
     alpha.set_value(np.full(shape, 0.25, np.float32))
+    if mode == "element":
+        # per-element alpha broadcasts over batch; F.prelu's channel
+        # reshape only fits scalar/per-channel weights
+        from ..core.dispatch import apply_op
+
+        def _pe(x, a):
+            import jax.numpy as jnp
+
+            return jnp.where(x >= 0, x, a[None] * x)
+
+        return apply_op("prelu_element", _pe, x, alpha)
     return F.prelu(x, alpha)
 
 
@@ -122,18 +156,15 @@ def data_norm(input, epsilon=1e-5, param_attr=None, **kw):
     """reference: fluid/layers/nn.py data_norm — normalize by accumulated
     batch statistics (batch_size/batch_sum/batch_square_sum buffers)."""
     from ..core.dispatch import apply_op
-    from ..tensor import creation
+    from ..core.tensor import Tensor
 
     d = input.shape[-1]
-    size = creation.create_parameter([d], "float32")
-    size.set_value(np.full([d], 1e4, np.float32))
-    size.stop_gradient = True
-    ssum = creation.create_parameter([d], "float32")
-    ssum.set_value(np.zeros([d], np.float32))
-    ssum.stop_gradient = True
-    sqsum = creation.create_parameter([d], "float32")
-    sqsum.set_value(np.full([d], 1e4, np.float32))
-    sqsum.stop_gradient = True
+    # statistics are accumulators, NOT trainable weights: plain
+    # persistable Tensors stay out of program.params, so the static
+    # optimizer can never gradient-update them
+    size = Tensor(np.full([d], 1e4, np.float32), stop_gradient=True)
+    ssum = Tensor(np.zeros([d], np.float32), stop_gradient=True)
+    sqsum = Tensor(np.full([d], 1e4, np.float32), stop_gradient=True)
 
     def _dn(x, n, s, sq, *, eps):
         import jax.numpy as jnp
@@ -182,14 +213,22 @@ def crf_decoding(potentials, transition_params=None, lengths=None,
                  **kw):
     """Viterbi decode of linear-chain CRF unary potentials (reference:
     operators/crf_decoding_op.h; paddle.text.ViterbiDecoder semantics):
-    returns the argmax tag path [B, T]."""
+    returns the argmax tag path [B, T]. With per-sample ``lengths``,
+    steps beyond each length are frozen (stop weights apply at the true
+    last step; padded path positions repeat the final tag)."""
     from ..core.dispatch import apply_op
 
     if transition_params is None:
         raise ValueError("crf_decoding needs transition_params [N+2, N] "
                          "or [N, N]")
+    if lengths is None:
+        from ..core.tensor import Tensor as _T
 
-    def _viterbi(unary, trans):
+        B = potentials.shape[0]
+        T = potentials.shape[1]
+        lengths = _T(np.full([B], T, np.int32), stop_gradient=True)
+
+    def _viterbi(unary, trans, lens):
         import jax
         import jax.numpy as jnp
 
@@ -204,16 +243,25 @@ def crf_decoding(potentials, transition_params=None, lengths=None,
             stop = jnp.zeros(n)
             pair = trans[:n, :n]
 
-        def step(carry, emit):
-            score = carry  # [B, N]
+        B = unary.shape[0]
+        ident = jnp.broadcast_to(jnp.arange(n)[None, :], (B, n))
+
+        def step(carry, xs):
+            score, t = carry
+            emit = xs
             cand = score[:, :, None] + pair[None, :, :]  # [B, from, to]
             best = jnp.max(cand, axis=1) + emit
             back = jnp.argmax(cand, axis=1)
-            return best, back
+            live = (t < lens)[:, None]
+            # frozen samples: score unchanged, backpointer = identity so
+            # backtracking walks the final tag through the padding
+            return ((jnp.where(live, best, score), t + 1),
+                    jnp.where(live, back, ident))
 
         first = unary[:, 0] + start[None, :]
-        score, backs = jax.lax.scan(step, first,
-                                    jnp.swapaxes(unary[:, 1:], 0, 1))
+        (score, _), backs = jax.lax.scan(
+            step, (first, jnp.asarray(1)),
+            jnp.swapaxes(unary[:, 1:], 0, 1))
         last = jnp.argmax(score + stop[None, :], axis=-1)  # [B]
 
         def backtrack(carry, back):
@@ -228,7 +276,7 @@ def crf_decoding(potentials, transition_params=None, lengths=None,
                                 jnp.swapaxes(path, 0, 1)], axis=1)
 
     return apply_op("crf_decoding", _viterbi, potentials,
-                    transition_params)
+                    transition_params, lengths)
 
 
 def deform_conv2d(*args, **kwargs):

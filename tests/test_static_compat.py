@@ -331,3 +331,48 @@ class TestStaticNNBuilders:
             out = static.nn.layer_norm(inp, scale=False, shift=False)
         assert len(main.all_parameters()) == 0  # no gamma/beta created
         paddle.disable_static()
+
+    def test_crf_decoding_lengths(self):
+        """Padded steps are frozen: stop applies at the true last step
+        and padding repeats the final tag (review regression)."""
+        N = 2
+        trans = np.zeros((N, N), np.float32)
+        trans[0, 1] = trans[1, 0] = 3.0  # force alternation
+        trans[0, 0] = trans[1, 1] = -3.0
+        unary = np.zeros((2, 5, N), np.float32)
+        unary[:, 0, 0] = 5.0
+        lens = paddle.to_tensor(np.asarray([3, 5], np.int32))
+        path = np.asarray(static.nn.crf_decoding(
+            paddle.to_tensor(unary), paddle.to_tensor(trans),
+            lengths=lens).numpy())
+        np.testing.assert_array_equal(path[1], [0, 1, 0, 1, 0])
+        # sample 0 decodes only 3 live steps; padding repeats tag at t=2
+        np.testing.assert_array_equal(path[0][:3], [0, 1, 0])
+        np.testing.assert_array_equal(path[0][3:], [0, 0])
+
+    def test_prelu_element_mode_3d(self):
+        paddle.enable_static()
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 3, 4, 4).astype(np.float32))
+        out = static.nn.prelu(x, mode="element")
+        assert out.shape == [2, 3, 4, 4]
+        xn = np.asarray(x.numpy())
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.where(xn >= 0, xn, 0.25 * xn),
+                                   rtol=1e-6)
+        paddle.disable_static()
+
+    def test_data_norm_stats_not_trainable(self):
+        paddle.enable_static()
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 3])
+            out = static.nn.data_norm(x)
+        # only real weights (none here) are optimizer-visible
+        assert len(main.all_parameters()) == 0
+        paddle.disable_static()
+
+    def test_conv_builder_rejects_nhwc(self):
+        x = paddle.to_tensor(np.zeros((1, 3, 4, 4), np.float32))
+        with pytest.raises(NotImplementedError):
+            static.nn.conv2d_transpose(x, 2, 2, data_format="NHWC")
