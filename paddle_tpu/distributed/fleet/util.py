@@ -71,9 +71,18 @@ class UtilBase:
 
         arr = np.asarray(input)
         # device transport is 32-bit (TPU x64 off): ints ride int32,
-        # floats float32; the result is cast back to the input dtype
-        wire = arr.astype(np.int32 if arr.dtype.kind in "iu"
-                          else np.float32)
+        # floats float32; the result is cast back to the input dtype.
+        # Out-of-range ints would wrap silently — refuse instead.
+        if arr.dtype.kind in "iu":
+            if arr.size and (arr.max() > np.iinfo(np.int32).max
+                             or arr.min() < np.iinfo(np.int32).min):
+                raise OverflowError(
+                    "all_gather: integer values exceed int32 range "
+                    "(the 32-bit device wire would wrap them); gather "
+                    "as float or split the value")
+            wire = arr.astype(np.int32)
+        else:
+            wire = arr.astype(np.float32)
         garr, mesh = self._stack_over_processes(wire)
         out = jax.jit(lambda a: a,
                       out_shardings=NamedSharding(

@@ -202,11 +202,7 @@ def row_conv(input, future_context_size, param_attr=None, act=None):
         return out
 
     out = apply_op("row_conv", _rc, input, w)
-    if act:
-        from ..nn import functional as F
-
-        out = getattr(F, act)(out)
-    return out
+    return _apply_act(out, {"act": act})
 
 
 def crf_decoding(potentials, transition_params=None, lengths=None,
@@ -221,17 +217,16 @@ def crf_decoding(potentials, transition_params=None, lengths=None,
     if transition_params is None:
         raise ValueError("crf_decoding needs transition_params [N+2, N] "
                          "or [N, N]")
-    if lengths is None:
-        from ..core.tensor import Tensor as _T
-
-        B = potentials.shape[0]
-        T = potentials.shape[1]
-        lengths = _T(np.full([B], T, np.int32), stop_gradient=True)
-
     def _viterbi(unary, trans, lens):
         import jax
         import jax.numpy as jnp
 
+        if lens is None:
+            # resolve from the TRACED shape: baking the build-time
+            # placeholder dims would freeze every step for programs
+            # declared with dynamic (-1) batch/seq sizes
+            lens = jnp.full((unary.shape[0],), unary.shape[1],
+                            dtype=jnp.int32)
         # paddle layout [N+2, N] (crf_decoding_op.h): row 0 = start
         # weights, row 1 = stop weights, rows 2.. = pairwise transitions;
         # a bare [N, N] is pairwise-only

@@ -376,3 +376,24 @@ class TestStaticNNBuilders:
         x = paddle.to_tensor(np.zeros((1, 3, 4, 4), np.float32))
         with pytest.raises(NotImplementedError):
             static.nn.conv2d_transpose(x, 2, 2, data_format="NHWC")
+
+    def test_crf_decoding_dynamic_batch_program(self):
+        """Default lengths must come from the TRACED shape, not the
+        build-time placeholder dims (review regression, confirmed repro:
+        [-1,-1,N] programs previously froze every step)."""
+        N = 2
+        trans = np.zeros((N, N), np.float32)
+        trans[0, 1] = trans[1, 0] = 3.0
+        trans[0, 0] = trans[1, 1] = -3.0
+        paddle.enable_static()
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            pot = static.data("pot", [-1, -1, N])
+            path = static.nn.crf_decoding(pot, paddle.to_tensor(trans))
+        exe = static.Executor()
+        exe.run(startup)
+        unary = np.zeros((2, 5, N), np.float32)
+        unary[:, 0, 0] = 5.0
+        out, = exe.run(main, feed={"pot": unary}, fetch_list=[path])
+        np.testing.assert_array_equal(np.asarray(out)[0], [0, 1, 0, 1, 0])
+        paddle.disable_static()
