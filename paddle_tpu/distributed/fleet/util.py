@@ -72,22 +72,30 @@ class UtilBase:
         arr = np.asarray(input)
         # device transport is 32-bit (TPU x64 off): ints ride int32,
         # floats float32; the result is cast back to the input dtype.
-        # Out-of-range ints would wrap silently — refuse instead.
-        if arr.dtype.kind in "iu":
-            if arr.size and (arr.max() > np.iinfo(np.int32).max
-                             or arr.min() < np.iinfo(np.int32).min):
-                raise OverflowError(
-                    "all_gather: integer values exceed int32 range "
-                    "(the 32-bit device wire would wrap them); gather "
-                    "as float or split the value")
-            wire = arr.astype(np.int32)
+        # Overflow detection must be COLLECTIVE-CONSISTENT: a per-rank
+        # pre-collective raise would leave in-range ranks blocked inside
+        # the gather. So out-of-range ints are replaced by a sentinel on
+        # the wire, and every rank raises in unison after the collective.
+        int_wire = arr.dtype.kind in "iu"
+        if int_wire:
+            lo, hi = np.iinfo(np.int32).min + 1, np.iinfo(np.int32).max
+            bad = arr.size and (arr.max() > hi or arr.min() < lo)
+            wire = np.where(np.full(arr.shape, bad),
+                            np.iinfo(np.int32).min,
+                            np.clip(arr, lo, hi)).astype(np.int32)
         else:
             wire = arr.astype(np.float32)
         garr, mesh = self._stack_over_processes(wire)
         out = jax.jit(lambda a: a,
                       out_shardings=NamedSharding(
                           mesh, PartitionSpec()))(garr)
-        full = np.asarray(out.addressable_shards[0].data).astype(arr.dtype)
+        full = np.asarray(out.addressable_shards[0].data)
+        if int_wire and (full == np.iinfo(np.int32).min).any():
+            raise OverflowError(
+                "all_gather: some rank's integer values exceed int32 "
+                "range (the 32-bit device wire would wrap them); gather "
+                "as float or split the value")
+        full = full.astype(arr.dtype)
         return [full[i] for i in range(n)]
 
     def barrier(self, comm_world="worker"):
