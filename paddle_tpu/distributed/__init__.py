@@ -20,5 +20,7 @@ from . import spmd  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from .spmd import build_train_step, shard_batch  # noqa: F401
 from . import sharding  # noqa: F401
-from .launch_mod import launch  # noqa: F401
+# paddle.distributed.launch is a MODULE (python -m entry point), as in
+# the reference; the programmatic API lives in launch_mod
+from . import launch  # noqa: F401
 from ..ops.ring_attention import ring_attention  # noqa: F401

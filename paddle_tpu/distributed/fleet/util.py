@@ -78,11 +78,13 @@ class UtilBase:
         # the wire, and every rank raises in unison after the collective.
         int_wire = arr.dtype.kind in "iu"
         if int_wire:
-            lo, hi = np.iinfo(np.int32).min + 1, np.iinfo(np.int32).max
-            bad = arr.size and (arr.max() > hi or arr.min() < lo)
-            wire = np.where(np.full(arr.shape, bad),
-                            np.iinfo(np.int32).min,
-                            np.clip(arr, lo, hi)).astype(np.int32)
+            info = np.iinfo(np.int32)
+            sent = info.min  # reserved as the overflow sentinel
+            too_big = bool(arr.size) and int(arr.max()) > info.max
+            too_small = bool(arr.size) and arr.dtype.kind == "i" and \
+                int(arr.min()) <= sent
+            wire = (np.full(arr.shape, sent, np.int32)
+                    if too_big or too_small else arr.astype(np.int32))
         else:
             wire = arr.astype(np.float32)
         garr, mesh = self._stack_over_processes(wire)
@@ -92,9 +94,10 @@ class UtilBase:
         full = np.asarray(out.addressable_shards[0].data)
         if int_wire and (full == np.iinfo(np.int32).min).any():
             raise OverflowError(
-                "all_gather: some rank's integer values exceed int32 "
-                "range (the 32-bit device wire would wrap them); gather "
-                "as float or split the value")
+                "all_gather: some rank's integer values exceed the "
+                "int32 wire range [-2^31+1, 2^31-1] (INT32_MIN is "
+                "reserved as the overflow sentinel); gather as float or "
+                "split the value")
         full = full.astype(arr.dtype)
         return [full[i] for i in range(n)]
 
