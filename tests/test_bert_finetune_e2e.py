@@ -1,0 +1,65 @@
+"""North-star integration: BERT fine-tuning through hapi Model.fit —
+text model zoo + pooling head + DataLoader + metrics in one flow
+(reference analog: PaddleNLP BERT fine-tune on a hapi loop)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.hapi import Model
+from paddle_tpu.io.dataset import Dataset
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.text.models import BertModel
+
+V, L = 128, 12
+
+
+class SentimentDS(Dataset):
+    """Label 1 iff trigger tokens were planted (trigger ids scrubbed from
+    the noise so the task is exactly separable)."""
+
+    def __init__(self, n, seed, triggers):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randint(3, V, (n, L)).astype(np.int32)
+        self.x[np.isin(self.x, triggers)] = 2
+        self.y = rng.randint(0, 2, n).astype(np.int64)
+        for i in range(n):
+            if self.y[i]:
+                pos = rng.choice(L, 2, replace=False)
+                self.x[i, pos] = rng.choice(triggers, 2)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class BertClassifier(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.bert = BertModel(vocab_size=V, hidden_size=32,
+                              num_hidden_layers=2, num_attention_heads=2,
+                              intermediate_size=64,
+                              max_position_embeddings=L,
+                              hidden_dropout_prob=0.0,
+                              attention_probs_dropout_prob=0.0)
+        self.head = nn.Linear(32, 2)
+
+    def forward(self, ids):
+        seq, pooled = self.bert(ids)
+        return self.head(seq.mean(axis=1))
+
+
+def test_bert_finetune_via_hapi():
+    paddle.seed(0)
+    triggers = np.random.RandomState(7).choice(V - 3, 6,
+                                               replace=False) + 3
+    net = BertClassifier()
+    model = Model(net)
+    opt = optimizer.AdamW(3e-3, parameters=net.parameters())
+    model.prepare(opt, nn.loss.CrossEntropyLoss(), metrics=Accuracy())
+    train = SentimentDS(1024, 0, triggers)
+    val = SentimentDS(256, 1, triggers)
+    model.fit(train, val, batch_size=64, epochs=6, verbose=0)
+    res = model.evaluate(val, batch_size=64, verbose=0)
+    assert res["acc"] > 0.9, res
