@@ -129,14 +129,8 @@ class TestOnnxRealModels:
         from paddle_tpu.vision.models import MobileNetV2
 
         net = MobileNetV2(num_classes=10)
-        net.eval()
         x = np.random.RandomState(0).rand(1, 3, 32, 32).astype(np.float32)
-        blob = ponnx.export_bytes(
-            net, [InputSpec([1, 3, 32, 32], "float32", "img")])
-        model = ponnx.load(blob)
-        got = ponnx.run(model, {"img": x})[0]
-        want = net(paddle.to_tensor(x)).numpy()
-        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+        model = _roundtrip(net, {"img": x}, rtol=1e-3, atol=1e-4)
         groups = [n["attrs"].get("group", 1)
                   for n in model["graph"]["nodes"]
                   if n["op_type"] == "Conv"]
@@ -149,11 +143,6 @@ class TestOnnxRealModels:
         net = GPTModel(vocab_size=64, hidden_size=32, num_layers=2,
                        num_heads=4, intermediate_size=64, max_seq_len=32,
                        dropout=0.0)
-        net.eval()
         ids = np.random.RandomState(1).randint(0, 64, (1, 10)) \
             .astype(np.int32)
-        blob = ponnx.export_bytes(net, [InputSpec([1, 10], "int32",
-                                                  "ids")])
-        got = ponnx.run(ponnx.load(blob), {"ids": ids})[0]
-        want = net(paddle.to_tensor(ids)).numpy()
-        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+        _roundtrip(net, {"ids": ids}, rtol=1e-3, atol=1e-4)
