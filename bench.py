@@ -15,11 +15,20 @@ repo publishes no numbers -- see BASELINE.md):
 The target is >= 0.8x either way.
 
 TPU init policy: the axon tunnel can take many minutes to come up, so we
-retry jax.devices() with backoff for BENCH_INIT_TIMEOUT seconds (default
-30 min). If the TPU never materialises we print a DISTINCT FAILURE
-record (error field, value 0) and exit non-zero -- never a silent
-tiny-CPU number. BENCH_CPU=1 is the explicit hermetic smoke mode and is
-marked "smoke": true in the output.
+retry jax.devices() with backoff. If the TPU never materialises we print
+a DISTINCT FAILURE record (error field, value 0) and exit non-zero --
+never a silent tiny-CPU number. BENCH_CPU=1 is the explicit hermetic
+smoke mode and is marked "smoke": true in the output.
+
+Deadline policy: the driver runs this under its own timeout (observed
+~30 min; round 3 was killed at rc=124 with no JSON because init patience
+exceeded it). The WHOLE bench therefore runs in a worker thread while
+the main thread enforces BENCH_DEADLINE seconds (default 1440 = 24 min)
+and prints the one JSON line itself -- a failure record if the worker is
+still wedged at the deadline. rc-124-with-no-JSON is impossible as long
+as BENCH_DEADLINE is under the driver budget. Init patience is derived
+from the deadline (deadline minus ~7 min reserved for compile+steps),
+clamped by BENCH_INIT_TIMEOUT if set.
 
 Prints exactly ONE json line to stdout.
 """
@@ -45,22 +54,51 @@ BATCH_CANDIDATES = [256, 128, 64, 32]
 SEQ = int(os.environ.get("BENCH_SEQ", "128"))
 WARMUP = int(os.environ.get("BENCH_WARMUP", "3"))
 STEPS = int(os.environ.get("BENCH_STEPS", "20"))
-INIT_TIMEOUT = float(os.environ.get("BENCH_INIT_TIMEOUT", "1800"))
+
+# Total wall-clock budget for the whole bench (init + compile + steps).
+# Must stay under the driver's own command timeout with margin; the main
+# thread prints a failure JSON at the deadline no matter what the worker
+# thread is stuck on.
+DEADLINE = float(os.environ.get("BENCH_DEADLINE", "1440"))
+T_START = time.time()
+# Time reserved after init for compile + warmup + timed steps (r02 data:
+# compile+warmup ~124s; batch sweep can recompile up to 4x).
+RESERVE = float(os.environ.get("BENCH_RESERVE", "420"))
+INIT_TIMEOUT = min(
+    float(os.environ.get("BENCH_INIT_TIMEOUT", "1800")),
+    max(60.0, DEADLINE - RESERVE),
+)
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def fail(msg):
-    print(json.dumps({
+def emit(rec):
+    """Print the ONE json line (exactly once, process-wide)."""
+    print(json.dumps(rec), flush=True)
+
+
+def _failure_record(msg):
+    return {
         "metric": METRIC,
         "value": 0.0,
-        "unit": "tokens/s",
+        "unit": "images/s" if MODEL == "resnet50" else "tokens/s",
         "vs_baseline": 0.0,
         "error": msg,
-    }))
-    sys.exit(1)
+    }
+
+
+class BenchFailure(Exception):
+    """Raised by the worker to signal a clean failure record."""
+
+    def __init__(self, msg):
+        super().__init__(msg)
+        self.record = _failure_record(msg)
+
+
+def fail(msg):
+    raise BenchFailure(msg)
 
 
 def _is_oom(e):
@@ -283,7 +321,7 @@ def main():
     }
     if smoke:
         rec["smoke"] = True
-    print(json.dumps(rec))
+    return rec
 
 
 def run_resnet50(smoke, platform):
@@ -373,16 +411,43 @@ def run_resnet50(smoke, platform):
     }
     if smoke:
         rec["smoke"] = True
-    print(json.dumps(rec))
+    return rec
+
+
+def _run_with_deadline():
+    """Run the bench in a worker thread; the main thread owns the one
+    JSON line and emits a failure record at the deadline even if the
+    worker is wedged inside an uninterruptible backend call."""
+    import threading
+
+    box = {}
+
+    def worker():
+        try:
+            box["rec"], box["rc"] = main(), 0
+        except BenchFailure as e:
+            box["rec"], box["rc"] = e.record, 1
+        except BaseException as e:  # noqa: BLE001 - one JSON line, always
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            box["rec"] = _failure_record(
+                f"bench_crashed: {type(e).__name__}: {e}")
+            box["rc"] = 1
+
+    th = threading.Thread(target=worker, daemon=True)
+    th.start()
+    remaining = DEADLINE - (time.time() - T_START) - 15.0
+    th.join(max(5.0, remaining))
+    if th.is_alive():
+        emit(_failure_record(
+            f"deadline_exceeded: bench still running at BENCH_DEADLINE="
+            f"{DEADLINE:.0f}s (init patience was {INIT_TIMEOUT:.0f}s); "
+            "raise BENCH_DEADLINE if the driver budget allows"))
+        os._exit(1)
+    emit(box["rec"])
+    os._exit(box.get("rc", 1))
 
 
 if __name__ == "__main__":
-    try:
-        main()
-    except SystemExit:
-        raise
-    except Exception as e:  # guarantee ONE json line even on crash
-        import traceback
-
-        traceback.print_exc(file=sys.stderr)
-        fail(f"bench_crashed: {type(e).__name__}: {e}")
+    _run_with_deadline()
