@@ -65,8 +65,9 @@ leaky_relu = _F.leaky_relu
 elu = _F.elu
 dropout = _F.dropout
 cross_entropy = _F.cross_entropy
-softmax_with_cross_entropy = _F.softmax_with_cross_entropy \
-    if hasattr(_F, "softmax_with_cross_entropy") else None
+# real binding (the old hasattr guard predated the functional op and
+# left None behind when it missed)
+softmax_with_cross_entropy = _F.softmax_with_cross_entropy
 mse_loss = _F.mse_loss
 one_hot = _F.one_hot
 label_smooth = _F.label_smooth

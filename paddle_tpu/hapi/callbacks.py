@@ -1,6 +1,8 @@
 """Callbacks (reference: python/paddle/hapi/callbacks.py)."""
 import time
 
+import numpy as np
+
 
 def _auto_mode(monitor):
     """'auto' monitor-mode heuristic (reference: callbacks.py EarlyStopping
@@ -162,8 +164,12 @@ class VisualDL(Callback):
         self._files.clear()
 
     def _log(self, mode, step, logs):
+        import numbers
+
+        # Real (not complex — float() would raise) covers python ints/
+        # floats AND numpy scalar metrics like np.float32
         scalars = {k: float(v) for k, v in (logs or {}).items()
-                   if isinstance(v, (int, float)) and k != "step"}
+                   if isinstance(v, numbers.Real) and k != "step"}
         if scalars:
             self._write(mode, {**scalars, "step": step})
 

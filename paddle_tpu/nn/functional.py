@@ -574,7 +574,8 @@ def adaptive_avg_pool1d(x, output_size, name=None):
         y = jax.lax.reduce_window(x, 0.0, jax.lax.add, (1, 1, k), (1, 1, k), "VALID")
         return y / k
 
-    return apply_op("adaptive_avg_pool1d", _aap1, x, out=int(output_size))
+    return apply_op("adaptive_avg_pool1d", _aap1, x,
+                    out=_pair(output_size, 1)[0])
 
 
 # ------------------------------------------------------------- norms
@@ -1205,7 +1206,7 @@ def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
     if return_mask:
         raise NotImplementedError("return_mask=True not yet supported")
     return apply_op("adaptive_max_pool1d", _adaptive_pool_nd, x,
-                    out_sizes=(int(output_size),), spatial_axes=(2,),
+                    out_sizes=_pair(output_size, 1), spatial_axes=(2,),
                     mode="max")
 
 

@@ -32,6 +32,18 @@ class _SpectralNorm:
         return w.reshape(w.shape[0], -1)
 
     def compute(self, layer, training):
+        from ...core import dispatch
+
+        if dispatch.in_trace():
+            # Power iteration pulls the weight to host numpy; under a
+            # jax trace (jit.to_static / jit.save / onnx.export) the
+            # value is a tracer and np.asarray would raise opaquely.
+            raise RuntimeError(
+                "spectral_norm is eager-only: the power-iteration hook "
+                "materialises the weight on host, which is impossible "
+                "under jit.to_static/jit.save/onnx.export tracing. "
+                "Remove the hook (or fold sigma into the weight) before "
+                "exporting.")
         orig = layer._parameters[self.name + "_orig"]
         w = np.asarray(orig._value, np.float32)
         mat = self._reshape_to_matrix(w)

@@ -167,8 +167,14 @@ def _jitter_range(value, name, center=1.0):
 
 
 def _ceiling(img):
-    """Images are either [0, 1] floats or [0, 255]; clip to the range."""
-    return 255.0 if img.max() > 1.5 else 1.0
+    """Value ceiling for clipping: integer images (uint8 PIL/ndarray) live
+    in [0, 255] by dtype; for floats the value heuristic is the only
+    signal left, so a dark [0, 255]-float image must be passed here
+    BEFORE any float32 conversion of an integer original."""
+    img = np.asarray(img)
+    if np.issubdtype(img.dtype, np.integer):
+        return 255.0
+    return 255.0 if img.size and img.max() > 1.5 else 1.0
 
 
 class BrightnessTransform(BaseTransform):
@@ -176,9 +182,10 @@ class BrightnessTransform(BaseTransform):
         self.range = _jitter_range(value, "brightness")
 
     def _apply_image(self, img):
-        img = np.asarray(img, np.float32)
+        raw = np.asarray(img)
+        img = raw.astype(np.float32)
         return np.clip(img * np.random.uniform(*self.range), 0,
-                       _ceiling(img))
+                       _ceiling(raw))
 
 
 class Pad(BaseTransform):
@@ -207,10 +214,11 @@ class ContrastTransform(BaseTransform):
         self.range = _jitter_range(value, "contrast")
 
     def _apply_image(self, img):
-        img = _chw(np.asarray(img, np.float32))
+        raw = np.asarray(img)
+        img = _chw(raw.astype(np.float32))
         alpha = np.random.uniform(*self.range)
         mean = _gray(img).mean()
-        return np.clip(alpha * img + (1 - alpha) * mean, 0, _ceiling(img))
+        return np.clip(alpha * img + (1 - alpha) * mean, 0, _ceiling(raw))
 
 
 class SaturationTransform(BaseTransform):
@@ -220,10 +228,11 @@ class SaturationTransform(BaseTransform):
         self.range = _jitter_range(value, "saturation")
 
     def _apply_image(self, img):
-        img = _chw(np.asarray(img, np.float32))
+        raw = np.asarray(img)
+        img = _chw(raw.astype(np.float32))
         alpha = np.random.uniform(*self.range)
         gray = _gray(img)[None]
-        return np.clip(alpha * img + (1 - alpha) * gray, 0, _ceiling(img))
+        return np.clip(alpha * img + (1 - alpha) * gray, 0, _ceiling(raw))
 
 
 def _rgb_to_hsv(img):
@@ -272,10 +281,11 @@ class HueTransform(BaseTransform):
             self.range = (-float(value), float(value))
 
     def _apply_image(self, img):
-        img = _chw(np.asarray(img, np.float32))
+        raw = np.asarray(img)
+        img = _chw(raw.astype(np.float32))
         if img.shape[0] == 1:
             return img
-        scale = _ceiling(img)
+        scale = _ceiling(raw)
         h, s, v = _rgb_to_hsv(img[:3] / scale)
         shift = np.random.uniform(*self.range)
         out = _hsv_to_rgb((h + shift) % 1.0, s, v) * scale
