@@ -343,37 +343,54 @@ def _eager_alltoall_single(axis, mesh_id, ndim):
 
 
 # P2P: XLA has no eager point-to-point primitive — in-graph P2P is
-# ppermute (see meta_parallel/pipeline for the real use). The eager API
-# pairs send/recv through a process-local mailbox so matched calls have
-# reference semantics (send_v2/recv_v2) in tests and single-host runs.
-_P2P_MAILBOX = {}
+# ppermute (see distributed/pipeline.py for the compiled use). The eager
+# API ships tensors host-to-host over the TCP transport in p2p.py (the
+# TPU analog of send_v2/recv_v2 over NCCL P2P bootstrapped by the
+# gen_comm_id_helper.cc TCP side channel). ``src``/``dst`` are
+# group-relative like the reference: the wire address is the peer's
+# GLOBAL trainer rank (same mesh coordinates, axis index swapped) and
+# frames are matched by (axis, group-relative src).
 
 
-def _require_single_process(what):
-    if jax.process_count() > 1:
-        raise NotImplementedError(
-            f"eager {what} pairs through a process-local mailbox and "
-            f"cannot cross process boundaries; use ppermute inside a "
-            f"compiled step (distributed/pipeline.py) for real P2P")
+def _global_rank_of(axis, peer):
+    """Trainer rank of the process at group-relative position ``peer``
+    on ``axis``, holding every other mesh coordinate fixed (inverse of
+    get_rank_in's stride arithmetic)."""
+    mesh = topology.get_global_mesh()
+    inner = 1
+    seen = False
+    for name in mesh.axis_names:
+        if seen:
+            inner *= mesh.shape.get(name, 1)
+        if name == axis:
+            seen = True
+    me = jax.process_index()
+    mine = (me // inner) % mesh.shape.get(axis, 1)
+    return me + (int(peer) - mine) * inner
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
     """reference: collective.py:1253 / send_v2 op (see P2P note above)."""
-    _require_single_process("send()")
-    key = (_axis_of(group), get_rank_in(group), dst)
-    _P2P_MAILBOX.setdefault(key, []).append(tensor._value)
+    from . import p2p
+
+    axis = _axis_of(group)
+    p2p.get_transport().send(axis, _global_rank_of(axis, dst),
+                             np.asarray(tensor._value),
+                             src_tag=get_rank_in(group))
     return tensor
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    """reference: collective.py:1302 / recv_v2 op (see P2P note above)."""
-    _require_single_process("recv()")
-    key = (_axis_of(group), src, get_rank_in(group))
-    box = _P2P_MAILBOX.get(key)
-    if box:
-        val = box.pop(0)
-        tensor._value = val.astype(tensor._value.dtype) \
-            if val.dtype != tensor._value.dtype else val
+    """reference: collective.py:1302 / recv_v2 op (see P2P note above).
+
+    Blocks until the matching send arrives (PADDLE_P2P_TIMEOUT caps the
+    wait), like the reference's synchronous recv_v2."""
+    from . import p2p
+
+    val = p2p.get_transport().recv(_axis_of(group), int(src))
+    arr = jnp.asarray(val)
+    tensor._value = arr.astype(tensor._value.dtype) \
+        if arr.dtype != tensor._value.dtype else arr
     return tensor
 
 

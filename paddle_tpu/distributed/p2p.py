@@ -1,0 +1,260 @@
+"""Cross-process eager point-to-point transport.
+
+Reference: the eager ``send_v2``/``recv_v2`` collective ops
+(paddle/fluid/operators/collective/send_v2_op.cc, recv_v2_op.cu.cc) move
+tensors between ranks over NCCL P2P; the communicator id they need is
+exchanged over a TCP side channel
+(paddle/fluid/platform/gen_comm_id_helper.cc:286).
+
+TPU-native design: XLA has no eager point-to-point primitive — in-graph
+P2P is ``ppermute`` inside a compiled step (distributed/pipeline.py).
+The *eager* API therefore ships tensors host-to-host over its own TCP
+transport, which is exactly the role the reference's TCP side channel +
+NCCL socket transport plays for eager mode:
+
+- each process lazily binds an ephemeral listener and publishes
+  ``paddle_p2p/<rank> -> ip:port`` through the jax.distributed
+  coordination KV store (the service init_parallel_env already
+  rendezvouses through); with no KV store (single process) the loopback
+  address is used directly,
+- ``send`` frames the array as ``[u32 meta_len | meta_json | raw bytes]``
+  over a cached connection to the destination's listener,
+- the listener demuxes inbound messages into per-sender FIFO queues;
+  ``recv`` blocks on the matching queue.
+
+Messages are matched by (axis, src, dst) like the reference's
+(ring_id, peer) pairing, so interleaved streams on different group axes
+do not cross.
+"""
+import json
+import os
+import socket
+import struct
+import threading
+
+import numpy as np
+
+__all__ = ["get_transport", "shutdown"]
+
+_HEADER = struct.Struct("<I")
+_RECV_TIMEOUT = float(os.environ.get("PADDLE_P2P_TIMEOUT", "120"))
+
+_lock = threading.Lock()
+_transport = None
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("P2P peer closed the connection mid-message")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class _Queue:
+    """FIFO with a condition variable (queue.Queue without the
+    task-tracking we don't need)."""
+
+    def __init__(self):
+        self._items = []
+        self._cv = threading.Condition()
+
+    def put(self, item):
+        with self._cv:
+            self._items.append(item)
+            self._cv.notify()
+
+    def get(self, timeout):
+        with self._cv:
+            if not self._cv.wait_for(lambda: self._items, timeout):
+                raise TimeoutError(
+                    f"recv() timed out after {timeout:.0f}s waiting for a "
+                    "matching send (set PADDLE_P2P_TIMEOUT to adjust)")
+            return self._items.pop(0)
+
+
+class Transport:
+    """One per process: a listener socket + per-(axis, src) inbox queues
+    + cached outbound connections."""
+
+    def __init__(self, rank):
+        self.rank = int(rank)
+        self._queues = {}
+        self._queues_lock = threading.Lock()
+        self._out = {}
+        self._out_lock = threading.Lock()
+        self._closed = False
+
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("0.0.0.0", 0))
+        self._srv.listen(64)
+        self.port = self._srv.getsockname()[1]
+        self.addr = f"{self._my_host()}:{self.port}"
+
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"paddle-p2p-accept-r{self.rank}")
+        self._accept_thread.start()
+        self._publish()
+
+    # ---------------------------------------------------- address book
+
+    @staticmethod
+    def _my_host():
+        ep = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+        host = ep.rsplit(":", 1)[0] if ":" in ep else ""
+        if host:
+            return host
+        # no launcher env: publishing loopback to a multi-host cluster
+        # would send peers to their OWN machine, so derive a routable
+        # address (the UDP connect never transmits; it just picks the
+        # outbound interface). Single-host keeps loopback.
+        coord = os.environ.get("PADDLE_COORDINATOR", "")
+        if coord and not coord.startswith(("127.", "localhost")):
+            try:
+                probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                probe.connect((coord.rsplit(":", 1)[0], 80))
+                host = probe.getsockname()[0]
+                probe.close()
+                return host
+            except OSError:
+                pass
+        return "127.0.0.1"
+
+    @staticmethod
+    def _kv_client():
+        try:
+            import jax
+            from jax._src.distributed import global_state
+
+            if jax.distributed.is_initialized():
+                return global_state.client
+        except Exception:
+            pass
+        return None
+
+    def _publish(self):
+        client = self._kv_client()
+        if client is not None:
+            client.key_value_set(f"paddle_p2p/{self.rank}", self.addr)
+
+    def _peer_addr(self, dst):
+        if dst == self.rank:
+            return f"127.0.0.1:{self.port}"
+        client = self._kv_client()
+        if client is None:
+            raise RuntimeError(
+                f"eager send/recv with peer rank {dst} needs the "
+                "jax.distributed coordination service for address "
+                "exchange — call init_parallel_env() first (single-"
+                "process runs can only self-send)")
+        addr = client.blocking_key_value_get(
+            f"paddle_p2p/{dst}", int(_RECV_TIMEOUT * 1000))
+        return addr
+
+    # ---------------------------------------------------- inbound
+
+    def _queue_for(self, axis, src):
+        with self._queues_lock:
+            return self._queues.setdefault((axis, int(src)), _Queue())
+
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._conn_loop, args=(conn,),
+                             daemon=True).start()
+
+    def _conn_loop(self, conn):
+        try:
+            with conn:
+                while True:
+                    meta_len = _HEADER.unpack(_recv_exact(conn, 4))[0]
+                    meta = json.loads(_recv_exact(conn, meta_len))
+                    payload = _recv_exact(conn, int(meta["nbytes"]))
+                    arr = np.frombuffer(
+                        payload, dtype=np.dtype(meta["dtype"])
+                    ).reshape(meta["shape"]).copy()
+                    self._queue_for(meta["axis"], meta["src"]).put(arr)
+        except (ConnectionError, OSError):
+            return
+
+    # ---------------------------------------------------- outbound
+
+    def _conn_to(self, dst):
+        """Cached (socket, per-destination lock). The KV lookup and TCP
+        connect (each up to PADDLE_P2P_TIMEOUT) happen OUTSIDE the
+        global dict lock — a dead peer must not stall sends to healthy
+        peers; frame atomicity needs only the one socket locked."""
+        with self._out_lock:
+            entry = self._out.get(dst)
+        if entry is not None:
+            return entry
+        host, port = self._peer_addr(dst).rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)),
+                                        timeout=_RECV_TIMEOUT)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        entry = (sock, threading.Lock())
+        with self._out_lock:
+            raced = self._out.get(dst)
+            if raced is not None:
+                sock.close()
+                return raced
+            self._out[dst] = entry
+        return entry
+
+    def send(self, axis, dst, array, src_tag=None):
+        """Ship one array to trainer ``dst``; ``src_tag`` is the value
+        the receiver matches on (group-relative rank; defaults to this
+        process's trainer rank)."""
+        array = np.ascontiguousarray(array)
+        meta = json.dumps({
+            "axis": axis,
+            "src": self.rank if src_tag is None else int(src_tag),
+            "dtype": array.dtype.name, "shape": list(array.shape),
+            "nbytes": array.nbytes,
+        }).encode()
+        sock, lock = self._conn_to(int(dst))
+        with lock:
+            sock.sendall(_HEADER.pack(len(meta)) + meta +
+                         array.tobytes())
+
+    def recv(self, axis, src, timeout=None):
+        return self._queue_for(axis, src).get(timeout or _RECV_TIMEOUT)
+
+    def close(self):
+        self._closed = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._out_lock:
+            for sock, _ in self._out.values():
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._out.clear()
+
+
+def get_transport():
+    """The process-wide transport, created on first use."""
+    global _transport
+    with _lock:
+        if _transport is None:
+            rank = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+            _transport = Transport(rank)
+        return _transport
+
+
+def shutdown():
+    global _transport
+    with _lock:
+        if _transport is not None:
+            _transport.close()
+            _transport = None

@@ -1,0 +1,72 @@
+"""2-trainer eager P2P worker (reference: the send_v2/recv_v2 eager path
+exercised by test_collective_sendrecv_api.py). Exercises:
+
+1. ping-pong: rank 0 sends, rank 1 echoes x2, rank 0 checks.
+2. eager pipeline microbatch handoff: stage 0 (rank 0) forwards each
+   microbatch and sends the activation to stage 1 (rank 1), which
+   finishes the forward and records the loss — the eager analog of the
+   reference's pipeline SectionWorker P2P. Rank 1 writes the losses to
+   argv[1]; the launching test compares them against a 1-proc oracle.
+"""
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+
+
+def main():
+    out_path = sys.argv[1]
+    dist.init_parallel_env()
+    rank, world = dist.get_rank(), dist.get_world_size()
+    assert world == 2
+
+    # ---- 1. ping-pong
+    if rank == 0:
+        ping = paddle.to_tensor(np.arange(6, dtype=np.float32))
+        dist.send(ping, dst=1)
+        pong = paddle.to_tensor(np.zeros(6, np.float32))
+        dist.recv(pong, src=1)
+        np.testing.assert_allclose(pong.numpy(),
+                                   np.arange(6, dtype=np.float32) * 2.0)
+    else:
+        got = paddle.to_tensor(np.zeros(6, np.float32))
+        dist.recv(got, src=0)
+        np.testing.assert_allclose(got.numpy(),
+                                   np.arange(6, dtype=np.float32))
+        dist.send(got * 2.0, dst=0)
+
+    # ---- 2. pipeline microbatch handoff (stage r on rank r)
+    paddle.seed(11)  # both ranks build identical stage weights
+    stage0 = nn.Sequential(nn.Linear(4, 8), nn.Tanh())
+    stage1 = nn.Linear(8, 2)
+    rng = np.random.RandomState(7)
+    micro = [rng.rand(3, 4).astype(np.float32) for _ in range(4)]
+    losses = []
+    for mb in micro:
+        if rank == 0:
+            act = stage0(paddle.to_tensor(mb))
+            dist.send(act, dst=1)
+        else:
+            act = paddle.to_tensor(np.zeros((3, 8), np.float32))
+            dist.recv(act, src=0)
+            out = stage1(act)
+            losses.append(float((out ** 2).mean().numpy()))
+    if rank == 1:
+        with open(out_path, "w") as f:
+            json.dump(losses, f)
+    print(f"rank {rank} done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -648,6 +648,31 @@ class TestMultiProcess:
             one_proc.append(float(loss))
         np.testing.assert_allclose(two_proc, one_proc, rtol=2e-5, atol=1e-6)
 
+    def test_2proc_eager_p2p_pipeline(self, tmp_path):
+        """Cross-process send/recv (reference: send_v2/recv_v2 ops):
+        ping-pong + an eager pipeline microbatch handoff, checked
+        against a 1-proc oracle of the same 2-stage net."""
+        import json
+        from paddle_tpu.distributed import launch_mod
+
+        out = tmp_path / "p2p_losses.json"
+        worker = os.path.join(os.path.dirname(__file__),
+                              "dist_p2p_worker.py")
+        launch_mod.launch_collective(worker, [str(out)], nproc_per_node=2,
+                                     log_dir=str(tmp_path / "logs"))
+        two_proc = json.load(open(out))
+
+        paddle.seed(11)
+        stage0 = nn.Sequential(nn.Linear(4, 8), nn.Tanh())
+        stage1 = nn.Linear(8, 2)
+        rng = np.random.RandomState(7)
+        oracle = []
+        for _ in range(4):
+            mb = rng.rand(3, 4).astype(np.float32)
+            out_t = stage1(stage0(paddle.to_tensor(mb)))
+            oracle.append(float((out_t ** 2).mean().numpy()))
+        np.testing.assert_allclose(two_proc, oracle, rtol=2e-5, atol=1e-7)
+
     def test_watch_kills_pod_on_failure(self, tmp_path):
         from paddle_tpu.distributed import launch_mod
 
