@@ -133,16 +133,30 @@ def init_dgc_state(params0, mesh, data_axes):
 
 def build_localsgd_train_step(layer, loss_fn, optimizer, mesh=None,
                               k_steps=4, amp_level="O0",
-                              amp_dtype="bfloat16"):
+                              amp_dtype="bfloat16", adaptive=False,
+                              init_k_steps=1, begin_step=1):
     """LocalSGD compiled train step (reference:
     fleet/meta_optimizers/localsgd_optimizer.py): every worker keeps its
     own parameter replica and optimizer state, runs local updates on its
     batch shard, and every ``k_steps`` the replicas are averaged with a
     pmean inside the same compiled step.
 
+    ``adaptive=True`` is AdaptiveLocalSGD (reference:
+    localsgd_optimizer.py:194 AdaptiveLocalSGDOptimizer): the sync
+    interval k is recomputed at every sync from loss/LR progress,
+    ``k = clip(ceil(sqrt(lr_0 * avg_loss / (lr * loss_0) * init_k)),
+    1, 16)`` with loss_0/lr_0 captured at step 1 — the interval SHRINKS
+    as the loss falls (replicas fine-tuning need tighter sync) and
+    grows again as the LR decays. Until ``begin_step`` the
+    replicas average every step, as in the reference. The whole
+    adaptation (k, last-sync step, the loss_0/lr_0 snapshot) is carried
+    as compiled scalars, so there is still no host round-trip.
+
     Returns (step_fn, init_fn); step_fn(params, opt_state, x, y, key, lr)
     -> (loss, params, opt_state) where params carry a leading [D] worker
-    axis (use ``average_params`` to collapse for eval/save).
+    axis (use ``average_params`` to collapse for eval/save). With
+    ``adaptive=True``, ``step_fn.comm_state['comm']['k']`` holds the
+    current interval.
     """
     mesh = mesh or topology.get_global_mesh()
     data_axes = tuple(ax for ax in ("dp", "sharding")
@@ -189,7 +203,7 @@ def build_localsgd_train_step(layer, loss_fn, optimizer, mesh=None,
     opt_update = type(optimizer)._update
     grad_clip = optimizer._grad_clip
 
-    def local_step(params, opt_state, x, y, key, lr, step_i):
+    def local_step(params, opt_state, comm, x, y, key, lr, step_i):
         # everything here is per-worker: params/opt_state leading axis 1
         params = {n: params[n][0] for n in param_names}
         loss, grads = jax.value_and_grad(
@@ -212,37 +226,71 @@ def build_localsgd_train_step(layer, loss_fn, optimizer, mesh=None,
                 ps = jax.tree.map(lambda a: jax.lax.pmean(a, ax), ps)
             return ps
 
-        sync = (step_i % k_steps) == (k_steps - 1)
+        avg_loss = loss
+        for ax in data_axes:
+            avg_loss = jax.lax.pmean(avg_loss, ax)
+        new_comm = comm
+        if adaptive:
+            # AdaptiveLocalSGD (reference localsgd_optimizer.py:420):
+            # next_k = clip(ceil(sqrt(lr_0*avg_loss/(lr*loss_0)*init_k)))
+            step = step_i + 1  # 1-based like the reference counter
+            loss0 = jnp.where(step == 1, avg_loss, comm["loss0"])
+            lr0 = jnp.where(step == 1, lr, comm["lr0"])
+            due = (step - comm["last"]) >= comm["k"]
+            sync = jnp.where(step <= begin_step, True, due)
+            next_k = jnp.clip(jnp.ceil(jnp.sqrt(
+                lr0 * avg_loss * float(init_k_steps)
+                / (lr * loss0 + 1e-12))), 1, 16).astype(jnp.int32)
+            new_comm = {
+                "k": jnp.where((step > begin_step) & due, next_k,
+                               comm["k"]),
+                "last": jnp.where(sync, step, comm["last"]),
+                "loss0": loss0,
+                "lr0": lr0,
+            }
+        else:
+            sync = (step_i % k_steps) == (k_steps - 1)
         new_params = jax.lax.cond(sync, avg, lambda ps: ps, new_params)
-        loss = jax.lax.pmean(loss, data_axes[0])
-        return (loss, {n: new_params[n][None] for n in param_names},
+        return (avg_loss, {n: new_params[n][None] for n in param_names},
                 {n: tuple(a[None] for a in new_state[n])
-                 for n in param_names})
+                 for n in param_names}, new_comm)
 
     pspec = P(data_axes)
     repl = P()
+    comm_spec = {"k": repl, "last": repl, "loss0": repl, "lr0": repl}
     smapped = shard_map(
         local_step, mesh=mesh,
         in_specs=({n: pspec for n in param_names},
                   {n: (pspec,) * len(optimizer._init_state(params0[n]))
                    for n in param_names},
-                  pspec, pspec, repl, repl, repl),
+                  comm_spec, pspec, pspec, repl, repl, repl),
         out_specs=(repl, {n: pspec for n in param_names},
                    {n: (pspec,) * len(optimizer._init_state(params0[n]))
-                    for n in param_names}),
+                    for n in param_names}, comm_spec),
         check_vma=False)
     step_jit = jax.jit(smapped)
-    counter = {"i": 0}
+    counter = {"i": 0, "comm": None}
+
+    def _init_comm():
+        return {"k": jnp.asarray(init_k_steps, jnp.int32),
+                "last": jnp.asarray(0, jnp.int32),
+                "loss0": jnp.asarray(0.0, jnp.float32),
+                "lr0": jnp.asarray(0.0, jnp.float32)}
 
     def step_fn(params, opt_state, x, y, key=None, lr=None):
         if key is None:
             key = jax.random.PRNGKey(counter["i"])
         if lr is None:
             lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
+        if counter["comm"] is None:
+            counter["comm"] = _init_comm()
         i = jnp.asarray(counter["i"], jnp.int32)
-        loss, params, opt_state = step_jit(params, opt_state, x, y, key, lr, i)
+        loss, params, opt_state, counter["comm"] = step_jit(
+            params, opt_state, counter["comm"], x, y, key, lr, i)
         counter["i"] += 1
         return loss, params, opt_state
+
+    step_fn.comm_state = counter
 
     def init_fn():
         params = {}

@@ -32,6 +32,7 @@ _DEFAULTS = {
     "localsgd": False,
     "localsgd_configs": {"k_steps": 1, "begin_step": 1},
     "adaptive_localsgd": False,
+    "adaptive_localsgd_configs": {"init_k_steps": 1, "begin_step": 1},
     "a_sync": False,
     "a_sync_configs": {"k_steps": -1, "max_merge_var_num": 1,
                        "send_queue_size": 16, "independent_recv_thread": False,

@@ -87,11 +87,7 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
         # fleet DistributedStrategy knobs -> functional options (the
         # meta-optimizer stack of fleet_base.py:1242 collapsed into one
         # entry point; knobs without an implementation raise, never no-op)
-        if strategy.adaptive_localsgd:
-            raise NotImplementedError(
-                "adaptive_localsgd is not implemented; use localsgd with "
-                "a fixed k_steps")
-        if strategy.localsgd:
+        if strategy.adaptive_localsgd or strategy.localsgd:
             unsupported = [k for k in ("recompute", "dgc", "fp16_allreduce",
                                        "sharding")
                            if getattr(strategy, k)]
@@ -111,11 +107,16 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
                     f"disable them or drop localsgd")
             from . import comm_opt
 
+            acfg = dict(strategy.adaptive_localsgd_configs or {}) \
+                if strategy.adaptive_localsgd else {}
             return comm_opt.build_localsgd_train_step(
                 layer, loss_fn, optimizer, mesh=mesh,
                 k_steps=int(strategy.localsgd_configs.get("k_steps", 1) or 1),
                 amp_level="O1" if strategy.amp else amp_level,
-                amp_dtype=amp_dtype)
+                amp_dtype=amp_dtype,
+                adaptive=bool(strategy.adaptive_localsgd),
+                init_k_steps=int(acfg.get("init_k_steps", 1) or 1),
+                begin_step=int(acfg.get("begin_step", 1) or 1))
         if strategy.amp and amp_level == "O0":
             amp_level = "O2" if strategy.amp_configs.get("use_pure_fp16") \
                 else "O1"

@@ -140,13 +140,56 @@ class TestLocalSGD:
             for p in params.values())
         assert diverged, "local replicas should differ before the sync step"
 
-    def test_adaptive_raises(self, mesh4):
+class TestAdaptiveLocalSGD:
+    """reference: localsgd_optimizer.py:194 AdaptiveLocalSGDOptimizer —
+    k = clip(ceil(sqrt(lr_0*avg_loss/(lr*loss_0)*init_k)), 1, 16)."""
+
+    def test_converges_and_k_adapts(self, mesh4):
         import jax.numpy as jnp
 
         s = DistributedStrategy()
         s.adaptive_localsgd = True
-        m = nn.Sequential(nn.Linear(8, 4))
-        opt = optimizer.SGD(0.1, parameters=m.parameters())
-        with pytest.raises(NotImplementedError):
-            spmd.build_train_step(m, lambda o, t: jnp.mean(o), opt,
-                                  mesh=mesh4, strategy=s)
+        s.adaptive_localsgd_configs = {"init_k_steps": 4, "begin_step": 2}
+        paddle.seed(7)
+        m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+        opt = optimizer.SGD(0.2, parameters=m.parameters())
+        step, init = spmd.build_train_step(
+            m, lambda o, t: jnp.mean((o - t) ** 2), opt, mesh=mesh4,
+            strategy=s)
+        params, st = init()
+        x, y = _data()
+        xg, yg = spmd.shard_batch(x, mesh4), spmd.shard_batch(y, mesh4)
+        losses, ks = [], []
+        for _ in range(16):
+            loss, params, st = step(params, st, xg, yg)
+            losses.append(float(loss))
+            ks.append(int(step.comm_state["comm"]["k"]))
+        assert losses[-1] < losses[0] * 0.5, losses[::4]
+        assert all(1 <= k <= 16 for k in ks)
+        # as the loss drops, avg_loss/loss_0 < 1 -> k shrinks from init_k
+        assert ks[-1] < 4, ks
+
+    def test_begin_phase_syncs_every_step(self, mesh4):
+        import jax.numpy as jnp
+
+        s = DistributedStrategy()
+        s.adaptive_localsgd = True
+        s.adaptive_localsgd_configs = {"init_k_steps": 8,
+                                       "begin_step": 100}
+        paddle.seed(7)
+        m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+        opt = optimizer.SGD(0.2, parameters=m.parameters())
+        step, init = spmd.build_train_step(
+            m, lambda o, t: jnp.mean((o - t) ** 2), opt, mesh=mesh4,
+            strategy=s)
+        params, st = init()
+        x, y = _data()
+        xg, yg = spmd.shard_batch(x, mesh4), spmd.shard_batch(y, mesh4)
+        for _ in range(2):
+            _, params, st = step(params, st, xg, yg)
+        # every step inside the begin phase averages -> replicas equal
+        for n, p in params.items():
+            arr = np.asarray(p)
+            for d in range(1, arr.shape[0]):
+                np.testing.assert_allclose(arr[d], arr[0], rtol=1e-6,
+                                           err_msg=n)
