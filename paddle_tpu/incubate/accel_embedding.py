@@ -4,20 +4,32 @@ heter_comm.h + optimizer.cuh.h, ps_gpu_wrapper.cc: billions of sparse
 rows held ON the accelerator boxes so the training loop never round-trips
 to a CPU parameter server).
 
-TPU-native redesign: no hash table and no RPC — the table is one dense
-[capacity, emb_dim] parameter ROW-SHARDED over a mesh axis; feature ids
-hash (multiply-shift, mod capacity) into rows; lookups are XLA gathers
-and the backward is a scatter-add, all inside the one compiled SPMD
-train step, with the gradient/update traffic riding ICI instead of
-PCIe/brpc. Collisions are accepted exactly as in the reference's
-mod-sharded accessors — capacity is provisioned above the live id count.
+TPU-native redesign: no RPC — the table is one dense
+[capacity, emb_dim] parameter ROW-SHARDED over a mesh axis; lookups are
+XLA gathers and the backward is a scatter-add, all inside the one
+compiled SPMD train step, with the gradient/update traffic riding ICI
+instead of PCIe/brpc. Two id->row policies:
+
+- ``hashed`` (fully in-graph): ids hash (multiply-shift, mod capacity)
+  into rows inside the trace; collisions are accepted — capacity must
+  be provisioned above the live id count.
+- ``exact`` (KeyAccessor): the reference's accessor semantics
+  (framework/fleet/heter_ps/hashtable.h exact-key probing,
+  distributed/table/common_sparse_table.cc entry admission) live
+  HOST-side, mirroring the reference split where key->offset resolution
+  is CPU accessor work and the accelerator holds values by offset: an
+  exact key->row dict with a free list (two colliding ids always get
+  DISTINCT rows), ``entry_attr`` ProbabilityEntry/CountFilterEntry
+  admission gating insertion, and LRU eviction when full. Row
+  translation happens at data-ingestion time (``assign_rows``), so the
+  compiled train step still sees static int32 row indices.
 """
 import numpy as np
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .. import nn
-from ..core.dispatch import apply_op
+from ..core.dispatch import apply_op, in_trace
 from ..core.tensor import Tensor
 
 def hash_ids(ids, capacity):
@@ -35,22 +47,132 @@ def hash_ids(ids, capacity):
     return apply_op("hash_ids", _h, ids, cap=int(capacity))
 
 
+def _admission_hash(keys):
+    """Deterministic per-key uniform in [0, 1) for ProbabilityEntry —
+    reproducible across runs and ranks (the reference draws from the
+    table's RNG; keying the draw off the id itself keeps every rank's
+    admission decision identical without communication)."""
+    x = np.asarray(keys, np.uint64)
+    x = (x ^ (x >> np.uint64(33))) * np.uint64(0xFF51AFD7ED558CCD)
+    x = (x ^ (x >> np.uint64(33))) * np.uint64(0xC4CEB9FE1A85EC53)
+    x = x ^ (x >> np.uint64(33))
+    return (x >> np.uint64(11)).astype(np.float64) / float(1 << 53)
+
+
+class KeyAccessor:
+    """Host-side exact key -> row map with admission + LRU eviction
+    (reference: heter_ps/hashtable.h exact-key probing +
+    common_sparse_table.cc accessor admission via entry_attr).
+
+    - two colliding ids ALWAYS occupy distinct rows (rows come from a
+      free list, not a hash);
+    - ``entry`` (ProbabilityEntry / CountFilterEntry) gates NEW key
+      insertion; non-admitted keys resolve to row -1 (zero embedding,
+      no update) while their observation counts still accumulate;
+    - when the table is full the least-recently-used key is evicted
+      (the reference's shrink()); evicted (key, row) pairs are reported
+      via ``take_evicted`` so callers can re-init those rows.
+    """
+
+    def __init__(self, capacity, entry=None):
+        self.capacity = int(capacity)
+        self.entry = entry
+        self.key_to_row = {}
+        self._free = list(range(self.capacity - 1, -1, -1))
+        self._counts = {}
+        self._last_use = {}
+        self._clock = 0
+        self._evicted = []
+
+    def _admit(self, key):
+        if self.entry is None:
+            return True
+        kind = self.entry._to_attr().split(":")[0]
+        if kind == "probability_entry":
+            return _admission_hash(key) < self.entry.probability
+        if kind == "count_filter_entry":
+            return self._counts.get(key, 0) >= self.entry.count
+        return True
+
+    def _alloc_row(self, key):
+        if not self._free:
+            lru_key = min(self.key_to_row, key=self._last_use.__getitem__)
+            row = self.key_to_row.pop(lru_key)
+            self._last_use.pop(lru_key)
+            self._evicted.append((lru_key, row))
+            self._free.append(row)
+        row = self._free.pop()
+        self.key_to_row[key] = row
+        return row
+
+    def assign(self, ids):
+        """Training-time id -> row translation with admission; returns
+        int32 rows, -1 where the key is not (yet) admitted."""
+        ids_arr = np.asarray(ids)
+        rows = np.empty(ids_arr.shape, np.int32)
+        flat_ids = ids_arr.ravel()
+        flat_rows = rows.ravel()
+        self._clock += 1
+        for i, key in enumerate(flat_ids.tolist()):
+            row = self.key_to_row.get(key)
+            if row is None:
+                self._counts[key] = self._counts.get(key, 0) + 1
+                if self._admit(key):
+                    row = self._alloc_row(key)
+            if row is None:
+                flat_rows[i] = -1
+            else:
+                self._last_use[key] = self._clock
+                flat_rows[i] = row
+        return rows
+
+    def lookup(self, ids):
+        """Inference-time translation: no admission, unknown keys -> -1."""
+        ids_arr = np.asarray(ids)
+        rows = np.asarray([self.key_to_row.get(k, -1)
+                           for k in ids_arr.ravel().tolist()], np.int32)
+        return rows.reshape(ids_arr.shape)
+
+    def take_evicted(self):
+        out, self._evicted = self._evicted, []
+        return out
+
+    def __len__(self):
+        return len(self.key_to_row)
+
+
 class AccelSparseEmbedding(nn.Layer):
-    """Sharded on-device embedding table with hashed ids.
+    """Sharded on-device embedding table (see module docstring).
 
     shard_axis: mesh axis holding the rows ('mp' pairs with the
     tensor-parallel layout; 'sharding' spreads over the ZeRO group).
     Adam/Adagrad-style optimizers update only touched rows in effect
     (zero gradient rows have zero moments), matching the reference's
     per-row sparse optimizers.
+
+    mode='hashed' (default): ids hash to rows inside the trace.
+    mode='exact': ids resolve through the exact ``KeyAccessor``
+    (``self.accessor``) — call ``assign_rows(ids)`` at data-ingestion
+    time and feed the returned rows to ``forward``; eager calls with
+    raw ids translate automatically. Unadmitted/unknown keys (-1 rows)
+    produce zero embeddings and receive no gradient.
     """
 
     def __init__(self, capacity, emb_dim, shard_axis="mp",
-                 init_range=0.05, pad_id=None, name=None):
+                 init_range=0.05, pad_id=None, name=None, mode="hashed",
+                 entry=None):
         super().__init__()
         self.capacity = int(capacity)
         self.emb_dim = int(emb_dim)
         self.pad_id = pad_id
+        if mode not in ("hashed", "exact"):
+            raise ValueError(f"mode must be 'hashed' or 'exact', got {mode!r}")
+        self.mode = mode
+        self.accessor = KeyAccessor(capacity, entry) if mode == "exact" \
+            else None
+        if entry is not None and mode != "exact":
+            raise ValueError("entry admission needs mode='exact' (hashed "
+                             "rows have no key identity to admit)")
         self.weight = self.create_parameter(
             [self.capacity, self.emb_dim],
             default_initializer=nn.initializer.Uniform(-init_range,
@@ -59,7 +181,63 @@ class AccelSparseEmbedding(nn.Layer):
         # honors mp_spec for placement + keeps the update sharded)
         self.weight.mp_spec = P(shard_axis)
 
+    def _translate(self, ids, admit):
+        """ids -> rows on host; pad ids pin to -1 before touching the
+        accessor (a pad must neither be admitted nor counted)."""
+        ids_np = np.asarray(ids._value if isinstance(ids, Tensor) else ids)
+        if self.pad_id is not None:
+            live = ids_np != self.pad_id
+            rows = np.full(ids_np.shape, -1, np.int32)
+            if live.any():
+                sel = ids_np[live]
+                rows[live] = (self.accessor.assign(sel) if admit
+                              else self.accessor.lookup(sel))
+        else:
+            rows = (self.accessor.assign(ids_np) if admit
+                    else self.accessor.lookup(ids_np))
+        return rows
+
+    def assign_rows(self, ids):
+        """Host-side exact translation (mode='exact'): admits new keys
+        per the entry policy and returns int32 rows (-1 = unadmitted)
+        ready to feed into the compiled train step."""
+        if self.accessor is None:
+            raise RuntimeError("assign_rows requires mode='exact'")
+        return Tensor(jnp.asarray(self._translate(ids, admit=True)),
+                      stop_gradient=True)
+
     def forward(self, ids):
+        if self.mode == "exact":
+            if in_trace():
+                # traced inputs must already be rows (assign_rows ran at
+                # ingestion) — raw ids cannot be translated in-graph.
+                # assign_rows returns int32; raw feature ids are int64,
+                # so a dtype check catches the silent-clamp misuse of
+                # feeding untranslated ids into the compiled step.
+                val = ids._value if isinstance(ids, Tensor) else ids
+                if jnp.issubdtype(val.dtype, jnp.integer) and \
+                        val.dtype != jnp.int32:
+                    raise TypeError(
+                        "mode='exact' traced forward expects int32 row "
+                        "indices from assign_rows(); got raw "
+                        f"{val.dtype} ids — translate them at data-"
+                        "ingestion time with assign_rows()")
+                rows = ids
+            else:
+                # eval/inference must not mutate the table: admission +
+                # LRU touch only while training (reference accessors
+                # admit on push, not on pull)
+                rows = Tensor(jnp.asarray(
+                    self._translate(ids, admit=self.training)),
+                    stop_gradient=True)
+
+            def _gather_masked(rows, w):
+                safe = jnp.where(rows < 0, 0, rows)
+                emb = w[safe]
+                return emb * (rows >= 0)[..., None].astype(emb.dtype)
+
+            return apply_op("accel_emb_exact", _gather_masked, rows,
+                            self.weight)
         rows = hash_ids(ids, self.capacity)
         emb = nn.functional.embedding(rows, self.weight)
         if self.pad_id is not None:

@@ -7,8 +7,9 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu import nn, optimizer
 from paddle_tpu.distributed import spmd, topology
+from paddle_tpu.distributed import CountFilterEntry, ProbabilityEntry
 from paddle_tpu.incubate.accel_embedding import (AccelSparseEmbedding,
-                                                 hash_ids)
+                                                 KeyAccessor, hash_ids)
 
 
 class TestHashIds:
@@ -113,3 +114,109 @@ class TestAccelSparseEmbedding:
         untouched = np.delete(np.arange(32), row)
         np.testing.assert_allclose(after[untouched], before[untouched],
                                    atol=1e-7)
+
+
+class TestKeyAccessor:
+    """Exact-key accessor semantics (reference: heter_ps/hashtable.h,
+    common_sparse_table.cc accessors + entry_attr admission)."""
+
+    def test_colliding_ids_get_distinct_rows(self):
+        acc = KeyAccessor(capacity=16)
+        # find two ids that COLLIDE under the hashed path for cap=16
+        base = int(np.asarray(hash_ids(
+            paddle.to_tensor(np.array([1], np.int64)), 16)._value)[0])
+        other = None
+        for cand in range(2, 10000):
+            h = int(np.asarray(hash_ids(
+                paddle.to_tensor(np.array([cand], np.int64)), 16)._value)[0])
+            if h == base:
+                other = cand
+                break
+        assert other is not None
+        rows = acc.assign(np.array([1, other]))
+        assert rows[0] != rows[1], "exact mode must separate colliding keys"
+        # stable on re-lookup
+        again = acc.assign(np.array([other, 1]))
+        assert again[0] == rows[1] and again[1] == rows[0]
+
+    def test_probability_entry_gates_insertion(self):
+        acc = KeyAccessor(capacity=4096, entry=ProbabilityEntry(0.3))
+        ids = np.arange(2000)
+        rows = acc.assign(ids)
+        admitted = (rows >= 0).sum()
+        # deterministic per-key coin with p=0.3
+        assert 400 < admitted < 800, admitted
+        # decisions are deterministic: same keys, same outcome
+        rows2 = acc.assign(ids)
+        np.testing.assert_array_equal(rows >= 0, rows2 >= 0)
+
+    def test_count_filter_admits_after_n(self):
+        acc = KeyAccessor(capacity=64, entry=CountFilterEntry(3))
+        ids = np.array([7, 7])
+        assert (acc.assign(ids) == -1).all()      # counts 1, 2
+        rows = acc.assign(np.array([7]))          # count 3 -> admitted
+        assert rows[0] >= 0
+        assert acc.assign(np.array([7]))[0] == rows[0]
+
+    def test_lru_eviction_when_full(self):
+        acc = KeyAccessor(capacity=2)
+        r_a = int(acc.assign(np.array([100]))[0])
+        int(acc.assign(np.array([200]))[0])
+        acc.assign(np.array([200]))               # 100 is now LRU
+        r_c = int(acc.assign(np.array([300]))[0])
+        assert r_c == r_a                          # reused 100's row
+        assert acc.take_evicted() == [(100, r_a)]
+        assert acc.lookup(np.array([100]))[0] == -1
+
+    def test_exact_mode_end_to_end_training(self):
+        """assign_rows at ingestion -> rows into the compiled step;
+        unadmitted (-1) rows read zero and receive no gradient."""
+        import jax.numpy as jnp
+
+        mesh = topology.build_mesh(dp=1)
+        topology.set_global_mesh(mesh)
+        paddle.seed(3)
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = AccelSparseEmbedding(32, 4, mode="exact",
+                                                entry=CountFilterEntry(2))
+
+            def forward(self, rows):
+                from paddle_tpu import tensor as pt
+
+                return pt.sum(self.emb(rows), axis=[1, 2])
+
+        m = M()
+        opt = optimizer.SGD(0.5, parameters=m.parameters())
+        step, init = spmd.build_train_step(
+            m, lambda o, y: jnp.mean((o - y) ** 2), opt, mesh=mesh)
+        params, st = init()
+        before = np.array(params["emb.weight"])
+        ids = np.arange(5, 13, dtype=np.int64)[:, None] * 7  # 8 distinct
+        y = np.ones(8, np.float32)
+        rows1 = np.asarray(m.emb.assign_rows(ids)._value)
+        assert (rows1 == -1).all()                 # first sighting: gated
+        loss, params, st = step(params, st, rows1, y)
+        np.testing.assert_allclose(np.asarray(params["emb.weight"]),
+                                   before, atol=1e-7)  # no grad anywhere
+        rows2 = np.asarray(m.emb.assign_rows(ids)._value)
+        assert (rows2 >= 0).all()                  # second sighting: in
+        assert len(set(rows2.ravel().tolist())) == 8  # all distinct
+        loss, params, st = step(params, st, rows2, y)
+        after = np.asarray(params["emb.weight"])
+        touched = sorted(rows2.ravel().tolist())
+        untouched = np.delete(np.arange(32), touched)
+        assert not np.allclose(after[touched], before[touched])
+        np.testing.assert_allclose(after[untouched], before[untouched],
+                                   atol=1e-7)
+
+    def test_eager_exact_forward(self):
+        paddle.seed(4)
+        emb = AccelSparseEmbedding(16, 4, mode="exact")
+        out = emb(paddle.to_tensor(np.array([[3, 3, 8]], np.int64)))
+        arr = np.asarray(out._value)
+        assert arr.shape == (1, 3, 4)
+        np.testing.assert_allclose(arr[0, 0], arr[0, 1])
+        assert not np.allclose(arr[0, 0], arr[0, 2])
