@@ -1,15 +1,37 @@
 """Mixture-of-Experts with expert parallelism over the 'ep' mesh axis.
 
 The reference (~v2.1) predates its MoE work, so this is green-field
-TPU-native design (like ring attention): expert FFN weights are stacked
-[E, ...] and SHARDED over 'ep'; routing uses the einsum/dense-dispatch
-formulation — every expert's FFN runs for every token and the top-k
-gate mask zeroes the rest, with the expert-dim contraction compiling to
-a psum over the ep axis. No all_to_all, no capacity overflow, static
-shapes end to end: on TPU this trades E/k extra FLOPs (cheap on the
-MXU) for zero dynamic dispatch, the standard XLA-friendly MoE shape for
-modest expert counts. Sparse a2a dispatch can later ride
-collective.alltoall_single without changing this API.
+TPU-native design (like ring attention). Expert FFN weights are stacked
+[E, ...] and SHARDED over 'ep'. Two dispatch modes behind one API:
+
+- ``dense``: every expert's FFN runs for every token and the top-k gate
+  mask zeroes the rest; the expert-dim contraction compiles to a psum
+  over the ep axis. No capacity overflow, static shapes, but E/k wasted
+  FLOPs — right only for small expert counts.
+- ``capacity`` (GShard/Switch): each expert processes at most
+  C = ceil(capacity_factor * k * N / E) tokens; tokens claim capacity
+  slots in order (per-expert cumsum) and overflow tokens DROP that
+  expert's contribution, exactly the GShard top-2 formulation. Dispatch
+  and combine are one-hot einsums — static shapes end to end — so the
+  FFN compute is E*C = k*capacity_factor*N token-slots instead of
+  E*N: the compute-sparse path. The [E, C, H] expert buffers inherit
+  the 'ep' sharding from the weights, so XLA materialises the
+  token->expert shuffle as collectives over ep (the all_to_all of the
+  GShard paper) while the FFN einsums stay local per expert shard.
+
+- ``alltoall``: the literal GShard layout under ``jax.shard_map`` over
+  'ep' — tokens batch-sharded over ep, each shard routes its LOCAL
+  tokens into [E, C, H] capacity buffers, ``lax.all_to_all`` swaps the
+  expert dim across shards (each shard then holds its own E/ep experts'
+  tokens from every shard), the FFN runs on local expert weights only,
+  and a second all_to_all routes results back. Guaranteed all-to-all on
+  ICI + per-shard compute exactly E*C/ep token-slots, independent of
+  the XLA partitioner's einsum strategy.
+
+``dispatch_mode='auto'`` (default) picks capacity for E >= 8, dense
+below — at tiny E dense dispatch wastes little and never drops.
+'alltoall' is explicit: it requires a live global mesh with ep > 1,
+batch divisible by ep, and E divisible by ep.
 """
 import numpy as np
 import jax
@@ -18,6 +40,39 @@ from jax.sharding import PartitionSpec as P
 
 from .. import nn
 from ..core.dispatch import apply_op
+
+
+def _capacity_combine(xf, probs, top_k, cap):
+    """GShard combine/dispatch build for one token group (fig. 6 of the
+    paper): tokens claim per-expert capacity slots in order, overflow
+    drops. Returns (combine [N,E,C] f32, dispatch [N,E,C], top1 idx)."""
+    n, e = probs.shape
+    topv, topi = jax.lax.top_k(probs, top_k)           # [N, k]
+    gates = topv / jnp.maximum(jnp.sum(topv, axis=-1, keepdims=True), 1e-9)
+    combine = jnp.zeros((n, e, cap), jnp.float32)
+    counts = jnp.zeros((e,), jnp.float32)              # slots claimed
+    for j in range(top_k):
+        mask_j = jax.nn.one_hot(topi[:, j], e)         # [N, E]
+        # 0-indexed slot: exclusive cumsum over tokens + slots taken by
+        # earlier choices (choice 0 claims before choice 1, like GShard)
+        pos_in_e = jnp.cumsum(mask_j, axis=0) - mask_j + counts
+        counts = counts + jnp.sum(mask_j, axis=0)
+        slot = jnp.sum(pos_in_e * mask_j, axis=-1)     # [N]
+        keep = (slot < cap).astype(jnp.float32)
+        combine = combine + (
+            gates[:, j, None, None] * keep[:, None, None]
+            * mask_j[:, :, None]
+            * jax.nn.one_hot(slot, cap)[:, None, :])
+    dispatch = (combine > 0).astype(xf.dtype)
+    return combine, dispatch, topi
+
+
+def _gshard_aux(probs, topi):
+    """GShard aux loss from the full softmax + top-1 routing fraction."""
+    e = probs.shape[-1]
+    frac = jnp.mean(jax.nn.one_hot(topi[:, 0], e), axis=0)
+    imp = jnp.mean(probs, axis=0)
+    return e * jnp.sum(frac * imp)
 
 
 class MoELayer(nn.Layer):
@@ -36,11 +91,20 @@ class MoELayer(nn.Layer):
     """
 
     def __init__(self, hidden_size, ffn_hidden, num_experts, top_k=2,
-                 shard_axis="ep", aux_weight=0.01):
+                 shard_axis="ep", aux_weight=0.01, dispatch_mode="auto",
+                 capacity_factor=1.25):
         super().__init__()
         self.num_experts = int(num_experts)
         self.top_k = int(top_k)
         self.aux_weight = float(aux_weight)
+        if dispatch_mode == "auto":
+            dispatch_mode = "capacity" if self.num_experts >= 8 else "dense"
+        if dispatch_mode not in ("dense", "capacity", "alltoall"):
+            raise ValueError(f"dispatch_mode must be 'auto'/'dense'/"
+                             f"'capacity'/'alltoall', got {dispatch_mode!r}")
+        self.shard_axis = shard_axis
+        self.dispatch_mode = dispatch_mode
+        self.capacity_factor = float(capacity_factor)
         self.gate = nn.Linear(hidden_size, num_experts)
         k = 1.0 / np.sqrt(hidden_size)
         self.w_up = self.create_parameter(
@@ -86,9 +150,114 @@ class MoELayer(nn.Layer):
             aux = e * jnp.sum(frac / top_k * imp)
             return out, aux.astype(x.dtype)
 
-        out, aux = apply_op("moe_ffn", _moe, x, logits, self.w_up,
-                            self.w_down, top_k=self.top_k)
+        def _moe_capacity(x, logits, w_up, w_down, *, top_k, cap_factor):
+            """GShard top-k capacity dispatch (Lepikhin et al. 2020,
+            algorithm in fig. 6): one-hot dispatch/combine einsums with
+            per-expert capacity C and drop-overflow. Static shapes; the
+            ep-sharded [E, C, H] buffers make the dispatch einsum the
+            cross-expert shuffle (XLA picks the collective)."""
+            b, s, hdim = x.shape
+            e = logits.shape[-1]
+            n = b * s
+            cap = max(1, int(np.ceil(cap_factor * top_k * n / e)))
+            xf = x.reshape(n, hdim)
+            probs = jax.nn.softmax(
+                logits.astype(jnp.float32), axis=-1).reshape(n, e)
+            combine, dispatch, topi = _capacity_combine(xf, probs, top_k,
+                                                        cap)
+            buf = jnp.einsum("nec,nh->ech", dispatch, xf)
+            h = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", buf, w_up))
+            y = jnp.einsum("ecf,efh->ech", h, w_down)
+            out = jnp.einsum("nec,ech->nh", combine.astype(y.dtype), y)
+            aux = _gshard_aux(probs, topi)
+            return out.reshape(b, s, hdim), aux.astype(x.dtype)
+
+        if self.dispatch_mode == "alltoall":
+            out, aux = self._forward_alltoall(x, logits)
+        elif self.dispatch_mode == "capacity":
+            out, aux = apply_op("moe_ffn_capacity", _moe_capacity, x,
+                                logits, self.w_up, self.w_down,
+                                top_k=self.top_k,
+                                cap_factor=self.capacity_factor)
+        else:
+            out, aux = apply_op("moe_ffn", _moe, x, logits, self.w_up,
+                                self.w_down, top_k=self.top_k)
         from ..nn.aux_loss import emit_aux_loss
 
         emit_aux_loss(self, aux * self.aux_weight)
         return out
+
+    def _forward_alltoall(self, x, logits):
+        """Explicit GShard a2a dispatch under shard_map over 'ep' (see
+        module docstring): tokens batch-sharded, experts local, two
+        lax.all_to_all around the expert FFN."""
+        from ..distributed import topology
+
+        mesh = topology.get_global_mesh()
+        axis = self.shard_axis
+        ep = mesh.shape.get(axis, 1)
+        e, top_k, cf = self.num_experts, self.top_k, self.capacity_factor
+        if ep <= 1:
+            raise ValueError(
+                "dispatch_mode='alltoall' needs a global mesh with "
+                f"{axis!r} > 1 (set_global_mesh(build_mesh(ep=...)))")
+        if e % ep:
+            raise ValueError(f"num_experts={e} must divide over "
+                             f"{axis}={ep} for all_to_all dispatch")
+        b = int(x.shape[0])
+        if b % ep:
+            raise ValueError(f"batch {b} must be divisible by "
+                             f"{axis}={ep} (tokens are batch-sharded)")
+
+        def local_fn(x, logits, w_up, w_down):
+            # x: [B/ep, S, H]; w_up/w_down: [E/ep, ...] (local experts)
+            b_loc, s, hdim = x.shape
+            n = b_loc * s
+            cap = max(1, int(np.ceil(cf * top_k * n / e)))
+            xf = x.reshape(n, hdim)
+            probs = jax.nn.softmax(
+                logits.astype(jnp.float32), axis=-1).reshape(n, e)
+            combine, dispatch, topi = _capacity_combine(xf, probs, top_k,
+                                                        cap)
+            buf = jnp.einsum("nec,nh->ech", dispatch, xf)  # [E, C, H]
+            # shard r keeps experts [r*E/ep, (r+1)*E/ep): swap the
+            # expert dim across shards, stacking every shard's tokens
+            # for my experts along capacity
+            buf = jax.lax.all_to_all(buf, axis, split_axis=0,
+                                     concat_axis=1, tiled=True)
+            h = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", buf, w_up))
+            y = jnp.einsum("ecf,efh->ech", h, w_down)
+            y = jax.lax.all_to_all(y, axis, split_axis=1, concat_axis=0,
+                                   tiled=True)              # [E, C, H]
+            out = jnp.einsum("nec,ech->nh", combine.astype(y.dtype), y)
+            aux = jax.lax.pmean(_gshard_aux(probs, topi), axis)
+            return out.reshape(b_loc, s, hdim), aux.astype(x.dtype)
+
+        def _a2a(x, logits, w_up, w_down):
+            tok = P(axis, None, None)
+            wsp = P(axis, None, None)
+            fn = jax.shard_map(local_fn, mesh=mesh,
+                               in_specs=(tok, tok, wsp, wsp),
+                               out_specs=(tok, P()),
+                               check_vma=False)
+            return fn(x, logits, w_up, w_down)
+
+        from ..core.dispatch import in_trace
+
+        if not in_trace():
+            # eager values sit committed on one device; move them onto
+            # the mesh IN PLACE (value-preserving, keeps tape identity —
+            # the eager-collective placement pattern of collective.py)
+            from jax.sharding import NamedSharding
+
+            def _place(t, spec):
+                if not isinstance(t._value, jax.core.Tracer):
+                    t._value = jax.device_put(t._value,
+                                              NamedSharding(mesh, spec))
+
+            _place(x, P())
+            _place(logits, P())
+            _place(self.w_up, P(axis))
+            _place(self.w_down, P(axis))
+        return apply_op(f"moe_ffn_a2a_{axis}{ep}", _a2a, x, logits,
+                        self.w_up, self.w_down)

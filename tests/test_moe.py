@@ -219,3 +219,142 @@ class TestAuxLossRouting:
                      .mean().numpy())
         aux = float(net.aux_loss.numpy())
         np.testing.assert_allclose(float(loss), base + aux, rtol=1e-5)
+
+
+class TestCapacityDispatch:
+    """GShard capacity-factor sparse dispatch (green-field; matches the
+    GShard top-2 formulation: per-expert capacity C, drop-overflow)."""
+
+    def _twins(self, cf):
+        paddle.seed(9)
+        cap = MoELayer(8, 16, num_experts=8, top_k=2, capacity_factor=cf)
+        dense = MoELayer(8, 16, num_experts=8, top_k=2,
+                         dispatch_mode="dense")
+        dense.set_state_dict(cap.state_dict())
+        return cap, dense
+
+    def test_auto_mode_picks_capacity_at_8_experts(self):
+        cap, dense = self._twins(2.0)
+        assert cap.dispatch_mode == "capacity"
+        assert MoELayer(8, 16, num_experts=4).dispatch_mode == "dense"
+
+    def test_matches_dense_when_nothing_drops(self):
+        cap, dense = self._twins(8.0)  # C >= N: no token can overflow
+        x = np.random.RandomState(0).rand(2, 6, 8).astype(np.float32)
+        o_cap = np.asarray(cap(paddle.to_tensor(x))._value)
+        o_dense = np.asarray(dense(paddle.to_tensor(x))._value)
+        np.testing.assert_allclose(o_cap, o_dense, rtol=1e-4, atol=1e-5)
+
+    def test_tight_capacity_drops_overflow(self):
+        cap, dense = self._twins(0.1)  # C=1: most tokens overflow
+        x = np.random.RandomState(0).rand(2, 6, 8).astype(np.float32)
+        o_t = np.asarray(cap(paddle.to_tensor(x))._value)
+        o_d = np.asarray(dense(paddle.to_tensor(x))._value)
+        assert np.isfinite(o_t).all()
+        assert np.abs(o_t).sum() < np.abs(o_d).sum()
+
+    def test_trains_ep_sharded_and_hlo_has_expert_collective(self):
+        import jax
+        import jax.numpy as jnp
+
+        mesh = topology.build_mesh(dp=2, ep=4)
+        topology.set_global_mesh(mesh)
+        paddle.seed(10)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.moe = MoELayer(8, 16, num_experts=8, top_k=2,
+                                    dispatch_mode="capacity",
+                                    capacity_factor=2.0)
+
+            def forward(self, x):
+                return x + self.moe(x)
+
+        net = Net()
+        opt = optimizer.Adam(5e-3, parameters=net.parameters())
+        step, init = spmd.build_train_step(
+            net, lambda o, t: jnp.mean((o - t) ** 2), opt, mesh=mesh)
+        params, st = init()
+        assert params["moe.w_up"].sharding.spec == spmd.P("ep")
+        x = np.random.RandomState(0).rand(8, 4, 8).astype(np.float32)
+        y = np.random.RandomState(1).rand(8, 4, 8).astype(np.float32)
+        losses = []
+        for _ in range(12):
+            loss, params, st = step(params, st, x, y)
+            losses.append(float(loss))
+        # random targets + residual path: modest but monotone progress
+        assert losses[-1] < losses[0] * 0.85, losses[::4]
+        # the compiled step must move tokens across the ep axis (XLA
+        # picks the shuffle primitive for the einsum formulation)
+        import re
+
+        text = step.jitted.lower(params, st, {}, x, y,
+                                 jax.random.PRNGKey(0),
+                                 5e-3).compile().as_text()
+        colls = re.findall(r"all-to-all|all-reduce|collective-permute|"
+                           r"all-gather|reduce-scatter", text)
+        assert colls, "no cross-partition collective in the MoE step"
+
+    def test_alltoall_mode_parity_and_hlo(self):
+        """Explicit GShard a2a dispatch: parity with dense when nothing
+        drops + literal all-to-all ops in the compiled train step."""
+        import re
+
+        import jax
+        import jax.numpy as jnp
+
+        mesh = topology.build_mesh(dp=1, ep=4,
+                                   devices=jax.devices()[:4])
+        topology.set_global_mesh(mesh)
+        paddle.seed(3)
+        a2a = MoELayer(8, 16, num_experts=8, top_k=2,
+                       dispatch_mode="alltoall", capacity_factor=8.0)
+        dense = MoELayer(8, 16, num_experts=8, top_k=2,
+                         dispatch_mode="dense")
+        dense.set_state_dict(a2a.state_dict())
+        x = np.random.RandomState(0).rand(4, 6, 8).astype(np.float32)
+        o_a = np.asarray(a2a(paddle.to_tensor(x))._value)
+        o_d = np.asarray(dense(paddle.to_tensor(x))._value)
+        np.testing.assert_allclose(o_a, o_d, rtol=1e-4, atol=1e-5)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.moe = a2a
+
+            def forward(self, x):
+                return x + self.moe(x)
+
+        net = Net()
+        opt = optimizer.Adam(5e-3, parameters=net.parameters())
+        step, init = spmd.build_train_step(
+            net, lambda o, t: jnp.mean((o - t) ** 2), opt, mesh=mesh)
+        params, st = init()
+        text = step.jitted.lower(params, st, {}, x, x,
+                                 jax.random.PRNGKey(0),
+                                 5e-3).compile().as_text()
+        assert re.search(r"all-to-all", text), \
+            "a2a mode must compile to literal all-to-all collectives"
+        loss, params, st = step(params, st, x, x)
+        assert np.isfinite(float(loss))
+
+    def test_alltoall_rejects_bad_config(self):
+        import jax
+
+        mesh = topology.build_mesh(dp=1, ep=4,
+                                   devices=jax.devices()[:4])
+        topology.set_global_mesh(mesh)
+        paddle.seed(4)
+        moe = MoELayer(8, 16, num_experts=6, top_k=2,
+                       dispatch_mode="alltoall")
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(4, 2, 8).astype(np.float32))
+        with pytest.raises(ValueError, match="divide"):
+            moe(x)
+        moe8 = MoELayer(8, 16, num_experts=8, top_k=2,
+                        dispatch_mode="alltoall")
+        bad_batch = paddle.to_tensor(
+            np.random.RandomState(0).rand(3, 2, 8).astype(np.float32))
+        with pytest.raises(ValueError, match="divisible"):
+            moe8(bad_batch)
