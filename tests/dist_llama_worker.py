@@ -1,0 +1,74 @@
+"""Model-scale 2-process worker (reference: test_dist_base.py:682 runs
+dist_transformer at model scale across trainer processes): a tiny Llama
+with REAL tensor-parallel shardings trains on a dp=4 x mp=2 mesh that
+SPANS the two processes (4 virtual CPU devices per rank, 8 global).
+Each rank feeds its local half of the fixed global batch; rank 0 writes
+the loss sequence to argv[1] for the 1-proc oracle comparison.
+"""
+import json
+import os
+import sys
+
+# four virtual CPU devices per rank, BEFORE any jax backend touch
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import optimizer  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.distributed import spmd, topology  # noqa: E402
+from paddle_tpu.text.models import LlamaModel  # noqa: E402
+
+
+def main():
+    out_path = sys.argv[1]
+    dist.init_parallel_env()
+    rank, world = dist.get_rank(), dist.get_world_size()
+    assert world == 2 and len(jax.devices()) == 8
+
+    import jax.numpy as jnp
+
+    mesh = topology.build_mesh(dp=4, mp=2)  # spans both processes
+    topology.set_global_mesh(mesh)
+    paddle.seed(21)
+    model = LlamaModel(vocab_size=64, hidden_size=32, num_layers=2,
+                       num_heads=4, intermediate_size=64, num_kv_heads=2,
+                       max_seq_len=32, tensor_parallel=True)
+    opt = optimizer.AdamW(1e-3, parameters=model.parameters())
+
+    def lm_loss(logits, labels):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, labels[..., None],
+                                             axis=-1))
+
+    step, init = spmd.build_train_step(model, lm_loss, opt, mesh=mesh)
+    params, st = init()
+    assert any("mp" in str(a.sharding.spec) for a in params.values())
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 64, (8, 16)).astype(np.int32)
+    lbl = rng.randint(0, 64, (8, 16)).astype(np.int32)
+    half = 8 // world
+    ids_l = ids[rank * half:(rank + 1) * half]
+    lbl_l = lbl[rank * half:(rank + 1) * half]
+    ids_g = spmd.shard_batch(ids_l, mesh)
+    lbl_g = spmd.shard_batch(lbl_l, mesh)
+
+    losses = []
+    for i in range(3):
+        loss, params, st = step(params, st, ids_g, lbl_g,
+                                key=jax.random.PRNGKey(0))
+        losses.append(float(jax.device_get(loss)))
+    if rank == 0:
+        with open(out_path, "w") as f:
+            json.dump(losses, f)
+    print(f"rank {rank} llama losses {losses}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -648,6 +648,53 @@ class TestMultiProcess:
             one_proc.append(float(loss))
         np.testing.assert_allclose(two_proc, one_proc, rtol=2e-5, atol=1e-6)
 
+    def test_2proc_llama_dp_mp_loss_match(self, tmp_path):
+        """Model-scale across processes (reference: test_dist_base.py:682
+        dist_transformer): tiny Llama with real tensor-parallel shardings
+        on a dp=4 x mp=2 mesh spanning 2 processes (4 devices each) must
+        match the single-process run of the same global configuration."""
+        import json
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.distributed import launch_mod
+        from paddle_tpu.text.models import LlamaModel
+
+        out = tmp_path / "llama_losses.json"
+        worker = os.path.join(os.path.dirname(__file__),
+                              "dist_llama_worker.py")
+        launch_mod.launch_collective(worker, [str(out)], nproc_per_node=2,
+                                     log_dir=str(tmp_path / "logs"))
+        two_proc = json.load(open(out))
+
+        mesh = topology.build_mesh(dp=4, mp=2)
+        topology.set_global_mesh(mesh)
+        paddle.seed(21)
+        model = LlamaModel(vocab_size=64, hidden_size=32, num_layers=2,
+                           num_heads=4, intermediate_size=64,
+                           num_kv_heads=2, max_seq_len=32,
+                           tensor_parallel=True)
+        opt = optimizer.AdamW(1e-3, parameters=model.parameters())
+
+        def lm_loss(logits, labels):
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            return -jnp.mean(jnp.take_along_axis(logp, labels[..., None],
+                                                 axis=-1))
+
+        step, init = spmd.build_train_step(model, lm_loss, opt, mesh=mesh)
+        params, st = init()
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 64, (8, 16)).astype(np.int32)
+        lbl = rng.randint(0, 64, (8, 16)).astype(np.int32)
+        ids_g = spmd.shard_batch(ids, mesh)
+        lbl_g = spmd.shard_batch(lbl, mesh)
+        one_proc = []
+        for _ in range(3):
+            loss, params, st = step(params, st, ids_g, lbl_g,
+                                    key=jax.random.PRNGKey(0))
+            one_proc.append(float(loss))
+        np.testing.assert_allclose(two_proc, one_proc, rtol=2e-5,
+                                   atol=1e-6)
+
     def test_2proc_eager_p2p_pipeline(self, tmp_path):
         """Cross-process send/recv (reference: send_v2/recv_v2 ops):
         ping-pong + an eager pipeline microbatch handoff, checked
