@@ -153,3 +153,90 @@ def get_profiler(options=None):
     if _profiler is None:
         _profiler = Profiler(options=options)
     return _profiler
+
+
+# --------------------------------------------------------------------------
+# Device-trace op summary (reference: paddle/fluid/platform/profiler.cc
+# PrintProfiler's per-op table). jax.profiler.start_trace writes a
+# Chrome-trace json under <dir>/plugins/profile/<run>/*.trace.json.gz;
+# on TPU/GPU it contains per-device lanes with one complete ('X') event
+# per executed XLA op. These helpers aggregate that into the
+# reference-style "op, calls, total ms, avg ms, ratio" table — the
+# in-repo replacement for manually opening the trace in TensorBoard.
+
+
+def _find_trace_files(trace_dir):
+    import glob
+    import os as _os
+
+    pats = sorted(glob.glob(_os.path.join(
+        trace_dir, "plugins", "profile", "*", "*.trace.json.gz")),
+        key=_os.path.getmtime)
+    if not pats:
+        pats = sorted(glob.glob(_os.path.join(trace_dir,
+                                              "*.trace.json.gz")),
+                      key=_os.path.getmtime)
+    return pats[-1:] if pats else []
+
+
+def op_summary_from_trace(trace_dir, top=20, device_only=True):
+    """Aggregate the newest trace under ``trace_dir`` into per-op rows.
+
+    Returns a list of dicts (name, calls, total_ms, avg_ms, ratio)
+    sorted by total time descending. ``device_only=True`` restricts to
+    device lanes (process names containing '/device:'); when the trace
+    has none (CPU backend), falls back to every lane.
+    """
+    import gzip
+    import json as _json
+    from collections import defaultdict
+
+    files = _find_trace_files(trace_dir)
+    if not files:
+        raise FileNotFoundError(
+            f"no *.trace.json.gz under {trace_dir!r} — run inside "
+            "jax.profiler.start_trace/stop_trace first")
+    with gzip.open(files[0], "rt") as f:
+        events = _json.load(f).get("traceEvents", [])
+
+    proc_names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            proc_names[e["pid"]] = e.get("args", {}).get("name", "")
+    device_pids = {pid for pid, n in proc_names.items()
+                   if "/device:" in n or n.startswith("TPU")}
+    use_pids = device_pids if (device_only and device_pids) else None
+
+    total = defaultdict(float)
+    calls = defaultdict(int)
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        if use_pids is not None and e.get("pid") not in use_pids:
+            continue
+        name = e.get("name", "?")
+        total[name] += float(e["dur"])          # microseconds
+        calls[name] += 1
+    grand = sum(total.values()) or 1.0
+    rows = [{"name": n, "calls": calls[n],
+             "total_ms": total[n] / 1000.0,
+             "avg_ms": total[n] / calls[n] / 1000.0,
+             "ratio": total[n] / grand}
+            for n in total]
+    rows.sort(key=lambda r: -r["total_ms"])
+    return rows[:top] if top else rows
+
+
+def print_op_summary(trace_dir, top=20, printer=print, device_only=True):
+    """Reference profiler.cc-style table for the newest trace in
+    ``trace_dir``; returns the rows it printed."""
+    rows = op_summary_from_trace(trace_dir, top=top,
+                                 device_only=device_only)
+    width = max([len(r["name"]) for r in rows] + [8])
+    printer(f"{'op':<{width}}  {'calls':>6}  {'total ms':>10}  "
+            f"{'avg ms':>9}  {'ratio':>6}")
+    for r in rows:
+        printer(f"{r['name']:<{width}}  {r['calls']:>6}  "
+                f"{r['total_ms']:>10.3f}  {r['avg_ms']:>9.4f}  "
+                f"{r['ratio']:>6.1%}")
+    return rows

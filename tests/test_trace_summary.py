@@ -1,0 +1,44 @@
+"""Trace -> per-op summary table (reference:
+paddle/fluid/platform/profiler.cc PrintProfiler per-op table; here the
+table is parsed back out of the jax.profiler Chrome-trace capture)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.utils.profiler import (op_summary_from_trace,
+                                       print_op_summary)
+
+
+class TestTraceOpSummary:
+    def test_summarizes_captured_trace(self, tmp_path):
+        @jax.jit
+        def f(x, w):
+            for _ in range(3):
+                x = jnp.tanh(x @ w)
+            return x.sum()
+
+        x = jnp.asarray(np.random.RandomState(0)
+                        .rand(128, 128).astype(np.float32))
+        f(x, x).block_until_ready()
+        jax.profiler.start_trace(str(tmp_path))
+        for _ in range(4):
+            f(x, x).block_until_ready()
+        jax.profiler.stop_trace()
+
+        rows = op_summary_from_trace(str(tmp_path), top=10)
+        assert rows, "no events parsed"
+        assert rows == sorted(rows, key=lambda r: -r["total_ms"])
+        for r in rows:
+            assert r["calls"] >= 1 and r["total_ms"] >= 0
+            assert 0 <= r["ratio"] <= 1
+        printed = []
+        out = print_op_summary(str(tmp_path), top=5,
+                               printer=printed.append)
+        assert len(out) <= 5
+        assert any("total ms" in line for line in printed)
+
+    def test_missing_trace_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="trace.json.gz"):
+            op_summary_from_trace(str(tmp_path))
