@@ -41,9 +41,13 @@ import numpy as np
 
 A100_BERT_BASE_TOKENS_PER_SEC = 345600.0
 A100_RESNET50_IMAGES_PER_SEC = 2900.0
+# FlashAttention-2 paper: ~190 TFLOP/s fwd+bwd bf16 on A100 at seq 4k
+A100_FLASH_ATTN_TFLOPS = 190.0
 MODEL = os.environ.get("BENCH_MODEL", "bert")
-METRIC = ("resnet50_train_images_per_sec_per_chip" if MODEL == "resnet50"
-          else "bert_base_pretrain_tokens_per_sec_per_chip")
+METRIC = {"resnet50": "resnet50_train_images_per_sec_per_chip",
+          "flash": "flash_attention_fwd_bwd_tflops_per_chip"}.get(
+              MODEL, "bert_base_pretrain_tokens_per_sec_per_chip")
+_UNIT = {"resnet50": "images/s", "flash": "TFLOP/s"}.get(MODEL, "tokens/s")
 
 # With BENCH_BATCH unset the bench sweeps batch sizes downward from 256,
 # falling back on OOM (RESOURCE_EXHAUSTED) — 32x128 = 4k tokens/step is
@@ -83,7 +87,7 @@ def _failure_record(msg):
     return {
         "metric": METRIC,
         "value": 0.0,
-        "unit": "images/s" if MODEL == "resnet50" else "tokens/s",
+        "unit": _UNIT,
         "vs_baseline": 0.0,
         "error": msg,
     }
@@ -182,6 +186,15 @@ def init_tpu_patiently():
             time.sleep(min(30.0, max(5.0, remaining / 10.0)))
 
 
+def _print_trace_summary(profile_dir):
+    try:
+        from paddle_tpu.utils.profiler import print_op_summary
+
+        print_op_summary(profile_dir, top=20, printer=log)
+    except Exception as e:  # noqa: BLE001 - summary is best-effort
+        log(f"op summary failed: {e}")
+
+
 def main():
     import jax
 
@@ -208,6 +221,8 @@ def main():
 
     if MODEL == "resnet50":
         return run_resnet50(smoke, platform)
+    if MODEL == "flash":
+        return run_flash(smoke, platform)
 
     import jax.numpy as jnp
     import paddle_tpu as paddle
@@ -305,6 +320,7 @@ def main():
             if profile_dir:
                 jax.profiler.stop_trace()
                 log(f"profiler trace written to {profile_dir}")
+                _print_trace_summary(profile_dir)
         dt = time.time() - t0
         tokens_per_sec = batch * seq * steps / dt
         log(f"{steps} steps in {dt:.2f}s -> {tokens_per_sec:.0f} tokens/s, "
@@ -394,6 +410,7 @@ def run_resnet50(smoke, platform):
         finally:
             if profile_dir:
                 jax.profiler.stop_trace()
+                _print_trace_summary(profile_dir)
         dt = time.time() - t0
         images_per_sec = batch * steps / dt
         log(f"{steps} steps in {dt:.2f}s -> {images_per_sec:.0f} images/s, "
@@ -408,6 +425,62 @@ def run_resnet50(smoke, platform):
         "vs_baseline": round(images_per_sec / A100_RESNET50_IMAGES_PER_SEC,
                              4),
         "batch": batch,
+    }
+    if smoke:
+        rec["smoke"] = True
+    return rec
+
+
+def run_flash(smoke, platform):
+    """Long-context secondary metric (SURVEY §5): single-chip Pallas
+    flash attention fwd+bwd at seq BENCH_SEQ (default 4096), causal,
+    bf16. Reports achieved TFLOP/s; vs_baseline is against the
+    FlashAttention-2 A100 number (~190 TFLOP/s at the same config)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.flash_attention import mha
+
+    if smoke:
+        log("BENCH_CPU=1 smoke mode: tiny config (numbers not meaningful)")
+        b, h, s, d = 2, 2, 256, 32
+    else:
+        b, h, d = 8, 12, 64
+        # default 4096 unless the user explicitly set BENCH_SEQ
+        s = int(os.environ["BENCH_SEQ"]) if "BENCH_SEQ" in os.environ \
+            else 4096
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16)
+
+    def loss(q, k, v):
+        return mha(q, k, v, causal=True).astype(jnp.float32).sum()
+
+    step = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+    log(f"compiling flash fwd+bwd b={b} h={h} s={s} d={d} bf16 "
+        f"platform={platform} ...")
+    t0 = time.time()
+    out = step(q, k, v)
+    jax.block_until_ready(out)
+    log(f"compile+warmup {time.time() - t0:.1f}s")
+    steps = max(1, STEPS)
+    t0 = time.time()
+    for _ in range(steps):
+        out = step(q, k, v)
+    jax.block_until_ready(out)
+    dt = time.time() - t0
+    # standard flash accounting: fwd 4*B*H*S^2*D matmul FLOPs, bwd 2.5x,
+    # causal halves the realized work
+    flops = 3.5 * 4.0 * b * h * s * s * d * 0.5 * steps
+    tflops = flops / dt / 1e12
+    log(f"{steps} steps in {dt:.2f}s -> {tflops:.1f} TFLOP/s")
+    rec = {
+        "metric": METRIC,
+        "value": round(tflops, 2),
+        "unit": "TFLOP/s",
+        "vs_baseline": round(tflops / A100_FLASH_ATTN_TFLOPS, 4),
+        "seq": s,
     }
     if smoke:
         rec["smoke"] = True
