@@ -1,0 +1,104 @@
+"""Heterogeneous PS training: CPU-resident sparse tables + accelerator
+dense compute in ONE compiled step.
+
+Reference: the HeterPS / downpour architecture
+(paddle/fluid/framework/fleet/heter_ps/heter_comm.h, ps_gpu_wrapper.cc,
+distributed/table/common_sparse_table.cc): enormous embedding tables
+live on CPU parameter servers with per-row optimizers; the accelerator
+runs the dense net, pulling embeddings forward and pushing gradients
+back each step.
+
+TPU-native redesign: the pull is a ``jax.pure_callback`` and the push an
+ordered ``io_callback`` inside the SAME jitted train step — XLA's host
+callback machinery replaces the reference's PCIe pull/push streams, and
+the PS table's own per-row optimizer (sgd/adagrad in native/ps_core.cc)
+applies the update, exactly the downpour split: sparse on host, dense on
+device. Works under jit/pjit; eager calls go straight through.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.dispatch import apply_op
+
+__all__ = ["HeterPSEmbedding"]
+
+
+class HeterPSEmbedding(nn.Layer):
+    """Embedding whose table lives in a PS client (host), trained through
+    the PS table's own per-row optimizer.
+
+    client: a ps.LocalPSClient / ps.RpcPSClient / CommunicatorClient
+    holding a sparse table at ``table_idx`` with ``emb_dim`` columns.
+    forward(ids): [*, S] int -> [*, S, emb_dim] float32; gradients are
+    pushed to the PS inside the compiled backward.
+    """
+
+    def __init__(self, client, table_idx, emb_dim, scale_grad=1.0):
+        super().__init__()
+        self.client = client
+        self.table_idx = int(table_idx)
+        self.emb_dim = int(emb_dim)
+        self.scale_grad = float(scale_grad)
+        # Autodiff prunes the vjp of a subgraph no differentiable input
+        # feeds; ids are ints, so WITHOUT this zero-valued trainable
+        # anchor the backward push would be eliminated as dead code and
+        # the PS rows would silently never train.
+        self._anchor = self.create_parameter(
+            [], default_initializer=nn.initializer.Constant(0.0))
+        client_ref = client
+        tid, dim, scale = self.table_idx, self.emb_dim, self.scale_grad
+
+        def _pull_host(ids_np, _anchor_np):
+            ids_flat = np.asarray(ids_np).ravel()
+            vals = np.asarray(client_ref.pull_sparse(tid, ids_flat),
+                              np.float32)
+            return vals.reshape(tuple(np.asarray(ids_np).shape) + (dim,))
+
+        def _push_host(ids_np, grad_np):
+            ids_flat = np.asarray(ids_np).ravel()
+            g = np.asarray(grad_np, np.float32).reshape(len(ids_flat), dim)
+            client_ref.push_sparse(tid, ids_flat, g * scale)
+
+        # side-effecting callbacks cannot carry a replicated sharding
+        # under the SPMD partitioner — pin the push to one device (the
+        # host talks to the PS once per step, like the reference's
+        # rank-0 push stream)
+        from jax.sharding import SingleDeviceSharding
+
+        cb_sharding = SingleDeviceSharding(jax.devices()[0])
+
+        @jax.custom_vjp
+        def _ps_embed(ids, anchor):
+            # pure_callback keeps the SPMD partitioner happy (an ordered
+            # io_callback's token trips its replicated-sharding check);
+            # freshness is protected by threading ``anchor`` — a
+            # trainable carry value — through the callback OPERANDS, so
+            # XLA cannot hoist the pull out of a scanned train loop as
+            # loop-invariant. CSE within one step is harmless: the PS
+            # only mutates in the backward push.
+            shape = tuple(ids.shape) + (dim,)
+            e = jax.pure_callback(
+                _pull_host, jax.ShapeDtypeStruct(shape, jnp.float32),
+                ids, anchor)
+            return e + anchor.astype(e.dtype) * 0.0
+
+        def _fwd(ids, anchor):
+            return _ps_embed(ids, anchor), ids
+
+        def _bwd(ids, g):
+            # ordered: the push must not be elided or reordered past the
+            # next step's pull (the reference's push stream sync)
+            jax.experimental.io_callback(_push_host, None, ids, g,
+                                         ordered=True,
+                                         sharding=cb_sharding)
+            return (jnp.zeros(ids.shape, jax.dtypes.float0),
+                    jnp.zeros((), jnp.float32))
+
+        _ps_embed.defvjp(_fwd, _bwd)
+        self._ps_embed = _ps_embed
+
+    def forward(self, ids):
+        return apply_op(f"heter_ps_embed_t{self.table_idx}",
+                        self._ps_embed, ids, self._anchor)

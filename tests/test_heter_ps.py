@@ -1,0 +1,102 @@
+"""Heterogeneous PS training (reference: framework/fleet/heter_ps/,
+ps_gpu_wrapper.cc): CPU-resident sparse tables + compiled dense step.
+The pull is a pure_callback and the grad push an ordered io_callback
+inside the SAME jitted train step."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import ps, spmd, topology
+from paddle_tpu.incubate.heter_ps import HeterPSEmbedding
+
+
+def _client(emb_dim=4, lr=0.5):
+    return ps.LocalPSClient([ps.TableConfig("e", True, emb_dim=emb_dim,
+                                            optimizer="sgd", lr=lr)])
+
+
+class TestHeterPSEmbedding:
+    def test_eager_lookup_matches_ps(self):
+        c = _client()
+        emb = HeterPSEmbedding(c, 0, 4)
+        ids = np.array([[3, 9]], np.int64)
+        out = np.asarray(emb(paddle.to_tensor(ids))._value)
+        want = np.asarray(c.pull_sparse(0, ids.ravel())).reshape(1, 2, 4)
+        np.testing.assert_allclose(out, want, atol=1e-6)
+        c.close()
+
+    def test_jit_grad_pushes_to_ps_table(self):
+        """Inside jax.grad+jit, the backward io_callback must land the
+        gradient on the PS table (its own sgd applies the update)."""
+        c = _client(lr=1.0)
+        emb = HeterPSEmbedding(c, 0, 4)
+        ids = jnp.asarray(np.array([5, 7], np.int64))
+        before = np.asarray(c.pull_sparse(0, np.array([5, 7]))).copy()
+
+        def loss(anchor, ids):
+            return jnp.sum(emb._ps_embed(ids, anchor))
+
+        g = jax.jit(jax.grad(loss))(jnp.float32(0.0), ids)
+        jax.block_until_ready(g)
+        jax.effects_barrier()
+        after = np.asarray(c.pull_sparse(0, np.array([5, 7])))
+        # dL/de = 1 everywhere, table sgd lr=1 -> rows drop by exactly 1
+        np.testing.assert_allclose(after, before - 1.0, atol=1e-5)
+        c.close()
+
+    def test_compiled_train_step_cpu_sparse_device_dense(self):
+        """The full heterogeneous split: dense tower trained by the jax
+        optimizer on 'device', embedding rows trained by the PS-side
+        per-row optimizer — one compiled step, loss converges, and only
+        touched rows move."""
+        mesh = topology.build_mesh(dp=1)
+        topology.set_global_mesh(mesh)
+        paddle.seed(0)
+        c = _client(emb_dim=8, lr=0.3)
+
+        class Model(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = HeterPSEmbedding(c, 0, 8)
+                self.fc = nn.Linear(16, 1)
+
+            def forward(self, ids):
+                e = self.emb(ids)  # [B, 2, 8]
+                from paddle_tpu import tensor as pt
+
+                return self.fc(pt.reshape(e, [ids.shape[0], 16]))
+
+        m = Model()
+        opt = optimizer.Adam(5e-2, parameters=m.parameters())
+
+        def loss_fn(out, y):
+            return jnp.mean((out[:, 0] - y) ** 2)
+
+        step, init = spmd.build_train_step(m, loss_fn, opt, mesh=mesh)
+        params, st = init()
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 50, (8, 2)).astype(np.int64)
+        y = (rng.rand(8) > 0.5).astype(np.float32)
+        untouched_before = np.asarray(
+            c.pull_sparse(0, np.array([999]))).copy()
+        touched_before = np.asarray(
+            c.pull_sparse(0, ids.ravel())).copy()
+        losses = []
+        for _ in range(25):
+            loss, params, st = step(params, st, ids, y)
+            losses.append(float(loss))
+        jax.effects_barrier()
+        assert losses[-1] < losses[0] * 0.5, losses[::8]
+        # the PS-side rows actually trained (guards the dead-code-prune
+        # failure mode the anchor parameter exists for) ...
+        assert not np.allclose(np.asarray(c.pull_sparse(0, ids.ravel())),
+                               touched_before, atol=1e-5)
+        # ... while rows for unseen ids kept their init values
+        np.testing.assert_allclose(
+            np.asarray(c.pull_sparse(0, np.array([999]))),
+            untouched_before, atol=1e-6)
+        c.close()
