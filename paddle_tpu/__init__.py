@@ -152,5 +152,5 @@ def get_cuda_rng_state():
     return get_rng_state()
 
 
-def set_cuda_rng_state(state):
-    set_rng_state(state)
+def set_cuda_rng_state(state_list):
+    set_rng_state(state_list)

@@ -94,7 +94,8 @@ class CUDAPinnedPlace(CPUPlace):
 
 
 class XPUPlace(TPUPlace):
-    pass
+    def __init__(self, dev_id=0):
+        super().__init__(dev_id)
 
 
 class NPUPlace(TPUPlace):

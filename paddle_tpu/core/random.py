@@ -56,10 +56,10 @@ _GLOBAL_GENERATOR = Generator(0)
 _RNG_SCOPE = contextvars.ContextVar("rng_scope", default=None)
 
 
-def seed(s):
+def seed(seed):
     """paddle.seed — reseed the global generator."""
-    flags.set_flags({"seed": int(s)})
-    _GLOBAL_GENERATOR.manual_seed(int(s))
+    flags.set_flags({"seed": int(seed)})
+    _GLOBAL_GENERATOR.manual_seed(int(seed))
     return _GLOBAL_GENERATOR
 
 

@@ -36,7 +36,15 @@ sigmoid = _unary("sigmoid", lambda x: jax.nn.sigmoid(x))
 tanh = _unary("tanh", lambda x: jnp.tanh(x))
 silu = _unary("silu", lambda x: jax.nn.silu(x))
 swish = silu
-mish = _unary("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+def mish(x, threshold=20.0, name=None):
+    """reference: fluid/layers/nn.py mish — softplus switches to the
+    identity above ``threshold`` for numerical stability."""
+
+    def _mish(x, *, threshold):
+        sp = jnp.where(x > threshold, x, jax.nn.softplus(x))
+        return x * jnp.tanh(sp)
+
+    return apply_op("mish", _mish, x, threshold=float(threshold))
 tanhshrink = _unary("tanhshrink", lambda x: x - jnp.tanh(x))
 softsign = _unary("softsign", lambda x: jax.nn.soft_sign(x))
 log_sigmoid = _unary("log_sigmoid", lambda x: jax.nn.log_sigmoid(x))
@@ -408,7 +416,8 @@ def _resolve_output_padding(x, weight, output_size, output_padding, stride,
 
 
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
-                     groups=1, dilation=1, data_format="NCHW", output_size=None, name=None):
+                     dilation=1, groups=1, output_size=None,
+                     data_format="NCHW", name=None):
     """reference: operators/conv_transpose_op.cc. groups>1 unsupported for now."""
     stride_, pad_, dil_ = _pair(stride), _norm_padding(padding, 2), _pair(dilation)
     op_ = _resolve_output_padding(x, weight, output_size, output_padding,
@@ -420,7 +429,8 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
 
 
 def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
-                     groups=1, dilation=1, data_format="NCL", output_size=None, name=None):
+                     groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
     stride_, pad_, dil_ = (_pair(stride, 1), _norm_padding(padding, 1),
                            _pair(dilation, 1))
     op_ = _resolve_output_padding(x, weight, output_size, output_padding,
@@ -478,8 +488,8 @@ def _pool_nd(x, *, ksize, stride, padding, mode, ceil_mode, data_format, nd,
     return summed / float(np.prod(ksize))
 
 
-def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
-               return_mask=False, data_format="NCHW", name=None):
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
     ksize = _pair(kernel_size)
     stride = ksize if stride is None else _pair(stride)
     pad = _norm_padding(padding, 2)
@@ -1090,10 +1100,10 @@ def glu(x, axis=-1, name=None):
     return apply_op("glu", _glu, x, axis=int(axis))
 
 
-def pad(x, pad_width, mode="constant", value=0.0, data_format="NCHW", name=None):
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
     from ..tensor.manipulation import pad as _pad
 
-    return _pad(x, pad_width, mode, value, data_format)
+    return _pad(x, pad, mode, value, data_format)
 
 
 def unstack(x, axis=0, num=None):
@@ -1128,8 +1138,8 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
 # ride the generic _pool_nd reduce_window path)
 
 
-def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
-               return_mask=False, data_format="NCDHW", name=None):
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
     if return_mask:
         raise NotImplementedError("return_mask=True not yet supported")
     ksize = _pair(kernel_size, 3)
@@ -1212,7 +1222,7 @@ def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
 
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1,
-                     data_format="NCDHW", output_size=None, name=None):
+                     output_size=None, data_format="NCDHW", name=None):
     """reference: operators/conv_transpose_op.cc (3-D)."""
     stride_, pad_, dil_ = (_pair(stride, 3), _norm_padding(padding, 3),
                            _pair(dilation, 3))

@@ -196,7 +196,7 @@ class PixelShuffle(Layer):
 
 
 class Unfold(Layer):
-    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def __init__(self, kernel_sizes, dilations=1, paddings=0, strides=1, name=None):
         super().__init__()
         self.args = (kernel_sizes, strides, paddings, dilations)
 

@@ -87,14 +87,16 @@ class Conv1DTranspose(_ConvNd):
 
     def forward(self, x, output_size=None):
         return F.conv1d_transpose(x, self.weight, self.bias, self._stride,
-                                  self._padding, self._output_padding, self._groups,
-                                  self._dilation, self._data_format,
+                                  self._padding, self._output_padding,
+                                  groups=self._groups,
+                                  dilation=self._dilation,
+                                  data_format=self._data_format,
                                   output_size=output_size)
 
 
 class Conv2DTranspose(_ConvNd):
     def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
-                 output_padding=0, groups=1, dilation=1, weight_attr=None,
+                 output_padding=0, dilation=1, groups=1, weight_attr=None,
                  bias_attr=None, data_format="NCHW"):
         super().__init__(in_channels, out_channels, kernel_size, stride, padding,
                          dilation, groups, "zeros", weight_attr, bias_attr,
@@ -103,14 +105,16 @@ class Conv2DTranspose(_ConvNd):
 
     def forward(self, x, output_size=None):
         return F.conv2d_transpose(x, self.weight, self.bias, self._stride,
-                                  self._padding, self._output_padding, self._groups,
-                                  self._dilation, self._data_format,
+                                  self._padding, self._output_padding,
+                                  groups=self._groups,
+                                  dilation=self._dilation,
+                                  data_format=self._data_format,
                                   output_size=output_size)
 
 
 class Conv3DTranspose(_ConvNd):
     def __init__(self, in_channels, out_channels, kernel_size, stride=1,
-                 padding=0, output_padding=0, groups=1, dilation=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
                  weight_attr=None, bias_attr=None, data_format="NCDHW"):
         super().__init__(in_channels, out_channels, kernel_size, stride,
                          padding, dilation, groups, "zeros", weight_attr,
@@ -120,5 +124,7 @@ class Conv3DTranspose(_ConvNd):
     def forward(self, x, output_size=None):
         return F.conv3d_transpose(x, self.weight, self.bias, self._stride,
                                   self._padding, self._output_padding,
-                                  self._groups, self._dilation,
-                                  self._data_format, output_size=output_size)
+                                  groups=self._groups,
+                                  dilation=self._dilation,
+                                  data_format=self._data_format,
+                                  output_size=output_size)

@@ -32,14 +32,17 @@ class _BatchNormBase(Layer):
 class BatchNorm(_BatchNormBase):
     """fluid-style BatchNorm(num_channels) (reference: fluid/dygraph/nn.py BatchNorm)."""
 
-    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-5,
-                 param_attr=None, bias_attr=None, dtype="float32",
-                 data_layout="NCHW", in_place=False, moving_mean_name=None,
-                 moving_variance_name=None, do_model_average_for_mean_and_var=True,
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 dtype="float32", data_layout="NCHW", in_place=False,
+                 moving_mean_name=None, moving_variance_name=None,
+                 do_model_average_for_mean_and_var=True,
                  use_global_stats=False, trainable_statistics=False):
         super().__init__(num_channels, momentum, epsilon, param_attr, bias_attr,
                          data_layout, use_global_stats)
         self._act = act
+        if is_test:
+            self.eval()
 
     def forward(self, x):
         y = super().forward(x)

@@ -31,7 +31,8 @@ class MaxPool2D(Layer):
 
     def forward(self, x):
         k, s, p, cm, rm, df = self.args
-        return F.max_pool2d(x, k, s, p, cm, rm, df)
+        return F.max_pool2d(x, k, s, p, ceil_mode=cm, return_mask=rm,
+                            data_format=df)
 
 
 class AvgPool2D(Layer):
@@ -82,7 +83,8 @@ class MaxPool3D(Layer):
 
     def forward(self, x):
         k, s, p, cm, rm, df = self.args
-        return F.max_pool3d(x, k, s, p, cm, rm, df)
+        return F.max_pool3d(x, k, s, p, ceil_mode=cm, return_mask=rm,
+                            data_format=df)
 
 
 class AvgPool3D(Layer):

@@ -217,9 +217,9 @@ class Momentum(Optimizer):
     lars_momentum_op.cc via use_lars)."""
 
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
-                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None,
-                 use_lars=False, lars_coeff=0.001, lars_weight_decay=0.0005,
-                 multi_precision=False, rescale_grad=1.0):
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, rescale_grad=1.0, name=None,
+                 use_lars=False, lars_coeff=0.001, lars_weight_decay=0.0005):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._momentum = momentum
         self._use_nesterov = use_nesterov

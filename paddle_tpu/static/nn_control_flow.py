@@ -60,7 +60,7 @@ def cond(pred, true_fn=None, false_fn=None, name=None):
         [true_fn, false_fn])
 
 
-def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None,
+def while_loop(cond, body, loop_vars, is_test=False, name=None,
                maximum_iterations=None):
     """reference: layers.while_loop — body returns the next loop_vars list;
     shapes/dtypes must be loop-invariant (while_op contract).
@@ -72,6 +72,7 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None,
     from ..jit.dy2static import convert_while
 
     vals = tuple(loop_vars)
+    cond_fn, body_fn = cond, body
     body = lambda *vs: tuple(body_fn(*vs))  # noqa: E731
     out = convert_while(lambda *vs: cond_fn(*vs), body, vals,
                         maximum_iterations=maximum_iterations)

@@ -308,7 +308,8 @@ def data(name, shape, dtype="float32", lod_level=0):
     return dummy
 
 
-def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None):
+def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None,
+                    checkpoints=None):
     """Marks loss for the functional grad pass (reference: backward.py:1009)."""
     prog = _RECORDER.get() or default_main_program()
     prog._backward_loss = loss

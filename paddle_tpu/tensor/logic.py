@@ -21,16 +21,36 @@ greater_than = _binary("greater_than", lambda x, y: jnp.greater(x, y))
 greater_equal = _binary("greater_equal", lambda x, y: jnp.greater_equal(x, y))
 less_than = _binary("less_than", lambda x, y: jnp.less(x, y))
 less_equal = _binary("less_equal", lambda x, y: jnp.less_equal(x, y))
-logical_and = _binary("logical_and", lambda x, y: jnp.logical_and(x, y))
-logical_or = _binary("logical_or", lambda x, y: jnp.logical_or(x, y))
-logical_xor = _binary("logical_xor", lambda x, y: jnp.logical_xor(x, y))
+_logical_and = _binary("logical_and", lambda x, y: jnp.logical_and(x, y))
+_logical_or = _binary("logical_or", lambda x, y: jnp.logical_or(x, y))
+_logical_xor = _binary("logical_xor", lambda x, y: jnp.logical_xor(x, y))
+
+
+def _with_out(result, out):
+    if out is not None:
+        out._value = result._value
+        return out
+    return result
+
+
+def logical_and(x, y, out=None, name=None):
+    return _with_out(_logical_and(x, y), out)
+
+
+def logical_or(x, y, out=None, name=None):
+    return _with_out(_logical_or(x, y), out)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _with_out(_logical_xor(x, y), out)
 bitwise_and = _binary("bitwise_and", lambda x, y: jnp.bitwise_and(x, y))
 bitwise_or = _binary("bitwise_or", lambda x, y: jnp.bitwise_or(x, y))
 bitwise_xor = _binary("bitwise_xor", lambda x, y: jnp.bitwise_xor(x, y))
 
 
-def logical_not(x, name=None):
-    return apply_op("logical_not", lambda x: jnp.logical_not(x), x)
+def logical_not(x, out=None, name=None):
+    return _with_out(
+        apply_op("logical_not", lambda x: jnp.logical_not(x), x), out)
 
 
 def bitwise_not(x, name=None):

@@ -213,7 +213,16 @@ def _reduction(op_name, fn):
 mean = _reduction("mean", lambda x, *, axis, keepdim: jnp.mean(x, axis=axis, keepdims=keepdim))
 max = _reduction("max", lambda x, *, axis, keepdim: jnp.max(x, axis=axis, keepdims=keepdim))
 min = _reduction("min", lambda x, *, axis, keepdim: jnp.min(x, axis=axis, keepdims=keepdim))
-prod = _reduction("prod", lambda x, *, axis, keepdim: jnp.prod(x, axis=axis, keepdims=keepdim))
+_prod_impl = _reduction("prod", lambda x, *, axis, keepdim: jnp.prod(x, axis=axis, keepdims=keepdim))
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    """reference: tensor/math.py prod — optional accumulate dtype."""
+    if dtype is not None:
+        from .manipulation import cast
+
+        x = cast(x, dtype)
+    return _prod_impl(x, axis=axis, keepdim=keepdim)
 amax = max
 amin = min
 all = _reduction("all", lambda x, *, axis, keepdim: jnp.all(x, axis=axis, keepdims=keepdim))
@@ -289,7 +298,10 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
     return apply_op("matmul", _matmul, x, y, tx=bool(transpose_x), ty=bool(transpose_y))
 
 
-mm = matmul
+def mm(input, mat2, name=None):
+    """reference: tensor/math.py mm(input, mat2) — matmul alias with the
+    reference's parameter names."""
+    return matmul(input, mat2)
 
 
 def dot(x, y, name=None):
@@ -303,8 +315,8 @@ def bmm(x, y, name=None):
     return apply_op("bmm", lambda x, y: jnp.matmul(x, y), x, y)
 
 
-def t(x, name=None):
-    return apply_op("t", lambda x: x.T, x)
+def t(input, name=None):
+    return apply_op("t", lambda x: x.T, input)
 
 
 def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):

@@ -45,7 +45,14 @@ def normal(mean=0.0, std=1.0, shape=None, name=None):
         random_core.next_key(), shape=shape, dtype=dtype, mean=float(mean), std=float(std))
 
 
-gaussian = normal
+def gaussian(shape, mean=0.0, std=1.0, dtype=None, name=None):
+    """reference: tensor/random.py gaussian(shape, mean, std, dtype)."""
+    out = normal(mean, std, shape)
+    if dtype is not None:
+        from .manipulation import cast
+
+        out = cast(out, dtype)
+    return out
 
 
 def randn(shape, dtype=None, name=None):

@@ -12,8 +12,7 @@ from paddle_tpu import nn, static
 _REF = "/root/reference"
 
 
-@pytest.mark.skipif(not os.path.isdir(_REF), reason="reference not mounted")
-def test_zero_missing_exports():
+def _parity_mod():
     import importlib.util
 
     spec = importlib.util.spec_from_file_location(
@@ -21,8 +20,22 @@ def test_zero_missing_exports():
                                    "tools", "api_parity.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    failures = mod.check(_REF, verbose=False)
+    return mod
+
+
+@pytest.mark.skipif(not os.path.isdir(_REF), reason="reference not mounted")
+def test_zero_missing_exports():
+    failures = _parity_mod().check(_REF, verbose=False)
     assert not failures, failures
+
+
+@pytest.mark.skipif(not os.path.isdir(_REF), reason="reference not mounted")
+def test_zero_signature_mismatches():
+    """Signature-level parity (the API.spec analog): callable parameter
+    names/order must match the reference defs, modulo the documented
+    waivers in tools/api_parity.py."""
+    mismatches = _parity_mod().check_signatures(_REF, verbose=False)
+    assert not mismatches, mismatches
 
 
 class TestSerializationFamily:

@@ -112,15 +112,192 @@ def check(ref_root, verbose=True):
     return failures
 
 
+# --------------------------------------------------------------------------
+# Signature-level parity (reference: paddle/fluid/API.spec — the CI gate
+# that pinned every public signature; rebuilt as an ast-vs-inspect diff).
+
+# divergences that are deliberate TPU-native design, with the reason
+_SIG_WAIVED = {
+    # dtype-carrying ops: the reference threads VarType enums; here dtype
+    # strings/jnp dtypes with the same spelling but different defaults
+    # expressed via None-sentinels
+    "to_tensor",       # reference: (data, dtype, place, stop_gradient);
+                       # place is a no-op on TPU (kept, default differs)
+    "save", "load",    # reference adds **configs kwargs soup
+    "DataLoader",      # many GPU-pinning knobs are N/A (kept as **kwargs)
+    "grad",            # double-grad API: extra create_graph knobs order
+    # name collisions: the ast map keys by bare name, and these public
+    # names shadow a DIFFERENT reference callable
+    "cond",            # ours = tensor.linalg.cond (condition number);
+                       # the fluid control-flow cond lives in static.nn
+    "normal", "uniform",  # nn.initializer lowercase aliases of the
+                          # Normal/Uniform initializer classes collide
+                          # with tensor.random.normal/uniform defs
+    "round",           # tensor round(x); ref match is compat.py round
+    "decorate",        # paddle.amp.decorate (2.1 API, models/optimizers)
+                       # collides with fluid.contrib mixed_precision
+    "scaled_dot_product_attention",  # modern flash sdpa; the ref match
+                                     # is the unrelated fluid.nets helper
+    "group_norm",      # modern functional (x, num_groups, weight, bias);
+                       # ref only has the fluid layers builder form
+    "Variable",        # static compat shim over Tensor; the reference
+                       # ctor is framework-internal (block/type/...)
+}
+
+# namespaces whose callables we hold to signature parity; "" = paddle.*
+_SIG_NAMESPACES = ("", "nn", "nn.functional", "optimizer", "io",
+                   "static", "metric", "amp", "vision.transforms",
+                   "nn.initializer")
+
+
+def build_reference_defs(ref_root):
+    """ast-walk python/paddle, mapping name -> [(module, params)] where
+    params is [(name, default_repr_or_None), ...] for functions and for
+    classes the __init__ params (sans self)."""
+    import ast
+
+    defs = {}
+    base = os.path.join(ref_root, "python", "paddle")
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("tests", "__pycache__")]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, base)
+            try:
+                tree = ast.parse(open(path, encoding="utf8").read())
+            except SyntaxError:
+                continue
+
+            def params_of(fndef, drop_self=False):
+                a = fndef.args
+                names = [x.arg for x in a.args]
+                if drop_self and names and names[0] in ("self", "cls"):
+                    names = names[1:]
+                defaults = [None] * (len(names) - len(a.defaults)) + [
+                    ast.dump(d) for d in a.defaults[-len(names):]] \
+                    if a.defaults else [None] * len(names)
+                return list(zip(names, defaults))
+
+            for node in tree.body:
+                if isinstance(node, ast.FunctionDef) and \
+                        not node.name.startswith("_"):
+                    defs.setdefault(node.name, []).append(
+                        (rel, params_of(node)))
+                elif isinstance(node, ast.ClassDef) and \
+                        not node.name.startswith("_"):
+                    init = next((n for n in node.body
+                                 if isinstance(n, ast.FunctionDef)
+                                 and n.name == "__init__"), None)
+                    if init is not None:
+                        defs.setdefault(node.name, []).append(
+                            (rel, params_of(init, drop_self=True)))
+    return defs
+
+
+def _our_params(obj):
+    import inspect
+
+    try:
+        target = obj.__init__ if inspect.isclass(obj) else obj
+        sig = inspect.signature(target)
+    except (ValueError, TypeError):
+        return None
+    out = []
+    for p in sig.parameters.values():
+        if p.name in ("self", "cls"):
+            continue
+        if p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
+            out.append((p.name, "*"))
+        else:
+            out.append((p.name, None if p.default is p.empty
+                        else repr(p.default)))
+    return out
+
+
+def _sig_compatible(ref_params, ours):
+    """Ours is compatible when every reference parameter name exists
+    here and the shared positional prefix keeps the reference order
+    (extra trailing/defaulted params are fine; *args/**kwargs absorb
+    the rest)."""
+    if any(d == "*" for _, d in ours):
+        return True  # *args/**kwargs absorbs reference surface
+    our_names = [n for n, _ in ours]
+    ref_names = [n for n, _ in ref_params]
+    missing = [n for n in ref_names if n not in our_names
+               and n != "name"]  # `name=` is a no-op paddle convention
+    if missing:
+        return False
+    # order: reference names must appear in the same relative order
+    idx = [our_names.index(n) for n in ref_names if n in our_names]
+    return idx == sorted(idx)
+
+
+def check_signatures(ref_root, verbose=True):
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import inspect
+
+    import paddle_tpu as paddle
+
+    ref_defs = build_reference_defs(ref_root)
+    mismatches = {}
+    checked = 0
+    for ns in _SIG_NAMESPACES:
+        obj = paddle if not ns else importlib.import_module(
+            f"paddle_tpu.{ns}")
+        names = getattr(obj, "__all__", None) or [
+            n for n in dir(obj) if not n.startswith("_")]
+        for nm in sorted(set(names)):
+            if nm in _SIG_WAIVED or nm not in ref_defs:
+                continue
+            ours_obj = getattr(obj, nm, None)
+            if ours_obj is None or not callable(ours_obj):
+                continue
+            if inspect.ismodule(ours_obj):
+                continue
+            ours = _our_params(ours_obj)
+            if ours is None:
+                continue
+            checked += 1
+            # multiple reference defs with one name: pass if ANY matches
+            # (era-specific duplicates across fluid/2.0 namespaces)
+            cands = ref_defs[nm]
+            if any(_sig_compatible(rp, ours) for _, rp in cands):
+                continue
+            best_mod, best_params = cands[0]
+            mismatches[f"{ns or 'paddle'}.{nm}"] = {
+                "reference": [n for n, _ in best_params],
+                "ours": [n for n, _ in ours],
+                "ref_module": best_mod,
+            }
+    if verbose:
+        print(f"signature parity: {checked} callables checked, "
+              f"{len(mismatches)} mismatched")
+        for k, v in sorted(mismatches.items()):
+            print(f"  {k}: ref{v['reference']} != ours{v['ours']} "
+                  f"({v['ref_module']})")
+    return mismatches
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--reference", default="/root/reference")
     ap.add_argument("--json", action="store_true")
+    ap.add_argument("--signatures", action="store_true",
+                    help="also run the signature-level comparison")
     args = ap.parse_args()
     failures = check(args.reference, verbose=not args.json)
+    sig_fail = {}
+    if args.signatures:
+        sig_fail = check_signatures(args.reference,
+                                    verbose=not args.json)
     if args.json:
-        print(json.dumps(failures))
-    sys.exit(1 if failures else 0)
+        print(json.dumps({"missing": failures,
+                          "signatures": sig_fail}))
+    sys.exit(1 if (failures or sig_fail) else 0)
 
 
 if __name__ == "__main__":
