@@ -533,59 +533,26 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
 
 
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
-    out_hw = _pair(output_size)
-
-    def _aap(x, *, out_hw, chan_first):
-        h_ax, w_ax = (2, 3) if chan_first else (1, 2)
-        ih, iw = x.shape[h_ax], x.shape[w_ax]
-        oh, ow = out_hw
-        if ih % oh == 0 and iw % ow == 0:
-            kh, kw = ih // oh, iw // ow
-            window = [1, 1, 1, 1]
-            window[h_ax], window[w_ax] = kh, kw
-            y = jax.lax.reduce_window(x, 0.0, jax.lax.add, tuple(window), tuple(window),
-                                      "VALID")
-            return y / (kh * kw)
-        # general path: mean over computed bins (static shapes)
-        hs = [(i * ih) // oh for i in range(oh)] + [ih]
-        ws = [(i * iw) // ow for i in range(ow)] + [iw]
-        rows = []
-        for i in range(oh):
-            cols = []
-            for j in range(ow):
-                sl = [slice(None)] * x.ndim
-                sl[h_ax] = slice(hs[i], hs[i + 1])
-                sl[w_ax] = slice(ws[j], ws[j + 1])
-                cols.append(jnp.mean(x[tuple(sl)], axis=(h_ax, w_ax), keepdims=True))
-            rows.append(jnp.concatenate(cols, axis=w_ax))
-        return jnp.concatenate(rows, axis=h_ax)
-
-    return apply_op("adaptive_avg_pool2d", _aap, x, out_hw=out_hw,
-                    chan_first=data_format == "NCHW")
+    axes = (2, 3) if data_format == "NCHW" else (1, 2)
+    return apply_op("adaptive_avg_pool2d", _adaptive_pool_nd, x,
+                    out_sizes=_pair(output_size), spatial_axes=axes,
+                    mode="avg")
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
     out_hw = _pair(output_size)
 
     def _amp(x, *, out_hw):
-        ih, iw = x.shape[2], x.shape[3]
-        oh, ow = out_hw
-        kh, kw = ih // oh, iw // ow
-        return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 1, kh, kw),
-                                     (1, 1, kh, kw), "VALID")
+        return _adaptive_pool_nd(x, out_sizes=out_hw, spatial_axes=(2, 3),
+                                 mode="max")
 
     return apply_op("adaptive_max_pool2d", _amp, x, out_hw=out_hw)
 
 
 def adaptive_avg_pool1d(x, output_size, name=None):
-    def _aap1(x, *, out):
-        il = x.shape[2]
-        k = il // out
-        y = jax.lax.reduce_window(x, 0.0, jax.lax.add, (1, 1, k), (1, 1, k), "VALID")
-        return y / k
-
-    return apply_op("adaptive_avg_pool1d", _aap1, x,
-                    out=_pair(output_size, 1)[0])
+    return apply_op("adaptive_avg_pool1d", _adaptive_pool_nd, x,
+                    out_sizes=_pair(output_size, 1), spatial_axes=(2,),
+                    mode="avg")
 
 
 # ------------------------------------------------------------- norms
@@ -729,7 +696,9 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW
         window[1] = size
         s = jax.lax.reduce_window(sq, 0.0, jax.lax.add, tuple(window), (1,) * x.ndim,
                                   "VALID")
-        return x / jnp.power(k + alpha * s, beta)
+        # reference (nn/functional/norm.py local_response_norm) runs the
+        # squared sum through avg_pool: the divisor is the window SIZE
+        return x / jnp.power(k + alpha * s / size, beta)
 
     return apply_op("lrn", _lrn, x, size=int(size), alpha=float(alpha),
                     beta=float(beta), k=float(k))
@@ -964,9 +933,47 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=F
         else:
             n, h, w, c = x.shape
             img = x
-        method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
-                  "area": "linear"}[mode]
-        out = jax.image.resize(img, (n, size[0], size[1], c), method=method)
+        oh, ow = size
+
+        def src_pos(o, i_sz):
+            pos = jnp.arange(o, dtype=jnp.float32)
+            if align_corners:
+                # out==1: reference uses ratio 0 -> sample index 0
+                return pos * (float(i_sz - 1) / float(o - 1)) if o > 1 \
+                    else jnp.zeros((1,), jnp.float32)
+            return jnp.clip((pos + 0.5) * (i_sz / o) - 0.5, 0.0,
+                            float(i_sz - 1))
+
+        if mode == "bilinear":
+            # exact half-pixel / align-corners sampling (reference:
+            # interpolate_v2 bilinear kernel; jax.image.resize's
+            # antialiased kernel diverges on downscale)
+            si = src_pos(oh, h)
+            sj = src_pos(ow, w)
+            i0 = jnp.floor(si).astype(jnp.int32)
+            j0 = jnp.floor(sj).astype(jnp.int32)
+            i1 = jnp.minimum(i0 + 1, h - 1)
+            j1 = jnp.minimum(j0 + 1, w - 1)
+            wi = (si - i0)[None, :, None, None]
+            wj = (sj - j0)[None, None, :, None]
+            top = jnp.take(img, i0, axis=1)
+            bot = jnp.take(img, i1, axis=1)
+            tl, tr = jnp.take(top, j0, axis=2), jnp.take(top, j1, axis=2)
+            bl, br = jnp.take(bot, j0, axis=2), jnp.take(bot, j1, axis=2)
+            out = ((1 - wi) * ((1 - wj) * tl + wj * tr)
+                   + wi * ((1 - wj) * bl + wj * br))
+        elif mode == "nearest":
+            if align_corners:
+                i_idx = jnp.round(src_pos(oh, h)).astype(jnp.int32)
+                j_idx = jnp.round(src_pos(ow, w)).astype(jnp.int32)
+            else:
+                # floor(i * in/out): the reference/torch nearest rule
+                i_idx = jnp.floor(jnp.arange(oh) * (h / oh)).astype(jnp.int32)
+                j_idx = jnp.floor(jnp.arange(ow) * (w / ow)).astype(jnp.int32)
+            out = jnp.take(jnp.take(img, i_idx, axis=1), j_idx, axis=2)
+        else:  # bicubic / area via XLA resize
+            method = {"bicubic": "cubic", "area": "linear"}[mode]
+            out = jax.image.resize(img, (n, oh, ow, c), method=method)
         if chan_first:
             out = jnp.transpose(out, (0, 3, 1, 2))
         return out.astype(x.dtype)
@@ -1180,8 +1187,9 @@ def _adaptive_pool_nd(x, *, out_sizes, spatial_axes, mode):
         return y / float(np.prod([window[a] for a in spatial_axes]))
 
     def bins(i, o):
-        edges = [(k * i) // o for k in range(o)] + [i]
-        return list(zip(edges[:-1], edges[1:]))
+        # start = floor(k*i/o), end = CEIL((k+1)*i/o): bins may overlap
+        # (reference adaptive pool kernel / AdaptiveStartIndex-EndIndex)
+        return [((k * i) // o, -((-(k + 1) * i) // o)) for k in range(o)]
 
     def rec(axis_idx, slices):
         if axis_idx == len(spatial_axes):

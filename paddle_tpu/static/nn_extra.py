@@ -50,8 +50,9 @@ def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
 
     _check_nchw(kw, "conv2d_transpose")
     layer = Conv2DTranspose(input.shape[1], num_filters, filter_size,
-                            stride, padding, output_padding, groups,
-                            dilation, weight_attr=param_attr,
+                            stride, padding, output_padding,
+                            dilation=dilation, groups=groups,
+                            weight_attr=param_attr,
                             bias_attr=bias_attr)
     return _apply_act(layer(input), kw)
 
@@ -74,8 +75,9 @@ def conv3d_transpose(input, num_filters, filter_size, stride=1, padding=0,
 
     _check_nchw(kw, "conv3d_transpose")
     layer = Conv3DTranspose(input.shape[1], num_filters, filter_size,
-                            stride, padding, output_padding, groups,
-                            dilation, weight_attr=param_attr,
+                            stride, padding, output_padding,
+                            dilation=dilation, groups=groups,
+                            weight_attr=param_attr,
                             bias_attr=bias_attr)
     return _apply_act(layer(input), kw)
 

@@ -1,0 +1,261 @@
+"""Per-op FORWARD numeric parity against torch-CPU oracles.
+
+The reference's op semantics (paddle/fluid/operators/*) agree with
+torch for this table of ops; comparing against torch pins our jax
+implementations to the same numerics without copying any reference
+code. Complements the OpTest gradient sweep (test_op_grad.py), which
+checks d(out)/d(in) but not cross-framework value agreement.
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as TF  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+from paddle_tpu.nn import functional as F  # noqa: E402
+
+R = np.random.RandomState
+
+
+def a(shape, seed=0, lo=-1.0, hi=1.0):
+    return (R(seed).rand(*shape) * (hi - lo) + lo).astype(np.float32)
+
+
+def t(x):
+    return paddle.to_tensor(x)
+
+
+def tt(x):
+    return torch.tensor(x)
+
+
+def run(pfn, tfn, rtol=1e-5, atol=1e-5):
+    got = pfn()
+    want = tfn()
+    got = np.asarray(got._value if hasattr(got, "_value") else got)
+    want = want.detach().numpy()
+    np.testing.assert_allclose(got, want, rtol=rtol, atol=atol)
+
+
+X22 = a((2, 3, 8, 8))
+X13 = a((2, 3, 16))
+X3D = a((2, 3, 4, 6, 6))
+W2 = a((5, 3, 3, 3), 1)
+W1 = a((5, 3, 3), 1)
+W3 = a((5, 3, 2, 3, 3), 1)
+WG = a((6, 1, 3, 3), 1)  # depthwise groups=3? 6 out, 3 groups -> 2 per
+V = a((4, 7), 2)
+
+
+CASES = [
+    # ---- convolutions: stride/pad/dilation/groups
+    ("conv2d_basic",
+     lambda: F.conv2d(t(X22), t(W2), t(a((5,), 3)), stride=2, padding=1),
+     lambda: TF.conv2d(tt(X22), tt(W2), tt(a((5,), 3)), stride=2,
+                       padding=1), 1e-4, 1e-5),
+    ("conv2d_dilated",
+     lambda: F.conv2d(t(X22), t(W2), None, dilation=2, padding=2),
+     lambda: TF.conv2d(tt(X22), tt(W2), None, dilation=2, padding=2),
+     1e-4, 1e-5),
+    ("conv2d_groups",
+     lambda: F.conv2d(t(X22), t(a((6, 1, 3, 3), 1)), None, groups=3,
+                      padding=1),
+     lambda: TF.conv2d(tt(X22), tt(a((6, 1, 3, 3), 1)), None, groups=3,
+                       padding=1), 1e-4, 1e-5),
+    ("conv1d",
+     lambda: F.conv1d(t(X13), t(W1), None, stride=2, padding=1),
+     lambda: TF.conv1d(tt(X13), tt(W1), None, stride=2, padding=1),
+     1e-4, 1e-5),
+    ("conv3d",
+     lambda: F.conv3d(t(X3D), t(W3), None, padding=1),
+     lambda: TF.conv3d(tt(X3D), tt(W3), None, padding=1), 1e-4, 2e-5),
+    ("conv2d_transpose",
+     lambda: F.conv2d_transpose(t(X22), t(a((3, 5, 3, 3), 1)), None,
+                                stride=2, padding=1, output_padding=1),
+     lambda: TF.conv_transpose2d(tt(X22), tt(a((3, 5, 3, 3), 1)), None,
+                                 stride=2, padding=1, output_padding=1),
+     1e-4, 1e-5),
+    # ---- pooling: ceil_mode / exclusive-pad semantics
+    ("max_pool2d_ceil",
+     lambda: F.max_pool2d(t(a((1, 2, 7, 7))), 3, 2, 1, ceil_mode=True),
+     lambda: TF.max_pool2d(tt(a((1, 2, 7, 7))), 3, 2, 1, ceil_mode=True)),
+    ("avg_pool2d_pad_exclusive",
+     lambda: F.avg_pool2d(t(X22), 3, 2, 1, exclusive=True),
+     lambda: TF.avg_pool2d(tt(X22), 3, 2, 1, count_include_pad=False)),
+    ("avg_pool2d_pad_inclusive",
+     lambda: F.avg_pool2d(t(X22), 3, 2, 1, exclusive=False),
+     lambda: TF.avg_pool2d(tt(X22), 3, 2, 1, count_include_pad=True)),
+    ("adaptive_avg_pool2d",
+     lambda: F.adaptive_avg_pool2d(t(X22), [3, 5]),
+     lambda: TF.adaptive_avg_pool2d(tt(X22), (3, 5))),
+    ("adaptive_max_pool2d_nondiv",
+     lambda: F.adaptive_max_pool2d(t(X22), [3, 5]),
+     lambda: TF.adaptive_max_pool2d(tt(X22), (3, 5))),
+    ("adaptive_avg_pool1d_nondiv",
+     lambda: F.adaptive_avg_pool1d(t(X13), 5),
+     lambda: TF.adaptive_avg_pool1d(tt(X13), 5)),
+    # ---- normalization
+    ("layer_norm",
+     lambda: F.layer_norm(t(V), (7,), t(a((7,), 5)), t(a((7,), 6))),
+     lambda: TF.layer_norm(tt(V), (7,), tt(a((7,), 5)), tt(a((7,), 6)))),
+    ("batch_norm_eval",
+     lambda: F.batch_norm(t(X22), t(a((3,), 1, 0, 1)),
+                          t(a((3,), 2, 0.5, 2.0)), t(a((3,), 3)),
+                          t(a((3,), 4)), training=False),
+     lambda: TF.batch_norm(tt(X22), tt(a((3,), 1, 0, 1)),
+                           tt(a((3,), 2, 0.5, 2.0)), tt(a((3,), 3)),
+                           tt(a((3,), 4)), training=False)),
+    ("group_norm",
+     lambda: F.group_norm(t(a((2, 6, 4, 4))), 3, weight=t(a((6,), 5)),
+                          bias=t(a((6,), 6))),
+     lambda: TF.group_norm(tt(a((2, 6, 4, 4))), 3, tt(a((6,), 5)),
+                           tt(a((6,), 6)))),
+    ("instance_norm",
+     lambda: F.instance_norm(t(X22), weight=t(a((3,), 5)),
+                             bias=t(a((3,), 6))),
+     lambda: TF.instance_norm(tt(X22), weight=tt(a((3,), 5)),
+                              bias=tt(a((3,), 6)))),
+    ("local_response_norm",
+     lambda: F.local_response_norm(t(X22), 5, alpha=1e-3, beta=0.75, k=1.0),
+     lambda: TF.local_response_norm(tt(X22), 5, alpha=1e-3, beta=0.75,
+                                    k=1.0), 1e-4, 1e-5),
+    # ---- activations
+    ("gelu_exact", lambda: F.gelu(t(V)),
+     lambda: TF.gelu(tt(V))),
+    ("gelu_tanh", lambda: F.gelu(t(V), approximate=True),
+     lambda: TF.gelu(tt(V), approximate="tanh")),
+    ("elu", lambda: F.elu(t(V), alpha=0.7),
+     lambda: TF.elu(tt(V), alpha=0.7)),
+    ("selu", lambda: F.selu(t(V)), lambda: TF.selu(tt(V))),
+    ("hardswish", lambda: F.hardswish(t(3 * V)),
+     lambda: TF.hardswish(tt(3 * V))),
+    ("hardsigmoid", lambda: F.hardsigmoid(t(3 * V)),
+     lambda: TF.hardsigmoid(tt(3 * V))),
+    ("softplus", lambda: F.softplus(t(V), beta=2.0, threshold=15.0),
+     lambda: TF.softplus(tt(V), beta=2.0, threshold=15.0)),
+    ("mish", lambda: F.mish(t(V)), lambda: TF.mish(tt(V))),
+    ("log_sigmoid", lambda: F.log_sigmoid(t(V)),
+     lambda: TF.logsigmoid(tt(V))),
+    ("leaky_relu", lambda: F.leaky_relu(t(V), 0.13),
+     lambda: TF.leaky_relu(tt(V), 0.13)),
+    ("prelu", lambda: F.prelu(t(X22), t(a((3,), 7, 0.1, 0.4))),
+     lambda: TF.prelu(tt(X22), tt(a((3,), 7, 0.1, 0.4)))),
+    ("softmax", lambda: F.softmax(t(V), axis=-1),
+     lambda: TF.softmax(tt(V), dim=-1)),
+    ("log_softmax", lambda: F.log_softmax(t(V), axis=0),
+     lambda: TF.log_softmax(tt(V), dim=0)),
+    # ---- losses
+    ("cross_entropy_weight_ignore",
+     lambda: F.cross_entropy(
+         t(a((6, 5))), t(np.array([0, 1, 4, -100, 2, 3], np.int64)),
+         weight=t(a((5,), 8, 0.5, 1.5)), ignore_index=-100),
+     lambda: TF.cross_entropy(
+         tt(a((6, 5))), tt(np.array([0, 1, 4, -100, 2, 3])),
+         weight=tt(a((5,), 8, 0.5, 1.5)), ignore_index=-100)),
+    ("nll_loss",
+     lambda: F.nll_loss(F.log_softmax(t(a((6, 5))), axis=-1),
+                        t(np.array([0, 1, 4, 3, 2, 3], np.int64))),
+     lambda: TF.nll_loss(TF.log_softmax(tt(a((6, 5))), dim=-1),
+                         tt(np.array([0, 1, 4, 3, 2, 3])))),
+    ("bce_with_logits",
+     lambda: F.binary_cross_entropy_with_logits(
+         t(V), t(a((4, 7), 9, 0.0, 1.0))),
+     lambda: TF.binary_cross_entropy_with_logits(
+         tt(V), tt(a((4, 7), 9, 0.0, 1.0)))),
+    ("kl_div",
+     lambda: F.kl_div(F.log_softmax(t(V), axis=-1),
+                      F.softmax(t(a((4, 7), 10)), axis=-1),
+                      reduction="batchmean"),
+     lambda: TF.kl_div(TF.log_softmax(tt(V), dim=-1),
+                       TF.softmax(tt(a((4, 7), 10)), dim=-1),
+                       reduction="batchmean")),
+    ("smooth_l1",
+     lambda: F.smooth_l1_loss(t(V), t(a((4, 7), 11))),
+     lambda: TF.smooth_l1_loss(tt(V), tt(a((4, 7), 11)))),
+    ("margin_ranking",
+     lambda: F.margin_ranking_loss(t(a((5,))), t(a((5,), 1)),
+                                   t(np.sign(a((5,), 2)).astype(np.float32)),
+                                   margin=0.3),
+     lambda: TF.margin_ranking_loss(tt(a((5,))), tt(a((5,), 1)),
+                                    tt(np.sign(a((5,), 2)).astype(np.float32)),
+                                    margin=0.3)),
+    # ---- resampling / shaping
+    ("interp_bilinear_align_false",
+     lambda: F.interpolate(t(X22), size=[13, 5], mode="bilinear",
+                           align_corners=False),
+     lambda: TF.interpolate(tt(X22), size=(13, 5), mode="bilinear",
+                            align_corners=False), 1e-4, 1e-5),
+    ("interp_bilinear_align_true",
+     lambda: F.interpolate(t(X22), size=[13, 5], mode="bilinear",
+                           align_corners=True),
+     lambda: TF.interpolate(tt(X22), size=(13, 5), mode="bilinear",
+                            align_corners=True), 1e-4, 1e-5),
+    ("interp_nearest",
+     lambda: F.interpolate(t(X22), scale_factor=2, mode="nearest"),
+     lambda: TF.interpolate(tt(X22), scale_factor=2, mode="nearest")),
+    ("pad_reflect",
+     lambda: F.pad(t(X22), [1, 2, 2, 1], mode="reflect"),
+     lambda: TF.pad(tt(X22), (1, 2, 2, 1), mode="reflect")),
+    ("pad_replicate",
+     lambda: F.pad(t(X22), [1, 2, 2, 1], mode="replicate"),
+     lambda: TF.pad(tt(X22), (1, 2, 2, 1), mode="replicate")),
+    ("pixel_shuffle",
+     lambda: F.pixel_shuffle(t(a((2, 8, 3, 3))), 2),
+     lambda: TF.pixel_shuffle(tt(a((2, 8, 3, 3))), 2)),
+    ("unfold",
+     lambda: F.unfold(t(X22), 3, strides=2, paddings=1),
+     lambda: TF.unfold(tt(X22), 3, stride=2, padding=1)),
+    ("grid_sample",
+     lambda: F.grid_sample(t(X22), t(a((2, 5, 5, 2), 12)),
+                           align_corners=True),
+     lambda: TF.grid_sample(tt(X22), tt(a((2, 5, 5, 2), 12)),
+                            align_corners=True), 1e-4, 1e-5),
+    # ---- linalg / tensor
+    ("matmul_bcast",
+     lambda: paddle.matmul(t(a((2, 1, 4, 5))), t(a((3, 5, 6), 1))),
+     lambda: torch.matmul(tt(a((2, 1, 4, 5))), tt(a((3, 5, 6), 1))),
+     1e-4, 1e-5),
+    ("addmm",
+     lambda: paddle.addmm(t(a((4, 6))), t(a((4, 5), 1)), t(a((5, 6), 2)),
+                          beta=0.7, alpha=1.3),
+     lambda: torch.addmm(tt(a((4, 6))), tt(a((4, 5), 1)), tt(a((5, 6), 2)),
+                         beta=0.7, alpha=1.3), 1e-4, 1e-5),
+    ("cumsum", lambda: paddle.cumsum(t(V), axis=1),
+     lambda: torch.cumsum(tt(V), dim=1)),
+    ("cumprod", lambda: paddle.cumprod(t(V), dim=1),
+     lambda: torch.cumprod(tt(V), dim=1)),
+    ("logsumexp", lambda: paddle.logsumexp(t(V), axis=1),
+     lambda: torch.logsumexp(tt(V), dim=1)),
+    ("norm_fro", lambda: paddle.linalg.norm(t(V)),
+     lambda: torch.linalg.norm(tt(V))),
+    ("lerp", lambda: paddle.lerp(t(V), t(a((4, 7), 1)), 0.3),
+     lambda: torch.lerp(tt(V), tt(a((4, 7), 1)), 0.3)),
+    ("clip", lambda: paddle.clip(t(V), -0.3, 0.6),
+     lambda: torch.clamp(tt(V), -0.3, 0.6)),
+    ("diff", lambda: paddle.diff(t(V), axis=1),
+     lambda: torch.diff(tt(V), dim=1)),
+    ("kron", lambda: paddle.kron(t(a((2, 3))), t(a((3, 2), 1))),
+     lambda: torch.kron(tt(a((2, 3))), tt(a((3, 2), 1)))),
+    ("trace", lambda: paddle.trace(t(a((5, 5)))),
+     lambda: torch.trace(tt(a((5, 5))))),
+    # paddle's lookup_table_v2 zeroes the OUTPUT rows at padding_idx;
+    # torch returns the stored row, so the oracle stores a zero row
+    ("embedding_padding_idx",
+     lambda: F.embedding(t(np.array([[0, 2, 1], [1, 0, 2]], np.int64)),
+                         t(a((4, 6), 13)), padding_idx=1),
+     lambda: TF.embedding(
+         tt(np.array([[0, 2, 1], [1, 0, 2]])),
+         tt(np.where(np.arange(4)[:, None] == 1, 0.0,
+                     a((4, 6), 13)).astype(np.float32)),
+         padding_idx=1)),
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=[c[0] for c in CASES])
+def test_torch_forward_parity(case):
+    name, pfn, tfn = case[0], case[1], case[2]
+    rtol = case[3] if len(case) > 3 else 1e-5
+    atol = case[4] if len(case) > 4 else 1e-5
+    run(pfn, tfn, rtol=rtol, atol=atol)
