@@ -540,13 +540,11 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
-    out_hw = _pair(output_size)
-
-    def _amp(x, *, out_hw):
-        return _adaptive_pool_nd(x, out_sizes=out_hw, spatial_axes=(2, 3),
-                                 mode="max")
-
-    return apply_op("adaptive_max_pool2d", _amp, x, out_hw=out_hw)
+    if return_mask:
+        raise NotImplementedError("return_mask=True not yet supported")
+    return apply_op("adaptive_max_pool2d", _adaptive_pool_nd, x,
+                    out_sizes=_pair(output_size), spatial_axes=(2, 3),
+                    mode="max")
 
 
 def adaptive_avg_pool1d(x, output_size, name=None):
@@ -964,12 +962,15 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=F
                    + wi * ((1 - wj) * bl + wj * br))
         elif mode == "nearest":
             if align_corners:
-                i_idx = jnp.round(src_pos(oh, h)).astype(jnp.int32)
-                j_idx = jnp.round(src_pos(ow, w)).astype(jnp.int32)
+                # reference rounds half UP (int(ratio*i + 0.5)), not
+                # banker's-round
+                i_idx = jnp.floor(src_pos(oh, h) + 0.5).astype(jnp.int32)
+                j_idx = jnp.floor(src_pos(ow, w) + 0.5).astype(jnp.int32)
             else:
-                # floor(i * in/out): the reference/torch nearest rule
-                i_idx = jnp.floor(jnp.arange(oh) * (h / oh)).astype(jnp.int32)
-                j_idx = jnp.floor(jnp.arange(ow) * (w / ow)).astype(jnp.int32)
+                # floor(i * in/out) in INTEGER arithmetic: float32
+                # h/oh can land just below an exact boundary
+                i_idx = (jnp.arange(oh, dtype=jnp.int32) * h) // oh
+                j_idx = (jnp.arange(ow, dtype=jnp.int32) * w) // ow
             out = jnp.take(jnp.take(img, i_idx, axis=1), j_idx, axis=2)
         else:  # bicubic / area via XLA resize
             method = {"bicubic": "cubic", "area": "linear"}[mode]

@@ -13,7 +13,6 @@ torch = pytest.importorskip("torch")
 import torch.nn.functional as TF  # noqa: E402
 
 import paddle_tpu as paddle  # noqa: E402
-from paddle_tpu import nn  # noqa: E402
 from paddle_tpu.nn import functional as F  # noqa: E402
 
 R = np.random.RandomState
@@ -45,7 +44,6 @@ X3D = a((2, 3, 4, 6, 6))
 W2 = a((5, 3, 3, 3), 1)
 W1 = a((5, 3, 3), 1)
 W3 = a((5, 3, 2, 3, 3), 1)
-WG = a((6, 1, 3, 3), 1)  # depthwise groups=3? 6 out, 3 groups -> 2 per
 V = a((4, 7), 2)
 
 
