@@ -40,3 +40,111 @@ class TestCollectiveStructure:
         others = re.findall(r"all-gather|reduce-scatter|all-to-all|"
                             r"collective-permute", text)
         assert not others, others
+
+
+class TestMetaOptimizerHLOInspection:
+    """The reference's fleet meta-optimizer tests assert on inserted op
+    types after a program rewrite (fleet_meta_optimizer_base.py); the
+    TPU-native analog inspects the compiled HLO for the structures each
+    strategy must produce."""
+
+    def _lower(self, mesh, **kw):
+        paddle.seed(1)
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                            nn.Linear(32, 16))
+        opt = optimizer.AdamW(1e-3, parameters=net.parameters())
+        step_fn, init_fn = spmd.build_train_step(
+            net, lambda o, t: jnp.mean((o - t) ** 2), opt, mesh=mesh,
+            **kw)
+        params, st = init_fn()
+        x = np.zeros((16, 16), np.float32)
+        return step_fn.jitted.lower(
+            params, st, {}, x, x, jax.random.PRNGKey(0),
+            1e-3).compile().as_text()
+
+    def test_amp_o1_puts_bf16_on_the_matmuls(self):
+        mesh = topology.build_mesh(dp=8)
+        topology.set_global_mesh(mesh)
+        text = self._lower(mesh, amp_level="O1")
+        # forward/backward dots must run in bf16 (the MXU dtype); fp32
+        # master weights mean converts surround them
+        assert re.search(r"bf16\[[^\]]*\][^\n]*dot", text), \
+            "no bf16 dot in the amp O1 step"
+
+    def test_zero2_shards_grads_and_opt_state(self):
+        """ZeRO-2: the compiled step's gradient reduction and optimizer
+        state must be sharded over dp. On TPU the grad psum lowers to
+        reduce-scatter; the CPU backend decomposes it, so the invariant
+        checked here is the compiled OUTPUT shardings (opt state must
+        not be replicated) — the sharding that forces that lowering."""
+        mesh = topology.build_mesh(dp=8)
+        topology.set_global_mesh(mesh)
+        paddle.seed(1)
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                            nn.Linear(32, 16))
+        opt = optimizer.AdamW(1e-3, parameters=net.parameters())
+        step_fn, init_fn = spmd.build_train_step(
+            net, lambda o, t: jnp.mean((o - t) ** 2), opt, mesh=mesh,
+            sharding_stage=2)
+        params, st = init_fn()
+        # moment buffers sharded 1/8 at rest
+        m0 = next(iter(st.values()))[0]
+        assert "dp" in str(m0.sharding.spec) or \
+            "sharding" in str(m0.sharding.spec), m0.sharding
+        assert m0.addressable_shards[0].data.size * 8 == m0.size
+        # and a step keeps them sharded (no silent re-replication)
+        x = np.zeros((16, 16), np.float32)
+        loss, params, st = step_fn(params, st, x, x)
+        m1 = next(iter(st.values()))[0]
+        assert m1.addressable_shards[0].data.size * 8 == m1.size
+
+    def test_pipeline_emits_collective_permute(self):
+        from paddle_tpu.distributed import pipeline as pipe
+
+        mesh = topology.build_mesh(dp=4, pp=2)
+        topology.set_global_mesh(mesh)
+        paddle.seed(2)
+
+        class Block(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 8)
+
+            def forward(self, x):
+                return paddle.tanh(self.fc(x))
+
+        pre = [nn.Linear(4, 8)]
+        blocks = [Block() for _ in range(2)]
+        post = [nn.Linear(8, 4)]
+        opt = optimizer.SGD(0.1, parameters=[
+            p for l in pre + blocks + post for p in l.parameters()])
+        pstep, pinit = pipe.build_pipeline_train_step(
+            pre, blocks, post, lambda o, y: jnp.mean((o - y) ** 2), opt,
+            mesh=mesh, num_micro=2)
+        pparams, pstate = pinit()
+        x = np.zeros((8, 4), np.float32)
+        y = np.zeros((8, 4), np.float32)
+        text = pstep.jitted.lower(pparams, pstate, x, y,
+                                  jax.random.PRNGKey(0),
+                                  jnp.asarray(0.1)).compile().as_text()
+        assert "collective-permute" in text, \
+            "pipeline microbatch handoff must ride ppermute"
+
+
+class TestMeshDeviceLayout:
+    def test_dp_axis_is_outermost_contiguous(self):
+        """PERF.md's 8->256 scaling bound assumes the dp axis can be
+        laid out within one ICI pod: build_mesh must assign each dp
+        index a CONTIGUOUS block of devices (outermost axis), so a
+        dp-ring allreduce never interleaves across pod boundaries when
+        the device list is ordered by pod."""
+        mesh = topology.build_mesh(dp=4, mp=2)
+        devs = np.asarray(mesh.devices)
+        assert devs.shape[0] == 4  # dp is the leading mesh dim
+        flat_ids = [d.id for d in devs.reshape(4, -1).ravel()]
+        assert flat_ids == sorted(flat_ids), \
+            "device ids must stay in order: dp blocks = contiguous ids"
+        # every dp row holds a contiguous id range
+        for row in devs.reshape(4, -1):
+            ids = [d.id for d in row.ravel()]
+            assert ids == list(range(min(ids), max(ids) + 1))
