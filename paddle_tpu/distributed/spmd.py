@@ -392,7 +392,7 @@ def shard_batch(batch, mesh=None, axis=None):
     mesh = mesh or topology.get_global_mesh()
     arr = batch._value if isinstance(batch, Tensor) else jnp.asarray(np.asarray(batch))
     if axis is None:
-        axes = tuple(ax for ax in ("dp", "sharding") if mesh.shape.get(ax, 1) > 1)
+        axes = topology.data_axes(mesh)
         spec = P(axes) if axes else P()
     else:
         spec = P(axis)

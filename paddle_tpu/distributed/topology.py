@@ -33,6 +33,13 @@ def build_mesh(dp=1, mp=1, pp=1, sharding=1, sp=1, ep=1, devices=None):
     return Mesh(arr, AXIS_ORDER)
 
 
+def data_axes(mesh):
+    """The mesh axes that shard the batch dimension (shard_batch and
+    every consumer of its layout must agree on this set)."""
+    return tuple(ax for ax in ("dp", "sharding")
+                 if mesh.shape.get(ax, 1) > 1)
+
+
 def set_global_mesh(mesh):
     global _GLOBAL_MESH
     _GLOBAL_MESH = mesh

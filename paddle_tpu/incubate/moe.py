@@ -19,9 +19,9 @@ TPU-native design (like ring attention). Expert FFN weights are stacked
   token->expert shuffle as collectives over ep (the all_to_all of the
   GShard paper) while the FFN einsums stay local per expert shard.
 
-- ``alltoall``: the literal GShard layout under ``jax.shard_map`` over
-  'ep' — tokens batch-sharded over ep, each shard routes its LOCAL
-  tokens into [E, C, H] capacity buffers, ``lax.all_to_all`` swaps the
+- ``alltoall``: the literal GShard layout under ``jax.shard_map`` —
+  tokens batch-sharded over the data axes x ep (GShard's groups), each
+  shard routes its LOCAL tokens into [E, C, H] capacity buffers, ``lax.all_to_all`` swaps the
   expert dim across shards (each shard then holds its own E/ep experts'
   tokens from every shard), the FFN runs on local expert weights only,
   and a second all_to_all routes results back. Guaranteed all-to-all on
@@ -204,13 +204,23 @@ class MoELayer(nn.Layer):
         if e % ep:
             raise ValueError(f"num_experts={e} must divide over "
                              f"{axis}={ep} for all_to_all dispatch")
+        # tokens stay sharded over the data axes TOO (GShard groups =
+        # product of data axes x ep; the a2a rides only the ep sub-axis)
+        # — no per-step data->ep resharding. Shares shard_batch's axis
+        # derivation so the incoming batch layout always matches.
+        from ..distributed.topology import data_axes as _data_axes
+
+        tok_axes = tuple(ax for ax in _data_axes(mesh)
+                         if ax != axis) + (axis,)
+        groups = int(np.prod([mesh.shape[ax] for ax in tok_axes]))
         b = int(x.shape[0])
-        if b % ep:
-            raise ValueError(f"batch {b} must be divisible by "
-                             f"{axis}={ep} (tokens are batch-sharded)")
+        if b % groups:
+            raise ValueError(f"batch {b} must be divisible by the token "
+                             f"shard count {groups} (axes {tok_axes})")
 
         def local_fn(x, logits, w_up, w_down):
-            # x: [B/ep, S, H]; w_up/w_down: [E/ep, ...] (local experts)
+            # x: [B/groups, S, H] (groups = data axes x ep shards);
+            # w_up/w_down: [E/ep, ...] (local experts)
             b_loc, s, hdim = x.shape
             n = b_loc * s
             cap = max(1, int(np.ceil(cf * top_k * n / e)))
@@ -230,11 +240,11 @@ class MoELayer(nn.Layer):
             y = jax.lax.all_to_all(y, axis, split_axis=1, concat_axis=0,
                                    tiled=True)              # [E, C, H]
             out = jnp.einsum("nec,ech->nh", combine.astype(y.dtype), y)
-            aux = jax.lax.pmean(_gshard_aux(probs, topi), axis)
+            aux = jax.lax.pmean(_gshard_aux(probs, topi), tok_axes)
             return out.reshape(b_loc, s, hdim), aux.astype(x.dtype)
 
         def _a2a(x, logits, w_up, w_down):
-            tok = P(axis, None, None)
+            tok = P(tok_axes, None, None)
             wsp = P(axis, None, None)
             fn = jax.shard_map(local_fn, mesh=mesh,
                                in_specs=(tok, tok, wsp, wsp),
@@ -259,5 +269,7 @@ class MoELayer(nn.Layer):
             _place(logits, P())
             _place(self.w_up, P(axis))
             _place(self.w_down, P(axis))
-        return apply_op(f"moe_ffn_a2a_{axis}{ep}", _a2a, x, logits,
-                        self.w_up, self.w_down)
+        # cache key must discriminate everything the closure captures:
+        # the mesh's token-shard group count changes _a2a's semantics
+        return apply_op(f"moe_ffn_a2a_{axis}{ep}_g{groups}_m{id(mesh)}",
+                        _a2a, x, logits, self.w_up, self.w_down)
