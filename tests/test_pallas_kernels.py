@@ -173,3 +173,32 @@ def test_flash_bfloat16():
     assert o.dtype == jnp.bfloat16
     np.testing.assert_allclose(np.asarray(o, np.float32), np.asarray(r),
                                rtol=5e-2, atol=5e-2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_multiblock_long_seq(causal):
+    """S=512 = 4 q-blocks x 4 k-blocks of 128: the multi-block
+    accumulation path (online softmax across k blocks, dq/dkv loops)
+    that the seq-4k flash bench runs — the tests above stay within one
+    block and would miss cross-block bugs."""
+    rng = np.random.RandomState(7)
+    B, H, S, D = 1, 1, 512, 16
+    q = jnp.array(rng.randn(B, H, S, D) * 0.3, jnp.float32)
+    k = jnp.array(rng.randn(B, H, S, D) * 0.3, jnp.float32)
+    v = jnp.array(rng.randn(B, H, S, D), jnp.float32)
+    out = fa.mha(q, k, v, causal=causal)
+    ref = _ref(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss_fa(q, k, v):
+        return jnp.sum(jnp.sin(fa.mha(q, k, v, causal=causal)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(_ref(q, k, v, causal)))
+
+    g_fa = jax.grad(loss_fa, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_fa, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-4)
