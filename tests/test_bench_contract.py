@@ -1,0 +1,58 @@
+"""bench.py contract tests: the driver parses EXACTLY ONE json line
+from stdout, within its own command timeout. Round 3 was lost to a
+bench that blew the budget without printing (rc=124, parsed: null) —
+these tests pin the guarantees that prevent a repeat."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(env_extra, timeout):
+    env = dict(os.environ)
+    env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, env=env, timeout=timeout,
+        cwd=REPO)
+
+
+def _one_json_line(stdout):
+    lines = [l for l in stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected exactly one line, got {lines}"
+    return json.loads(lines[0])
+
+
+class TestBenchContract:
+    def test_cpu_smoke_emits_one_json_line(self):
+        r = _run({"BENCH_CPU": "1", "BENCH_STEPS": "1",
+                  "BENCH_WARMUP": "1"}, timeout=420)
+        assert r.returncode == 0, r.stderr[-500:]
+        rec = _one_json_line(r.stdout)
+        assert rec["metric"] == "bert_base_pretrain_tokens_per_sec_per_chip"
+        assert rec["value"] > 0 and rec["smoke"] is True
+        assert set(rec) >= {"metric", "value", "unit", "vs_baseline"}
+
+    def test_deadline_always_produces_failure_json(self):
+        """With no TPU and a tiny deadline the bench must still print
+        the one failure record and exit non-zero WITHIN the deadline —
+        never a silent rc-124."""
+        r = _run({"JAX_PLATFORMS": "cpu", "BENCH_DEADLINE": "25"},
+                 timeout=90)
+        assert r.returncode != 0
+        rec = _one_json_line(r.stdout)
+        assert rec["value"] == 0.0 and "error" in rec
+        assert rec["metric"] == "bert_base_pretrain_tokens_per_sec_per_chip"
+
+    def test_flash_mode_metric_fields(self):
+        r = _run({"BENCH_CPU": "1", "BENCH_STEPS": "1",
+                  "BENCH_WARMUP": "1", "BENCH_MODEL": "flash"},
+                 timeout=420)
+        assert r.returncode == 0, r.stderr[-500:]
+        rec = _one_json_line(r.stdout)
+        assert rec["metric"] == "flash_attention_fwd_bwd_tflops_per_chip"
+        assert rec["unit"] == "TFLOP/s"
