@@ -119,6 +119,19 @@ def fn_key(name, fn):
             getattr(fn, "__qualname__", repr(fn)))
 
 
+def evict_ops(name):
+    """Drop cached jits whose op name equals ``name`` (exact match — a
+    prefix match would collide across uids, e.g. _u2 vs _u20).
+
+    For ops keyed with a per-instance uid (state-capturing closures like
+    HeterPSEmbedding): the owner calls this on teardown so the cached
+    jit does not pin its captured state (PS client, tables) forever."""
+    dead = [k for k in _FWD_CACHE
+            if isinstance(k[0], tuple) and k[0][0] == name]
+    for k in dead:
+        del _FWD_CACHE[k]
+
+
 def jitted(fn, kwargs, name=None):
     """Cached jax.jit of fn with static kwargs closed over."""
     key = (fn_key(name, fn) if name is not None else fn, hashable(kwargs))

@@ -275,17 +275,9 @@ def get_rank_in(group=None):
                   "pp": "get_stage_id"}.get(axis)
         if getter and hasattr(hcg, getter):
             return getattr(hcg, getter)()
-    # mesh axis stride arithmetic: divide out the axes inner to `axis`
-    # before the modulo (a bare modulo is only right for the innermost axis)
-    mesh = topology.get_global_mesh()
-    inner = 1
-    seen = False
-    for name in mesh.axis_names:
-        if seen:
-            inner *= mesh.shape.get(name, 1)
-        if name == axis:
-            seen = True
-    return (jax.process_index() // inner) % mesh.shape.get(axis, 1)
+    # derived from mesh device ownership — stride arithmetic on the
+    # process index is wrong whenever a process hosts >1 device
+    return _group_pos_of(axis)
 
 
 def barrier(group=None):
@@ -353,20 +345,83 @@ def _eager_alltoall_single(axis, mesh_id, ndim):
 
 
 def _global_rank_of(axis, peer):
-    """Trainer rank of the process at group-relative position ``peer``
-    on ``axis``, holding every other mesh coordinate fixed (inverse of
-    get_rank_in's stride arithmetic)."""
+    """Trainer rank (process index) of the peer at group-relative
+    position ``peer`` on ``axis``.
+
+    Derived from mesh DEVICE OWNERSHIP, not stride arithmetic on the
+    process index: with multiple local devices per process (any real
+    TPU host) the process index does not walk the mesh axes, so strides
+    would compute a wrong or nonexistent rank. For every mesh
+    coordinate this process owns, swap the ``axis`` index to ``peer``
+    and collect the owning process of the device there; eager P2P is
+    well-defined only when that resolves to ONE process."""
     mesh = topology.get_global_mesh()
-    inner = 1
-    seen = False
-    for name in mesh.axis_names:
-        if seen:
-            inner *= mesh.shape.get(name, 1)
-        if name == axis:
-            seen = True
-    me = jax.process_index()
-    mine = (me // inner) % mesh.shape.get(axis, 1)
-    return me + (int(peer) - mine) * inner
+    if axis not in mesh.axis_names:
+        if int(peer) != 0:
+            raise ValueError(
+                f"axis {axis!r} is not on the global mesh (group size "
+                f"1): the only valid peer is 0, got {peer}")
+        return jax.process_index()  # size-1 group: self
+    return _rank_of_cached(mesh, axis, int(peer), jax.process_index())
+
+
+@functools.lru_cache(maxsize=1024)
+def _rank_of_cached(mesh, axis, peer, me):
+    axis_idx = list(mesh.axis_names).index(axis)
+    dev = np.asarray(mesh.devices)
+    size = dev.shape[axis_idx]
+    if not 0 <= peer < size:
+        raise ValueError(
+            f"peer rank {peer} out of range for group axis {axis!r} "
+            f"of size {size}")
+    procs = set()
+    for coord in np.ndindex(dev.shape):
+        if dev[coord].process_index != me:
+            continue
+        pc = list(coord)
+        pc[axis_idx] = peer
+        procs.add(dev[tuple(pc)].process_index)
+    if len(procs) == 1:
+        return procs.pop()
+    if not procs:
+        raise RuntimeError(
+            f"process {me} owns no device of the global mesh; eager "
+            "send/recv needs every participant on the mesh")
+    raise RuntimeError(
+        f"eager send/recv over axis {axis!r} is ambiguous: this "
+        f"process's local devices map peer {peer} to processes "
+        f"{sorted(procs)}. Host-side P2P addresses a single peer "
+        "process; use in-graph ppermute (distributed/pipeline.py) for "
+        "per-device point-to-point")
+
+
+def _group_pos_of(axis):
+    """This process's group-relative position on ``axis``, derived from
+    device ownership (the src the receiver matches on — must agree with
+    _global_rank_of's geometry, not process-index stride arithmetic)."""
+    mesh = topology.get_global_mesh()
+    if axis not in mesh.axis_names:
+        return 0
+    return _pos_of_cached(mesh, axis, jax.process_index())
+
+
+@functools.lru_cache(maxsize=1024)
+def _pos_of_cached(mesh, axis, me):
+    axis_idx = list(mesh.axis_names).index(axis)
+    dev = np.asarray(mesh.devices)
+    pos = {coord[axis_idx] for coord in np.ndindex(dev.shape)
+           if dev[coord].process_index == me}
+    if len(pos) == 1:
+        return pos.pop()
+    if pos and all(
+            _rank_of_cached(mesh, axis, p, me) == me for p in pos):
+        # every peer on the axis is this same process (single-controller
+        # virtual mesh / in-process group): self-group convention rank 0
+        return 0
+    raise RuntimeError(
+        f"this process's devices span positions {sorted(pos)} of axis "
+        f"{axis!r}; host-side P2P needs a unique per-process position "
+        "on the group axis")
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
@@ -376,7 +431,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
     axis = _axis_of(group)
     p2p.get_transport().send(axis, _global_rank_of(axis, dst),
                              np.asarray(tensor._value),
-                             src_tag=get_rank_in(group))
+                             src_tag=_group_pos_of(axis))
     return tensor
 
 

@@ -18,13 +18,34 @@ NCCL socket transport plays for eager mode:
   rendezvouses through); with no KV store (single process) the loopback
   address is used directly,
 - ``send`` frames the array as ``[u32 meta_len | meta_json | raw bytes]``
-  over a cached connection to the destination's listener,
-- the listener demuxes inbound messages into per-sender FIFO queues;
-  ``recv`` blocks on the matching queue.
+  over a cached connection to the destination's listener; the payload is
+  streamed in bounded chunks (PADDLE_P2P_CHUNK_BYTES, default 16 MiB)
+  straight from the array buffer, so a multi-GB activation never incurs
+  a second host copy, and oversized sends are refused up front
+  (PADDLE_P2P_MAX_BYTES, default 4 GiB),
+- the listener demuxes inbound messages into per-(axis, src, tag) FIFO
+  queues; ``recv`` blocks on the matching queue,
+- a send over a poisoned cached socket (peer restarted and republished a
+  new ephemeral port, or a prior frame died mid-write) closes + evicts
+  the cache entry, re-resolves the peer address through the KV store,
+  and retries ONCE,
+- delivery is exactly-once-or-loud: every frame carries the sender's
+  transport rank and a per-(sender incarnation, dst) sequence number.
+  The receiver delivers seq == last+1, silently drops duplicates
+  (seq <= last: a retry whose original did arrive), and treats a FORWARD
+  jump as proof that an earlier frame was lost with a dead connection —
+  it then poisons that sender and raises from every affected ``recv``
+  instead of silently pairing later tensors with earlier recv slots
+  (the reference's NCCL comm-abort semantics). Each accepted connection
+  starts with the receiver's 8-byte random epoch; a changed epoch on
+  reconnect means the peer restarted, so the sender resets its sequence
+  for that destination (the new incarnation's counter starts at 0).
 
-Messages are matched by (axis, src, dst) like the reference's
+Messages are matched by (axis, src, tag) like the reference's
 (ring_id, peer) pairing, so interleaved streams on different group axes
-do not cross.
+— or two concurrent sends on the SAME edge carrying different tags — do
+not cross. Same-edge same-tag sends rely on TCP FIFO ordering, exactly
+the reference's same-ring ordering contract.
 """
 import json
 import os
@@ -38,6 +59,10 @@ __all__ = ["get_transport", "shutdown"]
 
 _HEADER = struct.Struct("<I")
 _RECV_TIMEOUT = float(os.environ.get("PADDLE_P2P_TIMEOUT", "120"))
+_CHUNK_BYTES = int(os.environ.get("PADDLE_P2P_CHUNK_BYTES",
+                                  str(16 * 1024 * 1024)))
+_MAX_BYTES = int(os.environ.get("PADDLE_P2P_MAX_BYTES",
+                                str(4 * 1024 * 1024 * 1024)))
 
 _lock = threading.Lock()
 _transport = None
@@ -75,17 +100,35 @@ class _Queue:
             return self._items.pop(0)
 
 
+class _Gap:
+    """Queue marker: a frame from ``srank`` was lost (sequence jump)."""
+
+    def __init__(self, srank):
+        self.srank = srank
+
+
 class Transport:
-    """One per process: a listener socket + per-(axis, src) inbox queues
-    + cached outbound connections."""
+    """One per process: a listener socket + per-(axis, src, tag) inbox
+    queues + cached outbound connections."""
 
     def __init__(self, rank):
         self.rank = int(rank)
+        self.epoch = os.urandom(8)  # this incarnation's id
         self._queues = {}
         self._queues_lock = threading.Lock()
         self._out = {}
         self._out_lock = threading.Lock()
         self._closed = False
+        # sender-side sequence state (guarded by the per-entry lock +
+        # _out_lock for the epoch-change reset in _conn_to)
+        self._send_seq = {}    # dst -> next seq
+        self._peer_epoch = {}  # dst -> epoch of current peer incarnation
+        # receiver-side gap/duplicate tracking (guarded by _queues_lock)
+        # keyed by sid = (srank, sender epoch): a RESTARTED sender is a
+        # fresh stream whose counter starts over, not a duplicate
+        self._last_seq = {}      # sid -> last contiguous seq delivered
+        self._srank_queues = {}  # sid -> queue keys it has touched
+        self._poisoned = set()   # sids with a detected lost frame
 
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -157,9 +200,10 @@ class Transport:
 
     # ---------------------------------------------------- inbound
 
-    def _queue_for(self, axis, src):
+    def _queue_for(self, axis, src, tag):
         with self._queues_lock:
-            return self._queues.setdefault((axis, int(src)), _Queue())
+            return self._queues.setdefault((axis, int(src), int(tag)),
+                                           _Queue())
 
     def _accept_loop(self):
         while not self._closed:
@@ -173,16 +217,70 @@ class Transport:
     def _conn_loop(self, conn):
         try:
             with conn:
+                conn.sendall(self.epoch)  # incarnation handshake
                 while True:
                     meta_len = _HEADER.unpack(_recv_exact(conn, 4))[0]
                     meta = json.loads(_recv_exact(conn, meta_len))
-                    payload = _recv_exact(conn, int(meta["nbytes"]))
-                    arr = np.frombuffer(
-                        payload, dtype=np.dtype(meta["dtype"])
-                    ).reshape(meta["shape"]).copy()
-                    self._queue_for(meta["axis"], meta["src"]).put(arr)
+                    # inbound guard: the listener is unauthenticated, so
+                    # never allocate from unvalidated wire meta. Python
+                    # ints (no overflow) + non-negative dims + cap.
+                    nbytes = int(meta["nbytes"])
+                    shape = [int(d) for d in meta["shape"]]
+                    want = np.dtype(meta["dtype"]).itemsize
+                    for d in shape:
+                        if d < 0:
+                            raise ConnectionError(
+                                f"P2P frame meta invalid: dim {d} < 0")
+                        want *= d
+                    if nbytes != want or not 0 <= nbytes <= _MAX_BYTES:
+                        raise ConnectionError(
+                            f"P2P frame meta invalid (nbytes={nbytes}, "
+                            f"shape/dtype want {want}, cap {_MAX_BYTES})")
+                    # single-copy receive: allocate the array up front
+                    # and recv_into its buffer (a bytes staging copy
+                    # would triple peak RSS on multi-GB activations)
+                    arr = np.empty(meta["shape"], np.dtype(meta["dtype"]))
+                    view = memoryview(arr).cast("B")
+                    got, total = 0, int(meta["nbytes"])
+                    while got < total:
+                        n = conn.recv_into(view[got:], total - got)
+                        if not n:
+                            raise ConnectionError(
+                                "P2P peer closed the connection "
+                                "mid-message")
+                        got += n
+                    self._deliver(meta, arr)
         except (ConnectionError, OSError):
             return
+
+    def _deliver(self, meta, arr):
+        """Sequence-checked delivery (see module docstring): in-order
+        frames deliver, duplicates drop, a forward jump poisons the
+        sender and surfaces as an error on every affected recv."""
+        key = (meta["axis"], int(meta["src"]), int(meta.get("tag", 0)))
+        srank, seq = meta.get("srank"), meta.get("seq")
+        if srank is None or seq is None:
+            self._queue_for(*key).put(arr)
+            return
+        sid = (srank, meta.get("sepoch"))
+        with self._queues_lock:
+            q = self._queues.setdefault(key, _Queue())
+            if sid in self._poisoned:
+                q.put(_Gap(srank))
+                return
+            last = self._last_seq.get(sid, -1)
+            if seq <= last:
+                return  # duplicate of a delivered retry
+            touched = self._srank_queues.setdefault(sid, set())
+            touched.add(key)
+            if seq == last + 1:
+                self._last_seq[sid] = seq
+                q.put(arr)
+                return
+            # forward jump: an earlier frame died with its connection
+            self._poisoned.add(sid)
+            for k in touched:
+                self._queues.setdefault(k, _Queue()).put(_Gap(srank))
 
     # ---------------------------------------------------- outbound
 
@@ -199,33 +297,86 @@ class Transport:
         sock = socket.create_connection((host, int(port)),
                                         timeout=_RECV_TIMEOUT)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        peer_epoch = _recv_exact(sock, 8)  # incarnation handshake
         entry = (sock, threading.Lock())
         with self._out_lock:
             raced = self._out.get(dst)
             if raced is not None:
                 sock.close()
                 return raced
+            if self._peer_epoch.get(dst) != peer_epoch:
+                # new peer incarnation: its receive-side sequence state
+                # is fresh, so this destination's counter restarts
+                self._peer_epoch[dst] = peer_epoch
+                self._send_seq[dst] = 0
             self._out[dst] = entry
         return entry
 
-    def send(self, axis, dst, array, src_tag=None):
+    def _evict(self, dst, entry):
+        with self._out_lock:
+            if self._out.get(dst) is entry:
+                del self._out[dst]
+        try:
+            entry[0].close()
+        except OSError:
+            pass
+
+    def send(self, axis, dst, array, src_tag=None, tag=0):
         """Ship one array to trainer ``dst``; ``src_tag`` is the value
         the receiver matches on (group-relative rank; defaults to this
-        process's trainer rank)."""
+        process's trainer rank). ``tag`` disambiguates concurrent sends
+        on the same (axis, src, dst) edge."""
         array = np.ascontiguousarray(array)
-        meta = json.dumps({
+        if array.nbytes > _MAX_BYTES:
+            raise ValueError(
+                f"P2P send of {array.nbytes} bytes exceeds the "
+                f"{_MAX_BYTES}-byte limit (PADDLE_P2P_MAX_BYTES); shard "
+                "the tensor or raise the limit")
+        base_meta = {
             "axis": axis,
             "src": self.rank if src_tag is None else int(src_tag),
+            "tag": int(tag), "srank": self.rank,
+            "sepoch": self.epoch.hex(),
             "dtype": array.dtype.name, "shape": list(array.shape),
             "nbytes": array.nbytes,
-        }).encode()
-        sock, lock = self._conn_to(int(dst))
-        with lock:
-            sock.sendall(_HEADER.pack(len(meta)) + meta +
-                         array.tobytes())
+        }
+        view = memoryview(array).cast("B")
+        dst = int(dst)
+        for attempt in (0, 1):
+            entry = self._conn_to(dst)
+            sock, lock = entry
+            try:
+                with lock:
+                    # seq allocated under the socket lock so the frame
+                    # order on the wire matches the counter; a reconnect
+                    # to a restarted peer resets it (_conn_to)
+                    seq = self._send_seq.get(dst, 0)
+                    meta = json.dumps(dict(base_meta, seq=seq)).encode()
+                    sock.sendall(_HEADER.pack(len(meta)) + meta)
+                    for off in range(0, len(view), _CHUNK_BYTES):
+                        sock.sendall(view[off:off + _CHUNK_BYTES])
+                    self._send_seq[dst] = seq + 1
+                return
+            except OSError:
+                # poisoned cached socket (peer restarted / frame died
+                # mid-write): evict, re-resolve the address, retry once.
+                # The receiver's sequence check keeps this safe: a
+                # duplicate is dropped, a frame lost with the old
+                # connection surfaces as a loud gap error on recv.
+                self._evict(dst, entry)
+                if attempt:
+                    raise
 
-    def recv(self, axis, src, timeout=None):
-        return self._queue_for(axis, src).get(timeout or _RECV_TIMEOUT)
+    def recv(self, axis, src, timeout=None, tag=0):
+        q = self._queue_for(axis, src, tag)
+        item = q.get(timeout or _RECV_TIMEOUT)
+        if isinstance(item, _Gap):
+            q.put(item)  # keep the stream poisoned for later recvs
+            raise ConnectionError(
+                f"a P2P frame from trainer {item.srank} was lost with a "
+                "dead connection (sequence gap); the stream cannot be "
+                "trusted — re-establish it at the application level")
+        return item
 
     def close(self):
         self._closed = True

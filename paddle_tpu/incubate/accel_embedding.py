@@ -168,6 +168,9 @@ class AccelSparseEmbedding(nn.Layer):
         if mode not in ("hashed", "exact"):
             raise ValueError(f"mode must be 'hashed' or 'exact', got {mode!r}")
         self.mode = mode
+        self.init_range = float(init_range)
+        self._reinit_rng = np.random.default_rng(0xACCE1)
+        self.last_evicted = []
         self.accessor = KeyAccessor(capacity, entry) if mode == "exact" \
             else None
         if entry is not None and mode != "exact":
@@ -197,14 +200,36 @@ class AccelSparseEmbedding(nn.Layer):
                     else self.accessor.lookup(ids_np))
         return rows
 
+    def _reinit_evicted(self):
+        """Reset rows the accessor just evicted: a newly admitted key
+        must start from a FRESH embedding, not the evicted key's trained
+        vector (reference: common_sparse_table.cc re-initializes values
+        on insert). Rows are also recorded in ``self.last_evicted`` so a
+        training loop can zero its optimizer moments for them (moments
+        live with the optimizer, out of this layer's reach)."""
+        self.last_evicted = []
+        evicted = self.accessor.take_evicted()
+        if not evicted:
+            return
+        rows = np.asarray([r for _, r in evicted], np.int32)
+        fresh = self._reinit_rng.uniform(
+            -self.init_range, self.init_range,
+            (len(rows), self.emb_dim)).astype(np.float32)
+        w = self.weight._value
+        self.weight._value = w.at[rows].set(
+            jnp.asarray(fresh, dtype=w.dtype))
+        self.last_evicted = rows.tolist()
+
     def assign_rows(self, ids):
         """Host-side exact translation (mode='exact'): admits new keys
         per the entry policy and returns int32 rows (-1 = unadmitted)
-        ready to feed into the compiled train step."""
+        ready to feed into the compiled train step. Rows freed by LRU
+        eviction are re-initialized before the step sees them."""
         if self.accessor is None:
             raise RuntimeError("assign_rows requires mode='exact'")
-        return Tensor(jnp.asarray(self._translate(ids, admit=True)),
-                      stop_gradient=True)
+        rows = self._translate(ids, admit=True)
+        self._reinit_evicted()
+        return Tensor(jnp.asarray(rows), stop_gradient=True)
 
     def forward(self, ids):
         if self.mode == "exact":
@@ -227,9 +252,10 @@ class AccelSparseEmbedding(nn.Layer):
                 # eval/inference must not mutate the table: admission +
                 # LRU touch only while training (reference accessors
                 # admit on push, not on pull)
-                rows = Tensor(jnp.asarray(
-                    self._translate(ids, admit=self.training)),
-                    stop_gradient=True)
+                rows_np = self._translate(ids, admit=self.training)
+                if self.training:
+                    self._reinit_evicted()
+                rows = Tensor(jnp.asarray(rows_np), stop_gradient=True)
 
             def _gather_masked(rows, w):
                 safe = jnp.where(rows < 0, 0, rows)

@@ -35,12 +35,20 @@ class HeterPSEmbedding(nn.Layer):
     pushed to the PS inside the compiled backward.
     """
 
+    _uid_counter = 0
+
     def __init__(self, client, table_idx, emb_dim, scale_grad=1.0):
         super().__init__()
         self.client = client
         self.table_idx = int(table_idx)
         self.emb_dim = int(emb_dim)
         self.scale_grad = float(scale_grad)
+        # _ps_embed is a per-instance closure over (client, table_idx,
+        # dim): the fn_key convention requires state-capturing ops to
+        # discriminate the cache key, else a second instance with the
+        # same table_idx would silently serve this instance's table.
+        HeterPSEmbedding._uid_counter += 1
+        self._uid = HeterPSEmbedding._uid_counter
         # Autodiff prunes the vjp of a subgraph no differentiable input
         # feeds; ids are ints, so WITHOUT this zero-valued trainable
         # anchor the backward push would be eliminated as dead code and
@@ -100,5 +108,18 @@ class HeterPSEmbedding(nn.Layer):
         self._ps_embed = _ps_embed
 
     def forward(self, ids):
-        return apply_op(f"heter_ps_embed_t{self.table_idx}",
-                        self._ps_embed, ids, self._anchor)
+        return apply_op(self._op_name, self._ps_embed, ids, self._anchor)
+
+    @property
+    def _op_name(self):
+        return f"heter_ps_embed_t{self.table_idx}_u{self._uid}"
+
+    def __del__(self):
+        # the per-uid cache key means each instance owns its cached jit,
+        # whose closure pins the PS client — release it with the layer
+        try:
+            from ..core.dispatch import evict_ops
+
+            evict_ops(self._op_name)
+        except Exception:
+            pass  # interpreter shutdown

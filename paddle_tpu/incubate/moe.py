@@ -270,6 +270,10 @@ class MoELayer(nn.Layer):
             _place(self.w_up, P(axis))
             _place(self.w_down, P(axis))
         # cache key must discriminate everything the closure captures:
-        # the mesh's token-shard group count changes _a2a's semantics
-        return apply_op(f"moe_ffn_a2a_{axis}{ep}_g{groups}_m{id(mesh)}",
-                        _a2a, x, logits, self.w_up, self.w_down)
+        # the mesh's token-shard group count, and the routing params
+        # (top_k / capacity_factor / num_experts) — two layers differing
+        # only in top_k would otherwise share the cached jit
+        return apply_op(
+            f"moe_ffn_a2a_{axis}{ep}_g{groups}_m{id(mesh)}"
+            f"_k{top_k}_cf{cf}_e{e}",
+            _a2a, x, logits, self.w_up, self.w_down)

@@ -7,6 +7,12 @@ exercised by test_collective_sendrecv_api.py). Exercises:
    finishes the forward and records the loss — the eager analog of the
    reference's pipeline SectionWorker P2P. Rank 1 writes the losses to
    argv[1]; the launching test compares them against a 1-proc oracle.
+3. out-of-order two-tensor exchange: rank 0 sends two different-shaped
+   tensors on the same edge under distinct tags; rank 1 receives them in
+   the OPPOSITE order — the (axis, src, tag) match key, not FIFO luck,
+   must pair them.
+4. large chunked send: one ~128 MB tensor crosses the edge in
+   PADDLE_P2P_CHUNK_BYTES-sized slices and arrives intact.
 """
 import json
 import os
@@ -62,6 +68,34 @@ def main():
             dist.recv(act, src=0)
             out = stage1(act)
             losses.append(float((out ** 2).mean().numpy()))
+    # ---- 3. out-of-order exchange via tags (transport-level)
+    from paddle_tpu.distributed import p2p
+
+    tr = p2p.get_transport()
+    if rank == 0:
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        b = np.arange(10, dtype=np.int64) * 7
+        tr.send("pp", 1, a, tag=1)
+        tr.send("pp", 1, b, tag=2)
+    else:
+        # receive tag 2 FIRST although it was sent second
+        b = tr.recv("pp", 0, tag=2)
+        a = tr.recv("pp", 0, tag=1)
+        np.testing.assert_array_equal(b, np.arange(10, dtype=np.int64) * 7)
+        np.testing.assert_allclose(
+            a, np.arange(12, dtype=np.float32).reshape(3, 4))
+
+    # ---- 4. large chunked send (~128 MB, crosses many chunk slices)
+    big_n = 32 * 1024 * 1024
+    if rank == 0:
+        big = np.arange(big_n, dtype=np.float32)
+        tr.send("pp", 1, big, tag=9)
+    else:
+        got_big = tr.recv("pp", 0, tag=9, timeout=180)
+        assert got_big.shape == (big_n,)
+        assert got_big[0] == 0.0 and got_big[-1] == float(big_n - 1)
+        assert float(got_big[12345]) == 12345.0
+
     if rank == 1:
         with open(out_path, "w") as f:
             json.dump(losses, f)

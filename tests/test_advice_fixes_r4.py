@@ -1,0 +1,262 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md):
+1. heter_ps: two HeterPSEmbedding instances with the same table_idx must
+   not share a jitted-op cache entry (each serves ITS OWN client).
+2. moe: two alltoall MoELayers differing only in top_k must not share
+   the cached jit (top_k is in the closure, so it must be in the key).
+3. collective._global_rank_of must derive the peer's process from mesh
+   device ownership, not stride arithmetic on the process index.
+4. p2p: poisoned cached sockets are evicted + retried; tags demux
+   same-edge streams; oversized sends are refused; chunked framing.
+5. accel_embedding: rows freed by LRU eviction are re-initialized, not
+   inherited by the next admitted key.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import ps
+
+
+class TestHeterPSInstanceIsolation:
+    def test_two_instances_same_table_idx(self):
+        from paddle_tpu.incubate.heter_ps import HeterPSEmbedding
+
+        c1 = ps.LocalPSClient([ps.TableConfig("e", True, emb_dim=4,
+                                              optimizer="sgd", lr=1.0)])
+        c2 = ps.LocalPSClient([ps.TableConfig("e", True, emb_dim=4,
+                                              optimizer="sgd", lr=1.0)])
+        ids = np.array([2, 8], np.int64)
+        # make c2's rows distinct from c1's regardless of init policy
+        c2.push_sparse(0, ids, np.full((2, 4), 5.0, np.float32))
+        e1 = HeterPSEmbedding(c1, 0, 4)
+        e2 = HeterPSEmbedding(c2, 0, 4)
+        out1 = np.asarray(e1(paddle.to_tensor(ids))._value)
+        out2 = np.asarray(e2(paddle.to_tensor(ids))._value)
+        np.testing.assert_allclose(
+            out1, np.asarray(c1.pull_sparse(0, ids)), atol=1e-6)
+        # pre-fix: e2 silently served e1's client through the shared
+        # (name, module, qualname) cache entry
+        np.testing.assert_allclose(
+            out2, np.asarray(c2.pull_sparse(0, ids)), atol=1e-6)
+        assert not np.allclose(out1, out2)
+        # deleting a layer releases its cached jit (the per-uid key
+        # would otherwise pin the PS client forever)
+        from paddle_tpu.core import dispatch
+
+        name1 = e1._op_name
+        assert any(isinstance(k[0], tuple) and k[0][0] == name1
+                   for k in dispatch._FWD_CACHE)
+        del e1
+        import gc
+
+        gc.collect()
+        assert not any(isinstance(k[0], tuple) and k[0][0] == name1
+                       for k in dispatch._FWD_CACHE)
+        c1.close()
+        c2.close()
+
+
+class TestMoEAlltoallCacheKey:
+    def test_topk_discriminates_cached_jit(self):
+        import jax
+
+        from paddle_tpu.distributed import topology
+        from paddle_tpu.incubate.moe import MoELayer
+
+        mesh = topology.build_mesh(dp=1, ep=4, devices=jax.devices()[:4])
+        topology.set_global_mesh(mesh)
+        paddle.seed(7)
+        m1 = MoELayer(8, 16, num_experts=8, top_k=1,
+                      dispatch_mode="alltoall", capacity_factor=8.0)
+        m2 = MoELayer(8, 16, num_experts=8, top_k=4,
+                      dispatch_mode="alltoall", capacity_factor=8.0)
+        m2.set_state_dict(m1.state_dict())
+        x = np.random.RandomState(0).rand(4, 6, 8).astype(np.float32)
+        o1 = np.asarray(m1(paddle.to_tensor(x))._value)
+        o2 = np.asarray(m2(paddle.to_tensor(x))._value)
+        # identical weights, different top_k: routing MUST differ.
+        # pre-fix, m2 reused m1's cached jit (same axis/ep/groups/mesh)
+        # and silently routed with top_k=1.
+        assert not np.allclose(o1, o2, atol=1e-6)
+
+
+class _FakeDev:
+    def __init__(self, process_index):
+        self.process_index = process_index
+
+
+class _FakeMesh:
+    def __init__(self, axis_names, devices):
+        self.axis_names = tuple(axis_names)
+        self.devices = devices
+
+
+class TestGlobalRankOf:
+    def test_multi_local_device_mapping(self, monkeypatch):
+        """2 processes x 4 local devices, mesh pp=2 x dp=4: peer 1 on
+        'pp' lives at process 1. Stride arithmetic on process_index
+        would answer 4 — a nonexistent rank."""
+        import jax
+
+        from paddle_tpu.distributed import collective, topology
+
+        dev = np.array([[_FakeDev(p) for _ in range(4)] for p in range(2)],
+                       dtype=object)
+        monkeypatch.setattr(topology, "get_global_mesh",
+                            lambda: _FakeMesh(("pp", "dp"), dev))
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        assert collective._global_rank_of("pp", 1) == 1
+        assert collective._global_rank_of("pp", 0) == 0
+
+    def test_ambiguous_peer_raises(self, monkeypatch):
+        import jax
+
+        from paddle_tpu.distributed import collective, topology
+
+        dev = np.array([[_FakeDev(0), _FakeDev(0)],
+                        [_FakeDev(1), _FakeDev(2)]], dtype=object)
+        monkeypatch.setattr(topology, "get_global_mesh",
+                            lambda: _FakeMesh(("a", "b"), dev))
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        with pytest.raises(RuntimeError, match="ambiguous"):
+            collective._global_rank_of("a", 1)
+
+
+class TestP2PTransport:
+    def _transport(self):
+        from paddle_tpu.distributed.p2p import Transport
+
+        return Transport(rank=0)
+
+    def test_tags_demux_same_edge(self):
+        tr = self._transport()
+        try:
+            a = np.arange(6, dtype=np.float32)
+            b = np.arange(4, dtype=np.int64)
+            tr.send("ax", 0, a, tag=5)
+            tr.send("ax", 0, b, tag=6)
+            got_b = tr.recv("ax", 0, tag=6, timeout=30)
+            got_a = tr.recv("ax", 0, tag=5, timeout=30)
+            np.testing.assert_array_equal(got_a, a)
+            np.testing.assert_array_equal(got_b, b)
+        finally:
+            tr.close()
+
+    def test_chunked_framing(self, monkeypatch):
+        from paddle_tpu.distributed import p2p as p2p_mod
+
+        monkeypatch.setattr(p2p_mod, "_CHUNK_BYTES", 7)
+        tr = self._transport()
+        try:
+            arr = np.random.RandomState(0).rand(37, 5).astype(np.float32)
+            tr.send("ax", 0, arr)
+            got = tr.recv("ax", 0, timeout=30)
+            np.testing.assert_array_equal(got, arr)
+        finally:
+            tr.close()
+
+    def test_size_guard(self, monkeypatch):
+        from paddle_tpu.distributed import p2p as p2p_mod
+
+        monkeypatch.setattr(p2p_mod, "_MAX_BYTES", 64)
+        tr = self._transport()
+        try:
+            with pytest.raises(ValueError, match="PADDLE_P2P_MAX_BYTES"):
+                tr.send("ax", 0, np.zeros(1024, np.float32))
+        finally:
+            tr.close()
+
+    def test_sequence_gap_detected_loudly(self):
+        """A lost frame (sequence jump) must raise from recv, not let a
+        later tensor silently pair with an earlier recv slot."""
+        tr = self._transport()
+        try:
+            tr.send("ax", 0, np.zeros(2, np.float32), tag=1)
+            tr.recv("ax", 0, tag=1, timeout=30)
+            tr._send_seq[0] = 5  # simulate two frames lost in flight
+            tr.send("ax", 0, np.ones(2, np.float32), tag=1)
+            with pytest.raises(ConnectionError, match="sequence gap"):
+                tr.recv("ax", 0, tag=1, timeout=30)
+            # the stream stays poisoned for later recvs too
+            with pytest.raises(ConnectionError, match="sequence gap"):
+                tr.recv("ax", 0, tag=1, timeout=5)
+        finally:
+            tr.close()
+
+    def test_duplicate_frame_dropped(self):
+        tr = self._transport()
+        try:
+            tr.send("ax", 0, np.zeros(2, np.float32), tag=1)
+            tr.recv("ax", 0, tag=1, timeout=30)
+            tr._send_seq[0] = 0  # replay: a retry whose original landed
+            tr.send("ax", 0, np.ones(2, np.float32), tag=1)
+            with pytest.raises(TimeoutError):
+                tr.recv("ax", 0, tag=1, timeout=2)
+        finally:
+            tr.close()
+
+    def test_restarted_sender_is_a_fresh_stream(self):
+        """A restarted sender's seq restarts at 0; the receiver must key
+        its duplicate check by (srank, sender epoch) or it would drop
+        the new incarnation's frames as replays."""
+        from paddle_tpu.distributed.p2p import Transport
+
+        recv_t = Transport(rank=0)
+        send_t = Transport(rank=1)
+        addr = f"127.0.0.1:{recv_t.port}"
+        try:
+            send_t._peer_addr = lambda dst: addr
+            send_t.send("ax", 0, np.arange(3, dtype=np.float32))
+            np.testing.assert_array_equal(
+                recv_t.recv("ax", 1, timeout=30),
+                np.arange(3, dtype=np.float32))
+            send_t.close()
+            send_t = Transport(rank=1)  # restart: new epoch, seq 0
+            send_t._peer_addr = lambda dst: addr
+            payload = np.arange(4, dtype=np.float32) * 3
+            send_t.send("ax", 0, payload)
+            np.testing.assert_array_equal(
+                recv_t.recv("ax", 1, timeout=30), payload)
+        finally:
+            send_t.close()
+            recv_t.close()
+
+    def test_poisoned_socket_evicted_and_retried(self):
+        tr = self._transport()
+        try:
+            first = np.arange(3, dtype=np.float32)
+            tr.send("ax", 0, first, tag=1)
+            np.testing.assert_array_equal(tr.recv("ax", 0, tag=1,
+                                                  timeout=30), first)
+            # poison the cached outbound socket (peer-restart analog)
+            sock, _ = tr._out[0]
+            sock.close()
+            second = np.arange(5, dtype=np.float32) * 2
+            tr.send("ax", 0, second, tag=2)  # pre-fix: OSError, no retry
+            np.testing.assert_array_equal(tr.recv("ax", 0, tag=2,
+                                                  timeout=30), second)
+        finally:
+            tr.close()
+
+
+class TestAccelEvictionReinit:
+    def test_evicted_row_is_reinitialized(self):
+        from paddle_tpu.incubate.accel_embedding import AccelSparseEmbedding
+
+        paddle.seed(0)
+        emb = AccelSparseEmbedding(capacity=2, emb_dim=4, mode="exact",
+                                   init_range=0.05)
+        emb.train()
+        emb.assign_rows(np.array([100], np.int64))
+        emb.assign_rows(np.array([200], np.int64))
+        row_100 = emb.accessor.key_to_row[100]
+        # simulate training having moved key 100's row far from init
+        emb.weight._value = emb.weight._value.at[row_100].set(999.0)
+        # touch 200 so 100 is LRU, then admit a third key -> evicts 100
+        emb.assign_rows(np.array([200], np.int64))
+        emb.assign_rows(np.array([300], np.int64))
+        assert emb.accessor.key_to_row[300] == row_100
+        fresh = np.asarray(emb.weight._value)[row_100]
+        # pre-fix: key 300 inherited the trained [999., ...] vector
+        assert np.all(np.abs(fresh) <= 0.05 + 1e-6), fresh
+        assert emb.last_evicted == [row_100]
