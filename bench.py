@@ -198,6 +198,24 @@ def _print_trace_summary(profile_dir):
 def main():
     import jax
 
+    # Persistent XLA compilation cache on durable disk: r02 data shows
+    # compile+warmup ~124s and the batch sweep can recompile up to 4x —
+    # if the tunnel gives us a short window, every retry must be
+    # incremental (reference analog: executor.py:1112 cached prepared
+    # contexts). Harmless on CPU smoke runs.
+    cache_dir = os.environ.get("BENCH_COMPILE_CACHE",
+                               os.path.join(os.path.dirname(
+                                   os.path.abspath(__file__)),
+                                   ".jax_compile_cache"))
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        log(f"compilation cache at {cache_dir}")
+    except Exception as e:  # noqa: BLE001 - cache is an optimization
+        log(f"compilation cache unavailable: {e}")
+
     smoke = os.environ.get("BENCH_CPU") == "1"
     if smoke:
         jax.config.update("jax_platforms", "cpu")

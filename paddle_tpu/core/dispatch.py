@@ -91,6 +91,8 @@ def in_trace():
 
 def hashable(obj):
     """Normalise static kwargs into a hashable cache key."""
+    if not obj and isinstance(obj, dict):
+        return ()  # fast path: the common no-static-kwargs op
     if isinstance(obj, (list, tuple)):
         return tuple(hashable(o) for o in obj)
     if isinstance(obj, dict):
@@ -115,8 +117,9 @@ def fn_key(name, fn):
     that DO capture state (to_static programs, recompute segments) pass a
     discriminating uid kwarg.
     """
+    q = getattr(fn, "__qualname__", None)
     return (name, getattr(fn, "__module__", None),
-            getattr(fn, "__qualname__", repr(fn)))
+            q if q is not None else repr(fn))
 
 
 def evict_ops(name):
@@ -166,12 +169,24 @@ def _check_nan_inf(name, arrays):
 # ---------------------------------------------------------------- dispatch
 
 
+_HOT = None  # (Tensor, tape_mod) resolved once — import machinery is
+# measurable per-op overhead on the eager path (tools/op_bench.py
+# --eager-overhead)
+
+
+def _hot_mods():
+    global _HOT
+    if _HOT is None:
+        from . import tape as tape_mod
+        from . import tensor as tensor_mod
+
+        _HOT = (tensor_mod.Tensor, tape_mod)
+    return _HOT
+
+
 def apply_op(name, fn, *args, **kwargs):
     """Execute one op. Returns Tensor or tuple-of-Tensor mirroring fn's output."""
-    from . import tensor as tensor_mod
-    from . import tape as tape_mod
-
-    Tensor = tensor_mod.Tensor
+    Tensor, tape_mod = _hot_mods()
 
     arrays = []
     diff_argnums = []
@@ -198,12 +213,12 @@ def apply_op(name, fn, *args, **kwargs):
         out = fn(*arrays, **kwargs)
         return _wrap_outputs(out, requires_grad=not _all_stop(args, Tensor), node=None)
 
-    if flags.get_flags("eager_jit_ops")["eager_jit_ops"]:
-        out = jitted(fn, kwargs, name=name)(*[v for v in arrays])
+    if flags.flag_value("eager_jit_ops"):
+        out = jitted(fn, kwargs, name=name)(*arrays)
     else:
         out = fn(*arrays, **kwargs)
 
-    if flags.get_flags("check_nan_inf")["check_nan_inf"]:
+    if flags.flag_value("check_nan_inf"):
         _check_nan_inf(name, out if isinstance(out, (tuple, list)) else (out,))
 
     node = None
@@ -232,7 +247,7 @@ def _all_stop(args, Tensor):
 
 
 def _wrap_outputs(out, requires_grad, node):
-    from .tensor import Tensor
+    Tensor = _hot_mods()[0]
 
     if isinstance(out, (tuple, list)):
         outs = []

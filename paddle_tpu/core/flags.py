@@ -49,6 +49,12 @@ def set_flags(flags):
         _VALUES[key] = _REGISTRY[key][1](value)
 
 
+def flag_value(name):
+    """Fast single-flag read for the hot dispatch path (no dict build,
+    no FLAGS_ prefix handling — internal use)."""
+    return _VALUES[name]
+
+
 def get_flags(flags):
     """paddle.get_flags — name or list of names -> dict."""
     if isinstance(flags, str):

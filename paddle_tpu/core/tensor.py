@@ -186,11 +186,38 @@ class Tensor:
         self._node = None
 
     def _assign_result(self, t):
-        """Adopt another tensor's value + autograd node (in-place op support)."""
+        """Adopt another tensor's value + autograd node (in-place op
+        support — the reference's VarBase share + inplace version
+        bookkeeping, imperative/variable_wrapper.h).
+
+        Two repoints make the gradient survive the adoption:
+        - if the new node lists *self* as an input (y = op_(y)), the
+          pre-assignment identity is snapshotted into a hidden tensor so
+          the chain doesn't collapse into a self-cycle, and
+        - the node's weak output ref is moved onto the adopter, because
+          backward matches cotangents through out_refs and the donor
+          tensor is dropped right after this call."""
+        import weakref
+
+        node = t._node
+        if node is not None and any(it is self for it in node.in_tensors):
+            old = Tensor(self._value, stop_gradient=self.stop_gradient)
+            old._node = self._node
+            old._out_idx = self._out_idx
+            if self._node is not None:
+                for i, ref in enumerate(self._node.out_refs):
+                    if ref() is self:
+                        self._node.out_refs[i] = weakref.ref(old)
+            node.in_tensors = [old if it is self else it
+                               for it in node.in_tensors]
         self._value = t._value
-        self._node = t._node
+        self._node = node
         self._out_idx = t._out_idx
         self.stop_gradient = t.stop_gradient
+        if node is not None:
+            for i, ref in enumerate(node.out_refs):
+                if ref() is t:
+                    node.out_refs[i] = weakref.ref(self)
 
     def copy_(self, other):
         self.set_value(other)

@@ -414,9 +414,12 @@ def _pos_of_cached(mesh, axis, me):
     if len(pos) == 1:
         return pos.pop()
     if pos and all(
-            _rank_of_cached(mesh, axis, p, me) == me for p in pos):
-        # every peer on the axis is this same process (single-controller
-        # virtual mesh / in-process group): self-group convention rank 0
+            _rank_of_cached(mesh, axis, p, me) == me
+            for p in range(dev.shape[axis_idx])):
+        # EVERY position on the axis is this same process (single-
+        # controller virtual mesh / in-process group): self-group
+        # convention rank 0. Testing only our own positions would wrongly
+        # pass when a spanning axis is split in contiguous blocks.
         return 0
     raise RuntimeError(
         f"this process's devices span positions {sorted(pos)} of axis "

@@ -220,6 +220,11 @@ class Transport:
                 conn.sendall(self.epoch)  # incarnation handshake
                 while True:
                     meta_len = _HEADER.unpack(_recv_exact(conn, 4))[0]
+                    if meta_len > 1 << 20:
+                        # meta is a short JSON blob; a huge header length
+                        # is corruption — never allocate from it
+                        raise ConnectionError(
+                            f"P2P meta length {meta_len} exceeds 1MB")
                     meta = json.loads(_recv_exact(conn, meta_len))
                     # inbound guard: the listener is unauthenticated, so
                     # never allocate from unvalidated wire meta. Python

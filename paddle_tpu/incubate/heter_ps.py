@@ -59,15 +59,24 @@ class HeterPSEmbedding(nn.Layer):
         tid, dim, scale = self.table_idx, self.emb_dim, self.scale_grad
 
         def _pull_host(ids_np, _anchor_np):
+            # dedup repeated ids per batch (reference: heter_comm.h
+            # pull/push batching — a wide&deep batch repeats hot ids
+            # heavily, and the PS round-trip is the boundary that
+            # dominates): pull each unique id once, scatter back
             ids_flat = np.asarray(ids_np).ravel()
-            vals = np.asarray(client_ref.pull_sparse(tid, ids_flat),
-                              np.float32)
+            uniq, inverse = np.unique(ids_flat, return_inverse=True)
+            vals = np.asarray(client_ref.pull_sparse(tid, uniq),
+                              np.float32)[inverse]
             return vals.reshape(tuple(np.asarray(ids_np).shape) + (dim,))
 
         def _push_host(ids_np, grad_np):
+            # aggregate gradients per unique id host-side, ONE push
             ids_flat = np.asarray(ids_np).ravel()
             g = np.asarray(grad_np, np.float32).reshape(len(ids_flat), dim)
-            client_ref.push_sparse(tid, ids_flat, g * scale)
+            uniq, inverse = np.unique(ids_flat, return_inverse=True)
+            agg = np.zeros((len(uniq), dim), np.float32)
+            np.add.at(agg, inverse, g)
+            client_ref.push_sparse(tid, uniq, agg * scale)
 
         # side-effecting callbacks cannot carry a replicated sharding
         # under the SPMD partitioner — pin the push to one device (the

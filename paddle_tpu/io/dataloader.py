@@ -270,5 +270,76 @@ class DataLoader:
     def from_generator(feed_list=None, capacity=64, use_double_buffer=True,
                        iterable=True, return_list=False, use_multiprocess=False,
                        drop_last=True):
-        raise NotImplementedError(
-            "from_generator is a legacy static-graph API; use DataLoader(dataset)")
+        """Legacy static-graph loader (reference: fluid/reader.py
+        GeneratorLoader): returns an object whose
+        set_sample_generator / set_sample_list_generator /
+        set_batch_generator feed the static program; iterating yields
+        Executor-ready feed dicts keyed by the feed_list var names (or
+        plain lists with return_list=True). capacity/use_double_buffer
+        are accepted for compatibility — host->device staging is XLA's
+        job on TPU."""
+        return _GeneratorLoader(feed_list, return_list, drop_last)
+
+
+class _GeneratorLoader:
+    """reference: fluid/reader.py GeneratorLoader (from_generator)."""
+
+    def __init__(self, feed_list, return_list, drop_last):
+        self.feed_list = list(feed_list or [])
+        self.return_list = return_list
+        self.drop_last = drop_last
+        self._batch_gen = None
+
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        def batches():
+            buf = []
+            for sample in reader():
+                buf.append(sample if isinstance(sample, (list, tuple))
+                           else [sample])
+                if len(buf) == batch_size:
+                    yield [np.stack([row[i] for row in buf])
+                           for i in range(len(buf[0]))]
+                    buf = []
+            if buf and not drop_last:
+                yield [np.stack([row[i] for row in buf])
+                       for i in range(len(buf[0]))]
+
+        self._batch_gen = batches
+        return self
+
+    def set_sample_list_generator(self, reader, places=None):
+        def batches():
+            for sample_list in reader():
+                yield [np.stack([row[i] for row in sample_list])
+                       for i in range(len(sample_list[0]))]
+
+        self._batch_gen = batches
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        self._batch_gen = reader
+        return self
+
+    def __call__(self):
+        return iter(self)
+
+    def __iter__(self):
+        if self._batch_gen is None:
+            raise RuntimeError(
+                "from_generator loader has no data source: call "
+                "set_sample_generator / set_sample_list_generator / "
+                "set_batch_generator first")
+        for batch in self._batch_gen():
+            arrays = [np.asarray(a) for a in batch]
+            if self.return_list:
+                yield arrays
+            else:
+                names = [getattr(v, "name", f"feed_{i}")
+                         for i, v in enumerate(self.feed_list)]
+                if len(names) != len(arrays):
+                    raise ValueError(
+                        f"from_generator batch has {len(arrays)} arrays "
+                        f"but feed_list names {len(names)} — pass a "
+                        "matching feed_list, or return_list=True")
+                yield dict(zip(names, arrays))

@@ -347,9 +347,13 @@ def _conv_transpose_nd(x, w, b, *, stride, padding, output_padding, dilation, gr
     size (i−1)·s − 2p + d·(k−1) + 1 + op exactly for all channel counts
     (jax.lax.conv_transpose's padding convention differs, and its
     transpose_kernel path mis-contracts when in != out for the paddle
-    [in, out, *k] weight layout)."""
-    if groups != 1:
-        raise NotImplementedError("conv transpose with groups>1")
+    [in, out, *k] weight layout).
+
+    groups > 1 (reference conv_transpose_op.cc `groups` attr): the
+    paddle weight [in, out/g, *k] stacks the per-group kernels along
+    dim 0; rearranged to [in/g, g*(out/g), *k] it maps onto ONE XLA
+    grouped conv (feature_group_count=g) — output block j uses input
+    block j, exactly the per-group transpose."""
     chan_first = data_format in ("NCHW", "NCL", "NCDHW")
     sp = "DHW"[3 - nd:]
     dn_in = ("NC" + sp) if chan_first else ("N" + sp + "C")
@@ -368,6 +372,14 @@ def _conv_transpose_nd(x, w, b, *, stride, padding, output_padding, dilation, gr
                for i in range(nd)]
     spatial_axes = tuple(range(2, 2 + nd))
     w_flipped = jnp.flip(w, axis=spatial_axes)
+    if groups > 1:
+        cin, og = w.shape[0], w.shape[1]
+        if cin % groups:
+            raise ValueError(f"in_channels {cin} not divisible by "
+                             f"groups {groups}")
+        wk = w_flipped.reshape((groups, cin // groups, og) + w.shape[2:])
+        w_flipped = jnp.moveaxis(wk, 0, 1).reshape(
+            (cin // groups, groups * og) + w.shape[2:])
     # kernel [in, out, *k]: contraction over dim0 (=I), outputs dim1 (=O)
     y = jax.lax.conv_general_dilated(
         x, w_flipped,
@@ -376,6 +388,7 @@ def _conv_transpose_nd(x, w, b, *, stride, padding, output_padding, dilation, gr
         lhs_dilation=stride,
         rhs_dilation=dilation,
         dimension_numbers=(dn_in, "IO" + sp, dn_in),
+        feature_group_count=int(groups),
     )
     if b is not None:
         shape = [1] * y.ndim
@@ -418,7 +431,7 @@ def _resolve_output_padding(x, weight, output_size, output_padding, stride,
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
                      dilation=1, groups=1, output_size=None,
                      data_format="NCHW", name=None):
-    """reference: operators/conv_transpose_op.cc. groups>1 unsupported for now."""
+    """reference: operators/conv_transpose_op.cc."""
     stride_, pad_, dil_ = _pair(stride), _norm_padding(padding, 2), _pair(dilation)
     op_ = _resolve_output_padding(x, weight, output_size, output_padding,
                                   stride_, pad_, dil_, 2, data_format)
@@ -444,8 +457,8 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
 # ------------------------------------------------------------- pooling
 
 
-def _pool_nd(x, *, ksize, stride, padding, mode, ceil_mode, data_format, nd,
-             exclusive=True, divisor=None):
+def _pool_geometry(x, ksize, stride, padding, ceil_mode, data_format, nd):
+    """Shared window/stride/pad derivation for the pooling family."""
     chan_first = data_format in ("NCHW", "NCL", "NCDHW")
     if chan_first:
         window = (1, 1) + ksize
@@ -474,6 +487,49 @@ def _pool_nd(x, *, ksize, stride, padding, mode, ceil_mode, data_format, nd,
             pads[ax] = sp_pads[i]
         had_pad = any(p != (0, 0) for p in pads)
         pads = tuple(pads)
+    return window, strides, pads, spatial, had_pad
+
+
+def _spatial_index_array(x, spatial):
+    """int32 array shaped like x holding each cell's flattened spatial
+    index (reference pool_with_index mask semantics: the index within
+    the input's flattened spatial dims, per sample and channel)."""
+    sizes = [x.shape[a] for a in spatial]
+    idx = jnp.arange(int(np.prod(sizes)), dtype=jnp.int32).reshape(sizes)
+    shape = [1] * x.ndim
+    for a, s in zip(spatial, sizes):
+        shape[a] = s
+    return jnp.broadcast_to(idx.reshape(shape), x.shape)
+
+
+def _max_pool_with_index(x, *, ksize, stride, padding, ceil_mode,
+                         data_format, nd):
+    """Max pooling that also returns the argmax mask (reference:
+    operators/pool_with_index_op.cc max_pool2d_with_index): a variadic
+    reduce_window over (value, flat spatial index) pairs; ties take the
+    smaller index, padding cells can never win."""
+    window, strides, pads, spatial, _ = _pool_geometry(
+        x, ksize, stride, padding, ceil_mode, data_format, nd)
+    idx = _spatial_index_array(x, spatial)
+    neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = (bv > av) | ((bv == av) & (bi < ai))
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    vals, mask = jax.lax.reduce_window(
+        (x, idx), (jnp.asarray(neg, x.dtype), jnp.int32(2**31 - 1)),
+        reducer, window, strides, pads)
+    return vals, mask
+
+
+def _pool_nd(x, *, ksize, stride, padding, mode, ceil_mode, data_format, nd,
+             exclusive=True, divisor=None):
+    window, strides, pads, spatial, had_pad = _pool_geometry(
+        x, ksize, stride, padding, ceil_mode, data_format, nd)
     if mode == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         return jax.lax.reduce_window(x, init, jax.lax.max, window, strides, pads)
@@ -493,13 +549,14 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
     ksize = _pair(kernel_size)
     stride = ksize if stride is None else _pair(stride)
     pad = _norm_padding(padding, 2)
-    out = apply_op("max_pool2d", _pool_nd, x, ksize=ksize, stride=stride,
-                   padding=pad, mode="max", ceil_mode=bool(ceil_mode),
-                   data_format=data_format, nd=2)
     if return_mask:
-        # indices not natively produced by reduce_window; compute via argmax trick
-        raise NotImplementedError("return_mask=True not yet supported")
-    return out
+        return apply_op("max_pool2d_index", _max_pool_with_index, x,
+                        ksize=ksize, stride=stride, padding=pad,
+                        ceil_mode=bool(ceil_mode),
+                        data_format=data_format, nd=2)
+    return apply_op("max_pool2d", _pool_nd, x, ksize=ksize, stride=stride,
+                    padding=pad, mode="max", ceil_mode=bool(ceil_mode),
+                    data_format=data_format, nd=2)
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -517,6 +574,12 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
     ksize = _pair(kernel_size, 1)
     stride = ksize if stride is None else _pair(stride, 1)
+    if return_mask:
+        return apply_op("max_pool1d_index", _max_pool_with_index, x,
+                        ksize=ksize, stride=stride,
+                        padding=_norm_padding(padding, 1),
+                        ceil_mode=bool(ceil_mode), data_format="NCL",
+                        nd=1)
     return apply_op("max_pool1d", _pool_nd, x, ksize=ksize, stride=stride,
                     padding=_norm_padding(padding, 1), mode="max",
                     ceil_mode=bool(ceil_mode), data_format="NCL", nd=1)
@@ -541,7 +604,9 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
     if return_mask:
-        raise NotImplementedError("return_mask=True not yet supported")
+        return apply_op("adaptive_max_pool2d_index",
+                        _adaptive_max_pool_with_index, x,
+                        out_sizes=_pair(output_size), spatial_axes=(2, 3))
     return apply_op("adaptive_max_pool2d", _adaptive_pool_nd, x,
                     out_sizes=_pair(output_size), spatial_axes=(2, 3),
                     mode="max")
@@ -1148,11 +1213,14 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
-    if return_mask:
-        raise NotImplementedError("return_mask=True not yet supported")
     ksize = _pair(kernel_size, 3)
     stride = ksize if stride is None else _pair(stride, 3)
     pad = _norm_padding(padding, 3)
+    if return_mask:
+        return apply_op("max_pool3d_index", _max_pool_with_index, x,
+                        ksize=ksize, stride=stride, padding=pad,
+                        ceil_mode=bool(ceil_mode),
+                        data_format=data_format, nd=3)
     return apply_op("max_pool3d", _pool_nd, x, ksize=ksize, stride=stride,
                     padding=pad, mode="max", ceil_mode=bool(ceil_mode),
                     data_format=data_format, nd=3)
@@ -1206,6 +1274,48 @@ def _adaptive_pool_nd(x, *, out_sizes, spatial_axes, mode):
     return rec(0, [])
 
 
+def _adaptive_bins(i, o):
+    """start = floor(k*i/o), end = ceil((k+1)*i/o) — the reference
+    adaptive pool bin boundaries (AdaptiveStartIndex/EndIndex)."""
+    return [((k * i) // o, -((-(k + 1) * i) // o)) for k in range(o)]
+
+
+def _adaptive_max_pool_with_index(x, *, out_sizes, spatial_axes):
+    """Adaptive max pool returning (values, mask of flat spatial argmax)
+    — reference operators/pool_with_index_op.cc (max_pool*_with_index
+    adaptive=True). Bin shapes are compile-time constants, so each
+    output cell is a static slice + argmax; ties take the first (lowest
+    index) element like the reference kernels."""
+    in_sizes = [x.shape[a] for a in spatial_axes]
+    nd = len(spatial_axes)
+    all_bins = [_adaptive_bins(i, o) for i, o in zip(in_sizes, out_sizes)]
+
+    def rec(axis_idx, slices):
+        if axis_idx == nd:
+            sl = [slice(None)] * x.ndim
+            for a, (lo, hi) in zip(spatial_axes, slices):
+                sl[a] = slice(lo, hi)
+            region = x[tuple(sl)]
+            lead = region.shape[:spatial_axes[0]]
+            rs = [region.shape[a] for a in spatial_axes]
+            flat = region.reshape(lead + (-1,))
+            loc = jnp.argmax(flat, axis=-1)
+            val = jnp.take_along_axis(flat, loc[..., None], axis=-1)
+            coords = jnp.unravel_index(loc, rs)
+            glob = jnp.zeros_like(loc)
+            for c, (lo, _), size in zip(coords, slices, in_sizes):
+                glob = glob * size + (c + lo)
+            keep = (1,) * nd
+            return (val.reshape(lead + keep),
+                    glob.astype(jnp.int32).reshape(lead + keep))
+        parts = [rec(axis_idx + 1, slices + [b])
+                 for b in all_bins[axis_idx]]
+        return tuple(jnp.concatenate(p, axis=spatial_axes[axis_idx])
+                     for p in zip(*parts))
+
+    return rec(0, [])
+
+
 def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
     out = _pair(output_size, 3)
     axes = (2, 3, 4) if data_format == "NCDHW" else (1, 2, 3)
@@ -1214,16 +1324,21 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
-    if return_mask:
-        raise NotImplementedError("return_mask=True not yet supported")
     out = _pair(output_size, 3)
+    if return_mask:
+        return apply_op("adaptive_max_pool3d_index",
+                        _adaptive_max_pool_with_index, x,
+                        out_sizes=out, spatial_axes=(2, 3, 4))
     return apply_op("adaptive_max_pool3d", _adaptive_pool_nd, x,
                     out_sizes=out, spatial_axes=(2, 3, 4), mode="max")
 
 
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
     if return_mask:
-        raise NotImplementedError("return_mask=True not yet supported")
+        return apply_op("adaptive_max_pool1d_index",
+                        _adaptive_max_pool_with_index, x,
+                        out_sizes=_pair(output_size, 1),
+                        spatial_axes=(2,))
     return apply_op("adaptive_max_pool1d", _adaptive_pool_nd, x,
                     out_sizes=_pair(output_size, 1), spatial_axes=(2,),
                     mode="max")

@@ -29,7 +29,10 @@ class BuildStrategy:
     """reference: framework/details/build_strategy.h. Every knob is a
     plain attribute; XLA's compiler performs the corresponding passes
     (fusion, memory reuse, allreduce fusion) unconditionally, so the
-    knobs carry intent for API compat rather than toggling behavior."""
+    knobs carry intent for API compat rather than toggling behavior.
+    Setting a SEMANTIC knob away from its default (reduce_strategy,
+    gradient_scale_strategy) warns once — ported code that depends on
+    those semantics should hear that XLA decides them, not silence."""
 
     class ReduceStrategy:
         AllReduce = 0
@@ -39,6 +42,14 @@ class BuildStrategy:
         CoeffNumDevice = 0
         One = 1
         Customized = 2
+
+    # knobs whose non-default value would CHANGE numerics/semantics in
+    # the reference (the pure perf-hint knobs stay silent: XLA fuses /
+    # reuses memory unconditionally)
+    _SEMANTIC_DEFAULTS = {
+        "reduce_strategy": 0,
+        "gradient_scale_strategy": 0,
+    }
 
     def __init__(self):
         self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
@@ -55,6 +66,18 @@ class BuildStrategy:
         self.build_cuda_graph = False
         self.num_trainers = 1
         self.trainer_id = 0
+
+    def __setattr__(self, name, value):
+        default = self._SEMANTIC_DEFAULTS.get(name)
+        if default is not None and value != default:
+            import warnings
+
+            warnings.warn(
+                f"BuildStrategy.{name}={value!r} is a no-op on TPU: XLA "
+                "chooses the reduction/fusion schedule; gradient scaling "
+                "follows the optimizer config (spmd.build_train_step)",
+                stacklevel=2)
+        object.__setattr__(self, name, value)
 
 
 class ExecutionStrategy:
@@ -217,19 +240,55 @@ def set_program_state(program, state_dict):
         raise ValueError(f"state entries not found in program: {missing}")
 
 
+_PYFUNC_UIDS = None  # weak func -> (uid, weak backward_func) — created lazily
+_PYFUNC_COUNTER = [0]
+
+
+def _pyfunc_uid(func, backward_func):
+    """Stable per-(func, backward_func) uid for the jit-cache key.
+
+    id() is NOT usable here: CPython reuses addresses after GC, so a
+    fresh lambda could silently hit a dead lambda's cached jit (whose
+    callback closure still calls the OLD function). A weak registry +
+    monotonic counter gives stable uids while the functions live and
+    fresh uids after they die; a finalizer evicts the dead entry's
+    cached jits so they do not pin the closures forever."""
+    global _PYFUNC_UIDS
+    import weakref
+
+    from ..core.dispatch import evict_ops
+
+    if _PYFUNC_UIDS is None:
+        _PYFUNC_UIDS = weakref.WeakKeyDictionary()
+    rec = _PYFUNC_UIDS.get(func)
+    if rec is not None:
+        uid, bwd_ref = rec
+        if (backward_func is None) == (bwd_ref is None) and (
+                bwd_ref is None or bwd_ref() is backward_func):
+            return uid
+    _PYFUNC_COUNTER[0] += 1
+    uid = _PYFUNC_COUNTER[0]
+    _PYFUNC_UIDS[func] = (
+        uid, None if backward_func is None else weakref.ref(backward_func))
+    for nm in (f"py_func_u{uid}", f"py_func_bwd_u{uid}"):
+        weakref.finalize(func, evict_ops, nm)
+    return uid
+
+
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
-    """reference: fluid/layers/nn.py py_func — run arbitrary Python in
-    the graph. Mapped to jax.pure_callback (host callback inside the XLA
-    program); ``out`` provides the result template(s). backward_func is
-    unsupported (use PyLayer for custom gradients)."""
+    """reference: fluid/layers/nn.py py_func / operators/py_func_op.cc —
+    run arbitrary Python in the graph. Mapped to jax.pure_callback (host
+    callback inside the XLA program); ``out`` provides the result
+    template(s). ``backward_func`` follows the reference contract: it is
+    called with (forward inputs..., forward outputs..., output grads...)
+    — minus any variables listed in ``skip_vars_in_backward_input`` —
+    and must return one gradient per forward input (None for
+    non-differentiable inputs). Wired through jax.custom_vjp so it runs
+    inside compiled backward passes too."""
     import jax
 
     from ..core.dispatch import apply_op
 
-    if backward_func is not None:
-        raise NotImplementedError(
-            "py_func backward_func: use paddle.autograd.PyLayer for "
-            "custom gradients on TPU")
     xs = x if isinstance(x, (list, tuple)) else [x]
     outs = out if isinstance(out, (list, tuple)) else [out]
     templates = tuple(
@@ -237,21 +296,67 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
                              if str(o.dtype) != "bfloat16" else np.float32)
         for o in outs)
 
-    def _py(*arrs):
-        import jax.numpy as jnp
+    def host(*vals):
+        res = func(*[Tensor(np.asarray(v)) for v in vals])
+        rs = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(np.asarray(r._value if isinstance(r, Tensor)
+                                else r) for r in rs)
 
-        def host(*vals):
-            res = func(*[Tensor(np.asarray(v)) for v in vals])
-            rs = res if isinstance(res, (list, tuple)) else [res]
-            return tuple(np.asarray(r._value if isinstance(r, Tensor)
-                                    else r) for r in rs)
-
+    def _py_fwd_callback(*arrs):
         return jax.pure_callback(host, templates, *arrs)
 
-    result = apply_op("py_func", _py, *xs)
+    # the callbacks capture func/backward_func: the op name must
+    # discriminate them or two py_func sites would share one cached jit
+    uid = _pyfunc_uid(func, backward_func)
+    if backward_func is None:
+        result = apply_op(f"py_func_u{uid}", _py_fwd_callback, *xs)
+    else:
+        skip = set(id(v) for v in (skip_vars_in_backward_input or []))
+        keep_x = [i for i, v in enumerate(xs) if id(v) not in skip]
+        keep_o = [i for i, v in enumerate(outs) if id(v) not in skip]
+        # keep the REAL dtype (incl. bfloat16 via ml_dtypes): custom_vjp
+        # validates that bwd cotangents match the primal avals
+        in_templates = tuple(
+            jax.ShapeDtypeStruct(tuple(v._value.shape), v._value.dtype)
+            for v in xs)
+
+        def host_bwd(*vals):
+            res = backward_func(*[Tensor(np.asarray(v)) for v in vals])
+            rs = res if isinstance(res, (list, tuple)) else [res]
+            grads = []
+            for t, r in zip(in_templates, rs):
+                if r is None:
+                    grads.append(np.zeros(t.shape, t.dtype))
+                else:
+                    a = np.asarray(r._value if isinstance(r, Tensor)
+                                   else r)
+                    grads.append(a.astype(t.dtype, copy=False))
+            return tuple(grads)
+
+        @jax.custom_vjp
+        def _py(*arrs):
+            return _py_fwd_callback(*arrs)
+
+        def _fwd(*arrs):
+            res = _py(*arrs)
+            saved = res if isinstance(res, tuple) else (res,)
+            return res, (arrs, saved)
+
+        def _bwd(saved, g):
+            arrs, outs_v = saved
+            gs = g if isinstance(g, tuple) else (g,)
+            call_ins = ([arrs[i] for i in keep_x]
+                        + [outs_v[i] for i in keep_o] + list(gs))
+            return jax.pure_callback(host_bwd, in_templates, *call_ins)
+
+        _py.defvjp(_fwd, _bwd)
+        result = apply_op(f"py_func_bwd_u{uid}", _py, *xs)
     results = result if isinstance(result, (list, tuple)) else [result]
     for o, r in zip(outs, results):
-        o._value = r._value
+        # transplant value AND tape linkage onto the caller's template
+        # tensors (the reference returns `out`; gradients must flow
+        # through the object the user holds)
+        o._assign_result(r)
     return out
 
 

@@ -276,10 +276,31 @@ def crf_decoding(potentials, transition_params=None, lengths=None,
                     transition_params, lengths)
 
 
-def deform_conv2d(*args, **kwargs):
-    raise NotImplementedError(
-        "deform_conv2d: deformable sampling is data-dependent gather — "
-        "not yet implemented on TPU (use conv2d or roi_align)")
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, weight_attr=None, bias_attr=None,
+                  name=None):
+    """Deformable conv v1/v2 with in-graph parameter creation
+    (reference: python/paddle/static/nn/common.py:168 deform_conv2d,
+    operators/deformable_conv_op.cc). mask=None selects v1. The compute
+    core lives in vision.ops.deform_conv2d (vectorized bilinear gathers
+    + one MXU einsum — no im2col scratch, so im2col_step is moot)."""
+    from ..tensor import creation
+    from ..vision.ops import deform_conv2d as _dc
+
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    cin = x.shape[1]
+    w = creation.create_parameter(
+        [num_filters, cin // groups, ks[0], ks[1]], "float32",
+        attr=weight_attr)
+    b = None
+    if bias_attr is not False:
+        b = creation.create_parameter([num_filters], "float32",
+                                      attr=bias_attr, is_bias=True)
+    return _dc(x, offset, w, bias=b, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups, mask=mask)
 
 
 def multi_box_head(*args, **kwargs):
@@ -288,11 +309,85 @@ def multi_box_head(*args, **kwargs):
         "heads directly (see paddle_tpu.vision.ops)")
 
 
-def nce(*args, **kwargs):
-    raise NotImplementedError(
-        "nce: use sampled softmax via paddle.nn.functional.cross_entropy "
-        "over sampled candidates, or HSigmoidLoss for hierarchical "
-        "softmax")
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference:
+    python/paddle/fluid/layers/loss.py nce + operators/nce_op.h
+    NCEKernel): per row, sigmoid logits o for the true labels and
+    num_neg_samples sampled noise classes; cost = -log(o/(o+B)) for
+    true, -log(B/(o+B)) for noise, with B = q(class) * num_neg.
+
+    TPU notes: negatives are drawn host-side at call time (one set per
+    trace — the reference's per-batch CPU sampler moved outside the
+    compiled region) and the cost itself is pure jnp, so autodiff trains
+    weight/bias without the reference's hand-written NCEGradKernel.
+    Returns [B, 1]."""
+    from ..core.dispatch import apply_op
+    from ..tensor import creation
+
+    dim = input.shape[-1]
+    n = int(num_total_classes)
+    k = 10 if num_neg_samples is None else int(num_neg_samples)
+    num_true = label.shape[1] if len(label.shape) > 1 else 1
+    bsz = input.shape[0]
+    w = creation.create_parameter([n, dim], "float32", attr=param_attr)
+    b = creation.create_parameter([n], "float32", attr=bias_attr,
+                                  is_bias=True)
+    rng = np.random.RandomState(seed if seed else None)
+    if sampler == "uniform":
+        negs = rng.randint(0, n, size=(bsz, k))
+    elif sampler == "log_uniform":
+        # inverse-transform sampling of f(x) ~ 1/((x+1) ln(range+1))
+        # (reference math/sampler.cc LogUniformSampler::Sample)
+        u = rng.rand(bsz, k)
+        negs = (np.exp(u * np.log(n)).astype(np.int64) - 1) % n
+    elif sampler == "custom_dist":
+        p = np.asarray(custom_dist, np.float64)
+        p = p / p.sum()
+        negs = rng.choice(n, size=(bsz, k), p=p)
+    else:
+        raise ValueError(f"sampler must be uniform/log_uniform/"
+                         f"custom_dist, got {sampler!r}")
+    negs_t = np.asarray(negs, np.int64)
+    dist = None if sampler != "custom_dist" else \
+        np.asarray(custom_dist, np.float32)
+
+    def _nce(x, lab, negs, sw, dist_arr, w, b, *, n, k, num_true, samp):
+        import jax
+        import jax.numpy as jnp
+
+        lab = lab.reshape(lab.shape[0], -1)
+        sl = jnp.concatenate([lab.astype(jnp.int32),
+                              negs.astype(jnp.int32)], axis=1)
+        logits = jnp.einsum("bd,bsd->bs", x, w[sl]) + b[sl]
+        o = jax.nn.sigmoid(logits)
+        if samp == "uniform":
+            q = jnp.full(sl.shape, 1.0 / n)
+        elif samp == "log_uniform":
+            q = jnp.log((sl + 2.0) / (sl + 1.0)) / jnp.log(float(n))
+        else:
+            # runtime operand, NOT a static kwarg: a vocab-sized tuple
+            # in the cache key costs O(V) hashing per call and bakes a
+            # million-element constant into the HLO
+            q = dist_arr[sl]
+        B = q * k
+        is_true = jnp.arange(sl.shape[1]) < num_true
+        cost = jnp.where(is_true[None, :],
+                         -jnp.log(o / (o + B)),
+                         -jnp.log(B / (o + B)))
+        out = jnp.sum(cost, axis=1, keepdims=True)
+        if sw is not None:
+            out = out * sw.reshape(-1, 1)
+        return out
+
+    from ..core.tensor import Tensor
+
+    dist_t = None if dist is None else Tensor(dist, stop_gradient=True)
+    return apply_op("nce", _nce, input, label,
+                    Tensor(negs_t, stop_gradient=True), sample_weight,
+                    dist_t, w, b, n=n, k=k, num_true=int(num_true),
+                    samp=sampler)
 
 
 def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):

@@ -534,3 +534,139 @@ def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
         np.zeros(0, np.float32)
     order = np.argsort(-scores)[:post_nms_top_n]
     return Tensor(rois[order].astype(np.float32))
+
+
+def _deform_sample(x, py, px, dg):
+    """Bilinear-sample x [B,C,H,W] at per-(def group, tap, out pos)
+    fractional coords py/px [B,dg,K,Ho,Wo] -> [B,C,K,Ho,Wo].
+
+    Border semantics follow the reference im2col
+    (operators/math/deformable_im2col / modulated_deformable_im2col):
+    each corner contributes only while it lies inside the feature map,
+    so a point sliding off the edge fades to zero.
+    """
+    b, c, h, w = x.shape
+    cpg = c // dg
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    parts = []
+    for dy, dx in ((0, 0), (0, 1), (1, 0), (1, 1)):
+        yc = y0 + dy
+        xc = x0 + dx
+        wgt = ((1 - jnp.abs(py - yc)) * (1 - jnp.abs(px - xc)))
+        valid = ((yc >= 0) & (yc <= h - 1) & (xc >= 0) & (xc <= w - 1))
+        wgt = jnp.where(valid, wgt, 0.0)
+        yi = jnp.clip(yc, 0, h - 1).astype(jnp.int32)
+        xi = jnp.clip(xc, 0, w - 1).astype(jnp.int32)
+        flat = yi * w + xi                       # [B,dg,K,Ho,Wo]
+        # one gather per channel block: repeat the dg axis out to C
+        flat_c = jnp.repeat(flat, cpg, axis=1)   # [B,C,K,Ho,Wo]
+        wgt_c = jnp.repeat(wgt, cpg, axis=1)
+        xf = x.reshape(b, c, h * w)
+        gathered = jnp.take_along_axis(
+            xf[:, :, None, :], flat_c.reshape(b, c, -1)[:, :, None, :],
+            axis=-1)[:, :, 0, :].reshape(flat_c.shape)
+        parts.append(gathered * wgt_c.astype(x.dtype))
+    return parts[0] + parts[1] + parts[2] + parts[3]
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1 (mask=None) / v2 (modulated).
+
+    reference: operators/deformable_conv_op.cc +
+    operators/math/deformable_im2col.(cc|h); python API
+    python/paddle/vision/ops.py:394 (deform_conv2d).
+
+    TPU-native design: instead of the reference's im2col scratch +
+    GEMM per image, the sampled taps are built with vectorized bilinear
+    gathers ([B, C, kH*kW, Ho, Wo]) and contracted with the kernel in
+    one einsum, which XLA maps onto the MXU. offset channels are
+    ordered (y, x) per tap like the reference kernel:
+    offset[:, 2*(g*K + k)] is Δy for def-group g, tap k.
+    """
+    from ..nn.functional import _pair, _norm_padding
+
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    pad = _norm_padding(padding, 2)
+    pads = [(p, p) if isinstance(p, int) else tuple(p) for p in pad]
+
+    def _deform(x, offset, mask_arr, w, b, *, stride, pads, dilation,
+                dg, groups):
+        bsz, c, h, wdt = x.shape
+        cout, cpg_w, kh, kw = w.shape
+        k = kh * kw
+        ho = (h + pads[0][0] + pads[0][1]
+              - dilation[0] * (kh - 1) - 1) // stride[0] + 1
+        wo = (wdt + pads[1][0] + pads[1][1]
+              - dilation[1] * (kw - 1) - 1) // stride[1] + 1
+        # base sampling grid p0 + p_k (tap offsets), then learned Δ
+        iy = jnp.arange(ho) * stride[0] - pads[0][0]
+        ix = jnp.arange(wo) * stride[1] - pads[1][0]
+        ky, kx = jnp.meshgrid(jnp.arange(kh) * dilation[0],
+                              jnp.arange(kw) * dilation[1], indexing="ij")
+        base_y = (iy[None, :, None] + ky.reshape(-1)[:, None, None])
+        base_x = (ix[None, None, :] + kx.reshape(-1)[:, None, None])
+        off = offset.reshape(bsz, dg, k, 2, ho, wo)
+        py = base_y[None, None] + off[:, :, :, 0]
+        px = base_x[None, None] + off[:, :, :, 1]
+        sampled = _deform_sample(x, py, px, dg)   # [B,C,K,Ho,Wo]
+        if mask_arr is not None:
+            m = jnp.repeat(mask_arr.reshape(bsz, dg, k, ho, wo),
+                           c // dg, axis=1)
+            sampled = sampled * m.astype(sampled.dtype)
+        # grouped contraction: out group g uses in-channel block g
+        sampled = sampled.reshape(bsz, groups, c // groups, k, ho, wo)
+        wg = w.reshape(groups, cout // groups, cpg_w, kh * kw)
+        y = jnp.einsum("bgckhw,gock->bgohw", sampled, wg)
+        y = y.reshape(bsz, cout, ho, wo)
+        if b is not None:
+            y = y + b.reshape(1, -1, 1, 1)
+        return y
+
+    return apply_op("deform_conv2d", _deform, x, offset, mask, weight,
+                    bias, stride=stride, pads=tuple(pads),
+                    dilation=dilation, dg=int(deformable_groups),
+                    groups=int(groups))
+
+
+def _nn():
+    from .. import nn
+
+    return nn
+
+
+class DeformConv2D(_nn().Layer):
+    """Deformable conv layer (reference: python/paddle/vision/ops.py:598
+    DeformConv2D): holds weight [out, in/groups, kH, kW] (+ bias) and
+    applies ``deform_conv2d``; forward(x, offset, mask=None) — mask=None
+    is v1, a mask tensor is v2 (modulated)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        nn = _nn()
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else (kernel_size, kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        bound = 1.0 / np.sqrt(in_channels // groups * ks[0] * ks[1])
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, ks[0], ks[1]],
+            default_initializer=nn.initializer.Uniform(-bound, bound))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], is_bias=True,
+            default_initializer=nn.initializer.Uniform(-bound, bound))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, bias=self.bias, stride=self._stride,
+            padding=self._padding, dilation=self._dilation,
+            deformable_groups=self._deformable_groups,
+            groups=self._groups, mask=mask)

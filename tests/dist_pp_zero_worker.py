@@ -1,0 +1,118 @@
+"""2-process worker completing the multi-process axis coverage
+(reference: test_dist_base.py:682 runs every strategy through real
+trainer processes): pipeline parallelism (in-graph ppermute) and ZeRO-2
+sharding each train on a mesh that SPANS the two processes — one
+virtual CPU device per rank, 2 global, so the pp / sharding axis IS the
+process boundary. Rank 0 writes {"pp": [...], "zero2": [...]} loss
+sequences to argv[1]; the launching test compares against 1-proc
+oracles on the same seeds.
+"""
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn, optimizer  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.distributed import pipeline as pipe  # noqa: E402
+from paddle_tpu.distributed import spmd, topology  # noqa: E402
+
+
+def build_pp(mesh, hidden=16):
+    paddle.seed(31)
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(hidden, hidden)
+
+        def forward(self, x):
+            return paddle.tanh(self.fc(x))
+
+    import jax.numpy as jnp
+
+    pre = [nn.Linear(8, hidden)]
+    blocks = [Block() for _ in range(4)]
+    post = [nn.Linear(hidden, 4)]
+    opt = optimizer.SGD(0.1, parameters=[
+        p for l in pre + blocks + post for p in l.parameters()])
+    return pipe.build_pipeline_train_step(
+        pre, blocks, post, lambda o, y: jnp.mean((o - y) ** 2), opt,
+        mesh=mesh, num_micro=2)
+
+
+def build_zero2(mesh):
+    import jax.numpy as jnp
+
+    paddle.seed(32)
+    model = nn.Sequential(nn.Linear(8, 32), nn.Tanh(), nn.Linear(32, 4))
+    opt = optimizer.AdamW(1e-2, parameters=model.parameters())
+    return spmd.build_train_step(
+        model, lambda o, y: jnp.mean((o - y) ** 2), opt, mesh=mesh,
+        sharding_stage=2)
+
+
+def pp_data():
+    rng = np.random.RandomState(5)
+    return (rng.randn(8, 8).astype(np.float32),
+            rng.randn(8, 4).astype(np.float32))
+
+
+def zero_data():
+    rng = np.random.RandomState(6)
+    return (rng.randn(8, 8).astype(np.float32),
+            rng.randn(8, 4).astype(np.float32))
+
+
+def main():
+    out_path = sys.argv[1]
+    dist.init_parallel_env()
+    rank, world = dist.get_rank(), dist.get_world_size()
+    assert world == 2 and len(jax.devices()) == 2
+    assert len(jax.local_devices()) == 1
+
+    # ---- pipeline: pp axis == process boundary
+    mesh_pp = topology.build_mesh(pp=2)
+    topology.set_global_mesh(mesh_pp)
+    pstep, pinit = build_pp(mesh_pp)
+    pparams, pstate = pinit()
+    x, y = pp_data()  # dp=1: batch replicated, both ranks feed it whole
+    xg = spmd.shard_batch(x, mesh_pp)
+    yg = spmd.shard_batch(y, mesh_pp)
+    pp_losses = []
+    for _ in range(3):
+        loss, pparams, pstate = pstep(pparams, pstate, xg, yg,
+                                      key=jax.random.PRNGKey(0))
+        pp_losses.append(float(jax.device_get(loss)))
+
+    # ---- ZeRO-2: sharding axis == process boundary
+    mesh_z = topology.build_mesh(sharding=2)
+    topology.set_global_mesh(mesh_z)
+    zstep, zinit = build_zero2(mesh_z)
+    zparams, zstate = zinit()
+    xz, yz = zero_data()
+    half = xz.shape[0] // world  # each rank feeds its local half
+    xg = spmd.shard_batch(xz[rank * half:(rank + 1) * half], mesh_z)
+    yg = spmd.shard_batch(yz[rank * half:(rank + 1) * half], mesh_z)
+    z_losses = []
+    for _ in range(3):
+        loss, zparams, zstate = zstep(zparams, zstate, xg, yg,
+                                      key=jax.random.PRNGKey(0))
+        z_losses.append(float(jax.device_get(loss)))
+
+    if rank == 0:
+        with open(out_path, "w") as f:
+            json.dump({"pp": pp_losses, "zero2": z_losses}, f)
+    print(f"rank {rank} pp={pp_losses} zero2={z_losses}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

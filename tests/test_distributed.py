@@ -648,6 +648,63 @@ class TestMultiProcess:
             one_proc.append(float(loss))
         np.testing.assert_allclose(two_proc, one_proc, rtol=2e-5, atol=1e-6)
 
+    def test_2proc_pipeline_and_zero2_loss_match(self, tmp_path):
+        """Completes the multi-process axis coverage (reference:
+        test_dist_base.py:682): pipeline (in-graph ppermute) and ZeRO-2
+        sharding each on a mesh whose pp / sharding axis IS the process
+        boundary (1 device per rank), loss-matched vs 1-proc oracles."""
+        import importlib.util
+        import json
+
+        import jax
+        from paddle_tpu.distributed import launch_mod
+
+        out = tmp_path / "pp_zero_losses.json"
+        worker = os.path.join(os.path.dirname(__file__),
+                              "dist_pp_zero_worker.py")
+        launch_mod.launch_collective(worker, [str(out)], nproc_per_node=2,
+                                     log_dir=str(tmp_path / "logs"))
+        two_proc = json.load(open(out))
+
+        devs = jax.devices()  # init the 8-device CPU backend FIRST: the
+        # worker module sets XLA_FLAGS=1-device at import for its
+        # subprocess role, which must not win the lazy backend init
+        flags_before = os.environ.get("XLA_FLAGS")
+        spec = importlib.util.spec_from_file_location("dist_pp_zero_worker",
+                                                      worker)
+        wmod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(wmod)
+        if flags_before is not None:
+            os.environ["XLA_FLAGS"] = flags_before
+
+        mesh_pp = topology.build_mesh(pp=2, devices=devs[:2])
+        topology.set_global_mesh(mesh_pp)
+        pstep, pinit = wmod.build_pp(mesh_pp)
+        pparams, pstate = pinit()
+        x, y = wmod.pp_data()
+        xg, yg = spmd.shard_batch(x, mesh_pp), spmd.shard_batch(y, mesh_pp)
+        pp_oracle = []
+        for _ in range(3):
+            loss, pparams, pstate = pstep(pparams, pstate, xg, yg,
+                                          key=jax.random.PRNGKey(0))
+            pp_oracle.append(float(loss))
+        np.testing.assert_allclose(two_proc["pp"], pp_oracle, rtol=2e-5,
+                                   atol=1e-6)
+
+        mesh_z = topology.build_mesh(sharding=2, devices=devs[:2])
+        topology.set_global_mesh(mesh_z)
+        zstep, zinit = wmod.build_zero2(mesh_z)
+        zparams, zstate = zinit()
+        xz, yz = wmod.zero_data()
+        xg, yg = spmd.shard_batch(xz, mesh_z), spmd.shard_batch(yz, mesh_z)
+        z_oracle = []
+        for _ in range(3):
+            loss, zparams, zstate = zstep(zparams, zstate, xg, yg,
+                                          key=jax.random.PRNGKey(0))
+            z_oracle.append(float(loss))
+        np.testing.assert_allclose(two_proc["zero2"], z_oracle, rtol=2e-5,
+                                   atol=1e-6)
+
     def test_2proc_llama_dp_mp_loss_match(self, tmp_path):
         """Model-scale across processes (reference: test_dist_base.py:682
         dist_transformer): tiny Llama with real tensor-parallel shardings

@@ -1,0 +1,408 @@
+"""OpTest-style numeric checks for the last runtime-raising op edges
+(round-4 verdict Missing #4): pool return_mask, grouped conv-transpose,
+deform_conv2d, nce, py_func backward — plus the in-place autograd
+adoption fix their wiring exposed (_assign_result self-cycle).
+
+Oracles: torch-CPU where torch has the op, hand-written numpy loops for
+deform_conv2d (torchvision is not in the image), closed-form math for
+nce (reference operators/nce_op.h cost formula).
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import static
+
+
+class TestMaxPoolReturnMask:
+    def test_max_pool2d_mask_vs_torch(self):
+        x = np.random.RandomState(0).rand(2, 3, 7, 9).astype(np.float32)
+        out, mask = F.max_pool2d(paddle.to_tensor(x), 3, stride=2,
+                                 padding=1, return_mask=True)
+        to, ti = torch.nn.functional.max_pool2d(
+            torch.tensor(x), 3, 2, 1, return_indices=True)
+        np.testing.assert_allclose(np.asarray(out._value), to.numpy(),
+                                   atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(mask._value), ti.numpy())
+
+    def test_max_pool1d_and_3d_mask(self):
+        rng = np.random.RandomState(1)
+        x1 = rng.rand(2, 4, 11).astype(np.float32)
+        o1, m1 = F.max_pool1d(paddle.to_tensor(x1), 3, 2, 1,
+                              return_mask=True)
+        t1, i1 = torch.nn.functional.max_pool1d(
+            torch.tensor(x1), 3, 2, 1, return_indices=True)
+        np.testing.assert_allclose(np.asarray(o1._value), t1.numpy(),
+                                   atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(m1._value), i1.numpy())
+        x3 = rng.rand(1, 2, 6, 7, 5).astype(np.float32)
+        o3, m3 = F.max_pool3d(paddle.to_tensor(x3), 2, 2, 0,
+                              return_mask=True)
+        t3, i3 = torch.nn.functional.max_pool3d(
+            torch.tensor(x3), 2, 2, 0, return_indices=True)
+        np.testing.assert_allclose(np.asarray(o3._value), t3.numpy(),
+                                   atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(m3._value), i3.numpy())
+
+    def test_adaptive_masks_divisible_and_not(self):
+        rng = np.random.RandomState(2)
+        for shape, outsz in [((2, 3, 10, 10), 5), ((2, 3, 7, 9), (3, 4))]:
+            xa = rng.rand(*shape).astype(np.float32)
+            oa, ma = F.adaptive_max_pool2d(paddle.to_tensor(xa), outsz,
+                                           return_mask=True)
+            ta, ia = torch.nn.functional.adaptive_max_pool2d(
+                torch.tensor(xa), outsz, return_indices=True)
+            np.testing.assert_allclose(np.asarray(oa._value), ta.numpy(),
+                                       atol=1e-6)
+            np.testing.assert_array_equal(np.asarray(ma._value),
+                                          ia.numpy())
+        x1 = rng.rand(2, 3, 11).astype(np.float32)
+        o1, m1 = F.adaptive_max_pool1d(paddle.to_tensor(x1), 4,
+                                       return_mask=True)
+        t1, i1 = torch.nn.functional.adaptive_max_pool1d(
+            torch.tensor(x1), 4, return_indices=True)
+        np.testing.assert_allclose(np.asarray(o1._value), t1.numpy(),
+                                   atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(m1._value), i1.numpy())
+        x3 = rng.rand(1, 2, 5, 6, 7).astype(np.float32)
+        o3, m3 = F.adaptive_max_pool3d(paddle.to_tensor(x3), (2, 3, 4),
+                                       return_mask=True)
+        t3, i3 = torch.nn.functional.adaptive_max_pool3d(
+            torch.tensor(x3), (2, 3, 4), return_indices=True)
+        np.testing.assert_allclose(np.asarray(o3._value), t3.numpy(),
+                                   atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(m3._value), i3.numpy())
+
+
+class TestGroupedConvTranspose:
+    def test_conv2d_transpose_groups_vs_torch(self):
+        rng = np.random.RandomState(3)
+        x = rng.rand(2, 6, 5, 5).astype(np.float32)
+        w = rng.rand(6, 2, 3, 3).astype(np.float32)  # [in, out/g, k, k]
+        b = rng.rand(4).astype(np.float32)
+        y = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                               paddle.to_tensor(b), stride=2, padding=1,
+                               output_padding=1, groups=2)
+        yt = torch.nn.functional.conv_transpose2d(
+            torch.tensor(x), torch.tensor(w), torch.tensor(b), stride=2,
+            padding=1, output_padding=1, groups=2)
+        np.testing.assert_allclose(np.asarray(y._value), yt.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_depthwise_transpose_with_dilation(self):
+        rng = np.random.RandomState(4)
+        x = rng.rand(1, 4, 6, 6).astype(np.float32)
+        w = rng.rand(4, 2, 3, 3).astype(np.float32)
+        y = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                               None, dilation=2, groups=4)
+        yt = torch.nn.functional.conv_transpose2d(
+            torch.tensor(x), torch.tensor(w), None, dilation=2, groups=4)
+        np.testing.assert_allclose(np.asarray(y._value), yt.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_conv1d_transpose_groups(self):
+        rng = np.random.RandomState(5)
+        x = rng.rand(2, 4, 7).astype(np.float32)
+        w = rng.rand(4, 3, 3).astype(np.float32)
+        y = F.conv1d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                               None, stride=2, padding=1, groups=2)
+        yt = torch.nn.functional.conv_transpose1d(
+            torch.tensor(x), torch.tensor(w), None, stride=2, padding=1,
+            groups=2)
+        np.testing.assert_allclose(np.asarray(y._value), yt.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def _naive_deform(x, off, w, b, stride, pad, dil, dg, groups, mask=None):
+    """Loop oracle for the reference im2col border/bilinear semantics
+    (operators/math/deformable_im2col.cc)."""
+    B, C, H, W = x.shape
+    Cout, _, KH, KW = w.shape
+    K = KH * KW
+    Ho = (H + 2 * pad - dil * (KH - 1) - 1) // stride + 1
+    Wo = (W + 2 * pad - dil * (KW - 1) - 1) // stride + 1
+    out = np.zeros((B, Cout, Ho, Wo), np.float64)
+    cpg_in = C // groups
+    cpdg = C // dg
+
+    def bil(xc, py, px):
+        if py <= -1 or py >= H or px <= -1 or px >= W:
+            return 0.0
+        y0, x0 = int(np.floor(py)), int(np.floor(px))
+        v = 0.0
+        for dy in (0, 1):
+            for dx in (0, 1):
+                yy, xx = y0 + dy, x0 + dx
+                if 0 <= yy < H and 0 <= xx < W:
+                    v += (1 - abs(py - yy)) * (1 - abs(px - xx)) * xc[yy, xx]
+        return v
+
+    for bi in range(B):
+        for o in range(Cout):
+            g = o // (Cout // groups)
+            for i in range(Ho):
+                for j in range(Wo):
+                    acc = 0.0
+                    for ci in range(cpg_in):
+                        c = g * cpg_in + ci
+                        dgi = c // cpdg
+                        for kh in range(KH):
+                            for kw in range(KW):
+                                kk = kh * KW + kw
+                                oy = off[bi, 2 * (dgi * K + kk), i, j]
+                                ox = off[bi, 2 * (dgi * K + kk) + 1, i, j]
+                                v = bil(x[bi, c], i * stride - pad + kh * dil + oy,
+                                        j * stride - pad + kw * dil + ox)
+                                if mask is not None:
+                                    v *= mask[bi, dgi * K + kk, i, j]
+                                acc += v * w[o, ci, kh, kw]
+                    out[bi, o, i, j] = acc + (b[o] if b is not None else 0.0)
+    return out
+
+
+class TestDeformConv2D:
+    def test_v2_modulated_vs_naive(self):
+        from paddle_tpu.vision.ops import deform_conv2d
+
+        rng = np.random.RandomState(6)
+        dg, groups = 2, 2
+        x = rng.rand(2, 4, 6, 6).astype(np.float32)
+        w = rng.rand(6, 2, 3, 3).astype(np.float32)
+        b = rng.rand(6).astype(np.float32)
+        off = (rng.rand(2, 2 * dg * 9, 6, 6).astype(np.float32) - 0.5) * 3
+        msk = rng.rand(2, dg * 9, 6, 6).astype(np.float32)
+        got = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                            paddle.to_tensor(w), paddle.to_tensor(b),
+                            padding=1, deformable_groups=dg, groups=groups,
+                            mask=paddle.to_tensor(msk))
+        want = _naive_deform(x, off, w, b, 1, 1, 1, dg, groups, msk)
+        np.testing.assert_allclose(np.asarray(got._value), want,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_v1_trains(self):
+        """v1 (no mask) + gradient flow through x, offset and weight."""
+        from paddle_tpu.vision.ops import deform_conv2d
+
+        rng = np.random.RandomState(7)
+        x = paddle.to_tensor(rng.rand(1, 2, 5, 5).astype(np.float32),
+                             stop_gradient=False)
+        off = paddle.to_tensor(
+            (rng.rand(1, 2 * 9, 5, 5).astype(np.float32) - 0.5),
+            stop_gradient=False)
+        w = paddle.to_tensor(rng.rand(3, 2, 3, 3).astype(np.float32),
+                             stop_gradient=False)
+        y = deform_conv2d(x, off, w, None, padding=1)
+        want = _naive_deform(np.asarray(x._value), np.asarray(off._value),
+                             np.asarray(w._value), None, 1, 1, 1, 1, 1)
+        np.testing.assert_allclose(np.asarray(y._value), want,
+                                   rtol=1e-4, atol=1e-4)
+        y.sum().backward()
+        for t in (x, off, w):
+            assert t.grad is not None
+            assert np.abs(np.asarray(t.grad._value)).sum() > 0
+
+    def test_layer_class(self):
+        from paddle_tpu.vision.ops import DeformConv2D
+
+        paddle.seed(0)
+        layer = DeformConv2D(4, 6, 3, padding=1, deformable_groups=2,
+                             groups=2)
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.rand(1, 4, 5, 5).astype(np.float32))
+        off = paddle.to_tensor(np.zeros((1, 36, 5, 5), np.float32))
+        y = layer(x, off)
+        assert list(y.shape) == [1, 6, 5, 5]
+        assert len(layer.parameters()) == 2
+        # all-ones mask == v1 (no modulation)
+        msk = paddle.to_tensor(np.ones((1, 18, 5, 5), np.float32))
+        np.testing.assert_allclose(np.asarray(y._value),
+                                   np.asarray(layer(x, off, msk)._value),
+                                   atol=1e-6)
+
+    def test_static_builder_creates_params(self):
+        from paddle_tpu.static import nn_extra
+
+        rng = np.random.RandomState(8)
+        x = paddle.to_tensor(rng.rand(1, 4, 5, 5).astype(np.float32))
+        off = paddle.to_tensor(np.zeros((1, 18, 5, 5), np.float32))
+        y = nn_extra.deform_conv2d(x, off, None, num_filters=6,
+                                   filter_size=3, padding=1)
+        assert list(y.shape) == [1, 6, 5, 5]
+        # zero offsets == plain conv with the same kernel: sanity bound
+        assert np.isfinite(np.asarray(y._value)).all()
+
+
+class TestNCE:
+    def test_numeric_vs_formula(self):
+        from paddle_tpu.static import nn_extra
+        from paddle_tpu.tensor import creation
+
+        rng = np.random.RandomState(1)
+        B, D, N, K = 4, 8, 20, 5
+        x = rng.rand(B, D).astype(np.float32)
+        lab = rng.randint(0, N, (B, 1)).astype(np.int64)
+        created = {}
+        orig = creation.create_parameter
+
+        def cp(shape, *a, **kw):
+            p = orig(shape, *a, **kw)
+            created[tuple(shape)] = p
+            return p
+
+        creation.create_parameter = cp
+        try:
+            paddle.seed(0)
+            out = nn_extra.nce(paddle.to_tensor(x), paddle.to_tensor(lab),
+                               N, num_neg_samples=K, sampler="uniform",
+                               seed=7)
+        finally:
+            creation.create_parameter = orig
+        wv = np.asarray(created[(N, D)]._value)
+        bv = np.asarray(created[(N,)]._value)
+        negs = np.random.RandomState(7).randint(0, N, size=(B, K))
+        sl = np.concatenate([lab, negs], axis=1)
+        o = 1 / (1 + np.exp(-(np.einsum("bd,bsd->bs", x, wv[sl]) + bv[sl])))
+        Bq = (1.0 / N) * K
+        cost = np.where(np.arange(sl.shape[1])[None] < 1,
+                        -np.log(o / (o + Bq)), -np.log(Bq / (o + Bq)))
+        np.testing.assert_allclose(np.asarray(out._value),
+                                   cost.sum(1, keepdims=True),
+                                   rtol=1e-4, atol=1e-5)
+        out.sum().backward()
+        g = created[(N, D)].grad
+        assert g is not None and np.abs(np.asarray(g._value)).sum() > 0
+
+    def test_other_samplers_finite(self):
+        from paddle_tpu.static import nn_extra
+
+        rng = np.random.RandomState(2)
+        x = rng.rand(3, 6).astype(np.float32)
+        lab = rng.randint(0, 15, (3, 1)).astype(np.int64)
+        o = nn_extra.nce(paddle.to_tensor(x), paddle.to_tensor(lab), 15,
+                         num_neg_samples=4, sampler="log_uniform", seed=3)
+        assert np.isfinite(np.asarray(o._value)).all()
+        dist = rng.rand(15)
+        dist /= dist.sum()
+        o2 = nn_extra.nce(paddle.to_tensor(x), paddle.to_tensor(lab), 15,
+                          num_neg_samples=4, sampler="custom_dist",
+                          custom_dist=dist, seed=3)
+        assert np.isfinite(np.asarray(o2._value)).all()
+        with pytest.raises(ValueError, match="sampler"):
+            nn_extra.nce(paddle.to_tensor(x), paddle.to_tensor(lab), 15,
+                         sampler="bogus")
+
+
+class TestPyFuncBackward:
+    def test_eager_custom_grad(self):
+        def fwd(a):
+            return a * a + 1.0
+
+        def bwd(a, out, dout):
+            return dout * 2.0 * a
+
+        x = paddle.to_tensor(np.array([1., 2., 3.], np.float32),
+                             stop_gradient=False)
+        res = static.py_func(fwd, x,
+                             paddle.to_tensor(np.zeros(3, np.float32)),
+                             backward_func=bwd)
+        np.testing.assert_allclose(res.numpy(), [2., 5., 10.])
+        res.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2., 4., 6.])
+
+    def test_compiled_custom_grad(self):
+        import jax
+        import jax.numpy as jnp
+
+        def fwd(a):
+            return a * a + 1.0
+
+        def bwd(a, out, dout):
+            return dout * 2.0 * a
+
+        def loss_fn(xv):
+            r = static.py_func(
+                fwd, paddle.to_tensor(xv),
+                paddle.to_tensor(np.zeros(3, np.float32)),
+                backward_func=bwd)
+            return jnp.sum(r._value)
+
+        g = jax.grad(loss_fn)(jnp.asarray([1.0, 2.0, 3.0]))
+        np.testing.assert_allclose(np.asarray(g), [2., 4., 6.], atol=1e-6)
+
+    def test_skip_vars(self):
+        def fwd(a):
+            return a * 2.0
+
+        def bwd(out, dout):  # input skipped: only (out, dout) arrive
+            return dout * 3.0
+
+        x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        o = static.py_func(fwd, x,
+                           paddle.to_tensor(np.zeros(2, np.float32)),
+                           backward_func=bwd,
+                           skip_vars_in_backward_input=[x])
+        o.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3., 3.])
+
+
+class TestNoHiddenHoles:
+    def test_smoke_scan_clean(self):
+        """Every callable that passes signature parity must be callable:
+        no undocumented unconditional NotImplementedError bodies left
+        (tools/api_parity.py --smoke)."""
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        import api_parity
+
+        assert api_parity.check_smoke(verbose=False) == []
+
+
+class TestFromGenerator:
+    def test_sample_generator_feed_dicts(self):
+        from paddle_tpu.io import DataLoader
+
+        class V:
+            def __init__(self, name):
+                self.name = name
+
+        loader = DataLoader.from_generator(feed_list=[V("x"), V("y")])
+
+        def reader():
+            for i in range(5):
+                yield [np.full((3,), i, np.float32),
+                       np.array(i, np.int64)]
+
+        loader.set_sample_generator(reader, batch_size=2, drop_last=False)
+        feeds = list(loader())
+        assert len(feeds) == 3
+        assert set(feeds[0]) == {"x", "y"}
+        assert feeds[0]["x"].shape == (2, 3)
+        assert feeds[2]["x"].shape == (1, 3)  # drop_last=False tail
+
+    def test_batch_generator_return_list(self):
+        from paddle_tpu.io import DataLoader
+
+        def breader():
+            yield [np.zeros((4, 2), np.float32)]
+
+        lb = DataLoader.from_generator(
+            feed_list=None, return_list=True).set_batch_generator(breader)
+        out = list(lb)
+        assert out[0][0].shape == (4, 2)
+
+
+class TestInplaceAdoptionGrad:
+    def test_inplace_op_keeps_chain(self):
+        """_assign_result used to self-cycle the tape (y = relu_(y)):
+        every in-place op silently produced no gradient."""
+        x = paddle.to_tensor(np.array([-1.0, 2.0], np.float32),
+                             stop_gradient=False)
+        y = x * 2.0
+        F.relu_(y)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0., 2.])

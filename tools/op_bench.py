@@ -72,12 +72,109 @@ def run_case(case):
             "latency_us": round(dt * 1e6, 2)}
 
 
+# ---------------------------------------------------------------------
+# Eager dispatch-overhead tier (reference rationale: the whole
+# core.ops.* codegen fast path exists because per-op eager overhead
+# decides usability — pybind/op_function_generator.cc:497). Small
+# shapes so dispatch, not math, dominates; compared against torch-CPU
+# eager, the reference's own eager benchmark.
+
+
+_EAGER_SHAPE = (8, 8)
+_EAGER_OPS = [
+    # (name, paddle call, torch call) over one or two [8,8] f32 inputs
+    ("add", lambda p, a, b: a + b, lambda t, a, b: a + b),
+    ("mul", lambda p, a, b: a * b, lambda t, a, b: a * b),
+    ("sub", lambda p, a, b: a - b, lambda t, a, b: a - b),
+    ("matmul", lambda p, a, b: p.matmul(a, b),
+     lambda t, a, b: t.matmul(a, b)),
+    ("relu", lambda p, a, b: p.nn.functional.relu(a),
+     lambda t, a, b: t.nn.functional.relu(a)),
+    ("tanh", lambda p, a, b: p.tanh(a), lambda t, a, b: t.tanh(a)),
+    ("sigmoid", lambda p, a, b: p.nn.functional.sigmoid(a),
+     lambda t, a, b: t.sigmoid(a)),
+    ("exp", lambda p, a, b: p.exp(a), lambda t, a, b: t.exp(a)),
+    ("abs", lambda p, a, b: p.abs(a), lambda t, a, b: t.abs(a)),
+    ("softmax", lambda p, a, b: p.nn.functional.softmax(a, axis=-1),
+     lambda t, a, b: t.softmax(a, dim=-1)),
+    ("gelu", lambda p, a, b: p.nn.functional.gelu(a),
+     lambda t, a, b: t.nn.functional.gelu(a)),
+    ("sum", lambda p, a, b: p.sum(a), lambda t, a, b: t.sum(a)),
+    ("mean", lambda p, a, b: p.mean(a), lambda t, a, b: t.mean(a)),
+    ("max", lambda p, a, b: p.max(a), lambda t, a, b: t.max(a)),
+    ("reshape", lambda p, a, b: p.reshape(a, [64]),
+     lambda t, a, b: t.reshape(a, (64,))),
+    ("transpose", lambda p, a, b: p.transpose(a, [1, 0]),
+     lambda t, a, b: a.t()),
+    ("concat", lambda p, a, b: p.concat([a, b], axis=0),
+     lambda t, a, b: t.cat([a, b], dim=0)),
+    ("maximum", lambda p, a, b: p.maximum(a, b),
+     lambda t, a, b: t.maximum(a, b)),
+    ("clip", lambda p, a, b: p.clip(a, 0.2, 0.8),
+     lambda t, a, b: t.clamp(a, 0.2, 0.8)),
+    ("layer_norm",
+     lambda p, a, b: p.nn.functional.layer_norm(a, 8),
+     lambda t, a, b: t.nn.functional.layer_norm(a, (8,))),
+]
+
+
+def run_eager_overhead(repeat=300):
+    """μs/op of the eager cache-hit dispatch path vs torch-CPU eager.
+
+    Protocol: warm once (compile + cache fill), then time `repeat`
+    back-to-back eager calls and block once at the end — the amortized
+    per-call dispatch cost, the quantity the reference's core.ops fast
+    path optimizes. torch CPU eager is synchronous; same loop shape."""
+    import jax
+    import torch
+
+    import paddle_tpu as paddle
+
+    rng = np.random.RandomState(0)
+    a_np = rng.rand(*_EAGER_SHAPE).astype(np.float32)
+    b_np = rng.rand(*_EAGER_SHAPE).astype(np.float32)
+    pa, pb = paddle.to_tensor(a_np), paddle.to_tensor(b_np)
+    ta, tb = torch.tensor(a_np), torch.tensor(b_np)
+    rows = []
+    for name, pfn, tfn in _EAGER_OPS:
+        out = pfn(paddle, pa, pb)          # warm: compile + cache fill
+        jax.block_until_ready(out._value)
+        t0 = time.perf_counter()
+        for _ in range(repeat):
+            out = pfn(paddle, pa, pb)
+        jax.block_until_ready(out._value)
+        ours = (time.perf_counter() - t0) / repeat * 1e6
+
+        tfn(torch, ta, tb)                 # torch warm
+        t0 = time.perf_counter()
+        for _ in range(repeat):
+            tout = tfn(torch, ta, tb)
+        del tout
+        theirs = (time.perf_counter() - t0) / repeat * 1e6
+        rows.append({"op": name, "ours_us": round(ours, 2),
+                     "torch_us": round(theirs, 2),
+                     "ratio": round(ours / max(theirs, 1e-9), 2)})
+    return rows
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config")
     ap.add_argument("--out")
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--eager-overhead", action="store_true",
+                    help="μs/op eager dispatch vs torch-CPU eager")
     ns = ap.parse_args()
+    if ns.eager_overhead:
+        rows = run_eager_overhead()
+        for r in rows:
+            print(f"{r['op']:<12} ours {r['ours_us']:>8.2f} us   "
+                  f"torch {r['torch_us']:>8.2f} us   x{r['ratio']}",
+                  file=sys.stderr)
+        if ns.out:
+            json.dump(rows, open(ns.out, "w"), indent=1)
+        print(json.dumps(rows))
+        return
     cases = QUICK if ns.quick or not ns.config else \
         json.load(open(ns.config))
     results = []
