@@ -228,10 +228,17 @@ class Transport:
                     meta = json.loads(_recv_exact(conn, meta_len))
                     # inbound guard: the listener is unauthenticated, so
                     # never allocate from unvalidated wire meta. Python
-                    # ints (no overflow) + non-negative dims + cap.
-                    nbytes = int(meta["nbytes"])
-                    shape = [int(d) for d in meta["shape"]]
-                    want = np.dtype(meta["dtype"]).itemsize
+                    # ints (no overflow) + non-negative dims + cap; any
+                    # junk surfaces as the loud ConnectionError, not an
+                    # unhandled thread death.
+                    try:
+                        nbytes = int(meta["nbytes"])
+                        shape = [int(d) for d in meta["shape"]]
+                        dtype = np.dtype(meta["dtype"])
+                    except Exception as e:  # noqa: BLE001
+                        raise ConnectionError(
+                            f"P2P frame meta unparseable: {e}")
+                    want = dtype.itemsize
                     for d in shape:
                         if d < 0:
                             raise ConnectionError(
@@ -243,10 +250,11 @@ class Transport:
                             f"shape/dtype want {want}, cap {_MAX_BYTES})")
                     # single-copy receive: allocate the array up front
                     # and recv_into its buffer (a bytes staging copy
-                    # would triple peak RSS on multi-GB activations)
-                    arr = np.empty(meta["shape"], np.dtype(meta["dtype"]))
+                    # would triple peak RSS on multi-GB activations) —
+                    # from the VALIDATED locals, not the raw meta
+                    arr = np.empty(shape, dtype)
                     view = memoryview(arr).cast("B")
-                    got, total = 0, int(meta["nbytes"])
+                    got, total = 0, nbytes
                     while got < total:
                         n = conn.recv_into(view[got:], total - got)
                         if not n:

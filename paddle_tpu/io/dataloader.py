@@ -290,8 +290,11 @@ class _GeneratorLoader:
         self.drop_last = drop_last
         self._batch_gen = None
 
-    def set_sample_generator(self, reader, batch_size, drop_last=True,
+    def set_sample_generator(self, reader, batch_size, drop_last=None,
                              places=None):
+        # drop_last=None inherits the from_generator(...) setting
+        drop = self.drop_last if drop_last is None else drop_last
+
         def batches():
             buf = []
             for sample in reader():
@@ -301,7 +304,7 @@ class _GeneratorLoader:
                     yield [np.stack([row[i] for row in buf])
                            for i in range(len(buf[0]))]
                     buf = []
-            if buf and not drop_last:
+            if buf and not drop:
                 yield [np.stack([row[i] for row in buf])
                        for i in range(len(buf[0]))]
 

@@ -58,7 +58,11 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     p = float(dropout_p) if training else 0.0
     key = random_core.next_key() if p > 0.0 else None
 
-    if _use_pallas() and attn_mask is None:
+    # seq-length dispatch threshold: below it, XLA's own fused attention
+    # runs (at one 128-block the kernel's advantage can invert — the
+    # BENCH_NO_PALLAS A/B sets this from data; 0 = always use the kernel)
+    min_seq = flags.flag_value("pallas_attention_min_seq")
+    if q.shape[-2] >= min_seq and _use_pallas() and attn_mask is None:
         from .pallas import flash_attention
 
         def _flash(q, k, v, key, *, scale, is_causal, dropout_p):

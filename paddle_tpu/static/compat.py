@@ -244,15 +244,19 @@ _PYFUNC_UIDS = None  # weak func -> (uid, weak backward_func) — created lazily
 _PYFUNC_COUNTER = [0]
 
 
-def _pyfunc_uid(func, backward_func):
-    """Stable per-(func, backward_func) uid for the jit-cache key.
+def _pyfunc_uid(func, backward_func, sig):
+    """Stable per-(func, backward_func, call signature) uid for the
+    jit-cache key.
 
     id() is NOT usable here: CPython reuses addresses after GC, so a
     fresh lambda could silently hit a dead lambda's cached jit (whose
-    callback closure still calls the OLD function). A weak registry +
-    monotonic counter gives stable uids while the functions live and
-    fresh uids after they die; a finalizer evicts the dead entry's
-    cached jits so they do not pin the closures forever."""
+    callback closure still calls the OLD function). ``sig`` — the
+    (output templates, input avals, skip config) the closure bakes in —
+    must also discriminate: the same func called at new shapes or with
+    a different skip set needs a fresh jit, not the stale closure. A
+    weak registry + monotonic counter gives stable uids while the
+    inputs live; replaced or dead entries have their cached jits
+    evicted so they do not pin the closures forever."""
     global _PYFUNC_UIDS
     import weakref
 
@@ -262,14 +266,20 @@ def _pyfunc_uid(func, backward_func):
         _PYFUNC_UIDS = weakref.WeakKeyDictionary()
     rec = _PYFUNC_UIDS.get(func)
     if rec is not None:
-        uid, bwd_ref = rec
+        uid, bwd_ref, old_sig = rec
         if (backward_func is None) == (bwd_ref is None) and (
-                bwd_ref is None or bwd_ref() is backward_func):
+                bwd_ref is None or bwd_ref() is backward_func) and \
+                old_sig == sig:
             return uid
+        # replaced (new backward / new shapes): drop the old jits now
+        # rather than waiting for func's death
+        for nm in (f"py_func_u{uid}", f"py_func_bwd_u{uid}"):
+            evict_ops(nm)
     _PYFUNC_COUNTER[0] += 1
     uid = _PYFUNC_COUNTER[0]
     _PYFUNC_UIDS[func] = (
-        uid, None if backward_func is None else weakref.ref(backward_func))
+        uid, None if backward_func is None else weakref.ref(backward_func),
+        sig)
     for nm in (f"py_func_u{uid}", f"py_func_bwd_u{uid}"):
         weakref.finalize(func, evict_ops, nm)
     return uid
@@ -305,20 +315,25 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     def _py_fwd_callback(*arrs):
         return jax.pure_callback(host, templates, *arrs)
 
-    # the callbacks capture func/backward_func: the op name must
-    # discriminate them or two py_func sites would share one cached jit
-    uid = _pyfunc_uid(func, backward_func)
+    skip = set(id(v) for v in (skip_vars_in_backward_input or []))
+    keep_x = [i for i, v in enumerate(xs) if id(v) not in skip]
+    keep_o = [i for i, v in enumerate(outs) if id(v) not in skip]
+    # keep the REAL dtype (incl. bfloat16 via ml_dtypes): custom_vjp
+    # validates that bwd cotangents match the primal avals
+    in_templates = tuple(
+        jax.ShapeDtypeStruct(tuple(v._value.shape), v._value.dtype)
+        for v in xs)
+
+    # the callbacks capture func/backward_func AND the templates/skip
+    # config: the op name must discriminate all of it, or a second call
+    # with the same funcs at new shapes would reuse a stale closure
+    sig = (tuple((t.shape, str(t.dtype)) for t in templates),
+           tuple((t.shape, str(t.dtype)) for t in in_templates),
+           tuple(keep_x), tuple(keep_o))
+    uid = _pyfunc_uid(func, backward_func, sig)
     if backward_func is None:
         result = apply_op(f"py_func_u{uid}", _py_fwd_callback, *xs)
     else:
-        skip = set(id(v) for v in (skip_vars_in_backward_input or []))
-        keep_x = [i for i, v in enumerate(xs) if id(v) not in skip]
-        keep_o = [i for i, v in enumerate(outs) if id(v) not in skip]
-        # keep the REAL dtype (incl. bfloat16 via ml_dtypes): custom_vjp
-        # validates that bwd cotangents match the primal avals
-        in_templates = tuple(
-            jax.ShapeDtypeStruct(tuple(v._value.shape), v._value.dtype)
-            for v in xs)
 
         def host_bwd(*vals):
             res = backward_func(*[Tensor(np.asarray(v)) for v in vals])

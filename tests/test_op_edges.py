@@ -331,6 +331,26 @@ class TestPyFuncBackward:
         g = jax.grad(loss_fn)(jnp.asarray([1.0, 2.0, 3.0]))
         np.testing.assert_allclose(np.asarray(g), [2., 4., 6.], atol=1e-6)
 
+    def test_same_funcs_new_shapes(self):
+        """The jit-cache uid must discriminate shapes/templates: the
+        same (func, backward_func) pair called at a new shape needs a
+        fresh closure, not the first call's (2,)-template callback."""
+        def fwd(a):
+            return a * 2.0
+
+        def bwd(a, out, dout):
+            return dout * 2.0
+
+        for n in (2, 5):
+            x = paddle.to_tensor(np.ones(n, np.float32),
+                                 stop_gradient=False)
+            o = static.py_func(fwd, x,
+                               paddle.to_tensor(np.zeros(n, np.float32)),
+                               backward_func=bwd)
+            np.testing.assert_allclose(o.numpy(), np.full(n, 2.0))
+            o.sum().backward()
+            np.testing.assert_allclose(x.grad.numpy(), np.full(n, 2.0))
+
     def test_skip_vars(self):
         def fwd(a):
             return a * 2.0

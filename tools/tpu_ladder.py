@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Run the full staged TPU bench ladder in one command.
+
+The axon tunnel opens rarely and briefly; when it does, every minute
+counts. This driver runs the whole ladder as bench.py subprocesses
+(each prints its one JSON line) sharing the persistent XLA compilation
+cache, so a retry after a dropped tunnel resumes incrementally:
+
+  1. flagship BERT (batch sweep 256->32, masked MLM, fused QKV)
+  2. BENCH_NO_PALLAS=1 A/B (flash kernel value at seq 128)
+  3. BENCH_MODEL=resnet50 (BASELINE config 1)
+  4. BENCH_MODEL=flash (seq-4096 kernel TFLOP/s)
+  5. flagship again under BENCH_PROFILE (top-20 op table to stderr)
+
+Results land in BENCH_LADDER.json (list of {stage, rc, record}).
+Usage: python tools/tpu_ladder.py [--out BENCH_LADDER.json]
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STAGES = [
+    ("bert_sweep", {}),
+    ("no_pallas_ab", {"BENCH_NO_PALLAS": "1", "BENCH_BATCH": "32"}),
+    ("resnet50", {"BENCH_MODEL": "resnet50"}),
+    ("flash_4096", {"BENCH_MODEL": "flash"}),
+    ("bert_profile", {"BENCH_PROFILE": "/tmp/tpu_ladder_trace",
+                      "BENCH_BATCH": "32"}),
+]
+
+
+def run_stage(name, extra_env, deadline):
+    env = dict(os.environ, **extra_env)
+    env.setdefault("BENCH_DEADLINE", str(deadline))
+    t0 = time.time()
+    out_file = f"/tmp/ladder_{name}.out"
+    with open(out_file, "w") as f:
+        p = subprocess.Popen([sys.executable, os.path.join(REPO, "bench.py")],
+                             stdout=f, stderr=subprocess.STDOUT, env=env,
+                             cwd=REPO, start_new_session=True)
+        try:
+            rc = p.wait(timeout=deadline + 120)
+        except subprocess.TimeoutExpired:
+            import signal
+
+            os.killpg(p.pid, signal.SIGKILL)
+            rc = -9
+    record = None
+    for line in reversed(open(out_file).read().splitlines()):
+        try:
+            record = json.loads(line)
+            break
+        except ValueError:
+            continue
+    print(f"[{name}] rc={rc} {time.time()-t0:.0f}s -> {record}",
+          file=sys.stderr, flush=True)
+    return {"stage": name, "rc": rc, "seconds": round(time.time() - t0, 1),
+            "record": record}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_LADDER.json"))
+    ap.add_argument("--stage-deadline", type=float, default=900,
+                    help="per-stage BENCH_DEADLINE seconds")
+    args = ap.parse_args()
+    results = []
+    for name, env in STAGES:
+        results.append(run_stage(name, env, args.stage_deadline))
+        json.dump(results, open(args.out, "w"), indent=1)  # save as we go
+        rec = results[-1]["record"] or {}
+        if "tpu_unavailable" in str(rec.get("error", "")):
+            print("tunnel down — aborting ladder", file=sys.stderr)
+            break
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()
