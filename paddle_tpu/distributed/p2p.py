@@ -235,6 +235,16 @@ class Transport:
                         nbytes = int(meta["nbytes"])
                         shape = [int(d) for d in meta["shape"]]
                         dtype = np.dtype(meta["dtype"])
+                        # routing fields too: junk must surface as the
+                        # loud ConnectionError, not kill the thread in
+                        # _deliver with a KeyError/TypeError
+                        meta["axis"] = str(meta["axis"])
+                        meta["src"] = int(meta["src"])
+                        meta["tag"] = int(meta.get("tag", 0))
+                        if meta.get("seq") is not None:
+                            meta["seq"] = int(meta["seq"])
+                        if meta.get("srank") is not None:
+                            meta["srank"] = int(meta["srank"])
                     except Exception as e:  # noqa: BLE001
                         raise ConnectionError(
                             f"P2P frame meta unparseable: {e}")

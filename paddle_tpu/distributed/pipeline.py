@@ -119,6 +119,14 @@ def build_pipeline_train_step(pre_layers, trunk_layers, post_layers, loss_fn,
     post_p0 = {f"post.{i}.{n}": a for i, l in enumerate(post_layers)
                for n, a in _layer_params(l).items()}
     trunk_names = list(_layer_params(template))
+    # tensor-parallel composition (dp x pp x mp, the reference's hybrid
+    # stretch config): per-param mp_spec from the Megatron layers rides
+    # BEHIND the [stage, layer] stacking dims; the 'mp' axis stays an
+    # AUTO axis of the shard_map so GSPMD partitions the stage interior
+    # and inserts the Megatron collectives, while 'pp' stays manual for
+    # the explicit ppermute schedule.
+    trunk_mp_spec = {n: getattr(p, "mp_spec", None)
+                     for n, p in template.named_parameters()}
     stages_p0 = {}
     for n in trunk_names:
         per_layer = [_layer_params(l)[n] for l in trunk_layers]
@@ -128,12 +136,19 @@ def build_pipeline_train_step(pre_layers, trunk_layers, post_layers, loss_fn,
     params0 = {**pre_p0, **stages_p0, **post_p0}
     param_names = list(params0)
 
-    pp_spec = NamedSharding(mesh, P("pp"))
     repl = NamedSharding(mesh, P())
     data_axes = tuple(ax for ax in ("dp", "sharding")
                       if mesh.shape.get(ax, 1) > 1)
     batch_spec = NamedSharding(mesh, P(data_axes)) if data_axes else repl
-    shardings = {n: (pp_spec if n.startswith("stages.") else repl)
+
+    def _stage_sharding(name):
+        spec = trunk_mp_spec.get(name)
+        if spec:
+            return NamedSharding(mesh, P("pp", None, *spec))
+        return NamedSharding(mesh, P("pp"))
+
+    shardings = {n: (_stage_sharding(n[len("stages."):])
+                     if n.startswith("stages.") else repl)
                  for n in param_names}
 
     def _stage_apply(stage_params, x, key):
@@ -191,10 +206,16 @@ def build_pipeline_train_step(pre_layers, trunk_layers, post_layers, loss_fn,
         return outputs.reshape((b_loc,) + outputs.shape[2:])
 
     h_in_spec = P(data_axes) if data_axes else P()
+    manual_axes = frozenset(("pp",) + data_axes)
+    sm_kwargs = {}
+    if mesh.shape.get("mp", 1) > 1:
+        # leave 'mp' to GSPMD (auto): the stage interior partitions over
+        # it via the layers' with_sharding_constraint annotations
+        sm_kwargs["axis_names"] = manual_axes
     trunk_fn = jax.shard_map(
         body, mesh=mesh,
         in_specs=(P("pp"), h_in_spec, P()),
-        out_specs=h_in_spec)
+        out_specs=h_in_spec, **sm_kwargs)
 
     def forward_loss(params, x, y, key):
         h = x

@@ -290,10 +290,13 @@ class _GeneratorLoader:
         self.drop_last = drop_last
         self._batch_gen = None
 
-    def set_sample_generator(self, reader, batch_size, drop_last=None,
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
                              places=None):
-        # drop_last=None inherits the from_generator(...) setting
-        drop = self.drop_last if drop_last is None else drop_last
+        # default True matches the reference set_sample_generator; the
+        # from_generator-level drop_last is a DIFFERENT knob there
+        # (drop trailing batches fewer than the device count — moot for
+        # this single-stream loader, kept as an API carrier)
+        drop = drop_last
 
         def batches():
             buf = []

@@ -253,10 +253,11 @@ def _pyfunc_uid(func, backward_func, sig):
     callback closure still calls the OLD function). ``sig`` — the
     (output templates, input avals, skip config) the closure bakes in —
     must also discriminate: the same func called at new shapes or with
-    a different skip set needs a fresh jit, not the stale closure. A
-    weak registry + monotonic counter gives stable uids while the
-    inputs live; replaced or dead entries have their cached jits
-    evicted so they do not pin the closures forever."""
+    a different skip set needs a fresh jit, not the stale closure.
+    Every (func, sig) keeps its OWN uid so alternating shapes (e.g. a
+    partial last batch) stay warm instead of evict-thrashing; a changed
+    backward for the same sig replaces that entry (its jits evicted).
+    func death evicts everything via weak-registry finalizers."""
     global _PYFUNC_UIDS
     import weakref
 
@@ -264,22 +265,20 @@ def _pyfunc_uid(func, backward_func, sig):
 
     if _PYFUNC_UIDS is None:
         _PYFUNC_UIDS = weakref.WeakKeyDictionary()
-    rec = _PYFUNC_UIDS.get(func)
+    per_sig = _PYFUNC_UIDS.setdefault(func, {})
+    rec = per_sig.get(sig)
     if rec is not None:
-        uid, bwd_ref, old_sig = rec
+        uid, bwd_ref = rec
         if (backward_func is None) == (bwd_ref is None) and (
-                bwd_ref is None or bwd_ref() is backward_func) and \
-                old_sig == sig:
+                bwd_ref is None or bwd_ref() is backward_func):
             return uid
-        # replaced (new backward / new shapes): drop the old jits now
-        # rather than waiting for func's death
+        # same shapes, different backward: replace this entry's jits
         for nm in (f"py_func_u{uid}", f"py_func_bwd_u{uid}"):
             evict_ops(nm)
     _PYFUNC_COUNTER[0] += 1
     uid = _PYFUNC_COUNTER[0]
-    _PYFUNC_UIDS[func] = (
-        uid, None if backward_func is None else weakref.ref(backward_func),
-        sig)
+    per_sig[sig] = (
+        uid, None if backward_func is None else weakref.ref(backward_func))
     for nm in (f"py_func_u{uid}", f"py_func_bwd_u{uid}"):
         weakref.finalize(func, evict_ops, nm)
     return uid

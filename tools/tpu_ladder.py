@@ -37,6 +37,10 @@ STAGES = [
 def run_stage(name, extra_env, deadline):
     env = dict(os.environ, **extra_env)
     env.setdefault("BENCH_DEADLINE", str(deadline))
+    # the hard kill must stay BEHIND bench.py's own deadline (which may
+    # be an inherited BENCH_DEADLINE larger than --stage-deadline), or a
+    # stage gets SIGKILLed before it can emit its JSON record
+    hard_timeout = float(env["BENCH_DEADLINE"]) + 120
     t0 = time.time()
     out_file = f"/tmp/ladder_{name}.out"
     with open(out_file, "w") as f:
@@ -44,7 +48,7 @@ def run_stage(name, extra_env, deadline):
                              stdout=f, stderr=subprocess.STDOUT, env=env,
                              cwd=REPO, start_new_session=True)
         try:
-            rc = p.wait(timeout=deadline + 120)
+            rc = p.wait(timeout=hard_timeout)
         except subprocess.TimeoutExpired:
             import signal
 
@@ -53,10 +57,12 @@ def run_stage(name, extra_env, deadline):
     record = None
     for line in reversed(open(out_file).read().splitlines()):
         try:
-            record = json.loads(line)
-            break
+            parsed = json.loads(line)
         except ValueError:
             continue
+        if isinstance(parsed, dict):  # a bench record, not a stray token
+            record = parsed
+            break
     print(f"[{name}] rc={rc} {time.time()-t0:.0f}s -> {record}",
           file=sys.stderr, flush=True)
     return {"stage": name, "rc": rc, "seconds": round(time.time() - t0, 1),
