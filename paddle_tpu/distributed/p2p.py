@@ -238,7 +238,9 @@ class Transport:
                         # routing fields too: junk must surface as the
                         # loud ConnectionError, not kill the thread in
                         # _deliver with a KeyError/TypeError
-                        meta["axis"] = str(meta["axis"])
+                        if not isinstance(meta["axis"], str):
+                            raise ValueError(
+                                f"axis must be str, got {meta['axis']!r}")
                         meta["src"] = int(meta["src"])
                         meta["tag"] = int(meta.get("tag", 0))
                         if meta.get("seq") is not None:

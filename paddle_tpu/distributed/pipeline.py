@@ -206,16 +206,17 @@ def build_pipeline_train_step(pre_layers, trunk_layers, post_layers, loss_fn,
         return outputs.reshape((b_loc,) + outputs.shape[2:])
 
     h_in_spec = P(data_axes) if data_axes else P()
+    # only pp (the explicit ppermute schedule) and the data axes are
+    # MANUAL; every other mesh axis (mp, ep, ...) stays auto so GSPMD
+    # partitions the stage interior via the layers' sharding
+    # annotations (Megatron tensor parallel / MoE expert parallel
+    # inside pipeline stages). For meshes with no such axis this is
+    # identical to all-manual.
     manual_axes = frozenset(("pp",) + data_axes)
-    sm_kwargs = {}
-    if mesh.shape.get("mp", 1) > 1:
-        # leave 'mp' to GSPMD (auto): the stage interior partitions over
-        # it via the layers' with_sharding_constraint annotations
-        sm_kwargs["axis_names"] = manual_axes
     trunk_fn = jax.shard_map(
         body, mesh=mesh,
         in_specs=(P("pp"), h_in_spec, P()),
-        out_specs=h_in_spec, **sm_kwargs)
+        out_specs=h_in_spec, axis_names=manual_axes)
 
     def forward_loss(params, x, y, key):
         h = x

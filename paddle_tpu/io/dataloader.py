@@ -295,8 +295,9 @@ class _GeneratorLoader:
         # default True matches the reference set_sample_generator; the
         # from_generator-level drop_last is a DIFFERENT knob there
         # (drop trailing batches fewer than the device count — moot for
-        # this single-stream loader, kept as an API carrier)
-        drop = drop_last
+        # this single-stream loader, kept as an API carrier). None (the
+        # short-lived 'inherit' sentinel) normalizes to True.
+        drop = True if drop_last is None else drop_last
 
         def batches():
             buf = []

@@ -240,7 +240,7 @@ def set_program_state(program, state_dict):
         raise ValueError(f"state entries not found in program: {missing}")
 
 
-_PYFUNC_UIDS = None  # weak func -> (uid, weak backward_func) — created lazily
+_PYFUNC_UIDS = None  # weak func -> {sig: (uid, weak backward_func)} — lazy
 _PYFUNC_COUNTER = [0]
 
 

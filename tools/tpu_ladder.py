@@ -40,7 +40,11 @@ def run_stage(name, extra_env, deadline):
     # the hard kill must stay BEHIND bench.py's own deadline (which may
     # be an inherited BENCH_DEADLINE larger than --stage-deadline), or a
     # stage gets SIGKILLed before it can emit its JSON record
-    hard_timeout = float(env["BENCH_DEADLINE"]) + 120
+    try:
+        hard_timeout = float(env["BENCH_DEADLINE"]) + 120
+    except ValueError:
+        env["BENCH_DEADLINE"] = str(deadline)  # unparseable inherited var
+        hard_timeout = deadline + 120
     t0 = time.time()
     out_file = f"/tmp/ladder_{name}.out"
     with open(out_file, "w") as f:
