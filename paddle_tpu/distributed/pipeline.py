@@ -165,7 +165,9 @@ def build_pipeline_train_step(pre_layers, trunk_layers, post_layers, loss_fn,
     if recompute:
         _stage_apply = jax.checkpoint(_stage_apply)
 
-    shard_axes = ("pp",) + (("dp",) if mesh.shape.get("dp", 1) > 1 else ())
+    # every axis the batch shards over (dp AND sharding) varies the
+    # carry; missing one trips the scan's varying-manual-axes check
+    shard_axes = ("pp",) + data_axes
 
     def body(stage_params_local, h_local, key):
         # stage_params_local: [1, lps, ...] slices; h_local: [B_loc, ...]
@@ -253,6 +255,27 @@ def build_pipeline_train_step(pre_layers, trunk_layers, post_layers, loss_fn,
             new_state[name] = tuple(out[1:])
         return loss, new_params, new_state
 
+    # ZeRO-1 x pipeline (reference: sharding+pipeline meta-optimizer
+    # composition): optimizer-state arrays additionally shard their
+    # first divisible dim over the dp/sharding axes — stage states
+    # behind the [stage, layer] stacking dims, pre/post states exactly
+    # like spmd's ZeRO-1 (same _zero1_spec). Elementwise updates keep
+    # the layout: the memory win of sharding_optimizer.py stage 1.
+    from .spmd import _zero1_spec
+
+    zero_axes = tuple(ax for ax in ("dp", "sharding")
+                      if mesh.shape.get(ax, 1) > 1)
+
+    def _opt_state_sharding(name, a):
+        if np.ndim(a) != np.ndim(params0[name]):
+            return repl  # scalar states (step counters)
+        if not zero_axes:
+            return shardings[name]
+        if name.startswith("stages."):
+            return _zero1_spec(a, mesh, axes=zero_axes, start=2,
+                               prefix=tuple(shardings[name].spec))
+        return _zero1_spec(a, mesh, axes=zero_axes)
+
     def init_fn():
         params = {n: jax.device_put(params0[n], shardings[n])
                   for n in param_names}
@@ -260,11 +283,9 @@ def build_pipeline_train_step(pre_layers, trunk_layers, post_layers, loss_fn,
         for n in param_names:
             st = optimizer._init_state(params0[n])
             # scalar states (step counters) stay replicated; stage-shaped
-            # states inherit the stacked pp sharding
+            # states inherit the stacked pp sharding (+ ZeRO-1 sharding)
             opt_state[n] = tuple(
-                jax.device_put(a, shardings[n]
-                               if np.ndim(a) == np.ndim(params0[n]) else repl)
-                for a in st)
+                jax.device_put(a, _opt_state_sharding(n, a)) for a in st)
         return params, opt_state
 
     in_shardings = (shardings, None, batch_spec, batch_spec, repl, repl)

@@ -39,21 +39,27 @@ def param_sharding_spec(layer, mesh):
     return specs
 
 
-def _zero1_spec(arr, mesh, axes=("dp", "sharding")):
-    """Shard the largest divisible dim of an optimizer-state array over the
-    dp/sharding axes (ZeRO-1; reference sharding_optimizer.py shards by
-    param — per-dim sharding is the XLA-friendly equivalent)."""
+def _zero1_spec(arr, mesh, axes=("dp", "sharding"), start=0, prefix=()):
+    """Shard the FIRST divisible (and not already-sharded) dim of an
+    optimizer-state array over the dp/sharding axes (ZeRO-1; reference
+    sharding_optimizer.py shards by param — per-dim sharding is the
+    XLA-friendly equivalent). ``start``/``prefix`` let callers protect
+    leading structural dims (the pipeline's [stage, layer] stacking)
+    and respect an existing sharding (pp/mp axes)."""
     n = 1
     for ax in axes:
         n *= mesh.shape.get(ax, 1)
+    base = P(*prefix) if prefix else P()
     if n == 1 or arr.ndim == 0:
-        return NamedSharding(mesh, P())
-    for dim, size in enumerate(arr.shape):
-        if size % n == 0:
-            spec = [None] * arr.ndim
+        return NamedSharding(mesh, base)
+    for dim in range(start, arr.ndim):
+        if dim < len(prefix) and prefix[dim] is not None:
+            continue  # already sharded (pp / mp)
+        if arr.shape[dim] % n == 0:
+            spec = list(prefix) + [None] * (arr.ndim - len(prefix))
             spec[dim] = axes if len(axes) > 1 else axes[0]
             return NamedSharding(mesh, P(*spec))
-    return NamedSharding(mesh, P())
+    return NamedSharding(mesh, base)
 
 
 def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,

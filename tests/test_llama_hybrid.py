@@ -222,6 +222,57 @@ class TestLlamaPipeline:
         np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
         assert got[-1] < got[0]
 
+    def test_dp2_pp2_sharding2_zero1_opt_state(self):
+        """Pipeline x ZeRO-1 (reference: sharding+pipeline
+        meta-optimizer composition): with a 'sharding' axis on the
+        mesh, optimizer-state arrays shard their first divisible dim
+        over it — stage states behind the [stage, layer] stacking, and
+        pre/post states like spmd's ZeRO-1 — with losses unchanged
+        (elementwise updates keep the layout, no gathers)."""
+        from paddle_tpu.distributed import pipeline as pipe
+
+        paddle.seed(21)
+        hidden = 16
+
+        class Block(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(hidden, hidden)
+
+            def forward(self, x):
+                return paddle.tanh(self.fc(x))
+
+        pre = [nn.Linear(8, hidden)]
+        blocks = [Block() for _ in range(4)]
+        post = [nn.Linear(hidden, 4)]
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 8).astype(np.float32)
+        y = rng.randn(8, 4).astype(np.float32)
+
+        def run(mesh):
+            topology.set_global_mesh(mesh)
+            opt = optimizer.Adam(1e-2, parameters=[
+                p for l in pre + blocks + post for p in l.parameters()])
+            step, init = pipe.build_pipeline_train_step(
+                pre, blocks, post,
+                lambda o, t: jnp.mean((o - t) ** 2), opt, mesh=mesh,
+                num_micro=2, donate=False)
+            params, st = init()
+            out = []
+            for _ in range(3):
+                loss, params, st = step(params, st, x, y,
+                                        key=jax.random.PRNGKey(0))
+                out.append(float(loss))
+            return out, st
+
+        ref, _ = run(topology.build_mesh(dp=1, pp=1,
+                                         devices=jax.devices("cpu")[:1]))
+        got, st = run(topology.build_mesh(dp=2, pp=2, sharding=2))
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+        m_spec = str(st["stages.fc.weight"][0].sharding.spec)
+        assert "'sharding'" in m_spec and "'pp'" in m_spec, m_spec
+        assert "'sharding'" in str(st["pre.0.weight"][0].sharding.spec)
+
     def test_dp2_pp2_ep2_moe_pipeline_trains(self):
         """GPT-MoE-style hybrid: MoE blocks (capacity dispatch, experts
         sharded over 'ep') pipelined over 'pp' — ep is an AUTO axis of
