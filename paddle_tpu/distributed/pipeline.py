@@ -139,7 +139,28 @@ def build_pipeline_train_step(pre_layers, trunk_layers, post_layers, loss_fn,
     repl = NamedSharding(mesh, P())
     data_axes = tuple(ax for ax in ("dp", "sharding")
                       if mesh.shape.get(ax, 1) > 1)
-    batch_spec = NamedSharding(mesh, P(data_axes)) if data_axes else repl
+    # sequence parallelism (pp x sp long context): with sp on the mesh,
+    # activations are [B, S, ...] with the SEQ dim sharded over sp;
+    # stage interiors call ring_attention_in_shard_map (sp is a manual
+    # axis of the trunk shard_map alongside pp). data_p is THE one
+    # activation partition spec — batch placement and the trunk's
+    # in_spec both use it.
+    sp_n = int(mesh.shape.get("sp", 1))
+    if sp_n > 1:
+        data_p = P(data_axes if data_axes else None, "sp")
+    else:
+        data_p = P(data_axes) if data_axes else P()
+    batch_spec = NamedSharding(mesh, data_p)
+
+    def _place_input(arr):
+        """Per-array placement: the sp seq sharding applies only to
+        arrays that HAVE a sharded seq dim (rank-1 labels etc. keep the
+        plain data-axes layout)."""
+        if sp_n > 1 and (arr.ndim < 2 or arr.shape[1] % sp_n != 0):
+            return jax.device_put(
+                arr, NamedSharding(mesh, P(data_axes) if data_axes
+                                   else P()))
+        return jax.device_put(arr, batch_spec)
 
     def _stage_sharding(name):
         spec = trunk_mp_spec.get(name)
@@ -165,9 +186,10 @@ def build_pipeline_train_step(pre_layers, trunk_layers, post_layers, loss_fn,
     if recompute:
         _stage_apply = jax.checkpoint(_stage_apply)
 
-    # every axis the batch shards over (dp AND sharding) varies the
-    # carry; missing one trips the scan's varying-manual-axes check
-    shard_axes = ("pp",) + data_axes
+    # every axis the batch shards over (dp, sharding, AND the seq-dim
+    # sp) varies the carry; missing one trips the scan's
+    # varying-manual-axes check
+    shard_axes = ("pp",) + data_axes + (("sp",) if sp_n > 1 else ())
 
     def body(stage_params_local, h_local, key):
         # stage_params_local: [1, lps, ...] slices; h_local: [B_loc, ...]
@@ -207,14 +229,14 @@ def build_pipeline_train_step(pre_layers, trunk_layers, post_layers, loss_fn,
                       jnp.zeros_like(outputs)), "pp")
         return outputs.reshape((b_loc,) + outputs.shape[2:])
 
-    h_in_spec = P(data_axes) if data_axes else P()
-    # only pp (the explicit ppermute schedule) and the data axes are
-    # MANUAL; every other mesh axis (mp, ep, ...) stays auto so GSPMD
-    # partitions the stage interior via the layers' sharding
-    # annotations (Megatron tensor parallel / MoE expert parallel
-    # inside pipeline stages). For meshes with no such axis this is
-    # identical to all-manual.
-    manual_axes = frozenset(("pp",) + data_axes)
+    h_in_spec = data_p
+    # only pp (the explicit ppermute schedule), the data axes, and sp
+    # (the stage-interior ring) are MANUAL; every other mesh axis (mp,
+    # ep, ...) stays auto so GSPMD partitions the stage interior via
+    # the layers' sharding annotations (Megatron tensor parallel / MoE
+    # expert parallel inside pipeline stages). For meshes with no such
+    # axis this is identical to all-manual.
+    manual_axes = frozenset(shard_axes)
     trunk_fn = jax.shard_map(
         body, mesh=mesh,
         in_specs=(P("pp"), h_in_spec, P()),
@@ -288,7 +310,7 @@ def build_pipeline_train_step(pre_layers, trunk_layers, post_layers, loss_fn,
                 jax.device_put(a, _opt_state_sharding(n, a)) for a in st)
         return params, opt_state
 
-    in_shardings = (shardings, None, batch_spec, batch_spec, repl, repl)
+    in_shardings = (shardings, None, batch_spec, None, repl, repl)
     out_shardings = (repl, shardings, None)
     step_jit = jax.jit(step, in_shardings=in_shardings,
                        out_shardings=out_shardings,
@@ -302,7 +324,7 @@ def build_pipeline_train_step(pre_layers, trunk_layers, post_layers, loss_fn,
         # inputs may arrive as committed single-device arrays (eager
         # Tensors); place them on the data axes explicitly
         x = jax.device_put(jnp.asarray(x), batch_spec)
-        y = jax.device_put(jnp.asarray(y), batch_spec)
+        y = _place_input(jnp.asarray(y))
         return step_jit(params, opt_state, x, y, key, lr)
 
     step_fn.jitted = step_jit  # AOT access (schedule/memory introspection)

@@ -97,18 +97,44 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", scale=None,
 
     mesh = mesh or topology.get_global_mesh()
     n = mesh.shape.get(axis_name, 1)
+    if n == 1:
+        # degenerate ring: plain blockwise attention on one device
+        return _dispatch_ring(q, k, v, axis_name, 1, scale, causal)
+
+    spec = P(None, None, axis_name, None)
+    fn = functools.partial(_dispatch_ring, axis_name=axis_name, n=n,
+                           scale=scale, causal=causal)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
+
+
+def ring_attention_in_shard_map(q, k, v, axis_name="sp", scale=None,
+                                causal=False):
+    """Ring attention for code ALREADY inside a shard_map whose manual
+    axes include ``axis_name`` (e.g. a pipeline stage interior — the
+    pp x sp long-context composition): calls the per-device ring body
+    directly instead of opening a second, un-nestable shard_map.
+    q, k, v: [B, H, S_local, D] local sequence shards. The shard count
+    comes from the MANUAL CONTEXT itself (lax.axis_size — static), not
+    the global mesh, so a mesh= mismatch cannot silently degrade to
+    block-diagonal local attention. Outside any manual context (or
+    axis size 1) it falls back to plain local attention (the 1-device
+    oracle)."""
+    try:
+        n = jax.lax.axis_size(axis_name)
+    except NameError:
+        n = 1  # not inside a manual context carrying this axis
+    return _dispatch_ring(q, k, v, axis_name, n, scale, causal)
+
+
+def _dispatch_ring(q, k, v, axis_name, n, scale, causal):
+    """Shared resolve-and-dispatch for both entry points."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if n == 1:
-        # degenerate ring: plain blockwise attention on one device
         return _ring_attn_local(q, k, v, scale=scale, causal=causal)
-
-    spec = P(None, None, axis_name, None)
-    fn = functools.partial(_ring_attn_shard, axis_name=axis_name,
-                           n_shards=n, scale=float(scale),
-                           causal=bool(causal))
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+    return _ring_attn_shard(q, k, v, axis_name=axis_name, n_shards=n,
+                            scale=float(scale), causal=bool(causal))
 
 
 def _ring_attn_local(q, k, v, *, scale, causal):

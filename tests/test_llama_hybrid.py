@@ -222,6 +222,91 @@ class TestLlamaPipeline:
         np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
         assert got[-1] < got[0]
 
+    def test_dp2_pp2_sp2_ring_attention_pipeline(self):
+        """Long-context pipeline (pp x sp): stages whose interiors run
+        RING attention over the sp axis — sp is a manual axis of the
+        trunk shard_map next to pp, activations are [B, S, ...] with
+        the seq dim sp-sharded, and the stage calls
+        ring_attention_in_shard_map (the per-device ring body; a nested
+        shard_map cannot open inside the pipeline region). Loss-matched
+        vs the 1-device oracle."""
+        import paddle_tpu.tensor as pt
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.distributed import pipeline as pipe
+        from paddle_tpu.ops import ring_attention as ra
+
+        paddle.seed(9)
+        hidden, heads = 16, 2
+
+        class RingBlock(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.qkv = nn.Linear(hidden, 3 * hidden)
+                self.out = nn.Linear(hidden, hidden)
+
+            def forward(self, x):  # [B, S_local, H]
+                b, s, h = x.shape
+                d = h // heads
+                q, k, v = pt.split(self.qkv(x), 3, axis=-1)
+
+                def hsplit(t):
+                    return pt.transpose(pt.reshape(t, [b, s, heads, d]),
+                                        [0, 2, 1, 3])
+
+                att = ra.ring_attention_in_shard_map(
+                    hsplit(q)._value, hsplit(k)._value,
+                    hsplit(v)._value, causal=True)
+                att = pt.reshape(pt.transpose(Tensor(att), [0, 2, 1, 3]),
+                                 [b, s, h])
+                return x + self.out(att)
+
+        pre = [nn.Linear(8, hidden)]
+        blocks = [RingBlock() for _ in range(4)]
+        post = [nn.Linear(hidden, 4)]
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 16, 8).astype(np.float32)
+        y = rng.randn(4, 16, 4).astype(np.float32)
+
+        def run(mesh):
+            topology.set_global_mesh(mesh)
+            opt = optimizer.Adam(1e-2, parameters=[
+                p for l in pre + blocks + post for p in l.parameters()])
+            step, init = pipe.build_pipeline_train_step(
+                pre, blocks, post,
+                lambda o, t: jnp.mean((o - t) ** 2), opt, mesh=mesh,
+                num_micro=2, donate=False)
+            params, st = init()
+            out = []
+            for _ in range(3):
+                loss, params, st = step(params, st, x, y,
+                                        key=jax.random.PRNGKey(0))
+                out.append(float(loss))
+            return out
+
+        ref = run(topology.build_mesh(dp=1, pp=1,
+                                      devices=jax.devices("cpu")[:1]))
+        got = run(topology.build_mesh(dp=2, pp=2, sp=2))
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+        assert got[-1] < got[0]
+
+        # rank-1 labels must not get the seq sharding (classification
+        # targets on an sp mesh): mean-pool head + [B] labels
+        mesh = topology.build_mesh(dp=2, pp=2, sp=2)
+        topology.set_global_mesh(mesh)
+        from paddle_tpu.distributed import pipeline as pipe
+
+        opt = optimizer.Adam(1e-2, parameters=[
+            p for l in pre + blocks + post for p in l.parameters()])
+        step, init = pipe.build_pipeline_train_step(
+            pre, blocks, post,
+            lambda o, t: jnp.mean((jnp.mean(o, axis=1)[:, 0] - t) ** 2),
+            opt, mesh=mesh, num_micro=2, donate=False)
+        params, st = init()
+        y1 = np.random.RandomState(1).randn(4).astype(np.float32)
+        loss, params, st = step(params, st, x, y1,
+                                key=jax.random.PRNGKey(0))
+        assert np.isfinite(float(loss))
+
     def test_dp2_pp2_sharding2_zero1_opt_state(self):
         """Pipeline x ZeRO-1 (reference: sharding+pipeline
         meta-optimizer composition): with a 'sharding' axis on the
