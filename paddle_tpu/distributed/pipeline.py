@@ -21,8 +21,6 @@ of all live ticks retained); pass ``recompute=True`` to rematerialise
 each stage application in the backward (jax.checkpoint), the analog of
 the reference's recompute+pipeline composition.
 """
-import functools
-
 import numpy as np
 import jax
 import jax.numpy as jnp

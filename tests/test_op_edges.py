@@ -404,6 +404,45 @@ class TestFromGenerator:
         assert feeds[0]["x"].shape == (2, 3)
         assert feeds[2]["x"].shape == (1, 3)  # drop_last=False tail
 
+    def test_executor_trains_from_generator_feeds(self):
+        """The full fluid-era loop: from_generator feed dicts drive a
+        static Executor train step (reference reader.py:432 usage)."""
+        from paddle_tpu import optimizer, static
+
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            startup = static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [8, 4], "float32")
+                y = static.data("y", [8, 1], "float32")
+                pred = static.nn.fc(x, 1)
+                loss = paddle.mean((pred - y) ** 2)
+                optimizer.SGD(0.1).minimize(loss)
+            exe = static.Executor()
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            w_true = rng.rand(4, 1).astype(np.float32)
+
+            def reader():
+                r = np.random.RandomState(1)
+                for _ in range(40):
+                    xs = r.rand(8, 4).astype(np.float32)
+                    yield [xs, xs @ w_true]
+
+            loader = static.DataLoader.from_generator(
+                feed_list=[x, y]) if hasattr(static, "DataLoader") else \
+                __import__("paddle_tpu").io.DataLoader.from_generator(
+                    feed_list=[x, y])
+            loader.set_batch_generator(reader)
+            hist = []
+            for feed in loader():
+                lv, = exe.run(main, feed=feed, fetch_list=[loss])
+                hist.append(float(np.asarray(lv)))
+            assert hist[-1] < hist[0] / 10, (hist[0], hist[-1])
+        finally:
+            paddle.disable_static()
+
     def test_batch_generator_return_list(self):
         from paddle_tpu.io import DataLoader
 
