@@ -67,6 +67,14 @@ def amp_cast_hook(name, arrays):
     return out
 
 
+def suspend_auto_cast():
+    """Disable the per-op AMP hook for a region (the pipeline trunk
+    uses explicit per-stage casts instead: per-op converts inside the
+    manual shard_map region trip an XLA-CPU legalization CHECK).
+    Exactly ``auto_cast(enable=False)`` — one hook-off protocol."""
+    return auto_cast(enable=False)
+
+
 @contextlib.contextmanager
 def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
               level="O1", dtype="bfloat16"):

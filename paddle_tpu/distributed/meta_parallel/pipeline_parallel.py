@@ -152,9 +152,19 @@ class PipelineParallel(nn.Layer):
                 res = raw_loss(Tensor(out), Tensor(y, stop_gradient=True))
             return res._value if isinstance(res, Tensor) else res
 
+        # strategy.amp rides into the pipeline (the reference's
+        # amp+pipeline meta-optimizer stacking)
+        amp_level = "O0"
+        amp_dtype = "bfloat16"
+        if self._strategy is not None and getattr(self._strategy, "amp",
+                                                  False):
+            cfg = getattr(self._strategy, "amp_configs", {}) or {}
+            amp_level = "O2" if cfg.get("use_pure_fp16") else "O1"
+            amp_dtype = cfg.get("dtype", "bfloat16")
         step, init = pipe.build_pipeline_train_step(
             pre, trunk, post, loss_fn, optimizer, mesh=mesh,
-            num_micro=self._micro_batches)
+            num_micro=self._micro_batches, amp_level=amp_level,
+            amp_dtype=amp_dtype)
         params, state = init()
         lps = len(trunk) // pp
         self._spmd = {"step": step, "params": params, "state": state,

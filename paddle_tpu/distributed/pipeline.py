@@ -84,7 +84,8 @@ def split_pre_trunk_post(layers, num_stages):
 
 def build_pipeline_train_step(pre_layers, trunk_layers, post_layers, loss_fn,
                               optimizer, mesh=None, num_micro=None,
-                              recompute=False, donate=True):
+                              recompute=False, donate=True,
+                              amp_level="O0", amp_dtype="bfloat16"):
     """Compile a pipeline-parallel training step.
 
     - pre_layers/post_layers: lists of Layers applied outside the pipelined
@@ -96,7 +97,28 @@ def build_pipeline_train_step(pre_layers, trunk_layers, post_layers, loss_fn,
     Returns (step_fn, init_fn):
       init_fn() -> (params, opt_state) with 'stages' leaves sharded P('pp')
       step_fn(params, opt_state, x, y, key, lr) -> (loss, params, opt_state)
+
+    amp_level "O1"/"O2" (the reference's amp+pipeline meta-optimizer
+    composition): pre/post layers trace under ``paddle.amp.auto_cast``
+    (per-op white/black lists, like spmd.build_train_step); the
+    pipelined trunk runs each STAGE interior in pure ``amp_dtype`` via
+    explicit casts at the stage boundary — per-op converts inside the
+    manual shard_map region trip an XLA-CPU bf16-legalization CHECK,
+    and a whole-stage cast is the better TPU schedule anyway (one
+    convert per boundary, not per op). Activations cross stage
+    boundaries in the carry dtype (f32).
     """
+    if amp_level not in ("O0", "O1", "O2"):
+        raise ValueError(f"amp_level must be 'O0'|'O1'|'O2', "
+                         f"got {amp_level!r}")
+    amp_enabled = amp_level in ("O1", "O2")
+    if amp_dtype in ("bfloat16", "bf16"):
+        amp_jdtype = jnp.bfloat16
+    elif amp_dtype in ("float16", "fp16"):
+        amp_jdtype = jnp.float16
+    else:
+        raise ValueError(f"amp_dtype must be bfloat16/bf16/float16/fp16, "
+                         f"got {amp_dtype!r}")
     mesh = mesh or topology.get_global_mesh()
     num_stages = int(mesh.shape.get("pp", 1))
     L = len(trunk_layers)
@@ -171,8 +193,20 @@ def build_pipeline_train_step(pre_layers, trunk_layers, post_layers, loss_fn,
                  for n in param_names}
 
     def _stage_apply(stage_params, x, key):
-        """Apply this stage's lps layers (scan over the stacked dim)."""
+        """Apply this stage's lps layers (scan over the stacked dim).
+
+        amp: the stage interior runs in pure ``amp_dtype`` via explicit
+        casts of params + activation at the stage boundary (the per-op
+        auto_cast hook is suspended inside the manual trunk region —
+        its convert-per-op pattern trips an XLA-CPU legalization CHECK;
+        O1's white/black lists still govern pre/post layers)."""
         keys = jax.random.split(key, lps)
+        if amp_enabled:
+            stage_params = jax.tree.map(
+                lambda a: a.astype(amp_jdtype)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                stage_params)
+            x = x.astype(amp_jdtype)
 
         def per_layer(h, xs):
             p_layer, k = xs
@@ -210,7 +244,9 @@ def build_pipeline_train_step(pre_layers, trunk_layers, post_layers, loss_fn,
             x_in = jnp.where(stage == 0,
                              micro[jnp.clip(t, 0, num_micro - 1)], carry)
             k = jax.random.fold_in(jax.random.fold_in(key, t), stage)
-            y = _stage_apply(p_stage, x_in, k)
+            # the carry dtype is fixed across ticks: under amp the stage
+            # emits amp_dtype, which must cast back at the boundary
+            y = _stage_apply(p_stage, x_in, k).astype(x_in.dtype)
             y = jnp.where(active, y, jnp.zeros_like(y))
             is_last = stage == num_stages - 1
             out_idx = jnp.clip(mb_idx, 0, num_micro - 1)
@@ -241,20 +277,33 @@ def build_pipeline_train_step(pre_layers, trunk_layers, post_layers, loss_fn,
         out_specs=h_in_spec, axis_names=manual_axes)
 
     def forward_loss(params, x, y, key):
-        h = x
-        kpre = jax.random.fold_in(key, 10_000)
-        for i, layer in enumerate(pre_layers):
-            lp = {n: params[f"pre.{i}.{n}"] for n, _ in layer.named_parameters()}
-            h = _functional_apply(layer, lp, h,
-                                  jax.random.fold_in(kpre, i))
-        stage_params = {n: params[f"stages.{n}"] for n in trunk_names}
-        h = trunk_fn(stage_params, h, key)
-        kpost = jax.random.fold_in(key, 20_000)
-        for i, layer in enumerate(post_layers):
-            lp = {n: params[f"post.{i}.{n}"] for n, _ in layer.named_parameters()}
-            h = _functional_apply(layer, lp, h,
-                                  jax.random.fold_in(kpost, i))
-        return loss_fn(h, y)
+        from ..amp.auto_cast import auto_cast as _auto_cast
+        from ..amp.auto_cast import suspend_auto_cast
+
+        with _auto_cast(enable=amp_enabled, level=amp_level,
+                        dtype=amp_dtype):
+            h = x
+            kpre = jax.random.fold_in(key, 10_000)
+            for i, layer in enumerate(pre_layers):
+                lp = {n: params[f"pre.{i}.{n}"]
+                      for n, _ in layer.named_parameters()}
+                h = _functional_apply(layer, lp, h,
+                                      jax.random.fold_in(kpre, i))
+            if amp_enabled:
+                # enforce the documented invariant: the trunk carry and
+                # ppermute traffic run in f32 regardless of what dtype
+                # the last pre layer emitted under the hook
+                h = h.astype(jnp.float32)
+            stage_params = {n: params[f"stages.{n}"] for n in trunk_names}
+            with suspend_auto_cast():
+                h = trunk_fn(stage_params, h, key)
+            kpost = jax.random.fold_in(key, 20_000)
+            for i, layer in enumerate(post_layers):
+                lp = {n: params[f"post.{i}.{n}"]
+                      for n, _ in layer.named_parameters()}
+                h = _functional_apply(layer, lp, h,
+                                      jax.random.fold_in(kpost, i))
+            return loss_fn(h, y)
 
     hypers = optimizer._hypers()
     opt_update = type(optimizer)._update

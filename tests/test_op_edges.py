@@ -430,10 +430,9 @@ class TestFromGenerator:
                     xs = r.rand(8, 4).astype(np.float32)
                     yield [xs, xs @ w_true]
 
-            loader = static.DataLoader.from_generator(
-                feed_list=[x, y]) if hasattr(static, "DataLoader") else \
-                __import__("paddle_tpu").io.DataLoader.from_generator(
-                    feed_list=[x, y])
+            from paddle_tpu.io import DataLoader
+
+            loader = DataLoader.from_generator(feed_list=[x, y])
             loader.set_batch_generator(reader)
             hist = []
             for feed in loader():
