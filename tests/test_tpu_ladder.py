@@ -1,0 +1,142 @@
+"""tools/tpu_ladder.py re-entrancy contract: the ladder is re-run
+across brief tunnel windows by tools/tpu_watch.py, so green stages must
+be skipped (their records preserved), results must merge atomically,
+and any wedge signature must abort the pass instead of burning every
+remaining stage's deadline."""
+import importlib
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+tpu_ladder = importlib.import_module("tpu_ladder")
+
+
+def _run_main(monkeypatch, tmp_path, fake_run, argv_extra=()):
+    out = tmp_path / "ladder.json"
+    monkeypatch.setattr(tpu_ladder, "run_stage", fake_run)
+    monkeypatch.setattr(sys, "argv",
+                        ["tpu_ladder.py", "--out", str(out), *argv_extra])
+    tpu_ladder.main()
+    return json.load(open(out))
+
+
+def test_all_stages_run_and_merge(monkeypatch, tmp_path):
+    ran = []
+
+    def fake(name, env, deadline):
+        ran.append(name)
+        return {"stage": name, "rc": 0, "seconds": 1.0,
+                "record": {"metric": name, "value": 1.0}}
+
+    results = _run_main(monkeypatch, tmp_path, fake)
+    assert ran == [n for n, _ in tpu_ladder.STAGES]
+    assert [r["stage"] for r in results] == ran
+    assert all(r["rc"] == 0 for r in results)
+
+
+def test_green_stages_skip_and_keep_records(monkeypatch, tmp_path):
+    out = tmp_path / "ladder.json"
+    first = tpu_ladder.STAGES[0][0]
+    prior = [{"stage": first, "rc": 0, "seconds": 42.0,
+              "record": {"metric": first, "value": 123.0}}]
+    json.dump(prior, open(out, "w"))
+
+    ran = []
+
+    def fake(name, env, deadline):
+        ran.append(name)
+        return {"stage": name, "rc": 0, "seconds": 1.0,
+                "record": {"metric": name, "value": 1.0}}
+
+    monkeypatch.setattr(tpu_ladder, "run_stage", fake)
+    monkeypatch.setattr(sys, "argv", ["tpu_ladder.py", "--out", str(out)])
+    tpu_ladder.main()
+    results = json.load(open(out))
+
+    assert first not in ran  # green stage skipped
+    by_stage = {r["stage"]: r for r in results}
+    assert by_stage[first]["record"]["value"] == 123.0  # record preserved
+    assert len(results) == len(tpu_ladder.STAGES)
+
+
+@pytest.mark.parametrize("rec", [
+    None,  # hard-killed stage: no JSON emitted at all
+    {"error": "tpu_unavailable: ..."},
+    {"error": "deadline_exceeded: ..."},
+])
+def test_wedge_signatures_abort_the_pass(monkeypatch, tmp_path, rec):
+    ran = []
+
+    def fake(name, env, deadline):
+        ran.append(name)
+        return {"stage": name, "rc": -9 if rec is None else 1,
+                "seconds": 1.0, "record": rec}
+
+    # the deadline_exceeded signature re-probes before aborting; a dead
+    # tunnel must abort
+    monkeypatch.setattr(tpu_ladder, "tunnel_alive", lambda timeout=60: False)
+    results = _run_main(monkeypatch, tmp_path, fake)
+    assert ran == [tpu_ladder.STAGES[0][0]]  # aborted after stage 1
+    assert len(results) == 1
+
+
+def test_slow_stage_with_live_tunnel_continues(monkeypatch, tmp_path):
+    """deadline_exceeded + a probe that still answers = a slow stage on
+    a healthy tunnel (cold-cache compile): the pass must continue."""
+    ran = []
+
+    def fake(name, env, deadline):
+        ran.append(name)
+        return {"stage": name, "rc": 1, "seconds": 900.0,
+                "record": {"error": "deadline_exceeded: ..."}}
+
+    monkeypatch.setattr(tpu_ladder, "tunnel_alive", lambda timeout=60: True)
+    results = _run_main(monkeypatch, tmp_path, fake)
+    assert ran == [n for n, _ in tpu_ladder.STAGES]
+    assert len(results) == len(tpu_ladder.STAGES)
+
+
+def test_skip_override_env(monkeypatch, tmp_path):
+    ran = []
+
+    def fake(name, env, deadline):
+        ran.append(name)
+        return {"stage": name, "rc": 0, "seconds": 1.0,
+                "record": {"metric": name, "value": 1.0}}
+
+    bad = tpu_ladder.STAGES[1][0]
+    monkeypatch.setenv("TPU_LADDER_SKIP", bad)
+    results = _run_main(monkeypatch, tmp_path, fake)
+    assert bad not in ran
+    assert len(results) == len(tpu_ladder.STAGES) - 1
+
+
+def test_failed_but_alive_stage_does_not_abort(monkeypatch, tmp_path):
+    """A stage that fails for a non-wedge reason (e.g. a crash in one
+    model path) must NOT stop the rest of the ladder."""
+    ran = []
+
+    def fake(name, env, deadline):
+        ran.append(name)
+        return {"stage": name, "rc": 1, "seconds": 1.0,
+                "record": {"error": "bench_crashed: ValueError: boom"}}
+
+    results = _run_main(monkeypatch, tmp_path, fake)
+    assert ran == [n for n, _ in tpu_ladder.STAGES]
+    assert len(results) == len(tpu_ladder.STAGES)
+
+
+def test_watch_done_stages_tolerates_corrupt_state(tmp_path):
+    watch = importlib.import_module("tpu_watch")
+    p = tmp_path / "ladder.json"
+    assert watch.done_stages(str(p)) == set()  # missing file
+    p.write_text("{ truncated")
+    assert watch.done_stages(str(p)) == set()  # corrupt file
+    p.write_text(json.dumps([{"stage": "a", "rc": 0},
+                             {"stage": "b", "rc": 1}]))
+    assert watch.done_stages(str(p)) == {"a"}

@@ -19,36 +19,15 @@ Usage: setsid nohup python tools/tpu_watch.py >> /tmp/tpu_watch.log 2>&1 &
 import argparse
 import json
 import os
-import signal
 import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PROBE = (
-    "import jax, jax.numpy as jnp;"
-    "x = jnp.ones((256, 256));"
-    "y = (x @ x).block_until_ready();"
-    "print('PROBE_OK', float(y[0, 0]))"
-)
 
 
 def log(*a):
     print(f"[{time.strftime('%H:%M:%S')}]", *a, flush=True)
-
-
-def probe(timeout=90):
-    """True iff a real matmul executes on the TPU in a fresh process."""
-    p = subprocess.Popen([sys.executable, "-c", PROBE],
-                         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-                         start_new_session=True, text=True, cwd=REPO)
-    try:
-        out, _ = p.communicate(timeout=timeout)
-        return "PROBE_OK" in (out or "")
-    except subprocess.TimeoutExpired:
-        os.killpg(p.pid, signal.SIGKILL)
-        p.wait()
-        return False
 
 
 def done_stages(out_path):
@@ -68,30 +47,56 @@ def main():
                          "under the ~2-min observed window length)")
     ap.add_argument("--probe-timeout", type=float, default=60.0)
     ap.add_argument("--stage-deadline", type=float, default=900.0)
+    ap.add_argument("--max-fails", type=int, default=3,
+                    help="skip a stage after this many non-wedge crashes")
     args = ap.parse_args()
 
-    from tpu_ladder import STAGES  # noqa: E402 - sibling module
+    from tpu_ladder import STAGES, tunnel_alive  # noqa: E402 - sibling
 
     deadline = time.time() + args.hours * 3600.0
     attempt = 0
+    fails = {}  # stage -> count of non-wedge failures (crashes)
     while time.time() < deadline:
         done = done_stages(args.out)
-        todo = [name for name, _ in STAGES if name not in done]
+        # a stage that crashed deterministically --max-fails times keeps
+        # getting skipped so it can't starve later stages inside a rare
+        # short window (wedge-signature failures don't count: those
+        # abort the pass and say nothing about the stage itself)
+        bad = {s for s, n in fails.items() if n >= args.max_fails}
+        todo = [name for name, _ in STAGES
+                if name not in done and name not in bad]
         if not todo:
+            if bad:
+                log(f"nothing left to run (green={sorted(done)}, "
+                    f"crashed out={sorted(bad)}) — exiting")
+                return 1
             log("all ladder stages green — exiting")
             return 0
         attempt += 1
         t0 = time.time()
-        if probe(timeout=args.probe_timeout):
+        if tunnel_alive(timeout=args.probe_timeout):
             log(f"probe {attempt}: TUNNEL UP — running ladder, todo={todo}")
-            # the ladder derives the skip set itself from rc==0 stages
-            # already recorded in --out
+            # the ladder derives the green skip set itself from rc==0
+            # stages in --out; crashed-out stages ride the override var
+            env = dict(os.environ)
+            if bad:
+                env["TPU_LADDER_SKIP"] = ",".join(sorted(bad))
             subprocess.call(
                 [sys.executable, os.path.join(REPO, "tools/tpu_ladder.py"),
                  "--out", args.out,
                  "--stage-deadline", str(args.stage_deadline)],
-                cwd=REPO)
-            log(f"ladder pass finished; done={sorted(done_stages(args.out))}")
+                cwd=REPO, env=env)
+            done = done_stages(args.out)
+            try:
+                for r in json.load(open(args.out)):
+                    err = str((r.get("record") or {}).get("error", ""))
+                    if (r.get("rc") != 0 and r.get("record") is not None
+                            and "tpu_unavailable" not in err
+                            and "deadline_exceeded" not in err):
+                        fails[r["stage"]] = fails.get(r["stage"], 0) + 1
+            except (OSError, ValueError, KeyError, TypeError):
+                pass
+            log(f"ladder pass finished; done={sorted(done)} fails={fails}")
         else:
             log(f"probe {attempt}: tunnel down")
         # keep probe STARTS no more than interval apart (a dead-tunnel
