@@ -200,6 +200,7 @@ def build_localsgd_train_step(layer, loss_fn, optimizer, mesh=None,
             layer.load_functional_state(saved_p, saved_b)
 
     hypers = optimizer._hypers()
+    l1_coeff = type(optimizer)._take_l1(hypers)
     opt_update = type(optimizer)._update
     grad_clip = optimizer._grad_clip
 
@@ -215,6 +216,8 @@ def build_localsgd_train_step(layer, loss_fn, optimizer, mesh=None,
         new_params, new_state = {}, {}
         for n in param_names:
             g = grads[n].astype(params[n].dtype)
+            if l1_coeff:
+                g = g + l1_coeff * jnp.sign(params[n])
             st = tuple(a[0] for a in opt_state[n])
             out = opt_update(params[n], g, lr, *st, **hypers)
             new_params[n] = out[0]

@@ -306,6 +306,7 @@ def build_pipeline_train_step(pre_layers, trunk_layers, post_layers, loss_fn,
             return loss_fn(h, y)
 
     hypers = optimizer._hypers()
+    l1_coeff = type(optimizer)._take_l1(hypers)
     opt_update = type(optimizer)._update
     grad_clip = optimizer._grad_clip
 
@@ -319,6 +320,8 @@ def build_pipeline_train_step(pre_layers, trunk_layers, post_layers, loss_fn,
         new_params, new_state = {}, {}
         for name in param_names:
             g = grads[name].astype(params[name].dtype)
+            if l1_coeff:
+                g = g + l1_coeff * jnp.sign(params[name])
             out = opt_update(params[name], g, lr, *opt_state[name], **hypers)
             new_params[name] = out[0]
             new_state[name] = tuple(out[1:])

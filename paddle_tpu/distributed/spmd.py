@@ -188,6 +188,7 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
         forward_loss = jax.checkpoint(forward_loss, static_argnums=())
 
     hypers = optimizer._hypers()
+    l1_coeff = type(optimizer)._take_l1(hypers)
     opt_update = type(optimizer)._update
     grad_clip = optimizer._grad_clip
 
@@ -285,6 +286,8 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
         new_params, new_state = {}, {}
         for name in param_names:
             g = grads[name].astype(params[name].dtype)
+            if l1_coeff:
+                g = g + l1_coeff * jnp.sign(params[name])
             out = opt_update(params[name], g, lr, *opt_state[name], **hypers)
             new_params[name] = out[0]
             new_state[name] = tuple(out[1:])
@@ -528,6 +531,7 @@ def build_fsdp_train_step(layers, loss_fn, optimizer, mesh=None,
         return loss_fn(h, y)
 
     hypers = optimizer._hypers()
+    l1_coeff = type(optimizer)._take_l1(hypers)
     opt_update = type(optimizer)._update
     grad_clip = optimizer._grad_clip
     batch_shard = NamedSharding(mesh, P(data_axes)) if data_axes else repl
@@ -545,6 +549,8 @@ def build_fsdp_train_step(layers, loss_fn, optimizer, mesh=None,
         new_params, new_state = {}, {}
         for n in param_names:
             g = grads[n].astype(params[n].dtype)
+            if l1_coeff:
+                g = g + l1_coeff * jnp.sign(params[n])
             out = opt_update(params[n], g, lr, *opt_state[n], **hypers)
             new_params[n] = out[0]
             new_state[n] = tuple(out[1:])

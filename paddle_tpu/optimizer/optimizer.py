@@ -33,10 +33,18 @@ class Optimizer:
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
         self._name = name
+        self._coupled_l1 = 0.0
         if weight_decay is None:
             self._coupled_wd = 0.0
         elif isinstance(weight_decay, float):
             self._coupled_wd = weight_decay
+        elif type(weight_decay).__name__.startswith("L1"):
+            # regularizer.L1Decay: grad += coeff * sign(param)
+            # (reference: fluid/regularizer.py L1DecayRegularizer appends
+            # a sign op — NOT interchangeable with L2's coeff * param)
+            self._coupled_wd = 0.0
+            self._coupled_l1 = getattr(weight_decay, "_coeff",
+                                       getattr(weight_decay, "coeff", 0.0))
         else:  # regularizer.L2Decay
             self._coupled_wd = getattr(weight_decay, "_coeff",
                                        getattr(weight_decay, "coeff", 0.0))
@@ -72,10 +80,28 @@ class Optimizer:
     def _hypers(self, param=None):
         h = dict(self._hyper_defaults)
         h["l2"] = self._coupled_wd
+        if self._coupled_l1:
+            h["l1_reg"] = self._coupled_l1
         if param is not None and getattr(param, "regularizer", None) is not None:
-            h["l2"] = getattr(param.regularizer, "_coeff",
-                              getattr(param.regularizer, "coeff", h["l2"]))
+            reg = param.regularizer
+            coeff = getattr(reg, "_coeff", getattr(reg, "coeff", h["l2"]))
+            if type(reg).__name__.startswith("L1"):
+                # per-param L1 overrides the optimizer-level decay for
+                # this param (reference regularizer precedence)
+                h["l1_reg"], h["l2"] = coeff, 0.0
+            else:
+                h["l2"] = coeff
+                h.pop("l1_reg", None)
         return h
+
+    @staticmethod
+    def _take_l1(hypers):
+        """Pop the L1-regularizer coefficient out of a hypers dict (the
+        per-class ``_update`` signatures take only ``l2``; L1 is applied
+        centrally as grad += coeff * sign(param) before the update). The
+        key is ``l1_reg``, NOT ``l1`` — Ftrl has its own ``l1`` hyper
+        that must reach its update untouched."""
+        return hypers.pop("l1_reg", 0.0)
 
     # ------------------------------------------------------------ eager step
     @property
@@ -103,6 +129,9 @@ class Optimizer:
                 if state is None:
                     state = self._init_state(p._value)
                 hypers = self._hypers(p)
+                l1 = self._take_l1(hypers)
+                if l1:
+                    g = g + l1 * jnp.sign(p._value)
                 fn = dispatch.jitted(type(self)._update, hypers)
                 out = fn(p._value, g, lr_arr * plr, *state)
                 new_p, new_state = out[0], tuple(out[1:])
@@ -144,7 +173,11 @@ class Optimizer:
                 state = self._accumulators.get(id(p))
                 if state is None:
                     state = self._init_state(p._value)
-                fn = dispatch.jitted(type(self)._update, self._hypers(p))
+                hypers = self._hypers(p)
+                l1 = self._take_l1(hypers)
+                if l1:
+                    g_arr = g_arr + l1 * jnp.sign(p._value)
+                fn = dispatch.jitted(type(self)._update, hypers)
                 out = fn(p._value, g_arr, lr_arr, *state)
                 p._value = out[0]
                 self._accumulators[id(p)] = tuple(out[1:])
@@ -164,6 +197,7 @@ class Optimizer:
             clipped = self._grad_clip.clip_arrays([grads[n] for n in names])
             grads = dict(zip(names, clipped))
         hypers = self._hypers()
+        l1 = self._take_l1(hypers)
         new_params, new_state = {}, {}
         for name, p in params.items():
             g = grads.get(name)
@@ -171,8 +205,10 @@ class Optimizer:
                 new_params[name] = p
                 new_state[name] = state[name]
                 continue
-            out = type(self)._update(p, g.astype(p.dtype) if g.dtype != p.dtype else g,
-                                     lr, *state[name], **hypers)
+            g = g.astype(p.dtype) if g.dtype != p.dtype else g
+            if l1:
+                g = g + l1 * jnp.sign(p)
+            out = type(self)._update(p, g, lr, *state[name], **hypers)
             new_params[name] = out[0]
             new_state[name] = tuple(out[1:])
         return new_params, new_state

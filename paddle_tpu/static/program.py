@@ -210,9 +210,13 @@ class Executor:
             if opt._grad_clip is not None:
                 grads = opt._grad_clip.clip_arrays(grads)
             hypers = opt._hypers()
+            l1_coeff = type(opt)._take_l1(hypers)
             new_params, new_state = [], []
             for p, g, st in zip(param_arrays, grads, opt_state):
-                out = type(opt)._update(p, g.astype(p.dtype), lr, *st, **hypers)
+                g = g.astype(p.dtype)
+                if l1_coeff:
+                    g = g + l1_coeff * jnp.sign(p)
+                out = type(opt)._update(p, g, lr, *st, **hypers)
                 new_params.append(out[0])
                 new_state.append(tuple(out[1:]))
             fetches = tuple(env[fid] for fid in fetch_ids)

@@ -1,0 +1,179 @@
+"""L1Decay/L2Decay regularizer numerics (reference: python/paddle/fluid/
+regularizer.py — L1DecayRegularizer appends a sign op to the grad,
+L2DecayRegularizer appends coeff * param; the two are NOT
+interchangeable). Round-5 audit found L1Decay silently applied as L2;
+these tests pin the correct behavior on every update path."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, regularizer
+
+
+def _one_sgd_step(weight_decay, p0, g0, lr=0.1):
+    paddle.seed(0)
+    lin = nn.Linear(3, 1, bias_attr=False)
+    lin.weight.set_value(p0.reshape(3, 1))
+    opt = optimizer.SGD(learning_rate=lr, parameters=lin.parameters(),
+                        weight_decay=weight_decay)
+    x = paddle.to_tensor(np.eye(3).astype(np.float32))
+    out = lin(x)
+    # loss = sum(w * g0) gives grad exactly g0 per row
+    loss = (out.reshape([-1]) * paddle.to_tensor(g0)).sum()
+    loss.backward()
+    opt.step()
+    return np.asarray(lin.weight.numpy()).reshape(-1)
+
+
+P0 = np.array([0.5, -0.8, 0.3], np.float32)
+G0 = np.array([0.1, 0.2, -0.4], np.float32)
+
+
+class TestEagerRegularizer:
+    def test_l2_decay_adds_coeff_times_param(self):
+        got = _one_sgd_step(regularizer.L2Decay(0.01), P0, G0)
+        want = P0 - 0.1 * (G0 + 0.01 * P0)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_l1_decay_adds_coeff_times_sign(self):
+        got = _one_sgd_step(regularizer.L1Decay(0.01), P0, G0)
+        want = P0 - 0.1 * (G0 + 0.01 * np.sign(P0))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        l2_wrong = P0 - 0.1 * (G0 + 0.01 * P0)
+        assert not np.allclose(got, l2_wrong), \
+            "L1Decay must not behave like L2Decay"
+
+    def test_per_param_l1_overrides_optimizer_decay(self):
+        paddle.seed(0)
+        lin = nn.Linear(3, 1, bias_attr=False)
+        lin.weight.set_value(P0.reshape(3, 1))
+        lin.weight.regularizer = regularizer.L1Decay(0.02)
+        opt = optimizer.SGD(learning_rate=0.1, parameters=lin.parameters(),
+                            weight_decay=0.5)  # would dominate if applied
+        x = paddle.to_tensor(np.eye(3).astype(np.float32))
+        loss = (lin(x).reshape([-1]) * paddle.to_tensor(G0)).sum()
+        loss.backward()
+        opt.step()
+        got = np.asarray(lin.weight.numpy()).reshape(-1)
+        want = P0 - 0.1 * (G0 + 0.02 * np.sign(P0))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_ftrl_own_l1_hyper_is_untouched(self):
+        """Ftrl's l1 is ITS update's hyper (soft-threshold), not the
+        grad-coupled regularizer; _take_l1 must not swallow it."""
+        opt = optimizer.Ftrl(learning_rate=0.1, l1=0.3, l2=0.1)
+        h = opt._hypers()
+        assert h.get("l1") == pytest.approx(0.3)
+        assert optimizer.Optimizer._take_l1(h) == 0.0
+        assert h.get("l1") == pytest.approx(0.3)
+
+
+class TestEveryCompiledPathAcceptsL1:
+    """Round-5 review: _hypers() now carries l1_reg, and every compiled
+    consumer must pop it before **hypers reaches the keyword-only
+    _update signatures — a missed site is a TypeError at trace time."""
+
+    def _mesh(self, **axes):
+        from paddle_tpu.distributed import topology
+
+        mesh = topology.build_mesh(**axes)
+        topology.set_global_mesh(mesh)
+        return mesh
+
+    def test_localsgd_path(self):
+        from paddle_tpu.distributed import spmd
+
+        mesh = self._mesh(dp=4)
+        paddle.seed(7)
+        m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+        opt = optimizer.SGD(0.2, parameters=m.parameters(),
+                            weight_decay=regularizer.L1Decay(1e-4))
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+
+        s = DistributedStrategy()
+        s.localsgd = True
+        s.localsgd_configs = {"k_steps": 2}
+        step, init = spmd.build_train_step(
+            m, lambda o, t: jnp.mean((o - t) ** 2), opt, mesh=mesh,
+            strategy=s)
+        params, st = init()
+        rng = np.random.RandomState(0)
+        x = spmd.shard_batch(rng.rand(8, 8).astype(np.float32), mesh)
+        y = spmd.shard_batch(rng.rand(8, 4).astype(np.float32), mesh)
+        l0, params, st = step(params, st, x, y)
+        l1, params, st = step(params, st, x, y)
+        assert np.isfinite(float(l0)) and float(l1) < float(l0)
+
+    def test_pipeline_path(self):
+        from paddle_tpu.distributed import pipeline as pipe
+
+        mesh = self._mesh(pp=4)
+        paddle.seed(3)
+        layers = [nn.Linear(16, 16) for _ in range(8)]
+        opt = optimizer.SGD(0.1,
+                            parameters=[p for l in layers
+                                        for p in l.parameters()],
+                            weight_decay=regularizer.L1Decay(1e-4))
+        pre, trunk, post = pipe.split_pre_trunk_post(layers, 4)
+        step, init = pipe.build_pipeline_train_step(
+            pre, trunk, post, lambda o, t: jnp.mean((o - t) ** 2), opt,
+            mesh=mesh, num_micro=4)
+        params, st = init()
+        rng = np.random.RandomState(0)
+        x = rng.rand(8, 16).astype(np.float32)
+        l0, params, st = step(params, st, x, x, jax.random.PRNGKey(0))
+        assert np.isfinite(float(l0))
+
+    def test_static_program_path(self):
+        import paddle_tpu.static as static
+
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data("x", [4, 3], "float32")
+                w_out = static.nn.fc(x, 1)
+                loss = (w_out * w_out).sum()
+                opt = optimizer.SGD(
+                    learning_rate=0.01,
+                    weight_decay=regularizer.L1Decay(1e-3))
+                opt.minimize(loss)
+            exe = static.Executor()
+            exe.run(static.default_startup_program())
+            feed = {"x": np.ones((4, 3), np.float32)}
+            (l0,) = exe.run(prog, feed=feed, fetch_list=[loss])
+            (l1,) = exe.run(prog, feed=feed, fetch_list=[loss])
+            assert np.isfinite(float(np.asarray(l0).sum()))
+            assert float(np.asarray(l1).sum()) <= float(np.asarray(l0).sum())
+        finally:
+            paddle.disable_static()
+
+
+class TestSpmdRegularizer:
+    def test_build_train_step_applies_l1(self):
+        from paddle_tpu.distributed import spmd, topology
+
+        paddle.seed(0)
+        mesh = topology.build_mesh(dp=8)
+        topology.set_global_mesh(mesh)
+        net = nn.Linear(4, 4, bias_attr=False)
+        p0 = np.asarray(net.weight.numpy()).copy()
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=net.parameters(),
+                            weight_decay=regularizer.L1Decay(0.05))
+        step_fn, init_fn = spmd.build_train_step(
+            net, lambda o, t: (o * t).sum(), opt, mesh=mesh)
+        params, st = init_fn()
+        x = np.tile(np.eye(4, dtype=np.float32), (2, 1))  # dp=8 needs B%8==0
+        y = np.tile(np.ones((4, 4), np.float32), (2, 1))
+        _, new_params, _ = step_fn(params, st, x, y,
+                                   key=jax.random.PRNGKey(0))
+        (name,) = [n for n in new_params if "weight" in n] or list(new_params)
+        got = np.asarray(new_params[name])
+        # d loss/d w for loss = sum over batch of (xW * y): with x = two
+        # stacked identities and y all-ones, grad = 2 * ones
+        want = p0 - 0.1 * (2.0 * np.ones_like(p0) + 0.05 * np.sign(p0))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
