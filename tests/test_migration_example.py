@@ -1,0 +1,20 @@
+"""The MIGRATION.md worked example must run VERBATIM — it is the first
+thing a reference user tries. Executed straight from the doc text so the
+doc and the framework cannot drift apart."""
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_worked_example_runs_verbatim(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)  # the example writes lenet.pdparams
+    text = open(os.path.join(REPO, "MIGRATION.md")).read()
+    m = re.search(r"```python\n(.*?)```", text, re.S)
+    assert m, "MIGRATION.md lost its worked example"
+    code = m.group(1)
+    # one epoch keeps the suite fast; everything else runs as written
+    code = code.replace("for epoch in range(2):", "for epoch in range(1):")
+    assert "import paddle_tpu as paddle" in code
+    exec(compile(code, "MIGRATION.md", "exec"), {})
+    assert os.path.exists("lenet.pdparams")
