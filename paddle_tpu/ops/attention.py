@@ -35,7 +35,9 @@ def _sdpa_ref(q, k, v, mask, key, *, scale, dropout_p, is_causal):
             logits = logits + mask
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
     if dropout_p > 0.0 and key is not None:
-        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        # counter-hash mask, not threefry bernoulli (core/random.py
+        # fast_keep_mask): attention-prob masks dominate dropout RNG cost
+        keep = random_core.fast_keep_mask(key, 1.0 - dropout_p, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
@@ -59,10 +61,18 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     key = random_core.next_key() if p > 0.0 else None
 
     # seq-length dispatch threshold: below it, XLA's own fused attention
-    # runs (at one 128-block the kernel's advantage can invert — the
-    # BENCH_NO_PALLAS A/B sets this from data; 0 = always use the kernel)
+    # runs (at one 128-block per program the kernel is overhead-bound and
+    # 3x slower than XLA's batched matmul — v5e measurement in the flag's
+    # help text; 0 = always use the kernel). Kernel overhead is governed
+    # by seq_k (the per-program inner-loop length); XLA's memory blowup
+    # by the seq_q*seq_k logits buffer. So: kernel when the k side is
+    # long, OR when the logits product is as big as a min_seq^2 square
+    # (long-q/short-k stays on XLA — its logits are small and the kernel
+    # would be one k-block per program again).
     min_seq = flags.flag_value("pallas_attention_min_seq")
-    if q.shape[-2] >= min_seq and _use_pallas() and attn_mask is None:
+    seq_q, seq_k = q.shape[-2], k.shape[-2]
+    kernel_pays = seq_k >= min_seq or seq_q * seq_k >= min_seq * min_seq
+    if kernel_pays and _use_pallas() and attn_mask is None:
         from .pallas import flash_attention
 
         def _flash(q, k, v, key, *, scale, is_causal, dropout_p):

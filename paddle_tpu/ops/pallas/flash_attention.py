@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...core.random import fmix32
+
 NEG_INF = -1e30
 
 
@@ -60,12 +62,7 @@ def _keep_mask(seed, b, rows, cols, seq_q, seq_k, keep_thresh):
     bseed ^= bseed >> jnp.uint32(13)
     bseed *= jnp.uint32(0xC2B2AE35)
     idx = (rows * _i32(seq_k) + cols).astype(jnp.uint32)
-    h = idx * jnp.uint32(0x9E3779B1) ^ bseed
-    h ^= h >> jnp.uint32(16)
-    h *= jnp.uint32(0x85EBCA6B)
-    h ^= h >> jnp.uint32(13)
-    h *= jnp.uint32(0xC2B2AE35)
-    h ^= h >> jnp.uint32(16)
+    h = fmix32(idx * jnp.uint32(0x9E3779B1) ^ bseed)
     return h < jnp.uint32(keep_thresh)
 
 
@@ -77,21 +74,42 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
     bi = _i32(pl.program_id(0))
     qi = _i32(pl.program_id(1))
     seed = seed_ref[0, 0].astype(jnp.uint32)
-    q = q_ref[0].astype(jnp.float32) * scale           # [block_q, d]
-    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q, 1), jnp.float32)
-    acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    # Keep the MXU inputs in the source dtype (bf16 in practice): casting
+    # q/k/v to f32 before the dots forces multi-pass f32 MXU matmuls,
+    # measured ~8x slower end-to-end at seq 4096. Accumulation stays f32
+    # via preferred_element_type; the softmax scale is applied to the f32
+    # scores rather than pre-scaling q (better numerics in bf16 anyway).
+    q = q_ref[0]                                        # [block_q, d]
+    d = q.shape[-1]
+    # Running max/sum are kept LANE-REPLICATED at [block_q, LANES] (not
+    # [block_q, 1]): narrow-column f32 arrays waste the (8,128) vector
+    # registers and force a relayout on every online-softmax update —
+    # the dominant VPU cost of the forward at long seq.
+    LANES = 128
+    m = jnp.full((block_q, LANES), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, LANES), jnp.float32)
+    acc = jnp.zeros((block_q, d), jnp.float32)
+
+    def _bcast(n):
+        # lane-group broadcast ([block_q, LANES] -> [block_q, n]): a tile
+        # is a cheap lane copy when n is lane-aligned; odd widths fall
+        # back to a column broadcast
+        if n % LANES == 0:
+            return lambda a: jnp.tile(a, (1, n // LANES))
+        return lambda a: jnp.broadcast_to(a[:, :1], (block_q, n))
+
+    bcast_k, bcast_d = _bcast(block_k), _bcast(d)
 
     num_kb = seq_k // block_k
     q_start = qi * _i32(block_q)
 
     def body(kb, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(kb * _i32(block_k), block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * _i32(block_k), block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kb * _i32(block_k), block_k), :]
+        v = v_ref[0, pl.ds(kb * _i32(block_k), block_k), :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)        # [block_q, block_k]
+            preferred_element_type=jnp.float32) * scale  # [block_q, block_k]
         rows = q_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         cols = kb * _i32(block_k) + jax.lax.broadcasted_iota(
@@ -99,7 +117,7 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
         if causal:
             s = jnp.where(rows + _i32(offset) >= cols, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        p = jnp.exp(s - bcast_k(m_new))
         alpha = jnp.exp(m - m_new)
         # dropout applies to softmax probs: l accumulates the undropped sum
         # (the normalizer), acc the dropped numerator
@@ -107,8 +125,8 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
         if dropout_p > 0.0:
             keep = _keep_mask(seed, bi, rows, cols, seq_q, seq_k, keep_thresh)
             p = jnp.where(keep, p * (1.0 / (1.0 - dropout_p)), 0.0)
-        acc = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+        acc = acc * bcast_d(alpha) + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l, acc
 
@@ -118,8 +136,8 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
         num_kb = jnp.minimum(_i32(num_kb), last)
     m, l, acc = jax.lax.fori_loop(_i32(0), _i32(num_kb) if isinstance(num_kb, int) else num_kb, body, (m, l, acc))
     l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l)                        # [block_q, 1]
+    o_ref[0] = (acc / bcast_d(l)).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l))[:, :1]               # [block_q, 1]
 
 
 def _keep_thresh(dropout_p):
@@ -172,20 +190,22 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     bi = _i32(pl.program_id(0))
     qi = _i32(pl.program_id(1))
     seed = seed_ref[0, 0].astype(jnp.uint32)
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)
+    # dot inputs stay in the source dtype (see _fwd_kernel note); scale
+    # is applied to the f32 scores and folded into dq at the end
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0]                                    # [block_q, 1]
     delta = delta_ref[0]
-    dq = jnp.zeros_like(q)
+    dq = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
     q_start = qi * _i32(block_q)
 
     num_kb = seq_k // block_k
 
     def body(kb, dq):
-        k = k_ref[0, pl.ds(kb * _i32(block_k), block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * _i32(block_k), block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kb * _i32(block_k), block_k), :]
+        v = v_ref[0, pl.ds(kb * _i32(block_k), block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         rows = q_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         cols = kb * _i32(block_k) + jax.lax.broadcasted_iota(
@@ -200,7 +220,7 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_p)), 0.0)
         ds = p * (dp - delta)
         return dq + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -216,23 +236,24 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     bi = _i32(pl.program_id(0))
     ki = _i32(pl.program_id(1))
     seed = seed_ref[0, 0].astype(jnp.uint32)
-    k = k_ref[0].astype(jnp.float32)                    # [block_k, d]
-    v = v_ref[0].astype(jnp.float32)
-    dk = jnp.zeros_like(k)
-    dv = jnp.zeros_like(v)
+    # dot inputs stay in the source dtype (see _fwd_kernel note); scale
+    # is applied to the f32 scores and folded into dk at the end
+    k = k_ref[0]                                        # [block_k, d]
+    v = v_ref[0]
+    dk = jnp.zeros(k.shape, jnp.float32)
+    dv = jnp.zeros(v.shape, jnp.float32)
     k_start = ki * _i32(block_k)
 
     num_qb = seq_q // block_q
 
     def body(qb, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(qb * _i32(block_q), block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(qb * _i32(block_q), block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(qb * _i32(block_q), block_q), :]
+        do = do_ref[0, pl.ds(qb * _i32(block_q), block_q), :]
         lse = lse_ref[0, pl.ds(qb * _i32(block_q), block_q), :]   # [block_q, 1]
         delta = delta_ref[0, pl.ds(qb * _i32(block_q), block_q), :]
-        qs = q * scale
-        s = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         rows = qb * _i32(block_q) + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         cols = k_start + jax.lax.broadcasted_iota(
@@ -246,14 +267,16 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             p_d = jnp.where(keep, p * inv, 0.0)
         else:
             p_d = p
-        dv = dv + jax.lax.dot_general(p_d, do, (((0,), (0,)), ((), ())),
+        dv = dv + jax.lax.dot_general(p_d.astype(do.dtype), do,
+                                      (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if dropout_p > 0.0:
             dp = jnp.where(keep, dp * inv, 0.0)
         ds = p * (dp - delta)
-        dk = dk + jax.lax.dot_general(ds, qs, (((0,), (0,)), ((), ())),
+        dk = dk + jax.lax.dot_general(ds.astype(q.dtype), q,
+                                      (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         return dk, dv
 
@@ -263,7 +286,7 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         start_qb = jnp.maximum(
             _i32(0), (k_start - _i32(offset)) // _i32(block_q))
     dk, dv = jax.lax.fori_loop(start_qb, _i32(num_qb), body, (dk, dv))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
@@ -344,7 +367,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def mha(q, k, v, *, scale=None, causal=False, dropout_p=0.0, seed=None,
-        block_q=128, block_k=128):
+        block_q=256, block_k=256):
     """Flash attention. q,k,v: [batch, heads, seq, head_dim] (or 3-d
     [batch*heads, seq, head_dim]). Returns same shape as q.
 

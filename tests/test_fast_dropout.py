@@ -1,0 +1,86 @@
+"""Counter-hash dropout masks (core/random.py fast_keep_mask).
+
+Round-5 perf change: dropout-class ops draw their keep-masks from a
+murmur-style counter hash instead of jax.random.bernoulli — threefry
+mask generation measured ~55 ms of a 250 ms batch-256 BERT step on the
+v5e (PERF.md round-5). These tests pin the statistical properties the
+swap relies on. Reference: operators/dropout_op.cc (seed/offset
+counter-based GPU dropout — the same design point).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import random as random_core
+from paddle_tpu.nn import functional as F
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(1234)
+
+
+def test_keep_fraction_matches_probability():
+    key = random_core.next_key()
+    for p_keep in (0.5, 0.8, 0.9, 0.99):
+        m = random_core.fast_keep_mask(key, p_keep, (400, 500))
+        frac = float(jnp.mean(m))
+        assert abs(frac - p_keep) < 0.01, (p_keep, frac)
+
+
+def test_deterministic_per_key_and_sensitive_to_key():
+    key = random_core.next_key()
+    m1 = random_core.fast_keep_mask(key, 0.9, (1000, 100))
+    m2 = random_core.fast_keep_mask(key, 0.9, (1000, 100))
+    assert bool(jnp.all(m1 == m2))
+    key2 = jax.random.fold_in(key, 1)
+    m3 = random_core.fast_keep_mask(key2, 0.9, (1000, 100))
+    # independent masks at p=0.9 differ on 2*p*(1-p) = 18% of elements
+    diff = float(jnp.mean(m1 != m3))
+    assert 0.15 < diff < 0.21, diff
+
+
+def test_no_adjacent_row_or_column_correlation():
+    key = random_core.next_key()
+    m = np.asarray(random_core.fast_keep_mask(key, 0.9, (1000, 100)))
+    # independent Bernoulli(0.9) agree on p^2 + q^2 = 0.82
+    rows = (m[:-1] == m[1:]).mean()
+    cols = (m[:, :-1] == m[:, 1:]).mean()
+    assert abs(rows - 0.82) < 0.02, rows
+    assert abs(cols - 0.82) < 0.02, cols
+
+
+def test_jit_with_traced_key():
+    f = jax.jit(lambda k: random_core.fast_keep_mask(k, 0.5, (64, 64)))
+    m = f(random_core.next_key())
+    assert 0.4 < float(jnp.mean(m)) < 0.6
+
+
+def test_functional_dropout_uses_hash_mask():
+    x = paddle.ones([100000])
+    y = np.asarray(F.dropout(x, p=0.25, training=True).numpy())
+    zeros = (y == 0).mean()
+    assert abs(zeros - 0.25) < 0.02, zeros
+    # upscale_in_train: survivors scaled by 1/(1-p)
+    np.testing.assert_allclose(y.max(), 1.0 / 0.75, rtol=1e-6)
+
+
+def test_dropout_axis_broadcast_mask():
+    x = paddle.ones([64, 32])
+    y = np.asarray(F.dropout(x, p=0.5, axis=0, training=True).numpy())
+    # mask broadcasts over axis 1: each row is all-zero or all-scaled
+    row_zero = (y == 0).all(axis=1)
+    row_live = (y > 0).all(axis=1)
+    assert bool((row_zero | row_live).all())
+
+
+def test_grad_flows_through_kept_elements_only():
+    x = paddle.ones([4096])
+    x.stop_gradient = False
+    y = F.dropout(x, p=0.5, training=True)
+    y.sum().backward()
+    g = np.asarray(x.grad.numpy())
+    yv = np.asarray(y.numpy())
+    np.testing.assert_allclose(g, (yv > 0) * 2.0, rtol=1e-6)

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Flash-kernel tuning sweep for a live TPU window (round 5).
+
+Run manually when tools/tpu_watch.py reports the tunnel up (after the
+ladder finishes). Measures, with honest readback timing (PERF.md
+round-5 axon semantics):
+
+  1. our kernel fwd+bwd at several (block_q, block_k) incl. the
+     single-k-step configs (block_k = seq: no online-softmax recurrence)
+  2. the lane-replicated m/l fwd (committed) vs the jax reference kernel
+  3. the Llama-2-7B attention shape (h=32, d=128) where the MXU
+     contraction is full-width — candidate flash-bench config
+
+Prints one line per config; exits cleanly on wedge (TimeoutError).
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(REPO, ".jax_compile_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+from paddle_tpu.ops.pallas.flash_attention import mha
+
+STEPS = 10
+
+
+def bench(name, fn, args, flops):
+    f = jax.jit(fn)
+    t0 = time.time()
+    float(f(*args, jnp.int32(10**6)))
+    c = time.time() - t0
+    t0 = time.time()
+    out = None
+    for i in range(STEPS):
+        out = f(*args, jnp.int32(i))
+    float(out)
+    dt = (time.time() - t0) / STEPS
+    print(f"{name:38s} {dt*1e3:8.2f} ms  {flops/dt/1e12:7.1f} TF/s"
+          f"  (compile {c:.0f}s)", flush=True)
+
+
+def qkv(b, h, s, d):
+    rng = np.random.RandomState(0)
+    return tuple(jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16)
+                 for _ in range(3))
+
+
+def fwdbwd(bq, bk):
+    def loss(q, k, v):
+        return mha(q, k, v, causal=True, block_q=bq,
+                   block_k=bk).astype(jnp.float32).sum()
+
+    def fn(q, k, v, i):
+        qi = q + jnp.bfloat16(1e-3) * i.astype(jnp.bfloat16)
+        lv, g = jax.value_and_grad(loss, argnums=(0, 1, 2))(qi, k, v)
+        return lv + sum(x.astype(jnp.float32).sum() for x in g)
+    return fn
+
+
+def fwd_only(bq, bk):
+    def fn(q, k, v, i):
+        qi = q + jnp.bfloat16(1e-3) * i.astype(jnp.bfloat16)
+        return mha(qi, k, v, causal=True, block_q=bq,
+                   block_k=bk).astype(jnp.float32).sum()
+    return fn
+
+
+def main():
+    print("devices:", jax.devices(), flush=True)
+    # BERT-ish long-context shape (current flash bench config)
+    b, h, s, d = 8, 12, 4096, 64
+    args = qkv(b, h, s, d)
+    FWD = 4.0 * b * h * s * s * d * 0.5
+    for bq, bk in [(256, 256), (512, 512), (128, 4096), (256, 4096),
+                   (256, 2048)]:
+        try:
+            bench(f"d64 fwd {bq}x{bk}", fwd_only(bq, bk), args, FWD)
+        except Exception as e:
+            print(f"d64 fwd {bq}x{bk}: FAIL {type(e).__name__}", flush=True)
+    for bq, bk in [(256, 256), (512, 512), (256, 2048)]:
+        try:
+            bench(f"d64 fwd+bwd {bq}x{bk}", fwdbwd(bq, bk), args, FWD * 3.5)
+        except Exception as e:
+            print(f"d64 f+b {bq}x{bk}: FAIL {type(e).__name__}", flush=True)
+
+    # Llama-2-7B attention shape: full-width MXU contraction
+    b, h, s, d = 4, 32, 4096, 128
+    args = qkv(b, h, s, d)
+    FWD = 4.0 * b * h * s * s * d * 0.5
+    for bq, bk in [(256, 256), (512, 512)]:
+        try:
+            bench(f"d128 fwd+bwd {bq}x{bk}", fwdbwd(bq, bk), args, FWD * 3.5)
+        except Exception as e:
+            print(f"d128 f+b {bq}x{bk}: FAIL {type(e).__name__}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
