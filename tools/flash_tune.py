@@ -1,9 +1,12 @@
 #!/usr/bin/env python
 """Flash-kernel tuning sweep for a live TPU window (round 5).
 
-Run manually when tools/tpu_watch.py reports the tunnel up (after the
-ladder finishes). Measures, with honest readback timing (PERF.md
-round-5 axon semantics):
+Fired automatically by tools/tpu_watch.py after the bench ladder goes
+green (output: /tmp/flash_tune.log); safe to run manually too, but
+check the watcher isn't mid-sweep first. Exits non-zero unless at
+least 3 configs produced numbers, so a wedged tunnel can't record a
+fake success. Measures, with honest readback timing (PERF.md round-5
+axon semantics):
 
   1. our kernel fwd+bwd at several (block_q, block_k) incl. the
      single-k-step configs (block_k = seq: no online-softmax recurrence)
@@ -28,10 +31,15 @@ jax.config.update("jax_compilation_cache_dir",
                   os.path.join(REPO, ".jax_compile_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+if os.environ.get("FLASH_TUNE_SMOKE") == "1":
+    jax.config.update("jax_platforms", "cpu")  # sitecustomize forces axon
 
 from paddle_tpu.ops.pallas.flash_attention import mha
 
 STEPS = 10
+
+
+OK_COUNT = [0]
 
 
 def bench(name, fn, args, flops):
@@ -47,6 +55,7 @@ def bench(name, fn, args, flops):
     dt = (time.time() - t0) / STEPS
     print(f"{name:38s} {dt*1e3:8.2f} ms  {flops/dt/1e12:7.1f} TF/s"
           f"  (compile {c:.0f}s)", flush=True)
+    OK_COUNT[0] += 1
 
 
 def qkv(b, h, s, d):
@@ -77,6 +86,17 @@ def fwd_only(bq, bk):
 
 def main():
     print("devices:", jax.devices(), flush=True)
+    smoke = os.environ.get("FLASH_TUNE_SMOKE") == "1"
+    if smoke:
+        # tiny end-to-end validation on CPU (interpret mode); numbers
+        # meaningless, the point is the script cannot crash in a window
+        b, h, s, d = 1, 2, 256, 32
+        args = qkv(b, h, s, d)
+        bench("smoke fwd+bwd 128x128", fwdbwd(128, 128),
+              args, 4.0 * b * h * s * s * d * 0.5 * 3.5)
+        bench("smoke fwd 128x256", fwd_only(128, 256),
+              args, 4.0 * b * h * s * s * d * 0.5)
+        return
     # BERT-ish long-context shape (current flash bench config)
     b, h, s, d = 8, 12, 4096, 64
     args = qkv(b, h, s, d)
@@ -102,7 +122,9 @@ def main():
             bench(f"d128 fwd+bwd {bq}x{bk}", fwdbwd(bq, bk), args, FWD * 3.5)
         except Exception as e:
             print(f"d128 f+b {bq}x{bk}: FAIL {type(e).__name__}", flush=True)
+    print(f"{OK_COUNT[0]} configs measured", flush=True)
+    return 0 if OK_COUNT[0] >= 3 else 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""BERT-step micro-experiments for a live TPU window (round 5, pass 2).
+
+Fired automatically by tools/tpu_watch.py after the bench ladder goes
+green (output: /tmp/step_tune.log); safe to run manually too, but
+check the watcher isn't mid-sweep first. Exits non-zero unless at
+least 4 variants produced numbers, so a wedged tunnel can't record a
+fake success. Measures, with honest readback timing (PERF.md round-5
+axon semantics), the post-optimization step and the remaining
+candidate levers:
+
+  A. full step, current defaults (XLA attention at seq 128 + hash
+     dropout) — the number the bert_sweep stage should reproduce
+  B. dropout off — isolates the hash-mask cost (threefry was ~55 ms)
+  C. amp O2 (pure bf16) — master-weight/elementwise HBM traffic
+  D. no grad clip — global-norm pass cost
+  E. embedding backward: scatter (default) vs one-hot matmul oracle
+
+Prints one line per variant.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(REPO, ".jax_compile_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+if os.environ.get("STEP_TUNE_SMOKE") == "1":
+    jax.config.update("jax_platforms", "cpu")  # sitecustomize forces axon
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import spmd, topology
+from paddle_tpu.text.models import BertForPretraining
+
+# STEP_TUNE_SMOKE=1: tiny shapes on CPU to validate the script end-to-end
+# without burning a tunnel window on a crash
+SMOKE = os.environ.get("STEP_TUNE_SMOKE") == "1"
+B, SEQ, MAXP = (8, 32, 5) if SMOKE else (256, 128, 20)
+STEPS = 2 if SMOKE else 10
+
+
+def full_step(name, dropout=0.1, amp="O1", clip=True):
+    paddle.seed(0)
+    if SMOKE:
+        model = BertForPretraining(
+            vocab_size=512, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=2, intermediate_size=128,
+            hidden_dropout_prob=dropout,
+            attention_probs_dropout_prob=dropout)
+    else:
+        model = BertForPretraining(hidden_dropout_prob=dropout,
+                                   attention_probs_dropout_prob=dropout)
+    opt = optimizer.AdamW(
+        1e-4, parameters=model.parameters(), weight_decay=0.01,
+        grad_clip=nn.ClipGradByGlobalNorm(1.0) if clip else None)
+    vocab = model.bert.vocab_size
+
+    class W(nn.Layer):
+        def __init__(self, inner):
+            super().__init__()
+            self.inner = inner
+
+        def forward(self, packed):
+            mlm, _ = self.inner(packed[:, :SEQ],
+                                masked_positions=packed[:, SEQ:])
+            return mlm
+
+    def loss_fn(mlm, labels):
+        logp = jax.nn.log_softmax(mlm.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(logp, labels[..., None],
+                                     axis=-1)[..., 0]
+        return -jnp.mean(picked)
+
+    mesh = topology.build_mesh(dp=1)
+    topology.set_global_mesh(mesh)
+    step_fn, init_fn = spmd.build_train_step(W(model), loss_fn, opt,
+                                             mesh=mesh, amp_level=amp,
+                                             donate=True)
+    params, opt_state = init_fn()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (B, SEQ)).astype(np.int32)
+    pos = np.stack([rng.choice(SEQ, MAXP, replace=False)
+                    for _ in range(B)]).astype(np.int32)
+    packed = jnp.asarray(np.concatenate([ids, pos], axis=1))
+    labels = jnp.asarray(rng.randint(0, vocab, (B, MAXP)).astype(np.int32))
+    key = jax.random.PRNGKey(0)
+
+    t0 = time.time()
+    loss, params, opt_state = step_fn(params, opt_state, packed, labels,
+                                      key=jax.random.fold_in(key, 0))
+    float(loss)
+    c = time.time() - t0
+    t0 = time.time()
+    for i in range(STEPS):
+        loss, params, opt_state = step_fn(params, opt_state, packed, labels,
+                                          key=jax.random.fold_in(key, 1 + i))
+    float(loss)
+    dt = (time.time() - t0) / STEPS
+    print(f"{name:44s} {dt*1e3:8.2f} ms/step {B*SEQ/dt:9.0f} tok/s"
+          f"  (compile {c:.0f}s)", flush=True)
+
+
+def embedding_bwd(name, mode):
+    """Isolated embedding fwd+bwd: scatter-add (XLA default for gather
+    grad) vs one-hot matmul (MXU-friendly; costs 2*T*V*H flops)."""
+    V, H = (512, 64) if SMOKE else (30522, 768)
+    rng = np.random.RandomState(0)
+    table = jnp.asarray(rng.randn(V, H) * 0.02, jnp.float32)
+    ids = jnp.asarray(rng.randint(0, V, (B * SEQ,)).astype(np.int32))
+
+    if mode == "scatter":
+        def loss(tab, i):
+            emb = tab[ids] * (1.0 + 1e-6 * i)
+            return (emb.astype(jnp.float32) ** 2).sum()
+    else:
+        def loss(tab, i):
+            oh = jax.nn.one_hot(ids, V, dtype=jnp.bfloat16)
+            emb = jax.lax.dot_general(
+                oh, tab.astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * (1.0 + 1e-6 * i)
+            return (emb ** 2).sum()
+
+    def fn(tab, i):
+        lv, g = jax.value_and_grad(loss)(tab, i)
+        return lv + g.sum()
+
+    f = jax.jit(fn)
+    t0 = time.time()
+    float(f(table, jnp.float32(10**6)))
+    c = time.time() - t0
+    t0 = time.time()
+    out = None
+    for i in range(STEPS):
+        out = f(table, jnp.float32(i))
+    float(out)
+    dt = (time.time() - t0) / STEPS
+    print(f"{name:44s} {dt*1e3:8.2f} ms  (compile {c:.0f}s)", flush=True)
+
+
+def main():
+    print("devices:", jax.devices(), flush=True)
+    ok = 0
+    for label, fn in [
+        ("A full step (defaults: XLA attn + hash drop)",
+         lambda: full_step("A full step (defaults: XLA attn + hash drop)")),
+        ("B dropout off", lambda: full_step("B dropout off", dropout=0.0)),
+        ("C amp O2 pure bf16",
+         lambda: full_step("C amp O2 pure bf16", amp="O2")),
+        ("D no grad clip", lambda: full_step("D no grad clip", clip=False)),
+        ("E1 embedding bwd: scatter",
+         lambda: embedding_bwd("E1 embedding bwd: scatter", "scatter")),
+        ("E2 embedding bwd: one-hot matmul",
+         lambda: embedding_bwd("E2 embedding bwd: one-hot matmul",
+                               "onehot")),
+    ]:
+        try:
+            fn()
+            ok += 1
+        except Exception as e:
+            print(f"{label}: FAIL {type(e).__name__}: {e}", flush=True)
+    print(f"{ok} variants measured", flush=True)
+    return 0 if ok >= 4 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,28 +53,57 @@ def main():
 
     from tpu_ladder import STAGES, tunnel_alive  # noqa: E402 - sibling
 
+    def run_post(p):
+        """One post-ladder sweep as a killable subprocess; rc or -9."""
+        import signal
+
+        log(f"post: running tools/{p}.py -> /tmp/{p}.log")
+        with open(f"/tmp/{p}.log", "a") as f:
+            proc = subprocess.Popen(
+                [sys.executable, os.path.join(REPO, f"tools/{p}.py")],
+                stdout=f, stderr=subprocess.STDOUT,
+                cwd=REPO, start_new_session=True)
+            try:
+                rc = proc.wait(timeout=1500)
+            except subprocess.TimeoutExpired:
+                os.killpg(proc.pid, signal.SIGKILL)
+                proc.wait()
+                rc = -9
+        log(f"post {p}: rc={rc}")
+        return rc
+
     deadline = time.time() + args.hours * 3600.0
     attempt = 0
-    fails = {}  # stage -> count of non-wedge failures (crashes)
+    fails = {}       # ladder stage -> count of non-wedge crashes
+    post_fails = {}  # post sweep -> count of failed attempts
+    # done markers are keyed to --out (not bare /tmp names) so a stale
+    # marker from another run/checkout can't silently skip a sweep
+    post_marker = lambda p: args.out + f".{p}.done"  # noqa: E731
     while time.time() < deadline:
         done = done_stages(args.out)
         # a stage that crashed deterministically --max-fails times keeps
         # getting skipped so it can't starve later stages inside a rare
         # short window (wedge-signature failures don't count: those
-        # abort the pass and say nothing about the stage itself)
+        # abort the pass and say nothing about the stage itself); the
+        # post sweeps get the same cap so a deterministic crash can't
+        # eat every remaining window
         bad = {s for s, n in fails.items() if n >= args.max_fails}
         todo = [name for name, _ in STAGES
                 if name not in done and name not in bad]
-        if not todo:
-            if bad:
-                log(f"nothing left to run (green={sorted(done)}, "
-                    f"crashed out={sorted(bad)}) — exiting")
-                return 1
-            log("all ladder stages green — exiting")
-            return 0
+        posts = [p for p in ("flash_tune", "step_tune")
+                 if not os.path.exists(post_marker(p))
+                 and post_fails.get(p, 0) < args.max_fails]
+        if not todo and not posts:
+            log(f"nothing left to run (green={sorted(done)}, "
+                f"crashed out={sorted(bad)}, "
+                f"post fails={post_fails}) — exiting")
+            return 1 if (bad or post_fails) else 0
         attempt += 1
         t0 = time.time()
-        if tunnel_alive(timeout=args.probe_timeout):
+        if not tunnel_alive(timeout=args.probe_timeout):
+            log(f"probe {attempt}: tunnel down "
+                f"(todo={todo} posts={posts})")
+        elif todo:
             log(f"probe {attempt}: TUNNEL UP — running ladder, todo={todo}")
             # the ladder derives the green skip set itself from rc==0
             # stages in --out; crashed-out stages ride the override var
@@ -98,7 +127,19 @@ def main():
                 pass
             log(f"ladder pass finished; done={sorted(done)} fails={fails}")
         else:
-            log(f"probe {attempt}: tunnel down")
+            # ladder done: the post-ladder tuning sweeps (round-5 pass 2:
+            # kernel block sweep + step-lever A/B), each once
+            # successfully; a failed attempt retries next window up to
+            # the cap (the sweeps exit non-zero unless enough variants
+            # produced numbers, so a wedge can't fake success)
+            for p in posts:
+                rc = run_post(p)
+                if rc == 0:
+                    with open(post_marker(p), "w") as f:
+                        f.write("ok")
+                else:
+                    post_fails[p] = post_fails.get(p, 0) + 1
+                    break  # likely wedge: re-probe before the next sweep
         # keep probe STARTS no more than interval apart (a dead-tunnel
         # probe burns its full timeout; the observed windows are ~2 min,
         # so probe-start spacing must stay under that)
