@@ -89,6 +89,13 @@ def next_key():
     return _GLOBAL_GENERATOR.next_key()
 
 
+def keep_thresh_u32(keep_prob):
+    """keep probability -> uint32 comparison threshold (single source for
+    functional dropout AND the flash kernel's in-kernel dropout — the two
+    must keep identical fractions for the same p)."""
+    return min(int(float(keep_prob) * 4294967296.0), 4294967295)
+
+
 def fmix32(h):
     """murmur3's 32-bit avalanche finalizer (shared by fast_keep_mask and
     the flash kernel's in-kernel dropout — one definition, one bit
@@ -120,9 +127,10 @@ def fast_keep_mask(key, keep_prob, shape):
     n = 1
     for s in shape:
         n *= int(s)
-    thresh = jnp.uint32(min(int(float(keep_prob) * 4294967296.0),
-                            4294967295))
-    h = jax.lax.iota(jnp.uint32, max(n, 1)) * jnp.uint32(0x9E3779B1)
+    if n == 0:  # empty tensors keep an empty mask (bernoulli parity)
+        return jnp.zeros(shape, bool)
+    thresh = jnp.uint32(keep_thresh_u32(keep_prob))
+    h = jax.lax.iota(jnp.uint32, n) * jnp.uint32(0x9E3779B1)
     for w in range(kd.shape[0]):
         h = (h ^ kd[w]) * jnp.uint32(0x85EBCA6B)
         h ^= h >> jnp.uint32(13)

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ...core.random import fmix32
+from ...core.random import fmix32, keep_thresh_u32
 
 NEG_INF = -1e30
 
@@ -68,86 +68,119 @@ def _keep_mask(seed, b, rows, cols, seq_q, seq_k, keep_thresh):
 
 # ---------------------------------------------------------------- forward
 
-def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
-                causal, block_q, block_k, seq_q, seq_k, offset, dropout_p,
-                keep_thresh):
+LANES = 128
+
+
+def _lane_bcast(block_q, n):
+    """Lane-group broadcast ([block_q, LANES] -> [block_q, n]): a tile is
+    a cheap lane copy when n is lane-aligned; odd widths fall back to a
+    column broadcast."""
+    if n % LANES == 0:
+        return lambda a: jnp.tile(a, (1, n // LANES))
+    return lambda a: jnp.broadcast_to(a[:, :1], (block_q, n))
+
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_ref, l_ref, acc_ref, *, scale, causal, block_q, block_k,
+                seq_q, seq_k, offset, dropout_p, keep_thresh):
+    """Streaming-grid flash forward: grid (bh, q_blocks, k_blocks) with k
+    innermost, one K/V tile per grid step (Mosaic double-buffers the tile
+    DMA against compute — the full-K/V-in-VMEM design it replaces was
+    bound by per-program overhead and capped at seq ~16k by the 16 MB
+    scoped VMEM limit). Running max/sum/acc live in VMEM scratch that
+    persists across the k steps of one q block; they are LANE-REPLICATED
+    at [block_q, LANES] because narrow-column f32 arrays waste the
+    (8,128) vector registers and force a relayout on every online-softmax
+    update. MXU inputs stay in the source dtype (bf16): casting to f32
+    forces multi-pass f32 MXU matmuls, measured ~8x slower; accumulation
+    is f32 via preferred_element_type, and the softmax scale is applied
+    to the f32 scores rather than pre-scaling q."""
     bi = _i32(pl.program_id(0))
     qi = _i32(pl.program_id(1))
+    ki = _i32(pl.program_id(2))
     seed = seed_ref[0, 0].astype(jnp.uint32)
-    # Keep the MXU inputs in the source dtype (bf16 in practice): casting
-    # q/k/v to f32 before the dots forces multi-pass f32 MXU matmuls,
-    # measured ~8x slower end-to-end at seq 4096. Accumulation stays f32
-    # via preferred_element_type; the softmax scale is applied to the f32
-    # scores rather than pre-scaling q (better numerics in bf16 anyway).
-    q = q_ref[0]                                        # [block_q, d]
-    d = q.shape[-1]
-    # Running max/sum are kept LANE-REPLICATED at [block_q, LANES] (not
-    # [block_q, 1]): narrow-column f32 arrays waste the (8,128) vector
-    # registers and force a relayout on every online-softmax update —
-    # the dominant VPU cost of the forward at long seq.
-    LANES = 128
-    m = jnp.full((block_q, LANES), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q, LANES), jnp.float32)
-    acc = jnp.zeros((block_q, d), jnp.float32)
-
-    def _bcast(n):
-        # lane-group broadcast ([block_q, LANES] -> [block_q, n]): a tile
-        # is a cheap lane copy when n is lane-aligned; odd widths fall
-        # back to a column broadcast
-        if n % LANES == 0:
-            return lambda a: jnp.tile(a, (1, n // LANES))
-        return lambda a: jnp.broadcast_to(a[:, :1], (block_q, n))
-
-    bcast_k, bcast_d = _bcast(block_k), _bcast(d)
-
     num_kb = seq_k // block_k
     q_start = qi * _i32(block_q)
+    k_start = ki * _i32(block_k)
+    d = q_ref.shape[-1]
+    bcast_k = _lane_bcast(block_q, block_k)
+    bcast_d = _lane_bcast(block_q, d)
 
-    def body(kb, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(kb * _i32(block_k), block_k), :]
-        v = v_ref[0, pl.ds(kb * _i32(block_k), block_k), :]
+    if causal:
+        # the last k block this q block attends to; later ones are
+        # skipped entirely (compute AND the finalize write both key off
+        # it, so the output is stored exactly once). Clamped to >= 0 so
+        # a fully-masked q block (seq_q > seq_k with causal) still
+        # finalizes — writing the zeros/-inf the masked rows deserve —
+        # instead of leaving the output block unwritten.
+        last_kb = jnp.clip(
+            (q_start + _i32(block_q - 1 + offset)) // _i32(block_k),
+            _i32(0), _i32(num_kb - 1))
+        needed = k_start <= q_start + _i32(block_q - 1 + offset)
+    else:
+        last_kb = _i32(num_kb - 1)
+        needed = None
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full(m_ref.shape, NEG_INF, jnp.float32)
+        l_ref[...] = jnp.zeros(l_ref.shape, jnp.float32)
+        acc_ref[...] = jnp.zeros(acc_ref.shape, jnp.float32)
+
+    def _compute():
+        q = q_ref[0]                                    # [block_q, d]
+        k = k_ref[0]                                    # [block_k, d]
+        v = v_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [block_q, block_k]
         rows = q_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
-        cols = kb * _i32(block_k) + jax.lax.broadcasted_iota(
+        cols = k_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         if causal:
             s = jnp.where(rows + _i32(offset) >= cols, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - bcast_k(m_new))
-        alpha = jnp.exp(m - m_new)
-        # dropout applies to softmax probs: l accumulates the undropped sum
-        # (the normalizer), acc the dropped numerator
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if causal:
+            # a row with EVERY entry masked has m_new == NEG_INF, making
+            # exp(s - m) = exp(0) = 1 across the row — zero those entries
+            # so fully-masked rows produce o = 0, not the mean of v
+            p = jnp.where(s == NEG_INF, 0.0, p)
+        alpha = jnp.exp(m_prev - m_new)
+        # dropout applies to softmax probs: l accumulates the undropped
+        # sum (the normalizer), acc the dropped numerator
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[...] = m_new
         if dropout_p > 0.0:
-            keep = _keep_mask(seed, bi, rows, cols, seq_q, seq_k, keep_thresh)
+            keep = _keep_mask(seed, bi, rows, cols, seq_q, seq_k,
+                              keep_thresh)
             p = jnp.where(keep, p * (1.0 / (1.0 - dropout_p)), 0.0)
-        acc = acc * bcast_d(alpha) + jax.lax.dot_general(
+        acc_ref[...] = acc_ref[...] * bcast_d(alpha) + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l, acc
 
     if causal:
-        # only k blocks whose start <= q block end + offset contribute
-        last = (q_start + _i32(block_q + offset + block_k - 1)) // _i32(block_k)
-        num_kb = jnp.minimum(_i32(num_kb), last)
-    m, l, acc = jax.lax.fori_loop(_i32(0), _i32(num_kb) if isinstance(num_kb, int) else num_kb, body, (m, l, acc))
-    l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / bcast_d(l)).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, :1]               # [block_q, 1]
+        pl.when(needed)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == last_kb)
+    def _finalize():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / bcast_d(l)).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[...] + jnp.log(l))[:, :1]  # [block_q, 1]
 
 
 def _keep_thresh(dropout_p):
-    return min(int((1.0 - dropout_p) * 4294967296.0), 4294967295)
+    return keep_thresh_u32(1.0 - dropout_p)
 
 
 def _fwd(q, k, v, seed, scale, causal, block_q, block_k, dropout_p):
     bh, seq_q, d = q.shape
     seq_k = k.shape[1]
-    grid = (bh, seq_q // block_q)
+    grid = (bh, seq_q // block_q, seq_k // block_k)
     out_shape = (
         jax.ShapeDtypeStruct(q.shape, q.dtype),
         # lse kept 3-d with trailing dim 1: TPU block shapes must tile
@@ -159,20 +192,38 @@ def _fwd(q, k, v, seed, scale, causal, block_q, block_k, dropout_p):
         block_q=block_q, block_k=block_k, seq_q=seq_q, seq_k=seq_k,
         offset=seq_k - seq_q, dropout_p=dropout_p,
         keep_thresh=_keep_thresh(dropout_p))
+    if causal:
+        # skipped upper-triangle k steps map to the last NEEDED tile of
+        # their q block, so Mosaic's revisit cache dedups the DMA — the
+        # pl.when compute gate alone would still fetch every skipped
+        # K/V tile from HBM
+        off = seq_k - seq_q
+        nkb = seq_k // block_k
+
+        def kv_index(b, i, j):
+            last = (i * block_q + block_q - 1 + off) // block_k
+            return (b, jnp.clip(jnp.minimum(j, last), 0, nkb - 1), 0)
+    else:
+        kv_index = lambda b, i, j: (b, j, 0)  # noqa: E731
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
         ],
         out_specs=(
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ),
         out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),   # running max
+            pltpu.VMEM((block_q, LANES), jnp.float32),   # running sum
+            pltpu.VMEM((block_q, d), jnp.float32),       # output acc
+        ],
         interpret=_interpret(),
         cost_estimate=pl.CostEstimate(
             flops=4 * seq_q * seq_k * d,
@@ -213,6 +264,10 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             s = jnp.where(rows + _i32(offset) >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)                            # [bq, bk]
+        if causal:
+            # fully-masked rows have lse ~= NEG_INF, so exp(s - lse)
+            # cancels to 1 on masked entries; zero them (see _fwd_kernel)
+            p = jnp.where(s == NEG_INF, 0.0, p)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if dropout_p > 0.0:
@@ -261,6 +316,9 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             s = jnp.where(rows + _i32(offset) >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)
+        if causal:
+            # see _fwd_kernel: zero masked entries of fully-masked rows
+            p = jnp.where(s == NEG_INF, 0.0, p)
         if dropout_p > 0.0:
             keep = _keep_mask(seed, bi, rows, cols, seq_q, seq_k, keep_thresh)
             inv = 1.0 / (1.0 - dropout_p)

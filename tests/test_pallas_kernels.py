@@ -202,3 +202,35 @@ def test_flash_multiblock_long_seq(causal):
     for a, b in zip(g_fa, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=3e-4, atol=3e-4)
+
+
+def test_flash_fully_masked_rows_zero():
+    """Causal with seq_q > seq_k: rows whose causal window is empty must
+    produce o = 0 with zero gradient — not exp(0)=1 uniform attention
+    (the online-softmax degenerate case where the running max never
+    leaves NEG_INF). Covers both the block-aligned and the
+    straddling-block layout of the masked region."""
+    rng = np.random.RandomState(11)
+    B, H, SQ, SK, D = 1, 1, 128, 64, 16
+    q = jnp.array(rng.randn(B, H, SQ, D) * 0.3, jnp.float32)
+    k = jnp.array(rng.randn(B, H, SK, D) * 0.3, jnp.float32)
+    v = jnp.array(rng.randn(B, H, SK, D), jnp.float32)
+    # rows 0..SK-1 attend to nothing (offset = SK - SQ = -64). The module
+    # _ref uses top-left causal alignment; mha is bottom-right-aligned
+    # (row r attends cols <= r + seq_k - seq_q), so build the reference
+    # with that mask directly.
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    mask = jnp.tril(jnp.ones((SQ, SK), bool), k=SK - SQ)
+    ref = jnp.einsum("bhqk,bhkd->bhqd",
+                     jax.nn.softmax(jnp.where(mask, s, -1e30), axis=-1), v)
+    for bq, bk in [(64, 64), (128, 64)]:  # aligned / straddling
+        out = fa.mha(q, k, v, causal=True, block_q=bq, block_k=bk)
+        np.testing.assert_allclose(np.asarray(out[:, :, SK:]),
+                                   np.asarray(ref[:, :, SK:]),
+                                   rtol=2e-4, atol=2e-4)
+        assert float(jnp.abs(out[:, :, :SK]).max()) == 0.0
+
+        g = jax.grad(lambda q: jnp.sum(fa.mha(q, k, v, causal=True,
+                                              block_q=bq,
+                                              block_k=bk)))(q)
+        assert float(jnp.abs(g[:, :, :SK]).max()) == 0.0

@@ -94,10 +94,15 @@ def main():
                  if not os.path.exists(post_marker(p))
                  and post_fails.get(p, 0) < args.max_fails]
         if not todo and not posts:
+            # judge posts by capped-out failures, not historical retries
+            # that later succeeded (their marker exists, so posts is
+            # empty either way)
+            capped = {p for p, n in post_fails.items()
+                      if n >= args.max_fails}
             log(f"nothing left to run (green={sorted(done)}, "
                 f"crashed out={sorted(bad)}, "
-                f"post fails={post_fails}) — exiting")
-            return 1 if (bad or post_fails) else 0
+                f"post capped={sorted(capped)}) — exiting")
+            return 1 if (bad or capped) else 0
         attempt += 1
         t0 = time.time()
         if not tunnel_alive(timeout=args.probe_timeout):
