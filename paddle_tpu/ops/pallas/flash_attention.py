@@ -6,13 +6,18 @@ paddle/fluid/operators/fused/multihead_matmul_op.cu) — those are CUDA
 softmax-fused matmuls; here the idiomatic TPU design is the standard
 flash-attention online-softmax recurrence tiled for the MXU:
 
-- grid over (batch*heads, q_blocks); each program holds one q tile in
-  VMEM plus the full K/V for that head (K/V for one head are small:
-  seq*head_dim, e.g. 4096*128*2B = 1MB bf16) and loops over k tiles
-  with `lax.fori_loop`, keeping running max/sum in f32.
-- backward follows the standard two-kernel flash backward: dq via a
-  q-tile grid, dk/dv via a k-tile grid, both recomputing probabilities
-  from the saved logsumexp (no S*S materialisation anywhere).
+- streaming 3-d grids: forward and dq run (bh, q_blocks, k_blocks)
+  with ONE K/V tile fetched per grid step (Mosaic double-buffers the
+  DMA against compute); dk/dv runs (bh, k_blocks, q_blocks) streaming
+  Q/dO tiles. Accumulators (running max/sum, output/grad partials)
+  live in VMEM scratch that persists across the inner grid dimension,
+  lane-replicated at [block, 128] where narrow columns would waste the
+  vector registers. Causal grids skip fully-masked steps and remap
+  their tile index so the revisit cache elides the dead DMA.
+- backward is the standard two-kernel flash backward recomputing
+  probabilities from the saved logsumexp (no S*S materialisation
+  anywhere, and no full-K/V VMEM residency: seq length is not capped
+  by the 16 MB scoped-VMEM limit).
 
 All matmuls request `preferred_element_type=float32` so the MXU
 accumulates in f32 even for bf16 inputs. On CPU the same kernels run in
@@ -71,6 +76,23 @@ def _keep_mask(seed, b, rows, cols, seq_q, seq_k, keep_thresh):
 LANES = 128
 
 
+def _causal_last_kb(q_block, block_q, block_k, offset, num_kb):
+    """Index of the LAST k block the rows of ``q_block`` attend to under
+    bottom-right-aligned causal masking (row r attends cols <= r+offset),
+    clamped into the grid. Single source for the in-kernel compute gates
+    AND the DMA index-map remaps — the two must stay bit-identical or a
+    kernel computes against a tile the index map never fetched."""
+    raw = (q_block * block_q + block_q - 1 + offset) // block_k
+    return jnp.clip(raw, 0, num_kb - 1).astype(jnp.int32)
+
+
+def _causal_first_qb(k_block, block_q, block_k, offset, num_qb):
+    """Index of the FIRST q block with any unmasked row for ``k_block``
+    (mirror of _causal_last_kb for the dk/dv streaming grid)."""
+    raw = (k_block * block_k - offset) // block_q
+    return jnp.clip(raw, 0, num_qb - 1).astype(jnp.int32)
+
+
 def _lane_bcast(block_q, n):
     """Lane-group broadcast ([block_q, LANES] -> [block_q, n]): a tile is
     a cheap lane copy when n is lane-aligned; odd widths fall back to a
@@ -109,13 +131,11 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     if causal:
         # the last k block this q block attends to; later ones are
         # skipped entirely (compute AND the finalize write both key off
-        # it, so the output is stored exactly once). Clamped to >= 0 so
-        # a fully-masked q block (seq_q > seq_k with causal) still
-        # finalizes — writing the zeros/-inf the masked rows deserve —
+        # it, so the output is stored exactly once). The clamp means a
+        # fully-masked q block (seq_q > seq_k with causal) still
+        # finalizes — writing the zeros the masked rows deserve —
         # instead of leaving the output block unwritten.
-        last_kb = jnp.clip(
-            (q_start + _i32(block_q - 1 + offset)) // _i32(block_k),
-            _i32(0), _i32(num_kb - 1))
+        last_kb = _causal_last_kb(qi, block_q, block_k, offset, num_kb)
         needed = k_start <= q_start + _i32(block_q - 1 + offset)
     else:
         last_kb = _i32(num_kb - 1)
@@ -201,8 +221,8 @@ def _fwd(q, k, v, seed, scale, causal, block_q, block_k, dropout_p):
         nkb = seq_k // block_k
 
         def kv_index(b, i, j):
-            last = (i * block_q + block_q - 1 + off) // block_k
-            return (b, jnp.clip(jnp.minimum(j, last), 0, nkb - 1), 0)
+            last = _causal_last_kb(i, block_q, block_k, off, nkb)
+            return (b, jnp.minimum(j, last), 0)
     else:
         kv_index = lambda b, i, j: (b, j, 0)  # noqa: E731
     o, lse = pl.pallas_call(
@@ -236,30 +256,44 @@ def _fwd(q, k, v, seed, scale, causal, block_q, block_k, dropout_p):
 # ---------------------------------------------------------------- backward
 
 def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, *, scale, causal, block_q, block_k, seq_q, seq_k,
-                   offset, dropout_p, keep_thresh):
+                   dq_ref, acc_ref, *, scale, causal, block_q, block_k,
+                   seq_q, seq_k, offset, dropout_p, keep_thresh):
+    """Streaming dq: grid (bh, q_blocks, k_blocks), one K/V tile per step
+    (same design as _fwd_kernel — no full-K/V VMEM residency, no seq
+    cap); the dq accumulator lives in VMEM scratch across the k steps.
+    Dot inputs stay in the source dtype; scale is applied to the f32
+    scores and folded into dq at the finalize step."""
     bi = _i32(pl.program_id(0))
     qi = _i32(pl.program_id(1))
+    ki = _i32(pl.program_id(2))
     seed = seed_ref[0, 0].astype(jnp.uint32)
-    # dot inputs stay in the source dtype (see _fwd_kernel note); scale
-    # is applied to the f32 scores and folded into dq at the end
-    q = q_ref[0]
-    do = do_ref[0]
-    lse = lse_ref[0]                                    # [block_q, 1]
-    delta = delta_ref[0]
-    dq = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
-    q_start = qi * _i32(block_q)
-
     num_kb = seq_k // block_k
+    q_start = qi * _i32(block_q)
+    k_start = ki * _i32(block_k)
 
-    def body(kb, dq):
-        k = k_ref[0, pl.ds(kb * _i32(block_k), block_k), :]
-        v = v_ref[0, pl.ds(kb * _i32(block_k), block_k), :]
+    if causal:
+        last_kb = _causal_last_kb(qi, block_q, block_k, offset, num_kb)
+        needed = k_start <= q_start + _i32(block_q - 1 + offset)
+    else:
+        last_kb = _i32(num_kb - 1)
+        needed = None
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros(acc_ref.shape, jnp.float32)
+
+    def _compute():
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]                                # [block_q, 1]
+        delta = delta_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         rows = q_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
-        cols = kb * _i32(block_k) + jax.lax.broadcasted_iota(
+        cols = k_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         if causal:
             s = jnp.where(rows + _i32(offset) >= cols, s, NEG_INF)
@@ -271,45 +305,61 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if dropout_p > 0.0:
-            keep = _keep_mask(seed, bi, rows, cols, seq_q, seq_k, keep_thresh)
+            keep = _keep_mask(seed, bi, rows, cols, seq_q, seq_k,
+                              keep_thresh)
             dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_p)), 0.0)
         ds = p * (dp - delta)
-        return dq + jax.lax.dot_general(
+        acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
-        last = (q_start + _i32(block_q + offset + block_k - 1)) // _i32(block_k)
-        num_kb = jnp.minimum(_i32(num_kb), last)
-    dq = jax.lax.fori_loop(_i32(0), _i32(num_kb) if isinstance(num_kb, int) else num_kb, body, dq)
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+        pl.when(needed)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == last_kb)
+    def _finalize():
+        dq_ref[0] = (acc_ref[...] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, block_q, block_k,
-                    seq_q, seq_k, offset, dropout_p, keep_thresh):
+                    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *, scale, causal,
+                    block_q, block_k, seq_q, seq_k, offset, dropout_p,
+                    keep_thresh):
+    """Streaming dk/dv: grid (bh, k_blocks, q_blocks), one Q/dO tile per
+    step; dk/dv accumulators in VMEM scratch. The last q block always
+    attends every k block (causal or not), so the finalize write keys
+    off qi == num_qb - 1 unconditionally."""
     bi = _i32(pl.program_id(0))
     ki = _i32(pl.program_id(1))
+    qi = _i32(pl.program_id(2))
     seed = seed_ref[0, 0].astype(jnp.uint32)
-    # dot inputs stay in the source dtype (see _fwd_kernel note); scale
-    # is applied to the f32 scores and folded into dk at the end
-    k = k_ref[0]                                        # [block_k, d]
-    v = v_ref[0]
-    dk = jnp.zeros(k.shape, jnp.float32)
-    dv = jnp.zeros(v.shape, jnp.float32)
-    k_start = ki * _i32(block_k)
-
     num_qb = seq_q // block_q
+    k_start = ki * _i32(block_k)
+    q_start = qi * _i32(block_q)
 
-    def body(qb, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(qb * _i32(block_q), block_q), :]
-        do = do_ref[0, pl.ds(qb * _i32(block_q), block_q), :]
-        lse = lse_ref[0, pl.ds(qb * _i32(block_q), block_q), :]   # [block_q, 1]
-        delta = delta_ref[0, pl.ds(qb * _i32(block_q), block_q), :]
+    if causal:
+        # q blocks strictly before the diagonal see only masked rows
+        needed = q_start + _i32(block_q - 1 + offset) >= k_start
+    else:
+        needed = None
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros(dk_acc_ref.shape, jnp.float32)
+        dv_acc_ref[...] = jnp.zeros(dv_acc_ref.shape, jnp.float32)
+
+    def _compute():
+        k = k_ref[0]                                    # [block_k, d]
+        v = v_ref[0]
+        q = q_ref[0]                                    # [block_q, d]
+        do = do_ref[0]
+        lse = lse_ref[0]                                # [block_q, 1]
+        delta = delta_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        rows = qb * _i32(block_q) + jax.lax.broadcasted_iota(
+        rows = q_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         cols = k_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
@@ -320,32 +370,33 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             # see _fwd_kernel: zero masked entries of fully-masked rows
             p = jnp.where(s == NEG_INF, 0.0, p)
         if dropout_p > 0.0:
-            keep = _keep_mask(seed, bi, rows, cols, seq_q, seq_k, keep_thresh)
+            keep = _keep_mask(seed, bi, rows, cols, seq_q, seq_k,
+                              keep_thresh)
             inv = 1.0 / (1.0 - dropout_p)
             p_d = jnp.where(keep, p * inv, 0.0)
         else:
             p_d = p
-        dv = dv + jax.lax.dot_general(p_d.astype(do.dtype), do,
-                                      (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
+        dv_acc_ref[...] = dv_acc_ref[...] + jax.lax.dot_general(
+            p_d.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if dropout_p > 0.0:
             dp = jnp.where(keep, dp * inv, 0.0)
         ds = p * (dp - delta)
-        dk = dk + jax.lax.dot_general(ds.astype(q.dtype), q,
-                                      (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        return dk, dv
+        dk_acc_ref[...] = dk_acc_ref[...] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    start_qb = _i32(0)
     if causal:
-        # q rows < k_start - offset are fully masked for this k block
-        start_qb = jnp.maximum(
-            _i32(0), (k_start - _i32(offset)) // _i32(block_q))
-    dk, dv = jax.lax.fori_loop(start_qb, _i32(num_qb), body, (dk, dv))
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+        pl.when(needed)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qi == _i32(num_qb - 1))
+    def _finalize():
+        dk_ref[0] = (dk_acc_ref[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
 def _bwd(scale, causal, block_q, block_k, dropout_p, res, do):
@@ -354,52 +405,74 @@ def _bwd(scale, causal, block_q, block_k, dropout_p, res, do):
     seq_k = k.shape[1]
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)            # [bh, seq_q, 1]
+    off = seq_k - seq_q
+    nkb = seq_k // block_k
+    nqb = seq_q // block_q
+
+    if causal:
+        # causal DMA dedup (see _fwd): skipped steps remap to a tile the
+        # revisit cache already holds
+        def kv_index(b, i, j):
+            last = _causal_last_kb(i, block_q, block_k, off, nkb)
+            return (b, jnp.minimum(j, last), 0)
+
+        def q_index(b, i, j):
+            first = _causal_first_qb(i, block_q, block_k, off, nqb)
+            return (b, jnp.maximum(j, first), 0)
+    else:
+        kv_index = lambda b, i, j: (b, j, 0)  # noqa: E731
+        q_index = lambda b, i, j: (b, j, 0)  # noqa: E731
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_q=seq_q,
-                          seq_k=seq_k, offset=seq_k - seq_q,
+                          seq_k=seq_k, offset=off,
                           dropout_p=dropout_p,
                           keep_thresh=_keep_thresh(dropout_p)),
-        grid=(bh, seq_q // block_q),
+        grid=(bh, seq_q // block_q, nkb),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
     )(seed, q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_q=seq_q,
-                          seq_k=seq_k, offset=seq_k - seq_q,
+                          seq_k=seq_k, offset=off,
                           dropout_p=dropout_p,
                           keep_thresh=_keep_thresh(dropout_p)),
-        grid=(bh, seq_k // block_k),
+        grid=(bh, nkb, nqb),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, seq_q, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq_q, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq_q, 1), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq_q, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_q, 1), q_index),
+            pl.BlockSpec((1, block_q, 1), q_index),
         ],
         out_specs=(
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
         ),
         out_shape=(
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
         interpret=_interpret(),
     )(seed, q, k, v, do, lse, delta)
     return dq, dk, dv
