@@ -75,6 +75,12 @@ def _keep_mask(seed, b, rows, cols, seq_q, seq_k, keep_thresh):
 
 LANES = 128
 
+# all three kernels run (outer, outer, streamed) grids: the outer dims
+# are independent work; only the streamed accumulation dim is
+# order-dependent
+_STREAM_GRID_PARAMS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary"))
+
 
 def _causal_last_kb(q_block, block_q, block_k, offset, num_kb):
     """Index of the LAST k block the rows of ``q_block`` attend to under
@@ -245,6 +251,7 @@ def _fwd(q, k, v, seed, scale, causal, block_q, block_k, dropout_p):
             pltpu.VMEM((block_q, d), jnp.float32),       # output acc
         ],
         interpret=_interpret(),
+        compiler_params=_STREAM_GRID_PARAMS,
         cost_estimate=pl.CostEstimate(
             flops=4 * seq_q * seq_k * d,
             bytes_accessed=(seq_q + 2 * seq_k) * d * q.dtype.itemsize,
@@ -443,6 +450,7 @@ def _bwd(scale, causal, block_q, block_k, dropout_p, res, do):
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
+        compiler_params=_STREAM_GRID_PARAMS,
     )(seed, q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
@@ -474,6 +482,7 @@ def _bwd(scale, causal, block_q, block_k, dropout_p, res, do):
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=_interpret(),
+        compiler_params=_STREAM_GRID_PARAMS,
     )(seed, q, k, v, do, lse, delta)
     return dq, dk, dv
 
