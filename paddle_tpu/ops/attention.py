@@ -42,6 +42,11 @@ def _sdpa_ref(q, k, v, mask, key, *, scale, dropout_p, is_causal):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
+# kernel configs that failed once: skipped (with one warning each) so
+# every later step neither re-pays the failed trace nor hides it
+_KERNEL_FAILED = set()
+
+
 def _use_pallas():
     if not flags.get_flags("use_pallas_kernels")["use_pallas_kernels"]:
         return False
@@ -72,7 +77,10 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     min_seq = flags.flag_value("pallas_attention_min_seq")
     seq_q, seq_k = q.shape[-2], k.shape[-2]
     kernel_pays = seq_k >= min_seq or seq_q * seq_k >= min_seq * min_seq
-    if kernel_pays and _use_pallas() and attn_mask is None:
+    fail_key = (tuple(q.shape), tuple(k.shape), str(q.dtype),
+                bool(is_causal), p > 0.0)
+    if (kernel_pays and fail_key not in _KERNEL_FAILED and _use_pallas()
+            and attn_mask is None):
         from .pallas import flash_attention
 
         def _flash(q, k, v, key, *, scale, is_causal, dropout_p):
@@ -85,8 +93,18 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
             return apply_op(
                 "flash_attention", _flash, q, k, v, key,
                 scale=scale, is_causal=bool(is_causal), dropout_p=p)
-        except Exception:
-            pass  # fall back to reference path
+        except Exception as e:
+            # fall back to the reference path, but never silently (a
+            # broken kernel would otherwise hide as a perf regression),
+            # and remember the config so later steps neither re-pay the
+            # failed trace nor drown the log
+            _KERNEL_FAILED.add(fail_key)
+            import warnings
+
+            warnings.warn(
+                f"flash attention kernel failed ({type(e).__name__}: "
+                f"{e}); falling back to the XLA reference path for "
+                f"this config from now on: {fail_key}", RuntimeWarning)
 
     return apply_op(
         "sdpa", _sdpa_ref, q, k, v, attn_mask, key,
