@@ -467,14 +467,17 @@ def run_flash(smoke, platform):
     import jax.numpy as jnp
     from paddle_tpu.ops.pallas.flash_attention import mha
 
+    # default seq 4096 unless the user explicitly set BENCH_SEQ
+    s = int(os.environ["BENCH_SEQ"]) if "BENCH_SEQ" in os.environ else 4096
     if smoke:
         log("BENCH_CPU=1 smoke mode: tiny config (numbers not meaningful)")
         b, h, s, d = 2, 2, 256, 32
+    elif os.environ.get("BENCH_FLASH_PRESET") == "llama":
+        # Llama-2-7B attention shape: head_dim 128 = full-width MXU
+        # contraction (BERT's d=64 runs the MXU at half width)
+        b, h, d = 4, 32, 128
     else:
         b, h, d = 8, 12, 64
-        # default 4096 unless the user explicitly set BENCH_SEQ
-        s = int(os.environ["BENCH_SEQ"]) if "BENCH_SEQ" in os.environ \
-            else 4096
 
     rng = np.random.RandomState(0)
     q = jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16)

@@ -76,6 +76,7 @@ define_flag("benchmark", False, help="block_until_ready after each eager op for 
 define_flag("cudnn_deterministic", False, help="compat no-op; XLA is deterministic by default")
 define_flag("use_pallas_kernels", True, help="use Pallas fused kernels (flash attention etc.) on TPU")
 define_flag("pallas_attention_min_seq", 1024, help="route attention below this seq length to XLA's fused path instead of the Pallas kernel. Measured on the v5e (2026-07-31): at seq 128 the kernel is 3x SLOWER than XLA's batched-matmul attention (one 128-block per program = pure per-program overhead); at seq 4096 the kernel wins (XLA materialises S^2). 1024 = where the S^2 buffer starts to dominate activation memory. 0 = always Pallas")
+define_flag("sdpa_softmax_fp32", True, help="compute the XLA attention path's softmax in f32 (the amp-O1/NVIDIA-recipe default). False keeps the logits dtype (bf16 under amp) — halves the softmax HBM traffic; a step_tune candidate lever, flip only with a measured accuracy check")
 define_flag("allocator_strategy", "auto_growth", help="compat: XLA owns HBM allocation")
 define_flag("fraction_of_gpu_memory_to_use", 0.92, help="compat no-op on TPU")
 define_flag("seed", 0, help="global RNG seed")

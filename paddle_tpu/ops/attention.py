@@ -17,7 +17,8 @@ from ..core import flags, random as random_core
 from ..core.dispatch import apply_op
 
 
-def _sdpa_ref(q, k, v, mask, key, *, scale, dropout_p, is_causal):
+def _sdpa_ref(q, k, v, mask, key, *, scale, dropout_p, is_causal,
+              fp32_softmax=True):
     # q,k,v: [batch, heads, seq, head_dim]
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if is_causal:
@@ -33,7 +34,13 @@ def _sdpa_ref(q, k, v, mask, key, *, scale, dropout_p, is_causal):
                                jnp.finfo(logits.dtype).min)
         else:
             logits = logits + mask
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if fp32_softmax:
+        probs = (jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+                 .astype(q.dtype))
+    else:  # keep the q dtype: halves softmax HBM traffic under amp (an
+        # f32 additive mask can still have promoted the logits — cast
+        # back so both flag settings agree on the output dtype)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     if dropout_p > 0.0 and key is not None:
         # counter-hash mask, not threefry bernoulli (core/random.py
         # fast_keep_mask): attention-prob masks dominate dropout RNG cost
@@ -106,6 +113,9 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
                 f"{e}); falling back to the XLA reference path for "
                 f"this config from now on: {fail_key}", RuntimeWarning)
 
+    # the flag rides the static kwargs so the per-(op, shape) dispatch
+    # cache keys on it — a flag flip must not serve a stale trace
     return apply_op(
         "sdpa", _sdpa_ref, q, k, v, attn_mask, key,
-        scale=scale, dropout_p=p, is_causal=bool(is_causal))
+        scale=scale, dropout_p=p, is_causal=bool(is_causal),
+        fp32_softmax=bool(flags.flag_value("sdpa_softmax_fp32")))

@@ -49,7 +49,15 @@ B, SEQ, MAXP = (8, 32, 5) if SMOKE else (256, 128, 20)
 STEPS = 2 if SMOKE else 10
 
 
-def full_step(name, dropout=0.1, amp="O1", clip=True):
+def full_step(name, dropout=0.1, amp="O1", clip=True, fp32_softmax=True):
+    paddle.set_flags({"sdpa_softmax_fp32": bool(fp32_softmax)})
+    try:
+        return _full_step(name, dropout, amp, clip)
+    finally:  # the flag is process-global: don't leak into later variants
+        paddle.set_flags({"sdpa_softmax_fp32": True})
+
+
+def _full_step(name, dropout, amp, clip):
     paddle.seed(0)
     if SMOKE:
         model = BertForPretraining(
@@ -162,6 +170,9 @@ def main():
         ("E2 embedding bwd: one-hot matmul",
          lambda: embedding_bwd("E2 embedding bwd: one-hot matmul",
                                "onehot")),
+        ("F bf16 attention softmax",
+         lambda: full_step("F bf16 attention softmax",
+                           fp32_softmax=False)),
     ]:
         try:
             fn()
