@@ -56,3 +56,16 @@ class TestBenchContract:
         rec = _one_json_line(r.stdout)
         assert rec["metric"] == "flash_attention_fwd_bwd_tflops_per_chip"
         assert rec["unit"] == "TFLOP/s"
+
+    def test_llama_mode_metric_fields(self):
+        r = _run({"BENCH_CPU": "1", "BENCH_STEPS": "1",
+                  "BENCH_WARMUP": "1", "BENCH_MODEL": "llama"},
+                 timeout=420)
+        assert r.returncode == 0, r.stderr[-500:]
+        rec = _one_json_line(r.stdout)
+        assert rec["metric"] == "llama_374m_pretrain_tokens_per_sec_per_chip"
+        assert rec["unit"] == "tokens/s"
+        # vs_baseline doubles as MFU for this config (no published
+        # per-chip baseline; see run_llama docstring)
+        assert rec["vs_baseline"] == rec["mfu"]
+        assert rec["smoke"] is True and rec["params_m"] > 0

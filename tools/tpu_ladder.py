@@ -32,6 +32,7 @@ STAGES = [
     ("resnet50", {"BENCH_MODEL": "resnet50"}),
     ("flash_4096", {"BENCH_MODEL": "flash"}),
     ("bert_o2", {"BENCH_AMP": "O2"}),
+    ("llama_2048", {"BENCH_MODEL": "llama"}),
 ]
 
 
