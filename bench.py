@@ -46,10 +46,17 @@ A100_FLASH_ATTN_TFLOPS = 190.0
 MODEL = os.environ.get("BENCH_MODEL", "bert")
 METRIC = {"resnet50": "resnet50_train_images_per_sec_per_chip",
           "flash": "flash_attention_fwd_bwd_tflops_per_chip",
-          "llama": "llama_374m_pretrain_tokens_per_sec_per_chip"}.get(
+          "llama": "llama_374m_pretrain_tokens_per_sec_per_chip",
+          "decode": "llama_374m_decode_tokens_per_sec_per_chip"}.get(
               MODEL, "bert_base_pretrain_tokens_per_sec_per_chip")
 _UNIT = {"resnet50": "images/s", "flash": "TFLOP/s"}.get(MODEL, "tokens/s")
 V5E_BF16_PEAK_TFLOPS = 197.0
+V5E_HBM_GBPS = 819.0
+# shared by run_llama (training) and run_decode (serving): the two
+# llama_374m_* metrics must benchmark the SAME model
+# (vocab, hidden, layers, heads, intermediate)
+LLAMA_374M = (32000, 1024, 24, 8, 2816)
+LLAMA_SMOKE = (256, 64, 2, 2, 128)
 
 # With BENCH_BATCH unset the bench sweeps batch sizes downward from 512,
 # falling back on OOM (RESOURCE_EXHAUSTED) — 32x128 = 4k tokens/step is
@@ -249,6 +256,8 @@ def main():
         return run_flash(smoke, platform)
     if MODEL == "llama":
         return run_llama(smoke, platform)
+    if MODEL == "decode":
+        return run_decode(smoke, platform)
 
     import jax.numpy as jnp
     import paddle_tpu as paddle
@@ -482,12 +491,12 @@ def run_llama(smoke, platform):
     paddle.seed(0)
     if smoke:
         log("BENCH_CPU=1 smoke mode: tiny config (numbers not meaningful)")
-        vocab, hidden, layers, heads, inter = 256, 64, 2, 2, 128
+        vocab, hidden, layers, heads, inter = LLAMA_SMOKE
         fixed_batch, seq = 8, 64  # divisible by the 8-dev test mesh
     else:
         # ~374M params: hidden 1024, 24 layers, 8 heads of head_dim 128
         # (full-width MXU contraction), SwiGLU 2816
-        vocab, hidden, layers, heads, inter = 32000, 1024, 24, 8, 2816
+        vocab, hidden, layers, heads, inter = LLAMA_374M
         seq = int(os.environ.get("BENCH_SEQ", "2048"))
         fixed_batch = BATCH
     model = LlamaModel(vocab_size=vocab, hidden_size=hidden,
@@ -577,6 +586,94 @@ def run_llama(smoke, platform):
         "params_m": round(n_params / 1e6, 1),
         "mflop_per_token": round(fpt / 1e6, 1),
         "mfu": round(mfu, 4),
+    }
+    if smoke:
+        rec["smoke"] = True
+    return rec
+
+
+def run_decode(smoke, platform):
+    """KV-cached autoregressive decode throughput (the inference-side
+    number: reference analog is the Predictor/serving path). Runs the
+    ~374M Llama's jitted prefill+lax.scan decode (text/generation.py)
+    and reports generated tokens/s. vs_baseline is the fraction of the
+    HBM-bandwidth roofline: each decode step must read the weights once
+    (amortized over the batch) plus every row's KV cache, so
+      bound tok/s = batch * BW / (param_bytes + batch * kv_bytes)
+    — the honest ceiling for bandwidth-bound decode on one chip."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.text.generation import llama_generate
+    from paddle_tpu.text.models import LlamaModel
+
+    paddle.seed(0)
+    if smoke:
+        log("BENCH_CPU=1 smoke mode: tiny config (numbers not meaningful)")
+        vocab, hidden, layers, heads, inter = LLAMA_SMOKE
+        batch, t0, new = 2, 16, 8
+    else:
+        vocab, hidden, layers, heads, inter = LLAMA_374M
+        batch = int(os.environ.get("BENCH_BATCH", "16"))
+        t0, new = 128, int(os.environ.get("BENCH_DECODE_TOKENS", "128"))
+    model = LlamaModel(vocab_size=vocab, hidden_size=hidden,
+                      num_layers=layers, num_heads=heads,
+                      intermediate_size=inter, max_seq_len=4096)
+    model.eval()
+    if os.environ.get("BENCH_AMP", "O1") != "O0":
+        model.to(dtype="bfloat16")  # serving precision; halves the
+        # weight bytes each decode step must stream from HBM
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    param_itemsize = next(iter(model.parameters()))._value.dtype.itemsize
+    attn0 = model.layers[0].self_attn
+    kv_width = attn0.num_kv_heads * attn0.head_dim  # = hidden for MHA
+    rng = np.random.RandomState(0)
+
+    def gen(seed):
+        # distinct prompts per call: the axon backend serves
+        # content-identical executions from cache (PERF.md round-5),
+        # and the returned ndarray is a device->host transfer = a true
+        # sync, so wall-clock here is honest
+        ids = rng.randint(0, vocab, (batch, t0)).astype(np.int32)
+        return llama_generate(model, ids, max_new_tokens=new, seed=seed)
+
+    log(f"compiling prefill+decode batch={batch} prompt={t0} new={new} "
+        f"params={n_params/1e6:.0f}M platform={platform} ...")
+    t_start = time.time()
+    out = gen(0)
+    assert out.shape == (batch, t0 + new)
+    log(f"compile+first run {time.time() - t_start:.1f}s")
+    reps = max(1, STEPS // 4)
+    t_start = time.time()
+    for r in range(reps):
+        gen(1 + r)
+    dt = time.time() - t_start
+    tokens_per_sec = batch * new * reps / dt
+    log(f"{reps} runs in {dt:.2f}s -> {tokens_per_sec:.0f} decode tokens/s")
+
+    # two-term roofline: each of the `new` decode steps streams the
+    # weights once (amortized over the batch) plus every row's KV cache
+    # [2, kv_heads*hd, total] per layer; the timed region ALSO includes
+    # the compute-bound prefill of t0 prompt tokens, so the bound adds
+    # its MXU time — without that term the fraction would be biased low
+    # and depend on the t0/new split
+    param_bytes = float(n_params * param_itemsize)
+    kv_bytes = 2.0 * layers * kv_width * (t0 + new) * param_itemsize
+    decode_s = new * (param_bytes + batch * kv_bytes) / (V5E_HBM_GBPS * 1e9)
+    prefill_s = (batch * t0 * 2.0 * (n_params - vocab * hidden)
+                 / (V5E_BF16_PEAK_TFLOPS * 1e12))
+    bound = batch * new / (decode_s + prefill_s)
+    frac = tokens_per_sec / bound
+    rec = {
+        "metric": METRIC,
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        # no published baseline: vs_baseline = fraction of the HBM
+        # bandwidth roofline (see docstring)
+        "vs_baseline": round(frac, 4),
+        "batch": batch,
+        "new_tokens": new,
+        "params_m": round(n_params / 1e6, 1),
+        "roofline_tokens_per_sec": round(bound, 1),
     }
     if smoke:
         rec["smoke"] = True

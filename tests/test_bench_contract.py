@@ -69,3 +69,15 @@ class TestBenchContract:
         # per-chip baseline; see run_llama docstring)
         assert rec["vs_baseline"] == rec["mfu"]
         assert rec["smoke"] is True and rec["params_m"] > 0
+
+    def test_decode_mode_metric_fields(self):
+        r = _run({"BENCH_CPU": "1", "BENCH_STEPS": "4",
+                  "BENCH_MODEL": "decode"}, timeout=420)
+        assert r.returncode == 0, r.stderr[-500:]
+        rec = _one_json_line(r.stdout)
+        assert rec["metric"] == "llama_374m_decode_tokens_per_sec_per_chip"
+        assert rec["unit"] == "tokens/s"
+        # vs_baseline = fraction of the HBM-bandwidth roofline
+        assert 0 <= rec["vs_baseline"] <= 1.5
+        assert rec["roofline_tokens_per_sec"] > 0
+        assert rec["smoke"] is True

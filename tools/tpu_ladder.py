@@ -33,6 +33,7 @@ STAGES = [
     ("flash_4096", {"BENCH_MODEL": "flash"}),
     ("bert_o2", {"BENCH_AMP": "O2"}),
     ("llama_2048", {"BENCH_MODEL": "llama"}),
+    ("decode", {"BENCH_MODEL": "decode"}),
 ]
 
 
