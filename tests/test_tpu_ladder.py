@@ -140,3 +140,97 @@ def test_watch_done_stages_tolerates_corrupt_state(tmp_path):
     p.write_text(json.dumps([{"stage": "a", "rc": 0},
                              {"stage": "b", "rc": 1}]))
     assert watch.done_stages(str(p)) == {"a"}
+
+
+class TestWatcherPostSweeps:
+    """tools/tpu_watch.py post-sweep orchestration: after the ladder is
+    green the watcher must run flash_tune/step_tune once each, retry a
+    failed sweep on later windows up to the crash cap, key done-markers
+    to --out, and exit with the right code."""
+
+    def _watch_main(self, monkeypatch, tmp_path, *, alive, post_rcs,
+                    hours=0.02):
+        watch = importlib.import_module("tpu_watch")
+        out = tmp_path / "ladder.json"
+        # ladder already fully green
+        json.dump([{"stage": n, "rc": 0, "record": {"metric": n}}
+                   for n, _ in tpu_ladder.STAGES], open(out, "w"))
+        calls = []
+
+        def fake_popen(cmd, **kw):
+            name = os.path.basename(cmd[-1]).replace(".py", "")
+            calls.append(name)
+
+            class P:
+                pid = 12345
+
+                def wait(self, timeout=None):
+                    return post_rcs.get(name, 0) if not callable(
+                        post_rcs.get(name, 0)) else post_rcs[name](calls)
+            return P()
+
+        monkeypatch.setattr(watch.subprocess, "Popen", fake_popen)
+        monkeypatch.setattr(watch.time, "sleep", lambda s: None)
+        monkeypatch.setattr(sys, "argv",
+                            ["tpu_watch.py", "--out", str(out),
+                             "--hours", str(hours),
+                             "--probe-timeout", "1"])
+        import tpu_ladder as tl
+        monkeypatch.setattr(tl, "tunnel_alive",
+                            lambda timeout=60: alive)
+        rc = watch.main()
+        return rc, calls, out
+
+    def test_posts_run_once_and_exit_green(self, monkeypatch, tmp_path):
+        rc, calls, out = self._watch_main(monkeypatch, tmp_path,
+                                          alive=True,
+                                          post_rcs={"flash_tune": 0,
+                                                    "step_tune": 0})
+        assert calls == ["flash_tune", "step_tune"]
+        assert rc == 0
+        assert os.path.exists(str(out) + ".flash_tune.done")
+        assert os.path.exists(str(out) + ".step_tune.done")
+
+    def test_failed_post_retries_then_caps(self, monkeypatch, tmp_path):
+        rc, calls, out = self._watch_main(monkeypatch, tmp_path,
+                                          alive=True, hours=0.2,
+                                          post_rcs={"flash_tune": 1,
+                                                    "step_tune": 0})
+        # flash_tune fails 3x (cap), then step_tune still runs
+        assert calls.count("flash_tune") == 3
+        assert calls.count("step_tune") == 1
+        assert rc == 1  # a capped-out post fails the watch run
+        assert not os.path.exists(str(out) + ".flash_tune.done")
+        assert os.path.exists(str(out) + ".step_tune.done")
+
+    def test_transient_post_failure_still_exits_green(self, monkeypatch,
+                                                      tmp_path):
+        seen = {"n": 0}
+
+        def flaky(calls):
+            seen["n"] += 1
+            return 1 if seen["n"] == 1 else 0  # fail once, then pass
+
+        rc, calls, out = self._watch_main(monkeypatch, tmp_path,
+                                          alive=True, hours=0.2,
+                                          post_rcs={"flash_tune": flaky,
+                                                    "step_tune": 0})
+        assert calls.count("flash_tune") == 2
+        assert rc == 0  # retried-and-passed must not fail the run
+
+    def test_stale_marker_from_other_out_does_not_skip(self, monkeypatch,
+                                                       tmp_path):
+        # a marker belonging to a DIFFERENT --out must not skip the sweep
+        (tmp_path / "other.json.flash_tune.done").write_text("ok")
+        rc, calls, out = self._watch_main(monkeypatch, tmp_path,
+                                          alive=True,
+                                          post_rcs={"flash_tune": 0,
+                                                    "step_tune": 0})
+        assert calls.count("flash_tune") == 1
+
+    def test_dead_tunnel_runs_nothing(self, monkeypatch, tmp_path):
+        rc, calls, out = self._watch_main(monkeypatch, tmp_path,
+                                          alive=False, post_rcs={},
+                                          hours=0.001)
+        assert calls == []
+        assert rc == 1  # window expired with posts pending
