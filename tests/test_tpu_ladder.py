@@ -148,10 +148,26 @@ class TestWatcherPostSweeps:
     failed sweep on later windows up to the crash cap, key done-markers
     to --out, and exit with the right code."""
 
+    class _FakeTime:
+        """Virtual clock: sleep() advances it, so the watch loop's
+        real-time deadline math runs instantly and deterministically."""
+
+        def __init__(self):
+            self.t = 0.0
+
+        def time(self):
+            return self.t
+
+        def sleep(self, s):
+            self.t += max(float(s), 1.0)
+
+        def strftime(self, fmt):
+            return "00:00:00"
+
     def _watch_main(self, monkeypatch, tmp_path, *, alive, post_rcs,
-                    hours=0.02):
+                    hours=0.2, out=None):
         watch = importlib.import_module("tpu_watch")
-        out = tmp_path / "ladder.json"
+        out = out or (tmp_path / "ladder.json")
         # ladder already fully green
         json.dump([{"stage": n, "rc": 0, "record": {"metric": n}}
                    for n, _ in tpu_ladder.STAGES], open(out, "w"))
@@ -165,12 +181,13 @@ class TestWatcherPostSweeps:
                 pid = 12345
 
                 def wait(self, timeout=None):
-                    return post_rcs.get(name, 0) if not callable(
-                        post_rcs.get(name, 0)) else post_rcs[name](calls)
+                    v = post_rcs.get(name, 0)
+                    return v(calls) if callable(v) else v
             return P()
 
         monkeypatch.setattr(watch.subprocess, "Popen", fake_popen)
-        monkeypatch.setattr(watch.time, "sleep", lambda s: None)
+        monkeypatch.setattr(watch, "time", self._FakeTime())
+        monkeypatch.setattr(watch, "POST_LOG_DIR", str(tmp_path))
         monkeypatch.setattr(sys, "argv",
                             ["tpu_watch.py", "--out", str(out),
                              "--hours", str(hours),
@@ -218,15 +235,22 @@ class TestWatcherPostSweeps:
         assert calls.count("flash_tune") == 2
         assert rc == 0  # retried-and-passed must not fail the run
 
-    def test_stale_marker_from_other_out_does_not_skip(self, monkeypatch,
-                                                       tmp_path):
-        # a marker belonging to a DIFFERENT --out must not skip the sweep
-        (tmp_path / "other.json.flash_tune.done").write_text("ok")
-        rc, calls, out = self._watch_main(monkeypatch, tmp_path,
-                                          alive=True,
-                                          post_rcs={"flash_tune": 0,
-                                                    "step_tune": 0})
-        assert calls.count("flash_tune") == 1
+    def test_markers_are_keyed_to_out_path(self, monkeypatch, tmp_path):
+        # run green once against out1, then against out2: the sweeps
+        # must run AGAIN (markers keyed per --out, not a fixed path —
+        # the regression a bare /tmp/<sweep>.done scheme would cause)
+        rc, calls1, _ = self._watch_main(monkeypatch, tmp_path,
+                                         alive=True,
+                                         post_rcs={"flash_tune": 0,
+                                                   "step_tune": 0},
+                                         out=tmp_path / "out1.json")
+        rc, calls2, _ = self._watch_main(monkeypatch, tmp_path,
+                                         alive=True,
+                                         post_rcs={"flash_tune": 0,
+                                                   "step_tune": 0},
+                                         out=tmp_path / "out2.json")
+        assert calls1.count("flash_tune") == 1
+        assert calls2.count("flash_tune") == 1
 
     def test_dead_tunnel_runs_nothing(self, monkeypatch, tmp_path):
         rc, calls, out = self._watch_main(monkeypatch, tmp_path,

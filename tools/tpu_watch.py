@@ -24,6 +24,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+POST_LOG_DIR = "/tmp"  # tests point this at a tmp_path for hermeticity
 
 
 def log(*a):
@@ -57,8 +58,9 @@ def main():
         """One post-ladder sweep as a killable subprocess; rc or -9."""
         import signal
 
-        log(f"post: running tools/{p}.py -> /tmp/{p}.log")
-        with open(f"/tmp/{p}.log", "a") as f:
+        log_path = os.path.join(POST_LOG_DIR, f"{p}.log")
+        log(f"post: running tools/{p}.py -> {log_path}")
+        with open(log_path, "a") as f:
             proc = subprocess.Popen(
                 [sys.executable, os.path.join(REPO, f"tools/{p}.py")],
                 stdout=f, stderr=subprocess.STDOUT,
