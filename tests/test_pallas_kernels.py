@@ -234,3 +234,28 @@ def test_flash_fully_masked_rows_zero():
                                               block_q=bq,
                                               block_k=bk)))(q)
         assert float(jnp.abs(g[:, :, :SK]).max()) == 0.0
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_head_dim_128(causal):
+    """head_dim 128 = the Llama attention shape (two full lane groups in
+    the d dimension; every other test uses d <= 64). The llama_2048 and
+    flash d128 benches run this config on the TPU — a lowering bug here
+    must fail in-suite, not inside a scarce tunnel window."""
+    rng = np.random.RandomState(3)
+    B, H, S, D = 1, 2, 512, 128
+    q = jnp.array(rng.randn(B, H, S, D) * 0.2, jnp.float32)
+    k = jnp.array(rng.randn(B, H, S, D) * 0.2, jnp.float32)
+    v = jnp.array(rng.randn(B, H, S, D), jnp.float32)
+    out = fa.mha(q, k, v, causal=causal)
+    ref = _ref(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    g_fa = jax.grad(lambda q, k, v: jnp.sum(
+        jnp.sin(fa.mha(q, k, v, causal=causal))), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(lambda q, k, v: jnp.sum(
+        jnp.sin(_ref(q, k, v, causal))), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_fa, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-4)
