@@ -14,13 +14,16 @@ from paddle_tpu.ops import attention
 
 @pytest.fixture(autouse=True)
 def _reset():
-    dispatch.evict_ops("sdpa")
+    # (no dispatch eviction needed: the sdpa cache keys on the flag via
+    # its static kwargs)
     yield
     paddle.set_flags({"sdpa_softmax_fp32": True})
-    dispatch.evict_ops("sdpa")
 
 
 def _train(fp32_softmax, steps=25):
+    """Train under amp O1 so the attention logits really are bf16 —
+    without auto_cast both flag settings compute identical f32 softmax
+    and the comparison proves nothing."""
     paddle.set_flags({"sdpa_softmax_fp32": bool(fp32_softmax)})
     paddle.seed(11)
     enc = nn.TransformerEncoder(
@@ -34,7 +37,8 @@ def _train(fp32_softmax, steps=25):
     y = paddle.to_tensor((rng.rand(16) > 0.5).astype("int64"))
     losses = []
     for _ in range(steps):
-        loss = nn.functional.cross_entropy(head(enc(x).mean(axis=1)), y)
+        with paddle.amp.auto_cast(enable=True, level="O1"):
+            loss = nn.functional.cross_entropy(head(enc(x).mean(axis=1)), y)
         loss.backward()
         opt.step()
         opt.clear_grad()
