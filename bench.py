@@ -1,10 +1,15 @@
-"""Benchmark: BERT-base MLM pretraining (tokens/s/chip, default) or
-ResNet-50 ImageNet training (images/s/chip, BENCH_MODEL=resnet50).
+"""Benchmark suite — one JSON line per run, mode via BENCH_MODEL:
 
-Flagship configs from BASELINE.md: config 3 (PaddleNLP BERT-base/ERNIE
-pretraining, Fleet collective) and config 1 (PaddleClas-style ResNet-50
-static conv path). Runs the full jitted training step (fwd + bwd +
-optimizer) on one chip.
+  bert (default)  BERT-base MLM pretraining tokens/s (BASELINE config 3)
+  resnet50        ResNet-50 ImageNet training images/s (config 1)
+  llama           ~374M Llama seq-2048 pretraining tokens/s + MFU
+                  (BASELINE stretch, drives the Pallas flash kernel)
+  decode          KV-cached serving decode tokens/s vs an HBM roofline
+  flash           raw flash-attention kernel fwd+bwd TFLOP/s at seq 4096
+                  (BENCH_FLASH_PRESET=llama for the d=128 shape)
+
+Runs the full jitted training step (fwd + bwd + optimizer) on one chip
+for the training modes.
 
 Baselines (NVIDIA DeepLearningExamples order-of-magnitude; the reference
 repo publishes no numbers -- see BASELINE.md):
@@ -243,8 +248,11 @@ def main():
     log("devices:", devs)
 
     if os.environ.get("BENCH_NO_PALLAS") == "1":
-        # A/B: XLA-fused attention vs the Pallas flash kernel (at seq 128
-        # a single 128x128 block may favor plain XLA fusion)
+        # kill-switch A/B: disables ALL Pallas kernels. (The seq-128
+        # question it was built for is settled — XLA attention wins 3x
+        # there and the pallas_attention_min_seq gate routes it by
+        # default, PERF.md round-5 — but the knob stays for long-seq
+        # modes where the kernel is on the hot path.)
         import paddle_tpu as _p
 
         _p.set_flags({"use_pallas_kernels": False})
