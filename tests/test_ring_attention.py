@@ -171,3 +171,27 @@ class TestLongContext:
         assert np.isfinite(np.asarray(o8)).all()
         np.testing.assert_allclose(np.asarray(o8), np.asarray(o2),
                                    rtol=2e-4, atol=2e-4)
+
+    def test_ring_llama_head_dim_128(self, sp_mesh):
+        """The Llama attention width (head_dim 128): the sp-axis hybrid
+        runs ring attention over shards whose inner mha uses two full
+        lane groups in d — the same shape the llama_2048 bench drives
+        single-chip. Must match the dense oracle."""
+        rng = np.random.RandomState(9)
+        B, H, S, D = 1, 2, 1024, 128
+        q = jnp.array(rng.randn(B, H, S, D) * 0.1, jnp.float32)
+        k = jnp.array(rng.randn(B, H, S, D) * 0.1, jnp.float32)
+        v = jnp.array(rng.randn(B, H, S, D), jnp.float32)
+        out = jax.jit(lambda q, k, v: ra.ring_attention(
+            q, k, v, mesh=sp_mesh, causal=True))(q, k, v)
+
+        def dense(q, k, v):
+            s = jnp.einsum("bhqd,bhkd->bhqk", q * (1.0 / np.sqrt(D)), k)
+            mask = jnp.tril(jnp.ones((S, S), bool))
+            s = jnp.where(mask, s, -jnp.inf)
+            return jnp.einsum("bhqk,bhkd->bhqd",
+                              jax.nn.softmax(s, axis=-1), v)
+
+        ref = jax.jit(dense)(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
