@@ -121,3 +121,24 @@ class TestSamplingOps:
         # two dominant tokens cover >0.9 prob -> rest filtered
         assert np.isfinite(f[0, 0]) and np.isfinite(f[0, 1])
         assert (f[0, 2:] < np.finfo(np.float32).min / 2).all()
+
+
+class TestBf16Decode:
+    """The decode bench (BENCH_MODEL=decode) casts the model to bf16
+    serving precision before the cached generate — pin that path on CPU
+    so a dtype bug fails here, not inside a tunnel window."""
+
+    def test_bf16_cached_decode_runs_and_is_deterministic(self):
+        paddle.seed(5)
+        m = LlamaModel(vocab_size=97, hidden_size=32, num_layers=2,
+                       num_heads=4, intermediate_size=64, max_seq_len=64)
+        m.eval()
+        m.to(dtype="bfloat16")
+        rng = np.random.RandomState(2)
+        prompt = rng.randint(0, 97, (2, 4)).astype(np.int32)
+        a = m.generate(prompt, max_new_tokens=6)
+        b = m.generate(prompt, max_new_tokens=6)
+        np.testing.assert_array_equal(a, b)  # greedy = deterministic
+        assert a.shape == (2, 10)
+        assert a.min() >= 0 and a.max() < 97
+        np.testing.assert_array_equal(a[:, :4], prompt)
