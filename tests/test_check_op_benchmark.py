@@ -1,0 +1,54 @@
+"""tools/check_op_benchmark_result.py CI gate (reference:
+tools/check_op_benchmark_result.py): regression past threshold exits 1,
+within-threshold and new/removed ops pass."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "check_op_benchmark_result.py")
+
+
+def _run(tmp_path, base, cand, extra=()):
+    b = tmp_path / "base.json"
+    c = tmp_path / "cand.json"
+    b.write_text(json.dumps(base))
+    c.write_text(json.dumps(cand))
+    return subprocess.run([sys.executable, TOOL, str(b), str(c), *extra],
+                         capture_output=True, text=True)
+
+
+def _row(op, us, shapes=((8, 8),)):
+    return {"op": op, "shapes": list(map(list, shapes)), "latency_us": us}
+
+
+def test_within_threshold_passes(tmp_path):
+    r = _run(tmp_path, [_row("add", 10.0)], [_row("add", 11.0)])
+    assert r.returncode == 0, r.stderr
+    assert "ok" in r.stdout
+
+
+def test_regression_fails(tmp_path):
+    r = _run(tmp_path, [_row("add", 10.0)], [_row("add", 13.0)])
+    assert r.returncode == 1
+    assert "REGRESSED" in r.stdout
+    assert "regressed" in r.stderr
+
+
+def test_custom_threshold(tmp_path):
+    r = _run(tmp_path, [_row("add", 10.0)], [_row("add", 13.0)],
+             extra=["--threshold", "0.5"])
+    assert r.returncode == 0
+
+
+def test_new_and_removed_ops_ignored(tmp_path):
+    base = [_row("add", 10.0), _row("gone", 5.0)]
+    cand = [_row("add", 10.5), _row("new", 7.0)]
+    r = _run(tmp_path, base, cand)
+    assert r.returncode == 0, r.stderr
+
+
+def test_improvement_passes(tmp_path):
+    r = _run(tmp_path, [_row("mul", 20.0)], [_row("mul", 8.0)])
+    assert r.returncode == 0
