@@ -69,6 +69,7 @@ from . import inference  # noqa: F401
 from . import quantization  # noqa: F401
 from . import onnx  # noqa: F401
 from . import utils  # noqa: F401
+from . import analysis  # noqa: F401 (tracelint: trace-safety static analyzer)
 from .hapi import Model, summary  # noqa: F401
 from .framework import save, load  # noqa: F401
 from . import framework  # noqa: F401

@@ -1,0 +1,33 @@
+"""paddle_tpu.analysis — tracelint: trace-safety & recompilation-hazard
+static analysis for paddle_tpu programs.
+
+The reference Paddle's dy2static AST transpiler doubles as a diagnoser
+of untranslatable user Python; our XLA-native ``jit/`` traces instead of
+transpiling, so raw tracer errors surface with no source-level guidance,
+and nothing guards the hot path against silent recompilation hazards
+(the dominant TPU goodput sink). This subsystem fills both gaps with
+three pass families over one ``Diagnostic`` model (stable ``TPUnnn``
+codes, severity, file:line, fix-it hint):
+
+- ``ast_checks`` (TPU001–TPU008): source-level trace-safety of functions
+  destined for ``@to_static`` / jitted train steps.
+- ``jaxpr_checks`` (TPU101–TPU104): post-trace program properties that
+  predict retraces, baked-in constants, and mesh-invalid collectives.
+- ``registry_checks`` (TPU201–TPU203): the ``core/dispatch.py`` op
+  contract (hashable statics, stable fn identity for the jit/vjp
+  caches, no float64).
+
+Surfaces: ``tools/tracelint.py`` (CLI), the ``jit/dy2static`` trace-
+failure hook (ranked diagnostics attached to the raised error), and the
+tier-1 self-check (`tests/test_tracelint.py`) that lints paddle_tpu
+itself.
+"""
+from .diagnostics import (  # noqa: F401
+    CODES, Diagnostic, SuppressionIndex, filter_diagnostics, format_json,
+    format_text, sort_key,
+)
+from .runner import (  # noqa: F401
+    LintResult, lint_file, lint_function, lint_paths, lint_registry,
+    lint_source,
+)
+from . import ast_checks, jaxpr_checks, registry_checks  # noqa: F401

@@ -1,0 +1,456 @@
+"""AST trace-safety passes (TPU001–TPU008).
+
+These run over Python *source* of functions destined for a trace —
+``@to_static`` / ``@jax.jit`` train steps, op implementations handed to
+``core.dispatch.apply_op`` (those inline into every enclosing trace),
+and branch/body callables given to ``lax.cond`` / ``lax.scan`` — and
+flag constructs that either cannot trace (tensor-dependent Python
+control flow, host syncs) or trace to something silently wrong
+(side effects, wall-clock and unkeyed randomness frozen at trace time).
+
+The tensor-dependence analysis is a conservative forward dataflow over
+names: function parameters (minus an allowlist of obviously-static ones
+like ``axis``/``training``) seed the tainted set; assignments whose RHS
+reads a tainted name propagate it; calls that are known host-synced
+(``.item()``) or known detaching (``.shape``, ``int`` of a shape dim)
+stop propagation. False negatives are acceptable (we never claim
+completeness); false positives on the *error* codes are kept rare by
+only firing when the taint demonstrably reaches the construct.
+"""
+import ast
+
+from .diagnostics import Diagnostic
+
+# Parameter names that are conventionally static configuration, never
+# traced arrays — seeding these would drown users in false positives.
+_STATIC_PARAM_NAMES = {
+    "self", "cls", "axis", "axes", "dim", "dims", "shape", "dtype", "name",
+    "training", "mode", "keepdim", "keep_dim", "num_classes", "epsilon",
+    "eps", "momentum", "data_format", "padding", "stride", "strides",
+    "dilation", "groups", "approximate", "inplace", "reverse", "descending",
+    "key", "rng", "seed",
+}
+
+# attribute accesses that yield host/python values (taint stops there,
+# but the *access itself* is a host sync when the base is tainted)
+_SYNC_METHODS = {"numpy", "item", "tolist", "__float__", "__int__",
+                 "__bool__", "cpu", "block_until_ready"}
+_SYNC_FREE_CALLS = {"float", "int", "bool"}
+# np.<fn>(tensor) that force materialisation
+_NP_SYNC_FUNCS = {"asarray", "array", "isnan", "isinf", "allclose",
+                  "array_equal", "asscalar"}
+# attribute reads that DETACH taint (static metadata, fine to branch on)
+_DETACHING_ATTRS = {"shape", "ndim", "dtype", "size", "stop_gradient",
+                    "name", "place"}
+
+_TIME_FUNCS = {("time", "time"), ("time", "perf_counter"),
+               ("time", "monotonic"), ("time", "process_time"),
+               ("datetime", "now"), ("datetime", "utcnow")}
+_RANDOM_MODULES = {"random"}
+_NP_RANDOM_ATTR = "random"
+
+
+def _func_name(node):
+    """Dotted name of a call target, e.g. 'np.random.uniform' -> same."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    else:
+        return None
+    return ".".join(reversed(parts))
+
+
+class _TaintVisitor(ast.NodeVisitor):
+    """Forward may-taint analysis + per-construct checks for one function."""
+
+    def __init__(self, fdef, filename, tainted_params=None):
+        self.fdef = fdef
+        self.filename = filename
+        self.func = fdef.name
+        self.diags = []
+        self._loop_depth = 0
+        # test expressions already reported by a construct-level check
+        # (if/while/assert) — their sub-expression checks must not emit
+        # a second code for the same line, or a single inline
+        # suppression can never clear the construct
+        self._claimed_tests = set()
+        a = fdef.args
+        # Keyword-only params are static by the dispatch convention
+        # ("positional args are array-likes; everything static must be a
+        # keyword argument") — only positional params seed the taint.
+        params = [p.arg for p in (a.posonlyargs + a.args)]
+        if a.vararg:
+            params.append(a.vararg.arg)
+        if tainted_params is None:
+            tainted = {p for p in params if p not in _STATIC_PARAM_NAMES
+                       and not p.startswith("_")}
+        else:
+            tainted = set(tainted_params)
+        self.tainted = tainted
+
+    # ---------------------------------------------------------------- helpers
+
+    def _emit(self, code, node, message, **kw):
+        self.diags.append(Diagnostic(
+            code=code, message=message, filename=self.filename,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0) + 1,
+            func=self.func, **kw))
+
+    def _is_tainted(self, node):
+        """May `node`'s value depend on a traced array?"""
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _DETACHING_ATTRS:
+                return False
+            if node.attr in _SYNC_METHODS:
+                return False  # result is a host value (flagged elsewhere)
+            return self._is_tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return self._is_tainted(node.value)
+        if isinstance(node, ast.Call):
+            fname = _func_name(node.func)
+            if fname and fname.split(".")[-1] in (
+                    _SYNC_METHODS | _SYNC_FREE_CALLS | {"len", "range",
+                                                        "isinstance", "getattr",
+                                                        "hasattr", "type"}):
+                return False
+            # a method call on a tainted receiver stays tainted (y.sum())
+            recv = (self._is_tainted(node.func.value)
+                    if isinstance(node.func, ast.Attribute) else False)
+            return recv or any(
+                self._is_tainted(a) for a in node.args) or any(
+                self._is_tainted(k.value) for k in node.keywords)
+        if isinstance(node, (ast.BinOp,)):
+            return self._is_tainted(node.left) or self._is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._is_tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self._is_tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                   for op in node.ops):
+                return False  # identity/membership tests yield real bools
+            return self._is_tainted(node.left) or any(
+                self._is_tainted(c) for c in node.comparators)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return (self._is_tainted(node.body) or
+                    self._is_tainted(node.orelse))
+        if isinstance(node, ast.Starred):
+            return self._is_tainted(node.value)
+        return False
+
+    def _taint_targets(self, target, on):
+        names = []
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                names.append(n.id)
+        for name in names:
+            if on:
+                self.tainted.add(name)
+            else:
+                self.tainted.discard(name)
+
+    # ---------------------------------------------------------------- stmts
+
+    def visit_FunctionDef(self, node):
+        if node is not self.fdef:
+            return  # nested defs analysed separately by the runner
+        # decorators of the analysed function itself are host-side
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node):
+        self.visit(node.value)
+        on = self._is_tainted(node.value)
+        for t in node.targets:
+            self._taint_targets(t, on)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self.visit(node.value)
+            self._taint_targets(node.target, self._is_tainted(node.value))
+
+    def visit_AugAssign(self, node):
+        self.visit(node.value)
+        if self._is_tainted(node.value):
+            self._taint_targets(node.target, True)
+        if self._loop_depth and isinstance(node.op, ast.Add) and \
+                isinstance(node.target, ast.Name) and \
+                not self._is_tainted(node.target) and \
+                isinstance(node.value, (ast.List, ast.ListComp)):
+            self._emit("TPU007", node,
+                       f"list {ast.unparse(node.target)!r} grows across "
+                       "loop iterations inside traced code")
+
+    def visit_Global(self, node):
+        self._emit("TPU006", node,
+                   f"`global {', '.join(node.names)}` inside traced code — "
+                   "mutation happens once at trace time, not per step")
+
+    def visit_Nonlocal(self, node):
+        self._emit("TPU006", node,
+                   f"`nonlocal {', '.join(node.names)}` inside traced code — "
+                   "mutation happens once at trace time, not per step")
+
+    def visit_If(self, node):
+        if self._is_tainted(node.test):
+            self._claimed_tests.add(id(node.test))
+        self.visit(node.test)
+        if self._is_tainted(node.test):
+            self._emit("TPU001", node,
+                       f"`if {ast.unparse(node.test)}:` branches on a value "
+                       "traced from the function inputs; under jit the "
+                       "predicate is an abstract tracer")
+        for stmt in node.body:
+            self.visit(stmt)
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    def visit_While(self, node):
+        if self._is_tainted(node.test):
+            self._claimed_tests.add(id(node.test))
+        self.visit(node.test)
+        if self._is_tainted(node.test):
+            self._emit("TPU002", node,
+                       f"`while {ast.unparse(node.test)}:` loops on a value "
+                       "traced from the function inputs")
+        self._loop_depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        self._loop_depth -= 1
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    def visit_For(self, node):
+        self.visit(node.iter)
+        if self._is_tainted(node.iter) and not (
+                isinstance(node.iter, ast.Call) and
+                _func_name(node.iter.func) in ("range", "enumerate", "zip")):
+            self._emit("TPU002", node,
+                       f"`for ... in {ast.unparse(node.iter)}:` iterates a "
+                       "traced value; iteration count must be static under "
+                       "jit")
+        self._taint_targets(node.target, self._is_tainted(node.iter))
+        self._loop_depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        self._loop_depth -= 1
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    def visit_Assert(self, node):
+        if self._is_tainted(node.test):
+            self._claimed_tests.add(id(node.test))
+            self._emit("TPU003", node,
+                       f"`assert {ast.unparse(node.test)}` evaluates a traced "
+                       "value as a Python bool")
+        self.generic_visit(node)
+
+    # ---------------------------------------------------------------- exprs
+
+    def visit_IfExp(self, node):
+        if self._is_tainted(node.test):
+            self._claimed_tests.add(id(node.test))
+            self._emit("TPU003", node,
+                       f"`... if {ast.unparse(node.test)} else ...` selects "
+                       "on a traced value")
+        self.generic_visit(node)
+
+    def visit_BoolOp(self, node):
+        if id(node) not in self._claimed_tests and \
+                any(self._is_tainted(v) for v in node.values[:-1]):
+            self._emit("TPU003", node,
+                       f"`{ast.unparse(node)}` short-circuits on a traced "
+                       "value")
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        fname = _func_name(node.func)
+        short = fname.split(".")[-1] if fname else None
+
+        # -- host syncs -------------------------------------------------
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _SYNC_METHODS and \
+                self._is_tainted(node.func.value):
+            self._emit("TPU004", node,
+                       f"`.{node.func.attr}()` on a traced value forces a "
+                       "device->host sync inside the trace")
+        elif short in _SYNC_FREE_CALLS and node.args and \
+                self._is_tainted(node.args[0]):
+            self._emit("TPU004", node,
+                       f"`{short}(...)` concretises a traced value to a "
+                       "Python scalar inside the trace")
+        elif fname and "." in fname:
+            mod, leaf = fname.split(".", 1)
+            if mod in ("np", "numpy") and \
+                    leaf.split(".")[-1] in _NP_SYNC_FUNCS and \
+                    any(self._is_tainted(a) for a in node.args):
+                self._emit("TPU004", node,
+                           f"`{fname}(...)` materialises a traced value on "
+                           "host (numpy is not traceable)")
+
+        # -- prints / logging -------------------------------------------
+        if short == "print" and fname == "print":
+            self._emit("TPU005", node,
+                       "`print` inside traced code runs once at trace time")
+        elif fname and fname.split(".")[0] in ("logging", "logger", "log") \
+                and short in ("debug", "info", "warning", "error",
+                              "critical", "exception"):
+            self._emit("TPU005", node,
+                       f"`{fname}(...)` inside traced code runs once at "
+                       "trace time")
+
+        # -- wall clock / unkeyed randomness ----------------------------
+        if fname:
+            parts = tuple(fname.split("."))
+            if parts[-2:] in _TIME_FUNCS or parts in _TIME_FUNCS:
+                self._emit("TPU008", node,
+                           f"`{fname}()` reads the wall clock; the value is "
+                           "frozen into the compiled program at trace time")
+            elif parts[0] in _RANDOM_MODULES and len(parts) > 1:
+                self._emit("TPU008", node,
+                           f"`{fname}()` draws from Python's global RNG; the "
+                           "draw happens once at trace time")
+            elif len(parts) >= 3 and parts[0] in ("np", "numpy") and \
+                    parts[1] == _NP_RANDOM_ATTR:
+                self._emit("TPU008", node,
+                           f"`{fname}()` draws from numpy's global RNG; the "
+                           "draw happens once at trace time")
+
+        # -- list growth under a loop -----------------------------------
+        if self._loop_depth and isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("append", "extend", "insert") and \
+                isinstance(node.func.value, ast.Name) and \
+                not self._is_tainted(node.func.value):
+            if any(self._is_tainted(a) for a in node.args):
+                self._emit("TPU007", node,
+                           f"`{ast.unparse(node.func)}(...)` accumulates "
+                           "traced values in a Python list inside a loop — "
+                           "the graph unrolls once per iteration")
+
+        self.generic_visit(node)
+
+    def visit_Lambda(self, node):
+        pass  # analysed separately when passed to a trace entry point
+
+    def visit_ClassDef(self, node):
+        pass
+
+
+def check_function_node(fdef, filename="<source>", tainted_params=None):
+    """Run all TPU0xx passes over one FunctionDef node."""
+    v = _TaintVisitor(fdef, filename, tainted_params=tainted_params)
+    v.visit(fdef)
+    return v.diags
+
+
+def iter_function_defs(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _decorator_marks_traced(dec):
+    """Is this decorator a trace entry point (to_static / jax.jit / pjit)?"""
+    target = dec
+    if isinstance(target, ast.Call):
+        # @partial(jax.jit, ...) / @to_static(input_spec=...)
+        fname = _func_name(target.func)
+        if fname and fname.split(".")[-1] in ("partial",):
+            if target.args:
+                fname = _func_name(target.args[0])
+            else:
+                fname = None
+        target_name = fname
+    else:
+        target_name = _func_name(target)
+    if not target_name:
+        return False
+    leaf = target_name.split(".")[-1]
+    return leaf in {"to_static", "declarative", "jit", "pjit", "pmap",
+                    "shard_map", "checkpoint", "remat", "grad",
+                    "value_and_grad", "traced"}
+
+
+def find_traced_functions(tree):
+    """FunctionDefs in `tree` that are trace entry points by decoration."""
+    out = []
+    for fdef in iter_function_defs(tree):
+        if any(_decorator_marks_traced(d) for d in fdef.decorator_list):
+            out.append(fdef)
+    return out
+
+
+# trace entry point -> positional indices that receive a callable whose
+# body will execute under the trace (everything else is data)
+_TRACE_CALL_FN_SLOTS = {
+    "apply_op": (1,),          # apply_op(name, fn, *arrays)
+    "jit": (0,), "pjit": (0,), "pmap": (0,), "shard_map": (0,),
+    "remat": (0,), "checkpoint": (0,), "vjp": (0,), "grad": (0,),
+    "value_and_grad": (0,), "make_jaxpr": (0,),
+    "cond": (1, 2),            # cond(pred, true_fn, false_fn, *ops)
+    "while_loop": (0, 1),      # while_loop(cond_fn, body_fn, init)
+    "fori_loop": (2,),         # fori_loop(lo, hi, body_fn, init)
+    "scan": (0,),              # scan(f, init, xs)
+}
+
+
+def find_trace_passed_functions(tree):
+    """Locally-defined functions passed into a callable slot of a trace
+    entry point (``apply_op(name, fn, ...)``, ``lax.cond(p, t, f, ...)``)
+    — those bodies execute under every enclosing trace. Only the known
+    fn slots count: data args that happen to share a name with a local
+    function (e.g. a tensor called ``scale``) are not trace context."""
+    local_defs = {}
+    for fdef in iter_function_defs(tree):
+        local_defs.setdefault(fdef.name, fdef)
+    picked = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = _func_name(node.func)
+        slots = _TRACE_CALL_FN_SLOTS.get(
+            fname.split(".")[-1]) if fname else None
+        if slots is None:
+            continue
+        for i in slots:
+            if i < len(node.args):
+                arg = node.args[i]
+                if isinstance(arg, ast.Name) and arg.id in local_defs:
+                    picked.setdefault(arg.id, local_defs[arg.id])
+    return list(picked.values())
+
+
+def check_source(source, filename="<source>", all_functions=False,
+                 tainted_params=None):
+    """Parse `source` and run AST passes.
+
+    all_functions=False (package-scan mode): only functions that are
+    demonstrably trace context — decorated with to_static/jit/... or
+    passed into apply_op/lax.* — are checked. all_functions=True
+    (single-function / error-hook mode): every top-level function is
+    treated as traced.
+    """
+    tree = ast.parse(source)
+    if all_functions:
+        targets = list(iter_function_defs(tree))
+    else:
+        targets = find_traced_functions(tree)
+        seen = {id(t) for t in targets}
+        for f in find_trace_passed_functions(tree):
+            if id(f) not in seen:
+                targets.append(f)
+    diags = []
+    for fdef in targets:
+        diags.extend(check_function_node(fdef, filename,
+                                         tainted_params=tainted_params))
+    return diags

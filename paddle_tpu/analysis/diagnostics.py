@@ -1,0 +1,209 @@
+"""Shared diagnostic model for tracelint.
+
+Every pass family (AST, jaxpr, registry) reports through one
+``Diagnostic`` shape so the CLI, the dy2static trace-failure hook, and
+the CI gate render and filter findings uniformly. Codes are stable and
+documented in README.md §"Trace-safety rules":
+
+- ``TPU0xx`` — AST passes over functions destined for a trace
+  (``jit/dy2static`` / jitted train steps).
+- ``TPU1xx`` — jaxpr passes (post-trace program properties).
+- ``TPU2xx`` — op-registry passes over ``core/dispatch.py`` ops.
+
+Suppression: an inline ``# tracelint: disable=TPU001,TPU005`` comment on
+the flagged line silences those codes for that line; a file-level
+comment (on any of the first five lines, with no code after ``disable=``
+meaning "all") silences the whole file; ``--disable`` on the CLI
+silences codes globally.
+"""
+import dataclasses
+import json
+import re
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+SEVERITY_INFO = "info"
+
+_SEV_RANK = {SEVERITY_ERROR: 0, SEVERITY_WARNING: 1, SEVERITY_INFO: 2}
+
+# code -> (default severity, short title, generic fix-it hint)
+CODES = {
+    "TPU000": (SEVERITY_WARNING, "file could not be analysed",
+               "fix the syntax error (or exclude generated files)"),
+    # ---- AST passes (trace-safety of Python source) ----
+    "TPU001": (SEVERITY_ERROR, "tensor-dependent `if`",
+               "branch on traced values with paddle.where / lax.cond "
+               "(dy2static rewrites plain `if t:` automatically only under "
+               "@to_static)"),
+    "TPU002": (SEVERITY_ERROR, "tensor-dependent `while`/`for`",
+               "use lax.while_loop / lax.fori_loop / lax.scan with the "
+               "loop state as carry"),
+    "TPU003": (SEVERITY_ERROR, "tensor-dependent conditional expression",
+               "replace `a if t else b` / `t and x` with paddle.where(t, a, b) "
+               "or jnp.where"),
+    "TPU004": (SEVERITY_ERROR, "host sync inside traced code",
+               "`.numpy()`/`.item()`/`float(t)`/`np.asarray(t)` forces a "
+               "device->host transfer and blocks the trace; keep values as "
+               "arrays, or move the readback outside the jitted step"),
+    "TPU005": (SEVERITY_WARNING, "print/log inside traced code",
+               "use jax.debug.print (traced-safe) or log outside the step; "
+               "`print` runs once at trace time, not per step"),
+    "TPU006": (SEVERITY_ERROR, "global/nonlocal mutation inside traced code",
+               "return the new value instead; traced functions must be pure "
+               "or the mutation happens once at trace time"),
+    "TPU007": (SEVERITY_WARNING, "list growth across loop iterations",
+               "accumulating Python lists in a loop unrolls the graph; use "
+               "lax.scan (ys output) or preallocated jnp arrays"),
+    "TPU008": (SEVERITY_ERROR, "wall-clock / unkeyed randomness in traced code",
+               "time()/random.*/np.random.* freeze at trace time; use "
+               "paddle.seed + paddle_tpu random ops (keyed jax.random)"),
+    # ---- jaxpr passes (post-trace program properties) ----
+    "TPU101": (SEVERITY_WARNING, "large constant baked into the program",
+               "a closure-captured array is inlined into HLO and re-uploaded "
+               "per compile; pass it as an argument (donated/sharded) instead"),
+    "TPU102": (SEVERITY_ERROR, "unhashable static argument defeats the jit cache",
+               "normalise statics to hashable (tuple/str/int) before the call; "
+               "lists/dicts/arrays as statics retrace every step"),
+    "TPU103": (SEVERITY_WARNING, "weak-type leak forces retraces",
+               "a Python scalar entered the traced output; anchor dtypes with "
+               "jnp.asarray(x, dtype) so repeated calls hit the same cache "
+               "entry"),
+    "TPU104": (SEVERITY_ERROR, "collective axis_name not on the active mesh",
+               "axis names inside the traced program must match "
+               "distributed mesh axes (topology.get_global_mesh().axis_names)"),
+    # ---- registry passes (core/dispatch.py op contract) ----
+    "TPU201": (SEVERITY_ERROR, "op static kwarg does not normalise hashable",
+               "dispatch caches jits on hashable(kwargs); pass axes/shapes as "
+               "tuples, dtypes by name, never arrays/dicts-of-arrays"),
+    "TPU202": (SEVERITY_ERROR, "op function identity unstable for the jit/vjp cache",
+               "a closure-capturing op whose qualname is reused must pass a "
+               "discriminating uid kwarg, or the cached jit replays stale "
+               "captured state (wrong gradients)"),
+    "TPU203": (SEVERITY_WARNING, "float64 in op implementation",
+               "TPUs have no f64 ALU path and jax demotes silently under "
+               "x64-disabled; use float32/bfloat16 explicitly"),
+}
+
+
+@dataclasses.dataclass
+class Diagnostic:
+    code: str
+    message: str
+    filename: str = "<unknown>"
+    line: int = 0
+    col: int = 0
+    severity: str = ""  # defaulted from CODES when empty
+    hint: str = ""      # defaulted from CODES when empty
+    func: str = ""      # enclosing function, when known
+
+    def __post_init__(self):
+        sev, _title, hint = CODES.get(
+            self.code, (SEVERITY_WARNING, "unknown code", ""))
+        if not self.severity:
+            self.severity = sev
+        if not self.hint:
+            self.hint = hint
+
+    @property
+    def is_error(self):
+        return self.severity == SEVERITY_ERROR
+
+    def as_dict(self):
+        return dataclasses.asdict(self)
+
+    def format(self):
+        loc = f"{self.filename}:{self.line}"
+        if self.col:
+            loc += f":{self.col}"
+        where = f" [{self.func}]" if self.func else ""
+        out = f"{loc}: {self.severity} {self.code}{where}: {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+def sort_key(d):
+    """Rank: errors first, then code, then location — the order the
+    dy2static failure hook and the CLI present findings in."""
+    return (_SEV_RANK.get(d.severity, 9), d.code, d.filename, d.line, d.col)
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tracelint\s*:\s*disable(?:=([A-Z0-9,\s]+))?")
+
+
+def _parse_suppression(comment):
+    """-> None (no directive) | set of codes | 'all'."""
+    m = _SUPPRESS_RE.search(comment)
+    if not m:
+        return None
+    if m.group(1) is None:
+        return "all"
+    codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+    return codes or "all"
+
+
+class SuppressionIndex:
+    """Per-file map of inline/file-level `# tracelint: disable=` directives.
+
+    ``file_level=False`` treats even first-five-lines comment directives
+    as line-scoped (used when the "file" is a single function's source).
+    """
+
+    def __init__(self, source, file_level=True):
+        self._by_line = {}
+        self._file_level = None
+        for i, text in enumerate(source.splitlines(), start=1):
+            if "tracelint" not in text:
+                continue
+            got = _parse_suppression(text)
+            if got is None:
+                continue
+            if file_level and i <= 5 and text.lstrip().startswith("#"):
+                if self._file_level is None or got == "all":
+                    self._file_level = got
+                elif self._file_level != "all":
+                    self._file_level |= got
+            else:
+                self._by_line[i] = got
+
+    def suppressed(self, diag):
+        for scope in (self._file_level, self._by_line.get(diag.line)):
+            if scope == "all":
+                return True
+            if scope and diag.code in scope:
+                return True
+        return False
+
+
+def filter_diagnostics(diags, disabled=(), suppression=None):
+    out = []
+    disabled = set(disabled)
+    for d in diags:
+        if d.code in disabled:
+            continue
+        if suppression is not None and suppression.suppressed(d):
+            continue
+        out.append(d)
+    return sorted(out, key=sort_key)
+
+
+def format_text(diags):
+    if not diags:
+        return "tracelint: clean (0 findings)"
+    lines = [d.format() for d in diags]
+    n_err = sum(1 for d in diags if d.is_error)
+    lines.append(
+        f"tracelint: {len(diags)} finding(s), {n_err} error(s)")
+    return "\n".join(lines)
+
+
+def format_json(diags):
+    return json.dumps(
+        {
+            "findings": [d.as_dict() for d in diags],
+            "errors": sum(1 for d in diags if d.is_error),
+            "warnings": sum(1 for d in diags if d.severity == SEVERITY_WARNING),
+        },
+        indent=2,
+    )

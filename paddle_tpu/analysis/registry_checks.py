@@ -1,0 +1,137 @@
+"""Op-registry contract passes (TPU201–TPU203).
+
+``core/dispatch.py`` states the op contract: positional args are arrays,
+statics are keyword args hashable-after-normalisation, and op function
+identity must be stable under ``fn_key`` (name, module, qualname) because
+both the forward jit cache and the tape's VJP cache key on it. These
+passes audit every registered (``def_op``) and observed (``apply_op``)
+op against that contract:
+
+- **TPU201** — a declared static-kwarg default that does not normalise
+  hashable would crash (or silently thrash) the jit-cache dict lookup.
+- **TPU202** — a ``<locals>``-defined op function with a non-empty
+  closure and no discriminating kwarg: two instances share one fn_key,
+  so the cached forward jit and the tape's cached VJP replay whichever
+  captured state compiled first — wrong outputs *and* wrong gradients.
+- **TPU203** — float64 in the op implementation; TPU has no f64 path
+  and jax silently demotes under the default x64-disabled config, so
+  promotion differs between CPU tests and the pod.
+"""
+import inspect
+import re
+
+from .diagnostics import Diagnostic, _parse_suppression
+from .jaxpr_checks import _loc_of, check_static_kwargs
+
+# kwarg-name fragments accepted as fn_key discriminators (the dispatch
+# module's documented escape hatch for state-capturing ops: to_static
+# passes __spec, the tape passes __sig, HeterPS passes uid)
+_DISCRIMINATOR_RE = re.compile(r"uid|spec|sig|key_id", re.IGNORECASE)
+
+_F64_RE = re.compile(r"float64|\bf64\b|np\.double|jnp\.double")
+
+
+def check_op(name, fn, static_kwarg_names=()):
+    """Run all TPU2xx passes over one op function."""
+    filename, line = _loc_of(fn)
+    diags = []
+
+    # TPU201 — declared defaults must normalise hashable
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        sig = None
+    if sig is not None:
+        defaults = {p.name: p.default for p in sig.parameters.values()
+                    if p.default is not inspect.Parameter.empty}
+        for d in check_static_kwargs(defaults, filename, line, func=name,
+                                     code="TPU201"):
+            diags.append(d)
+
+    # TPU202 — fn_key stability
+    qualname = getattr(fn, "__qualname__", "") or ""
+    closure = getattr(fn, "__closure__", None)
+    if "<locals>" in qualname and closure:
+        discriminated = (
+            any(_DISCRIMINATOR_RE.search(k) for k in static_kwarg_names)
+            or _DISCRIMINATOR_RE.search(name))
+        if not discriminated:
+            captured = []
+            for cellvar, cell in zip(fn.__code__.co_freevars, closure):
+                try:
+                    captured.append(
+                        f"{cellvar}={type(cell.cell_contents).__name__}")
+                except ValueError:
+                    captured.append(f"{cellvar}=<unset>")
+            diags.append(Diagnostic(
+                code="TPU202",
+                message=(f"op {name!r} is a closure over "
+                         f"[{', '.join(captured)}] with qualname "
+                         f"{qualname!r}; the jit/vjp caches key on qualname, "
+                         "so every instance shares one compiled entry"),
+                filename=filename, line=line, func=name))
+
+    # TPU203 — float64 in the implementation (code only: the docstring
+    # and pure comments are prose, and a `# tracelint: disable=TPU203`
+    # directive — not the mere word "tracelint" — suppresses the line)
+    try:
+        src = inspect.getsource(fn)
+    except (OSError, TypeError):
+        src = ""
+    if src:
+        for i, text in enumerate(src.splitlines()):
+            code_part, _, comment = text.partition("#")
+            if not _F64_RE.search(code_part):
+                continue
+            if i in _docstring_lines(src):
+                continue
+            codes = _parse_suppression("#" + comment) if comment else None
+            if codes == "all" or (codes and "TPU203" in codes):
+                continue
+            diags.append(Diagnostic(
+                code="TPU203",
+                message=f"op {name!r} implementation mentions float64",
+                filename=filename, line=line + i, func=name))
+    return diags
+
+
+def _docstring_lines(src):
+    """0-based line indices covered by the function's docstring."""
+    import ast
+    import textwrap
+
+    try:
+        tree = ast.parse(textwrap.dedent(src))
+        fdef = tree.body[0]
+        first = fdef.body[0]
+    except (SyntaxError, IndexError, AttributeError):
+        return frozenset()
+    if isinstance(first, ast.Expr) and isinstance(first.value, ast.Constant) \
+            and isinstance(first.value.value, str):
+        return frozenset(range(first.lineno - 1, (first.end_lineno or
+                                                  first.lineno)))
+    return frozenset()
+
+
+def check_registry(ops=None):
+    """Audit the live registry (def_op registrations + apply_op-observed
+    ops). Pass ``ops`` as {name: fn} or {name: (fn, kwarg_names)} to
+    audit an explicit set instead."""
+    if ops is None:
+        from ..core import dispatch
+
+        seen = dispatch.ops_seen_live()
+        ops = {}
+        for name, api in dispatch.OP_REGISTRY.items():
+            # keep the observed static-kwarg names (they may carry the
+            # uid discriminator TPU202 looks for), audit the raw fn
+            _, kwnames = seen.get(name, (None, ()))
+            ops[name] = (api.raw_fn, kwnames)
+        for name, entry in seen.items():
+            ops.setdefault(name, entry)
+    diags = []
+    for name in sorted(ops):
+        entry = ops[name]
+        fn, kwnames = entry if isinstance(entry, tuple) else (entry, ())
+        diags.extend(check_op(name, fn, static_kwarg_names=kwnames))
+    return diags

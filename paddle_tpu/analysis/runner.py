@@ -1,0 +1,115 @@
+"""tracelint orchestration: walk files/packages, run pass families,
+apply suppression, and aggregate one sorted Diagnostic list.
+
+This is the engine under ``tools/tracelint.py`` (CLI), the dy2static
+trace-failure hook, and the tier-1 self-check test.
+"""
+import ast
+import os
+
+from . import ast_checks, registry_checks
+from .diagnostics import (Diagnostic, SuppressionIndex, filter_diagnostics,
+                          format_json, format_text)
+
+__all__ = ["lint_source", "lint_file", "lint_paths", "lint_function",
+           "lint_registry", "LintResult"]
+
+
+class LintResult:
+    def __init__(self, diagnostics, files_scanned=0):
+        self.diagnostics = diagnostics
+        self.files_scanned = files_scanned
+
+    @property
+    def errors(self):
+        return [d for d in self.diagnostics if d.is_error]
+
+    @property
+    def exit_code(self):
+        return 1 if self.errors else 0
+
+    def format(self, fmt="text"):
+        if fmt == "json":
+            return format_json(self.diagnostics)
+        return format_text(self.diagnostics)
+
+
+def lint_source(source, filename="<source>", all_functions=False,
+                disabled=(), tainted_params=None, file_level_suppression=True):
+    """AST passes over one source blob, honouring inline suppression.
+
+    ``file_level_suppression=False`` keeps first-five-lines directives
+    line-scoped — lint_function passes FUNCTION source, where "first
+    five lines" would wrongly widen a statement annotation to the whole
+    body."""
+    try:
+        diags = ast_checks.check_source(
+            source, filename, all_functions=all_functions,
+            tainted_params=tainted_params)
+    except SyntaxError as e:
+        diags = [Diagnostic(code="TPU000", severity="warning",
+                            message=f"could not parse: {e.msg}",
+                            filename=filename, line=e.lineno or 0)]
+        return filter_diagnostics(diags, disabled=disabled)
+    return filter_diagnostics(
+        diags, disabled=disabled,
+        suppression=SuppressionIndex(source,
+                                     file_level=file_level_suppression))
+
+
+def lint_file(path, all_functions=False, disabled=()):
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    return lint_source(source, filename=path, all_functions=all_functions,
+                       disabled=disabled)
+
+
+def _iter_py_files(paths):
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs
+                             if not d.startswith(".") and
+                             d not in ("__pycache__",))
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def lint_paths(paths, all_functions=False, disabled=()):
+    """Walk files/dirs and run the AST pass family on every .py file."""
+    diags = []
+    n = 0
+    for path in _iter_py_files(paths):
+        n += 1
+        diags.extend(lint_file(path, all_functions=all_functions,
+                               disabled=disabled))
+    return LintResult(filter_diagnostics(diags), files_scanned=n)
+
+
+def lint_function(fn, disabled=(), tainted_params=None):
+    """AST passes over one live function object (the dy2static hook's
+    entry point): its whole body is trace context."""
+    import inspect
+    import textwrap
+
+    try:
+        source = textwrap.dedent(inspect.getsource(fn))
+        filename = inspect.getsourcefile(fn) or "<function>"
+        _, base_line = inspect.getsourcelines(fn)
+    except (OSError, TypeError):
+        return []
+    diags = lint_source(source, filename=filename, all_functions=True,
+                        disabled=disabled, tainted_params=tainted_params,
+                        file_level_suppression=False)
+    for d in diags:
+        d.line += base_line - 1
+    return diags
+
+
+def lint_registry(ops=None, disabled=()):
+    """Registry pass family over the live op registry."""
+    return LintResult(filter_diagnostics(
+        registry_checks.check_registry(ops), disabled=disabled))

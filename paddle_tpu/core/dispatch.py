@@ -22,6 +22,7 @@ argument and hashable-after-normalisation.
 """
 import contextvars
 import functools
+import weakref
 
 import jax
 import numpy as np
@@ -90,13 +91,18 @@ def in_trace():
 
 
 def hashable(obj):
-    """Normalise static kwargs into a hashable cache key."""
-    if not obj and isinstance(obj, dict):
-        return ()  # fast path: the common no-static-kwargs op
+    """Normalise static kwargs into a hashable cache key.
+
+    Type checks come before any truthiness test: ``not obj`` on an
+    ndarray raises, so the old ``if not obj and isinstance(obj, dict)``
+    fast path crashed on array-valued statics (tracelint TPU102 audits
+    them; found by tests/test_tracelint.py)."""
+    if isinstance(obj, dict):
+        if not obj:
+            return ()  # fast path: the common no-static-kwargs op
+        return tuple(sorted((k, hashable(v)) for k, v in obj.items()))
     if isinstance(obj, (list, tuple)):
         return tuple(hashable(o) for o in obj)
-    if isinstance(obj, dict):
-        return tuple(sorted((k, hashable(v)) for k, v in obj.items()))
     if isinstance(obj, set):
         return tuple(sorted(hashable(o) for o in obj))
     if isinstance(obj, np.dtype):
@@ -105,6 +111,33 @@ def hashable(obj):
 
 
 _FWD_CACHE = {}
+
+# ---------------------------------------------------------------- op registry
+#
+# The OpInfoMap analog, now introspectable: def_op registrations land in
+# OP_REGISTRY; ops that flow through apply_op directly (the dominant
+# in-tree idiom) are observed on first dispatch into OPS_SEEN with the
+# static-kwarg names used at that call site. paddle_tpu.analysis's
+# registry passes (tools/tracelint.py --registry) audit both against the
+# dispatch contract documented at the top of this module.
+
+OP_REGISTRY = {}  # name -> def_op api wrapper (api.raw_fn is the pure fn)
+# name -> (weakref-or-fn, static kwarg names at first dispatch). Weakly
+# referenced so observation never pins a closure op (to_static pure_fns
+# close over whole Layers) past its owner's lifetime.
+OPS_SEEN = {}
+
+
+def ops_seen_live():
+    """Resolve OPS_SEEN to {name: (fn, kwarg_names)}, dropping dead refs."""
+    out = {}
+    for name, (ref, kwnames) in list(OPS_SEEN.items()):
+        fn = ref() if isinstance(ref, weakref.ref) else ref
+        if fn is None:
+            del OPS_SEEN[name]
+        else:
+            out[name] = (fn, kwnames)
+    return out
 
 
 def fn_key(name, fn):
@@ -133,6 +166,9 @@ def evict_ops(name):
             if isinstance(k[0], tuple) and k[0][0] == name]
     for k in dead:
         del _FWD_CACHE[k]
+    # the observed-op registry holds the same fn reference — drop it too
+    # or the captured state outlives the teardown it was evicted for
+    OPS_SEEN.pop(name, None)
 
 
 def jitted(fn, kwargs, name=None):
@@ -187,6 +223,13 @@ def _hot_mods():
 def apply_op(name, fn, *args, **kwargs):
     """Execute one op. Returns Tensor or tuple-of-Tensor mirroring fn's output."""
     Tensor, tape_mod = _hot_mods()
+
+    if name not in OPS_SEEN:  # first dispatch only — hot path stays one lookup
+        try:
+            ref = weakref.ref(fn)
+        except TypeError:  # not weakref-able (e.g. builtins, partials)
+            ref = fn
+        OPS_SEEN[name] = (ref, tuple(sorted(kwargs)))
 
     arrays = []
     diff_argnums = []
@@ -277,4 +320,5 @@ def def_op(name, fn):
 
     api.__name__ = name
     api.raw_fn = fn
+    OP_REGISTRY[name] = api
     return api

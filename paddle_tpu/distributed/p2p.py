@@ -55,6 +55,8 @@ import threading
 
 import numpy as np
 
+from ..core import jax_compat
+
 __all__ = ["get_transport", "shutdown"]
 
 _HEADER = struct.Struct("<I")
@@ -173,7 +175,7 @@ class Transport:
             import jax
             from jax._src.distributed import global_state
 
-            if jax.distributed.is_initialized():
+            if jax_compat.distributed_is_initialized():
                 return global_state.client
         except Exception:
             pass

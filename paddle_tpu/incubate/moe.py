@@ -40,6 +40,7 @@ from jax.sharding import PartitionSpec as P
 
 from .. import nn
 from ..core.dispatch import apply_op
+from ..core.jax_compat import shard_map
 
 
 def _capacity_combine(xf, probs, top_k, cap):
@@ -246,10 +247,10 @@ class MoELayer(nn.Layer):
         def _a2a(x, logits, w_up, w_down):
             tok = P(tok_axes, None, None)
             wsp = P(axis, None, None)
-            fn = jax.shard_map(local_fn, mesh=mesh,
-                               in_specs=(tok, tok, wsp, wsp),
-                               out_specs=(tok, P()),
-                               check_vma=False)
+            fn = shard_map(local_fn, mesh=mesh,
+                           in_specs=(tok, tok, wsp, wsp),
+                           out_specs=(tok, P()),
+                           check_vma=False)
             return fn(x, logits, w_up, w_down)
 
         from ..core.dispatch import in_trace

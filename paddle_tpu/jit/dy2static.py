@@ -203,10 +203,12 @@ def _traced_while(cond_fn, body_fn, vals, maximum_iterations=None):
 
     def cond_w(carry):
         kind, p = _pred_value(cond_fn(*rebuild(carry)))
-        if kind == "py":
+        # `kind` is a host-side tag ('py'/'traced'), and `p` is a real
+        # Python bool exactly on the 'py' branch — safe by construction
+        if kind == "py":  # tracelint: disable=TPU001
             # condition independent of the carry (e.g. `while flag:` over
             # a python constant) — a plain bool has no .dtype; lift it
-            return jnp.asarray(bool(p))
+            return jnp.asarray(bool(p))  # tracelint: disable=TPU004
         return p != 0 if p.dtype != jnp.bool_ else p
 
     def body_w(carry):
@@ -420,6 +422,70 @@ def _pack_call(names):
 import sys as _sys
 
 _THIS = _sys.modules[__name__]
+
+
+# ------------------------------------------------- trace-failure diagnostics
+
+
+class TraceSafetyError(RuntimeError):
+    """A to_static trace failed; ``.diagnostics`` carries ranked tracelint
+    findings for the user function (the actionable-dy2static-error analog
+    of the reference's error_utils/origin_info source mapping)."""
+
+    def __init__(self, message, diagnostics=()):
+        super().__init__(message)
+        self.diagnostics = list(diagnostics)
+
+
+# jax error types that mean "the user's Python is not trace-safe" (vs a
+# shape/dtype bug inside an op) — only these get the tracelint treatment
+def _trace_error_types():
+    errs = jax.errors
+    names = ("TracerBoolConversionError", "TracerArrayConversionError",
+             "TracerIntegerConversionError", "ConcretizationTypeError",
+             "UnexpectedTracerError")
+    return tuple(t for t in (getattr(errs, n, None) for n in names)
+                 if t is not None)
+
+
+def explain_trace_failure(fn, exc):
+    """Run the tracelint AST passes over ``fn`` and build a
+    TraceSafetyError whose message ranks the likely causes next to the
+    raw tracer error. Returns None when fn has no findings (the caller
+    re-raises the original error untouched)."""
+    from ..analysis import runner, sort_key
+
+    target = inspect.unwrap(getattr(fn, "__func__", fn))
+    try:
+        diags = runner.lint_function(target)
+    except Exception:  # noqa: BLE001 — diagnostics must never mask the error
+        return None
+    if not diags:
+        return None
+    # tensor-dependent if/while (TPU001/TPU002) are usually NOT the cause
+    # under to_static — ast_transform rewrites them to lax.cond/while —
+    # so rank genuine trace-breakers (host syncs, side effects) first
+    auto_rewritten = ("TPU001", "TPU002")
+    diags = sorted(diags, key=lambda d: (d.code in auto_rewritten,)
+                   + sort_key(d))
+    name = getattr(target, "__qualname__", repr(target))
+    lines = [
+        f"to_static failed while tracing {name!r}: {exc}",
+        "",
+        f"tracelint found {len(diags)} likely cause(s) in the function "
+        "source, ranked:",
+    ]
+    for i, d in enumerate(diags, start=1):
+        note = (" (dy2static auto-rewrites this construct; likely benign)"
+                if d.code in auto_rewritten else "")
+        lines.append(
+            f"  {i}. {d.filename}:{d.line} [{d.code}] {d.message}{note}")
+        if d.hint:
+            lines.append(f"     hint: {d.hint}")
+    lines.append("")
+    lines.append("(suppress a finding with `# tracelint: disable=CODE` on "
+                 "its line; full rules in README.md §Trace-safety rules)")
+    return TraceSafetyError("\n".join(lines), diagnostics=diags)
 
 
 @functools.lru_cache(maxsize=256)

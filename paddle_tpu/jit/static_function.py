@@ -183,10 +183,30 @@ class StaticFunction:
             p_tensors, b_arrays = [], []
         in_tensors, _, _ = _flatten_tensors((args, kwargs))
         rng = random_core.next_key()
-        out = dispatch.apply_op(
-            f"to_static::{program.name}::{self._uid}", program.pure_fn,
-            rng, *p_tensors, *[Tensor(b, stop_gradient=True) for b in b_arrays],
-            *in_tensors, __spec=dispatch.hashable(key))
+        try:
+            out = dispatch.apply_op(
+                f"to_static::{program.name}::{self._uid}", program.pure_fn,
+                rng, *p_tensors,
+                *[Tensor(b, stop_gradient=True) for b in b_arrays],
+                *in_tensors, __spec=dispatch.hashable(key))
+        except Exception as exc:  # noqa: BLE001 — filtered right below
+            from . import dy2static
+
+            if not isinstance(exc, dy2static._trace_error_types()):
+                raise
+            # the trace rejected the user's Python: attach ranked
+            # source-level diagnostics (reference: dy2static's actionable
+            # error reports) instead of the raw tracer error
+            self._cache.pop(key, None)  # a failed build must not be reused
+            # ... and neither must the dispatch-level jit: fn_key of a
+            # REBUILT pure_fn is identical, so a stale cached jit would
+            # run the old closure and leave the new out_skeleton_box
+            # empty (KeyError 'rebuild' on the next successful call)
+            dispatch.evict_ops(f"to_static::{program.name}::{self._uid}")
+            explained = dy2static.explain_trace_failure(self._orig_fn, exc)
+            if explained is None:
+                raise
+            raise explained from exc
         outs = out if isinstance(out, tuple) else (out,)
         rebuild = program.out_skeleton_box["rebuild"]
         skel = program.out_skeleton_box["skel"]

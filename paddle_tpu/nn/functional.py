@@ -540,7 +540,9 @@ def _pool_nd(x, *, ksize, stride, padding, mode, ceil_mode, data_format, nd,
     summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
     if divisor is not None:
         return summed / float(divisor)
-    if exclusive and had_pad:
+    # had_pad is a host bool derived from the static pool geometry (shape
+    # arithmetic only), not from x's values
+    if exclusive and had_pad:  # tracelint: disable=TPU001
         ones = jnp.ones_like(x)
         counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
         return summed / counts

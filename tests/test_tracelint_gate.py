@@ -1,0 +1,72 @@
+"""tools/ci_gate.py pass/fail contract (mirroring
+tests/test_check_op_benchmark.py): lint phase gates on error findings,
+test phase gates on the pytest exit status, and the last stdout line is
+a machine-readable JSON summary."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATE = os.path.join(REPO, "tools", "ci_gate.py")
+
+BAD_SRC = ("from paddle_tpu.jit import to_static\n"
+           "@to_static\n"
+           "def f(x):\n    return float(x.mean())\n")
+GOOD_SRC = "def f(x):\n    return x\n"
+
+
+def _run(args):
+    return subprocess.run([sys.executable, GATE, *args],
+                          capture_output=True, text=True, cwd=REPO)
+
+
+def _summary(r):
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def test_lint_clean_skip_tests_passes(tmp_path):
+    f = tmp_path / "good.py"
+    f.write_text(GOOD_SRC)
+    r = _run(["--paths", str(f), "--skip-tests"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    s = _summary(r)
+    assert s["lint_ok"] and s["tests_skipped"] and s["lint_errors"] == 0
+
+
+def test_lint_error_fails_gate(tmp_path):
+    f = tmp_path / "bad.py"
+    f.write_text(BAD_SRC)
+    r = _run(["--paths", str(f), "--skip-tests"])
+    assert r.returncode == 1
+    s = _summary(r)
+    assert not s["lint_ok"] and s["lint_errors"] >= 1
+    assert "TPU004" in r.stdout  # error findings are listed before the summary
+    assert "FAILED" in r.stderr
+
+
+def test_disable_clears_the_gate(tmp_path):
+    f = tmp_path / "bad.py"
+    f.write_text(BAD_SRC)
+    r = _run(["--paths", str(f), "--skip-tests", "--disable", "TPU004"])
+    assert r.returncode == 0
+    assert _summary(r)["lint_ok"]
+
+
+def test_pytest_phase_gates(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text(GOOD_SRC)
+    ok_test = tmp_path / "test_ok.py"
+    ok_test.write_text("def test_ok():\n    assert True\n")
+    r = _run(["--paths", str(good), "--pytest-args",
+              f"{ok_test} -q -p no:cacheprovider"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert _summary(r)["tests_ok"]
+
+    fail_test = tmp_path / "test_fail.py"
+    fail_test.write_text("def test_no():\n    assert False\n")
+    r = _run(["--paths", str(good), "--pytest-args",
+              f"{fail_test} -q -p no:cacheprovider"])
+    assert r.returncode == 1
+    s = _summary(r)
+    assert s["lint_ok"] and not s["tests_ok"]

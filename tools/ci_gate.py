@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""CI gate: tracelint + tier-1 pytest, one exit status.
+
+Usage:
+    python tools/ci_gate.py [--paths paddle_tpu]
+        [--skip-tests] [--pytest-args "tests/ -q -m 'not slow'"]
+        [--disable TPU005,...]
+
+Phase 1 runs ``tools/tracelint.py --format json`` over ``--paths`` and
+fails on any error-severity finding (the analyzer gates the codebase
+that ships it). Phase 2 runs the tier-1 pytest command (ROADMAP.md) —
+``--skip-tests`` elides it for lint-only invocations, ``--pytest-args``
+overrides the default selection. Exit 1 when either phase fails;
+the JSON line printed last summarises both for log scrapers
+(mirroring tools/check_op_benchmark_result.py's contract).
+"""
+import argparse
+import json
+import os
+import shlex
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRACELINT = os.path.join(REPO, "tools", "tracelint.py")
+
+DEFAULT_PYTEST_ARGS = ("tests/ -q -m 'not slow' "
+                       "--continue-on-collection-errors -p no:cacheprovider")
+
+
+def run_tracelint(paths, disable=""):
+    cmd = [sys.executable, TRACELINT, "--format", "json", *paths]
+    if disable:
+        cmd += ["--disable", disable]
+    proc = subprocess.run(cmd, capture_output=True, text=True, cwd=REPO)
+    try:
+        report = json.loads(proc.stdout)
+    except json.JSONDecodeError:
+        return {"errors": -1, "warnings": 0,
+                "findings": [],
+                "crash": proc.stderr.strip()[-2000:]}, 1
+    return report, proc.returncode
+
+
+def run_pytest(pytest_args):
+    cmd = [sys.executable, "-m", "pytest", *shlex.split(pytest_args)]
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS",
+                                                        "cpu"))
+    proc = subprocess.run(cmd, cwd=REPO, env=env)
+    return proc.returncode
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="ci_gate")
+    ap.add_argument("--paths", nargs="*", default=["paddle_tpu"])
+    ap.add_argument("--disable", default="")
+    ap.add_argument("--skip-tests", action="store_true")
+    ap.add_argument("--pytest-args", default=DEFAULT_PYTEST_ARGS)
+    ns = ap.parse_args(argv)
+
+    report, lint_rc = run_tracelint(ns.paths, ns.disable)
+    for f in report.get("findings", []):
+        if f.get("severity") == "error":
+            print(f"{f['filename']}:{f['line']}: {f['code']} {f['message']}")
+    lint_ok = lint_rc == 0
+
+    tests_ok = True
+    if not ns.skip_tests:
+        tests_ok = run_pytest(ns.pytest_args) == 0
+
+    summary = {
+        "gate": "tracelint+tier1",
+        "lint_ok": lint_ok,
+        "lint_errors": report.get("errors", -1),
+        "lint_warnings": report.get("warnings", 0),
+        "tests_ok": tests_ok,
+        "tests_skipped": bool(ns.skip_tests),
+    }
+    print(json.dumps(summary))
+    if not (lint_ok and tests_ok):
+        print("ci_gate: FAILED", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""tracelint — trace-safety & recompilation-hazard linter for paddle_tpu
+programs (driver for paddle_tpu.analysis).
+
+Usage:
+    python tools/tracelint.py PATH [PATH ...]
+        [--format text|json] [--disable TPU005,TPU007]
+        [--all-functions] [--registry] [--warnings-as-errors]
+
+Scans .py files (or whole packages) with the AST trace-safety passes
+(TPU0xx); ``--registry`` additionally imports paddle_tpu and audits the
+live op registry (TPU2xx). By default only functions that are
+demonstrably trace context (decorated @to_static/@jax.jit/..., or passed
+into apply_op / lax.cond / lax.scan) are checked; ``--all-functions``
+treats every function as traced (useful for auditing a train-step
+module wholesale).
+
+Exit status: 1 when any error-severity finding remains after
+suppression, else 0. Inline suppression: ``# tracelint: disable=TPU001``
+on the flagged line (file-level when in the first five lines).
+"""
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="tracelint")
+    ap.add_argument("paths", nargs="+", help=".py files or package dirs")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--disable", default="",
+                    help="comma-separated diagnostic codes to suppress")
+    ap.add_argument("--all-functions", action="store_true",
+                    help="treat every function as trace context")
+    ap.add_argument("--registry", action="store_true",
+                    help="also audit the live op registry (imports paddle_tpu)")
+    ap.add_argument("--warnings-as-errors", action="store_true")
+    ns = ap.parse_args(argv)
+
+    from paddle_tpu.analysis import (LintResult, filter_diagnostics,
+                                     lint_paths, lint_registry)
+
+    disabled = tuple(c.strip() for c in ns.disable.split(",") if c.strip())
+    for p in ns.paths:
+        if not os.path.exists(p):
+            print(f"tracelint: no such path: {p}", file=sys.stderr)
+            return 2
+    result = lint_paths(ns.paths, all_functions=ns.all_functions,
+                        disabled=disabled)
+    diags = list(result.diagnostics)
+    if ns.registry:
+        import paddle_tpu  # noqa: F401 — populate the registry
+
+        diags += lint_registry(disabled=disabled).diagnostics
+    merged = LintResult(filter_diagnostics(diags),
+                        files_scanned=result.files_scanned)
+    print(merged.format(ns.format))
+    if merged.errors:
+        return 1
+    if ns.warnings_as_errors and merged.diagnostics:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
