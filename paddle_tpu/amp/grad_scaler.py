@@ -26,6 +26,7 @@ class AmpScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._bad_step_monitor = None
 
     def is_enable(self):
         return self._enable
@@ -55,13 +56,27 @@ class AmpScaler:
 
     minimize_unscale = unscale_
 
+    def attach_bad_step_monitor(self, monitor):
+        """Feed this scaler's overflow skips into a
+        resilience.BadStepMonitor: the scaler keeps doing its dynamic
+        re-scaling, and after the monitor's threshold of CONSECUTIVE
+        skipped steps it triggers the checkpoint-rollback policy (the
+        two defenses compose instead of double-counting — see
+        MIGRATION.md)."""
+        self._bad_step_monitor = monitor
+        return monitor
+
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
+            if self._bad_step_monitor is not None:
+                self._bad_step_monitor.record(False)
             return
         self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
+        if self._bad_step_monitor is not None:
+            self._bad_step_monitor.record(self._found_inf)
         self.update()
 
     def minimize(self, optimizer, loss, **kwargs):

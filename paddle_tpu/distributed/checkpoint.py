@@ -20,21 +20,67 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
-def save_sharded(state, path, force=True):
+def save_sharded(state, path, force=True, atomic=True):
     """Save a pytree of (possibly sharded) jax arrays.
 
     state: e.g. {"params": params, "opt_state": opt_state, "step": 7}.
     Every process must call this (collective); single-process saves work
     the same way.
+
+    atomic=True (default) stages the orbax directory next to `path` and
+    publishes it with one os.replace, so a preempted/crashed save never
+    leaves a half-written checkpoint at `path`. Single-process only: in
+    multi-process runs every process must hand orbax the SAME directory
+    (its coordination + finalize barrier provide the atomic publish
+    there), so the tmp+rename staging automatically steps aside when
+    jax.process_count() > 1.
     """
     path = os.path.abspath(path)
     # orbax's standard handler takes arrays, not raw python/np scalars
     state = jax.tree.map(
         lambda x: np.asarray(x) if isinstance(x, (np.generic, int, float,
                                                   bool)) else x, state)
+    from ..resilience import chaos
+
     ckptr = _checkpointer()
-    ckptr.save(path, state, force=force)
-    ckptr.wait_until_finished()
+    if not atomic or jax.process_count() > 1:
+        ckptr.save(path, state, force=force)
+        ckptr.wait_until_finished()
+        return path
+    if os.path.isdir(path) and not force:
+        raise FileExistsError(f"checkpoint exists: {path}")
+    import shutil
+
+    tmp = os.path.join(os.path.dirname(path),
+                       f".tmp-{os.path.basename(path)}-{os.getpid()}")
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp, ignore_errors=True)
+    try:
+        chaos.hit("checkpoint.write")
+        ckptr.save(tmp, state, force=True)
+        ckptr.wait_until_finished()
+        chaos.hit("checkpoint.rename")
+        old = None
+        if os.path.isdir(path):
+            # move the previous checkpoint ASIDE atomically instead of
+            # deleting it first: a crash between the two renames leaves
+            # the old data in .old-* (recoverable) rather than nothing
+            old = os.path.join(os.path.dirname(path),
+                               f".old-{os.path.basename(path)}-{os.getpid()}")
+            if os.path.isdir(old):
+                shutil.rmtree(old, ignore_errors=True)
+            os.replace(path, old)
+        try:
+            os.replace(tmp, path)
+        except BaseException:
+            if old is not None and not os.path.isdir(path):
+                os.replace(old, path)  # publish failed: put the old back
+            raise
+        if old is not None:
+            shutil.rmtree(old, ignore_errors=True)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
     return path
 
 
@@ -75,3 +121,49 @@ def load_train_state(path, params_like, opt_state_like):
                                 "opt_state": opt_state_like,
                                 "step": np.int64(0)})
     return state["params"], state["opt_state"], int(state["step"])
+
+
+def sharded_checkpoint_manager(root, like=None, keep=3, io_retries=3):
+    """A resilience.CheckpointManager whose payload is this module's
+    orbax/TensorStore sharded format: atomic rename + manifest with
+    per-file checksums + retention GC + verified load with fallback,
+    over reshardable global-array checkpoints.
+
+    like: pytree template for restore (arrays or ShapeDtypeStruct with
+    shardings — reshard-on-load); set/replace it later via
+    ``manager.reader_like`` before calling load() if the target
+    sharding isn't known at construction time.
+
+    Single-process only (one controller saving a multi-chip mesh is
+    fine): orbax collective saves need every process to stage into the
+    SAME directory, which the manager's per-pid tmp staging cannot
+    provide — multi-process runs must call save_sharded directly.
+    """
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "sharded_checkpoint_manager stages saves in a per-process "
+            "temp dir and cannot coordinate orbax's collective save "
+            "across processes; in multi-process runs use save_sharded/"
+            "load_sharded directly (orbax provides the atomic finalize "
+            "barrier there)")
+    from ..resilience.checkpoint import CheckpointManager
+
+    def writer(state, ckpt_dir):
+        # orbax owns its directory layout; the manager checksums every
+        # file it produced. atomic=False — the manager's tmp dir is the
+        # staging area, one rename publishes payload AND manifest.
+        save_sharded(state, os.path.join(ckpt_dir, "state"), atomic=False)
+        return None
+
+    def reader(ckpt_dir):
+        template = getattr(manager, "reader_like", None)
+        if template is None:
+            raise ValueError(
+                "sharded_checkpoint_manager needs `like` (or set "
+                "manager.reader_like) to restore sharded arrays")
+        return load_sharded(os.path.join(ckpt_dir, "state"), template)
+
+    manager = CheckpointManager(root, keep=keep, writer=writer,
+                                reader=reader, io_retries=io_retries)
+    manager.reader_like = like
+    return manager

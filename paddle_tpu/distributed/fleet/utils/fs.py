@@ -1,7 +1,16 @@
 """Filesystem abstraction (reference: fleet/utils/fs.py LocalFS:115,
-HDFSClient:419)."""
+HDFSClient:419).
+
+Data-moving operations (upload/download/cat/mv) retry transient
+OSErrors with backoff (resilience.retry) — these run against shared
+filesystems that hiccup under checkpoint storms at pod scale."""
 import os
 import shutil
+
+from ....resilience import chaos
+from ....resilience.retry import retry
+
+_io_retry = retry(retry_on=(OSError,), base_delay=0.05)
 
 
 class FS:
@@ -39,21 +48,29 @@ class LocalFS(FS):
         elif os.path.exists(path):
             os.remove(path)
 
+    @_io_retry
     def mv(self, src, dst, overwrite=False):
         if overwrite and os.path.exists(dst):
             self.delete(dst)
         shutil.move(src, dst)
 
+    @_io_retry
     def upload(self, local_path, fs_path):
+        chaos.hit("fs.upload")
         shutil.copy(local_path, fs_path)
 
+    @_io_retry
     def download(self, fs_path, local_path):
+        chaos.hit("fs.download")
         shutil.copy(fs_path, local_path)
 
+    @_io_retry
     def touch(self, path, exist_ok=True):
         open(path, "a").close()
 
+    @_io_retry
     def cat(self, path):
+        chaos.hit("fs.cat")
         with open(path) as f:
             return f.read()
 

@@ -82,10 +82,40 @@ def init_parallel_env():
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
         except Exception:  # noqa: BLE001 — unknown option / no gloo build
             pass
-        jax.distributed.initialize(
+        # the coordination service races worker startup: early workers
+        # see connection-refused/timeouts until the coordinator binds.
+        # Backoff+jitter instead of crashing the whole gang (knobs:
+        # PADDLE_TPU_RETRY_* env, see resilience.retry).
+        from ..resilience.retry import call_with_retry
+
+        deadline = float(os.environ.get("PADDLE_TPU_DIST_INIT_DEADLINE",
+                                        300.0))
+
+        def _transient(e):
+            # jax wraps grpc coordination failures in RuntimeError; only
+            # connection-flavored ones are worth waiting out — config
+            # errors ("already called", bad address) must surface fast
+            if not isinstance(e, RuntimeError):
+                return True
+            msg = str(e)
+            return any(s in msg for s in (
+                "UNAVAILABLE", "DEADLINE_EXCEEDED", "connect",
+                "Connect", "timed out", "Timed out", "unavailable"))
+
+        call_with_retry(
+            jax.distributed.initialize,
             coordinator_address=coordinator,
             num_processes=n,
-            process_id=int(os.environ.get("PADDLE_TRAINER_ID") or 0))
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID") or 0),
+            retry_on=(OSError, ConnectionError, TimeoutError, RuntimeError),
+            retry_if=_transient,
+            # connection-refused races resolve in seconds (refused
+            # connects fail fast, so 5 attempts span ~15s of backoff);
+            # jax's own initialization_timeout already waits minutes for
+            # slow peers, so more attempts would multiply that, and the
+            # deadline caps the total either way
+            max_attempts=5, base_delay=1.0,
+            max_delay=10.0, deadline=deadline)
         _distributed_initialized = True
     mesh = topology.build_mesh(dp=len(jax.devices()))
     topology.set_global_mesh(mesh)

@@ -107,7 +107,7 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
                      shard_optimizer=False, sharding_stage=None, donate=True,
                      amp_level="O0", amp_dtype="bfloat16",
                      fp16_allreduce=False, dgc_configs=None, strategy=None,
-                     offload=False):
+                     offload=False, bad_step_guard=False):
     """Compile the full distributed training step for `layer`.
 
     loss_fn(model_out, label_array) -> scalar (pure jnp).
@@ -120,6 +120,13 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
     fp32 master weights; bf16 needs no loss scaling on TPU, and grads come
     out fp32 via the loss. The cast decision is trace-time, so the compiled
     step has bf16 matmuls on the MXU with no per-step Python cost.
+
+    bad_step_guard=True detects a non-finite loss or gradient INSIDE the
+    compiled step and keeps the previous params/opt_state/buffers (a
+    branchless jnp.where select — no host round-trip, donation-safe);
+    step_fn then returns (loss, params, opt_state, bad) with ``bad`` a
+    scalar bool array. Pair with resilience.BadStepMonitor to roll back
+    to the last good checkpoint after N consecutive bad steps.
 
     sharding_stage (ZeRO; reference sharding_optimizer.py:40,84,180 does
     this with 3k lines of program surgery — here it is sharding specs):
@@ -334,6 +341,18 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
             new_state[name] = tuple(out[1:])
         if use_local_grads and dgc_configs is not None:
             new_state["__comm__"] = new_comm
+        if bad_step_guard:
+            from ..resilience.badstep import select_tree, tree_nonfinite
+
+            # grads (pre-update) + loss cover NaN/Inf from the forward
+            # and backward; selecting the OLD state keeps the bad step a
+            # no-op without breaking donation (one XLA program, buffer-
+            # level aliasing still holds)
+            bad = tree_nonfinite(loss) | tree_nonfinite(grads)
+            new_params = select_tree(bad, params, new_params)
+            new_state = select_tree(bad, opt_state, new_state)
+            new_buffers = select_tree(bad, buffers, new_buffers)
+            return loss, new_params, new_state, new_buffers, bad
         return loss, new_params, new_state, new_buffers
 
     def init_fn():
@@ -382,6 +401,8 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
         repl,
     )
     out_shardings = (repl, param_shards, None, {n: repl for n in buffer_names})
+    if bad_step_guard:
+        out_shardings = out_shardings + (repl,)
     # donate params + opt_state: the step returns their replacements, so
     # XLA can update in place instead of holding both copies in HBM
     # (no-op on CPU backends, which don't implement donation)
@@ -420,13 +441,16 @@ def build_train_step(layer, loss_fn, optimizer, mesh=None, recompute=False,
             if any(live.get(n) is not cur.get(n) for n in buffer_names):
                 buffers_cell["cur"] = {n: jnp.asarray(live[n])
                                        for n in buffer_names}
-        loss, new_params, new_state, new_buffers = step_jit(
+        out = step_jit(
             params, opt_state, buffers_cell["cur"], x, y, key, lr)
+        loss, new_params, new_state, new_buffers = out[:4]
         if offload:
             new_state = _bounce(new_state, jax_compat.host_memory_kind())
         buffers_cell["cur"] = new_buffers
         if buffer_names:
             layer.load_functional_state(None, new_buffers)
+        if bad_step_guard:
+            return loss, new_params, new_state, out[4]
         return loss, new_params, new_state
 
     step_fn.jitted = step_jit  # AOT/lowering access (tests, memory checks)

@@ -37,12 +37,28 @@ def _from_saveable(obj, return_numpy=False):
 
 
 def save(obj, path, protocol=4, **configs):
-    """paddle.save — pickle of (nested) state dicts with Tensors as numpy."""
+    """paddle.save — pickle of (nested) state dicts with Tensors as numpy.
+
+    Atomic: the pickle lands in a same-directory temp file that is
+    fsynced and os.replace'd into place, so a crash mid-save leaves the
+    previous checkpoint intact instead of a truncated pickle."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+    tmp = os.path.join(d or ".",
+                       f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    try:
+        with open(tmp, "wb") as f:
+            pickle.dump(_to_saveable(obj), f, protocol=protocol)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def load(path, **configs):

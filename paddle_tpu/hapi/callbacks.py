@@ -73,13 +73,50 @@ class ProgBarLogger(Callback):
 
 
 class ModelCheckpoint(Callback):
-    def __init__(self, save_freq=1, save_dir=None):
+    """Epoch checkpoints (atomic — framework.save stages + renames).
+
+    save_best_only=True keeps one "best" checkpoint judged by `monitor`
+    (an epoch-end log key, e.g. "loss" or "val_acc"; mode "auto"
+    resolves min/max like EarlyStopping) — long runs keep the best eval
+    snapshot instead of only the last epoch."""
+
+    def __init__(self, save_freq=1, save_dir=None, save_best_only=False,
+                 monitor="loss", mode="auto", verbose=0):
         super().__init__()
         self.save_freq = save_freq
         self.save_dir = save_dir
+        self.save_best_only = save_best_only
+        self.monitor = monitor
+        self.verbose = verbose
+        self.mode = _auto_mode(monitor) if mode == "auto" else (
+            "max" if mode == "max" else "min")
+        self.best = None
+        self.best_epoch = None
+
+    def _is_better(self, value):
+        if self.best is None:
+            return True
+        return value > self.best if self.mode == "max" else value < self.best
 
     def on_epoch_end(self, epoch, logs=None):
-        if self.save_dir and (epoch + 1) % self.save_freq == 0:
+        if not self.save_dir:
+            return
+        if self.save_best_only:
+            value = (logs or {}).get(self.monitor)
+            if value is None or not self._is_better(float(value)):
+                return
+            self.best = float(value)
+            self.best_epoch = epoch
+            self.model.save(f"{self.save_dir}/best")
+            from ..resilience.checkpoint import atomic_write_json
+
+            atomic_write_json(f"{self.save_dir}/best.json",
+                              {"epoch": epoch, "monitor": self.monitor,
+                               "value": self.best, "mode": self.mode})
+            if self.verbose:
+                print(f"Epoch {epoch}: {self.monitor} improved to "
+                      f"{self.best:.6f}, saving best model")
+        elif (epoch + 1) % self.save_freq == 0:
             self.model.save(f"{self.save_dir}/{epoch}")
 
 

@@ -5,6 +5,8 @@ The dual static/dynamic adapter pair collapses to one adapter: the eager
 path runs the dygraph step; to_static on the network gives the compiled
 path with the same code.
 """
+import os
+
 import numpy as np
 
 from ..core.tensor import Tensor
@@ -91,7 +93,18 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, resume=False):
+        """Train. With ``save_dir`` the loop is preemption-safe: each
+        epoch end atomically writes a ``resume`` snapshot +
+        ``fit_state.json``, SIGTERM/SIGINT (resilience.preemption) stops
+        at the next batch boundary leaving a resumable marker, and
+        ``resume=True`` restarts from the last completed epoch —
+        interrupted epochs replay from their boundary snapshot, so a
+        resumed run matches an uninterrupted one wherever the per-epoch
+        data order is deterministic."""
+        from ..resilience import chaos, preemption
+        from ..resilience.checkpoint import atomic_write_json
+
         train_loader = _as_loader(train_data, batch_size, shuffle, drop_last,
                                   num_workers)
         eval_loader = _as_loader(eval_data, batch_size, False, False, num_workers) \
@@ -100,31 +113,133 @@ class Model:
             callbacks, model=self, epochs=epochs, steps=_safe_len(train_loader),
             log_freq=log_freq, save_freq=save_freq, save_dir=save_dir,
             verbose=verbose, metrics=self._metrics_names())
-        cbks.on_begin("train")
-        for epoch in range(epochs):
-            if self.stop_training:
-                break
-            cbks.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            logs = {}
-            for step, batch in enumerate(train_loader):
-                if num_iters is not None and step >= num_iters:
-                    break
-                cbks.on_batch_begin("train", step, logs)
-                ins, lbls = _split_batch(batch)
-                result = self.train_batch(ins, lbls)
-                logs = self._make_logs(result, step)
-                cbks.on_batch_end("train", step, logs)
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self._run_eval(eval_loader)
-                logs.update({f"val_{k}": v for k, v in eval_logs.items()})
-            cbks.on_epoch_end(epoch, logs)
-            if save_dir and (epoch + 1) % save_freq == 0:
-                self.save(f"{save_dir}/{epoch}")
-        cbks.on_end("train")
+        self.stop_training = False  # a prior preempted/early-stopped fit
+        # must not make this one a no-op
+        start_epoch = 0
+        handler = None
+        uninstall_after = False
         if save_dir:
+            if resume:
+                start_epoch = self._load_fit_state(save_dir)
+                preemption.clear_resume_marker(save_dir)
+            # SIGTERM only — the cluster's preemption signal; SIGINT
+            # keeps raising KeyboardInterrupt for interactive users
+            import signal as signal_mod
+
+            handler = preemption.get_preemption_handler()
+            uninstall_after = not handler._installed
+            handler.install(signals=(signal_mod.SIGTERM,))
+            if resume:
+                # this fit IS the post-preemption restart; a still-set
+                # flag would re-preempt it on the first batch
+                handler.clear()
+        cbks.on_begin("train")
+        preempted_run = False
+        try:
+            for epoch in range(start_epoch, epochs):
+                if self.stop_training:
+                    break
+                cbks.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                logs = {}
+                preempted = False
+                for step, batch in enumerate(train_loader):
+                    if num_iters is not None and step >= num_iters:
+                        break
+                    chaos.hit("train.step")
+                    cbks.on_batch_begin("train", step, logs)
+                    ins, lbls = _split_batch(batch)
+                    result = self.train_batch(ins, lbls)
+                    logs = self._make_logs(result, step)
+                    cbks.on_batch_end("train", step, logs)
+                    if handler is not None and handler.requested:
+                        # exit at the batch boundary: the last epoch-end
+                        # snapshot is the resume point (replaying the
+                        # interrupted epoch keeps resume bit-identical
+                        # to an uninterrupted run)
+                        preempted = True
+                        break
+                if preempted:
+                    preemption.write_resume_marker(save_dir, step=epoch)
+                    self.stop_training = True
+                    preempted_run = True
+                    # the request is now fully handled (marker on disk);
+                    # leaving the flag set would instantly "re-preempt"
+                    # any later fit in a driver that chooses to continue
+                    handler.clear()
+                    break
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    eval_logs = self._run_eval(eval_loader)
+                    logs.update({f"val_{k}": v
+                                 for k, v in eval_logs.items()})
+                cbks.on_epoch_end(epoch, logs)
+                if save_dir:
+                    # epoch snapshot, THEN fit_state referencing it:
+                    # fit_state (written last, atomically) can only ever
+                    # name a complete params+opt pair, so a crash
+                    # between any of these writes resumes from the
+                    # previous consistent snapshot instead of mixing
+                    # epoch-N weights with a next_epoch=N replay. When
+                    # the numbered save already ran this epoch, reuse it
+                    # rather than writing the same state twice.
+                    if (epoch + 1) % save_freq == 0:
+                        snap = str(epoch)
+                        self.save(f"{save_dir}/{snap}")
+                    else:
+                        snap = f"resume-{epoch}"
+                        self.save(f"{save_dir}/{snap}")
+                    atomic_write_json(f"{save_dir}/fit_state.json",
+                                      {"next_epoch": epoch + 1,
+                                       "snapshot": snap})
+                    self._gc_resume_snapshots(save_dir, keep=snap)
+                if handler is not None and handler.requested:
+                    # signal landed during eval/epoch-end/saves: the
+                    # epoch snapshot above is the resume point — honor
+                    # the request here instead of silently finishing
+                    preemption.write_resume_marker(save_dir, step=epoch)
+                    self.stop_training = True
+                    preempted_run = True
+                    handler.clear()
+                    break
+        finally:
+            if handler is not None and uninstall_after:
+                # restore default signal disposition: a SIGTERM after
+                # fit returns must terminate the process, not set a
+                # dead flag — and a flag fit never consumed must not
+                # leak into a later fit as a bogus instant preemption
+                handler.clear()
+                handler.uninstall()
+        cbks.on_end("train")
+        if save_dir and not preempted_run:
             self.save(f"{save_dir}/final")
+
+    def _load_fit_state(self, save_dir):
+        """-> epoch to resume from; loads the snapshot fit_state.json
+        names (fit_state is written last, so the pair it references is
+        always complete)."""
+        import json
+
+        try:
+            with open(f"{save_dir}/fit_state.json") as f:
+                state = json.load(f)
+        except (OSError, json.JSONDecodeError, ValueError):
+            return 0
+        next_epoch = int(state.get("next_epoch", 0))
+        snap = state.get("snapshot", "resume")
+        if next_epoch > 0 and os.path.exists(f"{save_dir}/{snap}.pdparams"):
+            self.load(f"{save_dir}/{snap}")
+        return next_epoch
+
+    @staticmethod
+    def _gc_resume_snapshots(save_dir, keep):
+        for fn in os.listdir(save_dir):
+            stem = fn.rsplit(".", 1)[0]
+            if stem.startswith("resume") and stem != keep:
+                try:
+                    os.remove(os.path.join(save_dir, fn))
+                except OSError:
+                    pass
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_iters=None):

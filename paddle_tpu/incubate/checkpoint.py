@@ -1,10 +1,41 @@
 """Auto-checkpoint (reference: python/paddle/fluid/incubate/checkpoint/
 auto_checkpoint.py:71 AutoCheckpointChecker — epoch-granular train-state
 snapshots to a shared FS for preemptible-cluster resume).
+
+Resilience semantics (paddle_tpu.resilience):
+- every write is atomic (tmp + os.replace): a crash mid-save never
+  corrupts the resume state;
+- ``meta.json`` keeps a one-generation backup (``meta.json.bak``); a
+  corrupt/truncated meta falls back to the backup, and failing that the
+  range restarts cleanly instead of crashing;
+- SIGTERM/SIGINT preemption is honored at the epoch boundary: the
+  epoch's snapshot is saved, a resumable marker is written, and the
+  process exits 143 (128+SIGTERM) so the scheduler reschedules; the
+  restarted range resumes from the recorded epoch.
 """
 import json
 import os
 import time
+import warnings
+
+from ..resilience import chaos, preemption
+from ..resilience.checkpoint import atomic_write_json
+
+
+def _load_meta(meta_path):
+    """-> meta dict from meta.json, falling back to meta.json.bak; None
+    when neither is usable (fresh start)."""
+    for path in (meta_path, meta_path + ".bak"):
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            continue
+        except (OSError, json.JSONDecodeError, ValueError) as e:
+            warnings.warn(
+                f"auto_checkpoint: {path} unreadable ({e}); "
+                f"falling back to the last good snapshot")
+    return None
 
 
 class TrainEpochRange:
@@ -21,10 +52,9 @@ class TrainEpochRange:
         self._optimizer = optimizer
         self._meta_path = os.path.join(self.save_dir, "meta.json")
         self._start = 0
-        if os.path.exists(self._meta_path):
-            with open(self._meta_path) as f:
-                meta = json.load(f)
-            self._start = meta.get("next_epoch", 0)
+        meta = _load_meta(self._meta_path)
+        if meta is not None:
+            self._start = int(meta.get("next_epoch", 0))
             ckpt = os.path.join(self.save_dir, "ckpt")
             if self._model is not None and os.path.exists(ckpt + ".pdparams"):
                 from .. import framework
@@ -32,23 +62,64 @@ class TrainEpochRange:
                 self._model.set_state_dict(framework.load(ckpt + ".pdparams"))
                 if self._optimizer is not None and os.path.exists(ckpt + ".pdopt"):
                     self._optimizer.set_state_dict(framework.load(ckpt + ".pdopt"))
+        # a previous incarnation's preemption marker means this restart
+        # IS the resume — consume it so a clean finish leaves no marker
+        if preemption.read_resume_marker(self.save_dir) is not None:
+            preemption.clear_resume_marker(self.save_dir)
 
     def __iter__(self):
-        for epoch in range(self._start, self.max_epoch_num):
-            yield epoch
-            self._save(epoch)
+        import signal as signal_mod
+
+        # SIGTERM only (the scheduler's preemption signal); SIGINT
+        # stays a hard KeyboardInterrupt for interactive runs
+        handler = preemption.get_preemption_handler()
+        uninstall_after = not handler._installed
+        handler.install(signals=(signal_mod.SIGTERM,))
+        try:
+            for epoch in range(self._start, self.max_epoch_num):
+                chaos.hit("train.epoch")
+                yield epoch
+                self._save(epoch)
+                if handler.requested:
+                    # save-and-exit at the epoch boundary: snapshot is
+                    # on disk, marker makes the restart resumable, 143
+                    # tells the scheduler this was a graceful preemption
+                    preemption.write_resume_marker(
+                        self.save_dir, step=epoch + 1,
+                        extra={"name": self.name})
+                    handler.clear()  # handled; a driver catching the
+                    # exit and re-entering must not loop forever
+                    raise preemption.PreemptedExit(step=epoch + 1)
+        finally:
+            if uninstall_after:
+                # SIGTERM outside the range must kill the process again
+                handler.uninstall()
 
     def _save(self, epoch):
         os.makedirs(self.save_dir, exist_ok=True)
+        chaos.hit("autockpt.save")
         ckpt = os.path.join(self.save_dir, "ckpt")
         if self._model is not None:
-            from .. import framework
+            from .. import framework  # framework.save is atomic
 
             framework.save(self._model.state_dict(), ckpt + ".pdparams")
             if self._optimizer is not None:
                 framework.save(self._optimizer.state_dict(), ckpt + ".pdopt")
-        with open(self._meta_path, "w") as f:
-            json.dump({"next_epoch": epoch + 1, "ts": time.time()}, f)
+        # keep the previous good meta as .bak before publishing the new
+        # one — both writes atomic, so every crash point leaves at least
+        # one parseable meta on disk
+        if os.path.exists(self._meta_path):
+            try:
+                with open(self._meta_path, "rb") as f:
+                    old = f.read()
+                json.loads(old)  # only back up a *good* meta
+                from ..resilience.checkpoint import atomic_write_bytes
+
+                atomic_write_bytes(self._meta_path + ".bak", old)
+            except (OSError, json.JSONDecodeError, ValueError):
+                pass
+        atomic_write_json(self._meta_path,
+                          {"next_epoch": epoch + 1, "ts": time.time()})
 
 
 class auto_checkpoint:

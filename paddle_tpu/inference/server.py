@@ -15,6 +15,7 @@ wire format (little-endian):
         7 stop
   response: u32 body_len | u8 status | (cmd 1: same per-output encoding)
 """
+import os
 import socket
 import struct
 import threading
@@ -24,15 +25,31 @@ import numpy as np
 _DTYPES = {0: np.float32, 1: np.int32}
 _DTYPE_CODES = {np.dtype(np.float32): 0, np.dtype(np.int32): 1}
 
+# Hardening knobs: a 4-byte length prefix from a buggy/malicious client
+# must not trigger an unbounded allocation, and a stalled client must
+# not pin a handler thread forever.
+MAX_BODY_BYTES = int(os.environ.get("PADDLE_TPU_SERVER_MAX_BODY",
+                                    64 * 1024 * 1024))
+RECV_TIMEOUT = float(os.environ.get("PADDLE_TPU_SERVER_RECV_TIMEOUT", 30.0))
+DRAIN_TIMEOUT = float(os.environ.get("PADDLE_TPU_SERVER_DRAIN_TIMEOUT", 10.0))
 
-def _read_all(sock, n):
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+
+class BodyTooLarge(ValueError):
+    pass
+
+
+def _read_all(sock, n, limit=None):
+    if limit is not None and n > limit:
+        raise BodyTooLarge(f"frame of {n} bytes exceeds cap {limit}")
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
         if not chunk:
             raise ConnectionError("peer closed")
-        buf += chunk
-    return buf
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
 
 
 def _encode_arrays(arrays):
@@ -71,14 +88,19 @@ class PredictorServer:
     """Serve `predictor` (an inference.Predictor or any callable taking
     numpy arrays and returning a list of numpy arrays) on a TCP port."""
 
-    def __init__(self, run_fn, port=0, host="127.0.0.1"):
+    def __init__(self, run_fn, port=0, host="127.0.0.1",
+                 max_body=MAX_BODY_BYTES, recv_timeout=RECV_TIMEOUT):
         self._run = run_fn
+        self._max_body = max_body
+        self._recv_timeout = recv_timeout
         self._sock = socket.socket()
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
         self._sock.listen(8)
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
+        self._conns = {}  # thread -> {"conn": socket, "busy": bool}
+        self._conns_lock = threading.Lock()
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
 
@@ -88,22 +110,56 @@ class PredictorServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            threading.Thread(target=self._handle, args=(conn,),
-                             daemon=True).start()
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True)
+            with self._conns_lock:
+                self._conns[t] = {"conn": conn, "busy": False}
+            t.start()
+
+    def _set_busy(self, busy):
+        with self._conns_lock:
+            ent = self._conns.get(threading.current_thread())
+            if ent is not None:
+                ent["busy"] = busy
 
     def _handle(self, conn):
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
             while not self._stop.is_set():
-                (blen,) = struct.unpack("<I", _read_all(conn, 4))
-                body = _read_all(conn, blen)
+                # idle between frames: block without timeout — keep-alive
+                # connections may sit quiet for minutes (stop() unblocks
+                # this recv by closing the socket). Once the first header
+                # byte arrives, a frame is in flight: a peer that stalls
+                # mid-frame times out instead of pinning this thread.
+                conn.settimeout(None)
+                first = conn.recv(1)
+                if not first:
+                    raise ConnectionError("peer closed")
+                conn.settimeout(self._recv_timeout)
+                (blen,) = struct.unpack("<I", first + _read_all(conn, 3))
+                if blen == 0:
+                    # malformed (a body always has at least the cmd
+                    # byte) but the stream is still in sync: report and
+                    # keep serving
+                    conn.sendall(struct.pack("<IB", 1, 1))
+                    continue
+                self._set_busy(True)  # a frame is in flight: drain waits
+                try:
+                    body = _read_all(conn, blen, limit=self._max_body)
+                except BodyTooLarge:
+                    # cap exceeded: error status, then close — the rest
+                    # of the oversized frame is unread, so the stream
+                    # cannot be resynced
+                    conn.sendall(struct.pack("<IB", 1, 1))
+                    return
                 cmd = body[0]
                 if cmd == 7:
                     conn.sendall(struct.pack("<IB", 1, 0))
-                    self.stop()
+                    threading.Thread(target=self.stop, daemon=True).start()
                     return
                 if cmd != 1:
                     conn.sendall(struct.pack("<IB", 1, 1))
+                    self._set_busy(False)
                     continue
                 try:
                     inputs = _decode_arrays(body[1:])
@@ -116,17 +172,51 @@ class PredictorServer:
                     conn.sendall(struct.pack("<IB", 1 + len(enc), 0) + enc)
                 except Exception:  # noqa: BLE001 - protocol error status
                     conn.sendall(struct.pack("<IB", 1, 1))
+                self._set_busy(False)
+        except socket.timeout:
+            pass
         except (ConnectionError, OSError):
             pass
         finally:
             conn.close()
+            with self._conns_lock:
+                self._conns.pop(threading.current_thread(), None)
 
-    def stop(self):
+    def stop(self, drain=True, timeout=DRAIN_TIMEOUT):
+        """Graceful shutdown: stop accepting, let requests that are
+        mid-processing finish (up to `timeout`), force-close idle
+        keep-alive connections — a rolling restart neither drops a
+        response mid-write nor hangs on a silent client."""
+        import time as time_mod
+
         self._stop.set()
         try:
-            self._sock.close()
+            self._sock.close()  # unblocks accept(); no new connections
         except OSError:
             pass
+        if not drain:
+            return
+        me = threading.current_thread()
+        deadline = time_mod.monotonic() + timeout
+        with self._conns_lock:
+            entries = [(t, e) for t, e in self._conns.items() if t is not me]
+        for t, ent in entries:
+            if ent["busy"]:
+                t.join(max(0.0, deadline - time_mod.monotonic()))
+        # whoever is left is idle (blocked waiting for the next frame) or
+        # overran the drain window — unblock by closing the socket
+        with self._conns_lock:
+            leftover = [e["conn"] for t, e in self._conns.items()
+                        if t is not me]
+        for c in leftover:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
 
 def serve_model(path_prefix, port=0):

@@ -17,6 +17,7 @@ import threading
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..resilience.retry import call_with_retry
 from .dataset import IterableDataset
 from .sampler import BatchSampler, SequenceSampler, RandomSampler
 
@@ -91,7 +92,12 @@ def _worker_loop(dataset, index_queue, out_queue, collate_fn, worker_id,
                     break
                 batch_idx, indices = cmd
                 try:
-                    samples = [dataset[i] for i in indices]
+                    # transient I/O from remote-FS-backed datasets gets
+                    # backoff+retry instead of poisoning the batch
+                    samples = [call_with_retry(dataset.__getitem__, i,
+                                               retry_on=(OSError,),
+                                               base_delay=0.05)
+                               for i in indices]
                     out_queue.put((batch_idx, collate_fn(samples)))
                 except Exception as e:  # noqa: BLE001
                     out_queue.put((batch_idx, e))
@@ -173,7 +179,10 @@ class _SingleProcessIter:
 
     def __next__(self):
         indices = next(self.batches)
-        samples = [self.loader.dataset[i] for i in indices]
+        # same transient-I/O retry the multiprocess workers get
+        samples = [call_with_retry(self.loader.dataset.__getitem__, i,
+                                   retry_on=(OSError,), base_delay=0.05)
+                   for i in indices]
         return _to_tensor_tree(self.loader.collate_fn(samples))
 
 

@@ -228,10 +228,21 @@ class Optimizer:
     def set_state_dict(self, state_dict):
         self._step_count = state_dict.get("step_count", 0)
         by_name = {(p.name or f"param_{i}"): p for i, p in enumerate(self._params)}
-        for name, arrs in state_dict.get("accumulators", {}).items():
-            if name in by_name:
-                self._accumulators[id(by_name[name])] = tuple(
+        acc = state_dict.get("accumulators", {})
+        if acc and not any(n in by_name for n in acc) \
+                and len(acc) == len(self._params):
+            # a re-instantiated model gets fresh unique_name suffixes
+            # (linear_1.* vs the saved linear_0.*) — silently dropping
+            # the accumulators breaks checkpoint resume, so fall back to
+            # positional mapping (state_dict preserves param order)
+            for (name, arrs), p in zip(acc.items(), self._params):
+                self._accumulators[id(p)] = tuple(
                     jnp.asarray(a) for a in arrs)
+        else:
+            for name, arrs in acc.items():
+                if name in by_name:
+                    self._accumulators[id(by_name[name])] = tuple(
+                        jnp.asarray(a) for a in arrs)
         if "LR_Scheduler" in state_dict and self._lr_scheduler is not None:
             self._lr_scheduler.set_state_dict(state_dict["LR_Scheduler"])
 

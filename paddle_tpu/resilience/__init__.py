@@ -1,0 +1,42 @@
+"""paddle_tpu.resilience — fault-tolerant training runtime.
+
+Five cooperating pieces (ISSUE: ML Productivity Goodput — delivered
+throughput is dominated by recovery efficiency, not step time):
+
+- checkpoint: atomic, self-verifying checkpoints with retention GC and
+  verified load + fallback (:class:`CheckpointManager`);
+- preemption: SIGTERM/maintenance-event handling — save-and-exit at the
+  next step boundary with a resumable marker;
+- retry: exponential backoff + jitter + deadline for transient I/O and
+  coordination failures;
+- badstep: in-graph NaN/Inf step skipping + consecutive-bad-step
+  rollback policy (:class:`BadStepMonitor`);
+- chaos: deterministic fault injection so all of the above stays
+  covered by tier-1 CPU tests.
+"""
+from . import chaos  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    CheckpointCorrupt,
+    CheckpointManager,
+    atomic_write_bytes,
+    atomic_write_json,
+    file_sha256,
+    leaf_checksums,
+)
+from .preemption import (  # noqa: F401
+    EXIT_CODE as PREEMPTED_EXIT_CODE,
+    PreemptedExit,
+    PreemptionHandler,
+    clear_resume_marker,
+    get_preemption_handler,
+    preemption_requested,
+    read_resume_marker,
+    write_resume_marker,
+)
+from .retry import RetryError, call_with_retry, retry  # noqa: F401
+from .badstep import (  # noqa: F401
+    BadStepMonitor,
+    guard_step,
+    select_tree,
+    tree_nonfinite,
+)

@@ -1,0 +1,120 @@
+"""Bad-step guard: never let a NaN/Inf step poison the parameters.
+
+One non-finite loss or gradient silently corrupts every parameter it
+touches, and the run only "fails" thousands of steps later when someone
+looks at the loss curve. Defense is layered:
+
+1. in-graph (:func:`guard_step`, or ``build_train_step(...,
+   bad_step_guard=True)`` which fuses the same selection inside the
+   compiled step): detect non-finite loss/updates and keep the previous
+   params/opt_state — the step is skipped at zero host cost;
+2. host-side (:class:`BadStepMonitor`): count *consecutive* bad steps;
+   past a threshold skipping is no longer enough (the state itself or
+   the data stream is bad) — roll back to the last good checkpoint via
+   a `resilience.checkpoint.CheckpointManager`.
+
+This composes with `amp.GradScaler`: the scaler already skips updates
+on overflow and re-scales; attach a monitor
+(``scaler.attach_bad_step_monitor``) and its overflow skips feed the
+same consecutive-bad-step accounting (see MIGRATION.md).
+"""
+import jax
+import jax.numpy as jnp
+
+OK = "ok"
+SKIP = "skipped"
+ROLLBACK = "rollback"
+
+
+def tree_nonfinite(tree):
+    """Scalar bool array: any non-finite value in any floating leaf."""
+    bad = jnp.asarray(False)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if jnp.issubdtype(jnp.result_type(leaf), jnp.inexact):
+            bad = bad | ~jnp.all(jnp.isfinite(leaf))
+    return bad
+
+
+def select_tree(bad, on_bad, on_good):
+    """Per-leaf jnp.where(bad, on_bad, on_good) — the branchless skip
+    that XLA compiles instead of a host round-trip."""
+    return jax.tree_util.tree_map(
+        lambda b, g: jnp.where(bad, b, g), on_bad, on_good)
+
+
+def guard_step(step_fn):
+    """Wrap a functional train step so bad steps become no-ops.
+
+    step_fn(params, opt_state, *rest) -> (loss, new_params, new_opt).
+    Returns guarded(params, opt_state, *rest) ->
+    (loss, params', opt_state', bad) where bad is a scalar bool array
+    and params'/opt_state' equal the INPUTS when bad.
+
+    The wrapper is pure jnp, so ``jax.jit(guard_step(step))`` keeps the
+    whole guard on-device. Do not apply it around an already-jitted
+    step that donates its inputs — the guard needs the old state alive
+    (use ``build_train_step(bad_step_guard=True)`` there, which selects
+    before donation is visible).
+    """
+
+    def guarded(params, opt_state, *rest):
+        loss, new_params, new_opt = step_fn(params, opt_state, *rest)
+        bad = tree_nonfinite(loss) | tree_nonfinite(new_params)
+        return (loss,
+                select_tree(bad, params, new_params),
+                select_tree(bad, opt_state, new_opt),
+                bad)
+
+    return guarded
+
+
+class BadStepMonitor:
+    """Consecutive-bad-step accounting + checkpoint rollback policy.
+
+    record(bad) -> OK | SKIP | ROLLBACK. After `threshold` consecutive
+    bad steps it returns ROLLBACK (and resets the streak); the caller
+    restores state — via :meth:`restore` when a manager is attached,
+    and `on_rollback` fires for custom recovery (reload data pipeline,
+    lower LR, page an operator...).
+    """
+
+    def __init__(self, threshold=3, manager=None, on_rollback=None):
+        if threshold < 1:
+            raise ValueError(f"threshold must be >= 1, got {threshold}")
+        self.threshold = threshold
+        self.manager = manager
+        self.on_rollback = on_rollback
+        self.consecutive = 0
+        self.total_bad = 0
+        self.rollbacks = 0
+
+    def record(self, bad):
+        if not bool(bad):
+            self.consecutive = 0
+            return OK
+        self.total_bad += 1
+        self.consecutive += 1
+        if self.consecutive >= self.threshold:
+            self.consecutive = 0
+            self.rollbacks += 1
+            if self.on_rollback is not None:
+                self.on_rollback()
+            return ROLLBACK
+        return SKIP
+
+    def restore(self):
+        """-> (state, step) from the attached manager's last good
+        checkpoint (verified, with fallback)."""
+        if self.manager is None:
+            raise RuntimeError("BadStepMonitor has no CheckpointManager "
+                               "attached; pass manager= to restore")
+        state, step = self.manager.load()
+        if state is None:
+            raise RuntimeError(
+                f"rollback requested but no usable checkpoint under "
+                f"{self.manager.root}")
+        return state, step
+
+    def state_dict(self):
+        return {"consecutive": self.consecutive, "total_bad": self.total_bad,
+                "rollbacks": self.rollbacks}

@@ -1,0 +1,356 @@
+"""Atomic, self-verifying checkpoints.
+
+A checkpoint either exists completely or not at all: all files are
+written into a hidden temp directory, fsynced, then published with one
+``os.replace`` — a crash at any point leaves the previous checkpoint
+untouched (the temp dir is garbage-collected on the next save). Each
+checkpoint carries a ``MANIFEST.json`` with the step number and
+per-file SHA-256 digests (plus per-leaf array checksums for the default
+pickle payload), and loads verify the manifest before trusting the
+payload, falling back to the previous good checkpoint on corruption.
+
+On-disk layout (documented in README "Resilience"):
+
+    <root>/
+      LATEST                # text: name of the newest published ckpt
+      ckpt-<step>/
+        MANIFEST.json       # {"format":1,"step":N,"ts":...,"files":{...},
+                            #  "leaves":{...}}
+        state.pdparams      # default payload (framework.save pickle)
+      .tmp-ckpt-<step>-<pid>/   # in-flight save; never read
+
+The payload is pluggable (``writer``/``reader``) so the same manager
+fronts the orbax/TensorStore sharded path
+(`distributed.checkpoint.sharded_checkpoint_manager`) and the plain
+pickle path. Single-writer-per-root is assumed (one trainer process
+saves; any number may read).
+"""
+import hashlib
+import json
+import os
+import shutil
+import time
+import warnings
+
+import numpy as np
+
+from . import chaos
+from .retry import call_with_retry
+
+MANIFEST_NAME = "MANIFEST.json"
+LATEST_NAME = "LATEST"
+FORMAT_VERSION = 1
+
+
+class CheckpointCorrupt(RuntimeError):
+    """Manifest missing/unreadable or a payload file fails verification."""
+
+
+# --------------------------------------------------------------- primitives
+
+def _fsync_dir(path):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path, data):
+    """Write bytes so readers see the old content or the new, never a
+    truncated mix (tmp in the same dir + fsync + os.replace)."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(d)
+    return path
+
+
+def atomic_write_json(path, obj):
+    return atomic_write_bytes(
+        path, json.dumps(obj, sort_keys=True).encode("utf-8"))
+
+
+def file_sha256(path, chunk=1 << 20):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(chunk), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _leaf_bytes(leaf):
+    v = getattr(leaf, "_value", leaf)  # Tensor -> backing array
+    try:
+        arr = np.asarray(v)
+    except Exception:  # noqa: BLE001 — opaque leaf, hash its repr
+        return repr(v).encode("utf-8"), "opaque", ()
+    return np.ascontiguousarray(arr).tobytes(), str(arr.dtype), arr.shape
+
+
+def leaf_checksums(state, prefix=""):
+    """Flatten a nested dict/list/tuple pytree into {dotted.path:
+    {sha256, dtype, shape}} — corruption diagnostics name the exact
+    tensor, not just "the file"."""
+    out = {}
+    if isinstance(state, dict):
+        for k, v in state.items():
+            out.update(leaf_checksums(v, f"{prefix}{k}."))
+    elif isinstance(state, (list, tuple)):
+        for i, v in enumerate(state):
+            out.update(leaf_checksums(v, f"{prefix}{i}."))
+    else:
+        data, dtype, shape = _leaf_bytes(state)
+        out[prefix.rstrip(".") or "<root>"] = {
+            "sha256": hashlib.sha256(data).hexdigest(),
+            "dtype": dtype, "shape": list(shape)}
+    return out
+
+
+def _default_writer(state, ckpt_dir, leaf_manifest=False):
+    from .. import framework
+
+    framework.save(state, os.path.join(ckpt_dir, "state.pdparams"))
+    # leaf hashing walks a full copy of every tensor — integrity is
+    # already guaranteed by the per-file sha256, so per-leaf forensics
+    # (naming the exact corrupted tensor) are opt-in
+    return leaf_checksums(state) if leaf_manifest else None
+
+
+def _default_reader(ckpt_dir):
+    from .. import framework
+
+    return framework.load(os.path.join(ckpt_dir, "state.pdparams"))
+
+
+# ------------------------------------------------------------------ manager
+
+class CheckpointManager:
+    """Atomic save / verified load / retention GC over one directory.
+
+    keep: retention — newest N published checkpoints survive GC (the
+    one LATEST names is always kept).
+    writer(state, dir) -> leaves|None: materialize the payload into dir.
+    reader(dir) -> state: load the payload back.
+    io_retries: transient OSErrors during the payload write are retried
+    with backoff before the save is abandoned.
+    leaf_manifest: also record per-leaf array checksums in the manifest
+    (default writer only) — corruption reports then name the exact
+    tensor, at the cost of hashing every leaf a second time on save.
+    """
+
+    def __init__(self, root, keep=3, prefix="ckpt", writer=None, reader=None,
+                 io_retries=3, leaf_manifest=False):
+        self.root = os.path.abspath(root)
+        self.keep = keep
+        self.prefix = prefix
+        if writer is None:
+            def writer(state, d):
+                return _default_writer(state, d, leaf_manifest)
+        self._writer = writer
+        self._reader = reader or _default_reader
+        self._io_retries = io_retries
+
+    # -------------------------------------------------------------- naming
+    def _name(self, step):
+        return f"{self.prefix}-{step}"
+
+    def _step_of(self, name):
+        tag = f"{self.prefix}-"
+        if not name.startswith(tag):
+            return None
+        try:
+            return int(name[len(tag):])
+        except ValueError:
+            return None
+
+    def all_steps(self):
+        """Published checkpoint steps, ascending."""
+        if not os.path.isdir(self.root):
+            return []
+        steps = [s for n in os.listdir(self.root)
+                 if (s := self._step_of(n)) is not None
+                 and os.path.isdir(os.path.join(self.root, n))]
+        return sorted(steps)
+
+    def path(self, step):
+        return os.path.join(self.root, self._name(step))
+
+    def latest_name(self):
+        try:
+            with open(os.path.join(self.root, LATEST_NAME)) as f:
+                name = f.read().strip()
+            return name or None
+        except OSError:
+            return None
+
+    def latest_step(self):
+        name = self.latest_name()
+        if name is not None:
+            step = self._step_of(name)
+            if step is not None and os.path.isdir(
+                    os.path.join(self.root, name)):
+                return step
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    # ---------------------------------------------------------------- save
+    def save(self, state, step, extra=None):
+        """Publish `state` as checkpoint `step`. Returns the final path.
+
+        Crash-safe at every point: the payload + manifest land in a temp
+        dir, one os.replace publishes, then LATEST flips (also
+        atomically). Transient write errors retry with backoff."""
+        os.makedirs(self.root, exist_ok=True)
+        name = self._name(step)
+        final = os.path.join(self.root, name)
+        tmp = os.path.join(self.root, f".tmp-{name}-{os.getpid()}")
+
+        def _write_payload():
+            if os.path.isdir(tmp):
+                shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp)
+            chaos.hit("checkpoint.write")
+            leaves = self._writer(state, tmp)
+            manifest = {"format": FORMAT_VERSION, "step": int(step),
+                        "ts": time.time(), "files": {}}
+            if leaves:
+                manifest["leaves"] = leaves
+            if extra:
+                manifest["extra"] = extra
+            for dirpath, _, files in os.walk(tmp):
+                for fn in files:
+                    full = os.path.join(dirpath, fn)
+                    rel = os.path.relpath(full, tmp)
+                    manifest["files"][rel] = {
+                        "sha256": file_sha256(full),
+                        "size": os.path.getsize(full)}
+            with open(os.path.join(tmp, MANIFEST_NAME), "w") as f:
+                json.dump(manifest, f, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+
+        try:
+            call_with_retry(_write_payload, retry_on=(OSError,),
+                            max_attempts=self._io_retries, base_delay=0.05)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        chaos.hit("checkpoint.rename")
+        old = None
+        if os.path.isdir(final):  # re-save of the same step: move the
+            # previous copy aside atomically, never delete-then-publish
+            old = os.path.join(self.root, f".old-{name}-{os.getpid()}")
+            if os.path.isdir(old):
+                shutil.rmtree(old, ignore_errors=True)
+            os.replace(final, old)
+        try:
+            os.replace(tmp, final)
+        except BaseException:
+            if old is not None and not os.path.isdir(final):
+                os.replace(old, final)  # publish failed: restore it
+            raise
+        if old is not None:
+            shutil.rmtree(old, ignore_errors=True)
+        _fsync_dir(self.root)
+        chaos.hit("checkpoint.latest")
+        atomic_write_bytes(os.path.join(self.root, LATEST_NAME),
+                           name.encode("utf-8"))
+        self.gc()
+        return final
+
+    # -------------------------------------------------------------- verify
+    def verify(self, ckpt_dir):
+        """Check every payload file against the manifest. Returns the
+        manifest; raises CheckpointCorrupt on any mismatch."""
+        mpath = os.path.join(ckpt_dir, MANIFEST_NAME)
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise CheckpointCorrupt(
+                f"{ckpt_dir}: manifest unreadable: {e}") from e
+        if manifest.get("format") != FORMAT_VERSION:
+            raise CheckpointCorrupt(
+                f"{ckpt_dir}: unknown manifest format "
+                f"{manifest.get('format')!r}")
+        for rel, meta in manifest.get("files", {}).items():
+            full = os.path.join(ckpt_dir, rel)
+            if not os.path.isfile(full):
+                raise CheckpointCorrupt(f"{ckpt_dir}: missing file {rel}")
+            if os.path.getsize(full) != meta["size"]:
+                raise CheckpointCorrupt(
+                    f"{ckpt_dir}: size mismatch for {rel}")
+            if file_sha256(full) != meta["sha256"]:
+                raise CheckpointCorrupt(
+                    f"{ckpt_dir}: checksum mismatch for {rel}")
+        return manifest
+
+    # ---------------------------------------------------------------- load
+    def _candidates(self):
+        names = []
+        latest = self.latest_name()
+        if latest is not None:
+            names.append(latest)
+        for step in reversed(self.all_steps()):
+            n = self._name(step)
+            if n not in names:
+                names.append(n)
+        return names
+
+    def load(self, verify=True):
+        """-> (state, step) from the newest checkpoint that verifies,
+        falling back through older ones; (None, -1) when none usable."""
+        for name in self._candidates():
+            ckpt_dir = os.path.join(self.root, name)
+            if not os.path.isdir(ckpt_dir):
+                continue
+            try:
+                manifest = self.verify(ckpt_dir) if verify else None
+                state = self._reader(ckpt_dir)
+                if manifest is None:
+                    step = self._step_of(name)
+                    step = -1 if step is None else step
+                else:
+                    step = int(manifest["step"])
+                return state, step
+            except Exception as e:  # noqa: BLE001 — fall back past corruption
+                warnings.warn(
+                    f"checkpoint {ckpt_dir} unusable ({e}); "
+                    f"falling back to an older checkpoint")
+        return None, -1
+
+    # ------------------------------------------------------------------ gc
+    def gc(self):
+        """Drop all but the newest `keep` checkpoints and stale temp
+        dirs. The checkpoint LATEST names is never dropped."""
+        if not os.path.isdir(self.root):
+            return
+        steps = self.all_steps()
+        latest = self.latest_name()
+        if self.keep and self.keep > 0:
+            for step in steps[:-self.keep]:
+                name = self._name(step)
+                if name == latest:
+                    continue
+                shutil.rmtree(os.path.join(self.root, name),
+                              ignore_errors=True)
+        for n in os.listdir(self.root):
+            if (n.startswith(".tmp-") and
+                    n != f".tmp-{latest}-{os.getpid()}") or \
+                    n.startswith(".old-"):
+                full = os.path.join(self.root, n)
+                # a crashed writer's leftovers; current-process saves
+                # clean their own tmp before writing
+                if os.path.isdir(full):
+                    shutil.rmtree(full, ignore_errors=True)

@@ -1,0 +1,134 @@
+"""Preemption handling: save-and-exit at the next safe boundary.
+
+Preemptible TPU/GPU clusters deliver SIGTERM (maintenance events,
+spot reclaim, job-queue eviction) with a grace window. Killing a
+trainer mid-step loses the epoch; the right move is: note the request
+in the signal handler (async-signal-safe — just an Event), finish the
+current step/epoch, checkpoint, write a resumable marker, and exit with
+the conventional 128+SIGTERM status so the scheduler reschedules.
+
+Wired into `incubate.checkpoint.TrainEpochRange` and `hapi.Model.fit`;
+tests inject the signal with `resilience.chaos` (signum=SIGTERM).
+"""
+import json
+import os
+import signal
+import threading
+
+MARKER_NAME = "PREEMPTED.json"
+EXIT_CODE = 143  # 128 + SIGTERM — what a scheduler expects from a
+                 # gracefully preempted worker
+
+
+class PreemptedExit(SystemExit):
+    """Raised at a step/epoch boundary after the preemption checkpoint
+    is on disk; carries the conventional exit status."""
+
+    def __init__(self, step=None):
+        super().__init__(EXIT_CODE)
+        self.step = step
+
+
+class PreemptionHandler:
+    """Signal handler that records a preemption request.
+
+    The handler only sets a flag (async-signal-safe); training loops
+    poll `requested` at boundaries and perform the save/exit themselves.
+    install() is idempotent and chains nothing — uninstall() restores
+    the previous handlers.
+    """
+
+    def __init__(self):
+        self._requested = threading.Event()
+        self._prev = {}
+        self._installed = False
+        self.signum = None  # which signal fired (telemetry)
+
+    def install(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        if threading.current_thread() is not threading.main_thread():
+            return self  # signal.signal only works on the main thread
+        for s in signals:
+            if s in self._prev:
+                continue  # idempotent per signal
+            try:
+                self._prev[s] = signal.signal(s, self._on_signal)
+            except (ValueError, OSError):  # non-main thread / exotic env
+                pass
+        self._installed = True
+        return self
+
+    def uninstall(self):
+        for s, prev in self._prev.items():
+            try:
+                signal.signal(s, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev = {}
+        self._installed = False
+
+    def _on_signal(self, signum, frame):
+        self.signum = signum
+        self._requested.set()
+
+    @property
+    def requested(self):
+        return self._requested.is_set()
+
+    def request(self):
+        """Programmatic preemption (tests, cluster agents polling a
+        maintenance-event API instead of a signal)."""
+        self._requested.set()
+
+    def clear(self):
+        self._requested.clear()
+        self.signum = None
+
+
+_handler = None
+_handler_lock = threading.Lock()
+
+
+def get_preemption_handler():
+    global _handler
+    with _handler_lock:
+        if _handler is None:
+            _handler = PreemptionHandler()
+        return _handler
+
+
+def install(signals=(signal.SIGTERM, signal.SIGINT)):
+    return get_preemption_handler().install(signals)
+
+
+def preemption_requested():
+    return _handler is not None and _handler.requested
+
+
+# ----------------------------------------------------------------- markers
+
+def write_resume_marker(save_dir, step=None, extra=None):
+    """Atomically record "this run was preempted after saving at
+    `step`" so the restart knows the checkpoint is resumable (and
+    schedulers/tooling can distinguish preemption from a crash)."""
+    from .checkpoint import atomic_write_json
+
+    payload = {"preempted": True, "step": step}
+    if extra:
+        payload.update(extra)
+    os.makedirs(save_dir, exist_ok=True)
+    return atomic_write_json(os.path.join(save_dir, MARKER_NAME), payload)
+
+
+def read_resume_marker(save_dir):
+    try:
+        with open(os.path.join(save_dir, MARKER_NAME)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def clear_resume_marker(save_dir):
+    try:
+        os.remove(os.path.join(save_dir, MARKER_NAME))
+    except OSError:
+        pass

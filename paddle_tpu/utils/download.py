@@ -4,12 +4,17 @@ caches and raises otherwise."""
 import hashlib
 import os
 
+from ..resilience import chaos
+from ..resilience.retry import retry
+
 WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/hapi/weights")
 
 
+@retry(retry_on=(OSError,), base_delay=0.05)
 def md5check(fullname, md5sum=None):
     if md5sum is None:
         return True
+    chaos.hit("download.md5check")
     md5 = hashlib.md5()
     with open(fullname, "rb") as f:
         for chunk in iter(lambda: f.read(4096), b""):

@@ -1,0 +1,106 @@
+"""hapi ModelCheckpoint: atomic saves, save_best_only/monitor."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.hapi import Model
+from paddle_tpu.hapi.callbacks import ModelCheckpoint
+from paddle_tpu.io.dataset import Dataset
+
+
+class _Toy(Dataset):
+    def __init__(self, n=32):
+        rng = np.random.RandomState(0)
+        self.x = rng.rand(n, 4).astype(np.float32)
+        w = rng.rand(4, 1).astype(np.float32)
+        self.y = (self.x @ w).astype(np.float32)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _fit(tmp_path, cb, epochs=3):
+    paddle.seed(0)
+    net = nn.Linear(4, 1)
+    model = Model(net)
+    model.prepare(optimizer.SGD(0.1, parameters=net.parameters()),
+                  nn.loss.MSELoss())
+    model.fit(_Toy(), batch_size=8, epochs=epochs, verbose=0, shuffle=False,
+              callbacks=[cb])
+    return model
+
+
+class TestSaveBestOnly:
+    def test_keeps_single_best_checkpoint(self, tmp_path):
+        d = str(tmp_path)
+        cb = ModelCheckpoint(save_dir=d, save_best_only=True, monitor="loss")
+        _fit(tmp_path, cb)
+        files = sorted(os.listdir(d))
+        assert "best.pdparams" in files and "best.json" in files
+        # no per-epoch checkpoints in best-only mode
+        assert not any(f.startswith(("0.", "1.", "2.")) for f in files)
+        with open(os.path.join(d, "best.json")) as f:
+            meta = json.load(f)
+        assert meta["monitor"] == "loss" and meta["mode"] == "min"
+        assert cb.best == pytest.approx(meta["value"])
+
+    def test_best_tracks_minimum_loss(self, tmp_path):
+        cb = ModelCheckpoint(save_dir=str(tmp_path), save_best_only=True,
+                             monitor="loss")
+        _fit(tmp_path, cb)
+        # loss decreases over epochs on this toy problem -> best is last
+        assert cb.best_epoch == 2
+
+    def test_no_save_when_metric_missing(self, tmp_path):
+        d = str(tmp_path)
+        cb = ModelCheckpoint(save_dir=d, save_best_only=True,
+                             monitor="val_acc")  # never produced
+        _fit(tmp_path, cb)
+        assert not os.path.exists(os.path.join(d, "best.pdparams"))
+
+    def test_max_mode_for_accuracy_like_monitor(self):
+        cb = ModelCheckpoint(save_dir="x", save_best_only=True,
+                             monitor="val_acc")
+        assert cb.mode == "max"
+        assert cb._is_better(0.9)
+        cb.best = 0.9
+        assert not cb._is_better(0.5)
+        assert cb._is_better(0.95)
+
+    def test_freq_mode_unchanged(self, tmp_path):
+        d = str(tmp_path)
+        cb = ModelCheckpoint(save_dir=d, save_freq=2)
+        _fit(tmp_path, cb)
+        files = sorted(os.listdir(d))
+        assert "1.pdparams" in files  # epochs 1 (and not 0 or 2)
+        assert "0.pdparams" not in files
+
+
+class TestAtomicModelSave:
+    def test_no_tmp_debris_after_save(self, tmp_path):
+        d = str(tmp_path)
+        paddle.seed(0)
+        net = nn.Linear(4, 1)
+        model = Model(net)
+        model.prepare(optimizer.SGD(0.1, parameters=net.parameters()),
+                      nn.loss.MSELoss())
+        model.save(f"{d}/snap")
+        files = sorted(os.listdir(d))
+        assert files == ["snap.pdopt", "snap.pdparams"]
+
+    def test_framework_save_replaces_atomically(self, tmp_path):
+        from paddle_tpu import framework
+
+        p = str(tmp_path / "state.pdparams")
+        framework.save({"a": np.ones(3)}, p)
+        framework.save({"a": np.zeros(3)}, p)
+        out = framework.load(p)
+        np.testing.assert_array_equal(np.asarray(out["a"]), np.zeros(3))
+        assert sorted(os.listdir(tmp_path)) == ["state.pdparams"]

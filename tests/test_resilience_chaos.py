@@ -1,0 +1,108 @@
+"""The chaos harness itself (resilience.chaos): deterministic
+count-based triggering, delay/signal/exception/NaN actions."""
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.resilience import chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+class TestDeterministicTriggering:
+    def test_fires_on_exact_visit(self):
+        chaos.arm("site.a", exc=OSError("boom"), at=3)
+        assert chaos.hit("site.a") == 1
+        assert chaos.hit("site.a") == 2
+        with pytest.raises(OSError, match="boom"):
+            chaos.hit("site.a")
+        assert chaos.hit("site.a") == 4  # window passed
+
+    def test_times_window(self):
+        chaos.arm("w", exc=ValueError, at=2, times=2)
+        chaos.hit("w")
+        for _ in range(2):
+            with pytest.raises(ValueError):
+                chaos.hit("w")
+        chaos.hit("w")
+
+    def test_sites_are_independent(self):
+        chaos.arm("x", exc=OSError, at=1)
+        assert chaos.hit("y") == 1  # unaffected
+        with pytest.raises(OSError):
+            chaos.hit("x")
+
+    def test_replay_is_identical(self):
+        # same arming + same visit sequence -> same firing pattern
+        for _ in range(2):
+            chaos.reset()
+            chaos.arm("r", exc=OSError, at=2)
+            outcomes = []
+            for _ in range(3):
+                try:
+                    chaos.hit("r")
+                    outcomes.append("ok")
+                except OSError:
+                    outcomes.append("raise")
+            assert outcomes == ["ok", "raise", "ok"]
+
+    def test_context_manager_disarms(self):
+        with chaos.fault("cm", exc=OSError):
+            with pytest.raises(OSError):
+                chaos.hit("cm")
+        chaos.hit("cm")  # disarmed
+        assert not chaos.armed("cm")
+
+
+class TestActions:
+    def test_delay_injection(self):
+        chaos.arm("slow", delay=0.05, at=1)
+        t0 = time.monotonic()
+        chaos.hit("slow")
+        assert time.monotonic() - t0 >= 0.05
+        assert ("slow", 1, "delay") in chaos.monkey.log
+
+    def test_signal_injection(self):
+        got = []
+        prev = signal.signal(signal.SIGUSR1, lambda s, f: got.append(s))
+        try:
+            chaos.arm("sig", signum=signal.SIGUSR1, at=1)
+            chaos.hit("sig")
+            assert got == [signal.SIGUSR1]
+        finally:
+            signal.signal(signal.SIGUSR1, prev)
+
+    def test_nan_poisoning(self):
+        chaos.arm("grads", nan=True, at=2)
+        clean = np.ones(4, np.float32)
+        out1 = chaos.poison("grads", clean)
+        np.testing.assert_array_equal(out1, clean)
+        out2 = chaos.poison("grads", clean)
+        assert np.all(np.isnan(out2))
+        np.testing.assert_array_equal(clean, np.ones(4))  # input untouched
+
+    def test_nan_poison_int_array_becomes_float(self):
+        chaos.arm("g", nan=True)
+        out = chaos.poison("g", np.arange(3))
+        assert np.issubdtype(out.dtype, np.floating) and np.all(np.isnan(out))
+
+    def test_exception_type_or_instance(self):
+        chaos.arm("t1", exc=ConnectionError)
+        with pytest.raises(ConnectionError):
+            chaos.hit("t1")
+        chaos.arm("t2", exc=ConnectionResetError("gone"))
+        with pytest.raises(ConnectionResetError, match="gone"):
+            chaos.hit("t2")
+
+    def test_visit_counts_tracked(self):
+        for _ in range(5):
+            chaos.hit("counted")
+        assert chaos.visits("counted") == 5
+        assert chaos.visits("never") == 0

@@ -1,0 +1,178 @@
+"""Atomic self-verifying checkpoints (resilience.checkpoint): atomic
+publish, manifest verification with fallback, retention GC, and
+chaos-injected write crashes."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.resilience import (CheckpointCorrupt, CheckpointManager,
+                                   RetryError, chaos)
+from paddle_tpu.resilience.checkpoint import (LATEST_NAME, MANIFEST_NAME,
+                                              atomic_write_json,
+                                              file_sha256, leaf_checksums)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _state(v):
+    return {"params": {"w": np.full((2, 3), v, np.float32),
+                       "b": np.arange(3, dtype=np.float32)},
+            "step": int(v)}
+
+
+class TestAtomicSave:
+    def test_save_load_roundtrip(self, tmp_path):
+        m = CheckpointManager(tmp_path)
+        m.save(_state(7), 7)
+        state, step = m.load()
+        assert step == 7
+        np.testing.assert_array_equal(
+            np.asarray(state["params"]["w"]._value
+                       if hasattr(state["params"]["w"], "_value")
+                       else state["params"]["w"]),
+            np.full((2, 3), 7, np.float32))
+
+    def test_manifest_has_files_and_leaves(self, tmp_path):
+        m = CheckpointManager(tmp_path, leaf_manifest=True)
+        path = m.save(_state(1), 1)
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            manifest = json.load(f)
+        assert manifest["step"] == 1
+        assert "state.pdparams" in manifest["files"]
+        rec = manifest["files"]["state.pdparams"]
+        full = os.path.join(path, "state.pdparams")
+        assert rec["sha256"] == file_sha256(full)
+        assert rec["size"] == os.path.getsize(full)
+        # per-leaf checksums name the exact tensor
+        assert "params.w" in manifest["leaves"]
+        assert manifest["leaves"]["params.w"]["shape"] == [2, 3]
+
+    def test_leaf_manifest_off_by_default(self, tmp_path):
+        m = CheckpointManager(tmp_path)
+        path = m.save(_state(1), 1)
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            manifest = json.load(f)
+        assert "leaves" not in manifest  # per-file sha256 still guards
+        assert m.load()[1] == 1
+
+    def test_latest_pointer_tracks_newest(self, tmp_path):
+        m = CheckpointManager(tmp_path)
+        for s in (1, 5, 9):
+            m.save(_state(s), s)
+        assert m.latest_step() == 9
+        with open(os.path.join(tmp_path, LATEST_NAME)) as f:
+            assert f.read().strip() == "ckpt-9"
+
+    def test_no_partial_state_visible_after_crash(self, tmp_path):
+        m = CheckpointManager(tmp_path, io_retries=1)
+        m.save(_state(1), 1)
+        with chaos.fault("checkpoint.rename", exc=OSError("killed"),
+                         times=99):
+            with pytest.raises((OSError, RetryError)):
+                m.save(_state(2), 2)
+        # the failed save is invisible: latest still 1, no ckpt-2
+        assert m.latest_step() == 1
+        assert m.all_steps() == [1]
+        state, step = m.load()
+        assert step == 1
+
+
+class TestVerifyFallback:
+    def test_corrupt_payload_falls_back(self, tmp_path):
+        m = CheckpointManager(tmp_path)
+        m.save(_state(1), 1)
+        m.save(_state(2), 2)
+        with open(os.path.join(m.path(2), "state.pdparams"), "wb") as f:
+            f.write(b"bitrot")
+        with pytest.warns(UserWarning, match="falling back"):
+            state, step = m.load()
+        assert step == 1
+
+    def test_corrupt_manifest_falls_back(self, tmp_path):
+        m = CheckpointManager(tmp_path)
+        m.save(_state(1), 1)
+        m.save(_state(2), 2)
+        with open(os.path.join(m.path(2), MANIFEST_NAME), "w") as f:
+            f.write('{"truncated')
+        with pytest.warns(UserWarning):
+            _, step = m.load()
+        assert step == 1
+
+    def test_verify_raises_on_tamper(self, tmp_path):
+        m = CheckpointManager(tmp_path)
+        path = m.save(_state(3), 3)
+        with open(os.path.join(path, "state.pdparams"), "ab") as f:
+            f.write(b"x")
+        with pytest.raises(CheckpointCorrupt):
+            m.verify(path)
+
+    def test_all_corrupt_returns_none(self, tmp_path):
+        m = CheckpointManager(tmp_path)
+        m.save(_state(1), 1)
+        with open(os.path.join(m.path(1), "state.pdparams"), "wb") as f:
+            f.write(b"")
+        with pytest.warns(UserWarning):
+            state, step = m.load()
+        assert state is None and step == -1
+
+    def test_empty_dir_loads_none(self, tmp_path):
+        state, step = CheckpointManager(tmp_path).load()
+        assert state is None and step == -1
+
+
+class TestRetentionGC:
+    def test_keeps_newest_n(self, tmp_path):
+        m = CheckpointManager(tmp_path, keep=2)
+        for s in range(1, 6):
+            m.save(_state(s), s)
+        assert m.all_steps() == [4, 5]
+
+    def test_stale_tmp_dirs_cleaned(self, tmp_path):
+        m = CheckpointManager(tmp_path)
+        stale = os.path.join(tmp_path, ".tmp-ckpt-99-12345")
+        os.makedirs(stale)
+        m.save(_state(1), 1)
+        assert not os.path.exists(stale)
+
+
+class TestChaosInjectedWrites:
+    @pytest.mark.chaos
+    def test_transient_write_error_retries_and_succeeds(self, tmp_path):
+        m = CheckpointManager(tmp_path, io_retries=3)
+        with chaos.fault("checkpoint.write", exc=OSError("EIO"), at=1):
+            m.save(_state(4), 4)  # 1st attempt fails, retry lands it
+        state, step = m.load()
+        assert step == 4
+
+    @pytest.mark.chaos
+    def test_persistent_write_error_leaves_previous_good(self, tmp_path):
+        m = CheckpointManager(tmp_path, io_retries=2)
+        m.save(_state(1), 1)
+        with chaos.fault("checkpoint.write", exc=OSError("EIO"), times=99):
+            with pytest.raises(RetryError):
+                m.save(_state(2), 2)
+        assert [n for n in os.listdir(tmp_path)
+                if n.startswith(".tmp")] == []
+        state, step = m.load()
+        assert step == 1
+
+
+class TestLeafChecksums:
+    def test_distinct_leaves_distinct_hashes(self):
+        sums = leaf_checksums({"a": np.zeros(3), "b": np.ones(3)})
+        assert sums["a"]["sha256"] != sums["b"]["sha256"]
+
+    def test_atomic_write_json_replaces(self, tmp_path):
+        p = os.path.join(tmp_path, "m.json")
+        atomic_write_json(p, {"v": 1})
+        atomic_write_json(p, {"v": 2})
+        with open(p) as f:
+            assert json.load(f)["v"] == 2
+        assert [n for n in os.listdir(tmp_path) if "tmp" in n] == []

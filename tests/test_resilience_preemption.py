@@ -1,0 +1,244 @@
+"""Preemption handling: signal -> save-and-exit at the next boundary ->
+resumable marker -> restart matches an uninterrupted run bit-for-bit.
+
+The end-to-end test chaos-kills a real training process mid-epoch with
+an injected SIGTERM (resilience.chaos signum injection), restarts it,
+and asserts params/opt_state/epoch equal an uninterrupted run's.
+"""
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.resilience import chaos, preemption
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    chaos.reset()
+    h = preemption.get_preemption_handler()
+    h.clear()
+    yield
+    chaos.reset()
+    h.clear()
+    h.uninstall()
+
+
+class TestHandler:
+    def test_sigterm_sets_flag_without_killing(self):
+        h = preemption.get_preemption_handler()
+        h.install(signals=(signal.SIGTERM,))
+        assert not h.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert h.requested
+        assert h.signum == signal.SIGTERM
+
+    def test_chaos_signal_injection_route(self):
+        h = preemption.get_preemption_handler()
+        h.install(signals=(signal.SIGTERM,))
+        chaos.arm("train.step", signum=signal.SIGTERM, at=3)
+        for _ in range(2):
+            chaos.hit("train.step")
+        assert not h.requested
+        chaos.hit("train.step")
+        assert h.requested
+
+    def test_install_idempotent_and_uninstall_restores(self):
+        h = preemption.get_preemption_handler()
+        before = signal.getsignal(signal.SIGTERM)
+        h.install(signals=(signal.SIGTERM,))
+        h.install(signals=(signal.SIGTERM,))
+        h.uninstall()
+        assert signal.getsignal(signal.SIGTERM) is before
+
+    def test_marker_roundtrip(self, tmp_path):
+        d = str(tmp_path)
+        assert preemption.read_resume_marker(d) is None
+        preemption.write_resume_marker(d, step=12, extra={"name": "run"})
+        m = preemption.read_resume_marker(d)
+        assert m["preempted"] and m["step"] == 12 and m["name"] == "run"
+        preemption.clear_resume_marker(d)
+        assert preemption.read_resume_marker(d) is None
+
+
+class TestTrainEpochRangePreemption:
+    def test_epoch_boundary_save_and_exit(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.incubate.checkpoint import auto_checkpoint
+
+        d = str(tmp_path)
+        paddle.seed(0)
+        net = nn.Linear(3, 1)
+        opt = optimizer.SGD(0.1, parameters=net.parameters())
+        seen = []
+        with pytest.raises(SystemExit) as ei:
+            for epoch in auto_checkpoint.train_epoch_range(
+                    5, save_dir=d, model=net, optimizer=opt):
+                seen.append(epoch)
+                if epoch == 1:
+                    preemption.get_preemption_handler().request()
+        assert ei.value.code == preemption.EXIT_CODE == 143
+        assert seen == [0, 1]  # exited at the boundary after epoch 1
+        marker = preemption.read_resume_marker(d)
+        assert marker and marker["step"] == 2
+        # snapshot + meta for epoch 1 are on disk
+        assert os.path.exists(os.path.join(d, "ckpt.pdparams"))
+        # restart resumes from epoch 2 and consumes the marker
+        preemption.get_preemption_handler().clear()
+        r2 = auto_checkpoint.train_epoch_range(5, save_dir=d, model=net,
+                                               optimizer=opt)
+        assert r2._start == 2
+        assert preemption.read_resume_marker(d) is None
+
+    def test_corrupt_meta_restarts_from_backup(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.incubate.checkpoint import auto_checkpoint
+
+        d = str(tmp_path)
+        paddle.seed(0)
+        net = nn.Linear(3, 1)
+        for _ in auto_checkpoint.train_epoch_range(2, save_dir=d, model=net):
+            pass
+        # truncate meta.json mid-write (legacy non-atomic writer crash)
+        with open(os.path.join(d, "meta.json"), "w") as f:
+            f.write('{"next_ep')
+        with pytest.warns(UserWarning, match="last good snapshot"):
+            r = auto_checkpoint.train_epoch_range(4, save_dir=d, model=net)
+        assert r._start == 1  # meta.json.bak recorded epoch 0 done
+
+    def test_both_metas_gone_starts_clean(self, tmp_path):
+        from paddle_tpu.incubate.checkpoint import auto_checkpoint
+
+        d = str(tmp_path)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "meta.json"), "w") as f:
+            f.write("garbage")
+        with pytest.warns(UserWarning):
+            r = auto_checkpoint.train_epoch_range(3, save_dir=d)
+        assert r._start == 0
+
+
+class TestModelFitPreemption:
+    def _fit(self, d, epochs, preempt_at_epoch=None, resume=False):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.io.dataset import Dataset
+
+        rng = np.random.RandomState(0)
+        X = rng.rand(32, 4).astype(np.float32)
+        Y = (X @ rng.rand(4, 1).astype(np.float32)).astype(np.float32)
+        h = preemption.get_preemption_handler()
+
+        class DS(Dataset):
+            def __getitem__(self, i):
+                return X[i], Y[i]
+
+            def __len__(self):
+                return 32
+
+        from paddle_tpu.hapi.callbacks import Callback
+
+        class Preempter(Callback):
+            def on_epoch_begin(self, epoch, logs=None):
+                if preempt_at_epoch is not None and epoch == preempt_at_epoch:
+                    h.request()  # mid-epoch maintenance event
+
+        paddle.seed(7)
+        net = nn.Linear(4, 1)
+        m = Model(net)
+        m.prepare(optimizer.Momentum(0.05, parameters=net.parameters()),
+                  nn.loss.MSELoss())
+        m.fit(DS(), batch_size=8, epochs=epochs, verbose=0, save_dir=d,
+              shuffle=False, resume=resume, callbacks=[Preempter()])
+        return np.asarray(net.parameters()[0]._value)
+
+    def test_preempt_resume_matches_uninterrupted(self, tmp_path):
+        d_pre = str(tmp_path / "pre")
+        d_ref = str(tmp_path / "ref")
+        # run 1: preempted during epoch 2 -> stops, marker written
+        self._fit(d_pre, epochs=4, preempt_at_epoch=2)
+        assert preemption.read_resume_marker(d_pre) is not None
+        assert not os.path.exists(os.path.join(d_pre, "final.pdparams"))
+        # run 2: resume -> replays epoch 2+3 from the epoch-1 snapshot
+        preemption.get_preemption_handler().clear()
+        w_resumed = self._fit(d_pre, epochs=4, resume=True)
+        assert preemption.read_resume_marker(d_pre) is None
+        # reference: one uninterrupted run
+        preemption.get_preemption_handler().clear()
+        w_ref = self._fit(d_ref, epochs=4)
+        np.testing.assert_array_equal(w_resumed, w_ref)
+
+
+TRAIN_SCRIPT = r"""
+import os, sys, signal
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {repo!r})
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.incubate.checkpoint import auto_checkpoint
+from paddle_tpu.resilience import chaos
+
+save_dir, kill_at = sys.argv[1], int(sys.argv[2])
+if kill_at:
+    chaos.arm("train.step", signum=signal.SIGTERM, at=kill_at)
+paddle.seed(0)
+net = nn.Linear(4, 2)
+opt = optimizer.Momentum(0.1, momentum=0.9, parameters=net.parameters())
+for epoch in auto_checkpoint.train_epoch_range(
+        3, save_dir=save_dir, model=net, optimizer=opt):
+    rng = np.random.RandomState(100 + epoch)  # deterministic per epoch
+    for step in range(4):
+        chaos.hit("train.step")
+        x = paddle.to_tensor(rng.rand(8, 4).astype(np.float32))
+        y = paddle.to_tensor(rng.rand(8, 2).astype(np.float32))
+        opt.clear_grad()
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+np.save(os.path.join(save_dir, "final_w.npy"),
+        np.asarray(net.parameters()[0]._value))
+opt_state = opt.state_dict()
+np.save(os.path.join(save_dir, "final_epoch.npy"), np.asarray(3))
+"""
+
+
+@pytest.mark.chaos
+class TestEndToEndChaosKill:
+    """Chaos-kill a real training process mid-epoch, restart, compare
+    bit-for-bit with an uninterrupted run (acceptance criterion)."""
+
+    def _run(self, d, kill_at):
+        script = TRAIN_SCRIPT.format(repo=REPO)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.run([sys.executable, "-c", script, d,
+                               str(kill_at)],
+                              capture_output=True, text=True, env=env,
+                              timeout=300)
+
+    def test_sigterm_midepoch_resume_bitexact(self, tmp_path):
+        d_chaos = str(tmp_path / "chaos")
+        d_ref = str(tmp_path / "ref")
+        # SIGTERM on the 6th train step = epoch 1, step 1 (mid-epoch)
+        p1 = self._run(d_chaos, kill_at=6)
+        assert p1.returncode == 143, (p1.stdout, p1.stderr)
+        assert not os.path.exists(os.path.join(d_chaos, "final_w.npy"))
+        marker = preemption.read_resume_marker(d_chaos)
+        assert marker and marker["preempted"] and marker["step"] == 2
+        # restart: resumes from epoch 2, runs to completion
+        p2 = self._run(d_chaos, kill_at=0)
+        assert p2.returncode == 0, (p2.stdout, p2.stderr)
+        # uninterrupted reference
+        p3 = self._run(d_ref, kill_at=0)
+        assert p3.returncode == 0, (p3.stdout, p3.stderr)
+        w_chaos = np.load(os.path.join(d_chaos, "final_w.npy"))
+        w_ref = np.load(os.path.join(d_ref, "final_w.npy"))
+        np.testing.assert_array_equal(w_chaos, w_ref)  # bit-for-bit

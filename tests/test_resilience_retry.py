@@ -1,0 +1,205 @@
+"""retry/backoff (resilience.retry) + the call sites that wear it
+(fleet fs, utils.download, dataloader workers)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.resilience import RetryError, call_with_retry, chaos, retry
+from paddle_tpu.resilience.retry import backoff_delays
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+class TestRetryCore:
+    def test_succeeds_after_transient_failures(self):
+        calls = {"n": 0}
+        slept = []
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient")
+            return "ok"
+
+        assert call_with_retry(flaky, max_attempts=5, base_delay=0.01,
+                               sleep=slept.append) == "ok"
+        assert calls["n"] == 3 and len(slept) == 2
+
+    def test_exhaustion_raises_retry_error_with_cause(self):
+        def always():
+            raise ConnectionError("down")
+
+        with pytest.raises(RetryError) as ei:
+            call_with_retry(always, max_attempts=3, base_delay=0,
+                            sleep=lambda s: None)
+        assert ei.value.attempts == 3
+        assert isinstance(ei.value.last, ConnectionError)
+        assert isinstance(ei.value.__cause__, ConnectionError)
+
+    def test_non_retryable_raises_immediately(self):
+        calls = {"n": 0}
+
+        def boom():
+            calls["n"] += 1
+            raise ValueError("logic bug")
+
+        with pytest.raises(ValueError):
+            call_with_retry(boom, max_attempts=5, sleep=lambda s: None)
+        assert calls["n"] == 1
+
+    def test_exponential_backoff_with_cap(self):
+        delays = list(backoff_delays(5, base_delay=1.0, max_delay=4.0,
+                                     jitter=0, rng=lambda: 0.5))
+        assert delays == [1.0, 2.0, 4.0, 4.0]
+
+    def test_jitter_spreads_delays(self):
+        lo = list(backoff_delays(2, 1.0, 30.0, jitter=0.5, rng=lambda: 0.0))
+        hi = list(backoff_delays(2, 1.0, 30.0, jitter=0.5, rng=lambda: 1.0))
+        assert lo[0] == pytest.approx(0.5) and hi[0] == pytest.approx(1.5)
+
+    def test_deadline_enforced(self):
+        def always():
+            raise OSError("slow storage")
+
+        with pytest.raises(RetryError, match="deadline"):
+            call_with_retry(always, max_attempts=100, base_delay=10.0,
+                            jitter=0, deadline=0.5, sleep=lambda s: None)
+
+    def test_decorator_form(self):
+        state = {"n": 0}
+
+        @retry(max_attempts=4, base_delay=0, sleep=lambda s: None)
+        def f(x):
+            state["n"] += 1
+            if state["n"] < 2:
+                raise TimeoutError
+            return x * 2
+
+        assert f(21) == 42
+
+    def test_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_RETRY_MAX_ATTEMPTS", "2")
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise OSError
+
+        with pytest.raises(RetryError):
+            call_with_retry(always, sleep=lambda s: None)
+        assert calls["n"] == 2
+
+    def test_on_retry_observer(self):
+        seen = []
+
+        def flaky():
+            if len(seen) < 1:
+                raise OSError("x")
+            return 1
+
+        call_with_retry(flaky, max_attempts=3, base_delay=0.25, jitter=0,
+                        on_retry=lambda a, e, d: seen.append((a, d)),
+                        sleep=lambda s: None)
+        assert seen == [(1, 0.25)]
+
+
+class TestRetryCallSites:
+    @pytest.mark.chaos
+    def test_fleet_fs_download_retries_injected_io_error(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils.fs import LocalFS
+
+        src = tmp_path / "a.bin"
+        src.write_bytes(b"payload")
+        fs = LocalFS()
+        with chaos.fault("fs.download", exc=OSError("nfs blip"), at=1):
+            fs.download(str(src), str(tmp_path / "b.bin"))
+        assert (tmp_path / "b.bin").read_bytes() == b"payload"
+
+    @pytest.mark.chaos
+    def test_download_md5check_retries(self, tmp_path, monkeypatch):
+        import hashlib
+
+        from paddle_tpu.utils.download import get_path_from_url
+
+        f = tmp_path / "weights.bin"
+        f.write_bytes(b"w" * 64)
+        md5 = hashlib.md5(b"w" * 64).hexdigest()
+        with chaos.fault("download.md5check", exc=OSError("blip"), at=1):
+            assert get_path_from_url(str(f), root_dir=str(tmp_path),
+                                     md5sum=md5) == str(f)
+
+    def test_dataloader_worker_retries_transient_fetch(self):
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.io.dataset import Dataset
+
+        class Flaky(Dataset):
+            def __init__(self):
+                self.failed = set()
+
+            def __getitem__(self, i):
+                # every index fails exactly once before succeeding
+                if i not in self.failed:
+                    self.failed.add(i)
+                    raise OSError(f"transient fetch {i}")
+                return np.float32(i)
+
+            def __len__(self):
+                return 8
+
+        loader = DataLoader(Flaky(), batch_size=4, shuffle=False)
+        batches = [np.asarray(b[0]._value if hasattr(b[0], "_value") else b[0])
+                   if isinstance(b, (list, tuple)) else np.asarray(b._value)
+                   for b in loader]
+        flat = np.concatenate([np.ravel(b) for b in batches])
+        np.testing.assert_array_equal(np.sort(flat), np.arange(8))
+
+
+class TestPermanentErrors:
+    def test_file_not_found_raises_immediately_unwrapped(self):
+        calls = {"n": 0}
+
+        def missing():
+            calls["n"] += 1
+            open("/nonexistent/definitely/not/here")
+
+        with pytest.raises(FileNotFoundError):
+            call_with_retry(missing, max_attempts=5, sleep=lambda s: None)
+        assert calls["n"] == 1  # no retries for ENOENT
+
+    def test_fleet_fs_cat_missing_keeps_oserror_contract(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils.fs import LocalFS
+
+        with pytest.raises(FileNotFoundError):
+            LocalFS().cat(str(tmp_path / "missing.txt"))
+
+    def test_transient_errno_still_retries(self):
+        import errno as errno_mod
+
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 2:
+                raise OSError(errno_mod.EIO, "I/O error")
+            return "ok"
+
+        assert call_with_retry(flaky, max_attempts=3,
+                               sleep=lambda s: None) == "ok"
+        assert calls["n"] == 2
+
+    def test_retry_if_predicate_short_circuits(self):
+        calls = {"n": 0}
+
+        def config_error():
+            calls["n"] += 1
+            raise RuntimeError("jax.distributed.initialize already called")
+
+        with pytest.raises(RuntimeError, match="already called"):
+            call_with_retry(config_error, retry_on=(RuntimeError,),
+                            retry_if=lambda e: "UNAVAILABLE" in str(e),
+                            max_attempts=50, sleep=lambda s: None)
+        assert calls["n"] == 1

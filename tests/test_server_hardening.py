@@ -1,0 +1,157 @@
+"""PredictorServer hardening: body-length cap, recv timeout, graceful
+drain on stop()."""
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.server import PredictorServer, _encode_arrays
+
+
+def _mk_server(run_fn=None, **kw):
+    if run_fn is None:
+        def run_fn(*arrays):
+            return [np.asarray(a) * 2 for a in arrays]
+    return PredictorServer(run_fn, **kw)
+
+
+def _infer_frame(arr):
+    enc = _encode_arrays([arr])
+    return struct.pack("<IB", 1 + len(enc), 1) + enc
+
+
+def _recv_frame(s):
+    hdr = s.recv(4)
+    (n,) = struct.unpack("<I", hdr)
+    body = b""
+    while len(body) < n:
+        chunk = s.recv(n - len(body))
+        if not chunk:
+            break
+        body += chunk
+    return body
+
+
+class TestBodyCap:
+    def test_oversized_prefix_rejected_not_allocated(self):
+        server = _mk_server(max_body=1024)
+        try:
+            s = socket.create_connection(("127.0.0.1", server.port),
+                                         timeout=5)
+            # a malicious 4-byte prefix claiming a ~4GB body: the server
+            # must answer with an error status instead of allocating or
+            # hanging for the bytes that will never come
+            s.sendall(struct.pack("<I", 0xFFFFFFF0))
+            body = _recv_frame(s)
+            assert body[0] == 1  # status=error
+            # and the connection is closed (stream can't be resynced)
+            s.settimeout(5)
+            assert s.recv(16) == b""
+            s.close()
+        finally:
+            server.stop()
+
+    def test_normal_requests_still_served_under_cap(self):
+        server = _mk_server(max_body=1 << 20)
+        try:
+            s = socket.create_connection(("127.0.0.1", server.port),
+                                         timeout=5)
+            x = np.arange(6, dtype=np.float32)
+            s.sendall(_infer_frame(x))
+            body = _recv_frame(s)
+            assert body[0] == 0  # ok
+            s.close()
+        finally:
+            server.stop()
+
+
+class TestRecvTimeout:
+    def test_stalled_body_times_out(self):
+        server = _mk_server(recv_timeout=0.3)
+        try:
+            s = socket.create_connection(("127.0.0.1", server.port),
+                                         timeout=5)
+            # claim an 8-byte body, send only 1 byte, then stall
+            s.sendall(struct.pack("<I", 8) + b"\x01")
+            t0 = time.monotonic()
+            s.settimeout(5)
+            data = s.recv(16)  # server closes after its recv timeout
+            assert data == b""
+            assert time.monotonic() - t0 < 4.0
+            s.close()
+        finally:
+            server.stop()
+
+
+class TestGracefulDrain:
+    def test_inflight_request_completes_during_stop(self):
+        release = threading.Event()
+        started = threading.Event()
+
+        def slow_run(*arrays):
+            started.set()
+            release.wait(5)
+            return [np.asarray(a) + 1 for a in arrays]
+
+        server = _mk_server(slow_run)
+        s = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+        s.sendall(_infer_frame(np.zeros(3, np.float32)))
+        assert started.wait(5)
+        # stop with the request mid-flight; release the handler shortly
+        # after — drain must deliver the response before returning
+        threading.Timer(0.2, release.set).start()
+        server.stop(timeout=5)
+        s.settimeout(5)
+        body = _recv_frame(s)
+        assert body[0] == 0  # response arrived despite stop()
+        s.close()
+
+    def test_idle_connection_does_not_block_stop(self):
+        server = _mk_server()
+        s = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+        time.sleep(0.1)  # handler thread is idle in recv()
+        t0 = time.monotonic()
+        server.stop(timeout=10)
+        assert time.monotonic() - t0 < 5.0  # no 10s drain stall
+        s.close()
+
+    def test_stop_without_drain_returns_fast(self):
+        server = _mk_server()
+        t0 = time.monotonic()
+        server.stop(drain=False)
+        assert time.monotonic() - t0 < 1.0
+
+
+class TestZeroLengthFrame:
+    def test_zero_body_gets_error_and_stream_stays_usable(self):
+        server = _mk_server()
+        try:
+            s = socket.create_connection(("127.0.0.1", server.port),
+                                         timeout=5)
+            s.sendall(struct.pack("<I", 0))  # malformed: no cmd byte
+            body = _recv_frame(s)
+            assert body[0] == 1  # error status, not a dead thread
+            # still in sync: a real request on the same conn works
+            s.sendall(_infer_frame(np.ones(2, np.float32)))
+            assert _recv_frame(s)[0] == 0
+            s.close()
+        finally:
+            server.stop()
+
+
+class TestIdleKeepAlive:
+    def test_idle_connection_survives_past_recv_timeout(self):
+        server = _mk_server(recv_timeout=0.2)
+        try:
+            s = socket.create_connection(("127.0.0.1", server.port),
+                                         timeout=5)
+            time.sleep(0.6)  # idle 3x the recv timeout between frames
+            s.sendall(_infer_frame(np.ones(3, np.float32)))
+            body = _recv_frame(s)
+            assert body[0] == 0  # still served: idle != stalled
+            s.close()
+        finally:
+            server.stop()

@@ -70,3 +70,61 @@ def test_pytest_phase_gates(tmp_path):
     assert r.returncode == 1
     s = _summary(r)
     assert s["lint_ok"] and not s["tests_ok"]
+
+
+def test_suppression_audit_notes_but_allows_outside_clean_paths(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text("x = 1  # tracelint: disable=TPU007\n")
+    r = _run(["--paths", str(f), "--skip-tests"])
+    assert r.returncode == 0
+    s = _summary(r)
+    assert s["suppressions"] == 1 and s["suppression_violations"] == 0
+    assert "suppression (noted)" in r.stdout
+
+
+def test_suppression_in_clean_path_fails_gate(tmp_path):
+    sub = tmp_path / "resilience"
+    sub.mkdir()
+    f = sub / "mod.py"
+    f.write_text("x = 1  # tracelint: disable=TPU007\n")
+    r = _run(["--paths", str(tmp_path), "--skip-tests",
+              "--clean-paths", str(sub)])
+    assert r.returncode == 1
+    s = _summary(r)
+    assert s["suppression_violations"] == 1 and not s["audit_ok"]
+    assert "VIOLATION" in r.stdout
+
+
+def test_resilience_subsystem_is_suppression_free():
+    """The shipped clean-zone policy holds: no inline suppressions under
+    paddle_tpu/resilience (fix findings there, don't silence them)."""
+    r = _run(["--paths", "paddle_tpu/resilience", "--skip-tests"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    s = _summary(r)
+    assert s["suppression_violations"] == 0 and s["lint_errors"] == 0
+
+
+def test_chaos_stage_gates(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text(GOOD_SRC)
+    bad_chaos = tmp_path / "test_chaos_fail.py"
+    bad_chaos.write_text(
+        "import pytest\n"
+        "@pytest.mark.chaos\n"
+        "def test_boom():\n    assert False\n")
+    r = _run(["--paths", str(good), "--skip-tests", "--chaos",
+              "--chaos-args", f"{bad_chaos} -q -m chaos "
+                              f"-p no:cacheprovider"])
+    assert r.returncode == 1
+    s = _summary(r)
+    assert s["chaos_run"] and not s["chaos_ok"]
+    ok_chaos = tmp_path / "test_chaos_ok.py"
+    ok_chaos.write_text(
+        "import pytest\n"
+        "@pytest.mark.chaos\n"
+        "def test_fine():\n    assert True\n")
+    r = _run(["--paths", str(good), "--skip-tests", "--chaos",
+              "--chaos-args", f"{ok_chaos} -q -m chaos "
+                              f"-p no:cacheprovider"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert _summary(r)["chaos_ok"]

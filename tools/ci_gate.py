@@ -1,22 +1,31 @@
 #!/usr/bin/env python
-"""CI gate: tracelint + tier-1 pytest, one exit status.
+"""CI gate: tracelint + suppression audit + tier-1 pytest (+ chaos), one
+exit status.
 
 Usage:
     python tools/ci_gate.py [--paths paddle_tpu]
         [--skip-tests] [--pytest-args "tests/ -q -m 'not slow'"]
-        [--disable TPU005,...]
+        [--disable TPU005,...] [--chaos]
+        [--clean-paths paddle_tpu/resilience]
 
 Phase 1 runs ``tools/tracelint.py --format json`` over ``--paths`` and
 fails on any error-severity finding (the analyzer gates the codebase
-that ships it). Phase 2 runs the tier-1 pytest command (ROADMAP.md) —
-``--skip-tests`` elides it for lint-only invocations, ``--pytest-args``
-overrides the default selection. Exit 1 when either phase fails;
-the JSON line printed last summarises both for log scrapers
-(mirroring tools/check_op_benchmark_result.py's contract).
+that ships it). Phase 2 audits inline ``# tracelint: disable``
+directives: every suppression is listed for reviewers, and any found
+under a ``--clean-paths`` prefix (default: the resilience subsystem,
+which must stay TPU001–TPU008 clean) fails the gate. Phase 3 runs the
+tier-1 pytest command (ROADMAP.md) — ``--skip-tests`` elides it,
+``--pytest-args`` overrides the selection. ``--chaos`` adds a fourth
+stage running the fault-injection suite (``-m chaos``) on its own, so
+recovery paths are exercised and reported separately from the
+functional tests. Exit 1 when any phase fails; the JSON line printed
+last summarises all of them for log scrapers (mirroring
+tools/check_op_benchmark_result.py's contract).
 """
 import argparse
 import json
 import os
+import re
 import shlex
 import subprocess
 import sys
@@ -26,6 +35,10 @@ TRACELINT = os.path.join(REPO, "tools", "tracelint.py")
 
 DEFAULT_PYTEST_ARGS = ("tests/ -q -m 'not slow' "
                        "--continue-on-collection-errors -p no:cacheprovider")
+CHAOS_PYTEST_ARGS = "tests/ -q -m chaos -p no:cacheprovider"
+DEFAULT_CLEAN_PATHS = ("paddle_tpu/resilience",)
+
+_SUPPRESS_RE = re.compile(r"#\s*tracelint\s*:\s*disable")
 
 
 def run_tracelint(paths, disable=""):
@@ -42,6 +55,40 @@ def run_tracelint(paths, disable=""):
     return report, proc.returncode
 
 
+def audit_suppressions(paths, clean_paths):
+    """List every inline tracelint suppression under `paths`; flag those
+    under a `clean_paths` prefix as violations (new subsystems must fix
+    findings, not silence them)."""
+    entries, violations = [], []
+    # clean prefixes may be repo-relative or absolute
+    clean = [os.path.normpath(os.path.join(REPO, c)) for c in clean_paths]
+    for path in paths:
+        full = os.path.join(REPO, path)
+        if os.path.isfile(full):
+            files = [full]
+        else:
+            files = [os.path.join(dp, fn)
+                     for dp, _, fns in os.walk(full)
+                     for fn in fns if fn.endswith(".py")]
+        for f in sorted(files):
+            rel = os.path.relpath(f, REPO)
+            try:
+                with open(f, encoding="utf-8", errors="replace") as fh:
+                    lines = fh.readlines()
+            except OSError:
+                continue
+            for i, line in enumerate(lines, start=1):
+                if "tracelint" in line and _SUPPRESS_RE.search(line):
+                    entry = {"file": rel, "line": i,
+                             "text": line.strip()[:120]}
+                    entries.append(entry)
+                    absf = os.path.normpath(os.path.abspath(f))
+                    if any(absf.startswith(c + os.sep) or absf == c
+                           for c in clean):
+                        violations.append(entry)
+    return entries, violations
+
+
 def run_pytest(pytest_args):
     cmd = [sys.executable, "-m", "pytest", *shlex.split(pytest_args)]
     env = dict(os.environ, JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS",
@@ -56,6 +103,13 @@ def main(argv=None):
     ap.add_argument("--disable", default="")
     ap.add_argument("--skip-tests", action="store_true")
     ap.add_argument("--pytest-args", default=DEFAULT_PYTEST_ARGS)
+    ap.add_argument("--chaos", action="store_true",
+                    help="also run the fault-injection suite (-m chaos)")
+    ap.add_argument("--chaos-args", default=CHAOS_PYTEST_ARGS)
+    ap.add_argument("--clean-paths", nargs="*",
+                    default=list(DEFAULT_CLEAN_PATHS),
+                    help="path prefixes where tracelint suppressions "
+                         "fail the gate")
     ns = ap.parse_args(argv)
 
     report, lint_rc = run_tracelint(ns.paths, ns.disable)
@@ -64,20 +118,36 @@ def main(argv=None):
             print(f"{f['filename']}:{f['line']}: {f['code']} {f['message']}")
     lint_ok = lint_rc == 0
 
+    suppressions, violations = audit_suppressions(ns.paths, ns.clean_paths)
+    for s in suppressions:
+        tag = "VIOLATION" if s in violations else "noted"
+        print(f"suppression ({tag}): {s['file']}:{s['line']}: {s['text']}")
+    audit_ok = not violations
+
     tests_ok = True
     if not ns.skip_tests:
         tests_ok = run_pytest(ns.pytest_args) == 0
 
+    chaos_ok = True
+    if ns.chaos:
+        chaos_ok = run_pytest(ns.chaos_args) == 0
+
     summary = {
-        "gate": "tracelint+tier1",
+        "gate": "tracelint+suppressions+tier1" + ("+chaos" if ns.chaos
+                                                  else ""),
         "lint_ok": lint_ok,
         "lint_errors": report.get("errors", -1),
         "lint_warnings": report.get("warnings", 0),
+        "suppressions": len(suppressions),
+        "suppression_violations": len(violations),
+        "audit_ok": audit_ok,
         "tests_ok": tests_ok,
         "tests_skipped": bool(ns.skip_tests),
+        "chaos_ok": chaos_ok,
+        "chaos_run": bool(ns.chaos),
     }
     print(json.dumps(summary))
-    if not (lint_ok and tests_ok):
+    if not (lint_ok and audit_ok and tests_ok and chaos_ok):
         print("ci_gate: FAILED", file=sys.stderr)
         return 1
     return 0
