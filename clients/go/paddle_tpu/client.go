@@ -5,8 +5,11 @@
 //
 // Protocol (little-endian), see paddle_tpu/inference/server.py:
 //   request:  u32 body_len | u8 cmd(1=infer) | u8 n_inputs |
-//             per input: u8 dtype(0=f32,1=i32) u8 ndim i64 dims[] data
+//             per input: u8 dtype(0=f32,1=i32,2=i64,3=bool) u8 ndim
+//             i64 dims[] data
 //   response: u32 body_len | u8 status | same encoding of outputs
+//   status:   0 ok | 1 error | 2 overloaded (request shed by the
+//             server's batching engine — back off and retry)
 package paddletpu
 
 import (
@@ -17,13 +20,29 @@ import (
 	"net"
 )
 
-// Tensor is a dense row-major array: set Data for f32 payloads or
-// IntData for i32 payloads (token ids etc.) — exactly one of the two.
+// Tensor is a dense row-major array: set exactly one of Data (f32),
+// IntData (i32), Int64Data (i64 token ids etc.) or BoolData (masks).
 type Tensor struct {
-	Dims    []int64
-	Data    []float32
-	IntData []int32
+	Dims      []int64
+	Data      []float32
+	IntData   []int32
+	Int64Data []int64
+	BoolData  []bool
 }
+
+// Wire dtype codes and element sizes (mirrors server.py _DTYPES).
+const (
+	dtypeF32  = 0
+	dtypeI32  = 1
+	dtypeI64  = 2
+	dtypeBool = 3
+)
+
+var dtypeSize = map[byte]int{dtypeF32: 4, dtypeI32: 4, dtypeI64: 8, dtypeBool: 1}
+
+// ErrOverloaded is returned by Run when the server shed the request
+// (status 2: its batching-engine queue is full) — retry after backoff.
+var ErrOverloaded = fmt.Errorf("server overloaded: request shed (status 2)")
 
 // Predictor holds one connection to a PredictorServer.
 type Predictor struct {
@@ -44,22 +63,49 @@ func (p *Predictor) Close() error { return p.conn.Close() }
 func (p *Predictor) Run(inputs []Tensor) ([]Tensor, error) {
 	body := []byte{1, byte(len(inputs))}
 	for i, t := range inputs {
-		if (t.Data != nil) == (t.IntData != nil) {
-			return nil, fmt.Errorf("input %d: set exactly one of Data / IntData", i)
+		set := 0
+		dtype := byte(dtypeF32)
+		if t.Data != nil {
+			set++
 		}
-		dtype := byte(0)
 		if t.IntData != nil {
-			dtype = 1
+			set++
+			dtype = dtypeI32
+		}
+		if t.Int64Data != nil {
+			set++
+			dtype = dtypeI64
+		}
+		if t.BoolData != nil {
+			set++
+			dtype = dtypeBool
+		}
+		if set != 1 {
+			return nil, fmt.Errorf(
+				"input %d: set exactly one of Data / IntData / Int64Data / BoolData", i)
 		}
 		body = append(body, dtype, byte(len(t.Dims)))
 		for _, d := range t.Dims {
 			body = binary.LittleEndian.AppendUint64(body, uint64(d))
 		}
-		if t.IntData != nil {
+		switch dtype {
+		case dtypeI32:
 			for _, v := range t.IntData {
 				body = binary.LittleEndian.AppendUint32(body, uint32(v))
 			}
-		} else {
+		case dtypeI64:
+			for _, v := range t.Int64Data {
+				body = binary.LittleEndian.AppendUint64(body, uint64(v))
+			}
+		case dtypeBool:
+			for _, v := range t.BoolData {
+				b := byte(0)
+				if v {
+					b = 1
+				}
+				body = append(body, b)
+			}
+		default:
 			for _, v := range t.Data {
 				body = binary.LittleEndian.AppendUint32(body, math.Float32bits(v))
 			}
@@ -80,6 +126,9 @@ func (p *Predictor) Run(inputs []Tensor) ([]Tensor, error) {
 	if len(resp) < 1 {
 		return nil, fmt.Errorf("empty response")
 	}
+	if resp[0] == 2 {
+		return nil, ErrOverloaded
+	}
 	if resp[0] != 0 {
 		return nil, fmt.Errorf("inference failed (status %d)", resp[0])
 	}
@@ -95,14 +144,15 @@ func (p *Predictor) Run(inputs []Tensor) ([]Tensor, error) {
 			return nil, fmt.Errorf("truncated output %d header", i)
 		}
 		dtype := resp[off]
-		if dtype > 1 {
+		esize, ok := dtypeSize[dtype]
+		if !ok {
 			return nil, fmt.Errorf("output %d has unknown dtype %d", i, dtype)
 		}
 		ndim := int(resp[off+1])
 		off += 2
 		dims := make([]int64, ndim)
 		count := int64(1)
-		maxCount := int64(len(resp)-off) / 4
+		maxCount := int64(len(resp)-off) / int64(esize)
 		for d := 0; d < ndim; d++ {
 			if off+8 > len(resp) {
 				return nil, fmt.Errorf("truncated dims of output %d", i)
@@ -116,17 +166,30 @@ func (p *Predictor) Run(inputs []Tensor) ([]Tensor, error) {
 			}
 			count *= dims[d]
 		}
-		if off+int(count)*4 > len(resp) {
+		if off+int(count)*esize > len(resp) {
 			return nil, fmt.Errorf("truncated data of output %d", i)
 		}
 		out := Tensor{Dims: dims}
-		if dtype == 1 {
+		switch dtype {
+		case dtypeI32:
 			out.IntData = make([]int32, count)
 			for j := range out.IntData {
 				out.IntData[j] = int32(binary.LittleEndian.Uint32(resp[off:]))
 				off += 4
 			}
-		} else {
+		case dtypeI64:
+			out.Int64Data = make([]int64, count)
+			for j := range out.Int64Data {
+				out.Int64Data[j] = int64(binary.LittleEndian.Uint64(resp[off:]))
+				off += 8
+			}
+		case dtypeBool:
+			out.BoolData = make([]bool, count)
+			for j := range out.BoolData {
+				out.BoolData[j] = resp[off] != 0
+				off++
+			}
+		default:
 			out.Data = make([]float32, count)
 			for j := range out.Data {
 				out.Data[j] = math.Float32frombits(binary.LittleEndian.Uint32(resp[off:]))

@@ -2,32 +2,55 @@
 # reference's r/ demo client; here a pure-socket client with no python
 # dependency). Protocol: see paddle_tpu/inference/server.py —
 #   request:  u32 body_len | u8 cmd(1) | u8 n_inputs |
-#             per input: u8 dtype(0=f32) u8 ndim i64 dims[] f32 data
+#             per input: u8 dtype(0=f32,1=i32,2=i64,3=bool) u8 ndim
+#             i64 dims[] data
 #   response: u32 body_len | u8 status | same encoding of outputs
+#   status:   0 ok | 1 error | 2 overloaded (request shed by the
+#             server's batching engine — back off and retry)
 
 pd_connect <- function(host = "127.0.0.1", port) {
   socketConnection(host, port, blocking = TRUE, open = "r+b")
 }
 
+# dtype code -> element size on the wire (mirrors server.py _DTYPES)
+.pd_dtype_codes <- c(float32 = 0L, int32 = 1L, int64 = 2L, bool = 3L)
+.pd_dtype_sizes <- c(4L, 4L, 8L, 1L)  # indexed by code + 1
+
 .write_i64 <- function(buf, v) {
-  # little-endian int64 as lo/hi 32-bit words (dims fit in 32 bits)
-  writeBin(as.integer(v), buf, size = 4, endian = "little")
-  writeBin(0L, buf, size = 4, endian = "little")
+  # little-endian int64 as lo/hi 32-bit words. R has no native int64;
+  # doubles are exact up to 2^53, so encode that full range (mirroring
+  # the decode path below) and ERROR beyond it — never transmit a
+  # corrupted value.
+  v <- as.numeric(v)
+  if (is.na(v) || abs(v) > 2^53 || v != trunc(v))
+    stop(sprintf(
+      "value %s is not losslessly encodable as int64 from R (must be integral with |v| <= 2^53)",
+      format(v)))
+  lo <- v %% 2^32  # R's %% returns the non-negative remainder
+  hi <- floor(v / 2^32)
+  if (lo >= 2^31) lo <- lo - 2^32  # reinterpret as signed i32 for writeBin
+  writeBin(as.integer(lo), buf, size = 4, endian = "little")
+  writeBin(as.integer(hi), buf, size = 4, endian = "little")
 }
 
-pd_predict <- function(con, x, dtype = c("float32", "int32")) {
+pd_predict <- function(con, x, dtype = c("float32", "int32", "int64",
+                                         "bool")) {
   dtype <- match.arg(dtype)
   dims <- if (is.null(dim(x))) length(x) else dim(x)
   # R stores column-major; the wire format is row-major — aperm handles
   # any rank (t() would fail beyond matrices)
   data <- if (is.null(dim(x))) as.numeric(x) else
     as.numeric(aperm(x, rev(seq_along(dims))))
-  code <- if (dtype == "int32") 1 else 0
+  code <- .pd_dtype_codes[[dtype]]
   buf <- rawConnection(raw(0), "w")
   writeBin(as.raw(c(1, 1, code, length(dims))), buf)
   for (d in dims) .write_i64(buf, d)
   if (dtype == "int32") {
     writeBin(as.integer(data), buf, size = 4, endian = "little")
+  } else if (dtype == "int64") {
+    for (v in data) .write_i64(buf, v)
+  } else if (dtype == "bool") {
+    writeBin(as.raw(data != 0), buf)
   } else {
     writeBin(data, buf, size = 4, endian = "little")
   }
@@ -39,12 +62,17 @@ pd_predict <- function(con, x, dtype = c("float32", "int32")) {
 
   rlen <- readBin(con, "integer", size = 4, endian = "little")
   resp <- readBin(con, "raw", n = rlen)
-  stopifnot(as.integer(resp[1]) == 0)
+  status <- as.integer(resp[1])
+  if (status == 2)
+    stop("server overloaded: request shed (status 2) - retry with backoff")
+  stopifnot(status == 0)
   off <- 2
   n_out <- as.integer(resp[off]); off <- off + 1
   outs <- vector("list", n_out)
   for (i in seq_len(n_out)) {
     out_code <- as.integer(resp[off])
+    if (out_code > 3) stop(sprintf("unknown wire dtype %d", out_code))
+    esize <- .pd_dtype_sizes[out_code + 1]
     ndim <- as.integer(resp[off + 1]); off <- off + 2
     odims <- integer(ndim)
     for (d in seq_len(ndim)) {
@@ -53,12 +81,25 @@ pd_predict <- function(con, x, dtype = c("float32", "int32")) {
       off <- off + 8
     }
     count <- prod(odims)
+    raw_seg <- resp[off:(off + count * esize - 1)]
     vals <- if (out_code == 1)
-      readBin(resp[off:(off + count * 4 - 1)], "integer", n = count,
-              size = 4, endian = "little") else
-      readBin(resp[off:(off + count * 4 - 1)], "numeric", n = count,
-              size = 4, endian = "little")
-    off <- off + count * 4
+      readBin(raw_seg, "integer", n = count, size = 4,
+              endian = "little")
+    else if (out_code == 2) {
+      # int64 as lo/hi 32-bit word pairs -> numeric (R has no int64;
+      # exact up to 2^53)
+      words <- readBin(raw_seg, "integer", n = count * 2, size = 4,
+                       endian = "little")
+      lo <- words[seq(1, length(words), 2)]
+      hi <- words[seq(2, length(words), 2)]
+      (lo + (lo < 0) * 2^32) + hi * 2^32
+    }
+    else if (out_code == 3)
+      as.logical(as.integer(raw_seg))
+    else
+      readBin(raw_seg, "numeric", n = count, size = 4,
+              endian = "little")
+    off <- off + count * esize
     # wire is row-major: fill a reversed array then permute back
     outs[[i]] <- if (ndim >= 2)
       aperm(array(vals, rev(odims)), rev(seq_len(ndim))) else

@@ -1,0 +1,629 @@
+"""Dynamic-batching serving engine: coalesce concurrent infer requests
+into padded shape-bucket batches over one compiled-program cache.
+
+On TPU, serving throughput comes almost entirely from batch parallelism
+and from amortizing XLA compilation over stable shapes — a
+thread-per-request predictor pays full dispatch per sample and a full
+compile per novel shape. This engine is the runtime complement to
+tracelint's static recompilation-hazard passes (TPU101-TPU104):
+
+  requests --> bounded queue --> scheduler thread --> padded bucket batch
+                (load shed)       (fire on max_batch_size                 \
+                                   or max_wait_ms)                         --> per-bucket
+                                                                               AOT-compiled
+  response <-- slice rows off <---------------------------------------------- program
+
+Shape buckets are powers of two (clamped to ``max_batch_size``): padding
+the coalesced row count up to the next bucket means each bucket's
+program compiles exactly once, no matter what request mix arrives.
+Declared buckets are precompiled by :meth:`BatchingEngine.warmup` so the
+first real request never eats a compile. The bounded queue plus
+:class:`EngineOverloaded` (wire status ``2``) turn saturation into fast
+rejection — load shedding — instead of unbounded memory growth.
+
+Determinism contract (verified in tests/test_serving_batching.py):
+engine outputs are bitwise identical to unbatched ``Predictor.run`` for
+any request of >= 2 rows and for all integer dtypes — padding rows are
+sliced off before anything is returned, and XLA's row-independent
+programs are bitwise row-stable across batch sizes >= 2 on CPU. The one
+carve-out: XLA lowers batch-1 float matmuls to a gemv with different
+rounding than the gemm used for batch >= 2, so a COALESCED 1-row float
+request can differ from its solo baseline in the last ulp (a solo 1-row
+request fires at bucket 1 — the same program as the baseline — and stays
+bitwise equal). A 1-row tail chunk of a split oversized request pads to
+bucket 2 for the same reason: its rows came from a >= 2-row baseline
+dispatch, so it must stay in the gemm regime.
+"""
+import json
+import threading
+import time
+import warnings
+
+import numpy as np
+
+# Wire status byte for a shed request (server.py speaks it; defined here
+# so the engine has no import-time dependency on the server).
+OVERLOADED_STATUS = 2
+
+
+class EngineOverloaded(RuntimeError):
+    """Raised by submit/infer when the bounded queue is full: the caller
+    should back off (the server maps this to wire status 2)."""
+
+    status_code = OVERLOADED_STATUS
+
+
+class EngineClosed(RuntimeError):
+    pass
+
+
+def bucket_rows(n, max_batch_size):
+    """Next power-of-2 >= n, clamped to max_batch_size."""
+    if n <= 0:
+        raise ValueError(f"need at least one row, got {n}")
+    if n >= max_batch_size:
+        return max_batch_size
+    return min(max_batch_size, 1 << (n - 1).bit_length())
+
+
+def _signature(arrays):
+    """Batch-compatibility key: dtype + trailing dims of every input
+    (requests coalesce only when everything but the row count matches)."""
+    return tuple((a.dtype.str, a.shape[1:]) for a in arrays)
+
+
+class _Request:
+    __slots__ = ("inputs", "rows", "sig", "event", "outputs", "error",
+                 "t_enqueue", "min_bucket")
+
+    def __init__(self, inputs, rows, sig, min_bucket=1):
+        self.inputs = inputs
+        self.rows = rows
+        self.sig = sig
+        self.event = threading.Event()
+        self.outputs = None
+        self.error = None
+        self.t_enqueue = time.monotonic()
+        # split chunks of a >= 2-row request carry min_bucket=2: a solo
+        # 1-row tail chunk must still fire in the batch >= 2 regime
+        # (bucket 1 is XLA's gemv regime, which rounds differently) to
+        # keep the split path bitwise equal to the unbatched baseline
+        self.min_bucket = min_bucket
+
+
+class _BucketStats:
+    __slots__ = ("compiles", "batches", "requests", "rows", "padded_rows",
+                 "total_ms", "max_ms")
+
+    def __init__(self):
+        self.compiles = 0
+        self.batches = 0
+        self.requests = 0
+        self.rows = 0
+        self.padded_rows = 0
+        self.total_ms = 0.0
+        self.max_ms = 0.0
+
+    def as_dict(self):
+        return {
+            "compiles": self.compiles,
+            "batches": self.batches,
+            "requests": self.requests,
+            "rows": self.rows,
+            "padded_rows": self.padded_rows,
+            "total_ms": round(self.total_ms, 3),
+            "avg_ms": round(self.total_ms / self.batches, 3)
+                      if self.batches else 0.0,
+            "max_ms": round(self.max_ms, 3),
+        }
+
+
+class AotLayerRunner:
+    """Execute batches for a jit-loaded :class:`TranslatedLayer` through
+    per-bucket ahead-of-time compiled programs.
+
+    The layer's exported StableHLO must be batch-polymorphic in dim 0 of
+    every input (``jit.save`` with ``InputSpec([None, ...])``); each
+    bucket is then lowered+compiled exactly once with the weights passed
+    as runtime arguments (shared on device across buckets, never baked
+    into the program) and the batch buffers donated.
+    """
+
+    def __init__(self, layer, donate=True):
+        import jax
+
+        self._jax = jax
+        self._layer = layer
+        self._donate = donate
+        specs = getattr(layer, "_input_specs", None) or []
+        if not specs:
+            raise ValueError("layer has no input specs; was it jit-saved?")
+        if not getattr(layer, "_polymorphic", False):
+            raise ValueError(
+                "dynamic batching needs a batch-polymorphic saved model: "
+                "re-save with paddle.jit.save(..., input_spec="
+                "[InputSpec([None, ...], dtype)]) so dim 0 exports as a "
+                "symbolic size (BatchingEngine.for_callable is the "
+                "fallback for fixed-shape models)")
+        self._trailing = []
+        self._dtypes = []
+        for shape, dtype in specs:
+            if shape and shape[0] is not None:
+                raise ValueError(
+                    f"input spec {shape} has a concrete dim 0; every "
+                    "input must be batch-polymorphic for bucket batching")
+            if any(d is None for d in shape[1:]):
+                raise ValueError(
+                    f"input spec {shape} has a symbolic non-batch dim; "
+                    "the batching engine buckets dim 0 only — re-save "
+                    "with concrete trailing dims (or pad/bucket those "
+                    "dims client-side before submitting)")
+            self._trailing.append(tuple(int(d) for d in shape[1:]))
+            self._dtypes.append(np.dtype(dtype))
+
+    def default_signature(self):
+        """The saved model's batch signature (for warmup)."""
+        return tuple((dt.str, tr)
+                     for dt, tr in zip(self._dtypes, self._trailing))
+
+    def compile(self, bucket, sig):
+        """Lower + compile the bucket's program. Called once per bucket
+        by the engine's cache; the compiled callable takes the padded
+        numpy batch arrays and returns a list of numpy outputs."""
+        jax = self._jax
+        layer = self._layer
+        n_in = len(sig)
+
+        def flat_fn(param_list, buffer_list, *inputs):
+            out = layer._call_fn(param_list, buffer_list, *inputs)
+            return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+        param_arrays = [p._value for p in layer._parameters.values()]
+        buffer_arrays = [jax.numpy.asarray(b)
+                         for b in layer._loaded_buffers.values()]
+        param_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                       for a in param_arrays]
+        buffer_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                        for a in buffer_arrays]
+        in_specs = [jax.ShapeDtypeStruct((bucket,) + tr, np.dtype(dt))
+                    for dt, tr in sig]
+        donate = tuple(range(2, 2 + n_in)) if self._donate else ()
+        with warnings.catch_warnings():
+            # tiny models may leave a donated batch buffer unused; that
+            # is an optimization miss, not an error worth a warning per
+            # compile
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            compiled = (jax.jit(flat_fn, donate_argnums=donate)
+                        .lower(param_specs, buffer_specs, *in_specs)
+                        .compile())
+
+        def run(batch_arrays):
+            out = compiled(param_arrays, buffer_arrays, *batch_arrays)
+            # np.asarray is the device->host readback: the true sync
+            # point (PERF.md), and the bytes the server will encode
+            return [np.asarray(o) for o in out]
+
+        return run
+
+    def prime(self, run, bucket, sig):
+        """No-op: compile() above already AOT-compiled the program."""
+
+
+class CallableRunner:
+    """Fallback runner wrapping any ``fn(*arrays) -> list[array]`` (e.g.
+    a fixed-shape model or a plain python function). There is no AOT
+    cache to manage — the bucket's real compile happens inside XLA's
+    own jit cache on the first batch executed at that size, so
+    ``warmup`` primes each bucket by running a zero batch through it."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def default_signature(self):
+        return None
+
+    def compile(self, bucket, sig):
+        fn = self._fn
+
+        def run(batch_arrays):
+            out = fn(*batch_arrays)
+            if not isinstance(out, (list, tuple)):
+                out = [out]
+            return [np.asarray(o._value if hasattr(o, "_value") else o)
+                    for o in out]
+
+        return run
+
+    def prime(self, run, bucket, sig):
+        """Execute a zero batch so XLA traces+compiles this bucket now,
+        not on the first real request."""
+        run([np.zeros((bucket,) + tuple(tr), np.dtype(dt))
+             for dt, tr in sig])
+
+
+class BatchingEngine:
+    """Shared dynamic-batching front end for a served model.
+
+    ``infer(inputs)`` blocks the calling thread until its rows come back
+    from a coalesced batch; any number of threads (server handlers,
+    cloned predictors) may call it concurrently. Construction::
+
+        engine = BatchingEngine.for_layer(layer, max_batch_size=32,
+                                          max_wait_ms=2.0, max_queue=256)
+        engine.warmup()            # precompile all power-of-2 buckets
+        outs = engine.infer([x])   # x: [rows, ...]; rows <= max splits
+
+    Knobs:
+      max_batch_size  cap on coalesced rows per fired batch (the
+                      Config.enable_tensorrt_engine(max_batch_size=...)
+                      knob routes here on TPU)
+      max_wait_ms     scheduler fires a partial batch once the oldest
+                      pending request has waited this long
+      max_queue       bounded pending-request cap; beyond it submit()
+                      sheds with EngineOverloaded (wire status 2)
+    """
+
+    def __init__(self, runner, max_batch_size=32, max_wait_ms=2.0,
+                 max_queue=256, name="engine"):
+        if max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        self._runner = runner
+        self.max_batch_size = int(max_batch_size)
+        self.max_wait_s = float(max_wait_ms) / 1000.0
+        self.max_queue = int(max_queue)
+        self.name = name
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending = []  # FIFO of _Request
+        self._cache = {}  # (bucket, sig) -> compiled run callable
+        self._compiling = {}  # (bucket, sig) -> Event for in-flight compile
+        self._bucket_stats = {}  # (bucket, sig) -> _BucketStats
+        self._shed_count = 0
+        self._n_requests = 0
+        self._n_rows = 0
+        self._declared = []  # bucket row counts from warmup()
+        self._cold_threads = []  # in-flight cold-bucket compile threads
+        self._closed = False
+        self._scheduler = threading.Thread(target=self._run_scheduler,
+                                           name=f"{name}-scheduler",
+                                           daemon=True)
+        self._scheduler.start()
+
+    # ------------------------------------------------------- constructors
+    @classmethod
+    def for_layer(cls, layer, donate=True, **kw):
+        """Engine over a jit-loaded batch-polymorphic TranslatedLayer
+        (per-bucket AOT compile, donation on the batch buffers)."""
+        return cls(AotLayerRunner(layer, donate=donate), **kw)
+
+    @classmethod
+    def for_callable(cls, fn, **kw):
+        """Engine over any ``fn(*arrays) -> outputs`` callable."""
+        return cls(CallableRunner(fn), **kw)
+
+    # ------------------------------------------------------------- submit
+    def infer(self, inputs, timeout=None):
+        """Run one request (list of arrays sharing dim 0 = rows) through
+        the engine; returns the list of output arrays for those rows.
+
+        Requests larger than max_batch_size are split into chunks and
+        re-joined (the split path); each chunk occupies its own queue
+        slot so an oversized request cannot bypass the shed cap.
+        """
+        inputs = [np.ascontiguousarray(a) for a in inputs]
+        if not inputs:
+            raise ValueError("infer() needs at least one input array")
+        rows = int(inputs[0].shape[0]) if inputs[0].ndim else 0
+        if rows <= 0:
+            raise ValueError("inputs must have a leading batch dim >= 1")
+        for a in inputs:
+            if a.ndim == 0 or a.shape[0] != rows:
+                raise ValueError(
+                    "all inputs of one request must share dim 0 "
+                    f"(got {[tuple(x.shape) for x in inputs]})")
+        if rows > self.max_batch_size:
+            return self._infer_split(inputs, rows, timeout)
+        req = self._submit(inputs, rows)
+        return self._wait(req, timeout)
+
+    def _infer_split(self, inputs, rows, timeout):
+        n_chunks = -(-rows // self.max_batch_size)
+        if n_chunks > self.max_queue:
+            # a deterministic can-never-fit request must get a permanent
+            # error, not EngineOverloaded: status 2 tells clients to back
+            # off and RETRY, and this retry can never succeed
+            raise ValueError(
+                f"request of {rows} rows needs {n_chunks} chunks of "
+                f"max_batch_size={self.max_batch_size} but the queue cap "
+                f"is {self.max_queue}: split the request client-side or "
+                "raise max_queue/max_batch_size")
+        chunks = []
+        for lo in range(0, rows, self.max_batch_size):
+            hi = min(rows, lo + self.max_batch_size)
+            chunks.append([a[lo:hi] for a in inputs])
+        # all chunks are enqueued atomically: a partially-admitted
+        # oversized request would compute rows only to discard them
+        # when a later chunk sheds
+        reqs = self._submit_chunks(
+            chunks, min_bucket=min(2, self.max_batch_size))
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        parts = []
+        for r in reqs:
+            left = (None if deadline is None
+                    else max(0.0, deadline - time.monotonic()))
+            parts.append(self._wait(r, left))
+        return [np.concatenate([p[i] for p in parts])
+                for i in range(len(parts[0]))]
+
+    def _submit(self, inputs, rows):
+        return self._submit_chunks([inputs])[0]
+
+    def _submit_chunks(self, chunks, min_bucket=1):
+        """Admit every chunk or none (one queue slot per chunk, so an
+        oversized request still counts fully against the shed cap)."""
+        with self._cond:
+            if self._closed:
+                raise EngineClosed(f"{self.name} is closed")
+            if len(self._pending) + len(chunks) > self.max_queue:
+                self._shed_count += 1
+                raise EngineOverloaded(
+                    f"{self.name} queue full ({len(self._pending)} pending,"
+                    f" cap {self.max_queue}, need {len(chunks)} slots); "
+                    "request shed")
+            reqs = []
+            for chunk in chunks:
+                rows = int(chunk[0].shape[0])
+                req = _Request(chunk, rows, _signature(chunk), min_bucket)
+                self._pending.append(req)
+                self._n_requests += 1
+                self._n_rows += rows
+                reqs.append(req)
+            self._cond.notify_all()
+        return reqs
+
+    @staticmethod
+    def _wait(req, timeout):
+        if not req.event.wait(timeout):
+            raise TimeoutError("engine did not answer within timeout")
+        if req.error is not None:
+            raise req.error
+        return req.outputs
+
+    # ---------------------------------------------------------- scheduler
+    def _run_scheduler(self):
+        while True:
+            group = self._next_group()
+            if group is None:
+                return  # closed and drained
+            key = (self._group_bucket(group), group[0].sig)
+            with self._lock:
+                cold = key not in self._cache
+            if cold:
+                # a cold bucket pays a multi-second XLA compile: run it
+                # on its own thread so already-compiled buckets keep
+                # flowing instead of stalling head-of-line behind it
+                t = threading.Thread(target=self._run_group_guarded,
+                                     args=(group,),
+                                     name=f"{self.name}-cold-compile",
+                                     daemon=True)
+                with self._lock:
+                    self._cold_threads = [x for x in self._cold_threads
+                                          if x.is_alive()]
+                    self._cold_threads.append(t)
+                t.start()
+            else:
+                self._run_group_guarded(group)
+
+    def _run_group_guarded(self, group):
+        try:
+            self._run_group(group)
+        except Exception as e:  # noqa: BLE001 - fail the group only
+            for r in group:
+                r.error = e
+                r.event.set()
+
+    def _next_group(self):
+        """Block until a same-signature group is ready to fire: either
+        max_batch_size rows are pending or the oldest request has waited
+        max_wait_ms. Returns the popped group (None = engine closed)."""
+        with self._cond:
+            while True:
+                if not self._pending:
+                    if self._closed:
+                        return None
+                    self._cond.wait()
+                    continue
+                head = self._pending[0]
+                group, rows = [], 0
+                for r in self._pending:
+                    if r.sig != head.sig:
+                        continue
+                    if rows + r.rows > self.max_batch_size:
+                        break
+                    group.append(r)
+                    rows += r.rows
+                deadline = head.t_enqueue + self.max_wait_s
+                now = time.monotonic()
+                if (rows >= self.max_batch_size or now >= deadline
+                        or self._closed):
+                    for r in group:
+                        self._pending.remove(r)
+                    return group
+                self._cond.wait(deadline - now)
+
+    def _group_bucket(self, group):
+        """Bucket for a popped group: next power of two over the
+        coalesced rows, floored by any chunk's min_bucket (a solo
+        1-row split tail pads to bucket 2 to stay in the bitwise-stable
+        batch >= 2 regime)."""
+        want = max(sum(r.rows for r in group),
+                   max(r.min_bucket for r in group))
+        return bucket_rows(want, self.max_batch_size)
+
+    def _run_group(self, group):
+        rows = sum(r.rows for r in group)
+        sig = group[0].sig
+        bucket = self._group_bucket(group)
+        run, _ = self._compiled(bucket, sig)
+        n_in = len(sig)
+        batch = []
+        for i in range(n_in):
+            parts = [r.inputs[i] for r in group]
+            if bucket > rows:
+                pad_shape = (bucket - rows,) + parts[0].shape[1:]
+                parts.append(np.zeros(pad_shape, parts[0].dtype))
+            batch.append(np.concatenate(parts) if len(parts) > 1
+                         else parts[0])
+        t0 = time.monotonic()
+        outs = run(batch)
+        dt_ms = (time.monotonic() - t0) * 1000.0
+        for j, o in enumerate(outs):
+            if getattr(o, "ndim", 0) == 0 or o.shape[0] != bucket:
+                raise ValueError(
+                    f"output {j} has shape {tuple(getattr(o, 'shape', ()))}"
+                    f" but the batch has {bucket} rows: every output must "
+                    "keep the batch dim as dim 0 so per-request rows can "
+                    "be sliced back — batch-reduced outputs cannot go "
+                    "through the batching engine")
+        off = 0
+        for r in group:
+            r.outputs = [o[off:off + r.rows] for o in outs]
+            off += r.rows
+            r.event.set()
+        with self._lock:
+            st = self._stats_for(bucket, sig)
+            st.batches += 1
+            st.requests += len(group)
+            st.rows += rows
+            st.padded_rows += bucket - rows
+            st.total_ms += dt_ms
+            st.max_ms = max(st.max_ms, dt_ms)
+
+    # ------------------------------------------------------ compiled cache
+    def _stats_for(self, bucket, sig):
+        key = (bucket, sig)
+        st = self._bucket_stats.get(key)
+        if st is None:
+            st = self._bucket_stats[key] = _BucketStats()
+        return st
+
+    def _compiled(self, bucket, sig):
+        """Per-bucket compiled program; compiles exactly once per
+        (bucket, signature). Compiles run outside the lock (XLA can
+        take seconds; infer submissions must not block on them); an
+        in-flight event per key makes racing callers (warmup thread,
+        concurrent cold groups) WAIT for the one compile instead of
+        burning CPU redoing it N times."""
+        key = (bucket, sig)
+        while True:
+            with self._lock:
+                run = self._cache.get(key)
+                if run is not None:
+                    return run, False
+                ev = self._compiling.get(key)
+                if ev is None:
+                    ev = self._compiling[key] = threading.Event()
+                    mine = True
+                else:
+                    mine = False
+            if not mine:
+                # loop: pick up the cached result, or take over as the
+                # owner if the first compile failed
+                ev.wait()
+                continue
+            try:
+                run = self._runner.compile(bucket, sig)
+            except BaseException:
+                with self._lock:
+                    self._compiling.pop(key, None)
+                ev.set()
+                raise
+            with self._lock:
+                self._cache[key] = run
+                self._stats_for(bucket, sig).compiles += 1
+                self._compiling.pop(key, None)
+            ev.set()
+            return run, True
+
+    def warmup(self, buckets=None, signature=None):
+        """Precompile bucket programs at server start so no request pays
+        a compile. Default buckets: every power of two up to
+        max_batch_size (plus max itself). Returns the declared list."""
+        sig = signature or self._runner.default_signature()
+        if sig is None:
+            raise ValueError(
+                "warmup needs a signature for a callable-backed engine: "
+                "pass signature=[(dtype_str, trailing_shape), ...]")
+        sig = tuple((np.dtype(dt).str, tuple(tr)) for dt, tr in sig)
+        if buckets is None:
+            buckets = []
+            b = 1
+            while b < self.max_batch_size:
+                buckets.append(b)
+                b <<= 1
+            buckets.append(self.max_batch_size)
+        buckets = sorted({bucket_rows(int(b), self.max_batch_size)
+                          for b in buckets})
+        for b in buckets:
+            run, fresh = self._compiled(b, sig)
+            if fresh:
+                # callable-backed runners compile lazily inside XLA's
+                # jit cache: prime with a zero batch so the "no request
+                # pays a compile" promise holds there too (no-op for
+                # the AOT runner, whose compile() already compiled)
+                self._runner.prime(run, b, sig)
+        with self._lock:
+            self._declared = buckets
+        return buckets
+
+    # -------------------------------------------------------------- stats
+    def stats(self):
+        """Snapshot of engine counters (the `stats` wire command)."""
+        with self._lock:
+            buckets = {}
+            for (bucket, sig), st in sorted(self._bucket_stats.items(),
+                                            key=lambda kv: kv[0][0]):
+                d = st.as_dict()
+                d["signature"] = [[dt, list(tr)] for dt, tr in sig]
+                buckets.setdefault(str(bucket), []).append(d)
+            return {
+                "name": self.name,
+                "max_batch_size": self.max_batch_size,
+                "max_wait_ms": round(self.max_wait_s * 1000.0, 3),
+                "max_queue": self.max_queue,
+                "declared_buckets": list(self._declared),
+                "queue_depth": len(self._pending),
+                "requests": self._n_requests,
+                "rows": self._n_rows,
+                "shed_count": self._shed_count,
+                "compiles": sum(st.compiles
+                                for st in self._bucket_stats.values()),
+                "buckets": buckets,
+            }
+
+    def stats_json(self):
+        return json.dumps(self.stats())
+
+    # -------------------------------------------------------------- close
+    def close(self, timeout=5.0):
+        """Stop the scheduler; pending requests still fire (partial
+        batches), new submissions raise EngineClosed."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._scheduler.join(timeout)
+        with self._lock:
+            colds = list(self._cold_threads)
+            self._cold_threads = []
+        for t in colds:
+            t.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -141,9 +141,11 @@ class Config:
         self._extra["specify_input_names"] = bool(flag)
 
     # TensorRT/Lite/MKLDNN: vendor-engine capture is XLA's job on TPU; the
-    # precision argument is honored (bf16/int8-weight autocast), the rest
-    # recorded for introspection (reference: enable_tensorrt_engine,
-    # EnableLiteEngine, EnableMKLDNN in paddle_analysis_config.h).
+    # precision argument is honored (bf16/int8-weight autocast) and
+    # max_batch_size caps the dynamic-batching engine
+    # (Predictor.enable_dynamic_batching) — the rest is recorded for
+    # introspection (reference: enable_tensorrt_engine, EnableLiteEngine,
+    # EnableMKLDNN in paddle_analysis_config.h).
     def enable_tensorrt_engine(self, workspace_size=1 << 30, max_batch_size=1,
                                min_subgraph_size=3,
                                precision_mode=PrecisionType.Float32,
@@ -154,6 +156,34 @@ class Config:
 
     def tensorrt_engine_enabled(self):
         return "tensorrt" in self._extra
+
+    # ------------------------------------------------------ dynamic batching
+    def enable_dynamic_batching(self, max_batch_size=32, max_wait_ms=2.0,
+                                max_queue=256):
+        """Record dynamic-batching engine knobs; Predictor reads them in
+        enable_dynamic_batching(). max_batch_size here wins over the
+        enable_tensorrt_engine one when both are set."""
+        self._extra["dynamic_batching"] = dict(
+            max_batch_size=int(max_batch_size),
+            max_wait_ms=float(max_wait_ms), max_queue=int(max_queue))
+
+    def dynamic_batching_enabled(self):
+        return "dynamic_batching" in self._extra
+
+    def dynamic_batching_config(self):
+        return dict(self._extra.get("dynamic_batching") or {})
+
+    def max_batch_size(self):
+        """The serving engine's batch cap: the explicit dynamic-batching
+        knob, else the enable_tensorrt_engine(max_batch_size=...) value
+        (no longer a TensorRT no-op on TPU), else 1."""
+        db = self._extra.get("dynamic_batching")
+        if db:
+            return int(db["max_batch_size"])
+        trt = self._extra.get("tensorrt")
+        if trt:
+            return int(trt["max_batch_size"])
+        return 1
 
     def enable_lite_engine(self, precision_mode=PrecisionType.Float32,
                            zero_copy=False, passes_filter=(), ops_filter=()):

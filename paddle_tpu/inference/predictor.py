@@ -9,11 +9,19 @@ that module, cached per input-shape signature; "ZeroCopyRun" = inputs
 stay device-resident between copy_from_cpu and run, outputs are fetched
 lazily by copy_to_cpu.
 """
+import threading
+import warnings
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from .config import Config, PrecisionType
+
+# Engine attach/detach is rare (once per process, not per request) but
+# clones are explicitly multithreaded: one process-wide lock keeps two
+# threads from each building + warming an engine and leaking the loser.
+_ENGINE_ATTACH_LOCK = threading.Lock()
 
 
 class Tensor:
@@ -37,6 +45,13 @@ class Tensor:
         arr = np.asarray(arr)
         if self._shape is not None:
             arr = arr.reshape(self._shape)
+        if self._pred.batching_engine() is not None:
+            # the engine pads + concatenates requests on host and uploads
+            # the coalesced batch itself; uploading here would make run()
+            # pay a blocking device->host readback per request just to
+            # hand the engine the bytes it already had
+            self._pred._inputs[self._name] = arr
+            return
         # device upload happens here, once — run() consumes the resident copy
         self._pred._inputs[self._name] = jax.device_put(arr, self._pred._device)
 
@@ -136,25 +151,128 @@ class Predictor:
     def get_output_tensor(self, name):
         return self.get_output_handle(name)
 
+    # ----------------------------------------------------------- batching
+    def enable_dynamic_batching(self, engine=None, max_batch_size=None,
+                                max_wait_ms=None, max_queue=None,
+                                warmup=True, warmup_buckets=None):
+        """Route this predictor's run() through a shared dynamic-batching
+        engine (inference/batching.py). The engine lives on the loaded
+        layer, which clone() shares — so every clone coalesces into ONE
+        scheduler instead of racing separate dispatches. Knob defaults
+        come from the Config (enable_dynamic_batching /
+        enable_tensorrt_engine(max_batch_size=...)). Returns the engine.
+        """
+        from .batching import BatchingEngine
+
+        prev = prev_owned = None
+        with _ENGINE_ATTACH_LOCK:
+            if engine is not None:
+                # caller-owned engine (possibly shared with a server):
+                # attach only — disable_dynamic_batching will detach
+                # without closing it. An engine WE built earlier must be
+                # closed now or its scheduler thread + compiled programs
+                # leak with no handle left to close them.
+                prev = getattr(self._layer, "_batch_engine", None)
+                prev_owned = getattr(self._layer, "_batch_engine_owned",
+                                     False)
+                self._layer._batch_engine = engine
+                self._layer._batch_engine_owned = False
+        if engine is not None:
+            if prev is not None and prev is not engine and prev_owned:
+                prev.close()
+            return engine
+        with _ENGINE_ATTACH_LOCK:
+            engine = getattr(self._layer, "_batch_engine", None)
+            if engine is not None:
+                if any(k is not None for k in (max_batch_size, max_wait_ms,
+                                               max_queue)):
+                    warnings.warn(
+                        "enable_dynamic_batching: an engine is already "
+                        "attached to this (shared) layer; the knobs passed "
+                        "here are ignored. Call disable_dynamic_batching() "
+                        "first to rebuild with new settings.",
+                        RuntimeWarning, stacklevel=2)
+                return engine
+            db = self._config.dynamic_batching_config()
+            kw = dict(
+                # Config.max_batch_size() already encodes the
+                # dynamic_batching > tensorrt > 1 precedence
+                max_batch_size=(max_batch_size
+                                or max(self._config.max_batch_size(), 1)),
+                max_wait_ms=(max_wait_ms if max_wait_ms is not None
+                             else db.get("max_wait_ms", 2.0)),
+                max_queue=(max_queue if max_queue is not None
+                           else db.get("max_queue", 256)),
+            )
+            engine = BatchingEngine.for_layer(self._layer, **kw)
+            if warmup:
+                engine.warmup(warmup_buckets)
+            self._layer._batch_engine = engine
+            self._layer._batch_engine_owned = True
+            return engine
+
+    def disable_dynamic_batching(self):
+        """Detach the shared engine; run() goes back to direct dispatch
+        for this predictor AND its clones. Engines this predictor built
+        are closed; a caller-provided engine is only detached (other
+        consumers, e.g. a PredictorServer, may still be using it)."""
+        with _ENGINE_ATTACH_LOCK:
+            engine = getattr(self._layer, "_batch_engine", None)
+            if engine is None:
+                return
+            owned = getattr(self._layer, "_batch_engine_owned", True)
+            self._layer._batch_engine = None
+            self._layer._batch_engine_owned = False
+        if owned:
+            engine.close()
+
+    def batching_engine(self):
+        return getattr(self._layer, "_batch_engine", None)
+
     # ----------------------------------------------------------- run
     def run(self, inputs=None):
         """ZeroCopyRun analog. With `inputs` (list of numpy arrays) behaves
         like the reference's Run(feed) convenience; otherwise consumes
-        handles set via copy_from_cpu."""
+        handles set via copy_from_cpu. With dynamic batching enabled the
+        rows go through the shared engine (padded shape-bucket batches,
+        outputs sliced back — bitwise-identical to direct dispatch for
+        >= 2-row requests, see inference/batching.py)."""
+        engine = getattr(self._layer, "_batch_engine", None)
         if inputs is not None:
             if len(inputs) != len(self._in_names):
                 raise ValueError(
                     f"run() got {len(inputs)} inputs, model has "
                     f"{len(self._in_names)}: {self._in_names}")
+            if engine is not None:
+                arrays = engine.infer([np.asarray(a) for a in inputs])
+                # keep the handle API coherent with the non-engine
+                # path: inputs stay readable/re-runnable afterwards
+                for name, arr in zip(self._in_names, inputs):
+                    self._inputs[name] = np.asarray(arr)
+                if self._out_names is None:
+                    self._out_names = [f"out{i}"
+                                       for i in range(len(arrays))]
+                self._outputs = dict(zip(self._out_names, arrays))
+                return arrays
             for name, arr in zip(self._in_names, inputs):
                 self.get_input_handle(name).copy_from_cpu(arr)
         missing = [n for n in self._in_names if n not in self._inputs]
         if missing:
             raise RuntimeError(f"inputs not set: {missing}")
         args = [self._inputs[n] for n in self._in_names]
-        out = self._layer(*args)
-        outs = out if isinstance(out, (tuple, list)) else (out,)
-        arrays = [o._value if hasattr(o, "_value") else o for o in outs]
+        if engine is not None:
+            # np.asarray is free for host arrays (copy_from_cpu keeps
+            # them on host while an engine is attached); only
+            # share_external_data device arrays pay a readback here
+            arrays = engine.infer([np.asarray(a) for a in args])
+        else:
+            # a host array can be left behind by copy_from_cpu if the
+            # engine was detached since; commit it to our device now
+            args = [a if isinstance(a, jax.Array)
+                    else jax.device_put(a, self._device) for a in args]
+            out = self._layer(*args)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            arrays = [o._value if hasattr(o, "_value") else o for o in outs]
         if self._out_names is None:
             self._out_names = [f"out{i}" for i in range(len(arrays))]
         self._outputs = dict(zip(self._out_names, arrays))

@@ -11,10 +11,18 @@ instead (the architecture real TPU serving uses).
 wire format (little-endian):
   request:  u32 body_len | u8 cmd | payload
   cmds: 1 infer  payload = u8 n_inputs, per input:
-            u8 dtype (0=f32, 1=i32) | u8 ndim | i64 dims[ndim] | data
+            u8 dtype (0=f32, 1=i32, 2=i64, 3=bool) | u8 ndim |
+            i64 dims[ndim] | data
+        5 stats  payload = (empty); response body is a UTF-8 JSON
+            object with the batching-engine counters (per-bucket
+            compiles/hits/latency, queue depth, shed_count) — or
+            {"engine": null} when serving without an engine
         7 stop
   response: u32 body_len | u8 status | (cmd 1: same per-output encoding)
+  status: 0 ok | 1 error | 2 overloaded (request shed by the batching
+          engine's bounded queue — back off and retry)
 """
+import json
 import os
 import socket
 import struct
@@ -22,8 +30,19 @@ import threading
 
 import numpy as np
 
-_DTYPES = {0: np.float32, 1: np.int32}
-_DTYPE_CODES = {np.dtype(np.float32): 0, np.dtype(np.int32): 1}
+from .batching import EngineOverloaded
+
+_DTYPES = {0: np.float32, 1: np.int32, 2: np.int64, 3: np.bool_}
+_DTYPE_CODES = {np.dtype(np.float32): 0, np.dtype(np.int32): 1,
+                np.dtype(np.int64): 2, np.dtype(np.bool_): 3}
+# exact widenings only: half floats encode as f32 without corruption;
+# anything else (f64, unsigned, complex...) must raise, never silently
+# cast (the old behavior corrupted i64 token ids through an f32 cast)
+_WIDEN_TO_F32 = {"float16", "bfloat16"}
+
+STATUS_OK = 0
+STATUS_ERROR = 1
+STATUS_OVERLOADED = EngineOverloaded.status_code  # 2
 
 # Hardening knobs: a 4-byte length prefix from a buggy/malicious client
 # must not trigger an unbounded allocation, and a stalled client must
@@ -58,8 +77,14 @@ def _encode_arrays(arrays):
         a = np.ascontiguousarray(a)
         code = _DTYPE_CODES.get(a.dtype)
         if code is None:
-            a = a.astype(np.float32)
-            code = 0
+            if a.dtype.name in _WIDEN_TO_F32:
+                a = a.astype(np.float32)  # exact widening, not corruption
+                code = 0
+            else:
+                raise TypeError(
+                    f"dtype {a.dtype} is not encodable on the wire "
+                    "(supported: float32, int32, int64, bool, plus "
+                    "f16/bf16 widened to f32)")
         out.append(struct.pack("<BB", code, a.ndim))
         out.append(struct.pack(f"<{a.ndim}q", *a.shape))
         out.append(a.tobytes())
@@ -86,11 +111,24 @@ def _decode_arrays(payload):
 
 class PredictorServer:
     """Serve `predictor` (an inference.Predictor or any callable taking
-    numpy arrays and returning a list of numpy arrays) on a TCP port."""
+    numpy arrays and returning a list of numpy arrays) on a TCP port.
+
+    With ``engine`` (an inference.batching.BatchingEngine), cmd-1 infer
+    requests from ALL connections route through the engine's scheduler:
+    concurrent clients coalesce into padded shape-bucket batches, the
+    bounded queue sheds overload as wire status 2 instead of queuing
+    unboundedly, and the ``stats`` command (cmd 5) exposes the
+    per-bucket compile/hit/latency counters."""
 
     def __init__(self, run_fn, port=0, host="127.0.0.1",
-                 max_body=MAX_BODY_BYTES, recv_timeout=RECV_TIMEOUT):
+                 max_body=MAX_BODY_BYTES, recv_timeout=RECV_TIMEOUT,
+                 engine=None, own_engine=False):
         self._run = run_fn
+        self._engine = engine
+        # own_engine: this server is the engine's only handle (serve_model
+        # builds one per server) and must close it on stop, or its
+        # scheduler thread + compiled programs leak per server lifecycle
+        self._own_engine = own_engine and engine is not None
         self._max_body = max_body
         self._recv_timeout = recv_timeout
         self._sock = socket.socket()
@@ -121,6 +159,12 @@ class PredictorServer:
             ent = self._conns.get(threading.current_thread())
             if ent is not None:
                 ent["busy"] = busy
+
+    def _stats_json(self):
+        """Body of the `stats` wire command (cmd 5)."""
+        if self._engine is None:
+            return json.dumps({"engine": None})
+        return self._engine.stats_json()
 
     def _handle(self, conn):
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -157,19 +201,31 @@ class PredictorServer:
                     conn.sendall(struct.pack("<IB", 1, 0))
                     threading.Thread(target=self.stop, daemon=True).start()
                     return
+                if cmd == 5:
+                    enc = self._stats_json().encode("utf-8")
+                    conn.sendall(struct.pack("<IB", 1 + len(enc), 0) + enc)
+                    self._set_busy(False)
+                    continue
                 if cmd != 1:
                     conn.sendall(struct.pack("<IB", 1, 1))
                     self._set_busy(False)
                     continue
                 try:
                     inputs = _decode_arrays(body[1:])
-                    outputs = self._run(*inputs)
+                    if self._engine is not None:
+                        outputs = self._engine.infer(inputs)
+                    else:
+                        outputs = self._run(*inputs)
                     if not isinstance(outputs, (list, tuple)):
                         outputs = [outputs]
                     outputs = [np.asarray(o._value if hasattr(o, "_value")
                                           else o) for o in outputs]
                     enc = _encode_arrays(outputs)
                     conn.sendall(struct.pack("<IB", 1 + len(enc), 0) + enc)
+                except EngineOverloaded:
+                    # load shed: a fast, explicit rejection the client
+                    # can retry — never an unbounded queue
+                    conn.sendall(struct.pack("<IB", 1, STATUS_OVERLOADED))
                 except Exception:  # noqa: BLE001 - protocol error status
                     conn.sendall(struct.pack("<IB", 1, 1))
                 self._set_busy(False)
@@ -195,6 +251,8 @@ class PredictorServer:
         except OSError:
             pass
         if not drain:
+            if self._own_engine:
+                self._engine.close()
             return
         me = threading.current_thread()
         deadline = time_mod.monotonic() + timeout
@@ -217,10 +275,21 @@ class PredictorServer:
                 c.close()
             except OSError:
                 pass
+        if self._own_engine:
+            # handlers are drained/unblocked; pending engine requests
+            # still fire (close() lets partial batches complete)
+            self._engine.close()
 
 
-def serve_model(path_prefix, port=0):
-    """Load a jit-saved model and serve it (the C API's server side)."""
+def serve_model(path_prefix, port=0, dynamic_batching=False,
+                max_batch_size=32, max_wait_ms=2.0, max_queue=256,
+                warmup=True):
+    """Load a jit-saved model and serve it (the C API's server side).
+
+    With ``dynamic_batching=True`` (needs a batch-polymorphic save, see
+    jit.save) all connections share one BatchingEngine: requests
+    coalesce into padded shape-bucket batches, declared buckets are
+    precompiled up front, and saturation sheds as wire status 2."""
     from ..jit import load as jit_load
 
     layer = jit_load(path_prefix)
@@ -229,4 +298,15 @@ def serve_model(path_prefix, port=0):
         out = layer(*arrays)
         return out if isinstance(out, (list, tuple)) else [out]
 
-    return PredictorServer(run, port=port)
+    engine = None
+    if dynamic_batching:
+        from .batching import BatchingEngine
+
+        engine = BatchingEngine.for_layer(layer,
+                                          max_batch_size=max_batch_size,
+                                          max_wait_ms=max_wait_ms,
+                                          max_queue=max_queue)
+        if warmup:
+            engine.warmup()
+    return PredictorServer(run, port=port, engine=engine,
+                           own_engine=engine is not None)

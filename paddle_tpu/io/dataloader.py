@@ -129,7 +129,10 @@ class _MultiprocessIter:
             self.workers.append(w)
             self.index_queues.append(iq)
         atexit.register(self._shutdown)
-        for _ in range(loader.num_workers * 2):
+        # in-flight dispatch bounded by prefetch_factor per worker (the
+        # reference/PyTorch semantic); each completed batch triggers one
+        # _send_next, so this is the steady-state cap too
+        for _ in range(loader.num_workers * loader.prefetch_factor):
             self._send_next()
 
     def _send_next(self):
@@ -201,17 +204,27 @@ class _IterableDatasetIter:
 
 
 class _PrefetchIter:
-    """One-batch lookahead on a background thread (buffered_reader analog)."""
+    """Bounded lookahead on a background thread (buffered_reader analog).
+
+    ``depth`` (the DataLoader's ``prefetch_factor``) is a hard cap on
+    how many batches exist ahead of the consumer: a slot semaphore is
+    acquired BEFORE the next batch is materialized and released when
+    the consumer takes one, so at most ``depth`` batches are ever
+    buffered — a queue-maxsize bound alone would still let the filler
+    hold one extra materialized batch while blocked in put()."""
 
     def __init__(self, inner, depth=2):
         self.inner = inner
-        self.q = queue_mod.Queue(maxsize=depth)
+        self.depth = max(1, int(depth))
+        self._slots = threading.Semaphore(self.depth)
+        self.q = queue_mod.Queue()
         self.thread = threading.Thread(target=self._fill, daemon=True)
         self.thread.start()
 
     def _fill(self):
         try:
             while True:
+                self._slots.acquire()
                 self.q.put(("data", next(self.inner)))
         except StopIteration:
             self.q.put(("stop", None))
@@ -220,6 +233,7 @@ class _PrefetchIter:
 
     def __next__(self):
         kind, payload = self.q.get()
+        self._slots.release()  # consumer took a batch: free one slot
         if kind == "stop":
             raise StopIteration
         if kind == "error":
@@ -240,6 +254,10 @@ class DataLoader:
         self.use_buffer_reader = use_buffer_reader
         self.batch_size = batch_size
         self.drop_last = drop_last
+        # caps BOTH the buffered-reader lookahead (at most this many
+        # batches materialized ahead of the consumer) and, with workers,
+        # the in-flight index dispatch per worker
+        self.prefetch_factor = max(1, int(prefetch_factor))
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None
@@ -258,7 +276,8 @@ class DataLoader:
             inner = _MultiprocessIter(self)
         else:
             inner = _SingleProcessIter(self)
-        it = _PrefetchIter(inner) if self.use_buffer_reader else inner
+        it = (_PrefetchIter(inner, depth=self.prefetch_factor)
+              if self.use_buffer_reader else inner)
 
         class _Wrapper:
             def __iter__(w):

@@ -18,22 +18,76 @@ from ..nn.layer import Layer
 from .static_function import StaticFunction, _flatten_tensors
 
 
-def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save — export layer.forward at the given input spec."""
+def _build_input_specs(input_spec, polymorphic):
+    """Turn InputSpec/Tensor entries into jax ShapeDtypeStructs. With
+    `polymorphic`, None/-1 dims become jax.export symbolic dims, so the
+    exported module accepts ANY size there — the enabler for the
+    serving engine's shape-bucket batching. Returns
+    (candidate_spec_lists, had_symbolic_dims): candidates are attempted
+    in order by write_artifacts — first with dim 0 SHARED across all
+    inputs (the batching contract; programs that relate their inputs'
+    batch dims, e.g. x + y, only trace this way), then with fully
+    independent symbols (inputs whose leading dims are genuinely
+    unrelated)."""
     from ..static import InputSpec
 
-    if input_spec is None:
-        raise ValueError("jit.save requires input_spec (list of InputSpec or Tensors)")
-    specs = []
+    entries = []  # (shape_with_None, dtype)
     for s in input_spec:
         if isinstance(s, InputSpec):
-            shape = [1 if d is None or d < 0 else d for d in s.shape]
-            specs.append(jax.ShapeDtypeStruct(tuple(shape), np.dtype(s.dtype)))
+            dims = [None if d is None or d < 0 else int(d) for d in s.shape]
+            entries.append((dims, np.dtype(s.dtype)))
         elif isinstance(s, Tensor):
-            specs.append(jax.ShapeDtypeStruct(tuple(s._value.shape),
-                                              np.dtype(s._value.dtype)))
+            entries.append((list(s._value.shape), np.dtype(s._value.dtype)))
         else:
             raise TypeError(f"bad input_spec entry {s!r}")
+    n_none = sum(1 for dims, _ in entries for d in dims if d is None)
+    symbolic = polymorphic and n_none > 0
+
+    def build(share_dim0):
+        names = {}  # (input_idx, dim_idx) -> symbol name
+        for i, (dims, _) in enumerate(entries):
+            for j, d in enumerate(dims):
+                if d is None:
+                    names[(i, j)] = ("b" if share_dim0 and j == 0
+                                     else f"d{i}_{j}")
+        syms = {}
+        if symbolic and names:
+            from jax import export as jax_export
+
+            uniq = sorted(set(names.values()))
+            sym_by_name = dict(zip(uniq,
+                                   jax_export.symbolic_shape(
+                                       ", ".join(uniq))))
+            syms = {k: sym_by_name[v] for k, v in names.items()}
+        specs = []
+        for i, (dims, dt) in enumerate(entries):
+            shape = tuple(syms[(i, j)] if symbolic and d is None
+                          else (1 if d is None else d)
+                          for j, d in enumerate(dims))
+            specs.append(jax.ShapeDtypeStruct(shape, dt))
+        return specs
+
+    if not symbolic:
+        return [build(False)], False
+    candidates = [build(True)]
+    if sum(1 for dims, _ in entries if dims and dims[0] is None) > 1:
+        candidates.append(build(False))  # distinct only multi-input
+    return candidates, True
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save — export layer.forward at the given input spec.
+
+    Dims given as None/-1 are exported batch-polymorphically (symbolic
+    shapes) when the model traces under them, so the saved StableHLO can
+    be run — and AOT-compiled per shape bucket by the serving engine —
+    at any concrete size. Models that cannot trace symbolically fall
+    back to the old behavior (dynamic dims pinned to 1)."""
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (list of InputSpec or Tensors)")
+    spec_candidates, polymorphic = _build_input_specs(input_spec,
+                                                      polymorphic=True)
+    specs = spec_candidates[0]
 
     layer.eval()
     params, buffers = layer.functional_state()
@@ -66,34 +120,71 @@ def save(layer, path, input_spec=None, **configs):
 
     write_artifacts(path, jitted, (param_specs, buffer_specs), specs,
                     {n: np.asarray(a) for n, a in params.items()},
-                    {n: np.asarray(a) for n, a in buffers.items()})
+                    {n: np.asarray(a) for n, a in buffers.items()},
+                    spec_candidates=spec_candidates)
 
 
-def write_artifacts(path, jitted_fn, state_specs, input_specs, params, buffers):
+def _is_symbolic_dim(d):
+    return not isinstance(d, (int, np.integer))
+
+
+def _json_spec(s):
+    """JSON-safe (shape, dtype): symbolic dims serialize as None."""
+    return ([None if _is_symbolic_dim(d) else int(d) for d in s.shape],
+            str(s.dtype))
+
+
+def write_artifacts(path, jitted_fn, state_specs, input_specs, params,
+                    buffers, spec_candidates=None):
     """Serialize the single on-disk model format (<prefix>.pdmodel StableHLO +
     .pdiparams npz + .pdmeta.json sidecar) shared by jit.save and
     static.save_inference_model. ``jitted_fn(params_like, buffers_like,
-    *inputs)``; state_specs = (param_specs, buffer_specs)."""
+    *inputs)``; state_specs = (param_specs, buffer_specs).
+
+    Input specs may carry jax.export symbolic dims (batch-polymorphic
+    save); ``spec_candidates`` orders alternative symbolic spellings of
+    the same spec (shared batch dim first, then independent symbols).
+    If every symbolic export fails — not every program traces under
+    abstract sizes — the export retries with those dims pinned to 1,
+    preserving the pre-polymorphism behavior."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     from ..framework import op_version
 
     payload = {
         "params": params,
         "buffers": buffers,
-        "input_specs": [(list(s.shape), str(s.dtype)) for s in input_specs],
+        "input_specs": [_json_spec(s) for s in input_specs],
         "op_versions": op_version.all_op_versions(),
     }
-    try:
-        from jax import export as jax_export
+    symbolic = any(_is_symbolic_dim(d) for s in input_specs for d in s.shape)
+    attempts = [(c, any(_is_symbolic_dim(d) for s in c for d in s.shape))
+                for c in (spec_candidates or [input_specs])]
+    if symbolic:
+        concrete = [jax.ShapeDtypeStruct(
+            tuple(1 if _is_symbolic_dim(d) else int(d) for d in s.shape),
+            s.dtype) for s in input_specs]
+        attempts.append((concrete, False))
+    last_err = None
+    for specs, poly in attempts:
+        try:
+            from jax import export as jax_export
 
-        exported = jax_export.export(jitted_fn)(*state_specs, *input_specs)
-        blob = exported.serialize()
-        with open(path + ".pdmodel", "wb") as f:
-            f.write(blob)
-        payload["format"] = "stablehlo"
-    except Exception as e:  # noqa: BLE001
+            exported = jax_export.export(jitted_fn)(*state_specs, *specs)
+            blob = exported.serialize()
+            with open(path + ".pdmodel", "wb") as f:
+                f.write(blob)
+            payload["format"] = "stablehlo"
+            payload["polymorphic"] = poly
+            # record the shapes actually exported (symbolic dims
+            # serialize as None; pinned dims as 1 on the fallback)
+            payload["input_specs"] = [_json_spec(s) for s in specs]
+            last_err = None
+            break
+        except Exception as e:  # noqa: BLE001
+            last_err = e
+    if last_err is not None:
         payload["format"] = "params-only"
-        payload["export_error"] = repr(e)
+        payload["export_error"] = repr(last_err)
     # .pdiparams is an npz (never pickle: loaded models may come from
     # untrusted sources, and np.load defaults to allow_pickle=False);
     # bfloat16 arrays round-trip as uint16 views since numpy's npz
@@ -115,6 +206,7 @@ def write_artifacts(path, jitted_fn, state_specs, input_specs, params, buffers):
     with open(path + ".pdmeta.json", "w") as f:
         json.dump({"format": payload["format"],
                    "input_specs": payload["input_specs"],
+                   "polymorphic": payload.get("polymorphic", False),
                    "op_versions": payload["op_versions"],
                    "export_error": payload.get("export_error")}, f)
 
@@ -122,12 +214,16 @@ def write_artifacts(path, jitted_fn, state_specs, input_specs, params, buffers):
 class TranslatedLayer(Layer):
     """Loaded inference layer (reference: dygraph/io.py TranslatedLayer)."""
 
-    def __init__(self, call_fn, params, buffers, input_specs=None):
+    def __init__(self, call_fn, params, buffers, input_specs=None,
+                 polymorphic=False):
         super().__init__()
         self._call_fn = call_fn
         self._loaded_params = params
         self._loaded_buffers = buffers
         self._input_specs = input_specs or []
+        # True when the saved module has symbolic (None) dims: it can be
+        # called — and AOT-compiled per shape bucket — at any size there
+        self._polymorphic = bool(polymorphic)
         for i, (n, a) in enumerate(params.items()):
             from ..core.tensor import Parameter
 
@@ -184,7 +280,8 @@ def load(path, **configs):
             return exported.call(param_list, buffer_list, *inputs)
 
         return TranslatedLayer(call_fn, params, buffers,
-                               input_specs=payload.get("input_specs", []))
+                               input_specs=payload.get("input_specs", []),
+                               polymorphic=payload.get("polymorphic", False))
     raise RuntimeError(
         f"model at {path} was saved without a serialized program "
         f"({payload.get('export_error')}); re-save with a supported spec")

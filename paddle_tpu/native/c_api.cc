@@ -7,8 +7,11 @@
 //
 // Protocol (little-endian), mirrors inference/server.py:
 //   request  u32 len | u8 cmd(1=infer) | u8 n_inputs |
-//            per input: u8 dtype(0=f32,1=i32) u8 ndim i64 dims[] data
+//            per input: u8 dtype(0=f32,1=i32,2=i64,3=bool) u8 ndim
+//            i64 dims[] data
 //   response u32 len | u8 status | same encoding of outputs
+//   status   0 ok | 1 error | 2 overloaded (shed by the server's
+//            batching engine: back off and retry)
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -23,6 +26,18 @@
 #include <vector>
 
 namespace {
+
+// Wire dtype table (mirrors server.py _DTYPES): element size in bytes,
+// 0 for unknown codes — callers must reject those, never guess.
+size_t dtype_size(int dt) {
+  switch (dt) {
+    case 0: return 4;  // f32
+    case 1: return 4;  // i32
+    case 2: return 8;  // i64
+    case 3: return 1;  // bool
+    default: return 0;
+  }
+}
 
 bool rd(int fd, void* p, size_t n) {
   char* c = (char*)p;
@@ -137,8 +152,9 @@ void PD_PredictorDestroy(int64_t h) {
 }
 
 // Run inference. Inputs: n_inputs tensors, each described by dtype
-// (0=f32, 1=i32), ndim, dims, and a data pointer. Returns 0 on success;
-// outputs are held by the predictor until the next call.
+// (0=f32, 1=i32, 2=i64, 3=bool), ndim, dims, and a data pointer.
+// Returns 0 on success; outputs are held by the predictor until the
+// next call.
 int PD_PredictorRun(int64_t h, int n_inputs, const int* dtypes,
                     const int* ndims, const int64_t* const* dims,
                     const void* const* data) {
@@ -150,6 +166,8 @@ int PD_PredictorRun(int64_t h, int n_inputs, const int* dtypes,
   body.push_back((char)1);
   body.push_back((char)n_inputs);
   for (int i = 0; i < n_inputs; i++) {
+    size_t esize = dtype_size(dtypes[i]);
+    if (esize == 0) return -1;  // unknown dtype: reject, don't corrupt
     body.push_back((char)dtypes[i]);
     body.push_back((char)ndims[i]);
     size_t count = 1;
@@ -158,7 +176,7 @@ int PD_PredictorRun(int64_t h, int n_inputs, const int* dtypes,
       body.insert(body.end(), (char*)&v, (char*)&v + 8);
       count *= (size_t)v;
     }
-    size_t bytes = count * 4;  // f32 and i32 are both 4 bytes
+    size_t bytes = count * esize;
     body.insert(body.end(), (const char*)data[i],
                 (const char*)data[i] + bytes);
   }
@@ -168,6 +186,7 @@ int PD_PredictorRun(int64_t h, int n_inputs, const int* dtypes,
   if (!rd(p->fd, &rlen, 4) || rlen < 1) return -1;
   std::vector<char> resp(rlen);
   if (!rd(p->fd, resp.data(), rlen)) return -1;
+  if (resp[0] == 2) return -3;  // overloaded (load shed): retry w/ backoff
   if (resp[0] != 0) return -2;
   p->out_data.clear();
   p->out_dims.clear();
@@ -179,6 +198,8 @@ int PD_PredictorRun(int64_t h, int n_inputs, const int* dtypes,
     if (off + 2 > resp.size()) return -1;
     int dt = (unsigned char)resp[off++];
     int nd = (unsigned char)resp[off++];
+    size_t esize = dtype_size(dt);
+    if (esize == 0) return -1;  // unknown dtype from a newer server
     std::vector<int64_t> ds(nd);
     size_t count = 1;
     for (int d = 0; d < nd; d++) {
@@ -187,7 +208,7 @@ int PD_PredictorRun(int64_t h, int n_inputs, const int* dtypes,
       off += 8;
       count *= (size_t)ds[d];
     }
-    size_t bytes = count * 4;
+    size_t bytes = count * esize;
     if (off + bytes > resp.size()) return -1;
     p->out_dtype.push_back(dt);
     p->out_dims.push_back(std::move(ds));

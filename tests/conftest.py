@@ -16,6 +16,24 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compile cache (same dir bench.py uses): the tier-1
+# suite runs close to its 870s timeout cap on this class of box, and
+# most of that is repeated big compiles — a warm cache cuts the suite
+# roughly in half. Only >=1s compiles are written, so the cold-run
+# overhead stays small relative to the compiles it saves.
+_CACHE_DIR = os.environ.get(
+    "PADDLE_TPU_TEST_COMPILE_CACHE",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_compile_cache"))
+if _CACHE_DIR != "0":
+    try:
+        os.makedirs(_CACHE_DIR, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:  # noqa: BLE001 - cache is an optimization only
+        pass
+
 import pytest  # noqa: E402
 
 

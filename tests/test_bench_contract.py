@@ -70,6 +70,27 @@ class TestBenchContract:
         assert rec["vs_baseline"] == rec["mfu"]
         assert rec["smoke"] is True and rec["params_m"] > 0
 
+    @pytest.mark.slow  # subprocess bench run; tier-1 is near its
+    @pytest.mark.serving  # timeout cap — ci_gate --serving runs this
+    def test_serving_mode_metric_fields(self):
+        r = _run({"BENCH_CPU": "1", "BENCH_MODEL": "serving",
+                  "BENCH_CLIENTS": "4", "BENCH_SERVING_SECS": "1"},
+                 timeout=420)
+        assert r.returncode == 0, r.stderr[-500:]
+        rec = _one_json_line(r.stdout)
+        assert rec["metric"] == "serving_infer_qps_dynamic_batching"
+        assert rec["unit"] == "req/s"
+        # the serving schema: QPS + latency percentiles + load shedding
+        assert set(rec) >= {"qps", "p50_ms", "p99_ms", "shed_count",
+                            "baseline_qps", "clients"}
+        assert rec["value"] == rec["qps"] > 0
+        assert rec["p50_ms"] > 0 and rec["p99_ms"] >= rec["p50_ms"]
+        assert rec["shed_count"] >= 0
+        # vs_baseline = QPS speedup over the unbatched per-request path
+        assert rec["vs_baseline"] == pytest.approx(
+            rec["qps"] / rec["baseline_qps"], rel=1e-3)
+        assert rec["smoke"] is True
+
     def test_decode_mode_metric_fields(self):
         r = _run({"BENCH_CPU": "1", "BENCH_STEPS": "4",
                   "BENCH_MODEL": "decode"}, timeout=420)

@@ -82,6 +82,69 @@ class TestDataLoader:
         assert batch["b"].shape == [4, 2]
 
 
+class _CountingDataset(Dataset):
+    """Tracks how many samples have been materialized (__getitem__)."""
+
+    def __init__(self, n=64):
+        self.n = n
+        self.fetched = 0
+
+    def __getitem__(self, i):
+        self.fetched += 1
+        return np.full((4,), i, np.float32)
+
+    def __len__(self):
+        return self.n
+
+
+class TestPrefetchFactor:
+    """prefetch_factor must BOUND the buffered-reader lookahead, not
+    just be accepted (it used to be dropped on the floor while
+    _PrefetchIter ran at a hard-coded depth)."""
+
+    @pytest.mark.parametrize("factor", [1, 3])
+    def test_lookahead_bounded(self, factor):
+        import time
+
+        ds = _CountingDataset(32)
+        loader = DataLoader(ds, batch_size=1, shuffle=False,
+                            prefetch_factor=factor)
+        it = iter(loader)
+        consumed = 0
+        for _ in range(5):
+            next(it)
+            consumed += 1
+            # let the prefetch thread run to its cap (it blocks on the
+            # slot semaphore there; an upper-bound assert cannot flake
+            # from the thread being slow, only from the cap leaking)
+            time.sleep(0.05)
+            assert ds.fetched <= consumed + factor, (
+                f"materialized {ds.fetched} samples with {consumed} "
+                f"consumed: lookahead exceeds prefetch_factor={factor}")
+        rest = list(it)
+        assert consumed + len(rest) == 32
+
+    def test_prefetch_disabled_is_lazy(self):
+        ds = _CountingDataset(8)
+        loader = DataLoader(ds, batch_size=1, shuffle=False,
+                            use_buffer_reader=False)
+        it = iter(loader)
+        next(it)
+        assert ds.fetched == 1  # no background lookahead at all
+
+    def test_multiprocess_inflight_dispatch_uses_factor(self):
+        # the worker path seeds prefetch_factor batches per worker (was
+        # hard-coded 2): with the full dataset smaller than the cap the
+        # run must still complete and yield everything exactly once
+        loader = DataLoader(_CountingDataset(12), batch_size=2,
+                            shuffle=False, num_workers=2,
+                            prefetch_factor=3)
+        batches = list(loader)
+        assert len(batches) == 6
+        got = sorted(float(b.numpy()[0, 0]) for b in batches)
+        assert got == [0.0, 2.0, 4.0, 6.0, 8.0, 10.0]
+
+
 class TestMNISTConvergence:
     def test_lenet_learns(self):
         from paddle_tpu.vision.datasets import MNIST

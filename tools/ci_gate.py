@@ -1,11 +1,11 @@
 #!/usr/bin/env python
-"""CI gate: tracelint + suppression audit + tier-1 pytest (+ chaos), one
-exit status.
+"""CI gate: tracelint + suppression audit + tier-1 pytest (+ chaos,
++ serving), one exit status.
 
 Usage:
     python tools/ci_gate.py [--paths paddle_tpu]
         [--skip-tests] [--pytest-args "tests/ -q -m 'not slow'"]
-        [--disable TPU005,...] [--chaos]
+        [--disable TPU005,...] [--chaos] [--serving]
         [--clean-paths paddle_tpu/resilience]
 
 Phase 1 runs ``tools/tracelint.py --format json`` over ``--paths`` and
@@ -18,8 +18,11 @@ tier-1 pytest command (ROADMAP.md) — ``--skip-tests`` elides it,
 ``--pytest-args`` overrides the selection. ``--chaos`` adds a fourth
 stage running the fault-injection suite (``-m chaos``) on its own, so
 recovery paths are exercised and reported separately from the
-functional tests. Exit 1 when any phase fails; the JSON line printed
-last summarises all of them for log scrapers (mirroring
+functional tests. ``--serving`` adds a stage running the
+dynamic-batching serving suite (``-m serving``) — including its
+slow-marked cases like the serving bench contract that tier-1's
+``not slow`` filter skips. Exit 1 when any phase fails; the JSON line
+printed last summarises all of them for log scrapers (mirroring
 tools/check_op_benchmark_result.py's contract).
 """
 import argparse
@@ -36,6 +39,7 @@ TRACELINT = os.path.join(REPO, "tools", "tracelint.py")
 DEFAULT_PYTEST_ARGS = ("tests/ -q -m 'not slow' "
                        "--continue-on-collection-errors -p no:cacheprovider")
 CHAOS_PYTEST_ARGS = "tests/ -q -m chaos -p no:cacheprovider"
+SERVING_PYTEST_ARGS = "tests/ -q -m serving -p no:cacheprovider"
 DEFAULT_CLEAN_PATHS = ("paddle_tpu/resilience",)
 
 _SUPPRESS_RE = re.compile(r"#\s*tracelint\s*:\s*disable")
@@ -106,6 +110,10 @@ def main(argv=None):
     ap.add_argument("--chaos", action="store_true",
                     help="also run the fault-injection suite (-m chaos)")
     ap.add_argument("--chaos-args", default=CHAOS_PYTEST_ARGS)
+    ap.add_argument("--serving", action="store_true",
+                    help="also run the dynamic-batching serving suite "
+                         "(-m serving, including its slow-marked cases)")
+    ap.add_argument("--serving-args", default=SERVING_PYTEST_ARGS)
     ap.add_argument("--clean-paths", nargs="*",
                     default=list(DEFAULT_CLEAN_PATHS),
                     help="path prefixes where tracelint suppressions "
@@ -126,15 +134,26 @@ def main(argv=None):
 
     tests_ok = True
     if not ns.skip_tests:
-        tests_ok = run_pytest(ns.pytest_args) == 0
+        pytest_args = ns.pytest_args
+        if ns.serving and pytest_args == DEFAULT_PYTEST_ARGS:
+            # the serving stage runs -m serving itself: don't pay the
+            # compile-heavy serving suite twice in one gate invocation
+            pytest_args = pytest_args.replace(
+                "'not slow'", "'not slow and not serving'")
+        tests_ok = run_pytest(pytest_args) == 0
 
     chaos_ok = True
     if ns.chaos:
         chaos_ok = run_pytest(ns.chaos_args) == 0
 
+    serving_ok = True
+    if ns.serving:
+        serving_ok = run_pytest(ns.serving_args) == 0
+
     summary = {
-        "gate": "tracelint+suppressions+tier1" + ("+chaos" if ns.chaos
-                                                  else ""),
+        "gate": ("tracelint+suppressions+tier1"
+                 + ("+chaos" if ns.chaos else "")
+                 + ("+serving" if ns.serving else "")),
         "lint_ok": lint_ok,
         "lint_errors": report.get("errors", -1),
         "lint_warnings": report.get("warnings", 0),
@@ -145,9 +164,12 @@ def main(argv=None):
         "tests_skipped": bool(ns.skip_tests),
         "chaos_ok": chaos_ok,
         "chaos_run": bool(ns.chaos),
+        "serving_ok": serving_ok,
+        "serving_run": bool(ns.serving),
     }
     print(json.dumps(summary))
-    if not (lint_ok and audit_ok and tests_ok and chaos_ok):
+    if not (lint_ok and audit_ok and tests_ok and chaos_ok
+            and serving_ok):
         print("ci_gate: FAILED", file=sys.stderr)
         return 1
     return 0
