@@ -9,7 +9,10 @@
                   (BENCH_FLASH_PRESET=llama for the d=128 shape)
   serving         dynamic-batching server QPS + p50/p99 latency under
                   BENCH_CLIENTS concurrent socket clients, vs the
-                  per-request (unbatched) baseline server
+                  per-request (unbatched) baseline server; with --chaos
+                  (or BENCH_SERVING_CHAOS=1) measures GOODPUT under
+                  injected faults instead: scheduler death + hot reload
+                  + a poisoned-bucket quarantine phase
 
 Runs the full jitted training step (fwd + bwd + optimizer) on one chip
 for the training modes.
@@ -272,6 +275,9 @@ def main():
     if MODEL == "decode":
         return run_decode(smoke, platform)
     if MODEL == "serving":
+        if ("--chaos" in sys.argv
+                or os.environ.get("BENCH_SERVING_CHAOS") == "1"):
+            return run_serving_chaos(smoke, platform)
         return run_serving(smoke, platform)
 
     import jax.numpy as jnp
@@ -697,19 +703,27 @@ def run_decode(smoke, platform):
     return rec
 
 
-def _serving_client_proc(port, frame, secs, conns, barrier, out_q):
+def _serving_client_proc(port, frame, secs, conns, barrier, out_q,
+                         allow_shed=False):
     """One benchmark client process (spawn) driving `conns` closed-loop
     connections through a selector. Client work runs out-of-process so
     it never steals the server's GIL, and a handful of multiplexing
     processes (instead of one per connection) keeps the measurement
     from drowning in scheduler/context-switch overhead on small boxes
     — each connection still has exactly one request in flight, so
-    per-request latency semantics are unchanged."""
+    per-request latency semantics are unchanged.
+
+    ``allow_shed`` (the --chaos goodput rounds): a wire status 2
+    (retryable: shed / quarantined / scheduler restart / expired
+    deadline) is COUNTED and the request re-issued instead of failing
+    the client — goodput is the ok-only rate. Any other non-zero status
+    still fails the round. Puts (latencies, shed_count) on out_q."""
     import selectors
     import socket
     import time as time_mod
 
     lats = []
+    shed = 0
     try:
         socks = []
         for _ in range(conns):
@@ -736,43 +750,37 @@ def _serving_client_proc(port, frame, secs, conns, barrier, out_q):
                     blen = int.from_bytes(st[1][:4], "little")
                     if len(st[1]) < 4 + blen:
                         break
-                    assert st[1][4] == 0, f"status {st[1][4]}"
+                    status = st[1][4]
+                    if status == 2 and allow_shed:
+                        shed += 1
+                    else:
+                        assert status == 0, f"status {status}"
+                        now = time_mod.monotonic()
+                        lats.append(now - st[0])
                     st[1] = st[1][4 + blen:]
-                    now = time_mod.monotonic()
-                    lats.append(now - st[0])
-                    st[0] = now
+                    st[0] = time_mod.monotonic()
                     s.sendall(frame)  # next request on this connection
         for s in socks:
             s.close()
-        out_q.put(lats)
+        out_q.put((lats, shed))
     except BaseException as e:  # noqa: BLE001 - parent raises on this
         out_q.put(e)
 
 
-def run_serving(smoke, platform):
-    """Dynamic-batching serving engine vs per-request baseline: N
-    concurrent socket client PROCESSES (BENCH_CLIENTS, default 32)
-    hammer a PredictorServer for BENCH_SERVING_SECS each way and we
-    report QPS, p50/p99 request latency, and the engine's shed count.
-
-    Timing honesty: the server calls np.asarray on every output before
-    encoding — the device->host readback that PERF.md established as
-    the only true sync point on axon — and each client latency sample
-    spans request-write to response-read over the socket, so no queued
-    device work can leak out of the timed region. vs_baseline reports
-    the QPS speedup over the unbatched per-request server (same model,
-    same clients, direct dispatch)."""
+def _serving_fixture(smoke):
+    """Shared setup for the serving benches (`serving` and its --chaos
+    variant): env knobs, the ServeMLP model saved batch-polymorphically
+    to a temp prefix, the canned 1-row request frame, and the client
+    process layout. Returns a SimpleNamespace so the two benches can't
+    drift apart on model size, GIL tuning, or per-proc rounding."""
     import multiprocessing as mp
-    import socket
     import struct
     import tempfile
+    from types import SimpleNamespace
 
     import paddle_tpu as paddle
     from paddle_tpu import nn
-    from paddle_tpu.inference.batching import BatchingEngine
-    from paddle_tpu.inference.server import (PredictorServer,
-                                             _encode_arrays, _read_all)
-    from paddle_tpu.jit import load as jit_load
+    from paddle_tpu.inference.server import _encode_arrays
     from paddle_tpu.static import InputSpec
 
     paddle.seed(0)
@@ -810,22 +818,10 @@ def run_serving(smoke, platform):
     prefix = os.path.join(tempfile.mkdtemp(), "serving_mlp")
     paddle.jit.save(model, prefix,
                     input_spec=[InputSpec([None, hidden], "float32")])
-    layer = jit_load(prefix)
-
-    def run_fn(*arrays):
-        out = layer(*arrays)
-        return out if isinstance(out, (list, tuple)) else [out]
 
     x = np.random.RandomState(0).randn(1, hidden).astype(np.float32)
     req = struct.pack("<B", 1) + _encode_arrays([x])
     frame = struct.pack("<I", len(req)) + req
-
-    def one_request(port):
-        with socket.create_connection(("127.0.0.1", port)) as s:
-            s.sendall(frame)
-            (blen,) = struct.unpack("<I", _read_all(s, 4))
-            body = _read_all(s, blen)
-            assert body[0] == 0, f"serving request failed (status {body[0]})"
 
     # spawn (not fork): the parent holds a jax runtime + many threads
     ctx = mp.get_context("spawn")
@@ -834,6 +830,46 @@ def run_serving(smoke, platform):
     per_proc = [clients // n_procs + (1 if i < clients % n_procs else 0)
                 for i in range(n_procs)]
     per_proc = [c for c in per_proc if c]
+    return SimpleNamespace(clients=clients, secs=secs, hidden=hidden,
+                           depth=depth, wait_ms=wait_ms, prefix=prefix,
+                           frame=frame, ctx=ctx, per_proc=per_proc)
+
+
+def run_serving(smoke, platform):
+    """Dynamic-batching serving engine vs per-request baseline: N
+    concurrent socket client PROCESSES (BENCH_CLIENTS, default 32)
+    hammer a PredictorServer for BENCH_SERVING_SECS each way and we
+    report QPS, p50/p99 request latency, and the engine's shed count.
+
+    Timing honesty: the server calls np.asarray on every output before
+    encoding — the device->host readback that PERF.md established as
+    the only true sync point on axon — and each client latency sample
+    spans request-write to response-read over the socket, so no queued
+    device work can leak out of the timed region. vs_baseline reports
+    the QPS speedup over the unbatched per-request server (same model,
+    same clients, direct dispatch)."""
+    import socket
+    import struct
+
+    from paddle_tpu.inference.batching import BatchingEngine
+    from paddle_tpu.inference.server import PredictorServer, _read_all
+    from paddle_tpu.jit import load as jit_load
+
+    fx = _serving_fixture(smoke)
+    clients, secs, wait_ms = fx.clients, fx.secs, fx.wait_ms
+    frame, ctx, per_proc = fx.frame, fx.ctx, fx.per_proc
+    layer = jit_load(fx.prefix)
+
+    def run_fn(*arrays):
+        out = layer(*arrays)
+        return out if isinstance(out, (list, tuple)) else [out]
+
+    def one_request(port):
+        with socket.create_connection(("127.0.0.1", port)) as s:
+            s.sendall(frame)
+            (blen,) = struct.unpack("<I", _read_all(s, 4))
+            body = _read_all(s, blen)
+            assert body[0] == 0, f"serving request failed (status {body[0]})"
 
     def drive(port, label):
         """`clients` closed-loop connections spread over a few
@@ -853,7 +889,7 @@ def run_serving(smoke, platform):
             got = out_q.get(timeout=secs + 120)
             if isinstance(got, BaseException):
                 fail(f"serving bench ({label}) client failed: {got!r}")
-            latencies.extend(got)
+            latencies.extend(got[0])
         for p in procs:
             p.join(30)
         n = len(latencies)
@@ -925,6 +961,246 @@ def run_serving(smoke, platform):
         "shed_count": int(stats["shed_count"]),
         "bucket_compiles": int(stats["compiles"]),
         "speedup_vs_unbatched": round(speedup, 2),
+    }
+    if smoke:
+        rec["smoke"] = True
+    return rec
+
+
+def run_serving_chaos(smoke, platform):
+    """--chaos variant of the serving bench: goodput under injected
+    faults (the fleet-goodput lens: what fraction of the healthy rate
+    survives component failure).
+
+    Three wire-level rounds against a serve_model server (fast watchdog
+    knobs) with closed-loop clients that COUNT status-2 sheds instead of
+    failing:
+      healthy   no faults — the goodput denominator
+      chaos     a killer thread arms a one-shot scheduler death every
+                CHAOS_KILL_PERIOD seconds; the watchdog restarts it and
+                only in-flight groups shed
+      reload    a hot weight swap mid-round; drops (sheds/errors) must
+                be zero and the swapped-in engine must show zero cold
+                compiles beyond its pre-swap warmup
+    plus an engine-level poisoned-bucket phase: two request populations
+    with distinct signatures share one engine; poisoning the sick
+    signature's execute path must quarantine ONLY its (bucket, sig)
+    breaker — the healthy population's rate stays within 20% — and the
+    bucket must recover after the breaker cooldown."""
+    import socket
+    import struct
+    import threading
+
+    from paddle_tpu.inference.batching import BatchingEngine, RetryableError
+    from paddle_tpu.inference.server import serve_model, _read_all
+    from paddle_tpu.jit import load as jit_load
+    from paddle_tpu.resilience import chaos
+
+    fx = _serving_fixture(smoke)
+    clients, secs, hidden, wait_ms = (fx.clients, fx.secs, fx.hidden,
+                                      fx.wait_ms)
+    prefix, frame, ctx, per_proc = fx.prefix, fx.frame, fx.ctx, fx.per_proc
+    kill_period = float(os.environ.get("BENCH_CHAOS_KILL_PERIOD", "0.5"))
+
+    max_batch = min(8 if smoke else 32, max(1, clients))
+    server = serve_model(
+        prefix, dynamic_batching=True, max_batch_size=max_batch,
+        max_wait_ms=wait_ms, max_queue=4096,
+        watchdog_interval=0.05, wedge_timeout=10.0,
+        breaker_threshold=3, breaker_cooldown=1.0)
+
+    def wire_cmd(cmd, payload=b""):
+        with socket.create_connection(("127.0.0.1", server.port),
+                                      timeout=120) as s:
+            body = struct.pack("<B", cmd) + payload
+            s.sendall(struct.pack("<I", len(body)) + body)
+            (blen,) = struct.unpack("<I", _read_all(s, 4))
+            resp = _read_all(s, blen)
+        assert resp[0] == 0, f"cmd {cmd} failed (status {resp[0]})"
+        return json.loads(resp[1:].decode("utf-8")) if blen > 1 else None
+
+    def drive(label, during=None):
+        """Closed-loop clients for `secs`, counting sheds; optionally
+        run `during()` once the round is underway. Returns
+        (ok_qps, shed_count, during_result)."""
+        barrier = ctx.Barrier(len(per_proc) + 1)
+        out_q = ctx.Queue()
+        procs = [ctx.Process(target=_serving_client_proc,
+                             args=(server.port, frame, secs, conns,
+                                   barrier, out_q, True),
+                             daemon=True)
+                 for conns in per_proc]
+        for p in procs:
+            p.start()
+        barrier.wait(60)
+        during_result = None
+        if during is not None:
+            time.sleep(secs * 0.2)  # traffic flowing before the event
+            during_result = during()
+        oks, sheds = 0, 0
+        for _ in procs:
+            got = out_q.get(timeout=secs + 300)
+            if isinstance(got, BaseException):
+                fail(f"serving chaos bench ({label}) client failed: "
+                     f"{got!r}")
+            oks += len(got[0])
+            sheds += got[1]
+        for p in procs:
+            p.join(30)
+        qps = oks / secs
+        log(f"{label}: {oks} ok ({qps:.0f} QPS goodput), {sheds} shed "
+            f"over {secs:.1f}s")
+        return qps, sheds, during_result
+
+    # -------- round 1: healthy (the goodput denominator)
+    healthy_qps, healthy_shed, _ = drive("healthy")
+
+    # -------- round 2: scheduler death every kill_period seconds
+    stop_killer = threading.Event()
+
+    def killer():
+        while not stop_killer.wait(kill_period):
+            v = chaos.visits("serving.scheduler.loop")
+            chaos.arm("serving.scheduler.loop", at=v + 2,
+                      exc=RuntimeError("bench chaos: scheduler die"))
+
+    kt = threading.Thread(target=killer, daemon=True)
+    kt.start()
+    chaos_qps, chaos_shed, _ = drive("chaos(scheduler-death)")
+    stop_killer.set()
+    kt.join(5)
+    chaos.reset()
+    # a death injected in the round's final moments leaves the scheduler
+    # dead for up to watchdog_interval — poll briefly instead of racing
+    # the watchdog to a spurious failure
+    deadline = time.monotonic() + 2.0
+    while True:
+        health = wire_cmd(3)
+        if health["engine"]["scheduler_alive"]:
+            break
+        if time.monotonic() >= deadline:
+            fail("scheduler not alive after chaos round")
+        time.sleep(0.05)
+    restarts = health["engine"]["scheduler_restarts"]
+    if restarts == 0:
+        fail("chaos round injected no scheduler death "
+             "(kill period too long for the round?)")
+
+    # -------- round 3: hot reload mid-round (zero drops, zero cold
+    # compiles for declared buckets)
+    def do_reload():
+        t0 = time.monotonic()
+        info = wire_cmd(4)
+        return {"reload_s": round(time.monotonic() - t0, 3),
+                "warm_buckets": info["warm_buckets"]}
+
+    reload_qps, reload_shed, reload_info = drive("reload", during=do_reload)
+    stats = wire_cmd(5)
+    reload_cold_compiles = (stats["compiles"]
+                            - len(stats["declared_buckets"]))
+
+    # -------- engine-level phase: poisoned-signature quarantine
+    layer = jit_load(prefix)
+    sick_width = hidden + 4
+
+    def chaos_fn(xa):
+        if xa.shape[1] != hidden:
+            chaos.hit("bench.sick.execute")  # the poisoned population
+            xa = xa[:, :hidden]
+        out = layer(xa)
+        return [np.asarray(out[0] if isinstance(out, (list, tuple))
+                           else out)]
+
+    engine = BatchingEngine.for_callable(
+        chaos_fn, max_batch_size=8, max_wait_ms=2.0,
+        breaker_threshold=3, breaker_cooldown=1.0,
+        watchdog_interval=0.05, wedge_timeout=10.0)
+    engine.warmup(signature=[("float32", (hidden,))])
+    engine.warmup(signature=[("float32", (sick_width,))])
+    q_secs = 1.0 if smoke else 3.0
+    h_threads, s_threads = 4, 2
+
+    def drive_engine(label):
+        ok = [0] * (h_threads + s_threads)
+        shed = [0] * (h_threads + s_threads)
+        failed = [0] * (h_threads + s_threads)
+        t_end = time.monotonic() + q_secs
+
+        def worker(i, width):
+            xa = np.random.RandomState(i).randn(2, width).astype(
+                np.float32)
+            while time.monotonic() < t_end:
+                try:
+                    engine.infer([xa], timeout=30)
+                    ok[i] += 1
+                except RetryableError:
+                    shed[i] += 1
+                    time.sleep(0.002)
+                except RuntimeError:
+                    failed[i] += 1  # raw poison before the breaker trips
+        threads = ([threading.Thread(target=worker, args=(i, hidden))
+                    for i in range(h_threads)]
+                   + [threading.Thread(target=worker,
+                                       args=(h_threads + j, sick_width))
+                      for j in range(s_threads)])
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(q_secs + 60)
+        h_qps = sum(ok[:h_threads]) / q_secs
+        s_ok = sum(ok[h_threads:])
+        s_shed = sum(shed[h_threads:])
+        s_failed = sum(failed[h_threads:])
+        log(f"{label}: healthy {h_qps:.0f} QPS, sick ok={s_ok} "
+            f"shed={s_shed} failed={s_failed}")
+        return h_qps, s_ok, s_shed, s_failed
+
+    h_qps0, s_ok0, _, _ = drive_engine("quarantine baseline")
+    chaos.arm("bench.sick.execute", times=1 << 30,
+              exc=RuntimeError("bench poison"))
+    h_qps1, s_ok1, s_shed1, s_failed1 = drive_engine("quarantine poisoned")
+    chaos.reset()
+    # after the cooldown the half-open probe re-executes (poison gone)
+    # and the bucket heals
+    time.sleep(1.2)
+    recovered = False
+    sick_x = np.zeros((2, sick_width), np.float32)
+    for _ in range(5):
+        try:
+            engine.infer([sick_x], timeout=30)
+            recovered = True
+            break
+        except (RetryableError, RuntimeError):
+            time.sleep(0.5)
+    healthy_ratio = h_qps1 / h_qps0 if h_qps0 else 0.0
+    engine.close()
+    server.stop()
+
+    goodput_ratio = chaos_qps / healthy_qps if healthy_qps else 0.0
+    log(f"goodput under scheduler chaos: {goodput_ratio:.2f}x healthy "
+        f"({restarts} restarts), reload drops {reload_shed}, "
+        f"quarantined healthy ratio {healthy_ratio:.2f}, "
+        f"recovered={recovered}")
+    rec = {
+        "metric": "serving_goodput_qps_under_chaos",
+        "value": round(chaos_qps, 1),
+        "unit": "req/s",
+        # goodput retained under injected scheduler death vs healthy
+        "vs_baseline": round(goodput_ratio, 4),
+        "clients": clients,
+        "healthy_qps": round(healthy_qps, 1),
+        "healthy_shed": int(healthy_shed),
+        "chaos_qps": round(chaos_qps, 1),
+        "chaos_shed": int(chaos_shed),
+        "scheduler_restarts": int(restarts),
+        "reload_qps": round(reload_qps, 1),
+        "reload_dropped": int(reload_shed),
+        "reload_s": reload_info["reload_s"],
+        "reload_cold_compiles": int(reload_cold_compiles),
+        "quarantine_healthy_ratio": round(healthy_ratio, 4),
+        "quarantine_sick_shed": int(s_shed1),
+        "quarantine_sick_failed": int(s_failed1),
+        "quarantine_recovered": bool(recovered),
     }
     if smoke:
         rec["smoke"] = True

@@ -7,9 +7,14 @@
 //   request:  u32 body_len | u8 cmd(1=infer) | u8 n_inputs |
 //             per input: u8 dtype(0=f32,1=i32,2=i64,3=bool) u8 ndim
 //             i64 dims[] data
+//             optionally followed by u8 0xDD | f64 timeout_ms (a
+//             per-request deadline; servers predating it ignore the
+//             trailing bytes)
 //   response: u32 body_len | u8 status | same encoding of outputs
-//   status:   0 ok | 1 error | 2 overloaded (request shed by the
-//             server's batching engine — back off and retry)
+//   status:   0 ok | 1 error | 2 retryable (request shed by the
+//             server's batching engine, a quarantined bucket, a
+//             scheduler restart, or an expired deadline — back off
+//             and retry; see WithRetry)
 package paddletpu
 
 import (
@@ -17,7 +22,9 @@ import (
 	"fmt"
 	"io"
 	"math"
+	"math/rand"
 	"net"
+	"time"
 )
 
 // Tensor is a dense row-major array: set exactly one of Data (f32),
@@ -40,27 +47,126 @@ const (
 
 var dtypeSize = map[byte]int{dtypeF32: 4, dtypeI32: 4, dtypeI64: 8, dtypeBool: 1}
 
-// ErrOverloaded is returned by Run when the server shed the request
-// (status 2: its batching-engine queue is full) — retry after backoff.
+// ErrOverloaded is returned by Run when the server answered with the
+// retryable status (2): its batching-engine queue is full, the target
+// bucket is quarantined, the scheduler was restarted mid-group, or the
+// request's deadline expired. Back off and retry — or construct the
+// predictor with WithRetry to have Run do the bounded
+// backoff-and-retry itself.
 var ErrOverloaded = fmt.Errorf("server overloaded: request shed (status 2)")
+
+// deadlineMarker tags the optional trailing deadline field on an infer
+// body (mirrors server.py DEADLINE_MARKER).
+const deadlineMarker = 0xDD
 
 // Predictor holds one connection to a PredictorServer.
 type Predictor struct {
+	addr string
+	// nil after an I/O error desynced the frame stream (a late response
+	// to a timed-out request would otherwise be read as the answer to
+	// the NEXT request); the next attempt redials
 	conn net.Conn
+	// per-request deadline: sent on the wire (the server drops expired
+	// work before dispatch) and applied to the socket I/O
+	timeout time.Duration
+	// bounded retry on ErrOverloaded (status 2): exponential backoff
+	// with +/-50% jitter, mirroring resilience/retry.py
+	retryAttempts  int
+	retryBaseDelay time.Duration
+	retryMaxDelay  time.Duration
 }
 
-func NewPredictor(addr string) (*Predictor, error) {
-	conn, err := net.Dial("tcp", addr)
+// Option configures a Predictor (NewPredictor(addr, opts...)).
+type Option func(*Predictor)
+
+// WithTimeout sets a per-request deadline: each Run attempt carries it
+// on the wire (the server drops the request without dispatch once it
+// expires — no compute for a client that gave up) and bounds the
+// socket I/O for the attempt.
+func WithTimeout(d time.Duration) Option {
+	return func(p *Predictor) { p.timeout = d }
+}
+
+// WithRetry makes Run retry up to maxAttempts times when the server
+// answers with the retryable status 2 (ErrOverloaded), sleeping
+// baseDelay*2^k (capped at maxDelay) with +/-50% jitter between
+// attempts — the backoff shape of resilience/retry.py. Other errors
+// are returned immediately.
+func WithRetry(maxAttempts int, baseDelay, maxDelay time.Duration) Option {
+	return func(p *Predictor) {
+		p.retryAttempts = maxAttempts
+		p.retryBaseDelay = baseDelay
+		p.retryMaxDelay = maxDelay
+	}
+}
+
+func NewPredictor(addr string, opts ...Option) (*Predictor, error) {
+	p := &Predictor{addr: addr, retryAttempts: 1}
+	for _, o := range opts {
+		o(p)
+	}
+	if p.retryAttempts < 1 {
+		p.retryAttempts = 1
+	}
+	// options first, so WithTimeout bounds the initial connect too (a
+	// bare Dial blocks for the OS connect default — minutes)
+	var conn net.Conn
+	var err error
+	if p.timeout > 0 {
+		conn, err = net.DialTimeout("tcp", addr, p.timeout)
+	} else {
+		conn, err = net.Dial("tcp", addr)
+	}
 	if err != nil {
 		return nil, err
 	}
-	return &Predictor{conn: conn}, nil
+	p.conn = conn
+	return p, nil
 }
 
-func (p *Predictor) Close() error { return p.conn.Close() }
+func (p *Predictor) Close() error {
+	if p.conn == nil {
+		return nil
+	}
+	return p.conn.Close()
+}
 
-// Run sends the inputs and returns the model outputs.
+// ioError poisons the connection after a failed write or read: the
+// frame stream is desynced (the server's late response would be read
+// as the answer to the next request, silently returning wrong
+// tensors), so drop it and let the next attempt redial.
+func (p *Predictor) ioError(err error) error {
+	if p.conn != nil {
+		_ = p.conn.Close()
+		p.conn = nil
+	}
+	return err
+}
+
+// Run sends the inputs and returns the model outputs, honoring the
+// WithTimeout deadline and the WithRetry backoff policy.
 func (p *Predictor) Run(inputs []Tensor) ([]Tensor, error) {
+	var last error
+	for attempt := 0; attempt < p.retryAttempts; attempt++ {
+		if attempt > 0 {
+			// base*2^k capped, +/-50% jitter (resilience/retry.py)
+			d := float64(p.retryBaseDelay) * math.Pow(2, float64(attempt-1))
+			if ceil := float64(p.retryMaxDelay); ceil > 0 && d > ceil {
+				d = ceil
+			}
+			d *= 1.0 + 0.5*(2.0*rand.Float64()-1.0)
+			time.Sleep(time.Duration(d))
+		}
+		outs, err := p.runOnce(inputs)
+		if err != ErrOverloaded {
+			return outs, err
+		}
+		last = err
+	}
+	return nil, last
+}
+
+func (p *Predictor) runOnce(inputs []Tensor) ([]Tensor, error) {
 	body := []byte{1, byte(len(inputs))}
 	for i, t := range inputs {
 		set := 0
@@ -111,17 +217,43 @@ func (p *Predictor) Run(inputs []Tensor) ([]Tensor, error) {
 			}
 		}
 	}
+	if p.conn == nil {
+		// previous attempt hit an I/O error and poisoned the stream;
+		// bound the redial by the request timeout (a bare Dial blocks
+		// for the OS connect default — minutes — ignoring WithTimeout)
+		var conn net.Conn
+		var err error
+		if p.timeout > 0 {
+			conn, err = net.DialTimeout("tcp", p.addr, p.timeout)
+		} else {
+			conn, err = net.Dial("tcp", p.addr)
+		}
+		if err != nil {
+			return nil, err
+		}
+		p.conn = conn
+	}
+	conn := p.conn
+	if p.timeout > 0 {
+		// optional wire deadline field (old servers ignore it) + a
+		// matching socket deadline for this attempt
+		body = append(body, deadlineMarker)
+		ms := float64(p.timeout) / float64(time.Millisecond)
+		body = binary.LittleEndian.AppendUint64(body, math.Float64bits(ms))
+		_ = conn.SetDeadline(time.Now().Add(p.timeout))
+		defer conn.SetDeadline(time.Time{})
+	}
 	hdr := binary.LittleEndian.AppendUint32(nil, uint32(len(body)))
-	if _, err := p.conn.Write(append(hdr, body...)); err != nil {
-		return nil, err
+	if _, err := conn.Write(append(hdr, body...)); err != nil {
+		return nil, p.ioError(err)
 	}
 	var rlenBuf [4]byte
-	if _, err := io.ReadFull(p.conn, rlenBuf[:]); err != nil {
-		return nil, err
+	if _, err := io.ReadFull(conn, rlenBuf[:]); err != nil {
+		return nil, p.ioError(err)
 	}
 	resp := make([]byte, binary.LittleEndian.Uint32(rlenBuf[:]))
-	if _, err := io.ReadFull(p.conn, resp); err != nil {
-		return nil, err
+	if _, err := io.ReadFull(conn, resp); err != nil {
+		return nil, p.ioError(err)
 	}
 	if len(resp) < 1 {
 		return nil, fmt.Errorf("empty response")

@@ -1,0 +1,206 @@
+package paddletpu
+
+import (
+	"encoding/binary"
+	"errors"
+	"math"
+	"net"
+	"testing"
+	"time"
+)
+
+// fakeServer answers each infer frame with the scripted status bytes in
+// order (repeating the last one), echoing a single f32 output of one
+// element on status 0. It records each received body for assertions.
+func fakeServer(t *testing.T, statuses []byte) (addr string, bodies chan []byte) {
+	t.Helper()
+	ln, err := net.Listen("tcp", "127.0.0.1:0")
+	if err != nil {
+		t.Fatal(err)
+	}
+	t.Cleanup(func() { ln.Close() })
+	bodies = make(chan []byte, 16)
+	go func() {
+		conn, err := ln.Accept()
+		if err != nil {
+			return
+		}
+		defer conn.Close()
+		for i := 0; ; i++ {
+			hdr := make([]byte, 4)
+			if _, err := readFull(conn, hdr); err != nil {
+				return
+			}
+			body := make([]byte, binary.LittleEndian.Uint32(hdr))
+			if _, err := readFull(conn, body); err != nil {
+				return
+			}
+			bodies <- body
+			st := statuses[len(statuses)-1]
+			if i < len(statuses) {
+				st = statuses[i]
+			}
+			var resp []byte
+			if st == 0 {
+				// status | n_out=1 | dtype=f32 ndim=1 dims=[1] | 1.0f
+				resp = []byte{0, 1, 0, 1}
+				resp = binary.LittleEndian.AppendUint64(resp, 1)
+				resp = binary.LittleEndian.AppendUint32(resp,
+					math.Float32bits(1.0))
+			} else {
+				resp = []byte{st}
+			}
+			out := binary.LittleEndian.AppendUint32(nil, uint32(len(resp)))
+			if _, err := conn.Write(append(out, resp...)); err != nil {
+				return
+			}
+		}
+	}()
+	return ln.Addr().String(), bodies
+}
+
+func readFull(conn net.Conn, buf []byte) (int, error) {
+	got := 0
+	for got < len(buf) {
+		n, err := conn.Read(buf[got:])
+		if err != nil {
+			return got, err
+		}
+		got += n
+	}
+	return got, nil
+}
+
+func oneInput() []Tensor {
+	return []Tensor{{Dims: []int64{1}, Data: []float32{2.0}}}
+}
+
+func TestRunWithoutRetryReturnsErrOverloaded(t *testing.T) {
+	addr, _ := fakeServer(t, []byte{2})
+	p, err := NewPredictor(addr)
+	if err != nil {
+		t.Fatal(err)
+	}
+	defer p.Close()
+	if _, err := p.Run(oneInput()); !errors.Is(err, ErrOverloaded) {
+		t.Fatalf("want ErrOverloaded, got %v", err)
+	}
+}
+
+func TestWithRetrySucceedsAfterBackoff(t *testing.T) {
+	// two sheds, then success: WithRetry(3, ...) must deliver the result
+	addr, _ := fakeServer(t, []byte{2, 2, 0})
+	p, err := NewPredictor(addr,
+		WithRetry(3, time.Millisecond, 10*time.Millisecond))
+	if err != nil {
+		t.Fatal(err)
+	}
+	defer p.Close()
+	outs, err := p.Run(oneInput())
+	if err != nil {
+		t.Fatalf("retry did not recover: %v", err)
+	}
+	if len(outs) != 1 || outs[0].Data[0] != 1.0 {
+		t.Fatalf("bad output: %+v", outs)
+	}
+}
+
+func TestWithRetryBoundedAttempts(t *testing.T) {
+	addr, bodies := fakeServer(t, []byte{2})
+	p, err := NewPredictor(addr,
+		WithRetry(3, time.Millisecond, 10*time.Millisecond))
+	if err != nil {
+		t.Fatal(err)
+	}
+	defer p.Close()
+	if _, err := p.Run(oneInput()); !errors.Is(err, ErrOverloaded) {
+		t.Fatalf("want ErrOverloaded after bounded retries, got %v", err)
+	}
+	if n := len(bodies); n != 3 {
+		t.Fatalf("want exactly 3 attempts on the wire, got %d", n)
+	}
+}
+
+func TestWithTimeoutAppendsWireDeadline(t *testing.T) {
+	addr, bodies := fakeServer(t, []byte{0})
+	p, err := NewPredictor(addr, WithTimeout(250*time.Millisecond))
+	if err != nil {
+		t.Fatal(err)
+	}
+	defer p.Close()
+	if _, err := p.Run(oneInput()); err != nil {
+		t.Fatal(err)
+	}
+	body := <-bodies
+	if len(body) < 9 || body[len(body)-9] != deadlineMarker {
+		t.Fatalf("deadline marker missing from body tail: % x", body)
+	}
+	ms := math.Float64frombits(
+		binary.LittleEndian.Uint64(body[len(body)-8:]))
+	if ms != 250.0 {
+		t.Fatalf("want 250ms on the wire, got %v", ms)
+	}
+}
+
+func TestTimeoutPoisonsConnAndRedials(t *testing.T) {
+	// A server that stays silent on the first connection (forcing the
+	// client's socket deadline to fire) and serves correctly on later
+	// ones: Run must fail with a timeout, then succeed on a FRESH
+	// connection — never read the first request's late response as the
+	// next request's answer.
+	ln, err := net.Listen("tcp", "127.0.0.1:0")
+	if err != nil {
+		t.Fatal(err)
+	}
+	t.Cleanup(func() { ln.Close() })
+	conns := make(chan net.Conn, 4)
+	go func() {
+		for i := 0; ; i++ {
+			conn, err := ln.Accept()
+			if err != nil {
+				return
+			}
+			conns <- conn
+			if i == 0 {
+				continue // silent: swallow the request, never reply
+			}
+			go func(c net.Conn) {
+				defer c.Close()
+				hdr := make([]byte, 4)
+				if _, err := readFull(c, hdr); err != nil {
+					return
+				}
+				body := make([]byte, binary.LittleEndian.Uint32(hdr))
+				if _, err := readFull(c, body); err != nil {
+					return
+				}
+				resp := []byte{0, 1, 0, 1}
+				resp = binary.LittleEndian.AppendUint64(resp, 1)
+				resp = binary.LittleEndian.AppendUint32(resp,
+					math.Float32bits(1.0))
+				out := binary.LittleEndian.AppendUint32(nil,
+					uint32(len(resp)))
+				_, _ = c.Write(append(out, resp...))
+			}(conn)
+		}
+	}()
+	p, err := NewPredictor(ln.Addr().String(),
+		WithTimeout(50*time.Millisecond))
+	if err != nil {
+		t.Fatal(err)
+	}
+	defer p.Close()
+	if _, err := p.Run(oneInput()); err == nil {
+		t.Fatal("want a timeout error from the silent connection")
+	}
+	outs, err := p.Run(oneInput())
+	if err != nil {
+		t.Fatalf("redial after poisoned connection failed: %v", err)
+	}
+	if len(outs) != 1 || outs[0].Data[0] != 1.0 {
+		t.Fatalf("bad output after redial: %+v", outs)
+	}
+	if n := len(conns); n != 2 {
+		t.Fatalf("want exactly one redial (2 connections), got %d", n)
+	}
+}

@@ -4,9 +4,14 @@
 #   request:  u32 body_len | u8 cmd(1) | u8 n_inputs |
 #             per input: u8 dtype(0=f32,1=i32,2=i64,3=bool) u8 ndim
 #             i64 dims[] data
+#             optionally followed by u8 0xDD | f64 timeout_ms (a
+#             per-request deadline; servers predating it ignore the
+#             trailing bytes)
 #   response: u32 body_len | u8 status | same encoding of outputs
-#   status:   0 ok | 1 error | 2 overloaded (request shed by the
-#             server's batching engine — back off and retry)
+#   status:   0 ok | 1 error | 2 retryable (request shed by the
+#             server's batching engine, a quarantined bucket, a
+#             scheduler restart, or an expired deadline — back off and
+#             retry; see the retries= argument of pd_predict)
 
 pd_connect <- function(host = "127.0.0.1", port) {
   socketConnection(host, port, blocking = TRUE, open = "r+b")
@@ -33,8 +38,15 @@ pd_connect <- function(host = "127.0.0.1", port) {
   writeBin(as.integer(hi), buf, size = 4, endian = "little")
 }
 
+# One prediction round-trip. timeout_ms adds the optional wire deadline
+# field (the server drops the request without dispatch once the budget
+# is spent). retries > 0 retries a status-2 (retryable) response with
+# exponential backoff + jitter — the backoff shape of
+# paddle_tpu/resilience/retry.py: base * 2^k capped, *(1 +/- 0.5*u).
 pd_predict <- function(con, x, dtype = c("float32", "int32", "int64",
-                                         "bool")) {
+                                         "bool"),
+                       timeout_ms = NULL, retries = 0L,
+                       backoff_base = 0.1, backoff_max = 2.0) {
   dtype <- match.arg(dtype)
   dims <- if (is.null(dim(x))) length(x) else dim(x)
   # R stores column-major; the wire format is row-major — aperm handles
@@ -54,15 +66,27 @@ pd_predict <- function(con, x, dtype = c("float32", "int32", "int64",
   } else {
     writeBin(data, buf, size = 4, endian = "little")
   }
+  if (!is.null(timeout_ms)) {
+    writeBin(as.raw(0xDD), buf)
+    writeBin(as.numeric(timeout_ms), buf, size = 8, endian = "little")
+  }
   body <- rawConnectionValue(buf)
   close(buf)
-  writeBin(length(body), con, size = 4, endian = "little")
-  writeBin(body, con)
-  flush(con)
 
-  rlen <- readBin(con, "integer", size = 4, endian = "little")
-  resp <- readBin(con, "raw", n = rlen)
-  status <- as.integer(resp[1])
+  status <- 2L
+  for (attempt in seq_len(as.integer(retries) + 1L)) {
+    if (attempt > 1L) {
+      delay <- min(backoff_max, backoff_base * 2^(attempt - 2L))
+      Sys.sleep(delay * (1 + 0.5 * (2 * stats::runif(1) - 1)))
+    }
+    writeBin(length(body), con, size = 4, endian = "little")
+    writeBin(body, con)
+    flush(con)
+    rlen <- readBin(con, "integer", size = 4, endian = "little")
+    resp <- readBin(con, "raw", n = rlen)
+    status <- as.integer(resp[1])
+    if (status != 2) break
+  }
   if (status == 2)
     stop("server overloaded: request shed (status 2) - retry with backoff")
   stopifnot(status == 0)

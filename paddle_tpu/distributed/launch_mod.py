@@ -10,11 +10,36 @@ harness, test_dist_base.py:682): each local proc gets a distinct global
 rank and a single virtual CPU device.
 """
 import os
+import re
 import signal
 import socket
 import subprocess
 import sys
 import time
+
+# Known-transient trainer crash signatures, worth a bounded pod rerun
+# (launch_collective transient_retries): gloo's TCP transport has a
+# framing race on loopback CPU runs — two collectives' payloads race on
+# one pair and the size check aborts the process ("op.preamble.length <=
+# op.nbytes", gloo/transport/tcp/pair.cc) — and the coordination-service
+# cascade a dying peer triggers in the OTHER ranks is equally transient.
+_TRANSIENT_RE = re.compile(
+    r"op\.preamble\.length|gloo::EnforceNotMet"
+    r"|Terminating process because the JAX distributed service")
+
+
+def _failure_is_transient(err):
+    """Is this pod failure worth a bounded relaunch? Only a trainer
+    killed by a signal (negative returncode) qualifies — a clean nonzero
+    sys.exit is deterministic — and when its log was captured, the crash
+    must match a known-transient signature."""
+    tp = getattr(err, "trainer", None)
+    if tp is None or tp.proc.returncode is None or tp.proc.returncode >= 0:
+        return False
+    if tp.log_path and os.path.exists(tp.log_path):
+        with open(tp.log_path, errors="replace") as f:
+            return bool(_TRANSIENT_RE.search(f.read()))
+    return True  # signal death, no log captured: assume transient
 
 
 def find_free_port():
@@ -64,8 +89,10 @@ def watch_local_trainers(procs, poll_interval=0.5):
                     alive = True
                 elif ret != 0:
                     terminate_local_procs(procs)
-                    raise RuntimeError(
+                    err = RuntimeError(
                         f"trainer rank {tp.rank} exited with code {ret}")
+                    err.trainer = tp  # inspected by transient_retries
+                    raise err
             if not alive:
                 return 0
             time.sleep(poll_interval)
@@ -89,28 +116,51 @@ def terminate_local_procs(procs, grace=3.0):
 
 def launch_collective(script, args=(), nproc_per_node=1, nnodes=1,
                       node_rank=0, master=None, log_dir=None,
-                      extra_env=None):
+                      extra_env=None, transient_retries=0):
     """Spawn nproc_per_node trainer processes on this node and watch them
-    (reference: launch.py:215 launch_collective)."""
+    (reference: launch.py:215 launch_collective).
+
+    ``transient_retries`` bounds a rerun of the whole pod when a trainer
+    is killed by a signal with a known-transient crash signature in its
+    log (the gloo TCP framing race aborts a CPU worker ~50% of the time
+    on this box — see _TRANSIENT_RE). A clean nonzero exit is
+    deterministic and never retried. Each attempt rendezvouses on a
+    fresh master port unless the caller pinned one."""
     world = nnodes * nproc_per_node
-    master = master or f"127.0.0.1:{find_free_port()}"
-    procs = []
-    for local_rank in range(nproc_per_node):
-        rank = node_rank * nproc_per_node + local_rank
-        env = get_cluster_env(rank, world, master, local_rank)
-        if extra_env:
-            env.update({k: str(v) for k, v in extra_env.items()})
-        stdout = None
-        log_path = None
-        if log_dir:
-            os.makedirs(log_dir, exist_ok=True)
-            log_path = os.path.join(log_dir, f"workerlog.{rank}")
-            stdout = open(log_path, "w")
-        proc = subprocess.Popen([sys.executable, script, *map(str, args)],
-                                env=env, stdout=stdout,
-                                stderr=subprocess.STDOUT if stdout else None)
-        procs.append(TrainerProc(proc, rank, log_path))
-    return watch_local_trainers(procs)
+    for attempt in range(int(transient_retries) + 1):
+        rdv = master or f"127.0.0.1:{find_free_port()}"
+        procs = []
+        for local_rank in range(nproc_per_node):
+            rank = node_rank * nproc_per_node + local_rank
+            env = get_cluster_env(rank, world, rdv, local_rank)
+            if extra_env:
+                env.update({k: str(v) for k, v in extra_env.items()})
+            stdout = None
+            log_path = None
+            if log_dir:
+                os.makedirs(log_dir, exist_ok=True)
+                # retry attempts get their own files: reopening the
+                # attempt-0 name with "w" would truncate the crash
+                # evidence the transient check just matched
+                suffix = f".attempt{attempt}" if attempt else ""
+                log_path = os.path.join(log_dir,
+                                        f"workerlog.{rank}{suffix}")
+                stdout = open(log_path, "w")
+            proc = subprocess.Popen(
+                [sys.executable, script, *map(str, args)],
+                env=env, stdout=stdout,
+                stderr=subprocess.STDOUT if stdout else None)
+            if stdout is not None:
+                stdout.close()  # the child owns the fd now
+            procs.append(TrainerProc(proc, rank, log_path))
+        try:
+            return watch_local_trainers(procs)
+        except RuntimeError as e:
+            if attempt >= transient_retries or not _failure_is_transient(e):
+                raise
+            print(f"[launch] transient trainer crash (attempt "
+                  f"{attempt + 1}/{transient_retries + 1}): {e}; "
+                  "relaunching pod", file=sys.stderr, flush=True)
 
 
 def launch_elastic(script, args=(), nproc_per_node=1, nnodes=1,

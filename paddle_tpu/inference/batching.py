@@ -8,10 +8,12 @@ compile per novel shape. This engine is the runtime complement to
 tracelint's static recompilation-hazard passes (TPU101-TPU104):
 
   requests --> bounded queue --> scheduler thread --> padded bucket batch
-                (load shed)       (fire on max_batch_size                 \
-                                   or max_wait_ms)                         --> per-bucket
-                                                                               AOT-compiled
-  response <-- slice rows off <---------------------------------------------- program
+                (load shed,       (fire on max_batch_size                 \
+                 deadline purge)   or max_wait_ms)                         --> per-bucket
+                                        ^                                      AOT-compiled
+  response <-- slice rows off <---------|--------------------------------- program
+                                   watchdog thread
+                              (heartbeat check, restart)
 
 Shape buckets are powers of two (clamped to ``max_batch_size``): padding
 the coalesced row count up to the next bucket means each bucket's
@@ -20,6 +22,41 @@ Declared buckets are precompiled by :meth:`BatchingEngine.warmup` so the
 first real request never eats a compile. The bounded queue plus
 :class:`EngineOverloaded` (wire status ``2``) turn saturation into fast
 rejection — load shedding — instead of unbounded memory growth.
+
+Graceful degradation (at production scale, *recovering* from component
+failure — not avoiding it — is what preserves throughput):
+
+- **Scheduler watchdog**: the scheduler bumps a heartbeat each loop; a
+  watchdog thread restarts a dead or wedged scheduler, failing only the
+  in-flight group with a retryable status (:class:`SchedulerRestarted`,
+  wire status 2) — parked requests are served by the restarted
+  scheduler, never stranded.
+- **Poisoned-bucket quarantine**: N consecutive compile/execute failures
+  for one (bucket, signature) trip a circuit breaker — that bucket sheds
+  fast (:class:`BucketQuarantined`, wire status 2) while other buckets
+  keep serving; after a cooldown one half-open probe group re-admits it.
+- **Deadlines**: a request may carry an absolute deadline; expired
+  requests are purged *before* dispatch (no compute for a client that
+  already gave up) and a group never waits past the tightest deadline of
+  its members.
+- **Chaos sites**: ``serving.scheduler.loop``, ``serving.compile[.bucketN]``,
+  ``serving.execute[.bucketN]`` and ``serving.submit`` let the
+  deterministic chaos harness (resilience/chaos.py) inject scheduler
+  death, poisoned buckets, and mid-batch failures in CI.
+
+Env knobs (constructor kwargs override):
+    PADDLE_TPU_SERVING_BREAKER_THRESHOLD   consecutive failures to trip
+                                           a bucket breaker (default 3;
+                                           0 disables the breaker)
+    PADDLE_TPU_SERVING_BREAKER_COOLDOWN    seconds an open breaker waits
+                                           before its half-open probe
+                                           (default 5.0)
+    PADDLE_TPU_SERVING_WATCHDOG_INTERVAL   heartbeat check period
+                                           (default 0.5; 0 disables the
+                                           watchdog)
+    PADDLE_TPU_SERVING_WEDGE_TIMEOUT       heartbeat staleness (with work
+                                           pending) treated as a wedged
+                                           scheduler (default 30.0)
 
 Determinism contract (verified in tests/test_serving_batching.py):
 engine outputs are bitwise identical to unbatched ``Predictor.run`` for
@@ -35,22 +72,53 @@ bucket 2 for the same reason: its rows came from a >= 2-row baseline
 dispatch, so it must stay in the gemm regime.
 """
 import json
+import os
 import threading
 import time
+import traceback
 import warnings
 
 import numpy as np
+
+from ..resilience import chaos
+from ..resilience.retry import _env_float, _env_int
 
 # Wire status byte for a shed request (server.py speaks it; defined here
 # so the engine has no import-time dependency on the server).
 OVERLOADED_STATUS = 2
 
 
-class EngineOverloaded(RuntimeError):
+class RetryableError(RuntimeError):
+    """Transient serving failure: the caller should back off and retry
+    (the server maps every subclass to wire status 2)."""
+
+    status_code = OVERLOADED_STATUS
+
+
+class EngineOverloaded(RetryableError):
     """Raised by submit/infer when the bounded queue is full: the caller
     should back off (the server maps this to wire status 2)."""
 
-    status_code = OVERLOADED_STATUS
+
+class SchedulerRestarted(RetryableError):
+    """The scheduler died or wedged while this request's group was in
+    flight; the watchdog restarted it. A dead scheduler never delivered
+    the group's results; a wedged one may still be executing it — either
+    way the results are discarded, never delivered, so retrying cannot
+    observe a double answer (a wedge-triggered retry can, however,
+    re-run rows the stuck execute eventually finishes — inference is
+    side-effect free, so duplicate compute, not duplicate effects)."""
+
+
+class BucketQuarantined(RetryableError):
+    """This request's (bucket, signature) breaker is open after repeated
+    compile/execute failures; the bucket sheds fast while it cools down.
+    Other buckets keep serving."""
+
+
+class DeadlineExceeded(RetryableError):
+    """The request's deadline passed before its batch dispatched; it was
+    dropped without spending compute (the client already gave up)."""
 
 
 class EngineClosed(RuntimeError):
@@ -74,9 +142,9 @@ def _signature(arrays):
 
 class _Request:
     __slots__ = ("inputs", "rows", "sig", "event", "outputs", "error",
-                 "t_enqueue", "min_bucket")
+                 "t_enqueue", "min_bucket", "deadline")
 
-    def __init__(self, inputs, rows, sig, min_bucket=1):
+    def __init__(self, inputs, rows, sig, min_bucket=1, deadline=None):
         self.inputs = inputs
         self.rows = rows
         self.sig = sig
@@ -89,6 +157,14 @@ class _Request:
         # (bucket 1 is XLA's gemv regime, which rounds differently) to
         # keep the split path bitwise equal to the unbatched baseline
         self.min_bucket = min_bucket
+        # absolute time.monotonic() drop-dead point (None = no deadline)
+        self.deadline = deadline
+
+    def fail(self, error):
+        """Deliver an error result unless a result already landed."""
+        if not self.event.is_set():
+            self.error = error
+            self.event.set()
 
 
 class _BucketStats:
@@ -116,6 +192,57 @@ class _BucketStats:
                       if self.batches else 0.0,
             "max_ms": round(self.max_ms, 3),
         }
+
+
+class _Breaker:
+    """Per-(bucket, signature) circuit breaker. All methods are called
+    under the engine lock.
+
+    closed --N consecutive failures--> open --cooldown--> half_open
+      ^                                 ^                    |
+      +------- probe succeeds ----------+--- probe fails ----+
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+    __slots__ = ("threshold", "cooldown", "state", "failures", "opened_at",
+                 "trips", "shed")
+
+    def __init__(self, threshold, cooldown):
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self.state = self.CLOSED
+        self.failures = 0
+        self.opened_at = 0.0
+        self.trips = 0
+        self.shed = 0
+
+    def allow(self, now):
+        """May a group for this bucket dispatch now? OPEN past its
+        cooldown admits exactly one probe (HALF_OPEN); a second group
+        while the probe is in flight is shed."""
+        if self.state == self.CLOSED:
+            return True
+        if self.state == self.OPEN and now - self.opened_at >= self.cooldown:
+            self.state = self.HALF_OPEN
+            return True
+        return False
+
+    def record_success(self):
+        self.failures = 0
+        self.state = self.CLOSED
+
+    def record_failure(self, now):
+        self.failures += 1
+        if self.threshold <= 0:
+            return  # breaker disabled: count but never trip
+        if self.state == self.HALF_OPEN or self.failures >= self.threshold:
+            self.state = self.OPEN
+            self.opened_at = now
+            self.trips += 1
+
+    def as_dict(self):
+        return {"state": self.state, "consecutive_failures": self.failures,
+                "trips": self.trips, "shed": self.shed}
 
 
 class AotLayerRunner:
@@ -262,10 +389,18 @@ class BatchingEngine:
                       pending request has waited this long
       max_queue       bounded pending-request cap; beyond it submit()
                       sheds with EngineOverloaded (wire status 2)
+      breaker_threshold / breaker_cooldown
+                      poisoned-bucket quarantine (see _Breaker); env
+                      defaults PADDLE_TPU_SERVING_BREAKER_*
+      watchdog_interval / wedge_timeout
+                      scheduler self-healing cadence; env defaults
+                      PADDLE_TPU_SERVING_WATCHDOG_INTERVAL / _WEDGE_TIMEOUT
     """
 
     def __init__(self, runner, max_batch_size=32, max_wait_ms=2.0,
-                 max_queue=256, name="engine"):
+                 max_queue=256, name="engine", breaker_threshold=None,
+                 breaker_cooldown=None, watchdog_interval=None,
+                 wedge_timeout=None, cold_compile_timeout=None):
         if max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
         self._runner = runner
@@ -273,22 +408,66 @@ class BatchingEngine:
         self.max_wait_s = float(max_wait_ms) / 1000.0
         self.max_queue = int(max_queue)
         self.name = name
+        self.breaker_threshold = int(
+            breaker_threshold if breaker_threshold is not None
+            else _env_int("PADDLE_TPU_SERVING_BREAKER_THRESHOLD", 3))
+        self.breaker_cooldown = float(
+            breaker_cooldown if breaker_cooldown is not None
+            else _env_float("PADDLE_TPU_SERVING_BREAKER_COOLDOWN", 5.0))
+        self.watchdog_interval = float(
+            watchdog_interval if watchdog_interval is not None
+            else _env_float("PADDLE_TPU_SERVING_WATCHDOG_INTERVAL", 0.5))
+        self.wedge_timeout = float(
+            wedge_timeout if wedge_timeout is not None
+            else _env_float("PADDLE_TPU_SERVING_WEDGE_TIMEOUT", 30.0))
+        # a cold-bucket compile runs on its own thread, outside the
+        # scheduler the watchdog heartbeats — bound it separately
+        # (generous: XLA compiles legitimately take tens of seconds)
+        # so a wedged compile fails its waiters retryably instead of
+        # hanging them forever. Enforced by the watchdog; 0 disables.
+        self.cold_compile_timeout = float(
+            cold_compile_timeout if cold_compile_timeout is not None
+            else _env_float("PADDLE_TPU_SERVING_COLD_COMPILE_TIMEOUT",
+                            300.0))
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._pending = []  # FIFO of _Request
         self._cache = {}  # (bucket, sig) -> compiled run callable
         self._compiling = {}  # (bucket, sig) -> Event for in-flight compile
         self._bucket_stats = {}  # (bucket, sig) -> _BucketStats
+        self._breakers = {}  # (bucket, sig) -> _Breaker
         self._shed_count = 0
+        self._quarantine_shed = 0
+        self._deadline_expired = 0  # dropped pre-dispatch, zero compute
+        self._deadline_late = 0  # expired in flight, batch may have run
+        self._deadline_seen = False  # any deadline-bearing submit yet?
         self._n_requests = 0
         self._n_rows = 0
         self._declared = []  # bucket row counts from warmup()
         self._cold_threads = []  # in-flight cold-bucket compile threads
+        self._cold_seq = 0
+        self._cold_inflight = {}  # token -> (group, t_start): groups in
+        # cold-compile threads, invisible to the scheduler heartbeat —
+        # the watchdog bounds these by cold_compile_timeout
         self._closed = False
-        self._scheduler = threading.Thread(target=self._run_scheduler,
-                                           name=f"{name}-scheduler",
-                                           daemon=True)
+        self._closed_ev = threading.Event()
+        # --- scheduler self-healing state ---
+        # generation token: a watchdog restart bumps it; a superseded
+        # scheduler thread notices and exits instead of double-serving
+        self._sched_gen = 0
+        self._scheduler_restarts = 0
+        self._heartbeat = time.monotonic()  # bumped each scheduler loop
+        self._inflight = {}  # gen -> group popped but not yet delivered
+        self._watchdog = None  # before the scheduler starts: its crash
+        self._scheduler = threading.Thread(  # handler reads it
+            target=self._run_scheduler, args=(0,),
+            name=f"{name}-scheduler", daemon=True)
         self._scheduler.start()
+        if self.watchdog_interval > 0:
+            self._watchdog = threading.Thread(target=self._run_watchdog,
+                                              name=f"{name}-watchdog",
+                                              daemon=True)
+            self._watchdog.start()
 
     # ------------------------------------------------------- constructors
     @classmethod
@@ -303,9 +482,15 @@ class BatchingEngine:
         return cls(CallableRunner(fn), **kw)
 
     # ------------------------------------------------------------- submit
-    def infer(self, inputs, timeout=None):
+    def infer(self, inputs, timeout=None, deadline=None):
         """Run one request (list of arrays sharing dim 0 = rows) through
         the engine; returns the list of output arrays for those rows.
+
+        ``timeout`` bounds only this caller's wait; ``deadline`` (an
+        absolute ``time.monotonic()`` point) is additionally honored by
+        the scheduler: an expired request is purged before dispatch
+        (DeadlineExceeded) and a group never waits past the tightest
+        deadline of its members.
 
         Requests larger than max_batch_size are split into chunks and
         re-joined (the split path); each chunk occupies its own queue
@@ -322,12 +507,17 @@ class BatchingEngine:
                 raise ValueError(
                     "all inputs of one request must share dim 0 "
                     f"(got {[tuple(x.shape) for x in inputs]})")
+        if deadline is not None and time.monotonic() >= deadline:
+            with self._lock:
+                self._deadline_expired += 1
+            raise DeadlineExceeded(
+                f"{self.name}: deadline passed before submission")
         if rows > self.max_batch_size:
-            return self._infer_split(inputs, rows, timeout)
-        req = self._submit(inputs, rows)
+            return self._infer_split(inputs, rows, timeout, deadline)
+        req = self._submit(inputs, rows, deadline)
         return self._wait(req, timeout)
 
-    def _infer_split(self, inputs, rows, timeout):
+    def _infer_split(self, inputs, rows, timeout, deadline):
         n_chunks = -(-rows // self.max_batch_size)
         if n_chunks > self.max_queue:
             # a deterministic can-never-fit request must get a permanent
@@ -344,25 +534,43 @@ class BatchingEngine:
             chunks.append([a[lo:hi] for a in inputs])
         # all chunks are enqueued atomically: a partially-admitted
         # oversized request would compute rows only to discard them
-        # when a later chunk sheds
+        # when a later chunk sheds. One shared deadline covers them all
+        # (the tightest deadline in any group a chunk joins).
         reqs = self._submit_chunks(
-            chunks, min_bucket=min(2, self.max_batch_size))
-        deadline = (None if timeout is None
-                    else time.monotonic() + timeout)
+            chunks, min_bucket=min(2, self.max_batch_size),
+            deadline=deadline)
+        wait_until = (None if timeout is None
+                      else time.monotonic() + timeout)
         parts = []
-        for r in reqs:
-            left = (None if deadline is None
-                    else max(0.0, deadline - time.monotonic()))
-            parts.append(self._wait(r, left))
+        for i, r in enumerate(reqs):
+            left = (None if wait_until is None
+                    else max(0.0, wait_until - time.monotonic()))
+            try:
+                parts.append(self._wait(r, left))
+            except BaseException as e:
+                # the joined result can never be produced now: pull the
+                # sibling chunks still queued (freeing their shed-cap
+                # slots) and fail the rest, or they fire full padded
+                # batches nobody will ever read
+                with self._cond:
+                    for rest in reqs[i + 1:]:
+                        try:
+                            self._pending.remove(rest)
+                        except ValueError:
+                            pass  # already grouped/in flight; discarded
+                for rest in reqs[i + 1:]:
+                    rest.fail(e)
+                raise
         return [np.concatenate([p[i] for p in parts])
                 for i in range(len(parts[0]))]
 
-    def _submit(self, inputs, rows):
-        return self._submit_chunks([inputs])[0]
+    def _submit(self, inputs, rows, deadline=None):
+        return self._submit_chunks([inputs], deadline=deadline)[0]
 
-    def _submit_chunks(self, chunks, min_bucket=1):
+    def _submit_chunks(self, chunks, min_bucket=1, deadline=None):
         """Admit every chunk or none (one queue slot per chunk, so an
         oversized request still counts fully against the shed cap)."""
+        chaos.hit("serving.submit")
         with self._cond:
             if self._closed:
                 raise EngineClosed(f"{self.name} is closed")
@@ -373,9 +581,12 @@ class BatchingEngine:
                     f" cap {self.max_queue}, need {len(chunks)} slots); "
                     "request shed")
             reqs = []
+            if deadline is not None:
+                self._deadline_seen = True
             for chunk in chunks:
                 rows = int(chunk[0].shape[0])
-                req = _Request(chunk, rows, _signature(chunk), min_bucket)
+                req = _Request(chunk, rows, _signature(chunk), min_bucket,
+                               deadline)
                 self._pending.append(req)
                 self._n_requests += 1
                 self._n_rows += rows
@@ -383,57 +594,196 @@ class BatchingEngine:
             self._cond.notify_all()
         return reqs
 
-    @staticmethod
-    def _wait(req, timeout):
+    def _wait(self, req, timeout):
+        if req.deadline is not None:
+            # the scheduler purges expired requests (DeadlineExceeded);
+            # the small grace lets that cleaner error win over a bare
+            # TimeoutError when both fire together
+            dl_left = max(0.0, req.deadline - time.monotonic()) + 0.25
+            timeout = dl_left if timeout is None else min(timeout, dl_left)
         if not req.event.wait(timeout):
+            # abandon: pull it out of the queue so the scheduler never
+            # spends a batch slot computing rows nobody will read
+            with self._cond:
+                try:
+                    self._pending.remove(req)
+                except ValueError:
+                    pass  # already grouped/in flight; result is discarded
+            if (req.deadline is not None
+                    and time.monotonic() >= req.deadline):
+                with self._lock:
+                    # separate counter: deadline_expired promises "dropped
+                    # BEFORE dispatch, no compute spent" — an in-flight
+                    # expiry may have burned a full batch, and lumping it
+                    # in would skew the metric operators size budgets by
+                    self._deadline_late += 1
+                raise DeadlineExceeded(
+                    f"{self.name}: deadline passed while the request was "
+                    "in flight; the result (if any) was discarded")
             raise TimeoutError("engine did not answer within timeout")
         if req.error is not None:
             raise req.error
         return req.outputs
 
     # ---------------------------------------------------------- scheduler
-    def _run_scheduler(self):
+    def _run_scheduler(self, gen):
+        try:
+            self._scheduler_loop(gen)
+        except Exception:  # noqa: BLE001 - watchdog owns recovery
+            # The loop itself broke (injected chaos, unexpected bug).
+            # Log it — a scheduler that vanishes without a traceback is
+            # undebuggable — then die WITHOUT clearing _inflight: the
+            # watchdog fails that group with a retryable status and
+            # starts a replacement scheduler for the parked requests.
+            traceback.print_exc()
+            if self._watchdog is None:
+                # watchdog disabled (interval 0): nobody else will
+                # recover, so self-heal inline — the crash must never
+                # strand the in-flight group or the parked queue
+                self._restart_scheduler(gen, "died (watchdog disabled)")
+
+    def _scheduler_loop(self, gen):
         while True:
-            group = self._next_group()
+            self._heartbeat = time.monotonic()
+            group = self._next_group(gen)
             if group is None:
-                return  # closed and drained
-            key = (self._group_bucket(group), group[0].sig)
+                return  # closed and drained, or superseded by a restart
+            with self._lock:
+                self._inflight[gen] = group
+            # From here until dispatch hand-off, an unhandled exception
+            # (e.g. injected chaos) kills this thread WITH the group
+            # still recorded in _inflight — the watchdog then fails
+            # exactly that group with a retryable status (never a hang)
+            # and restarts the scheduler for the parked requests.
+            chaos.hit("serving.scheduler.loop")
+            bucket = self._group_bucket(group)
+            key = (bucket, group[0].sig)
+            now = time.monotonic()
+            with self._lock:
+                br = self._breaker_for(key)
+                allowed = br.allow(now)
+                if not allowed:
+                    br.shed += len(group)
+                    self._quarantine_shed += len(group)
+            if not allowed:
+                err = BucketQuarantined(
+                    f"{self.name} bucket {bucket} is quarantined after "
+                    f"{br.failures} consecutive failures; retry after "
+                    f"cooldown ({self.breaker_cooldown}s)")
+                for r in group:
+                    r.fail(err)
+                with self._lock:
+                    self._inflight.pop(gen, None)
+                continue
             with self._lock:
                 cold = key not in self._cache
             if cold:
                 # a cold bucket pays a multi-second XLA compile: run it
                 # on its own thread so already-compiled buckets keep
-                # flowing instead of stalling head-of-line behind it
-                t = threading.Thread(target=self._run_group_guarded,
-                                     args=(group,),
+                # flowing instead of stalling head-of-line behind it.
+                # The cold thread owns delivery from here (its guarded
+                # wrapper cannot strand waiters).
+                with self._lock:
+                    self._cold_seq += 1
+                    token = self._cold_seq
+                t = threading.Thread(target=self._run_cold_group,
+                                     args=(token, group, br),
                                      name=f"{self.name}-cold-compile",
                                      daemon=True)
                 with self._lock:
+                    self._inflight.pop(gen, None)
+                    self._cold_inflight[token] = (group, time.monotonic())
                     self._cold_threads = [x for x in self._cold_threads
                                           if x.is_alive()]
                     self._cold_threads.append(t)
                 t.start()
             else:
-                self._run_group_guarded(group)
+                try:
+                    self._run_group_guarded(group, br)
+                finally:
+                    # _run_group_guarded never raises (it fails the
+                    # group instead), so waiters are already answered —
+                    # clear even on a BaseException so a later watchdog
+                    # restart cannot double-fail a delivered group
+                    with self._lock:
+                        self._inflight.pop(gen, None)
 
-    def _run_group_guarded(self, group):
+    def _run_cold_group(self, token, group, br):
+        """Like _run_group_guarded, but the breaker outcome is recorded
+        only while this group still owns its cold-inflight token: once
+        the watchdog timed the group out it already recorded a failure
+        for this incident — the zombie thread's eventual outcome must
+        not count the same incident twice, and a late zombie success
+        must not flip an OPEN breaker straight past its cooldown."""
         try:
             self._run_group(group)
         except Exception as e:  # noqa: BLE001 - fail the group only
+            now = time.monotonic()
+            with self._lock:
+                owned = self._cold_inflight.pop(token, None) is not None
+                if br is not None and owned:
+                    br.record_failure(now)
             for r in group:
-                r.error = e
-                r.event.set()
+                r.fail(e)
+        else:
+            with self._lock:
+                owned = self._cold_inflight.pop(token, None) is not None
+                if br is not None and owned:
+                    br.record_success()
 
-    def _next_group(self):
+    def _run_group_guarded(self, group, br=None):
+        try:
+            self._run_group(group)
+        except Exception as e:  # noqa: BLE001 - fail the group only
+            now = time.monotonic()
+            with self._lock:
+                if br is not None:
+                    br.record_failure(now)
+            for r in group:
+                r.fail(e)
+        else:
+            with self._lock:
+                if br is not None:
+                    br.record_success()
+
+    def _purge_expired_locked(self, now):
+        """Drop pending requests whose deadline already passed — before
+        dispatch, so no compute is spent on a client that gave up.
+        Called with the lock held."""
+        if not self._deadline_seen:
+            # deadline-free deployments skip the per-iteration O(queue)
+            # scan entirely (sticky flag: set on the first deadline-
+            # bearing submit, never cleared)
+            return
+        expired = [r for r in self._pending
+                   if r.deadline is not None and now >= r.deadline]
+        if not expired:
+            return
+        for r in expired:
+            self._pending.remove(r)
+            self._deadline_expired += 1
+        err = DeadlineExceeded(
+            f"{self.name}: deadline passed while queued; request dropped "
+            "before dispatch")
+        for r in expired:
+            r.fail(err)
+
+    def _next_group(self, gen):
         """Block until a same-signature group is ready to fire: either
         max_batch_size rows are pending or the oldest request has waited
-        max_wait_ms. Returns the popped group (None = engine closed)."""
+        max_wait_ms — or the tightest deadline in the candidate group is
+        about to pass. Returns the popped group (None = engine closed or
+        this scheduler generation superseded)."""
         with self._cond:
             while True:
+                if self._sched_gen != gen:
+                    return None  # a watchdog restart superseded us
+                now = time.monotonic()
+                self._purge_expired_locked(now)
                 if not self._pending:
                     if self._closed:
                         return None
-                    self._cond.wait()
+                    self._cond.wait()  # a submit/close/restart notifies
                     continue
                 head = self._pending[0]
                 group, rows = [], 0
@@ -445,7 +795,15 @@ class BatchingEngine:
                     group.append(r)
                     rows += r.rows
                 deadline = head.t_enqueue + self.max_wait_s
-                now = time.monotonic()
+                tight = min((r.deadline for r in group
+                             if r.deadline is not None), default=None)
+                if tight is not None:
+                    # never coalesce-wait past the tightest deadline of
+                    # the group's members; the 5ms margin dispatches the
+                    # group BEFORE that deadline (the purge above would
+                    # otherwise drop the request at the exact instant
+                    # its group was due to fire)
+                    deadline = min(deadline, tight - 0.005)
                 if (rows >= self.max_batch_size or now >= deadline
                         or self._closed):
                     for r in group:
@@ -476,6 +834,8 @@ class BatchingEngine:
                 parts.append(np.zeros(pad_shape, parts[0].dtype))
             batch.append(np.concatenate(parts) if len(parts) > 1
                          else parts[0])
+        chaos.hit("serving.execute")
+        chaos.hit(f"serving.execute.bucket{bucket}")
         t0 = time.monotonic()
         outs = run(batch)
         dt_ms = (time.monotonic() - t0) * 1000.0
@@ -501,6 +861,115 @@ class BatchingEngine:
             st.total_ms += dt_ms
             st.max_ms = max(st.max_ms, dt_ms)
 
+    # ----------------------------------------------------------- watchdog
+    def _run_watchdog(self):
+        """Restart a dead or wedged scheduler. Death (an unhandled
+        exception escaped the loop — e.g. injected chaos) and wedging
+        (heartbeat stale AND the oldest pending request stale, so a long
+        legitimate execute with a fresh queue never false-positives) get
+        the same treatment: bump the generation, fail only the in-flight
+        group with a retryable status, start a fresh scheduler thread.
+        Parked requests stay queued and are served by the new thread."""
+        while not self._closed_ev.wait(self.watchdog_interval):
+            with self._lock:
+                if self._closed:
+                    return
+                gen = self._sched_gen
+                th = self._scheduler
+                hb = self._heartbeat
+                head = self._pending[0] if self._pending else None
+                group = self._inflight.get(gen)
+            now = time.monotonic()
+            dead = not th.is_alive()
+            # staleness witness: the queue head, or — when the queue is
+            # empty — the in-flight group itself (a scheduler wedged
+            # mid-execute on the LAST request must still be caught, or
+            # its waiters hang forever)
+            if head is not None:
+                oldest = head.t_enqueue
+            elif group:
+                oldest = min(r.t_enqueue for r in group)
+            else:
+                oldest = None
+            wedged = (oldest is not None
+                      and now - hb > self.wedge_timeout
+                      and now - oldest > self.wedge_timeout)
+            if dead:
+                self._restart_scheduler(gen, "died")
+            elif wedged:
+                self._restart_scheduler(gen, "wedged (heartbeat stale)")
+            self._fail_overdue_cold_groups(now)
+
+    def _fail_overdue_cold_groups(self, now):
+        """Cold-compile groups run outside the scheduler the heartbeat
+        watches; bound them by cold_compile_timeout so a wedged XLA
+        compile fails its waiters retryably instead of hanging them
+        (and every later same-bucket group queued behind its in-flight
+        compile event) forever. The zombie thread may still finish and
+        cache its program — results go nowhere, r.fail is a no-op once
+        delivery happened."""
+        if self.cold_compile_timeout <= 0:
+            return
+        with self._lock:
+            overdue = [(tok, grp)
+                       for tok, (grp, t0) in self._cold_inflight.items()
+                       if now - t0 > self.cold_compile_timeout]
+            for tok, _ in overdue:
+                self._cold_inflight.pop(tok, None)
+        for _, grp in overdue:
+            # count toward the bucket's breaker: a compile that keeps
+            # wedging must quarantine the bucket (sheds happen BEFORE
+            # cold dispatch), which bounds the stuck-thread population
+            # at breaker_threshold instead of one per client retry
+            key = (self._group_bucket(grp), grp[0].sig)
+            with self._lock:
+                self._breaker_for(key).record_failure(time.monotonic())
+            err = RetryableError(
+                f"{self.name}: cold bucket compile/execute exceeded "
+                f"cold_compile_timeout={self.cold_compile_timeout}s; "
+                "request failed retryable (the compile may still finish "
+                "and cache its program for the next attempt)")
+            for r in grp:
+                r.fail(err)
+
+    def _restart_scheduler(self, observed_gen, reason):
+        with self._cond:
+            if self._closed or observed_gen != self._sched_gen:
+                return  # already restarted (or shutting down)
+            self._sched_gen += 1
+            gen = self._sched_gen
+            stranded = self._inflight.pop(observed_gen, None)
+            if stranded:
+                # if the stranded group was a HALF_OPEN probe, count it
+                # as a failed probe (back to OPEN, fresh cooldown) — the
+                # probe's own record_failure may never run, and a
+                # breaker stuck HALF_OPEN sheds its bucket forever. A
+                # CLOSED breaker is left alone: a scheduler death is not
+                # the bucket's fault.
+                key = (self._group_bucket(stranded), stranded[0].sig)
+                br = self._breakers.get(key)
+                if br is not None and br.state == _Breaker.HALF_OPEN:
+                    br.record_failure(time.monotonic())
+            self._scheduler_restarts += 1
+            self._heartbeat = time.monotonic()
+            t = threading.Thread(target=self._run_scheduler, args=(gen,),
+                                 name=f"{self.name}-scheduler-g{gen}",
+                                 daemon=True)
+            self._scheduler = t
+            # start INSIDE the lock: a concurrent close() reading
+            # self._scheduler must never join() a not-yet-started
+            # thread (RuntimeError). The new thread just parks on this
+            # same lock until we release it.
+            t.start()
+            self._cond.notify_all()  # a superseded thread parked in wait()
+        if stranded:
+            err = SchedulerRestarted(
+                f"{self.name} scheduler {reason} and was restarted; this "
+                "request's group was in flight — its results (if any) "
+                "were discarded, never delivered — retry it")
+            for r in stranded:
+                r.fail(err)
+
     # ------------------------------------------------------ compiled cache
     def _stats_for(self, bucket, sig):
         key = (bucket, sig)
@@ -508,6 +977,14 @@ class BatchingEngine:
         if st is None:
             st = self._bucket_stats[key] = _BucketStats()
         return st
+
+    def _breaker_for(self, key):
+        """Called with the lock held."""
+        br = self._breakers.get(key)
+        if br is None:
+            br = self._breakers[key] = _Breaker(self.breaker_threshold,
+                                               self.breaker_cooldown)
+        return br
 
     def _compiled(self, bucket, sig):
         """Per-bucket compiled program; compiles exactly once per
@@ -530,10 +1007,24 @@ class BatchingEngine:
                     mine = False
             if not mine:
                 # loop: pick up the cached result, or take over as the
-                # owner if the first compile failed
-                ev.wait()
+                # owner if the first compile failed. Bounded: if the
+                # owner's compile wedges, each waiting cold thread must
+                # fail its group and EXIT (unbounded ev.wait would leak
+                # one permanently-blocked thread per client retry)
+                limit = self.cold_compile_timeout
+                if limit > 0 and not ev.wait(limit):
+                    # retryable: the owner's compile may still land and
+                    # cache the program for the caller's next attempt
+                    raise RetryableError(
+                        f"{self.name}: compile for bucket {bucket} "
+                        f"still in flight after cold_compile_timeout="
+                        f"{limit}s; retry later")
+                elif limit <= 0:
+                    ev.wait()
                 continue
             try:
+                chaos.hit("serving.compile")
+                chaos.hit(f"serving.compile.bucket{bucket}")
                 run = self._runner.compile(bucket, sig)
             except BaseException:
                 with self._lock:
@@ -578,6 +1069,10 @@ class BatchingEngine:
             self._declared = buckets
         return buckets
 
+    def declared_buckets(self):
+        with self._lock:
+            return list(self._declared)
+
     # -------------------------------------------------------------- stats
     def stats(self):
         """Snapshot of engine counters (the `stats` wire command)."""
@@ -587,7 +1082,11 @@ class BatchingEngine:
                                             key=lambda kv: kv[0][0]):
                 d = st.as_dict()
                 d["signature"] = [[dt, list(tr)] for dt, tr in sig]
+                br = self._breakers.get((bucket, sig))
+                if br is not None:
+                    d["breaker"] = br.as_dict()
                 buckets.setdefault(str(bucket), []).append(d)
+            states = [br.state for br in self._breakers.values()]
             return {
                 "name": self.name,
                 "max_batch_size": self.max_batch_size,
@@ -598,6 +1097,18 @@ class BatchingEngine:
                 "requests": self._n_requests,
                 "rows": self._n_rows,
                 "shed_count": self._shed_count,
+                "quarantine_shed": self._quarantine_shed,
+                "deadline_expired": self._deadline_expired,
+                "deadline_late": self._deadline_late,
+                "scheduler_restarts": self._scheduler_restarts,
+                "breaker": {
+                    "threshold": self.breaker_threshold,
+                    "cooldown_s": self.breaker_cooldown,
+                    "open": states.count(_Breaker.OPEN),
+                    "half_open": states.count(_Breaker.HALF_OPEN),
+                    "trips": sum(br.trips
+                                 for br in self._breakers.values()),
+                },
                 "compiles": sum(st.compiles
                                 for st in self._bucket_stats.values()),
                 "buckets": buckets,
@@ -605,6 +1116,28 @@ class BatchingEngine:
 
     def stats_json(self):
         return json.dumps(self.stats())
+
+    def health(self):
+        """Liveness snapshot for the `health` wire command: is the
+        scheduler alive, how stale is its heartbeat, which buckets are
+        quarantined, how deep is the queue."""
+        now = time.monotonic()
+        with self._lock:
+            alive = self._scheduler.is_alive()
+            quarantined = sorted(
+                bucket for (bucket, _sig), br in self._breakers.items()
+                if br.state != _Breaker.CLOSED)
+            return {
+                "ok": alive and not self._closed,
+                "closed": self._closed,
+                "scheduler_alive": alive,
+                "heartbeat_age_s": round(now - self._heartbeat, 3),
+                "scheduler_restarts": self._scheduler_restarts,
+                "queue_depth": len(self._pending),
+                "quarantined_buckets": quarantined,
+                "cold_compiles_inflight": len(self._cold_inflight),
+                "declared_buckets": list(self._declared),
+            }
 
     # -------------------------------------------------------------- close
     def close(self, timeout=5.0):
@@ -614,8 +1147,12 @@ class BatchingEngine:
             if self._closed:
                 return
             self._closed = True
+            self._closed_ev.set()
             self._cond.notify_all()
-        self._scheduler.join(timeout)
+            sched = self._scheduler
+        sched.join(timeout)
+        if self._watchdog is not None:
+            self._watchdog.join(timeout)
         with self._lock:
             colds = list(self._cold_threads)
             self._cold_threads = []

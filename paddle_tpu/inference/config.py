@@ -159,13 +159,32 @@ class Config:
 
     # ------------------------------------------------------ dynamic batching
     def enable_dynamic_batching(self, max_batch_size=32, max_wait_ms=2.0,
-                                max_queue=256):
+                                max_queue=256, breaker_threshold=None,
+                                breaker_cooldown=None,
+                                watchdog_interval=None,
+                                wedge_timeout=None,
+                                cold_compile_timeout=None):
         """Record dynamic-batching engine knobs; Predictor reads them in
         enable_dynamic_batching(). max_batch_size here wins over the
-        enable_tensorrt_engine one when both are set."""
-        self._extra["dynamic_batching"] = dict(
-            max_batch_size=int(max_batch_size),
-            max_wait_ms=float(max_wait_ms), max_queue=int(max_queue))
+        enable_tensorrt_engine one when both are set. The robustness
+        knobs (breaker_threshold/breaker_cooldown for poisoned-bucket
+        quarantine, watchdog_interval/wedge_timeout for scheduler
+        self-healing — raise wedge_timeout above the model's longest
+        legitimate batch execute) default to the PADDLE_TPU_SERVING_*
+        env knobs when None."""
+        cfg = dict(max_batch_size=int(max_batch_size),
+                   max_wait_ms=float(max_wait_ms), max_queue=int(max_queue))
+        if breaker_threshold is not None:
+            cfg["breaker_threshold"] = int(breaker_threshold)
+        if breaker_cooldown is not None:
+            cfg["breaker_cooldown"] = float(breaker_cooldown)
+        if watchdog_interval is not None:
+            cfg["watchdog_interval"] = float(watchdog_interval)
+        if wedge_timeout is not None:
+            cfg["wedge_timeout"] = float(wedge_timeout)
+        if cold_compile_timeout is not None:
+            cfg["cold_compile_timeout"] = float(cold_compile_timeout)
+        self._extra["dynamic_batching"] = cfg
 
     def dynamic_batching_enabled(self):
         return "dynamic_batching" in self._extra

@@ -204,6 +204,14 @@ class Predictor:
                 max_queue=(max_queue if max_queue is not None
                            else db.get("max_queue", 256)),
             )
+            # robustness knobs recorded on the Config (breaker /
+            # watchdog); absent keys fall back to the engine's
+            # PADDLE_TPU_SERVING_* env defaults
+            for k in ("breaker_threshold", "breaker_cooldown",
+                      "watchdog_interval", "wedge_timeout",
+                      "cold_compile_timeout"):
+                if k in db:
+                    kw[k] = db[k]
             engine = BatchingEngine.for_layer(self._layer, **kw)
             if warmup:
                 engine.warmup(warmup_buckets)

@@ -13,24 +13,41 @@ wire format (little-endian):
   cmds: 1 infer  payload = u8 n_inputs, per input:
             u8 dtype (0=f32, 1=i32, 2=i64, 3=bool) | u8 ndim |
             i64 dims[ndim] | data
+          ... optionally followed by a deadline field:
+            u8 0xDD | f64 timeout_ms (relative budget; the server
+            computes the absolute deadline at receipt and drops the
+            request without dispatch once it expires). Old servers
+            ignore the trailing bytes; old clients simply omit them —
+            both directions stay compatible.
+        3 health  payload = (empty); response body is UTF-8 JSON
+            liveness/readiness: scheduler alive + heartbeat age,
+            quarantined buckets, queue depth, draining flag
+        4 reload  payload = optional UTF-8 model prefix (empty = same
+            prefix); the server loads + warms the new model OFF TO THE
+            SIDE, swaps it in atomically, then drains the old engine —
+            zero dropped requests, zero post-swap cold compiles for
+            declared buckets. Response body is UTF-8 JSON.
         5 stats  payload = (empty); response body is a UTF-8 JSON
             object with the batching-engine counters (per-bucket
-            compiles/hits/latency, queue depth, shed_count) — or
-            {"engine": null} when serving without an engine
+            compiles/hits/latency, breaker states, queue depth,
+            shed_count) — or {"engine": null} when serving without an
+            engine
         7 stop
   response: u32 body_len | u8 status | (cmd 1: same per-output encoding)
-  status: 0 ok | 1 error | 2 overloaded (request shed by the batching
-          engine's bounded queue — back off and retry)
+  status: 0 ok | 1 error | 2 retryable (request shed by the batching
+          engine's bounded queue, a quarantined bucket, a scheduler
+          restart, or an expired deadline — back off and retry)
 """
 import json
 import os
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 
-from .batching import EngineOverloaded
+from .batching import EngineClosed, RetryableError
 
 _DTYPES = {0: np.float32, 1: np.int32, 2: np.int64, 3: np.bool_}
 _DTYPE_CODES = {np.dtype(np.float32): 0, np.dtype(np.int32): 1,
@@ -42,7 +59,12 @@ _WIDEN_TO_F32 = {"float16", "bfloat16"}
 
 STATUS_OK = 0
 STATUS_ERROR = 1
-STATUS_OVERLOADED = EngineOverloaded.status_code  # 2
+STATUS_OVERLOADED = RetryableError.status_code  # 2
+
+# Optional trailing field marker on cmd-1 infer bodies: a per-request
+# deadline. A marker byte (not bare trailing bytes) so garbage tails
+# can't be misread as a deadline.
+DEADLINE_MARKER = 0xDD
 
 # Hardening knobs: a 4-byte length prefix from a buggy/malicious client
 # must not trigger an unbounded allocation, and a stalled client must
@@ -91,7 +113,13 @@ def _encode_arrays(arrays):
     return b"".join(out)
 
 
-def _decode_arrays(payload):
+def _encode_deadline(timeout_ms):
+    """Trailing optional deadline field a new client appends to a cmd-1
+    body (old servers ignore it)."""
+    return struct.pack("<Bd", DEADLINE_MARKER, float(timeout_ms))
+
+
+def _decode_arrays_off(payload):
     off = 0
     (n,) = struct.unpack_from("<B", payload, off)
     off += 1
@@ -106,7 +134,22 @@ def _decode_arrays(payload):
         arr = np.frombuffer(payload, dt, count, off).reshape(dims)
         off += arr.nbytes
         arrays.append(arr)
-    return arrays
+    return arrays, off
+
+
+def _decode_arrays(payload):
+    return _decode_arrays_off(payload)[0]
+
+
+def _decode_request(payload):
+    """Decode a cmd-1 infer body: arrays plus the optional trailing
+    deadline field. Returns (arrays, budget_seconds_or_None)."""
+    arrays, off = _decode_arrays_off(payload)
+    budget = None
+    if len(payload) - off >= 9 and payload[off] == DEADLINE_MARKER:
+        (timeout_ms,) = struct.unpack_from("<d", payload, off + 1)
+        budget = max(0.0, float(timeout_ms)) / 1000.0
+    return arrays, budget
 
 
 class PredictorServer:
@@ -118,17 +161,31 @@ class PredictorServer:
     concurrent clients coalesce into padded shape-bucket batches, the
     bounded queue sheds overload as wire status 2 instead of queuing
     unboundedly, and the ``stats`` command (cmd 5) exposes the
-    per-bucket compile/hit/latency counters."""
+    per-bucket compile/hit/latency counters.
+
+    With ``loader`` (a callable ``prefix -> (run_fn, engine_or_None)``,
+    supplied by :func:`serve_model`), the ``reload`` wire command (cmd
+    4) hot-swaps the served model: the new model loads and warms up off
+    to the side, the (run_fn, engine) pair swaps atomically, and the old
+    engine drains — in-flight requests complete on the old programs, a
+    handler that raced the swap retries once on the new engine, and
+    declared buckets are precompiled so no post-swap request pays a
+    cold compile."""
 
     def __init__(self, run_fn, port=0, host="127.0.0.1",
                  max_body=MAX_BODY_BYTES, recv_timeout=RECV_TIMEOUT,
-                 engine=None, own_engine=False):
+                 engine=None, own_engine=False, loader=None, prefix=None):
         self._run = run_fn
         self._engine = engine
         # own_engine: this server is the engine's only handle (serve_model
         # builds one per server) and must close it on stop, or its
         # scheduler thread + compiled programs leak per server lifecycle
         self._own_engine = own_engine and engine is not None
+        self._loader = loader
+        self._prefix = prefix
+        self._backend_lock = threading.Lock()  # guards _run/_engine swap
+        self._reload_lock = threading.Lock()  # one reload at a time
+        self._reload_count = 0
         self._max_body = max_body
         self._recv_timeout = recv_timeout
         self._sock = socket.socket()
@@ -160,11 +217,130 @@ class PredictorServer:
             if ent is not None:
                 ent["busy"] = busy
 
+    def _backend(self):
+        with self._backend_lock:
+            return self._run, self._engine
+
     def _stats_json(self):
         """Body of the `stats` wire command (cmd 5)."""
-        if self._engine is None:
+        _, engine = self._backend()
+        if engine is None:
             return json.dumps({"engine": None})
-        return self._engine.stats_json()
+        return engine.stats_json()
+
+    def _health_json(self):
+        """Body of the `health` wire command (cmd 3): liveness (is the
+        serving path able to make progress) and readiness (is it
+        accepting work) in one probe."""
+        _, engine = self._backend()
+        eng = engine.health() if engine is not None else None
+        draining = self._stop.is_set()
+        ok = not draining and (eng is None or eng["ok"])
+        with self._conns_lock:
+            conns = len(self._conns)
+        return json.dumps({
+            "ok": ok,
+            "draining": draining,
+            "connections": conns,
+            "reloads": self._reload_count,
+            "engine": eng,
+        })
+
+    # ------------------------------------------------------------- reload
+    def reload(self, prefix=None):
+        """Atomic hot weight swap (the `reload` wire command, cmd 4).
+
+        Load + warm the new model off to the side (requests keep being
+        served by the old one the whole time), swap the (run_fn, engine)
+        pair under the backend lock, then close the old engine — which
+        drains its in-flight batches. Declared buckets of the old engine
+        are precompiled on the new one BEFORE the swap, so post-swap
+        traffic never pays a cold compile for them."""
+        if self._loader is None:
+            raise RuntimeError(
+                "this server has no model loader; hot reload needs a "
+                "server constructed by serve_model(...) (a bare "
+                "PredictorServer wraps an opaque callable)")
+        with self._reload_lock:
+            if self._stop.is_set():
+                # stop() closes the serving engine; a reload racing past
+                # it would swap in a fresh engine (scheduler + watchdog
+                # + compiled programs) that nothing ever closes
+                raise RuntimeError("server is stopping; reload refused")
+            new_prefix = prefix or self._prefix
+            old_engine = self._backend()[1]
+            new_run, new_engine = self._loader(new_prefix)
+            warmed = []
+            try:
+                if new_engine is not None:
+                    declared = (old_engine.declared_buckets()
+                                if old_engine is not None else None)
+                    # warm the same buckets the old engine declared (or
+                    # the full power-of-2 ladder) before any request can
+                    # see the new engine
+                    warmed = new_engine.warmup(declared or None)
+                with self._backend_lock:
+                    if self._stop.is_set():
+                        # stop() closed the serving engine while we were
+                        # loading; swapping now would hand the server an
+                        # engine nothing ever closes
+                        raise RuntimeError(
+                            "server stopped during reload; new model "
+                            "discarded")
+                    old_run, old_engine = self._run, self._engine
+                    old_owned = self._own_engine
+                    self._run, self._engine = new_run, new_engine
+                    self._own_engine = new_engine is not None
+                    self._prefix = new_prefix
+                    self._reload_count += 1
+            except BaseException:
+                # a failed load/warmup (or a stop racing us) must not
+                # leak the freshly built engine's scheduler + watchdog
+                # threads and compiled programs
+                if new_engine is not None:
+                    new_engine.close()
+                raise
+            if old_engine is not None and old_owned:
+                # drains: pending groups on the old engine still fire
+                old_engine.close()
+            return {"reloaded": True, "prefix": new_prefix,
+                    "warm_buckets": list(warmed),
+                    "reloads": self._reload_count}
+
+    # ------------------------------------------------------------ handler
+    def _infer(self, body):
+        """Run one cmd-1 infer body; returns the encoded response frame
+        body (status byte + payload)."""
+        inputs, budget = _decode_request(body[1:])
+        deadline = (None if budget is None
+                    else time.monotonic() + budget)
+        if budget is not None and budget <= 0.0:
+            # the client's budget was spent before the frame finished
+            # arriving: drop before dispatch, spend no compute
+            return struct.pack("<B", STATUS_OVERLOADED)
+        for attempt in (0, 1):
+            run, engine = self._backend()
+            try:
+                if engine is not None:
+                    outputs = engine.infer(inputs, deadline=deadline)
+                else:
+                    if deadline is not None and \
+                            time.monotonic() >= deadline:
+                        return struct.pack("<B", STATUS_OVERLOADED)
+                    outputs = run(*inputs)
+                break
+            except EngineClosed:
+                # the engine was hot-swapped between our snapshot and
+                # the submit: retry once on the new backend so a reload
+                # never drops a request
+                if attempt:
+                    raise
+        if not isinstance(outputs, (list, tuple)):
+            outputs = [outputs]
+        outputs = [np.asarray(o._value if hasattr(o, "_value")
+                              else o) for o in outputs]
+        enc = _encode_arrays(outputs)
+        return struct.pack("<B", STATUS_OK) + enc
 
     def _handle(self, conn):
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -201,6 +377,24 @@ class PredictorServer:
                     conn.sendall(struct.pack("<IB", 1, 0))
                     threading.Thread(target=self.stop, daemon=True).start()
                     return
+                if cmd == 3:
+                    enc = self._health_json().encode("utf-8")
+                    conn.sendall(struct.pack("<IB", 1 + len(enc), 0) + enc)
+                    self._set_busy(False)
+                    continue
+                if cmd == 4:
+                    prefix = body[1:].decode("utf-8", errors="replace")
+                    try:
+                        info = self.reload(prefix or None)
+                        enc = json.dumps(info).encode("utf-8")
+                        conn.sendall(struct.pack("<IB", 1 + len(enc), 0)
+                                     + enc)
+                    except Exception as e:  # noqa: BLE001 - wire error
+                        enc = str(e).encode("utf-8", errors="replace")
+                        conn.sendall(struct.pack("<IB", 1 + len(enc), 1)
+                                     + enc)
+                    self._set_busy(False)
+                    continue
                 if cmd == 5:
                     enc = self._stats_json().encode("utf-8")
                     conn.sendall(struct.pack("<IB", 1 + len(enc), 0) + enc)
@@ -211,20 +405,16 @@ class PredictorServer:
                     self._set_busy(False)
                     continue
                 try:
-                    inputs = _decode_arrays(body[1:])
-                    if self._engine is not None:
-                        outputs = self._engine.infer(inputs)
-                    else:
-                        outputs = self._run(*inputs)
-                    if not isinstance(outputs, (list, tuple)):
-                        outputs = [outputs]
-                    outputs = [np.asarray(o._value if hasattr(o, "_value")
-                                          else o) for o in outputs]
-                    enc = _encode_arrays(outputs)
-                    conn.sendall(struct.pack("<IB", 1 + len(enc), 0) + enc)
-                except EngineOverloaded:
-                    # load shed: a fast, explicit rejection the client
-                    # can retry — never an unbounded queue
+                    resp = self._infer(body)
+                    conn.sendall(struct.pack("<I", len(resp)) + resp)
+                except (RetryableError, EngineClosed):
+                    # load shed / quarantined bucket / scheduler restart
+                    # / expired deadline: a fast, explicit rejection the
+                    # client can retry — never an unbounded queue, never
+                    # a hang. EngineClosed (a request racing back-to-back
+                    # reloads or a stop past _infer's one retry) is
+                    # equally transient: the next attempt lands on the
+                    # swapped-in engine or a cleanly-restarted server.
                     conn.sendall(struct.pack("<IB", 1, STATUS_OVERLOADED))
                 except Exception:  # noqa: BLE001 - protocol error status
                     conn.sendall(struct.pack("<IB", 1, 1))
@@ -243,24 +433,29 @@ class PredictorServer:
         mid-processing finish (up to `timeout`), force-close idle
         keep-alive connections — a rolling restart neither drops a
         response mid-write nor hangs on a silent client."""
-        import time as time_mod
-
         self._stop.set()
+        # a reload mid-flight cannot swap past us: its swap re-checks
+        # _stop under _backend_lock (set above, before our engine read
+        # below) and aborts, closing its own new engine — so the engine
+        # we read here is the one that is actually serving, and stop()
+        # never waits out a multi-second model load
         try:
             self._sock.close()  # unblocks accept(); no new connections
         except OSError:
             pass
+        with self._backend_lock:
+            engine = self._engine if self._own_engine else None
         if not drain:
-            if self._own_engine:
-                self._engine.close()
+            if engine is not None:
+                engine.close()
             return
         me = threading.current_thread()
-        deadline = time_mod.monotonic() + timeout
+        deadline = time.monotonic() + timeout
         with self._conns_lock:
             entries = [(t, e) for t, e in self._conns.items() if t is not me]
         for t, ent in entries:
             if ent["busy"]:
-                t.join(max(0.0, deadline - time_mod.monotonic()))
+                t.join(max(0.0, deadline - time.monotonic()))
         # whoever is left is idle (blocked waiting for the next frame) or
         # overran the drain window — unblock by closing the socket
         with self._conns_lock:
@@ -275,38 +470,49 @@ class PredictorServer:
                 c.close()
             except OSError:
                 pass
-        if self._own_engine:
+        if engine is not None:
             # handlers are drained/unblocked; pending engine requests
             # still fire (close() lets partial batches complete)
-            self._engine.close()
+            engine.close()
 
 
 def serve_model(path_prefix, port=0, dynamic_batching=False,
                 max_batch_size=32, max_wait_ms=2.0, max_queue=256,
-                warmup=True):
+                warmup=True, **engine_kwargs):
     """Load a jit-saved model and serve it (the C API's server side).
 
     With ``dynamic_batching=True`` (needs a batch-polymorphic save, see
     jit.save) all connections share one BatchingEngine: requests
     coalesce into padded shape-bucket batches, declared buckets are
-    precompiled up front, and saturation sheds as wire status 2."""
+    precompiled up front, and saturation sheds as wire status 2. Extra
+    ``engine_kwargs`` (breaker_threshold, watchdog_interval, ...) pass
+    through to the BatchingEngine.
+
+    The returned server supports the ``reload`` wire command (cmd 4):
+    re-save the model to the same (or a new) prefix and issue a reload
+    to hot-swap weights with zero dropped requests."""
     from ..jit import load as jit_load
 
-    layer = jit_load(path_prefix)
+    def loader(prefix):
+        layer = jit_load(prefix)
 
-    def run(*arrays):
-        out = layer(*arrays)
-        return out if isinstance(out, (list, tuple)) else [out]
+        def run(*arrays):
+            out = layer(*arrays)
+            return out if isinstance(out, (list, tuple)) else [out]
 
-    engine = None
-    if dynamic_batching:
-        from .batching import BatchingEngine
+        engine = None
+        if dynamic_batching:
+            from .batching import BatchingEngine
 
-        engine = BatchingEngine.for_layer(layer,
-                                          max_batch_size=max_batch_size,
-                                          max_wait_ms=max_wait_ms,
-                                          max_queue=max_queue)
-        if warmup:
-            engine.warmup()
+            engine = BatchingEngine.for_layer(
+                layer, max_batch_size=max_batch_size,
+                max_wait_ms=max_wait_ms, max_queue=max_queue,
+                **engine_kwargs)
+        return run, engine
+
+    run, engine = loader(path_prefix)
+    if engine is not None and warmup:
+        engine.warmup()
     return PredictorServer(run, port=port, engine=engine,
-                           own_engine=engine is not None)
+                           own_engine=engine is not None,
+                           loader=loader, prefix=path_prefix)

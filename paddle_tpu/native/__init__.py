@@ -121,6 +121,14 @@ def _declare(lib):
                                   ctypes.POINTER(ctypes.POINTER(
                                       ctypes.c_int64)),
                                   ctypes.POINTER(ctypes.c_void_p)]),
+        "PD_PredictorRunDeadline": (i32, [i64, i32,
+                                          ctypes.POINTER(ctypes.c_int),
+                                          ctypes.POINTER(ctypes.c_int),
+                                          ctypes.POINTER(ctypes.POINTER(
+                                              ctypes.c_int64)),
+                                          ctypes.POINTER(ctypes.c_void_p),
+                                          ctypes.c_double]),
+        "PD_PredictorHealth": (i64, [i64, ctypes.c_char_p, i64]),
         "PD_PredictorNumOutputs": (i32, [i64]),
         "PD_PredictorOutputNdim": (i32, [i64, i32]),
         "PD_PredictorOutputDims": (i32, [i64, i32, i64p]),

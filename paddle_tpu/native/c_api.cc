@@ -6,12 +6,16 @@
 // programs, so external languages talk to the serving port instead.
 //
 // Protocol (little-endian), mirrors inference/server.py:
-//   request  u32 len | u8 cmd(1=infer) | u8 n_inputs |
+//   request  u32 len | u8 cmd(1=infer, 3=health) | u8 n_inputs |
 //            per input: u8 dtype(0=f32,1=i32,2=i64,3=bool) u8 ndim
 //            i64 dims[] data
+//            cmd 1 may carry a trailing optional deadline field:
+//            u8 0xDD | f64 timeout_ms (old servers ignore it)
 //   response u32 len | u8 status | same encoding of outputs
-//   status   0 ok | 1 error | 2 overloaded (shed by the server's
-//            batching engine: back off and retry)
+//            (cmd 3: UTF-8 JSON liveness body)
+//   status   0 ok | 1 error | 2 retryable (shed by the server's
+//            batching engine / quarantined bucket / scheduler restart
+//            / expired deadline: back off and retry)
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -105,6 +109,29 @@ CPredictor* acquire(int64_t h, Guard& gd) {
   return gd.p;
 }
 
+// Bound one request's socket I/O (seconds; 0 restores blocking) — a
+// server that never answers must surface as an error, not a permanent
+// hang. Mirrors the Go client's SetDeadline.
+void set_io_timeout(int fd, double total_s) {
+  timeval tv{};
+  tv.tv_sec = (long)total_s;
+  tv.tv_usec = (long)((total_s - (double)tv.tv_sec) * 1e6);
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+}
+
+// After a failed write/read the frame stream is desynced (a late
+// response would be read as the NEXT request's answer, silently
+// returning wrong tensors): poison the connection so later calls fail
+// fast (-1) instead of mis-reading. Called under the predictor mutex.
+int io_fail(CPredictor* p) {
+  if (p->fd >= 0) {
+    ::close(p->fd);
+    p->fd = -1;
+  }
+  return -1;
+}
+
 }  // namespace
 
 extern "C" {
@@ -151,13 +178,17 @@ void PD_PredictorDestroy(int64_t h) {
   delete p;
 }
 
-// Run inference. Inputs: n_inputs tensors, each described by dtype
-// (0=f32, 1=i32, 2=i64, 3=bool), ndim, dims, and a data pointer.
-// Returns 0 on success; outputs are held by the predictor until the
-// next call.
-int PD_PredictorRun(int64_t h, int n_inputs, const int* dtypes,
-                    const int* ndims, const int64_t* const* dims,
-                    const void* const* data) {
+}  // extern "C" — reopened below; the shared helpers between the Run
+   // variants keep internal linkage
+
+namespace {
+
+// Shared body of PD_PredictorRun / PD_PredictorRunDeadline. A
+// timeout_ms > 0 appends the optional wire deadline field (marker 0xDD
+// + f64 ms); servers predating the field ignore the trailing bytes.
+int run_impl(int64_t h, int n_inputs, const int* dtypes, const int* ndims,
+             const int64_t* const* dims, const void* const* data,
+             double timeout_ms) {
   if (n_inputs < 0 || n_inputs > 255) return -1;
   Guard gd;
   CPredictor* p = acquire(h, gd);
@@ -180,13 +211,29 @@ int PD_PredictorRun(int64_t h, int n_inputs, const int* dtypes,
     body.insert(body.end(), (const char*)data[i],
                 (const char*)data[i] + bytes);
   }
+  if (timeout_ms > 0) {
+    body.push_back((char)0xDD);
+    body.insert(body.end(), (char*)&timeout_ms, (char*)&timeout_ms + 8);
+  }
+  if (p->fd < 0) return -1;  // poisoned by an earlier I/O failure
+  if (timeout_ms > 0) {
+    // +1s grace: the server answers an expired request with status 2
+    // shortly AFTER the wire deadline; only a wedged/dead server is
+    // cut off by the socket timeout
+    set_io_timeout(p->fd, timeout_ms / 1000.0 + 1.0);
+  }
   uint32_t blen = (uint32_t)body.size();
-  if (!wr(p->fd, &blen, 4) || !wr(p->fd, body.data(), blen)) return -1;
-  uint32_t rlen;
-  if (!rd(p->fd, &rlen, 4) || rlen < 1) return -1;
-  std::vector<char> resp(rlen);
-  if (!rd(p->fd, resp.data(), rlen)) return -1;
-  if (resp[0] == 2) return -3;  // overloaded (load shed): retry w/ backoff
+  bool ok = wr(p->fd, &blen, 4) && wr(p->fd, body.data(), blen);
+  uint32_t rlen = 0;
+  ok = ok && rd(p->fd, &rlen, 4) && rlen >= 1;
+  std::vector<char> resp;
+  if (ok) {
+    resp.resize(rlen);
+    ok = rd(p->fd, resp.data(), rlen);
+  }
+  if (timeout_ms > 0 && p->fd >= 0) set_io_timeout(p->fd, 0.0);
+  if (!ok) return io_fail(p);
+  if (resp[0] == 2) return -3;  // retryable (shed/quarantine/deadline)
   if (resp[0] != 0) return -2;
   p->out_data.clear();
   p->out_dims.clear();
@@ -217,6 +264,58 @@ int PD_PredictorRun(int64_t h, int n_inputs, const int* dtypes,
     off += bytes;
   }
   return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int PD_PredictorRun(int64_t h, int n_inputs, const int* dtypes,
+                    const int* ndims, const int64_t* const* dims,
+                    const void* const* data) {
+  return run_impl(h, n_inputs, dtypes, ndims, dims, data, 0.0);
+}
+
+// Run with a per-request deadline: the server drops the request without
+// dispatch once timeout_ms elapses (returns -3, retryable), so a client
+// that stopped waiting never costs the accelerator a batch slot.
+int PD_PredictorRunDeadline(int64_t h, int n_inputs, const int* dtypes,
+                            const int* ndims, const int64_t* const* dims,
+                            const void* const* data, double timeout_ms) {
+  return run_impl(h, n_inputs, dtypes, ndims, dims, data, timeout_ms);
+}
+
+// Liveness/readiness probe (wire cmd 3). Copies the server's UTF-8
+// health JSON (NUL-terminated) into out and returns the full JSON
+// length (call again with a bigger buffer if it exceeds cap-1);
+// -1 on transport error, -2 on server error status.
+int64_t PD_PredictorHealth(int64_t h, char* out, int64_t cap) {
+  Guard gd;
+  CPredictor* p = acquire(h, gd);
+  if (!p) return -1;
+  if (p->fd < 0) return -1;  // poisoned by an earlier I/O failure
+  // a liveness probe that can hang is useless: always bounded
+  set_io_timeout(p->fd, 10.0);
+  const char body[1] = {(char)3};
+  uint32_t blen = 1;
+  bool ok = wr(p->fd, &blen, 4) && wr(p->fd, body, 1);
+  uint32_t rlen = 0;
+  ok = ok && rd(p->fd, &rlen, 4) && rlen >= 1;
+  std::vector<char> resp;
+  if (ok) {
+    resp.resize(rlen);
+    ok = rd(p->fd, resp.data(), rlen);
+  }
+  if (p->fd >= 0) set_io_timeout(p->fd, 0.0);
+  if (!ok) return io_fail(p);
+  if (resp[0] != 0) return -2;
+  int64_t n = (int64_t)rlen - 1;
+  if (out && cap > 0) {
+    int64_t copy = n < cap - 1 ? n : cap - 1;
+    std::memcpy(out, resp.data() + 1, (size_t)copy);
+    out[copy] = '\0';
+  }
+  return n;
 }
 
 int PD_PredictorNumOutputs(int64_t h) {

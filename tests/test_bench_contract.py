@@ -91,6 +91,33 @@ class TestBenchContract:
             rec["qps"] / rec["baseline_qps"], rel=1e-3)
         assert rec["smoke"] is True
 
+    @pytest.mark.slow  # subprocess bench run
+    @pytest.mark.serving
+    @pytest.mark.chaos  # ci_gate --serving-chaos runs this
+    def test_serving_chaos_mode_metric_fields(self):
+        r = _run({"BENCH_CPU": "1", "BENCH_MODEL": "serving",
+                  "BENCH_SERVING_CHAOS": "1", "BENCH_CLIENTS": "4",
+                  "BENCH_SERVING_SECS": "1"}, timeout=420)
+        assert r.returncode == 0, r.stderr[-500:]
+        rec = _one_json_line(r.stdout)
+        assert rec["metric"] == "serving_goodput_qps_under_chaos"
+        assert rec["unit"] == "req/s"
+        # the goodput-under-faults schema
+        assert set(rec) >= {"healthy_qps", "chaos_qps", "chaos_shed",
+                            "scheduler_restarts", "reload_dropped",
+                            "reload_cold_compiles",
+                            "quarantine_healthy_ratio",
+                            "quarantine_recovered"}
+        assert rec["value"] == rec["chaos_qps"] > 0
+        # self-healing: the injected deaths were observed and recovered
+        assert rec["scheduler_restarts"] >= 1
+        # the acceptance invariants the chaos e2e pins
+        assert rec["reload_dropped"] == 0
+        assert rec["reload_cold_compiles"] == 0
+        assert rec["quarantine_healthy_ratio"] >= 0.8
+        assert rec["quarantine_recovered"] is True
+        assert rec["smoke"] is True
+
     def test_decode_mode_metric_fields(self):
         r = _run({"BENCH_CPU": "1", "BENCH_STEPS": "4",
                   "BENCH_MODEL": "decode"}, timeout=420)

@@ -125,6 +125,71 @@ class TestGracefulDrain:
         assert time.monotonic() - t0 < 1.0
 
 
+class TestDrainOverrun:
+    def test_handler_blocked_past_drain_timeout_is_unblocked(self):
+        """stop(drain=True) with a handler stuck in run_fn PAST the
+        drain window: stop must return at the timeout (not hang), the
+        overrunning handler's socket must be force-closed (the client
+        sees EOF instead of hanging), and once the handler unsticks it
+        must exit cleanly — no stuck thread keeping the process alive."""
+        release = threading.Event()
+        started = threading.Event()
+
+        def wedged_run(*arrays):
+            started.set()
+            release.wait(30)  # far past the drain window
+            return [np.asarray(a) for a in arrays]
+
+        server = _mk_server(wedged_run)
+        try:
+            s = socket.create_connection(("127.0.0.1", server.port),
+                                         timeout=5)
+            s.sendall(_infer_frame(np.zeros(3, np.float32)))
+            assert started.wait(5)
+            with server._conns_lock:
+                (handler,) = [t for t in server._conns]
+            t0 = time.monotonic()
+            server.stop(drain=True, timeout=0.4)
+            elapsed = time.monotonic() - t0
+            assert elapsed < 5.0, f"stop hung {elapsed:.1f}s on overrun"
+            # the overrunning handler's socket was force-closed: the
+            # client is unblocked with EOF, never a hang
+            s.settimeout(5)
+            assert s.recv(16) == b""
+            s.close()
+            # handler is still wedged in run_fn; once it unsticks, its
+            # response write hits the closed socket and the thread exits
+            # cleanly (a clean process exit needs no stuck threads)
+            assert handler.is_alive()
+            release.set()
+            handler.join(5)
+            assert not handler.is_alive(), "handler never exited"
+            with server._conns_lock:
+                assert handler not in server._conns
+        finally:
+            release.set()
+
+    def test_stalled_midframe_peer_does_not_hold_drain(self):
+        """A peer that stalls mid-frame makes its handler 'busy'; drain
+        must not wait the full recv timeout for it — the socket close at
+        the drain deadline unblocks the blocked recv immediately."""
+        server = _mk_server(recv_timeout=30.0)
+        s = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+        # claim an 8-byte body, deliver 2 bytes, stall: the handler is
+        # now blocked in recv with busy=True and a 30s socket timeout
+        s.sendall(struct.pack("<I", 8) + b"\x01\x02")
+        time.sleep(0.2)
+        t0 = time.monotonic()
+        server.stop(drain=True, timeout=0.4)
+        assert time.monotonic() - t0 < 5.0
+        with server._conns_lock:
+            leftover = list(server._conns)
+        for t in leftover:
+            t.join(5)
+            assert not t.is_alive()
+        s.close()
+
+
 class TestZeroLengthFrame:
     def test_zero_body_gets_error_and_stream_stays_usable(self):
         server = _mk_server()

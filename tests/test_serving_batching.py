@@ -745,7 +745,11 @@ class TestConfigWiring:
         cfg = Config(mlp_prefix)
         cfg.enable_tensorrt_engine(max_batch_size=16)
         cfg.enable_dynamic_batching(max_batch_size=8, max_wait_ms=5.0,
-                                    max_queue=99)
+                                    max_queue=99, breaker_threshold=7,
+                                    breaker_cooldown=9.0,
+                                    watchdog_interval=0.11,
+                                    wedge_timeout=77.0,
+                                    cold_compile_timeout=123.0)
         assert cfg.dynamic_batching_enabled()
         assert cfg.max_batch_size() == 8
         pred = create_predictor(cfg)
@@ -754,6 +758,12 @@ class TestConfigWiring:
             assert engine.max_batch_size == 8
             assert engine.max_wait_s == pytest.approx(0.005)
             assert engine.max_queue == 99
+            # all five robustness knobs plumb through (not just env)
+            assert engine.breaker_threshold == 7
+            assert engine.breaker_cooldown == pytest.approx(9.0)
+            assert engine.watchdog_interval == pytest.approx(0.11)
+            assert engine.wedge_timeout == pytest.approx(77.0)
+            assert engine.cold_compile_timeout == pytest.approx(123.0)
         finally:
             pred.disable_dynamic_batching()
 

@@ -1,0 +1,870 @@
+"""Serving-side chaos: deterministic fault injection into the
+dynamic-batching engine's scheduler loop, compile path, and execute
+path (sites armed via resilience.chaos), asserting the self-healing
+invariants of inference/batching.py + server.py:
+
+- scheduler death/wedge: the watchdog restarts the scheduler; only the
+  in-flight group fails (retryable status 2) — no client ever hangs,
+  and the next round of requests is served bitwise-identically;
+- poisoned-bucket quarantine: N consecutive compile/execute failures
+  trip that bucket's breaker (fast shed, status 2) while other buckets
+  keep serving; a half-open probe after the cooldown re-admits it;
+- deadlines: expired requests are purged before dispatch (no wasted
+  compute) and a group fires before the tightest deadline of its
+  members;
+- hot reload: an atomic weight swap drops zero requests and pays zero
+  post-swap cold compiles for declared buckets;
+- split admission: oversized requests stay all-or-nothing even with a
+  chaos-injected delay racing the queue.
+"""
+import json
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.inference.batching import (BatchingEngine, BucketQuarantined,
+                                           DeadlineExceeded, EngineOverloaded,
+                                           RetryableError, SchedulerRestarted)
+from paddle_tpu.inference.server import (PredictorServer, serve_model,
+                                         _encode_arrays, _encode_deadline,
+                                         _decode_arrays, _read_all,
+                                         STATUS_OK, STATUS_ERROR,
+                                         STATUS_OVERLOADED)
+from paddle_tpu.resilience import chaos
+from paddle_tpu.static import InputSpec
+
+pytestmark = [pytest.mark.chaos, pytest.mark.serving]
+
+# fast self-healing knobs so recovery latencies stay test-sized.
+# wedge_timeout is deliberately NOT aggressive: since the in-flight
+# group itself is a staleness witness, a loaded CI box stalling a
+# legitimate execute past the timeout would spuriously restart the
+# scheduler mid-test (deterministic wedge tests inject delays well
+# above this)
+FAST = dict(watchdog_interval=0.02, wedge_timeout=1.5)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _echo(x):
+    return [np.asarray(x)]
+
+
+def _send_frame(sock, body):
+    sock.sendall(struct.pack("<I", len(body)) + body)
+
+
+def _recv_frame(sock):
+    (blen,) = struct.unpack("<I", _read_all(sock, 4))
+    body = _read_all(sock, blen)
+    return body[0], body[1:]
+
+
+def _infer_over_wire(port, arrays, timeout_ms=None, sock_timeout=30):
+    body = struct.pack("<B", 1) + _encode_arrays(arrays)
+    if timeout_ms is not None:
+        body += _encode_deadline(timeout_ms)
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=sock_timeout) as s:
+        _send_frame(s, body)
+        status, payload = _recv_frame(s)
+    return status, (_decode_arrays(payload) if status == STATUS_OK else None)
+
+
+def _health_over_wire(port):
+    with socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+        _send_frame(s, struct.pack("<B", 3))
+        status, payload = _recv_frame(s)
+    assert status == STATUS_OK
+    return json.loads(payload.decode("utf-8"))
+
+
+def _reload_over_wire(port, prefix=""):
+    with socket.create_connection(("127.0.0.1", port), timeout=120) as s:
+        _send_frame(s, struct.pack("<B", 4) + prefix.encode("utf-8"))
+        status, payload = _recv_frame(s)
+    return status, payload.decode("utf-8", errors="replace")
+
+
+class TestSchedulerWatchdog:
+    def test_death_fails_inflight_group_retryable_then_recovers(self):
+        engine = BatchingEngine.for_callable(_echo, max_batch_size=2,
+                                             max_wait_ms=1.0, **FAST)
+        try:
+            engine.warmup(signature=[("float32", (3,))])
+            x = np.ones((2, 3), np.float32)
+            chaos.arm("serving.scheduler.loop", exc=RuntimeError("die"))
+            with pytest.raises(SchedulerRestarted) as ei:
+                engine.infer([x], timeout=10)
+            # retryable contract: the server maps this to wire status 2
+            assert isinstance(ei.value, RetryableError)
+            assert ei.value.status_code == 2
+            # the restarted scheduler serves the retry bitwise-correctly
+            out = engine.infer([x], timeout=10)
+            assert out[0].tobytes() == x.tobytes()
+            st = engine.stats()
+            assert st["scheduler_restarts"] == 1
+            assert st["queue_depth"] == 0
+            assert engine.health()["scheduler_alive"]
+        finally:
+            engine.close()
+
+    def test_death_does_not_strand_parked_requests(self):
+        # requests PARKED behind the in-flight group survive the restart
+        # and are served (only the in-flight group fails)
+        engine = BatchingEngine.for_callable(_echo, max_batch_size=1,
+                                             max_wait_ms=1.0, **FAST)
+        try:
+            engine.warmup(signature=[("float32", (2,))])
+            chaos.arm("serving.scheduler.loop", exc=RuntimeError("die"))
+            results, errors = [], []
+
+            def worker(i):
+                x = np.full((1, 2), float(i), np.float32)
+                try:
+                    results.append((i, engine.infer([x], timeout=10)))
+                except Exception as e:  # noqa: BLE001 - sorted below
+                    errors.append((i, e))
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(15)
+            assert not any(t.is_alive() for t in threads), "client hung"
+            # exactly the in-flight group died; everyone else was served
+            assert len(errors) == 1
+            assert isinstance(errors[0][1], SchedulerRestarted)
+            assert len(results) == 5
+            for i, out in results:
+                assert out[0][0, 0] == float(i)
+        finally:
+            engine.close()
+
+    @pytest.mark.slow  # multi-second injected wedge delay
+    def test_wedged_scheduler_restarted_and_queue_drains(self):
+        # a scheduler stuck mid-execute (chaos delay) wedges: heartbeat
+        # stale + head-of-queue stale -> the watchdog abandons it, fails
+        # the stuck group retryable, and a fresh scheduler serves the
+        # parked request
+        engine = BatchingEngine.for_callable(_echo, max_batch_size=2,
+                                             max_wait_ms=1.0, **FAST)
+        try:
+            engine.warmup(signature=[("float32", (2,))])
+            chaos.arm("serving.execute", delay=2.5)  # visit 1 = group A
+            a_err, b_out = [], []
+            a = threading.Thread(target=lambda: a_err.append(
+                _raises(lambda: engine.infer(
+                    [np.ones((2, 2), np.float32)], timeout=10))))
+            a.start()
+            time.sleep(0.05)  # A popped; scheduler sleeping in execute
+            b = threading.Thread(target=lambda: b_out.append(
+                engine.infer([np.full((2, 2), 7.0, np.float32)],
+                             timeout=10)))
+            b.start()
+            a.join(10)
+            b.join(10)
+            assert not a.is_alive() and not b.is_alive(), "client hung"
+            assert isinstance(a_err[0], SchedulerRestarted)
+            assert b_out and b_out[0][0][0, 0] == 7.0
+            assert engine.stats()["scheduler_restarts"] >= 1
+        finally:
+            engine.close()
+
+    @pytest.mark.slow  # multi-second injected wedge delay
+    def test_wedged_on_last_request_with_empty_queue_recovers(self):
+        # the ONLY request is in flight (queue empty) when the execute
+        # wedges: the watchdog must use the in-flight group itself as
+        # its staleness witness — with only the queue head as witness,
+        # these waiters would hang forever
+        engine = BatchingEngine.for_callable(_echo, max_batch_size=4,
+                                             max_wait_ms=1.0, **FAST)
+        try:
+            engine.warmup(signature=[("float32", (2,))])
+            x = np.ones((2, 2), np.float32)
+            chaos.arm("serving.execute.bucket2", delay=3.0)
+            t0 = time.monotonic()
+            with pytest.raises(SchedulerRestarted):
+                engine.infer([x], timeout=10)
+            assert time.monotonic() - t0 < 2.9, "watchdog missed the wedge"
+            # the replacement scheduler serves the next request (the
+            # superseded thread exits after its sleep, results discarded)
+            out = engine.infer([x], timeout=10)
+            assert out[0].tobytes() == x.tobytes()
+        finally:
+            engine.close()
+
+    @pytest.mark.slow  # multi-second injected wedge delay
+    def test_wedged_cold_compile_fails_waiters_retryable(self):
+        # a cold-bucket compile runs on its own thread, outside the
+        # scheduler heartbeat: if it wedges, the watchdog must bound it
+        # by cold_compile_timeout and fail the waiters retryably — and
+        # warm buckets must keep serving the whole time
+        engine = BatchingEngine.for_callable(
+            _echo, max_batch_size=4, max_wait_ms=1.0,
+            cold_compile_timeout=0.3, **FAST)
+        try:
+            engine.warmup(signature=[("float32", (2,))], buckets=[2])
+            warm = np.ones((2, 2), np.float32)
+            cold = np.ones((4, 2), np.float32)  # bucket 4: not declared
+            chaos.arm("serving.compile.bucket4", delay=2.0)
+            got = []
+            t = threading.Thread(target=lambda: got.append(
+                _raises(lambda: engine.infer([cold], timeout=10))))
+            t.start()
+            # warm bucket unaffected while the cold compile is stuck
+            out = engine.infer([warm], timeout=10)
+            assert out[0].tobytes() == warm.tobytes()
+            t.join(5)
+            assert not t.is_alive(), "cold-compile waiter hung"
+            assert isinstance(got[0], RetryableError), got
+            assert "cold_compile_timeout" in str(got[0])
+        finally:
+            engine.close()
+
+    def test_wire_status_2_on_death_then_ok(self):
+        engine = BatchingEngine.for_callable(_echo, max_batch_size=2,
+                                             max_wait_ms=1.0, **FAST)
+        server = PredictorServer(_echo, engine=engine)
+        try:
+            engine.warmup(signature=[("float32", (2,))])
+            x = np.ones((2, 2), np.float32)
+            chaos.arm("serving.scheduler.loop", exc=RuntimeError("die"))
+            status, _ = _infer_over_wire(server.port, [x], sock_timeout=15)
+            assert status == STATUS_OVERLOADED
+            status, outs = _infer_over_wire(server.port, [x],
+                                            sock_timeout=15)
+            assert status == STATUS_OK
+            assert outs[0].tobytes() == x.tobytes()
+        finally:
+            server.stop()
+            engine.close()
+
+    @pytest.mark.slow
+    def test_e2e_death_concurrent_clients_bitwise_after_recovery(
+            self, tmp_path):
+        """Acceptance: with scheduler-death injected, every concurrent
+        client gets a correct result or a clean retryable status (never
+        a hang), and the next round succeeds bitwise-identically."""
+        prefix = _save_mlp(tmp_path)
+        server = serve_model(prefix, dynamic_batching=True,
+                             max_batch_size=8, max_wait_ms=2.0,
+                             **FAST)
+        baseline = create_predictor(Config(prefix))
+        rng = np.random.RandomState(3)
+        requests = [rng.randn(2 + (i % 3), 8).astype(np.float32)
+                    for i in range(16)]
+        expected = [np.asarray(baseline.run([x])[0]).copy()
+                    for x in requests]
+        try:
+            # kill the scheduler a couple of groups into the burst
+            base = chaos.visits("serving.scheduler.loop")
+            chaos.arm("serving.scheduler.loop",
+                      exc=RuntimeError("chaos: die"), at=base + 2)
+
+            def round_trip(tag):
+                statuses = [None] * len(requests)
+                outs = [None] * len(requests)
+
+                def client(i):
+                    st, o = _infer_over_wire(server.port, [requests[i]],
+                                             sock_timeout=30)
+                    statuses[i], outs[i] = st, o
+
+                threads = [threading.Thread(target=client, args=(i,))
+                           for i in range(len(requests))]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(60)
+                assert not any(t.is_alive() for t in threads), \
+                    f"{tag}: a client hung"
+                return statuses, outs
+
+            statuses, outs = round_trip("chaos round")
+            for i, st in enumerate(statuses):
+                assert st in (STATUS_OK, STATUS_OVERLOADED), \
+                    f"client {i}: status {st} is neither ok nor retryable"
+                if st == STATUS_OK:
+                    assert outs[i][0].tobytes() == expected[i].tobytes()
+            # recovery round: everything succeeds, bitwise
+            statuses, outs = round_trip("recovery round")
+            assert all(st == STATUS_OK for st in statuses)
+            for o, want in zip(outs, expected):
+                assert o[0].tobytes() == want.tobytes()
+            health = _health_over_wire(server.port)
+            assert health["ok"] and health["engine"]["scheduler_alive"]
+        finally:
+            server.stop()
+
+
+def _raises(fn):
+    try:
+        return fn()
+    except Exception as e:  # noqa: BLE001 - test helper
+        return e
+
+
+def _save_mlp(tmp_path, scale=1.0, name="mlp"):
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(8, 16)
+            self.fc2 = nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.fc2(nn.functional.relu(self.fc1(x))) * scale
+
+    paddle.seed(0)
+    m = MLP()
+    m.eval()
+    prefix = str(tmp_path / name)
+    paddle.jit.save(m, prefix, input_spec=[InputSpec([None, 8], "float32")])
+    return prefix
+
+
+class TestBucketQuarantine:
+    def test_trips_after_n_failures_sheds_fast_recovers_after_cooldown(
+            self):
+        engine = BatchingEngine.for_callable(
+            _echo, max_batch_size=4, max_wait_ms=1.0,
+            breaker_threshold=2, breaker_cooldown=0.25, **FAST)
+        try:
+            engine.warmup(signature=[("float32", (2,))])
+            x = np.ones((2, 2), np.float32)
+            chaos.arm("serving.execute.bucket2",
+                      exc=RuntimeError("poisoned"), times=2)
+            for _ in range(2):  # two consecutive execute failures
+                with pytest.raises(RuntimeError, match="poisoned"):
+                    engine.infer([x], timeout=10)
+            # breaker OPEN: shed fast without executing
+            t0 = time.monotonic()
+            with pytest.raises(BucketQuarantined) as ei:
+                engine.infer([x], timeout=10)
+            assert time.monotonic() - t0 < 0.5, "quarantine shed not fast"
+            assert ei.value.status_code == 2
+            st = engine.stats()
+            assert st["breaker"]["open"] == 1
+            assert st["breaker"]["trips"] == 1
+            assert st["quarantine_shed"] >= 1
+            assert 2 in engine.health()["quarantined_buckets"]
+            # cooldown passes -> half-open probe succeeds -> closed
+            time.sleep(0.3)
+            out = engine.infer([x], timeout=10)
+            assert out[0].tobytes() == x.tobytes()
+            st = engine.stats()
+            assert st["breaker"]["open"] == 0
+            assert engine.health()["quarantined_buckets"] == []
+        finally:
+            engine.close()
+
+    def test_poisoned_bucket_does_not_take_down_other_buckets(self):
+        # bucket 2 poisoned forever; bucket 4 keeps serving throughout
+        engine = BatchingEngine.for_callable(
+            _echo, max_batch_size=4, max_wait_ms=1.0,
+            breaker_threshold=2, breaker_cooldown=30.0, **FAST)
+        try:
+            engine.warmup(signature=[("float32", (2,))])
+            chaos.arm("serving.execute.bucket2",
+                      exc=RuntimeError("poisoned"), times=1000)
+            sick = np.ones((2, 2), np.float32)
+            healthy = np.ones((4, 2), np.float32)
+            for i in range(6):
+                with pytest.raises((RuntimeError, BucketQuarantined)):
+                    engine.infer([sick], timeout=10)
+                out = engine.infer([healthy], timeout=10)
+                assert out[0].tobytes() == healthy.tobytes(), f"round {i}"
+            st = engine.stats()
+            assert st["breaker"]["open"] == 1
+            assert st["quarantine_shed"] >= 4  # sheds after the 2 trips
+            # the healthy bucket's counters kept growing
+            healthy_stats = st["buckets"]["4"][0]
+            assert healthy_stats["batches"] >= 6
+            assert healthy_stats.get("breaker", {}).get("state",
+                                                        "closed") == "closed"
+        finally:
+            engine.close()
+
+    def test_half_open_probe_failure_reopens(self):
+        engine = BatchingEngine.for_callable(
+            _echo, max_batch_size=2, max_wait_ms=1.0,
+            breaker_threshold=1, breaker_cooldown=0.15, **FAST)
+        try:
+            engine.warmup(signature=[("float32", (2,))])
+            x = np.ones((2, 2), np.float32)
+            chaos.arm("serving.execute.bucket2",
+                      exc=RuntimeError("poisoned"), times=2)
+            with pytest.raises(RuntimeError, match="poisoned"):
+                engine.infer([x], timeout=10)  # trips (threshold 1)
+            time.sleep(0.2)
+            # half-open probe also fails -> reopen
+            with pytest.raises(RuntimeError, match="poisoned"):
+                engine.infer([x], timeout=10)
+            st = engine.stats()
+            assert st["breaker"]["open"] == 1
+            assert st["breaker"]["trips"] == 2
+            # immediately after the failed probe: still quarantined
+            with pytest.raises(BucketQuarantined):
+                engine.infer([x], timeout=10)
+            # fault exhausted: next probe heals it
+            time.sleep(0.2)
+            assert engine.infer([x], timeout=10)[0].tobytes() == x.tobytes()
+        finally:
+            engine.close()
+
+    @pytest.mark.slow  # multi-second injected wedge delay
+    def test_stranded_half_open_probe_reopens_not_stuck(self):
+        # a probe group stranded by a scheduler restart must put its
+        # breaker back to OPEN (fresh cooldown) — neither record_success
+        # nor record_failure ever runs for a stranded probe, and a
+        # breaker stuck HALF_OPEN would shed its bucket forever
+        engine = BatchingEngine.for_callable(
+            _echo, max_batch_size=4, max_wait_ms=1.0,
+            breaker_threshold=1, breaker_cooldown=0.15, **FAST)
+        try:
+            engine.warmup(signature=[("float32", (2,))])
+            x = np.ones((2, 2), np.float32)
+            chaos.arm("serving.execute.bucket2",
+                      exc=RuntimeError("poisoned"))
+            with pytest.raises(RuntimeError, match="poisoned"):
+                engine.infer([x], timeout=10)  # trips (threshold 1)
+            time.sleep(0.2)  # past cooldown: next group is the probe
+            # the probe's execute wedges; the watchdog restarts the
+            # scheduler, stranding the probe group mid-flight (at= is an
+            # absolute site-visit count, so aim past the trip above)
+            chaos.arm("serving.execute.bucket2", delay=3.0,
+                      at=chaos.visits("serving.execute.bucket2") + 1)
+            with pytest.raises(SchedulerRestarted):
+                engine.infer([x], timeout=10)
+            assert engine.stats()["breaker"]["open"] == 1  # re-OPENED
+            # after another cooldown a fresh probe heals the bucket
+            time.sleep(0.2)
+            assert engine.infer([x], timeout=10)[0].tobytes() == x.tobytes()
+        finally:
+            engine.close()
+
+    def test_compile_failures_trip_breaker(self):
+        engine = BatchingEngine.for_callable(
+            _echo, max_batch_size=2, max_wait_ms=1.0,
+            breaker_threshold=2, breaker_cooldown=0.2, **FAST)
+        try:
+            x = np.ones((2, 2), np.float32)
+            chaos.arm("serving.compile.bucket2",
+                      exc=RuntimeError("bad lowering"), times=2)
+            for _ in range(2):
+                with pytest.raises(RuntimeError, match="bad lowering"):
+                    engine.infer([x], timeout=10)
+            with pytest.raises(BucketQuarantined):
+                engine.infer([x], timeout=10)
+            time.sleep(0.25)
+            # probe re-compiles (fault exhausted) and serves
+            assert engine.infer([x], timeout=10)[0].tobytes() == x.tobytes()
+            assert engine.stats()["compiles"] == 1
+        finally:
+            engine.close()
+
+
+class TestDeadlines:
+    def test_expired_request_purged_before_dispatch(self):
+        ran = []
+        release = threading.Event()
+
+        def fn(x):
+            if x.any():  # warmup primes with a zero batch: let it pass;
+                ran.append(x.shape)  # only real requests gate on release
+                release.wait(5)
+            return [np.asarray(x)]
+
+        engine = BatchingEngine.for_callable(fn, max_batch_size=1,
+                                             max_wait_ms=1.0, **FAST)
+        try:
+            # warm bucket 1 so A executes INLINE on the scheduler thread
+            # (a cold bucket runs on a spawned compile thread and would
+            # leave the scheduler free to dispatch B)
+            engine.warmup(signature=[("float32", (2,))])
+            # A occupies the scheduler; B expires while parked
+            a = threading.Thread(target=lambda: engine.infer(
+                [np.ones((1, 2), np.float32)], timeout=10))
+            a.start()
+            deadline = time.monotonic() + 0.05
+            time.sleep(0.02)
+            threading.Timer(0.15, release.set).start()
+            with pytest.raises(DeadlineExceeded):
+                engine.infer([np.full((1, 2), 9.0, np.float32)],
+                             deadline=deadline)
+            a.join(10)
+            # B's rows were never computed: dropped before dispatch
+            assert len(ran) == 1
+            assert engine.stats()["deadline_expired"] >= 1
+        finally:
+            release.set()
+            engine.close()
+
+    def test_group_fires_before_tightest_deadline_not_max_wait(self):
+        engine = BatchingEngine.for_callable(_echo, max_batch_size=8,
+                                             max_wait_ms=10_000.0, **FAST)
+        try:
+            engine.warmup(signature=[("float32", (2,))])
+            x = np.ones((2, 2), np.float32)
+            # 0.5s: enough headroom that scheduler starvation on a
+            # loaded box can't expire the deadline before dispatch,
+            # still 20x under the 10s coalesce wait it discriminates
+            t0 = time.monotonic()
+            out = engine.infer([x], deadline=t0 + 0.5, timeout=10)
+            elapsed = time.monotonic() - t0
+            assert out[0].tobytes() == x.tobytes()
+            # fired by the deadline margin, not the 10s coalesce wait
+            assert elapsed < 2.0, f"group waited {elapsed:.3f}s"
+            assert engine.stats()["deadline_expired"] == 0
+        finally:
+            engine.close()
+
+    def test_split_path_shares_deadline(self):
+        # oversized request: chunks inherit the shared deadline, so a
+        # gated executor expires ALL of them instead of hanging the join
+        release = threading.Event()
+
+        def fn(x):
+            release.wait(5)
+            return [np.asarray(x)]
+
+        engine = BatchingEngine.for_callable(fn, max_batch_size=2,
+                                             max_wait_ms=1.0, **FAST)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises((DeadlineExceeded, TimeoutError)):
+                engine.infer([np.ones((6, 2), np.float32)],
+                             deadline=time.monotonic() + 0.1)
+            assert time.monotonic() - t0 < 2.0
+        finally:
+            release.set()
+            engine.close()
+
+    def test_wire_deadline_ok_when_fast_and_expired_budget_drops(self):
+        engine = BatchingEngine.for_callable(_echo, max_batch_size=2,
+                                             max_wait_ms=1.0, **FAST)
+        server = PredictorServer(_echo, engine=engine)
+        try:
+            engine.warmup(signature=[("float32", (2,))])
+            x = np.arange(4, dtype=np.float32).reshape(2, 2)
+            # a generous deadline on a healthy engine: served, bitwise
+            status, outs = _infer_over_wire(server.port, [x],
+                                            timeout_ms=5000.0)
+            assert status == STATUS_OK
+            assert outs[0].tobytes() == x.tobytes()
+            # a zero budget is expired on arrival: dropped pre-dispatch
+            status, _ = _infer_over_wire(server.port, [x], timeout_ms=0.0)
+            assert status == STATUS_OVERLOADED
+        finally:
+            server.stop()
+            engine.close()
+
+    def test_wire_deadline_expires_in_flight_status_2(self):
+        release = threading.Event()
+
+        def fn(x):
+            release.wait(5)
+            return [np.asarray(x)]
+
+        engine = BatchingEngine.for_callable(fn, max_batch_size=1,
+                                             max_wait_ms=1.0, **FAST)
+        server = PredictorServer(fn, engine=engine)
+        try:
+            t0 = time.monotonic()
+            status, _ = _infer_over_wire(
+                server.port, [np.ones((1, 2), np.float32)],
+                timeout_ms=80.0, sock_timeout=15)
+            assert status == STATUS_OVERLOADED
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            release.set()
+            server.stop()
+            engine.close()
+
+
+class TestSplitAdmissionUnderChaos:
+    def test_all_or_nothing_holds_with_injected_submit_delay(self):
+        """Satellite: EngineOverloaded mid-split after partial admission
+        must be impossible — a chaos delay in the submit path lets a
+        competing request steal the last slot DURING the oversized
+        request's admission, which must then shed atomically."""
+        release = threading.Event()
+
+        def gated(x):
+            release.wait(10)
+            return [np.asarray(x)]
+
+        # NOT the FAST knobs: gated blocks the executor on purpose, and
+        # a test-sized wedge_timeout would have the watchdog "heal" that
+        # (this test is about split admission, not self-healing)
+        engine = BatchingEngine.for_callable(gated, max_batch_size=2,
+                                             max_wait_ms=1.0, max_queue=3,
+                                             watchdog_interval=0.02,
+                                             wedge_timeout=30.0)
+        try:
+            one = np.ones((1, 2), np.float32)
+            workers = []
+
+            def submit_single():
+                t = threading.Thread(target=lambda: engine.infer([one]))
+                t.start()
+                workers.append(t)
+
+            # occupy the executors and fill 2 of 3 slots
+            deadline = time.monotonic() + 10
+            while engine.stats()["queue_depth"] < 2:
+                assert time.monotonic() < deadline, "queue never filled"
+                if len(workers) < 6:
+                    submit_single()
+                time.sleep(0.02)
+            admitted = engine.stats()["requests"]
+
+            # the oversized request's submit stalls in the chaos delay;
+            # poll the chaos log for the delay firing, then steal the
+            # third slot while it sleeps
+            visit = chaos.visits("serving.submit") + 1
+            chaos.arm("serving.submit", at=visit, delay=0.3)
+            big_err = []
+            big = threading.Thread(target=lambda: big_err.append(
+                _raises(lambda: engine.infer(
+                    [np.ones((4, 2), np.float32)]))))
+            big.start()
+            t0 = time.monotonic()
+            while ("serving.submit", visit, "delay") not in chaos.monkey.log:
+                assert time.monotonic() - t0 < 5, "delay never fired"
+                time.sleep(0.01)
+            submit_single()  # takes the last slot mid-delay
+            big.join(10)
+            assert not big.is_alive()
+            assert isinstance(big_err[0], EngineOverloaded)
+            st = engine.stats()
+            # all-or-nothing: NO chunk of the oversized request admitted
+            assert st["requests"] == admitted + 1  # just the stealer
+            assert st["shed_count"] == 1
+            release.set()
+            for w in workers:
+                w.join(10)
+        finally:
+            release.set()
+            engine.close()
+
+
+class TestHealthAndReload:
+    def test_health_without_engine(self):
+        server = PredictorServer(_echo)
+        try:
+            h = _health_over_wire(server.port)
+            assert h["ok"] is True and h["engine"] is None
+            assert h["draining"] is False
+        finally:
+            server.stop()
+
+    def test_health_reports_engine_liveness(self):
+        engine = BatchingEngine.for_callable(_echo, max_batch_size=2,
+                                             max_wait_ms=1.0, **FAST)
+        server = PredictorServer(_echo, engine=engine)
+        try:
+            h = _health_over_wire(server.port)
+            assert h["ok"] is True
+            assert h["engine"]["scheduler_alive"] is True
+            assert h["engine"]["queue_depth"] == 0
+            assert h["engine"]["quarantined_buckets"] == []
+        finally:
+            server.stop()
+            engine.close()
+
+    def test_reload_without_loader_is_wire_error(self):
+        server = PredictorServer(_echo)
+        try:
+            status, msg = _reload_over_wire(server.port)
+            assert status == STATUS_ERROR
+            assert "loader" in msg
+        finally:
+            server.stop()
+
+    @pytest.mark.slow
+    def test_reload_zero_drops_zero_cold_compiles(self, tmp_path):
+        """Acceptance: reload during a concurrent closed-loop burst
+        drops zero requests and incurs zero post-swap cold compiles for
+        declared buckets."""
+        prefix = _save_mlp(tmp_path)
+        server = serve_model(prefix, dynamic_batching=True,
+                             max_batch_size=4, max_wait_ms=1.0, **FAST)
+        baseline = create_predictor(Config(prefix))
+        x = np.random.RandomState(5).randn(2, 8).astype(np.float32)
+        want = np.asarray(baseline.run([x])[0]).copy()
+        stop = threading.Event()
+        failures = []
+        counts = [0] * 8
+
+        def client(i):
+            try:
+                while not stop.is_set():
+                    status, outs = _infer_over_wire(server.port, [x],
+                                                    sock_timeout=30)
+                    if status != STATUS_OK or \
+                            outs[0].tobytes() != want.tobytes():
+                        failures.append((i, status))
+                        return
+                    counts[i] += 1
+            except Exception as e:  # noqa: BLE001 - recorded below
+                failures.append((i, repr(e)))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(0.3)  # closed-loop traffic flowing
+            status, payload = _reload_over_wire(server.port)  # same prefix
+            assert status == STATUS_OK, payload
+            info = json.loads(payload)
+            assert info["reloaded"] and info["warm_buckets"] == [1, 2, 4]
+            time.sleep(0.3)  # traffic keeps flowing on the new engine
+            stop.set()
+            for t in threads:
+                t.join(30)
+            assert not failures, failures[:3]
+            assert all(c > 0 for c in counts)
+            # the swapped-in engine warmed its declared buckets BEFORE
+            # the swap: post-swap traffic never paid a cold compile
+            with socket.create_connection(("127.0.0.1", server.port),
+                                          timeout=10) as s:
+                _send_frame(s, struct.pack("<B", 5))
+                st_status, st_payload = _recv_frame(s)
+            assert st_status == STATUS_OK
+            stats = json.loads(st_payload.decode("utf-8"))
+            assert stats["declared_buckets"] == [1, 2, 4]
+            assert stats["compiles"] == len(stats["declared_buckets"])
+            h = _health_over_wire(server.port)
+            assert h["reloads"] == 1 and h["ok"]
+        finally:
+            stop.set()
+            server.stop()
+
+    @pytest.mark.slow
+    def test_reload_swaps_in_new_weights(self, tmp_path):
+        prefix1 = _save_mlp(tmp_path, scale=1.0, name="m1")
+        prefix2 = _save_mlp(tmp_path, scale=3.0, name="m2")
+        server = serve_model(prefix1, dynamic_batching=True,
+                             max_batch_size=4, max_wait_ms=1.0, **FAST)
+        try:
+            x = np.random.RandomState(7).randn(2, 8).astype(np.float32)
+            want1 = np.asarray(
+                create_predictor(Config(prefix1)).run([x])[0]).copy()
+            want2 = np.asarray(
+                create_predictor(Config(prefix2)).run([x])[0]).copy()
+            status, outs = _infer_over_wire(server.port, [x])
+            assert status == STATUS_OK
+            assert outs[0].tobytes() == want1.tobytes()
+            status, payload = _reload_over_wire(server.port, prefix2)
+            assert status == STATUS_OK, payload
+            status, outs = _infer_over_wire(server.port, [x])
+            assert status == STATUS_OK
+            assert outs[0].tobytes() == want2.tobytes()
+        finally:
+            server.stop()
+
+    def test_stop_during_reload_aborts_swap_and_leaks_nothing(self):
+        """stop() racing a mid-flight reload: stop must not wait out the
+        (possibly multi-second) model load, the reload must abort at
+        swap time instead of handing the stopped server an engine
+        nothing would ever close, and reloads arriving after stop() are
+        refused without loading."""
+        from paddle_tpu.inference.batching import CallableRunner
+
+        class SigRunner(CallableRunner):
+            def default_signature(self):
+                return [("float32", (2,))]
+
+        def make_engine():
+            return BatchingEngine(SigRunner(_echo), max_batch_size=4,
+                                  max_wait_ms=1.0, **FAST)
+
+        made = []
+
+        def loader(prefix):
+            time.sleep(0.4)  # slow load: stop() lands mid-reload
+            eng = make_engine()
+            made.append(eng)
+            return (lambda arrs: _echo(arrs[0])), eng
+
+        eng0 = make_engine()
+        eng0.warmup()
+        server = PredictorServer(lambda arrs: _echo(arrs[0]), engine=eng0,
+                                 own_engine=True, loader=loader,
+                                 prefix="p0")
+        res = {}
+
+        def do_reload():
+            try:
+                res["r"] = server.reload("p1")
+            except RuntimeError as e:
+                res["err"] = str(e)
+
+        t = threading.Thread(target=do_reload)
+        t.start()
+        time.sleep(0.1)       # reload is inside the slow loader now
+        t0 = time.monotonic()
+        server.stop(drain=True)
+        assert time.monotonic() - t0 < 0.25, "stop() waited out the load"
+        t.join(10)
+        assert "stopped during reload" in res.get("err", ""), res
+        assert made[0]._closed, "aborted reload leaked its new engine"
+        assert eng0._closed, "serving engine leaked after stop()"
+        with pytest.raises(RuntimeError, match="stopping"):
+            server.reload("p2")
+        assert len(made) == 1  # the refused reload never hit the loader
+
+    def test_failed_reload_closes_new_engine_and_keeps_serving(self):
+        """A reload whose warmup raises must close the engine it built
+        (no scheduler/watchdog thread leak per retry) and leave the old
+        backend serving."""
+        from paddle_tpu.inference.batching import CallableRunner
+
+        class SigRunner(CallableRunner):
+            def default_signature(self):
+                return [("float32", (2,))]
+
+        class BadRunner(SigRunner):
+            def compile(self, bucket, sig):
+                raise RuntimeError("bad model: compile exploded")
+
+        made = []
+
+        def loader(prefix):
+            eng = BatchingEngine(BadRunner(_echo), max_batch_size=4,
+                                 max_wait_ms=1.0, **FAST)
+            made.append(eng)
+            return (lambda arrs: _echo(arrs[0])), eng
+
+        eng0 = BatchingEngine(SigRunner(_echo), max_batch_size=4,
+                              max_wait_ms=1.0, **FAST)
+        eng0.warmup()
+        server = PredictorServer(lambda arrs: _echo(arrs[0]), engine=eng0,
+                                 own_engine=True, loader=loader,
+                                 prefix="p0")
+        try:
+            with pytest.raises(RuntimeError, match="compile exploded"):
+                server.reload("broken")
+            assert made and made[0]._closed, \
+                "failed reload leaked its half-built engine"
+            x = np.arange(4, dtype=np.float32).reshape(2, 2)
+            status, outs = _infer_over_wire(server.port, [x])
+            assert status == STATUS_OK  # old backend still serving
+            assert outs[0].tobytes() == x.tobytes()
+        finally:
+            server.stop()

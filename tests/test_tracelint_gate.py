@@ -104,6 +104,29 @@ def test_resilience_subsystem_is_suppression_free():
     assert s["suppression_violations"] == 0 and s["lint_errors"] == 0
 
 
+def test_inference_subsystem_is_suppression_free():
+    """The serving stack is a clean zone too (DEFAULT_CLEAN_PATHS): no
+    inline tracelint suppressions under paddle_tpu/inference."""
+    r = _run(["--paths", "paddle_tpu/inference", "--skip-tests"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    s = _summary(r)
+    assert s["suppression_violations"] == 0 and s["lint_errors"] == 0
+
+
+def test_inference_is_a_default_clean_path():
+    """Both clean zones ship in the gate's DEFAULT clean paths (a
+    suppression under either fails without any --clean-paths override;
+    planting a violation inside the real tree is too invasive to test
+    end-to-end, so pin the default list itself)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("ci_gate", GATE)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert "paddle_tpu/inference" in mod.DEFAULT_CLEAN_PATHS
+    assert "paddle_tpu/resilience" in mod.DEFAULT_CLEAN_PATHS
+
+
 def test_chaos_stage_gates(tmp_path):
     good = tmp_path / "good.py"
     good.write_text(GOOD_SRC)
@@ -128,3 +151,30 @@ def test_chaos_stage_gates(tmp_path):
                               f"-p no:cacheprovider"])
     assert r.returncode == 0, r.stdout + r.stderr
     assert _summary(r)["chaos_ok"]
+
+
+def test_serving_chaos_stage_gates(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text(GOOD_SRC)
+    bad = tmp_path / "test_serving_chaos_fail.py"
+    bad.write_text(
+        "import pytest\n"
+        "pytestmark = [pytest.mark.chaos, pytest.mark.serving]\n"
+        "def test_boom():\n    assert False\n")
+    r = _run(["--paths", str(good), "--skip-tests", "--serving-chaos",
+              "--serving-chaos-args",
+              f"{bad} -q -m 'chaos and serving' -p no:cacheprovider"])
+    assert r.returncode == 1
+    s = _summary(r)
+    assert s["serving_chaos_run"] and not s["serving_chaos_ok"]
+    assert "+serving-chaos" in s["gate"]
+    ok = tmp_path / "test_serving_chaos_ok.py"
+    ok.write_text(
+        "import pytest\n"
+        "pytestmark = [pytest.mark.chaos, pytest.mark.serving]\n"
+        "def test_fine():\n    assert True\n")
+    r = _run(["--paths", str(good), "--skip-tests", "--serving-chaos",
+              "--serving-chaos-args",
+              f"{ok} -q -m 'chaos and serving' -p no:cacheprovider"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert _summary(r)["serving_chaos_ok"]

@@ -5,8 +5,8 @@
 Usage:
     python tools/ci_gate.py [--paths paddle_tpu]
         [--skip-tests] [--pytest-args "tests/ -q -m 'not slow'"]
-        [--disable TPU005,...] [--chaos] [--serving]
-        [--clean-paths paddle_tpu/resilience]
+        [--disable TPU005,...] [--chaos] [--serving] [--serving-chaos]
+        [--clean-paths paddle_tpu/resilience paddle_tpu/inference]
 
 Phase 1 runs ``tools/tracelint.py --format json`` over ``--paths`` and
 fails on any error-severity finding (the analyzer gates the codebase
@@ -21,9 +21,12 @@ recovery paths are exercised and reported separately from the
 functional tests. ``--serving`` adds a stage running the
 dynamic-batching serving suite (``-m serving``) — including its
 slow-marked cases like the serving bench contract that tier-1's
-``not slow`` filter skips. Exit 1 when any phase fails; the JSON line
-printed last summarises all of them for log scrapers (mirroring
-tools/check_op_benchmark_result.py's contract).
+``not slow`` filter skips. ``--serving-chaos`` adds a stage running the
+serving fault-injection suite (``-m 'chaos and serving'``: scheduler
+death, poisoned-bucket quarantine, deadlines, hot reload) so the
+self-healing invariants gate releases on their own line. Exit 1 when
+any phase fails; the JSON line printed last summarises all of them for
+log scrapers (mirroring tools/check_op_benchmark_result.py's contract).
 """
 import argparse
 import json
@@ -38,9 +41,16 @@ TRACELINT = os.path.join(REPO, "tools", "tracelint.py")
 
 DEFAULT_PYTEST_ARGS = ("tests/ -q -m 'not slow' "
                        "--continue-on-collection-errors -p no:cacheprovider")
-CHAOS_PYTEST_ARGS = "tests/ -q -m chaos -p no:cacheprovider"
+# 'and not serving': the serving fault-injection suite (incl. slow
+# subprocess goodput benches) belongs to the --serving-chaos stage —
+# plain --chaos must not balloon by minutes because PR 5 added tests
+CHAOS_PYTEST_ARGS = "tests/ -q -m 'chaos and not serving' -p no:cacheprovider"
 SERVING_PYTEST_ARGS = "tests/ -q -m serving -p no:cacheprovider"
-DEFAULT_CLEAN_PATHS = ("paddle_tpu/resilience",)
+SERVING_CHAOS_PYTEST_ARGS = ("tests/ -q -m 'chaos and serving' "
+                             "-p no:cacheprovider")
+# subsystems that must stay suppression-free: resilience (PR 2) and the
+# serving stack (this PR) fix findings instead of silencing them
+DEFAULT_CLEAN_PATHS = ("paddle_tpu/resilience", "paddle_tpu/inference")
 
 _SUPPRESS_RE = re.compile(r"#\s*tracelint\s*:\s*disable")
 
@@ -108,12 +118,20 @@ def main(argv=None):
     ap.add_argument("--skip-tests", action="store_true")
     ap.add_argument("--pytest-args", default=DEFAULT_PYTEST_ARGS)
     ap.add_argument("--chaos", action="store_true",
-                    help="also run the fault-injection suite (-m chaos)")
+                    help="also run the training fault-injection suite "
+                         "(-m 'chaos and not serving'; serving chaos "
+                         "has its own --serving-chaos stage)")
     ap.add_argument("--chaos-args", default=CHAOS_PYTEST_ARGS)
     ap.add_argument("--serving", action="store_true",
                     help="also run the dynamic-batching serving suite "
                          "(-m serving, including its slow-marked cases)")
     ap.add_argument("--serving-args", default=SERVING_PYTEST_ARGS)
+    ap.add_argument("--serving-chaos", action="store_true",
+                    help="also run the serving fault-injection suite "
+                         "(-m 'chaos and serving': scheduler death, "
+                         "quarantine, deadlines, hot reload)")
+    ap.add_argument("--serving-chaos-args",
+                    default=SERVING_CHAOS_PYTEST_ARGS)
     ap.add_argument("--clean-paths", nargs="*",
                     default=list(DEFAULT_CLEAN_PATHS),
                     help="path prefixes where tracelint suppressions "
@@ -140,6 +158,10 @@ def main(argv=None):
             # compile-heavy serving suite twice in one gate invocation
             pytest_args = pytest_args.replace(
                 "'not slow'", "'not slow and not serving'")
+        elif ns.serving_chaos and pytest_args == DEFAULT_PYTEST_ARGS:
+            # same double-run guard for the serving-chaos stage alone
+            pytest_args = pytest_args.replace(
+                "'not slow'", "'not slow and not (chaos and serving)'")
         tests_ok = run_pytest(pytest_args) == 0
 
     chaos_ok = True
@@ -148,12 +170,23 @@ def main(argv=None):
 
     serving_ok = True
     if ns.serving:
-        serving_ok = run_pytest(ns.serving_args) == 0
+        serving_args = ns.serving_args
+        if ns.serving_chaos and serving_args == SERVING_PYTEST_ARGS:
+            # same guard: the serving-chaos stage owns chaos+serving
+            # (including the slow subprocess goodput bench)
+            serving_args = serving_args.replace(
+                "-m serving", "-m 'serving and not chaos'")
+        serving_ok = run_pytest(serving_args) == 0
+
+    serving_chaos_ok = True
+    if ns.serving_chaos:
+        serving_chaos_ok = run_pytest(ns.serving_chaos_args) == 0
 
     summary = {
         "gate": ("tracelint+suppressions+tier1"
                  + ("+chaos" if ns.chaos else "")
-                 + ("+serving" if ns.serving else "")),
+                 + ("+serving" if ns.serving else "")
+                 + ("+serving-chaos" if ns.serving_chaos else "")),
         "lint_ok": lint_ok,
         "lint_errors": report.get("errors", -1),
         "lint_warnings": report.get("warnings", 0),
@@ -166,10 +199,12 @@ def main(argv=None):
         "chaos_run": bool(ns.chaos),
         "serving_ok": serving_ok,
         "serving_run": bool(ns.serving),
+        "serving_chaos_ok": serving_chaos_ok,
+        "serving_chaos_run": bool(ns.serving_chaos),
     }
     print(json.dumps(summary))
     if not (lint_ok and audit_ok and tests_ok and chaos_ok
-            and serving_ok):
+            and serving_ok and serving_chaos_ok):
         print("ci_gate: FAILED", file=sys.stderr)
         return 1
     return 0
