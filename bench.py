@@ -13,6 +13,18 @@
                   (or BENCH_SERVING_CHAOS=1) measures GOODPUT under
                   injected faults instead: scheduler death + hot reload
                   + a poisoned-bucket quarantine phase
+  perfproxy       CPU-only compile-ledger regression check (also:
+                  `python bench.py perfproxy`): replays a fixed
+                  serving-bucket warmup + train-step compile, records
+                  compile counts / HLO op counts / cost-analysis FLOPs
+                  through paddle_tpu.obs.ledger, and diffs them against
+                  the committed PERFPROXY_BASELINE.json — the CI
+                  stand-in for the single-chip speed ladder while the
+                  TPU tunnel is unreachable. `--update-baseline`
+                  rewrites the baseline; BENCH_PERFPROXY_INJECT
+                  (extra_compile | flops) fakes a regression for
+                  failure-path tests; BENCH_PERFPROXY_BASELINE points
+                  at an alternate baseline file.
 
 Runs the full jitted training step (fwd + bwd + optimizer) on one chip
 for the training modes.
@@ -55,14 +67,17 @@ A100_RESNET50_IMAGES_PER_SEC = 2900.0
 # FlashAttention-2 paper: ~190 TFLOP/s fwd+bwd bf16 on A100 at seq 4k
 A100_FLASH_ATTN_TFLOPS = 190.0
 MODEL = os.environ.get("BENCH_MODEL", "bert")
+if "perfproxy" in sys.argv[1:]:
+    MODEL = "perfproxy"  # CLI spelling: python bench.py perfproxy
 METRIC = {"resnet50": "resnet50_train_images_per_sec_per_chip",
           "flash": "flash_attention_fwd_bwd_tflops_per_chip",
           "llama": "llama_374m_pretrain_tokens_per_sec_per_chip",
           "decode": "llama_374m_decode_tokens_per_sec_per_chip",
-          "serving": "serving_infer_qps_dynamic_batching"}.get(
+          "serving": "serving_infer_qps_dynamic_batching",
+          "perfproxy": "perfproxy_compile_ledger_check"}.get(
               MODEL, "bert_base_pretrain_tokens_per_sec_per_chip")
 _UNIT = {"resnet50": "images/s", "flash": "TFLOP/s",
-         "serving": "req/s"}.get(MODEL, "tokens/s")
+         "serving": "req/s", "perfproxy": "ok"}.get(MODEL, "tokens/s")
 V5E_BF16_PEAK_TFLOPS = 197.0
 V5E_HBM_GBPS = 819.0
 # shared by run_llama (training) and run_decode (serving): the two
@@ -241,6 +256,21 @@ def main():
         log(f"compilation cache at {cache_dir}")
     except Exception as e:  # noqa: BLE001 - cache is an optimization
         log(f"compilation cache unavailable: {e}")
+
+    if MODEL == "perfproxy":
+        # CPU-only by design: the whole point is a chip-independent
+        # structural check that runs while the TPU tunnel is dead.
+        # Hermetic device count too: a caller running under the test
+        # harness exports --xla_force_host_platform_device_count=8,
+        # which would reshard the train-step compile and shift every
+        # structural number — strip it before the backend initialises
+        # (no device has been touched yet at this point).
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if not f.startswith(
+                     "--xla_force_host_platform_device_count")]
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+        jax.config.update("jax_platforms", "cpu")
+        return run_perfproxy("--update-baseline" in sys.argv)
 
     smoke = os.environ.get("BENCH_CPU") == "1"
     if smoke:
@@ -1204,6 +1234,256 @@ def run_serving_chaos(smoke, platform):
     }
     if smoke:
         rec["smoke"] = True
+    return rec
+
+
+def _perfproxy_measure():
+    """Replay the fixed perfproxy scenario and return the measured
+    structural record. Deterministic on a fixed jax build: tiny models,
+    fixed seeds, CPU backend."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed import spmd, topology
+    from paddle_tpu.inference.batching import BatchingEngine
+    from paddle_tpu.jit import load as jit_load
+    from paddle_tpu.obs.ledger import LEDGER
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(0)
+    hidden, depth, max_batch = 64, 3, 8
+
+    # ---- scenario 1: the serving bucket ladder. Warmup must compile
+    # every declared bucket exactly once; post-warmup traffic at
+    # declared sizes must add ZERO compiles (the compile-once promise
+    # the whole serving design rests on — a regression here is the
+    # "extra compile" failure mode).
+    class ProxyMLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fcs = nn.LayerList([nn.Linear(hidden, hidden)
+                                     for _ in range(depth)])
+
+        def forward(self, x):
+            h = x
+            for fc in self.fcs[:-1]:
+                h = nn.functional.relu(fc(h))
+            return self.fcs[-1](h)
+
+    model = ProxyMLP()
+    model.eval()
+    prefix = os.path.join(tempfile.mkdtemp(), "perfproxy_mlp")
+    paddle.jit.save(model, prefix,
+                    input_spec=[InputSpec([None, hidden], "float32")])
+    layer = jit_load(prefix)
+    LEDGER.reset()
+    engine = BatchingEngine.for_layer(
+        layer, max_batch_size=max_batch, max_wait_ms=1.0, max_queue=64,
+        watchdog_interval=0)
+    try:
+        engine.warmup()
+        warm = LEDGER.totals("serving/")
+        buckets = {}
+        for ev in LEDGER.events("serving/"):
+            buckets[str(ev["bucket"])] = {
+                "flops": ev.get("flops", 0.0),
+                "n_ops": ev.get("n_ops", 0),
+                "fingerprint": ev.get("fingerprint", ""),
+            }
+        rng = np.random.RandomState(0)
+        for rows in (1, 3, max_batch):
+            engine.infer([rng.randn(rows, hidden).astype(np.float32)],
+                         timeout=60)
+        post = LEDGER.totals("serving/")["compiles"] - warm["compiles"]
+    finally:
+        engine.close()
+
+    # ---- scenario 2: one full jitted train step (fwd + bwd + AdamW
+    # under amp O1) AOT-lowered so cost_analysis sees the real program
+    # the speed ladder optimizes.
+    train = nn.Sequential(nn.Linear(32, 64), nn.ReLU(), nn.Linear(64, 8))
+    train.train()
+    opt = optimizer.AdamW(1e-3, parameters=train.parameters())
+
+    def loss_fn(out, y):
+        return jnp.mean((out.astype(jnp.float32) - y) ** 2)
+
+    mesh = topology.build_mesh(dp=1)
+    topology.set_global_mesh(mesh)
+    step_fn, init_fn = spmd.build_train_step(train, loss_fn, opt,
+                                             mesh=mesh, amp_level="O1",
+                                             donate=False)
+    params, opt_state = init_fn()
+    x = jnp.zeros((16, 32), jnp.float32)
+    y = jnp.zeros((16, 8), jnp.float32)
+    key = jax.random.PRNGKey(0)
+    lr = jnp.asarray(1e-3, jnp.float32)
+    t0 = time.time()
+    compiled = step_fn.jitted.lower(params, opt_state, {}, x, y, key,
+                                    lr).compile()
+    # the ledger event already carries the full structural analysis
+    # (flops/op_counts/fingerprint) — reuse it, don't re-parse the HLO
+    train_info = LEDGER.record("train/step", duration_s=time.time() - t0,
+                               compiled=compiled, kind="aot")
+
+    return {
+        "jax": jax.__version__,
+        "serving": {
+            "warmup_compiles": int(warm["compiles"]),
+            "post_warmup_compiles": int(post),
+            "flops": warm["flops"],
+            "n_ops": int(warm["n_ops"]),
+            "op_counts": warm["op_counts"],
+            "buckets": buckets,
+        },
+        "train_step": {
+            "flops": train_info.get("flops", 0.0),
+            "bytes_accessed": train_info.get("bytes_accessed", 0.0),
+            "n_ops": train_info.get("n_ops", 0),
+            "op_counts": train_info.get("op_counts", {}),
+            "fingerprint": train_info.get("fingerprint", ""),
+        },
+    }
+
+
+def _perfproxy_compare(measured, baseline, flop_tol, op_tol):
+    """Diff a measured perfproxy record against the committed baseline.
+    Returns (checks, notes): every check row carries measured/baseline/
+    tol/ok; notes are informational (fingerprint drift)."""
+    checks = []
+
+    def chk(name, got, want, tol=None):
+        if tol is None:
+            ok = got == want
+        elif want == 0:
+            ok = got == 0
+        else:
+            ok = abs(got - want) <= tol * abs(want)
+        checks.append({"check": name, "measured": got, "baseline": want,
+                       "tol": tol, "ok": bool(ok)})
+
+    def chk_ops(name, got, want):
+        # an opcode appearing or disappearing is ALWAYS a structural
+        # regression; an opcode present on both sides may drift by
+        # max(2, op_tol * baseline) before it counts
+        bad = []
+        for op in sorted(set(got) | set(want)):
+            g, w = got.get(op, 0), want.get(op, 0)
+            if (g == 0) != (w == 0) or abs(g - w) > max(2, op_tol * w):
+                bad.append(f"{op}:{w}->{g}")
+        checks.append({"check": name, "measured": len(got),
+                       "baseline": len(want), "tol": op_tol,
+                       "ok": not bad,
+                       "drift": bad[:10]})
+
+    m_s, b_s = measured["serving"], baseline["serving"]
+    chk("serving.warmup_compiles", m_s["warmup_compiles"],
+        b_s["warmup_compiles"])
+    chk("serving.post_warmup_compiles", m_s["post_warmup_compiles"],
+        b_s["post_warmup_compiles"])
+    chk("serving.flops", m_s["flops"], b_s["flops"], flop_tol)
+    chk("serving.n_ops", m_s["n_ops"], b_s["n_ops"], op_tol)
+    chk_ops("serving.op_counts", m_s["op_counts"], b_s["op_counts"])
+    for b in sorted(b_s["buckets"], key=int):
+        mb = m_s["buckets"].get(b, {})
+        chk(f"serving.bucket{b}.flops", mb.get("flops", 0.0),
+            b_s["buckets"][b]["flops"], flop_tol)
+    m_t, b_t = measured["train_step"], baseline["train_step"]
+    chk("train_step.flops", m_t["flops"], b_t["flops"], flop_tol)
+    chk("train_step.n_ops", m_t["n_ops"], b_t["n_ops"], op_tol)
+    chk_ops("train_step.op_counts", m_t["op_counts"], b_t["op_counts"])
+
+    notes = []
+    for b in sorted(b_s["buckets"], key=int):
+        got = m_s["buckets"].get(b, {}).get("fingerprint", "")
+        want = b_s["buckets"][b].get("fingerprint", "")
+        if got != want:
+            notes.append(f"bucket {b} HLO fingerprint changed "
+                         f"{want} -> {got}")
+    if m_t.get("fingerprint") != b_t.get("fingerprint"):
+        notes.append(f"train_step HLO fingerprint changed "
+                     f"{b_t.get('fingerprint')} -> {m_t.get('fingerprint')}")
+    return checks, notes
+
+
+def run_perfproxy(update_baseline=False):
+    """CPU-only perf-proxy regression gate (ROADMAP item 4): the chip
+    may be unreachable, but compile counts, HLO op counts, and XLA
+    cost-analysis FLOPs are measurable anywhere — if those rot, perf
+    rotted. Diffs against the committed baseline; exits non-zero (with
+    the failing checks in the one JSON line) on regression."""
+    baseline_path = os.environ.get(
+        "BENCH_PERFPROXY_BASELINE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "PERFPROXY_BASELINE.json"))
+    flop_tol = float(os.environ.get("BENCH_PERFPROXY_FLOP_TOL", "0.02"))
+    op_tol = float(os.environ.get("BENCH_PERFPROXY_OP_TOL", "0.05"))
+
+    measured = _perfproxy_measure()
+
+    if update_baseline:
+        payload = dict(measured)
+        payload["format"] = 1
+        payload["flop_tol"] = flop_tol
+        payload["op_tol"] = op_tol
+        with open(baseline_path, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+        log(f"perfproxy baseline written to {baseline_path}")
+        return {"metric": METRIC, "value": 1.0, "unit": "ok",
+                "vs_baseline": 1.0, "ok": True,
+                "updated_baseline": baseline_path}
+
+    inject = os.environ.get("BENCH_PERFPROXY_INJECT", "")
+    if inject == "extra_compile":
+        # simulated recompile regression (a bucket paying a second
+        # compile post-warmup) for the failure-path contract test
+        measured["serving"]["post_warmup_compiles"] += 1
+    elif inject == "flops":
+        measured["serving"]["flops"] *= 1.5
+        measured["train_step"]["flops"] *= 1.5
+    elif inject:
+        fail(f"unknown BENCH_PERFPROXY_INJECT={inject!r} "
+             "(expected extra_compile | flops)")
+
+    try:
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        fail(f"perfproxy baseline unreadable ({baseline_path}): {e} — "
+             "run `python bench.py perfproxy --update-baseline` and "
+             "commit the result")
+
+    checks, notes = _perfproxy_compare(measured, baseline, flop_tol,
+                                       op_tol)
+    failed = [c for c in checks if not c["ok"]]
+    for c in checks:
+        log(f"perfproxy {'ok  ' if c['ok'] else 'FAIL'} {c['check']}: "
+            f"measured={c['measured']} baseline={c['baseline']}"
+            + (f" tol={c['tol']}" if c["tol"] is not None else ""))
+    for n in notes:
+        log(f"perfproxy note: {n}")
+    rec = {
+        "metric": METRIC,
+        "value": 0.0 if failed else 1.0,
+        "unit": "ok",
+        "vs_baseline": 0.0 if failed else 1.0,
+        "ok": not failed,
+        "baseline_file": os.path.basename(baseline_path),
+        "baseline_jax": baseline.get("jax"),
+        "jax": measured["jax"],
+        "checks": checks,
+        "notes": notes,
+    }
+    if failed:
+        rec["error"] = ("perfproxy regression: "
+                        + "; ".join(c["check"] for c in failed))
+        e = BenchFailure(rec["error"])
+        e.record = rec
+        raise e
     return rec
 
 

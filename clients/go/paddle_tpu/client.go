@@ -7,9 +7,10 @@
 //   request:  u32 body_len | u8 cmd(1=infer) | u8 n_inputs |
 //             per input: u8 dtype(0=f32,1=i32,2=i64,3=bool) u8 ndim
 //             i64 dims[] data
-//             optionally followed by u8 0xDD | f64 timeout_ms (a
-//             per-request deadline; servers predating it ignore the
-//             trailing bytes)
+//             optionally followed by marker-tagged trailing fields in
+//             any order (servers predating a field ignore the bytes):
+//               u8 0xDD | f64 timeout_ms   per-request deadline
+//               u8 0x1D | u64 trace_id     non-zero span-trace id
 //   response: u32 body_len | u8 status | same encoding of outputs
 //   status:   0 ok | 1 error | 2 retryable (request shed by the
 //             server's batching engine, a quarantined bucket, a
@@ -55,9 +56,22 @@ var dtypeSize = map[byte]int{dtypeF32: 4, dtypeI32: 4, dtypeI64: 8, dtypeBool: 1
 // backoff-and-retry itself.
 var ErrOverloaded = fmt.Errorf("server overloaded: request shed (status 2)")
 
-// deadlineMarker tags the optional trailing deadline field on an infer
-// body (mirrors server.py DEADLINE_MARKER).
-const deadlineMarker = 0xDD
+// deadlineMarker / traceMarker tag the optional trailing fields on an
+// infer body (mirror server.py DEADLINE_MARKER / TRACE_MARKER).
+const (
+	deadlineMarker = 0xDD
+	traceMarker    = 0x1D
+)
+
+// NewTraceID returns a random non-zero trace id (0 means "untraced" on
+// the wire).
+func NewTraceID() uint64 {
+	for {
+		if id := rand.Uint64(); id != 0 {
+			return id
+		}
+	}
+}
 
 // Predictor holds one connection to a PredictorServer.
 type Predictor struct {
@@ -74,6 +88,10 @@ type Predictor struct {
 	retryAttempts  int
 	retryBaseDelay time.Duration
 	retryMaxDelay  time.Duration
+	// non-zero: sent as the wire trace-id field on every Run, tagging
+	// the server-side spans (enqueue/batch/execute/reply) so one
+	// request can be followed through the engine
+	traceID uint64
 }
 
 // Option configures a Predictor (NewPredictor(addr, opts...)).
@@ -99,6 +117,19 @@ func WithRetry(maxAttempts int, baseDelay, maxDelay time.Duration) Option {
 		p.retryMaxDelay = maxDelay
 	}
 }
+
+// WithTraceID attaches a trace id (see NewTraceID) to every Run: the
+// server tags the request's spans with it, so its path through the
+// batching engine shows up in the obs.tracing span buffer and the
+// shared summary table. SetTraceID changes it per request.
+func WithTraceID(id uint64) Option {
+	return func(p *Predictor) { p.traceID = id }
+}
+
+// SetTraceID switches the trace id sent on subsequent Runs (0 disables
+// tracing). Callers that tag each request individually pair this with
+// NewTraceID.
+func (p *Predictor) SetTraceID(id uint64) { p.traceID = id }
 
 func NewPredictor(addr string, opts ...Option) (*Predictor, error) {
 	p := &Predictor{addr: addr, retryAttempts: 1}
@@ -242,6 +273,11 @@ func (p *Predictor) runOnce(inputs []Tensor) ([]Tensor, error) {
 		body = binary.LittleEndian.AppendUint64(body, math.Float64bits(ms))
 		_ = conn.SetDeadline(time.Now().Add(p.timeout))
 		defer conn.SetDeadline(time.Time{})
+	}
+	if p.traceID != 0 {
+		// optional wire trace-id field (old servers ignore it)
+		body = append(body, traceMarker)
+		body = binary.LittleEndian.AppendUint64(body, p.traceID)
 	}
 	hdr := binary.LittleEndian.AppendUint32(nil, uint32(len(body)))
 	if _, err := conn.Write(append(hdr, body...)); err != nil {

@@ -142,6 +142,47 @@ func TestWithTimeoutAppendsWireDeadline(t *testing.T) {
 	}
 }
 
+func TestWithTraceIDAppendsWireField(t *testing.T) {
+	addr, bodies := fakeServer(t, []byte{0})
+	id := NewTraceID()
+	p, err := NewPredictor(addr,
+		WithTimeout(250*time.Millisecond), WithTraceID(id))
+	if err != nil {
+		t.Fatal(err)
+	}
+	defer p.Close()
+	if _, err := p.Run(oneInput()); err != nil {
+		t.Fatal(err)
+	}
+	body := <-bodies
+	// tail layout: ... | 0xDD f64 | 0x1D u64 — the trace field rides
+	// after the deadline field, each 9 bytes
+	if len(body) < 18 || body[len(body)-9] != traceMarker {
+		t.Fatalf("trace marker missing from body tail: % x", body)
+	}
+	got := binary.LittleEndian.Uint64(body[len(body)-8:])
+	if got != id {
+		t.Fatalf("want trace id %d on the wire, got %d", id, got)
+	}
+	if body[len(body)-18] != deadlineMarker {
+		t.Fatalf("deadline field displaced by trace field: % x", body)
+	}
+}
+
+func TestNewTraceIDNonZero(t *testing.T) {
+	seen := map[uint64]bool{}
+	for i := 0; i < 64; i++ {
+		id := NewTraceID()
+		if id == 0 {
+			t.Fatal("NewTraceID returned 0 (the untraced sentinel)")
+		}
+		seen[id] = true
+	}
+	if len(seen) < 2 {
+		t.Fatal("NewTraceID does not look random")
+	}
+}
+
 func TestTimeoutPoisonsConnAndRedials(t *testing.T) {
 	// A server that stays silent on the first connection (forcing the
 	// client's socket deadline to fire) and serves correctly on later

@@ -4,9 +4,10 @@
 #   request:  u32 body_len | u8 cmd(1) | u8 n_inputs |
 #             per input: u8 dtype(0=f32,1=i32,2=i64,3=bool) u8 ndim
 #             i64 dims[] data
-#             optionally followed by u8 0xDD | f64 timeout_ms (a
-#             per-request deadline; servers predating it ignore the
-#             trailing bytes)
+#             optionally followed by marker-tagged trailing fields in
+#             any order (servers predating a field ignore the bytes):
+#               u8 0xDD | f64 timeout_ms   per-request deadline
+#               u8 0x1D | u64 trace_id     non-zero span-trace id
 #   response: u32 body_len | u8 status | same encoding of outputs
 #   status:   0 ok | 1 error | 2 retryable (request shed by the
 #             server's batching engine, a quarantined bucket, a
@@ -40,12 +41,21 @@ pd_connect <- function(host = "127.0.0.1", port) {
 
 # One prediction round-trip. timeout_ms adds the optional wire deadline
 # field (the server drops the request without dispatch once the budget
-# is spent). retries > 0 retries a status-2 (retryable) response with
-# exponential backoff + jitter — the backoff shape of
+# is spent). trace_id adds the optional wire trace-id field: the server
+# tags the request's obs.tracing spans (enqueue/batch/execute/reply)
+# with it so this call can be followed through the batching engine —
+# R doubles are exact to 2^53, so pass an id in [1, 2^53] (e.g.
+# pd_trace_id()). retries > 0 retries a status-2 (retryable) response
+# with exponential backoff + jitter — the backoff shape of
 # paddle_tpu/resilience/retry.py: base * 2^k capped, *(1 +/- 0.5*u).
+pd_trace_id <- function() {
+  # random non-zero id in the double-exact range (53 usable bits)
+  floor(stats::runif(1, min = 1, max = 2^53))
+}
+
 pd_predict <- function(con, x, dtype = c("float32", "int32", "int64",
                                          "bool"),
-                       timeout_ms = NULL, retries = 0L,
+                       timeout_ms = NULL, trace_id = NULL, retries = 0L,
                        backoff_base = 0.1, backoff_max = 2.0) {
   dtype <- match.arg(dtype)
   dims <- if (is.null(dim(x))) length(x) else dim(x)
@@ -69,6 +79,11 @@ pd_predict <- function(con, x, dtype = c("float32", "int32", "int64",
   if (!is.null(timeout_ms)) {
     writeBin(as.raw(0xDD), buf)
     writeBin(as.numeric(timeout_ms), buf, size = 8, endian = "little")
+  }
+  if (!is.null(trace_id)) {
+    if (trace_id < 1) stop("trace_id must be a positive integer")
+    writeBin(as.raw(0x1D), buf)
+    .write_i64(buf, trace_id)  # u64 on the wire; exact up to 2^53
   }
   body <- rawConnectionValue(buf)
   close(buf)

@@ -44,6 +44,17 @@ failure — not avoiding it — is what preserves throughput):
   deterministic chaos harness (resilience/chaos.py) inject scheduler
   death, poisoned buckets, and mid-batch failures in CI.
 
+Telemetry (paddle_tpu/obs): the engine's counters are obs.metrics
+instruments — cmd-5 ``stats`` and cmd-3 ``health`` are consistent views
+over them (read under one engine-lock acquisition) and the process
+registry exposes the same instruments to Prometheus (wire cmd 6 and
+``serve_model(metrics_port=)``). Per-request spans cover
+enqueue -> batch -> (compile) -> execute, tagged with the
+wire-propagated trace id (``infer(trace_id=...)``), and every AOT
+bucket compile lands in the compile ledger (``obs.LEDGER``) with its
+cost-analysis FLOPs and structural HLO fingerprint — the data
+``bench.py perfproxy`` gates on.
+
 Env knobs (constructor kwargs override):
     PADDLE_TPU_SERVING_BREAKER_THRESHOLD   consecutive failures to trip
                                            a bucket breaker (default 3;
@@ -77,9 +88,13 @@ import threading
 import time
 import traceback
 import warnings
+import weakref
 
 import numpy as np
 
+from ..obs import metrics as obs_metrics
+from ..obs import tracing as obs_tracing
+from ..obs.ledger import LEDGER
 from ..resilience import chaos
 from ..resilience.retry import _env_float, _env_int
 
@@ -142,9 +157,10 @@ def _signature(arrays):
 
 class _Request:
     __slots__ = ("inputs", "rows", "sig", "event", "outputs", "error",
-                 "t_enqueue", "min_bucket", "deadline")
+                 "t_enqueue", "min_bucket", "deadline", "trace_id")
 
-    def __init__(self, inputs, rows, sig, min_bucket=1, deadline=None):
+    def __init__(self, inputs, rows, sig, min_bucket=1, deadline=None,
+                 trace_id=None):
         self.inputs = inputs
         self.rows = rows
         self.sig = sig
@@ -159,6 +175,9 @@ class _Request:
         self.min_bucket = min_bucket
         # absolute time.monotonic() drop-dead point (None = no deadline)
         self.deadline = deadline
+        # wire-propagated trace id (obs.tracing): spans recorded for
+        # this request's enqueue/execute carry it
+        self.trace_id = trace_id
 
     def fail(self, error):
         """Deliver an error result unless a result already landed."""
@@ -315,6 +334,7 @@ class AotLayerRunner:
         in_specs = [jax.ShapeDtypeStruct((bucket,) + tr, np.dtype(dt))
                     for dt, tr in sig]
         donate = tuple(range(2, 2 + n_in)) if self._donate else ()
+        t0 = time.monotonic()
         with warnings.catch_warnings():
             # tiny models may leave a donated batch buffer unused; that
             # is an optimization miss, not an error worth a warning per
@@ -324,6 +344,16 @@ class AotLayerRunner:
             compiled = (jax.jit(flat_fn, donate_argnums=donate)
                         .lower(param_specs, buffer_specs, *in_specs)
                         .compile())
+        # every AOT compile lands in the process compile ledger: bucket,
+        # duration, cost_analysis FLOPs/bytes, structural HLO
+        # fingerprint — what bench.py perfproxy diffs against its
+        # committed baseline
+        LEDGER.record(f"serving/bucket{bucket}",
+                      duration_s=time.monotonic() - t0, compiled=compiled,
+                      kind="aot",
+                      extra={"bucket": bucket,
+                             "signature": [[dt, list(tr)]
+                                           for dt, tr in sig]})
 
         def run(batch_arrays):
             out = compiled(param_arrays, buffer_arrays, *batch_arrays)
@@ -436,13 +466,8 @@ class BatchingEngine:
         self._compiling = {}  # (bucket, sig) -> Event for in-flight compile
         self._bucket_stats = {}  # (bucket, sig) -> _BucketStats
         self._breakers = {}  # (bucket, sig) -> _Breaker
-        self._shed_count = 0
-        self._quarantine_shed = 0
-        self._deadline_expired = 0  # dropped pre-dispatch, zero compute
-        self._deadline_late = 0  # expired in flight, batch may have run
         self._deadline_seen = False  # any deadline-bearing submit yet?
-        self._n_requests = 0
-        self._n_rows = 0
+        self._init_metrics()
         self._declared = []  # bucket row counts from warmup()
         self._cold_threads = []  # in-flight cold-bucket compile threads
         self._cold_seq = 0
@@ -455,7 +480,6 @@ class BatchingEngine:
         # generation token: a watchdog restart bumps it; a superseded
         # scheduler thread notices and exits instead of double-serving
         self._sched_gen = 0
-        self._scheduler_restarts = 0
         self._heartbeat = time.monotonic()  # bumped each scheduler loop
         self._inflight = {}  # gen -> group popped but not yet delivered
         self._watchdog = None  # before the scheduler starts: its crash
@@ -468,6 +492,93 @@ class BatchingEngine:
                                               name=f"{name}-watchdog",
                                               daemon=True)
             self._watchdog.start()
+
+    # -------------------------------------------------------- telemetry
+    def _init_metrics(self):
+        """Per-engine obs instruments (obs.metrics). These ARE the
+        engine's counters — cmd-5 ``stats`` and cmd-3 ``health`` read
+        them (under the engine lock, so a snapshot is never torn) and
+        the process registry exposes them to Prometheus through a
+        registered collector. Instruments are engine-owned (const label
+        ``engine=<name>``) rather than global so every engine instance
+        keeps an isolated view; the exposition merges same-name
+        families across engines."""
+        cl = {"engine": self.name}
+        M = obs_metrics
+        lat_buckets = M.log_buckets(0.0001, 4.0, 10)
+        self._m_requests = M.Counter(
+            "paddle_serving_requests_total",
+            "Requests admitted to the batching engine", const_labels=cl)
+        self._m_rows = M.Counter(
+            "paddle_serving_rows_total",
+            "Input rows admitted to the batching engine", const_labels=cl)
+        self._m_shed = M.Counter(
+            "paddle_serving_shed_total",
+            "Requests shed (reason: queue_full | quarantine)",
+            labelnames=("reason",), const_labels=cl)
+        self._m_deadline = M.Counter(
+            "paddle_serving_deadline_total",
+            "Deadline outcomes (stage: expired = dropped pre-dispatch, "
+            "zero compute; late = expired in flight, compute spent)",
+            labelnames=("stage",), const_labels=cl)
+        self._m_restarts = M.Counter(
+            "paddle_serving_scheduler_restarts_total",
+            "Watchdog scheduler restarts", const_labels=cl)
+        self._m_compiles = M.Counter(
+            "paddle_serving_compiles_total",
+            "Bucket program compiles", labelnames=("bucket",),
+            const_labels=cl)
+        self._m_batches = M.Counter(
+            "paddle_serving_batches_total",
+            "Batches executed", labelnames=("bucket",), const_labels=cl)
+        self._m_batch_rows = M.Counter(
+            "paddle_serving_batch_rows_total",
+            "Real rows executed per bucket", labelnames=("bucket",),
+            const_labels=cl)
+        self._m_padded = M.Counter(
+            "paddle_serving_padded_rows_total",
+            "Padding rows executed per bucket", labelnames=("bucket",),
+            const_labels=cl)
+        self._m_queue_depth = M.Gauge(
+            "paddle_serving_queue_depth",
+            "Pending requests in the bounded queue", const_labels=cl)
+        self._m_queue_wait = M.Histogram(
+            "paddle_serving_queue_wait_seconds",
+            "Enqueue-to-dispatch wait per request",
+            const_labels=cl, buckets=lat_buckets)
+        self._m_occupancy = M.Histogram(
+            "paddle_serving_batch_occupancy",
+            "Real rows / bucket size per executed batch",
+            const_labels=cl,
+            buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+        self._m_exec = M.Histogram(
+            "paddle_serving_batch_exec_seconds",
+            "Batch execute duration", labelnames=("bucket",),
+            const_labels=cl, buckets=lat_buckets)
+        self._instruments = [
+            self._m_requests, self._m_rows, self._m_shed,
+            self._m_deadline, self._m_restarts, self._m_compiles,
+            self._m_batches, self._m_batch_rows, self._m_padded,
+            self._m_queue_depth, self._m_queue_wait, self._m_occupancy,
+            self._m_exec]
+        # weakref so a leaked (never-closed) engine can still be
+        # garbage-collected; a dead ref returns None, which the
+        # registry treats as "auto-unregister me"
+        ref = weakref.ref(self)
+
+        def _collector():
+            eng = ref()
+            return eng._collect_families() if eng is not None else None
+
+        self._obs_collector = _collector
+        obs_metrics.REGISTRY.register_collector(_collector)
+
+    def _collect_families(self):
+        # one engine-lock acquisition for the whole family set: the
+        # exposition sees the same consistent view cmd-5 stats does
+        with self._lock:
+            self._m_queue_depth.set(len(self._pending))
+            return [m.collect() for m in self._instruments]
 
     # ------------------------------------------------------- constructors
     @classmethod
@@ -482,7 +593,7 @@ class BatchingEngine:
         return cls(CallableRunner(fn), **kw)
 
     # ------------------------------------------------------------- submit
-    def infer(self, inputs, timeout=None, deadline=None):
+    def infer(self, inputs, timeout=None, deadline=None, trace_id=None):
         """Run one request (list of arrays sharing dim 0 = rows) through
         the engine; returns the list of output arrays for those rows.
 
@@ -491,6 +602,12 @@ class BatchingEngine:
         the scheduler: an expired request is purged before dispatch
         (DeadlineExceeded) and a group never waits past the tightest
         deadline of its members.
+
+        ``trace_id`` (default: the thread's ambient obs.tracing id)
+        tags the request's recorded spans — ``serving.request`` (this
+        whole call), ``serving.queue`` (enqueue -> dispatch) and
+        ``serving.execute`` (its batch) — so a wire-propagated id can
+        be followed across threads.
 
         Requests larger than max_batch_size are split into chunks and
         re-joined (the split path); each chunk occupies its own queue
@@ -507,17 +624,39 @@ class BatchingEngine:
                 raise ValueError(
                     "all inputs of one request must share dim 0 "
                     f"(got {[tuple(x.shape) for x in inputs]})")
-        if deadline is not None and time.monotonic() >= deadline:
-            with self._lock:
-                self._deadline_expired += 1
-            raise DeadlineExceeded(
-                f"{self.name}: deadline passed before submission")
-        if rows > self.max_batch_size:
-            return self._infer_split(inputs, rows, timeout, deadline)
-        req = self._submit(inputs, rows, deadline)
-        return self._wait(req, timeout)
+        if trace_id is None:
+            trace_id = obs_tracing.current_trace_id()
+        t0 = time.perf_counter()
+        try:
+            if deadline is not None and time.monotonic() >= deadline:
+                self._m_deadline.inc(stage="expired")
+                raise DeadlineExceeded(
+                    f"{self.name}: deadline passed before submission")
+            if rows > self.max_batch_size:
+                out = self._infer_split(inputs, rows, timeout, deadline,
+                                        trace_id)
+            else:
+                req = self._submit(inputs, rows, deadline, trace_id)
+                out = self._wait(req, timeout)
+        except BaseException as e:
+            self._span_request(trace_id, t0, rows, type(e).__name__)
+            raise
+        self._span_request(trace_id, t0, rows, "ok")
+        return out
 
-    def _infer_split(self, inputs, rows, timeout, deadline):
+    def _span_request(self, trace_id, t0, rows, outcome):
+        """End-of-request telemetry: aggregate always; a full span
+        record only for traced requests (the bounded span buffer is a
+        debugging surface, not a per-request firehose)."""
+        dt = time.perf_counter() - t0
+        if trace_id is not None:
+            obs_tracing.record_span("serving.request", dt,
+                                    trace_id=trace_id, engine=self.name,
+                                    rows=rows, outcome=outcome)
+        else:
+            obs_tracing.observe("serving.request", dt)
+
+    def _infer_split(self, inputs, rows, timeout, deadline, trace_id):
         n_chunks = -(-rows // self.max_batch_size)
         if n_chunks > self.max_queue:
             # a deterministic can-never-fit request must get a permanent
@@ -538,7 +677,7 @@ class BatchingEngine:
         # (the tightest deadline in any group a chunk joins).
         reqs = self._submit_chunks(
             chunks, min_bucket=min(2, self.max_batch_size),
-            deadline=deadline)
+            deadline=deadline, trace_id=trace_id)
         wait_until = (None if timeout is None
                       else time.monotonic() + timeout)
         parts = []
@@ -564,10 +703,12 @@ class BatchingEngine:
         return [np.concatenate([p[i] for p in parts])
                 for i in range(len(parts[0]))]
 
-    def _submit(self, inputs, rows, deadline=None):
-        return self._submit_chunks([inputs], deadline=deadline)[0]
+    def _submit(self, inputs, rows, deadline=None, trace_id=None):
+        return self._submit_chunks([inputs], deadline=deadline,
+                                   trace_id=trace_id)[0]
 
-    def _submit_chunks(self, chunks, min_bucket=1, deadline=None):
+    def _submit_chunks(self, chunks, min_bucket=1, deadline=None,
+                       trace_id=None):
         """Admit every chunk or none (one queue slot per chunk, so an
         oversized request still counts fully against the shed cap)."""
         chaos.hit("serving.submit")
@@ -575,7 +716,7 @@ class BatchingEngine:
             if self._closed:
                 raise EngineClosed(f"{self.name} is closed")
             if len(self._pending) + len(chunks) > self.max_queue:
-                self._shed_count += 1
+                self._m_shed.inc(reason="queue_full")
                 raise EngineOverloaded(
                     f"{self.name} queue full ({len(self._pending)} pending,"
                     f" cap {self.max_queue}, need {len(chunks)} slots); "
@@ -586,10 +727,10 @@ class BatchingEngine:
             for chunk in chunks:
                 rows = int(chunk[0].shape[0])
                 req = _Request(chunk, rows, _signature(chunk), min_bucket,
-                               deadline)
+                               deadline, trace_id)
                 self._pending.append(req)
-                self._n_requests += 1
-                self._n_rows += rows
+                self._m_requests.inc()
+                self._m_rows.inc(rows)
                 reqs.append(req)
             self._cond.notify_all()
         return reqs
@@ -611,12 +752,11 @@ class BatchingEngine:
                     pass  # already grouped/in flight; result is discarded
             if (req.deadline is not None
                     and time.monotonic() >= req.deadline):
-                with self._lock:
-                    # separate counter: deadline_expired promises "dropped
-                    # BEFORE dispatch, no compute spent" — an in-flight
-                    # expiry may have burned a full batch, and lumping it
-                    # in would skew the metric operators size budgets by
-                    self._deadline_late += 1
+                # separate counter: deadline_expired promises "dropped
+                # BEFORE dispatch, no compute spent" — an in-flight
+                # expiry may have burned a full batch, and lumping it
+                # in would skew the metric operators size budgets by
+                self._m_deadline.inc(stage="late")
                 raise DeadlineExceeded(
                     f"{self.name}: deadline passed while the request was "
                     "in flight; the result (if any) was discarded")
@@ -664,7 +804,7 @@ class BatchingEngine:
                 allowed = br.allow(now)
                 if not allowed:
                     br.shed += len(group)
-                    self._quarantine_shed += len(group)
+                    self._m_shed.inc(len(group), reason="quarantine")
             if not allowed:
                 err = BucketQuarantined(
                     f"{self.name} bucket {bucket} is quarantined after "
@@ -761,7 +901,7 @@ class BatchingEngine:
             return
         for r in expired:
             self._pending.remove(r)
-            self._deadline_expired += 1
+            self._m_deadline.inc(stage="expired")
         err = DeadlineExceeded(
             f"{self.name}: deadline passed while queued; request dropped "
             "before dispatch")
@@ -806,8 +946,16 @@ class BatchingEngine:
                     deadline = min(deadline, tight - 0.005)
                 if (rows >= self.max_batch_size or now >= deadline
                         or self._closed):
+                    t_pop = time.monotonic()
                     for r in group:
                         self._pending.remove(r)
+                        wait = t_pop - r.t_enqueue
+                        self._m_queue_wait.observe(wait)
+                        if r.trace_id is not None:
+                            obs_tracing.record_span(
+                                "serving.queue", wait,
+                                trace_id=r.trace_id, engine=self.name,
+                                rows=r.rows)
                     return group
                 self._cond.wait(deadline - now)
 
@@ -824,7 +972,10 @@ class BatchingEngine:
         rows = sum(r.rows for r in group)
         sig = group[0].sig
         bucket = self._group_bucket(group)
-        run, _ = self._compiled(bucket, sig)
+        run, _ = self._compiled(
+            bucket, sig,
+            trace_id=next((r.trace_id for r in group
+                           if r.trace_id is not None), None))
         n_in = len(sig)
         batch = []
         for i in range(n_in):
@@ -839,6 +990,16 @@ class BatchingEngine:
         t0 = time.monotonic()
         outs = run(batch)
         dt_ms = (time.monotonic() - t0) * 1000.0
+        # one execute per group; traced requests each get a span with
+        # the shared duration, untraced traffic only feeds the table
+        tids = {r.trace_id for r in group if r.trace_id is not None}
+        if tids:
+            for tid in tids:
+                obs_tracing.record_span(
+                    "serving.execute", dt_ms / 1000.0, trace_id=tid,
+                    engine=self.name, bucket=bucket, rows=rows)
+        else:
+            obs_tracing.observe("serving.execute", dt_ms / 1000.0)
         for j, o in enumerate(outs):
             if getattr(o, "ndim", 0) == 0 or o.shape[0] != bucket:
                 raise ValueError(
@@ -860,6 +1021,12 @@ class BatchingEngine:
             st.padded_rows += bucket - rows
             st.total_ms += dt_ms
             st.max_ms = max(st.max_ms, dt_ms)
+            bs = str(bucket)
+            self._m_batches.inc(bucket=bs)
+            self._m_batch_rows.inc(rows, bucket=bs)
+            self._m_padded.inc(bucket - rows, bucket=bs)
+            self._m_exec.observe(dt_ms / 1000.0, bucket=bs)
+            self._m_occupancy.observe(rows / bucket)
 
     # ----------------------------------------------------------- watchdog
     def _run_watchdog(self):
@@ -950,7 +1117,7 @@ class BatchingEngine:
                 br = self._breakers.get(key)
                 if br is not None and br.state == _Breaker.HALF_OPEN:
                     br.record_failure(time.monotonic())
-            self._scheduler_restarts += 1
+            self._m_restarts.inc()
             self._heartbeat = time.monotonic()
             t = threading.Thread(target=self._run_scheduler, args=(gen,),
                                  name=f"{self.name}-scheduler-g{gen}",
@@ -986,13 +1153,15 @@ class BatchingEngine:
                                                self.breaker_cooldown)
         return br
 
-    def _compiled(self, bucket, sig):
+    def _compiled(self, bucket, sig, trace_id=None):
         """Per-bucket compiled program; compiles exactly once per
         (bucket, signature). Compiles run outside the lock (XLA can
         take seconds; infer submissions must not block on them); an
         in-flight event per key makes racing callers (warmup thread,
         concurrent cold groups) WAIT for the one compile instead of
-        burning CPU redoing it N times."""
+        burning CPU redoing it N times. ``trace_id`` (a traced request
+        in the group that pays the compile) tags the serving.compile
+        span; warmup/untraced compiles only feed the summary table."""
         key = (bucket, sig)
         while True:
             with self._lock:
@@ -1025,15 +1194,24 @@ class BatchingEngine:
             try:
                 chaos.hit("serving.compile")
                 chaos.hit(f"serving.compile.bucket{bucket}")
+                t0 = time.monotonic()
                 run = self._runner.compile(bucket, sig)
             except BaseException:
                 with self._lock:
                     self._compiling.pop(key, None)
                 ev.set()
                 raise
+            dt = time.monotonic() - t0
+            if trace_id is not None:
+                obs_tracing.record_span("serving.compile", dt,
+                                        trace_id=trace_id,
+                                        engine=self.name, bucket=bucket)
+            else:
+                obs_tracing.observe("serving.compile", dt)
             with self._lock:
                 self._cache[key] = run
                 self._stats_for(bucket, sig).compiles += 1
+                self._m_compiles.inc(bucket=str(bucket))
                 self._compiling.pop(key, None)
             ev.set()
             return run, True
@@ -1075,7 +1253,14 @@ class BatchingEngine:
 
     # -------------------------------------------------------------- stats
     def stats(self):
-        """Snapshot of engine counters (the `stats` wire command)."""
+        """Snapshot of engine counters (the `stats` wire command).
+
+        A *view over the obs registry*: every scalar here reads the
+        same instruments the Prometheus exposition renders. The whole
+        snapshot — registry-backed scalars AND per-bucket tables — is
+        taken under one engine-lock acquisition, so a mid-update read
+        can never return torn totals (e.g. ``rows`` bumped but
+        ``padded`` not yet)."""
         with self._lock:
             buckets = {}
             for (bucket, sig), st in sorted(self._bucket_stats.items(),
@@ -1094,13 +1279,15 @@ class BatchingEngine:
                 "max_queue": self.max_queue,
                 "declared_buckets": list(self._declared),
                 "queue_depth": len(self._pending),
-                "requests": self._n_requests,
-                "rows": self._n_rows,
-                "shed_count": self._shed_count,
-                "quarantine_shed": self._quarantine_shed,
-                "deadline_expired": self._deadline_expired,
-                "deadline_late": self._deadline_late,
-                "scheduler_restarts": self._scheduler_restarts,
+                "requests": int(self._m_requests.value()),
+                "rows": int(self._m_rows.value()),
+                "shed_count": int(self._m_shed.value(reason="queue_full")),
+                "quarantine_shed": int(
+                    self._m_shed.value(reason="quarantine")),
+                "deadline_expired": int(
+                    self._m_deadline.value(stage="expired")),
+                "deadline_late": int(self._m_deadline.value(stage="late")),
+                "scheduler_restarts": int(self._m_restarts.value()),
                 "breaker": {
                     "threshold": self.breaker_threshold,
                     "cooldown_s": self.breaker_cooldown,
@@ -1132,7 +1319,7 @@ class BatchingEngine:
                 "closed": self._closed,
                 "scheduler_alive": alive,
                 "heartbeat_age_s": round(now - self._heartbeat, 3),
-                "scheduler_restarts": self._scheduler_restarts,
+                "scheduler_restarts": int(self._m_restarts.value()),
                 "queue_depth": len(self._pending),
                 "quarantined_buckets": quarantined,
                 "cold_compiles_inflight": len(self._cold_inflight),
@@ -1150,6 +1337,7 @@ class BatchingEngine:
             self._closed_ev.set()
             self._cond.notify_all()
             sched = self._scheduler
+        obs_metrics.REGISTRY.unregister_collector(self._obs_collector)
         sched.join(timeout)
         if self._watchdog is not None:
             self._watchdog.join(timeout)

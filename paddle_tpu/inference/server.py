@@ -13,12 +13,16 @@ wire format (little-endian):
   cmds: 1 infer  payload = u8 n_inputs, per input:
             u8 dtype (0=f32, 1=i32, 2=i64, 3=bool) | u8 ndim |
             i64 dims[ndim] | data
-          ... optionally followed by a deadline field:
+          ... optionally followed by trailing fields, each tagged by a
+          marker byte and parseable in any order:
             u8 0xDD | f64 timeout_ms (relative budget; the server
             computes the absolute deadline at receipt and drops the
-            request without dispatch once it expires). Old servers
-            ignore the trailing bytes; old clients simply omit them —
-            both directions stay compatible.
+            request without dispatch once it expires)
+            u8 0x1D | u64 trace_id (non-zero; tags the request's
+            obs.tracing spans across enqueue/batch/execute/reply so
+            one request can be followed through the engine)
+          Old servers ignore the trailing bytes; old clients simply
+          omit them — both directions stay compatible.
         3 health  payload = (empty); response body is UTF-8 JSON
             liveness/readiness: scheduler alive + heartbeat age,
             quarantined buckets, queue depth, draining flag
@@ -32,6 +36,11 @@ wire format (little-endian):
             compiles/hits/latency, breaker states, queue depth,
             shed_count) — or {"engine": null} when serving without an
             engine
+        6 metrics  payload = (empty); response body is the Prometheus
+            text exposition (format 0.0.4) of the process obs registry:
+            engine counters, server conn/frame counters, resilience
+            counters, goodput, compile-ledger totals. The same text is
+            served over HTTP by ``serve_model(metrics_port=...)``.
         7 stop
   response: u32 body_len | u8 status | (cmd 1: same per-output encoding)
   status: 0 ok | 1 error | 2 retryable (request shed by the batching
@@ -47,6 +56,9 @@ import time
 
 import numpy as np
 
+from ..obs import metrics as obs_metrics
+from ..obs import prometheus as obs_prometheus
+from ..obs import tracing as obs_tracing
 from .batching import EngineClosed, RetryableError
 
 _DTYPES = {0: np.float32, 1: np.int32, 2: np.int64, 3: np.bool_}
@@ -61,10 +73,11 @@ STATUS_OK = 0
 STATUS_ERROR = 1
 STATUS_OVERLOADED = RetryableError.status_code  # 2
 
-# Optional trailing field marker on cmd-1 infer bodies: a per-request
-# deadline. A marker byte (not bare trailing bytes) so garbage tails
-# can't be misread as a deadline.
-DEADLINE_MARKER = 0xDD
+# Optional trailing field markers on cmd-1 infer bodies. A marker byte
+# (not bare trailing bytes) so garbage tails can't be misread as a
+# field; fields may appear in any order, each marker at most once.
+DEADLINE_MARKER = 0xDD  # + f64 relative budget in ms
+TRACE_MARKER = 0x1D  # + u64 non-zero trace id (obs.tracing)
 
 # Hardening knobs: a 4-byte length prefix from a buggy/malicious client
 # must not trigger an unbounded allocation, and a stalled client must
@@ -119,6 +132,11 @@ def _encode_deadline(timeout_ms):
     return struct.pack("<Bd", DEADLINE_MARKER, float(timeout_ms))
 
 
+def _encode_trace(trace_id):
+    """Trailing optional trace-id field (old servers ignore it)."""
+    return struct.pack("<BQ", TRACE_MARKER, int(trace_id))
+
+
 def _decode_arrays_off(payload):
     off = 0
     (n,) = struct.unpack_from("<B", payload, off)
@@ -143,13 +161,25 @@ def _decode_arrays(payload):
 
 def _decode_request(payload):
     """Decode a cmd-1 infer body: arrays plus the optional trailing
-    deadline field. Returns (arrays, budget_seconds_or_None)."""
+    marker-tagged fields (deadline, trace id — any order). Returns
+    (arrays, budget_seconds_or_None, trace_id_or_None). Parsing stops
+    at the first unknown marker: old servers ignored trailing garbage,
+    and a field this server predates must not be misread."""
     arrays, off = _decode_arrays_off(payload)
     budget = None
-    if len(payload) - off >= 9 and payload[off] == DEADLINE_MARKER:
-        (timeout_ms,) = struct.unpack_from("<d", payload, off + 1)
-        budget = max(0.0, float(timeout_ms)) / 1000.0
-    return arrays, budget
+    trace_id = None
+    while len(payload) - off >= 9:
+        marker = payload[off]
+        if marker == DEADLINE_MARKER and budget is None:
+            (timeout_ms,) = struct.unpack_from("<d", payload, off + 1)
+            budget = max(0.0, float(timeout_ms)) / 1000.0
+        elif marker == TRACE_MARKER and trace_id is None:
+            (tid,) = struct.unpack_from("<Q", payload, off + 1)
+            trace_id = tid or None  # 0 = "no trace" on the wire
+        else:
+            break
+        off += 9
+    return arrays, budget, trace_id
 
 
 class PredictorServer:
@@ -196,8 +226,52 @@ class PredictorServer:
         self._stop = threading.Event()
         self._conns = {}  # thread -> {"conn": socket, "busy": bool}
         self._conns_lock = threading.Lock()
+        # optional /metrics HTTP endpoint (obs.httpd.MetricsServer),
+        # attached by serve_model(metrics_port=...); stop() closes it
+        self.metrics_server = None
+        self._init_metrics()
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
+
+    def _init_metrics(self):
+        """Per-server obs instruments, exposed through the process
+        registry by a collector (unregistered in stop())."""
+        import weakref
+
+        cl = {"port": str(self.port)}
+        self._m_conns = obs_metrics.Counter(
+            "paddle_server_connections_total",
+            "Accepted client connections", const_labels=cl)
+        self._m_frames = obs_metrics.Counter(
+            "paddle_server_frames_total",
+            "Request frames received, by wire command",
+            labelnames=("cmd",), const_labels=cl)
+        self._m_responses = obs_metrics.Counter(
+            "paddle_server_responses_total",
+            "cmd-1 infer responses, by wire status "
+            "(0 ok, 1 error, 2 retryable)",
+            labelnames=("status",), const_labels=cl)
+        self._m_reloads = obs_metrics.Counter(
+            "paddle_server_reloads_total",
+            "Hot model reloads", const_labels=cl)
+        self._m_open = obs_metrics.Gauge(
+            "paddle_server_connections_open",
+            "Currently-connected clients", const_labels=cl)
+        self._server_instruments = [
+            self._m_conns, self._m_frames, self._m_responses,
+            self._m_reloads, self._m_open]
+        ref = weakref.ref(self)
+
+        def _collector():
+            srv = ref()
+            if srv is None:
+                return None  # GC'd server: registry auto-unregisters
+            with srv._conns_lock:
+                srv._m_open.set(len(srv._conns))
+            return [m.collect() for m in srv._server_instruments]
+
+        self._obs_collector = _collector
+        obs_metrics.REGISTRY.register_collector(_collector)
 
     def _serve(self):
         while not self._stop.is_set():
@@ -205,6 +279,7 @@ class PredictorServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            self._m_conns.inc()
             t = threading.Thread(target=self._handle, args=(conn,),
                                  daemon=True)
             with self._conns_lock:
@@ -293,6 +368,7 @@ class PredictorServer:
                     self._own_engine = new_engine is not None
                     self._prefix = new_prefix
                     self._reload_count += 1
+                    self._m_reloads.inc()
             except BaseException:
                 # a failed load/warmup (or a stop racing us) must not
                 # leak the freshly built engine's scheduler + watchdog
@@ -311,9 +387,10 @@ class PredictorServer:
     def _infer(self, body):
         """Run one cmd-1 infer body; returns the encoded response frame
         body (status byte + payload)."""
-        inputs, budget = _decode_request(body[1:])
+        inputs, budget, trace_id = _decode_request(body[1:])
         deadline = (None if budget is None
                     else time.monotonic() + budget)
+        t0 = time.perf_counter()
         if budget is not None and budget <= 0.0:
             # the client's budget was spent before the frame finished
             # arriving: drop before dispatch, spend no compute
@@ -322,7 +399,8 @@ class PredictorServer:
             run, engine = self._backend()
             try:
                 if engine is not None:
-                    outputs = engine.infer(inputs, deadline=deadline)
+                    outputs = engine.infer(inputs, deadline=deadline,
+                                           trace_id=trace_id)
                 else:
                     if deadline is not None and \
                             time.monotonic() >= deadline:
@@ -340,6 +418,12 @@ class PredictorServer:
         outputs = [np.asarray(o._value if hasattr(o, "_value")
                               else o) for o in outputs]
         enc = _encode_arrays(outputs)
+        if trace_id is not None:
+            # the handler-side span: decode -> dispatch -> encode (the
+            # engine's serving.request span nests inside this window)
+            obs_tracing.record_span(
+                "serving.reply", time.perf_counter() - t0,
+                trace_id=trace_id, port=self.port)
         return struct.pack("<B", STATUS_OK) + enc
 
     def _handle(self, conn):
@@ -373,12 +457,18 @@ class PredictorServer:
                     conn.sendall(struct.pack("<IB", 1, 1))
                     return
                 cmd = body[0]
+                self._m_frames.inc(cmd=str(cmd))
                 if cmd == 7:
                     conn.sendall(struct.pack("<IB", 1, 0))
                     threading.Thread(target=self.stop, daemon=True).start()
                     return
                 if cmd == 3:
                     enc = self._health_json().encode("utf-8")
+                    conn.sendall(struct.pack("<IB", 1 + len(enc), 0) + enc)
+                    self._set_busy(False)
+                    continue
+                if cmd == 6:
+                    enc = obs_prometheus.render().encode("utf-8")
                     conn.sendall(struct.pack("<IB", 1 + len(enc), 0) + enc)
                     self._set_busy(False)
                     continue
@@ -406,6 +496,7 @@ class PredictorServer:
                     continue
                 try:
                     resp = self._infer(body)
+                    self._m_responses.inc(status=str(resp[0]))
                     conn.sendall(struct.pack("<I", len(resp)) + resp)
                 except (RetryableError, EngineClosed):
                     # load shed / quarantined bucket / scheduler restart
@@ -415,8 +506,10 @@ class PredictorServer:
                     # reloads or a stop past _infer's one retry) is
                     # equally transient: the next attempt lands on the
                     # swapped-in engine or a cleanly-restarted server.
+                    self._m_responses.inc(status=str(STATUS_OVERLOADED))
                     conn.sendall(struct.pack("<IB", 1, STATUS_OVERLOADED))
                 except Exception:  # noqa: BLE001 - protocol error status
+                    self._m_responses.inc(status=str(STATUS_ERROR))
                     conn.sendall(struct.pack("<IB", 1, 1))
                 self._set_busy(False)
         except socket.timeout:
@@ -434,6 +527,10 @@ class PredictorServer:
         keep-alive connections — a rolling restart neither drops a
         response mid-write nor hangs on a silent client."""
         self._stop.set()
+        obs_metrics.REGISTRY.unregister_collector(self._obs_collector)
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+            self.metrics_server = None
         # a reload mid-flight cannot swap past us: its swap re-checks
         # _stop under _backend_lock (set above, before our engine read
         # below) and aborts, closing its own new engine — so the engine
@@ -478,7 +575,7 @@ class PredictorServer:
 
 def serve_model(path_prefix, port=0, dynamic_batching=False,
                 max_batch_size=32, max_wait_ms=2.0, max_queue=256,
-                warmup=True, **engine_kwargs):
+                warmup=True, metrics_port=None, **engine_kwargs):
     """Load a jit-saved model and serve it (the C API's server side).
 
     With ``dynamic_batching=True`` (needs a batch-polymorphic save, see
@@ -487,6 +584,13 @@ def serve_model(path_prefix, port=0, dynamic_batching=False,
     precompiled up front, and saturation sheds as wire status 2. Extra
     ``engine_kwargs`` (breaker_threshold, watchdog_interval, ...) pass
     through to the BatchingEngine.
+
+    ``metrics_port`` (0 = any free port) additionally serves the
+    Prometheus text exposition of the process obs registry on
+    ``http://host:metrics_port/metrics`` — the scrape-friendly twin of
+    the ``metrics`` wire command (cmd 6). The endpoint lives and dies
+    with the server (``server.metrics_server.port`` has the bound
+    port).
 
     The returned server supports the ``reload`` wire command (cmd 4):
     re-save the model to the same (or a new) prefix and issue a reload
@@ -513,6 +617,15 @@ def serve_model(path_prefix, port=0, dynamic_batching=False,
     run, engine = loader(path_prefix)
     if engine is not None and warmup:
         engine.warmup()
-    return PredictorServer(run, port=port, engine=engine,
-                           own_engine=engine is not None,
-                           loader=loader, prefix=path_prefix)
+    server = PredictorServer(run, port=port, engine=engine,
+                             own_engine=engine is not None,
+                             loader=loader, prefix=path_prefix)
+    if metrics_port is not None:
+        from ..obs.httpd import MetricsServer
+
+        try:
+            server.metrics_server = MetricsServer(metrics_port)
+        except BaseException:
+            server.stop(drain=False)
+            raise
+    return server

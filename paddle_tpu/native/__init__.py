@@ -128,6 +128,13 @@ def _declare(lib):
                                               ctypes.c_int64)),
                                           ctypes.POINTER(ctypes.c_void_p),
                                           ctypes.c_double]),
+        "PD_PredictorRunTraced": (i32, [i64, i32,
+                                        ctypes.POINTER(ctypes.c_int),
+                                        ctypes.POINTER(ctypes.c_int),
+                                        ctypes.POINTER(ctypes.POINTER(
+                                            ctypes.c_int64)),
+                                        ctypes.POINTER(ctypes.c_void_p),
+                                        ctypes.c_double, u64]),
         "PD_PredictorHealth": (i64, [i64, ctypes.c_char_p, i64]),
         "PD_PredictorNumOutputs": (i32, [i64]),
         "PD_PredictorOutputNdim": (i32, [i64, i32]),

@@ -9,8 +9,10 @@
 //   request  u32 len | u8 cmd(1=infer, 3=health) | u8 n_inputs |
 //            per input: u8 dtype(0=f32,1=i32,2=i64,3=bool) u8 ndim
 //            i64 dims[] data
-//            cmd 1 may carry a trailing optional deadline field:
-//            u8 0xDD | f64 timeout_ms (old servers ignore it)
+//            cmd 1 may carry marker-tagged trailing optional fields,
+//            in any order (old servers ignore them):
+//            u8 0xDD | f64 timeout_ms    per-request deadline
+//            u8 0x1D | u64 trace_id      non-zero span-trace id
 //   response u32 len | u8 status | same encoding of outputs
 //            (cmd 3: UTF-8 JSON liveness body)
 //   status   0 ok | 1 error | 2 retryable (shed by the server's
@@ -183,12 +185,15 @@ void PD_PredictorDestroy(int64_t h) {
 
 namespace {
 
-// Shared body of PD_PredictorRun / PD_PredictorRunDeadline. A
-// timeout_ms > 0 appends the optional wire deadline field (marker 0xDD
-// + f64 ms); servers predating the field ignore the trailing bytes.
+// Shared body of PD_PredictorRun / PD_PredictorRunDeadline /
+// PD_PredictorRunTraced. A timeout_ms > 0 appends the optional wire
+// deadline field (marker 0xDD + f64 ms); a trace_id != 0 appends the
+// optional trace-id field (marker 0x1D + u64): the server tags the
+// request's spans with it. Servers predating either field ignore the
+// trailing bytes.
 int run_impl(int64_t h, int n_inputs, const int* dtypes, const int* ndims,
              const int64_t* const* dims, const void* const* data,
-             double timeout_ms) {
+             double timeout_ms, uint64_t trace_id) {
   if (n_inputs < 0 || n_inputs > 255) return -1;
   Guard gd;
   CPredictor* p = acquire(h, gd);
@@ -214,6 +219,10 @@ int run_impl(int64_t h, int n_inputs, const int* dtypes, const int* ndims,
   if (timeout_ms > 0) {
     body.push_back((char)0xDD);
     body.insert(body.end(), (char*)&timeout_ms, (char*)&timeout_ms + 8);
+  }
+  if (trace_id != 0) {
+    body.push_back((char)0x1D);
+    body.insert(body.end(), (char*)&trace_id, (char*)&trace_id + 8);
   }
   if (p->fd < 0) return -1;  // poisoned by an earlier I/O failure
   if (timeout_ms > 0) {
@@ -273,7 +282,7 @@ extern "C" {
 int PD_PredictorRun(int64_t h, int n_inputs, const int* dtypes,
                     const int* ndims, const int64_t* const* dims,
                     const void* const* data) {
-  return run_impl(h, n_inputs, dtypes, ndims, dims, data, 0.0);
+  return run_impl(h, n_inputs, dtypes, ndims, dims, data, 0.0, 0);
 }
 
 // Run with a per-request deadline: the server drops the request without
@@ -282,7 +291,19 @@ int PD_PredictorRun(int64_t h, int n_inputs, const int* dtypes,
 int PD_PredictorRunDeadline(int64_t h, int n_inputs, const int* dtypes,
                             const int* ndims, const int64_t* const* dims,
                             const void* const* data, double timeout_ms) {
-  return run_impl(h, n_inputs, dtypes, ndims, dims, data, timeout_ms);
+  return run_impl(h, n_inputs, dtypes, ndims, dims, data, timeout_ms, 0);
+}
+
+// Run with a deadline AND a trace id (0 disables either): the server
+// tags the request's obs.tracing spans (enqueue/batch/execute/reply)
+// with trace_id, so one C-client request can be followed through the
+// batching engine's span buffer and shared summary table.
+int PD_PredictorRunTraced(int64_t h, int n_inputs, const int* dtypes,
+                          const int* ndims, const int64_t* const* dims,
+                          const void* const* data, double timeout_ms,
+                          uint64_t trace_id) {
+  return run_impl(h, n_inputs, dtypes, ndims, dims, data, timeout_ms,
+                  trace_id);
 }
 
 // Liveness/readiness probe (wire cmd 3). Copies the server's UTF-8

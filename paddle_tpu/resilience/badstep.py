@@ -18,12 +18,23 @@ on overflow and re-scales; attach a monitor
 (``scaler.attach_bad_step_monitor``) and its overflow skips feed the
 same consecutive-bad-step accounting (see MIGRATION.md).
 """
+import time
+
 import jax
 import jax.numpy as jnp
+
+from ..obs import goodput as _goodput
+from ..obs import metrics as _obs
 
 OK = "ok"
 SKIP = "skipped"
 ROLLBACK = "rollback"
+
+_BAD_STEPS = _obs.counter("paddle_badstep_bad_total",
+                          "Non-finite (skipped) training steps")
+_ROLLBACKS = _obs.counter(
+    "paddle_badstep_rollbacks_total",
+    "Checkpoint rollbacks after consecutive bad steps")
 
 
 def tree_nonfinite(tree):
@@ -94,9 +105,11 @@ class BadStepMonitor:
             return OK
         self.total_bad += 1
         self.consecutive += 1
+        _BAD_STEPS.inc()
         if self.consecutive >= self.threshold:
             self.consecutive = 0
             self.rollbacks += 1
+            _ROLLBACKS.inc()
             if self.on_rollback is not None:
                 self.on_rollback()
             return ROLLBACK
@@ -108,11 +121,15 @@ class BadStepMonitor:
         if self.manager is None:
             raise RuntimeError("BadStepMonitor has no CheckpointManager "
                                "attached; pass manager= to restore")
+        t0 = time.perf_counter()
         state, step = self.manager.load()
         if state is None:
             raise RuntimeError(
                 f"rollback requested but no usable checkpoint under "
                 f"{self.manager.root}")
+        # restore time is goodput lost to the rollback, not to the
+        # checkpoint category (the load span already records itself)
+        _goodput.account("rollback", time.perf_counter() - t0)
         return state, step
 
     def state_dict(self):

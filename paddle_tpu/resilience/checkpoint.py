@@ -34,12 +34,29 @@ import warnings
 
 import numpy as np
 
+from ..obs import goodput as _goodput
+from ..obs import metrics as _obs
+from ..obs import tracing as _tracing
 from . import chaos
 from .retry import call_with_retry
 
 MANIFEST_NAME = "MANIFEST.json"
 LATEST_NAME = "LATEST"
 FORMAT_VERSION = 1
+
+# Registry-backed checkpoint telemetry: save/load durations feed the
+# goodput accountant (checkpoint time is goodput the fleet loses) and
+# the Prometheus exposition.
+_SAVES = _obs.counter("paddle_checkpoint_saves_total",
+                      "Published checkpoints")
+_SAVE_SECONDS = _obs.histogram(
+    "paddle_checkpoint_save_seconds", "Checkpoint publish duration",
+    buckets=_obs.log_buckets(0.001, 4.0, 10))
+_LOADS = _obs.counter("paddle_checkpoint_loads_total",
+                      "Verified checkpoint loads")
+_FALLBACKS = _obs.counter(
+    "paddle_checkpoint_fallbacks_total",
+    "Corrupt/unusable checkpoints skipped during load")
 
 
 class CheckpointCorrupt(RuntimeError):
@@ -211,6 +228,7 @@ class CheckpointManager:
         Crash-safe at every point: the payload + manifest land in a temp
         dir, one os.replace publishes, then LATEST flips (also
         atomically). Transient write errors retry with backoff."""
+        t_save = time.perf_counter()
         os.makedirs(self.root, exist_ok=True)
         name = self._name(step)
         final = os.path.join(self.root, name)
@@ -267,6 +285,11 @@ class CheckpointManager:
         atomic_write_bytes(os.path.join(self.root, LATEST_NAME),
                            name.encode("utf-8"))
         self.gc()
+        dt = time.perf_counter() - t_save
+        _SAVES.inc()
+        _SAVE_SECONDS.observe(dt)
+        _goodput.account("checkpoint", dt)
+        _tracing.record_span("checkpoint.save", dt, step=int(step))
         return final
 
     # -------------------------------------------------------------- verify
@@ -311,6 +334,7 @@ class CheckpointManager:
     def load(self, verify=True):
         """-> (state, step) from the newest checkpoint that verifies,
         falling back through older ones; (None, -1) when none usable."""
+        t_load = time.perf_counter()
         for name in self._candidates():
             ckpt_dir = os.path.join(self.root, name)
             if not os.path.isdir(ckpt_dir):
@@ -323,8 +347,13 @@ class CheckpointManager:
                     step = -1 if step is None else step
                 else:
                     step = int(manifest["step"])
+                _LOADS.inc()
+                _tracing.record_span("checkpoint.load",
+                                     time.perf_counter() - t_load,
+                                     step=step)
                 return state, step
             except Exception as e:  # noqa: BLE001 — fall back past corruption
+                _FALLBACKS.inc()
                 warnings.warn(
                     f"checkpoint {ckpt_dir} unusable ({e}); "
                     f"falling back to an older checkpoint")

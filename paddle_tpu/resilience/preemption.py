@@ -15,7 +15,13 @@ import os
 import signal
 import threading
 
+from ..obs import metrics as _obs
+
 MARKER_NAME = "PREEMPTED.json"
+
+_PREEMPTION_SAVES = _obs.counter(
+    "paddle_preemption_saves_total",
+    "Preemption save-and-exit markers written (resumable shutdowns)")
 EXIT_CODE = 143  # 128 + SIGTERM — what a scheduler expects from a
                  # gracefully preempted worker
 
@@ -116,7 +122,9 @@ def write_resume_marker(save_dir, step=None, extra=None):
     if extra:
         payload.update(extra)
     os.makedirs(save_dir, exist_ok=True)
-    return atomic_write_json(os.path.join(save_dir, MARKER_NAME), payload)
+    path = atomic_write_json(os.path.join(save_dir, MARKER_NAME), payload)
+    _PREEMPTION_SAVES.inc()
+    return path
 
 
 def read_resume_marker(save_dir):

@@ -18,8 +18,17 @@ import os
 import random
 import time
 
+from ..obs import goodput as _goodput
+from ..obs import metrics as _obs
 
 DEFAULT_RETRYABLE = (OSError, ConnectionError, TimeoutError)
+
+# Registry-backed retry telemetry: backoff sleeps are wall-clock the
+# goodput accountant debits (a pod retrying a flaky FS is not training).
+_RETRIES = _obs.counter("paddle_retry_attempts_total",
+                        "Retries performed (backoff sleeps)")
+_EXHAUSTED = _obs.counter("paddle_retry_exhausted_total",
+                          "call_with_retry gave up (RetryError)")
 
 # OSErrors that no amount of waiting fixes: retrying them only adds
 # latency, and converting a FileNotFoundError into a RetryError breaks
@@ -110,12 +119,16 @@ def call_with_retry(fn, *args, max_attempts=None, base_delay=None,
             delay = next(delays)
             if deadline is not None and \
                     time.monotonic() - t0 + delay > deadline:
+                _EXHAUSTED.inc()
                 raise RetryError(
                     f"{_name(fn)}: deadline {deadline}s exceeded after "
                     f"{attempt} attempt(s)", last=e, attempts=attempt) from e
             if on_retry is not None:
                 on_retry(attempt, e, delay)
+            _RETRIES.inc()
+            _goodput.account("retry", delay)
             sleep(delay)
+    _EXHAUSTED.inc()
     raise RetryError(
         f"{_name(fn)}: failed after {max_attempts} attempt(s): {last}",
         last=last, attempts=max_attempts) from last

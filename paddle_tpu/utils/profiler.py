@@ -5,57 +5,53 @@ TPU-native: jax.profiler produces XPlane traces viewable in TensorBoard /
 Perfetto (the chrome-trace analog); RecordEvent spans map to
 jax.profiler.TraceAnnotation (host) which the XLA runtime correlates with
 device timelines — CUPTI's role is played by the TPU runtime itself.
+
+Host-side aggregation routes through the unified span layer
+(``paddle_tpu.obs.tracing``): RecordEvent spans, serving spans
+(enqueue/batch/execute/reply), and checkpoint/compile spans share one
+clock (``time.perf_counter``) and one summary table — ``summary()``
+prints all of them, and a RecordEvent inside a traced request inherits
+the ambient trace id.
 """
 import contextlib
-import threading
-import time
 
 import jax
 
-# -------------------------------------------------- host span aggregation
-_SPANS = {}
-_SPANS_LOCK = threading.Lock()
+from ..obs import tracing as _tracing
 
 
 class RecordEvent:
     """RAII span (reference: profiler.h:127): feeds the TraceAnnotation
-    (device-correlated XPlane span) AND the host-side aggregation that
-    backs ``summary()`` (the profiler.cc summary-table analog)."""
+    (device-correlated XPlane span) AND the unified obs.tracing span
+    layer that backs ``summary()`` (the profiler.cc summary-table
+    analog)."""
 
     def __init__(self, name):
         self.name = name
         self._ann = jax.profiler.TraceAnnotation(name)
-        self._t0 = None
+        self._span = None
 
     def __enter__(self):
         self._ann.__enter__()
-        self._t0 = time.perf_counter()
+        self._span = _tracing.start_span(self.name)
         return self
 
     def __exit__(self, *exc):
-        dt = time.perf_counter() - self._t0
-        with _SPANS_LOCK:
-            rec = _SPANS.setdefault(self.name,
-                                    [0, 0.0, 0.0, float("inf")])
-            rec[0] += 1
-            rec[1] += dt
-            rec[2] = max(rec[2], dt)
-            rec[3] = min(rec[3], dt)
+        self._span.finish()
+        self._span = None
         return self._ann.__exit__(*exc)
 
 
 def reset_summary():
-    with _SPANS_LOCK:
-        _SPANS.clear()
+    _tracing.reset_summary()
 
 
 def summary(sorted_by="total", printer=print):
     """Aggregated span table (reference: profiler.cc PrintProfiler /
-    'sorted by total time'). Returns the rows; also prints a table."""
-    with _SPANS_LOCK:
-        rows = [{"name": n, "calls": c, "total": tot, "avg": tot / c,
-                 "max": mx, "min": mn}
-                for n, (c, tot, mx, mn) in _SPANS.items()]
+    'sorted by total time'). Includes every span the process recorded —
+    RecordEvent, serving, checkpoint, compile — since the last
+    ``reset_summary()``. Returns the rows; also prints a table."""
+    rows = _tracing.summary_rows()
     key = {"total": "total", "calls": "calls", "avg": "avg",
            "max": "max", "min": "min"}.get(sorted_by, "total")
     rows.sort(key=lambda r: r[key], reverse=True)

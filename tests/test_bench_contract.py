@@ -12,11 +12,11 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(env_extra, timeout):
+def _run(env_extra, timeout, argv=()):
     env = dict(os.environ)
     env.update(env_extra)
     return subprocess.run(
-        [sys.executable, os.path.join(REPO, "bench.py")],
+        [sys.executable, os.path.join(REPO, "bench.py"), *argv],
         capture_output=True, text=True, env=env, timeout=timeout,
         cwd=REPO)
 
@@ -117,6 +117,67 @@ class TestBenchContract:
         assert rec["quarantine_healthy_ratio"] >= 0.8
         assert rec["quarantine_recovered"] is True
         assert rec["smoke"] is True
+
+    @pytest.mark.slow  # subprocess bench run; ci_gate --perfproxy is
+    # the per-PR gate, these pin the contract it relies on
+    def test_perfproxy_green_against_committed_baseline(self):
+        """The acceptance invariant: `bench.py perfproxy` runs green on
+        CPU against the committed baseline, one JSON line, schema
+        intact."""
+        r = _run({"JAX_PLATFORMS": "cpu"}, timeout=420,
+                 argv=("perfproxy",))
+        assert r.returncode == 0, r.stderr[-800:]
+        rec = _one_json_line(r.stdout)
+        assert rec["metric"] == "perfproxy_compile_ledger_check"
+        assert rec["unit"] == "ok"
+        assert rec["ok"] is True and rec["value"] == 1.0
+        assert set(rec) >= {"metric", "value", "unit", "vs_baseline",
+                            "checks", "baseline_file", "jax"}
+        by_name = {c["check"]: c for c in rec["checks"]}
+        # the three gated dimensions: compile counts, FLOPs, op counts
+        assert by_name["serving.warmup_compiles"]["ok"]
+        assert by_name["serving.post_warmup_compiles"]["baseline"] == 0
+        assert by_name["serving.flops"]["measured"] > 0
+        assert by_name["train_step.flops"]["measured"] > 0
+        assert by_name["train_step.op_counts"]["ok"]
+
+    @pytest.mark.slow  # subprocess bench run
+    def test_perfproxy_fails_loudly_on_injected_regression(self):
+        """An extra post-warmup compile (or a FLOP delta beyond
+        tolerance) must exit non-zero with the failing check named —
+        never a silent pass."""
+        r = _run({"JAX_PLATFORMS": "cpu",
+                  "BENCH_PERFPROXY_INJECT": "extra_compile"},
+                 timeout=420, argv=("perfproxy",))
+        assert r.returncode != 0
+        rec = _one_json_line(r.stdout)
+        assert rec["ok"] is False and rec["value"] == 0.0
+        assert "post_warmup_compiles" in rec["error"]
+
+        r = _run({"JAX_PLATFORMS": "cpu",
+                  "BENCH_PERFPROXY_INJECT": "flops"},
+                 timeout=420, argv=("perfproxy",))
+        assert r.returncode != 0
+        rec = _one_json_line(r.stdout)
+        assert rec["ok"] is False
+        assert "flops" in rec["error"]
+
+    @pytest.mark.slow  # subprocess bench run
+    def test_perfproxy_update_baseline_roundtrip(self, tmp_path):
+        """--update-baseline writes a baseline the very next check run
+        passes against (the recipe a jax upgrade will follow)."""
+        baseline = str(tmp_path / "baseline.json")
+        env = {"JAX_PLATFORMS": "cpu",
+               "BENCH_PERFPROXY_BASELINE": baseline}
+        r = _run(env, timeout=420, argv=("perfproxy",
+                                         "--update-baseline"))
+        assert r.returncode == 0, r.stderr[-800:]
+        payload = json.load(open(baseline))
+        assert payload["format"] == 1
+        assert payload["serving"]["warmup_compiles"] > 0
+        r = _run(env, timeout=420, argv=("perfproxy",))
+        assert r.returncode == 0, r.stderr[-800:]
+        assert _one_json_line(r.stdout)["ok"] is True
 
     def test_decode_mode_metric_fields(self):
         r = _run({"BENCH_CPU": "1", "BENCH_STEPS": "4",

@@ -1,0 +1,246 @@
+"""Serving telemetry end-to-end: trace-id propagation over the wire
+protocol, the `metrics` wire command (cmd 6), the /metrics HTTP
+endpoint, and cmd-5 stats as a consistent view over the obs registry."""
+import json
+import socket
+import struct
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.batching import BatchingEngine
+from paddle_tpu.inference.server import (DEADLINE_MARKER, TRACE_MARKER,
+                                         PredictorServer, _decode_arrays,
+                                         _decode_request, _encode_arrays,
+                                         _encode_deadline, _encode_trace,
+                                         _read_all)
+from paddle_tpu.obs import metrics, prometheus, tracing
+from paddle_tpu.obs.httpd import MetricsServer
+
+pytestmark = pytest.mark.serving
+
+
+def _double(x):
+    return [np.asarray(x) * 2.0]
+
+
+@pytest.fixture()
+def served_engine():
+    engine = BatchingEngine.for_callable(
+        _double, max_batch_size=8, max_wait_ms=1.0, name="obs-e2e")
+    engine.warmup(signature=[("float32", (4,))])
+    server = PredictorServer(lambda *a: _double(*a), engine=engine)
+    yield server, engine
+    server.stop()
+    engine.close()
+
+
+def _roundtrip(port, frame_body):
+    with socket.create_connection(("127.0.0.1", port)) as s:
+        s.sendall(struct.pack("<I", len(frame_body)) + frame_body)
+        (blen,) = struct.unpack("<I", _read_all(s, 4))
+        return _read_all(s, blen)
+
+
+class TestWireTracePropagation:
+    def test_decode_request_fields_any_order(self):
+        x = np.ones((2, 3), np.float32)
+        enc = _encode_arrays([x])
+        arrays, budget, tid = _decode_request(
+            enc + _encode_deadline(250.0) + _encode_trace(77))
+        assert budget == pytest.approx(0.25)
+        assert tid == 77
+        arrays, budget, tid = _decode_request(
+            enc + _encode_trace(77) + _encode_deadline(250.0))
+        assert budget == pytest.approx(0.25)
+        assert tid == 77
+        np.testing.assert_array_equal(arrays[0], x)
+
+    def test_decode_request_tolerates_absent_and_zero(self):
+        enc = _encode_arrays([np.ones((1, 2), np.float32)])
+        assert _decode_request(enc)[1:] == (None, None)
+        # trace id 0 = "untraced" sentinel, not a trace
+        assert _decode_request(enc + _encode_trace(0))[2] is None
+        # unknown marker: parsing stops, no crash
+        arrays, budget, tid = _decode_request(
+            enc + bytes([0xEE]) + b"\x00" * 8)
+        assert (budget, tid) == (None, None)
+
+    def test_markers_are_distinct(self):
+        assert TRACE_MARKER != DEADLINE_MARKER
+
+    def test_trace_id_spans_cover_request_path(self, served_engine):
+        server, engine = served_engine
+        tid = tracing.new_trace_id()
+        x = np.arange(8, dtype=np.float32).reshape(2, 4)
+        body = (struct.pack("<B", 1) + _encode_arrays([x])
+                + _encode_deadline(10_000) + _encode_trace(tid))
+        resp = _roundtrip(server.port, body)
+        assert resp[0] == 0
+        np.testing.assert_array_equal(_decode_arrays(resp[1:])[0], x * 2)
+        names = {sp["name"] for sp in tracing.finished(trace_id=tid)}
+        # the enqueue -> batch -> execute -> reply ladder, all tagged
+        # with the wire-propagated id
+        assert {"serving.request", "serving.queue", "serving.execute",
+                "serving.reply"} <= names
+
+    def test_cold_bucket_compile_span_carries_trace_id(self):
+        # no warmup: the traced request pays the bucket compile, so its
+        # trace must include the serving.compile span (README contract)
+        eng = BatchingEngine.for_callable(_double, max_batch_size=4,
+                                          max_wait_ms=1.0,
+                                          name="obs-cold")
+        try:
+            tid = tracing.new_trace_id()
+            eng.infer([np.ones((2, 4), np.float32)], timeout=60,
+                      trace_id=tid)
+            spans = tracing.finished(trace_id=tid,
+                                     name="serving.compile")
+            assert len(spans) == 1
+            assert spans[0]["attrs"]["bucket"] == 2
+        finally:
+            eng.close()
+
+    def test_untraced_requests_record_no_spans(self, served_engine):
+        server, engine = served_engine
+        before = len(tracing.finished(name="serving.request"))
+        x = np.ones((2, 4), np.float32)
+        resp = _roundtrip(server.port,
+                          struct.pack("<B", 1) + _encode_arrays([x]))
+        assert resp[0] == 0
+        # aggregation still ticks, but no span record without an id
+        assert len(tracing.finished(name="serving.request")) == before
+
+    def test_concurrent_traced_requests_keep_ids_separate(self,
+                                                          served_engine):
+        server, engine = served_engine
+        tids = [tracing.new_trace_id() for _ in range(4)]
+        errs = []
+
+        def worker(tid):
+            try:
+                x = np.ones((2, 4), np.float32)
+                body = (struct.pack("<B", 1) + _encode_arrays([x])
+                        + _encode_trace(tid))
+                assert _roundtrip(server.port, body)[0] == 0
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(t,)) for t in tids]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        for tid in tids:
+            spans = tracing.finished(trace_id=tid,
+                                     name="serving.request")
+            assert len(spans) == 1
+
+
+class TestMetricsWireCommand:
+    def test_cmd6_returns_prometheus_text(self, served_engine):
+        server, engine = served_engine
+        x = np.ones((2, 4), np.float32)
+        assert _roundtrip(server.port, struct.pack("<B", 1)
+                          + _encode_arrays([x]))[0] == 0
+        resp = _roundtrip(server.port, struct.pack("<B", 6))
+        assert resp[0] == 0
+        text = resp[1:].decode("utf-8")
+        # engine family with this engine's label, server family with
+        # this server's port, and the resilience/goodput process
+        # families — one registry, every subsystem
+        assert 'paddle_serving_requests_total{engine="obs-e2e"}' in text
+        assert f'port="{server.port}"' in text
+        assert "paddle_server_frames_total" in text
+        assert "paddle_goodput_seconds_total" in text
+        assert "# TYPE paddle_serving_queue_wait_seconds histogram" \
+            in text
+
+    def test_cmd6_reflects_live_counters(self, served_engine):
+        server, engine = served_engine
+
+        def scrape():
+            text = _roundtrip(server.port,
+                              struct.pack("<B", 6))[1:].decode()
+            for line in text.splitlines():
+                if line.startswith(
+                        'paddle_serving_requests_total{engine="obs-e2e"}'):
+                    return float(line.rsplit(" ", 1)[1])
+            return 0.0
+
+        before = scrape()
+        x = np.ones((2, 4), np.float32)
+        for _ in range(3):
+            assert _roundtrip(server.port, struct.pack("<B", 1)
+                              + _encode_arrays([x]))[0] == 0
+        assert scrape() == before + 3
+
+
+class TestStatsAsRegistryView:
+    def test_stats_match_instruments_and_exposition(self, served_engine):
+        server, engine = served_engine
+        x = np.ones((3, 4), np.float32)
+        for _ in range(2):
+            assert _roundtrip(server.port, struct.pack("<B", 1)
+                              + _encode_arrays([x]))[0] == 0
+        st = engine.stats()
+        assert st["requests"] == int(engine._m_requests.value())
+        assert st["rows"] == int(engine._m_rows.value())
+        assert st["shed_count"] == int(
+            engine._m_shed.value(reason="queue_full"))
+        # per-bucket batches in the registry agree with the stats table
+        fams = {f.name: f for f in engine._collect_families()}
+        batches = sum(
+            v for _s, _l, v
+            in fams["paddle_serving_batches_total"].samples)
+        assert batches == sum(d["batches"]
+                              for ds in st["buckets"].values()
+                              for d in ds)
+
+    def test_legacy_stats_schema_intact(self, served_engine):
+        # the MIGRATION promise: registry-backed, schema unchanged
+        server, engine = served_engine
+        st = json.loads(engine.stats_json())
+        assert set(st) >= {"name", "max_batch_size", "max_wait_ms",
+                           "max_queue", "declared_buckets",
+                           "queue_depth", "requests", "rows",
+                           "shed_count", "quarantine_shed",
+                           "deadline_expired", "deadline_late",
+                           "scheduler_restarts", "breaker", "compiles",
+                           "buckets"}
+
+    def test_closed_engine_unregisters_collector(self):
+        eng = BatchingEngine.for_callable(_double, max_batch_size=2,
+                                          name="obs-close")
+        coll = eng._obs_collector
+        assert coll in metrics.REGISTRY._collectors
+        eng.close()
+        assert coll not in metrics.REGISTRY._collectors
+
+
+class TestMetricsHTTP:
+    def test_http_metrics_endpoint(self):
+        with MetricsServer() as srv:
+            url = f"http://127.0.0.1:{srv.port}/metrics"
+            with urllib.request.urlopen(url) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith(
+                    "text/plain; version=0.0.4")
+                text = r.read().decode()
+            assert "paddle_goodput_seconds_total" in text
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/nope")
+
+    def test_http_renders_same_registry_as_cmd6(self):
+        c = metrics.counter("t_http_parity_total", "parity probe")
+        c.inc()
+        with MetricsServer() as srv:
+            url = f"http://127.0.0.1:{srv.port}/metrics"
+            with urllib.request.urlopen(url) as r:
+                text = r.read().decode()
+        assert "t_http_parity_total" in text
+        assert "t_http_parity_total" in prometheus.render()

@@ -113,9 +113,18 @@ def test_inference_subsystem_is_suppression_free():
     assert s["suppression_violations"] == 0 and s["lint_errors"] == 0
 
 
+def test_obs_subsystem_is_suppression_free():
+    """The telemetry layer is a clean zone too (DEFAULT_CLEAN_PATHS):
+    no inline tracelint suppressions under paddle_tpu/obs."""
+    r = _run(["--paths", "paddle_tpu/obs", "--skip-tests"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    s = _summary(r)
+    assert s["suppression_violations"] == 0 and s["lint_errors"] == 0
+
+
 def test_inference_is_a_default_clean_path():
-    """Both clean zones ship in the gate's DEFAULT clean paths (a
-    suppression under either fails without any --clean-paths override;
+    """All clean zones ship in the gate's DEFAULT clean paths (a
+    suppression under any fails without any --clean-paths override;
     planting a violation inside the real tree is too invasive to test
     end-to-end, so pin the default list itself)."""
     import importlib.util
@@ -125,6 +134,16 @@ def test_inference_is_a_default_clean_path():
     spec.loader.exec_module(mod)
     assert "paddle_tpu/inference" in mod.DEFAULT_CLEAN_PATHS
     assert "paddle_tpu/resilience" in mod.DEFAULT_CLEAN_PATHS
+    assert "paddle_tpu/obs" in mod.DEFAULT_CLEAN_PATHS
+
+
+def test_perfproxy_stage_reported_in_summary():
+    """Without --perfproxy the stage is skipped-but-ok; the summary
+    carries the run/ok keys either way so log scrapers see the stage."""
+    r = _run(["--paths", "paddle_tpu/obs", "--skip-tests"])
+    s = _summary(r)
+    assert s["perfproxy_run"] is False and s["perfproxy_ok"] is True
+    assert s["gate"].endswith("tier1")
 
 
 def test_chaos_stage_gates(tmp_path):

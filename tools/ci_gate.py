@@ -1,12 +1,14 @@
 #!/usr/bin/env python
 """CI gate: tracelint + suppression audit + tier-1 pytest (+ chaos,
-+ serving), one exit status.
++ serving, + perfproxy), one exit status.
 
 Usage:
     python tools/ci_gate.py [--paths paddle_tpu]
         [--skip-tests] [--pytest-args "tests/ -q -m 'not slow'"]
         [--disable TPU005,...] [--chaos] [--serving] [--serving-chaos]
-        [--clean-paths paddle_tpu/resilience paddle_tpu/inference]
+        [--perfproxy]
+        [--clean-paths paddle_tpu/resilience paddle_tpu/inference
+         paddle_tpu/obs]
 
 Phase 1 runs ``tools/tracelint.py --format json`` over ``--paths`` and
 fails on any error-severity finding (the analyzer gates the codebase
@@ -24,7 +26,11 @@ slow-marked cases like the serving bench contract that tier-1's
 ``not slow`` filter skips. ``--serving-chaos`` adds a stage running the
 serving fault-injection suite (``-m 'chaos and serving'``: scheduler
 death, poisoned-bucket quarantine, deadlines, hot reload) so the
-self-healing invariants gate releases on their own line. Exit 1 when
+self-healing invariants gate releases on their own line. ``--perfproxy``
+adds a stage running ``bench.py perfproxy`` on CPU against the
+committed PERFPROXY_BASELINE.json — compile counts, HLO op counts, and
+cost-analysis FLOPs must match, so single-chip perf can't silently rot
+while the TPU tunnel is unreachable (ROADMAP item 4). Exit 1 when
 any phase fails; the JSON line printed last summarises all of them for
 log scrapers (mirroring tools/check_op_benchmark_result.py's contract).
 """
@@ -48,9 +54,11 @@ CHAOS_PYTEST_ARGS = "tests/ -q -m 'chaos and not serving' -p no:cacheprovider"
 SERVING_PYTEST_ARGS = "tests/ -q -m serving -p no:cacheprovider"
 SERVING_CHAOS_PYTEST_ARGS = ("tests/ -q -m 'chaos and serving' "
                              "-p no:cacheprovider")
-# subsystems that must stay suppression-free: resilience (PR 2) and the
-# serving stack (this PR) fix findings instead of silencing them
-DEFAULT_CLEAN_PATHS = ("paddle_tpu/resilience", "paddle_tpu/inference")
+# subsystems that must stay suppression-free: resilience (PR 2), the
+# serving stack (PRs 4-5), and the telemetry layer (PR 7) fix findings
+# instead of silencing them
+DEFAULT_CLEAN_PATHS = ("paddle_tpu/resilience", "paddle_tpu/inference",
+                       "paddle_tpu/obs")
 
 _SUPPRESS_RE = re.compile(r"#\s*tracelint\s*:\s*disable")
 
@@ -111,6 +119,14 @@ def run_pytest(pytest_args):
     return proc.returncode
 
 
+def run_perfproxy():
+    """bench.py perfproxy vs the committed baseline (always CPU)."""
+    cmd = [sys.executable, os.path.join(REPO, "bench.py"), "perfproxy"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(cmd, cwd=REPO, env=env)
+    return proc.returncode
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="ci_gate")
     ap.add_argument("--paths", nargs="*", default=["paddle_tpu"])
@@ -132,6 +148,10 @@ def main(argv=None):
                          "quarantine, deadlines, hot reload)")
     ap.add_argument("--serving-chaos-args",
                     default=SERVING_CHAOS_PYTEST_ARGS)
+    ap.add_argument("--perfproxy", action="store_true",
+                    help="also run bench.py perfproxy (CPU compile-"
+                         "ledger regression check vs the committed "
+                         "PERFPROXY_BASELINE.json)")
     ap.add_argument("--clean-paths", nargs="*",
                     default=list(DEFAULT_CLEAN_PATHS),
                     help="path prefixes where tracelint suppressions "
@@ -182,11 +202,16 @@ def main(argv=None):
     if ns.serving_chaos:
         serving_chaos_ok = run_pytest(ns.serving_chaos_args) == 0
 
+    perfproxy_ok = True
+    if ns.perfproxy:
+        perfproxy_ok = run_perfproxy() == 0
+
     summary = {
         "gate": ("tracelint+suppressions+tier1"
                  + ("+chaos" if ns.chaos else "")
                  + ("+serving" if ns.serving else "")
-                 + ("+serving-chaos" if ns.serving_chaos else "")),
+                 + ("+serving-chaos" if ns.serving_chaos else "")
+                 + ("+perfproxy" if ns.perfproxy else "")),
         "lint_ok": lint_ok,
         "lint_errors": report.get("errors", -1),
         "lint_warnings": report.get("warnings", 0),
@@ -201,10 +226,12 @@ def main(argv=None):
         "serving_run": bool(ns.serving),
         "serving_chaos_ok": serving_chaos_ok,
         "serving_chaos_run": bool(ns.serving_chaos),
+        "perfproxy_ok": perfproxy_ok,
+        "perfproxy_run": bool(ns.perfproxy),
     }
     print(json.dumps(summary))
     if not (lint_ok and audit_ok and tests_ok and chaos_ok
-            and serving_ok and serving_chaos_ok):
+            and serving_ok and serving_chaos_ok and perfproxy_ok):
         print("ci_gate: FAILED", file=sys.stderr)
         return 1
     return 0
