@@ -16,6 +16,16 @@ codes, severity, file:line, fix-it hint):
 - ``registry_checks`` (TPU201–TPU203): the ``core/dispatch.py`` op
   contract (hashable statics, stable fn identity for the jit/vjp
   caches, no float64).
+- ``concurrency`` + ``lockmodel`` (TPU301–TPU310): static lock model
+  of the threaded serving/resilience/obs stack — lock-order cycles,
+  blocking calls under a lock, timeout-less waits, heuristic races,
+  callback-under-registry-lock, and machine-checked
+  ``# tpu-lock-order: a < b`` declarations.
+- ``locktrace``: the dynamic complement — an opt-in
+  (``PADDLE_TPU_LOCKTRACE=1``) runtime sanitizer recording actual
+  per-thread lock-acquisition order and flagging inversions, so the
+  static model is verified against observed behaviour in the chaos
+  suites.
 
 Surfaces: ``tools/tracelint.py`` (CLI), the ``jit/dy2static`` trace-
 failure hook (ranked diagnostics attached to the raised error), and the
@@ -27,7 +37,10 @@ from .diagnostics import (  # noqa: F401
     format_text, sort_key,
 )
 from .runner import (  # noqa: F401
-    LintResult, lint_file, lint_function, lint_paths, lint_registry,
-    lint_source,
+    LintResult, lint_concurrency, lint_file, lint_function, lint_paths,
+    lint_registry, lint_source,
 )
-from . import ast_checks, jaxpr_checks, registry_checks  # noqa: F401
+from . import (  # noqa: F401
+    ast_checks, concurrency, jaxpr_checks, lockmodel, locktrace,
+    registry_checks,
+)

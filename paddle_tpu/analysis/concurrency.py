@@ -1,0 +1,413 @@
+"""Concurrency lint passes TPU301–TPU310 over the static lock model.
+
+The threaded serving/resilience/obs stack's invariants — lock ordering,
+no blocking work under a lock, callbacks outside the registry lock —
+were each a post-review fix to a real hang or torn read. These passes
+encode that invariant class so it is machine-checked on every gate run
+(``tools/tracelint.py --concurrency``), the same treatment the
+trace-safety invariants got in TPU001–TPU008. The dynamic complement is
+``analysis/locktrace.py``: an opt-in runtime sanitizer that verifies the
+static model against *observed* per-thread acquisition order.
+
+Checks (codes documented in README §"Concurrency rules"):
+
+- TPU301  lock-order cycle in the interprocedural acquisition graph
+          (potential deadlock).
+- TPU302  blocking call while holding a lock (``.join()``, ``sleep``,
+          socket/subprocess ops, known-slow calls like XLA compile
+          entry points).
+- TPU303  ``Condition.wait()`` / ``Event.wait()`` without a timeout —
+          a missed notify hangs the waiter forever.
+- TPU304  ``Thread.start()`` while holding a lock (lock-holding
+          start is occasionally intentional — annotate it).
+- TPU305  heuristic race: an attribute written from >= 2 thread-entry
+          roots with no common guarding lock.
+- TPU306  ``release()`` outside a ``finally`` block (an exception
+          between acquire and release deadlocks every later acquirer).
+- TPU307  callback invoked while holding the lock of the collection it
+          came from (registry pattern: snapshot under the lock, call
+          OUTSIDE it).
+- TPU308  ``tpu-lock-order`` annotation malformed or naming a lock the
+          model cannot find.
+- TPU309  observed acquisition order contradicts a declared
+          ``tpu-lock-order`` annotation.
+- TPU310  the declared ``tpu-lock-order`` annotations themselves form a
+          cycle.
+
+Suppression uses the shared mechanism with the concurrency alias tag:
+``# tpu-lint: disable=TPU305  — one-line justification`` (``tracelint:``
+also works); the ci_gate suppression audit requires the justification
+text in clean-path subsystems.
+"""
+from . import lockmodel
+from .diagnostics import Diagnostic
+
+__all__ = ["check_model", "check_sources", "BLOCKING_CALL_LEAVES",
+           "SLOW_CALL_LEAVES"]
+
+# `<recv>.join()`, socket verbs, subprocess entry points, sleeps: calls
+# that block the calling thread for unbounded (or unbounded-ish) time.
+# `join` additionally requires a receiver PROVEN to be a Thread, and
+# run/check_call/check_output require a `subprocess.` qualifier — the
+# bare names are os.path.join / str.join / anything.run far more often.
+BLOCKING_CALL_LEAVES = {
+    "join": "Thread.join blocks until the thread exits",
+    "sleep": "time.sleep stalls every waiter on this lock",
+    "recv": "socket recv blocks on the peer",
+    "recv_into": "socket recv blocks on the peer",
+    "accept": "socket accept blocks on a client",
+    "connect": "socket connect blocks on the network",
+    "create_connection": "socket connect blocks on the network",
+    "sendall": "socket sendall blocks on a slow reader",
+    "getaddrinfo": "DNS resolution blocks on the resolver",
+    "run": None,        # subprocess.run only (module-qualified below)
+    "check_call": None,
+    "check_output": None,
+    "communicate": "subprocess communicate blocks until exit",
+    "urlopen": "HTTP fetch blocks on the network",
+}
+_SUBPROCESS_ONLY = {"run", "check_call", "check_output"}
+
+# known-slow entry points: XLA compiles take seconds to minutes — a
+# documented invariant of the serving stack is "compile OUTSIDE the
+# engine lock"
+SLOW_CALL_LEAVES = {
+    "compile": "XLA compilation takes seconds to minutes",
+    "lower": "XLA lowering precedes a compile",
+    "warmup": "bucket warmup pays one compile per bucket",
+    "load_model": "model load + deserialise is multi-second work",
+}
+
+def _diag(code, filename, line, message, func=""):
+    return Diagnostic(code=code, message=message, filename=filename,
+                      line=line, func=func)
+
+
+# ------------------------------------------------------------- TPU301
+
+
+def _find_cycles(edges):
+    """Cycles in the acquisition graph (adjacency from edge dict keys).
+    Returns a list of cycles, each a list of nodes [a, b, ..., a]."""
+    adj = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+    cycles = []
+    seen_cycles = set()
+
+    def dfs(node, stack, on_stack):
+        for nxt in sorted(adj.get(node, ())):
+            if nxt in on_stack:
+                cyc = stack[stack.index(nxt):] + [nxt]
+                key = frozenset(cyc)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    cycles.append(cyc)
+                continue
+            if nxt in visited:
+                continue
+            visited.add(nxt)
+            stack.append(nxt)
+            on_stack.add(nxt)
+            dfs(nxt, stack, on_stack)
+            stack.pop()
+            on_stack.discard(nxt)
+
+    visited = set()
+    for start in sorted(adj):
+        if start in visited:
+            continue
+        visited.add(start)
+        dfs(start, [start], {start})
+    return cycles
+
+
+def _check_lock_order_cycles(model, diags):
+    for cyc in _find_cycles(model.edges):
+        witnesses = []
+        for a, b in zip(cyc, cyc[1:]):
+            filename, line, func = model.edges[(a, b)]
+            witnesses.append(f"{a} -> {b} at {filename}:{line} [{func}]")
+        filename, line, func = model.edges[(cyc[0], cyc[1])]
+        diags.append(_diag(
+            "TPU301", filename, line,
+            "lock-order cycle (potential deadlock): "
+            + "; ".join(witnesses), func=func))
+
+
+# ------------------------------------------------------- TPU302 / 303
+
+
+def _check_blocking_under_lock(model, diags):
+    for fi in model.functions:
+        for ce in fi.calls:
+            if not ce.held or ce.target is None:
+                continue
+            parts = ce.target.split(".")
+            leaf = parts[-1]
+            why = None
+            if leaf in BLOCKING_CALL_LEAVES:
+                why = BLOCKING_CALL_LEAVES[leaf]
+                if leaf in _SUBPROCESS_ONLY:
+                    why = ("subprocess blocks until the child exits"
+                           if len(parts) > 1 and parts[-2] == "subprocess"
+                           else None)
+                elif leaf == "join" and \
+                        ce.recv_class != lockmodel.THREAD_CLASS:
+                    # os.path.join / str.join share the name; only a
+                    # receiver PROVEN to be a threading.Thread (ctor
+                    # assignment, possibly through a self attribute)
+                    # is the blocking call
+                    why = None
+            elif leaf in SLOW_CALL_LEAVES:
+                why = SLOW_CALL_LEAVES[leaf]
+            if why is None:
+                continue
+            diags.append(_diag(
+                "TPU302", fi.filename, ce.line,
+                f"`{ce.target}(...)` while holding "
+                f"{', '.join(ce.held)} — {why}; every thread that "
+                "needs the lock stalls behind it", func=fi.qualname))
+        for target, line, has_timeout, held in fi.waits:
+            if held:
+                # waiting on X while holding an UNRELATED lock blocks
+                # every acquirer of that lock for the wait duration
+                # (a Condition built ON the held lock releases it in
+                # wait() — that alias case has held == (target,), which
+                # the `h != target` filter clears)
+                others = [h for h in held if h != target]
+                if others:
+                    diags.append(_diag(
+                        "TPU302", fi.filename, line,
+                        f"`{target}.wait()` while holding "
+                        f"{', '.join(others)} — the wait parks this "
+                        "thread with the lock still held",
+                        func=fi.qualname))
+            if not has_timeout:
+                diags.append(_diag(
+                    "TPU303", fi.filename, line,
+                    f"`{target}.wait()` with no timeout — a missed "
+                    "notify (or a dead notifier thread) hangs this "
+                    "waiter forever", func=fi.qualname))
+
+
+# ------------------------------------------------------------- TPU304
+
+
+def _check_thread_start_under_lock(model, diags):
+    for fi in model.functions:
+        for line, held in fi.thread_starts:
+            if held:
+                diags.append(_diag(
+                    "TPU304", fi.filename, line,
+                    f"`Thread.start()` while holding {', '.join(held)} "
+                    "— the new thread often immediately contends on the "
+                    "same lock; annotate if the ordering is intentional",
+                    func=fi.qualname))
+
+
+# ------------------------------------------------------------- TPU305
+
+
+def _reachable_writes(model, ci, root):
+    """(attr, line, effective_guards, filename) for every self-attr
+    write reachable from `root` via self-calls, with one level of
+    call-site guard propagation (a method only ever called under a lock
+    counts as guarded by it)."""
+    out = []
+    seen = set()
+    stack = [(root, frozenset())]
+    while stack:
+        meth, inherited = stack.pop()
+        key = (meth, inherited)
+        if key in seen:
+            continue
+        seen.add(key)
+        fi = model.resolve_method(ci, meth)
+        if fi is None:
+            continue
+        for w in fi.writes:
+            out.append((w.attr, w.line,
+                        frozenset(w.held) | inherited, fi.filename))
+        for ce in fi.calls:
+            if ce.recv_is_self and ce.target and \
+                    len(ce.target.split(".")) == 2:
+                callee = ce.target.split(".")[1]
+                stack.append((callee, inherited | frozenset(ce.held)))
+    return out
+
+
+def _check_unguarded_shared_writes(model, diags):
+    for ci in model.iter_classes():
+        roots = set(ci.thread_targets)
+        if len(roots) < 2:
+            continue
+        # attr -> {root: [(line, guards, filename)]}
+        by_attr = {}
+        for root in sorted(roots):
+            for attr, line, guards, filename in \
+                    _reachable_writes(model, ci, root):
+                by_attr.setdefault(attr, {}).setdefault(
+                    root, []).append((line, guards, filename))
+        for attr, per_root in sorted(by_attr.items()):
+            if len(per_root) < 2:
+                continue
+            if attr in ci.lock_attrs:
+                continue  # assigning the lock object itself
+            # common guard = intersection of guards over EVERY write
+            all_writes = [w for ws in per_root.values() for w in ws]
+            common = None
+            for _line, guards, _fn in all_writes:
+                common = guards if common is None else (common & guards)
+            if common:
+                continue
+            line, guards, filename = min(
+                all_writes, key=lambda w: (bool(w[1]), w[0]))
+            writers = ", ".join(sorted(per_root))
+            diags.append(_diag(
+                "TPU305", filename, line,
+                f"`self.{attr}` is written from {len(per_root)} "
+                f"thread-entry roots ({writers}) with no common "
+                "guarding lock — a torn/stale value is possible; guard "
+                "the writes with one lock or annotate why the race is "
+                "benign", func=f"{ci.name}.{attr}"))
+
+
+# ------------------------------------------------------------- TPU306
+
+
+def _check_release_not_in_finally(model, diags):
+    for fi in model.functions:
+        for lockname, line, in_finally in fi.releases:
+            if in_finally:
+                continue
+            ld = model.locks.get(lockname)
+            if ld is not None and ld.kind == "semaphore":
+                # producer/consumer slot accounting: acquire and release
+                # legitimately happen on DIFFERENT threads, so there is
+                # no critical section for a finally to protect
+                continue
+            diags.append(_diag(
+                "TPU306", fi.filename, line,
+                f"`{lockname}.release()` outside a `finally` block — an "
+                "exception between acquire and release leaves the lock "
+                "held forever; use `with` or try/finally",
+                func=fi.qualname))
+
+
+# ------------------------------------------------------------- TPU307
+
+
+def _check_callback_under_lock(model, diags):
+    for fi in model.functions:
+        for line, held, src_attr in fi.callback_calls:
+            if not held:
+                continue
+            # only fire when the held lock belongs to the same object
+            # the callback collection lives on (the registry pattern):
+            # an unrelated (e.g. module-level) lock held around a hook
+            # loop is a latency question, not the re-entrancy deadlock
+            # this check encodes
+            if fi.cls is None:
+                continue
+            own = {ld.canonical
+                   for c in model._walk_mro(fi.cls)
+                   for ld in c.lock_attrs.values()}
+            offending = [h for h in held if h in own]
+            if not offending:
+                continue
+            diags.append(_diag(
+                "TPU307", fi.filename, line,
+                f"callback from `self.{src_attr}` invoked while holding "
+                f"{', '.join(offending)} — a callback that (re)enters "
+                "this subsystem deadlocks; snapshot the list under the "
+                "lock and call OUTSIDE it", func=fi.qualname))
+
+
+# ------------------------------------------------- TPU308 / 309 / 310
+
+
+def _check_declared_order(model, diags):
+    # a declaration may name an ALIAS (`Eng._cond` for a Condition over
+    # `Eng._lock`) — the natural name at the acquisition sites;
+    # canonicalise before checking, exactly like acquisitions are
+    known = {ld.canonical for ld in model.locks.values()}
+
+    def canon(n):
+        ld = model.locks.get(n)
+        return ld.canonical if ld is not None else n
+
+    declared = {}   # (a, b) -> (filename, line)
+    for pair, decl, filename, line in model.order_decls:
+        if pair is None:
+            diags.append(_diag(
+                "TPU308", filename, line,
+                f"malformed tpu-lock-order annotation {decl!r} — "
+                "expected `# tpu-lock-order: A.lock < B.lock [< ...]`"))
+            continue
+        a, b = (canon(n) for n in pair)
+        missing = [raw for raw, c in zip(pair, (a, b)) if c not in known]
+        if missing:
+            nameable = sorted(set(model.locks) | known)
+            diags.append(_diag(
+                "TPU308", filename, line,
+                f"tpu-lock-order names unknown lock(s) "
+                f"{', '.join(missing)} (known: "
+                f"{', '.join(nameable) or 'none'}) — fix the name "
+                "or the annotation is dead"))
+            continue
+        declared[(a, b)] = (filename, line)
+    # TPU310: cycles among the declarations themselves
+    for cyc in _find_cycles(declared):
+        filename, line = declared[(cyc[0], cyc[1])]
+        diags.append(_diag(
+            "TPU310", filename, line,
+            "declared tpu-lock-order annotations form a cycle: "
+            + " < ".join(cyc) + " — no acquisition order can satisfy "
+            "them all"))
+    # TPU309: an observed edge b -> a contradicting a declared a < b
+    # (honour transitivity over the declared DAG)
+    closure = set(declared)
+    changed = True
+    while changed:
+        changed = False
+        for (a, b) in list(closure):
+            for (c, d) in list(closure):
+                if b == c and (a, d) not in closure and a != d:
+                    closure.add((a, d))
+                    changed = True
+    for (a, b) in sorted(closure):
+        rev = model.edges.get((b, a))
+        if rev is None:
+            continue
+        filename, line, func = rev
+        where = declared.get((a, b))
+        src = f" (declared at {where[0]}:{where[1]})" if where else \
+            " (declared transitively)"
+        diags.append(_diag(
+            "TPU309", filename, line,
+            f"acquisition order {b} -> {a} contradicts the declared "
+            f"lock order {a} < {b}{src} — this inversion is exactly "
+            "the deadlock the annotation guards against", func=func))
+
+
+# ---------------------------------------------------------------- driver
+
+
+def check_model(model):
+    """Run every TPU3xx pass over a built LockModel."""
+    diags = []
+    _check_lock_order_cycles(model, diags)
+    _check_blocking_under_lock(model, diags)
+    _check_thread_start_under_lock(model, diags)
+    _check_unguarded_shared_writes(model, diags)
+    _check_release_not_in_finally(model, diags)
+    _check_callback_under_lock(model, diags)
+    _check_declared_order(model, diags)
+    return diags
+
+
+def check_sources(sources):
+    """``sources``: iterable of (source_text, filename) analysed as ONE
+    model (cross-file edges and annotations resolve globally)."""
+    return check_model(lockmodel.build_model(list(sources)))

@@ -9,16 +9,23 @@ documented in README.md §"Trace-safety rules":
   (``jit/dy2static`` / jitted train steps).
 - ``TPU1xx`` — jaxpr passes (post-trace program properties).
 - ``TPU2xx`` — op-registry passes over ``core/dispatch.py`` ops.
+- ``TPU3xx`` — concurrency passes over the static lock model
+  (``analysis/concurrency.py``; README §"Concurrency rules").
 
 Suppression: an inline ``# tracelint: disable=TPU001,TPU005`` comment on
 the flagged line silences those codes for that line; a file-level
 comment (on any of the first five lines, with no code after ``disable=``
 meaning "all") silences the whole file; ``--disable`` on the CLI
-silences codes globally.
+silences codes globally. ``# tpu-lint: disable=...`` is an equivalent
+alias tag (conventionally used for the concurrency codes, where the
+ci_gate suppression audit additionally requires a trailing one-line
+justification in clean-path subsystems).
 """
 import dataclasses
+import io
 import json
 import re
+import tokenize
 
 SEVERITY_ERROR = "error"
 SEVERITY_WARNING = "warning"
@@ -82,6 +89,49 @@ CODES = {
     "TPU203": (SEVERITY_WARNING, "float64 in op implementation",
                "TPUs have no f64 ALU path and jax demotes silently under "
                "x64-disabled; use float32/bfloat16 explicitly"),
+    # ---- concurrency passes (static lock model; analysis/concurrency) ----
+    "TPU301": (SEVERITY_ERROR, "lock-order cycle (potential deadlock)",
+               "pick one global order for the cycle's locks and acquire "
+               "in that order everywhere; declare it with a "
+               "`# tpu-lock-order: a < b` annotation so it stays checked"),
+    "TPU302": (SEVERITY_WARNING, "blocking call while holding a lock",
+               "snapshot the state you need under the lock, release it, "
+               "then do the slow work (the serving engine's 'compile "
+               "outside the engine lock' pattern)"),
+    "TPU303": (SEVERITY_WARNING, "wait() without a timeout",
+               "pass a timeout and re-check the predicate in a loop; an "
+               "unbounded wait turns one missed notify into a permanent "
+               "hang (annotate the rare wait that is provably always "
+               "notified)"),
+    "TPU304": (SEVERITY_WARNING, "Thread.start() while holding a lock",
+               "start threads after releasing the lock, or annotate why "
+               "the ordering is load-bearing (e.g. close() must never "
+               "join an unstarted thread)"),
+    "TPU305": (SEVERITY_WARNING, "shared write from multiple threads "
+               "with no common lock",
+               "guard every write to the attribute with one lock, or "
+               "annotate why the race is benign (GIL-atomic scalar bump)"),
+    "TPU306": (SEVERITY_ERROR, "release() not in a finally block",
+               "use `with lock:` (preferred) or try/finally — an "
+               "exception between acquire and release deadlocks every "
+               "later acquirer"),
+    "TPU307": (SEVERITY_ERROR, "callback invoked under the owning lock",
+               "copy the callback list under the lock and invoke OUTSIDE "
+               "it (the obs registry contract: collectors run outside "
+               "the registry lock so exposition can't deadlock the hot "
+               "path)"),
+    "TPU308": (SEVERITY_WARNING, "unresolvable tpu-lock-order annotation",
+               "annotation names must match the lock model: "
+               "`ClassName.attr` for instance locks, "
+               "`modulename.varname` for module-level locks"),
+    "TPU309": (SEVERITY_ERROR, "acquisition order contradicts a declared "
+               "tpu-lock-order",
+               "the declared order is the documented invariant; fix the "
+               "acquisition site (or fix a stale annotation)"),
+    "TPU310": (SEVERITY_ERROR, "declared tpu-lock-order annotations form "
+               "a cycle",
+               "the declarations are mutually unsatisfiable; pick one "
+               "global order and fix the stale annotation(s)"),
 }
 
 
@@ -129,7 +179,7 @@ def sort_key(d):
 
 
 _SUPPRESS_RE = re.compile(
-    r"#\s*tracelint\s*:\s*disable(?:=([A-Z0-9,\s]+))?")
+    r"#\s*(?:tracelint|tpu-lint)\s*:\s*disable(?:=([A-Z0-9,\s]+))?")
 
 
 def _parse_suppression(comment):
@@ -143,6 +193,28 @@ def _parse_suppression(comment):
     return codes or "all"
 
 
+def _directive_lines(source):
+    """(lineno, comment_text, own_line) for every token that may carry
+    a directive — REAL comment tokens only, so a docstring that
+    *documents* the syntax never becomes a live suppression (the
+    ci_gate audit is tokenize-based for the same reason: what it cannot
+    see must not suppress). ``own_line`` is True for a whole-line
+    comment (the only file-level candidates; a trailing comment stays
+    line-scoped). Unparseable source falls back to the raw line scan —
+    there the only diagnostic is TPU000 anyway."""
+    if "tracelint" not in source and "tpu-lint" not in source:
+        return []
+    try:
+        return [(tok.start[0], tok.string,
+                 not tok.line[:tok.start[1]].strip())
+                for tok in tokenize.generate_tokens(
+                    io.StringIO(source).readline)
+                if tok.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return [(i, text, text.lstrip().startswith("#"))
+                for i, text in enumerate(source.splitlines(), start=1)]
+
+
 class SuppressionIndex:
     """Per-file map of inline/file-level `# tracelint: disable=` directives.
 
@@ -153,13 +225,13 @@ class SuppressionIndex:
     def __init__(self, source, file_level=True):
         self._by_line = {}
         self._file_level = None
-        for i, text in enumerate(source.splitlines(), start=1):
-            if "tracelint" not in text:
+        for i, text, own_line in _directive_lines(source):
+            if "tracelint" not in text and "tpu-lint" not in text:
                 continue
             got = _parse_suppression(text)
             if got is None:
                 continue
-            if file_level and i <= 5 and text.lstrip().startswith("#"):
+            if file_level and i <= 5 and own_line:
                 if self._file_level is None or got == "all":
                     self._file_level = got
                 elif self._file_level != "all":
@@ -198,12 +270,21 @@ def format_text(diags):
     return "\n".join(lines)
 
 
-def format_json(diags):
-    return json.dumps(
-        {
-            "findings": [d.as_dict() for d in diags],
-            "errors": sum(1 for d in diags if d.is_error),
-            "warnings": sum(1 for d in diags if d.severity == SEVERITY_WARNING),
-        },
-        indent=2,
-    )
+#: Version of the JSON report shape below. Bump on any breaking change
+#: to the top-level keys or the per-finding fields — CI consumers key
+#: on it instead of sniffing the shape.
+JSON_SCHEMA_VERSION = 2
+
+
+def format_json(diags, timings=None):
+    """``timings``: optional {pass_group: seconds} map (the CLI measures
+    per-group wall time so gate logs can attribute slow runs)."""
+    report = {
+        "schema_version": JSON_SCHEMA_VERSION,
+        "findings": [d.as_dict() for d in diags],
+        "errors": sum(1 for d in diags if d.is_error),
+        "warnings": sum(1 for d in diags if d.severity == SEVERITY_WARNING),
+    }
+    if timings is not None:
+        report["timings_s"] = {k: round(v, 4) for k, v in timings.items()}
+    return json.dumps(report, indent=2)

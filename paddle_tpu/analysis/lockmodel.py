@@ -1,0 +1,919 @@
+"""Static per-module lock model for the concurrency passes (TPU3xx).
+
+The serving/resilience/obs runtimes are multi-threaded and their
+correctness rests on lock-order invariants that — before this pass
+family — existed only in prose ("lock order subsystem -> instrument,
+never reversed", "compile outside the engine lock", "collector
+callbacks run OUTSIDE the registry lock"). This module extracts, from
+the AST alone, everything the checks in ``concurrency.py`` need:
+
+- **Lock definitions**: ``self._lock = threading.Lock()`` (and RLock /
+  Condition / Event / Semaphore) inside class methods, plus
+  module-level ``_lock = threading.Lock()``. A ``Condition(self._lock)``
+  constructed over an existing lock is an *alias* — acquiring the
+  condition IS acquiring the lock, so both names canonicalise to one
+  node.
+- **Acquisition regions**: ``with self._lock:`` / ``with _lock:``
+  blocks (including multi-item withs), and bare ``.acquire()`` /
+  ``.release()`` calls (tracked for the release-not-in-finally check).
+- **Events**: every call made while holding each lock (nested
+  acquisitions, method calls, blocking calls, ``Thread.start()``,
+  callback invocations), attribute writes with the guard set at the
+  write site, waits without timeout, thread-entry registrations
+  (``threading.Thread(target=...)``).
+- **Declared order annotations**: ``# tpu-lock-order: A._x < B._y``
+  comment lines (chains ``a < b < c`` allowed), validated by
+  TPU308–TPU310 against the observed acquisition graph.
+
+Node naming: an instance lock is ``ClassName.attr`` (the class whose
+method *created* it — subclasses inherit the base's node, resolved
+through the recorded bases). When two classes of the same bare name in
+different files BOTH own locks, each node is qualified as
+``modulename.ClassName.attr`` so unrelated hierarchies never merge. A
+module-level lock is ``<modulebasename>.varname``. Names are global
+across the analysed file set so cross-module edges (engine lock ->
+instrument lock) land in one graph.
+
+Classes themselves are per-file: two files defining ``class Metric``
+yield two independent :class:`ClassInfo` objects (the repo really has
+that collision — ``obs/metrics.py`` vs ``metric/__init__.py``).
+Resolution prefers a class from the same module, then a globally
+unique bare name, and otherwise resolves nothing — ambiguity makes
+the model conservative, never wrong.
+
+Interprocedural resolution is deliberately heuristic and conservative:
+``self._meth()`` resolves within the class (and its resolvable bases);
+a bare ``fn()`` resolves to a module function of the analysed set
+(never a Python builtin); ``obj.meth()`` resolves through a proven
+receiver type (a local or self attribute assigned from a known
+constructor) or, failing that, to every lock-acquiring definition of
+``meth`` — except for generic collection/socket method names, which
+resolve only when the receiver type is proven. False negatives are
+acceptable (we never claim completeness); the error-severity checks
+only fire on demonstrated evidence.
+
+KNOWN LIMITATION: nested function bodies (closures, local thread
+targets) are not modelled — lock use inside a closure is invisible to
+every TPU3xx pass (false negatives, never false positives).
+"""
+import ast
+import builtins
+import io
+import os
+import re
+import tokenize
+
+__all__ = ["LockModel", "build_model", "ORDER_RE", "THREAD_CLASS"]
+
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Semaphore": "semaphore",
+               "BoundedSemaphore": "semaphore"}
+_COND_CTOR = "Condition"
+_EVENT_CTOR = "Event"
+
+# Method names too generic to resolve by name alone: they collide with
+# dict/list/set/socket/file/Event methods, and a `self._cache.get(k)`
+# under a lock must not fabricate an edge into an unrelated class's
+# `get`. Calls on receivers with a known type hint (a local or self
+# attribute assigned from `KnownClass(...)`) still resolve precisely.
+_GENERIC_METHODS = frozenset({
+    "get", "put", "set", "pop", "clear", "update", "setdefault", "keys",
+    "values", "items", "add", "discard", "remove", "append", "extend",
+    "insert", "sort", "copy", "index", "count", "read", "write", "flush",
+    "send", "sendall", "recv", "recv_into", "accept", "connect", "start",
+    "join", "acquire", "release", "wait", "notify", "notify_all",
+    "locked", "is_set",
+})
+
+# A bare call to `max(...)` inside the engine is the builtin, even
+# though paddle_tpu's tensor API exports a module function named `max`
+# somewhere in the analysed set — never resolve builtins by name.
+_BUILTIN_NAMES = frozenset(dir(builtins))
+
+#: Sentinel receiver type for `x = threading.Thread(...)` assignments —
+#: lets the TPU302 `.join()` check fire only on actual thread handles
+#: (an unqualified `.join` is os.path.join / str.join far more often).
+THREAD_CLASS = "threading.Thread"
+
+ORDER_RE = re.compile(r"#\s*tpu-lock-order\s*:\s*(.+?)\s*(?:#|$)")
+
+
+def _ctor_kind(call):
+    """threading.Lock()/Lock() etc -> kind string, else None."""
+    if not isinstance(call, ast.Call):
+        return None
+    fn = call.func
+    name = None
+    if isinstance(fn, ast.Attribute):
+        name = fn.attr
+    elif isinstance(fn, ast.Name):
+        name = fn.id
+    if name in _LOCK_CTORS:
+        return _LOCK_CTORS[name]
+    if name == _COND_CTOR:
+        return "condition"
+    if name == _EVENT_CTOR:
+        return "event"
+    return None
+
+
+class LockDef:
+    __slots__ = ("name", "kind", "filename", "line", "canonical")
+
+    def __init__(self, name, kind, filename, line):
+        self.name = name          # e.g. "BatchingEngine._lock"
+        self.kind = kind          # lock|rlock|condition|event|semaphore
+        self.filename = filename
+        self.line = line
+        self.canonical = name     # alias target (Condition over a lock)
+
+
+class Acquisition:
+    """One lock acquisition site (a with-item or bare .acquire())."""
+
+    __slots__ = ("lock", "line", "held", "via_with")
+
+    def __init__(self, lock, line, held, via_with):
+        self.lock = lock          # canonical lock name
+        self.line = line
+        self.held = tuple(held)   # canonical names held when acquiring
+        self.via_with = via_with
+
+
+class CallEvent:
+    """A call made inside a function body, with the guard set at the
+    call site. ``target`` is the best-effort dotted name;
+    ``recv_class`` the receiver's ClassInfo (or the THREAD_CLASS
+    sentinel) when a ctor assignment proved it."""
+
+    __slots__ = ("target", "recv_is_self", "recv_class", "line", "held",
+                 "node", "timeout_arg")
+
+    def __init__(self, target, recv_is_self, line, held, node,
+                 timeout_arg, recv_class=None):
+        self.target = target
+        self.recv_is_self = recv_is_self
+        self.recv_class = recv_class
+        self.line = line
+        self.held = tuple(held)
+        self.node = node
+        self.timeout_arg = timeout_arg  # True if any positional/kw arg
+
+
+class WriteEvent:
+    __slots__ = ("attr", "line", "held")
+
+    def __init__(self, attr, line, held):
+        self.attr = attr
+        self.line = line
+        self.held = tuple(held)
+
+
+class FuncInfo:
+    """Per-function lock behaviour summary."""
+
+    def __init__(self, qualname, filename, node, cls=None):
+        self.qualname = qualname      # "Class.meth" or "meth"
+        self.filename = filename
+        self.node = node
+        self.cls = cls                # enclosing ClassInfo (or None)
+        self.acquisitions = []        # [Acquisition]
+        self.calls = []               # [CallEvent]
+        self.writes = []              # [WriteEvent] (self.attr writes)
+        self.releases = []            # [(lockname, line, in_finally)]
+        self.bare_acquires = []       # [(lockname, line)]
+        self.thread_starts = []       # [(line, held)]
+        self.waits = []               # [(target, line, has_timeout, held)]
+        self.callback_calls = []      # [(line, held, source_attr)]
+        # locks this function acquires anywhere in its body (local only)
+        self.local_locks = set()
+        # filled by the fixpoint: locks (transitively) acquired
+        self.all_locks = set()
+
+
+class ClassInfo:
+    def __init__(self, name, modname, filename, bases):
+        self.name = name
+        self.modname = modname
+        self.filename = filename
+        self.bases = bases            # base-class name strings
+        self.lock_attrs = {}          # attr -> LockDef
+        self.attr_types = {}          # attr -> ClassInfo | THREAD_CLASS
+        self.methods = {}             # meth name -> FuncInfo
+        self.thread_targets = set()   # method names used as Thread targets
+
+
+class LockModel:
+    """The aggregate model over one or more analysed files."""
+
+    def __init__(self):
+        self.locks = {}               # canonical name -> LockDef
+        self.class_index = {}         # bare name -> [ClassInfo, ...]
+        self.module_funcs = {}        # func name -> [FuncInfo, ...]
+        self.functions = []           # every FuncInfo, in order
+        self.order_decls = []         # [(before, after, filename, line)]
+        self.order_texts = []         # [(rawtext, filename, line)]
+        self.edges = {}               # (a, b) -> (filename, line, func)
+        self._by_file = {}            # (filename, classname) -> ClassInfo
+
+    # -------------------------------------------------- name resolution
+    def iter_classes(self):
+        for lst in self.class_index.values():
+            yield from lst
+
+    def resolve_class(self, name, prefer_mod=None):
+        """Bare class name -> ClassInfo: same module first, then a
+        globally unique name; ambiguity resolves to None (the model
+        stays conservative rather than merging unrelated classes)."""
+        lst = self.class_index.get(name)
+        if not lst:
+            return None
+        if prefer_mod is not None:
+            same = [ci for ci in lst if ci.modname == prefer_mod]
+            if len(same) == 1:
+                return same[0]
+        return lst[0] if len(lst) == 1 else None
+
+    def _walk_mro(self, ci):
+        seen, stack = set(), [ci]
+        while stack:
+            c = stack.pop()
+            if c is None or id(c) in seen:
+                continue
+            seen.add(id(c))
+            yield c
+            for b in c.bases:
+                stack.append(self.resolve_class(b, prefer_mod=c.modname))
+
+    def lock_attr_of(self, ci, attr):
+        """Resolve ``self.<attr>`` in class ``ci`` to a canonical lock
+        node, walking resolvable base classes."""
+        for c in self._walk_mro(ci):
+            ld = c.lock_attrs.get(attr)
+            if ld is not None:
+                return ld.canonical
+        return None
+
+    def attr_type_of(self, ci, attr):
+        for c in self._walk_mro(ci):
+            t = c.attr_types.get(attr)
+            if t is not None:
+                return t
+        return None
+
+    def resolve_method(self, ci, meth):
+        """``self.<meth>()`` (or a typed receiver's meth) -> FuncInfo."""
+        if not isinstance(ci, ClassInfo):
+            return None               # THREAD_CLASS sentinel etc.
+        for c in self._walk_mro(ci):
+            fi = c.methods.get(meth)
+            if fi is not None:
+                return fi
+        return None
+
+    def candidates_for_attr_call(self, meth):
+        """``obj.<meth>()`` with unknown receiver type: every class in
+        the set defining ``meth`` whose definition acquires locks."""
+        out = []
+        for ci in self.iter_classes():
+            fi = ci.methods.get(meth)
+            if fi is not None and fi.all_locks:
+                out.append(fi)
+        return out
+
+    def resolve_module_func(self, name, from_file=None):
+        """Bare-name call -> module FuncInfo: the SAME file's function
+        first, then a globally unique name; same-named functions in two
+        different files otherwise resolve to nothing (file A's `helper()`
+        must never enter file B's unrelated lock-acquiring `helper`)."""
+        lst = self.module_funcs.get(name)
+        if not lst:
+            return None
+        if from_file is not None:
+            same = [fi for fi in lst if fi.filename == from_file]
+            if len(same) == 1:
+                return same[0]
+        return lst[0] if len(lst) == 1 else None
+
+
+# --------------------------------------------------------------- extraction
+
+
+def _attr_chain(node):
+    """x.a.b -> ("x", ("a", "b")) for Name-rooted chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    return node.id, tuple(reversed(parts))
+
+
+def _ctor_class_in(model, expr, prefer_mod=None):
+    """The single known-class constructor called inside `expr`
+    (``_Queue()``, ``x.setdefault(k, _Queue())``) resolved to its
+    ClassInfo, else None. ``threading.Thread(...)`` types as the
+    :data:`THREAD_CLASS` sentinel."""
+    found = set()
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call):
+            fn = n.func
+            leaf = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None)
+            if leaf in model.class_index:
+                found.add(leaf)
+            elif leaf == "Thread":
+                found.add(THREAD_CLASS)
+    if len(found) != 1:
+        return None
+    leaf = found.pop()
+    if leaf == THREAD_CLASS:
+        return THREAD_CLASS
+    return model.resolve_class(leaf, prefer_mod=prefer_mod)
+
+
+class _FuncExtractor(ast.NodeVisitor):
+    """Walk one function body tracking the statically-held lock set."""
+
+    def __init__(self, model, modname, cls, info):
+        self.model = model
+        self.modname = modname
+        self.cls = cls                # ClassInfo or None
+        self.info = info
+        self.held = []                # stack of canonical lock names
+        self._finally_depth = 0
+        # local names bound from self-attr collections (callback lists)
+        self._cb_vars = {}            # name -> source attr
+        # local names with a proven class (assigned from a known ctor)
+        self._local_types = {}        # name -> ClassInfo | THREAD_CLASS
+
+    def _recv_class(self, recv):
+        """Best-effort class of a call receiver expression."""
+        if isinstance(recv, ast.Name):
+            return self._local_types.get(recv.id)
+        chain = _attr_chain(recv)
+        if chain and chain[0] == "self" and len(chain[1]) == 1 \
+                and self.cls is not None:
+            return self.model.attr_type_of(self.cls, chain[1][0])
+        if isinstance(recv, ast.Call):
+            return _ctor_class_in(self.model, recv,
+                                  prefer_mod=self.modname)
+        return None
+
+    # ---- lock name resolution inside this function
+    def _lock_of_expr(self, node):
+        """Expression used as a with-item / acquire receiver ->
+        canonical lock name, or None."""
+        chain = _attr_chain(node)
+        if chain is None:
+            return None
+        root, parts = chain
+        if root == "self" and len(parts) == 1 and self.cls is not None:
+            return self.model.lock_attr_of(self.cls, parts[0])
+        if not parts:
+            mod_lock = f"{self.modname}.{root}"
+            if mod_lock in self.model.locks:
+                return mod_lock
+        return None
+
+    def _note_acquire(self, lockname, line, via_with):
+        self.info.acquisitions.append(
+            Acquisition(lockname, line, self.held, via_with))
+        self.info.local_locks.add(lockname)
+
+    # -------------------------------------------------------- statements
+    def visit_With(self, node):
+        acquired = []
+        for item in node.items:
+            expr = item.context_expr
+            # `with self._lock:` or `with lock.acquire_timeout(..)` — only
+            # direct lock names count
+            lock = self._lock_of_expr(expr)
+            if lock is not None:
+                self._note_acquire(lock, node.lineno, via_with=True)
+                self.held.append(lock)
+                acquired.append(lock)
+            else:
+                self.visit(expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Try(self, node):
+        for stmt in node.body:
+            self.visit(stmt)
+        for h in node.handlers:
+            for stmt in h.body:
+                self.visit(stmt)
+        for stmt in node.orelse:
+            self.visit(stmt)
+        self._finally_depth += 1
+        for stmt in node.finalbody:
+            self.visit(stmt)
+        self._finally_depth -= 1
+
+    def visit_Assign(self, node):
+        self.visit(node.value)
+        for t in node.targets:
+            self._note_target(t)
+        # track callback-collection derived locals:
+        #   fns = self._collectors / list(self._collectors)
+        src = node.value
+        if isinstance(src, ast.Call) and isinstance(src.func, ast.Name) \
+                and src.func.id in ("list", "tuple", "sorted") and src.args:
+            src = src.args[0]
+        chain = _attr_chain(src)
+        if chain and chain[0] == "self" and len(chain[1]) == 1:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self._cb_vars[t.id] = chain[1][0]
+                    # `t = self._thread` inherits the attr's proven type
+                    # (so a later `t.join()` is still thread-qualified)
+                    at = self._recv_class(src)
+                    if at is not None:
+                        self._local_types[t.id] = at
+        # type hints: x = KnownClass(...) (possibly nested, e.g.
+        # d.setdefault(k, _Queue())); self.attr = KnownClass(...)
+        ctor = _ctor_class_in(self.model, node.value,
+                              prefer_mod=self.modname)
+        if ctor is not None:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self._local_types[t.id] = ctor
+                elif self.cls is not None:
+                    tc = _attr_chain(t)
+                    if tc and tc[0] == "self" and len(tc[1]) == 1:
+                        self.cls.attr_types.setdefault(tc[1][0], ctor)
+
+    def visit_AugAssign(self, node):
+        self.visit(node.value)
+        self._note_target(node.target)
+
+    def _note_target(self, target):
+        for n in ast.walk(target):
+            if isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Store):
+                chain = _attr_chain(n)
+                if chain and chain[0] == "self" and len(chain[1]) == 1:
+                    self.info.writes.append(
+                        WriteEvent(chain[1][0], n.lineno, self.held))
+
+    def visit_For(self, node):
+        self.visit(node.iter)
+        # `for fn in self._collectors:` (or over a derived local) binds
+        # the loop var as a callback candidate
+        src_attr = None
+        chain = _attr_chain(node.iter)
+        if chain and chain[0] == "self" and len(chain[1]) == 1:
+            src_attr = chain[1][0]
+        elif isinstance(node.iter, ast.Name):
+            src_attr = self._cb_vars.get(node.iter.id)
+        if src_attr and isinstance(node.target, ast.Name):
+            self._cb_vars[node.target.id] = src_attr
+        for stmt in node.body:
+            self.visit(stmt)
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    # ------------------------------------------------------------- calls
+    def visit_Call(self, node):
+        # recurse into arguments first (nested calls see the same held set)
+        for a in node.args:
+            self.visit(a)
+        for k in node.keywords:
+            self.visit(k.value)
+
+        fn = node.func
+        has_args = bool(node.args or node.keywords)
+
+        # Thread(target=...) registration. Only bound-method targets
+        # feed the TPU305 root analysis: module-function and closure
+        # targets have no `self` whose attributes two roots could race
+        # on.
+        tname = None
+        if isinstance(fn, ast.Attribute) and fn.attr == "Thread":
+            tname = "Thread"
+        elif isinstance(fn, ast.Name) and fn.id == "Thread":
+            tname = "Thread"
+        if tname:
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    chain = _attr_chain(kw.value)
+                    if chain and chain[0] == "self" and len(chain[1]) == 1 \
+                            and self.cls is not None:
+                        self.cls.thread_targets.add(chain[1][0])
+
+        if isinstance(fn, ast.Attribute):
+            if not isinstance(fn.value, (ast.Name, ast.Attribute)):
+                # chained receivers (self._backend()[1].close()) may hide
+                # further calls
+                self.visit(fn.value)
+            recv_lock = self._lock_of_expr(fn.value)
+            # bare acquire()/release() on a known lock
+            if fn.attr == "acquire" and recv_lock is not None:
+                self._note_acquire(recv_lock, node.lineno, via_with=False)
+                self.info.bare_acquires.append((recv_lock, node.lineno))
+            elif fn.attr == "release" and recv_lock is not None:
+                self.info.releases.append(
+                    (recv_lock, node.lineno, self._finally_depth > 0))
+            elif fn.attr in ("wait", "wait_for"):
+                # Condition/Event wait: target may be a known lock attr
+                # or any self attr (events aren't lock nodes but their
+                # timeout-less waits still hang forever)
+                target = None
+                chain = _attr_chain(fn.value)
+                if recv_lock is not None:
+                    target = recv_lock
+                elif chain and chain[0] == "self" and len(chain[1]) == 1:
+                    target = f"self.{chain[1][0]}"
+                elif chain and not chain[1]:
+                    target = chain[0]
+                if fn.attr == "wait_for":
+                    # the predicate is MANDATORY: only a second
+                    # positional (or timeout=) actually bounds the wait
+                    has_timeout = (len(node.args) >= 2 or any(
+                        k.arg == "timeout" for k in node.keywords))
+                else:
+                    has_timeout = has_args
+                if target is not None:
+                    self.info.waits.append(
+                        (target, node.lineno, has_timeout,
+                         tuple(self.held)))
+            elif fn.attr == "start":
+                chain = _attr_chain(fn.value)
+                # t.start() — only count plausible thread handles (any
+                # bare name or self attr; servers/sockets don't .start())
+                if chain is not None:
+                    self.info.thread_starts.append(
+                        (node.lineno, tuple(self.held)))
+
+            chain = _attr_chain(fn)
+            target = ".".join((chain[0],) + chain[1]) if chain else None
+            self.info.calls.append(CallEvent(
+                target, bool(chain and chain[0] == "self"), node.lineno,
+                self.held, node, has_args,
+                recv_class=self._recv_class(fn.value)))
+        elif isinstance(fn, ast.Name):
+            # callback invocation: calling a local bound from a self-attr
+            # collection
+            src_attr = self._cb_vars.get(fn.id)
+            if src_attr is not None:
+                self.info.callback_calls.append(
+                    (node.lineno, tuple(self.held), src_attr))
+            self.info.calls.append(CallEvent(
+                fn.id, False, node.lineno, self.held, node, has_args))
+
+    def visit_FunctionDef(self, node):
+        if node is self.info.node:
+            for stmt in node.body:
+                self.visit(stmt)
+        # KNOWN LIMITATION: nested defs (closures, local thread targets)
+        # are not modelled at all — their bodies run on their own
+        # schedule, not under this function's held set, and the walker
+        # only registers module-level functions and direct class
+        # methods. Lock use inside a closure is invisible to every
+        # TPU3xx pass (false negatives, never false positives).
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _modname_for(filename):
+    """Module key for node naming: the file's basename — except
+    ``__init__.py``, which takes its PACKAGE name (``native/__init__.py``
+    -> ``native``), or every package init in the tree would collide on
+    the meaningless key ``__init__``."""
+    base = os.path.splitext(os.path.basename(filename))[0]
+    if base == "__init__":
+        parent = os.path.basename(os.path.dirname(filename))
+        if parent:
+            return parent
+    return base
+
+
+def _qualified_modname(filename):
+    """Disambiguator for same-basename twins that both define module
+    locks: prefix the parent directory (``serving.util`` vs
+    ``train.util``)."""
+    base = _modname_for(filename)
+    parts = os.path.normpath(filename).replace("\\", "/").split("/")
+    if os.path.splitext(parts[-1])[0] == "__init__":
+        parts = parts[:-1]
+    if len(parts) > 1:
+        return f"{parts[-2]}.{base}"
+    return base
+
+
+def _has_module_locks(tree):
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and \
+                _ctor_kind(stmt.value) is not None and \
+                any(isinstance(t, ast.Name) for t in stmt.targets):
+            return True
+    return False
+
+
+def _register_classes(model, modname, tree, filename):
+    """Phase 0: one ClassInfo per (file, class) — same-named classes in
+    different files never merge."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        bases = []
+        for b in node.bases:
+            chain = _attr_chain(b)
+            if chain:
+                bases.append(chain[1][-1] if chain[1] else chain[0])
+        ci = ClassInfo(node.name, modname, filename, bases)
+        model.class_index.setdefault(node.name, []).append(ci)
+        model._by_file[(filename, node.name)] = ci
+
+
+def _lock_owners_by_name(tree):
+    """Class names in `tree` that assign a threading primitive to a
+    self attribute (pre-scan for collision-qualified node naming)."""
+    out = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and \
+                    _ctor_kind(sub.value) is not None:
+                for t in sub.targets:
+                    chain = _attr_chain(t)
+                    if chain and chain[0] == "self" and len(chain[1]) == 1:
+                        out.add(node.name)
+    return out
+
+
+def _collect_lock_defs(model, modname, tree, filename, contested):
+    """Phase 1: lock/condition/event definitions. ``contested`` holds
+    the bare class names owned by >= 2 lock-defining classes across the
+    file set — their nodes are qualified with the module name so
+    unrelated same-named hierarchies never share a lock node."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            ci = model._by_file[(filename, node.name)]
+            prefix = (f"{modname}.{node.name}" if node.name in contested
+                      else node.name)
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                kind = _ctor_kind(sub.value)
+                if kind is None:
+                    continue
+                for t in sub.targets:
+                    chain = _attr_chain(t)
+                    if not (chain and chain[0] == "self"
+                            and len(chain[1]) == 1):
+                        continue
+                    attr = chain[1][0]
+                    name = f"{prefix}.{attr}"
+                    ld = LockDef(name, kind, filename, sub.lineno)
+                    # Condition(self._x) aliases the underlying lock
+                    if kind == "condition" and sub.value.args:
+                        ac = _attr_chain(sub.value.args[0])
+                        if ac and ac[0] == "self" and len(ac[1]) == 1:
+                            ld.canonical = f"{prefix}.{ac[1][0]}"
+                    ci.lock_attrs[attr] = ld
+                    model.locks[name] = ld
+        elif isinstance(node, ast.Module):
+            for stmt in node.body:
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                kind = _ctor_kind(stmt.value)
+                if kind is None:
+                    continue
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        name = f"{modname}.{t.id}"
+                        ld = LockDef(name, kind, filename, stmt.lineno)
+                        model.locks[name] = ld
+    # resolve alias chains to a fixpoint (cond over cond is theoretical
+    # but cheap to close)
+    for ld in model.locks.values():
+        seen = set()
+        while ld.canonical in model.locks and \
+                model.locks[ld.canonical].canonical != ld.canonical:
+            if ld.canonical in seen:
+                break
+            seen.add(ld.canonical)
+            ld.canonical = model.locks[ld.canonical].canonical
+
+
+def _iter_comments(source):
+    """(lineno, comment_text) for every REAL comment token — a
+    ``tpu-lock-order`` mention inside a docstring or string literal is
+    prose, not a declaration."""
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in toks:
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return
+
+
+def _collect_order_decls(model, source, filename):
+    if "tpu-lock-order" not in source:
+        return
+    for i, text in _iter_comments(source):
+        if "tpu-lock-order" not in text:
+            continue
+        m = ORDER_RE.search(text)
+        if not m:
+            # a comment that clearly intends a declaration but does not
+            # parse (missing colon, etc.) must not silently be dead
+            model.order_texts.append((text.strip(), filename, i))
+            model.order_decls.append((None, text.strip(), filename, i))
+            continue
+        decl = m.group(1).strip()
+        model.order_texts.append((decl, filename, i))
+        parts = [p.strip() for p in decl.split("<")]
+        if len(parts) < 2 or not all(parts):
+            model.order_decls.append((None, decl, filename, i))
+            continue
+        for a, b in zip(parts, parts[1:]):
+            model.order_decls.append(((a, b), decl, filename, i))
+
+
+def _collect_attr_types(model, tree, filename):
+    """Phase 2 pre-pass: record ``self.X = KnownClass(...)`` so call
+    receivers resolve precisely."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        ci = model._by_file.get((filename, node.name))
+        if ci is None:
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Assign):
+                continue
+            ctor = _ctor_class_in(model, sub.value, prefer_mod=ci.modname)
+            if ctor is None:
+                continue
+            for t in sub.targets:
+                chain = _attr_chain(t)
+                if chain and chain[0] == "self" and len(chain[1]) == 1:
+                    ci.attr_types.setdefault(chain[1][0], ctor)
+
+
+def _walk_functions(model, modname, tree, filename):
+    # module functions (per file: same-named functions in different
+    # files stay distinct, resolution prefers the caller's own file)
+    local_funcs = {}
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fi = FuncInfo(stmt.name, filename, stmt)
+            model.module_funcs.setdefault(stmt.name, []).append(fi)
+            local_funcs[stmt.name] = fi
+            model.functions.append(fi)
+    # class methods
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        ci = model._by_file[(filename, node.name)]
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FuncInfo(f"{node.name}.{stmt.name}", filename, stmt,
+                              cls=ci)
+                ci.methods[stmt.name] = fi
+                model.functions.append(fi)
+    # second pass: extract behaviour (lock defs are all known now)
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _FuncExtractor(model, modname, None,
+                           local_funcs[stmt.name]).visit(stmt)
+        elif isinstance(stmt, ast.ClassDef):
+            ci = model._by_file[(filename, stmt.name)]
+            for sub in stmt.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    _FuncExtractor(model, modname, ci,
+                                   ci.methods[sub.name]).visit(sub)
+
+
+def _resolve_callees(model, fi, ce):
+    """FuncInfos a CallEvent may enter. Precision ladder: a proven
+    receiver class resolves exactly; `self.meth()` resolves through the
+    class; a bare name resolves to a module function; anything else
+    falls back to name-based candidates — except for _GENERIC_METHODS,
+    which collide with dict/socket/Event methods and resolve only when
+    the receiver type is proven."""
+    if ce.target is None:
+        return []
+    parts = ce.target.split(".")
+    if ce.recv_class is not None:
+        cal = model.resolve_method(ce.recv_class, parts[-1])
+        return [cal] if cal is not None else []
+    if ce.recv_is_self and fi.cls is not None:
+        if len(parts) == 2:       # self.meth()
+            cal = model.resolve_method(fi.cls, parts[1])
+            return [cal] if cal is not None else []
+        return []                 # self.attr.meth() with no type hint
+    if len(parts) == 1:
+        if ce.target in _BUILTIN_NAMES:
+            return []
+        cal = model.resolve_module_func(ce.target, from_file=fi.filename)
+        return [cal] if cal is not None else []
+    meth = parts[-1]
+    if meth in _GENERIC_METHODS:
+        return []
+    return model.candidates_for_attr_call(meth)
+
+
+def _fixpoint_all_locks(model):
+    """all_locks(f) = local_locks(f) U all_locks(every resolvable callee),
+    iterated to a fixpoint over the whole file set."""
+    def callees(fi):
+        out = []
+        for ce in fi.calls:
+            out.extend(_resolve_callees(model, fi, ce))
+        return out
+
+    for fi in model.functions:
+        fi.all_locks = set(fi.local_locks)
+    changed = True
+    iters = 0
+    while changed and iters < 50:
+        changed = False
+        iters += 1
+        for fi in model.functions:
+            for cal in callees(fi):
+                if not cal.all_locks <= fi.all_locks:
+                    fi.all_locks |= cal.all_locks
+                    changed = True
+
+
+def _build_edges(model):
+    """Acquisition-order edges held -> acquired, both from direct nested
+    acquisitions and from calls made under a lock into functions that
+    (transitively) acquire more locks."""
+    def add(a, b, filename, line, func):
+        if a == b:
+            return  # same lock class (often literally the same lock)
+        model.edges.setdefault((a, b), (filename, line, func))
+
+    for fi in model.functions:
+        for acq in fi.acquisitions:
+            for h in acq.held:
+                add(h, acq.lock, fi.filename, acq.line, fi.qualname)
+        for ce in fi.calls:
+            if not ce.held or ce.target is None:
+                continue
+            acquired = set()
+            for cal in _resolve_callees(model, fi, ce):
+                acquired |= cal.all_locks
+            for b in acquired:
+                for h in ce.held:
+                    add(h, b, fi.filename, ce.line, fi.qualname)
+
+
+def build_model(sources):
+    """``sources``: iterable of (source_text, filename). Returns the
+    aggregate LockModel with edges and declarations resolved."""
+    model = LockModel()
+    pre = []
+    for source, filename in sources:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            continue  # the AST family already reports TPU000
+        pre.append((tree, filename, source))
+    # module keys: basename (package name for __init__.py); when two
+    # module-lock-defining files still share a key, qualify each with
+    # its parent directory so their lock nodes never merge
+    by_key = {}
+    for tree, filename, _source in pre:
+        if _has_module_locks(tree):
+            by_key.setdefault(_modname_for(filename), []).append(filename)
+    contested_mods = {fn for fns in by_key.values() if len(fns) > 1
+                      for fn in fns}
+    parsed = []
+    for tree, filename, source in pre:
+        modname = (_qualified_modname(filename)
+                   if filename in contested_mods
+                   else _modname_for(filename))
+        parsed.append((modname, tree, filename, source))
+        _register_classes(model, modname, tree, filename)
+    # contested names: >= 2 same-named classes (different files) that
+    # BOTH define locks — only those need module-qualified nodes, so
+    # the common case keeps the ergonomic `ClassName.attr` names
+    owners = {}
+    for modname, tree, filename, _source in parsed:
+        for name in _lock_owners_by_name(tree):
+            owners.setdefault(name, set()).add(filename)
+    contested = {name for name, files in owners.items() if len(files) > 1}
+    for modname, tree, filename, source in parsed:
+        _collect_lock_defs(model, modname, tree, filename, contested)
+        _collect_order_decls(model, source, filename)
+    for modname, tree, filename, _source in parsed:
+        _collect_attr_types(model, tree, filename)
+    for modname, tree, filename, _source in parsed:
+        _walk_functions(model, modname, tree, filename)
+    _fixpoint_all_locks(model)
+    _build_edges(model)
+    return model

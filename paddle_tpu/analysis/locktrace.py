@@ -1,0 +1,377 @@
+"""Runtime lock-order sanitizer (the dynamic complement of the TPU3xx
+static passes).
+
+When enabled, ``threading.Lock`` / ``threading.RLock`` constructions
+return thin wrappers that record, per thread, which locks are held at
+every acquisition and fold those observations into one process-wide
+acquisition-order graph keyed by *lock site* (the ``file:line`` that
+constructed the lock — lockdep-style lock classes, so every
+``BatchingEngine._lock`` instance is one node no matter how many
+engines a test builds). Acquiring B while holding A records the edge
+``A -> B``; if the reverse edge ``B -> A`` was ever observed, that is a
+lock-order **inversion** — two threads interleaving those paths can
+deadlock — and the sanitizer records a violation (and raises, when
+asked to).
+
+This is how the static model in ``lockmodel.py`` is verified against
+reality: the chaos suites and a tier-1 self-check run with the
+sanitizer on, so an invariant like "subsystem lock before instrument
+lock, never reversed" is checked against *observed* runtime behaviour,
+not just the AST.
+
+Usage::
+
+    from paddle_tpu.analysis import locktrace
+    locktrace.enable()            # or PADDLE_TPU_LOCKTRACE=1 + maybe_enable_from_env()
+    ... run threaded code ...
+    locktrace.assert_clean()      # raises on any recorded inversion
+    locktrace.disable()
+
+Env knobs:
+    PADDLE_TPU_LOCKTRACE=1        opt in (maybe_enable_from_env();
+                                  tests/conftest.py calls it, so any
+                                  pytest run inherits the sanitizer)
+    PADDLE_TPU_LOCKTRACE_RAISE=1  raise LockOrderInversion at the
+                                  acquisition that completes an
+                                  inversion (default: record only)
+
+Contract & costs: disabled (the default) is a true no-op — the
+``threading`` factories are untouched, so there is zero overhead and
+zero behaviour change. Enabled, each acquisition costs a thread-local
+list walk; the (one-time) first observation of a new edge additionally
+captures a short stack. Locks created *before* enable() are untracked
+(stdlib import-time locks, jax internals created at import); that is
+fine — the invariants under test live in locks our subsystems create
+after the test session enables tracing. Same-site edges (two instances
+of the same lock class) are ignored rather than reported, trading away
+instance-level cycle detection within one class for zero false
+positives on sibling instruments.
+"""
+import os
+import sys
+import threading
+import traceback
+
+__all__ = ["enable", "disable", "enabled", "reset", "violations",
+           "report", "assert_clean", "maybe_enable_from_env",
+           "LockOrderInversion"]
+
+
+class LockOrderInversion(RuntimeError):
+    """Two lock sites were acquired in both orders — a potential
+    deadlock under the right thread interleaving."""
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.Lock()   # guards the graph (a REAL lock,
+        # created before patching so it is itself untracked)
+        self.edges = {}                # (site_a, site_b) -> witness dict
+        self.violations = []
+        self.sites = set()
+        self.raise_on_inversion = False
+        self.tls = threading.local()   # .held = [(wrapper, count)]
+
+
+_state = _State()
+_enabled = False
+_orig_lock = None
+_orig_rlock = None
+
+
+def _caller_site():
+    """file:line of the frame that constructed the lock — first frame
+    outside this module AND outside stdlib ``threading.py``. Skipping
+    threading matters: a no-arg ``Condition()`` builds its RLock inside
+    threading.py, and naming THAT line would collapse every such
+    condition in the process into one lockdep class (their mutual
+    inversions invisible, their couplings spuriously merged); the
+    user's construction site is the meaningful class."""
+    f = sys._getframe(2)
+    skip = (__file__, threading.__file__)
+    while f is not None and f.f_code.co_filename in skip:
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    fn = f.f_code.co_filename
+    parts = fn.replace("\\", "/").split("/")
+    short = "/".join(parts[-2:]) if len(parts) > 1 else fn
+    return f"{short}:{f.f_lineno}"
+
+
+def _held_list():
+    held = getattr(_state.tls, "held", None)
+    if held is None:
+        held = _state.tls.held = []
+    return held
+
+
+def _purge_cross_thread_releases(held):
+    """Drop held entries whose lock was since released by ANOTHER
+    thread (legal for plain Locks — the one-shot-signal pattern). A
+    stale entry would attach a phantom held-lock to every later
+    acquisition on this thread, eventually recording spurious
+    inversions. The counter is mutated under _state.lock (releases on
+    other threads increment it concurrently; a lost update would leave
+    the phantom alive forever); the unlocked pre-check keeps the
+    common nothing-to-purge path free."""
+    if not any(ent[0]._xrel for ent in held):
+        return
+    with _state.lock:
+        for i in range(len(held) - 1, -1, -1):
+            w = held[i][0]
+            xrel = w._xrel
+            if xrel > 0:
+                take = min(xrel, held[i][1])
+                w._xrel = xrel - take
+                held[i][1] -= take
+                if held[i][1] <= 0:
+                    del held[i]
+
+
+def _note_acquired(wrapper, may_raise=True):
+    if not _enabled:
+        return
+    held = _held_list()
+    _purge_cross_thread_releases(held)
+    for ent in held:
+        if ent[0] is wrapper:
+            ent[1] += 1           # re-entrant (RLock): no new edges
+            return
+    new_site = wrapper._site
+    inversion = None
+    with _state.lock:
+        _state.sites.add(new_site)
+        for ent in held:
+            a = ent[0]._site
+            if a == new_site:
+                continue          # same lock class: sibling instances
+            key = (a, new_site)
+            if key not in _state.edges:
+                _state.edges[key] = {
+                    "thread": threading.current_thread().name,
+                    "stack": "".join(traceback.format_stack(
+                        sys._getframe(2), limit=6)),
+                }
+                rev = _state.edges.get((new_site, a))
+                if rev is not None:
+                    v = {"locks": (a, new_site),
+                         "second": dict(_state.edges[key]),
+                         "first": dict(rev)}
+                    _state.violations.append(v)
+                    inversion = v
+    held.append([wrapper, 1])
+    if inversion is not None and _state.raise_on_inversion and may_raise:
+        # the caller never gets the lock: undo the acquisition before
+        # raising, or the diagnostic converts into a PERMANENTLY held
+        # lock (the escaping raise skips the with-statement's __exit__)
+        held.pop()
+        wrapper._inner.release()
+        raise LockOrderInversion(_format_violation(inversion))
+
+
+def _note_released(wrapper):
+    if not _enabled:
+        return
+    held = getattr(_state.tls, "held", None)
+    if held:
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is wrapper:
+                held[i][1] -= 1
+                if held[i][1] <= 0:
+                    del held[i]
+                return
+    # released by a thread that never acquired it (legal for plain
+    # Locks): note it so the acquirer's stale held entry is purged at
+    # its next acquisition instead of haunting its edge recording
+    # (under _state.lock: += is a read-modify-write racing the purge)
+    with _state.lock:
+        wrapper._xrel += 1
+
+
+class _TracedLock:
+    """Wrapper over one _thread.lock / RLock instance. Forwards the
+    lock protocol (including the ``_release_save`` /
+    ``_acquire_restore`` / ``_is_owned`` trio ``Condition`` uses on
+    RLocks) while keeping the per-thread held list accurate."""
+
+    def __init__(self, inner, site):
+        self._inner = inner
+        self._site = site
+        self._xrel = 0  # releases observed on non-acquiring threads
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _note_acquired(self)
+        return got
+
+    def release(self):
+        self._inner.release()
+        _note_released(self)
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __getattr__(self, name):
+        # forward protocol attributes we don't wrap (_at_fork_reinit,
+        # which concurrent.futures registers with os.register_at_fork;
+        # anything a future stdlib grows) straight to the real lock
+        try:
+            inner = object.__getattribute__(self, "_inner")
+        except AttributeError:
+            raise AttributeError(name)
+        return getattr(inner, name)
+
+    def __repr__(self):
+        return f"<locktrace {self._site} over {self._inner!r}>"
+
+
+class _TracedRLock(_TracedLock):
+    def locked(self):
+        # py3.12 RLock grew locked(); older ones did not — mirror inner
+        inner_locked = getattr(self._inner, "locked", None)
+        return inner_locked() if inner_locked else None
+
+    # Condition integration: it probes for these attributes and, when
+    # present, fully releases/restores the RLock around wait().
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        inner_state = self._inner._release_save()
+        # full release regardless of recursion depth — REMEMBER the
+        # depth, or the restore would track a doubly-held RLock at
+        # count 1 and the outer `with` exit would mark it unheld while
+        # the thread still owns it (silently losing every edge from it
+        # until the real final release)
+        count = 0
+        held = getattr(_state.tls, "held", None)
+        if held:
+            for i in range(len(held) - 1, -1, -1):
+                if held[i][0] is self:
+                    count = held[i][1]
+                    del held[i]
+                    break
+        return (inner_state, count)
+
+    def _acquire_restore(self, state):
+        inner_state, count = state
+        self._inner._acquire_restore(inner_state)
+        # never raise here: Condition.wait() is mid-reacquire and its
+        # caller owns cleanup that assumes the lock is held again
+        _note_acquired(self, may_raise=False)
+        if count > 1:
+            for ent in _held_list():
+                if ent[0] is self:
+                    ent[1] = count
+                    break
+
+
+def _lock_factory():
+    return _TracedLock(_orig_lock(), _caller_site())
+
+
+def _rlock_factory():
+    return _TracedRLock(_orig_rlock(), _caller_site())
+
+
+# ------------------------------------------------------------------- API
+
+
+def enable(raise_on_inversion=None):
+    """Install the tracing factories. Idempotent. ``raise_on_inversion``
+    defaults to the PADDLE_TPU_LOCKTRACE_RAISE env knob (off: record
+    only — test teardown asserts via :func:`assert_clean`)."""
+    global _enabled, _orig_lock, _orig_rlock
+    if raise_on_inversion is None:
+        raise_on_inversion = os.environ.get(
+            "PADDLE_TPU_LOCKTRACE_RAISE", "0") not in ("0", "", "false")
+    _state.raise_on_inversion = bool(raise_on_inversion)
+    if _enabled:
+        return
+    _orig_lock = threading.Lock
+    _orig_rlock = threading.RLock
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    _enabled = True
+
+
+def disable():
+    """Restore the original factories. Locks created while enabled keep
+    working (their wrappers just stop recording)."""
+    global _enabled
+    if not _enabled:
+        return
+    threading.Lock = _orig_lock
+    threading.RLock = _orig_rlock
+    _enabled = False
+
+
+def enabled():
+    return _enabled
+
+
+def maybe_enable_from_env():
+    """Enable iff PADDLE_TPU_LOCKTRACE=1 (the opt-in the chaos suites
+    and the ci_gate --concurrency smoke use). Returns enabled()."""
+    if os.environ.get("PADDLE_TPU_LOCKTRACE", "0") not in ("0", "",
+                                                           "false"):
+        enable()
+    return _enabled
+
+
+def reset():
+    """Drop the recorded graph and violations (held sets are per-thread
+    state and survive — they reflect locks actually held right now)."""
+    with _state.lock:
+        _state.edges.clear()
+        _state.violations.clear()
+        _state.sites.clear()
+
+
+def violations():
+    with _state.lock:
+        return list(_state.violations)
+
+
+def _format_violation(v):
+    a, b = v["locks"]
+    return (f"lock-order inversion: {a} and {b} acquired in both "
+            f"orders.\n  {b} -> {a} first observed on thread "
+            f"{v['first']['thread']}:\n{v['first']['stack']}"
+            f"  {a} -> {b} then observed on thread "
+            f"{v['second']['thread']}:\n{v['second']['stack']}")
+
+
+def report():
+    """JSON-able summary: sites seen, edges observed, violations."""
+    with _state.lock:
+        return {
+            "enabled": _enabled,
+            "sites": sorted(_state.sites),
+            "edges": sorted(f"{a} -> {b}" for a, b in _state.edges),
+            "violations": [
+                {"locks": list(v["locks"]),
+                 "first_thread": v["first"]["thread"],
+                 "second_thread": v["second"]["thread"]}
+                for v in _state.violations],
+        }
+
+
+def assert_clean():
+    """Raise LockOrderInversion if any inversion was recorded (the
+    chaos-suite teardown contract)."""
+    vs = violations()
+    if vs:
+        raise LockOrderInversion(
+            f"{len(vs)} lock-order inversion(s) recorded:\n\n"
+            + "\n\n".join(_format_violation(v) for v in vs))

@@ -72,8 +72,9 @@ def check_op(name, fn, static_kwarg_names=()):
                 filename=filename, line=line, func=name))
 
     # TPU203 — float64 in the implementation (code only: the docstring
-    # and pure comments are prose, and a `# tracelint: disable=TPU203`
-    # directive — not the mere word "tracelint" — suppresses the line)
+    # and pure comments are prose, and an inline tracelint disable
+    # directive for TPU203 — not the mere word "tracelint" — suppresses
+    # the line)
     try:
         src = inspect.getsource(fn)
     except (OSError, TypeError):

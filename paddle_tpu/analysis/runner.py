@@ -9,16 +9,17 @@ import os
 
 from . import ast_checks, registry_checks
 from .diagnostics import (Diagnostic, SuppressionIndex, filter_diagnostics,
-                          format_json, format_text)
+                          format_json, format_text, sort_key)
 
 __all__ = ["lint_source", "lint_file", "lint_paths", "lint_function",
-           "lint_registry", "LintResult"]
+           "lint_registry", "lint_concurrency", "LintResult"]
 
 
 class LintResult:
-    def __init__(self, diagnostics, files_scanned=0):
+    def __init__(self, diagnostics, files_scanned=0, timings=None):
         self.diagnostics = diagnostics
         self.files_scanned = files_scanned
+        self.timings = timings  # {pass_group: seconds} or None
 
     @property
     def errors(self):
@@ -30,7 +31,7 @@ class LintResult:
 
     def format(self, fmt="text"):
         if fmt == "json":
-            return format_json(self.diagnostics)
+            return format_json(self.diagnostics, timings=self.timings)
         return format_text(self.diagnostics)
 
 
@@ -113,3 +114,33 @@ def lint_registry(ops=None, disabled=()):
     """Registry pass family over the live op registry."""
     return LintResult(filter_diagnostics(
         registry_checks.check_registry(ops), disabled=disabled))
+
+
+def lint_concurrency(paths, disabled=()):
+    """Concurrency pass family (TPU3xx) over files/packages.
+
+    Unlike the per-file AST passes, every .py file under ``paths`` is
+    analysed as ONE lock model: acquisition-order edges and
+    ``tpu-lock-order`` declarations resolve across files (the engine
+    lock -> instrument lock edge spans inference/ and obs/). Inline
+    suppression still applies per file/line."""
+    from . import concurrency
+
+    sources = []
+    for path in _iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                sources.append((f.read(), path))
+        except OSError:
+            continue
+    diags = concurrency.check_sources(sources)
+    suppression = {fn: SuppressionIndex(src) for src, fn in sources}
+    by_file = {}
+    for d in diags:
+        by_file.setdefault(d.filename, []).append(d)
+    out = []
+    for fn, group in by_file.items():
+        out.extend(filter_diagnostics(group, disabled=disabled,
+                                      suppression=suppression.get(fn)))
+    return LintResult(sorted(out, key=sort_key),
+                      files_scanned=len(sources))

@@ -69,6 +69,13 @@ _MAX_BYTES = int(os.environ.get("PADDLE_P2P_MAX_BYTES",
 _lock = threading.Lock()
 _transport = None
 
+# Machine-checked lock order (tools/tracelint.py --concurrency, TPU309):
+# the module singleton lock is outermost (get_transport/shutdown);
+# inside the transport, the outbound-cache lock orders before each
+# queue's condition (delivery touches queues while routing).
+# tpu-lock-order: p2p._lock < Transport._out_lock  # shutdown closes the cache under the singleton lock
+# tpu-lock-order: Transport._queues_lock < _Queue._cv  # gap delivery enqueues under the routing lock
+
 
 def _recv_exact(sock, n):
     buf = bytearray()

@@ -47,12 +47,23 @@ class AsyncCommunicator:
     def flush(self):
         """Block until every queued push has reached the PS. Raises (and
         clears) any error the sender thread hit, so a recovered PS can
-        keep being used."""
+        keep being used. The wait is bounded in 1s slices that re-check
+        the sender thread's liveness: a dead sender (TPU303's hazard —
+        a waiter nothing will ever notify) surfaces as an error instead
+        of hanging this caller forever."""
         if self.sync:
             return
         with self._cv:
-            self._cv.wait_for(lambda: self._inflight == 0 and
-                              self._q.empty())
+            while not self._cv.wait_for(
+                    lambda: self._inflight == 0 and self._q.empty(),
+                    timeout=1.0):
+                if self._thread is not None and \
+                        not self._thread.is_alive():
+                    if not self._exc:
+                        self._exc = RuntimeError(
+                            "AsyncCommunicator sender thread died with "
+                            f"{self._inflight} push(es) in flight")
+                    break
         if self._exc:
             exc, self._exc = self._exc, None
             raise exc

@@ -98,6 +98,15 @@ from ..obs.ledger import LEDGER
 from ..resilience import chaos
 from ..resilience.retry import _env_float, _env_int
 
+# Machine-checked lock order (tools/tracelint.py --concurrency, TPU309):
+# the engine lock is the SUBSYSTEM lock; obs instrument and registry
+# locks nest strictly inside it, never the reverse — otherwise metrics
+# exposition could deadlock the serving hot path. These declarations
+# turn the prose invariant from obs/metrics.py's docstring into a
+# gated check.
+# tpu-lock-order: BatchingEngine._lock < Metric._lock  # subsystem -> instrument
+# tpu-lock-order: BatchingEngine._lock < Registry._lock  # collectors run OUTSIDE the registry lock
+
 # Wire status byte for a shed request (server.py speaks it; defined here
 # so the engine has no import-time dependency on the server).
 OVERLOADED_STATUS = 2
@@ -784,7 +793,11 @@ class BatchingEngine:
 
     def _scheduler_loop(self, gen):
         while True:
-            self._heartbeat = time.monotonic()
+            # unguarded on purpose: a single f64 store is GIL-atomic, the
+            # value is monotonic, and the watchdog only compares it to a
+            # staleness threshold — a lock here would put one acquisition
+            # on every scheduler iteration for no correctness gain
+            self._heartbeat = time.monotonic()  # tpu-lint: disable=TPU305  # benign race: GIL-atomic monotonic bump
             group = self._next_group(gen)
             if group is None:
                 return  # closed and drained, or superseded by a restart
@@ -923,7 +936,11 @@ class BatchingEngine:
                 if not self._pending:
                     if self._closed:
                         return None
-                    self._cond.wait()  # a submit/close/restart notifies
+                    # every producer of work notifies: submit, close and
+                    # restart all notify_all under this same condition —
+                    # an idle scheduler parked here is woken by ANY
+                    # state change it could act on
+                    self._cond.wait()  # tpu-lint: disable=TPU303  # all three wake sources notify_all under _cond
                     continue
                 head = self._pending[0]
                 group, rows = [], 0
@@ -1127,7 +1144,7 @@ class BatchingEngine:
             # self._scheduler must never join() a not-yet-started
             # thread (RuntimeError). The new thread just parks on this
             # same lock until we release it.
-            t.start()
+            t.start()  # tpu-lint: disable=TPU304  # load-bearing: close() must never join an unstarted thread
             self._cond.notify_all()  # a superseded thread parked in wait()
         if stranded:
             err = SchedulerRestarted(
@@ -1189,7 +1206,9 @@ class BatchingEngine:
                         f"still in flight after cold_compile_timeout="
                         f"{limit}s; retry later")
                 elif limit <= 0:
-                    ev.wait()
+                    # cold_compile_timeout=0 is the operator explicitly
+                    # disabling the bound; honour it
+                    ev.wait()  # tpu-lint: disable=TPU303  # unbounded wait is the documented timeout-disabled mode
                 continue
             try:
                 chaos.hit("serving.compile")

@@ -214,7 +214,10 @@ class Predictor:
                     kw[k] = db[k]
             engine = BatchingEngine.for_layer(self._layer, **kw)
             if warmup:
-                engine.warmup(warmup_buckets)
+                # a clone racing this attach must block until ONE fully
+                # warmed engine is published, not build (and compile) a
+                # second engine for the same layer
+                engine.warmup(warmup_buckets)  # tpu-lint: disable=TPU302  # intentional warmup under the attach lock
             self._layer._batch_engine = engine
             self._layer._batch_engine_owned = True
             return engine

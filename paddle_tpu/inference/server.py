@@ -73,6 +73,15 @@ STATUS_OK = 0
 STATUS_ERROR = 1
 STATUS_OVERLOADED = RetryableError.status_code  # 2
 
+# Machine-checked lock order (tools/tracelint.py --concurrency, TPU309):
+# one reload at a time (coarse, dedicated) > the backend swap lock (held
+# only for the pointer swap) > the engine's own lock. The serving path
+# (_handle/_infer) takes _backend_lock alone, so reload's long
+# load+warmup never stalls a request.
+# tpu-lock-order: PredictorServer._reload_lock < PredictorServer._backend_lock  # swap happens inside a reload
+# tpu-lock-order: PredictorServer._reload_lock < BatchingEngine._lock  # reload warms/closes engines
+# tpu-lock-order: PredictorServer._backend_lock < Metric._lock  # counters bump under the swap lock
+
 # Optional trailing field markers on cmd-1 infer bodies. A marker byte
 # (not bare trailing bytes) so garbage tails can't be misread as a
 # field; fields may appear in any order, each marker at most once.
@@ -352,8 +361,11 @@ class PredictorServer:
                                 if old_engine is not None else None)
                     # warm the same buckets the old engine declared (or
                     # the full power-of-2 ladder) before any request can
-                    # see the new engine
-                    warmed = new_engine.warmup(declared or None)
+                    # see the new engine. The reload lock is dedicated
+                    # (one reload at a time) and requests keep flowing
+                    # under _backend_lock the whole time — holding it
+                    # across the multi-second warmup stalls nobody.
+                    warmed = new_engine.warmup(declared or None)  # tpu-lint: disable=TPU302  # dedicated coarse lock; serving path never takes it
                 with self._backend_lock:
                     if self._stop.is_set():
                         # stop() closed the serving engine while we were

@@ -34,6 +34,13 @@ import math
 import re
 import threading
 
+# Machine-checked lock order (tools/tracelint.py --concurrency, TPU309):
+# registration may hold the registry lock while touching instruments,
+# but instrument code must NEVER call back into the registry while
+# holding its own lock — the reverse edge is the exposition-deadlock
+# this module's docstring argues can't happen. Now it is checked.
+# tpu-lock-order: Registry._lock < Metric._lock  # instruments never re-enter the registry
+
 _METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 _RESERVED_LABELS = frozenset({"le", "quantile"})

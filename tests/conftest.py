@@ -36,6 +36,35 @@ if _CACHE_DIR != "0":
 
 import pytest  # noqa: E402
 
+# Opt-in runtime lock-order sanitizer (PR 8): with PADDLE_TPU_LOCKTRACE=1
+# every threading.Lock/RLock the suite creates from here on records its
+# per-thread acquisition order, and an A->B / B->A inversion is recorded
+# as a violation (tests/test_locktrace.py asserts cleanliness around the
+# engine + chaos scenarios; tools/ci_gate.py --concurrency runs that
+# file with the knob set). The module is loaded STANDALONE (stdlib-only
+# file, registered under its canonical name so the later package import
+# binds this same instance) — importing it through paddle_tpu.analysis
+# would execute the whole paddle_tpu __init__ first and create the
+# import-time subsystem locks (the global obs Registry, tracing,
+# goodput, ledger) with the stock factory, untraced.
+if os.environ.get("PADDLE_TPU_LOCKTRACE", "0") not in ("0", "", "false"):
+    import importlib.util
+    import sys as _sys
+
+    _lt_name = "paddle_tpu.analysis.locktrace"
+    if _lt_name in _sys.modules:
+        _locktrace = _sys.modules[_lt_name]
+    else:
+        _lt_spec = importlib.util.spec_from_file_location(
+            _lt_name,
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+                "paddle_tpu", "analysis", "locktrace.py"))
+        _locktrace = importlib.util.module_from_spec(_lt_spec)
+        _sys.modules[_lt_name] = _locktrace
+        _lt_spec.loader.exec_module(_locktrace)
+    _locktrace.enable()
+
 
 @pytest.fixture(autouse=True)
 def _seed():

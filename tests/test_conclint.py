@@ -1,0 +1,764 @@
+"""Concurrency lint (TPU301–TPU310, paddle_tpu.analysis.concurrency):
+every code fires on a minimal bad fixture and stays silent on the
+disciplined rewrite, the lock model resolves aliases/inheritance/
+interprocedural edges, and the repo-wide self-check keeps paddle_tpu
+clean (mirroring tests/test_tracelint.py)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu.analysis import CODES, concurrency, lockmodel
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRACELINT = os.path.join(REPO, "tools", "tracelint.py")
+
+
+def lint(src, filename="mod.py"):
+    return concurrency.check_sources([(src, filename)])
+
+
+def codes_of(diags):
+    return {d.code for d in diags}
+
+
+# ------------------------------------------------------------ per-pass pairs
+# one (bad, good) fixture pair per code
+
+CASES = {
+    # deliberate A->B / B->A deadlock cycle
+    "TPU301": (
+        """
+import threading
+class Eng:
+    def __init__(self):
+        self._la = threading.Lock()
+        self._lb = threading.Lock()
+    def one(self):
+        with self._la:
+            with self._lb:
+                pass
+    def two(self):
+        with self._lb:
+            with self._la:
+                pass
+""",
+        """
+import threading
+class Eng:
+    def __init__(self):
+        self._la = threading.Lock()
+        self._lb = threading.Lock()
+    def one(self):
+        with self._la:
+            with self._lb:
+                pass
+    def two(self):
+        with self._la:
+            with self._lb:
+                pass
+""",
+    ),
+    # blocking join under a lock
+    "TPU302": (
+        """
+import threading
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run)
+    def _run(self):
+        pass
+    def stop(self):
+        with self._lock:
+            self._thread.join()
+""",
+        """
+import threading
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run)
+    def _run(self):
+        pass
+    def stop(self):
+        with self._lock:
+            t = self._thread
+        t.join()
+""",
+    ),
+    # timeout-less wait
+    "TPU303": (
+        """
+import threading
+class W:
+    def __init__(self):
+        self._cv = threading.Condition()
+    def take(self):
+        with self._cv:
+            self._cv.wait()
+""",
+        """
+import threading
+class W:
+    def __init__(self):
+        self._cv = threading.Condition()
+    def take(self):
+        with self._cv:
+            self._cv.wait(1.0)
+""",
+    ),
+    # Thread.start() under a lock
+    "TPU304": (
+        """
+import threading
+class T:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def restart(self):
+        t = threading.Thread(target=self.restart)
+        with self._lock:
+            t.start()
+""",
+        """
+import threading
+class T:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def restart(self):
+        t = threading.Thread(target=self.restart)
+        with self._lock:
+            pass
+        t.start()
+""",
+    ),
+    # unguarded shared write from two thread-entry roots
+    "TPU305": (
+        """
+import threading
+class H:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = 0
+        threading.Thread(target=self._worker).start()
+        threading.Thread(target=self._monitor).start()
+    def _worker(self):
+        self.state = 1
+    def _monitor(self):
+        self.state = 2
+""",
+        """
+import threading
+class H:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = 0
+        threading.Thread(target=self._worker).start()
+        threading.Thread(target=self._monitor).start()
+    def _worker(self):
+        with self._lock:
+            self.state = 1
+    def _monitor(self):
+        with self._lock:
+            self.state = 2
+""",
+    ),
+    # release() not in finally
+    "TPU306": (
+        """
+import threading
+class R:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def step(self):
+        self._lock.acquire()
+        do_work()
+        self._lock.release()
+""",
+        """
+import threading
+class R:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def step(self):
+        self._lock.acquire()
+        try:
+            do_work()
+        finally:
+            self._lock.release()
+""",
+    ),
+    # callback invoked under the owning lock
+    "TPU307": (
+        """
+import threading
+class Reg:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._collectors = []
+    def collect(self):
+        with self._lock:
+            for fn in self._collectors:
+                fn()
+""",
+        """
+import threading
+class Reg:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._collectors = []
+    def collect(self):
+        with self._lock:
+            fns = list(self._collectors)
+        for fn in fns:
+            fn()
+""",
+    ),
+    # annotation naming an unknown lock
+    "TPU308": (
+        """
+import threading
+# tpu-lock-order: Reg._lock < Nope._lock
+class Reg:
+    def __init__(self):
+        self._lock = threading.Lock()
+""",
+        """
+import threading
+# tpu-lock-order: Reg._lock < Reg._inner
+class Reg:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inner = threading.Lock()
+""",
+    ),
+    # observed order contradicting a declaration
+    "TPU309": (
+        """
+import threading
+# tpu-lock-order: O._outer < O._inner
+class O:
+    def __init__(self):
+        self._outer = threading.Lock()
+        self._inner = threading.Lock()
+    def bad(self):
+        with self._inner:
+            with self._outer:
+                pass
+""",
+        """
+import threading
+# tpu-lock-order: O._outer < O._inner
+class O:
+    def __init__(self):
+        self._outer = threading.Lock()
+        self._inner = threading.Lock()
+    def good(self):
+        with self._outer:
+            with self._inner:
+                pass
+""",
+    ),
+    # declarations forming a cycle
+    "TPU310": (
+        """
+import threading
+# tpu-lock-order: C._a < C._b
+# tpu-lock-order: C._b < C._c
+# tpu-lock-order: C._c < C._a
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._c = threading.Lock()
+""",
+        """
+import threading
+# tpu-lock-order: C._a < C._b
+# tpu-lock-order: C._b < C._c
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._c = threading.Lock()
+""",
+    ),
+}
+
+
+@pytest.mark.parametrize("code", sorted(CASES))
+def test_code_fires_on_bad_fixture(code):
+    bad, _good = CASES[code]
+    assert code in codes_of(lint(bad)), f"{code} did not fire:\n{bad}"
+
+
+@pytest.mark.parametrize("code", sorted(CASES))
+def test_code_silent_on_disciplined_rewrite(code):
+    _bad, good = CASES[code]
+    assert code not in codes_of(lint(good)), \
+        f"{code} false-positive on the rewrite:\n{good}"
+
+
+def test_all_ten_codes_documented():
+    for i in range(301, 311):
+        assert f"TPU{i}" in CODES
+
+
+# --------------------------------------------------------------- lock model
+
+
+def test_condition_over_lock_aliases_to_one_node():
+    """Condition(self._lock) IS the lock: acquiring via the condition
+    and via the lock must not look like two different locks (no
+    self-cycle, and declarations written against the lock name apply)."""
+    src = """
+import threading
+class E:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+    def a(self):
+        with self._cond:
+            pass
+    def b(self):
+        with self._lock:
+            pass
+"""
+    model = lockmodel.build_model([(src, "e.py")])
+    ld = model.locks["E._cond"]
+    assert ld.canonical == "E._lock"
+    assert lint(src) == []
+
+
+def test_interprocedural_cycle_detected():
+    """The cycle spans two methods and a helper on each side."""
+    src = """
+import threading
+class I:
+    def __init__(self):
+        self._la = threading.Lock()
+        self._lb = threading.Lock()
+    def _take_a(self):
+        with self._la:
+            pass
+    def _take_b(self):
+        with self._lb:
+            pass
+    def one(self):
+        with self._la:
+            self._take_b()
+    def two(self):
+        with self._lb:
+            self._take_a()
+"""
+    assert "TPU301" in codes_of(lint(src))
+
+
+def test_inherited_lock_resolves_through_base_class():
+    """`with self._lock` in a subclass method maps to the BASE class's
+    lock node (the Metric/Counter pattern)."""
+    src = """
+import threading
+class Base:
+    def __init__(self):
+        self._lock = threading.Lock()
+class Child(Base):
+    def inc(self):
+        with self._lock:
+            pass
+class Holder:
+    def __init__(self):
+        self._big = threading.Lock()
+        self._m = Child()
+    def bump(self):
+        with self._big:
+            self._m.inc()
+"""
+    model = lockmodel.build_model([(src, "i.py")])
+    assert ("Holder._big", "Base._lock") in model.edges
+
+
+def test_generic_method_names_do_not_fabricate_edges():
+    """`self._cache.get(k)` under a lock is dict.get, not some class's
+    lock-taking `get` — no edge, no cycle."""
+    src = """
+import threading
+class Q:
+    def __init__(self):
+        self._cv = threading.Condition()
+    def get(self):
+        with self._cv:
+            return 1
+class User:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cache = {}
+    def hit(self):
+        with self._lock:
+            return self._cache.get("k")
+"""
+    model = lockmodel.build_model([(src, "g.py")])
+    assert ("User._lock", "Q._cv") not in model.edges
+
+
+def test_typed_receiver_still_resolves_generic_name():
+    """A receiver proven by ctor assignment resolves precisely even for
+    a generic method name (the p2p `q = ...; q.put(...)` pattern)."""
+    src = """
+import threading
+class Q:
+    def __init__(self):
+        self._cv = threading.Condition()
+    def put(self, x):
+        with self._cv:
+            pass
+class Router:
+    def __init__(self):
+        self._routes_lock = threading.Lock()
+        self._routes = {}
+    def deliver(self, k, item):
+        with self._routes_lock:
+            q = self._routes.setdefault(k, Q())
+            q.put(item)
+"""
+    model = lockmodel.build_model([(src, "t.py")])
+    assert ("Router._routes_lock", "Q._cv") in model.edges
+
+
+def test_semaphore_cross_thread_release_not_flagged():
+    """Producer/consumer slot accounting releases on a different thread
+    than the acquirer — no finally pairing exists, and TPU306 must not
+    demand one (the DataLoader prefetch pattern)."""
+    src = """
+import threading
+class P:
+    def __init__(self):
+        self._slots = threading.Semaphore(2)
+    def fill(self):
+        self._slots.acquire()
+    def take(self):
+        self._slots.release()
+"""
+    assert "TPU306" not in codes_of(lint(src))
+
+
+def test_module_level_lock_names_use_module_prefix():
+    src = """
+import threading
+_lock = threading.Lock()
+# tpu-lock-order: singleton._lock < T._inner
+class T:
+    def __init__(self):
+        self._inner = threading.Lock()
+    def go(self):
+        with self._inner:
+            with _lock:
+                pass
+"""
+    diags = lint(src, filename="pkg/singleton.py")
+    assert "TPU309" in codes_of(diags)
+
+
+def test_declaration_may_name_a_condition_alias():
+    """`Eng._cond = Condition(self._lock)`: declaring against the
+    CONDITION name — the one every acquisition site uses — must
+    canonicalise, not die as TPU308, and must still catch the
+    inversion."""
+    src = """
+import threading
+# tpu-lock-order: Eng._cond < Eng._other
+class Eng:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._other = threading.Lock()
+    def bad(self):
+        with self._other:
+            with self._cond:
+                pass
+"""
+    codes = codes_of(lint(src))
+    assert "TPU308" not in codes
+    assert "TPU309" in codes
+
+
+def test_declared_order_is_transitive():
+    """a < b and b < c declared; an observed c -> a edge violates the
+    closure even though a < c was never written."""
+    src = """
+import threading
+# tpu-lock-order: T._a < T._b
+# tpu-lock-order: T._b < T._c
+class T:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._c = threading.Lock()
+    def bad(self):
+        with self._c:
+            with self._a:
+                pass
+"""
+    assert "TPU309" in codes_of(lint(src))
+
+
+def test_same_named_classes_in_different_files_do_not_merge():
+    """The repo really has two `class Metric` (obs/metrics.py and
+    metric/__init__.py). A subclass of the LOCK-FREE one must not
+    resolve `self._lock` to the other hierarchy's node and trip a
+    declared order it never touches."""
+    obs_src = """
+import threading
+# tpu-lock-order: Holder._big < Metric._lock
+class Metric:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def inc(self):
+        with self._lock:
+            pass
+class Holder:
+    def __init__(self):
+        self._big = threading.Lock()
+        self._m = Metric()
+    def bump(self):
+        with self._big:
+            self._m.inc()
+"""
+    eval_src = """
+import threading
+class Metric:
+    def update(self, x):
+        return x
+class Accuracy(Metric):
+    def __init__(self):
+        self._lock = threading.Lock()
+    def compute(self):
+        with self._lock:
+            with self._anything_lock:
+                pass
+"""
+    model = lockmodel.build_model([(obs_src, "pkg/obsmetrics.py"),
+                                   (eval_src, "pkg/evalmetric.py")])
+    # two independent ClassInfos, one lock-owning Metric -> the node
+    # keeps its ergonomic bare name
+    assert len(model.class_index["Metric"]) == 2
+    assert "Metric._lock" in model.locks
+    # Accuracy's lock resolves via ITS OWN file's (lock-free) Metric
+    # base, landing on Accuracy._lock — never the obs node
+    assert "Accuracy._lock" in model.locks
+    diags = concurrency.check_sources([(obs_src, "pkg/obsmetrics.py"),
+                                       (eval_src, "pkg/evalmetric.py")])
+    assert "TPU309" not in codes_of(diags)
+
+
+def test_colliding_lock_owners_get_module_qualified_nodes():
+    """When same-named classes in different files BOTH own locks, the
+    nodes are module-qualified so the hierarchies never share one."""
+    a = "import threading\nclass M:\n    def __init__(self):\n" \
+        "        self._lock = threading.Lock()\n"
+    b = "import threading\nclass M:\n    def __init__(self):\n" \
+        "        self._lock = threading.Lock()\n"
+    model = lockmodel.build_model([(a, "p/alpha.py"), (b, "p/beta.py")])
+    assert "alpha.M._lock" in model.locks
+    assert "beta.M._lock" in model.locks
+    assert "M._lock" not in model.locks
+
+
+def test_package_inits_get_distinct_module_lock_nodes():
+    """Two __init__.py files with module locks must not collide on the
+    meaningless key '__init__' — each takes its package name."""
+    a = "import threading\n_LOCK = threading.Lock()\n"
+    b = "import threading\n_LOCK = threading.Lock()\n"
+    model = lockmodel.build_model([(a, "pkg/native/__init__.py"),
+                                   (b, "pkg/obs/__init__.py")])
+    assert "native._LOCK" in model.locks
+    assert "obs._LOCK" in model.locks
+    assert "__init__._LOCK" not in model.locks
+
+
+def test_same_basename_module_locks_get_qualified_nodes():
+    a = "import threading\n_lock = threading.Lock()\n"
+    b = "import threading\n_lock = threading.Lock()\n"
+    model = lockmodel.build_model([(a, "serving/util.py"),
+                                   (b, "train/util.py")])
+    assert "serving.util._lock" in model.locks
+    assert "train.util._lock" in model.locks
+    assert "util._lock" not in model.locks
+
+
+def test_bare_call_resolves_same_file_function_first():
+    """File A's `helper()` must never enter file B's unrelated
+    lock-acquiring `helper` — a cross-package false edge would fail the
+    strict gate on code with no ordering relation."""
+    a = """
+import threading
+_la = threading.Lock()
+def helper():
+    pass
+def caller():
+    with _la:
+        helper()
+"""
+    b = """
+import threading
+_lb = threading.Lock()
+def helper():
+    with _lb:
+        pass
+"""
+    model = lockmodel.build_model([(a, "p/afile.py"), (b, "p/bfile.py")])
+    assert ("afile._la", "bfile._lb") not in model.edges
+
+
+def test_docstring_suppression_mention_does_not_suppress():
+    """A docstring in the first five lines that DOCUMENTS the directive
+    syntax must not become a live file-level suppression (the audit is
+    tokenize-based and could never see it — nothing invisible to the
+    audit may suppress)."""
+    from paddle_tpu.analysis.diagnostics import (SuppressionIndex,
+                                                 filter_diagnostics)
+
+    src = ('"""Helpers.\n'
+           "\n"
+           "# tpu-lint: disable=TPU303\n"
+           '"""\n'
+           "import threading\n"
+           "class W:\n"
+           "    def __init__(self):\n"
+           "        self._cv = threading.Condition()\n"
+           "    def take(self):\n"
+           "        with self._cv:\n"
+           "            self._cv.wait()\n")
+    diags = filter_diagnostics(lint(src),
+                               suppression=SuppressionIndex(src))
+    assert "TPU303" in codes_of(diags)
+
+
+def test_docstring_mention_is_not_a_declaration():
+    src = '''
+import threading
+def f():
+    """Prose about `# tpu-lock-order: A < B` syntax is not a decl."""
+    return 1
+'''
+    assert codes_of(lint(src)) == set()
+
+
+def test_tpu_lint_inline_suppression_clears_finding():
+    bad, _ = CASES["TPU303"]
+    suppressed = bad.replace(
+        "self._cv.wait()",
+        "self._cv.wait()  # tpu-lint: disable=TPU303  # provably notified")
+    from paddle_tpu.analysis.diagnostics import (SuppressionIndex,
+                                                 filter_diagnostics)
+
+    diags = filter_diagnostics(lint(suppressed),
+                               suppression=SuppressionIndex(suppressed))
+    assert "TPU303" not in codes_of(diags)
+
+
+def test_path_and_str_join_under_lock_not_flagged():
+    """os.path.join / sep.join share the `.join` name with Thread.join;
+    only a receiver PROVEN to be a thread fires TPU302."""
+    src = """
+import os
+import threading
+class J:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def build(self, parts):
+        with self._lock:
+            p = os.path.join("a", "b")
+            s = ",".join(parts)
+        return p, s
+"""
+    assert "TPU302" not in codes_of(lint(src))
+
+
+def test_thread_join_via_local_alias_still_flagged():
+    """`t = self._thread; t.join()` under a lock: the local inherits the
+    attribute's proven threading.Thread type."""
+    src = """
+import threading
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run)
+    def _run(self):
+        pass
+    def stop(self):
+        with self._lock:
+            t = self._thread
+            t.join()
+"""
+    assert "TPU302" in codes_of(lint(src))
+
+
+def test_wait_for_without_timeout_is_flagged():
+    """wait_for's predicate is mandatory — one arg is NOT a timeout."""
+    bad = """
+import threading
+class W:
+    def __init__(self):
+        self._cv = threading.Condition()
+    def take(self):
+        with self._cv:
+            self._cv.wait_for(lambda: True)
+"""
+    assert "TPU303" in codes_of(lint(bad))
+    good = bad.replace("wait_for(lambda: True)",
+                       "wait_for(lambda: True, 1.0)")
+    assert "TPU303" not in codes_of(lint(good))
+
+
+def test_wait_on_other_lock_while_held_is_blocking():
+    """ev.wait() while holding an unrelated lock parks the thread with
+    the lock held — TPU302 (the engine releases before ev.wait)."""
+    src = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+    def bad(self):
+        with self._lock:
+            self._done.wait(1.0)
+"""
+    assert "TPU302" in codes_of(lint(src))
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def run_cli(*args):
+    return subprocess.run([sys.executable, TRACELINT, *args],
+                          capture_output=True, text=True, cwd=REPO)
+
+
+def test_cli_concurrency_flag_and_json_schema(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(CASES["TPU301"][0])
+    r = run_cli(str(bad), "--concurrency", "--format", "json")
+    blob = json.loads(r.stdout)
+    assert blob["schema_version"] >= 2
+    assert "concurrency" in blob["timings_s"] and "ast" in blob["timings_s"]
+    assert any(f["code"] == "TPU301" for f in blob["findings"])
+    assert r.returncode == 1  # TPU301 is error severity
+    # without the flag the TPU3xx group does not run
+    r2 = run_cli(str(bad), "--format", "json")
+    blob2 = json.loads(r2.stdout)
+    assert not any(f["code"].startswith("TPU3") for f in blob2["findings"])
+
+
+def test_self_check_paddle_tpu_concurrency_clean():
+    """The acceptance bar: zero unsuppressed TPU3xx findings of ANY
+    severity over paddle_tpu/ (every waiver is inline-annotated with a
+    justification, which the ci_gate audit enforces)."""
+    r = run_cli(os.path.join(REPO, "paddle_tpu"), "--concurrency",
+                "--format", "json")
+    blob = json.loads(r.stdout)
+    tpu3 = [f for f in blob["findings"] if f["code"].startswith("TPU3")]
+    assert tpu3 == [], json.dumps(tpu3, indent=2)[-4000:]
+    assert r.returncode == 0, r.stdout[-4000:]

@@ -135,6 +135,116 @@ def test_inference_is_a_default_clean_path():
     assert "paddle_tpu/inference" in mod.DEFAULT_CLEAN_PATHS
     assert "paddle_tpu/resilience" in mod.DEFAULT_CLEAN_PATHS
     assert "paddle_tpu/obs" in mod.DEFAULT_CLEAN_PATHS
+    assert "paddle_tpu/analysis" in mod.DEFAULT_CLEAN_PATHS
+
+
+# --------------------------------------- concurrency stage + audit policy
+
+DEADLOCK_SRC = """
+import threading
+class Eng:
+    def __init__(self):
+        self._la = threading.Lock()
+        self._lb = threading.Lock()
+    def one(self):
+        with self._la:
+            with self._lb:
+                pass
+    def two(self):
+        with self._lb:
+            with self._la:
+                pass
+"""
+
+
+def test_concurrency_stage_gates(tmp_path):
+    ok_test = tmp_path / "test_smoke_ok.py"
+    ok_test.write_text("def test_ok():\n    assert True\n")
+    lt_args = f"{ok_test} -q -p no:cacheprovider"
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(DEADLOCK_SRC)
+    r = _run(["--paths", str(bad), "--skip-tests", "--concurrency",
+              "--locktrace-args", lt_args])
+    assert r.returncode == 1
+    s = _summary(r)
+    assert s["concurrency_run"] and not s["concurrency_ok"]
+    assert s["concurrency_tpu3xx"] >= 1
+    assert "+concurrency" in s["gate"]
+    assert "TPU301" in r.stdout
+
+    good = tmp_path / "good.py"
+    good.write_text(GOOD_SRC)
+    r = _run(["--paths", str(good), "--skip-tests", "--concurrency",
+              "--locktrace-args", lt_args])
+    assert r.returncode == 0, r.stdout + r.stderr
+    s = _summary(r)
+    assert s["concurrency_ok"] and s["locktrace_ok"]
+    assert s["concurrency_tpu3xx"] == 0
+
+
+def test_concurrency_stage_fails_on_locktrace_smoke(tmp_path):
+    """A red locktrace smoke fails the stage even when the static
+    passes are clean."""
+    good = tmp_path / "good.py"
+    good.write_text(GOOD_SRC)
+    bad_test = tmp_path / "test_smoke_bad.py"
+    bad_test.write_text("def test_no():\n    assert False\n")
+    r = _run(["--paths", str(good), "--skip-tests", "--concurrency",
+              "--locktrace-args", f"{bad_test} -q -p no:cacheprovider"])
+    assert r.returncode == 1
+    s = _summary(r)
+    assert s["concurrency_run"] and not s["locktrace_ok"]
+    assert not s["concurrency_ok"]
+
+
+def test_concurrency_summary_keys_present_when_not_run(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text(GOOD_SRC)
+    r = _run(["--paths", str(good), "--skip-tests"])
+    s = _summary(r)
+    assert s["concurrency_run"] is False and s["concurrency_ok"] is True
+    assert s["locktrace_ok"] is True and s["concurrency_tpu3xx"] == 0
+
+
+def test_justified_tpu_lint_waiver_noted_not_violation(tmp_path):
+    """The clean-path carve-out: a TPU3xx tpu-lint suppression WITH a
+    one-line justification is listed but allowed; the same directive
+    without one (or any tracelint trace-safety suppression) still
+    fails the gate."""
+    sub = tmp_path / "inference"
+    sub.mkdir()
+    f = sub / "mod.py"
+    f.write_text("x = 1  # tpu-lint: disable=TPU305  # benign GIL-atomic "
+                 "bump\n")
+    r = _run(["--paths", str(tmp_path), "--skip-tests",
+              "--clean-paths", str(sub)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    s = _summary(r)
+    assert s["suppressions"] == 1 and s["suppression_violations"] == 0
+
+    f.write_text("x = 1  # tpu-lint: disable=TPU305\n")  # no justification
+    r = _run(["--paths", str(tmp_path), "--skip-tests",
+              "--clean-paths", str(sub)])
+    assert r.returncode == 1
+    assert _summary(r)["suppression_violations"] == 1
+
+    # trace-safety suppressions get no waiver, justified or not
+    f.write_text("x = 1  # tracelint: disable=TPU007  # because reasons\n")
+    r = _run(["--paths", str(tmp_path), "--skip-tests",
+              "--clean-paths", str(sub)])
+    assert r.returncode == 1
+    assert _summary(r)["suppression_violations"] == 1
+
+
+def test_real_tree_waivers_pass_the_default_gate():
+    """The shipped dogfood annotations under paddle_tpu/inference are
+    all justified waivers: the default-clean-path audit stays green."""
+    r = _run(["--paths", "paddle_tpu/inference", "--skip-tests"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    s = _summary(r)
+    assert s["suppressions"] >= 5  # the PR 8 waivers are listed
+    assert s["suppression_violations"] == 0
 
 
 def test_perfproxy_stage_reported_in_summary():

@@ -1,27 +1,40 @@
 #!/usr/bin/env python
-"""tracelint — trace-safety & recompilation-hazard linter for paddle_tpu
-programs (driver for paddle_tpu.analysis).
+"""tracelint — trace-safety, recompilation-hazard & concurrency linter
+for paddle_tpu programs (driver for paddle_tpu.analysis).
 
 Usage:
     python tools/tracelint.py PATH [PATH ...]
         [--format text|json] [--disable TPU005,TPU007]
-        [--all-functions] [--registry] [--warnings-as-errors]
+        [--all-functions] [--registry] [--concurrency]
+        [--warnings-as-errors]
 
 Scans .py files (or whole packages) with the AST trace-safety passes
 (TPU0xx); ``--registry`` additionally imports paddle_tpu and audits the
-live op registry (TPU2xx). By default only functions that are
-demonstrably trace context (decorated @to_static/@jax.jit/..., or passed
-into apply_op / lax.cond / lax.scan) are checked; ``--all-functions``
-treats every function as traced (useful for auditing a train-step
-module wholesale).
+live op registry (TPU2xx); ``--concurrency`` additionally builds one
+static lock model over ALL scanned files and runs the concurrency
+passes (TPU3xx: lock-order cycles, blocking calls under a lock,
+timeout-less waits, heuristic races, callbacks under a registry lock,
+and ``# tpu-lock-order: a < b`` declaration checks). By default only
+functions that are demonstrably trace context (decorated
+@to_static/@jax.jit/..., or passed into apply_op / lax.cond / lax.scan)
+are checked by the AST passes; ``--all-functions`` treats every
+function as traced (useful for auditing a train-step module wholesale).
+
+JSON output carries a stable ``schema_version`` plus a per-pass-group
+``timings_s`` map ({"ast": ..., "registry": ..., "concurrency": ...})
+so CI consumers can key on the shape and attribute slow runs.
 
 Exit status: 1 when any error-severity finding remains after
 suppression, else 0. Inline suppression: ``# tracelint: disable=TPU001``
-on the flagged line (file-level when in the first five lines).
+on the flagged line (file-level when in the first five lines);
+``# tpu-lint: disable=TPU305  # justification`` is the concurrency-
+family alias (the ci_gate audit requires the justification text in
+clean-path subsystems).
 """
 import argparse
 import os
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
@@ -40,26 +53,51 @@ def main(argv=None):
                     help="treat every function as trace context")
     ap.add_argument("--registry", action="store_true",
                     help="also audit the live op registry (imports paddle_tpu)")
+    ap.add_argument("--concurrency", action="store_true",
+                    help="also run the TPU3xx concurrency passes (one "
+                         "static lock model over every scanned file)")
+    ap.add_argument("--concurrency-only", action="store_true",
+                    help="run ONLY the concurrency passes (implies "
+                         "--concurrency; skips the TPU0xx AST scan — "
+                         "what ci_gate's --concurrency stage uses, "
+                         "since its phase 1 already ran the AST family)")
     ap.add_argument("--warnings-as-errors", action="store_true")
     ns = ap.parse_args(argv)
 
     from paddle_tpu.analysis import (LintResult, filter_diagnostics,
-                                     lint_paths, lint_registry)
+                                     lint_concurrency, lint_paths,
+                                     lint_registry)
 
     disabled = tuple(c.strip() for c in ns.disable.split(",") if c.strip())
     for p in ns.paths:
         if not os.path.exists(p):
             print(f"tracelint: no such path: {p}", file=sys.stderr)
             return 2
-    result = lint_paths(ns.paths, all_functions=ns.all_functions,
-                        disabled=disabled)
-    diags = list(result.diagnostics)
+    timings = {}
+    diags = []
+    files_scanned = 0
+    if not ns.concurrency_only:
+        t0 = time.monotonic()
+        result = lint_paths(ns.paths, all_functions=ns.all_functions,
+                            disabled=disabled)
+        timings["ast"] = time.monotonic() - t0
+        diags += result.diagnostics
+        files_scanned = result.files_scanned
     if ns.registry:
+        t0 = time.monotonic()
         import paddle_tpu  # noqa: F401 — populate the registry
 
         diags += lint_registry(disabled=disabled).diagnostics
+        timings["registry"] = time.monotonic() - t0
+    if ns.concurrency or ns.concurrency_only:
+        t0 = time.monotonic()
+        conc = lint_concurrency(ns.paths, disabled=disabled)
+        diags += conc.diagnostics
+        timings["concurrency"] = time.monotonic() - t0
+        files_scanned = max(files_scanned, conc.files_scanned)
     merged = LintResult(filter_diagnostics(diags),
-                        files_scanned=result.files_scanned)
+                        files_scanned=files_scanned,
+                        timings=timings)
     print(merged.format(ns.format))
     if merged.errors:
         return 1
