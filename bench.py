@@ -13,6 +13,20 @@
                   (or BENCH_SERVING_CHAOS=1) measures GOODPUT under
                   injected faults instead: scheduler death + hot reload
                   + a poisoned-bucket quarantine phase
+  goodput         CPU-only elastic-training goodput bench (also:
+                  `python bench.py goodput`): useful-steps/hour of a
+                  multi-process pod (tests/elastic_worker.py --local)
+                  under chaos-injected host SIGTERM + SIGKILL and an
+                  injected slow host, vs the same workload healthy.
+                  The pod runs the multi-host preemption consensus
+                  (resilience.elastic), resumes from the consensus
+                  checkpoint after every kill, and feeds obs.goodput's
+                  ledger — the record echoes the injected kill count,
+                  the goodput ratio, the straggler flags, and the
+                  exported paddle_goodput_seconds_total series.
+                  BENCH_GOODPUT_{PROCS,STEPS,STEP_MS,CHAOS} tune it;
+                  BENCH_GOODPUT_CHAOS=0 measures the chaos-off control
+                  (ratio ~= 1.0).
   perfproxy       CPU-only compile-ledger regression check (also:
                   `python bench.py perfproxy`): replays a fixed
                   serving-bucket warmup + train-step compile, records
@@ -69,15 +83,19 @@ A100_FLASH_ATTN_TFLOPS = 190.0
 MODEL = os.environ.get("BENCH_MODEL", "bert")
 if "perfproxy" in sys.argv[1:]:
     MODEL = "perfproxy"  # CLI spelling: python bench.py perfproxy
+elif "goodput" in sys.argv[1:]:
+    MODEL = "goodput"  # CLI spelling: python bench.py goodput
 METRIC = {"resnet50": "resnet50_train_images_per_sec_per_chip",
           "flash": "flash_attention_fwd_bwd_tflops_per_chip",
           "llama": "llama_374m_pretrain_tokens_per_sec_per_chip",
           "decode": "llama_374m_decode_tokens_per_sec_per_chip",
           "serving": "serving_infer_qps_dynamic_batching",
+          "goodput": "training_goodput_steps_per_hour_under_chaos",
           "perfproxy": "perfproxy_compile_ledger_check"}.get(
               MODEL, "bert_base_pretrain_tokens_per_sec_per_chip")
 _UNIT = {"resnet50": "images/s", "flash": "TFLOP/s",
-         "serving": "req/s", "perfproxy": "ok"}.get(MODEL, "tokens/s")
+         "serving": "req/s", "goodput": "steps/h",
+         "perfproxy": "ok"}.get(MODEL, "tokens/s")
 V5E_BF16_PEAK_TFLOPS = 197.0
 V5E_HBM_GBPS = 819.0
 # shared by run_llama (training) and run_decode (serving): the two
@@ -271,6 +289,13 @@ def main():
         os.environ["XLA_FLAGS"] = " ".join(flags)
         jax.config.update("jax_platforms", "cpu")
         return run_perfproxy("--update-baseline" in sys.argv)
+
+    if MODEL == "goodput":
+        # CPU-only by design: the pod workers are subprocesses on this
+        # host; goodput-under-preemption is a protocol property, not a
+        # chip property
+        jax.config.update("jax_platforms", "cpu")
+        return run_goodput()
 
     smoke = os.environ.get("BENCH_CPU") == "1"
     if smoke:
@@ -1234,6 +1259,171 @@ def run_serving_chaos(smoke, platform):
     }
     if smoke:
         rec["smoke"] = True
+    return rec
+
+
+def run_goodput():
+    """Elastic-training goodput: useful-steps/hour under injected host
+    loss vs the same workload healthy (ROADMAP item 3, the training
+    analogue of the serving chaos bench).
+
+    Three phases, each a multi-process pod of
+    tests/elastic_worker.py --local (identical replicas, no cross
+    -process collectives — the layout where a SIGKILL'd host leaves
+    survivors free to run the dead-host consensus):
+
+      healthy    one clean pod to completion — the denominator
+      chaos      the same total-step workload with a SIGTERM'd rank on
+                 the first attempt and a SIGKILL'd rank on the second;
+                 every kill ends in a consensus checkpoint + pod exit
+                 143, and the next attempt resumes from it — useful
+                 steps are counted ONCE (wall clock pays the kills,
+                 the resumes, and the re-trained partial steps)
+      straggler  a short pod with a chaos-delayed rank; the coordinator
+                 must flag it (within straggler_n steps) WITHOUT
+                 killing the pod
+
+    BENCH_GOODPUT_CHAOS=0 turns the chaos phase into a second healthy
+    run (the control: ratio ~= 1.0, zero kills). The goodput ledger
+    (obs.goodput) rides along in the worker: the record echoes its
+    category totals and the exported paddle_goodput_seconds_total
+    exposition lines."""
+    import tempfile
+
+    from paddle_tpu.distributed import launch_mod
+
+    procs = int(os.environ.get("BENCH_GOODPUT_PROCS", "4"))
+    total = int(os.environ.get("BENCH_GOODPUT_STEPS", "36"))
+    step_ms = float(os.environ.get("BENCH_GOODPUT_STEP_MS", "25"))
+    chaos_on = os.environ.get("BENCH_GOODPUT_CHAOS", "1") != "0"
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tests", "elastic_worker.py")
+    if not os.path.isfile(worker):
+        fail(f"goodput worker missing: {worker}")
+    workdir = tempfile.mkdtemp(prefix="bench-goodput-")
+    knobs = {
+        "JAX_PLATFORMS": "cpu",
+        "PADDLE_TPU_ELASTIC_HB_INTERVAL": "0.1",
+        "PADDLE_TPU_ELASTIC_DEAD_TIMEOUT": "1.5",
+        "PADDLE_TPU_ELASTIC_STRAGGLER_K": "2.5",
+        "PADDLE_TPU_ELASTIC_STRAGGLER_N": "2",
+        "PADDLE_TPU_ELASTIC_STEP_SLEEP": str(step_ms / 1000.0),
+    }
+
+    def run_phase(tag, steps, spec_fn=None, max_attempts=8):
+        root = os.path.join(workdir, tag)
+        ck = os.path.join(root, "ck")
+        kills = {"sigterm": 0, "sigkill": 0}
+        reports = []  # rank-0 report per attempt (incl. preempted ones)
+        t0 = time.monotonic()
+        for attempt in range(max_attempts):
+            env = dict(knobs)
+            spec = spec_fn(attempt) if spec_fn else ""
+            if spec:
+                env["PADDLE_TPU_CHAOS"] = spec
+            rep = os.path.join(root, f"rep{attempt}")
+            try:
+                launch_mod.launch_collective(
+                    worker, [ck, rep, str(steps), "--local"],
+                    nproc_per_node=procs,
+                    log_dir=os.path.join(root, "logs"), extra_env=env)
+                reports.append(json.load(
+                    open(os.path.join(rep, "rank-0.json"))))
+                break
+            except launch_mod.PodPreempted as e:
+                if "signum=9" in spec:
+                    kills["sigkill"] += 1
+                else:
+                    kills["sigterm"] += 1
+                log(f"goodput {tag}: pod preempted ({e.codes}); resuming")
+                try:
+                    reports.append(json.load(
+                        open(os.path.join(rep, "rank-0.json"))))
+                except (OSError, ValueError):
+                    pass  # rank 0 died before reporting (host loss)
+        else:
+            fail(f"goodput phase {tag!r} never completed "
+                 f"in {max_attempts} attempts")
+        wall = time.monotonic() - t0
+        # aggregate the per-incarnation goodput ledgers: seconds per
+        # category and useful steps sum across resume attempts
+        gp = {c: 0.0 for c in ("step", "checkpoint", "retry",
+                               "rollback", "idle")}
+        ledger_steps = 0
+        for r in reports:
+            for c in gp:
+                gp[c] += r.get("goodput", {}).get(f"{c}_s", 0.0)
+            ledger_steps += r.get("goodput", {}).get("steps", 0)
+        rate = steps / wall * 3600.0
+        log(f"goodput {tag}: {steps} useful steps in {wall:.2f}s "
+            f"-> {rate:.0f} steps/h ({kills['sigterm']} sigterm, "
+            f"{kills['sigkill']} sigkill)")
+        return {"wall_s": wall, "rate": rate, "kills": kills,
+                "report": reports[-1], "goodput_totals": gp,
+                "ledger_steps": ledger_steps,
+                "exported": any(r.get("prometheus_goodput")
+                                for r in reports)}
+
+    kill_rank = max(1, procs - 1)
+    kill_at = max(2, total // 3)
+
+    def chaos_spec(attempt):
+        if attempt == 0:
+            # graceful preemption: SIGTERM one rank mid-run
+            return f"site=train.step,signum=15,at={kill_at},rank=1"
+        if attempt == 1:
+            # host loss: SIGKILL a rank — no grace signal, the
+            # survivors' dead-host consensus must save around it
+            return f"site=train.step,signum=9,at={kill_at},rank={kill_rank}"
+        return ""
+
+    healthy = run_phase("healthy", total)
+    chaos_phase = run_phase("chaos", total,
+                            chaos_spec if chaos_on else None)
+
+    straggler_flags = []
+    if chaos_on:
+        s_steps = min(total, 10)
+        delay = max(0.2, 4 * step_ms / 1000.0)
+        probe = run_phase(
+            "straggler", s_steps,
+            lambda a: (f"site=train.step,delay={delay},"
+                       f"times=1000000,rank=1"),
+            max_attempts=1)
+        straggler_flags = probe["report"].get("stragglers", [])
+        if not straggler_flags:
+            fail("straggler probe: slow host was not flagged")
+
+    kills = {k: healthy["kills"][k] + chaos_phase["kills"][k]
+             for k in ("sigterm", "sigkill")}
+    ratio = (chaos_phase["rate"] / healthy["rate"]
+             if healthy["rate"] else 0.0)
+    rec = {
+        "metric": METRIC,
+        "value": round(chaos_phase["rate"], 1),
+        "unit": "steps/h",
+        # goodput retained under injected host loss vs healthy
+        "vs_baseline": round(ratio, 4),
+        "goodput_ratio": round(ratio, 4),
+        "chaos": chaos_on,
+        "world": procs,
+        "total_steps": total,
+        "healthy_steps_per_hour": round(healthy["rate"], 1),
+        "chaos_steps_per_hour": round(chaos_phase["rate"], 1),
+        "injected_host_kills": kills["sigterm"] + kills["sigkill"],
+        "injected_sigterm": kills["sigterm"],
+        "injected_sigkill": kills["sigkill"],
+        "consensus_saves": kills["sigterm"] + kills["sigkill"],
+        "stragglers_flagged": straggler_flags,
+        # the worker's obs.goodput ledger, aggregated across the chaos
+        # phase's resume attempts, + the exported exposition series
+        "goodput_seconds_total": {
+            c: round(v, 4)
+            for c, v in chaos_phase["goodput_totals"].items()},
+        "ledger_steps": chaos_phase["ledger_steps"],
+        "goodput_exported": bool(chaos_phase["exported"]),
+        "smoke": True,
+    }
     return rec
 
 

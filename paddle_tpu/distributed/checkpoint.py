@@ -1,4 +1,5 @@
-"""Sharded distributed checkpoints over orbax/TensorStore.
+"""Sharded distributed checkpoints over orbax/TensorStore, plus a
+host-sharded format for true multi-process staging.
 
 Reference: the sharded save/load path (fleet sharding checkpoints,
 dist_sharding_save.py test; incubate auto_checkpoint HDFS snapshots).
@@ -7,8 +8,26 @@ logical copy of each GLOBAL array with every process storing only its
 addressable shards (orbax/TensorStore OCDBT), and restore reshards to
 whatever mesh/sharding the reader asks for — topology can change
 between save and load (e.g. dp8 ZeRO-3 -> dp4).
+
+Two payload formats behind one manager surface:
+
+- **orbax** (single-process ``sharded_checkpoint_manager``): unchanged.
+- **host-sharded** (``save_host_shards`` / ``load_host_sharded`` and
+  the multi-process manager): each process writes its ADDRESSABLE
+  shards as plain ``.npy`` data inside ``shard-<rank>/`` (an
+  ``index.json`` maps each blob to its slice of the global array), and
+  ``SHARDS.json`` records every leaf's global shape/dtype. Loading
+  assembles global host arrays (with a coverage check — a checkpoint
+  missing a dead host's shards fails verification and the manager falls
+  back to the previous good one) and re-slices them against whatever
+  mesh/PartitionSpec the reader's template asks for
+  (``jax.make_array_from_callback``), so a 4-process ZeRO checkpoint
+  restores bit-identically onto a 2-process mesh. CPU-testable with
+  ``xla_force_host_platform_device_count``.
 """
+import json
 import os
+import time
 
 import jax
 import numpy as np
@@ -90,8 +109,15 @@ def load_sharded(path, like):
     like: a pytree matching the saved structure whose leaves are jax
     arrays OR jax.ShapeDtypeStruct(shape, dtype, sharding=...) — the
     restore places each array per its sharding (reshard-on-load).
+
+    Detects the payload format: a directory carrying ``SHARDS.json``
+    is the host-sharded format (multi-process staged saves) and is
+    assembled + re-sliced on the host; anything else restores through
+    orbax.
     """
     path = os.path.abspath(path)
+    if os.path.isfile(os.path.join(path, HOST_SHARDS_NAME)):
+        return load_host_sharded(path, like)
 
     def as_abstract(x):
         if isinstance(x, jax.ShapeDtypeStruct):
@@ -123,29 +149,39 @@ def load_train_state(path, params_like, opt_state_like):
     return state["params"], state["opt_state"], int(state["step"])
 
 
-def sharded_checkpoint_manager(root, like=None, keep=3, io_retries=3):
-    """A resilience.CheckpointManager whose payload is this module's
-    orbax/TensorStore sharded format: atomic rename + manifest with
-    per-file checksums + retention GC + verified load with fallback,
-    over reshardable global-array checkpoints.
+def sharded_checkpoint_manager(root, like=None, keep=3, io_retries=3,
+                               rank=None, world=None, barrier=None):
+    """A resilience.CheckpointManager whose payload is reshardable
+    global-array checkpoints: atomic rename + manifest with per-file
+    checksums + retention GC + verified load with fallback.
 
     like: pytree template for restore (arrays or ShapeDtypeStruct with
     shardings — reshard-on-load); set/replace it later via
     ``manager.reader_like`` before calling load() if the target
     sharding isn't known at construction time.
 
-    Single-process only (one controller saving a multi-chip mesh is
-    fine): orbax collective saves need every process to stage into the
-    SAME directory, which the manager's per-pid tmp staging cannot
-    provide — multi-process runs must call save_sharded directly.
+    Single-process (the default when ``world`` is 1/unset and
+    ``jax.process_count() == 1``): the orbax/TensorStore payload,
+    unchanged. Multi-process: returns a
+    :class:`MultiProcessShardedManager` — every rank stages its
+    addressable shards into a per-rank tmp dir (host-sharded format),
+    an all-ranks barrier fences the staging, and rank 0 commits the
+    manifest with one ``os.replace`` so the pod never publishes a torn
+    checkpoint. ``barrier(name)`` defaults to the active elastic
+    client's coordinator barrier (dead hosts excluded), falling back to
+    a shared-filesystem barrier under ``root``.
     """
-    if jax.process_count() > 1:
-        raise NotImplementedError(
-            "sharded_checkpoint_manager stages saves in a per-process "
-            "temp dir and cannot coordinate orbax's collective save "
-            "across processes; in multi-process runs use save_sharded/"
-            "load_sharded directly (orbax provides the atomic finalize "
-            "barrier there)")
+    if world is None:
+        try:
+            world = int(os.environ.get("PADDLE_TRAINERS_NUM") or 0)
+        except ValueError:
+            world = 0
+        if world <= 0:
+            world = jax.process_count()
+    if int(world) > 1:
+        return MultiProcessShardedManager(root, like=like, keep=keep,
+                                          io_retries=io_retries, rank=rank,
+                                          world=world, barrier=barrier)
     from ..resilience.checkpoint import CheckpointManager
 
     def writer(state, ckpt_dir):
@@ -167,3 +203,381 @@ def sharded_checkpoint_manager(root, like=None, keep=3, io_retries=3):
                                 reader=reader, io_retries=io_retries)
     manager.reader_like = like
     return manager
+
+
+# ------------------------------------------------------- host-sharded format
+
+HOST_SHARDS_NAME = "SHARDS.json"
+HOST_FORMAT_VERSION = 1
+
+
+def _np_dtype(name):
+    """np.dtype from its string name, including the ml_dtypes extras
+    (bfloat16 & friends) jax arrays may carry."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _flatten_state(state):
+    """The shard writer/loader leaf naming IS resilience's checksum
+    naming: one shared walker, so the host-shard index and corruption
+    forensics can never drift apart."""
+    from ..resilience.checkpoint import flatten_tree
+
+    return flatten_tree(state)
+
+
+def _leaf_spec(leaf):
+    if isinstance(leaf, jax.Array):
+        return {"shape": list(leaf.shape), "dtype": str(leaf.dtype)}
+    arr = np.asarray(getattr(leaf, "_value", leaf))
+    return {"shape": list(arr.shape), "dtype": str(arr.dtype)}
+
+
+def _ser_index(index, shape):
+    """A shard's global slice as [[start, stop], ...] (step is always
+    1 for jax shardings)."""
+    out = []
+    for d, s in enumerate(index):
+        start = 0 if s.start is None else int(s.start)
+        stop = int(shape[d]) if s.stop is None else int(s.stop)
+        out.append([start, stop])
+    return out
+
+
+def write_host_shards(state, out_dir, rank=0):
+    """Write this process's addressable shards of every leaf into
+    ``out_dir`` (one ``data.npz`` + ``index.json``). Replicated leaves
+    are written whole by every rank — the loader dedups by index, and
+    the redundancy is what lets a pod that lost a host still publish a
+    complete checkpoint when the surviving ranks cover every shard."""
+    os.makedirs(out_dir, exist_ok=True)
+    entries, arrays = [], {}
+    for path, leaf in _flatten_state(state).items():
+        if isinstance(leaf, jax.Array):
+            shape = leaf.shape
+            for sh in leaf.addressable_shards:
+                key = f"a{len(arrays)}"
+                arrays[key] = np.asarray(sh.data)
+                entries.append({"leaf": path, "key": key,
+                                "index": _ser_index(sh.index, shape)})
+        else:
+            arr = np.asarray(getattr(leaf, "_value", leaf))
+            key = f"a{len(arrays)}"
+            arrays[key] = arr
+            entries.append({"leaf": path, "key": key,
+                            "index": _ser_index((), arr.shape)
+                            or [[0, d] for d in arr.shape]})
+    np.savez(os.path.join(out_dir, "data.npz"), **arrays)
+    with open(os.path.join(out_dir, "index.json"), "w") as f:
+        json.dump({"format": HOST_FORMAT_VERSION, "rank": int(rank),
+                   "entries": entries}, f, sort_keys=True)
+    return out_dir
+
+
+def write_host_manifest(state, ckpt_dir, world, step=None):
+    """SHARDS.json: the global shape/dtype of every leaf (what the
+    assembler allocates and the coverage check measures against)."""
+    leaves = {p: _leaf_spec(leaf)
+              for p, leaf in _flatten_state(state).items()}
+    payload = {"format": HOST_FORMAT_VERSION, "world": int(world),
+               "leaves": leaves}
+    if step is not None:
+        payload["step"] = int(step)
+    with open(os.path.join(ckpt_dir, HOST_SHARDS_NAME), "w") as f:
+        json.dump(payload, f, sort_keys=True)
+    return payload
+
+
+def assemble_host_checkpoint(path):
+    """Pure-numpy assembly of a host-sharded checkpoint directory into
+    {leaf_path: global ndarray}. Raises CheckpointCorrupt when the
+    shard files present do not cover every element of a leaf (e.g. a
+    host died before staging and no surviving rank held its shards)."""
+    from ..resilience.checkpoint import CheckpointCorrupt
+
+    meta_path = os.path.join(path, HOST_SHARDS_NAME)
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise CheckpointCorrupt(f"{path}: {HOST_SHARDS_NAME} "
+                                f"unreadable: {e}") from e
+    leaves = {p: np.zeros(tuple(spec["shape"]), _np_dtype(spec["dtype"]))
+              for p, spec in meta["leaves"].items()}
+    covered = {p: set() for p in leaves}
+    shard_dirs = sorted(n for n in os.listdir(path)
+                        if n.startswith("shard-")
+                        and os.path.isdir(os.path.join(path, n)))
+    for name in shard_dirs:
+        d = os.path.join(path, name)
+        try:
+            with open(os.path.join(d, "index.json")) as f:
+                index = json.load(f)
+            with np.load(os.path.join(d, "data.npz")) as blobs:
+                for e in index["entries"]:
+                    leaf = e["leaf"]
+                    if leaf not in leaves:
+                        continue  # template drift: ignore unknown leaves
+                    sl = tuple(slice(a, b) for a, b in e["index"])
+                    leaves[leaf][sl] = blobs[e["key"]]
+                    covered[leaf].add(tuple(map(tuple, e["index"])))
+        except (OSError, ValueError, KeyError) as e:
+            raise CheckpointCorrupt(f"{d}: shard unreadable: {e}") from e
+    for p, spec in meta["leaves"].items():
+        total = int(np.prod(spec["shape"])) if spec["shape"] else 1
+        got = sum(int(np.prod([b - a for a, b in idx])) if idx else 1
+                  for idx in covered[p])
+        if got < total:
+            raise CheckpointCorrupt(
+                f"{path}: leaf {p!r} covers {got}/{total} elements — "
+                "a rank's shards are missing (host lost before staging?)")
+    return leaves, meta
+
+
+def load_host_sharded(path, like):
+    """Restore a host-sharded checkpoint onto `like`'s mesh/shardings.
+
+    Every leaf is assembled into a global host array, then re-sliced
+    against the target sharding via ``jax.make_array_from_callback`` —
+    each process materialises only its own addressable shards, so the
+    slice shape may differ arbitrarily from the one that saved."""
+    leaves, _ = assemble_host_checkpoint(os.path.abspath(path))
+
+    def place(prefix, target):
+        key = prefix.rstrip(".") or "<root>"
+        if key not in leaves:
+            from ..resilience.checkpoint import CheckpointCorrupt
+
+            raise CheckpointCorrupt(f"{path}: leaf {key!r} missing "
+                                    "from checkpoint")
+        buf = leaves[key]
+        if isinstance(target, (jax.Array, jax.ShapeDtypeStruct)):
+            if tuple(target.shape) != tuple(buf.shape):
+                raise ValueError(
+                    f"{key}: checkpoint shape {tuple(buf.shape)} != "
+                    f"template shape {tuple(target.shape)}")
+            buf = buf.astype(target.dtype) \
+                if str(target.dtype) != str(buf.dtype) else buf
+            return jax.make_array_from_callback(
+                buf.shape, target.sharding, lambda idx, _b=buf: _b[idx])
+        arr = np.asarray(target)
+        out = buf.astype(arr.dtype) if arr.dtype != buf.dtype else buf
+        if isinstance(target, (int, float, bool, np.generic)):
+            return out[()] if out.shape == () else out
+        return out
+
+    def walk(node, prefix=""):
+        if isinstance(node, dict):
+            return {k: walk(v, f"{prefix}{k}.") for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v, f"{prefix}{i}.")
+                              for i, v in enumerate(node))
+        return place(prefix, node)
+
+    return walk(like)
+
+
+# --------------------------------------------------- multi-process manager
+
+def _fs_barrier(root, name, rank, world, timeout):
+    """Shared-filesystem barrier fallback: each rank touches
+    ``.sync/<name>.<rank>`` and polls for all ``world`` files. Used when
+    no elastic coordinator is active; barrier names must be unique per
+    save (the manager tags them step.seq)."""
+    from ..resilience.checkpoint import atomic_write_bytes
+
+    d = os.path.join(root, ".sync")
+    os.makedirs(d, exist_ok=True)
+    atomic_write_bytes(os.path.join(d, f"{name}.{rank}"), b"1")
+    deadline = time.monotonic() + timeout
+    want = int(world)
+    while True:
+        n = sum(1 for fn in os.listdir(d) if fn.startswith(name + "."))
+        if n >= want:
+            return
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"fs barrier {name!r}: {n}/{want} ranks arrived within "
+                f"{timeout:.0f}s")
+        time.sleep(0.02)
+
+
+class MultiProcessShardedManager:
+    """Multi-process sharded checkpoints with single-committer publish.
+
+    Staging protocol (the multi-process analogue of
+    resilience.CheckpointManager's tmp+rename):
+
+    1. every rank writes its addressable shards into a per-rank tmp dir
+       ``<root>/.stage-ckpt-<step>-rank<r>``;
+    2. barrier("stage") — nothing is visible yet;
+    3. rank 0 moves every staged rank dir into ITS manager tmp dir,
+       writes SHARDS.json + MANIFEST.json (per-file sha256), and
+       publishes with one ``os.replace`` + LATEST flip (reusing
+       CheckpointManager verbatim, so retention GC, verified load and
+       corruption fallback all apply);
+    4. barrier("publish") — only then may any rank resume training, so
+       a preemption mid-save can never leave ranks disagreeing about
+       which step is durable.
+
+    ``barrier`` defaults to the active elastic client's coordinator
+    barrier (dead ranks excluded); without one, a shared-filesystem
+    barrier under ``root``. Loads run on every rank independently:
+    verify manifest -> assemble global host arrays (coverage-checked)
+    -> re-slice onto ``reader_like``'s shardings.
+    """
+
+    def __init__(self, root, like=None, keep=3, io_retries=3, rank=None,
+                 world=None, barrier=None, barrier_timeout=None):
+        from ..resilience.checkpoint import CheckpointManager
+        from ..resilience.retry import _env_float
+
+        self.root = os.path.abspath(root)
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)
+                        if rank is None else rank)
+        self.world = int(world if world is not None
+                         else os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        self.reader_like = like
+        self._barrier_fn = barrier
+        self._barrier_timeout = (
+            _env_float("PADDLE_TPU_ELASTIC_BARRIER_TIMEOUT", 120.0)
+            if barrier_timeout is None else float(barrier_timeout))
+        self._seq = 0
+        self._inner = CheckpointManager(self.root, keep=keep,
+                                        writer=self._commit_writer,
+                                        reader=self._reader,
+                                        io_retries=io_retries)
+        self._commit_ctx = None  # (state, step, tag) during rank-0 save
+
+    # ------------------------------------------------------------ plumbing
+    def _barrier(self, name):
+        fn = self._barrier_fn
+        if fn is None:
+            from ..resilience import elastic
+
+            client = elastic.active_client()
+            if client is not None and not isinstance(client,
+                                                     elastic.LocalElastic):
+                fn = client.barrier
+        if fn is not None:
+            return fn(name)
+        return _fs_barrier(self.root, name, self.rank, self.world,
+                           self._barrier_timeout)
+
+    def _stage_dir(self, step, rank):
+        return os.path.join(self.root,
+                            f".stage-{self._inner._name(step)}-rank{rank}")
+
+    def _commit_writer(self, state, tmp):
+        """Rank 0's CheckpointManager writer: own shards + everyone
+        else's staged dirs + SHARDS.json, all inside the manager's tmp
+        (one os.replace publishes the lot).
+
+        The staged dirs are LINK-COPIED, not moved: CheckpointManager
+        retries this writer on transient OSErrors after wiping tmp, so
+        moving would destroy the only copy of the other ranks' shards
+        on attempt 1 and let a retry publish a torn (rank-0-only)
+        checkpoint. Staged dirs are cleaned up in save() only after the
+        publish succeeded."""
+        import shutil
+
+        step, tag = self._commit_ctx
+        write_host_shards(state, os.path.join(tmp, "shard-00000"),
+                          rank=0)
+        self._barrier(f"stage-{tag}")
+        for r in range(1, self.world):
+            staged = self._stage_dir(step, r)
+            if not os.path.isdir(staged):
+                # a dead host never staged: publish anyway — the
+                # coverage check on load decides whether the surviving
+                # shards form a complete checkpoint
+                continue
+            dst = os.path.join(tmp, f"shard-{r:05d}")
+            try:
+                shutil.copytree(staged, dst, copy_function=os.link)
+            except OSError:
+                shutil.rmtree(dst, ignore_errors=True)
+                shutil.copytree(staged, dst)  # fs without hardlinks
+        write_host_manifest(state, tmp, self.world, step=step)
+        return None
+
+    def _reader(self, ckpt_dir):
+        if self.reader_like is None:
+            raise ValueError(
+                "MultiProcessShardedManager needs `like` (or set "
+                "manager.reader_like) to restore sharded arrays")
+        return load_host_sharded(ckpt_dir, self.reader_like)
+
+    def _await_publish(self, step, tag):
+        """Publish fence for non-committer ranks. The coordinator
+        barrier is the fast path; if the coordinator vanishes mid-poll
+        (rank 0 publishes, exits 143, and its in-process coordinator
+        dies with it — a legal teardown race), the DISK is the truth:
+        wait for LATEST to name a step >= ours."""
+        from ..resilience import elastic
+
+        try:
+            self._barrier(f"publish-{tag}")
+            return
+        except elastic.CoordinatorLost:
+            deadline = time.monotonic() + self._barrier_timeout
+            while time.monotonic() < deadline:
+                latest = self._inner.latest_step()
+                if latest is not None and latest >= int(step):
+                    return
+                time.sleep(0.05)
+            raise
+
+    # ----------------------------------------------------------------- api
+    def save(self, state, step, extra=None):
+        """Collective: every rank must call save(state, step) with the
+        SAME step (the elastic consensus provides exactly that)."""
+        self._seq += 1
+        tag = f"{step}.{self._seq}"
+        if self.rank == 0:
+            self._commit_ctx = (step, tag)
+            try:
+                path = self._inner.save(state, step, extra=extra)
+            finally:
+                self._commit_ctx = None
+            # the publish succeeded: only now is it safe to drop the
+            # other ranks' staged shards (the commit link-copied them)
+            import shutil
+
+            for r in range(1, self.world):
+                shutil.rmtree(self._stage_dir(step, r),
+                              ignore_errors=True)
+            self._barrier(f"publish-{tag}")
+            return path
+        staged = self._stage_dir(step, self.rank)
+        if os.path.isdir(staged):
+            import shutil
+
+            shutil.rmtree(staged, ignore_errors=True)
+        write_host_shards(state, staged, rank=self.rank)
+        self._barrier(f"stage-{tag}")
+        self._await_publish(step, tag)
+        return self._inner.path(step)
+
+    def load(self, verify=True):
+        return self._inner.load(verify=verify)
+
+    def verify(self, ckpt_dir):
+        return self._inner.verify(ckpt_dir)
+
+    def latest_step(self):
+        return self._inner.latest_step()
+
+    def all_steps(self):
+        return self._inner.all_steps()
+
+    def path(self, step):
+        return self._inner.path(step)
+
+    def gc(self):
+        return self._inner.gc()

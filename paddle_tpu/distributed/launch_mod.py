@@ -48,6 +48,25 @@ def find_free_port():
         return s.getsockname()[1]
 
 
+PREEMPT_EXIT = 143  # 128 + SIGTERM (resilience.preemption.EXIT_CODE)
+# trainer exit codes that mean "preemption consensus in progress", not
+# "pod is broken": the graceful save-exit, SIGTERM death (a rank that
+# never reached a boundary), and SIGKILL (host loss — the survivors
+# consensus-save around it)
+_CONSENSUS_CODES = (PREEMPT_EXIT, -signal.SIGTERM, -signal.SIGKILL)
+
+
+class PodPreempted(RuntimeError):
+    """The pod exited through the preemption consensus: every rank
+    finished with a consensus code (143 / signal death) within the
+    grace window. Carries {rank: exit_code}; the caller resumes from
+    the consensus checkpoint instead of treating this as a crash."""
+
+    def __init__(self, codes):
+        super().__init__(f"pod preempted (rank exit codes {codes})")
+        self.codes = dict(codes)
+
+
 class TrainerProc:
     def __init__(self, proc, rank, log_path=None):
         self.proc = proc
@@ -77,24 +96,75 @@ def get_cluster_env(rank, world_size, master, local_rank=0):
     return env
 
 
-def watch_local_trainers(procs, poll_interval=0.5):
-    """Block until all trainers exit; on any non-zero exit, terminate the
-    rest of the pod (reference: launch_utils.py:556)."""
+def _raise_trainer_failure(procs, tp, ret):
+    terminate_local_procs(procs)
+    err = RuntimeError(f"trainer rank {tp.rank} exited with code {ret}")
+    err.trainer = tp  # inspected by transient_retries
+    raise err
+
+
+def watch_local_trainers(procs, poll_interval=0.5, preempt_grace=None):
+    """Block until all trainers exit (reference: launch_utils.py:556).
+
+    A hard failure (any exit code outside {0, 143, -SIGTERM, -SIGKILL})
+    still tears the pod down immediately. A CONSENSUS code instead opens
+    a grace window (``preempt_grace`` seconds, default env
+    PADDLE_TPU_ELASTIC_EXIT_GRACE or 30): the other ranks are mid
+    consensus-save and must be allowed to publish the shared checkpoint
+    and exit 143 themselves — killing them rank-by-rank is exactly the
+    torn-checkpoint failure the consensus exists to prevent. When every
+    rank lands on a consensus code, raises :class:`PodPreempted`."""
+    if preempt_grace is None:
+        try:
+            preempt_grace = float(os.environ.get(
+                "PADDLE_TPU_ELASTIC_EXIT_GRACE", 30.0))
+        except ValueError:
+            preempt_grace = 30.0
+    grace_deadline = None
+    first_signal_death = None  # (tp, ret) that opened the grace window
     try:
         while True:
             alive = False
+            preempting = False
+            saw_143 = False
             for tp in procs:
                 ret = tp.proc.poll()
                 if ret is None:
                     alive = True
+                elif ret in _CONSENSUS_CODES:
+                    preempting = True
+                    if ret == PREEMPT_EXIT:
+                        saw_143 = True
+                    elif first_signal_death is None:
+                        first_signal_death = (tp, ret)
                 elif ret != 0:
-                    terminate_local_procs(procs)
-                    err = RuntimeError(
-                        f"trainer rank {tp.rank} exited with code {ret}")
-                    err.trainer = tp  # inspected by transient_retries
-                    raise err
+                    _raise_trainer_failure(procs, tp, ret)
             if not alive:
+                if preempting:
+                    raise PodPreempted({tp.rank: tp.proc.returncode
+                                        for tp in procs})
                 return 0
+            if preempting:
+                now = time.time()
+                if grace_deadline is None:
+                    grace_deadline = now + preempt_grace
+                elif now >= grace_deadline:
+                    if not saw_143 and first_signal_death is not None:
+                        # no rank ever produced a graceful 143: this
+                        # was a plain signal kill (OOM killer, operator
+                        # SIGKILL) on a pod not running the consensus —
+                        # classify it as the original trainer failure
+                        # so transient_retries keeps working
+                        tp, ret = first_signal_death
+                        _raise_trainer_failure(procs, tp, ret)
+                    terminate_local_procs(procs)
+                    raise RuntimeError(
+                        f"preemption consensus exit timed out: ranks "
+                        f"{[tp.rank for tp in procs if tp.proc.poll() is None]}"
+                        f" still running {preempt_grace:.0f}s after the "
+                        "first preempted rank exited")
+                time.sleep(min(poll_interval, 0.1))
+                continue
             time.sleep(poll_interval)
     except KeyboardInterrupt:
         terminate_local_procs(procs)
@@ -114,6 +184,21 @@ def terminate_local_procs(procs, grace=3.0):
             tp.proc.send_signal(signal.SIGKILL)
 
 
+def _fresh_log_path(log_dir, rank, attempt):
+    """Per-attempt workerlogs that also survive the PREEMPTION path: a
+    resumed pod reuses the same log_dir, and reopening workerlog.N with
+    "w" would truncate the preempted incarnation's evidence — pick the
+    next free .rK name instead of overwriting."""
+    suffix = f".attempt{attempt}" if attempt else ""
+    base = f"workerlog.{rank}{suffix}"
+    log_path = os.path.join(log_dir, base)
+    k = 0
+    while os.path.exists(log_path) and os.path.getsize(log_path) > 0:
+        k += 1
+        log_path = os.path.join(log_dir, f"{base}.r{k}")
+    return log_path
+
+
 def launch_collective(script, args=(), nproc_per_node=1, nnodes=1,
                       node_rank=0, master=None, log_dir=None,
                       extra_env=None, transient_retries=0):
@@ -125,26 +210,32 @@ def launch_collective(script, args=(), nproc_per_node=1, nnodes=1,
     log (the gloo TCP framing race aborts a CPU worker ~50% of the time
     on this box — see _TRANSIENT_RE). A clean nonzero exit is
     deterministic and never retried. Each attempt rendezvouses on a
-    fresh master port unless the caller pinned one."""
+    fresh master port unless the caller pinned one.
+
+    Preemption contract: each attempt also publishes a fresh elastic
+    coordinator address (PADDLE_TPU_ELASTIC_COORD, unless the caller
+    pinned one via extra_env) so the trainers can run the multi-host
+    preemption consensus; a SIGTERM delivered to THIS launcher is
+    forwarded to every trainer, and the watcher then waits for the
+    consensus exit (all ranks 143) instead of letting the pod die
+    rank-by-rank — surfaced as :class:`PodPreempted`, never retried."""
     world = nnodes * nproc_per_node
     for attempt in range(int(transient_retries) + 1):
         rdv = master or f"127.0.0.1:{find_free_port()}"
+        coord_host = rdv.rsplit(":", 1)[0]
+        elastic_coord = f"{coord_host}:{find_free_port()}"
         procs = []
         for local_rank in range(nproc_per_node):
             rank = node_rank * nproc_per_node + local_rank
             env = get_cluster_env(rank, world, rdv, local_rank)
+            env["PADDLE_TPU_ELASTIC_COORD"] = elastic_coord
             if extra_env:
                 env.update({k: str(v) for k, v in extra_env.items()})
             stdout = None
             log_path = None
             if log_dir:
                 os.makedirs(log_dir, exist_ok=True)
-                # retry attempts get their own files: reopening the
-                # attempt-0 name with "w" would truncate the crash
-                # evidence the transient check just matched
-                suffix = f".attempt{attempt}" if attempt else ""
-                log_path = os.path.join(log_dir,
-                                        f"workerlog.{rank}{suffix}")
+                log_path = _fresh_log_path(log_dir, rank, attempt)
                 stdout = open(log_path, "w")
             proc = subprocess.Popen(
                 [sys.executable, script, *map(str, args)],
@@ -153,14 +244,45 @@ def launch_collective(script, args=(), nproc_per_node=1, nnodes=1,
             if stdout is not None:
                 stdout.close()  # the child owns the fd now
             procs.append(TrainerProc(proc, rank, log_path))
+        # forward a SIGTERM aimed at the launcher to the whole pod: the
+        # trainers run the preemption consensus and exit 143 together,
+        # and the watcher below waits for exactly that
+        prev_term = None
+        forwarded = {"done": False}
+
+        def _forward_sigterm(signum, frame, _procs=procs):
+            if not forwarded["done"]:
+                forwarded["done"] = True
+                for tp in _procs:
+                    if tp.proc.poll() is None:
+                        try:
+                            tp.proc.send_signal(signal.SIGTERM)
+                        except OSError:
+                            pass
+            if callable(prev_term) and prev_term not in (
+                    signal.SIG_DFL, signal.SIG_IGN):
+                prev_term(signum, frame)
+
+        try:
+            prev_term = signal.signal(signal.SIGTERM, _forward_sigterm)
+        except (ValueError, OSError):
+            prev_term = None  # non-main thread: no forwarding, still works
         try:
             return watch_local_trainers(procs)
+        except PodPreempted:
+            raise  # consensus exit: resumable, never a retryable crash
         except RuntimeError as e:
             if attempt >= transient_retries or not _failure_is_transient(e):
                 raise
             print(f"[launch] transient trainer crash (attempt "
                   f"{attempt + 1}/{transient_retries + 1}): {e}; "
                   "relaunching pod", file=sys.stderr, flush=True)
+        finally:
+            if prev_term is not None:
+                try:
+                    signal.signal(signal.SIGTERM, prev_term)
+                except (ValueError, OSError):
+                    pass
 
 
 def launch_elastic(script, args=(), nproc_per_node=1, nnodes=1,
@@ -214,8 +336,14 @@ def main():
     p.add_argument("script")
     p.add_argument("script_args", nargs="*")
     ns = p.parse_args()
-    launch_collective(ns.script, ns.script_args, ns.nproc_per_node,
-                      ns.nnodes, ns.node_rank, ns.master, ns.log_dir)
+    try:
+        launch_collective(ns.script, ns.script_args, ns.nproc_per_node,
+                          ns.nnodes, ns.node_rank, ns.master, ns.log_dir)
+    except PodPreempted as e:
+        # propagate the conventional preempted status so the scheduler
+        # reschedules the (resumable) job instead of marking it failed
+        print(f"[launch] {e}", file=sys.stderr, flush=True)
+        sys.exit(PREEMPT_EXIT)
 
 
 if __name__ == "__main__":

@@ -12,9 +12,13 @@ throughput is dominated by recovery efficiency, not step time):
 - badstep: in-graph NaN/Inf step skipping + consecutive-bad-step
   rollback policy (:class:`BadStepMonitor`);
 - chaos: deterministic fault injection so all of the above stays
-  covered by tier-1 CPU tests.
+  covered by tier-1 CPU tests;
+- elastic: pod-scale preemption consensus, straggler detection, and
+  dead-host recovery over a small TCP coordinator
+  (:func:`elastic.init_from_env`).
 """
 from . import chaos  # noqa: F401
+from . import elastic  # noqa: F401
 from .checkpoint import (  # noqa: F401
     CheckpointCorrupt,
     CheckpointManager,
@@ -22,6 +26,12 @@ from .checkpoint import (  # noqa: F401
     atomic_write_json,
     file_sha256,
     leaf_checksums,
+)
+from .elastic import (  # noqa: F401
+    CoordinatorLost,
+    ElasticClient,
+    ElasticCoordinator,
+    LocalElastic,
 )
 from .preemption import (  # noqa: F401
     EXIT_CODE as PREEMPTED_EXIT_CODE,
@@ -31,6 +41,7 @@ from .preemption import (  # noqa: F401
     get_preemption_handler,
     preemption_requested,
     read_resume_marker,
+    resolve_resume_step,
     write_resume_marker,
 )
 from .retry import RetryError, call_with_retry, retry  # noqa: F401

@@ -115,22 +115,32 @@ def _leaf_bytes(leaf):
     return np.ascontiguousarray(arr).tobytes(), str(arr.dtype), arr.shape
 
 
-def leaf_checksums(state, prefix=""):
-    """Flatten a nested dict/list/tuple pytree into {dotted.path:
-    {sha256, dtype, shape}} — corruption diagnostics name the exact
-    tensor, not just "the file"."""
+def flatten_tree(state, prefix=""):
+    """Flatten a nested dict/list/tuple pytree into an ordered
+    {dotted.path: leaf} map — the ONE leaf-naming walker shared by the
+    per-leaf checksum forensics here and the host-shard index
+    (distributed.checkpoint), which must name leaves identically."""
     out = {}
     if isinstance(state, dict):
         for k, v in state.items():
-            out.update(leaf_checksums(v, f"{prefix}{k}."))
+            out.update(flatten_tree(v, f"{prefix}{k}."))
     elif isinstance(state, (list, tuple)):
         for i, v in enumerate(state):
-            out.update(leaf_checksums(v, f"{prefix}{i}."))
+            out.update(flatten_tree(v, f"{prefix}{i}."))
     else:
-        data, dtype, shape = _leaf_bytes(state)
-        out[prefix.rstrip(".") or "<root>"] = {
-            "sha256": hashlib.sha256(data).hexdigest(),
-            "dtype": dtype, "shape": list(shape)}
+        out[prefix.rstrip(".") or "<root>"] = state
+    return out
+
+
+def leaf_checksums(state, prefix=""):
+    """{dotted.path: {sha256, dtype, shape}} over flatten_tree —
+    corruption diagnostics name the exact tensor, not just "the
+    file"."""
+    out = {}
+    for path, leaf in flatten_tree(state, prefix).items():
+        data, dtype, shape = _leaf_bytes(leaf)
+        out[path] = {"sha256": hashlib.sha256(data).hexdigest(),
+                     "dtype": dtype, "shape": list(shape)}
     return out
 
 

@@ -35,13 +35,24 @@ class PreemptedExit(SystemExit):
         self.step = step
 
 
+def _chainable(prev):
+    """Is a pre-existing handler worth chaining? Only a real callable
+    the application installed — the stock dispositions (SIG_DFL,
+    SIG_IGN, Python's default KeyboardInterrupt raiser) are what this
+    handler deliberately replaces."""
+    return (callable(prev) and prev not in (signal.SIG_DFL, signal.SIG_IGN)
+            and prev is not signal.default_int_handler)
+
+
 class PreemptionHandler:
     """Signal handler that records a preemption request.
 
     The handler only sets a flag (async-signal-safe); training loops
     poll `requested` at boundaries and perform the save/exit themselves.
-    install() is idempotent and chains nothing — uninstall() restores
-    the previous handlers.
+    install() is idempotent, and a pre-existing NON-DEFAULT handler is
+    chained (called after the flag is set) rather than silently
+    overwritten — a cluster agent's own SIGTERM bookkeeping keeps
+    running. uninstall() restores the previous handlers.
     """
 
     def __init__(self):
@@ -75,6 +86,9 @@ class PreemptionHandler:
     def _on_signal(self, signum, frame):
         self.signum = signum
         self._requested.set()
+        prev = self._prev.get(signum)
+        if _chainable(prev) and prev is not self._on_signal:
+            prev(signum, frame)
 
     @property
     def requested(self):
@@ -112,13 +126,23 @@ def preemption_requested():
 
 # ----------------------------------------------------------------- markers
 
-def write_resume_marker(save_dir, step=None, extra=None):
+def write_resume_marker(save_dir, step=None, extra=None, world_size=None):
     """Atomically record "this run was preempted after saving at
     `step`" so the restart knows the checkpoint is resumable (and
-    schedulers/tooling can distinguish preemption from a crash)."""
+    schedulers/tooling can distinguish preemption from a crash).
+    world_size (default: the PADDLE_TRAINERS_NUM env, when set) lets
+    the restart detect a marker written by a different slice shape."""
     from .checkpoint import atomic_write_json
 
+    if world_size is None:
+        try:
+            world_size = int(os.environ.get("PADDLE_TRAINERS_NUM") or 0)
+        except ValueError:
+            world_size = 0
+        world_size = world_size or None
     payload = {"preempted": True, "step": step}
+    if world_size is not None:
+        payload["world_size"] = int(world_size)
     if extra:
         payload.update(extra)
     os.makedirs(save_dir, exist_ok=True)
@@ -140,3 +164,58 @@ def clear_resume_marker(save_dir):
         os.remove(os.path.join(save_dir, MARKER_NAME))
     except OSError:
         pass
+
+
+def resolve_resume_step(save_dir, available_step=None, world_size=None):
+    """Reconcile the resume marker against what is actually on disk.
+
+    The marker is a HINT, not the source of truth — the verified
+    checkpoint store is. Edge cases this resolves (all warn rather than
+    crash, because a restart must always make progress):
+
+    - marker present but the checkpoint it names is missing/corrupt:
+      resume from ``available_step`` (the newest step the store could
+      verify — CheckpointManager.load's fallback result);
+    - marker step ahead of the store's LATEST (the marker write raced a
+      crash after an unpublished save): clamp to ``available_step``;
+    - marker written by a different world size: trust the step (the
+      sharded store reshards on load) but surface the mismatch so
+      non-reshardable callers can start clean instead.
+
+    Returns ``(step, info)``: ``step`` is the boundary to resume from
+    (``available_step`` when the marker is unusable, ``None`` when
+    neither exists), ``info`` carries ``marker``, ``stale_world`` and
+    ``clamped`` flags for the caller's logging.
+    """
+    import warnings
+
+    marker = read_resume_marker(save_dir)
+    info = {"marker": marker, "stale_world": False, "clamped": False}
+    if marker is None:
+        return available_step, info
+    mstep = marker.get("step")
+    mworld = marker.get("world_size")
+    if (world_size is not None and mworld is not None
+            and int(mworld) != int(world_size)):
+        info["stale_world"] = True
+        warnings.warn(
+            f"resume marker in {save_dir} was written by world_size="
+            f"{mworld}, resuming with world_size={world_size}: valid only "
+            "if the checkpoint store reshards on load")
+    if mstep is None:
+        return available_step, info
+    if available_step is None:
+        info["clamped"] = True
+        warnings.warn(
+            f"resume marker names step {mstep} but no usable checkpoint "
+            f"exists in {save_dir}; starting clean")
+        return None, info
+    if int(mstep) > int(available_step):
+        info["clamped"] = True
+        warnings.warn(
+            f"resume marker names step {mstep} but the newest verified "
+            f"checkpoint is step {available_step} (marker ahead of "
+            "LATEST, or the checkpoint it names was lost); resuming from "
+            f"{available_step}")
+        return available_step, info
+    return int(mstep), info

@@ -179,6 +179,67 @@ class TestBenchContract:
         assert r.returncode == 0, r.stderr[-800:]
         assert _one_json_line(r.stdout)["ok"] is True
 
+    @pytest.mark.slow  # subprocess pod launches; ci_gate --elastic
+    @pytest.mark.elastic  # runs these as its own stage
+    def test_goodput_mode_metric_fields(self):
+        """The elastic goodput bench under chaos: one JSON line with
+        useful-steps/hour, the goodput ratio, the injected host-kill
+        counts echoed, straggler flags, and the exported
+        paddle_goodput_seconds_total ledger."""
+        r = _run({"JAX_PLATFORMS": "cpu", "BENCH_GOODPUT_PROCS": "3",
+                  "BENCH_GOODPUT_STEPS": "12",
+                  "BENCH_GOODPUT_STEP_MS": "40"},
+                 timeout=420, argv=("goodput",))
+        assert r.returncode == 0, r.stderr[-1500:]
+        rec = _one_json_line(r.stdout)
+        assert rec["metric"] == \
+            "training_goodput_steps_per_hour_under_chaos"
+        assert rec["unit"] == "steps/h"
+        assert set(rec) >= {"goodput_ratio", "healthy_steps_per_hour",
+                            "chaos_steps_per_hour", "injected_host_kills",
+                            "injected_sigterm", "injected_sigkill",
+                            "consensus_saves", "stragglers_flagged",
+                            "goodput_seconds_total", "goodput_exported"}
+        assert rec["value"] == rec["chaos_steps_per_hour"] > 0
+        assert rec["healthy_steps_per_hour"] > 0
+        # the goodput ratio is present and is vs_baseline
+        assert 0 < rec["goodput_ratio"] <= rec["vs_baseline"] + 1e-9
+        # the injected host kills are echoed: one SIGTERM preemption +
+        # one SIGKILL host loss, each ending in a consensus save
+        assert rec["injected_sigterm"] >= 1
+        assert rec["injected_sigkill"] >= 1
+        assert rec["injected_host_kills"] == \
+            rec["injected_sigterm"] + rec["injected_sigkill"]
+        assert rec["consensus_saves"] == rec["injected_host_kills"]
+        # the chaos-delayed rank was flagged, and the pod survived it
+        assert rec["stragglers_flagged"] == [1]
+        # obs.goodput fed the bench and was exported as
+        # paddle_goodput_seconds_total
+        assert rec["goodput_seconds_total"]["step"] > 0
+        assert rec["ledger_steps"] == 12
+        assert rec["goodput_exported"] is True
+        assert rec["smoke"] is True
+
+    @pytest.mark.slow
+    @pytest.mark.elastic
+    def test_goodput_chaos_off_ratio_near_one(self):
+        """BENCH_GOODPUT_CHAOS=0 is the control: zero injected kills
+        and a goodput ratio ~= 1.0 (two identical healthy pods; the
+        wide tolerance absorbs shared-box startup noise)."""
+        r = _run({"JAX_PLATFORMS": "cpu", "BENCH_GOODPUT_PROCS": "3",
+                  "BENCH_GOODPUT_STEPS": "12",
+                  "BENCH_GOODPUT_STEP_MS": "40",
+                  "BENCH_GOODPUT_CHAOS": "0"},
+                 timeout=420, argv=("goodput",))
+        assert r.returncode == 0, r.stderr[-1500:]
+        rec = _one_json_line(r.stdout)
+        assert rec["chaos"] is False
+        assert rec["injected_host_kills"] == 0
+        assert rec["consensus_saves"] == 0
+        assert rec["stragglers_flagged"] == []
+        assert 0.4 <= rec["goodput_ratio"] <= 2.5
+        assert rec["ledger_steps"] == 12
+
     def test_decode_mode_metric_fields(self):
         r = _run({"BENCH_CPU": "1", "BENCH_STEPS": "4",
                   "BENCH_MODEL": "decode"}, timeout=420)

@@ -65,6 +65,113 @@ class TestHandler:
         preemption.clear_resume_marker(d)
         assert preemption.read_resume_marker(d) is None
 
+    def test_marker_records_world_size(self, tmp_path, monkeypatch):
+        d = str(tmp_path)
+        preemption.write_resume_marker(d, step=3, world_size=4)
+        assert preemption.read_resume_marker(d)["world_size"] == 4
+        preemption.clear_resume_marker(d)
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "8")
+        preemption.write_resume_marker(d, step=3)
+        assert preemption.read_resume_marker(d)["world_size"] == 8
+
+    def test_chains_preexisting_handler(self):
+        """Satellite: install() must not silently overwrite an
+        application handler — it chains it, and uninstall restores."""
+        calls = []
+
+        def agent_handler(signum, frame):
+            calls.append(signum)
+
+        before = signal.getsignal(signal.SIGTERM)
+        try:
+            signal.signal(signal.SIGTERM, agent_handler)
+            h = preemption.PreemptionHandler()
+            h.install(signals=(signal.SIGTERM,))
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert h.requested  # our flag set...
+            assert calls == [signal.SIGTERM]  # ...AND the agent ran
+            h.uninstall()
+            assert signal.getsignal(signal.SIGTERM) is agent_handler
+        finally:
+            signal.signal(signal.SIGTERM, before)
+
+    def test_default_dispositions_not_chained(self):
+        """SIG_DFL must not be 'chained' (calling it would be a crash);
+        the handler simply replaces it, as before."""
+        before = signal.getsignal(signal.SIGTERM)
+        try:
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            h = preemption.PreemptionHandler()
+            h.install(signals=(signal.SIGTERM,))
+            os.kill(os.getpid(), signal.SIGTERM)  # must not kill us
+            assert h.requested
+            h.uninstall()
+        finally:
+            signal.signal(signal.SIGTERM, before)
+
+
+class TestResolveResumeStep:
+    """Satellite: resume-marker edge cases reconcile against the
+    verified checkpoint store instead of being trusted blindly."""
+
+    def test_marker_agrees_with_store(self, tmp_path):
+        d = str(tmp_path)
+        preemption.write_resume_marker(d, step=5, world_size=2)
+        step, info = preemption.resolve_resume_step(d, available_step=5,
+                                                    world_size=2)
+        assert step == 5
+        assert not info["clamped"] and not info["stale_world"]
+
+    def test_marker_but_checkpoint_missing_falls_back(self, tmp_path):
+        """Marker names step 7 but the newest VERIFIED checkpoint is 4
+        (the ckpt-7 dir was lost/corrupt and CheckpointManager.load
+        already fell back): resume from 4."""
+        from paddle_tpu.resilience.checkpoint import CheckpointManager
+        import shutil
+
+        d = str(tmp_path)
+        mgr = CheckpointManager(d, keep=5)
+        mgr.save({"w": np.ones(2, np.float32)}, 4)
+        mgr.save({"w": np.ones(2, np.float32) * 2}, 7)
+        preemption.write_resume_marker(d, step=7)
+        shutil.rmtree(mgr.path(7))  # the checkpoint the marker names
+        state, available = mgr.load()  # falls back to 4
+        assert available == 4
+        with pytest.warns(UserWarning, match="marker ahead of LATEST"):
+            step, info = preemption.resolve_resume_step(
+                d, available_step=available)
+        assert step == 4 and info["clamped"]
+
+    def test_marker_ahead_of_latest_clamps(self, tmp_path):
+        d = str(tmp_path)
+        preemption.write_resume_marker(d, step=9)
+        with pytest.warns(UserWarning, match="marker ahead of LATEST"):
+            step, info = preemption.resolve_resume_step(d,
+                                                        available_step=6)
+        assert step == 6 and info["clamped"]
+
+    def test_marker_without_any_checkpoint_starts_clean(self, tmp_path):
+        d = str(tmp_path)
+        preemption.write_resume_marker(d, step=3)
+        with pytest.warns(UserWarning, match="no usable checkpoint"):
+            step, info = preemption.resolve_resume_step(d,
+                                                        available_step=None)
+        assert step is None and info["clamped"]
+
+    def test_stale_marker_from_different_world_size(self, tmp_path):
+        d = str(tmp_path)
+        preemption.write_resume_marker(d, step=5, world_size=4)
+        with pytest.warns(UserWarning, match="world_size"):
+            step, info = preemption.resolve_resume_step(
+                d, available_step=5, world_size=2)
+        assert step == 5  # still resumable: the sharded store reshards
+        assert info["stale_world"]
+
+    def test_no_marker_passthrough(self, tmp_path):
+        step, info = preemption.resolve_resume_step(str(tmp_path),
+                                                    available_step=11)
+        assert step == 11 and info["marker"] is None
+
 
 class TestTrainEpochRangePreemption:
     def test_epoch_boundary_save_and_exit(self, tmp_path):

@@ -6,7 +6,7 @@ Usage:
     python tools/ci_gate.py [--paths paddle_tpu]
         [--skip-tests] [--pytest-args "tests/ -q -m 'not slow'"]
         [--disable TPU005,...] [--chaos] [--serving] [--serving-chaos]
-        [--perfproxy] [--concurrency]
+        [--elastic] [--perfproxy] [--concurrency]
         [--clean-paths paddle_tpu/resilience paddle_tpu/inference
          paddle_tpu/obs paddle_tpu/analysis]
 
@@ -26,7 +26,11 @@ slow-marked cases like the serving bench contract that tier-1's
 ``not slow`` filter skips. ``--serving-chaos`` adds a stage running the
 serving fault-injection suite (``-m 'chaos and serving'``: scheduler
 death, poisoned-bucket quarantine, deadlines, hot reload) so the
-self-healing invariants gate releases on their own line. ``--perfproxy``
+self-healing invariants gate releases on their own line. ``--elastic``
+adds a stage running the elastic pod-scale training suite
+(``-m elastic``: multi-process preemption consensus, reshard-on-resume,
+straggler detection, and the goodput bench contract — subprocess pods,
+so it owns its own budget line). ``--perfproxy``
 adds a stage running ``bench.py perfproxy`` on CPU against the
 committed PERFPROXY_BASELINE.json — compile counts, HLO op counts, and
 cost-analysis FLOPs must match, so single-chip perf can't silently rot
@@ -63,6 +67,10 @@ CHAOS_PYTEST_ARGS = "tests/ -q -m 'chaos and not serving' -p no:cacheprovider"
 SERVING_PYTEST_ARGS = "tests/ -q -m serving -p no:cacheprovider"
 SERVING_CHAOS_PYTEST_ARGS = ("tests/ -q -m 'chaos and serving' "
                              "-p no:cacheprovider")
+# the elastic pod suite: multi-process consensus/reshard/straggler e2e
+# (including its slow-marked subprocess cases and the goodput bench
+# contract) runs as its own stage
+ELASTIC_PYTEST_ARGS = "tests/ -q -m elastic -p no:cacheprovider"
 # subsystems that must stay suppression-free: resilience (PR 2), the
 # serving stack (PRs 4-5), the telemetry layer (PR 7), and the analyzer
 # itself (PR 8) fix findings instead of silencing them. One carve-out:
@@ -245,6 +253,12 @@ def main(argv=None):
                          "quarantine, deadlines, hot reload)")
     ap.add_argument("--serving-chaos-args",
                     default=SERVING_CHAOS_PYTEST_ARGS)
+    ap.add_argument("--elastic", action="store_true",
+                    help="also run the elastic pod-scale training suite "
+                         "(-m elastic: multi-process preemption "
+                         "consensus, reshard-on-resume, straggler "
+                         "detection, goodput bench contract)")
+    ap.add_argument("--elastic-args", default=ELASTIC_PYTEST_ARGS)
     ap.add_argument("--perfproxy", action="store_true",
                     help="also run bench.py perfproxy (CPU compile-"
                          "ledger regression check vs the committed "
@@ -275,15 +289,21 @@ def main(argv=None):
     tests_ok = True
     if not ns.skip_tests:
         pytest_args = ns.pytest_args
-        if ns.serving and pytest_args == DEFAULT_PYTEST_ARGS:
-            # the serving stage runs -m serving itself: don't pay the
-            # compile-heavy serving suite twice in one gate invocation
-            pytest_args = pytest_args.replace(
-                "'not slow'", "'not slow and not serving'")
-        elif ns.serving_chaos and pytest_args == DEFAULT_PYTEST_ARGS:
-            # same double-run guard for the serving-chaos stage alone
-            pytest_args = pytest_args.replace(
-                "'not slow'", "'not slow and not (chaos and serving)'")
+        if pytest_args == DEFAULT_PYTEST_ARGS:
+            # double-run guards: a dedicated stage owns its marker, so
+            # tier-1 must not pay the same suite twice in one gate run
+            excl = []
+            if ns.serving:
+                excl.append("serving")
+            elif ns.serving_chaos:
+                excl.append("(chaos and serving)")
+            if ns.elastic:
+                excl.append("elastic")
+            if excl:
+                pytest_args = pytest_args.replace(
+                    "'not slow'",
+                    "'not slow and not "
+                    + " and not ".join(excl) + "'")
         tests_ok = run_pytest(pytest_args) == 0
 
     chaos_ok = True
@@ -304,6 +324,10 @@ def main(argv=None):
     if ns.serving_chaos:
         serving_chaos_ok = run_pytest(ns.serving_chaos_args) == 0
 
+    elastic_ok = True
+    if ns.elastic:
+        elastic_ok = run_pytest(ns.elastic_args) == 0
+
     perfproxy_ok = True
     if ns.perfproxy:
         perfproxy_ok = run_perfproxy() == 0
@@ -322,6 +346,7 @@ def main(argv=None):
                  + ("+chaos" if ns.chaos else "")
                  + ("+serving" if ns.serving else "")
                  + ("+serving-chaos" if ns.serving_chaos else "")
+                 + ("+elastic" if ns.elastic else "")
                  + ("+perfproxy" if ns.perfproxy else "")
                  + ("+concurrency" if ns.concurrency else "")),
         "lint_ok": lint_ok,
@@ -338,6 +363,8 @@ def main(argv=None):
         "serving_run": bool(ns.serving),
         "serving_chaos_ok": serving_chaos_ok,
         "serving_chaos_run": bool(ns.serving_chaos),
+        "elastic_ok": elastic_ok,
+        "elastic_run": bool(ns.elastic),
         "perfproxy_ok": perfproxy_ok,
         "perfproxy_run": bool(ns.perfproxy),
         "concurrency_ok": concurrency_ok,
@@ -347,8 +374,8 @@ def main(argv=None):
     }
     print(json.dumps(summary))
     if not (lint_ok and audit_ok and tests_ok and chaos_ok
-            and serving_ok and serving_chaos_ok and perfproxy_ok
-            and concurrency_ok):
+            and serving_ok and serving_chaos_ok and elastic_ok
+            and perfproxy_ok and concurrency_ok):
         print("ci_gate: FAILED", file=sys.stderr)
         return 1
     return 0
