@@ -27,6 +27,17 @@
                   BENCH_GOODPUT_{PROCS,STEPS,STEP_MS,CHAOS} tune it;
                   BENCH_GOODPUT_CHAOS=0 measures the chaos-off control
                   (ratio ~= 1.0).
+  coldstart       CPU-only zero-cold-start check (also: `python
+                  bench.py coldstart`): time-to-first-healthy-reply of
+                  a FRESH `serve_model` subprocess, cold artifact store
+                  vs warm store vs poisoned (bit-flipped) store. The
+                  warm phase must record ZERO inline engine compiles
+                  (every bucket loads from the persistent artifact
+                  store) and the poisoned phase must quarantine every
+                  artifact and degrade to inline compiles with the
+                  reply still bitwise-identical. BENCH_ARTIFACT_DIR
+                  reuses a store across runs; BENCH_COLDSTART_TIMEOUT
+                  bounds each phase.
   perfproxy       CPU-only compile-ledger regression check (also:
                   `python bench.py perfproxy`): replays a fixed
                   serving-bucket warmup + train-step compile, records
@@ -85,16 +96,19 @@ if "perfproxy" in sys.argv[1:]:
     MODEL = "perfproxy"  # CLI spelling: python bench.py perfproxy
 elif "goodput" in sys.argv[1:]:
     MODEL = "goodput"  # CLI spelling: python bench.py goodput
+elif "coldstart" in sys.argv[1:]:
+    MODEL = "coldstart"  # CLI spelling: python bench.py coldstart
 METRIC = {"resnet50": "resnet50_train_images_per_sec_per_chip",
           "flash": "flash_attention_fwd_bwd_tflops_per_chip",
           "llama": "llama_374m_pretrain_tokens_per_sec_per_chip",
           "decode": "llama_374m_decode_tokens_per_sec_per_chip",
           "serving": "serving_infer_qps_dynamic_batching",
           "goodput": "training_goodput_steps_per_hour_under_chaos",
+          "coldstart": "serving_coldstart_first_healthy_reply_seconds",
           "perfproxy": "perfproxy_compile_ledger_check"}.get(
               MODEL, "bert_base_pretrain_tokens_per_sec_per_chip")
 _UNIT = {"resnet50": "images/s", "flash": "TFLOP/s",
-         "serving": "req/s", "goodput": "steps/h",
+         "serving": "req/s", "goodput": "steps/h", "coldstart": "s",
          "perfproxy": "ok"}.get(MODEL, "tokens/s")
 V5E_BF16_PEAK_TFLOPS = 197.0
 V5E_HBM_GBPS = 819.0
@@ -296,6 +310,13 @@ def main():
         # chip property
         jax.config.update("jax_platforms", "cpu")
         return run_goodput()
+
+    if MODEL == "coldstart":
+        # CPU-only by design: the servers are fresh subprocesses on
+        # this host; zero-cold-start via the artifact store is a
+        # protocol property, not a chip property
+        jax.config.update("jax_platforms", "cpu")
+        return run_coldstart()
 
     smoke = os.environ.get("BENCH_CPU") == "1"
     if smoke:
@@ -1262,6 +1283,178 @@ def run_serving_chaos(smoke, platform):
     return rec
 
 
+def run_coldstart():
+    """Time-to-first-healthy-reply of a FRESH ``serve_model`` process,
+    cold store vs warm store vs poisoned store (the persistent
+    compiled-artifact store, serialize/artifact_store.py).
+
+    Three phases, each spawning a brand-new server subprocess against
+    the same PADDLE_TPU_ARTIFACT_DIR and timing spawn -> first OK infer
+    reply over the socket:
+
+      cold      empty store: warmup compiles every bucket inline and
+                publishes (the price every replica used to pay)
+      warm      same store, new process: warmup must load every bucket
+                (stats: compiles == 0, store_loads > 0) — the
+                zero-cold-start contract
+      poisoned  every stored payload bit-flipped: verification must
+                quarantine them all and degrade to inline compiles,
+                with the reply still bitwise-identical
+
+    CPU-only by design (like perfproxy/goodput): restart compile-
+    avoidance is a protocol property, not a chip property. The spawned
+    servers get no jax persistent compile cache, so the artifact store
+    is the only thing that can absorb a compile."""
+    import socket
+    import struct
+    import subprocess
+    import tempfile
+    import textwrap
+
+    from paddle_tpu.inference.server import _read_all
+    from paddle_tpu.serialize.artifact_store import PAYLOAD_NAME
+
+    fx = _serving_fixture(True)
+    store_dir = (os.environ.get("BENCH_ARTIFACT_DIR")
+                 or tempfile.mkdtemp(prefix="bench-artifacts-"))
+    timeout_s = float(os.environ.get("BENCH_COLDSTART_TIMEOUT", "180"))
+    worker = os.path.join(tempfile.mkdtemp(), "coldstart_worker.py")
+    with open(worker, "w") as f:
+        f.write(textwrap.dedent("""\
+            import os, sys
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            from paddle_tpu.inference.server import serve_model
+            prefix, portfile = sys.argv[1], sys.argv[2]
+            srv = serve_model(prefix, dynamic_batching=True,
+                              max_batch_size=8, max_wait_ms=2.0)
+            with open(portfile + ".tmp", "w") as f:
+                f.write(str(srv.port))
+            os.replace(portfile + ".tmp", portfile)
+            srv._thread.join()  # serve until the stop command (cmd 7)
+            """))
+
+    def request(port, frame):
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=30) as s:
+            s.sendall(frame)
+            (blen,) = struct.unpack("<I", _read_all(s, 4))
+            resp = _read_all(s, blen)
+        return resp[0], resp[1:]
+
+    def cmd_frame(cmd):
+        return struct.pack("<IB", 1, cmd)
+
+    def phase(name):
+        portfile = os.path.join(tempfile.mkdtemp(), "port")
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PADDLE_TPU_ARTIFACT_DIR=store_dir,
+                   PYTHONPATH=repo + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        env.pop("PADDLE_TPU_ARTIFACT_DISABLE", None)
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+        t0 = time.monotonic()
+        proc = subprocess.Popen([sys.executable, worker, fx.prefix,
+                                 portfile], env=env)
+        port, t_first, reply = None, None, None
+        try:
+            deadline = t0 + timeout_s
+            while time.monotonic() < deadline:
+                if port is None:
+                    if os.path.exists(portfile):
+                        with open(portfile) as pf:
+                            port = int(pf.read())
+                    elif proc.poll() is not None:
+                        fail(f"coldstart {name}: server exited rc="
+                             f"{proc.returncode} before binding")
+                    else:
+                        time.sleep(0.01)
+                        continue
+                status, body = request(port, fx.frame)
+                if status == 0:
+                    t_first = time.monotonic() - t0
+                    reply = body
+                    break
+                time.sleep(0.05)  # retryable (warming): poll again
+            if t_first is None:
+                fail(f"coldstart {name}: no healthy reply within "
+                     f"{timeout_s:.0f}s")
+            _, stats_body = request(port, cmd_frame(5))
+            stats = json.loads(stats_body.decode("utf-8"))
+            _, health_body = request(port, cmd_frame(3))
+            health = json.loads(health_body.decode("utf-8"))
+            request(port, cmd_frame(7))  # stop
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        store = (health.get("engine") or {}).get("artifact_store") or {}
+        rec = {"t_first_healthy_reply_s": round(t_first, 3),
+               "compiles": int(stats["compiles"]),
+               "store_loads": int(stats["store_loads"]),
+               "store_hits": int(store.get("hits", 0)),
+               "store_misses": int(store.get("misses", 0)),
+               "store_corrupt": int(store.get("corrupt", 0))}
+        log(f"coldstart {name}: first healthy reply {t_first:.3f}s, "
+            f"{rec['compiles']} inline compiles, "
+            f"{rec['store_loads']} store loads, "
+            f"{rec['store_corrupt']} quarantined")
+        return rec, reply
+
+    def poison_store():
+        """Flip one byte in the middle of every stored payload — the
+        MANIFEST sha256 no longer matches, so every get() must
+        quarantine (a bit-flipped jax.export blob can deserialize and
+        run silently wrong, so the sha check is the only line of
+        defense — see serialize/export.py)."""
+        n = 0
+        for d in os.listdir(store_dir):
+            if not d.startswith("art-"):
+                continue
+            p = os.path.join(store_dir, d, PAYLOAD_NAME)
+            try:
+                with open(p, "r+b") as f:
+                    data = bytearray(f.read())
+                    data[len(data) // 2] ^= 0xFF
+                    f.seek(0)
+                    f.write(data)
+            except OSError:
+                continue
+            n += 1
+        return n
+
+    cold, cold_reply = phase("cold")
+    warm, warm_reply = phase("warm")
+    n_poisoned = poison_store()
+    poisoned, poisoned_reply = phase("poisoned")
+
+    replies_equal = (cold_reply == warm_reply == poisoned_reply
+                     and cold_reply is not None)
+    rec = {
+        "metric": METRIC,
+        "value": warm["t_first_healthy_reply_s"],
+        "unit": "s",
+        # speedup of a warm-store restart over a cold one
+        "vs_baseline": round(cold["t_first_healthy_reply_s"]
+                             / max(warm["t_first_healthy_reply_s"], 1e-9),
+                             3),
+        "store_dir": store_dir,
+        "phases": {"cold": cold, "warm": warm, "poisoned": poisoned},
+        "poisoned_artifacts": int(n_poisoned),
+        # the acceptance contract, as first-class fields:
+        "warm_zero_engine_compiles": warm["compiles"] == 0
+                                     and warm["store_loads"] > 0,
+        "poisoned_degraded_inline": poisoned["compiles"] > 0
+                                    and poisoned["store_corrupt"] > 0,
+        "replies_bitwise_equal": bool(replies_equal),
+        "smoke": True,
+    }
+    return rec
+
+
 def run_goodput():
     """Elastic-training goodput: useful-steps/hour under injected host
     loss vs the same workload healthy (ROADMAP item 3, the training
@@ -1611,6 +1804,10 @@ def run_perfproxy(update_baseline=False):
                      "PERFPROXY_BASELINE.json"))
     flop_tol = float(os.environ.get("BENCH_PERFPROXY_FLOP_TOL", "0.02"))
     op_tol = float(os.environ.get("BENCH_PERFPROXY_OP_TOL", "0.05"))
+    # hermetic vs the persistent artifact store: a warm store would
+    # satisfy the bucket warmup with kind="store" ledger events and
+    # shift every compile count off the committed baseline
+    os.environ["PADDLE_TPU_ARTIFACT_DISABLE"] = "1"
 
     measured = _perfproxy_measure()
 

@@ -71,6 +71,7 @@ from . import onnx  # noqa: F401
 from . import utils  # noqa: F401
 from . import analysis  # noqa: F401 (tracelint: trace-safety static analyzer)
 from . import resilience  # noqa: F401 (fault-tolerant training runtime)
+from . import serialize  # noqa: F401 (program export + artifact store)
 from .hapi import Model, summary  # noqa: F401
 from .framework import save, load  # noqa: F401
 from . import framework  # noqa: F401

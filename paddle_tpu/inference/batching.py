@@ -42,7 +42,17 @@ failure — not avoiding it — is what preserves throughput):
 - **Chaos sites**: ``serving.scheduler.loop``, ``serving.compile[.bucketN]``,
   ``serving.execute[.bucketN]`` and ``serving.submit`` let the
   deterministic chaos harness (resilience/chaos.py) inject scheduler
-  death, poisoned buckets, and mid-batch failures in CI.
+  death, poisoned buckets, and mid-batch failures in CI (the artifact
+  store adds ``artifact.get`` / ``artifact.verify`` / ``artifact.put``
+  / ``artifact.put.publish``).
+- **Persistent artifact store** (serialize/artifact_store.py, opt-in
+  via ``PADDLE_TPU_ARTIFACT_DIR``): warmup and cold buckets consult a
+  crash-safe on-disk store of exported programs before compiling —
+  a fresh replica, hot reload, or restart warms its whole bucket
+  ladder with zero XLA compiles, and any corrupt/torn/skewed artifact
+  degrades to the inline compile it would have done anyway. Warmup is
+  single-flight across processes: N replicas warming one bucket pay
+  ONE compile fleet-wide.
 
 Telemetry (paddle_tpu/obs): the engine's counters are obs.metrics
 instruments — cmd-5 ``stats`` and cmd-3 ``health`` are consistent views
@@ -97,6 +107,8 @@ from ..obs import tracing as obs_tracing
 from ..obs.ledger import LEDGER
 from ..resilience import chaos
 from ..resilience.retry import _env_float, _env_int
+from ..serialize import artifact_store as _artifacts
+from ..serialize.export import deserialize_exported, serialize_exported
 
 # Machine-checked lock order (tools/tracelint.py --concurrency, TPU309):
 # the engine lock is the SUBSYSTEM lock; obs instrument and registry
@@ -196,11 +208,14 @@ class _Request:
 
 
 class _BucketStats:
-    __slots__ = ("compiles", "batches", "requests", "rows", "padded_rows",
-                 "total_ms", "max_ms")
+    __slots__ = ("compiles", "store_loads", "batches", "requests", "rows",
+                 "padded_rows", "total_ms", "max_ms")
 
     def __init__(self):
-        self.compiles = 0
+        self.compiles = 0  # real inline XLA compiles only
+        self.store_loads = 0  # programs deserialized from the artifact
+        # store — split so a store miss can never masquerade as (or
+        # hide) a real recompile regression in cmd-5 stats / perfproxy
         self.batches = 0
         self.requests = 0
         self.rows = 0
@@ -211,6 +226,7 @@ class _BucketStats:
     def as_dict(self):
         return {
             "compiles": self.compiles,
+            "store_loads": self.store_loads,
             "batches": self.batches,
             "requests": self.requests,
             "rows": self.rows,
@@ -284,12 +300,22 @@ class AotLayerRunner:
     into the program) and the batch buffers donated.
     """
 
-    def __init__(self, layer, donate=True):
+    def __init__(self, layer, donate=True, store=None):
         import jax
 
         self._jax = jax
         self._layer = layer
         self._donate = donate
+        # persistent compiled-artifact store (serialize.artifact_store):
+        # warmup and cold buckets consult it before compiling, and
+        # inline compiles publish back so the NEXT process (a fresh
+        # replica, a hot reload, a restart) pays zero cold compiles.
+        # None + no env opt-in = store-less, the pre-store behaviour.
+        self._store = store if store is not None \
+            else _artifacts.default_store()
+        self._fingerprint = getattr(layer, "_model_fingerprint", None)
+        self._warmup_wait_s = _env_float(
+            "PADDLE_TPU_ARTIFACT_WARMUP_WAIT_S", 120.0)
         specs = getattr(layer, "_input_specs", None) or []
         if not specs:
             raise ValueError("layer has no input specs; was it jit-saved?")
@@ -321,13 +347,29 @@ class AotLayerRunner:
         return tuple((dt.str, tr)
                      for dt, tr in zip(self._dtypes, self._trailing))
 
-    def compile(self, bucket, sig):
-        """Lower + compile the bucket's program. Called once per bucket
-        by the engine's cache; the compiled callable takes the padded
-        numpy batch arrays and returns a list of numpy outputs."""
+    # ------------------------------------------------- artifact store
+    def _active_store(self):
+        """The store to consult, or None. Needs a model fingerprint
+        (jit.load computes one from the module bytes) and survives the
+        operator kill switch (PADDLE_TPU_ARTIFACT_DISABLE wins even
+        over an explicitly-passed store)."""
+        if self._store is None or self._fingerprint is None:
+            return None
+        if _artifacts.disabled():
+            return None
+        return self._store
+
+    def _artifact_key(self, bucket, sig):
+        return _artifacts.ArtifactKey(self._fingerprint, bucket, sig,
+                                      mesh="single")
+
+    def _bucket_state(self, bucket, sig):
+        """(flat_fn, param_arrays, buffer_arrays, specs, donate) for one
+        bucket — shared by the inline compile and the export publish so
+        the two can never drift (the published artifact IS the program
+        the inline path would have compiled)."""
         jax = self._jax
         layer = self._layer
-        n_in = len(sig)
 
         def flat_fn(param_list, buffer_list, *inputs):
             out = layer._call_fn(param_list, buffer_list, *inputs)
@@ -342,7 +384,207 @@ class AotLayerRunner:
                         for a in buffer_arrays]
         in_specs = [jax.ShapeDtypeStruct((bucket,) + tr, np.dtype(dt))
                     for dt, tr in sig]
-        donate = tuple(range(2, 2 + n_in)) if self._donate else ()
+        donate = tuple(range(2, 2 + len(sig))) if self._donate else ()
+        return (flat_fn, param_arrays, buffer_arrays,
+                (param_specs, buffer_specs, in_specs), donate)
+
+    def compile(self, bucket, sig, warming=False):
+        """-> (run, source): the bucket's program, loaded from the
+        artifact store (``source == "store"``) or compiled inline
+        (``"inline"``). Every store failure mode — miss, corrupt,
+        version skew, undeserializable, probe crash — degrades to the
+        inline path; a store can make this slower than compiling only
+        by the cost of one verified read.
+
+        ``warming``: warmup is where single-flight matters — N replicas
+        warming the same bucket ladder block briefly on one O_EXCL
+        lock so exactly one pays the compile and the rest load its
+        published artifact. The hot path never blocks on a peer: a
+        cold bucket under live traffic compiles inline immediately
+        (publishing in the background when it holds the lock)."""
+        store = self._active_store()
+        if store is None:
+            return self._compile_inline(bucket, sig), "inline"
+        key = self._artifact_key(bucket, sig)
+        lock = None
+        if warming:
+            # ONE counted lookup: acquire_or_wait reads the store
+            # itself (a warm uncontended key resolves on the first
+            # acquire+read) — a separate get() first would count every
+            # peer-published bucket as a miss AND a hit, pinning the
+            # hit-ratio of a perfectly warm store at 50%
+            lock, payload = store.acquire_or_wait(
+                key, timeout=self._warmup_wait_s)
+        else:
+            payload = store.get(key)
+        if payload is not None:
+            run = self._run_from_payload(store, key, payload, bucket, sig)
+            if run is not None:
+                return run, "store"
+            # the artifact was bad (now quarantined): try to claim the
+            # compile so a good one replaces it
+            lock = lock or store.try_acquire(key)
+        elif not warming:
+            lock = store.try_acquire(key)
+        if lock is not None:
+            # we own the fleet-wide compile for this key: ONE export
+            # (trace + StableHLO lower) serves BOTH the published
+            # artifact and this process's own program — re-tracing the
+            # whole model a second time just to publish would roughly
+            # double the cold-start cost peers are parked waiting on.
+            # Building our run from the same exported module the peers
+            # will load also makes the fleet byte-identical by
+            # construction.
+            try:
+                # timed end to end (export trace/lower + probe compile):
+                # this event is a real cold compile and must be
+                # comparable to the store-less path's aot events. One
+                # _bucket_state serves both steps — rebuilding it means
+                # re-wrapping every param/buffer per cold bucket.
+                t0 = time.monotonic()
+                state = self._bucket_state(bucket, sig)
+                exported = self._export(bucket, sig, state=state)
+                blob = serialize_exported(exported)
+                run = self._make_run(exported, bucket, sig, state=state)
+                LEDGER.record(f"serving/bucket{bucket}",
+                              duration_s=time.monotonic() - t0,
+                              kind="aot",
+                              extra={"bucket": bucket, "via": "export",
+                                     "signature": [[dt, list(tr)]
+                                                   for dt, tr in sig]})
+            except Exception:  # noqa: BLE001 - degrade to plain inline
+                # export or probe failed (not every program exports):
+                # free the peers NOW (they compile themselves instead
+                # of waiting out the staleness horizon on a corpse),
+                # then serve through the store-less path
+                store.release(lock)
+                return self._compile_inline(bucket, sig), "inline"
+            if warming:
+                # synchronous publish: peers blocked in acquire_or_wait
+                # are waiting for exactly this artifact
+                try:
+                    store.put(key, blob)
+                finally:
+                    store.release(lock)
+            else:
+                self._publish_in_background(store, key, lock, blob)
+            return run, "inline"
+        return self._compile_inline(bucket, sig), "inline"
+
+    def _make_run(self, exported, bucket, sig, state=None):
+        """run callable over an exported module, gated by everything
+        bytes alone cannot prove: its input avals match the params/
+        buffers/bucket we will call it with, and a zero-batch probe
+        executes (paying the XLA compile HERE, never on live traffic).
+        Raises on any mismatch/failure — callers decide between
+        quarantine (store loads) and inline fallback (own exports)."""
+        (_, param_arrays, buffer_arrays,
+         (param_specs, buffer_specs, in_specs), _) = \
+            state if state is not None else self._bucket_state(bucket, sig)
+        # canonicalize through jax's dtype rules (x64 disabled traces
+        # i64/f64 specs as i32/f32): the EXPORTED avals are always
+        # canonical, and the inline path canonicalizes identically at
+        # lowering — the two must be compared in the same space
+        canon = self._jax.dtypes.canonicalize_dtype
+        expect = [(tuple(s.shape), np.dtype(canon(s.dtype)))
+                  for s in (*param_specs, *buffer_specs, *in_specs)]
+        got = [(tuple(a.shape), np.dtype(a.dtype))
+               for a in exported.in_avals]
+        if got != expect:
+            raise ValueError(
+                f"aval mismatch: artifact {got} vs expected {expect}")
+
+        def run(batch_arrays):
+            out = exported.call(param_arrays, buffer_arrays, *batch_arrays)
+            return [np.asarray(o) for o in out]
+
+        probe = [np.zeros((bucket,) + tuple(tr), np.dtype(dt))
+                 for dt, tr in sig]
+        outs = run(probe)
+        for o in outs:
+            if getattr(o, "ndim", 0) == 0 or o.shape[0] != bucket:
+                raise ValueError(
+                    f"probe output shape {getattr(o, 'shape', ())} "
+                    f"does not keep the {bucket}-row batch dim")
+        return run
+
+    def _run_from_payload(self, store, key, payload, bucket, sig):
+        """Materialize a store artifact into a run callable, or None
+        (with the artifact quarantined) when anything about it is off.
+        The payload already passed sha256 verification; _make_run
+        checks the rest (deserializes under THIS runtime, aval match,
+        probe execution) — so a store-loaded program can never first
+        fail on live traffic."""
+        t0 = time.monotonic()
+        try:
+            exported = deserialize_exported(payload)
+            run = self._make_run(exported, bucket, sig)
+        except Exception as e:  # noqa: BLE001 - any bad artifact degrades
+            store.quarantine(key, str(e))
+            return None
+        # the ledger distinguishes store loads from real compiles, so
+        # single-flight across processes is assertable ("exactly one
+        # kind=aot event per bucket, fleet-wide") and perfproxy's
+        # compile counts never conflate a store miss with a regression
+        LEDGER.record(f"serving/bucket{bucket}",
+                      duration_s=time.monotonic() - t0, kind="store",
+                      extra={"bucket": bucket,
+                             "artifact": key.digest(),
+                             "signature": [[dt, list(tr)]
+                                           for dt, tr in sig]})
+        return run
+
+    def _export(self, bucket, sig, state=None):
+        """Export this bucket's program (the same flat_fn + specs +
+        donation the inline compile uses) — ONE trace + lower that the
+        publish path serializes and the winner's own run is built on."""
+        from jax import export as jax_export
+
+        jax = self._jax
+        flat_fn, _, _, (param_specs, buffer_specs, in_specs), donate = \
+            state if state is not None else self._bucket_state(bucket, sig)
+        with warnings.catch_warnings():
+            # same carve-out as the inline compile: unused donations on
+            # tiny models are an optimization miss, not noise-worthy
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            return jax_export.export(
+                jax.jit(flat_fn, donate_argnums=donate))(
+                    param_specs, buffer_specs, *in_specs)
+
+    def _export_bytes(self, bucket, sig):
+        """Serialized form of :meth:`_export` (the published payload)."""
+        return serialize_exported(self._export(bucket, sig))
+
+    def _publish_in_background(self, store, key, lock, blob):
+        """Publish off the hot path: the requester already has its
+        program and the bytes are already serialized — only the store
+        I/O runs on a daemon thread, so no request waits on disk. The
+        single-flight lock is held until the publish lands (released
+        in all cases — a crashed publisher's lock is reclaimed by
+        peers via the staleness takeover)."""
+        def work():
+            try:
+                store.put(key, blob)
+            finally:
+                store.release(lock)
+
+        threading.Thread(target=work, name="artifact-publish",
+                         daemon=True).start()
+
+    def store_stats(self):
+        store = self._active_store()
+        return store.stats() if store is not None else None
+
+    # ---------------------------------------------------- inline compile
+    def _compile_inline(self, bucket, sig):
+        """Lower + compile the bucket's program. Called once per bucket
+        by the engine's cache; the compiled callable takes the padded
+        numpy batch arrays and returns a list of numpy outputs."""
+        jax = self._jax
+        (flat_fn, param_arrays, buffer_arrays,
+         (param_specs, buffer_specs, in_specs), donate) = \
+            self._bucket_state(bucket, sig)
         t0 = time.monotonic()
         with warnings.catch_warnings():
             # tiny models may leave a donated batch buffer unused; that
@@ -389,7 +631,7 @@ class CallableRunner:
     def default_signature(self):
         return None
 
-    def compile(self, bucket, sig):
+    def compile(self, bucket, sig, warming=False):
         fn = self._fn
 
         def run(batch_arrays):
@@ -399,7 +641,10 @@ class CallableRunner:
             return [np.asarray(o._value if hasattr(o, "_value") else o)
                     for o in out]
 
-        return run
+        return run, "inline"
+
+    def store_stats(self):
+        return None
 
     def prime(self, run, bucket, sig):
         """Execute a zero batch so XLA traces+compiles this bucket now,
@@ -468,6 +713,20 @@ class BatchingEngine:
             cold_compile_timeout if cold_compile_timeout is not None
             else _env_float("PADDLE_TPU_SERVING_COLD_COMPILE_TIMEOUT",
                             300.0))
+        # old duck-typed runners (pre-artifact-store protocol) define
+        # compile(bucket, sig) -> run; the current protocol is
+        # compile(bucket, sig, warming=False) -> (run, source). Detect
+        # once here so both keep working — the same tolerance health()
+        # extends to runners without store_stats()
+        try:
+            import inspect
+
+            ps = inspect.signature(runner.compile).parameters
+            self._compile_takes_warming = (
+                "warming" in ps
+                or any(p.kind is p.VAR_KEYWORD for p in ps.values()))
+        except (TypeError, ValueError):
+            self._compile_takes_warming = True
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._pending = []  # FIFO of _Request
@@ -535,7 +794,9 @@ class BatchingEngine:
             "Watchdog scheduler restarts", const_labels=cl)
         self._m_compiles = M.Counter(
             "paddle_serving_compiles_total",
-            "Bucket program compiles", labelnames=("bucket",),
+            "Bucket program materializations (source: inline = a real "
+            "XLA compile; store = deserialized from the persistent "
+            "artifact store)", labelnames=("bucket", "source"),
             const_labels=cl)
         self._m_batches = M.Counter(
             "paddle_serving_batches_total",
@@ -591,10 +852,14 @@ class BatchingEngine:
 
     # ------------------------------------------------------- constructors
     @classmethod
-    def for_layer(cls, layer, donate=True, **kw):
+    def for_layer(cls, layer, donate=True, artifact_store=None, **kw):
         """Engine over a jit-loaded batch-polymorphic TranslatedLayer
-        (per-bucket AOT compile, donation on the batch buffers)."""
-        return cls(AotLayerRunner(layer, donate=donate), **kw)
+        (per-bucket AOT compile, donation on the batch buffers).
+        ``artifact_store``: a serialize.ArtifactStore for persistent
+        cross-process program reuse (default: env-gated
+        ``default_store()`` — PADDLE_TPU_ARTIFACT_DIR opts in)."""
+        return cls(AotLayerRunner(layer, donate=donate,
+                                  store=artifact_store), **kw)
 
     @classmethod
     def for_callable(cls, fn, **kw):
@@ -1170,21 +1435,27 @@ class BatchingEngine:
                                                self.breaker_cooldown)
         return br
 
-    def _compiled(self, bucket, sig, trace_id=None):
-        """Per-bucket compiled program; compiles exactly once per
-        (bucket, signature). Compiles run outside the lock (XLA can
-        take seconds; infer submissions must not block on them); an
-        in-flight event per key makes racing callers (warmup thread,
-        concurrent cold groups) WAIT for the one compile instead of
-        burning CPU redoing it N times. ``trace_id`` (a traced request
-        in the group that pays the compile) tags the serving.compile
-        span; warmup/untraced compiles only feed the summary table."""
+    def _compiled(self, bucket, sig, trace_id=None, warming=False):
+        """Per-bucket compiled program; materializes exactly once per
+        (bucket, signature) in-process — from the artifact store when
+        one is attached and has a verified program (source "store"),
+        inline otherwise (source "inline"). Returns (run, source)
+        where source is None for an in-process cache hit. Compiles run
+        outside the lock (XLA can take seconds; infer submissions must
+        not block on them); an in-flight event per key makes racing
+        callers (warmup thread, concurrent cold groups) WAIT for the
+        one compile instead of burning CPU redoing it N times.
+        ``warming`` flows to the runner: warmup may block on a peer
+        replica's single-flight compile, the hot path never does.
+        ``trace_id`` (a traced request in the group that pays the
+        compile) tags the serving.compile span; warmup/untraced
+        compiles only feed the summary table."""
         key = (bucket, sig)
         while True:
             with self._lock:
                 run = self._cache.get(key)
                 if run is not None:
-                    return run, False
+                    return run, None
                 ev = self._compiling.get(key)
                 if ev is None:
                     ev = self._compiling[key] = threading.Event()
@@ -1214,7 +1485,13 @@ class BatchingEngine:
                 chaos.hit("serving.compile")
                 chaos.hit(f"serving.compile.bucket{bucket}")
                 t0 = time.monotonic()
-                run = self._runner.compile(bucket, sig)
+                if self._compile_takes_warming:
+                    res = self._runner.compile(bucket, sig,
+                                               warming=warming)
+                else:
+                    res = self._runner.compile(bucket, sig)
+                run, source = (res if isinstance(res, tuple)
+                               else (res, "inline"))
             except BaseException:
                 with self._lock:
                     self._compiling.pop(key, None)
@@ -1224,16 +1501,21 @@ class BatchingEngine:
             if trace_id is not None:
                 obs_tracing.record_span("serving.compile", dt,
                                         trace_id=trace_id,
-                                        engine=self.name, bucket=bucket)
+                                        engine=self.name, bucket=bucket,
+                                        source=source)
             else:
                 obs_tracing.observe("serving.compile", dt)
             with self._lock:
                 self._cache[key] = run
-                self._stats_for(bucket, sig).compiles += 1
-                self._m_compiles.inc(bucket=str(bucket))
+                st = self._stats_for(bucket, sig)
+                if source == "store":
+                    st.store_loads += 1
+                else:
+                    st.compiles += 1
+                self._m_compiles.inc(bucket=str(bucket), source=source)
                 self._compiling.pop(key, None)
             ev.set()
-            return run, True
+            return run, source
 
     def warmup(self, buckets=None, signature=None):
         """Precompile bucket programs at server start so no request pays
@@ -1255,8 +1537,12 @@ class BatchingEngine:
         buckets = sorted({bucket_rows(int(b), self.max_batch_size)
                           for b in buckets})
         for b in buckets:
-            run, fresh = self._compiled(b, sig)
-            if fresh:
+            # warming=True: warmup is the single-flight window — N
+            # replicas warming the same ladder against a shared
+            # artifact store produce ONE compile per bucket (the rest
+            # block briefly and load the winner's published program)
+            run, source = self._compiled(b, sig, warming=True)
+            if source is not None:
                 # callable-backed runners compile lazily inside XLA's
                 # jit cache: prime with a zero batch so the "no request
                 # pays a compile" promise holds there too (no-op for
@@ -1317,6 +1603,8 @@ class BatchingEngine:
                 },
                 "compiles": sum(st.compiles
                                 for st in self._bucket_stats.values()),
+                "store_loads": sum(st.store_loads
+                                   for st in self._bucket_stats.values()),
                 "buckets": buckets,
             }
 
@@ -1328,6 +1616,11 @@ class BatchingEngine:
         scheduler alive, how stale is its heartbeat, which buckets are
         quarantined, how deep is the queue."""
         now = time.monotonic()
+        # store stats walk the artifact directory (file I/O): taken
+        # BEFORE the engine lock so a slow disk never stalls the
+        # serving hot path behind a health probe (getattr: custom
+        # duck-typed runners may predate store_stats)
+        store_stats = getattr(self._runner, "store_stats", lambda: None)()
         with self._lock:
             alive = self._scheduler.is_alive()
             quarantined = sorted(
@@ -1343,6 +1636,7 @@ class BatchingEngine:
                 "quarantined_buckets": quarantined,
                 "cold_compiles_inflight": len(self._cold_inflight),
                 "declared_buckets": list(self._declared),
+                "artifact_store": store_stats,
             }
 
     # -------------------------------------------------------------- close

@@ -549,7 +549,15 @@ class PredictorServer:
         # we read here is the one that is actually serving, and stop()
         # never waits out a multi-second model load
         try:
-            self._sock.close()  # unblocks accept(); no new connections
+            # shutdown BEFORE close: on Linux, close() alone does not
+            # wake a thread already blocked in accept() — the accept
+            # loop would park forever and anything join()ing it (a
+            # serve-until-stopped wrapper process) would hang with it
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()  # no new connections
         except OSError:
             pass
         with self._backend_lock:
@@ -594,8 +602,18 @@ def serve_model(path_prefix, port=0, dynamic_batching=False,
     jit.save) all connections share one BatchingEngine: requests
     coalesce into padded shape-bucket batches, declared buckets are
     precompiled up front, and saturation sheds as wire status 2. Extra
-    ``engine_kwargs`` (breaker_threshold, watchdog_interval, ...) pass
-    through to the BatchingEngine.
+    ``engine_kwargs`` (breaker_threshold, watchdog_interval,
+    artifact_store, ...) pass through to the BatchingEngine.
+
+    With ``PADDLE_TPU_ARTIFACT_DIR`` set (or an explicit
+    ``artifact_store=``), warmup — including the off-to-the-side warmup
+    a hot reload performs — loads each bucket's program from the
+    persistent compiled-artifact store instead of compiling: a fresh
+    replica process reaches its first healthy reply with zero XLA
+    compiles once any replica has published the ladder
+    (``bench.py coldstart`` measures exactly this), and a corrupt or
+    stale store entry silently degrades that bucket to an inline
+    compile (README "Artifact store" has the degradation matrix).
 
     ``metrics_port`` (0 = any free port) additionally serves the
     Prometheus text exposition of the process obs registry on

@@ -15,6 +15,8 @@ import jax.numpy as jnp
 from ..core import dispatch
 from ..core.tensor import Tensor
 from ..nn.layer import Layer
+from ..serialize.export import (deserialize_exported, model_fingerprint,
+                                serialize_exported)
 from .static_function import StaticFunction, _flatten_tensors
 
 
@@ -170,11 +172,15 @@ def write_artifacts(path, jitted_fn, state_specs, input_specs, params,
             from jax import export as jax_export
 
             exported = jax_export.export(jitted_fn)(*state_specs, *specs)
-            blob = exported.serialize()
+            blob = serialize_exported(exported)
             with open(path + ".pdmodel", "wb") as f:
                 f.write(blob)
             payload["format"] = "stablehlo"
             payload["polymorphic"] = poly
+            # content identity of the exported program (weights are
+            # runtime args): the serving engine keys its persistent
+            # compiled-artifact store on this
+            payload["fingerprint"] = model_fingerprint(blob)
             # record the shapes actually exported (symbolic dims
             # serialize as None; pinned dims as 1 on the fallback)
             payload["input_specs"] = [_json_spec(s) for s in specs]
@@ -207,6 +213,7 @@ def write_artifacts(path, jitted_fn, state_specs, input_specs, params,
         json.dump({"format": payload["format"],
                    "input_specs": payload["input_specs"],
                    "polymorphic": payload.get("polymorphic", False),
+                   "fingerprint": payload.get("fingerprint"),
                    "op_versions": payload["op_versions"],
                    "export_error": payload.get("export_error")}, f)
 
@@ -215,7 +222,7 @@ class TranslatedLayer(Layer):
     """Loaded inference layer (reference: dygraph/io.py TranslatedLayer)."""
 
     def __init__(self, call_fn, params, buffers, input_specs=None,
-                 polymorphic=False):
+                 polymorphic=False, fingerprint=None):
         super().__init__()
         self._call_fn = call_fn
         self._loaded_params = params
@@ -224,6 +231,10 @@ class TranslatedLayer(Layer):
         # True when the saved module has symbolic (None) dims: it can be
         # called — and AOT-compiled per shape bucket — at any size there
         self._polymorphic = bool(polymorphic)
+        # sha256 of the serialized module bytes (serialize.export): the
+        # identity the serving engine's artifact store keys on; None
+        # disables the store for engines over this layer
+        self._model_fingerprint = fingerprint
         for i, (n, a) in enumerate(params.items()):
             from ..core.tensor import Parameter
 
@@ -271,17 +282,20 @@ def load(path, **configs):
 
     op_version.check_compat(payload.get("op_versions"), where=path)
     if payload.get("format") == "stablehlo" and os.path.exists(path + ".pdmodel"):
-        from jax import export as jax_export
-
         with open(path + ".pdmodel", "rb") as f:
-            exported = jax_export.deserialize(f.read())
+            blob = f.read()
+        exported = deserialize_exported(blob)
 
         def call_fn(param_list, buffer_list, *inputs):
             return exported.call(param_list, buffer_list, *inputs)
 
+        # computed from the bytes (not trusted from the sidecar): old
+        # saves without a recorded fingerprint still key the artifact
+        # store correctly
         return TranslatedLayer(call_fn, params, buffers,
                                input_specs=payload.get("input_specs", []),
-                               polymorphic=payload.get("polymorphic", False))
+                               polymorphic=payload.get("polymorphic", False),
+                               fingerprint=model_fingerprint(blob))
     raise RuntimeError(
         f"model at {path} was saved without a serialized program "
         f"({payload.get('export_error')}); re-save with a supported spec")

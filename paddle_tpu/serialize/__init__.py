@@ -1,0 +1,41 @@
+"""paddle_tpu.serialize — one serialization format for compiled programs.
+
+Two cooperating pieces:
+
+- ``export``: thin, shared helpers over ``jax.export`` — serialize /
+  deserialize StableHLO modules, fingerprint a saved model, and name
+  the runtime (jax + jaxlib + backend) a compiled artifact is tied to.
+  ``jit.save`` / ``jit.load`` and the serving engine's per-bucket AOT
+  programs all speak this one wire format now.
+- ``artifact_store``: a crash-safe, content-addressed on-disk store of
+  those serialized programs, keyed by (model fingerprint, bucket,
+  signature, mesh, runtime version), with the resilience guarantees
+  the checkpoint store proved out: tmp-dir + ``os.replace`` atomic
+  publish, per-artifact ``MANIFEST.json`` sha256 self-verification,
+  verify-on-load with quarantine + fallback, multi-process
+  single-flight compile dedup, and retention GC. A fresh serving
+  replica warms its bucket ladder from the store instead of paying
+  multi-second XLA compiles — and a corrupt, torn, stale, or
+  version-skewed artifact can never take it down (README "Artifact
+  store" has the degradation matrix).
+"""
+from . import artifact_store  # noqa: F401
+from . import export  # noqa: F401
+from .artifact_store import (  # noqa: F401
+    ArtifactKey,
+    ArtifactStore,
+    default_store,
+)
+from .export import (  # noqa: F401
+    deserialize_exported,
+    model_fingerprint,
+    runtime_version,
+    serialize_exported,
+)
+
+__all__ = [
+    "artifact_store", "export",
+    "ArtifactKey", "ArtifactStore", "default_store",
+    "serialize_exported", "deserialize_exported",
+    "model_fingerprint", "runtime_version",
+]

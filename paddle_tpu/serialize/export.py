@@ -1,0 +1,73 @@
+"""Shared ``jax.export`` helpers — the one program wire format.
+
+``jit.save`` writes a serialized exported module (``.pdmodel``), the
+serving engine publishes per-bucket exported programs into the
+artifact store, and both read them back through here. Centralizing the
+calls keeps the format decisions (and their failure modes) in one
+place:
+
+- ``serialize_exported`` / ``deserialize_exported``: byte-level
+  round-trip of a ``jax.export.Exported``. Serialization is
+  deterministic for a fixed program + jaxlib (verified in
+  tests/test_artifact_store.py), which is what makes the artifact
+  store content-addressable and lets jax's persistent compile cache
+  key stably on the deserialized module across processes.
+- ``model_fingerprint``: sha256 of the serialized module bytes. The
+  compiled program depends on the traced computation and the
+  shapes/dtypes of its inputs — not on weight *values* (weights are
+  runtime arguments) — so the module bytes are exactly the right
+  identity for the artifact-store key.
+- ``runtime_version``: the jax/jaxlib/backend triple an artifact is
+  tied to. A deserialized module is only guaranteed loadable under a
+  compatible runtime, so this string is part of the store key: a
+  version skew is a clean store *miss* (recompile), never a crash.
+
+A **bit-flipped export blob can deserialize and execute silently
+wrong** (measured on jaxlib 0.4.37: the flatbuffer has no integrity
+check of the embedded StableHLO payload) — which is why every consumer
+of these bytes must verify a sha256 over them BEFORE deserializing.
+The artifact store's MANIFEST does exactly that; ``jit.load`` trusts
+local files the same way it always has.
+"""
+import hashlib
+
+
+def serialize_exported(exported):
+    """``jax.export.Exported`` -> bytes (the one on-disk format)."""
+    return exported.serialize()
+
+
+def deserialize_exported(blob):
+    """bytes -> ``jax.export.Exported``. Raises on any malformed or
+    version-incompatible payload — callers that cannot tolerate a
+    raise (the artifact store load path) catch broadly and degrade."""
+    from jax import export as jax_export
+
+    return jax_export.deserialize(blob)
+
+
+def model_fingerprint(module_bytes):
+    """Content identity of a saved model: sha256 hex over its
+    serialized exported-module bytes."""
+    return hashlib.sha256(module_bytes).hexdigest()
+
+
+def runtime_version(backend=None):
+    """The runtime an exported artifact is tied to, as one stable
+    string: ``jax-<ver>/jaxlib-<ver>/<platform>``. Part of the
+    artifact-store key, so artifacts written by a different runtime
+    are simply never found (a miss, not a corruption)."""
+    import jax
+
+    try:
+        import jaxlib
+
+        jl = getattr(jaxlib, "__version__", "unknown")
+    except Exception:  # noqa: BLE001 - jaxlib may not expose a version
+        jl = "unknown"
+    if backend is None:
+        try:
+            backend = jax.default_backend()
+        except Exception:  # noqa: BLE001 - no backend yet: still keyable
+            backend = "unknown"
+    return f"jax-{jax.__version__}/jaxlib-{jl}/{backend}"

@@ -1,0 +1,63 @@
+"""Subprocess worker for the artifact-store multi-process tests: load
+a jit-saved model, warm a BatchingEngine's bucket ladder against a
+shared artifact store, and dump what happened (per-bucket ledger event
+kinds, engine stats, store stats) as JSON for the parent to assert
+single-flight and takeover behaviour on.
+
+Usage: python tests/artifact_worker.py <model_prefix> <store_dir> \
+           <outfile> [max_batch_size]
+
+PADDLE_TPU_CHAOS (resilience.chaos.arm_from_env) injects faults — the
+SIGKILL-mid-publish case arms ``site=artifact.put.publish,signum=9``.
+"""
+import json
+import os
+import sys
+
+
+def main():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    prefix, store_dir, outfile = sys.argv[1], sys.argv[2], sys.argv[3]
+    max_bs = int(sys.argv[4]) if len(sys.argv) > 4 else 4
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu.inference.batching import BatchingEngine
+    from paddle_tpu.jit import load as jit_load
+    from paddle_tpu.obs.ledger import LEDGER
+    from paddle_tpu.resilience import chaos
+    from paddle_tpu.serialize.artifact_store import ArtifactStore
+
+    chaos.arm_from_env()
+    layer = jit_load(prefix)
+    store = ArtifactStore(store_dir)
+    engine = BatchingEngine.for_layer(layer, max_batch_size=max_bs,
+                                      artifact_store=store)
+    buckets = engine.warmup()
+
+    import numpy as np
+
+    x = np.ones((2, 8), np.float32)
+    out = engine.infer([x])
+    stats = engine.stats()
+    engine.close()
+
+    events = [{"key": e["key"], "kind": e["kind"],
+               "bucket": e.get("bucket")}
+              for e in LEDGER.events("serving/")]
+    with open(outfile + ".tmp", "w") as f:
+        json.dump({"pid": os.getpid(),
+                   "buckets": buckets,
+                   "events": events,
+                   "compiles": stats["compiles"],
+                   "store_loads": stats["store_loads"],
+                   "store": store.stats(),
+                   "out_sha": __import__("hashlib").sha256(
+                       out[0].tobytes()).hexdigest()}, f)
+    os.replace(outfile + ".tmp", outfile)
+
+
+if __name__ == "__main__":
+    main()

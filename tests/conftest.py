@@ -28,6 +28,21 @@ _CACHE_DIR = os.environ.get(
 if _CACHE_DIR != "0":
     try:
         os.makedirs(_CACHE_DIR, exist_ok=True)
+        # Corruption guard: a run killed mid-write (SIGKILL, timeout,
+        # full disk) can leave a truncated entry behind. jax itself
+        # degrades a garbage entry to a warning + recompile at read
+        # time (regression-tested in test_compile_cache_guard.py), but
+        # zero-byte files are pure dead weight and the cheapest
+        # corruption to detect — scrub them up front so the cache dir
+        # can never accumulate torn writes. Everything here is
+        # best-effort: a broken cache must never fail the suite.
+        for _fn in os.listdir(_CACHE_DIR):
+            _full = os.path.join(_CACHE_DIR, _fn)
+            try:
+                if os.path.isfile(_full) and os.path.getsize(_full) == 0:
+                    os.unlink(_full)
+            except OSError:
+                pass
         jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

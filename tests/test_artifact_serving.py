@@ -1,0 +1,447 @@
+"""Serving-engine integration of the persistent compiled-artifact
+store (ISSUE 10 acceptance suite, ``artifacts``-marked; tools/ci_gate
+--artifacts runs it as its own stage).
+
+The adversarial contract: a store artifact that is bit-flipped,
+truncated mid-publish (SIGKILL via the chaos harness), version-skewed,
+wrong-keyed, or undeserializable must ALWAYS degrade to a correct
+inline compile — bitwise-identical outputs vs a store-less engine,
+quarantine counters incremented, no artifact ever served twice after
+failing verification, zero crashes. Multi-process warmup of one bucket
+ladder performs exactly one compile per bucket fleet-wide (single-
+flight), including when a warming process dies mid-publish (lockfile
+takeover).
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.inference.batching import BatchingEngine
+from paddle_tpu.jit import load as jit_load
+from paddle_tpu.obs.ledger import LEDGER
+from paddle_tpu.resilience import chaos
+from paddle_tpu.serialize import artifact_store as A
+from paddle_tpu.serialize.artifact_store import ArtifactStore, PAYLOAD_NAME
+from paddle_tpu.static import InputSpec
+
+pytestmark = pytest.mark.artifacts  # ci_gate --artifacts runs -m artifacts
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "artifact_worker.py")
+
+
+class _MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+class _IntOps(nn.Layer):
+    def forward(self, x):
+        return x * 3 + 1
+
+
+class _BoolOps(nn.Layer):
+    def forward(self, x):
+        return paddle.logical_not(x)
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+@pytest.fixture(scope="module")
+def mlp_prefix(tmp_path_factory):
+    paddle.seed(0)
+    m = _MLP()
+    m.eval()
+    prefix = str(tmp_path_factory.mktemp("artifact-serving") / "mlp")
+    paddle.jit.save(m, prefix, input_spec=[InputSpec([None, 8], "float32")])
+    return prefix
+
+
+def _store(tmp_path, **kw):
+    kw.setdefault("stale_s", 600.0)
+    return ArtifactStore(str(tmp_path / "store"), **kw)
+
+
+def _engine(prefix, store=None, max_bs=4):
+    return BatchingEngine.for_layer(jit_load(prefix), max_batch_size=max_bs,
+                                    artifact_store=store)
+
+
+def _payload_paths(store):
+    return [os.path.join(store.root, d, PAYLOAD_NAME)
+            for d in sorted(os.listdir(store.root)) if d.startswith("art-")]
+
+
+class TestStoreRoundTrip:
+    def test_warm_engine_loads_everything_and_is_bitwise_equal(
+            self, tmp_path, mlp_prefix):
+        rng = np.random.RandomState(7)
+        x = rng.randn(3, 8).astype(np.float32)
+        # reference: a store-less engine (what "no cache" would serve)
+        ref = _engine(mlp_prefix)
+        ref.warmup()
+        want = ref.infer([x])
+        ref.close()
+
+        store = _store(tmp_path)
+        e1 = _engine(mlp_prefix, store)
+        assert e1.warmup() == [1, 2, 4]
+        s1 = e1.stats()
+        assert s1["compiles"] == 3 and s1["store_loads"] == 0
+        got1 = e1.infer([x])
+        e1.close()
+
+        # "fresh replica": new engine over the same store
+        warm_store = _store(tmp_path)
+        e2 = _engine(mlp_prefix, warm_store)
+        e2.warmup()
+        s2 = e2.stats()
+        assert s2["compiles"] == 0 and s2["store_loads"] == 3
+        # a perfectly warm warmup is pure hits: no phantom miss per
+        # bucket (one counted lookup per key, not a get + a wait)
+        ws = warm_store.stats()
+        assert ws["hits"] == 3 and ws["misses"] == 0, ws
+        got2 = e2.infer([x])
+        # per-bucket stats carry the source split for cmd-5 consumers
+        for rows in e2.stats()["buckets"].values():
+            for d in rows:
+                assert d["compiles"] == 0 and d["store_loads"] == 1
+        e2.close()
+
+        assert want[0].tobytes() == got1[0].tobytes() == got2[0].tobytes()
+
+    @pytest.mark.parametrize("name,layer_cls,dtype,gen", [
+        ("f32", _MLP, "float32",
+         lambda rng, n: rng.randn(n, 8).astype(np.float32)),
+        ("i32", _IntOps, "int32",
+         lambda rng, n: rng.randint(-9, 9, (n, 8)).astype(np.int32)),
+        ("i64", _IntOps, "int64",
+         lambda rng, n: rng.randint(-9, 9, (n, 8)).astype(np.int64)),
+        ("bool", _BoolOps, "bool",
+         lambda rng, n: rng.rand(n, 8) > 0.5),
+    ])
+    def test_store_program_bitwise_equals_inline_per_wire_dtype(
+            self, tmp_path, name, layer_cls, dtype, gen):
+        """Satellite: the jax.export round trip through the store is
+        bitwise-equivalent to the inline-compiled program for every
+        wire dtype."""
+        paddle.seed(0)
+        m = layer_cls()
+        m.eval()
+        prefix = str(tmp_path / f"m-{name}")
+        paddle.jit.save(m, prefix,
+                        input_spec=[InputSpec([None, 8], dtype)])
+        rng = np.random.RandomState(3)
+        x = gen(rng, 3)
+
+        inline = _engine(prefix)
+        inline.warmup()
+        want = inline.infer([x])
+        inline.close()
+
+        store = _store(tmp_path)
+        publisher = _engine(prefix, store)
+        publisher.warmup()
+        publisher.close()
+        loaded = _engine(prefix, _store(tmp_path))
+        loaded.warmup()
+        st = loaded.stats()
+        assert st["compiles"] == 0 and st["store_loads"] == 3
+        got = loaded.infer([x])
+        loaded.close()
+        assert want[0].dtype == got[0].dtype
+        assert want[0].tobytes() == got[0].tobytes()
+
+
+class TestPoisonedStore:
+    def _published(self, tmp_path, mlp_prefix):
+        store = _store(tmp_path)
+        e = _engine(mlp_prefix, store)
+        e.warmup()
+        e.close()
+        return store
+
+    def test_bit_flipped_artifacts_degrade_bitwise_correct(
+            self, tmp_path, mlp_prefix):
+        store = self._published(tmp_path, mlp_prefix)
+        for p in _payload_paths(store):
+            with open(p, "r+b") as f:
+                data = bytearray(f.read())
+                data[len(data) // 2] ^= 0xFF
+                f.seek(0)
+                f.write(data)
+        before = A._CORRUPT.value()
+        ref = _engine(mlp_prefix)
+        ref.warmup()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            eng = _engine(mlp_prefix, _store(tmp_path))
+            eng.warmup()
+        st = eng.stats()
+        # every bucket degraded to a correct inline compile...
+        assert st["compiles"] == 3 and st["store_loads"] == 0
+        assert A._CORRUPT.value() - before == 3
+        x = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+        assert eng.infer([x])[0].tobytes() == ref.infer([x])[0].tobytes()
+        # ...and the republished (good) artifacts replaced the bad ones
+        eng.close()
+        ref.close()
+
+    def test_quarantined_artifact_never_served_twice(self, tmp_path,
+                                                     mlp_prefix):
+        store = self._published(tmp_path, mlp_prefix)
+        p = _payload_paths(store)[0]
+        with open(p, "r+b") as f:
+            f.truncate(10)
+        loader_store = _store(tmp_path)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            eng = _engine(mlp_prefix, loader_store)
+            eng.warmup()
+        eng.close()
+        # the engine republished a good artifact under the same key,
+        # but THIS process must never trust that digest again
+        layer = jit_load(mlp_prefix)
+        from paddle_tpu.inference.batching import AotLayerRunner
+
+        runner = AotLayerRunner(layer, store=loader_store)
+        sig = runner.default_signature()
+        bad_key = None
+        for b in (1, 2, 4):
+            k = runner._artifact_key(b, sig)
+            if loader_store.is_quarantined(k):
+                bad_key = k
+        assert bad_key is not None
+        assert loader_store.get(bad_key) is None
+
+    def test_wrong_bucket_artifact_fails_aval_check(self, tmp_path,
+                                                    mlp_prefix):
+        """An artifact that VERIFIES byte-wise but was exported for a
+        different bucket (wrong-keyed publish) must fail the aval check
+        and degrade — never raise mid-batch with a shape error."""
+        store = _store(tmp_path)
+        layer = jit_load(mlp_prefix)
+        from paddle_tpu.inference.batching import AotLayerRunner
+
+        runner = AotLayerRunner(layer, store=store)
+        sig = runner.default_signature()
+        blob_b2 = runner._export_bytes(2, sig)
+        key_b4 = runner._artifact_key(4, sig)
+        assert store.put(key_b4, blob_b2)  # poisoned publish
+        before = A._CORRUPT.value()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            run, source = runner.compile(4, sig)
+        assert source == "inline"  # degraded
+        assert A._CORRUPT.value() - before == 1
+        out = run([np.zeros((4, 8), np.float32)])
+        assert out[0].shape[0] == 4
+
+    def test_version_skew_is_miss_not_crash(self, tmp_path, mlp_prefix):
+        store = self._published(tmp_path, mlp_prefix)
+        # a future runtime writes under a different version key: this
+        # runtime simply never finds those artifacts
+        layer = jit_load(mlp_prefix)
+        from paddle_tpu.inference.batching import AotLayerRunner
+
+        runner = AotLayerRunner(layer, store=_store(tmp_path))
+        sig = runner.default_signature()
+        skewed = A.ArtifactKey(runner._fingerprint, 2, sig,
+                               version="jax-9.9/jaxlib-9.9/tpu")
+        before = A._CORRUPT.value()
+        assert _store(tmp_path).get(skewed) is None
+        assert A._CORRUPT.value() == before
+
+    def test_chaos_get_failure_degrades_warmup(self, tmp_path,
+                                               mlp_prefix):
+        self._published(tmp_path, mlp_prefix)
+        chaos.arm("artifact.get", exc=OSError("store fs died"), times=99)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            eng = _engine(mlp_prefix, _store(tmp_path))
+            eng.warmup()
+        st = eng.stats()
+        assert st["compiles"] == 3  # all inline, zero crashes
+        eng.close()
+
+    def test_disable_env_bypasses_store(self, tmp_path, mlp_prefix,
+                                        monkeypatch):
+        store = self._published(tmp_path, mlp_prefix)
+        assert store.stats()["artifacts"] == 3
+        monkeypatch.setenv("PADDLE_TPU_ARTIFACT_DISABLE", "1")
+        eng = _engine(mlp_prefix, _store(tmp_path))
+        eng.warmup()
+        st = eng.stats()
+        assert st["compiles"] == 3 and st["store_loads"] == 0
+
+
+class TestServerIntegration:
+    def test_hot_reload_warms_from_store(self, tmp_path, mlp_prefix):
+        """PR 5's 'zero cold compiles' on reload now holds across
+        PROCESSES: the reloaded engine loads every declared bucket
+        from the store instead of recompiling."""
+        from paddle_tpu.inference.server import serve_model
+
+        store_dir = str(tmp_path / "store")
+        # a previous replica published the ladder
+        pub = _engine(mlp_prefix, ArtifactStore(store_dir), max_bs=4)
+        pub.warmup()
+        pub.close()
+
+        srv = serve_model(mlp_prefix, dynamic_batching=True,
+                          max_batch_size=4,
+                          artifact_store=ArtifactStore(store_dir))
+        try:
+            s0 = srv._backend()[1].stats()
+            assert s0["compiles"] == 0 and s0["store_loads"] == 3
+            info = srv.reload()
+            assert info["reloaded"] and info["warm_buckets"] == [1, 2, 4]
+            s1 = srv._backend()[1].stats()
+            assert s1["compiles"] == 0 and s1["store_loads"] == 3
+        finally:
+            srv.stop(drain=False)
+
+
+class TestMultiProcess:
+    def _spawn(self, mlp_prefix, store_dir, outfile, extra_env=None):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        env.pop("PADDLE_TPU_ARTIFACT_DISABLE", None)
+        env.update(extra_env or {})
+        return subprocess.Popen(
+            [sys.executable, WORKER, mlp_prefix, store_dir, outfile],
+            env=env, cwd=REPO)
+
+    def _collect(self, outfiles, timeout=240.0):
+        deadline = time.monotonic() + timeout
+        results = []
+        for of in outfiles:
+            while not os.path.exists(of):
+                assert time.monotonic() < deadline, f"worker {of} timed out"
+                time.sleep(0.1)
+            with open(of) as f:
+                results.append(json.load(f))
+        return results
+
+    @pytest.mark.slow
+    def test_four_process_warmup_single_flight(self, tmp_path,
+                                               mlp_prefix):
+        """Acceptance: 4 replicas warming the same bucket ladder pay
+        exactly ONE compile per bucket fleet-wide (asserted via each
+        process's compile ledger), and every replica serves identical
+        bytes."""
+        store_dir = str(tmp_path / "store")
+        outs = [str(tmp_path / f"rank{i}.json") for i in range(4)]
+        procs = [self._spawn(mlp_prefix, store_dir, of) for of in outs]
+        try:
+            results = self._collect(outs)
+        finally:
+            for p in procs:
+                p.wait(timeout=60)
+        for p in procs:
+            assert p.returncode == 0
+        # fleet-wide: each bucket was inline-compiled exactly once
+        aot_by_bucket = {}
+        for r in results:
+            for ev in r["events"]:
+                if ev["kind"] == "aot":
+                    aot_by_bucket[ev["bucket"]] = \
+                        aot_by_bucket.get(ev["bucket"], 0) + 1
+        assert aot_by_bucket == {1: 1, 2: 1, 4: 1}, results
+        # every rank materialized the full ladder, identical outputs
+        for r in results:
+            assert r["compiles"] + r["store_loads"] == 3
+        assert len({r["out_sha"] for r in results}) == 1
+        # no lockfiles left behind
+        assert not [n for n in os.listdir(store_dir)
+                    if n.startswith(".lock-")]
+
+    @pytest.mark.slow
+    def test_sigkill_mid_publish_takeover(self, tmp_path, mlp_prefix):
+        """Acceptance: a warming process SIGKILL'd mid-publish (torn
+        publish) never wedges the others — its lock is taken over,
+        the bucket is compiled exactly once by the survivors, and the
+        store never serves a partial artifact."""
+        store_dir = str(tmp_path / "store")
+        victim_out = str(tmp_path / "victim.json")
+        victim = self._spawn(
+            mlp_prefix, store_dir, victim_out,
+            # die at the first publish, between payload write and the
+            # atomic os.replace — the torn-publish window
+            {"PADDLE_TPU_CHAOS":
+             "site=artifact.put.publish,signum=9,at=1"})
+        victim.wait(timeout=240)
+        assert victim.returncode == -9  # SIGKILL'd as armed
+        assert not os.path.exists(victim_out)
+        # the victim died holding bucket 1's single-flight lock
+        held = [n for n in os.listdir(store_dir)
+                if n.startswith(".lock-")]
+        assert held, "victim should have died holding its lock"
+
+        outs = [str(tmp_path / f"rank{i}.json") for i in range(3)]
+        procs = [self._spawn(mlp_prefix, store_dir, of) for of in outs]
+        try:
+            results = self._collect(outs)
+        finally:
+            for p in procs:
+                p.wait(timeout=60)
+        for p in procs:
+            assert p.returncode == 0
+        aot_by_bucket = {}
+        for r in results:
+            for ev in r["events"]:
+                if ev["kind"] == "aot":
+                    aot_by_bucket[ev["bucket"]] = \
+                        aot_by_bucket.get(ev["bucket"], 0) + 1
+        # survivors: exactly one compile per bucket (the victim's
+        # partial work is invisible — tmp dir, never published)
+        assert aot_by_bucket == {1: 1, 2: 1, 4: 1}, results
+        assert sum(r["store"]["takeovers"] for r in results) >= 1
+        assert len({r["out_sha"] for r in results}) == 1
+        # the torn publish never became a visible artifact without
+        # verification: whatever is on disk now verifies
+        st = ArtifactStore(store_dir)
+        assert st.stats()["artifacts"] == 3
+
+
+class TestBackgroundPublish:
+    def test_cold_traffic_compile_publishes_in_background(
+            self, tmp_path, mlp_prefix):
+        """The hot path never blocks on store I/O: a cold bucket under
+        live traffic compiles inline immediately and the publish lands
+        asynchronously."""
+        store = _store(tmp_path)
+        eng = _engine(mlp_prefix, store)
+        # NO warmup: traffic hits a cold bucket
+        x = np.random.RandomState(1).randn(2, 8).astype(np.float32)
+        eng.infer([x])
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if store.stats()["artifacts"] >= 1 and not [
+                    n for n in os.listdir(store.root)
+                    if n.startswith(".lock-")]:
+                break
+            time.sleep(0.05)
+        st = store.stats()
+        assert st["artifacts"] >= 1 and st["publishes"] >= 1
+        eng.close()

@@ -251,3 +251,41 @@ class TestBenchContract:
         assert 0 <= rec["vs_baseline"] <= 1.5
         assert rec["roofline_tokens_per_sec"] > 0
         assert rec["smoke"] is True
+
+
+class TestColdstartContract:
+    """`bench.py coldstart` JSON contract (ISSUE 10 acceptance): a
+    warm-store fresh-process serve_model reaches its first healthy
+    reply with zero inline engine compiles, and a poisoned store
+    degrades to inline compiles with bitwise-identical replies."""
+
+    @pytest.mark.slow  # three serve_model subprocesses
+    @pytest.mark.artifacts  # ci_gate --artifacts runs this
+    def test_coldstart_mode_metric_fields(self):
+        r = _run({"BENCH_COLDSTART_TIMEOUT": "120"}, timeout=420,
+                 argv=("coldstart",))
+        assert r.returncode == 0, r.stdout + r.stderr
+        rec = _one_json_line(r.stdout)
+        assert rec["metric"] == \
+            "serving_coldstart_first_healthy_reply_seconds"
+        assert rec["unit"] == "s" and rec["value"] > 0
+        phases = rec["phases"]
+        assert set(phases) == {"cold", "warm", "poisoned"}
+        for ph in phases.values():
+            for k in ("t_first_healthy_reply_s", "compiles",
+                      "store_loads", "store_corrupt"):
+                assert k in ph
+        # cold: every bucket compiled inline, nothing to load
+        assert phases["cold"]["compiles"] > 0
+        assert phases["cold"]["store_loads"] == 0
+        # warm: the zero-cold-start contract — ZERO engine compiles
+        assert phases["warm"]["compiles"] == 0
+        assert phases["warm"]["store_loads"] > 0
+        assert rec["warm_zero_engine_compiles"] is True
+        # poisoned: every artifact quarantined, inline fallback, and
+        # the reply still bitwise-identical across all three phases
+        assert phases["poisoned"]["store_corrupt"] > 0
+        assert phases["poisoned"]["compiles"] > 0
+        assert rec["poisoned_degraded_inline"] is True
+        assert rec["replies_bitwise_equal"] is True
+        assert rec["poisoned_artifacts"] > 0

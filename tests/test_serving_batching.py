@@ -472,10 +472,10 @@ class TestEngineGuards:
             def default_signature(self):
                 return None
 
-            def compile(self, bucket, sig):
+            def compile(self, bucket, sig, warming=False):
                 if sig[0][1] == (3,):  # the cold sig compiles slowly
                     release.wait(10)
-                return lambda batch: [np.asarray(batch[0])]
+                return (lambda batch: [np.asarray(batch[0])]), "inline"
 
             def prime(self, run, bucket, sig):
                 pass
@@ -523,6 +523,32 @@ class TestEngineGuards:
         finally:
             engine.close()
 
+    def test_old_protocol_runner_still_works(self):
+        # pre-artifact-store duck-typed runners (compile(bucket, sig)
+        # -> bare run) must keep working: the engine detects the old
+        # signature and normalizes the return (MIGRATION.md)
+        class OldRunner:
+            def default_signature(self):
+                return None
+
+            def compile(self, bucket, sig):
+                return lambda batch: [np.asarray(batch[0]) * 2]
+
+            def prime(self, run, bucket, sig):
+                pass
+
+        engine = BatchingEngine(OldRunner(), max_batch_size=2,
+                                max_wait_ms=1.0)
+        try:
+            engine.warmup(signature=[("float32", (3,))])
+            x = np.arange(6, dtype=np.float32).reshape(2, 3)
+            out = engine.infer([x])
+            assert out[0].tolist() == (x * 2).tolist()
+            st = engine.stats()
+            assert st["compiles"] == 2 and st["store_loads"] == 0
+        finally:
+            engine.close()
+
     def test_concurrent_cold_groups_compile_once(self):
         # N same-signature groups arriving while the bucket is still
         # compiling must wait on the one in-flight compile, not each
@@ -534,10 +560,10 @@ class TestEngineGuards:
             def default_signature(self):
                 return None
 
-            def compile(self, bucket, sig):
+            def compile(self, bucket, sig, warming=False):
                 compiles.append(bucket)
                 gate.wait(10)  # hold the first compile open
-                return lambda batch: [np.asarray(batch[0])]
+                return (lambda batch: [np.asarray(batch[0])]), "inline"
 
             def prime(self, run, bucket, sig):
                 pass

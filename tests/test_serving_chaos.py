@@ -840,7 +840,7 @@ class TestHealthAndReload:
                 return [("float32", (2,))]
 
         class BadRunner(SigRunner):
-            def compile(self, bucket, sig):
+            def compile(self, bucket, sig, warming=False):
                 raise RuntimeError("bad model: compile exploded")
 
         made = []

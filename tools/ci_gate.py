@@ -6,7 +6,7 @@ Usage:
     python tools/ci_gate.py [--paths paddle_tpu]
         [--skip-tests] [--pytest-args "tests/ -q -m 'not slow'"]
         [--disable TPU005,...] [--chaos] [--serving] [--serving-chaos]
-        [--elastic] [--perfproxy] [--concurrency]
+        [--elastic] [--artifacts] [--perfproxy] [--concurrency]
         [--clean-paths paddle_tpu/resilience paddle_tpu/inference
          paddle_tpu/obs paddle_tpu/analysis]
 
@@ -17,7 +17,12 @@ directives: every suppression is listed for reviewers, and any found
 under a ``--clean-paths`` prefix (default: the resilience subsystem,
 which must stay TPU001–TPU008 clean) fails the gate. Phase 3 runs the
 tier-1 pytest command (ROADMAP.md) — ``--skip-tests`` elides it,
-``--pytest-args`` overrides the selection. ``--chaos`` adds a fourth
+``--pytest-args`` overrides the selection. With the default selection
+the stage diffs the observed failure set against the committed
+``KNOWN_FAILURES.json``: a failure NOT on the list fails the gate even
+when the total count matches HEAD's, and a listed test that passes
+also fails the gate until it is removed from the list (fixes are
+recorded, never silently absorbed). ``--chaos`` adds a fourth
 stage running the fault-injection suite (``-m chaos``) on its own, so
 recovery paths are exercised and reported separately from the
 functional tests. ``--serving`` adds a stage running the
@@ -30,7 +35,11 @@ self-healing invariants gate releases on their own line. ``--elastic``
 adds a stage running the elastic pod-scale training suite
 (``-m elastic``: multi-process preemption consensus, reshard-on-resume,
 straggler detection, and the goodput bench contract — subprocess pods,
-so it owns its own budget line). ``--perfproxy``
+so it owns its own budget line). ``--artifacts`` adds a stage running
+the compiled-artifact-store suite (``-m artifacts``: bit-flip /
+torn-publish / version-skew chaos, multi-process single-flight warmup
+races, and the coldstart bench contract), excluded from tier-1 by the
+same compositional double-run guard as serving/elastic. ``--perfproxy``
 adds a stage running ``bench.py perfproxy`` on CPU against the
 committed PERFPROXY_BASELINE.json — compile counts, HLO op counts, and
 cost-analysis FLOPs must match, so single-chip perf can't silently rot
@@ -71,6 +80,10 @@ SERVING_CHAOS_PYTEST_ARGS = ("tests/ -q -m 'chaos and serving' "
 # (including its slow-marked subprocess cases and the goodput bench
 # contract) runs as its own stage
 ELASTIC_PYTEST_ARGS = "tests/ -q -m elastic -p no:cacheprovider"
+# the artifact-store suite: chaos (bit-flip / torn publish / version
+# skew) + multi-process single-flight warmup cases, including its
+# slow-marked subprocess races and the coldstart bench contract
+ARTIFACTS_PYTEST_ARGS = "tests/ -q -m artifacts -p no:cacheprovider"
 # subsystems that must stay suppression-free: resilience (PR 2), the
 # serving stack (PRs 4-5), the telemetry layer (PR 7), and the analyzer
 # itself (PR 8) fix findings instead of silencing them. One carve-out:
@@ -80,7 +93,39 @@ ELASTIC_PYTEST_ARGS = "tests/ -q -m elastic -p no:cacheprovider"
 # directive WITHOUT a justification, or any trace-safety `tracelint:`
 # suppression, still fails.
 DEFAULT_CLEAN_PATHS = ("paddle_tpu/resilience", "paddle_tpu/inference",
-                       "paddle_tpu/obs", "paddle_tpu/analysis")
+                       "paddle_tpu/obs", "paddle_tpu/analysis",
+                       "paddle_tpu/serialize")
+
+# The committed record of pre-existing tier-1 failures. The tier-1
+# stage diffs its observed failure set against this list: a NEW
+# failure can no longer hide inside "same N failures as HEAD", and a
+# failure that stops failing must be removed from the list (the gate
+# fails until it is) — fixes get recorded, not silently absorbed.
+KNOWN_FAILURES_FILE = os.path.join(REPO, "KNOWN_FAILURES.json")
+
+# parsed ONLY inside pytest's "short test summary info" section: a
+# failing test that logs at ERROR level emits "ERROR    root:file:5 ..."
+# captured-log lines at column 0 earlier in the output, which must not
+# be read as nodeids.
+_FAILLINE_RE = re.compile(r"^(?:FAILED|ERROR) (.+)$")
+_SUMMARY_HDR_RE = re.compile(r"=+ short test summary info =+")
+
+
+def _nodeid_of_summary_line(rest):
+    """Strip pytest's ``" - <message>"`` suffix off a short-summary
+    line's tail, leaving the nodeid. The separator is the first
+    ``" - "`` OUTSIDE parametrize brackets — a nodeid like
+    ``test_x[a - b]`` must survive intact, so a plain split would
+    truncate it mid-id."""
+    depth = 0
+    for i, ch in enumerate(rest):
+        if ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth = max(0, depth - 1)
+        elif depth == 0 and rest.startswith(" - ", i):
+            return rest[:i]
+    return rest
 
 LOCKTRACE_PYTEST_ARGS = "tests/test_locktrace.py -q -p no:cacheprovider"
 
@@ -181,6 +226,55 @@ def run_pytest(pytest_args):
     return proc.returncode
 
 
+def run_pytest_capturing_failures(pytest_args):
+    """run_pytest, but stream-capture the output and parse the failed
+    nodeids out of pytest's short-summary ``FAILED``/``ERROR`` lines.
+    Returns (returncode, sorted failed-nodeid list)."""
+    cmd = [sys.executable, "-m", "pytest", *shlex.split(pytest_args)]
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS",
+                                                        "cpu"))
+    proc = subprocess.Popen(cmd, cwd=REPO, env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    failed = set()
+    in_summary = False
+    for line in proc.stdout:
+        print(line, end="")
+        if _SUMMARY_HDR_RE.search(line):
+            in_summary = True
+            continue
+        if not in_summary:
+            continue
+        m = _FAILLINE_RE.match(line.rstrip("\n"))
+        if m:
+            failed.add(_nodeid_of_summary_line(m.group(1)))
+    proc.stdout.close()
+    return proc.wait(), sorted(failed)
+
+
+def load_known_failures(path=KNOWN_FAILURES_FILE):
+    """The committed tier-1 failure list, or None when no file exists
+    (the diff is then skipped and plain rc==0 gates the stage)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    known = data.get("tier1")
+    if not isinstance(known, list):
+        return None
+    return sorted(str(k) for k in known)
+
+
+def diff_known_failures(failed, known):
+    """-> (new, fixed): failures not in the committed list, and
+    committed entries that did not fail (each non-empty list fails the
+    gate — the first is a regression, the second a stale KNOWN_FAILURES
+    entry that must be removed so the fix is recorded)."""
+    failed, known = set(failed), set(known)
+    return sorted(failed - known), sorted(known - failed)
+
+
 def run_perfproxy():
     """bench.py perfproxy vs the committed baseline (always CPU)."""
     cmd = [sys.executable, os.path.join(REPO, "bench.py"), "perfproxy"]
@@ -259,6 +353,15 @@ def main(argv=None):
                          "consensus, reshard-on-resume, straggler "
                          "detection, goodput bench contract)")
     ap.add_argument("--elastic-args", default=ELASTIC_PYTEST_ARGS)
+    ap.add_argument("--artifacts", action="store_true",
+                    help="also run the compiled-artifact-store suite "
+                         "(-m artifacts: corruption/torn-publish/"
+                         "version-skew chaos, multi-process single-"
+                         "flight warmup, coldstart bench contract)")
+    ap.add_argument("--artifacts-args", default=ARTIFACTS_PYTEST_ARGS)
+    ap.add_argument("--known-failures", default=KNOWN_FAILURES_FILE,
+                    help="JSON file naming the committed pre-existing "
+                         "tier-1 failures the stage diffs against")
     ap.add_argument("--perfproxy", action="store_true",
                     help="also run bench.py perfproxy (CPU compile-"
                          "ledger regression check vs the committed "
@@ -287,9 +390,12 @@ def main(argv=None):
     audit_ok = not violations
 
     tests_ok = True
+    known = load_known_failures(ns.known_failures)
+    tier1_new, tier1_fixed = [], []
     if not ns.skip_tests:
         pytest_args = ns.pytest_args
-        if pytest_args == DEFAULT_PYTEST_ARGS:
+        default_based = pytest_args == DEFAULT_PYTEST_ARGS
+        if default_based:
             # double-run guards: a dedicated stage owns its marker, so
             # tier-1 must not pay the same suite twice in one gate run
             excl = []
@@ -299,12 +405,34 @@ def main(argv=None):
                 excl.append("(chaos and serving)")
             if ns.elastic:
                 excl.append("elastic")
+            if ns.artifacts:
+                excl.append("artifacts")
             if excl:
                 pytest_args = pytest_args.replace(
                     "'not slow'",
                     "'not slow and not "
                     + " and not ".join(excl) + "'")
-        tests_ok = run_pytest(pytest_args) == 0
+        if known is not None and default_based:
+            # diff the observed failure set against the committed list:
+            # exact match (in both directions) is the only green state
+            rc, failed = run_pytest_capturing_failures(pytest_args)
+            tier1_new, tier1_fixed = diff_known_failures(failed, known)
+            for t in tier1_new:
+                print(f"tier1: NEW failure (not in KNOWN_FAILURES.json): "
+                      f"{t}", file=sys.stderr)
+            for t in tier1_fixed:
+                print(f"tier1: {t} passed but is still listed in "
+                      "KNOWN_FAILURES.json — remove it so the fix is "
+                      "recorded", file=sys.stderr)
+            # rc 0 (nothing failed) or 1 (tests failed) are the states
+            # the diff adjudicates; anything else (interrupted, usage
+            # error, crash) is a failure regardless of the diff
+            tests_ok = (rc in (0, 1) and not tier1_new
+                        and not tier1_fixed)
+        else:
+            # custom selections (or no committed list) can't be diffed
+            # against the tier-1 failure record: plain rc gating
+            tests_ok = run_pytest(pytest_args) == 0
 
     chaos_ok = True
     if ns.chaos:
@@ -328,6 +456,10 @@ def main(argv=None):
     if ns.elastic:
         elastic_ok = run_pytest(ns.elastic_args) == 0
 
+    artifacts_ok = True
+    if ns.artifacts:
+        artifacts_ok = run_pytest(ns.artifacts_args) == 0
+
     perfproxy_ok = True
     if ns.perfproxy:
         perfproxy_ok = run_perfproxy() == 0
@@ -347,6 +479,7 @@ def main(argv=None):
                  + ("+serving" if ns.serving else "")
                  + ("+serving-chaos" if ns.serving_chaos else "")
                  + ("+elastic" if ns.elastic else "")
+                 + ("+artifacts" if ns.artifacts else "")
                  + ("+perfproxy" if ns.perfproxy else "")
                  + ("+concurrency" if ns.concurrency else "")),
         "lint_ok": lint_ok,
@@ -357,6 +490,9 @@ def main(argv=None):
         "audit_ok": audit_ok,
         "tests_ok": tests_ok,
         "tests_skipped": bool(ns.skip_tests),
+        "known_failures": len(known) if known is not None else -1,
+        "tier1_new_failures": len(tier1_new),
+        "tier1_fixed_known": len(tier1_fixed),
         "chaos_ok": chaos_ok,
         "chaos_run": bool(ns.chaos),
         "serving_ok": serving_ok,
@@ -365,6 +501,8 @@ def main(argv=None):
         "serving_chaos_run": bool(ns.serving_chaos),
         "elastic_ok": elastic_ok,
         "elastic_run": bool(ns.elastic),
+        "artifacts_ok": artifacts_ok,
+        "artifacts_run": bool(ns.artifacts),
         "perfproxy_ok": perfproxy_ok,
         "perfproxy_run": bool(ns.perfproxy),
         "concurrency_ok": concurrency_ok,
@@ -375,7 +513,7 @@ def main(argv=None):
     print(json.dumps(summary))
     if not (lint_ok and audit_ok and tests_ok and chaos_ok
             and serving_ok and serving_chaos_ok and elastic_ok
-            and perfproxy_ok and concurrency_ok):
+            and artifacts_ok and perfproxy_ok and concurrency_ok):
         print("ci_gate: FAILED", file=sys.stderr)
         return 1
     return 0
