@@ -76,6 +76,13 @@ func NewTraceID() uint64 {
 // Predictor holds one connection to a PredictorServer.
 type Predictor struct {
 	addr string
+	// endpoint rotation (WithEndpoints): all known server addresses;
+	// addrIdx is the one the current/next connection uses. A poisoned
+	// connection or a status-2 retry advances addrIdx before redialing
+	// so failover lands on a DIFFERENT endpoint instead of hammering
+	// the dead or shedding one.
+	addrs   []string
+	addrIdx int
 	// nil after an I/O error desynced the frame stream (a late response
 	// to a timed-out request would otherwise be read as the answer to
 	// the NEXT request); the next attempt redials
@@ -118,6 +125,25 @@ func WithRetry(maxAttempts int, baseDelay, maxDelay time.Duration) Option {
 	}
 }
 
+// WithEndpoints adds failover endpoints: the full server list is the
+// NewPredictor addr plus these (duplicates of addr are dropped). On a
+// poisoned-connection redial (I/O error or timeout) or before a
+// WithRetry attempt after a status-2 shed, the predictor rotates to
+// the NEXT endpoint round-robin instead of hammering the dead or
+// shedding one. With a fleet router in front (paddle_tpu.inference
+// fleet tier) a single router address usually suffices — the router
+// does replica-level failover itself; WithEndpoints covers multiple
+// routers or router-less replica lists.
+func WithEndpoints(addrs []string) Option {
+	return func(p *Predictor) {
+		for _, a := range addrs {
+			if a != p.addr {
+				p.addrs = append(p.addrs, a)
+			}
+		}
+	}
+}
+
 // WithTraceID attaches a trace id (see NewTraceID) to every Run: the
 // server tags the request's spans with it, so its path through the
 // batching engine shows up in the obs.tracing span buffer and the
@@ -139,20 +165,39 @@ func NewPredictor(addr string, opts ...Option) (*Predictor, error) {
 	if p.retryAttempts < 1 {
 		p.retryAttempts = 1
 	}
+	// the rotation list: addr first, then the WithEndpoints extras
+	p.addrs = append([]string{addr}, p.addrs...)
 	// options first, so WithTimeout bounds the initial connect too (a
-	// bare Dial blocks for the OS connect default — minutes)
-	var conn net.Conn
+	// bare Dial blocks for the OS connect default — minutes). With
+	// endpoints configured, a dead first endpoint is not fatal: each
+	// gets one connect attempt before giving up.
 	var err error
+	for range p.addrs {
+		var conn net.Conn
+		conn, err = p.dial()
+		if err == nil {
+			p.conn = conn
+			return p, nil
+		}
+		p.rotate()
+	}
+	return nil, err
+}
+
+// dial connects to the CURRENT endpoint, honoring WithTimeout.
+func (p *Predictor) dial() (net.Conn, error) {
+	addr := p.addrs[p.addrIdx]
 	if p.timeout > 0 {
-		conn, err = net.DialTimeout("tcp", addr, p.timeout)
-	} else {
-		conn, err = net.Dial("tcp", addr)
+		return net.DialTimeout("tcp", addr, p.timeout)
 	}
-	if err != nil {
-		return nil, err
+	return net.Dial("tcp", addr)
+}
+
+// rotate advances to the next endpoint (no-op with a single one).
+func (p *Predictor) rotate() {
+	if len(p.addrs) > 1 {
+		p.addrIdx = (p.addrIdx + 1) % len(p.addrs)
 	}
-	p.conn = conn
-	return p, nil
 }
 
 func (p *Predictor) Close() error {
@@ -165,12 +210,15 @@ func (p *Predictor) Close() error {
 // ioError poisons the connection after a failed write or read: the
 // frame stream is desynced (the server's late response would be read
 // as the answer to the next request, silently returning wrong
-// tensors), so drop it and let the next attempt redial.
+// tensors), so drop it and let the next attempt redial — against the
+// NEXT endpoint when WithEndpoints configured several, so failover
+// never hammers the endpoint that just died.
 func (p *Predictor) ioError(err error) error {
 	if p.conn != nil {
 		_ = p.conn.Close()
 		p.conn = nil
 	}
+	p.rotate()
 	return err
 }
 
@@ -193,6 +241,16 @@ func (p *Predictor) Run(inputs []Tensor) ([]Tensor, error) {
 			return outs, err
 		}
 		last = err
+		if len(p.addrs) > 1 {
+			// shed-aware failover: the retry should land on a
+			// DIFFERENT endpoint — drop the connection to the
+			// shedding one and rotate before the backoff sleep
+			if p.conn != nil {
+				_ = p.conn.Close()
+				p.conn = nil
+			}
+			p.rotate()
+		}
 	}
 	return nil, last
 }
@@ -249,17 +307,15 @@ func (p *Predictor) runOnce(inputs []Tensor) ([]Tensor, error) {
 		}
 	}
 	if p.conn == nil {
-		// previous attempt hit an I/O error and poisoned the stream;
-		// bound the redial by the request timeout (a bare Dial blocks
-		// for the OS connect default — minutes — ignoring WithTimeout)
-		var conn net.Conn
-		var err error
-		if p.timeout > 0 {
-			conn, err = net.DialTimeout("tcp", p.addr, p.timeout)
-		} else {
-			conn, err = net.Dial("tcp", p.addr)
-		}
+		// previous attempt hit an I/O error (or a shed with endpoint
+		// rotation) and poisoned the stream; redial the CURRENT
+		// endpoint, bounded by the request timeout (a bare Dial
+		// blocks for the OS connect default — minutes — ignoring
+		// WithTimeout). A failed redial rotates too, so the attempt
+		// after this one tries the next endpoint.
+		conn, err := p.dial()
 		if err != nil {
+			p.rotate()
 			return nil, err
 		}
 		p.conn = conn

@@ -5,6 +5,7 @@ import (
 	"errors"
 	"math"
 	"net"
+	"sync/atomic"
 	"testing"
 	"time"
 )
@@ -243,5 +244,113 @@ func TestTimeoutPoisonsConnAndRedials(t *testing.T) {
 	}
 	if n := len(conns); n != 2 {
 		t.Fatalf("want exactly one redial (2 connections), got %d", n)
+	}
+}
+
+// multiServer is a fakeServer that accepts ANY number of connections,
+// always answering the one fixed status (echoing a 1-element f32 output
+// on status 0) and counting requests served.
+func multiServer(t *testing.T, status byte) (addr string, hits *int32) {
+	t.Helper()
+	ln, err := net.Listen("tcp", "127.0.0.1:0")
+	if err != nil {
+		t.Fatal(err)
+	}
+	t.Cleanup(func() { ln.Close() })
+	var n int32
+	go func() {
+		for {
+			conn, err := ln.Accept()
+			if err != nil {
+				return
+			}
+			go func(c net.Conn) {
+				defer c.Close()
+				for {
+					hdr := make([]byte, 4)
+					if _, err := readFull(c, hdr); err != nil {
+						return
+					}
+					body := make([]byte, binary.LittleEndian.Uint32(hdr))
+					if _, err := readFull(c, body); err != nil {
+						return
+					}
+					atomic.AddInt32(&n, 1)
+					var resp []byte
+					if status == 0 {
+						resp = []byte{0, 1, 0, 1}
+						resp = binary.LittleEndian.AppendUint64(resp, 1)
+						resp = binary.LittleEndian.AppendUint32(resp,
+							math.Float32bits(1.0))
+					} else {
+						resp = []byte{status}
+					}
+					out := binary.LittleEndian.AppendUint32(nil,
+						uint32(len(resp)))
+					if _, err := c.Write(append(out, resp...)); err != nil {
+						return
+					}
+				}
+			}(conn)
+		}
+	}()
+	return ln.Addr().String(), &n
+}
+
+// A status-2 shed with WithEndpoints + WithRetry must retry on the
+// NEXT endpoint, not hammer the shedding one.
+func TestWithEndpointsRotatesOnShed(t *testing.T) {
+	shedAddr, shedHits := multiServer(t, 2)
+	okAddr, okHits := multiServer(t, 0)
+	p, err := NewPredictor(shedAddr,
+		WithEndpoints([]string{okAddr}),
+		WithRetry(3, time.Millisecond, 4*time.Millisecond))
+	if err != nil {
+		t.Fatal(err)
+	}
+	defer p.Close()
+	outs, err := p.Run(oneInput())
+	if err != nil {
+		t.Fatalf("failover run failed: %v", err)
+	}
+	if len(outs) != 1 || outs[0].Data[0] != 1.0 {
+		t.Fatalf("bad output after failover: %+v", outs)
+	}
+	if got := atomic.LoadInt32(shedHits); got != 1 {
+		t.Fatalf("shedding endpoint hit %d times, want exactly 1", got)
+	}
+	if got := atomic.LoadInt32(okHits); got != 1 {
+		t.Fatalf("ok endpoint hit %d times, want exactly 1", got)
+	}
+}
+
+// A dead endpoint at dial time must fail over: the constructor tries
+// each endpoint, and a poisoned connection redials the next one.
+func TestWithEndpointsFailsOverDeadEndpoint(t *testing.T) {
+	// a listener we close immediately: connecting fails fast
+	dead, err := net.Listen("tcp", "127.0.0.1:0")
+	if err != nil {
+		t.Fatal(err)
+	}
+	deadAddr := dead.Addr().String()
+	dead.Close()
+	okAddr, okHits := multiServer(t, 0)
+	p, err := NewPredictor(deadAddr,
+		WithEndpoints([]string{okAddr}),
+		WithTimeout(time.Second))
+	if err != nil {
+		t.Fatalf("constructor should fail over to the live endpoint: %v",
+			err)
+	}
+	defer p.Close()
+	outs, err := p.Run(oneInput())
+	if err != nil {
+		t.Fatalf("run against failover endpoint: %v", err)
+	}
+	if len(outs) != 1 || outs[0].Data[0] != 1.0 {
+		t.Fatalf("bad output: %+v", outs)
+	}
+	if got := atomic.LoadInt32(okHits); got != 1 {
+		t.Fatalf("ok endpoint hit %d times, want 1", got)
 	}
 }

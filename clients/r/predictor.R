@@ -13,6 +13,13 @@
 #             server's batching engine, a quarantined bucket, a
 #             scheduler restart, or an expired deadline — back off and
 #             retry; see the retries= argument of pd_predict)
+#
+# Multi-replica failover: this client holds ONE connection on purpose.
+# For a replica fleet, connect to the fleet router
+# (paddle_tpu.inference.fleet — same wire protocol, same port
+# semantics) and let the router do replica-level retry, ejection, and
+# drains; the Go client's WithEndpoints option exists for router-less
+# setups.
 
 pd_connect <- function(host = "127.0.0.1", port) {
   socketConnection(host, port, blocking = TRUE, open = "r+b")

@@ -28,4 +28,26 @@ __all__ = [
     "Config", "DataType", "Predictor", "PredictorTensor", "Tensor",
     "create_predictor",
     "PrecisionType", "PlaceType",
+    # fleet tier (lazy: importing paddle_tpu.inference must not pull
+    # in the router/registry threads' modules until asked)
+    "Fleet", "FleetRouter", "ReplicaRegistry", "TenantPolicy",
+    "Autoscaler", "subprocess_spawner", "tenant_id",
 ]
+
+_FLEET_HOMES = {
+    "Fleet": "fleet", "Autoscaler": "fleet",
+    "subprocess_spawner": "fleet", "ReplicaHandle": "fleet",
+    "FleetRouter": "router", "TenantPolicy": "router",
+    "FairGate": "router", "tenant_id": "router",
+    "ReplicaRegistry": "registry",
+}
+
+
+def __getattr__(name):
+    home = _FLEET_HOMES.get(name)
+    if home is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f".{home}", __name__), name)

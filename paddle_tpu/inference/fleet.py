@@ -1,0 +1,424 @@
+"""Fleet tier: self-healing multi-replica serving (ROADMAP item 3).
+
+Ties the pieces together into one operable unit::
+
+    clients ──> FleetRouter ──┬──> serve_model replica 0
+      (wire protocol,         ├──> serve_model replica 1   ── artifact
+       any existing client)   └──> serve_model replica 2      store
+
+    Fleet = ReplicaRegistry (heartbeats, eject/probe)
+          + FleetRouter     (WFQ admission, shed-aware retry, drains)
+          + supervisor      (respawn dead replicas, autoscale)
+
+A :class:`Fleet` owns N replicas produced by a ``spawn_fn`` — either
+:func:`subprocess_spawner` (a fresh ``serve_model`` process per
+replica; with ``PADDLE_TPU_ARTIFACT_DIR`` set, respawn and scale-up are
+warm: the PR 10 artifact store makes a new replica's whole bucket
+ladder load instead of compile) or anything else returning a
+:class:`ReplicaHandle`-shaped object (tests use in-process servers).
+
+The supervisor thread:
+
+- **respawns** replicas whose process died (SIGKILL, OOM, crash): the
+  dead rid is deregistered and a replacement spawned and registered —
+  the router routes around the corpse from the first failed heartbeat
+  or I/O error, so the only client-visible effect is a few retryable
+  status-2 replies, never a hang or a wrong tensor;
+- **autoscales**: sustained admission-queue pressure (requests waiting
+  in the router's fair gate, or deep per-replica engine queues) spawns
+  a replica up to ``max_replicas``; a sustained idle fleet drains one
+  replica (zero-drop: new work routes elsewhere, in-flight finishes)
+  and stops it, down to ``min_replicas``.
+
+``rolling_reload`` hot-swaps weights across the fleet one replica at a
+time: drain -> wire cmd 4 reload -> undrain, so the fleet never has
+fewer than N-1 replicas taking traffic and no request ever drops.
+
+Env knobs (constructor kwargs win):
+    PADDLE_TPU_FLEET_MIN_REPLICAS        (1)
+    PADDLE_TPU_FLEET_MAX_REPLICAS        (4)
+    PADDLE_TPU_FLEET_SUPERVISE_S         supervisor tick     (0.5)
+    PADDLE_TPU_FLEET_SCALE_UP_PRESSURE   per-replica queued+
+                                         waiting to add one  (4.0)
+    PADDLE_TPU_FLEET_SCALE_DOWN_TICKS    consecutive idle
+                                         ticks to remove one (20)
+    PADDLE_TPU_FLEET_SPAWN_TIMEOUT_S     subprocess replica
+                                         bind wait           (120)
+(plus the ROUTER/REGISTRY knobs — see router.py / registry.py.)
+"""
+import os
+import socket
+import struct
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+from ..obs import metrics as obs_metrics
+from .registry import EJECTED, ReplicaRegistry, _env_float, _env_int
+from .router import FleetRouter, TenantPolicy, tenant_id  # noqa: F401
+from .server import _read_all
+
+_M_RESPAWNS = obs_metrics.counter(
+    "paddle_fleet_respawns_total",
+    "Dead replicas replaced by the fleet supervisor")
+_M_SCALE = obs_metrics.counter(
+    "paddle_fleet_scale_events_total",
+    "Autoscaler actions", labelnames=("direction",))
+
+
+class ReplicaHandle:
+    """One spawned replica: its endpoint plus enough process handle to
+    supervise it. ``proc`` is a subprocess.Popen or None (in-process
+    replicas override :meth:`alive`/:meth:`stop`)."""
+
+    def __init__(self, rid, host, port, proc=None):
+        self.rid = rid
+        self.host = host
+        self.port = int(port)
+        self.proc = proc
+
+    @property
+    def pid(self):
+        return None if self.proc is None else self.proc.pid
+
+    def alive(self):
+        # a proc-less (in-process) handle counts as alive unless a
+        # subclass says otherwise
+        return self.proc is None or self.proc.poll() is None
+
+    def stop(self, timeout=10.0):
+        """Graceful stop: wire cmd 7, then wait, then SIGKILL."""
+        try:
+            with socket.create_connection((self.host, self.port),
+                                          timeout=2.0) as s:
+                s.settimeout(2.0)
+                s.sendall(struct.pack("<IB", 1, 7))
+                (blen,) = struct.unpack("<I", _read_all(s, 4))
+                _read_all(s, blen)
+        except (OSError, ConnectionError):
+            pass
+        if self.proc is not None:
+            try:
+                self.proc.wait(timeout)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                try:
+                    self.proc.wait(5.0)
+                except subprocess.TimeoutExpired:
+                    pass  # un-reapable zombie; the OS owns it now
+
+
+def subprocess_spawner(prefix, host="127.0.0.1", extra_env=None,
+                       spawn_timeout=None, max_batch_size=8,
+                       max_wait_ms=2.0, max_queue=256):
+    """Build a ``spawn_fn`` that starts each replica as a fresh
+    ``serve_model`` process (``python -m paddle_tpu.inference.fleet
+    --replica ...``). Point ``PADDLE_TPU_ARTIFACT_DIR`` (or pass it via
+    ``extra_env``) at a shared store to make every spawn warm."""
+    timeout = (spawn_timeout if spawn_timeout is not None
+               else _env_float("PADDLE_TPU_FLEET_SPAWN_TIMEOUT_S", 120.0))
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+    def spawn(rid):
+        portfile = os.path.join(tempfile.mkdtemp(prefix="fleet-"),
+                                f"{rid}.port")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        if extra_env:
+            env.update(extra_env)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.inference.fleet",
+             "--replica", prefix, portfile,
+             str(max_batch_size), str(max_wait_ms), str(max_queue)],
+            env=env)
+        t_end = time.monotonic() + timeout
+        while time.monotonic() < t_end:
+            if os.path.exists(portfile):
+                with open(portfile) as f:
+                    return ReplicaHandle(rid, host, int(f.read()),
+                                         proc=proc)
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"replica {rid} exited rc={proc.returncode} "
+                    "before binding")
+            time.sleep(0.02)
+        proc.kill()
+        proc.wait()
+        raise TimeoutError(f"replica {rid} did not bind within "
+                           f"{timeout:.0f}s")
+
+    return spawn
+
+
+class Autoscaler:
+    """Pure scale decision over one supervisor tick's observations
+    (kept side-effect free so tests drive it directly):
+    ``decide(n_replicas, waiting, backlog)`` -> +1 / 0 / -1 where
+    ``waiting`` is requests queued in the router's fair gate and
+    ``backlog`` is the summed per-replica (router in-flight + engine
+    queue depth)."""
+
+    def __init__(self, min_replicas=None, max_replicas=None,
+                 scale_up_pressure=None, scale_down_ticks=None):
+        self.min_replicas = max(1, (
+            min_replicas if min_replicas is not None
+            else _env_int("PADDLE_TPU_FLEET_MIN_REPLICAS", 1)))
+        self.max_replicas = (
+            max_replicas if max_replicas is not None
+            else _env_int("PADDLE_TPU_FLEET_MAX_REPLICAS", 4))
+        self.scale_up_pressure = (
+            scale_up_pressure if scale_up_pressure is not None
+            else _env_float("PADDLE_TPU_FLEET_SCALE_UP_PRESSURE", 4.0))
+        self.scale_down_ticks = (
+            scale_down_ticks if scale_down_ticks is not None
+            else _env_int("PADDLE_TPU_FLEET_SCALE_DOWN_TICKS", 20))
+        self._idle_ticks = 0
+
+    def decide(self, n_replicas, waiting, backlog):
+        if n_replicas < self.min_replicas:
+            return 1
+        pressure = waiting + backlog
+        per_replica = pressure / max(1, n_replicas)
+        if per_replica >= self.scale_up_pressure \
+                and n_replicas < self.max_replicas:
+            self._idle_ticks = 0
+            return 1
+        if pressure == 0:
+            self._idle_ticks += 1
+            if self._idle_ticks >= self.scale_down_ticks \
+                    and n_replicas > self.min_replicas:
+                self._idle_ticks = 0
+                return -1
+        else:
+            self._idle_ticks = 0
+        return 0
+
+
+class Fleet:
+    """Spawn, register, route, supervise (see module docstring).
+
+    ``spawn_fn(rid) -> ReplicaHandle`` produces replicas;
+    :func:`subprocess_spawner` builds the production one. With
+    ``supervise=False`` nothing respawns or autoscales (tests drive
+    :meth:`supervise_once` manually)."""
+
+    def __init__(self, spawn_fn, replicas=None, tenants=(),
+                 registry=None, router_kwargs=None, autoscaler=None,
+                 supervise=True, supervise_interval=None):
+        self._spawn_fn = spawn_fn
+        self.autoscaler = autoscaler or Autoscaler()
+        n0 = (replicas if replicas is not None
+              else self.autoscaler.min_replicas)
+        self.registry = registry or ReplicaRegistry()
+        self.router = FleetRouter(self.registry, tenants=tenants,
+                                  own_registry=False,
+                                  **(router_kwargs or {}))
+        self._lock = threading.Lock()
+        self._handles = {}  # rid -> ReplicaHandle
+        self._next_rid = 0
+        self._closed = threading.Event()
+        self.respawns = 0
+        for _ in range(n0):
+            self._spawn_one()
+        self._thread = None
+        if supervise:
+            interval = (supervise_interval if supervise_interval is not None
+                        else _env_float("PADDLE_TPU_FLEET_SUPERVISE_S", 0.5))
+            self._interval = interval
+            self._thread = threading.Thread(target=self._supervise_loop,
+                                            name="fleet-supervisor",
+                                            daemon=True)
+            self._thread.start()
+
+    @property
+    def port(self):
+        """The router's client-facing port."""
+        return self.router.port
+
+    def handles(self):
+        with self._lock:
+            return dict(self._handles)
+
+    # ------------------------------------------------------------ scaling
+    def _new_rid(self):
+        with self._lock:
+            rid = f"replica-{self._next_rid}"
+            self._next_rid += 1
+        return rid
+
+    def _spawn_one(self):
+        rid = self._new_rid()
+        handle = self._spawn_fn(rid)
+        with self._lock:
+            # a close() that raced this spawn (it can take the whole
+            # subprocess startup) must not leak an orphan replica: the
+            # handle table is already cleared, so stop the newborn
+            # instead of inserting it
+            aborted = self._closed.is_set()
+            if not aborted:
+                self._handles[rid] = handle
+        if aborted:
+            handle.stop()
+            return None
+        self.registry.register(rid, handle.host, handle.port,
+                               pid=handle.pid)
+        return rid
+
+    def _remove_one(self, rid, drain_deadline=10.0):
+        """Zero-drop scale-down: drain (router stops routing, replica
+        announces it, in-flight finishes), then stop."""
+        self.router.drain(rid, deadline_s=drain_deadline)
+        with self._lock:
+            handle = self._handles.pop(rid, None)
+        self.registry.deregister(rid)
+        if handle is not None:
+            handle.stop()
+
+    def scale_to(self, n):
+        """Imperative scale (the autoscaler does this on pressure)."""
+        while True:
+            with self._lock:
+                current = len(self._handles)
+                victim = (sorted(self._handles)[-1]
+                          if current > n else None)
+            if current < n:
+                if self._spawn_one() is None:  # closing: stop scaling
+                    return
+            elif current > n:
+                self._remove_one(victim)
+            else:
+                return
+
+    # --------------------------------------------------------- supervisor
+    def supervise_once(self):
+        """One supervisor tick: bury+respawn dead replicas, then ask
+        the autoscaler. Runs unlocked except for handle-table reads and
+        writes — spawning (seconds) must not block drains or stats."""
+        if self._closed.is_set():
+            return {"dead": 0, "action": 0, "waiting": 0,
+                    "backlog": 0, "ejected": 0}
+        with self._lock:
+            dead = [(rid, h) for rid, h in self._handles.items()
+                    if not h.alive()]
+        for rid, handle in dead:
+            with self._lock:
+                self._handles.pop(rid, None)
+            self.registry.deregister(rid)
+            try:
+                handle.stop(timeout=0.1)  # reap the corpse
+            except Exception:  # noqa: BLE001 — already dead
+                pass
+            if self._spawn_one() is not None:
+                self.respawns += 1
+                _M_RESPAWNS.inc()
+        waiting = sum(t["waiting"]
+                      for t in self.router.gate.stats().values())
+        backlog = 0
+        ejected = 0
+        for v in self.registry.snapshot():
+            backlog += v.inflight + v.queue_depth
+            ejected += v.state == EJECTED
+        with self._lock:
+            n = len(self._handles)
+        action = self.autoscaler.decide(n, waiting, backlog)
+        if action > 0:
+            self._spawn_one()
+            _M_SCALE.inc(direction="up")
+        elif action < 0:
+            with self._lock:
+                victim = sorted(self._handles)[-1] if self._handles \
+                    else None
+            if victim is not None:
+                self._remove_one(victim)
+                _M_SCALE.inc(direction="down")
+        return {"dead": len(dead), "action": action,
+                "waiting": waiting, "backlog": backlog,
+                "ejected": ejected}
+
+    def _supervise_loop(self):
+        while not self._closed.wait(self._interval):
+            try:
+                self.supervise_once()
+            except Exception:  # noqa: BLE001 — supervisor must survive
+                # a failed spawn (transient exec error) must not kill
+                # supervision; the next tick retries
+                pass
+
+    # ------------------------------------------------------------ reloads
+    def rolling_reload(self, prefix=None, drain_deadline=10.0):
+        """Hot weight swap across the fleet, one replica at a time,
+        zero dropped requests: drain -> cmd 4 reload -> undrain. The
+        fleet keeps serving on the other replicas throughout. Returns
+        the per-replica reload JSON replies."""
+        out = {}
+        for rid, handle in sorted(self.handles().items()):
+            self.router.drain(rid, deadline_s=drain_deadline)
+            try:
+                payload = struct.pack("<B", 4) + (
+                    (prefix or "").encode("utf-8"))
+                with socket.create_connection(
+                        (handle.host, handle.port), timeout=300) as s:
+                    s.settimeout(300)
+                    s.sendall(struct.pack("<I", len(payload)) + payload)
+                    (blen,) = struct.unpack("<I", _read_all(s, 4))
+                    body = _read_all(s, blen)
+                out[rid] = {"status": body[0],
+                            "body": body[1:].decode("utf-8",
+                                                    errors="replace")}
+            finally:
+                self.router.undrain(rid)
+        return out
+
+    # -------------------------------------------------------------- close
+    def close(self):
+        self._closed.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+        self.router.stop()
+        with self._lock:
+            handles = list(self._handles.values())
+            self._handles = {}
+        for h in handles:
+            try:
+                h.stop()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        self.registry.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _replica_main(argv):
+    """``python -m paddle_tpu.inference.fleet --replica PREFIX PORTFILE
+    [max_batch max_wait_ms max_queue]`` — one serve_model replica that
+    writes its bound port atomically and serves until cmd 7."""
+    prefix, portfile = argv[0], argv[1]
+    max_batch = int(argv[2]) if len(argv) > 2 else 8
+    max_wait_ms = float(argv[3]) if len(argv) > 3 else 2.0
+    max_queue = int(argv[4]) if len(argv) > 4 else 256
+    from .server import serve_model
+
+    srv = serve_model(prefix, dynamic_batching=True,
+                      max_batch_size=max_batch, max_wait_ms=max_wait_ms,
+                      max_queue=max_queue)
+    with open(portfile + ".tmp", "w") as f:
+        f.write(str(srv.port))
+    os.replace(portfile + ".tmp", portfile)
+    srv._thread.join()  # serve until the stop command (cmd 7)
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 4 and sys.argv[1] == "--replica":
+        sys.exit(_replica_main(sys.argv[2:]))
+    print("usage: python -m paddle_tpu.inference.fleet --replica "
+          "PREFIX PORTFILE [max_batch max_wait_ms max_queue]",
+          file=sys.stderr)
+    sys.exit(2)

@@ -1,0 +1,780 @@
+"""Front-tier fleet router (ROADMAP item 3 tentpole).
+
+Speaks the exact PredictorServer wire protocol on its front socket, so
+every existing client (Go/R/C, bench.py, plain sockets) points at the
+router instead of a replica and nothing else changes. Behind it, a
+:class:`~paddle_tpu.inference.registry.ReplicaRegistry` of ``serve_model``
+replicas. Per cmd-1 infer request the router:
+
+1. **admits** through a weighted-fair gate: per-tenant FIFO queues
+   (tenant = the optional ``0x7E`` trailing wire field, see
+   :func:`tenant_id`; untagged requests share the ``default`` tenant)
+   scheduled by start-time fair queueing — each grant consumes
+   ``1/weight`` of virtual time, so a noisy tenant saturating its queue
+   cannot starve a polite one — over a bounded total concurrency; a
+   tenant whose own queue is full is shed *immediately* (status 2,
+   accounted to that tenant alone);
+2. **routes** to the least-loaded routable replica (router in-flight +
+   last heartbeat queue depth, warm-bucket count breaking ties toward
+   replicas whose ladder is already compiled), chaos site
+   ``fleet.route``;
+3. **retries**: a replica answering the retryable status 2 (shed /
+   quarantined / restarting) is retried on a *different* replica with
+   bounded exponential backoff + jitter (the ``resilience/retry.py``
+   shape); a replica that dies mid-request (connect/read error or
+   timeout) is reported to the registry — poisoned, ejected, probed
+   back in — and the request fails over to another replica immediately
+   (no backoff: the failure was detected, not load-signalled);
+4. **accounts**: per-tenant request/shed/deadline counters in
+   ``paddle_tpu.obs`` and a serving-goodput ledger entry
+   (``obs.goodput.SERVING_LEDGER``) per finished request.
+
+The client contract under ANY single-replica failure is: every request
+ends with status 0 (correct tensors) or status 2 (retryable) — never a
+hang, never a wrong answer, never a status-1 error caused by fleet
+topology. Status 1 is reserved for genuine request errors the replica
+itself reported.
+
+Draining (zero-drop reload / scale-down): :meth:`FleetRouter.drain`
+marks a replica not-routable, optionally tells the replica itself (wire
+cmd 8, so its own health announces ``accepting: false``), then waits
+for the router's in-flight count on that replica to reach zero.
+In-flight requests finish; new ones go elsewhere; nothing drops.
+
+Env knobs (constructor kwargs win):
+    PADDLE_TPU_FLEET_RETRY_ATTEMPTS    total tries per request (3)
+    PADDLE_TPU_FLEET_RETRY_BASE_S      first shed backoff      (0.05)
+    PADDLE_TPU_FLEET_RETRY_MAX_S       shed backoff ceiling    (1.0)
+    PADDLE_TPU_FLEET_MAX_INFLIGHT      fair-gate concurrency   (64)
+    PADDLE_TPU_FLEET_TENANT_QUEUE      per-tenant waiting cap  (32)
+    PADDLE_TPU_FLEET_ADMIT_TIMEOUT_S   deadline-less admission
+                                       wait cap                (5.0)
+    PADDLE_TPU_FLEET_BACKEND_TIMEOUT_S per-attempt reply cap   (30.0)
+"""
+import hashlib
+import json
+import os
+import random
+import socket
+import struct
+import threading
+import time
+
+from ..obs import goodput as obs_goodput
+from ..obs import metrics as obs_metrics
+from ..obs import prometheus as obs_prometheus
+from ..resilience import chaos
+from ..resilience.retry import backoff_delays
+from .registry import ReplicaRegistry, _env_float, _env_int
+from .server import (DEADLINE_MARKER, MAX_BODY_BYTES, STATUS_ERROR,
+                     STATUS_OK, STATUS_OVERLOADED, TENANT_MARKER,
+                     TRACE_MARKER, BodyTooLarge, _decode_arrays_off,
+                     _read_all)
+
+DEFAULT_TENANT = "default"
+
+# Machine-checked lock order (tools/tracelint.py --concurrency):
+# the fair gate's condition lock and the registry lock are LEAVES of
+# the router — no router code path holds one while taking the other,
+# and neither is ever held across socket I/O or a metrics bump.
+# tpu-lock-order: FairGate._lock < Metric._lock  # shed accounting under the gate
+
+
+def tenant_id(name):
+    """Stable 64-bit wire id for a tenant name (sha256 prefix): clients
+    compute it once and send it as the ``0x7E`` trailing field; router
+    policies declare the same names."""
+    return int.from_bytes(
+        hashlib.sha256(str(name).encode("utf-8")).digest()[:8], "little")
+
+
+class TenantPolicy:
+    """Admission policy for one tenant: scheduling ``weight`` (shares
+    of the fleet under contention), ``max_queue`` (bound on requests
+    WAITING in the router for this tenant; overflow sheds immediately)
+    and an optional ``slo_ms`` used for deadline-hit accounting when a
+    request carries no explicit wire deadline."""
+
+    def __init__(self, name, weight=1.0, max_queue=None, slo_ms=None):
+        if weight <= 0:
+            raise ValueError(f"tenant {name!r}: weight must be > 0")
+        self.name = str(name)
+        self.weight = float(weight)
+        self.max_queue = (max_queue if max_queue is not None
+                          else _env_int("PADDLE_TPU_FLEET_TENANT_QUEUE", 32))
+        self.slo_ms = slo_ms
+        self.tid = tenant_id(self.name)
+
+
+class ShedError(RuntimeError):
+    """Router-side shed (wire status 2): tenant queue full, admission
+    deadline expired, no routable replica, or retries exhausted."""
+
+    def __init__(self, reason):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class _Waiter:
+    __slots__ = ("finish", "seq", "granted")
+
+    def __init__(self, finish, seq):
+        self.finish = finish
+        self.seq = seq
+        self.granted = False
+
+
+class _TenantState:
+    __slots__ = ("policy", "queue", "vfinish", "granted", "shed")
+
+    def __init__(self, policy):
+        self.policy = policy
+        self.queue = []  # FIFO of _Waiter
+        self.vfinish = 0.0  # finish tag of the last admitted request
+        self.granted = 0
+        self.shed = 0
+
+
+_M_SHEDS = obs_metrics.counter(
+    "paddle_fleet_sheds_total",
+    "Requests the router shed (wire status 2), by tenant and reason",
+    labelnames=("tenant", "reason"))
+_M_REQUESTS = obs_metrics.counter(
+    "paddle_fleet_requests_total",
+    "Requests finished by the router, by tenant and wire status",
+    labelnames=("tenant", "status"))
+_M_RETRIES = obs_metrics.counter(
+    "paddle_fleet_retries_total",
+    "Per-request replica retries, by cause (shed = status-2 rerouted "
+    "with backoff, io = dead-replica failover)",
+    labelnames=("cause",))
+_M_DEADLINE = obs_metrics.counter(
+    "paddle_fleet_deadline_total",
+    "Deadline accounting at the router, by tenant and outcome",
+    labelnames=("tenant", "outcome"))
+_M_INFLIGHT = obs_metrics.gauge(
+    "paddle_fleet_inflight",
+    "Requests currently admitted through the router's fair gate")
+
+
+class FairGate:
+    """Start-time weighted fair queueing over a bounded concurrency.
+
+    ``acquire(tenant)`` blocks until one of the ``capacity`` permits is
+    granted to this request in WFQ order, sheds immediately when the
+    tenant's own waiting queue is at ``max_queue``, and sheds on
+    timeout. Each grant advances the tenant's virtual finish tag by
+    ``1/weight``; the waiter with the smallest finish tag among queue
+    heads is granted first — the classic SFQ guarantee that a tenant's
+    long-run share under contention is proportional to its weight,
+    regardless of how hard another tenant storms."""
+
+    def __init__(self, capacity, policies=(), default_policy=None):
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._tenants = {}  # tid -> _TenantState
+        self._by_name = {}  # name -> _TenantState
+        self._vtime = 0.0
+        self._permits = self.capacity
+        self._seq = 0
+        self._default = default_policy or TenantPolicy(DEFAULT_TENANT)
+        for p in policies:
+            self._add(p)
+        self._add(self._default)
+
+    def _add(self, policy):
+        st = _TenantState(policy)
+        self._tenants.setdefault(policy.tid, st)
+        self._by_name.setdefault(policy.name, st)
+
+    def add_tenant(self, policy):
+        with self._lock:
+            self._add(policy)
+
+    def _state_for(self, tid):
+        # unknown tenant ids share the default tenant's queue/weight
+        # (an unconfigured tenant must not mint itself a fresh share)
+        if tid is None:
+            return self._by_name[self._default.name]
+        st = self._tenants.get(tid)
+        return st if st is not None else self._by_name[self._default.name]
+
+    def acquire(self, tid, timeout):
+        """Admit one request for tenant id `tid` (None = default).
+        Returns the tenant name. Raises :class:`ShedError` on a full
+        tenant queue or timeout."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        with self._cond:
+            st = self._state_for(tid)
+            name = st.policy.name
+            if len(st.queue) >= st.policy.max_queue:
+                st.shed += 1
+                raise ShedError("tenant_queue_full")
+            start = max(self._vtime, st.vfinish)
+            w = _Waiter(start + 1.0 / st.policy.weight, self._seq)
+            self._seq += 1
+            st.queue.append(w)
+            try:
+                while not w.granted:
+                    self._grant_locked()
+                    if w.granted:
+                        break
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise ShedError("admission_timeout")
+                    self._cond.wait(min(remaining, 0.5))
+            except ShedError:
+                st.queue.remove(w)
+                st.shed += 1
+                raise
+            st.granted += 1
+        _M_INFLIGHT.inc()
+        return name
+
+    def _grant_locked(self):
+        """Hand out permits to queue heads in WFQ order (caller holds
+        the lock)."""
+        while self._permits > 0:
+            best = None
+            for st in self._tenants.values():
+                if not st.queue:
+                    continue
+                head = st.queue[0]
+                if (best is None
+                        or (head.finish, head.seq)
+                        < (best[1].finish, best[1].seq)):
+                    best = (st, head)
+            if best is None:
+                return
+            st, head = best
+            st.queue.pop(0)
+            head.granted = True
+            self._permits -= 1
+            self._vtime = max(self._vtime, head.finish - 1.0
+                              / st.policy.weight)
+            st.vfinish = head.finish
+            self._cond.notify_all()
+
+    def release(self):
+        with self._cond:
+            self._permits += 1
+            self._grant_locked()
+        _M_INFLIGHT.dec()
+
+    def stats(self):
+        with self._lock:
+            return {st.policy.name: {
+                "weight": st.policy.weight,
+                "waiting": len(st.queue),
+                "granted": st.granted,
+                "shed": st.shed,
+            } for st in self._by_name.values()}
+
+
+def _split_meta(body):
+    """Split a cmd-1 body into (arrays_bytes, fields) where
+    arrays_bytes is the cmd byte + array payload (trailing fields
+    EXCLUDED), fields is a list of (marker, raw8) in wire order, and
+    tail is any unparsed remainder (an unknown marker stops the scan,
+    mirroring the server; the bytes are preserved for forwarding);
+    also extract (tenant_id, budget_s, trace_id)."""
+    payload = body[1:]
+    _, arrays_end = _decode_arrays_off(payload)
+    off = arrays_end
+    fields = []
+    tid = budget = trace = None
+    while len(payload) - off >= 9:
+        marker = payload[off]
+        raw = payload[off + 1:off + 9]
+        if marker == DEADLINE_MARKER and budget is None:
+            (ms,) = struct.unpack("<d", raw)
+            budget = max(0.0, float(ms)) / 1000.0
+        elif marker == TRACE_MARKER and trace is None:
+            (t,) = struct.unpack("<Q", raw)
+            trace = t or None
+        elif marker == TENANT_MARKER and tid is None:
+            (tid,) = struct.unpack("<Q", raw)
+        else:
+            break
+        fields.append((marker, raw))
+        off += 9
+    return (body[:1 + arrays_end], fields, payload[off:],
+            tid, budget, trace)
+
+
+class FleetRouter:
+    """TCP front tier over a :class:`ReplicaRegistry` (see module
+    docstring). Construct with an existing registry (``own_registry=
+    False``) or let it build one; ``tenants`` is an iterable of
+    :class:`TenantPolicy`."""
+
+    def __init__(self, registry=None, port=0, host="127.0.0.1",
+                 tenants=(), max_inflight=None, retry_attempts=None,
+                 retry_base=None, retry_max=None, admit_timeout=None,
+                 backend_timeout=None, own_registry=None,
+                 max_body=MAX_BODY_BYTES, rng=random.random):
+        own = registry is None if own_registry is None else own_registry
+        self.registry = registry if registry is not None \
+            else ReplicaRegistry()
+        self._own_registry = own
+        self.retry_attempts = max(1, (
+            retry_attempts if retry_attempts is not None
+            else _env_int("PADDLE_TPU_FLEET_RETRY_ATTEMPTS", 3)))
+        self.retry_base = (retry_base if retry_base is not None
+                           else _env_float("PADDLE_TPU_FLEET_RETRY_BASE_S",
+                                           0.05))
+        self.retry_max = (retry_max if retry_max is not None
+                          else _env_float("PADDLE_TPU_FLEET_RETRY_MAX_S",
+                                          1.0))
+        self.admit_timeout = (
+            admit_timeout if admit_timeout is not None
+            else _env_float("PADDLE_TPU_FLEET_ADMIT_TIMEOUT_S", 5.0))
+        self.backend_timeout = (
+            backend_timeout if backend_timeout is not None
+            else _env_float("PADDLE_TPU_FLEET_BACKEND_TIMEOUT_S", 30.0))
+        self.max_body = max_body
+        self._rng = rng
+        self.gate = FairGate(
+            max_inflight if max_inflight is not None
+            else _env_int("PADDLE_TPU_FLEET_MAX_INFLIGHT", 64),
+            policies=tenants)
+        self._pools = {}  # rid -> [idle sockets]
+        self._pools_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._conns = {}  # handler thread -> socket
+        self._conns_lock = threading.Lock()
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._thread = threading.Thread(target=self._serve,
+                                        name="fleet-router-accept",
+                                        daemon=True)
+        self._thread.start()
+
+    # --------------------------------------------------------- membership
+    def add_tenant(self, policy):
+        self.gate.add_tenant(policy)
+
+    # ----------------------------------------------------------- backend
+    def _pool_get(self, rid):
+        with self._pools_lock:
+            pool = self._pools.get(rid)
+            if pool:
+                return pool.pop()
+        return None
+
+    def _pool_put(self, rid, sock):
+        with self._pools_lock:
+            if not self._stop.is_set():
+                self._pools.setdefault(rid, []).append(sock)
+                return
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _pool_drop(self, rid):
+        with self._pools_lock:
+            socks = self._pools.pop(rid, [])
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _forward(self, view, frame, timeout):
+        """Send one framed request to replica `view` over a pooled
+        connection; return the raw response body (status byte +
+        payload). Raises OSError/ConnectionError/TimeoutError on a
+        dead/stalled replica (the connection is NOT returned to the
+        pool in that case — a desynced stream must never be reused)."""
+        sock = self._pool_get(view.rid)
+        fresh = sock is None
+        if fresh:
+            sock = socket.create_connection((view.host, view.port),
+                                            timeout=self.registry.dial_timeout)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        hdr = b""
+        try:
+            sock.settimeout(timeout)
+            sock.sendall(frame)
+            hdr = _read_all(sock, 4)
+            (blen,) = struct.unpack("<I", hdr)
+            body = _read_all(sock, blen)
+        except socket.timeout:
+            # a SLOW replica, not a dead stream: resending would
+            # double-execute the request and double the latency —
+            # surface the timeout (caller ejects + fails over)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        except (OSError, ConnectionError):
+            try:
+                sock.close()
+            except OSError:
+                pass
+            if not fresh and not hdr:
+                # the pooled connection was stale (closed by a replica
+                # restart between requests — reset/EOF before any
+                # reply byte): one transparent retry on a fresh dial.
+                # Inference is read-only, so even the worst case (the
+                # replica executed but died pre-reply) cannot corrupt
+                # state, and a genuinely dead replica fails the fresh
+                # dial immediately.
+                return self._forward_fresh(view, frame, timeout)
+            raise
+        self._pool_put(view.rid, sock)
+        return body
+
+    def _forward_fresh(self, view, frame, timeout):
+        sock = socket.create_connection((view.host, view.port),
+                                        timeout=self.registry.dial_timeout)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(timeout)
+            sock.sendall(frame)
+            (blen,) = struct.unpack("<I", _read_all(sock, 4))
+            body = _read_all(sock, blen)
+        except (OSError, ConnectionError):
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        self._pool_put(view.rid, sock)
+        return body
+
+    # ------------------------------------------------------------ routing
+    def _route_once(self, tried):
+        """Pick the next replica: least-loaded routable one not yet
+        tried this request; falls back to an already-tried one (it may
+        have shed transiently) rather than giving up while anything is
+        routable. Returns a ReplicaView or None."""
+        chaos.hit("fleet.route")
+        routable = self.registry.routable()
+        for view in routable:
+            if view.rid not in tried:
+                return view
+        return routable[0] if routable else None
+
+    def _dispatch(self, arrays_bytes, fields, tail, deadline):
+        """Route one admitted cmd-1 request with shed-aware retry.
+        Returns the raw response body to send to the client. Never
+        raises for fleet-topology failures — those become status 2."""
+        # forward everything except the tenant field (admission
+        # happened here; replicas predating the field would stop
+        # parsing at it and miss a deadline/trace field behind it)
+        fwd_body = arrays_bytes + b"".join(
+            struct.pack("<B", m) + raw for m, raw in fields
+            if m != TENANT_MARKER) + tail
+        frame = struct.pack("<I", len(fwd_body)) + fwd_body
+        delays = backoff_delays(self.retry_attempts, self.retry_base,
+                                self.retry_max, 0.5, self._rng)
+        tried = set()
+        last_shed = None
+        for attempt in range(1, self.retry_attempts + 1):
+            if deadline is not None and time.monotonic() >= deadline:
+                raise ShedError("deadline")
+            view = self._route_once(tried)
+            if view is None:
+                raise ShedError("no_replica")
+            tried.add(view.rid)
+            timeout = self.backend_timeout
+            if deadline is not None:
+                timeout = min(timeout,
+                              max(0.05, deadline - time.monotonic()) + 1.0)
+            self.registry.acquire(view.rid)
+            try:
+                resp = self._forward(view, frame, timeout)
+            except (OSError, ConnectionError):
+                # dead / stalled replica: poison it and fail over to a
+                # different one immediately — detection, not load
+                self.registry.report_io_error(view.rid)
+                self._pool_drop(view.rid)
+                _M_RETRIES.inc(cause="io")
+                continue
+            finally:
+                self.registry.release(view.rid)
+            self.registry.report_ok(view.rid)
+            if resp and resp[0] == STATUS_OVERLOADED:
+                last_shed = resp
+                if attempt == self.retry_attempts:
+                    break
+                delay = next(delays)
+                if deadline is not None and \
+                        time.monotonic() + delay >= deadline:
+                    raise ShedError("deadline")
+                _M_RETRIES.inc(cause="shed")
+                time.sleep(delay)
+                continue
+            return resp
+        if last_shed is not None:
+            return last_shed  # retries exhausted: the shed stands
+        raise ShedError("retries_exhausted")
+
+    def _infer(self, body):
+        """Admission + dispatch + accounting for one cmd-1 request.
+        Returns the response body bytes."""
+        t0 = time.perf_counter()
+        arrays_bytes, fields, tail, tid, budget, _trace = \
+            _split_meta(body)
+        deadline = (None if budget is None
+                    else time.monotonic() + budget)
+        # the SLO used for deadline-hit accounting: the wire deadline
+        # when the client sent one, else the tenant policy's slo_ms
+        slo_s = budget
+        if slo_s is None:
+            slo_ms = self.gate._state_for(tid).policy.slo_ms
+            slo_s = None if slo_ms is None else slo_ms / 1000.0
+        tenant_name = None
+        outcome = "error"
+        status = STATUS_ERROR
+        try:
+            admit_timeout = (budget if budget is not None
+                             else self.admit_timeout)
+            try:
+                tenant_name = self.gate.acquire(tid, admit_timeout)
+            except ShedError as e:
+                tenant_name = tenant_name or self._tenant_name(tid)
+                _M_SHEDS.inc(tenant=tenant_name, reason=e.reason)
+                outcome = "shed"
+                status = STATUS_OVERLOADED
+                return struct.pack("<B", STATUS_OVERLOADED)
+            try:
+                resp = self._dispatch(arrays_bytes, fields, tail,
+                                      deadline)
+            except ShedError as e:
+                _M_SHEDS.inc(tenant=tenant_name, reason=e.reason)
+                outcome = "shed"
+                status = STATUS_OVERLOADED
+                return struct.pack("<B", STATUS_OVERLOADED)
+            except Exception:  # noqa: BLE001 — router fault, not the
+                # request's fault: the contract is ok-or-retryable, so
+                # an internal routing failure (including an armed
+                # chaos fault on fleet.route) sheds instead of erroring
+                _M_SHEDS.inc(tenant=tenant_name, reason="router_fault")
+                outcome = "shed"
+                status = STATUS_OVERLOADED
+                return struct.pack("<B", STATUS_OVERLOADED)
+            finally:
+                self.gate.release()
+            status = resp[0] if resp else STATUS_ERROR
+            if status == STATUS_OK:
+                met = (slo_s is None
+                       or time.perf_counter() - t0 <= slo_s)
+                outcome = "ok" if met else "late"
+            elif status == STATUS_OVERLOADED:
+                outcome = "shed"
+            else:
+                outcome = "error"
+            return resp
+        finally:
+            name = tenant_name or self._tenant_name(tid)
+            dt = time.perf_counter() - t0
+            _M_REQUESTS.inc(tenant=name, status=str(status))
+            if slo_s is not None:
+                # every request of an SLO-carrying tenant is a hit or
+                # a miss — a shed/error against a deadline is a miss
+                _M_DEADLINE.inc(tenant=name,
+                                outcome="hit" if outcome == "ok"
+                                else "miss")
+            obs_goodput.SERVING_LEDGER.record(name, outcome, dt)
+
+    def _tenant_name(self, tid):
+        return self.gate._state_for(tid).policy.name
+
+    # ------------------------------------------------------------- drains
+    def drain(self, rid, deadline_s=10.0, notify_replica=True):
+        """Zero-drop drain of one replica: stop routing new work to it,
+        tell the replica itself (wire cmd 8) so its own health
+        announces the drain, then wait until the router's in-flight
+        count on it reaches zero. Returns True when drained, False on
+        timeout (in-flight work still running — the caller decides
+        whether to stop anyway)."""
+        self.registry.set_draining(rid, True)
+        if notify_replica:
+            ep = self.registry.endpoints().get(rid)
+            if ep is not None:
+                try:
+                    with socket.create_connection(
+                            ep, timeout=self.registry.dial_timeout) as s:
+                        s.settimeout(self.registry.dial_timeout)
+                        payload = struct.pack("<Bd", 8, float(deadline_s))
+                        s.sendall(struct.pack("<I", len(payload)) + payload)
+                        (blen,) = struct.unpack("<I", _read_all(s, 4))
+                        _read_all(s, blen)
+                except (OSError, ConnectionError):
+                    pass  # dead replica drains trivially
+        t_end = time.monotonic() + max(0.0, deadline_s)
+        while time.monotonic() < t_end:
+            if self.registry.inflight(rid) == 0:
+                return True
+            time.sleep(0.01)
+        return self.registry.inflight(rid) == 0
+
+    def undrain(self, rid, notify_replica=True):
+        """Re-admit a drained replica for routing (after a reload
+        finished, say)."""
+        if notify_replica:
+            ep = self.registry.endpoints().get(rid)
+            if ep is not None:
+                try:
+                    with socket.create_connection(
+                            ep, timeout=self.registry.dial_timeout) as s:
+                        s.settimeout(self.registry.dial_timeout)
+                        payload = struct.pack("<Bd", 8, -1.0)
+                        s.sendall(struct.pack("<I", len(payload)) + payload)
+                        (blen,) = struct.unpack("<I", _read_all(s, 4))
+                        _read_all(s, blen)
+                except (OSError, ConnectionError):
+                    pass
+        self.registry.set_draining(rid, False)
+
+    # ------------------------------------------------------------- server
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True)
+            with self._conns_lock:
+                self._conns[t] = conn
+            t.start()
+
+    def _handle(self, conn):
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while not self._stop.is_set():
+                conn.settimeout(None)
+                first = conn.recv(1)
+                if not first:
+                    raise ConnectionError("peer closed")
+                conn.settimeout(self.backend_timeout)
+                (blen,) = struct.unpack("<I", first + _read_all(conn, 3))
+                if blen == 0:
+                    conn.sendall(struct.pack("<IB", 1, 1))
+                    continue
+                try:
+                    body = _read_all(conn, blen, limit=self.max_body)
+                except BodyTooLarge:
+                    # same hardening as the replica server: a bogus
+                    # length prefix must not buffer gigabytes on the
+                    # front tier; the stream can't be resynced — error
+                    # status, then close
+                    conn.sendall(struct.pack("<IB", 1, 1))
+                    return
+                cmd = body[0]
+                if cmd == 7:
+                    conn.sendall(struct.pack("<IB", 1, 0))
+                    threading.Thread(target=self.stop,
+                                     daemon=True).start()
+                    return
+                if cmd == 3:
+                    enc = json.dumps(self.health()).encode("utf-8")
+                    conn.sendall(struct.pack("<IB", 1 + len(enc), 0) + enc)
+                    continue
+                if cmd == 5:
+                    enc = json.dumps(self.stats()).encode("utf-8")
+                    conn.sendall(struct.pack("<IB", 1 + len(enc), 0) + enc)
+                    continue
+                if cmd == 6:
+                    enc = obs_prometheus.render().encode("utf-8")
+                    conn.sendall(struct.pack("<IB", 1 + len(enc), 0) + enc)
+                    continue
+                if cmd != 1:
+                    # reload/stop of individual replicas goes through
+                    # Fleet.rolling_reload — a router-wide cmd 4 would
+                    # be ambiguous about which replica it names
+                    conn.sendall(struct.pack("<IB", 1, 1))
+                    continue
+                try:
+                    resp = self._infer(body)
+                    conn.sendall(struct.pack("<I", len(resp)) + resp)
+                except Exception:  # noqa: BLE001 - wire error status
+                    conn.sendall(struct.pack("<IB", 1, STATUS_ERROR))
+        except socket.timeout:
+            pass
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._conns_lock:
+                self._conns.pop(threading.current_thread(), None)
+
+    # -------------------------------------------------------------- views
+    def health(self):
+        """Fleet-level health JSON (wire cmd 3 on the router): replica
+        table with states, plus the gate view. ``ok`` is true while at
+        least one replica is routable."""
+        replicas = [v.as_dict() for v in self.registry.snapshot()]
+        routable = sum(1 for r in replicas if r["state"] == "ok")
+        return {
+            "ok": routable > 0 and not self._stop.is_set(),
+            "router": True,
+            "draining": self._stop.is_set(),
+            "accepting": not self._stop.is_set(),
+            "routable_replicas": routable,
+            "replicas": replicas,
+            "tenants": self.gate.stats(),
+        }
+
+    def stats(self):
+        return {
+            "router": True,
+            "port": self.port,
+            "retry_attempts": self.retry_attempts,
+            "max_inflight": self.gate.capacity,
+            "tenants": self.gate.stats(),
+            "replicas": [v.as_dict() for v in self.registry.snapshot()],
+            "serving_goodput": obs_goodput.SERVING_LEDGER.report(),
+        }
+
+    # -------------------------------------------------------------- close
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            conns = list(self._conns.values())
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        with self._pools_lock:
+            pools = list(self._pools.values())
+            self._pools = {}
+        for pool in pools:
+            for s in pool:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        if self._own_registry:
+            self.registry.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -21,11 +21,20 @@ wire format (little-endian):
             u8 0x1D | u64 trace_id (non-zero; tags the request's
             obs.tracing spans across enqueue/batch/execute/reply so
             one request can be followed through the engine)
+            u8 0x7E | u64 tenant_id (inference.fleet.tenant_id(name);
+            the fleet router keys admission control and per-tenant
+            goodput accounting on it; a direct replica parses and
+            ignores it)
           Old servers ignore the trailing bytes; old clients simply
           omit them — both directions stay compatible.
         3 health  payload = (empty); response body is UTF-8 JSON
             liveness/readiness: scheduler alive + heartbeat age,
-            quarantined buckets, queue depth, draining flag
+            quarantined buckets, queue depth, draining flag, plus
+            ``accepting`` (false once a drain began — route no new
+            work here, but in-flight requests still finish) and
+            ``draining_deadline_s`` (seconds the drain will still
+            wait; null when not draining). Absent fields mean
+            accepting: servers predating them never drain-announce.
         4 reload  payload = optional UTF-8 model prefix (empty = same
             prefix); the server loads + warms the new model OFF TO THE
             SIDE, swaps it in atomically, then drains the old engine —
@@ -36,6 +45,15 @@ wire format (little-endian):
             compiles/hits/latency, breaker states, queue depth,
             shed_count) — or {"engine": null} when serving without an
             engine
+        8 drain  payload = optional f64 drain budget in seconds; marks
+            the server not-accepting (health: accepting=false,
+            draining_deadline_s counts down) WITHOUT stopping it —
+            in-flight and even newly-arriving requests still serve,
+            but a fleet router that honors the flag stops routing here
+            (how the fleet scales down / hot-reloads with zero drops:
+            drain, wait for the router's in-flight count to reach
+            zero, then reload or cmd-7 stop). Response is the health
+            JSON. `undrain` = cmd 8 with f64 < 0: re-open admission.
         6 metrics  payload = (empty); response body is the Prometheus
             text exposition (format 0.0.4) of the process obs registry:
             engine counters, server conn/frame counters, resilience
@@ -87,6 +105,7 @@ STATUS_OVERLOADED = RetryableError.status_code  # 2
 # field; fields may appear in any order, each marker at most once.
 DEADLINE_MARKER = 0xDD  # + f64 relative budget in ms
 TRACE_MARKER = 0x1D  # + u64 non-zero trace id (obs.tracing)
+TENANT_MARKER = 0x7E  # + u64 tenant id (fleet router admission/SLOs)
 
 # Hardening knobs: a 4-byte length prefix from a buggy/malicious client
 # must not trigger an unbounded allocation, and a stalled client must
@@ -168,15 +187,24 @@ def _decode_arrays(payload):
     return _decode_arrays_off(payload)[0]
 
 
+def _encode_tenant(tenant_id):
+    """Trailing optional tenant-id field (the fleet router keys WFQ
+    admission and per-tenant SLO accounting on it; a direct replica
+    parses and ignores it — old servers must see it LAST)."""
+    return struct.pack("<BQ", TENANT_MARKER, int(tenant_id))
+
+
 def _decode_request(payload):
     """Decode a cmd-1 infer body: arrays plus the optional trailing
-    marker-tagged fields (deadline, trace id — any order). Returns
-    (arrays, budget_seconds_or_None, trace_id_or_None). Parsing stops
-    at the first unknown marker: old servers ignored trailing garbage,
-    and a field this server predates must not be misread."""
+    marker-tagged fields (deadline, trace id, tenant id — any order).
+    Returns (arrays, budget_seconds_or_None, trace_id_or_None).
+    Parsing stops at the first unknown marker: old servers ignored
+    trailing garbage, and a field this server predates must not be
+    misread."""
     arrays, off = _decode_arrays_off(payload)
     budget = None
     trace_id = None
+    tenant = None
     while len(payload) - off >= 9:
         marker = payload[off]
         if marker == DEADLINE_MARKER and budget is None:
@@ -185,6 +213,10 @@ def _decode_request(payload):
         elif marker == TRACE_MARKER and trace_id is None:
             (tid,) = struct.unpack_from("<Q", payload, off + 1)
             trace_id = tid or None  # 0 = "no trace" on the wire
+        elif marker == TENANT_MARKER and tenant is None:
+            # admission control happened at the router; a replica just
+            # skips past so fields AFTER the tenant id still parse
+            (tenant,) = struct.unpack_from("<Q", payload, off + 1)
         else:
             break
         off += 9
@@ -235,6 +267,13 @@ class PredictorServer:
         self._stop = threading.Event()
         self._conns = {}  # thread -> {"conn": socket, "busy": bool}
         self._conns_lock = threading.Lock()
+        # drain announcement (cmd 8 / begin_drain / stop): while
+        # _accepting is False the server still serves everything it
+        # receives, but health JSON tells routers to stop sending new
+        # work. Guarded by _conns_lock (written from handler threads
+        # via cmd 8 and from whoever calls stop()).
+        self._accepting = True
+        self._draining_deadline = None  # monotonic, or None
         # optional /metrics HTTP endpoint (obs.httpd.MetricsServer),
         # attached by serve_model(metrics_port=...); stop() closes it
         self.metrics_server = None
@@ -318,17 +357,44 @@ class PredictorServer:
         accepting work) in one probe."""
         _, engine = self._backend()
         eng = engine.health() if engine is not None else None
-        draining = self._stop.is_set()
-        ok = not draining and (eng is None or eng["ok"])
         with self._conns_lock:
             conns = len(self._conns)
+            accepting = self._accepting and not self._stop.is_set()
+            dl = self._draining_deadline
+        draining = not accepting
+        ok = not draining and (eng is None or eng["ok"])
         return json.dumps({
             "ok": ok,
             "draining": draining,
+            # readiness split (backward-compatible: absent fields mean
+            # accepting): a router distinguishes "draining, stop
+            # routing but in-flight work finishes" from "dead"
+            "accepting": accepting,
+            "draining_deadline_s": (None if (accepting or dl is None)
+                                    else round(max(0.0,
+                                                   dl - time.monotonic()),
+                                               3)),
             "connections": conns,
             "reloads": self._reload_count,
             "engine": eng,
         })
+
+    def begin_drain(self, deadline_s=None):
+        """Announce a drain (the `drain` wire command, cmd 8): health
+        flips to accepting=false so routers stop sending new work, but
+        the server keeps serving whatever arrives — the zero-drop half
+        of a scale-down or router-orchestrated reload. ``deadline_s``
+        is advisory (exported as ``draining_deadline_s``); a negative
+        value cancels the drain and re-opens admission."""
+        with self._conns_lock:
+            if deadline_s is not None and deadline_s < 0:
+                self._accepting = True
+                self._draining_deadline = None
+            else:
+                self._accepting = False
+                self._draining_deadline = (
+                    None if deadline_s is None
+                    else time.monotonic() + float(deadline_s))
 
     # ------------------------------------------------------------- reload
     def reload(self, prefix=None):
@@ -502,6 +568,14 @@ class PredictorServer:
                     conn.sendall(struct.pack("<IB", 1 + len(enc), 0) + enc)
                     self._set_busy(False)
                     continue
+                if cmd == 8:
+                    deadline_s = (struct.unpack("<d", body[1:9])[0]
+                                  if len(body) >= 9 else None)
+                    self.begin_drain(deadline_s)
+                    enc = self._health_json().encode("utf-8")
+                    conn.sendall(struct.pack("<IB", 1 + len(enc), 0) + enc)
+                    self._set_busy(False)
+                    continue
                 if cmd != 1:
                     conn.sendall(struct.pack("<IB", 1, 1))
                     self._set_busy(False)
@@ -538,6 +612,11 @@ class PredictorServer:
         mid-processing finish (up to `timeout`), force-close idle
         keep-alive connections — a rolling restart neither drops a
         response mid-write nor hangs on a silent client."""
+        # the drain announcement first: a health probe that races the
+        # shutdown (over an already-open connection) reads
+        # accepting=false + the drain budget, not a confusing
+        # "ok but about to vanish"
+        self.begin_drain(timeout if drain else 0.0)
         self._stop.set()
         obs_metrics.REGISTRY.unregister_collector(self._obs_collector)
         if self.metrics_server is not None:

@@ -18,6 +18,12 @@
 //   status   0 ok | 1 error | 2 retryable (shed by the server's
 //            batching engine / quarantined bucket / scheduler restart
 //            / expired deadline: back off and retry)
+//
+// Multi-replica failover: this client holds ONE address on purpose.
+// For a replica fleet, point it at the fleet router
+// (paddle_tpu.inference.fleet — same wire protocol) and let the
+// router do replica-level retry, ejection, and drains; the Go
+// client's WithEndpoints option exists for router-less setups.
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>

@@ -12,6 +12,9 @@ Categories:
     checkpoint  save/restore I/O (resilience/checkpoint.py feeds this)
     retry       backoff sleeps (resilience/retry.py feeds this)
     rollback    bad-step checkpoint restores (resilience/badstep.py)
+    serving     reply-seconds spent on in-deadline OK replies (the
+                fleet router feeds this; ServingGoodput below holds the
+                per-tenant breakdown)
     idle        wall-clock not covered by any recorded category
 
 Use either the context managers::
@@ -32,7 +35,7 @@ import time
 
 from . import metrics as _metrics
 
-CATEGORIES = ("step", "checkpoint", "retry", "rollback", "idle")
+CATEGORIES = ("step", "checkpoint", "retry", "rollback", "serving", "idle")
 
 _SECONDS = _metrics.counter(
     "paddle_goodput_seconds_total",
@@ -137,3 +140,105 @@ def account(category, seconds):
 
 def report():
     return ACCOUNTANT.report()
+
+
+# --------------------------------------------------------------- serving
+# Reply outcomes the fleet router records. "ok" is the goodput
+# numerator: the reply arrived AND met its deadline (or carried none).
+SERVING_OUTCOMES = ("ok", "late", "shed", "error")
+
+_SERVING_SECONDS = _metrics.counter(
+    "paddle_serving_goodput_seconds_total",
+    "Reply-service seconds per tenant and outcome (ok = in-deadline)",
+    labelnames=("tenant", "outcome"))
+_SERVING_REPLIES = _metrics.counter(
+    "paddle_serving_replies_total",
+    "Fleet replies per tenant and outcome",
+    labelnames=("tenant", "outcome"))
+
+
+class ServingGoodput:
+    """Serving-side goodput ledger ("ML Productivity Goodput" applied
+    to a reply fleet): the fraction of fleet reply-seconds spent on
+    replies that met their deadline, broken down per tenant.
+
+    The router records one event per finished request::
+
+        SERVING_LEDGER.record("tenant-a", "ok", seconds=0.012)
+
+    ``report()`` gives the fleet goodput fraction plus per-tenant
+    reply/deadline-hit counts; the same numbers export as
+    ``paddle_serving_goodput_seconds_total{tenant,outcome}`` /
+    ``paddle_serving_replies_total{tenant,outcome}``. Every in-deadline
+    OK reply's service time is also fed to the process accountant's
+    ``serving`` category, so one `goodput.report()` spans training and
+    serving."""
+
+    def __init__(self, export=True, accountant=None):
+        self._lock = threading.Lock()
+        self._data = {}  # tenant -> {outcome: [count, seconds]}
+        self._export = export
+        self._accountant = accountant
+
+    def record(self, tenant, outcome, seconds=0.0):
+        if outcome not in SERVING_OUTCOMES:
+            raise ValueError(f"unknown serving outcome {outcome!r} "
+                             f"(have {SERVING_OUTCOMES})")
+        tenant = str(tenant)
+        seconds = max(0.0, float(seconds))
+        with self._lock:
+            cell = self._data.setdefault(
+                tenant, {o: [0, 0.0] for o in SERVING_OUTCOMES})[outcome]
+            cell[0] += 1
+            cell[1] += seconds
+        if self._export:
+            _SERVING_SECONDS.inc(seconds, tenant=tenant, outcome=outcome)
+            _SERVING_REPLIES.inc(tenant=tenant, outcome=outcome)
+        if outcome == "ok":
+            (self._accountant or ACCOUNTANT).account("serving", seconds)
+
+    def report(self):
+        """-> {goodput, ok/late/shed/error totals, tenants: {name:
+        {replies, ok, late, shed, error, seconds, ok_seconds,
+        deadline_hit_rate}}}. ``goodput`` is ok-seconds over all
+        reply-seconds; ``deadline_hit_rate`` is ok replies over all
+        *answered* replies plus sheds (an error or shed is a miss, by
+        construction — a request the fleet failed to answer usefully)."""
+        with self._lock:
+            data = {t: {o: list(c) for o, c in per.items()}
+                    for t, per in self._data.items()}
+        tenants = {}
+        tot = {o: [0, 0.0] for o in SERVING_OUTCOMES}
+        for t, per in sorted(data.items()):
+            replies = sum(c[0] for c in per.values())
+            secs = sum(c[1] for c in per.values())
+            for o in SERVING_OUTCOMES:
+                tot[o][0] += per[o][0]
+                tot[o][1] += per[o][1]
+            tenants[t] = {
+                "replies": replies,
+                **{o: per[o][0] for o in SERVING_OUTCOMES},
+                "seconds": round(secs, 6),
+                "ok_seconds": round(per["ok"][1], 6),
+                "deadline_hit_rate": (round(per["ok"][0] / replies, 6)
+                                      if replies else 0.0),
+            }
+        total_s = sum(c[1] for c in tot.values())
+        total_n = sum(c[0] for c in tot.values())
+        return {
+            "goodput": (round(tot["ok"][1] / total_s, 6)
+                        if total_s > 0 else 0.0),
+            "replies": total_n,
+            **{o: tot[o][0] for o in SERVING_OUTCOMES},
+            "total_seconds": round(total_s, 6),
+            "ok_seconds": round(tot["ok"][1], 6),
+            "tenants": tenants,
+        }
+
+    def reset(self):
+        with self._lock:
+            self._data = {}
+
+
+#: Default process serving-goodput ledger; the fleet router feeds it.
+SERVING_LEDGER = ServingGoodput()

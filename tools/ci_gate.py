@@ -6,7 +6,8 @@ Usage:
     python tools/ci_gate.py [--paths paddle_tpu]
         [--skip-tests] [--pytest-args "tests/ -q -m 'not slow'"]
         [--disable TPU005,...] [--chaos] [--serving] [--serving-chaos]
-        [--elastic] [--artifacts] [--perfproxy] [--concurrency]
+        [--elastic] [--artifacts] [--fleet] [--perfproxy]
+        [--concurrency]
         [--clean-paths paddle_tpu/resilience paddle_tpu/inference
          paddle_tpu/obs paddle_tpu/analysis]
 
@@ -39,7 +40,12 @@ so it owns its own budget line). ``--artifacts`` adds a stage running
 the compiled-artifact-store suite (``-m artifacts``: bit-flip /
 torn-publish / version-skew chaos, multi-process single-flight warmup
 races, and the coldstart bench contract), excluded from tier-1 by the
-same compositional double-run guard as serving/elastic. ``--perfproxy``
+same compositional double-run guard as serving/elastic. ``--fleet``
+adds a stage running the fleet-tier suite (``-m fleet``: router WFQ
+fairness / eject-probe-readmit / retry-on-different-replica /
+drain-zero-drops units, the chaos-kill multi-replica e2e, and the
+``bench.py fleet`` goodput + SLO-isolation contract), with the same
+compositional tier-1 exclusion. ``--perfproxy``
 adds a stage running ``bench.py perfproxy`` on CPU against the
 committed PERFPROXY_BASELINE.json — compile counts, HLO op counts, and
 cost-analysis FLOPs must match, so single-chip perf can't silently rot
@@ -84,6 +90,10 @@ ELASTIC_PYTEST_ARGS = "tests/ -q -m elastic -p no:cacheprovider"
 # skew) + multi-process single-flight warmup cases, including its
 # slow-marked subprocess races and the coldstart bench contract
 ARTIFACTS_PYTEST_ARGS = "tests/ -q -m artifacts -p no:cacheprovider"
+# the fleet-tier suite: router/registry units (WFQ fairness,
+# eject/readmit, retry-on-different-replica, drain-zero-drops) plus
+# the slow chaos-kill e2e and the `bench.py fleet` contract
+FLEET_PYTEST_ARGS = "tests/ -q -m fleet -p no:cacheprovider"
 # subsystems that must stay suppression-free: resilience (PR 2), the
 # serving stack (PRs 4-5), the telemetry layer (PR 7), and the analyzer
 # itself (PR 8) fix findings instead of silencing them. One carve-out:
@@ -359,6 +369,11 @@ def main(argv=None):
                          "version-skew chaos, multi-process single-"
                          "flight warmup, coldstart bench contract)")
     ap.add_argument("--artifacts-args", default=ARTIFACTS_PYTEST_ARGS)
+    ap.add_argument("--fleet", action="store_true",
+                    help="also run the fleet-tier suite (-m fleet: "
+                         "router WFQ/eject/drain units, chaos-kill "
+                         "multi-replica e2e, fleet bench contract)")
+    ap.add_argument("--fleet-args", default=FLEET_PYTEST_ARGS)
     ap.add_argument("--known-failures", default=KNOWN_FAILURES_FILE,
                     help="JSON file naming the committed pre-existing "
                          "tier-1 failures the stage diffs against")
@@ -407,6 +422,8 @@ def main(argv=None):
                 excl.append("elastic")
             if ns.artifacts:
                 excl.append("artifacts")
+            if ns.fleet:
+                excl.append("fleet")
             if excl:
                 pytest_args = pytest_args.replace(
                     "'not slow'",
@@ -460,6 +477,10 @@ def main(argv=None):
     if ns.artifacts:
         artifacts_ok = run_pytest(ns.artifacts_args) == 0
 
+    fleet_ok = True
+    if ns.fleet:
+        fleet_ok = run_pytest(ns.fleet_args) == 0
+
     perfproxy_ok = True
     if ns.perfproxy:
         perfproxy_ok = run_perfproxy() == 0
@@ -480,6 +501,7 @@ def main(argv=None):
                  + ("+serving-chaos" if ns.serving_chaos else "")
                  + ("+elastic" if ns.elastic else "")
                  + ("+artifacts" if ns.artifacts else "")
+                 + ("+fleet" if ns.fleet else "")
                  + ("+perfproxy" if ns.perfproxy else "")
                  + ("+concurrency" if ns.concurrency else "")),
         "lint_ok": lint_ok,
@@ -503,6 +525,8 @@ def main(argv=None):
         "elastic_run": bool(ns.elastic),
         "artifacts_ok": artifacts_ok,
         "artifacts_run": bool(ns.artifacts),
+        "fleet_ok": fleet_ok,
+        "fleet_run": bool(ns.fleet),
         "perfproxy_ok": perfproxy_ok,
         "perfproxy_run": bool(ns.perfproxy),
         "concurrency_ok": concurrency_ok,
@@ -513,7 +537,8 @@ def main(argv=None):
     print(json.dumps(summary))
     if not (lint_ok and audit_ok and tests_ok and chaos_ok
             and serving_ok and serving_chaos_ok and elastic_ok
-            and artifacts_ok and perfproxy_ok and concurrency_ok):
+            and artifacts_ok and fleet_ok and perfproxy_ok
+            and concurrency_ok):
         print("ci_gate: FAILED", file=sys.stderr)
         return 1
     return 0
