@@ -4,7 +4,21 @@
   resnet50        ResNet-50 ImageNet training images/s (config 1)
   llama           ~374M Llama seq-2048 pretraining tokens/s + MFU
                   (BASELINE stretch, drives the Pallas flash kernel)
-  decode          KV-cached serving decode tokens/s vs an HBM roofline
+  decode          CPU-only continuous-batching decode bench (also:
+                  `python bench.py decode`): a closed-loop many-client
+                  token-streaming storm against two subprocess decode
+                  replicas (tests/decode_worker.py) — the continuous-
+                  batching engine (iteration-level scheduling, slots=
+                  BENCH_DECODE_SLOTS) vs the one-shot baseline (slots=1:
+                  each sequence decoded alone, the pre-ISSUE-12 shape).
+                  Reports tokens/s and p99 inter-token latency (first
+                  token included: per-token SLOs treat TTFT as a token)
+                  for both sides, plus the zero-cold-start contract: a
+                  THIRD fresh replica warms its whole decode-program
+                  ladder from the shared artifact store with zero
+                  inline XLA compiles.
+                  BENCH_DECODE_{CLIENTS,SECS,SLOTS,NEW_TOKENS} tune it.
+  decode-roofline KV-cached serving decode tokens/s vs an HBM roofline
   flash           raw flash-attention kernel fwd+bwd TFLOP/s at seq 4096
                   (BENCH_FLASH_PRESET=llama for the d=128 shape)
   serving         dynamic-batching server QPS + p50/p99 latency under
@@ -100,10 +114,15 @@ elif "coldstart" in sys.argv[1:]:
     MODEL = "coldstart"  # CLI spelling: python bench.py coldstart
 elif "fleet" in sys.argv[1:]:
     MODEL = "fleet"  # CLI spelling: python bench.py fleet
+elif "decode-roofline" in sys.argv[1:]:
+    MODEL = "decode-roofline"  # CLI spelling: python bench.py decode-roofline
+elif "decode" in sys.argv[1:]:
+    MODEL = "decode"  # CLI spelling: python bench.py decode
 METRIC = {"resnet50": "resnet50_train_images_per_sec_per_chip",
           "flash": "flash_attention_fwd_bwd_tflops_per_chip",
           "llama": "llama_374m_pretrain_tokens_per_sec_per_chip",
-          "decode": "llama_374m_decode_tokens_per_sec_per_chip",
+          "decode": "serving_decode_tokens_per_sec_continuous_batching",
+          "decode-roofline": "llama_374m_decode_tokens_per_sec_per_chip",
           "serving": "serving_infer_qps_dynamic_batching",
           "goodput": "training_goodput_steps_per_hour_under_chaos",
           "coldstart": "serving_coldstart_first_healthy_reply_seconds",
@@ -329,6 +348,13 @@ def main():
         jax.config.update("jax_platforms", "cpu")
         return run_fleet()
 
+    if MODEL == "decode":
+        # CPU-only by design: the decode replicas are subprocesses on
+        # this host; iteration-level scheduling vs one-shot decode is
+        # a scheduling property, not a chip property
+        jax.config.update("jax_platforms", "cpu")
+        return run_decode_storm()
+
     smoke = os.environ.get("BENCH_CPU") == "1"
     if smoke:
         jax.config.update("jax_platforms", "cpu")
@@ -359,8 +385,8 @@ def main():
         return run_flash(smoke, platform)
     if MODEL == "llama":
         return run_llama(smoke, platform)
-    if MODEL == "decode":
-        return run_decode(smoke, platform)
+    if MODEL == "decode-roofline":
+        return run_decode_roofline(smoke, platform)
     if MODEL == "serving":
         if ("--chaos" in sys.argv
                 or os.environ.get("BENCH_SERVING_CHAOS") == "1"):
@@ -702,7 +728,7 @@ def run_llama(smoke, platform):
     return rec
 
 
-def run_decode(smoke, platform):
+def run_decode_roofline(smoke, platform):
     """KV-cached autoregressive decode throughput (the inference-side
     number: reference analog is the Predictor/serving path). Runs the
     ~374M Llama's jitted prefill+lax.scan decode (text/generation.py)
@@ -1685,6 +1711,269 @@ def run_fleet():
     return rec
 
 
+def _decode_client_proc(port, frame, secs, conns, barrier, out_q):
+    """One decode-storm client process: `conns` closed-loop streaming
+    connections through a selector. Per connection it sends the canned
+    streaming decode request, records the gap to EVERY reply frame
+    (the first gap is time-to-first-token: per-token SLOs treat the
+    first token as a token), counts tokens from the chunk headers, and
+    immediately re-issues on the terminal frame. Status-2 terminals
+    are counted as sheds and re-issued. Puts (gaps, tokens, streams,
+    sheds) on out_q."""
+    import selectors
+    import socket
+    import time as time_mod
+
+    gaps = []
+    tokens = 0
+    streams = 0
+    sheds = 0
+    try:
+        socks = []
+        for _ in range(conns):
+            s = socket.create_connection(("127.0.0.1", port))
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            socks.append(s)
+        barrier.wait(120)
+        sel = selectors.DefaultSelector()
+        state = {}  # sock -> [t_last_event, recv_buffer]
+        t_end = time_mod.monotonic() + secs
+        for s in socks:
+            sel.register(s, selectors.EVENT_READ)
+            state[s] = [time_mod.monotonic(), b""]
+            s.sendall(frame)
+        while time_mod.monotonic() < t_end:
+            for key, _ in sel.select(timeout=0.1):
+                s = key.fileobj
+                data = s.recv(1 << 16)
+                if not data:
+                    raise ConnectionError("peer closed")
+                st = state[s]
+                st[1] += data
+                while len(st[1]) >= 4:
+                    blen = int.from_bytes(st[1][:4], "little")
+                    if len(st[1]) < 4 + blen:
+                        break
+                    body = st[1][4:4 + blen]
+                    st[1] = st[1][4 + blen:]
+                    now = time_mod.monotonic()
+                    status = body[0]
+                    if status in (0, 3):
+                        # status | n=1 | dtype | ndim=1 | i64 count
+                        count = (int.from_bytes(body[4:12], "little")
+                                 if len(body) > 12 else 0)
+                        if count:
+                            # gap samples ONLY for frames that carried
+                            # tokens: an empty status-0 terminal after
+                            # the last chunk is not a token arrival and
+                            # must not deflate the p50/p99 inter-token
+                            # numbers the acceptance contract reads
+                            gaps.append(now - st[0])
+                            st[0] = now
+                            tokens += count
+                    if status == 3:
+                        continue  # mid-stream chunk
+                    if status == 2:
+                        sheds += 1
+                    elif status == 0:
+                        streams += 1
+                    else:
+                        raise AssertionError(f"status {status}")
+                    st[0] = time_mod.monotonic()
+                    s.sendall(frame)  # next stream on this connection
+        for s in socks:
+            s.close()
+        out_q.put((gaps, tokens, streams, sheds))
+    except BaseException as e:  # noqa: BLE001 - parent raises on this
+        out_q.put(e)
+
+
+def run_decode_storm():
+    """Continuous-batching decode vs the one-shot baseline (ISSUE 12
+    acceptance): the same closed-loop token-streaming storm against
+    two decode replicas that differ ONLY in iteration-level batching —
+    slots=N (sequences join/leave the running batch every step) vs
+    slots=1 (each sequence decoded alone while the rest queue, the
+    fixed-batch one-shot shape). Reports tokens/s and p99 inter-token
+    latency per side, then proves the zero-cold-start contract: a
+    fresh third replica warms its whole decode-program ladder from the
+    shared artifact store with ZERO inline XLA compiles."""
+    import shutil
+    import tempfile
+
+    # explicit cleanup (the bench exits through os._exit, so atexit
+    # would never fire): repeated CI gate runs must not litter $TMPDIR
+    # with 15-program artifact stores
+    store_dir = tempfile.mkdtemp(prefix="decode_bench_store_")
+    try:
+        return _decode_storm_measure(store_dir)
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+
+
+def _decode_storm_measure(store_dir):
+    import multiprocessing as mp
+    import socket
+    import struct
+    import subprocess
+
+    from paddle_tpu.inference.server import (_encode_arrays,
+                                             _encode_decode_opts,
+                                             _read_all)
+
+    clients = int(os.environ.get("BENCH_DECODE_CLIENTS", "8"))
+    secs = float(os.environ.get("BENCH_DECODE_SECS", "4.0"))
+    slots = int(os.environ.get("BENCH_DECODE_SLOTS", "8"))
+    new_tokens = int(os.environ.get("BENCH_DECODE_NEW_TOKENS", "16"))
+
+    prompt = np.array([3, 1, 4, 1, 5, 9], np.int32)
+    req = (struct.pack("<B", 1) + _encode_arrays([prompt])
+           + _encode_decode_opts(new_tokens))
+    frame = struct.pack("<I", len(req)) + req
+
+    def spawn_worker(n_slots):
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   DECODE_WORKER_MAX_SLOTS=str(n_slots),
+                   DECODE_WORKER_MAX_SEQ="64",
+                   DECODE_WORKER_MAX_PROMPT="8",
+                   DECODE_WORKER_WARM="1",
+                   PADDLE_TPU_ARTIFACT_DIR=store_dir)
+        proc = subprocess.Popen(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tests", "decode_worker.py")],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env)
+        line = proc.stdout.readline()
+        if not line.startswith("PORT "):
+            proc.kill()
+            fail(f"decode worker failed to start: {line!r}")
+        return proc, int(line.split()[1])
+
+    def worker_stats(port):
+        with socket.create_connection(("127.0.0.1", port)) as s:
+            s.sendall(struct.pack("<IB", 1, 5))
+            (blen,) = struct.unpack("<I", _read_all(s, 4))
+            return json.loads(_read_all(s, blen)[1:].decode())
+
+    def stop_worker(proc, port):
+        try:
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=5) as s:
+                s.sendall(struct.pack("<IB", 1, 7))
+                _read_all(s, 5)
+        except OSError:
+            pass
+        proc.wait(timeout=20)
+
+    ctx = mp.get_context("spawn")
+    n_procs = min(clients, max(2, (os.cpu_count() or 2) // 2))
+    per_proc = [clients // n_procs + (1 if i < clients % n_procs else 0)
+                for i in range(n_procs)]
+    per_proc = [c for c in per_proc if c]
+    sys.setswitchinterval(float(os.environ.get("BENCH_SWITCH_INTERVAL",
+                                               "0.0005")))
+
+    def storm(port, label):
+        barrier = ctx.Barrier(len(per_proc))
+        out_q = ctx.Queue()
+        procs = [ctx.Process(target=_decode_client_proc,
+                             args=(port, frame, secs, conns, barrier,
+                                   out_q), daemon=True)
+                 for conns in per_proc]
+        for p in procs:
+            p.start()
+        gaps, tokens, streams, sheds = [], 0, 0, 0
+        for _ in procs:
+            got = out_q.get(timeout=secs + 180)
+            if isinstance(got, BaseException):
+                fail(f"decode bench ({label}) client failed: {got!r}")
+            gaps.extend(got[0])
+            tokens += got[1]
+            streams += got[2]
+            sheds += got[3]
+        for p in procs:
+            p.join(30)
+        if tokens == 0:
+            fail(f"decode bench ({label}): no token arrived")
+        gap_ms = np.asarray(gaps) * 1000.0
+        rate = tokens / secs
+        p50 = float(np.percentile(gap_ms, 50))
+        p99 = float(np.percentile(gap_ms, 99))
+        log(f"{label}: {tokens} tokens / {streams} streams in "
+            f"{secs:.1f}s -> {rate:.0f} tok/s, inter-token p50 "
+            f"{p50:.2f}ms p99 {p99:.2f}ms, {sheds} sheds "
+            f"({clients} conns / {len(per_proc)} client procs)")
+        return rate, p50, p99, streams, sheds
+
+    # one-shot baseline: slots=1, every other knob identical. It runs
+    # FIRST and publishes its (small) ladder; the continuous worker
+    # then publishes the full slot ladder the coldstart check needs.
+    base_proc, base_port = spawn_worker(1)
+    try:
+        base_rate, base_p50, base_p99, base_streams, base_sheds = \
+            storm(base_port, "one-shot r0")
+    finally:
+        stop_worker(base_proc, base_port)
+
+    cb_proc, cb_port = spawn_worker(slots)
+    try:
+        rate, p50, p99, streams, sheds = storm(cb_port, "continuous r0")
+        cb_stats = worker_stats(cb_port)["decode"]
+    finally:
+        stop_worker(cb_proc, cb_port)
+
+    # zero-cold-start: a FRESH replica's warmup must load the whole
+    # ladder from the store the continuous worker published — zero
+    # inline XLA compiles before its first request
+    cold_proc, cold_port = spawn_worker(slots)
+    try:
+        cold_stats = worker_stats(cold_port)["decode"]
+    finally:
+        stop_worker(cold_proc, cold_port)
+    if cold_stats["compiles"] != 0:
+        fail(f"coldstart contract broken: fresh decode replica paid "
+             f"{cold_stats['compiles']} inline compiles "
+             f"(store_loads={cold_stats['store_loads']})")
+
+    speedup = rate / base_rate if base_rate else 0.0
+    rec = {
+        "metric": METRIC,
+        "value": round(rate, 1),
+        "unit": "tokens/s",
+        # no external baseline exists: vs_baseline = tokens/s speedup
+        # over the one-shot (slots=1) decode of the same storm
+        "vs_baseline": round(speedup, 4),
+        "clients": clients,
+        "slots": slots,
+        "new_tokens": new_tokens,
+        "tokens_per_sec": round(rate, 1),
+        "p50_intertoken_ms": round(p50, 3),
+        "p99_intertoken_ms": round(p99, 3),
+        "streams": streams,
+        "shed_count": sheds,
+        "baseline_tokens_per_sec": round(base_rate, 1),
+        "baseline_p50_intertoken_ms": round(base_p50, 3),
+        "baseline_p99_intertoken_ms": round(base_p99, 3),
+        "baseline_streams": base_streams,
+        "baseline_shed_count": base_sheds,
+        "speedup_vs_oneshot": round(speedup, 2),
+        "p99_ratio_vs_oneshot": round(p99 / base_p99, 4)
+                                if base_p99 else 0.0,
+        "engine_compiles": int(cb_stats["compiles"]),
+        "engine_store_loads": int(cb_stats["store_loads"]),
+        "coldstart_inline_compiles": int(cold_stats["compiles"]),
+        "coldstart_store_loads": int(cold_stats["store_loads"]),
+        "smoke": True,
+    }
+    log(f"continuous batching: {speedup:.2f}x tokens/s vs one-shot, "
+        f"p99 inter-token {p99:.1f}ms vs {base_p99:.1f}ms, fresh "
+        f"replica warmed {cold_stats['store_loads']} programs with "
+        f"{cold_stats['compiles']} inline compiles")
+    return rec
+
+
 def run_goodput():
     """Elastic-training goodput: useful-steps/hour under injected host
     loss vs the same workload healthy (ROADMAP item 3, the training
@@ -1914,6 +2203,45 @@ def _perfproxy_measure():
     finally:
         engine.close()
 
+    # ---- scenario 3: the continuous-batching decode program ladder.
+    # Warmup must compile every (phase, slot_bucket, seq_bucket) rung
+    # exactly once, and a post-warmup join/leave storm must add ZERO
+    # compiles — the decode ladder's compile-once promise (ISSUE 12):
+    # a regression here means decode programs silently regrow compiles.
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests"))
+    from decode_worker import toy_decode_model
+    from paddle_tpu.inference.decode import DecodeEngine
+
+    dmodel = toy_decode_model(hidden=32, vocab=64, seed=0)
+    dengine = DecodeEngine(dmodel, max_slots=4, max_seq_len=32,
+                           min_seq_bucket=8, max_prompt_len=8,
+                           watchdog_interval=0, name="perfproxy-decode")
+    try:
+        dengine.warmup()
+        d_warm = LEDGER.totals("decode/")
+        d_programs = {}
+        for ev in LEDGER.events("decode/"):
+            d_programs[ev["key"].split("/", 1)[1]] = {
+                "flops": ev.get("flops", 0.0),
+                "n_ops": ev.get("n_ops", 0),
+                "fingerprint": ev.get("fingerprint", ""),
+            }
+        # join/leave traffic across the whole ladder: staggered
+        # lengths force seq-bucket climbs and slot-bucket changes
+        reqs = [dengine.submit(np.array([1, 2, 3], np.int32),
+                               max_new_tokens=20),
+                dengine.submit(np.array([4, 5], np.int32),
+                               max_new_tokens=4),
+                dengine.submit(np.arange(1, 8, dtype=np.int32),
+                               max_new_tokens=12)]
+        for r in reqs:
+            r.result(timeout=120)
+        d_post = LEDGER.totals("decode/")["compiles"] \
+            - d_warm["compiles"]
+    finally:
+        dengine.close()
+
     # ---- scenario 2: one full jitted train step (fwd + bwd + AdamW
     # under amp O1) AOT-lowered so cost_analysis sees the real program
     # the speed ladder optimizes.
@@ -1951,6 +2279,14 @@ def _perfproxy_measure():
             "n_ops": int(warm["n_ops"]),
             "op_counts": warm["op_counts"],
             "buckets": buckets,
+        },
+        "decode": {
+            "warmup_compiles": int(d_warm["compiles"]),
+            "post_warmup_compiles": int(d_post),
+            "flops": d_warm["flops"],
+            "n_ops": int(d_warm["n_ops"]),
+            "op_counts": d_warm["op_counts"],
+            "programs": d_programs,
         },
         "train_step": {
             "flops": train_info.get("flops", 0.0),
@@ -2004,6 +2340,25 @@ def _perfproxy_compare(measured, baseline, flop_tol, op_tol):
         mb = m_s["buckets"].get(b, {})
         chk(f"serving.bucket{b}.flops", mb.get("flops", 0.0),
             b_s["buckets"][b]["flops"], flop_tol)
+    m_d = measured.get("decode")
+    b_d = baseline.get("decode")
+    if b_d is None:
+        # a baseline predating the decode ladder cannot green-light it:
+        # regenerate with --update-baseline
+        checks.append({"check": "decode.baseline_present", "measured": 1,
+                       "baseline": 0, "tol": None, "ok": False})
+    else:
+        chk("decode.warmup_compiles", m_d["warmup_compiles"],
+            b_d["warmup_compiles"])
+        chk("decode.post_warmup_compiles", m_d["post_warmup_compiles"],
+            b_d["post_warmup_compiles"])
+        chk("decode.flops", m_d["flops"], b_d["flops"], flop_tol)
+        chk("decode.n_ops", m_d["n_ops"], b_d["n_ops"], op_tol)
+        chk_ops("decode.op_counts", m_d["op_counts"], b_d["op_counts"])
+        for name in sorted(b_d["programs"]):
+            mp_ = m_d["programs"].get(name, {})
+            chk(f"decode.{name}.flops", mp_.get("flops", 0.0),
+                b_d["programs"][name]["flops"], flop_tol)
     m_t, b_t = measured["train_step"], baseline["train_step"]
     chk("train_step.flops", m_t["flops"], b_t["flops"], flop_tol)
     chk("train_step.n_ops", m_t["n_ops"], b_t["n_ops"], op_tol)
@@ -2019,6 +2374,13 @@ def _perfproxy_compare(measured, baseline, flop_tol, op_tol):
     if m_t.get("fingerprint") != b_t.get("fingerprint"):
         notes.append(f"train_step HLO fingerprint changed "
                      f"{b_t.get('fingerprint')} -> {m_t.get('fingerprint')}")
+    if b_d is not None:
+        for name in sorted(b_d["programs"]):
+            got = m_d["programs"].get(name, {}).get("fingerprint", "")
+            want = b_d["programs"][name].get("fingerprint", "")
+            if got != want:
+                notes.append(f"decode {name} HLO fingerprint changed "
+                             f"{want} -> {got}")
     return checks, notes
 
 

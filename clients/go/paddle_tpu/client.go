@@ -11,11 +11,14 @@
 //             any order (servers predating a field ignore the bytes):
 //               u8 0xDD | f64 timeout_ms   per-request deadline
 //               u8 0x1D | u64 trace_id     non-zero span-trace id
+//               u8 0x5C | u64 decode opts   continuous-batching decode
+//                         (low 32 bits max_new_tokens, bit 63 one-shot)
 //   response: u32 body_len | u8 status | same encoding of outputs
 //   status:   0 ok | 1 error | 2 retryable (request shed by the
 //             server's batching engine, a quarantined bucket, a
 //             scheduler restart, or an expired deadline — back off
-//             and retry; see WithRetry)
+//             and retry; see WithRetry) | 3 stream chunk, more follow
+//             (streaming decode replies only; see RunStream)
 package paddletpu
 
 import (
@@ -56,12 +59,29 @@ var dtypeSize = map[byte]int{dtypeF32: 4, dtypeI32: 4, dtypeI64: 8, dtypeBool: 1
 // backoff-and-retry itself.
 var ErrOverloaded = fmt.Errorf("server overloaded: request shed (status 2)")
 
-// deadlineMarker / traceMarker tag the optional trailing fields on an
-// infer body (mirror server.py DEADLINE_MARKER / TRACE_MARKER).
+// deadlineMarker / traceMarker / decodeMarker tag the optional trailing
+// fields on an infer body (mirror server.py DEADLINE_MARKER /
+// TRACE_MARKER / DECODE_MARKER).
 const (
 	deadlineMarker = 0xDD
 	traceMarker    = 0x1D
+	decodeMarker   = 0x5C
 )
+
+// decodeOneshotBit in the decode field's u64 asks for a single
+// collected reply instead of a chunk stream.
+const decodeOneshotBit = uint64(1) << 63
+
+// statusStream marks a non-final chunk frame of a streaming decode
+// reply (server status byte 3).
+const statusStream = 3
+
+// ErrStreamBroken is returned by TokenStream.Recv when the connection
+// died mid-stream: the tokens received so far are a valid prefix, but
+// the sequence is INCOMPLETE and the request should be retried.
+// errors.Is(err, ErrOverloaded) is true — a broken stream is always
+// retryable, never a silent truncation.
+var ErrStreamBroken = fmt.Errorf("stream broken mid-flight: %w", ErrOverloaded)
 
 // NewTraceID returns a random non-zero trace id (0 means "untraced" on
 // the wire).
@@ -99,6 +119,9 @@ type Predictor struct {
 	// the server-side spans (enqueue/batch/execute/reply) so one
 	// request can be followed through the engine
 	traceID uint64
+	// the open token stream, if any: the connection is dedicated to it
+	// until the terminal frame, so Run/RunStream refuse while set
+	stream *TokenStream
 }
 
 // Option configures a Predictor (NewPredictor(addr, opts...)).
@@ -201,10 +224,20 @@ func (p *Predictor) rotate() {
 }
 
 func (p *Predictor) Close() error {
+	// closing the predictor abandons any open stream with it: clear
+	// the guard so a reused (re-dialed) predictor is not permanently
+	// refused — every other failure path recovers by redialing, and
+	// Close must not be the one that bricks the handle
+	if p.stream != nil {
+		p.stream.err = ErrStreamBroken
+		p.stream = nil
+	}
 	if p.conn == nil {
 		return nil
 	}
-	return p.conn.Close()
+	err := p.conn.Close()
+	p.conn = nil
+	return err
 }
 
 // ioError poisons the connection after a failed write or read: the
@@ -225,6 +258,27 @@ func (p *Predictor) ioError(err error) error {
 // Run sends the inputs and returns the model outputs, honoring the
 // WithTimeout deadline and the WithRetry backoff policy.
 func (p *Predictor) Run(inputs []Tensor) ([]Tensor, error) {
+	return p.run(inputs, nil)
+}
+
+// RunDecode sends a ONE-SHOT decode request (wire field 0x5C with the
+// one-shot bit): input 0 is the prompt (i32/i64 token ids, Dims [n]),
+// further inputs are the model's per-sequence features; the single
+// reply holds the whole generated token sequence. WithTimeout becomes
+// the request's PER-TOKEN budget on the server. Needs a server with a
+// decode engine; see RunStream for the streaming variant.
+func (p *Predictor) RunDecode(inputs []Tensor, maxNewTokens uint32) ([]Tensor, error) {
+	field := make([]byte, 0, 9)
+	field = append(field, decodeMarker)
+	field = binary.LittleEndian.AppendUint64(field,
+		uint64(maxNewTokens)|decodeOneshotBit)
+	return p.run(inputs, field)
+}
+
+func (p *Predictor) run(inputs []Tensor, extra []byte) ([]Tensor, error) {
+	if p.stream != nil {
+		return nil, fmt.Errorf("a token stream is open on this connection; finish or Close it first")
+	}
 	var last error
 	for attempt := 0; attempt < p.retryAttempts; attempt++ {
 		if attempt > 0 {
@@ -236,7 +290,7 @@ func (p *Predictor) Run(inputs []Tensor) ([]Tensor, error) {
 			d *= 1.0 + 0.5*(2.0*rand.Float64()-1.0)
 			time.Sleep(time.Duration(d))
 		}
-		outs, err := p.runOnce(inputs)
+		outs, err := p.runOnce(inputs, extra)
 		if err != ErrOverloaded {
 			return outs, err
 		}
@@ -255,7 +309,10 @@ func (p *Predictor) Run(inputs []Tensor) ([]Tensor, error) {
 	return nil, last
 }
 
-func (p *Predictor) runOnce(inputs []Tensor) ([]Tensor, error) {
+// sendRequest encodes and writes one cmd-1 frame (inputs + the extra
+// trailing field bytes + deadline/trace fields), dialing if the
+// connection was poisoned. Shared by runOnce and RunStream.
+func (p *Predictor) sendRequest(inputs []Tensor, extra []byte) (net.Conn, error) {
 	body := []byte{1, byte(len(inputs))}
 	for i, t := range inputs {
 		set := 0
@@ -321,14 +378,15 @@ func (p *Predictor) runOnce(inputs []Tensor) ([]Tensor, error) {
 		p.conn = conn
 	}
 	conn := p.conn
+	body = append(body, extra...)
 	if p.timeout > 0 {
-		// optional wire deadline field (old servers ignore it) + a
-		// matching socket deadline for this attempt
+		// optional wire deadline field (old servers ignore it; decode
+		// servers read it as the PER-TOKEN budget) + a matching
+		// socket deadline for this attempt — the CALLER clears it
 		body = append(body, deadlineMarker)
 		ms := float64(p.timeout) / float64(time.Millisecond)
 		body = binary.LittleEndian.AppendUint64(body, math.Float64bits(ms))
 		_ = conn.SetDeadline(time.Now().Add(p.timeout))
-		defer conn.SetDeadline(time.Time{})
 	}
 	if p.traceID != 0 {
 		// optional wire trace-id field (old servers ignore it)
@@ -339,6 +397,11 @@ func (p *Predictor) runOnce(inputs []Tensor) ([]Tensor, error) {
 	if _, err := conn.Write(append(hdr, body...)); err != nil {
 		return nil, p.ioError(err)
 	}
+	return conn, nil
+}
+
+// readFrame reads one length-prefixed response frame body.
+func (p *Predictor) readFrame(conn net.Conn) ([]byte, error) {
 	var rlenBuf [4]byte
 	if _, err := io.ReadFull(conn, rlenBuf[:]); err != nil {
 		return nil, p.ioError(err)
@@ -350,12 +413,33 @@ func (p *Predictor) runOnce(inputs []Tensor) ([]Tensor, error) {
 	if len(resp) < 1 {
 		return nil, fmt.Errorf("empty response")
 	}
+	return resp, nil
+}
+
+func (p *Predictor) runOnce(inputs []Tensor, extra []byte) ([]Tensor, error) {
+	conn, err := p.sendRequest(inputs, extra)
+	if err != nil {
+		return nil, err
+	}
+	if p.timeout > 0 {
+		defer conn.SetDeadline(time.Time{})
+	}
+	resp, err := p.readFrame(conn)
+	if err != nil {
+		return nil, err
+	}
 	if resp[0] == 2 {
 		return nil, ErrOverloaded
 	}
 	if resp[0] != 0 {
 		return nil, fmt.Errorf("inference failed (status %d)", resp[0])
 	}
+	return parseTensors(resp)
+}
+
+// parseTensors decodes the output tensors of one reply frame body
+// (resp[0] is the status byte, already checked by the caller).
+func parseTensors(resp []byte) ([]Tensor, error) {
 	if len(resp) < 2 {
 		return nil, fmt.Errorf("truncated response header")
 	}
@@ -423,4 +507,127 @@ func (p *Predictor) runOnce(inputs []Tensor) ([]Tensor, error) {
 		outs = append(outs, out)
 	}
 	return outs, nil
+}
+
+// TokenStream iterates a streaming decode reply (see RunStream). The
+// connection is dedicated to the stream until the terminal frame.
+type TokenStream struct {
+	p    *Predictor
+	conn net.Conn
+	done bool
+	err  error
+}
+
+// RunStream sends a STREAMING decode request (wire field 0x5C):
+// input 0 is the prompt (i32/i64 token ids, Dims [n]; the token
+// chunks echo its dtype), further inputs are per-sequence features.
+// Iterate with Recv until io.EOF. WithTimeout is the PER-TOKEN
+// budget: it rides the wire (the server fails a sequence whose
+// inter-token gap blows it) and bounds each Recv's socket read.
+// WithRetry does NOT apply — a stream that breaks after delivering
+// tokens cannot be transparently retried (the caller would see
+// duplicated tokens); Recv surfaces a retryable error instead and the
+// caller re-issues the request.
+func (p *Predictor) RunStream(inputs []Tensor, maxNewTokens uint32) (*TokenStream, error) {
+	if p.stream != nil {
+		return nil, fmt.Errorf("a token stream is already open; finish or Close it first")
+	}
+	field := make([]byte, 0, 9)
+	field = append(field, decodeMarker)
+	field = binary.LittleEndian.AppendUint64(field, uint64(maxNewTokens))
+	conn, err := p.sendRequest(inputs, field)
+	if err != nil {
+		return nil, err
+	}
+	s := &TokenStream{p: p, conn: conn}
+	p.stream = s
+	return s, nil
+}
+
+// Recv returns the next token chunk. io.EOF means the sequence
+// finished cleanly (every token was delivered). Any transport failure
+// mid-stream poisons the connection and returns ErrStreamBroken —
+// errors.Is(err, ErrOverloaded) — because the sequence is incomplete
+// and must be retried; a clean end is NEVER synthesized from a broken
+// connection. A status-2 terminal frame surfaces as ErrOverloaded.
+func (s *TokenStream) Recv() (Tensor, error) {
+	if s.done {
+		return Tensor{}, io.EOF
+	}
+	if s.err != nil {
+		return Tensor{}, s.err
+	}
+	if s.p.timeout > 0 {
+		_ = s.conn.SetDeadline(time.Now().Add(s.p.timeout))
+	}
+	resp, err := s.p.readFrame(s.conn)
+	if err != nil {
+		// readFrame already poisoned the connection; the stream is
+		// torn mid-sequence — retryable, never a silent clean EOF
+		s.finish(ErrStreamBroken)
+		return Tensor{}, s.err
+	}
+	switch resp[0] {
+	case statusStream, 0:
+		outs, perr := parseTensors(resp)
+		if perr != nil || len(outs) != 1 {
+			// a malformed chunk desyncs the frame stream: poison
+			_ = s.p.ioError(fmt.Errorf("malformed stream chunk"))
+			s.finish(ErrStreamBroken)
+			return Tensor{}, s.err
+		}
+		if resp[0] == 0 {
+			// terminal frame: deliver its (possibly empty) chunk,
+			// then report the clean end
+			s.finish(nil)
+			if tensorLen(outs[0]) == 0 {
+				return Tensor{}, io.EOF
+			}
+			return outs[0], nil
+		}
+		return outs[0], nil
+	case 2:
+		s.finish(ErrOverloaded)
+		return Tensor{}, ErrOverloaded
+	default:
+		s.finish(fmt.Errorf("decode failed (status %d)", resp[0]))
+		return Tensor{}, s.err
+	}
+}
+
+// Close abandons an unfinished stream: the connection is poisoned (a
+// half-read stream cannot be reused) which makes the server cancel
+// the sequence and free its KV slot. A finished stream closes for
+// free. Safe to call twice.
+func (s *TokenStream) Close() error {
+	if s.p.stream == s {
+		s.p.stream = nil
+	}
+	if !s.done && s.err == nil {
+		s.err = ErrStreamBroken
+		if s.p.conn == s.conn {
+			_ = s.p.ioError(fmt.Errorf("stream abandoned"))
+		}
+	}
+	return nil
+}
+
+// finish marks the stream terminal and releases the connection for
+// the next Run. err == nil: clean end (done -> io.EOF from now on).
+func (s *TokenStream) finish(err error) {
+	if s.p.stream == s {
+		s.p.stream = nil
+	}
+	if s.p.timeout > 0 && s.p.conn == s.conn {
+		_ = s.conn.SetDeadline(time.Time{})
+	}
+	if err == nil {
+		s.done = true
+	} else {
+		s.err = err
+	}
+}
+
+func tensorLen(t Tensor) int {
+	return len(t.Data) + len(t.IntData) + len(t.Int64Data) + len(t.BoolData)
 }

@@ -3,6 +3,7 @@ package paddletpu
 import (
 	"encoding/binary"
 	"errors"
+	"io"
 	"math"
 	"net"
 	"sync/atomic"
@@ -321,6 +322,230 @@ func TestWithEndpointsRotatesOnShed(t *testing.T) {
 	}
 	if got := atomic.LoadInt32(okHits); got != 1 {
 		t.Fatalf("ok endpoint hit %d times, want exactly 1", got)
+	}
+}
+
+// ---------------------------------------------------------- streaming
+
+// chunkFrame builds one stream reply frame: status byte + a single
+// 1-D i32 tensor of the given tokens (empty tokens = header only for
+// non-chunk statuses).
+func chunkFrame(status byte, tokens []int32) []byte {
+	resp := []byte{status}
+	if status == 0 || status == statusStream {
+		resp = append(resp, 1, dtypeI32, 1)
+		resp = binary.LittleEndian.AppendUint64(resp, uint64(len(tokens)))
+		for _, v := range tokens {
+			resp = binary.LittleEndian.AppendUint32(resp, uint32(v))
+		}
+	}
+	out := binary.LittleEndian.AppendUint32(nil, uint32(len(resp)))
+	return append(out, resp...)
+}
+
+// streamServer reads one request then plays the scripted reply frames;
+// closeAfter >= 0 closes the connection abruptly after that many
+// frames (simulating a replica death mid-stream).
+func streamServer(t *testing.T, frames [][]byte, closeAfter int) (addr string, bodies chan []byte) {
+	t.Helper()
+	ln, err := net.Listen("tcp", "127.0.0.1:0")
+	if err != nil {
+		t.Fatal(err)
+	}
+	t.Cleanup(func() { ln.Close() })
+	bodies = make(chan []byte, 4)
+	go func() {
+		conn, err := ln.Accept()
+		if err != nil {
+			return
+		}
+		defer conn.Close()
+		hdr := make([]byte, 4)
+		if _, err := readFull(conn, hdr); err != nil {
+			return
+		}
+		body := make([]byte, binary.LittleEndian.Uint32(hdr))
+		if _, err := readFull(conn, body); err != nil {
+			return
+		}
+		bodies <- body
+		for i, f := range frames {
+			if closeAfter >= 0 && i >= closeAfter {
+				return // abrupt close mid-stream
+			}
+			if _, err := conn.Write(f); err != nil {
+				return
+			}
+		}
+	}()
+	return ln.Addr().String(), bodies
+}
+
+func promptInput() []Tensor {
+	return []Tensor{{Dims: []int64{3}, IntData: []int32{1, 2, 3}}}
+}
+
+func recvAll(s *TokenStream) ([]int32, error) {
+	var got []int32
+	for {
+		chunk, err := s.Recv()
+		if err == io.EOF {
+			return got, nil
+		}
+		if err != nil {
+			return got, err
+		}
+		got = append(got, chunk.IntData...)
+	}
+}
+
+func TestRunStreamHappyPath(t *testing.T) {
+	frames := [][]byte{
+		chunkFrame(statusStream, []int32{5}),
+		chunkFrame(statusStream, []int32{6, 7}),
+		chunkFrame(0, []int32{8}),
+	}
+	addr, bodies := streamServer(t, frames, -1)
+	p, err := NewPredictor(addr)
+	if err != nil {
+		t.Fatal(err)
+	}
+	defer p.Close()
+	s, err := p.RunStream(promptInput(), 4)
+	if err != nil {
+		t.Fatal(err)
+	}
+	got, err := recvAll(s)
+	if err != nil {
+		t.Fatalf("stream failed: %v", err)
+	}
+	want := []int32{5, 6, 7, 8}
+	if len(got) != len(want) {
+		t.Fatalf("want %v, got %v", want, got)
+	}
+	for i := range want {
+		if got[i] != want[i] {
+			t.Fatalf("want %v, got %v", want, got)
+		}
+	}
+	// the request carried the decode field (marker + u64 value)
+	body := <-bodies
+	if len(body) < 9 || body[len(body)-9] != decodeMarker {
+		t.Fatalf("decode marker missing from body tail: % x", body)
+	}
+	if v := binary.LittleEndian.Uint64(body[len(body)-8:]); v != 4 {
+		t.Fatalf("want max_new_tokens 4 on the wire, got %d", v)
+	}
+	// a clean stream leaves the connection usable: EOF is sticky
+	if _, err := s.Recv(); err != io.EOF {
+		t.Fatalf("want sticky io.EOF after clean end, got %v", err)
+	}
+}
+
+func TestRunStreamMidStreamCloseIsRetryable(t *testing.T) {
+	// one chunk, then the server dies: the iterator must surface a
+	// RETRYABLE error — never a clean EOF over a truncated sequence
+	frames := [][]byte{
+		chunkFrame(statusStream, []int32{5}),
+		chunkFrame(0, []int32{6}),
+	}
+	addr, _ := streamServer(t, frames, 1)
+	p, err := NewPredictor(addr)
+	if err != nil {
+		t.Fatal(err)
+	}
+	defer p.Close()
+	s, err := p.RunStream(promptInput(), 4)
+	if err != nil {
+		t.Fatal(err)
+	}
+	got, err := recvAll(s)
+	if err == nil {
+		t.Fatalf("truncated stream reported clean EOF with %v", got)
+	}
+	if !errors.Is(err, ErrOverloaded) {
+		t.Fatalf("mid-stream poison must be retryable, got %v", err)
+	}
+	if len(got) != 1 || got[0] != 5 {
+		t.Fatalf("delivered prefix should survive: %v", got)
+	}
+	// the error is sticky — later Recv never fabricates an EOF
+	if _, err2 := s.Recv(); !errors.Is(err2, ErrOverloaded) {
+		t.Fatalf("want sticky retryable error, got %v", err2)
+	}
+	// the connection was poisoned: the next Run redials
+	if p.conn != nil {
+		t.Fatal("mid-stream failure must poison the connection")
+	}
+}
+
+func TestRunStreamMidStreamShedFrame(t *testing.T) {
+	frames := [][]byte{
+		chunkFrame(statusStream, []int32{5}),
+		chunkFrame(2, nil),
+	}
+	addr, _ := streamServer(t, frames, -1)
+	p, err := NewPredictor(addr)
+	if err != nil {
+		t.Fatal(err)
+	}
+	defer p.Close()
+	s, err := p.RunStream(promptInput(), 4)
+	if err != nil {
+		t.Fatal(err)
+	}
+	got, err := recvAll(s)
+	if !errors.Is(err, ErrOverloaded) {
+		t.Fatalf("status-2 terminal must be ErrOverloaded, got %v", err)
+	}
+	if len(got) != 1 || got[0] != 5 {
+		t.Fatalf("delivered prefix should survive the shed: %v", got)
+	}
+}
+
+func TestRunStreamBlocksConcurrentRun(t *testing.T) {
+	frames := [][]byte{chunkFrame(statusStream, []int32{5})}
+	addr, _ := streamServer(t, frames, -1)
+	p, err := NewPredictor(addr)
+	if err != nil {
+		t.Fatal(err)
+	}
+	defer p.Close()
+	s, err := p.RunStream(promptInput(), 4)
+	if err != nil {
+		t.Fatal(err)
+	}
+	if _, err := p.Run(oneInput()); err == nil {
+		t.Fatal("Run during an open stream must refuse")
+	}
+	if _, err := p.RunStream(promptInput(), 4); err == nil {
+		t.Fatal("second RunStream during an open stream must refuse")
+	}
+	_ = s.Close()
+	if p.conn != nil {
+		t.Fatal("abandoning an unfinished stream must poison the conn")
+	}
+}
+
+func TestRunDecodeOneshotCarriesField(t *testing.T) {
+	// RunDecode is a normal single-reply request with the decode
+	// field's one-shot bit set
+	addr, bodies := fakeServer(t, []byte{0})
+	p, err := NewPredictor(addr)
+	if err != nil {
+		t.Fatal(err)
+	}
+	defer p.Close()
+	if _, err := p.RunDecode(promptInput(), 7); err != nil {
+		t.Fatal(err)
+	}
+	body := <-bodies
+	if len(body) < 9 || body[len(body)-9] != decodeMarker {
+		t.Fatalf("decode marker missing: % x", body)
+	}
+	v := binary.LittleEndian.Uint64(body[len(body)-8:])
+	if v&(1<<63) == 0 || v&0xFFFFFFFF != 7 {
+		t.Fatalf("want one-shot bit + max_new 7, got %#x", v)
 	}
 }
 

@@ -8,11 +8,21 @@
 #             any order (servers predating a field ignore the bytes):
 #               u8 0xDD | f64 timeout_ms   per-request deadline
 #               u8 0x1D | u64 trace_id     non-zero span-trace id
+#               u8 0x5C | u64 decode opts  continuous-batching decode
+#                         (low 32 bits max_new_tokens; bit 63 one-shot)
 #   response: u32 body_len | u8 status | same encoding of outputs
 #   status:   0 ok | 1 error | 2 retryable (request shed by the
 #             server's batching engine, a quarantined bucket, a
 #             scheduler restart, or an expired deadline — back off and
 #             retry; see the retries= argument of pd_predict)
+#             | 3 stream chunk, more frames follow (streaming decode
+#             replies only; see pd_decode_stream)
+#
+# Streaming decode: pd_decode_stream() below is the minimal token
+# iterator — one callback per chunk frame, concatenated tokens on a
+# clean end, an error (retryable for status 2 / a broken stream) on
+# anything else. The deadline field is the PER-TOKEN budget for decode
+# requests. The fleet router relays chunk streams transparently.
 #
 # Multi-replica failover: this client holds ONE connection on purpose.
 # For a replica fleet, connect to the fleet router
@@ -152,4 +162,82 @@ pd_predict <- function(con, x, dtype = c("float32", "int32", "int64",
       array(vals, odims)
   }
   if (n_out == 1) outs[[1]] else outs
+}
+
+# Minimal streaming decode read path (continuous-batching servers):
+# sends `prompt` (integral token ids, encoded int32) with the 0x5C
+# decode field and reads chunk frames until the terminal one. Returns
+# the concatenated token vector; `on_tokens(tokens)` (if given) is
+# called once per chunk as it arrives. timeout_ms is the PER-TOKEN
+# budget. A status-2 terminal (shed / mid-stream failure — retryable)
+# or status-1 stops with an error; a truncated connection errors too —
+# never a silent prefix passed off as complete.
+pd_decode_stream <- function(con, prompt, max_new_tokens,
+                             timeout_ms = NULL, on_tokens = NULL) {
+  buf <- rawConnection(raw(0), "w")
+  writeBin(as.raw(c(1, 1, .pd_dtype_codes[["int32"]], 1L)), buf)
+  .write_i64(buf, length(prompt))
+  writeBin(as.integer(prompt), buf, size = 4, endian = "little")
+  writeBin(as.raw(0x5C), buf)
+  .write_i64(buf, as.integer(max_new_tokens))  # bit 63 clear: stream
+  if (!is.null(timeout_ms)) {
+    writeBin(as.raw(0xDD), buf)
+    writeBin(as.numeric(timeout_ms), buf, size = 8, endian = "little")
+  }
+  body <- rawConnectionValue(buf)
+  close(buf)
+  writeBin(length(body), con, size = 4, endian = "little")
+  writeBin(body, con)
+  flush(con)
+
+  tokens <- numeric(0)
+  repeat {
+    rlen <- readBin(con, "integer", size = 4, endian = "little")
+    if (length(rlen) == 0)
+      stop("stream broken mid-flight (retryable): connection closed")
+    resp <- readBin(con, "raw", n = rlen)
+    if (length(resp) < rlen)
+      stop("stream broken mid-flight (retryable): truncated frame")
+    status <- as.integer(resp[1])
+    if (status == 2)
+      stop("stream ended retryable (status 2): shed or mid-stream failure - retry the request")
+    if (status != 0 && status != 3)
+      stop(sprintf("decode failed (status %d)", status))
+    if (length(resp) > 1) {
+      chunk <- .pd_read_token_array(resp)
+      if (length(chunk) > 0) {
+        tokens <- c(tokens, chunk)
+        if (!is.null(on_tokens)) on_tokens(chunk)
+      }
+    }
+    if (status == 0) return(tokens)
+  }
+}
+
+# Decode the single 1-D token array of a chunk frame body (raw vector
+# starting at the status byte). Token chunks are int32 or int64.
+.pd_read_token_array <- function(resp) {
+  off <- 2
+  n_out <- as.integer(resp[off]); off <- off + 1
+  if (n_out < 1) return(numeric(0))
+  out_code <- as.integer(resp[off])
+  esize <- .pd_dtype_sizes[out_code + 1]
+  ndim <- as.integer(resp[off + 1]); off <- off + 2
+  count <- 1
+  for (d in seq_len(ndim)) {
+    count <- count * readBin(resp[off:(off + 3)], "integer", size = 4,
+                             endian = "little")
+    off <- off + 8
+  }
+  if (count == 0) return(numeric(0))
+  raw_seg <- resp[off:(off + count * esize - 1)]
+  if (out_code == 2) {
+    words <- readBin(raw_seg, "integer", n = count * 2, size = 4,
+                     endian = "little")
+    lo <- words[seq(1, length(words), 2)]
+    hi <- words[seq(2, length(words), 2)]
+    (lo + (lo < 0) * 2^32) + hi * 2^32
+  } else {
+    readBin(raw_seg, "integer", n = count, size = 4, endian = "little")
+  }
 }

@@ -32,6 +32,8 @@ __all__ = [
     # in the router/registry threads' modules until asked)
     "Fleet", "FleetRouter", "ReplicaRegistry", "TenantPolicy",
     "Autoscaler", "subprocess_spawner", "tenant_id",
+    # continuous-batching decode (lazy for the same reason)
+    "DecodeEngine", "DecodeModel", "DecodeRequest",
 ]
 
 _FLEET_HOMES = {
@@ -40,6 +42,8 @@ _FLEET_HOMES = {
     "FleetRouter": "router", "TenantPolicy": "router",
     "FairGate": "router", "tenant_id": "router",
     "ReplicaRegistry": "registry",
+    "DecodeEngine": "decode", "DecodeModel": "decode",
+    "DecodeRequest": "decode",
 }
 
 

@@ -289,6 +289,96 @@ class _Breaker:
                 "trips": self.trips, "shed": self.shed}
 
 
+def _publish_in_background(store, key, lock, blob):
+    """Publish off the hot path: the requester already has its
+    program and the bytes are already serialized — only the store
+    I/O runs on a daemon thread, so no request waits on disk. The
+    single-flight lock is held until the publish lands (released
+    in all cases — a crashed publisher's lock is reclaimed by
+    peers via the staleness takeover)."""
+    def work():
+        try:
+            store.put(key, blob)
+        finally:
+            store.release(lock)
+
+    threading.Thread(target=work, name="artifact-publish",
+                     daemon=True).start()
+
+
+def store_backed_compile(store, key, inline_fn, export_and_run,
+                         run_from_payload, warming=False,
+                         warmup_wait_s=120.0):
+    """The ONE store-consult-or-compile flow, shared by the batching
+    engine's :class:`AotLayerRunner` and the decode engine's program
+    cache (inference/decode.py). Returns ``(run, source)`` where
+    ``source`` is ``"store"`` (deserialized from the artifact store)
+    or ``"inline"`` (compiled in this process).
+
+    Caller-supplied callbacks own the program specifics:
+
+    - ``inline_fn() -> run``: plain lower+compile (also the degrade
+      path for every store failure mode);
+    - ``export_and_run() -> (blob, run)``: ONE export (trace +
+      StableHLO lower) serving both the published artifact and this
+      process's own program — the fleet is byte-identical by
+      construction, and the winner never traces twice;
+    - ``run_from_payload(payload) -> run or None``: materialize a
+      verified store payload (deserialize under THIS runtime, aval
+      check, probe execute), quarantining + returning None when
+      anything about it is off.
+
+    ``warming``: warmup is where single-flight matters — N replicas
+    warming the same key block briefly on one O_EXCL lock so exactly
+    one pays the compile and the rest load its published artifact.
+    The hot path never blocks on a peer: a cold key under live
+    traffic compiles inline immediately (publishing in the background
+    when it holds the lock)."""
+    if store is None:
+        return inline_fn(), "inline"
+    lock = None
+    if warming:
+        # ONE counted lookup: acquire_or_wait reads the store itself
+        # (a warm uncontended key resolves on the first acquire+read)
+        # — a separate get() first would count every peer-published
+        # key as a miss AND a hit, pinning the hit-ratio of a
+        # perfectly warm store at 50%
+        lock, payload = store.acquire_or_wait(key, timeout=warmup_wait_s)
+    else:
+        payload = store.get(key)
+    if payload is not None:
+        run = run_from_payload(payload)
+        if run is not None:
+            return run, "store"
+        # the artifact was bad (now quarantined): try to claim the
+        # compile so a good one replaces it
+        lock = lock or store.try_acquire(key)
+    elif not warming:
+        lock = store.try_acquire(key)
+    if lock is not None:
+        # we own the fleet-wide compile for this key
+        try:
+            blob, run = export_and_run()
+        except Exception:  # noqa: BLE001 - degrade to plain inline
+            # export or probe failed (not every program exports):
+            # free the peers NOW (they compile themselves instead of
+            # waiting out the staleness horizon on a corpse), then
+            # serve through the store-less path
+            store.release(lock)
+            return inline_fn(), "inline"
+        if warming:
+            # synchronous publish: peers blocked in acquire_or_wait
+            # are waiting for exactly this artifact
+            try:
+                store.put(key, blob)
+            finally:
+                store.release(lock)
+        else:
+            _publish_in_background(store, key, lock, blob)
+        return run, "inline"
+    return inline_fn(), "inline"
+
+
 class AotLayerRunner:
     """Execute batches for a jit-loaded :class:`TranslatedLayer` through
     per-bucket ahead-of-time compiled programs.
@@ -406,70 +496,33 @@ class AotLayerRunner:
         if store is None:
             return self._compile_inline(bucket, sig), "inline"
         key = self._artifact_key(bucket, sig)
-        lock = None
-        if warming:
-            # ONE counted lookup: acquire_or_wait reads the store
-            # itself (a warm uncontended key resolves on the first
-            # acquire+read) — a separate get() first would count every
-            # peer-published bucket as a miss AND a hit, pinning the
-            # hit-ratio of a perfectly warm store at 50%
-            lock, payload = store.acquire_or_wait(
-                key, timeout=self._warmup_wait_s)
-        else:
-            payload = store.get(key)
-        if payload is not None:
-            run = self._run_from_payload(store, key, payload, bucket, sig)
-            if run is not None:
-                return run, "store"
-            # the artifact was bad (now quarantined): try to claim the
-            # compile so a good one replaces it
-            lock = lock or store.try_acquire(key)
-        elif not warming:
-            lock = store.try_acquire(key)
-        if lock is not None:
-            # we own the fleet-wide compile for this key: ONE export
-            # (trace + StableHLO lower) serves BOTH the published
-            # artifact and this process's own program — re-tracing the
-            # whole model a second time just to publish would roughly
-            # double the cold-start cost peers are parked waiting on.
-            # Building our run from the same exported module the peers
-            # will load also makes the fleet byte-identical by
-            # construction.
-            try:
-                # timed end to end (export trace/lower + probe compile):
-                # this event is a real cold compile and must be
-                # comparable to the store-less path's aot events. One
-                # _bucket_state serves both steps — rebuilding it means
-                # re-wrapping every param/buffer per cold bucket.
-                t0 = time.monotonic()
-                state = self._bucket_state(bucket, sig)
-                exported = self._export(bucket, sig, state=state)
-                blob = serialize_exported(exported)
-                run = self._make_run(exported, bucket, sig, state=state)
-                LEDGER.record(f"serving/bucket{bucket}",
-                              duration_s=time.monotonic() - t0,
-                              kind="aot",
-                              extra={"bucket": bucket, "via": "export",
-                                     "signature": [[dt, list(tr)]
-                                                   for dt, tr in sig]})
-            except Exception:  # noqa: BLE001 - degrade to plain inline
-                # export or probe failed (not every program exports):
-                # free the peers NOW (they compile themselves instead
-                # of waiting out the staleness horizon on a corpse),
-                # then serve through the store-less path
-                store.release(lock)
-                return self._compile_inline(bucket, sig), "inline"
-            if warming:
-                # synchronous publish: peers blocked in acquire_or_wait
-                # are waiting for exactly this artifact
-                try:
-                    store.put(key, blob)
-                finally:
-                    store.release(lock)
-            else:
-                self._publish_in_background(store, key, lock, blob)
-            return run, "inline"
-        return self._compile_inline(bucket, sig), "inline"
+
+        def export_and_run():
+            # timed end to end (export trace/lower + probe compile):
+            # this event is a real cold compile and must be comparable
+            # to the store-less path's aot events. One _bucket_state
+            # serves both steps — rebuilding it means re-wrapping
+            # every param/buffer per cold bucket.
+            t0 = time.monotonic()
+            state = self._bucket_state(bucket, sig)
+            exported = self._export(bucket, sig, state=state)
+            blob = serialize_exported(exported)
+            run = self._make_run(exported, bucket, sig, state=state)
+            LEDGER.record(f"serving/bucket{bucket}",
+                          duration_s=time.monotonic() - t0,
+                          kind="aot",
+                          extra={"bucket": bucket, "via": "export",
+                                 "signature": [[dt, list(tr)]
+                                               for dt, tr in sig]})
+            return blob, run
+
+        return store_backed_compile(
+            store, key,
+            inline_fn=lambda: self._compile_inline(bucket, sig),
+            export_and_run=export_and_run,
+            run_from_payload=lambda payload: self._run_from_payload(
+                store, key, payload, bucket, sig),
+            warming=warming, warmup_wait_s=self._warmup_wait_s)
 
     def _make_run(self, exported, bucket, sig, state=None):
         """run callable over an exported module, gated by everything
@@ -555,22 +608,6 @@ class AotLayerRunner:
     def _export_bytes(self, bucket, sig):
         """Serialized form of :meth:`_export` (the published payload)."""
         return serialize_exported(self._export(bucket, sig))
-
-    def _publish_in_background(self, store, key, lock, blob):
-        """Publish off the hot path: the requester already has its
-        program and the bytes are already serialized — only the store
-        I/O runs on a daemon thread, so no request waits on disk. The
-        single-flight lock is held until the publish lands (released
-        in all cases — a crashed publisher's lock is reclaimed by
-        peers via the staleness takeover)."""
-        def work():
-            try:
-                store.put(key, blob)
-            finally:
-                store.release(lock)
-
-        threading.Thread(target=work, name="artifact-publish",
-                         daemon=True).start()
 
     def store_stats(self):
         store = self._active_store()

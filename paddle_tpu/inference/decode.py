@@ -1,0 +1,1540 @@
+"""Continuous-batching autoregressive decode engine (ROADMAP item 1).
+
+The dynamic-batching engine (batching.py) serves ONE-SHOT inference:
+a request is a batch of rows, a reply is the whole answer. Token
+streaming is a different shape of work — a request is a *sequence*
+that produces one token per model step for hundreds of steps, and
+sequences finish at wildly different times. Padding a fixed batch to
+the slowest member (the one-shot strategy) leaves the chip idle on
+every retired row; PERF.md pegs the untuned decode path at 0.2–0.5 of
+roofline for exactly this reason. The structural fix is
+**iteration-level scheduling** (the continuous-batching design of
+Orca/vLLM, and the concurrency lesson of PAPERS.md "Exploring the
+limits of Concurrency in ML Training on Google TPUs"): the scheduler
+re-forms the running batch EVERY step, so sequences join the moment a
+slot frees and leave the moment they finish::
+
+    requests --> bounded queue --> iteration scheduler
+                  (shed, purge)        |
+                                       v            per-(phase, rows,
+      admit joiners ---> PREFILL program             seq) AOT cache
+      every iteration     (rows_bucket, prompt_bucket)   |  artifact
+                                       |                 |  store keys
+      one token/seq  <--- DECODE STEP program  <---------+
+      every iteration     (slot_bucket, seq_bucket)
+                                       |
+      retire on eos/max/deadline; slot freed for the next joiner
+
+**KV slots.** Each running sequence owns a slot of paged host-side
+KV-cache storage (:class:`_KVSlots`): per-slot buffers grow in
+power-of-2 pages, so memory tracks actual sequence lengths, and each
+decode step gathers the active slots into a fixed-shape batch
+``[slot_bucket, seq_bucket, ...]`` — the same power-of-2 shape-bucket
+machinery the one-shot engine uses, which is what keeps the number of
+compiled programs a small ladder instead of one per (batch, length)
+pair. Decode-step exports flow through the PR 10 artifact store under
+their own keys (phase + seq bucket encoded in the signature), so a
+fresh decode replica warms its whole program ladder with zero inline
+XLA compiles once any replica has published it.
+
+**Bitwise determinism contract** (verified in tests/test_decode.py):
+a sequence decoded inside a continuous batch emits the SAME tokens as
+the same sequence decoded solo, under greedy sampling, across
+join/leave events and every wire dtype of its feature arrays. This
+holds because (a) rows of XLA's row-independent CPU programs are
+bitwise stable across batch sizes >= 2 (the PR 4 result; slot buckets
+are floored at 2 for exactly this reason), and (b) masked attention
+with exact ``-inf`` score masking and post-softmax zeroing is bitwise
+stable across KV padding widths — padded positions contribute exact
+``0.0`` terms, which pass through XLA's reductions unchanged
+(measured on this jaxlib; the model contract below requires that
+masking discipline). The engine zero-fills gathered KV beyond each
+sequence's length so stale slot contents can never reach a program.
+
+**Model contract** (:class:`DecodeModel`): two pure jax functions
+over flat positional args (export-friendly, weights as runtime args):
+
+    prefill_fn(params, tokens[b,p] i32, lengths[b] i32, *feat)
+        -> (logits[b, vocab] at each row's LAST valid position,
+            *kv[b, p, ...])  — one array per kv_spec entry
+    step_fn(params, tokens[b] i32, positions[b] i32,
+            *kv[b, s, ...], *feat)
+        -> (logits[b, vocab], *new_kv[b, ...])
+        The step must write the incoming token's kv at ``positions``
+        into its OWN attention (the passed kv buffers are donated
+        scratch) and return the new entries for the host to persist.
+
+    Padding rows carry token 0 / length 1 / position 0 / zero kv /
+    zero features; the model must produce finite outputs for them
+    (mask invalid positions to -inf BEFORE softmax and zero the
+    probabilities after, never ``nan``).
+
+**Robustness** is the PR 5 plumbing, unchanged in shape: per-program
+circuit breakers (:class:`batching._Breaker`), a scheduler watchdog
+(heartbeat per iteration; a dead/wedged scheduler is restarted, the
+active sequences fail retryable — wire status 2 — and parked requests
+are served by the replacement), bounded queue with
+:class:`batching.EngineOverloaded` shedding, and chaos sites
+``serving.decode.admit`` / ``serving.decode.prefill`` /
+``serving.decode.step``. Deadlines become **per-token SLOs**: a
+request's wire budget bounds the time to its FIRST token and every
+inter-token gap; a sequence that blows its per-token budget fails
+retryable and its KV slot is purged immediately (no slot leak against
+the slot cap — chaos-verified at ``serving.decode.step``).
+
+Telemetry: per-token latency and time-to-first-token histograms
+(``paddle_decode_ttft_seconds`` / ``paddle_decode_intertoken_seconds``)
+are engine-owned obs.metrics instruments exposed through the process
+registry (wire cmd 6 / ``/metrics``); traced requests get per-token
+``serving.decode.token`` spans in the obs.tracing buffer; every
+program materialization lands in the compile ledger under
+``decode/...`` labels (what ``bench.py perfproxy``'s decode contract
+gates on).
+
+Env knobs (constructor kwargs override):
+    PADDLE_TPU_DECODE_MAX_SLOTS        concurrent sequences (default 8)
+    PADDLE_TPU_DECODE_MAX_SEQ_LEN      prompt+generated cap (default 256)
+    PADDLE_TPU_DECODE_MAX_QUEUE        bounded wait queue (default 64)
+    PADDLE_TPU_DECODE_MIN_SEQ_BUCKET   smallest kv/prompt bucket (8)
+    PADDLE_TPU_DECODE_MAX_NEW_TOKENS   default per-request cap (64)
+    PADDLE_TPU_DECODE_MAX_PROMPT_LEN   admission cap on prompt length
+                                       (default max_seq_len)
+    (breaker/watchdog knobs: the PADDLE_TPU_SERVING_* family)
+"""
+import threading
+import time
+import traceback
+import weakref
+
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+from ..obs import tracing as obs_tracing
+from ..obs.ledger import LEDGER
+from ..resilience import chaos
+from ..resilience.retry import _env_float, _env_int
+from ..serialize import artifact_store as _artifacts
+from ..serialize.export import (deserialize_exported, model_fingerprint,
+                                serialize_exported)
+from .batching import (BucketQuarantined, DeadlineExceeded, EngineClosed,
+                       EngineOverloaded, RetryableError, SchedulerRestarted,
+                       _Breaker, bucket_rows, store_backed_compile)
+
+# Machine-checked lock order (tools/tracelint.py --concurrency, TPU309):
+# the decode engine lock is a SUBSYSTEM lock like BatchingEngine's —
+# obs instrument/registry locks nest strictly inside it, never the
+# reverse (exposition must not deadlock the decode loop).
+# tpu-lock-order: DecodeEngine._lock < Metric._lock  # subsystem -> instrument
+# tpu-lock-order: DecodeEngine._lock < Registry._lock  # collectors run OUTSIDE the registry lock
+
+
+def seq_bucket(n, min_bucket, max_len):
+    """Power-of-2 sequence-length bucket: next pow2 >= n, floored at
+    ``min_bucket``, clamped to ``max_len`` (the ladder's top rung)."""
+    if n <= 0:
+        raise ValueError(f"need length >= 1, got {n}")
+    return max(min_bucket, bucket_rows(n, max_len))
+
+
+class DecodeModel:
+    """Adapter holding the prefill/step jax functions, their runtime
+    parameters, and the shape contract (see module docstring).
+
+    ``kv_spec`` / ``feature_spec``: tuples of ``(trailing_shape,
+    dtype)`` per KV buffer / per-sequence feature array. A KV buffer's
+    full shape is ``[rows, seq, *trailing]``; a feature's is
+    ``[rows, *trailing]`` (constant per sequence — e.g. a user
+    embedding or per-sequence temperature, any wire dtype).
+
+    ``fingerprint``: content identity for the artifact store. Default:
+    computed lazily (sha256 of the step program's serialized export at
+    a canonical shape — same identity rule as jit.save: the traced
+    computation + avals, never the weight values)."""
+
+    def __init__(self, params, prefill_fn, step_fn, kv_spec, vocab_size,
+                 feature_spec=(), eos_token_id=None, fingerprint=None):
+        self.params = list(params)
+        self.prefill_fn = prefill_fn
+        self.step_fn = step_fn
+        self.kv_spec = tuple((tuple(int(d) for d in tr), np.dtype(dt))
+                             for tr, dt in kv_spec)
+        self.feature_spec = tuple((tuple(int(d) for d in tr), np.dtype(dt))
+                                  for tr, dt in feature_spec)
+        self.vocab_size = int(vocab_size)
+        self.eos_token_id = (None if eos_token_id is None
+                             else int(eos_token_id))
+        self._fingerprint = fingerprint
+
+
+class _Programs:
+    """Per-(phase, rows, seq) AOT program cache backend for the decode
+    engine — the decode twin of batching.AotLayerRunner. ``compile``
+    returns ``(run, source)`` via the shared
+    :func:`batching.store_backed_compile` flow, so decode-step exports
+    persist in the PR 10 artifact store (own keys: the phase and seq
+    bucket ride in the signature) with the same single-flight /
+    verify-then-quarantine / degrade-to-inline semantics."""
+
+    def __init__(self, model, store=None):
+        import jax
+
+        self._jax = jax
+        self._model = model
+        self._store = store if store is not None \
+            else _artifacts.default_store()
+        self._warmup_wait_s = _env_float(
+            "PADDLE_TPU_ARTIFACT_WARMUP_WAIT_S", 120.0)
+        self._fp_lock = threading.Lock()
+
+    # ----------------------------------------------------------- identity
+    def _fingerprint(self):
+        """Model identity for store keys, computed once: sha256 of the
+        step program's serialized export at the canonical (2, 8)
+        shape. Returns None when the model cannot export (store is
+        then skipped — inline compiles, the store-less behaviour)."""
+        m = self._model
+        if m._fingerprint is None:
+            with self._fp_lock:
+                if m._fingerprint is None:
+                    try:
+                        blob = serialize_exported(
+                            self._export("step", 2, 8))
+                        m._fingerprint = model_fingerprint(blob)
+                    except Exception:  # noqa: BLE001 - store-less fallback
+                        m._fingerprint = False
+        return m._fingerprint or None
+
+    def _active_store(self):
+        if self._store is None or _artifacts.disabled():
+            return None
+        if self._fingerprint() is None:
+            return None
+        return self._store
+
+    def _artifact_key(self, phase, rows, seq):
+        # the phase + seq bucket ride in the signature (the ArtifactKey
+        # schema has one integer bucket): a synthetic leading entry
+        # ("decode:<phase>", (seq,)) keys them unambiguously alongside
+        # the kv/feature avals
+        m = self._model
+        sig = ((f"decode:{phase}", (int(seq),)),)
+        sig += tuple((str(dt), tr) for tr, dt in m.kv_spec)
+        sig += tuple((str(dt), tr) for tr, dt in m.feature_spec)
+        sig += ((f"vocab{m.vocab_size}", ()),)
+        return _artifacts.ArtifactKey(self._fingerprint(), int(rows), sig,
+                                      mesh="single")
+
+    # ------------------------------------------------------------- shapes
+    def _in_specs(self, phase, rows, seq):
+        """ShapeDtypeStructs for one program's inputs (past params)."""
+        jax = self._jax
+        m = self._model
+        i32 = np.dtype(np.int32)
+        if phase == "prefill":
+            specs = [jax.ShapeDtypeStruct((rows, seq), i32),   # tokens
+                     jax.ShapeDtypeStruct((rows,), i32)]       # lengths
+        else:
+            specs = [jax.ShapeDtypeStruct((rows,), i32),       # tokens
+                     jax.ShapeDtypeStruct((rows,), i32)]       # positions
+            specs += [jax.ShapeDtypeStruct((rows, seq) + tr, dt)
+                      for tr, dt in m.kv_spec]
+        specs += [jax.ShapeDtypeStruct((rows,) + tr, dt)
+                  for tr, dt in m.feature_spec]
+        return specs
+
+    def _flat_fn(self, phase):
+        m = self._model
+
+        def flat(param_list, *args):
+            fn = m.prefill_fn if phase == "prefill" else m.step_fn
+            out = fn(param_list, *args)
+            return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+        return flat
+
+    def _state(self, phase, rows, seq):
+        jax = self._jax
+        param_arrays = [jax.numpy.asarray(p) for p in self._model.params]
+        param_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                       for a in param_arrays]
+        in_specs = self._in_specs(phase, rows, seq)
+        donate = ()
+        if phase == "step":
+            # donate the gathered kv scratch buffers (args: params,
+            # tokens, positions, kv..., feat...): they are rebuilt
+            # host-side every step, so the program may overwrite them
+            nkv = len(self._model.kv_spec)
+            donate = tuple(range(3, 3 + nkv))
+        return param_arrays, param_specs, in_specs, donate
+
+    # ------------------------------------------------------------ compile
+    def _export(self, phase, rows, seq, state=None):
+        from jax import export as jax_export
+
+        jax = self._jax
+        _, param_specs, in_specs, donate = \
+            state if state is not None else self._state(phase, rows, seq)
+        import warnings as _warnings
+
+        with _warnings.catch_warnings():
+            _warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            return jax_export.export(
+                jax.jit(self._flat_fn(phase), donate_argnums=donate))(
+                    param_specs, *in_specs)
+
+    def _probe_batch(self, phase, rows, seq):
+        m = self._model
+        i32 = np.int32
+        if phase == "prefill":
+            batch = [np.zeros((rows, seq), i32), np.ones((rows,), i32)]
+        else:
+            batch = [np.zeros((rows,), i32), np.zeros((rows,), i32)]
+            batch += [np.zeros((rows, seq) + tr, dt)
+                      for tr, dt in m.kv_spec]
+        batch += [np.zeros((rows,) + tr, dt) for tr, dt in m.feature_spec]
+        return batch
+
+    def _check_outputs(self, outs, phase, rows):
+        m = self._model
+        want = 1 + len(m.kv_spec)
+        if len(outs) != want:
+            raise ValueError(
+                f"{phase} program returned {len(outs)} outputs, "
+                f"expected logits + {len(m.kv_spec)} kv arrays")
+        lg = outs[0]
+        if tuple(getattr(lg, "shape", ())) != (rows, m.vocab_size):
+            raise ValueError(
+                f"{phase} logits shape {getattr(lg, 'shape', ())} != "
+                f"({rows}, {m.vocab_size})")
+        for o in outs[1:]:
+            if getattr(o, "ndim", 0) == 0 or o.shape[0] != rows:
+                raise ValueError(
+                    f"{phase} kv output shape {getattr(o, 'shape', ())} "
+                    f"does not keep the {rows}-row batch dim")
+
+    def _make_run(self, exported, phase, rows, seq, state=None):
+        """Run callable over an exported module, gated by everything
+        bytes alone cannot prove (aval match, zero-batch probe) —
+        mirrors AotLayerRunner._make_run."""
+        param_arrays, param_specs, in_specs, _ = \
+            state if state is not None else self._state(phase, rows, seq)
+        canon = self._jax.dtypes.canonicalize_dtype
+        expect = [(tuple(s.shape), np.dtype(canon(s.dtype)))
+                  for s in (*param_specs, *in_specs)]
+        got = [(tuple(a.shape), np.dtype(a.dtype))
+               for a in exported.in_avals]
+        if got != expect:
+            raise ValueError(
+                f"aval mismatch: artifact {got} vs expected {expect}")
+
+        def run(batch):
+            out = exported.call(param_arrays, *batch)
+            return [np.asarray(o) for o in out]
+
+        outs = run(self._probe_batch(phase, rows, seq))
+        self._check_outputs(outs, phase, rows)
+        return run
+
+    def _compile_inline(self, phase, rows, seq):
+        jax = self._jax
+        param_arrays, param_specs, in_specs, donate = \
+            self._state(phase, rows, seq)
+        t0 = time.monotonic()
+        import warnings as _warnings
+
+        with _warnings.catch_warnings():
+            _warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            compiled = (jax.jit(self._flat_fn(phase),
+                                donate_argnums=donate)
+                        .lower(param_specs, *in_specs).compile())
+        LEDGER.record(f"decode/{phase}{rows}x{seq}",
+                      duration_s=time.monotonic() - t0, compiled=compiled,
+                      kind="aot",
+                      extra={"phase": phase, "bucket": rows, "seq": seq})
+
+        def run(batch):
+            out = compiled(param_arrays, *batch)
+            return [np.asarray(o) for o in out]
+
+        outs = run(self._probe_batch(phase, rows, seq))
+        self._check_outputs(outs, phase, rows)
+        return run
+
+    def compile(self, phase, rows, seq, warming=False):
+        """-> (run, source) for one ladder rung, through the shared
+        store-backed flow (store load / export+publish / inline)."""
+        store = self._active_store()
+        if store is None:
+            return self._compile_inline(phase, rows, seq), "inline"
+        key = self._artifact_key(phase, rows, seq)
+
+        def export_and_run():
+            t0 = time.monotonic()
+            state = self._state(phase, rows, seq)
+            exported = self._export(phase, rows, seq, state=state)
+            blob = serialize_exported(exported)
+            run = self._make_run(exported, phase, rows, seq, state=state)
+            LEDGER.record(f"decode/{phase}{rows}x{seq}",
+                          duration_s=time.monotonic() - t0, kind="aot",
+                          extra={"phase": phase, "bucket": rows,
+                                 "seq": seq, "via": "export"})
+            return blob, run
+
+        def run_from_payload(payload):
+            t0 = time.monotonic()
+            try:
+                exported = deserialize_exported(payload)
+                run = self._make_run(exported, phase, rows, seq)
+            except Exception as e:  # noqa: BLE001 - bad artifact degrades
+                store.quarantine(key, str(e))
+                return None
+            LEDGER.record(f"decode/{phase}{rows}x{seq}",
+                          duration_s=time.monotonic() - t0, kind="store",
+                          extra={"phase": phase, "bucket": rows,
+                                 "seq": seq, "artifact": key.digest()})
+            return run
+
+        return store_backed_compile(
+            store, key,
+            inline_fn=lambda: self._compile_inline(phase, rows, seq),
+            export_and_run=export_and_run,
+            run_from_payload=run_from_payload,
+            warming=warming, warmup_wait_s=self._warmup_wait_s)
+
+    def store_stats(self):
+        store = self._active_store()
+        return store.stats() if store is not None else None
+
+
+class _KVSlots:
+    """Paged per-sequence KV storage. Each slot's buffers grow in
+    power-of-2 pages (doubling), so host memory tracks actual sequence
+    lengths; freed slots keep their pages for the next occupant (no
+    realloc churn at steady state). ``gather`` assembles the
+    fixed-shape step batch, zero-filling rows beyond each sequence's
+    length so stale contents never reach a program."""
+
+    def __init__(self, max_slots, max_seq_len, kv_spec, min_bucket=8):
+        self.max_slots = int(max_slots)
+        self.max_seq_len = int(max_seq_len)
+        self.kv_spec = kv_spec
+        self.min_bucket = int(min_bucket)
+        self._free = list(range(self.max_slots - 1, -1, -1))
+        self._bufs = [None] * self.max_slots  # slot -> [np [cap, *tr]]
+        self._caps = [0] * self.max_slots
+
+    def free_count(self):
+        return len(self._free)
+
+    def alloc(self):
+        return self._free.pop() if self._free else None
+
+    def release(self, slot):
+        self._free.append(slot)
+
+    def _ensure(self, slot, n):
+        """Grow slot capacity to the page (pow2 bucket) covering n."""
+        if n > self.max_seq_len:
+            raise ValueError(f"sequence length {n} exceeds max_seq_len "
+                             f"{self.max_seq_len}")
+        cap = self._caps[slot]
+        if cap >= n:
+            return
+        new_cap = seq_bucket(n, self.min_bucket, self.max_seq_len)
+        bufs = self._bufs[slot]
+        new = [np.zeros((new_cap,) + tr, dt) for tr, dt in self.kv_spec]
+        if bufs is not None and cap:
+            for dst, src in zip(new, bufs):
+                dst[:cap] = src[:cap]
+        self._bufs[slot] = new
+        self._caps[slot] = new_cap
+
+    def write_prefill(self, slot, kv_arrays, length):
+        """Install a fresh sequence's prompt kv (row slices of the
+        prefill program's [rows, prompt_bucket, ...] outputs)."""
+        self._ensure(slot, max(length, 1))
+        for buf, src in zip(self._bufs[slot], kv_arrays):
+            buf[:length] = src[:length]
+
+    def write_entry(self, slot, pos, entries):
+        """Append one decode step's kv entries at position ``pos``."""
+        self._ensure(slot, pos + 1)
+        for buf, e in zip(self._bufs[slot], entries):
+            buf[pos] = e
+
+    def gather(self, slots, lengths, rows_bucket, seq_b):
+        """[rows_bucket, seq_b, *tr] per kv buffer: row i carries slot
+        ``slots[i]``'s first ``lengths[i]`` entries, zeros elsewhere
+        (zero pad rows AND zero beyond-length tails — finite by
+        construction, masked out by the model)."""
+        out = [np.zeros((rows_bucket, seq_b) + tr, dt)
+               for tr, dt in self.kv_spec]
+        for i, (slot, n) in enumerate(zip(slots, lengths)):
+            n = min(n, seq_b)
+            if n <= 0:
+                continue
+            bufs = self._bufs[slot]
+            for o, buf in zip(out, bufs):
+                o[i, :n] = buf[:n]
+        return out
+
+
+_RETIRE_REASONS = ("eos", "max_tokens", "max_seq_len", "deadline",
+                   "error", "cancelled")
+
+
+class DecodeRequest:
+    """One streaming decode request: thread-safe token sink the engine
+    pushes into and a consumer (the server handler, or a direct
+    :meth:`result` caller) drains.
+
+    Consumer API:
+      - ``next_tokens(timeout)`` -> ``(tokens, done)``: blocks for new
+        tokens; delivers whatever accumulated since the last call.
+        Once the terminal error (if any) is the only thing left, it
+        raises it — delivered tokens always come out first, so a
+        streaming client sees the real prefix then the retryable
+        error, never a truncated-but-ok sequence.
+      - ``result(timeout)`` -> full token array (raises on error).
+      - ``cancel()``: abandon; the engine purges the KV slot at the
+        next iteration boundary and stops spending compute.
+    """
+
+    __slots__ = ("prompt", "features", "max_new_tokens", "eos_token_id",
+                 "token_budget_s", "trace_id", "token_dtype", "t_enqueue",
+                 "_cond", "_tokens", "_taken", "_done", "_error",
+                 "finish_reason", "cancelled")
+
+    def __init__(self, prompt, features, max_new_tokens, eos_token_id,
+                 token_budget_s, trace_id, token_dtype):
+        self.prompt = prompt
+        self.features = features
+        self.max_new_tokens = max_new_tokens
+        self.eos_token_id = eos_token_id
+        self.token_budget_s = token_budget_s
+        self.trace_id = trace_id
+        self.token_dtype = token_dtype
+        self.t_enqueue = time.monotonic()
+        self._cond = threading.Condition()
+        self._tokens = []
+        self._taken = 0
+        self._done = False
+        self._error = None
+        self.finish_reason = None
+        self.cancelled = False
+
+    # ------------------------------------------------------- engine side
+    def _push(self, token):
+        with self._cond:
+            if self._done:
+                return  # a superseded scheduler's late result: discard
+            self._tokens.append(token)
+            self._cond.notify_all()
+
+    def _finish(self, reason):
+        with self._cond:
+            if not self._done:
+                self._done = True
+                self.finish_reason = reason
+                self._cond.notify_all()
+
+    def _fail(self, error):
+        with self._cond:
+            if not self._done:
+                self._done = True
+                self._error = error
+                self.finish_reason = "error"
+                self._cond.notify_all()
+
+    # ----------------------------------------------------- consumer side
+    def cancel(self):
+        """Abandon the request: tokens stop, the engine frees the KV
+        slot at its next iteration boundary (or drops the request from
+        the queue if it never joined)."""
+        with self._cond:
+            self.cancelled = True
+            if not self._done:
+                self._done = True
+                self.finish_reason = "cancelled"
+                self._cond.notify_all()
+
+    def next_tokens(self, timeout=None):
+        """-> (new_tokens_list, done). Raises the terminal error once
+        every delivered token has been consumed; raises TimeoutError
+        if nothing happens within ``timeout``."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                if self._taken < len(self._tokens):
+                    out = self._tokens[self._taken:]
+                    self._taken = len(self._tokens)
+                    return out, self._done and self._error is None
+                if self._done:
+                    if self._error is not None:
+                        raise self._error
+                    return [], True
+                left = (None if deadline is None
+                        else deadline - time.monotonic())
+                if left is not None and left <= 0:
+                    raise TimeoutError(
+                        "no decode progress within timeout")
+                self._cond.wait(left)  # tpu-lint: disable=TPU303  # bounded by caller timeout; None is the documented no-timeout mode
+
+    def result(self, timeout=None):
+        """Block until the sequence finishes; -> 1-D token array in the
+        request's token dtype."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while not self._done:
+                left = (None if deadline is None
+                        else deadline - time.monotonic())
+                if left is not None and left <= 0:
+                    raise TimeoutError("decode did not finish in time")
+                self._cond.wait(left)  # tpu-lint: disable=TPU303  # bounded by caller timeout; None is the documented no-timeout mode
+            if self._error is not None:
+                raise self._error
+            return np.asarray(self._tokens, dtype=self.token_dtype)
+
+    def tokens_so_far(self):
+        with self._cond:
+            return list(self._tokens)
+
+
+class _Seq:
+    """One RUNNING sequence: its request, KV slot, and positions."""
+
+    __slots__ = ("req", "slot", "pos", "last_token", "n_generated",
+                 "t_last")
+
+    def __init__(self, req, slot, pos, last_token, now):
+        self.req = req
+        self.slot = slot
+        self.pos = pos  # kv entries cached so far
+        self.last_token = last_token
+        self.n_generated = 1  # prefill emitted the first token
+        self.t_last = now
+
+
+class DecodeEngine:
+    """Continuous-batching decode front end (see module docstring).
+
+    ``submit`` enqueues a sequence and returns its
+    :class:`DecodeRequest` (stream with ``next_tokens`` or block with
+    ``result``); ``generate`` is the blocking convenience. Any number
+    of threads may submit concurrently; one scheduler thread runs the
+    iteration loop."""
+
+    def __init__(self, model, max_slots=None, max_seq_len=None,
+                 max_queue=None, min_seq_bucket=None, max_prompt_len=None,
+                 default_max_new_tokens=None, name="decode", store=None,
+                 breaker_threshold=None, breaker_cooldown=None,
+                 watchdog_interval=None, wedge_timeout=None):
+        self._model = model
+        self.max_slots = int(
+            max_slots if max_slots is not None
+            else _env_int("PADDLE_TPU_DECODE_MAX_SLOTS", 8))
+        self.max_seq_len = int(
+            max_seq_len if max_seq_len is not None
+            else _env_int("PADDLE_TPU_DECODE_MAX_SEQ_LEN", 256))
+        self.max_queue = int(
+            max_queue if max_queue is not None
+            else _env_int("PADDLE_TPU_DECODE_MAX_QUEUE", 64))
+        self.min_seq_bucket = int(
+            min_seq_bucket if min_seq_bucket is not None
+            else _env_int("PADDLE_TPU_DECODE_MIN_SEQ_BUCKET", 8))
+        self.max_prompt_len = int(
+            max_prompt_len if max_prompt_len is not None
+            else _env_int("PADDLE_TPU_DECODE_MAX_PROMPT_LEN",
+                          self.max_seq_len))
+        self.default_max_new_tokens = int(
+            default_max_new_tokens if default_max_new_tokens is not None
+            else _env_int("PADDLE_TPU_DECODE_MAX_NEW_TOKENS", 64))
+        if self.max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        # row buckets are floored at 2 even for a max_slots=1 engine
+        # (one pad row): batch-1 float matmuls hit XLA's gemv regime,
+        # whose rounding differs from the gemm every batch >= 2 uses —
+        # keeping EVERY dispatch in the gemm regime is what makes a
+        # solo decode bitwise comparable to the same sequence inside a
+        # continuous batch (the PR 4 lesson, applied per decode step)
+        self._rows_cap = max(2, self.max_slots)
+        if self.max_prompt_len > self.max_seq_len:
+            raise ValueError("max_prompt_len cannot exceed max_seq_len")
+        self.breaker_threshold = int(
+            breaker_threshold if breaker_threshold is not None
+            else _env_int("PADDLE_TPU_SERVING_BREAKER_THRESHOLD", 3))
+        self.breaker_cooldown = float(
+            breaker_cooldown if breaker_cooldown is not None
+            else _env_float("PADDLE_TPU_SERVING_BREAKER_COOLDOWN", 5.0))
+        self.watchdog_interval = float(
+            watchdog_interval if watchdog_interval is not None
+            else _env_float("PADDLE_TPU_SERVING_WATCHDOG_INTERVAL", 0.5))
+        self.wedge_timeout = float(
+            wedge_timeout if wedge_timeout is not None
+            else _env_float("PADDLE_TPU_SERVING_WEDGE_TIMEOUT", 30.0))
+        self.name = name
+        self._programs = _Programs(model, store=store)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending = []  # FIFO of DecodeRequest
+        self._active = []   # list of _Seq (scheduler-owned mutation)
+        self._inflight_join = []  # joiners popped but not yet prefilled:
+        # a scheduler that dies holding them must not strand them — the
+        # watchdog restart fails exactly these (retryable), like the
+        # one-shot engine's _inflight group
+        self._slots = _KVSlots(self.max_slots, self.max_seq_len,
+                               model.kv_spec,
+                               min_bucket=self.min_seq_bucket)
+        self._cache = {}      # (phase, rows, seq) -> run
+        self._compiling = {}  # (phase, rows, seq) -> Event
+        self._breakers = {}   # (phase, rows, seq) -> _Breaker
+        self._compile_counts = {}  # (phase, rows, seq) -> {source: n}
+        self._declared = []
+        self._closed = False
+        self._closed_ev = threading.Event()
+        self._sched_gen = 0
+        self._heartbeat = time.monotonic()
+        self._init_metrics()
+        self._watchdog = None
+        self._scheduler = threading.Thread(
+            target=self._run_scheduler, args=(0,),
+            name=f"{name}-scheduler", daemon=True)
+        self._scheduler.start()
+        if self.watchdog_interval > 0:
+            self._watchdog = threading.Thread(
+                target=self._run_watchdog, name=f"{name}-watchdog",
+                daemon=True)
+            self._watchdog.start()
+
+    # -------------------------------------------------------- telemetry
+    def _init_metrics(self):
+        cl = {"engine": self.name}
+        M = obs_metrics
+        lat = M.log_buckets(0.0001, 4.0, 10)
+        self._m_requests = M.Counter(
+            "paddle_decode_requests_total",
+            "Decode requests admitted", const_labels=cl)
+        self._m_tokens = M.Counter(
+            "paddle_decode_tokens_total",
+            "Tokens generated", const_labels=cl)
+        self._m_shed = M.Counter(
+            "paddle_decode_shed_total",
+            "Requests shed (reason: queue_full | quarantine)",
+            labelnames=("reason",), const_labels=cl)
+        self._m_retired = M.Counter(
+            "paddle_decode_retired_total",
+            "Sequences retired, by reason",
+            labelnames=("reason",), const_labels=cl)
+        self._m_deadline = M.Counter(
+            "paddle_decode_deadline_total",
+            "Per-token deadline outcomes (stage: expired = purged "
+            "before joining, zero compute; late = blew a per-token "
+            "budget mid-sequence)",
+            labelnames=("stage",), const_labels=cl)
+        self._m_restarts = M.Counter(
+            "paddle_decode_scheduler_restarts_total",
+            "Watchdog scheduler restarts", const_labels=cl)
+        self._m_compiles = M.Counter(
+            "paddle_decode_compiles_total",
+            "Program materializations (source: inline = real XLA "
+            "compile, store = artifact-store load)",
+            labelnames=("phase", "source"), const_labels=cl)
+        self._m_steps = M.Counter(
+            "paddle_decode_steps_total",
+            "Model program dispatches, by phase",
+            labelnames=("phase",), const_labels=cl)
+        self._m_ttft = M.Histogram(
+            "paddle_decode_ttft_seconds",
+            "Time from enqueue to a sequence's FIRST token",
+            const_labels=cl, buckets=lat)
+        self._m_intertoken = M.Histogram(
+            "paddle_decode_intertoken_seconds",
+            "Gap between consecutive tokens of one sequence",
+            const_labels=cl, buckets=lat)
+        self._m_step_exec = M.Histogram(
+            "paddle_decode_step_seconds",
+            "Program execute duration, by phase",
+            labelnames=("phase",), const_labels=cl, buckets=lat)
+        self._m_occupancy = M.Histogram(
+            "paddle_decode_batch_occupancy",
+            "Active sequences / slot bucket per decode step",
+            const_labels=cl,
+            buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+        self._m_active = M.Gauge(
+            "paddle_decode_active_slots",
+            "Sequences currently holding a KV slot", const_labels=cl)
+        self._m_queue = M.Gauge(
+            "paddle_decode_queue_depth",
+            "Requests waiting for a slot", const_labels=cl)
+        self._instruments = [
+            self._m_requests, self._m_tokens, self._m_shed,
+            self._m_retired, self._m_deadline, self._m_restarts,
+            self._m_compiles, self._m_steps, self._m_ttft,
+            self._m_intertoken, self._m_step_exec, self._m_occupancy,
+            self._m_active, self._m_queue]
+        ref = weakref.ref(self)
+
+        def _collector():
+            eng = ref()
+            return eng._collect_families() if eng is not None else None
+
+        self._obs_collector = _collector
+        obs_metrics.REGISTRY.register_collector(_collector)
+
+    def _collect_families(self):
+        with self._lock:
+            self._m_queue.set(len(self._pending))
+            self._m_active.set(len(self._active))
+            return [m.collect() for m in self._instruments]
+
+    # ------------------------------------------------------------ submit
+    def submit(self, prompt, max_new_tokens=None, features=(),
+               token_budget_s=None, trace_id=None, eos_token_id=None):
+        """Enqueue one sequence; -> :class:`DecodeRequest`.
+
+        ``prompt``: 1-D (or [1, P]) int32/int64 token ids (the output
+        token dtype echoes it). ``features``: per-sequence arrays
+        matching the model's ``feature_spec`` (any wire dtype).
+        ``token_budget_s``: per-token SLO — bounds time-to-first-token
+        and every inter-token gap; a blown budget fails the request
+        retryable and frees its slot."""
+        chaos.hit("serving.decode.admit")
+        prompt = np.asarray(prompt)
+        if prompt.ndim == 2 and prompt.shape[0] == 1:
+            prompt = prompt[0]
+        if prompt.ndim != 1 or prompt.size < 1:
+            raise ValueError(
+                f"prompt must be a non-empty 1-D token array "
+                f"(got shape {tuple(prompt.shape)})")
+        if prompt.dtype == np.int64:
+            token_dtype = np.int64
+        elif prompt.dtype == np.int32:
+            token_dtype = np.int32
+        else:
+            raise ValueError(
+                f"prompt dtype {prompt.dtype} is not a token dtype "
+                "(int32 / int64)")
+        if prompt.size > self.max_prompt_len:
+            raise ValueError(
+                f"prompt of {prompt.size} tokens exceeds max_prompt_len="
+                f"{self.max_prompt_len}")
+        prompt_i32 = np.ascontiguousarray(prompt.astype(np.int32))
+        spec = self._model.feature_spec
+        features = [np.ascontiguousarray(np.asarray(f)) for f in features]
+        if len(features) != len(spec):
+            raise ValueError(
+                f"model expects {len(spec)} feature array(s), "
+                f"got {len(features)}")
+        for f, (tr, dt) in zip(features, spec):
+            if tuple(f.shape) != tr or f.dtype != dt:
+                raise ValueError(
+                    f"feature shape/dtype {f.shape}/{f.dtype} does not "
+                    f"match spec {tr}/{dt}")
+        if max_new_tokens is None:
+            max_new_tokens = self.default_max_new_tokens
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        eos = (self._model.eos_token_id if eos_token_id is None
+               else eos_token_id)
+        if trace_id is None:
+            trace_id = obs_tracing.current_trace_id()
+        req = DecodeRequest(prompt_i32, features, max_new_tokens, eos,
+                            token_budget_s, trace_id, token_dtype)
+        with self._cond:
+            if self._closed:
+                raise EngineClosed(f"{self.name} is closed")
+            if len(self._pending) >= self.max_queue:
+                self._m_shed.inc(reason="queue_full")
+                raise EngineOverloaded(
+                    f"{self.name} decode queue full "
+                    f"({len(self._pending)} waiting, cap {self.max_queue})"
+                    "; request shed")
+            self._pending.append(req)
+            self._m_requests.inc()
+            self._cond.notify_all()
+        return req
+
+    def generate(self, prompt, timeout=None, **kw):
+        """Blocking convenience: submit + result."""
+        return self.submit(prompt, **kw).result(timeout)
+
+    def cancel(self, req):
+        """Abandon a request: if still queued it is dropped here; if
+        running, the scheduler purges its KV slot at the next
+        iteration boundary (before any further compute)."""
+        req.cancel()
+        with self._cond:
+            try:
+                self._pending.remove(req)
+            except ValueError:
+                pass  # already joined (or finished); scheduler purges
+            self._cond.notify_all()
+
+    # --------------------------------------------------------- scheduler
+    def _run_scheduler(self, gen):
+        try:
+            self._scheduler_loop(gen)
+        except Exception:  # noqa: BLE001 - watchdog owns recovery
+            traceback.print_exc()
+            if self._watchdog is None:
+                self._restart_scheduler(gen, "died (watchdog disabled)")
+
+    def _scheduler_loop(self, gen):
+        while True:
+            # GIL-atomic monotonic bump, same contract as batching.py
+            self._heartbeat = time.monotonic()  # tpu-lint: disable=TPU305  # benign race: GIL-atomic monotonic bump
+            joiners = self._wait_for_work(gen)
+            if joiners is None:
+                return  # closed and drained, or superseded
+            chaos.hit("serving.decode.loop")
+            if joiners:
+                self._prefill(gen, joiners)
+            if self._superseded(gen):
+                return
+            self._purge_blown_budgets(gen)
+            if self._active:
+                self._decode_step(gen)
+            if self._superseded(gen):
+                return
+
+    def _superseded(self, gen):
+        with self._lock:
+            return self._sched_gen != gen or self._closed
+
+    def _wait_for_work(self, gen):
+        """Park until there is something to do; pop this iteration's
+        joiners (bounded by free slots). None = exit this thread."""
+        with self._cond:
+            while True:
+                if self._sched_gen != gen:
+                    return None
+                now = time.monotonic()
+                self._purge_expired_pending_locked(now)
+                self._drop_cancelled_locked()
+                if self._active or self._pending:
+                    break
+                if self._closed:
+                    return None
+                self._cond.wait()  # tpu-lint: disable=TPU303  # submit/cancel/close/restart all notify_all under _cond
+            joiners = []
+            free = self._slots.free_count()
+            while self._pending and len(joiners) < free:
+                joiners.append(self._pending.pop(0))
+            self._inflight_join = joiners
+            return joiners
+
+    def _purge_expired_pending_locked(self, now):
+        """Per-token SLO on the FIRST token: a queued request whose
+        budget already elapsed is purged before any compute."""
+        expired = [r for r in self._pending
+                   if r.token_budget_s is not None
+                   and now - r.t_enqueue >= r.token_budget_s]
+        for r in expired:
+            self._pending.remove(r)
+            self._m_deadline.inc(stage="expired")
+            r._fail(DeadlineExceeded(
+                f"{self.name}: per-token budget elapsed before the "
+                "sequence could join; dropped without compute"))
+
+    def _drop_cancelled_locked(self):
+        self._pending[:] = [r for r in self._pending if not r.cancelled]
+
+    def _purge_blown_budgets(self, gen):
+        """Retire active sequences that were cancelled or blew their
+        per-token budget — BEFORE the next step, so a dead client's
+        slot frees immediately instead of riding the batch to
+        max_new_tokens (the slot-leak audit of ISSUE 12). Slot release
+        and the active-list update happen under ONE lock acquisition:
+        a concurrent watchdog restart releases every active slot, and
+        interleaving with it would double-free a slot into the pool."""
+        now = time.monotonic()
+        purged = []
+        with self._lock:
+            if self._sched_gen != gen or self._closed:
+                # a stale (restarted-away) scheduler must not touch
+                # the replacement's active list or free slots it no
+                # longer owns — the restart handled every sequence it
+                # knew about
+                return
+            keep = []
+            for s in self._active:
+                if s.req.cancelled:
+                    purged.append((s, "cancelled", None))
+                    self._slots.release(s.slot)
+                elif (s.req.token_budget_s is not None
+                        and now - s.t_last > s.req.token_budget_s):
+                    purged.append((s, "deadline", DeadlineExceeded(
+                        f"{self.name}: per-token budget "
+                        f"{s.req.token_budget_s}s blown after "
+                        f"{s.n_generated} tokens; slot purged")))
+                    self._slots.release(s.slot)
+                else:
+                    keep.append(s)
+            self._active[:] = keep
+        for s, reason, err in purged:
+            self._notify_retired(s, reason, err)
+
+    # ----------------------------------------------------------- prefill
+    def _prefill(self, gen, joiners):
+        rows = bucket_rows(max(len(joiners), 2), self._rows_cap)
+        p_bucket = seq_bucket(max(r.prompt.size for r in joiners),
+                              self.min_seq_bucket, self.max_seq_len)
+        key = ("prefill", rows, p_bucket)
+        if not self._breaker_allows(key, joiners):
+            with self._lock:
+                if self._sched_gen == gen and not self._closed:
+                    # stale schedulers must not wipe the REPLACEMENT
+                    # scheduler's in-flight joiner record
+                    self._inflight_join = []
+            return
+        t0 = time.monotonic()
+        try:
+            run = self._program(key, warming=False,
+                                trace_id=next((r.trace_id for r in joiners
+                                               if r.trace_id is not None),
+                                              None))
+            tokens = np.zeros((rows, p_bucket), np.int32)
+            lengths = np.ones((rows,), np.int32)  # pad rows: length 1
+            for i, r in enumerate(joiners):
+                tokens[i, :r.prompt.size] = r.prompt
+                lengths[i] = r.prompt.size
+            batch = [tokens, lengths] + self._feature_batch(joiners, rows)
+            chaos.hit("serving.decode.prefill")
+            outs = run(batch)
+        except Exception as e:  # noqa: BLE001 - fail only these joiners
+            self._record_breaker(key, ok=False)
+            err = e if isinstance(e, RetryableError) else RetryableError(
+                f"{self.name}: prefill failed ({type(e).__name__}: {e}); "
+                "retry the request")
+            with self._lock:
+                if self._sched_gen == gen and not self._closed:
+                    self._inflight_join = []
+            for r in joiners:
+                r._fail(err)
+                self._m_retired.inc(reason="error")
+            return
+        self._record_breaker(key, ok=True)
+        now = time.monotonic()
+        dt = now - t0
+        self._m_steps.inc(phase="prefill")
+        self._m_step_exec.observe(dt, phase="prefill")
+        obs_tracing.observe("serving.decode.prefill", dt)
+        logits = outs[0]
+        kv = outs[1:]
+        stale = False
+        finished = []  # (seq-or-req, reason, err) notified post-lock
+        with self._lock:
+            if self._sched_gen != gen or self._closed:
+                # a watchdog restart superseded us mid-prefill: the
+                # restart already failed what it knew about; these
+                # joiners must fail too (no slot was allocated yet),
+                # and this thread must not touch slot state — nor the
+                # REPLACEMENT scheduler's _inflight_join record
+                stale = True
+            else:
+                self._inflight_join = []
+                # one lock acquisition for slot allocs + kv installs +
+                # emits + the active-list update — atomic against a
+                # restart's release sweep, like the step path
+                for i, r in enumerate(joiners):
+                    tok = int(np.argmax(logits[i]))
+                    if (r.token_budget_s is not None
+                            and now - r.t_enqueue > r.token_budget_s):
+                        # the FIRST token is a token too: a blown TTFT
+                        # budget fails retryable before the sequence
+                        # ever occupies a slot (slot -1: never held)
+                        finished.append((
+                            _Seq(r, -1, r.prompt.size, tok, now),
+                            "deadline",
+                            DeadlineExceeded(
+                                f"{self.name}: first token arrived "
+                                f"past the per-token budget "
+                                f"{r.token_budget_s}s")))
+                        continue
+                    # guaranteed non-None: admission was bounded by
+                    # the free count
+                    slot = self._slots.alloc()
+                    self._slots.write_prefill(slot, [k[i] for k in kv],
+                                              r.prompt.size)
+                    s = _Seq(r, slot, r.prompt.size, tok, now)
+                    self._m_ttft.observe(now - r.t_enqueue)
+                    self._emit(s, tok, now, ttft=True)
+                    reason = self._stop_reason(s)
+                    if reason is None:
+                        self._active.append(s)
+                    else:
+                        self._slots.release(s.slot)
+                        finished.append((s, reason, None))
+        if stale:
+            err = SchedulerRestarted(
+                f"{self.name} decode scheduler was restarted while this "
+                "sequence was in prefill; retry the request")
+            for r in joiners:
+                r._fail(err)
+            return
+        for s, reason, err in finished:
+            self._notify_retired(s, reason, err)
+
+    # ------------------------------------------------------- decode step
+    def _decode_step(self, gen):
+        active = list(self._active)
+        n = len(active)
+        rows = bucket_rows(max(n, 2), self._rows_cap)
+        need = max(s.pos + 1 for s in active)
+        seq_b = seq_bucket(need, self.min_seq_bucket, self.max_seq_len)
+        key = ("step", rows, seq_b)
+        if not self._breaker_allows(key, [s.req for s in active]):
+            with self._lock:
+                if self._sched_gen == gen and not self._closed:
+                    for s in active:
+                        self._slots.release(s.slot)
+                    self._active[:] = []
+            return
+        t0 = time.monotonic()
+        try:
+            run = self._program(key, warming=False,
+                                trace_id=next((s.req.trace_id
+                                               for s in active
+                                               if s.req.trace_id
+                                               is not None), None))
+            tokens = np.zeros((rows,), np.int32)
+            positions = np.zeros((rows,), np.int32)
+            for i, s in enumerate(active):
+                tokens[i] = s.last_token
+                positions[i] = s.pos
+            kv = self._slots.gather([s.slot for s in active],
+                                    [s.pos for s in active], rows, seq_b)
+            batch = ([tokens, positions] + kv
+                     + self._feature_batch([s.req for s in active], rows))
+            chaos.hit("serving.decode.step")
+            outs = run(batch)
+        except Exception as e:  # noqa: BLE001 - fail the whole step batch
+            # the step's kv writes never happened (the program raised),
+            # but exactly-once token delivery is gone for this batch:
+            # fail every member retryable and free the slots — clients
+            # retry, parked requests join a healthy next iteration.
+            # Release + clear happen atomically with the generation
+            # check: a restart that raced us already did both.
+            self._record_breaker(key, ok=False)
+            err = e if isinstance(e, RetryableError) else RetryableError(
+                f"{self.name}: decode step failed "
+                f"({type(e).__name__}: {e}); retry the request")
+            with self._lock:
+                if self._sched_gen != gen or self._closed:
+                    return  # restart already failed + released all
+                for s in active:
+                    self._slots.release(s.slot)
+                self._active[:] = []
+            for s in active:
+                self._m_retired.inc(reason="error")
+                s.req._fail(err)
+            return
+        self._record_breaker(key, ok=True)
+        now = time.monotonic()
+        dt = now - t0
+        self._m_steps.inc(phase="step")
+        self._m_step_exec.observe(dt, phase="step")
+        self._m_occupancy.observe(n / rows)
+        obs_tracing.observe("serving.decode.step", dt)
+        logits = outs[0]
+        entries = outs[1:]
+        finished = []  # (seq, reason, err) — notified after the lock
+        with self._lock:
+            if self._sched_gen != gen or self._closed:
+                # superseded mid-step: the restart failed these
+                # sequences and released their slots — our results
+                # are late zombies and must not touch slot state
+                # (_push on a done request is already a no-op)
+                return
+            # the whole result application is ONE lock acquisition:
+            # slot writes/releases and the active-list update can
+            # never interleave with a restart's release sweep
+            keep = []
+            for i, s in enumerate(active):
+                self._slots.write_entry(s.slot, s.pos,
+                                        [e[i] for e in entries])
+                s.pos += 1
+                tok = int(np.argmax(logits[i]))
+                s.last_token = tok
+                s.n_generated += 1
+                # per-token SLO enforced AT EMIT: a token that arrived
+                # past the budget is an SLO miss — the client gave up
+                # by its own timeout, so fail retryable and free the
+                # slot rather than refresh t_last and pretend it was
+                # on time
+                if (s.req.token_budget_s is not None
+                        and now - s.t_last > s.req.token_budget_s):
+                    self._slots.release(s.slot)
+                    finished.append((s, "deadline", DeadlineExceeded(
+                        f"{self.name}: token {s.n_generated} arrived "
+                        f"{now - s.t_last:.3f}s after the previous one "
+                        f"(per-token budget {s.req.token_budget_s}s); "
+                        "slot purged")))
+                    continue
+                self._emit(s, tok, now)
+                reason = self._stop_reason(s)
+                if reason is None:
+                    keep.append(s)
+                else:
+                    self._slots.release(s.slot)
+                    finished.append((s, reason, None))
+            self._active[:] = keep
+        for s, reason, err in finished:
+            self._notify_retired(s, reason, err)
+
+    # ----------------------------------------------------------- helpers
+    def _feature_batch(self, reqs, rows):
+        spec = self._model.feature_spec
+        out = [np.zeros((rows,) + tr, dt) for tr, dt in spec]
+        for i, r in enumerate(reqs):
+            for o, f in zip(out, r.features):
+                o[i] = f
+        return out
+
+    def _emit(self, s, tok, now, ttft=False):
+        gap = now - (s.req.t_enqueue if ttft else s.t_last)
+        if not ttft:
+            self._m_intertoken.observe(gap)
+        s.t_last = now
+        self._m_tokens.inc()
+        if s.req.trace_id is not None:
+            obs_tracing.record_span(
+                "serving.decode.token", gap,
+                trace_id=s.req.trace_id, engine=self.name,
+                index=s.n_generated - 1, first=ttft)
+        s.req._push(tok)
+
+    def _stop_reason(self, s):
+        """Why this sequence retires now, or None (pure check — the
+        caller owns the slot release)."""
+        if s.req.eos_token_id is not None \
+                and s.last_token == s.req.eos_token_id:
+            return "eos"
+        if s.n_generated >= s.req.max_new_tokens:
+            return "max_tokens"
+        if s.pos >= self.max_seq_len:
+            return "max_seq_len"
+        if s.req.cancelled:
+            return "cancelled"
+        return None
+
+    def _notify_retired(self, s, reason, err=None):
+        """Counters + request completion for a sequence whose slot the
+        caller already released. Runs OUTSIDE the engine lock."""
+        if reason == "deadline":
+            self._m_deadline.inc(stage="late")
+        self._m_retired.inc(reason=reason)
+        if err is not None:
+            s.req._fail(err)
+        else:
+            s.req._finish(reason)
+            if s.req.trace_id is not None:
+                obs_tracing.record_span(
+                    "serving.decode.request",
+                    time.monotonic() - s.req.t_enqueue,
+                    trace_id=s.req.trace_id, engine=self.name,
+                    tokens=s.n_generated, reason=reason)
+
+    def _breaker_allows(self, key, reqs):
+        """Check/trip the program-key breaker; on shed, fail ``reqs``
+        fast with the retryable quarantine status."""
+        now = time.monotonic()
+        with self._lock:
+            br = self._breakers.get(key)
+            if br is None:
+                br = self._breakers[key] = _Breaker(self.breaker_threshold,
+                                                   self.breaker_cooldown)
+            allowed = br.allow(now)
+            if not allowed:
+                br.shed += len(reqs)
+                self._m_shed.inc(len(reqs), reason="quarantine")
+        if not allowed:
+            err = BucketQuarantined(
+                f"{self.name} program {key} is quarantined after "
+                f"{br.failures} consecutive failures; retry after "
+                f"cooldown ({self.breaker_cooldown}s)")
+            for r in reqs:
+                r._fail(err)
+                self._m_retired.inc(reason="error")
+        return allowed
+
+    def _record_breaker(self, key, ok):
+        now = time.monotonic()
+        with self._lock:
+            br = self._breakers.get(key)
+            if br is not None:
+                br.record_success() if ok else br.record_failure(now)
+
+    # ----------------------------------------------------------- programs
+    def _program(self, key, warming=False, trace_id=None):
+        """Materialize-once per (phase, rows, seq) — the decode twin of
+        BatchingEngine._compiled (in-flight event so warmup and the
+        scheduler never compile the same key twice)."""
+        phase, rows, seq_b = key
+        while True:
+            with self._lock:
+                run = self._cache.get(key)
+                if run is not None:
+                    return run
+                ev = self._compiling.get(key)
+                if ev is None:
+                    ev = self._compiling[key] = threading.Event()
+                    mine = True
+                else:
+                    mine = False
+            if not mine:
+                # bounded like batching's cold-compile wait: a wedged
+                # owner must fail this caller retryably, not park it
+                # forever (the owner's compile may still land and cache
+                # the program for the next attempt)
+                if not ev.wait(_env_float(
+                        "PADDLE_TPU_SERVING_COLD_COMPILE_TIMEOUT", 300.0)):
+                    raise RetryableError(
+                        f"{self.name}: compile for {key} still in "
+                        "flight after the cold-compile timeout; retry")
+                continue
+            try:
+                chaos.hit("serving.decode.compile")
+                t0 = time.monotonic()
+                run, source = self._programs.compile(phase, rows, seq_b,
+                                                     warming=warming)
+            except BaseException:
+                with self._lock:
+                    self._compiling.pop(key, None)
+                ev.set()
+                raise
+            dt = time.monotonic() - t0
+            if trace_id is not None:
+                obs_tracing.record_span("serving.decode.compile", dt,
+                                        trace_id=trace_id,
+                                        engine=self.name, phase=phase,
+                                        rows=rows, seq=seq_b,
+                                        source=source)
+            else:
+                obs_tracing.observe("serving.decode.compile", dt)
+            with self._lock:
+                self._cache[key] = run
+                cc = self._compile_counts.setdefault(
+                    key, {"inline": 0, "store": 0})
+                cc[source] = cc.get(source, 0) + 1
+                self._m_compiles.inc(phase=phase, source=source)
+                self._compiling.pop(key, None)
+            ev.set()
+            return run
+
+    def warmup(self, slot_buckets=None, seq_buckets=None,
+               prompt_buckets=None):
+        """Precompile the program ladder so no sequence pays a compile
+        (and, with an artifact store attached, so a fresh replica
+        loads the whole ladder with zero inline XLA compiles).
+        Defaults: slot buckets = the power-of-2 ladder up to
+        ``max_slots``; seq/prompt buckets = the power-of-2 ladder from
+        ``min_seq_bucket`` up to ``max_seq_len`` / ``max_prompt_len``.
+        Returns the declared (phase, rows, seq) list."""
+        def ladder(lo, hi):
+            out, b = [], lo
+            while b < hi:
+                out.append(b)
+                b <<= 1
+            out.append(hi)
+            return sorted(set(out))
+
+        if slot_buckets is None:
+            # the runtime floors every dispatch at 2 rows (gemm
+            # regime), so the declared ladder starts there too — a
+            # max_slots=1 engine runs its one sequence at rows=2
+            slot_buckets = ladder(2, self._rows_cap)
+        if seq_buckets is None:
+            seq_buckets = ladder(self.min_seq_bucket, self.max_seq_len)
+        if prompt_buckets is None:
+            prompt_buckets = ladder(
+                self.min_seq_bucket,
+                seq_bucket(self.max_prompt_len, self.min_seq_bucket,
+                           self.max_seq_len))
+        declared = []
+        for rows in slot_buckets:
+            rows = bucket_rows(int(rows), self._rows_cap)
+            for sb in seq_buckets:
+                declared.append(("step", rows,
+                                 seq_bucket(int(sb), self.min_seq_bucket,
+                                            self.max_seq_len)))
+            for pb in prompt_buckets:
+                declared.append(("prefill", rows,
+                                 seq_bucket(int(pb), self.min_seq_bucket,
+                                            self.max_seq_len)))
+        declared = sorted(set(declared))
+        for key in declared:
+            self._program(key, warming=True)
+        with self._lock:
+            self._declared = declared
+        return declared
+
+    # -------------------------------------------------------------- views
+    def stats(self):
+        """Engine counters (merged into the cmd-5 ``stats`` wire view).
+        One lock acquisition: never a torn snapshot."""
+        with self._lock:
+            programs = {}
+            for key, cc in sorted(self._compile_counts.items()):
+                phase, rows, seq_b = key
+                d = {"compiles": cc.get("inline", 0),
+                     "store_loads": cc.get("store", 0)}
+                br = self._breakers.get(key)
+                if br is not None:
+                    d["breaker"] = br.as_dict()
+                programs[f"{phase}{rows}x{seq_b}"] = d
+            return {
+                "name": self.name,
+                "max_slots": self.max_slots,
+                "max_seq_len": self.max_seq_len,
+                "max_queue": self.max_queue,
+                "active": len(self._active),
+                "queue_depth": len(self._pending),
+                "requests": int(self._m_requests.value()),
+                "tokens": int(self._m_tokens.value()),
+                "shed_count": int(self._m_shed.value(reason="queue_full")),
+                "quarantine_shed": int(
+                    self._m_shed.value(reason="quarantine")),
+                "deadline_expired": int(
+                    self._m_deadline.value(stage="expired")),
+                "deadline_late": int(
+                    self._m_deadline.value(stage="late")),
+                "scheduler_restarts": int(self._m_restarts.value()),
+                "retired": {r: int(self._m_retired.value(reason=r))
+                            for r in _RETIRE_REASONS},
+                "prefills": int(self._m_steps.value(phase="prefill")),
+                "steps": int(self._m_steps.value(phase="step")),
+                "compiles": sum(cc.get("inline", 0)
+                                for cc in self._compile_counts.values()),
+                "store_loads": sum(cc.get("store", 0)
+                                   for cc in self._compile_counts.values()),
+                "declared_programs": len(self._declared),
+                "programs": programs,
+            }
+
+    def health(self):
+        now = time.monotonic()
+        store_stats = self._programs.store_stats()
+        with self._lock:
+            alive = self._scheduler.is_alive()
+            quarantined = sorted(
+                f"{k[0]}{k[1]}x{k[2]}" for k, br in self._breakers.items()
+                if br.state != _Breaker.CLOSED)
+            return {
+                "ok": alive and not self._closed,
+                "closed": self._closed,
+                "scheduler_alive": alive,
+                "heartbeat_age_s": round(now - self._heartbeat, 3),
+                "scheduler_restarts": int(self._m_restarts.value()),
+                "active": len(self._active),
+                "free_slots": self._slots.free_count(),
+                "queue_depth": len(self._pending),
+                "quarantined_programs": quarantined,
+                "declared_programs": len(self._declared),
+                "artifact_store": store_stats,
+            }
+
+    # ----------------------------------------------------------- watchdog
+    def _run_watchdog(self):
+        """Restart a dead or wedged scheduler: active sequences fail
+        retryable (their step state is owner-bound; a client retry
+        re-decodes from the prompt), parked requests stay queued and
+        are served by the replacement — same contract as the one-shot
+        engine's watchdog."""
+        while not self._closed_ev.wait(self.watchdog_interval):
+            with self._lock:
+                if self._closed:
+                    return
+                gen = self._sched_gen
+                th = self._scheduler
+                hb = self._heartbeat
+                head = self._pending[0] if self._pending else None
+                active = list(self._active)
+            now = time.monotonic()
+            dead = not th.is_alive()
+            if head is not None:
+                oldest = head.t_enqueue
+            elif active:
+                oldest = min(s.t_last for s in active)
+            else:
+                oldest = None
+            wedged = (oldest is not None
+                      and now - hb > self.wedge_timeout
+                      and now - oldest > self.wedge_timeout)
+            if dead:
+                self._restart_scheduler(gen, "died")
+            elif wedged:
+                self._restart_scheduler(gen, "wedged (heartbeat stale)")
+
+    def _restart_scheduler(self, observed_gen, reason):
+        with self._cond:
+            if self._closed or observed_gen != self._sched_gen:
+                return
+            self._sched_gen += 1
+            gen = self._sched_gen
+            stranded = list(self._active)
+            self._active[:] = []
+            stranded_join = list(self._inflight_join)
+            self._inflight_join = []
+            for s in stranded:
+                self._slots.release(s.slot)
+            self._m_restarts.inc()
+            self._heartbeat = time.monotonic()
+            t = threading.Thread(target=self._run_scheduler, args=(gen,),
+                                 name=f"{self.name}-scheduler-g{gen}",
+                                 daemon=True)
+            self._scheduler = t
+            # start INSIDE the lock: close() must never join an
+            # unstarted thread (same rationale as batching.py)
+            t.start()  # tpu-lint: disable=TPU304  # load-bearing: close() must never join an unstarted thread
+            self._cond.notify_all()
+        if stranded or stranded_join:
+            err = SchedulerRestarted(
+                f"{self.name} decode scheduler {reason} and was "
+                "restarted; this sequence was mid-decode — its tokens "
+                "so far were delivered but no more will come; retry the "
+                "request")
+            for s in stranded:
+                self._m_retired.inc(reason="error")
+                s.req._fail(err)
+            for r in stranded_join:
+                self._m_retired.inc(reason="error")
+                r._fail(err)
+
+    # -------------------------------------------------------------- close
+    def close(self, timeout=5.0):
+        """Stop the scheduler. Active sequences fail retryable (a
+        close mid-stream is a shed, not silent truncation); queued
+        requests fail retryable too; new submissions raise
+        EngineClosed."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._closed_ev.set()
+            pending = list(self._pending)
+            self._pending[:] = []
+            active = list(self._active)
+            self._active[:] = []
+            for s in active:
+                self._slots.release(s.slot)
+            self._cond.notify_all()
+            sched = self._scheduler
+        obs_metrics.REGISTRY.unregister_collector(self._obs_collector)
+        err = EngineClosed(f"{self.name} is closing; retry elsewhere")
+        for r in pending:
+            r._fail(err)
+        for s in active:
+            s.req._fail(err)
+        sched.join(timeout)
+        if self._watchdog is not None:
+            self._watchdog.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -66,8 +66,9 @@ from ..obs import prometheus as obs_prometheus
 from ..resilience import chaos
 from ..resilience.retry import backoff_delays
 from .registry import ReplicaRegistry, _env_float, _env_int
-from .server import (DEADLINE_MARKER, MAX_BODY_BYTES, STATUS_ERROR,
-                     STATUS_OK, STATUS_OVERLOADED, TENANT_MARKER,
+from .server import (DEADLINE_MARKER, DECODE_MARKER, DECODE_ONESHOT_BIT,
+                     MAX_BODY_BYTES, STATUS_ERROR, STATUS_OK,
+                     STATUS_OVERLOADED, STATUS_STREAM, TENANT_MARKER,
                      TRACE_MARKER, BodyTooLarge, _decode_arrays_off,
                      _read_all)
 
@@ -295,12 +296,36 @@ def _split_meta(body):
             trace = t or None
         elif marker == TENANT_MARKER and tid is None:
             (tid,) = struct.unpack("<Q", raw)
+        elif marker == DECODE_MARKER:
+            # a streaming decode request: kept in ``fields`` so it
+            # forwards to the replica; its presence switches dispatch
+            # into chunk-relay mode. Parsed here (not treated unknown)
+            # so fields BEHIND it still split correctly.
+            pass
         else:
             break
         fields.append((marker, raw))
         off += 9
     return (body[:1 + arrays_end], fields, payload[off:],
             tid, budget, trace)
+
+
+class _Streamed:
+    """Sentinel result of a relayed chunk stream: the reply frames
+    already went to the client; only accounting remains."""
+
+    __slots__ = ("status", "tokens", "max_gap_s", "replica_ok")
+
+    def __init__(self, status, tokens, max_gap_s, replica_ok=True):
+        self.status = status
+        self.tokens = tokens
+        self.max_gap_s = max_gap_s
+        self.replica_ok = replica_ok
+
+
+class _ClientGone(ConnectionError):
+    """The CLIENT vanished mid-relay (its socket write failed): there
+    is nobody to answer — the handler just closes."""
 
 
 class FleetRouter:
@@ -385,12 +410,23 @@ class FleetRouter:
             except OSError:
                 pass
 
-    def _forward(self, view, frame, timeout):
+    def _forward(self, view, frame, timeout, client_conn=None):
         """Send one framed request to replica `view` over a pooled
         connection; return the raw response body (status byte +
         payload). Raises OSError/ConnectionError/TimeoutError on a
         dead/stalled replica (the connection is NOT returned to the
-        pool in that case — a desynced stream must never be reused)."""
+        pool in that case — a desynced stream must never be reused).
+
+        ``client_conn`` (streaming decode requests): if the first
+        reply frame is a status-3 chunk, frames are RELAYED to the
+        client until the terminal frame and a :class:`_Streamed`
+        summary is returned instead of a body; from the first relayed
+        byte on there is no retry (the client already consumed part of
+        the stream) — a replica that dies mid-relay ends the stream
+        with a status-2 terminal frame, so the client sees retryable,
+        never truncated-but-ok. A normal single first frame (shed,
+        error, one-shot reply) returns exactly like the plain path, so
+        the caller's retry logic still applies to it."""
         sock = self._pool_get(view.rid)
         fresh = sock is None
         if fresh:
@@ -398,6 +434,7 @@ class FleetRouter:
                                             timeout=self.registry.dial_timeout)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         hdr = b""
+        t_send = time.monotonic()
         try:
             sock.settimeout(timeout)
             sock.sendall(frame)
@@ -425,15 +462,86 @@ class FleetRouter:
                 # Inference is read-only, so even the worst case (the
                 # replica executed but died pre-reply) cannot corrupt
                 # state, and a genuinely dead replica fails the fresh
-                # dial immediately.
-                return self._forward_fresh(view, frame, timeout)
+                # dial immediately. Nothing was relayed yet, so this
+                # is equally safe for the streaming path.
+                return self._forward_fresh(view, frame, timeout,
+                                           client_conn)
             raise
+        if (client_conn is not None and body
+                and body[0] == STATUS_STREAM):
+            return self._relay(view, sock, body, client_conn, timeout,
+                               t_send)
         self._pool_put(view.rid, sock)
         return body
 
-    def _forward_fresh(self, view, frame, timeout):
+    @staticmethod
+    def _chunk_tokens(body):
+        """Token count of one chunk frame body (status + arrays)."""
+        if len(body) <= 1:
+            return 0
+        try:
+            arrays, _ = _decode_arrays_off(body[1:])
+        except Exception:  # noqa: BLE001 - counting is best-effort
+            return 0
+        return sum(int(a.size) for a in arrays)
+
+    def _relay(self, view, sock, first_body, client_conn, timeout,
+               t_send):
+        """Pump chunk frames replica -> client until the terminal
+        frame. Pools the replica socket on a clean terminal (the
+        stream ends exactly at a frame boundary). ``t_send`` is when
+        the request hit the replica's socket, so the FIRST gap really
+        is time-to-first-token — the per-token SLO treats the first
+        chunk as a token, and anchoring at relay start would hide
+        exactly the slow-admission case the SLO exists to catch."""
+        tokens = 0
+        max_gap = 0.0
+        t_last = t_send
+
+        def send(body):
+            try:
+                client_conn.sendall(struct.pack("<I", len(body)) + body)
+            except (OSError, ConnectionError) as e:
+                # the client vanished: close the REPLICA socket too
+                # (never pooled — mid-stream), which makes the
+                # replica's own send fail and purge the KV slot
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                raise _ClientGone(str(e)) from e
+
+        body = first_body
+        while True:
+            now = time.monotonic()
+            max_gap = max(max_gap, now - t_last)
+            t_last = now
+            tokens += self._chunk_tokens(body)
+            send(body)
+            if body[0] != STATUS_STREAM:
+                self._pool_put(view.rid, sock)
+                return _Streamed(body[0], tokens, max_gap)
+            try:
+                (blen,) = struct.unpack("<I", _read_all(sock, 4))
+                body = _read_all(sock, blen)
+            except (OSError, ConnectionError):
+                # replica died mid-stream: the client already consumed
+                # a prefix, so no transparent retry — terminate the
+                # stream retryably and report the replica
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                self.registry.report_io_error(view.rid)
+                self._pool_drop(view.rid)
+                send(struct.pack("<B", STATUS_OVERLOADED))
+                return _Streamed(STATUS_OVERLOADED, tokens, max_gap,
+                                 replica_ok=False)
+
+    def _forward_fresh(self, view, frame, timeout, client_conn=None):
         sock = socket.create_connection((view.host, view.port),
                                         timeout=self.registry.dial_timeout)
+        t_send = time.monotonic()
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             sock.settimeout(timeout)
@@ -446,6 +554,10 @@ class FleetRouter:
             except OSError:
                 pass
             raise
+        if (client_conn is not None and body
+                and body[0] == STATUS_STREAM):
+            return self._relay(view, sock, body, client_conn, timeout,
+                               t_send)
         self._pool_put(view.rid, sock)
         return body
 
@@ -462,10 +574,17 @@ class FleetRouter:
                 return view
         return routable[0] if routable else None
 
-    def _dispatch(self, arrays_bytes, fields, tail, deadline):
+    def _dispatch(self, arrays_bytes, fields, tail, deadline,
+                  stream=False, client_conn=None):
         """Route one admitted cmd-1 request with shed-aware retry.
-        Returns the raw response body to send to the client. Never
-        raises for fleet-topology failures — those become status 2."""
+        Returns the raw response body to send to the client — or a
+        :class:`_Streamed` summary when the reply was a chunk stream
+        already relayed to ``client_conn`` (streaming retries happen
+        only BEFORE the first relayed frame: an immediate status-2
+        shed re-routes exactly like a one-shot request, but once the
+        client consumed a chunk the stream ends retryably instead).
+        Never raises for fleet-topology failures — those become
+        status 2 (except :class:`_ClientGone`: nobody left to tell)."""
         # forward everything except the tenant field (admission
         # happened here; replicas predating the field would stop
         # parsing at it and miss a deadline/trace field behind it)
@@ -490,7 +609,11 @@ class FleetRouter:
                               max(0.05, deadline - time.monotonic()) + 1.0)
             self.registry.acquire(view.rid)
             try:
-                resp = self._forward(view, frame, timeout)
+                resp = self._forward(
+                    view, frame, timeout,
+                    client_conn=client_conn if stream else None)
+            except _ClientGone:
+                raise
             except (OSError, ConnectionError):
                 # dead / stalled replica: poison it and fail over to a
                 # different one immediately — detection, not load
@@ -500,6 +623,13 @@ class FleetRouter:
                 continue
             finally:
                 self.registry.release(view.rid)
+            if isinstance(resp, _Streamed):
+                # frames already went to the client; a mid-relay
+                # replica death was reported inside the relay and must
+                # not be overwritten by an ok report here
+                if resp.replica_ok:
+                    self.registry.report_ok(view.rid)
+                return resp
             self.registry.report_ok(view.rid)
             if resp and resp[0] == STATUS_OVERLOADED:
                 last_shed = resp
@@ -517,25 +647,47 @@ class FleetRouter:
             return last_shed  # retries exhausted: the shed stands
         raise ShedError("retries_exhausted")
 
-    def _infer(self, body):
+    def _infer(self, body, client_conn=None):
         """Admission + dispatch + accounting for one cmd-1 request.
-        Returns the response body bytes."""
+        Returns the response body bytes — or None when the reply was a
+        chunk stream already relayed to ``client_conn``."""
         t0 = time.perf_counter()
         arrays_bytes, fields, tail, tid, budget, _trace = \
             _split_meta(body)
-        deadline = (None if budget is None
-                    else time.monotonic() + budget)
-        # the SLO used for deadline-hit accounting: the wire deadline
-        # when the client sent one, else the tenant policy's slo_ms
-        slo_s = budget
+        decode_val = next((struct.unpack("<Q", raw)[0]
+                           for m, raw in fields if m == DECODE_MARKER),
+                          None)
+        oneshot = (decode_val is not None
+                   and bool(decode_val & DECODE_ONESHOT_BIT))
+        # only a chunk-relay dispatch for genuine streams: a one-shot
+        # decode is a normal single reply with normal retry semantics
+        stream = decode_val is not None and not oneshot
+        budget_total = budget
+        if budget is not None and decode_val is not None:
+            # for decode requests the 0xDD field is a PER-TOKEN budget
+            # (TTFT + every inter-token gap), not an end-to-end
+            # deadline: the router's whole-request bound scales by the
+            # token count (+1 for the first token), or a legitimate
+            # 64-token one-shot reply would blow a 500ms per-token
+            # budget, time out the read, and eject the healthy replica
+            # that was busy completing it
+            max_new = int(decode_val & 0xFFFFFFFF) or 64
+            budget_total = budget * (max_new + 1)
+        deadline = (None if budget_total is None
+                    else time.monotonic() + budget_total)
+        # the SLO used for deadline-hit accounting: per-token for a
+        # stream (checked against the max inter-chunk gap), whole-reply
+        # for everything else; fall back to the tenant policy's slo_ms
+        slo_s = budget if stream else budget_total
         if slo_s is None:
             slo_ms = self.gate._state_for(tid).policy.slo_ms
             slo_s = None if slo_ms is None else slo_ms / 1000.0
         tenant_name = None
         outcome = "error"
         status = STATUS_ERROR
+        tokens = 0
         try:
-            admit_timeout = (budget if budget is not None
+            admit_timeout = (budget_total if budget_total is not None
                              else self.admit_timeout)
             try:
                 tenant_name = self.gate.acquire(tid, admit_timeout)
@@ -547,12 +699,19 @@ class FleetRouter:
                 return struct.pack("<B", STATUS_OVERLOADED)
             try:
                 resp = self._dispatch(arrays_bytes, fields, tail,
-                                      deadline)
+                                      deadline, stream=stream,
+                                      client_conn=client_conn)
             except ShedError as e:
                 _M_SHEDS.inc(tenant=tenant_name, reason=e.reason)
                 outcome = "shed"
                 status = STATUS_OVERLOADED
                 return struct.pack("<B", STATUS_OVERLOADED)
+            except _ClientGone:
+                # the client vanished mid-relay: nobody to answer,
+                # accounted as a shed (the fleet did not fail)
+                outcome = "shed"
+                status = STATUS_OVERLOADED
+                raise
             except Exception:  # noqa: BLE001 — router fault, not the
                 # request's fault: the contract is ok-or-retryable, so
                 # an internal routing failure (including an armed
@@ -563,6 +722,20 @@ class FleetRouter:
                 return struct.pack("<B", STATUS_OVERLOADED)
             finally:
                 self.gate.release()
+            if isinstance(resp, _Streamed):
+                # chunk stream, already relayed: per-token SLO — the
+                # request is "late" when any inter-chunk gap (incl.
+                # time to the first chunk) blew the budget
+                status = resp.status
+                tokens = resp.tokens
+                if status == STATUS_OK:
+                    met = slo_s is None or resp.max_gap_s <= slo_s
+                    outcome = "ok" if met else "late"
+                elif status == STATUS_OVERLOADED:
+                    outcome = "shed"
+                else:
+                    outcome = "error"
+                return None
             status = resp[0] if resp else STATUS_ERROR
             if status == STATUS_OK:
                 met = (slo_s is None
@@ -583,7 +756,8 @@ class FleetRouter:
                 _M_DEADLINE.inc(tenant=name,
                                 outcome="hit" if outcome == "ok"
                                 else "miss")
-            obs_goodput.SERVING_LEDGER.record(name, outcome, dt)
+            obs_goodput.SERVING_LEDGER.record(name, outcome, dt,
+                                              tokens=tokens)
 
     def _tenant_name(self, tid):
         return self.gate._state_for(tid).policy.name
@@ -695,8 +869,12 @@ class FleetRouter:
                     conn.sendall(struct.pack("<IB", 1, 1))
                     continue
                 try:
-                    resp = self._infer(body)
-                    conn.sendall(struct.pack("<I", len(resp)) + resp)
+                    resp = self._infer(body, client_conn=conn)
+                    if resp is not None:
+                        conn.sendall(struct.pack("<I", len(resp)) + resp)
+                    # resp None: chunk stream already relayed
+                except _ClientGone:
+                    raise ConnectionError("client gone mid-stream")
                 except Exception:  # noqa: BLE001 - wire error status
                     conn.sendall(struct.pack("<IB", 1, STATUS_ERROR))
         except socket.timeout:

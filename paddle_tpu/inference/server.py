@@ -25,8 +25,23 @@ wire format (little-endian):
             the fleet router keys admission control and per-tenant
             goodput accounting on it; a direct replica parses and
             ignores it)
+            u8 0x5C | u64 decode opts (continuous-batching decode
+            request, servers with a decode engine only: low 32 bits =
+            max_new_tokens, bit 63 set = ONE-SHOT — collect the whole
+            sequence into today's single reply. Without bit 63 the
+            reply is a CHUNKED STREAM: zero or more frames with
+            status 3 (one token-array chunk each, a frame per token
+            batch), terminated by exactly one frame with status 0
+            (the final chunk, possibly a zero-length array) or 1/2 on
+            error/shed — the client concatenates the chunks. Input
+            array 0 is the prompt (1-D int32/int64 token ids; the
+            token chunks echo its dtype), further arrays are the
+            model's per-sequence features. The 0xDD deadline field
+            becomes a PER-TOKEN budget: time to first token and every
+            inter-token gap.)
           Old servers ignore the trailing bytes; old clients simply
-          omit them — both directions stay compatible.
+          omit them — both directions stay compatible: only a client
+          that sent 0x5C without bit 63 ever sees status 3.
         3 health  payload = (empty); response body is UTF-8 JSON
             liveness/readiness: scheduler alive + heartbeat age,
             quarantined buckets, queue depth, draining flag, plus
@@ -64,6 +79,9 @@ wire format (little-endian):
   status: 0 ok | 1 error | 2 retryable (request shed by the batching
           engine's bounded queue, a quarantined bucket, a scheduler
           restart, or an expired deadline — back off and retry)
+          | 3 stream chunk, more frames follow (streaming decode
+          replies only — never sent unless the request carried the
+          0x5C field without its one-shot bit)
 """
 import json
 import os
@@ -90,6 +108,7 @@ _WIDEN_TO_F32 = {"float16", "bfloat16"}
 STATUS_OK = 0
 STATUS_ERROR = 1
 STATUS_OVERLOADED = RetryableError.status_code  # 2
+STATUS_STREAM = 3  # non-final chunk of a streaming decode reply
 
 # Machine-checked lock order (tools/tracelint.py --concurrency, TPU309):
 # one reload at a time (coarse, dedicated) > the backend swap lock (held
@@ -106,6 +125,8 @@ STATUS_OVERLOADED = RetryableError.status_code  # 2
 DEADLINE_MARKER = 0xDD  # + f64 relative budget in ms
 TRACE_MARKER = 0x1D  # + u64 non-zero trace id (obs.tracing)
 TENANT_MARKER = 0x7E  # + u64 tenant id (fleet router admission/SLOs)
+DECODE_MARKER = 0x5C  # + u64: low 32 bits max_new_tokens, bit 63 oneshot
+DECODE_ONESHOT_BIT = 1 << 63
 
 # Hardening knobs: a 4-byte length prefix from a buggy/malicious client
 # must not trigger an unbounded allocation, and a stalled client must
@@ -194,17 +215,28 @@ def _encode_tenant(tenant_id):
     return struct.pack("<BQ", TENANT_MARKER, int(tenant_id))
 
 
+def _encode_decode_opts(max_new_tokens, oneshot=False):
+    """Trailing optional decode field: marks a cmd-1 body as a
+    continuous-batching decode request (old servers ignore it)."""
+    val = int(max_new_tokens) & 0xFFFFFFFF
+    if oneshot:
+        val |= DECODE_ONESHOT_BIT
+    return struct.pack("<BQ", DECODE_MARKER, val)
+
+
 def _decode_request(payload):
     """Decode a cmd-1 infer body: arrays plus the optional trailing
-    marker-tagged fields (deadline, trace id, tenant id — any order).
-    Returns (arrays, budget_seconds_or_None, trace_id_or_None).
-    Parsing stops at the first unknown marker: old servers ignored
-    trailing garbage, and a field this server predates must not be
-    misread."""
+    marker-tagged fields (deadline, trace id, tenant id, decode opts —
+    any order). Returns (arrays, budget_seconds_or_None,
+    trace_id_or_None, decode_opts_or_None) where decode_opts is
+    ``{"max_new_tokens": n, "oneshot": bool}``. Parsing stops at the
+    first unknown marker: old servers ignored trailing garbage, and a
+    field this server predates must not be misread."""
     arrays, off = _decode_arrays_off(payload)
     budget = None
     trace_id = None
     tenant = None
+    decode_opts = None
     while len(payload) - off >= 9:
         marker = payload[off]
         if marker == DEADLINE_MARKER and budget is None:
@@ -217,10 +249,16 @@ def _decode_request(payload):
             # admission control happened at the router; a replica just
             # skips past so fields AFTER the tenant id still parse
             (tenant,) = struct.unpack_from("<Q", payload, off + 1)
+        elif marker == DECODE_MARKER and decode_opts is None:
+            (val,) = struct.unpack_from("<Q", payload, off + 1)
+            decode_opts = {
+                "max_new_tokens": int(val & 0xFFFFFFFF) or None,
+                "oneshot": bool(val & DECODE_ONESHOT_BIT),
+            }
         else:
             break
         off += 9
-    return arrays, budget, trace_id
+    return arrays, budget, trace_id, decode_opts
 
 
 class PredictorServer:
@@ -245,13 +283,22 @@ class PredictorServer:
 
     def __init__(self, run_fn, port=0, host="127.0.0.1",
                  max_body=MAX_BODY_BYTES, recv_timeout=RECV_TIMEOUT,
-                 engine=None, own_engine=False, loader=None, prefix=None):
+                 engine=None, own_engine=False, loader=None, prefix=None,
+                 decode_engine=None, own_decode_engine=False):
         self._run = run_fn
         self._engine = engine
         # own_engine: this server is the engine's only handle (serve_model
         # builds one per server) and must close it on stop, or its
         # scheduler thread + compiled programs leak per server lifecycle
         self._own_engine = own_engine and engine is not None
+        # continuous-batching decode engine (inference.decode): cmd-1
+        # requests carrying the 0x5C field route here and reply as a
+        # chunked stream (or a one-shot collected reply)
+        self._decode_engine = decode_engine
+        self._own_decode_engine = (own_decode_engine
+                                   and decode_engine is not None)
+        self._decode_stream_timeout = float(os.environ.get(
+            "PADDLE_TPU_SERVER_DECODE_TIMEOUT", 300.0))
         self._loader = loader
         self._prefix = prefix
         self._backend_lock = threading.Lock()  # guards _run/_engine swap
@@ -305,9 +352,13 @@ class PredictorServer:
         self._m_open = obs_metrics.Gauge(
             "paddle_server_connections_open",
             "Currently-connected clients", const_labels=cl)
+        self._m_chunks = obs_metrics.Counter(
+            "paddle_server_stream_chunks_total",
+            "Streaming decode reply frames sent (status 3 + terminal)",
+            const_labels=cl)
         self._server_instruments = [
             self._m_conns, self._m_frames, self._m_responses,
-            self._m_reloads, self._m_open]
+            self._m_reloads, self._m_open, self._m_chunks]
         ref = weakref.ref(self)
 
         def _collector():
@@ -345,11 +396,15 @@ class PredictorServer:
             return self._run, self._engine
 
     def _stats_json(self):
-        """Body of the `stats` wire command (cmd 5)."""
+        """Body of the `stats` wire command (cmd 5). Shape: the
+        batching-engine counters at top level (as always), plus a
+        ``decode`` key when a decode engine is attached."""
         _, engine = self._backend()
-        if engine is None:
-            return json.dumps({"engine": None})
-        return engine.stats_json()
+        stats = {"engine": None} if engine is None else engine.stats()
+        if self._decode_engine is not None:
+            stats = dict(stats)
+            stats["decode"] = self._decode_engine.stats()
+        return json.dumps(stats)
 
     def _health_json(self):
         """Body of the `health` wire command (cmd 3): liveness (is the
@@ -357,14 +412,18 @@ class PredictorServer:
         accepting work) in one probe."""
         _, engine = self._backend()
         eng = engine.health() if engine is not None else None
+        dec = (self._decode_engine.health()
+               if self._decode_engine is not None else None)
         with self._conns_lock:
             conns = len(self._conns)
             accepting = self._accepting and not self._stop.is_set()
             dl = self._draining_deadline
         draining = not accepting
-        ok = not draining and (eng is None or eng["ok"])
+        ok = (not draining and (eng is None or eng["ok"])
+              and (dec is None or dec["ok"]))
         return json.dumps({
             "ok": ok,
+            "decode": dec,
             "draining": draining,
             # readiness split (backward-compatible: absent fields mean
             # accepting): a router distinguishes "draining, stop
@@ -462,10 +521,9 @@ class PredictorServer:
                     "reloads": self._reload_count}
 
     # ------------------------------------------------------------ handler
-    def _infer(self, body):
-        """Run one cmd-1 infer body; returns the encoded response frame
-        body (status byte + payload)."""
-        inputs, budget, trace_id = _decode_request(body[1:])
+    def _infer(self, inputs, budget, trace_id):
+        """Run one NON-STREAMING cmd-1 infer request (already parsed);
+        returns the encoded response frame body (status + payload)."""
         deadline = (None if budget is None
                     else time.monotonic() + budget)
         t0 = time.perf_counter()
@@ -503,6 +561,100 @@ class PredictorServer:
                 "serving.reply", time.perf_counter() - t0,
                 trace_id=trace_id, port=self.port)
         return struct.pack("<B", STATUS_OK) + enc
+
+    # ------------------------------------------------- streaming decode
+    def _send_frame(self, conn, status, payload=b""):
+        conn.sendall(struct.pack("<IB", 1 + len(payload), status)
+                     + payload)
+        self._m_chunks.inc()
+
+    def _serve_decode(self, conn, inputs, budget, trace_id, opts):
+        """One cmd-1 decode request (0x5C field present): submit to
+        the decode engine and reply as a chunk stream (or a single
+        collected reply in one-shot mode). Sends its own frames;
+        counts the TERMINAL status in the response counter.
+
+        If the client vanishes mid-stream (sendall fails) the request
+        is cancelled so its KV slot frees immediately — a dead reader
+        must never ride the batch to max_new_tokens against the slot
+        cap (the ISSUE 12 slot-leak audit)."""
+        dec = self._decode_engine
+        if dec is None or not inputs:
+            self._m_responses.inc(status=str(STATUS_ERROR))
+            enc = b"no decode engine attached to this server"
+            conn.sendall(struct.pack("<IB", 1 + len(enc), 1) + enc)
+            return
+        t0 = time.perf_counter()
+        try:
+            req = dec.submit(inputs[0], features=list(inputs[1:]),
+                             max_new_tokens=opts.get("max_new_tokens"),
+                             token_budget_s=budget, trace_id=trace_id)
+        except (RetryableError, EngineClosed):
+            self._m_responses.inc(status=str(STATUS_OVERLOADED))
+            conn.sendall(struct.pack("<IB", 1, STATUS_OVERLOADED))
+            return
+        except Exception:  # noqa: BLE001 - bad request (shape/dtype)
+            self._m_responses.inc(status=str(STATUS_ERROR))
+            conn.sendall(struct.pack("<IB", 1, 1))
+            return
+        if opts.get("oneshot"):
+            try:
+                tokens = req.result(timeout=self._decode_stream_timeout)
+            except (RetryableError, EngineClosed, TimeoutError):
+                dec.cancel(req)
+                self._m_responses.inc(status=str(STATUS_OVERLOADED))
+                conn.sendall(struct.pack("<IB", 1, STATUS_OVERLOADED))
+                return
+            except Exception:  # noqa: BLE001 - protocol error status
+                dec.cancel(req)
+                self._m_responses.inc(status=str(STATUS_ERROR))
+                conn.sendall(struct.pack("<IB", 1, 1))
+                return
+            enc = _encode_arrays([tokens])
+            self._m_responses.inc(status=str(STATUS_OK))
+            conn.sendall(struct.pack("<I", 1 + len(enc))
+                         + struct.pack("<B", STATUS_OK) + enc)
+            if trace_id is not None:
+                obs_tracing.record_span(
+                    "serving.reply", time.perf_counter() - t0,
+                    trace_id=trace_id, port=self.port,
+                    tokens=int(tokens.size))
+            return
+        # chunk stream: one frame per available token batch
+        sent = 0
+        try:
+            while True:
+                try:
+                    toks, done = req.next_tokens(
+                        timeout=self._decode_stream_timeout)
+                except (RetryableError, EngineClosed, TimeoutError):
+                    dec.cancel(req)
+                    self._m_responses.inc(status=str(STATUS_OVERLOADED))
+                    self._send_frame(conn, STATUS_OVERLOADED)
+                    return
+                except Exception:  # noqa: BLE001 - protocol error status
+                    dec.cancel(req)
+                    self._m_responses.inc(status=str(STATUS_ERROR))
+                    self._send_frame(conn, STATUS_ERROR)
+                    return
+                arr = np.asarray(toks, dtype=req.token_dtype)
+                sent += arr.size
+                if done:
+                    self._m_responses.inc(status=str(STATUS_OK))
+                    self._send_frame(conn, STATUS_OK,
+                                     _encode_arrays([arr]))
+                    if trace_id is not None:
+                        obs_tracing.record_span(
+                            "serving.reply", time.perf_counter() - t0,
+                            trace_id=trace_id, port=self.port,
+                            tokens=sent)
+                    return
+                self._send_frame(conn, STATUS_STREAM,
+                                 _encode_arrays([arr]))
+        except (OSError, ConnectionError):
+            # the reader is gone mid-stream: free the KV slot NOW
+            dec.cancel(req)
+            raise
 
     def _handle(self, conn):
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -581,7 +733,21 @@ class PredictorServer:
                     self._set_busy(False)
                     continue
                 try:
-                    resp = self._infer(body)
+                    parsed = _decode_request(body[1:])
+                except Exception:  # noqa: BLE001 - malformed body
+                    self._m_responses.inc(status=str(STATUS_ERROR))
+                    conn.sendall(struct.pack("<IB", 1, 1))
+                    self._set_busy(False)
+                    continue
+                if parsed[3] is not None:
+                    # decode request (0x5C field): chunked streaming
+                    # reply (or one-shot collect) — sends its own frames
+                    self._serve_decode(conn, parsed[0], parsed[1],
+                                       parsed[2], parsed[3])
+                    self._set_busy(False)
+                    continue
+                try:
+                    resp = self._infer(parsed[0], parsed[1], parsed[2])
                     self._m_responses.inc(status=str(resp[0]))
                     conn.sendall(struct.pack("<I", len(resp)) + resp)
                 except (RetryableError, EngineClosed):
@@ -641,9 +807,12 @@ class PredictorServer:
             pass
         with self._backend_lock:
             engine = self._engine if self._own_engine else None
+        dec = self._decode_engine if self._own_decode_engine else None
         if not drain:
             if engine is not None:
                 engine.close()
+            if dec is not None:
+                dec.close()
             return
         me = threading.current_thread()
         deadline = time.monotonic() + timeout
@@ -670,6 +839,11 @@ class PredictorServer:
             # handlers are drained/unblocked; pending engine requests
             # still fire (close() lets partial batches complete)
             engine.close()
+        if dec is not None:
+            # streaming handlers were unblocked above; in-flight
+            # sequences fail retryable (a stop mid-stream is a shed,
+            # never silent truncation)
+            dec.close()
 
 
 def serve_model(path_prefix, port=0, dynamic_batching=False,

@@ -17,6 +17,12 @@ _SOURCES = ["ps_core.cc", "ps_service.cc", "data_feed.cc",
 _LOCK = threading.Lock()
 _LIB = None
 
+#: Per-chunk callback of PD_PredictorRunStream:
+#: (data_ptr, count, wire_dtype, user) -> 0 to continue, nonzero aborts
+TOKEN_CHUNK_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
+                                  ctypes.c_int64, ctypes.c_int,
+                                  ctypes.c_void_p)
+
 
 def _source_hash():
     h = hashlib.sha256()
@@ -135,6 +141,10 @@ def _declare(lib):
                                             ctypes.c_int64)),
                                         ctypes.POINTER(ctypes.c_void_p),
                                         ctypes.c_double, u64]),
+        "PD_PredictorRunStream": (i32, [i64, i64p, i32, ctypes.c_uint32,
+                                        ctypes.c_double,
+                                        TOKEN_CHUNK_FN,
+                                        ctypes.c_void_p]),
         "PD_PredictorHealth": (i64, [i64, ctypes.c_char_p, i64]),
         "PD_PredictorNumOutputs": (i32, [i64]),
         "PD_PredictorOutputNdim": (i32, [i64, i32]),

@@ -13,11 +13,15 @@
 //            in any order (old servers ignore them):
 //            u8 0xDD | f64 timeout_ms    per-request deadline
 //            u8 0x1D | u64 trace_id      non-zero span-trace id
+//            u8 0x5C | u64 decode opts   continuous-batching decode
+//                      (low 32 bits max_new_tokens; bit 63 one-shot)
 //   response u32 len | u8 status | same encoding of outputs
 //            (cmd 3: UTF-8 JSON liveness body)
 //   status   0 ok | 1 error | 2 retryable (shed by the server's
 //            batching engine / quarantined bucket / scheduler restart
-//            / expired deadline: back off and retry)
+//            / expired deadline: back off and retry) | 3 stream chunk,
+//            more frames follow (streaming decode replies only; see
+//            PD_PredictorRunStream)
 //
 // Multi-replica failover: this client holds ONE address on purpose.
 // For a replica fleet, point it at the fleet router
@@ -310,6 +314,109 @@ int PD_PredictorRunTraced(int64_t h, int n_inputs, const int* dtypes,
                           uint64_t trace_id) {
   return run_impl(h, n_inputs, dtypes, ndims, dims, data, timeout_ms,
                   trace_id);
+}
+
+// Minimal streaming decode read path (continuous-batching servers,
+// wire field 0x5C). Sends `prompt` (prompt_len int64 token ids) and
+// reads chunk frames, invoking on_chunk(data, count, dtype, user) for
+// every non-empty token chunk as it arrives (dtype 2 = i64 for an
+// i64-encoded prompt; data points into a transient buffer — copy it
+// if you keep it). timeout_ms > 0 is the PER-TOKEN budget: it rides
+// the wire (the server fails a sequence whose inter-token gap blows
+// it) and bounds each frame read. Returns 0 on a clean end (every
+// token delivered), -3 on a retryable end (status-2 terminal OR a
+// connection broken mid-stream — the delivered prefix is valid but
+// INCOMPLETE; retry the request), -2 on a server error status, -1 on
+// transport/protocol failure before the stream started or a non-zero
+// on_chunk return (the stream cannot be resynced; the connection is
+// poisoned). A broken stream is NEVER reported as a clean end.
+int PD_PredictorRunStream(int64_t h, const int64_t* prompt, int prompt_len,
+                          uint32_t max_new_tokens, double timeout_ms,
+                          int (*on_chunk)(const void* data, int64_t count,
+                                          int dtype, void* user),
+                          void* user) {
+  if (prompt_len < 1 || !on_chunk) return -1;
+  Guard gd;
+  CPredictor* p = acquire(h, gd);
+  if (!p) return -1;
+  if (p->fd < 0) return -1;  // poisoned by an earlier I/O failure
+  std::vector<char> body;
+  body.push_back((char)1);
+  body.push_back((char)1);
+  body.push_back((char)2);  // i64 prompt
+  body.push_back((char)1);  // ndim 1
+  int64_t n = prompt_len;
+  body.insert(body.end(), (char*)&n, (char*)&n + 8);
+  body.insert(body.end(), (const char*)prompt,
+              (const char*)prompt + (size_t)prompt_len * 8);
+  body.push_back((char)0x5C);
+  uint64_t opts = (uint64_t)max_new_tokens;  // bit 63 clear: stream
+  body.insert(body.end(), (char*)&opts, (char*)&opts + 8);
+  if (timeout_ms > 0) {
+    body.push_back((char)0xDD);
+    body.insert(body.end(), (char*)&timeout_ms, (char*)&timeout_ms + 8);
+  }
+  if (timeout_ms > 0) set_io_timeout(p->fd, timeout_ms / 1000.0 + 1.0);
+  uint32_t blen = (uint32_t)body.size();
+  bool started = false;  // any frame consumed: a later break is -3
+  if (!(wr(p->fd, &blen, 4) && wr(p->fd, body.data(), blen))) {
+    io_fail(p);
+    return -1;
+  }
+  for (;;) {
+    uint32_t rlen = 0;
+    if (!(rd(p->fd, &rlen, 4) && rlen >= 1)) {
+      io_fail(p);
+      return started ? -3 : -1;  // mid-stream break: retryable, not ok
+    }
+    std::vector<char> resp(rlen);
+    if (!rd(p->fd, resp.data(), rlen)) {
+      io_fail(p);
+      return started ? -3 : -1;
+    }
+    started = true;
+    int status = (unsigned char)resp[0];
+    if (status == 2) {
+      if (timeout_ms > 0 && p->fd >= 0) set_io_timeout(p->fd, 0.0);
+      return -3;
+    }
+    if (status != 0 && status != 3) {
+      if (timeout_ms > 0 && p->fd >= 0) set_io_timeout(p->fd, 0.0);
+      return -2;
+    }
+    if (rlen > 1) {
+      // parse the single token array of this chunk
+      size_t off = 1;
+      int n_out = (unsigned char)resp[off++];
+      if (n_out >= 1) {
+        if (off + 2 > resp.size()) { io_fail(p); return -1; }
+        int dt = (unsigned char)resp[off++];
+        int nd = (unsigned char)resp[off++];
+        size_t esize = dtype_size(dt);
+        if (esize == 0) { io_fail(p); return -1; }
+        size_t count = 1;
+        for (int d = 0; d < nd; d++) {
+          if (off + 8 > resp.size()) { io_fail(p); return -1; }
+          int64_t v;
+          std::memcpy(&v, resp.data() + off, 8);
+          off += 8;
+          count *= (size_t)v;
+        }
+        if (off + count * esize > resp.size()) { io_fail(p); return -1; }
+        if (count > 0 &&
+            on_chunk(resp.data() + off, (int64_t)count, dt, user) != 0) {
+          // caller aborted: the rest of the stream is undeliverable
+          // and the connection cannot be resynced
+          io_fail(p);
+          return -1;
+        }
+      }
+    }
+    if (status == 0) {
+      if (timeout_ms > 0 && p->fd >= 0) set_io_timeout(p->fd, 0.0);
+      return 0;
+    }
+  }
 }
 
 // Liveness/readiness probe (wire cmd 3). Copies the server's UTF-8

@@ -155,6 +155,12 @@ _SERVING_REPLIES = _metrics.counter(
     "paddle_serving_replies_total",
     "Fleet replies per tenant and outcome",
     labelnames=("tenant", "outcome"))
+_SERVING_TOKENS = _metrics.counter(
+    "paddle_serving_goodput_tokens_total",
+    "Streamed tokens per tenant and outcome (the token-streaming "
+    "workload's goodput unit: a reply is many tokens, so tenant SLO "
+    "accounting must count tokens, not replies)",
+    labelnames=("tenant", "outcome"))
 
 
 class ServingGoodput:
@@ -166,34 +172,50 @@ class ServingGoodput:
 
         SERVING_LEDGER.record("tenant-a", "ok", seconds=0.012)
 
+    Streaming decode replies additionally carry their token count —
+    the unit tenant SLO accounting uses for token workloads (one
+    streamed reply is hundreds of tokens; counting replies would let
+    a tenant's one giant stream look equal to another's one tiny
+    one)::
+
+        SERVING_LEDGER.record("tenant-a", "ok", seconds=1.2, tokens=128)
+
     ``report()`` gives the fleet goodput fraction plus per-tenant
-    reply/deadline-hit counts; the same numbers export as
-    ``paddle_serving_goodput_seconds_total{tenant,outcome}`` /
-    ``paddle_serving_replies_total{tenant,outcome}``. Every in-deadline
-    OK reply's service time is also fed to the process accountant's
-    ``serving`` category, so one `goodput.report()` spans training and
-    serving."""
+    reply/deadline-hit counts and token totals (``goodput_tokens`` =
+    in-SLO tokens over all streamed tokens); the same numbers export
+    as ``paddle_serving_goodput_seconds_total{tenant,outcome}`` /
+    ``paddle_serving_replies_total{tenant,outcome}`` /
+    ``paddle_serving_goodput_tokens_total{tenant,outcome}``. Every
+    in-deadline OK reply's service time is also fed to the process
+    accountant's ``serving`` category, so one `goodput.report()` spans
+    training and serving."""
 
     def __init__(self, export=True, accountant=None):
         self._lock = threading.Lock()
-        self._data = {}  # tenant -> {outcome: [count, seconds]}
+        self._data = {}  # tenant -> {outcome: [count, seconds, tokens]}
         self._export = export
         self._accountant = accountant
 
-    def record(self, tenant, outcome, seconds=0.0):
+    def record(self, tenant, outcome, seconds=0.0, tokens=0):
         if outcome not in SERVING_OUTCOMES:
             raise ValueError(f"unknown serving outcome {outcome!r} "
                              f"(have {SERVING_OUTCOMES})")
         tenant = str(tenant)
         seconds = max(0.0, float(seconds))
+        tokens = max(0, int(tokens))
         with self._lock:
             cell = self._data.setdefault(
-                tenant, {o: [0, 0.0] for o in SERVING_OUTCOMES})[outcome]
+                tenant,
+                {o: [0, 0.0, 0] for o in SERVING_OUTCOMES})[outcome]
             cell[0] += 1
             cell[1] += seconds
+            cell[2] += tokens
         if self._export:
             _SERVING_SECONDS.inc(seconds, tenant=tenant, outcome=outcome)
             _SERVING_REPLIES.inc(tenant=tenant, outcome=outcome)
+            if tokens:
+                _SERVING_TOKENS.inc(tokens, tenant=tenant,
+                                    outcome=outcome)
         if outcome == "ok":
             (self._accountant or ACCOUNTANT).account("serving", seconds)
 
@@ -208,30 +230,43 @@ class ServingGoodput:
             data = {t: {o: list(c) for o, c in per.items()}
                     for t, per in self._data.items()}
         tenants = {}
-        tot = {o: [0, 0.0] for o in SERVING_OUTCOMES}
+        tot = {o: [0, 0.0, 0] for o in SERVING_OUTCOMES}
         for t, per in sorted(data.items()):
             replies = sum(c[0] for c in per.values())
             secs = sum(c[1] for c in per.values())
+            toks = sum(c[2] for c in per.values())
             for o in SERVING_OUTCOMES:
                 tot[o][0] += per[o][0]
                 tot[o][1] += per[o][1]
+                tot[o][2] += per[o][2]
             tenants[t] = {
                 "replies": replies,
                 **{o: per[o][0] for o in SERVING_OUTCOMES},
                 "seconds": round(secs, 6),
                 "ok_seconds": round(per["ok"][1], 6),
+                "tokens": toks,
+                "ok_tokens": per["ok"][2],
                 "deadline_hit_rate": (round(per["ok"][0] / replies, 6)
                                       if replies else 0.0),
+                "token_hit_rate": (round(per["ok"][2] / toks, 6)
+                                   if toks else 0.0),
             }
         total_s = sum(c[1] for c in tot.values())
         total_n = sum(c[0] for c in tot.values())
+        total_tok = sum(c[2] for c in tot.values())
         return {
             "goodput": (round(tot["ok"][1] / total_s, 6)
                         if total_s > 0 else 0.0),
+            # the token-workload goodput: in-SLO tokens over ALL
+            # streamed tokens (0.0 while nothing streamed)
+            "goodput_tokens": (round(tot["ok"][2] / total_tok, 6)
+                               if total_tok > 0 else 0.0),
             "replies": total_n,
             **{o: tot[o][0] for o in SERVING_OUTCOMES},
             "total_seconds": round(total_s, 6),
             "ok_seconds": round(tot["ok"][1], 6),
+            "tokens": total_tok,
+            "ok_tokens": tot["ok"][2],
             "tenants": tenants,
         }
 

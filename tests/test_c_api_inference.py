@@ -93,6 +93,59 @@ class TestCAPI:
             assert a.dtype == b.dtype
 
 
+class TestCAPIStreaming:
+    """PD_PredictorRunStream: the C client's minimal streaming decode
+    read path against a real continuous-batching decode server."""
+
+    @pytest.mark.decode
+    def test_stream_collects_tokens_and_matches_reference(self):
+        import ctypes
+
+        from decode_worker import reference_decode, toy_decode_model
+        from paddle_tpu.inference.decode import DecodeEngine
+        from paddle_tpu.inference.server import PredictorServer
+
+        model = toy_decode_model(hidden=16, vocab=32, seed=0)
+        engine = DecodeEngine(model, max_slots=4, max_seq_len=32,
+                              min_seq_bucket=8, name="capi-decode")
+        server = PredictorServer(lambda *a: list(a),
+                                 decode_engine=engine,
+                                 own_decode_engine=True)
+        lib = native.get_lib()
+        try:
+            h = lib.PD_PredictorCreate(b"127.0.0.1", server.port)
+            assert h > 0
+            try:
+                got = []
+                chunks = []
+
+                @native.TOKEN_CHUNK_FN
+                def on_chunk(data, count, dtype, _user):
+                    assert dtype == 2  # i64 prompt -> i64 tokens
+                    vals = np.ctypeslib.as_array(
+                        ctypes.cast(data,
+                                    ctypes.POINTER(ctypes.c_int64)),
+                        shape=(count,))
+                    got.extend(int(v) for v in vals)
+                    chunks.append(int(count))
+                    return 0
+
+                prompt = np.array([1, 2, 3], np.int64)
+                rc = lib.PD_PredictorRunStream(
+                    h, native.i64_ptr(prompt), 3, 8, 0.0, on_chunk,
+                    None)
+                assert rc == 0
+                ref = reference_decode(model,
+                                       prompt.astype(np.int32), 8,
+                                       max_seq_len=32)
+                assert got == ref.tolist()
+                assert len(chunks) >= 1
+            finally:
+                lib.PD_PredictorDestroy(h)
+        finally:
+            server.stop()
+
+
 class TestConcurrentServing:
     def test_parallel_clients_get_correct_results(self, served_model):
         """The serving endpoint must stay correct under concurrent

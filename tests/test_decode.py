@@ -1,0 +1,373 @@
+"""Continuous-batching decode engine (inference/decode.py).
+
+The load-bearing contract is BITWISE equivalence: a sequence decoded
+inside a continuous batch — joining mid-flight, sharing steps with
+neighbors, crossing seq buckets, leaving early — must emit exactly
+the tokens the same sequence emits decoded solo (greedy sampling).
+Plus the PR 5 robustness plumbing applied to decode: per-token
+deadlines, breaker quarantine, watchdog restart, and the slot-purge
+audit (a shed/cancelled stream must free its KV slot immediately).
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import batching
+from paddle_tpu.inference.decode import DecodeEngine, seq_bucket
+from paddle_tpu.resilience import chaos
+
+from decode_worker import reference_decode, toy_decode_model
+
+pytestmark = pytest.mark.decode
+
+HID, VOCAB = 16, 32
+
+
+@pytest.fixture(scope="module")
+def model():
+    return toy_decode_model(hidden=HID, vocab=VOCAB, seed=0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def make_engine(model, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_seq_len", 32)
+    kw.setdefault("min_seq_bucket", 8)
+    kw.setdefault("watchdog_interval", 0)
+    kw.setdefault("name", "decode-test")
+    return DecodeEngine(model, **kw)
+
+
+def wait_tokens(req, n, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while len(req.tokens_so_far()) < n:
+        assert time.monotonic() < deadline, \
+            f"only {len(req.tokens_so_far())}/{n} tokens"
+        time.sleep(0.005)
+
+
+PROMPTS = [np.array([1, 2, 3], np.int32),
+           np.array([5, 6, 7, 8, 9, 10, 11, 12, 13], np.int32),
+           np.array([4], np.int32)]
+
+
+class TestSeqBucket:
+    def test_ladder(self):
+        assert seq_bucket(1, 8, 64) == 8
+        assert seq_bucket(8, 8, 64) == 8
+        assert seq_bucket(9, 8, 64) == 16
+        assert seq_bucket(33, 8, 64) == 64
+        assert seq_bucket(64, 8, 64) == 64
+
+
+class TestBitwiseEquivalence:
+    def test_concurrent_batch_equals_solo(self, model):
+        """Three sequences of different lengths decoded together ==
+        each decoded alone (the core continuous-batching contract)."""
+        with make_engine(model) as eng:
+            reqs = [eng.submit(p, max_new_tokens=10) for p in PROMPTS]
+            outs = [r.result(timeout=60) for r in reqs]
+        refs = [reference_decode(model, p, 10, max_seq_len=32)
+                for p in PROMPTS]
+        for o, r in zip(outs, refs):
+            assert o.tolist() == r.tolist()
+
+    def test_join_and_leave_mid_sequence(self, model):
+        """A long sequence's tokens are unchanged by a short neighbor
+        JOINING mid-decode and LEAVING before it finishes — the
+        iteration-level scheduling event the one-shot engine cannot
+        express."""
+        with make_engine(model) as eng:
+            a = eng.submit(PROMPTS[0], max_new_tokens=14)
+            wait_tokens(a, 4)  # a is mid-decode
+            b = eng.submit(PROMPTS[2], max_new_tokens=3)  # joins...
+            b_out = b.result(timeout=60)                  # ...and leaves
+            a_out = a.result(timeout=60)
+            assert len(a.tokens_so_far()) == 14
+        assert a_out.tolist() == reference_decode(
+            model, PROMPTS[0], 14, max_seq_len=32).tolist()
+        assert b_out.tolist() == reference_decode(
+            model, PROMPTS[2], 3, max_seq_len=32).tolist()
+
+    def test_seq_bucket_crossing_in_batch(self, model):
+        """Sequences whose shared step program climbs the seq-bucket
+        ladder (8 -> 16 -> 32) mid-batch stay bitwise equal to solo."""
+        long_p = np.arange(1, 12, dtype=np.int32)  # 11 prompt tokens
+        with make_engine(model) as eng:
+            a = eng.submit(long_p, max_new_tokens=18)   # crosses 16->32
+            c = eng.submit(PROMPTS[2], max_new_tokens=18)  # 8->16->...
+            outs = [a.result(timeout=60), c.result(timeout=60)]
+        assert outs[0].tolist() == reference_decode(
+            model, long_p, 18, max_seq_len=32).tolist()
+        assert outs[1].tolist() == reference_decode(
+            model, PROMPTS[2], 18, max_seq_len=32).tolist()
+
+    @pytest.mark.parametrize("dt", ["float32", "int32", "int64", "bool"])
+    def test_feature_dtypes_bitwise(self, dt):
+        """Per-sequence feature arrays of every wire dtype flow into
+        the logits; in-batch decode == solo decode for each."""
+        spec = (((3,), np.dtype(dt)),)
+        m = toy_decode_model(hidden=HID, vocab=VOCAB, seed=1,
+                             feature_spec=spec)
+        if dt == "bool":
+            feats = [np.array([True, False, True])]
+            feats2 = [np.array([False, False, True])]
+        else:
+            feats = [np.array([3, 1, 2], np.dtype(dt))]
+            feats2 = [np.array([7, 0, 5], np.dtype(dt))]
+        with make_engine(m) as eng:
+            r1 = eng.submit(PROMPTS[0], max_new_tokens=8, features=feats)
+            r2 = eng.submit(PROMPTS[2], max_new_tokens=8, features=feats2)
+            o1, o2 = r1.result(timeout=60), r2.result(timeout=60)
+        assert o1.tolist() == reference_decode(
+            m, PROMPTS[0], 8, features=feats, max_seq_len=32).tolist()
+        assert o2.tolist() == reference_decode(
+            m, PROMPTS[2], 8, features=feats2, max_seq_len=32).tolist()
+
+    def test_features_steer_decoding(self):
+        """Features are a live input: the same prompt with different
+        feature values decodes differently (so the per-dtype bitwise
+        tests above are real tests, not dead-input tautologies)."""
+        spec = (((3,), np.float32),)
+        m = toy_decode_model(hidden=HID, vocab=VOCAB, seed=1,
+                             feature_spec=spec)
+        a = reference_decode(m, PROMPTS[0], 10,
+                             features=[np.zeros(3, np.float32)],
+                             max_seq_len=32)
+        b = reference_decode(m, PROMPTS[0], 10,
+                             features=[np.full(3, 8.0, np.float32)],
+                             max_seq_len=32)
+        assert a.tolist() != b.tolist()
+
+    def test_i64_prompt_echoes_dtype(self, model):
+        with make_engine(model) as eng:
+            out = eng.generate(PROMPTS[0].astype(np.int64),
+                               max_new_tokens=5, timeout=60)
+        assert out.dtype == np.int64
+        assert out.tolist() == reference_decode(
+            model, PROMPTS[0], 5, max_seq_len=32).tolist()
+
+
+class TestLifecycle:
+    def test_eos_stops_early(self, model):
+        ref = reference_decode(model, PROMPTS[0], 10,
+                               max_seq_len=32).tolist()
+        eos = ref[2]  # the FIRST occurrence of this token id decides
+        stop_at = ref.index(eos) + 1
+        assert stop_at < len(ref)
+        m = toy_decode_model(hidden=HID, vocab=VOCAB, seed=0,
+                             eos_token_id=eos)
+        with make_engine(m) as eng:
+            req = eng.submit(PROMPTS[0], max_new_tokens=10)
+            out = req.result(timeout=60)
+        assert req.finish_reason == "eos"
+        assert out.tolist() == ref[:stop_at]
+
+    def test_max_seq_len_retires(self, model):
+        with make_engine(model, max_seq_len=16, max_prompt_len=8) as eng:
+            req = eng.submit(PROMPTS[0], max_new_tokens=100)
+            out = req.result(timeout=60)
+        assert req.finish_reason == "max_seq_len"
+        # prompt 3 + first token at pos 3 ... kv full at 16 entries
+        assert out.size == 16 - PROMPTS[0].size + 1
+
+    def test_queue_full_sheds(self, model):
+        with make_engine(model, max_queue=1) as eng:
+            # block the scheduler inside a slow step so the queue fills
+            with chaos.fault("serving.decode.step", delay=0.3, times=50):
+                eng.submit(PROMPTS[0], max_new_tokens=30)
+                time.sleep(0.05)  # let it join; queue now empty
+                eng.submit(PROMPTS[2], max_new_tokens=2)  # queued
+                with pytest.raises(batching.EngineOverloaded):
+                    eng.submit(PROMPTS[2], max_new_tokens=2)
+
+    def test_validation(self, model):
+        with make_engine(model, max_prompt_len=8) as eng:
+            with pytest.raises(ValueError):
+                eng.submit(np.zeros((2, 3), np.int32))  # 2 rows
+            with pytest.raises(ValueError):
+                eng.submit(np.array([0.5], np.float32))  # float prompt
+            with pytest.raises(ValueError):
+                eng.submit(np.arange(9, dtype=np.int32))  # > max_prompt
+            with pytest.raises(ValueError):
+                eng.submit(PROMPTS[0], max_new_tokens=0)
+            with pytest.raises(ValueError):
+                eng.submit(PROMPTS[0], features=[np.zeros(3)])  # no spec
+
+    def test_close_fails_inflight_retryable(self, model):
+        eng = make_engine(model)
+        with chaos.fault("serving.decode.step", delay=0.2, times=100):
+            req = eng.submit(PROMPTS[0], max_new_tokens=50)
+            wait_tokens(req, 1)
+            eng.close()
+        with pytest.raises(batching.EngineClosed):
+            req.result(timeout=10)
+        with pytest.raises(batching.EngineClosed):
+            eng.submit(PROMPTS[0])
+
+
+class TestRobustness:
+    def test_step_failure_retryable_and_slots_freed(self, model):
+        with make_engine(model, breaker_threshold=0) as eng:
+            with chaos.fault("serving.decode.step",
+                             exc=RuntimeError("boom")):
+                req = eng.submit(PROMPTS[0], max_new_tokens=6)
+                with pytest.raises(batching.RetryableError):
+                    req.result(timeout=30)
+            # no slot leak: the failed sequence released its slot
+            h = eng.health()
+            assert h["active"] == 0
+            assert h["free_slots"] == eng.max_slots
+            # and the engine still serves
+            out = eng.generate(PROMPTS[0], max_new_tokens=6, timeout=60)
+            assert out.tolist() == reference_decode(
+                model, PROMPTS[0], 6, max_seq_len=32).tolist()
+
+    def test_cancel_mid_stream_purges_slot(self, model):
+        """The ISSUE 12 slot-leak audit: a stream abandoned mid-flight
+        frees its KV slot immediately (chaos-slowed steps guarantee
+        the sequence is genuinely mid-decode when cancelled)."""
+        with make_engine(model) as eng:
+            with chaos.fault("serving.decode.step", delay=0.1,
+                             times=1000):
+                req = eng.submit(PROMPTS[0], max_new_tokens=500)
+                wait_tokens(req, 2)
+                eng.cancel(req)
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    h = eng.health()
+                    if h["active"] == 0 \
+                            and h["free_slots"] == eng.max_slots:
+                        break
+                    time.sleep(0.02)
+                h = eng.health()
+            assert h["active"] == 0
+            assert h["free_slots"] == eng.max_slots
+            assert req.finish_reason == "cancelled"
+            assert eng.stats()["retired"]["cancelled"] == 1
+            # far fewer than 500 tokens were computed
+            assert len(req.tokens_so_far()) < 50
+
+    def test_per_token_deadline_fails_retryable(self, model):
+        with make_engine(model) as eng:
+            with chaos.fault("serving.decode.step", delay=0.6,
+                             times=1000):
+                req = eng.submit(PROMPTS[0], max_new_tokens=50,
+                                 token_budget_s=0.15)
+                with pytest.raises(batching.DeadlineExceeded):
+                    req.result(timeout=30)
+            assert eng.health()["free_slots"] == eng.max_slots
+            assert eng.stats()["deadline_late"] >= 1
+
+    def test_pending_budget_expired_before_join(self, model):
+        with make_engine(model, max_slots=1) as eng:
+            with chaos.fault("serving.decode.step", delay=0.2,
+                             times=1000):
+                eng.submit(PROMPTS[0], max_new_tokens=30)
+                time.sleep(0.05)
+                late = eng.submit(PROMPTS[2], max_new_tokens=2,
+                                  token_budget_s=0.05)
+                with pytest.raises(batching.DeadlineExceeded):
+                    late.result(timeout=30)
+            assert eng.stats()["deadline_expired"] >= 1
+
+    def test_breaker_quarantines_program(self, model):
+        with make_engine(model, breaker_threshold=2,
+                         breaker_cooldown=60) as eng:
+            with chaos.fault("serving.decode.prefill",
+                             exc=RuntimeError("poison"), times=10):
+                for _ in range(2):
+                    with pytest.raises(batching.RetryableError):
+                        eng.generate(PROMPTS[0], max_new_tokens=2,
+                                     timeout=30)
+                # third trip: shed FAST by the open breaker
+                with pytest.raises(batching.BucketQuarantined):
+                    eng.generate(PROMPTS[0], max_new_tokens=2,
+                                 timeout=30)
+            st = eng.stats()
+            assert st["quarantine_shed"] >= 1
+
+    def test_watchdog_restarts_dead_scheduler(self, model):
+        with make_engine(model, watchdog_interval=0.05) as eng:
+            eng.generate(PROMPTS[0], max_new_tokens=2, timeout=60)
+            with chaos.fault("serving.decode.loop",
+                             exc=RuntimeError("sched-death"),
+                             at=chaos.visits("serving.decode.loop") + 1):
+                req = eng.submit(PROMPTS[0], max_new_tokens=30)
+                with pytest.raises(batching.RetryableError):
+                    req.result(timeout=30)
+            # the replacement scheduler serves parked + new work
+            out = eng.generate(PROMPTS[0], max_new_tokens=4, timeout=60)
+            assert out.tolist() == reference_decode(
+                model, PROMPTS[0], 4, max_seq_len=32).tolist()
+            assert eng.stats()["scheduler_restarts"] >= 1
+
+
+class TestWarmupAndStore:
+    def test_warmup_declares_ladder_no_hot_compiles(self, model):
+        with make_engine(model, max_slots=2, max_seq_len=16,
+                         max_prompt_len=16) as eng:
+            declared = eng.warmup()
+            st = eng.stats()
+            assert st["compiles"] == len(declared)
+            eng.generate(PROMPTS[0], max_new_tokens=6, timeout=60)
+            assert eng.stats()["compiles"] == len(declared)  # no new
+
+    def test_fresh_engine_rewarms_from_store_zero_compiles(self,
+                                                           tmp_path):
+        from paddle_tpu.serialize.artifact_store import ArtifactStore
+
+        m = toy_decode_model(hidden=HID, vocab=VOCAB, seed=2)
+        store = ArtifactStore(str(tmp_path / "store"))
+        buckets = dict(slot_buckets=[2], seq_buckets=[8, 16],
+                       prompt_buckets=[8])
+        with make_engine(m, max_slots=2, max_seq_len=16,
+                         store=store) as eng:
+            eng.warmup(**buckets)
+            st = eng.stats()
+            assert st["compiles"] == 3 and st["store_loads"] == 0
+            first = eng.generate(PROMPTS[0], max_new_tokens=6,
+                                 timeout=60)
+        # a FRESH engine over the same model+store warms with ZERO
+        # inline XLA compiles — the PR 10 zero-cold-start contract,
+        # now for decode replicas
+        with make_engine(m, max_slots=2, max_seq_len=16,
+                         store=store) as eng2:
+            eng2.warmup(**buckets)
+            st = eng2.stats()
+            assert st["compiles"] == 0 and st["store_loads"] == 3
+            again = eng2.generate(PROMPTS[0], max_new_tokens=6,
+                                  timeout=60)
+        # store-loaded programs are bitwise identical to compiled ones
+        assert first.tolist() == again.tolist()
+
+
+class TestMetrics:
+    def test_token_histograms_and_counters(self, model):
+        with make_engine(model, name="decode-metrics") as eng:
+            eng.generate(PROMPTS[0], max_new_tokens=6, timeout=60)
+            assert eng._m_ttft.value()["count"] == 1
+            assert eng._m_intertoken.value()["count"] == 5
+            st = eng.stats()
+            assert st["tokens"] == 6
+            assert st["requests"] == 1
+            assert st["retired"]["max_tokens"] == 1
+            assert st["prefills"] >= 1 and st["steps"] >= 5
+
+    def test_prometheus_exposition_has_decode_families(self, model):
+        from paddle_tpu.obs import prometheus as obs_prometheus
+
+        with make_engine(model, name="decode-prom") as eng:
+            eng.generate(PROMPTS[0], max_new_tokens=4, timeout=60)
+            text = obs_prometheus.render()
+        assert "paddle_decode_ttft_seconds" in text
+        assert "paddle_decode_intertoken_seconds" in text
+        assert "paddle_decode_tokens_total" in text

@@ -1,0 +1,365 @@
+"""Streaming decode over the wire: chunked cmd-1 replies, one-shot
+mode, backward compat, per-token budgets, the client-disconnect slot
+purge, router chunk relay, and the token goodput ledger."""
+import socket
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.decode import DecodeEngine
+from paddle_tpu.inference.server import (PredictorServer, STATUS_STREAM,
+                                         _decode_arrays, _encode_arrays,
+                                         _encode_deadline,
+                                         _encode_decode_opts, _read_all)
+from paddle_tpu.obs import goodput as obs_goodput
+from paddle_tpu.resilience import chaos
+
+from decode_worker import reference_decode, toy_decode_model
+
+pytestmark = pytest.mark.decode
+
+HID, VOCAB = 16, 32
+PROMPT = np.array([1, 2, 3], np.int32)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return toy_decode_model(hidden=HID, vocab=VOCAB, seed=0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def make_server(model, **eng_kw):
+    eng_kw.setdefault("max_slots", 4)
+    eng_kw.setdefault("max_seq_len", 32)
+    eng_kw.setdefault("min_seq_bucket", 8)
+    eng_kw.setdefault("name", "decode-wire")
+    engine = DecodeEngine(model, **eng_kw)
+    server = PredictorServer(lambda *a: list(a), decode_engine=engine,
+                             own_decode_engine=True)
+    return server, engine
+
+
+def decode_frame(prompt, max_new, oneshot=False, budget_ms=None,
+                 features=()):
+    body = (struct.pack("<B", 1)
+            + _encode_arrays([prompt, *features])
+            + _encode_decode_opts(max_new, oneshot=oneshot))
+    if budget_ms is not None:
+        body += _encode_deadline(budget_ms)
+    return struct.pack("<I", len(body)) + body
+
+
+def read_stream(sock, max_frames=1000):
+    """-> (terminal_status, tokens_array, n_frames)."""
+    chunks = []
+    frames = 0
+    while frames < max_frames:
+        (blen,) = struct.unpack("<I", _read_all(sock, 4))
+        resp = _read_all(sock, blen)
+        frames += 1
+        if len(resp) > 1 and resp[0] in (0, STATUS_STREAM):
+            arrs = _decode_arrays(resp[1:])
+            if arrs and arrs[0].size:
+                chunks.append(arrs[0])
+        if resp[0] != STATUS_STREAM:
+            toks = (np.concatenate(chunks) if chunks
+                    else np.array([], np.int32))
+            return resp[0], toks, frames
+    raise AssertionError("stream never terminated")
+
+
+def stream_decode(port, prompt, max_new, **kw):
+    with socket.create_connection(("127.0.0.1", port)) as s:
+        s.sendall(decode_frame(prompt, max_new, **kw))
+        return read_stream(s)
+
+
+class TestStreamingWire:
+    def test_stream_oneshot_and_plain_roundtrip(self, model):
+        server, engine = make_server(model)
+        try:
+            ref = reference_decode(model, PROMPT, 8, max_seq_len=32)
+            st, toks, frames = stream_decode(server.port, PROMPT, 8)
+            assert st == 0
+            assert toks.tolist() == ref.tolist()
+            assert frames >= 2  # genuinely chunked
+            # one-shot: today's single reply, whole sequence
+            st, toks, frames = stream_decode(server.port, PROMPT, 8,
+                                             oneshot=True)
+            assert (st, frames) == (0, 1)
+            assert toks.tolist() == ref.tolist()
+            # i64 prompt -> i64 token chunks
+            st, toks, _ = stream_decode(server.port,
+                                        PROMPT.astype(np.int64), 8)
+            assert st == 0 and toks.dtype == np.int64
+            assert toks.tolist() == ref.tolist()
+            # a NON-streaming cmd-1 (no 0x5C field) is untouched: one
+            # status-0 reply through the plain run_fn
+            x = np.ones((2, 3), np.float32)
+            body = struct.pack("<B", 1) + _encode_arrays([x])
+            with socket.create_connection(("127.0.0.1",
+                                           server.port)) as s:
+                s.sendall(struct.pack("<I", len(body)) + body)
+                (blen,) = struct.unpack("<I", _read_all(s, 4))
+                resp = _read_all(s, blen)
+            assert resp[0] == 0
+            np.testing.assert_array_equal(_decode_arrays(resp[1:])[0], x)
+        finally:
+            server.stop()
+
+    def test_mid_stream_failure_is_terminal_status2(self, model):
+        """A decode-step fault mid-stream ends the stream with a
+        retryable terminal frame — delivered tokens first, then the
+        status-2, never a truncated-but-'ok' status-0."""
+        server, engine = make_server(model, breaker_threshold=0)
+        try:
+            with chaos.fault("serving.decode.step",
+                             exc=RuntimeError("mid-stream"), at=3):
+                st, toks, frames = stream_decode(server.port, PROMPT, 30)
+            assert st == 2
+            assert 1 <= toks.size < 30  # a real prefix came through
+            # prefix is bitwise the solo prefix (no corruption)
+            ref = reference_decode(model, PROMPT, 30, max_seq_len=32)
+            assert toks.tolist() == ref[:toks.size].tolist()
+        finally:
+            server.stop()
+
+    def test_client_disconnect_purges_kv_slot(self, model):
+        """The ISSUE 12 slot-leak audit at the wire level: a client
+        that vanishes mid-stream frees its KV slot long before
+        max_new_tokens, with steps chaos-slowed at serving.decode.step
+        so the sequence is genuinely mid-decode."""
+        server, engine = make_server(model)
+        try:
+            with chaos.fault("serving.decode.step", delay=0.05,
+                             times=10000):
+                s = socket.create_connection(("127.0.0.1", server.port))
+                s.sendall(decode_frame(PROMPT, 500))
+                (blen,) = struct.unpack("<I", _read_all(s, 4))
+                _read_all(s, blen)  # one chunk arrived; stream is live
+                s.close()  # client gone
+                deadline = time.monotonic() + 10.0
+                purged = False
+                while time.monotonic() < deadline:
+                    h = engine.health()
+                    if h["active"] == 0 \
+                            and h["free_slots"] == engine.max_slots:
+                        purged = True
+                        break
+                    time.sleep(0.02)
+            assert purged, engine.health()
+            st = engine.stats()
+            assert st["retired"]["cancelled"] >= 1
+            assert st["tokens"] < 400  # nowhere near max_new_tokens
+        finally:
+            server.stop()
+
+    def test_per_token_budget_on_wire(self, model):
+        server, engine = make_server(model)
+        try:
+            with chaos.fault("serving.decode.step", delay=0.5,
+                             times=1000):
+                st, toks, _ = stream_decode(server.port, PROMPT, 30,
+                                            budget_ms=100.0)
+            assert st == 2
+            assert engine.stats()["deadline_late"] >= 1
+            assert engine.health()["free_slots"] == engine.max_slots
+        finally:
+            server.stop()
+
+    def test_health_stats_and_metrics_surfaces(self, model):
+        server, engine = make_server(model)
+        try:
+            stream_decode(server.port, PROMPT, 4)
+
+            def cmd(c):
+                with socket.create_connection(("127.0.0.1",
+                                               server.port)) as s:
+                    s.sendall(struct.pack("<IB", 1, c))
+                    (blen,) = struct.unpack("<I", _read_all(s, 4))
+                    return _read_all(s, blen)
+
+            import json
+
+            health = json.loads(cmd(3)[1:].decode())
+            assert health["ok"] is True
+            assert health["decode"]["scheduler_alive"] is True
+            assert health["decode"]["free_slots"] == engine.max_slots
+            stats = json.loads(cmd(5)[1:].decode())
+            assert stats["decode"]["tokens"] == 4
+            assert stats["decode"]["requests"] == 1
+            metrics = cmd(6)[1:].decode()
+            assert "paddle_decode_ttft_seconds" in metrics
+            assert "paddle_decode_intertoken_seconds" in metrics
+            assert "paddle_server_stream_chunks_total" in metrics
+        finally:
+            server.stop()
+
+
+class TestRouterRelay:
+    def test_router_relays_chunk_stream_and_counts_tokens(self, model):
+        from paddle_tpu.inference.registry import ReplicaRegistry
+        from paddle_tpu.inference.router import FleetRouter
+
+        obs_goodput.SERVING_LEDGER.reset()
+        server, engine = make_server(model)
+        registry = ReplicaRegistry(heartbeat_interval=0.1)
+        registry.register("r1", "127.0.0.1", server.port)
+        router = FleetRouter(registry=registry, own_registry=True)
+        try:
+            deadline = time.monotonic() + 10.0
+            while not registry.routable():
+                assert time.monotonic() < deadline, "replica never ok"
+                time.sleep(0.05)
+            ref = reference_decode(model, PROMPT, 8, max_seq_len=32)
+            st, toks, frames = stream_decode(router.port, PROMPT, 8)
+            assert st == 0
+            assert toks.tolist() == ref.tolist()
+            assert frames >= 2  # relayed as chunks, not re-buffered
+            rep = obs_goodput.SERVING_LEDGER.report()
+            assert rep["tokens"] == 8
+            assert rep["ok_tokens"] == 8
+            assert rep["goodput_tokens"] == 1.0
+            # non-streaming traffic through the same router unchanged
+            x = np.ones((2, 3), np.float32)
+            body = struct.pack("<B", 1) + _encode_arrays([x])
+            with socket.create_connection(("127.0.0.1",
+                                           router.port)) as s:
+                s.sendall(struct.pack("<I", len(body)) + body)
+                (blen,) = struct.unpack("<I", _read_all(s, 4))
+                resp = _read_all(s, blen)
+            assert resp[0] == 0
+        finally:
+            router.stop()
+            server.stop()
+
+    def test_router_oneshot_decode_scales_per_token_budget(self, model):
+        """A one-shot decode whose WHOLE reply takes longer than one
+        per-token budget must still succeed through the router: the
+        0xDD field is per-token, so the router's end-to-end bound
+        scales by the token count — treating it as an absolute
+        deadline shed every slow multi-token one-shot and ejected the
+        healthy replica that completed it."""
+        from paddle_tpu.inference.registry import ReplicaRegistry
+        from paddle_tpu.inference.router import FleetRouter
+
+        server, engine = make_server(model)
+        registry = ReplicaRegistry(heartbeat_interval=0.1)
+        registry.register("r1", "127.0.0.1", server.port)
+        router = FleetRouter(registry=registry, own_registry=True)
+        try:
+            deadline = time.monotonic() + 10.0
+            while not registry.routable():
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+            # ~8 steps x 40ms chaos delay: total >> one 150ms budget,
+            # each token comfortably inside it
+            with chaos.fault("serving.decode.step", delay=0.04,
+                             times=1000):
+                st, toks, frames = stream_decode(
+                    router.port, PROMPT, 8, oneshot=True,
+                    budget_ms=150.0)
+            assert (st, frames) == (0, 1)
+            assert toks.tolist() == reference_decode(
+                model, PROMPT, 8, max_seq_len=32).tolist()
+            # the replica was not ejected for being legitimately slow
+            assert registry.routable()
+        finally:
+            router.stop()
+            server.stop()
+
+    def test_router_stream_slo_includes_ttft(self, model):
+        """Per-token SLO accounting at the router counts the FIRST
+        chunk's gap (time-to-first-token): a slow prefill with fast
+        subsequent tokens is 'late', not 'ok' — anchoring the gap
+        clock after the first chunk hid exactly this case."""
+        from paddle_tpu.inference.registry import ReplicaRegistry
+        from paddle_tpu.inference.router import (FleetRouter,
+                                                 TenantPolicy)
+
+        obs_goodput.SERVING_LEDGER.reset()
+        server, engine = make_server(model)
+        registry = ReplicaRegistry(heartbeat_interval=0.1)
+        registry.register("r1", "127.0.0.1", server.port)
+        # SLO via tenant policy (no wire 0xDD: the replica must not
+        # enforce — this isolates the ROUTER's accounting)
+        router = FleetRouter(
+            registry=registry, own_registry=True,
+            tenants=(TenantPolicy("default", slo_ms=100),))
+        try:
+            deadline = time.monotonic() + 10.0
+            while not registry.routable():
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+            with chaos.fault("serving.decode.prefill", delay=0.4,
+                             times=1000):
+                st, toks, _ = stream_decode(router.port, PROMPT, 4)
+            assert st == 0 and toks.size == 4
+            rep = obs_goodput.SERVING_LEDGER.report()
+            t = rep["tenants"]["default"]
+            assert t["late"] >= 1, rep
+            assert t["token_hit_rate"] < 1.0
+        finally:
+            router.stop()
+            server.stop()
+
+    def test_router_mid_stream_fault_surfaces_retryable(self, model):
+        """Whether the replica sheds mid-stream itself or dies under
+        the router, the client's stream ends with a status-2 terminal
+        frame — retryable, never truncated-ok."""
+        from paddle_tpu.inference.registry import ReplicaRegistry
+        from paddle_tpu.inference.router import FleetRouter
+
+        server, engine = make_server(model, breaker_threshold=0)
+        registry = ReplicaRegistry(heartbeat_interval=0.1)
+        registry.register("r1", "127.0.0.1", server.port)
+        router = FleetRouter(registry=registry, own_registry=True)
+        try:
+            deadline = time.monotonic() + 10.0
+            while not registry.routable():
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+            with chaos.fault("serving.decode.step",
+                             exc=RuntimeError("replica-fault"), at=3):
+                st, toks, _ = stream_decode(router.port, PROMPT, 30)
+            assert st == 2
+            assert 1 <= toks.size < 30
+        finally:
+            router.stop()
+            server.stop()
+
+
+class TestTokenLedger:
+    def test_record_tokens_and_report(self):
+        led = obs_goodput.ServingGoodput(export=False)
+        led.record("a", "ok", seconds=1.0, tokens=100)
+        led.record("a", "late", seconds=2.0, tokens=50)
+        led.record("b", "ok", seconds=0.5, tokens=30)
+        led.record("b", "shed", seconds=0.0)
+        rep = led.report()
+        assert rep["tokens"] == 180
+        assert rep["ok_tokens"] == 130
+        assert rep["goodput_tokens"] == pytest.approx(130 / 180)
+        assert rep["tenants"]["a"]["tokens"] == 150
+        assert rep["tenants"]["a"]["ok_tokens"] == 100
+        assert rep["tenants"]["a"]["token_hit_rate"] == \
+            pytest.approx(100 / 150)
+        # replies-based fields unchanged
+        assert rep["tenants"]["b"]["deadline_hit_rate"] == 0.5
+
+    def test_tokens_export_exposition(self):
+        from paddle_tpu.obs import prometheus as obs_prometheus
+
+        obs_goodput.SERVING_LEDGER.record("exp-tenant", "ok",
+                                          seconds=0.1, tokens=7)
+        text = obs_prometheus.render()
+        assert "paddle_serving_goodput_tokens_total" in text
+        assert 'tenant="exp-tenant"' in text

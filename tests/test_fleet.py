@@ -522,7 +522,7 @@ class TestTenantWire:
         not)."""
         payload = (_encode_arrays([X]) + _encode_tenant(7)
                    + _encode_deadline(123.0))
-        arrays, budget, trace = _decode_request(payload)
+        arrays, budget, trace, _dec = _decode_request(payload)
         np.testing.assert_array_equal(arrays[0], X)
         assert budget == pytest.approx(0.123)
 
@@ -552,7 +552,7 @@ class TestTenantWire:
         fwd = (arrays_bytes
                + b"".join(struct.pack("<B", m) + raw
                           for m, raw in fields if m != 0x7E))
-        _arr, fwd_budget, _tr = _decode_request(fwd[1:])
+        _arr, fwd_budget, _tr, _dec = _decode_request(fwd[1:])
         assert fwd_budget == pytest.approx(0.25)
 
 

@@ -124,9 +124,10 @@ class TestSamplingOps:
 
 
 class TestBf16Decode:
-    """The decode bench (BENCH_MODEL=decode) casts the model to bf16
-    serving precision before the cached generate — pin that path on CPU
-    so a dtype bug fails here, not inside a tunnel window."""
+    """The decode roofline bench (BENCH_MODEL=decode-roofline) casts
+    the model to bf16 serving precision before the cached generate —
+    pin that path on CPU so a dtype bug fails here, not inside a
+    tunnel window."""
 
     def test_bf16_cached_decode_runs_and_is_deterministic(self):
         paddle.seed(5)

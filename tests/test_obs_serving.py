@@ -48,11 +48,11 @@ class TestWireTracePropagation:
     def test_decode_request_fields_any_order(self):
         x = np.ones((2, 3), np.float32)
         enc = _encode_arrays([x])
-        arrays, budget, tid = _decode_request(
+        arrays, budget, tid, _dec = _decode_request(
             enc + _encode_deadline(250.0) + _encode_trace(77))
         assert budget == pytest.approx(0.25)
         assert tid == 77
-        arrays, budget, tid = _decode_request(
+        arrays, budget, tid, _dec = _decode_request(
             enc + _encode_trace(77) + _encode_deadline(250.0))
         assert budget == pytest.approx(0.25)
         assert tid == 77
@@ -60,11 +60,11 @@ class TestWireTracePropagation:
 
     def test_decode_request_tolerates_absent_and_zero(self):
         enc = _encode_arrays([np.ones((1, 2), np.float32)])
-        assert _decode_request(enc)[1:] == (None, None)
+        assert _decode_request(enc)[1:] == (None, None, None)
         # trace id 0 = "untraced" sentinel, not a trace
         assert _decode_request(enc + _encode_trace(0))[2] is None
         # unknown marker: parsing stops, no crash
-        arrays, budget, tid = _decode_request(
+        arrays, budget, tid, _dec = _decode_request(
             enc + bytes([0xEE]) + b"\x00" * 8)
         assert (budget, tid) == (None, None)
 

@@ -454,6 +454,64 @@ def test_artifacts_double_run_guard_narrows_tier1():
     assert captured["args"][1] == mod.ARTIFACTS_PYTEST_ARGS
 
 
+def test_decode_stage_gates(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text(GOOD_SRC)
+    bad = tmp_path / "test_decode_fail.py"
+    bad.write_text(
+        "import pytest\n"
+        "pytestmark = pytest.mark.decode\n"
+        "def test_boom():\n    assert False\n")
+    r = _run(["--paths", str(good), "--skip-tests", "--decode",
+              "--decode-args",
+              f"{bad} -q -m decode -p no:cacheprovider"])
+    assert r.returncode == 1
+    s = _summary(r)
+    assert s["decode_run"] and not s["decode_ok"]
+    assert "+decode" in s["gate"]
+    ok = tmp_path / "test_decode_ok.py"
+    ok.write_text(
+        "import pytest\n"
+        "pytestmark = pytest.mark.decode\n"
+        "def test_fine():\n    assert True\n")
+    r = _run(["--paths", str(good), "--skip-tests", "--decode",
+              "--decode-args",
+              f"{ok} -q -m decode -p no:cacheprovider"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert _summary(r)["decode_ok"]
+
+
+def test_decode_summary_keys_present_when_not_run(tmp_path):
+    f = tmp_path / "good.py"
+    f.write_text(GOOD_SRC)
+    r = _run(["--paths", str(f), "--skip-tests"])
+    s = _summary(r)
+    assert s["decode_run"] is False and s["decode_ok"] is True
+
+
+def test_decode_double_run_guard_narrows_tier1():
+    """With --decode, tier-1 must exclude the decode marker (the
+    decode stage owns it, including the slow storm-bench contract)."""
+    mod = _gate_module()
+    captured = {}
+
+    def fake_capturing(args):
+        captured.setdefault("args", []).append(args)
+        return 1, mod.load_known_failures()
+
+    mod.run_pytest = lambda args: (
+        captured.setdefault("args", []).append(args) or 0)
+    mod.run_pytest_capturing_failures = fake_capturing
+    mod.run_tracelint = lambda *a, **k: ({"errors": 0, "warnings": 0,
+                                          "findings": []}, 0)
+    mod.audit_suppressions = lambda *a, **k: ([], [])
+    rc = mod.main(["--decode"])
+    assert rc == 0
+    tier1 = captured["args"][0]
+    assert "not decode" in tier1 and "not slow" in tier1
+    assert captured["args"][1] == mod.DECODE_PYTEST_ARGS
+
+
 def test_serialize_subsystem_is_suppression_free():
     """The artifact-store subsystem is a clean zone (DEFAULT_CLEAN_PATHS):
     no inline tracelint suppressions under paddle_tpu/serialize."""

@@ -6,7 +6,7 @@ Usage:
     python tools/ci_gate.py [--paths paddle_tpu]
         [--skip-tests] [--pytest-args "tests/ -q -m 'not slow'"]
         [--disable TPU005,...] [--chaos] [--serving] [--serving-chaos]
-        [--elastic] [--artifacts] [--fleet] [--perfproxy]
+        [--elastic] [--artifacts] [--fleet] [--decode] [--perfproxy]
         [--concurrency]
         [--clean-paths paddle_tpu/resilience paddle_tpu/inference
          paddle_tpu/obs paddle_tpu/analysis]
@@ -45,7 +45,12 @@ adds a stage running the fleet-tier suite (``-m fleet``: router WFQ
 fairness / eject-probe-readmit / retry-on-different-replica /
 drain-zero-drops units, the chaos-kill multi-replica e2e, and the
 ``bench.py fleet`` goodput + SLO-isolation contract), with the same
-compositional tier-1 exclusion. ``--perfproxy``
+compositional tier-1 exclusion. ``--decode`` adds a stage running the
+continuous-batching decode suite (``-m decode``: bitwise solo-vs-batch
+equivalence across join/leave events and every wire dtype, per-token
+SLO enforcement, streaming-wire + router-relay tests, the slot-purge
+chaos audit, and the slow ``bench.py decode`` storm contract), again
+with the compositional tier-1 double-run exclusion. ``--perfproxy``
 adds a stage running ``bench.py perfproxy`` on CPU against the
 committed PERFPROXY_BASELINE.json — compile counts, HLO op counts, and
 cost-analysis FLOPs must match, so single-chip perf can't silently rot
@@ -94,6 +99,10 @@ ARTIFACTS_PYTEST_ARGS = "tests/ -q -m artifacts -p no:cacheprovider"
 # eject/readmit, retry-on-different-replica, drain-zero-drops) plus
 # the slow chaos-kill e2e and the `bench.py fleet` contract
 FLEET_PYTEST_ARGS = "tests/ -q -m fleet -p no:cacheprovider"
+# the continuous-batching decode suite: bitwise equivalence, per-token
+# SLOs, streaming wire/router relay, slot-purge chaos, plus the slow
+# `bench.py decode` storm contract
+DECODE_PYTEST_ARGS = "tests/ -q -m decode -p no:cacheprovider"
 # subsystems that must stay suppression-free: resilience (PR 2), the
 # serving stack (PRs 4-5), the telemetry layer (PR 7), and the analyzer
 # itself (PR 8) fix findings instead of silencing them. One carve-out:
@@ -374,6 +383,13 @@ def main(argv=None):
                          "router WFQ/eject/drain units, chaos-kill "
                          "multi-replica e2e, fleet bench contract)")
     ap.add_argument("--fleet-args", default=FLEET_PYTEST_ARGS)
+    ap.add_argument("--decode", action="store_true",
+                    help="also run the continuous-batching decode "
+                         "suite (-m decode: bitwise solo-vs-batch "
+                         "equivalence, per-token SLOs, streaming "
+                         "wire/router relay, slot-purge chaos, decode "
+                         "bench contract)")
+    ap.add_argument("--decode-args", default=DECODE_PYTEST_ARGS)
     ap.add_argument("--known-failures", default=KNOWN_FAILURES_FILE,
                     help="JSON file naming the committed pre-existing "
                          "tier-1 failures the stage diffs against")
@@ -424,6 +440,8 @@ def main(argv=None):
                 excl.append("artifacts")
             if ns.fleet:
                 excl.append("fleet")
+            if ns.decode:
+                excl.append("decode")
             if excl:
                 pytest_args = pytest_args.replace(
                     "'not slow'",
@@ -481,6 +499,10 @@ def main(argv=None):
     if ns.fleet:
         fleet_ok = run_pytest(ns.fleet_args) == 0
 
+    decode_ok = True
+    if ns.decode:
+        decode_ok = run_pytest(ns.decode_args) == 0
+
     perfproxy_ok = True
     if ns.perfproxy:
         perfproxy_ok = run_perfproxy() == 0
@@ -502,6 +524,7 @@ def main(argv=None):
                  + ("+elastic" if ns.elastic else "")
                  + ("+artifacts" if ns.artifacts else "")
                  + ("+fleet" if ns.fleet else "")
+                 + ("+decode" if ns.decode else "")
                  + ("+perfproxy" if ns.perfproxy else "")
                  + ("+concurrency" if ns.concurrency else "")),
         "lint_ok": lint_ok,
@@ -527,6 +550,8 @@ def main(argv=None):
         "artifacts_run": bool(ns.artifacts),
         "fleet_ok": fleet_ok,
         "fleet_run": bool(ns.fleet),
+        "decode_ok": decode_ok,
+        "decode_run": bool(ns.decode),
         "perfproxy_ok": perfproxy_ok,
         "perfproxy_run": bool(ns.perfproxy),
         "concurrency_ok": concurrency_ok,
@@ -537,8 +562,8 @@ def main(argv=None):
     print(json.dumps(summary))
     if not (lint_ok and audit_ok and tests_ok and chaos_ok
             and serving_ok and serving_chaos_ok and elastic_ok
-            and artifacts_ok and fleet_ok and perfproxy_ok
-            and concurrency_ok):
+            and artifacts_ok and fleet_ok and decode_ok
+            and perfproxy_ok and concurrency_ok):
         print("ci_gate: FAILED", file=sys.stderr)
         return 1
     return 0
