@@ -932,6 +932,18 @@ def _serving_fixture(smoke):
     paddle.jit.save(model, prefix,
                     input_spec=[InputSpec([None, hidden], "float32")])
 
+    def make_quant_prefix(mode):
+        """The same seeded model, jit-saved under a serving quant mode
+        (the coldstart bench's quant phase serves this)."""
+        paddle.seed(0)
+        qm = ServeMLP()
+        qm.eval()
+        qprefix = os.path.join(tempfile.mkdtemp(), f"serving_mlp_{mode}")
+        paddle.jit.save(qm, qprefix,
+                        input_spec=[InputSpec([None, hidden], "float32")],
+                        quant=mode)
+        return qprefix
+
     x = np.random.RandomState(0).randn(1, hidden).astype(np.float32)
     req = struct.pack("<B", 1) + _encode_arrays([x])
     frame = struct.pack("<I", len(req)) + req
@@ -945,7 +957,8 @@ def _serving_fixture(smoke):
     per_proc = [c for c in per_proc if c]
     return SimpleNamespace(clients=clients, secs=secs, hidden=hidden,
                            depth=depth, wait_ms=wait_ms, prefix=prefix,
-                           frame=frame, ctx=ctx, per_proc=per_proc)
+                           frame=frame, ctx=ctx, per_proc=per_proc,
+                           make_quant_prefix=make_quant_prefix)
 
 
 def run_serving(smoke, platform):
@@ -1383,7 +1396,7 @@ def run_coldstart():
     def cmd_frame(cmd):
         return struct.pack("<IB", 1, cmd)
 
-    def phase(name):
+    def phase(name, prefix=None, extra_env=None):
         portfile = os.path.join(tempfile.mkdtemp(), "port")
         repo = os.path.dirname(os.path.abspath(__file__))
         env = dict(os.environ, JAX_PLATFORMS="cpu",
@@ -1392,9 +1405,11 @@ def run_coldstart():
                    + os.environ.get("PYTHONPATH", ""))
         env.pop("PADDLE_TPU_ARTIFACT_DISABLE", None)
         env.pop("JAX_COMPILATION_CACHE_DIR", None)
+        env.pop("PADDLE_TPU_SERVING_QUANT", None)
+        env.update(extra_env or {})
         t0 = time.monotonic()
-        proc = subprocess.Popen([sys.executable, worker, fx.prefix,
-                                 portfile], env=env)
+        proc = subprocess.Popen([sys.executable, worker,
+                                 prefix or fx.prefix, portfile], env=env)
         port, t_first, reply = None, None, None
         try:
             deadline = t0 + timeout_s
@@ -1465,11 +1480,30 @@ def run_coldstart():
 
     cold, cold_reply = phase("cold")
     warm, warm_reply = phase("warm")
+
+    # quant phases (ISSUE 13): the coldstart contract extended to a
+    # QUANTIZED model sharing the same store — the w8 export is a
+    # distinct artifact identity, so its cold phase compiles its own
+    # ladder even though the f32 ladder is already published, and its
+    # warm phase re-warms entirely from the store. The replicas run
+    # with PADDLE_TPU_SERVING_QUANT=w8 declared, so the deployment
+    # knob is exercised end to end against a matching save.
+    quant_prefix = fx.make_quant_prefix("w8")
+    quant_env = {"PADDLE_TPU_SERVING_QUANT": "w8"}
+    quant_cold, quant_cold_reply = phase("quant-cold",
+                                         prefix=quant_prefix,
+                                         extra_env=quant_env)
+    quant_warm, quant_warm_reply = phase("quant-warm",
+                                         prefix=quant_prefix,
+                                         extra_env=quant_env)
+
     n_poisoned = poison_store()
     poisoned, poisoned_reply = phase("poisoned")
 
     replies_equal = (cold_reply == warm_reply == poisoned_reply
                      and cold_reply is not None)
+    quant_replies_equal = (quant_cold_reply == quant_warm_reply
+                           and quant_cold_reply is not None)
     rec = {
         "metric": METRIC,
         "value": warm["t_first_healthy_reply_s"],
@@ -1479,7 +1513,9 @@ def run_coldstart():
                              / max(warm["t_first_healthy_reply_s"], 1e-9),
                              3),
         "store_dir": store_dir,
-        "phases": {"cold": cold, "warm": warm, "poisoned": poisoned},
+        "phases": {"cold": cold, "warm": warm,
+                   "quant_cold": quant_cold, "quant_warm": quant_warm,
+                   "poisoned": poisoned},
         "poisoned_artifacts": int(n_poisoned),
         # the acceptance contract, as first-class fields:
         "warm_zero_engine_compiles": warm["compiles"] == 0
@@ -1487,6 +1523,14 @@ def run_coldstart():
         "poisoned_degraded_inline": poisoned["compiles"] > 0
                                     and poisoned["store_corrupt"] > 0,
         "replies_bitwise_equal": bool(replies_equal),
+        # ISSUE 13: the same contract for a quantized (w8) model — its
+        # cold phase compiled its OWN ladder (the f32 artifacts cannot
+        # satisfy a w8 key), its warm phase loaded everything
+        "quant_mode": "w8",
+        "quant_warm_zero_engine_compiles":
+            quant_warm["compiles"] == 0 and quant_warm["store_loads"] > 0,
+        "quant_cold_compiled_own_ladder": quant_cold["compiles"] > 0,
+        "quant_replies_bitwise_equal": bool(quant_replies_equal),
         "smoke": True,
     }
     return rec
@@ -1797,7 +1841,19 @@ def run_decode_storm():
     fixed-batch one-shot shape). Reports tokens/s and p99 inter-token
     latency per side, then proves the zero-cold-start contract: a
     fresh third replica warms its whole decode-program ladder from the
-    shared artifact store with ZERO inline XLA compiles."""
+    shared artifact store with ZERO inline XLA compiles.
+
+    ``--quant`` (ISSUE 13) additionally runs the quantized serving
+    ladder: per mode (w8, bf16w), a replica serving the SAME toy model
+    under ``DECODE_WORKER_QUANT`` must (a) stream every staggered
+    in-batch sequence bitwise-identical to its solo decode (the
+    determinism contract, proven over the real wire), (b) survive the
+    same storm (tokens/s + p99 A/B vs the f32 continuous side), and
+    (c) re-warm a fresh replica from the shared store with zero inline
+    compiles — quantized artifacts are distinct store identities, so
+    the f32 ladder published earlier can never satisfy them. Also
+    reports the weight-bytes proxy (bytes every decode step streams):
+    the 2-4x bandwidth lever the modes exist for."""
     import shutil
     import tempfile
 
@@ -1805,13 +1861,14 @@ def run_decode_storm():
     # would never fire): repeated CI gate runs must not litter $TMPDIR
     # with 15-program artifact stores
     store_dir = tempfile.mkdtemp(prefix="decode_bench_store_")
+    quant_modes = (("w8", "bf16w") if "--quant" in sys.argv[1:] else ())
     try:
-        return _decode_storm_measure(store_dir)
+        return _decode_storm_measure(store_dir, quant_modes)
     finally:
         shutil.rmtree(store_dir, ignore_errors=True)
 
 
-def _decode_storm_measure(store_dir):
+def _decode_storm_measure(store_dir, quant_modes=()):
     import multiprocessing as mp
     import socket
     import struct
@@ -1831,14 +1888,19 @@ def _decode_storm_measure(store_dir):
            + _encode_decode_opts(new_tokens))
     frame = struct.pack("<I", len(req)) + req
 
-    def spawn_worker(n_slots):
+    def spawn_worker(n_slots, quant=None):
         env = dict(os.environ,
                    JAX_PLATFORMS="cpu",
                    DECODE_WORKER_MAX_SLOTS=str(n_slots),
                    DECODE_WORKER_MAX_SEQ="64",
                    DECODE_WORKER_MAX_PROMPT="8",
                    DECODE_WORKER_WARM="1",
+                   DECODE_WORKER_QUANT=quant or "",
                    PADDLE_TPU_ARTIFACT_DIR=store_dir)
+        # the bench's quant axis is DECODE_WORKER_QUANT alone: an
+        # operator's exported fleet knob must not silently quantize
+        # the f32 baseline/continuous sides of the A/B
+        env.pop("PADDLE_TPU_SERVING_QUANT", None)
         proc = subprocess.Popen(
             [sys.executable,
              os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -1937,6 +1999,126 @@ def _decode_storm_measure(store_dir):
              f"{cold_stats['compiles']} inline compiles "
              f"(store_loads={cold_stats['store_loads']})")
 
+    # ------------------------------------------------- quant ladder
+    def collect_stream(port, p, max_new):
+        """One full streamed decode over the wire -> token list."""
+        from paddle_tpu.inference.server import _decode_arrays
+
+        body = (struct.pack("<B", 1) + _encode_arrays([p])
+                + _encode_decode_opts(max_new))
+        with socket.create_connection(("127.0.0.1", port)) as s:
+            s.sendall(struct.pack("<I", len(body)) + body)
+            chunks = []
+            while True:
+                (blen,) = struct.unpack("<I", _read_all(s, 4))
+                resp = _read_all(s, blen)
+                if len(resp) > 1 and resp[0] in (0, 3):
+                    arrs = _decode_arrays(resp[1:])
+                    if arrs and arrs[0].size:
+                        chunks.append(arrs[0])
+                if resp[0] != 3:
+                    if resp[0] != 0:
+                        fail(f"quant stream ended status {resp[0]}")
+                    return ([int(t) for ch in chunks for t in ch])
+
+    def quant_mode_record(mode):
+        import threading
+
+        from paddle_tpu.quantization.serving import (
+            quantize_decode_model, weight_bytes)
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tests"))
+        from decode_worker import toy_decode_model
+
+        # weight-bytes proxy: what every decode step streams — built
+        # with the SAME env-driven dims the spawned workers use, so
+        # the reported bytes describe the benchmarked replicas
+        f32_model = toy_decode_model(
+            hidden=int(os.environ.get("DECODE_WORKER_HIDDEN", "32")),
+            vocab=int(os.environ.get("DECODE_WORKER_VOCAB", "64")),
+            seed=int(os.environ.get("DECODE_WORKER_SEED", "0")))
+        f32_bytes = weight_bytes(f32_model.params)
+        q_bytes = weight_bytes(
+            quantize_decode_model(f32_model, mode).params)
+
+        # solo oracle per distinct prompt, over the wire (slots=1)
+        short = np.array([2, 7], np.int32)
+        solo_proc, solo_port = spawn_worker(1, quant=mode)
+        try:
+            solo_main = collect_stream(solo_port, prompt, new_tokens)
+            solo_short = collect_stream(solo_port, short, 6)
+        finally:
+            stop_worker(solo_proc, solo_port)
+
+        q_proc, q_port = spawn_worker(slots, quant=mode)
+        try:
+            # bitwise contract through real join/leave: staggered
+            # concurrent streams of two prompt shapes, each must emit
+            # EXACTLY its solo tokens
+            results = [None] * 4
+            plan = [(prompt, new_tokens, solo_main, 0.0),
+                    (short, 6, solo_short, 0.02),
+                    (prompt, new_tokens, solo_main, 0.05),
+                    (short, 6, solo_short, 0.08)]
+
+            def one(i, p, n, delay):
+                time.sleep(delay)
+                results[i] = collect_stream(q_port, p, n)
+
+            threads = [threading.Thread(target=one, args=(i, p, n, d))
+                       for i, (p, n, _, d) in enumerate(plan)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(120)
+            bitwise = all(results[i] == plan[i][2]
+                          for i in range(len(plan)))
+            if not bitwise:
+                fail(f"quant {mode}: in-batch stream != solo decode "
+                     f"(got {results}, want {[p[2] for p in plan]})")
+            q_rate, q_p50, q_p99, q_streams, q_sheds = storm(
+                q_port, f"continuous {mode}")
+        finally:
+            stop_worker(q_proc, q_port)
+
+        # zero-cold-start for the QUANTIZED ladder: quantized programs
+        # are their own store identities — a fresh replica must warm
+        # them all from the store with zero inline compiles
+        qc_proc, qc_port = spawn_worker(slots, quant=mode)
+        try:
+            qc_stats = worker_stats(qc_port)["decode"]
+        finally:
+            stop_worker(qc_proc, qc_port)
+        if qc_stats["compiles"] != 0:
+            fail(f"quant {mode} coldstart contract broken: fresh "
+                 f"replica paid {qc_stats['compiles']} inline compiles "
+                 f"(store_loads={qc_stats['store_loads']})")
+        return {
+            "tokens_per_sec": round(q_rate, 1),
+            "p50_intertoken_ms": round(q_p50, 3),
+            "p99_intertoken_ms": round(q_p99, 3),
+            "streams": q_streams,
+            "shed_count": q_sheds,
+            "bitwise_solo_vs_batch": True,
+            "weight_bytes": int(q_bytes),
+            "weight_bytes_f32": int(f32_bytes),
+            "weight_bytes_ratio": round(f32_bytes / q_bytes, 3),
+            "coldstart_inline_compiles": int(qc_stats["compiles"]),
+            "coldstart_store_loads": int(qc_stats["store_loads"]),
+        }
+
+    quant_records = {}
+    for mode in quant_modes:
+        quant_records[mode] = quant_mode_record(mode)
+        q = quant_records[mode]
+        log(f"quant {mode}: {q['tokens_per_sec']:.0f} tok/s "
+            f"(f32 continuous ran {rate:.0f}), p99 "
+            f"{q['p99_intertoken_ms']:.2f}ms, weight bytes "
+            f"{q['weight_bytes']} vs f32 {q['weight_bytes_f32']} "
+            f"({q['weight_bytes_ratio']:.1f}x), bitwise solo-vs-batch "
+            f"ok, fresh replica {q['coldstart_store_loads']} store "
+            f"loads / {q['coldstart_inline_compiles']} compiles")
+
     speedup = rate / base_rate if base_rate else 0.0
     rec = {
         "metric": METRIC,
@@ -1967,6 +2149,12 @@ def _decode_storm_measure(store_dir):
         "coldstart_store_loads": int(cold_stats["store_loads"]),
         "smoke": True,
     }
+    if quant_records:
+        rec["quant"] = quant_records
+        # A/B vs the f32 continuous side of the same storm
+        for mode, q in quant_records.items():
+            q["tokens_vs_f32"] = (round(q["tokens_per_sec"] / rate, 4)
+                                  if rate else 0.0)
     log(f"continuous batching: {speedup:.2f}x tokens/s vs one-shot, "
         f"p99 inter-token {p99:.1f}ms vs {base_p99:.1f}ms, fresh "
         f"replica warmed {cold_stats['store_loads']} programs with "
@@ -2270,6 +2458,69 @@ def _perfproxy_measure():
     train_info = LEDGER.record("train/step", duration_s=time.time() - t0,
                                compiled=compiled, kind="aot")
 
+    # ---- scenario 4: the quant ladder (ISSUE 13). Per serving quant
+    # mode (w8 / w8a8 / bf16w), jit.save the SAME MLP quantized, warm
+    # the same bucket ladder, and record: exact compile counts, zero
+    # post-warmup compiles, FLOPs, opcode counts, and the
+    # opcode:result_dtype mix. The dtype mix is the load-bearing bit —
+    # a parameter:s8 / parameter:bf16 count proves the reduced-
+    # precision weights actually reached XLA as runtime args (and the
+    # convert/round/clamp ops prove the dequant/act-quant lowered)
+    # instead of silently promoting to f32 somewhere upstream.
+    def _dtype_mix(events):
+        mix = {}
+        for ev in events:
+            for op, n in ev.get("typed_op_counts", {}).items():
+                opname, _, dt = op.partition(":")
+                if (opname in ("parameter", "convert", "dot",
+                               "round-nearest-even", "clamp")
+                        or dt in ("s8", "bf16")):
+                    mix[op] = mix.get(op, 0) + n
+        return mix
+
+    def _calib():
+        crng = np.random.RandomState(7)
+        for _ in range(4):
+            yield crng.randn(4, hidden).astype(np.float32)
+
+    quant_sections = {}
+    for mode in ("w8", "w8a8", "bf16w"):
+        paddle.seed(0)
+        qmodel = ProxyMLP()
+        qmodel.eval()
+        qprefix = os.path.join(tempfile.mkdtemp(), f"perfproxy_{mode}")
+        paddle.jit.save(qmodel, qprefix,
+                        input_spec=[InputSpec([None, hidden], "float32")],
+                        quant=mode,
+                        quant_calib=_calib if mode == "w8a8" else None)
+        qlayer = jit_load(qprefix)
+        # every earlier scenario has captured its numbers: reset so
+        # this mode's "serving/" totals are exactly its own ladder
+        LEDGER.reset()
+        qengine = BatchingEngine.for_layer(
+            qlayer, max_batch_size=max_batch, max_wait_ms=1.0,
+            max_queue=64, watchdog_interval=0, name=f"perfproxy-{mode}")
+        try:
+            qengine.warmup()
+            q_warm = LEDGER.totals("serving/")
+            mix = _dtype_mix(LEDGER.events("serving/"))
+            qrng = np.random.RandomState(0)
+            for rows in (1, 3, max_batch):
+                qengine.infer([qrng.randn(rows, hidden)
+                               .astype(np.float32)], timeout=60)
+            q_post = LEDGER.totals("serving/")["compiles"] \
+                - q_warm["compiles"]
+        finally:
+            qengine.close()
+        quant_sections[mode] = {
+            "warmup_compiles": int(q_warm["compiles"]),
+            "post_warmup_compiles": int(q_post),
+            "flops": q_warm["flops"],
+            "n_ops": int(q_warm["n_ops"]),
+            "op_counts": q_warm["op_counts"],
+            "dtype_mix": mix,
+        }
+
     return {
         "jax": jax.__version__,
         "serving": {
@@ -2295,6 +2546,7 @@ def _perfproxy_measure():
             "op_counts": train_info.get("op_counts", {}),
             "fingerprint": train_info.get("fingerprint", ""),
         },
+        "quant": quant_sections,
     }
 
 
@@ -2363,6 +2615,33 @@ def _perfproxy_compare(measured, baseline, flop_tol, op_tol):
     chk("train_step.flops", m_t["flops"], b_t["flops"], flop_tol)
     chk("train_step.n_ops", m_t["n_ops"], b_t["n_ops"], op_tol)
     chk_ops("train_step.op_counts", m_t["op_counts"], b_t["op_counts"])
+    m_q = measured.get("quant") or {}
+    b_q = baseline.get("quant")
+    if b_q is None:
+        # a baseline predating the quant ladder cannot green-light it
+        checks.append({"check": "quant.baseline_present", "measured": 1,
+                       "baseline": 0, "tol": None, "ok": False})
+    else:
+        for mode in sorted(b_q):
+            mm = m_q.get(mode, {})
+            bm = b_q[mode]
+            chk(f"quant.{mode}.warmup_compiles",
+                mm.get("warmup_compiles", -1), bm["warmup_compiles"])
+            chk(f"quant.{mode}.post_warmup_compiles",
+                mm.get("post_warmup_compiles", -1),
+                bm["post_warmup_compiles"])
+            chk(f"quant.{mode}.flops", mm.get("flops", 0.0),
+                bm["flops"], flop_tol)
+            chk(f"quant.{mode}.n_ops", mm.get("n_ops", 0),
+                bm["n_ops"], op_tol)
+            chk_ops(f"quant.{mode}.op_counts", mm.get("op_counts", {}),
+                    bm["op_counts"])
+            # the reduced-precision proof: parameter:s8/parameter:bf16
+            # and the convert/round/clamp lattice ops must stay in the
+            # HLO — their disappearance means a mode silently promoted
+            # back to f32 (chk_ops fails on any opcode vanishing)
+            chk_ops(f"quant.{mode}.dtype_mix", mm.get("dtype_mix", {}),
+                    bm["dtype_mix"])
 
     notes = []
     for b in sorted(b_s["buckets"], key=int):

@@ -404,6 +404,11 @@ class AotLayerRunner:
         self._store = store if store is not None \
             else _artifacts.default_store()
         self._fingerprint = getattr(layer, "_model_fingerprint", None)
+        # serving quant mode the layer was jit-saved under (None = f32):
+        # rides in every ArtifactKey (quantized programs are distinct
+        # store identities), every ledger event, and the engine's
+        # compile metrics — a mixed-precision fleet stays observable
+        self.quant_mode = getattr(layer, "_quant_mode", None)
         self._warmup_wait_s = _env_float(
             "PADDLE_TPU_ARTIFACT_WARMUP_WAIT_S", 120.0)
         specs = getattr(layer, "_input_specs", None) or []
@@ -451,7 +456,8 @@ class AotLayerRunner:
 
     def _artifact_key(self, bucket, sig):
         return _artifacts.ArtifactKey(self._fingerprint, bucket, sig,
-                                      mesh="single")
+                                      mesh="single",
+                                      quant=self.quant_mode)
 
     def _bucket_state(self, bucket, sig):
         """(flat_fn, param_arrays, buffer_arrays, specs, donate) for one
@@ -513,7 +519,8 @@ class AotLayerRunner:
                           kind="aot",
                           extra={"bucket": bucket, "via": "export",
                                  "signature": [[dt, list(tr)]
-                                               for dt, tr in sig]})
+                                               for dt, tr in sig],
+                                 **self._quant_extra()})
             return blob, run
 
         return store_backed_compile(
@@ -584,7 +591,8 @@ class AotLayerRunner:
                       extra={"bucket": bucket,
                              "artifact": key.digest(),
                              "signature": [[dt, list(tr)]
-                                           for dt, tr in sig]})
+                                           for dt, tr in sig],
+                             **self._quant_extra()})
         return run
 
     def _export(self, bucket, sig, state=None):
@@ -608,6 +616,12 @@ class AotLayerRunner:
     def _export_bytes(self, bucket, sig):
         """Serialized form of :meth:`_export` (the published payload)."""
         return serialize_exported(self._export(bucket, sig))
+
+    def _quant_extra(self):
+        """Ledger-event mode tag. Empty for f32, so every historical
+        event shape (and the committed perfproxy baseline's f32
+        sections) stays byte-identical."""
+        return {"quant": self.quant_mode} if self.quant_mode else {}
 
     def store_stats(self):
         store = self._active_store()
@@ -641,7 +655,8 @@ class AotLayerRunner:
                       kind="aot",
                       extra={"bucket": bucket,
                              "signature": [[dt, list(tr)]
-                                           for dt, tr in sig]})
+                                           for dt, tr in sig],
+                             **self._quant_extra()})
 
         def run(batch_arrays):
             out = compiled(param_arrays, buffer_arrays, *batch_arrays)
@@ -829,12 +844,17 @@ class BatchingEngine:
         self._m_restarts = M.Counter(
             "paddle_serving_scheduler_restarts_total",
             "Watchdog scheduler restarts", const_labels=cl)
+        # quant rides as a const label (it is a property of the served
+        # model, not of an individual compile): a mixed-precision fleet
+        # shows per-mode compile/store-load series on one dashboard
+        quant = getattr(self._runner, "quant_mode", None) or "f32"
         self._m_compiles = M.Counter(
             "paddle_serving_compiles_total",
             "Bucket program materializations (source: inline = a real "
             "XLA compile; store = deserialized from the persistent "
-            "artifact store)", labelnames=("bucket", "source"),
-            const_labels=cl)
+            "artifact store; quant: the serving quantization mode)",
+            labelnames=("bucket", "source"),
+            const_labels={**cl, "quant": quant})
         self._m_batches = M.Counter(
             "paddle_serving_batches_total",
             "Batches executed", labelnames=("bucket",), const_labels=cl)
@@ -1616,6 +1636,7 @@ class BatchingEngine:
             states = [br.state for br in self._breakers.values()]
             return {
                 "name": self.name,
+                "quant": getattr(self._runner, "quant_mode", None) or "f32",
                 "max_batch_size": self.max_batch_size,
                 "max_wait_ms": round(self.max_wait_s * 1000.0, 3),
                 "max_queue": self.max_queue,

@@ -101,6 +101,7 @@ Env knobs (constructor kwargs override):
                                        (default max_seq_len)
     (breaker/watchdog knobs: the PADDLE_TPU_SERVING_* family)
 """
+import os
 import threading
 import time
 import traceback
@@ -149,10 +150,18 @@ class DecodeModel:
     ``fingerprint``: content identity for the artifact store. Default:
     computed lazily (sha256 of the step program's serialized export at
     a canonical shape — same identity rule as jit.save: the traced
-    computation + avals, never the weight values)."""
+    computation + avals, never the weight values).
+
+    ``quant``: the serving quantization mode the params/functions were
+    built under (``quantization.quantize_decode_model`` sets it;
+    None = f32). It rides in every program ArtifactKey, ledger event,
+    and compile metric, and folds into the lazy fingerprint — a
+    quantized decode ladder never collides with the f32 one in the
+    artifact store."""
 
     def __init__(self, params, prefill_fn, step_fn, kv_spec, vocab_size,
-                 feature_spec=(), eos_token_id=None, fingerprint=None):
+                 feature_spec=(), eos_token_id=None, fingerprint=None,
+                 quant=None):
         self.params = list(params)
         self.prefill_fn = prefill_fn
         self.step_fn = step_fn
@@ -164,6 +173,7 @@ class DecodeModel:
         self.eos_token_id = (None if eos_token_id is None
                              else int(eos_token_id))
         self._fingerprint = fingerprint
+        self.quant = quant
 
 
 class _Programs:
@@ -199,7 +209,8 @@ class _Programs:
                     try:
                         blob = serialize_exported(
                             self._export("step", 2, 8))
-                        m._fingerprint = model_fingerprint(blob)
+                        m._fingerprint = model_fingerprint(
+                            blob, quant=getattr(m, "quant", None))
                     except Exception:  # noqa: BLE001 - store-less fallback
                         m._fingerprint = False
         return m._fingerprint or None
@@ -210,6 +221,13 @@ class _Programs:
         if self._fingerprint() is None:
             return None
         return self._store
+
+    def _quant_extra(self):
+        """Ledger-event mode tag (empty for f32 — historical event
+        shapes and the committed perfproxy decode section stay
+        byte-identical)."""
+        q = getattr(self._model, "quant", None)
+        return {"quant": q} if q else {}
 
     def _artifact_key(self, phase, rows, seq):
         # the phase + seq bucket ride in the signature (the ArtifactKey
@@ -222,7 +240,8 @@ class _Programs:
         sig += tuple((str(dt), tr) for tr, dt in m.feature_spec)
         sig += ((f"vocab{m.vocab_size}", ()),)
         return _artifacts.ArtifactKey(self._fingerprint(), int(rows), sig,
-                                      mesh="single")
+                                      mesh="single",
+                                      quant=getattr(m, "quant", None))
 
     # ------------------------------------------------------------- shapes
     def _in_specs(self, phase, rows, seq):
@@ -352,7 +371,8 @@ class _Programs:
         LEDGER.record(f"decode/{phase}{rows}x{seq}",
                       duration_s=time.monotonic() - t0, compiled=compiled,
                       kind="aot",
-                      extra={"phase": phase, "bucket": rows, "seq": seq})
+                      extra={"phase": phase, "bucket": rows, "seq": seq,
+                             **self._quant_extra()})
 
         def run(batch):
             out = compiled(param_arrays, *batch)
@@ -379,7 +399,8 @@ class _Programs:
             LEDGER.record(f"decode/{phase}{rows}x{seq}",
                           duration_s=time.monotonic() - t0, kind="aot",
                           extra={"phase": phase, "bucket": rows,
-                                 "seq": seq, "via": "export"})
+                                 "seq": seq, "via": "export",
+                                 **self._quant_extra()})
             return blob, run
 
         def run_from_payload(payload):
@@ -393,7 +414,8 @@ class _Programs:
             LEDGER.record(f"decode/{phase}{rows}x{seq}",
                           duration_s=time.monotonic() - t0, kind="store",
                           extra={"phase": phase, "bucket": rows,
-                                 "seq": seq, "artifact": key.digest()})
+                                 "seq": seq, "artifact": key.digest(),
+                                 **self._quant_extra()})
             return run
 
         return store_backed_compile(
@@ -630,7 +652,25 @@ class DecodeEngine:
                  max_queue=None, min_seq_bucket=None, max_prompt_len=None,
                  default_max_new_tokens=None, name="decode", store=None,
                  breaker_threshold=None, breaker_cooldown=None,
-                 watchdog_interval=None, wedge_timeout=None):
+                 watchdog_interval=None, wedge_timeout=None, quant=None):
+        # quant: serve this model under a quantization mode ("w8" |
+        # "bf16w"; env default PADDLE_TPU_SERVING_QUANT — the one-knob
+        # fleet flip). An unquantized model is wrapped via
+        # quantization.quantize_decode_model; a model ALREADY carrying
+        # a mode must match the request (a replica told to serve w8
+        # must never silently serve something else).
+        if quant is None:
+            quant = os.environ.get("PADDLE_TPU_SERVING_QUANT") or None
+        model_quant = getattr(model, "quant", None)
+        if quant is not None and quant != (model_quant or "f32"):
+            if model_quant is not None:
+                raise ValueError(
+                    f"model is quantized as {model_quant!r} but the "
+                    f"engine was asked to serve {quant!r}")
+            if quant != "f32":
+                from ..quantization.serving import quantize_decode_model
+
+                model = quantize_decode_model(model, quant)
         self._model = model
         self.max_slots = int(
             max_slots if max_slots is not None
@@ -739,8 +779,10 @@ class DecodeEngine:
         self._m_compiles = M.Counter(
             "paddle_decode_compiles_total",
             "Program materializations (source: inline = real XLA "
-            "compile, store = artifact-store load)",
-            labelnames=("phase", "source"), const_labels=cl)
+            "compile, store = artifact-store load; quant: the serving "
+            "quantization mode)",
+            labelnames=("phase", "source"),
+            const_labels={**cl, "quant": getattr(self._model, "quant", None) or "f32"})
         self._m_steps = M.Counter(
             "paddle_decode_steps_total",
             "Model program dispatches, by phase",
@@ -1388,6 +1430,7 @@ class DecodeEngine:
                 programs[f"{phase}{rows}x{seq_b}"] = d
             return {
                 "name": self.name,
+                "quant": getattr(self._model, "quant", None) or "f32",
                 "max_slots": self.max_slots,
                 "max_seq_len": self.max_seq_len,
                 "max_queue": self.max_queue,

@@ -848,7 +848,8 @@ class PredictorServer:
 
 def serve_model(path_prefix, port=0, dynamic_batching=False,
                 max_batch_size=32, max_wait_ms=2.0, max_queue=256,
-                warmup=True, metrics_port=None, **engine_kwargs):
+                warmup=True, metrics_port=None, quant=None,
+                **engine_kwargs):
     """Load a jit-saved model and serve it (the C API's server side).
 
     With ``dynamic_batching=True`` (needs a batch-polymorphic save, see
@@ -875,13 +876,40 @@ def serve_model(path_prefix, port=0, dynamic_batching=False,
     with the server (``server.metrics_server.port`` has the bound
     port).
 
+    ``quant`` (env default ``PADDLE_TPU_SERVING_QUANT``) declares the
+    serving quantization mode this replica MUST serve (``"f32"`` |
+    ``"w8"`` | ``"w8a8"`` | ``"bf16w"``): the loaded model's recorded
+    mode (jit.save's ``quant=`` sidecar field) is checked at load time
+    — and on every hot reload — so a fleet flipped to w8 can never
+    silently serve an f32 save (or vice versa). Unset = serve whatever
+    the save recorded.
+
     The returned server supports the ``reload`` wire command (cmd 4):
     re-save the model to the same (or a new) prefix and issue a reload
     to hot-swap weights with zero dropped requests."""
     from ..jit import load as jit_load
 
+    if quant is None:
+        quant = os.environ.get("PADDLE_TPU_SERVING_QUANT") or None
+    if quant not in (None, "f32"):
+        # fail at entry with the valid mode set — a typo'd deployment
+        # knob ('W8', 'int8') must not surface later as a misleading
+        # "re-save your model" mismatch error
+        from ..quantization.serving import check_mode
+
+        check_mode(quant)
+
     def loader(prefix):
         layer = jit_load(prefix)
+        if quant is not None:
+            have = getattr(layer, "_quant_mode", None) or "f32"
+            if have != quant:
+                raise ValueError(
+                    f"{prefix}: saved quant mode {have!r} does not "
+                    f"match the declared serving mode {quant!r} "
+                    "(PADDLE_TPU_SERVING_QUANT / serve_model(quant=)); "
+                    "re-save with jit.save(..., quant=...) or fix the "
+                    "deployment knob")
 
         def run(*arrays):
             out = layer(*arrays)

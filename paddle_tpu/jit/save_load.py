@@ -77,22 +77,52 @@ def _build_input_specs(input_spec, polymorphic):
     return candidates, True
 
 
-def save(layer, path, input_spec=None, **configs):
+def save(layer, path, input_spec=None, quant=None, quant_calib=None,
+         **configs):
     """paddle.jit.save — export layer.forward at the given input spec.
 
     Dims given as None/-1 are exported batch-polymorphically (symbolic
     shapes) when the model traces under them, so the saved StableHLO can
     be run — and AOT-compiled per shape bucket by the serving engine —
     at any concrete size. Models that cannot trace symbolically fall
-    back to the old behavior (dynamic dims pinned to 1)."""
+    back to the old behavior (dynamic dims pinned to 1).
+
+    ``quant`` exports a QUANTIZED serving artifact (README "Quantized
+    serving"): ``"w8"`` freezes every Linear/Conv2D to int8 weights +
+    per-channel scales (in place, like ``quantization.quantize_weights``
+    — the reference's slim/PTQ flow folded into the save); ``"w8a8"``
+    additionally calibrates activation scales by running ``quant_calib``
+    (a sample-batch generator) and bakes them in; ``"bf16w"`` stores
+    f32 params as bf16 and upcasts inside the traced program (f32
+    accumulate). The mode is recorded in ``.pdmeta.json`` and folded
+    into the model fingerprint, so quantized programs are distinct
+    artifact-store identities — they persist, single-flight, and
+    cold-start-free across a replica fleet exactly like f32 ones."""
     if input_spec is None:
         raise ValueError("jit.save requires input_spec (list of InputSpec or Tensors)")
+    from ..quantization.serving import quantize_for_serving
+
+    layer, quant_meta = quantize_for_serving(layer, quant,
+                                             calib=quant_calib)
+    # the RESOLVED mode: an already-in-place-quantized model (e.g. a
+    # prior quant save of the same object, or PTQ's save flow) is
+    # detected and recorded as what it IS — never stamped f32
+    quant = quant_meta["mode"] if quant_meta else None
     spec_candidates, polymorphic = _build_input_specs(input_spec,
                                                       polymorphic=True)
     specs = spec_candidates[0]
 
     layer.eval()
     params, buffers = layer.functional_state()
+    if quant == "bf16w":
+        # the stored/streamed weights are bf16 (half the bytes the
+        # decode hot path reads per token); the traced fn upcasts to
+        # f32 below, so compute accumulates in f32 and the exported
+        # program carries the convert ops perfproxy's quant section
+        # asserts on
+        params = {n: a.astype(jnp.bfloat16)
+                  if np.dtype(a.dtype) == np.dtype(np.float32) else a
+                  for n, a in params.items()}
     param_names = list(params)
     buffer_names = list(buffers)
 
@@ -105,6 +135,12 @@ def save(layer, path, input_spec=None, **configs):
     def infer_fn(param_list, buffer_list, *inputs):
         saved_p = {n: p._value for n, p in layer.named_parameters()}
         saved_b = dict(zip(buffer_names, [buffers[n] for n in buffer_names]))
+        if quant == "bf16w":
+            # dequantize-into-compute: runtime args stay bf16, the
+            # program converts once and accumulates in f32
+            param_list = [p.astype(jnp.float32)
+                          if p.dtype == jnp.bfloat16 else p
+                          for p in param_list]
         try:
             with dispatch.trace_mode():
                 layer.load_functional_state(dict(zip(param_names, param_list)),
@@ -123,7 +159,8 @@ def save(layer, path, input_spec=None, **configs):
     write_artifacts(path, jitted, (param_specs, buffer_specs), specs,
                     {n: np.asarray(a) for n, a in params.items()},
                     {n: np.asarray(a) for n, a in buffers.items()},
-                    spec_candidates=spec_candidates)
+                    spec_candidates=spec_candidates,
+                    quant=quant, quant_meta=quant_meta)
 
 
 def _is_symbolic_dim(d):
@@ -137,7 +174,8 @@ def _json_spec(s):
 
 
 def write_artifacts(path, jitted_fn, state_specs, input_specs, params,
-                    buffers, spec_candidates=None):
+                    buffers, spec_candidates=None, quant=None,
+                    quant_meta=None):
     """Serialize the single on-disk model format (<prefix>.pdmodel StableHLO +
     .pdiparams npz + .pdmeta.json sidecar) shared by jit.save and
     static.save_inference_model. ``jitted_fn(params_like, buffers_like,
@@ -179,8 +217,9 @@ def write_artifacts(path, jitted_fn, state_specs, input_specs, params,
             payload["polymorphic"] = poly
             # content identity of the exported program (weights are
             # runtime args): the serving engine keys its persistent
-            # compiled-artifact store on this
-            payload["fingerprint"] = model_fingerprint(blob)
+            # compiled-artifact store on this. The quant mode folds in,
+            # so quantized programs are distinct store identities.
+            payload["fingerprint"] = model_fingerprint(blob, quant=quant)
             # record the shapes actually exported (symbolic dims
             # serialize as None; pinned dims as 1 on the fallback)
             payload["input_specs"] = [_json_spec(s) for s in specs]
@@ -215,6 +254,11 @@ def write_artifacts(path, jitted_fn, state_specs, input_specs, params,
                    "polymorphic": payload.get("polymorphic", False),
                    "fingerprint": payload.get("fingerprint"),
                    "op_versions": payload["op_versions"],
+                   # serving quant mode (None = f32) + its scale
+                   # metadata: jit.load re-folds the mode into the
+                   # fingerprint it computes from the module bytes
+                   "quant": quant,
+                   "quant_meta": quant_meta,
                    "export_error": payload.get("export_error")}, f)
 
 
@@ -222,7 +266,7 @@ class TranslatedLayer(Layer):
     """Loaded inference layer (reference: dygraph/io.py TranslatedLayer)."""
 
     def __init__(self, call_fn, params, buffers, input_specs=None,
-                 polymorphic=False, fingerprint=None):
+                 polymorphic=False, fingerprint=None, quant=None):
         super().__init__()
         self._call_fn = call_fn
         self._loaded_params = params
@@ -235,6 +279,10 @@ class TranslatedLayer(Layer):
         # identity the serving engine's artifact store keys on; None
         # disables the store for engines over this layer
         self._model_fingerprint = fingerprint
+        # serving quant mode the model was exported under (None = f32):
+        # threaded into engine ArtifactKeys, compile metrics, and
+        # ledger events so a mixed-precision fleet is observable
+        self._quant_mode = quant
         for i, (n, a) in enumerate(params.items()):
             from ..core.tensor import Parameter
 
@@ -291,11 +339,16 @@ def load(path, **configs):
 
         # computed from the bytes (not trusted from the sidecar): old
         # saves without a recorded fingerprint still key the artifact
-        # store correctly
+        # store correctly. The quant mode re-folds into the hash, so a
+        # quantized load carries the same distinct identity its save
+        # recorded.
+        quant = payload.get("quant")
         return TranslatedLayer(call_fn, params, buffers,
                                input_specs=payload.get("input_specs", []),
                                polymorphic=payload.get("polymorphic", False),
-                               fingerprint=model_fingerprint(blob))
+                               fingerprint=model_fingerprint(blob,
+                                                             quant=quant),
+                               quant=quant)
     raise RuntimeError(
         f"model at {path} was saved without a serialized program "
         f"({payload.get('export_error')}); re-save with a supported spec")

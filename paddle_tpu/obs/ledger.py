@@ -61,18 +61,39 @@ def _strip_hlo_type(rhs):
     return parts[1] if len(parts) > 1 else ""
 
 
-def hlo_opcodes(hlo_text):
-    """Ordered opcode sequence of every instruction in an HLO module
-    text dump (computation headers and metadata lines are skipped)."""
+def hlo_typed_opcodes(hlo_text):
+    """Ordered ``opcode:result_dtype`` sequence of every instruction in
+    an HLO module text dump (computation headers and metadata lines are
+    skipped) — ``convert:f32``, ``parameter:s8``, ``dot:f32``;
+    tuple-typed results report ``tuple``. The ONE parsing pass: the
+    untyped view is a projection (:func:`hlo_opcodes`). The dtype
+    dimension is what the quant-ladder perfproxy section gates on: a
+    ``parameter:s8`` / ``parameter:bf16`` count proves
+    reduced-precision weights actually reached XLA instead of silently
+    promoting to f32 upstream of the lowering."""
     ops = []
     for line in hlo_text.splitlines():
         if " = " not in line:
             continue
-        rhs = _strip_hlo_type(line.split(" = ", 1)[1])
+        rhs_full = line.split(" = ", 1)[1].lstrip()
+        if rhs_full.startswith("("):
+            dtype = "tuple"
+        else:
+            head = rhs_full.split(None, 1)[0]
+            dtype = head.split("[", 1)[0]
+        rhs = _strip_hlo_type(rhs_full)
         m = _OPCODE_RE.match(rhs)
         if m and "(" in rhs[m.end():m.end() + 1]:
-            ops.append(m.group(0))
+            ops.append(f"{m.group(0)}:{dtype}")
     return ops
+
+
+def hlo_opcodes(hlo_text):
+    """Ordered opcode sequence of every instruction in an HLO module
+    text dump — the dtype-less projection of
+    :func:`hlo_typed_opcodes` (opcode names never contain ``:``), so
+    there is exactly one parser to maintain."""
+    return [op.partition(":")[0] for op in hlo_typed_opcodes(hlo_text)]
 
 
 def hlo_fingerprint(opcodes):
@@ -105,13 +126,25 @@ def analyze_compiled(compiled):
     except Exception:  # noqa: BLE001 — introspection is best-effort
         pass
     try:
-        ops = hlo_opcodes(compiled.as_text())
+        # ONE parse of the HLO text; the untyped view (op_counts,
+        # n_ops, the structural fingerprint — all byte-compatible with
+        # pre-quant baselines) is a projection of the typed sequence
+        typed_ops = hlo_typed_opcodes(compiled.as_text())
+        ops = [op.partition(":")[0] for op in typed_ops]
         counts = {}
         for op in ops:
             counts[op] = counts.get(op, 0) + 1
         out["op_counts"] = counts
         out["n_ops"] = len(ops)
         out["fingerprint"] = hlo_fingerprint(ops)
+        typed = {}
+        for op in typed_ops:
+            typed[op] = typed.get(op, 0) + 1
+        # opcode:result_dtype counts — the reduced-precision evidence
+        # (parameter:s8 / parameter:bf16 / convert:f32) the quant
+        # perfproxy section diffs; untyped totals stay the gate for
+        # everything else
+        out["typed_op_counts"] = typed
     except Exception:  # noqa: BLE001
         pass
     return out

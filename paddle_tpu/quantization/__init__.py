@@ -14,9 +14,18 @@ dequant (the MXU path), matching how int8 serving works under XLA.
 from .imperative import (
     ImperativeQuantAware, QuantedConv2D, QuantedLinear, fake_quant,
 )
-from .post_training import PostTrainingQuantization, quantize_weights
+from .post_training import (
+    Int8Conv2D, Int8Linear, PostTrainingQuantization, quantize_weights,
+)
+from .serving import (
+    ACCURACY_BOUNDS, QUANT_MODES, quantize_decode_model,
+    quantize_for_serving,
+)
 
 __all__ = [
     "ImperativeQuantAware", "QuantedLinear", "QuantedConv2D", "fake_quant",
     "PostTrainingQuantization", "quantize_weights",
+    "Int8Linear", "Int8Conv2D",
+    "QUANT_MODES", "ACCURACY_BOUNDS", "quantize_for_serving",
+    "quantize_decode_model",
 ]

@@ -16,30 +16,59 @@ from ..core.tensor import Tensor
 from ..nn import functional as F
 
 
+def _quant_act(x, scale):
+    """Quantize-dequantize an activation onto the int8 grid at a FIXED
+    calibrated scale (the w8a8 serving semantics: the round/clamp bakes
+    into the exported program, so XLA sees the int8 value lattice and a
+    backend with int8 GEMMs can fuse the pair into true int8 compute)."""
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    s = jnp.maximum(jnp.asarray(scale, jnp.float32), 1e-9) / 127.0
+    xq = jnp.clip(jnp.round(xv / s), -127.0, 127.0) * s
+    return Tensor(xq, stop_gradient=True)
+
+
 class Int8Linear(nn.Layer):
     """Weight-only int8 linear: int8 weight + per-out-channel fp32 scale,
-    dequantized at compute (XLA fuses the dequant into the matmul read)."""
+    dequantized at compute (XLA fuses the dequant into the matmul read).
+    With ``act_scale`` (a calibrated scalar from
+    :class:`PostTrainingQuantization`) the input is additionally
+    quantize-dequantized onto the int8 grid — the w8a8 serving mode."""
 
-    def __init__(self, qweight, scale, bias):
+    def __init__(self, qweight, scale, bias, act_scale=None):
         super().__init__()
         self.register_buffer("qweight", jnp.asarray(qweight, jnp.int8))
         self.register_buffer("w_scale", jnp.asarray(scale, jnp.float32))
+        if act_scale is not None:
+            self.register_buffer("act_scale",
+                                 jnp.asarray(act_scale, jnp.float32))
+        else:
+            self.act_scale = None
         self.bias = bias
 
     def forward(self, x):
+        if self.act_scale is not None:
+            x = _quant_act(x, self.act_scale)
         w = self.qweight.astype(jnp.float32) * self.w_scale[None, :]
         return F.linear(x, Tensor(w, stop_gradient=True), self.bias)
 
 
 class Int8Conv2D(nn.Layer):
-    def __init__(self, qweight, scale, bias, stride, padding, dilation, groups):
+    def __init__(self, qweight, scale, bias, stride, padding, dilation, groups,
+                 act_scale=None):
         super().__init__()
         self.register_buffer("qweight", jnp.asarray(qweight, jnp.int8))
         self.register_buffer("w_scale", jnp.asarray(scale, jnp.float32))
+        if act_scale is not None:
+            self.register_buffer("act_scale",
+                                 jnp.asarray(act_scale, jnp.float32))
+        else:
+            self.act_scale = None
         self.bias = bias
         self._conv_args = (stride, padding, dilation, groups)
 
     def forward(self, x):
+        if self.act_scale is not None:
+            x = _quant_act(x, self.act_scale)
         w = self.qweight.astype(jnp.float32) * \
             self.w_scale[:, None, None, None]
         return F.conv2d(x, Tensor(w, stop_gradient=True), self.bias,
@@ -47,6 +76,16 @@ class Int8Conv2D(nn.Layer):
 
 
 def _quantize_array(w, channel_axis):
+    """Symmetric int8 quantization of ``w``. ``channel_axis`` selects
+    per-channel scales (one abs-max per slice along that axis — the out
+    axis: 1 for Linear's [in, out], 0 for Conv's OIHW); ``None`` means
+    one per-tensor scale (strictly worse reconstruction whenever the
+    channels' ranges differ — the regression tests pin the gap)."""
+    if channel_axis is None:
+        amax = np.max(np.abs(w))
+        scale = np.maximum(amax, 1e-9) / 127.0
+        q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+        return q, np.float32(scale)
     axes = tuple(i for i in range(w.ndim) if i != channel_axis)
     amax = np.max(np.abs(w), axis=axes)
     scale = np.maximum(amax, 1e-9) / 127.0
@@ -56,10 +95,17 @@ def _quantize_array(w, channel_axis):
     return q, scale.astype(np.float32)
 
 
-def quantize_weights(model):
+def quantize_weights(model, act_scales=None):
     """In-place weight-only int8 conversion of every Linear/Conv2D.
-    Returns (model, stats dict name->scale)."""
+    Returns (model, stats dict name->scale).
+
+    ``act_scales``: optional dict of calibrated per-layer activation
+    abs-max values keyed by the layer's dotted sublayer name (what
+    :meth:`PostTrainingQuantization.activation_scales` returns). Layers
+    with an entry become w8a8 — their input is quantize-dequantized at
+    the fixed calibrated scale; layers without stay weight-only."""
     stats = {}
+    act_scales = act_scales or {}
 
     def _walk(layer, prefix=""):
         from .imperative import _QuantedBase
@@ -73,14 +119,15 @@ def quantize_weights(model):
             if isinstance(sub, nn.Linear):
                 w = np.asarray(sub.weight._value)
                 q, s = _quantize_array(w, channel_axis=1)
-                layer._sub_layers[name] = Int8Linear(q, s, sub.bias)
+                layer._sub_layers[name] = Int8Linear(
+                    q, s, sub.bias, act_scale=act_scales.get(full))
                 stats[full] = s
             elif isinstance(sub, nn.Conv2D):
                 w = np.asarray(sub.weight._value)
                 q, s = _quantize_array(w, channel_axis=0)
                 layer._sub_layers[name] = Int8Conv2D(
                     q, s, sub.bias, sub._stride, sub._padding, sub._dilation,
-                    sub._groups)
+                    sub._groups, act_scale=act_scales.get(full))
                 stats[full] = s
             else:
                 _walk(sub, full + ".")
@@ -144,10 +191,20 @@ class PostTrainingQuantization:
         if self._algo == "avg":
             self._act_scales = {k: v[0] for k, v in scales.items()}
 
-    def quantize(self):
+    def quantize(self, act_quant=False):
+        """Calibrate (when a sample generator was given) and freeze int8
+        weights. ``act_quant=True`` additionally bakes the calibrated
+        activation scales into the quantized layers (w8a8): each
+        quantizable layer's input is quantize-dequantized at its frozen
+        calibration abs-max — requires a sample generator."""
         if self._samples is not None:
             self._calibrate()
-        self._quantized, self._weight_scales = quantize_weights(self._model)
+        elif act_quant:
+            raise ValueError(
+                "act_quant needs calibrated activation scales: construct "
+                "PostTrainingQuantization with a sample_generator")
+        self._quantized, self._weight_scales = quantize_weights(
+            self._model, act_scales=self._act_scales if act_quant else None)
         return self._quantized
 
     @property

@@ -44,6 +44,11 @@ Key schema (``ArtifactKey`` -> sha256 digest -> ``art-<digest>/``)::
     mesh       device-mesh identity ("single" for one-chip serving)
     version    jax/jaxlib/backend triple (serialize.export
                .runtime_version) — artifacts never cross runtimes
+    quant      serving quantization mode ("f32" default, omitted from
+               the canonical form so historical digests are stable;
+               "w8" / "w8a8" / "bf16w") — a quant-mode skew is a
+               clean miss, a w8 program is never served to an f32
+               request
 
 On-disk layout (mirrors resilience/checkpoint.py, which proved the
 pattern)::
@@ -155,12 +160,24 @@ class ArtifactKey:
     """Everything a compiled program's identity depends on. Weights are
     runtime arguments, so they are deliberately NOT part of the key —
     a re-save of the same architecture with new weights reuses the
-    same artifacts."""
+    same artifacts.
 
-    __slots__ = ("model", "bucket", "signature", "mesh", "version")
+    ``quant`` names the serving quantization mode the program was
+    exported under (``"f32"`` default; ``"w8"`` / ``"w8a8"`` /
+    ``"bf16w"``). The model fingerprint already folds the mode in
+    (serialize.export.model_fingerprint), but the key carries it
+    EXPLICITLY as well: a quant-mode skew is a clean miss by key
+    construction — a w8 artifact can never be served to an f32 request
+    even if the fingerprints were ever to collide — and the manifest's
+    recorded key makes the mode auditable on disk. ``"f32"`` is
+    omitted from the canonical form so every pre-quantization digest
+    (and on-disk manifest) stays byte-identical."""
+
+    __slots__ = ("model", "bucket", "signature", "mesh", "version",
+                 "quant")
 
     def __init__(self, model, bucket, signature, mesh="single",
-                 version=None):
+                 version=None, quant=None):
         self.model = str(model)
         self.bucket = int(bucket)
         # normalize to ((dtype_str, (trailing...)), ...) so logically
@@ -169,13 +186,17 @@ class ArtifactKey:
                                for dt, tr in signature)
         self.mesh = str(mesh)
         self.version = runtime_version() if version is None else str(version)
+        self.quant = "f32" if quant in (None, "f32") else str(quant)
 
     def canonical(self):
         """JSON-able identity — what the digest hashes and what the
         manifest records for self-verification."""
-        return {"model": self.model, "bucket": self.bucket,
-                "signature": [[dt, list(tr)] for dt, tr in self.signature],
-                "mesh": self.mesh, "version": self.version}
+        c = {"model": self.model, "bucket": self.bucket,
+             "signature": [[dt, list(tr)] for dt, tr in self.signature],
+             "mesh": self.mesh, "version": self.version}
+        if self.quant != "f32":
+            c["quant"] = self.quant
+        return c
 
     def digest(self):
         blob = json.dumps(self.canonical(), sort_keys=True)

@@ -46,10 +46,22 @@ def deserialize_exported(blob):
     return jax_export.deserialize(blob)
 
 
-def model_fingerprint(module_bytes):
+def model_fingerprint(module_bytes, quant=None):
     """Content identity of a saved model: sha256 hex over its
-    serialized exported-module bytes."""
-    return hashlib.sha256(module_bytes).hexdigest()
+    serialized exported-module bytes.
+
+    ``quant`` (a serving quant mode: ``"w8"`` / ``"w8a8"`` /
+    ``"bf16w"``) folds into the hash, so a quantized export is a
+    DISTINCT artifact-store identity even in the degenerate case where
+    two modes lower to byte-identical modules — a w8 program can never
+    be served to an f32 request (or vice versa) on fingerprint grounds
+    alone. ``None`` and the explicit ``"f32"`` spelling both keep the
+    historical hash: every existing store and saved model keys
+    identically regardless of which f32 spelling a caller uses."""
+    h = hashlib.sha256(module_bytes)
+    if quant is not None and quant != "f32":
+        h.update(b"\x00quant:" + str(quant).encode("utf-8"))
+    return h.hexdigest()
 
 
 def runtime_version(backend=None):

@@ -20,6 +20,8 @@ bench and the serving tests drive. Env:
     DECODE_WORKER_MAX_SEQ     max prompt+generated length (64)
     DECODE_WORKER_MAX_PROMPT  admission cap on prompts    (16)
     DECODE_WORKER_WARM        1 = warm the ladder before PORT prints
+    DECODE_WORKER_QUANT       serving quant mode ("w8" | "bf16w";
+                              empty = f32)
     PADDLE_TPU_ARTIFACT_DIR   artifact store (zero-cold-start rewarm)
 """
 import os
@@ -152,6 +154,7 @@ def main():
         seed=_env_int("DECODE_WORKER_SEED", 0))
     engine = DecodeEngine(
         model,
+        quant=os.environ.get("DECODE_WORKER_QUANT") or None,
         max_slots=_env_int("DECODE_WORKER_MAX_SLOTS", 8),
         max_seq_len=_env_int("DECODE_WORKER_MAX_SEQ", 64),
         max_prompt_len=_env_int("DECODE_WORKER_MAX_PROMPT", 16),

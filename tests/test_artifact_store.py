@@ -25,8 +25,9 @@ from paddle_tpu.serialize.artifact_store import (ArtifactKey, ArtifactStore,
 
 
 def _key(model="m" * 64, bucket=8, sig=(("float32", (4,)),),
-         mesh="single", version="jax-test/jaxlib-test/cpu"):
-    return ArtifactKey(model, bucket, sig, mesh=mesh, version=version)
+         mesh="single", version="jax-test/jaxlib-test/cpu", quant=None):
+    return ArtifactKey(model, bucket, sig, mesh=mesh, version=version,
+                       quant=quant)
 
 
 def _store(tmp_path, **kw):
@@ -67,6 +68,15 @@ class TestKey:
         # version is part of the KEY: a runtime skew is a clean miss,
         # never a corruption event
         assert k.digest() != _key(version="jax-other").digest()
+        # quant mode is part of the key too — every mode a distinct
+        # identity, and "f32"/None spell the HISTORICAL digest (no
+        # store invalidation for existing f32 artifacts)
+        assert k.digest() != _key(quant="w8").digest()
+        assert len({_key(quant=q).digest()
+                    for q in ("w8", "w8a8", "bf16w")}) == 3
+        assert _key(quant="f32").digest() == k.digest()
+        assert "quant" not in k.canonical()
+        assert _key(quant="w8").canonical()["quant"] == "w8"
 
     def test_signature_normalization(self):
         # logically-equal signatures (list vs tuple, np dims) digest
@@ -218,6 +228,32 @@ class TestVerification:
         skewed = _key(version="jax-9.9.9/jaxlib-9.9.9/tpu")
         before = _counters()
         assert st.get(skewed) is None
+        d = _delta(before)
+        assert d["misses"] == 1 and d["corrupt"] == 0
+
+    def test_quant_mode_skew_is_clean_miss(self, tmp_path):
+        """ISSUE 13 satellite: a w8 artifact must never be served to an
+        f32 request — and no quant mode's artifact to any other mode.
+        The key mismatch is a clean MISS (no quarantine, no corruption,
+        artifact untouched), in both directions."""
+        st = _store(tmp_path)
+        w8 = _key(quant="w8")
+        assert st.put(w8, b"w8-program-bytes-00000")
+        before = _counters()
+        # f32 request never sees the w8 artifact
+        assert st.get(_key()) is None
+        # nor does any OTHER quantized mode
+        assert st.get(_key(quant="bf16w")) is None
+        assert st.get(_key(quant="w8a8")) is None
+        d = _delta(before)
+        assert d["misses"] == 3 and d["corrupt"] == 0
+        # the w8 artifact is untouched and still serves w8
+        assert st.get(w8) == b"w8-program-bytes-00000"
+        # reverse direction: an f32 publish never serves a w8 request
+        f32 = _key(bucket=16)
+        assert st.put(f32, b"f32-program-bytes-0000")
+        before = _counters()
+        assert st.get(_key(bucket=16, quant="w8")) is None
         d = _delta(before)
         assert d["misses"] == 1 and d["corrupt"] == 0
 

@@ -140,6 +140,14 @@ class TestBenchContract:
         assert by_name["serving.flops"]["measured"] > 0
         assert by_name["train_step.flops"]["measured"] > 0
         assert by_name["train_step.op_counts"]["ok"]
+        # the quant ladder (ISSUE 13): every mode gated on exact
+        # compile counts, zero post-warmup compiles, and the
+        # opcode:dtype mix that proves reduced precision reached XLA
+        for mode in ("w8", "w8a8", "bf16w"):
+            assert by_name[f"quant.{mode}.warmup_compiles"]["ok"]
+            assert by_name[
+                f"quant.{mode}.post_warmup_compiles"]["baseline"] == 0
+            assert by_name[f"quant.{mode}.dtype_mix"]["ok"]
 
     @pytest.mark.slow  # subprocess bench run
     def test_perfproxy_fails_loudly_on_injected_regression(self):
@@ -178,6 +186,22 @@ class TestBenchContract:
         r = _run(env, timeout=420, argv=("perfproxy",))
         assert r.returncode == 0, r.stderr[-800:]
         assert _one_json_line(r.stdout)["ok"] is True
+        # ISSUE 13 discipline: regenerating with the quant section must
+        # leave the pre-existing sections BYTE-IDENTICAL to the
+        # committed baseline (sort_keys-canonical compare) — the quant
+        # ladder is additive, never an excuse to re-baseline f32 perf
+        committed = json.load(open(os.path.join(REPO,
+                                                "PERFPROXY_BASELINE.json")))
+        for section in ("serving", "decode", "train_step"):
+            assert (json.dumps(payload[section], sort_keys=True)
+                    == json.dumps(committed[section], sort_keys=True)), \
+                f"{section} section drifted under --update-baseline"
+        for mode in ("w8", "w8a8", "bf16w"):
+            q = payload["quant"][mode]
+            assert q["warmup_compiles"] > 0
+            assert q["post_warmup_compiles"] == 0
+            marker = "parameter:bf16" if mode == "bf16w" else "parameter:s8"
+            assert q["dtype_mix"].get(marker, 0) > 0
 
     @pytest.mark.slow  # subprocess pod launches; ci_gate --elastic
     @pytest.mark.elastic  # runs these as its own stage
@@ -291,6 +315,35 @@ class TestDecodeContract:
         assert rec["coldstart_store_loads"] > 0
         assert rec["streams"] > 0 and rec["baseline_streams"] > 0
 
+    @pytest.mark.slow  # nine decode-replica subprocesses + storms
+    @pytest.mark.decode
+    @pytest.mark.quant  # ci_gate --decode runs 'decode or quant'
+    def test_decode_quant_mode_metric_fields(self):
+        """`bench.py decode --quant` (ISSUE 13 acceptance): per quant
+        mode (w8, bf16w) the bench must prove the bitwise
+        solo-vs-batch contract over the wire, report the storm A/B vs
+        the f32 continuous side, report the weight-bytes proxy, and
+        hard-fail unless a fresh quantized replica re-warms from the
+        store with zero inline compiles."""
+        r = _run({"JAX_PLATFORMS": "cpu", "BENCH_DECODE_SECS": "1.5",
+                  "BENCH_DECODE_CLIENTS": "6"},
+                 timeout=540, argv=("decode", "--quant"))
+        assert r.returncode == 0, r.stderr[-1500:]
+        rec = _one_json_line(r.stdout)
+        assert set(rec["quant"]) == {"w8", "bf16w"}
+        for mode, q in rec["quant"].items():
+            assert q["tokens_per_sec"] > 0
+            assert q["p99_intertoken_ms"] > 0
+            assert q["bitwise_solo_vs_batch"] is True
+            assert q["coldstart_inline_compiles"] == 0
+            assert q["coldstart_store_loads"] > 0
+            assert q["tokens_vs_f32"] > 0
+            assert q["weight_bytes"] < q["weight_bytes_f32"]
+        # the bandwidth lever the modes exist for: int8 ~4x on matrix
+        # params (minus scales), bf16 exactly 2x
+        assert rec["quant"]["w8"]["weight_bytes_ratio"] > 3.0
+        assert rec["quant"]["bf16w"]["weight_bytes_ratio"] == 2.0
+
 
 class TestColdstartContract:
     """`bench.py coldstart` JSON contract (ISSUE 10 acceptance): a
@@ -309,7 +362,8 @@ class TestColdstartContract:
             "serving_coldstart_first_healthy_reply_seconds"
         assert rec["unit"] == "s" and rec["value"] > 0
         phases = rec["phases"]
-        assert set(phases) == {"cold", "warm", "poisoned"}
+        assert set(phases) == {"cold", "warm", "quant_cold",
+                               "quant_warm", "poisoned"}
         for ph in phases.values():
             for k in ("t_first_healthy_reply_s", "compiles",
                       "store_loads", "store_corrupt"):
@@ -328,3 +382,17 @@ class TestColdstartContract:
         assert rec["poisoned_degraded_inline"] is True
         assert rec["replies_bitwise_equal"] is True
         assert rec["poisoned_artifacts"] > 0
+        # ISSUE 13: the coldstart contract extended to a quantized (w8)
+        # replica sharing the same store, with the deployment knob
+        # (PADDLE_TPU_SERVING_QUANT=w8) declared end to end. Its cold
+        # phase compiled its OWN ladder — the already-published f32
+        # artifacts can never satisfy a w8 key — and its warm phase
+        # loaded everything with zero inline compiles.
+        assert rec["quant_mode"] == "w8"
+        assert phases["quant_cold"]["compiles"] > 0
+        assert phases["quant_cold"]["store_loads"] == 0
+        assert phases["quant_warm"]["compiles"] == 0
+        assert phases["quant_warm"]["store_loads"] > 0
+        assert rec["quant_warm_zero_engine_compiles"] is True
+        assert rec["quant_cold_compiled_own_ladder"] is True
+        assert rec["quant_replies_bitwise_equal"] is True

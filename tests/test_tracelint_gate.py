@@ -490,8 +490,9 @@ def test_decode_summary_keys_present_when_not_run(tmp_path):
 
 
 def test_decode_double_run_guard_narrows_tier1():
-    """With --decode, tier-1 must exclude the decode marker (the
-    decode stage owns it, including the slow storm-bench contract)."""
+    """With --decode, tier-1 must exclude BOTH the decode and the
+    quant markers (the decode stage owns '-m decode or quant',
+    including the slow storm-bench + quant-ladder contracts)."""
     mod = _gate_module()
     captured = {}
 
@@ -509,7 +510,9 @@ def test_decode_double_run_guard_narrows_tier1():
     assert rc == 0
     tier1 = captured["args"][0]
     assert "not decode" in tier1 and "not slow" in tier1
+    assert "not quant" in tier1
     assert captured["args"][1] == mod.DECODE_PYTEST_ARGS
+    assert "decode or quant" in mod.DECODE_PYTEST_ARGS
 
 
 def test_serialize_subsystem_is_suppression_free():

@@ -46,11 +46,15 @@ fairness / eject-probe-readmit / retry-on-different-replica /
 drain-zero-drops units, the chaos-kill multi-replica e2e, and the
 ``bench.py fleet`` goodput + SLO-isolation contract), with the same
 compositional tier-1 exclusion. ``--decode`` adds a stage running the
-continuous-batching decode suite (``-m decode``: bitwise solo-vs-batch
-equivalence across join/leave events and every wire dtype, per-token
-SLO enforcement, streaming-wire + router-relay tests, the slot-purge
-chaos audit, and the slow ``bench.py decode`` storm contract), again
-with the compositional tier-1 double-run exclusion. ``--perfproxy``
+continuous-batching decode suite plus the quantized-serving suite
+(``-m 'decode or quant'``: bitwise solo-vs-batch equivalence across
+join/leave events and every wire dtype, per-token SLO enforcement,
+streaming-wire + router-relay tests, the slot-purge chaos audit, the
+slow ``bench.py decode`` storm contract, and the ISSUE 13 quant ladder
+— per-channel axis audit, w8/w8a8/bf16w export + engine + artifact-key
+contracts, ``decode --quant`` and quant-coldstart bench contracts),
+again with the compositional tier-1 double-run exclusion of BOTH
+markers. ``--perfproxy``
 adds a stage running ``bench.py perfproxy`` on CPU against the
 committed PERFPROXY_BASELINE.json — compile counts, HLO op counts, and
 cost-analysis FLOPs must match, so single-chip perf can't silently rot
@@ -101,8 +105,13 @@ ARTIFACTS_PYTEST_ARGS = "tests/ -q -m artifacts -p no:cacheprovider"
 FLEET_PYTEST_ARGS = "tests/ -q -m fleet -p no:cacheprovider"
 # the continuous-batching decode suite: bitwise equivalence, per-token
 # SLOs, streaming wire/router relay, slot-purge chaos, plus the slow
-# `bench.py decode` storm contract
-DECODE_PYTEST_ARGS = "tests/ -q -m decode -p no:cacheprovider"
+# `bench.py decode` storm contract. The quantized-serving suite
+# (`quant` marker: per-channel axis audit, w8/w8a8/bf16w export +
+# engine + store contracts, the `decode --quant` and quant-coldstart
+# bench contracts) rides in this stage — quantization is the decode
+# path's bandwidth lever, and a separate stage would re-pay the same
+# model/ladder setup
+DECODE_PYTEST_ARGS = "tests/ -q -m 'decode or quant' -p no:cacheprovider"
 # subsystems that must stay suppression-free: resilience (PR 2), the
 # serving stack (PRs 4-5), the telemetry layer (PR 7), and the analyzer
 # itself (PR 8) fix findings instead of silencing them. One carve-out:
@@ -384,11 +393,13 @@ def main(argv=None):
                          "multi-replica e2e, fleet bench contract)")
     ap.add_argument("--fleet-args", default=FLEET_PYTEST_ARGS)
     ap.add_argument("--decode", action="store_true",
-                    help="also run the continuous-batching decode "
-                         "suite (-m decode: bitwise solo-vs-batch "
-                         "equivalence, per-token SLOs, streaming "
-                         "wire/router relay, slot-purge chaos, decode "
-                         "bench contract)")
+                    help="also run the continuous-batching decode + "
+                         "quantized-serving suites (-m 'decode or "
+                         "quant': bitwise solo-vs-batch equivalence, "
+                         "per-token SLOs, streaming wire/router relay, "
+                         "slot-purge chaos, decode bench contract, "
+                         "quant axis audit + export/engine/store "
+                         "contracts + quant bench contracts)")
     ap.add_argument("--decode-args", default=DECODE_PYTEST_ARGS)
     ap.add_argument("--known-failures", default=KNOWN_FAILURES_FILE,
                     help="JSON file naming the committed pre-existing "
@@ -441,7 +452,9 @@ def main(argv=None):
             if ns.fleet:
                 excl.append("fleet")
             if ns.decode:
+                # the decode stage owns BOTH markers (decode or quant)
                 excl.append("decode")
+                excl.append("quant")
             if excl:
                 pytest_args = pytest_args.replace(
                     "'not slow'",
