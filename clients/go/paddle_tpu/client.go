@@ -3,16 +3,24 @@
 // C++ predictor via cgo; on TPU the predictor owns device state, so
 // external languages speak the serving protocol instead).
 //
-// Protocol (little-endian), see paddle_tpu/inference/server.py:
+// Protocol (little-endian), regenerated from the machine-readable
+// spec paddle_tpu/inference/wire_spec.py — the `--protocol` lint
+// (tools/tracelint.py) diffs this client's constant tables AND these
+// comment lines against the spec, so neither can drift on its own:
 //   request:  u32 body_len | u8 cmd(1=infer) | u8 n_inputs |
 //             per input: u8 dtype(0=f32,1=i32,2=i64,3=bool) u8 ndim
 //             i64 dims[] data
 //             optionally followed by marker-tagged trailing fields in
 //             any order (servers predating a field ignore the bytes):
 //               u8 0xDD | f64 timeout_ms   per-request deadline
+//                         (decode requests: the PER-TOKEN budget)
 //               u8 0x1D | u64 trace_id     non-zero span-trace id
-//               u8 0x5C | u64 decode opts   continuous-batching decode
+//               u8 0x5C | u64 decode opts  continuous-batching decode
 //                         (low 32 bits max_new_tokens, bit 63 one-shot)
+//               u8 0x7E | u64 tenant_id    fleet-router tenancy; NOT
+//                         sent by this client (declared partial in
+//                         wire_spec.IMPLEMENTATIONS — the router
+//                         stamps admission itself)
 //   response: u32 body_len | u8 status | same encoding of outputs
 //   status:   0 ok | 1 error | 2 retryable (request shed by the
 //             server's batching engine, a quarantined bucket, a

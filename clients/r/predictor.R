@@ -1,15 +1,23 @@
 # R client for the paddle_tpu inference server (reference analog: the
 # reference's r/ demo client; here a pure-socket client with no python
-# dependency). Protocol: see paddle_tpu/inference/server.py —
+# dependency). Protocol (little-endian), regenerated from the
+# machine-readable spec paddle_tpu/inference/wire_spec.py — the
+# `--protocol` lint (tools/tracelint.py) diffs this client's constant
+# tables AND these comment lines against the spec:
 #   request:  u32 body_len | u8 cmd(1) | u8 n_inputs |
 #             per input: u8 dtype(0=f32,1=i32,2=i64,3=bool) u8 ndim
 #             i64 dims[] data
 #             optionally followed by marker-tagged trailing fields in
 #             any order (servers predating a field ignore the bytes):
 #               u8 0xDD | f64 timeout_ms   per-request deadline
+#                         (decode requests: the PER-TOKEN budget)
 #               u8 0x1D | u64 trace_id     non-zero span-trace id
 #               u8 0x5C | u64 decode opts  continuous-batching decode
 #                         (low 32 bits max_new_tokens; bit 63 one-shot)
+#               u8 0x7E | u64 tenant_id    fleet-router tenancy; NOT
+#                         sent by this client (declared partial in
+#                         wire_spec.IMPLEMENTATIONS — connect to the
+#                         fleet router, which stamps admission itself)
 #   response: u32 body_len | u8 status | same encoding of outputs
 #   status:   0 ok | 1 error | 2 retryable (request shed by the
 #             server's batching engine, a quarantined bucket, a
@@ -221,6 +229,9 @@ pd_decode_stream <- function(con, prompt, max_new_tokens,
   n_out <- as.integer(resp[off]); off <- off + 1
   if (n_out < 1) return(numeric(0))
   out_code <- as.integer(resp[off])
+  # same guard as pd_predict: a dtype code this client predates must
+  # error, never index NA into the size table and desync the stream
+  if (out_code > 3) stop(sprintf("unknown wire dtype %d", out_code))
   esize <- .pd_dtype_sizes[out_code + 1]
   ndim <- as.integer(resp[off + 1]); off <- off + 2
   count <- 1

@@ -16,6 +16,12 @@ codes, severity, file:line, fix-it hint):
 - ``registry_checks`` (TPU201–TPU203): the ``core/dispatch.py`` op
   contract (hashable statics, stable fn identity for the jit/vjp
   caches, no float64).
+- ``protocol`` (TPU401–TPU410): wire-contract passes — every
+  implementation of the serving wire protocol (Python server stack,
+  Go/R/C clients) is extracted by a language-appropriate scanner and
+  diffed against ``inference/wire_spec.py`` (the machine-readable
+  spec), and the ok-or-retryable error taxonomy is statically verified
+  over the Python serving stack.
 - ``concurrency`` + ``lockmodel`` (TPU301–TPU310): static lock model
   of the threaded serving/resilience/obs stack — lock-order cycles,
   blocking calls under a lock, timeout-less waits, heuristic races,
@@ -38,9 +44,9 @@ from .diagnostics import (  # noqa: F401
 )
 from .runner import (  # noqa: F401
     LintResult, lint_concurrency, lint_file, lint_function, lint_paths,
-    lint_registry, lint_source,
+    lint_protocol, lint_registry, lint_source,
 )
 from . import (  # noqa: F401
     ast_checks, concurrency, jaxpr_checks, lockmodel, locktrace,
-    registry_checks,
+    protocol, registry_checks,
 )

@@ -11,6 +11,9 @@ documented in README.md §"Trace-safety rules":
 - ``TPU2xx`` — op-registry passes over ``core/dispatch.py`` ops.
 - ``TPU3xx`` — concurrency passes over the static lock model
   (``analysis/concurrency.py``; README §"Concurrency rules").
+- ``TPU4xx`` — wire-contract passes (``analysis/protocol.py``; README
+  §"Wire-contract rules"): cross-language protocol drift against
+  ``inference/wire_spec.py`` and the ok-or-retryable error taxonomy.
 
 Suppression: an inline ``# tracelint: disable=TPU001,TPU005`` comment on
 the flagged line silences those codes for that line; a file-level
@@ -132,6 +135,49 @@ CODES = {
                "a cycle",
                "the declarations are mutually unsatisfiable; pick one "
                "global order and fix the stale annotation(s)"),
+    # ---- protocol passes (wire-contract drift; analysis/protocol) ----
+    "TPU401": (SEVERITY_ERROR, "wire dtype table drift",
+               "the dtype code/size tables of every implementation must "
+               "match paddle_tpu/inference/wire_spec.py DTYPES exactly; "
+               "change the spec first, then every implementation in the "
+               "same PR"),
+    "TPU402": (SEVERITY_ERROR, "wire marker/field constant drift",
+               "trailing-field marker bytes (0xDD/0x1D/0x7E/0x5C) and "
+               "the one-shot bit come from wire_spec.MARKERS; a value "
+               "invented in one language is silent protocol corruption"),
+    "TPU403": (SEVERITY_ERROR, "wire status drift",
+               "status bytes come from wire_spec.STATUSES; handling a "
+               "status the server never emits is dead protocol surface "
+               "hiding a misunderstanding"),
+    "TPU404": (SEVERITY_ERROR, "wire command drift",
+               "command bytes come from wire_spec.COMMANDS; an unknown "
+               "command earns a status-1 reply, not a new ad-hoc code"),
+    "TPU405": (SEVERITY_ERROR, "one-sided wire constant",
+               "the implementation declares a spec feature it does not "
+               "implement (or is missing/unparseable); narrow its "
+               "wire_spec.IMPLEMENTATIONS declaration for an "
+               "intentionally partial client (MIGRATION.md waiver note)"),
+    "TPU406": (SEVERITY_ERROR, "protocol comment contradicts the spec",
+               "comments asserting wire constants are what the next "
+               "implementer copies; regenerate the protocol block from "
+               "wire_spec instead of hand-editing it"),
+    "TPU407": (SEVERITY_ERROR, "hardcoded wire constant in serving code",
+               "import the named constant from "
+               "paddle_tpu.inference.wire_spec — bare literals are "
+               "where single-file protocol drift starts"),
+    "TPU408": (SEVERITY_ERROR, "unclassified exception in serving stack",
+               "add the class to wire_spec RETRYABLE_/PERMANENT_/"
+               "TRANSPORT_EXCEPTIONS; the ok-or-retryable contract is "
+               "only checkable when every raise is classified"),
+    "TPU409": (SEVERITY_ERROR, "exception mapped to the wrong wire status",
+               "retryable exceptions map to status 2 and permanent to "
+               "status 1, everywhere; a retryable surfaced as status 1 "
+               "makes clients give up on transient faults"),
+    "TPU410": (SEVERITY_ERROR, "dispatch path can mis-map or leak",
+               "wrap engine dispatch in a try with a retryable arm "
+               "(status 2) ahead of the broad arm; an unhandled escape "
+               "is a client hang, a broad-to-status-1 arm without the "
+               "retryable arm mis-maps sheds as permanent"),
 }
 
 
@@ -272,8 +318,9 @@ def format_text(diags):
 
 #: Version of the JSON report shape below. Bump on any breaking change
 #: to the top-level keys or the per-finding fields — CI consumers key
-#: on it instead of sniffing the shape.
-JSON_SCHEMA_VERSION = 2
+#: on it instead of sniffing the shape. v3: the ``timings_s`` map may
+#: carry a ``protocol`` pass group (the TPU4xx wire-contract family).
+JSON_SCHEMA_VERSION = 3
 
 
 def format_json(diags, timings=None):

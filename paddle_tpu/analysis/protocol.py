@@ -1,0 +1,1088 @@
+"""protocol — the TPU401–TPU410 wire-contract pass family.
+
+The serving wire protocol is implemented four times (Python server
+stack, Go client, R client, C client) and its constants used to be
+hand-duplicated in each — exactly how the i64→f32 silent-cast bug
+(PR 4) and the truncated-but-ok streaming hazard (PR 12) happened.
+These passes make cross-language drift a gate failure:
+
+- **Extraction**: language-appropriate scanners pull each
+  implementation's constant tables out of its source — Python by AST,
+  Go/R/C++ by token-level scanning (const blocks, ``c(...)`` vectors,
+  ``switch`` tables, marker-byte pushes, status comparisons) — plus
+  every protocol *claim* made in comments (``0xDD`` near "deadline",
+  ``0=f32`` dtype enumerations, ``2 retryable`` status enumerations).
+- **Diff**: the extracts are checked against
+  ``paddle_tpu/inference/wire_spec.py`` (the single machine-readable
+  source of truth, loaded standalone so the analyzer never imports
+  jax): any constant at the wrong value, any status/dtype a client
+  decodes that the server never emits, and any spec feature an
+  implementation *declares* (``wire_spec.IMPLEMENTATIONS``) but does
+  not actually implement is a finding. Declared-partial gaps (the R
+  client's read-only stream path, the clients' missing tenant field)
+  are spec data, not silence.
+- **Taxonomy** (the ok-or-retryable contract, PR 11): every exception
+  class raised in the Python serving stack must be classified in the
+  spec's retryable/permanent/transport taxonomy, retryable classes
+  must only ever map to wire status 2 (permanent to 1), and a handler
+  path that could let a retryable be swallowed as permanent — or an
+  unclassified exception escape into a hang — is a finding.
+
+Codes (README §"Wire-contract rules"):
+
+- TPU401  wire dtype table drift
+- TPU402  wire marker/field constant drift
+- TPU403  wire status drift (incl. statuses the server never emits)
+- TPU404  wire command drift
+- TPU405  one-sided wire constant (declared feature not implemented)
+- TPU406  protocol comment contradicts the wire spec
+- TPU407  hardcoded wire constant in Python serving code
+- TPU408  exception raised in the serving stack is not classified in
+          the wire_spec taxonomy
+- TPU409  exception handler maps a classified exception to the wrong
+          wire status
+- TPU410  dispatch path can mis-map or leak an exception (retryable
+          swallowed as permanent, or no reply at all — a client hang)
+
+Suppression: the ``tpu-lint: disable=TPU40x  # justification`` waiver
+works in every language (``//``, ``#`` and R comments alike; the
+ci_gate suppression audit requires the justification in clean-path
+subsystems). Intentional partial clients should prefer narrowing their
+``wire_spec.IMPLEMENTATIONS`` declaration over waivers.
+"""
+import ast
+import importlib.util
+import os
+import re
+
+from .diagnostics import Diagnostic, sort_key
+
+__all__ = ["check_protocol", "load_spec", "extract_python", "extract_go",
+           "extract_r", "extract_cpp"]
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_SPEC_RELPATH = os.path.join("paddle_tpu", "inference", "wire_spec.py")
+
+#: Python files the ok-or-retryable taxonomy passes (TPU408–TPU410)
+#: cover: the whole wire-facing serving stack.
+TAXONOMY_FILES = (
+    "paddle_tpu/inference/server.py",
+    "paddle_tpu/inference/router.py",
+    "paddle_tpu/inference/decode.py",
+    "paddle_tpu/inference/batching.py",
+    "paddle_tpu/inference/fleet.py",
+    "paddle_tpu/inference/registry.py",
+)
+
+#: Python serving files where a bare wire literal (status/command/
+#: marker position) is TPU407 — everything must come from wire_spec.
+LITERAL_CLEAN_FILES = TAXONOMY_FILES
+
+#: Method names whose call can raise the retryable family (the engine
+#: dispatch surface). A try block calling one of these and mapping
+#: broad exceptions to wire status 1 needs a preceding retryable arm.
+DISPATCH_CALLEES = frozenset({
+    "infer", "submit", "result", "next_tokens",
+    "_infer", "_dispatch", "_relay",
+})
+
+#: Dispatch functions that are TOTAL: they reply (or return reply
+#: bytes) for every classified exception internally and only ever let
+#: transport-classified exceptions escape, so callers may wrap them
+#: with a plain broad handler. Verified by _check_total_dispatcher —
+#: the totality is checked, not trusted.
+TOTAL_DISPATCHERS = {
+    "server.py": frozenset({"_serve_decode"}),
+    "router.py": frozenset({"_infer"}),
+}
+
+#: Names that read as wire-status carriers in reply/compare positions.
+_STATUS_VARS = frozenset({"status", "resp", "body", "out_code"})
+
+
+def load_spec(path=None):
+    """Load wire_spec.py standalone (by file path, stdlib+numpy only)
+    so the lint never pays the paddle_tpu package import (jax)."""
+    path = path or os.path.join(_REPO, _SPEC_RELPATH)
+    spec = importlib.util.spec_from_file_location(
+        "_tracelint_wire_spec", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --------------------------------------------------------------- extract
+
+class Extract:
+    """One implementation's protocol surface as scanned from source."""
+
+    def __init__(self, name, lang, path):
+        self.name = name
+        self.lang = lang
+        self.path = path
+        self.dtype_codes = {}    # dtype name -> (code, line)
+        self.dtype_sizes = {}    # code -> (size, line)
+        self.markers = {}        # marker name -> (value, line)
+        self.marker_bytes = {}   # raw byte value -> line (unnamed uses)
+        self.statuses = {}       # status value -> line
+        self.commands = {}       # command value -> line
+        # NAMED constants (python): a constant drifted onto another
+        # VALID value ("STATUS_ERROR = 2") is invisible to the
+        # value-keyed sets above — the name is the identity to check
+        self.named_statuses = {}  # const name -> (value, line)
+        self.named_commands = {}  # const name -> (value, line)
+        self.oneshot_shift = None  # (shift, line) or None
+        self.max_dtype_claims = []  # (value, line): "> N is unknown"
+        self.comment_claims = []    # (kind, key, value, line)
+
+    def marker_values(self):
+        vals = {v for v, _ in self.markers.values()}
+        vals.update(self.marker_bytes)
+        return vals
+
+
+_DTYPE_ALIASES = {
+    "f32": "float32", "float32": "float32", "float": "float32",
+    "i32": "int32", "int32": "int32", "int": "int32",
+    "i64": "int64", "int64": "int64",
+    "bool": "bool",
+}
+
+_MARKER_KEYWORDS = (
+    ("deadline", ("deadline", "timeout_ms", "timeout")),
+    ("trace", ("trace",)),
+    ("tenant", ("tenant",)),
+    ("decode", ("decode",)),
+)
+
+_STATUS_NAMES = {"ok": 0, "error": 1, "retryable": 2, "stream": 3}
+
+
+def _nearest_marker_keyword(low, hex_at, start, end):
+    """The marker name whose keyword occurrence inside [start, end) is
+    closest to the hex literal at ``hex_at`` (None when none occur)."""
+    best = None
+    for name, keywords in _MARKER_KEYWORDS:
+        for k in keywords:
+            at = low.find(k, start, end)
+            while at != -1:
+                dist = abs(at - hex_at)
+                if best is None or dist < best[0]:
+                    best = (dist, name)
+                at = low.find(k, at + 1, end)
+    return best[1] if best else None
+
+
+def _scan_comment_claims(ex, lines):
+    """Protocol claims in documentation (and constant-definition lines):
+    a hex byte co-located with a marker keyword, ``N=f32`` dtype
+    enumerations, ``N ok|error|retryable`` status enumerations, and
+    ``bit N`` one-shot claims. Checked by TPU406: a comment asserting a
+    wrong constant is drift waiting to be copied."""
+    for i, line in enumerate(lines, start=1):
+        low = line.lower()
+        for m in re.finditer(r"0x([0-9a-f]{2})\b", low):
+            val = int(m.group(1), 16)
+            # attribute the byte to a marker keyword in the same CLAUSE
+            # (split at ;/,) first, then the nearest on the whole line:
+            # prose naming two fields ("deadline field (0xDD + f64);
+            # a trace_id…") must not claim the wrong pairing
+            clause_start = max(low.rfind(";", 0, m.start()),
+                               low.rfind(",", 0, m.start())) + 1
+            clause_end = len(low)
+            for sep in ";,":
+                at = low.find(sep, m.end())
+                if at != -1:
+                    clause_end = min(clause_end, at)
+            name = (_nearest_marker_keyword(low, m.start(),
+                                            clause_start, clause_end)
+                    or _nearest_marker_keyword(low, m.start(),
+                                               0, len(low)))
+            if name is not None:
+                ex.comment_claims.append(("marker", name, val, i))
+        for m in re.finditer(
+                r"\b([0-9])\s*=\s*(f32|i32|i64|bool|float32|int32|int64)\b",
+                low):
+            ex.comment_claims.append(
+                ("dtype", _DTYPE_ALIASES[m.group(2)], int(m.group(1)), i))
+        for m in re.finditer(r"\b([0-9])\s+(ok|error|retryable)\b", low):
+            ex.comment_claims.append(
+                ("status", m.group(2), int(m.group(1)), i))
+        for m in re.finditer(r"\bstatus[ -]([0-9])\b", low):
+            # "status 3" / "status-2" style references
+            ex.comment_claims.append(("status_ref", None, int(m.group(1)), i))
+        if "one-shot" in low or "oneshot" in low:
+            m = re.search(r"\bbit\s+([0-9]+)\b", low)
+            if m:
+                ex.comment_claims.append(
+                    ("oneshot", None, int(m.group(1)), i))
+
+
+# ------------------------------------------------------------- Python
+
+def extract_python(source, path, name="python"):
+    """AST extraction for the Python side. After the constants-from-
+    spec refactor the live server defines no literal tables (imports
+    only — nothing left to drift); dict/assignment extraction remains
+    for fixture copies and out-of-tree servers, and the TPU407 literal
+    scan keeps the live files honest."""
+    ex = Extract(name, "python", path)
+    tree = ast.parse(source)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            tgt = node.targets[0].id
+            val = node.value
+            if isinstance(val, ast.Dict):
+                _py_dtype_dict(ex, tgt, val)
+            elif isinstance(val, ast.Constant) and isinstance(val.value, int):
+                _py_const(ex, tgt, val.value, node.lineno)
+            elif (isinstance(val, ast.BinOp)
+                  and isinstance(val.op, ast.LShift)
+                  and isinstance(val.left, ast.Constant)
+                  and val.left.value == 1
+                  and isinstance(val.right, ast.Constant)
+                  and "ONESHOT" in tgt.upper()):
+                ex.oneshot_shift = (int(val.right.value), node.lineno)
+    _scan_comment_claims(ex, source.splitlines())
+    return ex
+
+
+_PY_NP_NAMES = {"float32": "float32", "int32": "int32", "int64": "int64",
+                "bool_": "bool", "bool": "bool"}
+
+
+def _py_attr_dtype(node):
+    """np.float32 / np.dtype(np.float32) -> 'float32' (else None)."""
+    if isinstance(node, ast.Call) and node.args:
+        return _py_attr_dtype(node.args[0])
+    if isinstance(node, ast.Attribute):
+        return _PY_NP_NAMES.get(node.attr)
+    return None
+
+
+def _py_dtype_dict(ex, tgt, val):
+    """{0: np.float32, ...} and {np.dtype(np.float32): 0, ...}."""
+    for k, v in zip(val.keys, val.values):
+        if isinstance(k, ast.Constant) and isinstance(k.value, int):
+            dname = _py_attr_dtype(v)
+            if dname is not None:
+                ex.dtype_codes[dname] = (k.value, k.lineno)
+        else:
+            dname = _py_attr_dtype(k)
+            if dname is not None and isinstance(v, ast.Constant) \
+                    and isinstance(v.value, int):
+                ex.dtype_codes[dname] = (v.value, v.lineno)
+
+
+def _py_const(ex, tgt, value, lineno):
+    up = tgt.upper().lstrip("_")
+    if up.endswith("_MARKER"):
+        mname = up[:-len("_MARKER")].lower()
+        mname = {"deadline": "deadline", "trace": "trace",
+                 "tenant": "tenant", "decode": "decode"}.get(mname)
+        if mname:
+            ex.markers[mname] = (value, lineno)
+    elif up.startswith("STATUS_"):
+        ex.statuses[value] = lineno
+        ex.named_statuses[tgt] = (value, lineno)
+    elif up.startswith("CMD_"):
+        ex.commands[value] = lineno
+        ex.named_commands[tgt] = (value, lineno)
+    elif up == "OVERLOADED_STATUS":
+        ex.statuses[value] = lineno
+        ex.named_statuses[tgt] = (value, lineno)
+
+
+# ----------------------------------------------------------------- Go
+
+def _strip_line_comments(line, mark):
+    q = False
+    for i, ch in enumerate(line):
+        if ch == '"' and (i == 0 or line[i - 1] != "\\"):
+            q = not q
+        elif not q and line.startswith(mark, i):
+            return line[:i]
+    return line
+
+
+def extract_go(source, path, name="go"):
+    ex = Extract(name, "go", path)
+    lines = source.splitlines()
+    consts = {}  # const name -> int (for resolving map keys / cases)
+    # brace-depth tracking for `switch resp[0] {` blocks: only cases of
+    # a switch over the STATUS BYTE are wire statuses — an integer case
+    # in an unrelated switch must not fabricate a TPU403
+    status_switch_depth = None
+    depth = 0
+    for i, raw in enumerate(lines, start=1):
+        line = _strip_line_comments(raw, "//")
+        if status_switch_depth is not None and depth <= status_switch_depth:
+            status_switch_depth = None
+        if re.search(r"\bswitch\s+(?:resp\[0\]|status)\s*\{", line):
+            status_switch_depth = depth
+        depth += line.count("{") - line.count("}")
+        m = re.search(r"\b(dtype[A-Za-z0-9]+)\s*=\s*(\d+)\b", line)
+        if m:
+            dname = _DTYPE_ALIASES.get(m.group(1)[len("dtype"):].lower())
+            if dname:
+                ex.dtype_codes[dname] = (int(m.group(2)), i)
+                consts[m.group(1)] = int(m.group(2))
+        m = re.search(r"\b(\w+)Marker\s*=\s*(0x[0-9A-Fa-f]+|\d+)\b", line)
+        if m:
+            mname = m.group(1).lower()
+            if mname in ("deadline", "trace", "tenant", "decode"):
+                ex.markers[mname] = (int(m.group(2), 0), i)
+                consts[m.group(1) + "Marker"] = int(m.group(2), 0)
+        m = re.search(r"\bstatusStream\s*=\s*(\d+)\b", line)
+        if m:
+            ex.statuses[int(m.group(1))] = i
+            consts["statusStream"] = int(m.group(1))
+        m = re.search(r"\bdecodeOneshotBit\s*=\s*uint64\(1\)\s*<<\s*(\d+)",
+                      line)
+        if m:
+            ex.oneshot_shift = (int(m.group(1)), i)
+        m = re.search(r"dtypeSize\s*=\s*map\[byte\]int\{([^}]*)\}", line)
+        if m:
+            for k, v in re.findall(r"(\w+):\s*(\d+)", m.group(1)):
+                code = consts.get(k)
+                if code is None and k.isdigit():
+                    code = int(k)
+                if code is not None:
+                    ex.dtype_sizes[code] = (int(v), i)
+        # status compare/switch sites, anchored to the status byte
+        # itself: only `resp[0] == N` records N (another compare on the
+        # same line — `len(chunk) == 7` — must not), and only cases of
+        # a `switch resp[0]` block count
+        for m in re.finditer(r"\bresp\[0\]\s*(?:==|!=)\s*(\d+)\b", line):
+            ex.statuses[int(m.group(1))] = i
+        m = re.match(r"\s*case\s+([A-Za-z0-9_,\s]+):", line)
+        if m and status_switch_depth is not None:
+            for item in m.group(1).split(","):
+                item = item.strip()
+                if item.isdigit():
+                    ex.statuses[int(item)] = i
+                elif item in consts:
+                    ex.statuses[consts[item]] = i
+        # request body literal: []byte{cmd, ...}
+        m = re.search(r"\[\]byte\{(\d+)\s*,", line)
+        if m:
+            ex.commands[int(m.group(1))] = i
+    _scan_comment_claims(ex, lines)
+    return ex
+
+
+# ------------------------------------------------------------------ R
+
+def extract_r(source, path, name="r"):
+    ex = Extract(name, "r", path)
+    lines = source.splitlines()
+    joined = source  # R table literals can span lines
+    m = re.search(r"\.pd_dtype_codes\s*<-\s*c\(([^)]*)\)", joined)
+    if m:
+        at = joined[:m.start()].count("\n") + 1
+        for k, v in re.findall(r"(\w+)\s*=\s*(\d+)L", m.group(1)):
+            dname = _DTYPE_ALIASES.get(k.lower())
+            if dname:
+                ex.dtype_codes[dname] = (int(v), at)
+    m = re.search(r"\.pd_dtype_sizes\s*<-\s*c\(([^)]*)\)", joined)
+    if m:
+        at = joined[:m.start()].count("\n") + 1
+        sizes = re.findall(r"(\d+)L", m.group(1))
+        for code, size in enumerate(sizes):  # indexed by code + 1
+            ex.dtype_sizes[code] = (int(size), at)
+    for i, raw in enumerate(lines, start=1):
+        line = _strip_line_comments(raw, "#")
+        for m in re.finditer(r"as\.raw\(0x([0-9A-Fa-f]+)\)", line):
+            ex.marker_bytes[int(m.group(1), 16)] = i
+        for m in re.finditer(r"\bstatus\s*(==|!=)\s*(\d+)", line):
+            ex.statuses[int(m.group(2))] = i
+        m = re.search(r"stopifnot\(status\s*==\s*(\d+)\)", line)
+        if m:
+            ex.statuses[int(m.group(1))] = i
+        m = re.search(r"\bout_code\s*>\s*(\d+)", line)
+        if m:
+            ex.max_dtype_claims.append((int(m.group(1)), i))
+        m = re.search(r"as\.raw\(c\((\d+)\s*,", line)
+        if m:
+            ex.commands[int(m.group(1))] = i
+    _scan_comment_claims(ex, lines)
+    return ex
+
+
+# ---------------------------------------------------------------- C++
+
+def extract_cpp(source, path, name="c++"):
+    ex = Extract(name, "c++", path)
+    lines = source.splitlines()
+    # dtype_size() switch table
+    m = re.search(r"dtype_size\s*\(\s*int\s+\w+\s*\)\s*\{(.*?)\n\}",
+                  source, re.S)
+    if m:
+        base = source[:m.start()].count("\n")
+        for c in re.finditer(r"case\s+(\d+)\s*:\s*return\s+(\d+)\s*;",
+                             m.group(1)):
+            at = base + m.group(1)[:c.start()].count("\n") + 1
+            ex.dtype_sizes[int(c.group(1))] = (int(c.group(2)), at)
+    for i, raw in enumerate(lines, start=1):
+        line = _strip_line_comments(raw, "//")
+        for m in re.finditer(r"\(char\)\s*0x([0-9A-Fa-f]+)", line):
+            ex.marker_bytes[int(m.group(1), 16)] = i
+        for m in re.finditer(
+                r"\b(?:resp\[0\]|status)\s*(==|!=)\s*(\d+)\b", line):
+            ex.statuses[int(m.group(2))] = i
+    _scan_comment_claims(ex, lines)
+    return ex
+
+
+_EXTRACTORS = {"python": extract_python, "go": extract_go,
+               "r": extract_r, "c++": extract_cpp}
+
+#: What each scanner can extract from CODE (comment claims always
+#: work). A feature outside a language's capability is checked through
+#: its comment claims only, never reported one-sided.
+_CAPABILITIES = {
+    "python": {"dtypes", "markers", "statuses", "commands"},
+    "go": {"dtypes", "markers", "statuses", "commands"},
+    "r": {"dtypes", "markers", "statuses", "commands"},
+    "c++": {"dtypes", "markers", "statuses"},
+}
+
+
+# ------------------------------------------------------------ diff/check
+
+def _diag(code, msg, path, line):
+    return Diagnostic(code=code, message=msg, filename=path, line=line)
+
+
+def _diff_impl(ex, decl, spec):
+    """Diff one implementation's extract against the spec + its
+    coverage declaration."""
+    diags = []
+    caps = _CAPABILITIES[ex.lang]
+    # --- dtype table
+    for dname, (code, line) in sorted(ex.dtype_codes.items()):
+        want = spec.DTYPE_BY_NAME.get(dname)
+        if want is None:
+            diags.append(_diag(
+                "TPU401", f"{ex.name}: dtype {dname!r} is not in the "
+                "wire spec", ex.path, line))
+        elif want.code != code:
+            diags.append(_diag(
+                "TPU401", f"{ex.name}: dtype {dname!r} has wire code "
+                f"{code}, spec says {want.code}", ex.path, line))
+    for code, (size, line) in sorted(ex.dtype_sizes.items()):
+        want = spec.DTYPES.get(code)
+        if want is None:
+            diags.append(_diag(
+                "TPU401", f"{ex.name}: dtype code {code} (size {size}) "
+                "is not in the wire spec", ex.path, line))
+        elif want.size != size:
+            diags.append(_diag(
+                "TPU401", f"{ex.name}: dtype code {code} ({want.name}) "
+                f"has element size {size}, spec says {want.size}",
+                ex.path, line))
+    if "dtypes" in caps and (ex.dtype_codes or ex.dtype_sizes):
+        have = {c for c, _ in ex.dtype_codes.values()}
+        have.update(ex.dtype_sizes)
+        for code in sorted(decl.dtypes - have):
+            diags.append(_diag(
+                "TPU405", f"{ex.name}: declares wire dtype "
+                f"{spec.DTYPES[code].name} (code {code}) but its table "
+                "does not implement it", ex.path, 1))
+    for val, line in ex.max_dtype_claims:
+        if val != spec.MAX_DTYPE_CODE:
+            diags.append(_diag(
+                "TPU401", f"{ex.name}: rejects dtype codes > {val}, "
+                f"spec's highest code is {spec.MAX_DTYPE_CODE}",
+                ex.path, line))
+    # --- markers
+    for mname, (value, line) in sorted(ex.markers.items()):
+        want = spec.MARKER_BY_NAME.get(mname)
+        if want is None:
+            diags.append(_diag(
+                "TPU402", f"{ex.name}: marker {mname!r} is not in the "
+                "wire spec", ex.path, line))
+        elif want.byte != value:
+            diags.append(_diag(
+                "TPU402", f"{ex.name}: marker {mname!r} is 0x{value:02X}, "
+                f"spec says 0x{want.byte:02X}", ex.path, line))
+    for value, line in sorted(ex.marker_bytes.items()):
+        if value not in spec.MARKERS:
+            diags.append(_diag(
+                "TPU402", f"{ex.name}: writes marker byte 0x{value:02X} "
+                "which is not in the wire spec", ex.path, line))
+    if "markers" in caps and (ex.markers or ex.marker_bytes):
+        have = set(ex.markers)
+        have.update(spec.MARKERS[v].name for v in ex.marker_bytes
+                    if v in spec.MARKERS)
+        for mname in sorted(decl.markers - have):
+            diags.append(_diag(
+                "TPU405", f"{ex.name}: declares the "
+                f"{mname!r} trailing field (marker "
+                f"0x{spec.MARKER_BY_NAME[mname].byte:02X}) but never "
+                "implements it", ex.path, 1))
+    if ex.oneshot_shift is not None \
+            and ex.oneshot_shift[0] != spec.DECODE_ONESHOT_BIT_SHIFT:
+        diags.append(_diag(
+            "TPU402", f"{ex.name}: one-shot bit is bit "
+            f"{ex.oneshot_shift[0]}, spec says bit "
+            f"{spec.DECODE_ONESHOT_BIT_SHIFT}", ex.path,
+            ex.oneshot_shift[1]))
+    # --- statuses
+    for value, line in sorted(ex.statuses.items()):
+        if value not in spec.SERVER_EMITTED_STATUSES:
+            diags.append(_diag(
+                "TPU403", f"{ex.name}: handles wire status {value}, "
+                "which the server never emits", ex.path, line))
+    if "statuses" in caps and ex.statuses:
+        for value in sorted(decl.statuses - set(ex.statuses)):
+            if value == spec.STATUS_STREAM and not decl.streaming:
+                continue
+            if value == spec.STATUS_ERROR:
+                # the error status is every client's fallthrough
+                # branch ("anything not 0/2/3 is an error") — it is
+                # handled without ever being named, and an else branch
+                # cannot drift
+                continue
+            diags.append(_diag(
+                "TPU405", f"{ex.name}: declares wire status {value} "
+                f"({spec.STATUSES[value].name}) but never handles it",
+                ex.path, 1))
+    # --- NAMED status/command constants: the name is the identity, so
+    # a constant drifted onto another VALID value (STATUS_ERROR = 2 —
+    # permanent errors surfaced as retryable) is caught here where the
+    # value-keyed membership checks above cannot see it
+    by_suffix = {"OK": spec.STATUS_OK, "ERROR": spec.STATUS_ERROR,
+                 "RETRYABLE": spec.STATUS_RETRYABLE,
+                 "OVERLOADED": spec.STATUS_RETRYABLE,
+                 "STREAM": spec.STATUS_STREAM}
+    for cname, (value, line) in sorted(ex.named_statuses.items()):
+        up = cname.upper().lstrip("_")
+        suffix = ("OVERLOADED" if up == "OVERLOADED_STATUS"
+                  else up[len("STATUS_"):] if up.startswith("STATUS_")
+                  else None)
+        want = by_suffix.get(suffix)
+        if want is not None and value != want:
+            diags.append(_diag(
+                "TPU403", f"{ex.name}: {cname} = {value}, spec says "
+                f"{want}", ex.path, line))
+    cmd_by_name = {c.name.upper(): c.code for c in spec.COMMANDS.values()}
+    for cname, (value, line) in sorted(ex.named_commands.items()):
+        suffix = cname.upper().lstrip("_")[len("CMD_"):]
+        want = cmd_by_name.get(suffix)
+        if want is not None and value != want:
+            diags.append(_diag(
+                "TPU404", f"{ex.name}: {cname} = {value}, spec says "
+                f"{want}", ex.path, line))
+    # --- commands
+    for value, line in sorted(ex.commands.items()):
+        if value not in spec.COMMANDS:
+            diags.append(_diag(
+                "TPU404", f"{ex.name}: speaks wire command {value}, "
+                "which is not in the wire spec", ex.path, line))
+    if "commands" in caps and ex.commands:
+        for value in sorted(decl.commands - set(ex.commands)):
+            diags.append(_diag(
+                "TPU404", f"{ex.name}: declares wire command {value} "
+                f"({spec.COMMANDS[value].name}) but never sends or "
+                "handles it", ex.path, 1))
+    # --- comment claims (TPU406: docs must not contradict the spec)
+    for kind, key, value, line in ex.comment_claims:
+        if kind == "marker":
+            want = spec.MARKER_BY_NAME[key].byte
+            if value != want and value in spec.MARKERS:
+                # a DIFFERENT spec marker named with this keyword's
+                # meaning is a contradiction; an unknown byte near a
+                # keyword is usually prose, handled above when written
+                # by code
+                if spec.MARKERS[value].name != key:
+                    diags.append(_diag(
+                        "TPU406", f"{ex.name}: comment claims marker "
+                        f"0x{value:02X} is the {key!r} field; spec says "
+                        f"0x{value:02X} is "
+                        f"{spec.MARKERS[value].name!r} and {key!r} is "
+                        f"0x{want:02X}", ex.path, line))
+            elif value not in spec.MARKERS and value != want:
+                diags.append(_diag(
+                    "TPU406", f"{ex.name}: comment claims marker "
+                    f"0x{value:02X} for the {key!r} field; spec says "
+                    f"0x{want:02X}", ex.path, line))
+        elif kind == "dtype":
+            want = spec.DTYPE_BY_NAME.get(key)
+            if want is not None and want.code != value:
+                diags.append(_diag(
+                    "TPU406", f"{ex.name}: comment claims dtype {key} "
+                    f"= code {value}; spec says {want.code}",
+                    ex.path, line))
+        elif kind == "status":
+            want = _STATUS_NAMES.get(key)
+            if want is not None and want != value:
+                diags.append(_diag(
+                    "TPU406", f"{ex.name}: comment claims status "
+                    f"{value} is {key!r}; spec says {key!r} is "
+                    f"{want}", ex.path, line))
+        elif kind == "status_ref":
+            if value not in spec.STATUSES:
+                diags.append(_diag(
+                    "TPU406", f"{ex.name}: comment references wire "
+                    f"status {value}, which is not in the spec",
+                    ex.path, line))
+        elif kind == "oneshot":
+            if value != spec.DECODE_ONESHOT_BIT_SHIFT:
+                diags.append(_diag(
+                    "TPU406", f"{ex.name}: comment claims the one-shot "
+                    f"bit is bit {value}; spec says bit "
+                    f"{spec.DECODE_ONESHOT_BIT_SHIFT}", ex.path, line))
+    return diags
+
+
+# --------------------------------------------- Python literal scan (407)
+
+_PACK_STATUS_ARG = {"<IB": 2, "<B": 1, "<Bd": 1}
+
+
+def _check_py_literals(tree, path):
+    """TPU407: bare wire literals in Python serving code. Everything in
+    a status/command/marker position must be a named wire_spec
+    constant — a literal is where single-file drift starts."""
+    diags = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                and isinstance(node.ops[0], (ast.Eq, ast.NotEq)):
+            left, right = node.left, node.comparators[0]
+            if isinstance(right, ast.Constant) \
+                    and isinstance(right.value, int) \
+                    and not isinstance(right.value, bool):
+                what = None
+                if isinstance(left, ast.Name) and left.id == "cmd":
+                    what = "command"
+                elif (isinstance(left, ast.Subscript)
+                      and isinstance(left.value, ast.Name)
+                      and left.value.id in _STATUS_VARS
+                      and isinstance(left.slice, ast.Constant)
+                      and left.slice.value == 0
+                      and right.value != 0):
+                    # body[0]/resp[0] compared to a nonzero literal is
+                    # a status compare (== 0 is ambiguous with
+                    # emptiness checks and 0 can't drift silently:
+                    # every language pins it in tests)
+                    what = "status"
+                if what is not None:
+                    diags.append(_diag(
+                        "TPU407", f"hardcoded wire {what} literal "
+                        f"{right.value}; use the named wire_spec "
+                        "constant", path, node.lineno))
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute) \
+                and node.func.attr == "pack" \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "struct" and node.args:
+            fmt = node.args[0]
+            if isinstance(fmt, ast.Constant) \
+                    and fmt.value in _PACK_STATUS_ARG:
+                idx = _PACK_STATUS_ARG[fmt.value]
+                if len(node.args) > idx:
+                    arg = node.args[idx]
+                    if isinstance(arg, ast.Constant) \
+                            and isinstance(arg.value, int) \
+                            and not isinstance(arg.value, bool):
+                        diags.append(_diag(
+                            "TPU407", "hardcoded wire status/command "
+                            f"literal {arg.value} in struct.pack"
+                            f"({fmt.value!r}, ...); use the named "
+                            "wire_spec constant", path, node.lineno))
+    return diags
+
+
+# --------------------------------------------------- taxonomy (408-410)
+
+def _exc_names(node):
+    """Names caught by an except clause: [] for a bare except,
+    ['Exception'] counts as broad."""
+    if node is None:
+        return []
+    if isinstance(node, ast.Name):
+        return [node.id]
+    if isinstance(node, ast.Attribute):
+        return [node.attr]
+    if isinstance(node, ast.Tuple):
+        out = []
+        for e in node.elts:
+            out.extend(_exc_names(e))
+        return out
+    return []
+
+
+def _status_consts(tree):
+    """Module-level STATUS_*-style name -> int map, resolved through
+    wire_spec attribute aliases (STATUS_OVERLOADED =
+    wire_spec.STATUS_RETRYABLE) and import-from renames."""
+    spec_vals = {
+        "STATUS_OK": 0, "STATUS_ERROR": 1, "STATUS_RETRYABLE": 2,
+        "STATUS_OVERLOADED": 2, "STATUS_STREAM": 3,
+        "OVERLOADED_STATUS": 2,
+    }
+    out = dict(spec_vals)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            tgt = node.targets[0].id
+            val = node.value
+            if isinstance(val, ast.Constant) and isinstance(val.value, int) \
+                    and tgt.upper().startswith("STATUS"):
+                out[tgt] = val.value
+            elif isinstance(val, ast.Attribute) and val.attr in spec_vals:
+                out[tgt] = spec_vals[val.attr]
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name in spec_vals:
+                    out[alias.asname or alias.name] = spec_vals[alias.name]
+    return out
+
+
+def _reply_statuses(body_nodes, status_consts):
+    """Wire statuses a handler body replies with: struct.pack status
+    positions first; falls back to any STATUS_* name referenced."""
+    packed, named = set(), set()
+    for stmt in body_nodes:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "pack" and node.args:
+                fmt = node.args[0]
+                if isinstance(fmt, ast.Constant) \
+                        and fmt.value in _PACK_STATUS_ARG \
+                        and fmt.value != "<Bd":
+                    idx = _PACK_STATUS_ARG[fmt.value]
+                    if len(node.args) > idx:
+                        arg = node.args[idx]
+                        if isinstance(arg, ast.Name) \
+                                and arg.id in status_consts:
+                            packed.add(status_consts[arg.id])
+                        elif isinstance(arg, ast.Constant) \
+                                and isinstance(arg.value, int):
+                            packed.add(arg.value)
+            elif isinstance(node, ast.Name) and node.id in status_consts \
+                    and node.id.upper().startswith("STATUS"):
+                named.add(status_consts[node.id])
+    return packed or named
+
+
+def _has_raise(body_nodes):
+    return any(isinstance(n, ast.Raise)
+               for stmt in body_nodes for n in ast.walk(stmt))
+
+
+def _calls_dispatch(try_node):
+    """Does this try's BODY (not its handlers) call into the engine
+    dispatch surface?"""
+    for stmt in try_node.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in DISPATCH_CALLEES:
+                return node.func.attr
+    return None
+
+
+def _local_exception_bases(tree):
+    """class -> base names, for classifying local subclasses through
+    the taxonomy (e.g. a new RetryableError subclass is retryable)."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            out[node.name] = [b.id if isinstance(b, ast.Name) else b.attr
+                              for b in node.bases
+                              if isinstance(b, (ast.Name, ast.Attribute))]
+    return out
+
+
+def _classify(name, spec, bases, _seen=None):
+    kind = spec.classify_exception(name)
+    if kind is not None:
+        return kind
+    _seen = _seen or set()
+    if name in _seen:
+        return None
+    _seen.add(name)
+    for base in bases.get(name, ()):
+        kind = _classify(base, spec, bases, _seen)
+        if kind is not None:
+            return kind
+    return None
+
+
+def _check_taxonomy_file(tree, path, spec, in_wire_handler):
+    """TPU408/409/410 over one serving-stack file."""
+    diags = []
+    bases = _local_exception_bases(tree)
+    status_consts = _status_consts(tree)
+    base = os.path.basename(path)
+    total_fns = TOTAL_DISPATCHERS.get(base, frozenset())
+
+    # --- TPU408: every raised class is classified
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Raise) and node.exc is not None:
+            target = node.exc
+            if isinstance(target, ast.Call):
+                target = target.func
+            if isinstance(target, ast.Name):
+                if target.id in ("self",):
+                    continue
+                if _classify(target.id, spec, bases) is None:
+                    diags.append(_diag(
+                        "TPU408", f"raises {target.id}, which is not "
+                        "classified in the wire_spec ok-or-retryable "
+                        "taxonomy (add it to RETRYABLE_/PERMANENT_/"
+                        "TRANSPORT_EXCEPTIONS)", path, node.lineno))
+            # `raise self._error` / bare `raise` re-raise stored or
+            # in-flight classified errors — nothing new to classify
+
+    # --- TPU409/410: handler mapping, only in wire-handler files
+    if not in_wire_handler:
+        return diags
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try):
+            continue
+        retryable_intercepted = False
+        for handler in node.handlers:
+            names = _exc_names(handler.type)
+            broad = handler.type is None or "BaseException" in names \
+                or "Exception" in names
+            kinds = {k for k in (_classify(n, spec, bases)
+                                 for n in names) if k is not None}
+            replies = _reply_statuses(handler.body, status_consts)
+            reraises = _has_raise(handler.body)
+            if "retryable" in kinds or broad:
+                retryable_intercepted = True
+            if not replies:
+                continue
+            # a handler that catches ONLY classified named classes must
+            # reply their class's status (a broad arm may reply
+            # anything: the file's contract decides — the router sheds
+            # router faults as 2, the server reports bad requests as 1)
+            if names and not broad and kinds and not reraises:
+                for kind in kinds:
+                    want = (spec.STATUS_RETRYABLE if kind == "retryable"
+                            else spec.STATUS_ERROR if kind == "permanent"
+                            else None)
+                    if want is None:
+                        continue
+                    wrong = replies - {want, spec.STATUS_OK,
+                                       spec.STATUS_STREAM}
+                    if wrong:
+                        diags.append(_diag(
+                            "TPU409",
+                            f"handler catching {'/'.join(names)} "
+                            f"({kind}) replies wire status "
+                            f"{sorted(wrong)}; the taxonomy maps "
+                            f"{kind} exceptions to status {want}",
+                            path, handler.lineno))
+        # TPU410: a dispatch-calling try whose broad arm replies
+        # permanent needs a PRECEDING retryable arm, or a shed becomes
+        # a permanent error (exactly the mis-map the contract forbids)
+        callee = _calls_dispatch(node)
+        if callee is None:
+            continue
+        fn = _enclosing_function(tree, node)
+        if fn is not None and fn.name in total_fns:
+            # tries INSIDE a declared-total dispatcher are owned by
+            # _check_total_dispatcher below (same rule plus escape
+            # analysis) — running both would double-report one defect
+            continue
+        seen_retryable = False
+        for handler in node.handlers:
+            names = _exc_names(handler.type)
+            broad = handler.type is None or "BaseException" in names \
+                or "Exception" in names
+            kinds = {k for k in (_classify(n, spec, bases)
+                                 for n in names) if k is not None}
+            if "retryable" in kinds:
+                replies = _reply_statuses(handler.body, status_consts)
+                if not replies or spec.STATUS_RETRYABLE in replies \
+                        or _has_raise(handler.body):
+                    seen_retryable = True
+            if broad:
+                replies = _reply_statuses(handler.body, status_consts)
+                if spec.STATUS_ERROR in replies and not seen_retryable \
+                        and not _callee_is_total(callee, total_fns):
+                    diags.append(_diag(
+                        "TPU410",
+                        f"broad except around {callee}() replies wire "
+                        "status 1 with no preceding retryable arm: a "
+                        "shed/restart/deadline would be mis-mapped "
+                        "from retryable to permanent", path,
+                        handler.lineno))
+                break
+    # --- TPU410 totality: declared-total dispatchers verified
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name in total_fns:
+            diags.extend(_check_total_dispatcher(node, path, spec, bases,
+                                                 status_consts))
+    return diags
+
+
+def _callee_is_total(callee, total_fns):
+    return callee in total_fns
+
+
+def _enclosing_function(tree, target):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            for sub in ast.walk(node):
+                if sub is target:
+                    return node
+    return None
+
+
+def _check_total_dispatcher(fn, path, spec, bases, status_consts):
+    """A TOTAL dispatcher must wrap every engine dispatch call in a try
+    with a broad reply-bearing arm (preceded by a retryable arm when
+    the broad arm replies permanent), so no classified exception can
+    escape it into a caller that would hang or mis-map."""
+    diags = []
+    trys = [n for n in ast.walk(fn) if isinstance(n, ast.Try)]
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in DISPATCH_CALLEES):
+            continue
+        covering = [t for t in trys
+                    if any(node is sub for stmt in t.body
+                           for sub in ast.walk(stmt))]
+        ok = False
+        for t in covering:
+            seen_retryable = False
+            for handler in t.handlers:
+                names = _exc_names(handler.type)
+                broad = handler.type is None \
+                    or "BaseException" in names or "Exception" in names
+                kinds = {k for k in (_classify(n, spec, bases)
+                                     for n in names) if k is not None}
+                replies = _reply_statuses(handler.body, status_consts)
+                if "retryable" in kinds and (
+                        not replies or spec.STATUS_RETRYABLE in replies):
+                    seen_retryable = True
+                if broad and replies:
+                    if spec.STATUS_ERROR in replies \
+                            and not seen_retryable:
+                        continue
+                    ok = True
+        if not ok:
+            diags.append(_diag(
+                "TPU410",
+                f"{fn.name}() is declared a total dispatcher but its "
+                f"{node.func.attr}() call can let a classified "
+                "exception escape (no enclosing try with a broad "
+                "reply-bearing arm behind a retryable arm) — a caller "
+                "that trusts totality would hang or mis-map",
+                path, node.lineno))
+    return diags
+
+
+# ------------------------------------------------------- suppression
+
+_SUPPRESS_RE = re.compile(
+    r"(?:#|//)\s*(?:tracelint|tpu-lint)\s*:\s*disable"
+    r"(?:=([A-Z0-9,\s]+))?")
+
+
+def _suppressions(source):
+    """Line -> suppressed code set ('all' for a bare disable). Works on
+    every implementation language (#, //); first-five-lines directives
+    are file-level, mirroring the Python SuppressionIndex contract."""
+    by_line = {}
+    file_level = None
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        codes = ("all" if m.group(1) is None else
+                 {c.strip() for c in m.group(1).split(",") if c.strip()}
+                 or "all")
+        if i <= 5 and line.lstrip().startswith(("#", "//")):
+            if file_level is None or codes == "all":
+                file_level = codes
+            elif file_level != "all":
+                file_level |= codes
+        else:
+            by_line[i] = codes
+    return by_line, file_level
+
+
+def _apply_suppression(diags, sources_by_path):
+    out = []
+    cache = {}
+    for d in diags:
+        if d.filename not in cache:
+            cache[d.filename] = _suppressions(
+                sources_by_path.get(d.filename, ""))
+        by_line, file_level = cache[d.filename]
+        scopes = (file_level, by_line.get(d.line))
+        if any(s == "all" or (s and d.code in s) for s in scopes):
+            continue
+        out.append(d)
+    return out
+
+
+# ------------------------------------------------------------- driver
+
+def check_protocol(files=None, spec=None, root=None, taxonomy=True,
+                   disabled=()):
+    """Run the whole TPU401–TPU410 family.
+
+    ``files``: optional ``{impl_name: path}`` overrides (the planted-
+    drift gate tests point an implementation at a mutated fixture
+    copy); unlisted implementations use their spec-declared paths.
+    Returns a sorted Diagnostic list (suppression applied).
+    """
+    root = root or _REPO
+    spec = spec or load_spec(
+        os.path.join(root, _SPEC_RELPATH)
+        if os.path.exists(os.path.join(root, _SPEC_RELPATH)) else None)
+    files = files or {}
+    diags = []
+    sources_by_path = {}
+    for name, decl in sorted(spec.IMPLEMENTATIONS.items()):
+        path = files.get(name, os.path.join(root, decl.path))
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                source = f.read()
+        except OSError:
+            diags.append(_diag(
+                "TPU405", f"{name}: declared implementation file "
+                f"{decl.path} is missing", decl.path, 0))
+            continue
+        sources_by_path[path] = source
+        try:
+            ex = _EXTRACTORS[decl.lang](source, path, name=name)
+        except SyntaxError as e:
+            diags.append(_diag(
+                "TPU405", f"{name}: could not parse: {e}", path,
+                getattr(e, "lineno", 0) or 0))
+            continue
+        diags.extend(_diff_impl(ex, decl, spec))
+    if taxonomy:
+        for rel in TAXONOMY_FILES:
+            path = files.get(rel, os.path.join(root, rel))
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+            except OSError:
+                continue
+            sources_by_path[path] = source
+            try:
+                tree = ast.parse(source)
+            except SyntaxError:
+                continue
+            in_wire = os.path.basename(rel) in ("server.py", "router.py")
+            diags.extend(_check_taxonomy_file(tree, path, spec, in_wire))
+            if rel in LITERAL_CLEAN_FILES:
+                diags.extend(_check_py_literals(tree, path))
+    diags = _apply_suppression(diags, sources_by_path)
+    disabled = set(disabled)
+    return sorted((d for d in diags if d.code not in disabled),
+                  key=sort_key)

@@ -12,7 +12,8 @@ from .diagnostics import (Diagnostic, SuppressionIndex, filter_diagnostics,
                           format_json, format_text, sort_key)
 
 __all__ = ["lint_source", "lint_file", "lint_paths", "lint_function",
-           "lint_registry", "lint_concurrency", "LintResult"]
+           "lint_registry", "lint_concurrency", "lint_protocol",
+           "LintResult"]
 
 
 class LintResult:
@@ -144,3 +145,24 @@ def lint_concurrency(paths, disabled=()):
                                       suppression=suppression.get(fn)))
     return LintResult(sorted(out, key=sort_key),
                       files_scanned=len(sources))
+
+
+def lint_protocol(files=None, disabled=(), root=None):
+    """Wire-contract pass family (TPU4xx): cross-language protocol
+    drift against ``inference/wire_spec.py`` plus the ok-or-retryable
+    taxonomy over the Python serving stack. Unlike the other families
+    this one scans the spec-DECLARED implementation set (four files in
+    three non-Python languages among them), not arbitrary paths;
+    ``files`` maps implementation names to override paths (how the
+    planted-drift gate tests point one language at a mutated fixture
+    copy)."""
+    from . import protocol
+
+    diags = protocol.check_protocol(files=files, disabled=disabled,
+                                    root=root)
+    # the four implementations plus the Python taxonomy files (server
+    # and router are in both sets; counted once as implementations)
+    n = len(protocol.load_spec().IMPLEMENTATIONS) + sum(
+        1 for f in protocol.TAXONOMY_FILES
+        if f.rsplit("/", 1)[-1] not in ("server.py", "router.py"))
+    return LintResult(diags, files_scanned=n)

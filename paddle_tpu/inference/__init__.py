@@ -8,6 +8,12 @@ models are stored as serialized StableHLO (jax.export) produced by
 ``paddle.jit.save`` / ``paddle.static.save_inference_model``, and the
 predictor compiles them once per input-shape signature, then runs with
 device-resident inputs/outputs (the ZeroCopyRun analog).
+
+The serving wire protocol's machine-readable spec lives in
+``paddle_tpu.inference.wire_spec`` (commands, statuses, markers, dtype
+table, codec, error taxonomy) — the compatibility reference for
+external clients and the table the ``--protocol`` lint diffs every
+implementation against.
 """
 from .config import Config, PrecisionType, PlaceType
 from .predictor import Predictor, Tensor as PredictorTensor, create_predictor

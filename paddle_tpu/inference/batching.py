@@ -119,9 +119,10 @@ from ..serialize.export import deserialize_exported, serialize_exported
 # tpu-lock-order: BatchingEngine._lock < Metric._lock  # subsystem -> instrument
 # tpu-lock-order: BatchingEngine._lock < Registry._lock  # collectors run OUTSIDE the registry lock
 
-# Wire status byte for a shed request (server.py speaks it; defined here
-# so the engine has no import-time dependency on the server).
-OVERLOADED_STATUS = 2
+# Wire status byte for a shed request, from the machine-readable
+# protocol spec (wire_spec is import-light: the engine still has no
+# import-time dependency on the server).
+from .wire_spec import STATUS_RETRYABLE as OVERLOADED_STATUS  # noqa: E402
 
 
 class RetryableError(RuntimeError):

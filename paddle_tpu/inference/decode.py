@@ -115,11 +115,18 @@ from ..obs.ledger import LEDGER
 from ..resilience import chaos
 from ..resilience.retry import _env_float, _env_int
 from ..serialize import artifact_store as _artifacts
+from . import wire_spec as _wire_spec
 from ..serialize.export import (deserialize_exported, model_fingerprint,
                                 serialize_exported)
 from .batching import (BucketQuarantined, DeadlineExceeded, EngineClosed,
                        EngineOverloaded, RetryableError, SchedulerRestarted,
                        _Breaker, bucket_rows, store_backed_compile)
+
+# numpy dtypes the spec admits as decode prompts/token ids (wire codes
+# in wire_spec.TOKEN_DTYPE_CODES; the token chunks echo the prompt's
+# dtype bit for bit)
+_TOKEN_DTYPES = frozenset(_wire_spec.NUMPY_BY_CODE[c]
+                          for c in _wire_spec.TOKEN_DTYPE_CODES)
 
 # Machine-checked lock order (tools/tracelint.py --concurrency, TPU309):
 # the decode engine lock is a SUBSYSTEM lock like BatchingEngine's —
@@ -850,10 +857,10 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt must be a non-empty 1-D token array "
                 f"(got shape {tuple(prompt.shape)})")
-        if prompt.dtype == np.int64:
-            token_dtype = np.int64
-        elif prompt.dtype == np.int32:
-            token_dtype = np.int32
+        if prompt.dtype in _TOKEN_DTYPES:
+            # the spec's token-dtype set (wire codes 1/2): streamed
+            # chunks echo exactly this dtype back on the wire
+            token_dtype = prompt.dtype.type
         else:
             raise ValueError(
                 f"prompt dtype {prompt.dtype} is not a token dtype "

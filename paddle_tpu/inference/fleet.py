@@ -59,6 +59,7 @@ from ..obs import metrics as obs_metrics
 from .registry import EJECTED, ReplicaRegistry, _env_float, _env_int
 from .router import FleetRouter, TenantPolicy, tenant_id  # noqa: F401
 from .server import _read_all
+from .wire_spec import CMD_RELOAD, CMD_STOP
 
 _M_RESPAWNS = obs_metrics.counter(
     "paddle_fleet_respawns_total",
@@ -94,7 +95,7 @@ class ReplicaHandle:
             with socket.create_connection((self.host, self.port),
                                           timeout=2.0) as s:
                 s.settimeout(2.0)
-                s.sendall(struct.pack("<IB", 1, 7))
+                s.sendall(struct.pack("<IB", 1, CMD_STOP))
                 (blen,) = struct.unpack("<I", _read_all(s, 4))
                 _read_all(s, blen)
         except (OSError, ConnectionError):
@@ -357,7 +358,7 @@ class Fleet:
         for rid, handle in sorted(self.handles().items()):
             self.router.drain(rid, deadline_s=drain_deadline)
             try:
-                payload = struct.pack("<B", 4) + (
+                payload = struct.pack("<B", CMD_RELOAD) + (
                     (prefix or "").encode("utf-8"))
                 with socket.create_connection(
                         (handle.host, handle.port), timeout=300) as s:

@@ -41,6 +41,7 @@ import time
 
 from ..obs import metrics as obs_metrics
 from ..resilience import chaos
+from .wire_spec import CMD_HEALTH, STATUS_OK
 
 # replica lifecycle (the eject/readmit state machine)
 OK = "ok"            # routable
@@ -137,7 +138,7 @@ def _probe_health(host, port, timeout):
     Raises OSError/ConnectionError/TimeoutError on a dead replica."""
     with socket.create_connection((host, port), timeout=timeout) as s:
         s.settimeout(timeout)
-        s.sendall(struct.pack("<IB", 1, 3))
+        s.sendall(struct.pack("<IB", 1, CMD_HEALTH))
         hdr = b""
         while len(hdr) < 4:
             chunk = s.recv(4 - len(hdr))
@@ -151,7 +152,7 @@ def _probe_health(host, port, timeout):
             if not chunk:
                 raise ConnectionError("peer closed during health probe")
             body += chunk
-    if not body or body[0] != 0:
+    if not body or body[0] != STATUS_OK:
         raise ConnectionError(f"health probe returned status "
                               f"{body[0] if body else 'empty'}")
     return json.loads(body[1:].decode("utf-8"))

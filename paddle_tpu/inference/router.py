@@ -66,11 +66,16 @@ from ..obs import prometheus as obs_prometheus
 from ..resilience import chaos
 from ..resilience.retry import backoff_delays
 from .registry import ReplicaRegistry, _env_float, _env_int
-from .server import (DEADLINE_MARKER, DECODE_MARKER, DECODE_ONESHOT_BIT,
-                     MAX_BODY_BYTES, STATUS_ERROR, STATUS_OK,
-                     STATUS_OVERLOADED, STATUS_STREAM, TENANT_MARKER,
-                     TRACE_MARKER, BodyTooLarge, _decode_arrays_off,
-                     _read_all)
+from .server import MAX_BODY_BYTES, BodyTooLarge, _read_all
+# wire constants come from the ONE machine-readable spec (wire_spec.py;
+# the --protocol lint fails on hardcoded wire literals here)
+from .wire_spec import (CMD_DRAIN, CMD_HEALTH, CMD_INFER, CMD_METRICS,
+                        CMD_STATS, CMD_STOP, DEADLINE_MARKER,
+                        DECODE_MARKER, DECODE_ONESHOT_BIT, STATUS_ERROR,
+                        STATUS_OK, STATUS_STREAM, TENANT_MARKER,
+                        TRACE_MARKER)
+from .wire_spec import STATUS_RETRYABLE as STATUS_OVERLOADED
+from .wire_spec import decode_arrays_off as _decode_arrays_off
 
 DEFAULT_TENANT = "default"
 
@@ -778,7 +783,7 @@ class FleetRouter:
                     with socket.create_connection(
                             ep, timeout=self.registry.dial_timeout) as s:
                         s.settimeout(self.registry.dial_timeout)
-                        payload = struct.pack("<Bd", 8, float(deadline_s))
+                        payload = struct.pack("<Bd", CMD_DRAIN, float(deadline_s))
                         s.sendall(struct.pack("<I", len(payload)) + payload)
                         (blen,) = struct.unpack("<I", _read_all(s, 4))
                         _read_all(s, blen)
@@ -801,7 +806,7 @@ class FleetRouter:
                     with socket.create_connection(
                             ep, timeout=self.registry.dial_timeout) as s:
                         s.settimeout(self.registry.dial_timeout)
-                        payload = struct.pack("<Bd", 8, -1.0)
+                        payload = struct.pack("<Bd", CMD_DRAIN, -1.0)
                         s.sendall(struct.pack("<I", len(payload)) + payload)
                         (blen,) = struct.unpack("<I", _read_all(s, 4))
                         _read_all(s, blen)
@@ -833,7 +838,7 @@ class FleetRouter:
                 conn.settimeout(self.backend_timeout)
                 (blen,) = struct.unpack("<I", first + _read_all(conn, 3))
                 if blen == 0:
-                    conn.sendall(struct.pack("<IB", 1, 1))
+                    conn.sendall(struct.pack("<IB", 1, STATUS_ERROR))
                     continue
                 try:
                     body = _read_all(conn, blen, limit=self.max_body)
@@ -842,31 +847,34 @@ class FleetRouter:
                     # length prefix must not buffer gigabytes on the
                     # front tier; the stream can't be resynced — error
                     # status, then close
-                    conn.sendall(struct.pack("<IB", 1, 1))
+                    conn.sendall(struct.pack("<IB", 1, STATUS_ERROR))
                     return
                 cmd = body[0]
-                if cmd == 7:
-                    conn.sendall(struct.pack("<IB", 1, 0))
+                if cmd == CMD_STOP:
+                    conn.sendall(struct.pack("<IB", 1, STATUS_OK))
                     threading.Thread(target=self.stop,
                                      daemon=True).start()
                     return
-                if cmd == 3:
+                if cmd == CMD_HEALTH:
                     enc = json.dumps(self.health()).encode("utf-8")
-                    conn.sendall(struct.pack("<IB", 1 + len(enc), 0) + enc)
+                    conn.sendall(struct.pack("<IB", 1 + len(enc),
+                                             STATUS_OK) + enc)
                     continue
-                if cmd == 5:
+                if cmd == CMD_STATS:
                     enc = json.dumps(self.stats()).encode("utf-8")
-                    conn.sendall(struct.pack("<IB", 1 + len(enc), 0) + enc)
+                    conn.sendall(struct.pack("<IB", 1 + len(enc),
+                                             STATUS_OK) + enc)
                     continue
-                if cmd == 6:
+                if cmd == CMD_METRICS:
                     enc = obs_prometheus.render().encode("utf-8")
-                    conn.sendall(struct.pack("<IB", 1 + len(enc), 0) + enc)
+                    conn.sendall(struct.pack("<IB", 1 + len(enc),
+                                             STATUS_OK) + enc)
                     continue
-                if cmd != 1:
+                if cmd != CMD_INFER:
                     # reload/stop of individual replicas goes through
                     # Fleet.rolling_reload — a router-wide cmd 4 would
                     # be ambiguous about which replica it names
-                    conn.sendall(struct.pack("<IB", 1, 1))
+                    conn.sendall(struct.pack("<IB", 1, STATUS_ERROR))
                     continue
                 try:
                     resp = self._infer(body, client_conn=conn)

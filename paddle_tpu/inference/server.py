@@ -96,19 +96,27 @@ from ..obs import metrics as obs_metrics
 from ..obs import prometheus as obs_prometheus
 from ..obs import tracing as obs_tracing
 from .batching import EngineClosed, RetryableError
+# every wire constant comes from the ONE machine-readable spec
+# (wire_spec.py) — the protocol lint (tools/tracelint.py --protocol)
+# fails on any hardcoded wire literal reintroduced here, so this file
+# can never drift from the spec (or from the Go/R/C clients, which the
+# same lint diffs against it)
+from . import wire_spec
+from .wire_spec import (CMD_DRAIN, CMD_HEALTH, CMD_INFER, CMD_METRICS,
+                        CMD_RELOAD, CMD_STATS, CMD_STOP, DEADLINE_MARKER,
+                        DECODE_MARKER, DECODE_ONESHOT_BIT, TENANT_MARKER,
+                        TRACE_MARKER)
 
-_DTYPES = {0: np.float32, 1: np.int32, 2: np.int64, 3: np.bool_}
-_DTYPE_CODES = {np.dtype(np.float32): 0, np.dtype(np.int32): 1,
-                np.dtype(np.int64): 2, np.dtype(np.bool_): 3}
-# exact widenings only: half floats encode as f32 without corruption;
-# anything else (f64, unsigned, complex...) must raise, never silently
-# cast (the old behavior corrupted i64 token ids through an f32 cast)
-_WIDEN_TO_F32 = {"float16", "bfloat16"}
+# historical aliases (tests, bench.py, and the router import these
+# names from here): the tables live in wire_spec now
+_DTYPES = wire_spec.NUMPY_BY_CODE
+_DTYPE_CODES = wire_spec.CODE_BY_NUMPY
+_WIDEN_TO_F32 = wire_spec.WIDEN_TO_F32
 
-STATUS_OK = 0
-STATUS_ERROR = 1
-STATUS_OVERLOADED = RetryableError.status_code  # 2
-STATUS_STREAM = 3  # non-final chunk of a streaming decode reply
+STATUS_OK = wire_spec.STATUS_OK
+STATUS_ERROR = wire_spec.STATUS_ERROR
+STATUS_OVERLOADED = wire_spec.STATUS_RETRYABLE  # == RetryableError.status_code
+STATUS_STREAM = wire_spec.STATUS_STREAM  # non-final streaming chunk
 
 # Machine-checked lock order (tools/tracelint.py --concurrency, TPU309):
 # one reload at a time (coarse, dedicated) > the backend swap lock (held
@@ -118,15 +126,6 @@ STATUS_STREAM = 3  # non-final chunk of a streaming decode reply
 # tpu-lock-order: PredictorServer._reload_lock < PredictorServer._backend_lock  # swap happens inside a reload
 # tpu-lock-order: PredictorServer._reload_lock < BatchingEngine._lock  # reload warms/closes engines
 # tpu-lock-order: PredictorServer._backend_lock < Metric._lock  # counters bump under the swap lock
-
-# Optional trailing field markers on cmd-1 infer bodies. A marker byte
-# (not bare trailing bytes) so garbage tails can't be misread as a
-# field; fields may appear in any order, each marker at most once.
-DEADLINE_MARKER = 0xDD  # + f64 relative budget in ms
-TRACE_MARKER = 0x1D  # + u64 non-zero trace id (obs.tracing)
-TENANT_MARKER = 0x7E  # + u64 tenant id (fleet router admission/SLOs)
-DECODE_MARKER = 0x5C  # + u64: low 32 bits max_new_tokens, bit 63 oneshot
-DECODE_ONESHOT_BIT = 1 << 63
 
 # Hardening knobs: a 4-byte length prefix from a buggy/malicious client
 # must not trigger an unbounded allocation, and a stalled client must
@@ -155,110 +154,17 @@ def _read_all(sock, n, limit=None):
     return b"".join(chunks)
 
 
-def _encode_arrays(arrays):
-    out = [struct.pack("<B", len(arrays))]
-    for a in arrays:
-        a = np.ascontiguousarray(a)
-        code = _DTYPE_CODES.get(a.dtype)
-        if code is None:
-            if a.dtype.name in _WIDEN_TO_F32:
-                a = a.astype(np.float32)  # exact widening, not corruption
-                code = 0
-            else:
-                raise TypeError(
-                    f"dtype {a.dtype} is not encodable on the wire "
-                    "(supported: float32, int32, int64, bool, plus "
-                    "f16/bf16 widened to f32)")
-        out.append(struct.pack("<BB", code, a.ndim))
-        out.append(struct.pack(f"<{a.ndim}q", *a.shape))
-        out.append(a.tobytes())
-    return b"".join(out)
-
-
-def _encode_deadline(timeout_ms):
-    """Trailing optional deadline field a new client appends to a cmd-1
-    body (old servers ignore it)."""
-    return struct.pack("<Bd", DEADLINE_MARKER, float(timeout_ms))
-
-
-def _encode_trace(trace_id):
-    """Trailing optional trace-id field (old servers ignore it)."""
-    return struct.pack("<BQ", TRACE_MARKER, int(trace_id))
-
-
-def _decode_arrays_off(payload):
-    off = 0
-    (n,) = struct.unpack_from("<B", payload, off)
-    off += 1
-    arrays = []
-    for _ in range(n):
-        code, ndim = struct.unpack_from("<BB", payload, off)
-        off += 2
-        dims = struct.unpack_from(f"<{ndim}q", payload, off)
-        off += 8 * ndim
-        dt = _DTYPES[code]
-        count = int(np.prod(dims)) if dims else 1
-        arr = np.frombuffer(payload, dt, count, off).reshape(dims)
-        off += arr.nbytes
-        arrays.append(arr)
-    return arrays, off
-
-
-def _decode_arrays(payload):
-    return _decode_arrays_off(payload)[0]
-
-
-def _encode_tenant(tenant_id):
-    """Trailing optional tenant-id field (the fleet router keys WFQ
-    admission and per-tenant SLO accounting on it; a direct replica
-    parses and ignores it — old servers must see it LAST)."""
-    return struct.pack("<BQ", TENANT_MARKER, int(tenant_id))
-
-
-def _encode_decode_opts(max_new_tokens, oneshot=False):
-    """Trailing optional decode field: marks a cmd-1 body as a
-    continuous-batching decode request (old servers ignore it)."""
-    val = int(max_new_tokens) & 0xFFFFFFFF
-    if oneshot:
-        val |= DECODE_ONESHOT_BIT
-    return struct.pack("<BQ", DECODE_MARKER, val)
-
-
-def _decode_request(payload):
-    """Decode a cmd-1 infer body: arrays plus the optional trailing
-    marker-tagged fields (deadline, trace id, tenant id, decode opts —
-    any order). Returns (arrays, budget_seconds_or_None,
-    trace_id_or_None, decode_opts_or_None) where decode_opts is
-    ``{"max_new_tokens": n, "oneshot": bool}``. Parsing stops at the
-    first unknown marker: old servers ignored trailing garbage, and a
-    field this server predates must not be misread."""
-    arrays, off = _decode_arrays_off(payload)
-    budget = None
-    trace_id = None
-    tenant = None
-    decode_opts = None
-    while len(payload) - off >= 9:
-        marker = payload[off]
-        if marker == DEADLINE_MARKER and budget is None:
-            (timeout_ms,) = struct.unpack_from("<d", payload, off + 1)
-            budget = max(0.0, float(timeout_ms)) / 1000.0
-        elif marker == TRACE_MARKER and trace_id is None:
-            (tid,) = struct.unpack_from("<Q", payload, off + 1)
-            trace_id = tid or None  # 0 = "no trace" on the wire
-        elif marker == TENANT_MARKER and tenant is None:
-            # admission control happened at the router; a replica just
-            # skips past so fields AFTER the tenant id still parse
-            (tenant,) = struct.unpack_from("<Q", payload, off + 1)
-        elif marker == DECODE_MARKER and decode_opts is None:
-            (val,) = struct.unpack_from("<Q", payload, off + 1)
-            decode_opts = {
-                "max_new_tokens": int(val & 0xFFFFFFFF) or None,
-                "oneshot": bool(val & DECODE_ONESHOT_BIT),
-            }
-        else:
-            break
-        off += 9
-    return arrays, budget, trace_id, decode_opts
+# The codec lives in wire_spec (the one Python encoder/decoder of the
+# framing); these historical underscore names are what the rest of the
+# repo — router, bench.py, the serving test tree — imports from here.
+_encode_arrays = wire_spec.encode_arrays
+_encode_deadline = wire_spec.encode_deadline
+_encode_trace = wire_spec.encode_trace
+_encode_tenant = wire_spec.encode_tenant
+_encode_decode_opts = wire_spec.encode_decode_opts
+_decode_arrays_off = wire_spec.decode_arrays_off
+_decode_arrays = wire_spec.decode_arrays
+_decode_request = wire_spec.decode_request
 
 
 class PredictorServer:
@@ -582,7 +488,8 @@ class PredictorServer:
         if dec is None or not inputs:
             self._m_responses.inc(status=str(STATUS_ERROR))
             enc = b"no decode engine attached to this server"
-            conn.sendall(struct.pack("<IB", 1 + len(enc), 1) + enc)
+            conn.sendall(struct.pack("<IB", 1 + len(enc), STATUS_ERROR)
+                         + enc)
             return
         t0 = time.perf_counter()
         try:
@@ -595,7 +502,7 @@ class PredictorServer:
             return
         except Exception:  # noqa: BLE001 - bad request (shape/dtype)
             self._m_responses.inc(status=str(STATUS_ERROR))
-            conn.sendall(struct.pack("<IB", 1, 1))
+            conn.sendall(struct.pack("<IB", 1, STATUS_ERROR))
             return
         if opts.get("oneshot"):
             try:
@@ -608,7 +515,7 @@ class PredictorServer:
             except Exception:  # noqa: BLE001 - protocol error status
                 dec.cancel(req)
                 self._m_responses.inc(status=str(STATUS_ERROR))
-                conn.sendall(struct.pack("<IB", 1, 1))
+                conn.sendall(struct.pack("<IB", 1, STATUS_ERROR))
                 return
             enc = _encode_arrays([tokens])
             self._m_responses.inc(status=str(STATUS_OK))
@@ -675,7 +582,7 @@ class PredictorServer:
                     # malformed (a body always has at least the cmd
                     # byte) but the stream is still in sync: report and
                     # keep serving
-                    conn.sendall(struct.pack("<IB", 1, 1))
+                    conn.sendall(struct.pack("<IB", 1, STATUS_ERROR))
                     continue
                 self._set_busy(True)  # a frame is in flight: drain waits
                 try:
@@ -684,59 +591,63 @@ class PredictorServer:
                     # cap exceeded: error status, then close — the rest
                     # of the oversized frame is unread, so the stream
                     # cannot be resynced
-                    conn.sendall(struct.pack("<IB", 1, 1))
+                    conn.sendall(struct.pack("<IB", 1, STATUS_ERROR))
                     return
                 cmd = body[0]
                 self._m_frames.inc(cmd=str(cmd))
-                if cmd == 7:
-                    conn.sendall(struct.pack("<IB", 1, 0))
+                if cmd == CMD_STOP:
+                    conn.sendall(struct.pack("<IB", 1, STATUS_OK))
                     threading.Thread(target=self.stop, daemon=True).start()
                     return
-                if cmd == 3:
+                if cmd == CMD_HEALTH:
                     enc = self._health_json().encode("utf-8")
-                    conn.sendall(struct.pack("<IB", 1 + len(enc), 0) + enc)
+                    conn.sendall(struct.pack("<IB", 1 + len(enc),
+                                             STATUS_OK) + enc)
                     self._set_busy(False)
                     continue
-                if cmd == 6:
+                if cmd == CMD_METRICS:
                     enc = obs_prometheus.render().encode("utf-8")
-                    conn.sendall(struct.pack("<IB", 1 + len(enc), 0) + enc)
+                    conn.sendall(struct.pack("<IB", 1 + len(enc),
+                                             STATUS_OK) + enc)
                     self._set_busy(False)
                     continue
-                if cmd == 4:
+                if cmd == CMD_RELOAD:
                     prefix = body[1:].decode("utf-8", errors="replace")
                     try:
                         info = self.reload(prefix or None)
                         enc = json.dumps(info).encode("utf-8")
-                        conn.sendall(struct.pack("<IB", 1 + len(enc), 0)
-                                     + enc)
+                        conn.sendall(struct.pack("<IB", 1 + len(enc),
+                                                 STATUS_OK) + enc)
                     except Exception as e:  # noqa: BLE001 - wire error
                         enc = str(e).encode("utf-8", errors="replace")
-                        conn.sendall(struct.pack("<IB", 1 + len(enc), 1)
-                                     + enc)
+                        conn.sendall(struct.pack("<IB", 1 + len(enc),
+                                                 STATUS_ERROR) + enc)
                     self._set_busy(False)
                     continue
-                if cmd == 5:
+                if cmd == CMD_STATS:
                     enc = self._stats_json().encode("utf-8")
-                    conn.sendall(struct.pack("<IB", 1 + len(enc), 0) + enc)
+                    conn.sendall(struct.pack("<IB", 1 + len(enc),
+                                             STATUS_OK) + enc)
                     self._set_busy(False)
                     continue
-                if cmd == 8:
+                if cmd == CMD_DRAIN:
                     deadline_s = (struct.unpack("<d", body[1:9])[0]
                                   if len(body) >= 9 else None)
                     self.begin_drain(deadline_s)
                     enc = self._health_json().encode("utf-8")
-                    conn.sendall(struct.pack("<IB", 1 + len(enc), 0) + enc)
+                    conn.sendall(struct.pack("<IB", 1 + len(enc),
+                                             STATUS_OK) + enc)
                     self._set_busy(False)
                     continue
-                if cmd != 1:
-                    conn.sendall(struct.pack("<IB", 1, 1))
+                if cmd != CMD_INFER:
+                    conn.sendall(struct.pack("<IB", 1, STATUS_ERROR))
                     self._set_busy(False)
                     continue
                 try:
                     parsed = _decode_request(body[1:])
                 except Exception:  # noqa: BLE001 - malformed body
                     self._m_responses.inc(status=str(STATUS_ERROR))
-                    conn.sendall(struct.pack("<IB", 1, 1))
+                    conn.sendall(struct.pack("<IB", 1, STATUS_ERROR))
                     self._set_busy(False)
                     continue
                 if parsed[3] is not None:
@@ -762,7 +673,7 @@ class PredictorServer:
                     conn.sendall(struct.pack("<IB", 1, STATUS_OVERLOADED))
                 except Exception:  # noqa: BLE001 - protocol error status
                     self._m_responses.inc(status=str(STATUS_ERROR))
-                    conn.sendall(struct.pack("<IB", 1, 1))
+                    conn.sendall(struct.pack("<IB", 1, STATUS_ERROR))
                 self._set_busy(False)
         except socket.timeout:
             pass

@@ -33,6 +33,7 @@ from paddle_tpu.inference.registry import (DRAINING, EJECTED, OK, PROBING,
                                            ReplicaRegistry)
 from paddle_tpu.inference.router import (FairGate, FleetRouter, ShedError,
                                          TenantPolicy, tenant_id)
+from paddle_tpu.inference import wire_spec
 from paddle_tpu.inference.server import (PredictorServer, _decode_arrays,
                                          _decode_request, _encode_arrays,
                                          _encode_deadline, _encode_tenant,
@@ -54,10 +55,10 @@ def _clean_chaos():
 
 
 def _frame(arrays, *tail):
-    body = struct.pack("<B", 1) + _encode_arrays(arrays)
-    for t in tail:
-        body += t
-    return struct.pack("<I", len(body)) + body
+    # spec-driven frame build: the grammar (cmd byte + array block +
+    # trailing fields) comes from wire_spec, not a hand-rolled pack
+    return wire_spec.build_request(
+        wire_spec.CMD_INFER, _encode_arrays(arrays) + b"".join(tail))
 
 
 def _request(port, frame, timeout=10):

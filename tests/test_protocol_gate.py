@@ -1,0 +1,313 @@
+"""The TPU4xx wire-contract family's red/green gate contract: planted
+single-constant drift in ANY of the four languages fails
+``ci_gate --protocol`` naming the language and the constant; the real
+tree is green; the taxonomy passes catch mis-maps, dropped retryable
+arms, unclassified raises, and hardcoded wire literals; the CLI JSON
+schema carries the ``protocol`` timing group.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu.analysis import protocol
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATE = os.path.join(REPO, "tools", "ci_gate.py")
+TRACELINT = os.path.join(REPO, "tools", "tracelint.py")
+
+
+def _read(rel):
+    with open(os.path.join(REPO, rel), encoding="utf-8") as f:
+        return f.read()
+
+
+def _mutated(tmp_path, rel, old, new):
+    src = _read(rel)
+    assert src.count(old) == 1, f"mutation anchor drifted: {old!r}"
+    fix = tmp_path / os.path.basename(rel)
+    fix.write_text(src.replace(old, new), encoding="utf-8")
+    return str(fix)
+
+
+def _run(cmd):
+    return subprocess.run(cmd, capture_output=True, text=True, cwd=REPO)
+
+
+def _summary(r):
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+# ------------------------------------------- planted drift per language
+
+DRIFTS = {
+    "go-client": ("clients/go/paddle_tpu/client.go",
+                  "dtypeI64  = 2", "dtypeI64  = 5",
+                  ["TPU401", "int64", "go-client"]),
+    "r-client": ("clients/r/predictor.R",
+                 "int64 = 2L", "int64 = 6L",
+                 ["TPU401", "int64", "r-client"]),
+    "c-client": ("paddle_tpu/native/c_api.cc",
+                 "case 2: return 8;  // i64", "case 2: return 4;  // i64",
+                 ["TPU401", "int64", "c-client"]),
+}
+
+
+@pytest.mark.parametrize("impl", sorted(DRIFTS))
+def test_planted_dtype_drift_fails_naming_language_and_constant(
+        tmp_path, impl):
+    rel, old, new, want = DRIFTS[impl]
+    fix = _mutated(tmp_path, rel, old, new)
+    diags = protocol.check_protocol(files={impl: fix}, taxonomy=False)
+    hits = [d for d in diags if d.code.startswith("TPU4")]
+    assert hits, "planted drift not detected"
+    blob = "\n".join(d.format() for d in hits)
+    for needle in want:
+        assert needle in blob, (needle, blob)
+
+
+def test_planted_python_table_drift_fails(tmp_path):
+    """A Python server carrying literal tables (an out-of-tree fork, or
+    the pre-refactor layout the fixture mimics) is extracted and
+    diffed like any other language."""
+    fix = tmp_path / "server_tables.py"
+    fix.write_text(
+        "import numpy as np\n"
+        "_DTYPES = {0: np.float32, 1: np.int32, 2: np.int64,"
+        " 3: np.bool_}\n"
+        "DEADLINE_MARKER = 0xDE\n"   # planted: spec says 0xDD
+        "TRACE_MARKER = 0x1D\n"
+        "TENANT_MARKER = 0x7E\n"
+        "DECODE_MARKER = 0x5C\n"
+        "DECODE_ONESHOT_BIT = 1 << 63\n"
+        "STATUS_OK = 0\nSTATUS_ERROR = 1\nSTATUS_OVERLOADED = 2\n"
+        "STATUS_STREAM = 3\n"
+        "CMD_INFER = 1\nCMD_HEALTH = 3\nCMD_RELOAD = 4\nCMD_STATS = 5\n"
+        "CMD_METRICS = 6\nCMD_STOP = 7\nCMD_DRAIN = 8\n")
+    diags = protocol.check_protocol(files={"python-server": str(fix)},
+                                    taxonomy=False)
+    hits = [d.format() for d in diags if d.code == "TPU402"]
+    assert any("deadline" in h and "0xDE" in h and "0xDD" in h
+               for h in hits), diags
+
+
+def test_real_tree_is_green():
+    assert protocol.check_protocol() == []
+
+
+def test_named_status_drifted_onto_another_valid_value(tmp_path):
+    """Review regression: STATUS_ERROR = 2 is value-wise a legal
+    status, but by NAME it surfaces every permanent error as
+    retryable — the named-constant diff must catch it (and the
+    symmetric CMD_STOP = 8, which is value-wise the drain command)."""
+    fix = tmp_path / "consts.py"
+    fix.write_text("STATUS_OK = 0\nSTATUS_ERROR = 2\nSTATUS_STREAM = 3\n"
+                   "CMD_STOP = 8\n")
+    diags = protocol.check_protocol(files={"python-server": str(fix)},
+                                    taxonomy=False)
+    assert any(d.code == "TPU403" and "STATUS_ERROR = 2" in d.message
+               for d in diags), diags
+    assert any(d.code == "TPU404" and "CMD_STOP = 8" in d.message
+               for d in diags), diags
+
+
+def test_go_scanner_ignores_unrelated_compares_and_switches(tmp_path):
+    """Review regression: only `resp[0] == N` records a status (not a
+    second compare sharing the line) and only cases of a switch over
+    the status byte count — an unrelated switch's integer cases must
+    not fabricate TPU403 findings."""
+    src = (
+        "package p\n"
+        "func f(resp []byte, chunk []byte, n int) {\n"
+        "\tif resp[0] == 0 && len(chunk) == 7 {\n"
+        "\t}\n"
+        "\tswitch n {\n"
+        "\tcase 4:\n"
+        "\tcase 9:\n"
+        "\t}\n"
+        "\tswitch resp[0] {\n"
+        "\tcase 2:\n"
+        "\t}\n"
+        "}\n")
+    ex = protocol.extract_go(src, "t.go")
+    assert set(ex.statuses) == {0, 2}, ex.statuses
+
+
+# ------------------------------------------------- taxonomy red paths
+
+_RETRYABLE_ARM = """                except (RetryableError, EngineClosed):
+                    # load shed / quarantined bucket / scheduler restart
+                    # / expired deadline: a fast, explicit rejection the
+                    # client can retry — never an unbounded queue, never
+                    # a hang. EngineClosed (a request racing back-to-back
+                    # reloads or a stop past _infer's one retry) is
+                    # equally transient: the next attempt lands on the
+                    # swapped-in engine or a cleanly-restarted server.
+                    self._m_responses.inc(status=str(STATUS_OVERLOADED))
+                    conn.sendall(struct.pack("<IB", 1, STATUS_OVERLOADED))
+"""
+
+
+def _server_taxonomy_codes(tmp_path, old, new, name):
+    fix = _mutated(tmp_path, "paddle_tpu/inference/server.py", old, new)
+    diags = protocol.check_protocol(
+        files={"paddle_tpu/inference/server.py": fix,
+               "python-server": fix})
+    return {d.code for d in diags if d.filename == fix}
+
+
+def test_retryable_mapped_to_permanent_is_tpu409(tmp_path):
+    codes = _server_taxonomy_codes(
+        tmp_path, _RETRYABLE_ARM,
+        """                except (RetryableError, EngineClosed):
+                    self._m_responses.inc(status=str(STATUS_ERROR))
+                    conn.sendall(struct.pack("<IB", 1, STATUS_ERROR))
+""", "mismap")
+    assert "TPU409" in codes
+
+
+def test_dropped_retryable_arm_is_tpu410(tmp_path):
+    codes = _server_taxonomy_codes(
+        tmp_path, _RETRYABLE_ARM + "                except Exception:",
+        "                except Exception:", "dropped")
+    assert "TPU410" in codes
+
+
+def test_unclassified_raise_is_tpu408(tmp_path):
+    src = _read("paddle_tpu/inference/server.py")
+    mut = src.replace(
+        "class BodyTooLarge(ValueError):\n    pass",
+        "class BodyTooLarge(ValueError):\n    pass\n\n\n"
+        "class WeirdNewError(ArithmeticError):\n    pass")
+    mut = mut.replace(
+        'raise BodyTooLarge(f"frame of {n} bytes exceeds cap {limit}")',
+        'raise WeirdNewError(f"frame of {n} bytes exceeds cap {limit}")')
+    assert mut != src
+    fix = tmp_path / "server.py"
+    fix.write_text(mut, encoding="utf-8")
+    diags = protocol.check_protocol(
+        files={"paddle_tpu/inference/server.py": str(fix),
+               "python-server": str(fix)})
+    assert any(d.code == "TPU408" and "WeirdNewError" in d.message
+               for d in diags)
+
+
+def test_hardcoded_wire_literal_is_tpu407(tmp_path):
+    codes = _server_taxonomy_codes(
+        tmp_path, "if cmd == CMD_STOP:", "if cmd == 7:", "literal")
+    assert "TPU407" in codes
+
+
+def test_broken_total_dispatcher_is_tpu410(tmp_path):
+    """Deleting router._infer's broad shed arm breaks its declared
+    totality — the contract's 'router faults shed, never error/hang'
+    half."""
+    old = """            except Exception:  # noqa: BLE001 — router fault, not the
+                # request's fault: the contract is ok-or-retryable, so
+                # an internal routing failure (including an armed
+                # chaos fault on fleet.route) sheds instead of erroring
+                _M_SHEDS.inc(tenant=tenant_name, reason="router_fault")
+                outcome = "shed"
+                status = STATUS_OVERLOADED
+                return struct.pack("<B", STATUS_OVERLOADED)
+"""
+    fix = _mutated(tmp_path, "paddle_tpu/inference/router.py", old, "")
+    diags = protocol.check_protocol(
+        files={"paddle_tpu/inference/router.py": fix})
+    assert any(d.code == "TPU410" and "_infer" in d.message
+               for d in diags)
+
+
+def test_waiver_suppresses_with_any_comment_syntax(tmp_path):
+    """The tpu-lint waiver tag works in non-Python implementations
+    (// and # comments) — the documented escape hatch for a partial
+    client the IMPLEMENTATIONS declaration cannot express."""
+    rel, old, new, _ = DRIFTS["go-client"]
+    src = _read(rel).replace(
+        old, new + " // tpu-lint: disable=TPU401  # planted-drift waiver")
+    fix = tmp_path / "client.go"
+    fix.write_text(src, encoding="utf-8")
+    diags = protocol.check_protocol(files={"go-client": str(fix)},
+                                    taxonomy=False)
+    # the mutated const line is waived; the size-table and coverage
+    # findings on OTHER lines still fire — a waiver is line-scoped
+    assert not any(d.code == "TPU401" and "wire code 5" in d.message
+                   for d in diags)
+
+
+# ----------------------------------------------------- CLI + gate
+
+def test_tracelint_protocol_json_schema():
+    r = _run([sys.executable, TRACELINT, "paddle_tpu",
+              "--protocol-only", "--format", "json"])
+    blob = json.loads(r.stdout)
+    assert blob["schema_version"] >= 3
+    assert "protocol" in blob["timings_s"]
+    assert r.returncode == 0, r.stdout[-2000:]
+    assert not any(f["code"].startswith("TPU4")
+                   for f in blob["findings"])
+
+
+def test_tracelint_impl_override_red(tmp_path):
+    rel, old, new, want = DRIFTS["go-client"]
+    fix = _mutated(tmp_path, rel, old, new)
+    r = _run([sys.executable, TRACELINT, "paddle_tpu",
+              "--protocol-only", "--format", "json",
+              "--impl", f"go-client={fix}"])
+    assert r.returncode == 1
+    blob = json.loads(r.stdout)
+    assert any(f["code"] == "TPU401" for f in blob["findings"])
+
+
+def test_ci_gate_protocol_stage_green_and_summary_keys():
+    r = _run([sys.executable, GATE, "--protocol", "--skip-tests"])
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-1000:]
+    s = _summary(r)
+    assert s["protocol_run"] is True and s["protocol_ok"] is True
+    assert s["protocol_tpu4xx"] == 0
+    assert "+protocol" in s["gate"]
+
+
+@pytest.mark.parametrize("impl", sorted(DRIFTS))
+def test_ci_gate_protocol_stage_red_per_language(tmp_path, impl):
+    rel, old, new, want = DRIFTS[impl]
+    fix = _mutated(tmp_path, rel, old, new)
+    r = _run([sys.executable, GATE, "--protocol", "--skip-tests",
+              "--protocol-impl", f"{impl}={fix}"])
+    assert r.returncode == 1
+    s = _summary(r)
+    assert s["protocol_run"] is True and s["protocol_ok"] is False
+    assert s["protocol_tpu4xx"] >= 1
+    for needle in want:
+        assert needle in r.stdout, (needle, r.stdout[-3000:])
+
+
+def test_ci_gate_protocol_summary_keys_present_when_not_run(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text("def f(x):\n    return x\n")
+    r = _run([sys.executable, GATE, "--paths", str(good),
+              "--skip-tests"])
+    s = _summary(r)
+    assert s["protocol_run"] is False and s["protocol_ok"] is True
+    assert s["protocol_tpu4xx"] == 0
+
+
+def test_justified_tpu4_waiver_noted_not_violation(tmp_path):
+    """The suppression audit extends the TPU3xx documented-waiver
+    carve-out to TPU4xx: justified = noted, unjustified = violation,
+    even in a clean path."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import ci_gate
+    finally:
+        sys.path.pop(0)
+    f = tmp_path / "mod.py"
+    f.write_text("X = 1  # tpu-lint: disable=TPU405  # partial client: "
+                 "stream path only\n"
+                 "Y = 2  # tpu-lint: disable=TPU405\n")
+    entries, violations = ci_gate.audit_suppressions(
+        [str(f)], clean_paths=[str(tmp_path)])
+    assert len(entries) == 2
+    assert len(violations) == 1 and violations[0]["line"] == 2

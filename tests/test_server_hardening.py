@@ -8,6 +8,7 @@ import time
 import numpy as np
 import pytest
 
+from paddle_tpu.inference import wire_spec
 from paddle_tpu.inference.server import PredictorServer, _encode_arrays
 
 
@@ -19,8 +20,9 @@ def _mk_server(run_fn=None, **kw):
 
 
 def _infer_frame(arr):
-    enc = _encode_arrays([arr])
-    return struct.pack("<IB", 1 + len(enc), 1) + enc
+    # spec-driven frame build (wire_spec is the one codec)
+    return wire_spec.build_request(wire_spec.CMD_INFER,
+                                   _encode_arrays([arr]))
 
 
 def _recv_frame(s):

@@ -1,0 +1,283 @@
+"""wire_spec contracts (tier-1, numpy-only — no jax, no sockets):
+
+1. the spec's own tables are pinned (an accidental edit to a wire
+   constant is loud, not silent),
+2. spec-driven frame round trips — every command x dtype x
+   trailing-field-order permutation encodes through the spec codec and
+   decodes back exactly (the grammar IS the test matrix, replacing
+   ad-hoc per-suite frame builders),
+3. the server's historical aliases stay bound to the spec,
+4. the README "Wire protocol" block matches the generated table byte
+   for byte (the KNOWN_FAILURES discipline applied to docs),
+5. the TPU4xx protocol lint is clean repo-wide (the acceptance bar),
+   and the satellite drift fixes stay pinned at extractor level.
+"""
+import itertools
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from paddle_tpu.analysis import protocol
+from paddle_tpu.inference import wire_spec as ws
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RNG = np.random.RandomState(1234)  # fixed seed: tier-1 determinism
+
+
+def _sample(code, shape=(2, 3)):
+    d = ws.NUMPY_BY_CODE[code]
+    if d == np.bool_:
+        return RNG.rand(*shape) > 0.5
+    if d.kind == "i":
+        return RNG.randint(-(2 ** 31), 2 ** 31, size=shape).astype(d)
+    return RNG.rand(*shape).astype(d)
+
+
+# ------------------------------------------------------------ spec pins
+
+def test_spec_tables_pinned():
+    """The wire constants, pinned: changing any of these is a protocol
+    revision touching four languages, never a casual edit."""
+    assert {c: (d.name, d.size) for c, d in ws.DTYPES.items()} == {
+        0: ("float32", 4), 1: ("int32", 4), 2: ("int64", 8),
+        3: ("bool", 1)}
+    assert ws.MAX_DTYPE_CODE == 3
+    assert {m.name: m.byte for m in ws.MARKERS.values()} == {
+        "deadline": 0xDD, "trace": 0x1D, "tenant": 0x7E, "decode": 0x5C}
+    assert {s.code: s.name for s in ws.STATUSES.values()} == {
+        0: "ok", 1: "error", 2: "retryable", 3: "stream"}
+    assert {c.code: c.name for c in ws.COMMANDS.values()} == {
+        1: "infer", 3: "health", 4: "reload", 5: "stats",
+        6: "metrics", 7: "stop", 8: "drain"}
+    assert ws.DECODE_ONESHOT_BIT == 1 << 63
+    assert ws.FIELD_SIZE == 9
+    assert ws.STATUSES[ws.STATUS_STREAM].terminal is False
+    assert all(ws.STATUSES[s].terminal
+               for s in (ws.STATUS_OK, ws.STATUS_ERROR,
+                         ws.STATUS_RETRYABLE))
+    assert ws.TOKEN_DTYPE_CODES == {1, 2}
+
+
+def test_taxonomy_is_disjoint_and_total_for_known_raisers():
+    sets = (ws.RETRYABLE_EXCEPTIONS, ws.PERMANENT_EXCEPTIONS,
+            ws.TRANSPORT_EXCEPTIONS)
+    for a, b in itertools.combinations(sets, 2):
+        assert not (a & b), a & b
+    assert ws.classify_exception("EngineOverloaded") == "retryable"
+    assert ws.classify_exception("ValueError") == "permanent"
+    assert ws.classify_exception("_ClientGone") == "transport"
+    assert ws.classify_exception("TotallyNovel") is None
+    assert ws.status_for_exception("ShedError") == ws.STATUS_RETRYABLE
+    assert ws.status_for_exception("BodyTooLarge") == ws.STATUS_ERROR
+    assert ws.status_for_exception("OSError") is None
+
+
+def test_implementations_declare_existing_files():
+    for impl in ws.IMPLEMENTATIONS.values():
+        assert os.path.exists(os.path.join(REPO, impl.path)), impl.path
+
+
+# ------------------------------------------------- codec round trips
+
+@pytest.mark.parametrize("code", sorted(ws.DTYPES))
+def test_array_roundtrip_every_dtype(code):
+    arrays = [_sample(code), _sample(code, shape=(5,)),
+              _sample(code, shape=(1, 2, 2))]
+    out = ws.decode_arrays(ws.encode_arrays(arrays))
+    for a, b in zip(arrays, out):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert bytes(a.tobytes()) == bytes(b.tobytes())  # bitwise
+
+
+def test_half_floats_widen_exactly_and_f64_raises():
+    h = np.array([0.5, -2.0, 1.25], np.float16)
+    (out,) = ws.decode_arrays(ws.encode_arrays([h]))
+    assert out.dtype == np.float32
+    np.testing.assert_array_equal(out, h.astype(np.float32))
+    with pytest.raises(TypeError):
+        ws.encode_arrays([np.zeros(3, np.float64)])
+
+
+_FIELD_VALUES = {
+    "deadline": 1500.0,           # ms
+    "trace": 0xDEADBEEF,
+    "tenant": 0x7777,
+    "decode": 17 | ws.DECODE_ONESHOT_BIT,
+}
+
+
+def _encode_field(name):
+    if name == "deadline":
+        return ws.encode_deadline(_FIELD_VALUES["deadline"])
+    if name == "trace":
+        return ws.encode_trace(_FIELD_VALUES["trace"])
+    if name == "tenant":
+        return ws.encode_tenant(_FIELD_VALUES["tenant"])
+    return ws.encode_decode_opts(17, oneshot=True)
+
+
+def test_every_dtype_x_field_order_permutation_roundtrips():
+    """The grammar's am-I-really-order-independent matrix: every dtype
+    x every ordering of every subset of the four trailing fields. 260
+    frames, all through the ONE spec codec."""
+    names = sorted(ws.MARKER_BY_NAME)
+    count = 0
+    for code in sorted(ws.DTYPES):
+        arrays = [_sample(code)]
+        enc = ws.encode_arrays(arrays)
+        for k in range(len(names) + 1):
+            for perm in itertools.permutations(names, k):
+                body = enc + b"".join(_encode_field(n) for n in perm)
+                out, budget, trace, opts = ws.decode_request(body)
+                assert bytes(out[0].tobytes()) == bytes(
+                    arrays[0].tobytes())
+                assert (budget == 1.5) == ("deadline" in perm)
+                assert (trace == 0xDEADBEEF) == ("trace" in perm)
+                if "decode" in perm:
+                    assert opts == {"max_new_tokens": 17,
+                                    "oneshot": True}
+                else:
+                    assert opts is None
+                count += 1
+    assert count == 4 * 65  # 4 dtypes x sum over k of P(4, k)
+
+
+def test_unknown_marker_stops_parsing_and_garbage_is_inert():
+    enc = ws.encode_arrays([_sample(0)])
+    # unknown marker BEFORE a known field: both are ignored (old-server
+    # behaviour — a field this server predates must not be misread)
+    body = enc + struct.pack("<BQ", 0x99, 7) + ws.encode_trace(5)
+    _, budget, trace, opts = ws.decode_request(body)
+    assert budget is None and trace is None and opts is None
+    # trailing garbage shorter than a field is ignored
+    _, budget, trace, opts = ws.decode_request(enc + b"\xDD\x01")
+    assert budget is None and trace is None and opts is None
+    # duplicate marker: first occurrence wins, second stops the scan
+    body = enc + ws.encode_trace(5) + ws.encode_trace(6)
+    _, _, trace, _ = ws.decode_request(body)
+    assert trace == 5
+
+
+def test_tenant_field_is_skipped_but_does_not_block_later_fields():
+    enc = ws.encode_arrays([_sample(1)])
+    body = enc + ws.encode_tenant(0x42) + ws.encode_deadline(250.0)
+    _, budget, _, _ = ws.decode_request(body)
+    assert budget == 0.25
+
+
+def test_every_command_frame_builds_and_parses():
+    """Per-command grammar: request frames for all seven commands (and
+    reply frames for all four statuses) build through the spec and
+    re-parse to (cmd, payload)."""
+    payloads = {
+        ws.CMD_INFER: ws.encode_arrays([_sample(0)]),
+        ws.CMD_HEALTH: b"",
+        ws.CMD_RELOAD: "prefix/модель".encode("utf-8"),
+        ws.CMD_STATS: b"",
+        ws.CMD_METRICS: b"",
+        ws.CMD_STOP: b"",
+        ws.CMD_DRAIN: struct.pack("<d", 5.0),
+    }
+    assert set(payloads) == set(ws.COMMANDS)
+    for cmd, payload in payloads.items():
+        frame = ws.build_request(cmd, payload)
+        (blen,) = struct.unpack_from("<I", frame)
+        assert blen == 1 + len(payload) == len(frame) - 4
+        assert frame[4] == cmd
+        assert frame[5:] == payload
+    for status in ws.STATUSES:
+        frame = ws.build_reply(status, b"x")
+        assert frame[4] == status
+    with pytest.raises(ValueError):
+        ws.build_request(2)  # 2 was never a command
+    with pytest.raises(ValueError):
+        ws.build_reply(4)
+
+
+# ------------------------------------------------- server stays bound
+
+def test_server_aliases_are_the_spec():
+    from paddle_tpu.inference import batching, server
+
+    assert server._encode_arrays is ws.encode_arrays
+    assert server._decode_request is ws.decode_request
+    assert server._decode_arrays is ws.decode_arrays
+    assert server._DTYPES is ws.NUMPY_BY_CODE
+    assert server._DTYPE_CODES is ws.CODE_BY_NUMPY
+    assert (server.STATUS_OK, server.STATUS_ERROR,
+            server.STATUS_OVERLOADED, server.STATUS_STREAM) == (
+        ws.STATUS_OK, ws.STATUS_ERROR, ws.STATUS_RETRYABLE,
+        ws.STATUS_STREAM)
+    assert (server.DEADLINE_MARKER, server.TRACE_MARKER,
+            server.TENANT_MARKER, server.DECODE_MARKER) == (
+        0xDD, 0x1D, 0x7E, 0x5C)
+    assert batching.OVERLOADED_STATUS == ws.STATUS_RETRYABLE
+    assert batching.RetryableError.status_code == ws.STATUS_RETRYABLE
+
+
+# ------------------------------------------------------- doc drift
+
+def test_readme_wire_table_matches_spec():
+    """The README block between the wire-spec sentinels is generated —
+    regenerating and diffing here is the doc-drift gate (same
+    discipline KNOWN_FAILURES.json applies to test counts)."""
+    with open(os.path.join(REPO, "README.md"), encoding="utf-8") as f:
+        readme = f.read()
+    begin = readme.index("wire-spec:begin")
+    begin = readme.index("-->", begin) + len("-->")
+    end = readme.index("<!-- wire-spec:end -->")
+    block = readme[begin:end].strip("\n")
+    assert block == ws.markdown_table(), (
+        "README wire-protocol table drifted from wire_spec."
+        "markdown_table() — regenerate the block instead of hand-"
+        "editing it")
+
+
+# ----------------------------------------------- repo-wide acceptance
+
+def test_protocol_lint_clean_repo_wide():
+    """The acceptance bar: zero unsuppressed TPU4xx findings over the
+    real tree — four languages, one spec, no unexplained waivers."""
+    diags = protocol.check_protocol()
+    assert diags == [], "\n".join(d.format() for d in diags)[-4000:]
+
+
+def test_r_token_reader_dtype_guard_stays():
+    """Regression pin for the satellite fix: BOTH R read paths
+    (pd_predict and the streaming token-array reader) validate the
+    dtype code against the spec's maximum — an unknown code from a
+    newer server must error, never index NA into the size table."""
+    path = os.path.join(REPO, "clients/r/predictor.R")
+    with open(path, encoding="utf-8") as f:
+        ex = protocol.extract_r(f.read(), path)
+    assert len(ex.max_dtype_claims) >= 2, (
+        "expected the dtype-code guard in pd_predict AND "
+        ".pd_read_token_array")
+    assert all(v == ws.MAX_DTYPE_CODE for v, _ in ex.max_dtype_claims)
+
+
+def test_client_extracts_match_spec_tables():
+    """Extractor-level pins for the audit suspects: the C dtype_size
+    switch and the Go dtype/marker consts + one-shot bit equal the
+    spec (the lint asserts this too; pinning the extracts directly
+    keeps the scanners themselves honest)."""
+    spec = protocol.load_spec()
+    with open(os.path.join(REPO, "paddle_tpu/native/c_api.cc"),
+              encoding="utf-8") as f:
+        c = protocol.extract_cpp(f.read(), "c_api.cc")
+    assert {k: v for k, (v, _) in c.dtype_sizes.items()} == {
+        code: d.size for code, d in spec.DTYPES.items()}
+    with open(os.path.join(REPO, "clients/go/paddle_tpu/client.go"),
+              encoding="utf-8") as f:
+        go = protocol.extract_go(f.read(), "client.go")
+    assert {k: v for k, (v, _) in go.dtype_codes.items()} == {
+        d.name: code for code, d in spec.DTYPES.items()}
+    assert go.oneshot_shift[0] == spec.DECODE_ONESHOT_BIT_SHIFT
+    assert {k: v for k, (v, _) in go.markers.items()} == {
+        "deadline": 0xDD, "trace": 0x1D, "decode": 0x5C}
+    # Go handles exactly the emitted statuses it declares (status 1 is
+    # the fallthrough error branch, handled without being named)
+    assert set(go.statuses) == {0, 2, 3}

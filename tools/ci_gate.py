@@ -7,7 +7,7 @@ Usage:
         [--skip-tests] [--pytest-args "tests/ -q -m 'not slow'"]
         [--disable TPU005,...] [--chaos] [--serving] [--serving-chaos]
         [--elastic] [--artifacts] [--fleet] [--decode] [--perfproxy]
-        [--concurrency]
+        [--concurrency] [--protocol] [--protocol-impl NAME=PATH]
         [--clean-paths paddle_tpu/resilience paddle_tpu/inference
          paddle_tpu/obs paddle_tpu/analysis]
 
@@ -65,7 +65,17 @@ finding, warning or error, fails — and (b) runs the locktrace smoke:
 ``tests/test_locktrace.py`` under ``PADDLE_TPU_LOCKTRACE=1``, which
 drives a real BatchingEngine (and a chaos scenario) with the runtime
 lock-order sanitizer recording every acquisition, so the static lock
-model is verified against observed behaviour. Exit 1 when any phase
+model is verified against observed behaviour. ``--protocol`` adds a
+stage running the TPU4xx wire-contract passes
+(``tracelint.py --protocol-only``) STRICTLY — any unsuppressed TPU4xx
+finding fails: every implementation of the serving wire protocol
+(Python server stack, Go/R/C clients) is extracted and diffed against
+``paddle_tpu/inference/wire_spec.py``, and the ok-or-retryable error
+taxonomy is statically verified over the Python serving stack, so the
+protocol can never drift one language at a time
+(``--protocol-impl name=path`` forwards an implementation override to
+tracelint — the planted-drift gate tests run the stage against mutated
+fixture copies this way). Exit 1 when any phase
 fails; the JSON line printed last summarises all of them for log
 scrapers (mirroring tools/check_op_benchmark_result.py's contract).
 """
@@ -115,11 +125,15 @@ DECODE_PYTEST_ARGS = "tests/ -q -m 'decode or quant' -p no:cacheprovider"
 # subsystems that must stay suppression-free: resilience (PR 2), the
 # serving stack (PRs 4-5), the telemetry layer (PR 7), and the analyzer
 # itself (PR 8) fix findings instead of silencing them. One carve-out:
-# a `tpu-lint: disable=TPU3xx` with a trailing justification is a
-# *documented concurrency waiver* (e.g. "GIL-atomic heartbeat bump") —
+# a `tpu-lint: disable=TPU3xx` (concurrency) or `=TPU4xx` (wire
+# contract) with a trailing justification is a *documented waiver*
+# (e.g. "GIL-atomic heartbeat bump", "intentionally partial client") —
 # the audit lists it for reviewers but does not fail the gate; the same
 # directive WITHOUT a justification, or any trace-safety `tracelint:`
-# suppression, still fails.
+# suppression, still fails. (Intentionally partial protocol clients
+# should prefer narrowing their wire_spec.IMPLEMENTATIONS declaration
+# over TPU4xx waivers — the spec documents the gap, a waiver hides
+# it.)
 DEFAULT_CLEAN_PATHS = ("paddle_tpu/resilience", "paddle_tpu/inference",
                        "paddle_tpu/obs", "paddle_tpu/analysis",
                        "paddle_tpu/serialize")
@@ -239,7 +253,7 @@ def audit_suppressions(paths, clean_paths):
                 if not in_clean:
                     continue
                 waiver = (tag == "tpu-lint" and justified and entry["codes"]
-                          and all(c.startswith("TPU3")
+                          and all(c.startswith(("TPU3", "TPU4"))
                                   for c in entry["codes"]))
                 if not waiver:
                     violations.append(entry)
@@ -342,6 +356,35 @@ def run_concurrency_lint(paths, disable=""):
             "timing_s": report.get("timings_s", {}).get("concurrency")}, ok
 
 
+def run_protocol_lint(impl_overrides=(), disable=""):
+    """tracelint --protocol-only, STRICT on the TPU4xx group: any
+    unsuppressed wire-contract finding fails — the acceptance bar is
+    zero repo-wide, with intentional partial clients declared in
+    wire_spec.IMPLEMENTATIONS (and any rare waiver inline-annotated
+    and justified, which the suppression audit enforces separately)."""
+    cmd = [sys.executable, TRACELINT, "--format", "json",
+           "--protocol-only", "paddle_tpu"]
+    for ov in impl_overrides:
+        cmd += ["--impl", ov]
+    if disable:
+        cmd += ["--disable", disable]
+    proc = subprocess.run(cmd, capture_output=True, text=True, cwd=REPO)
+    try:
+        report = json.loads(proc.stdout)
+    except json.JSONDecodeError:
+        crash = proc.stderr.strip()[-2000:]
+        print(f"protocol: tracelint crashed:\n{crash}", file=sys.stderr)
+        return {"tpu4xx": -1, "crash": crash}, False
+    tpu4 = [f for f in report.get("findings", [])
+            if str(f.get("code", "")).startswith("TPU4")]
+    for f in tpu4:
+        print(f"protocol: {f['filename']}:{f['line']}: "
+              f"{f['code']} {f['message']}")
+    ok = proc.returncode == 0 and not tpu4
+    return {"tpu4xx": len(tpu4),
+            "timing_s": report.get("timings_s", {}).get("protocol")}, ok
+
+
 def run_locktrace_smoke(pytest_args):
     """The locktrace-enabled smoke: tests/test_locktrace.py with the
     runtime sanitizer armed for the whole pytest process, so the engine
@@ -413,6 +456,16 @@ def main(argv=None):
                          "strictly (zero unsuppressed findings) plus "
                          "the locktrace-enabled smoke suite")
     ap.add_argument("--locktrace-args", default=LOCKTRACE_PYTEST_ARGS)
+    ap.add_argument("--protocol", action="store_true",
+                    help="also run the TPU4xx wire-contract passes "
+                         "strictly (zero unsuppressed findings): "
+                         "cross-language protocol drift vs wire_spec "
+                         "+ the ok-or-retryable taxonomy")
+    ap.add_argument("--protocol-impl", action="append", default=[],
+                    metavar="NAME=PATH",
+                    help="override one implementation's source file "
+                         "for the --protocol stage (repeatable; the "
+                         "planted-drift gate tests use this)")
     ap.add_argument("--clean-paths", nargs="*",
                     default=list(DEFAULT_CLEAN_PATHS),
                     help="path prefixes where tracelint suppressions "
@@ -529,6 +582,12 @@ def main(argv=None):
         concurrency_ok = conc_lint_ok and locktrace_ok
         conc_report["locktrace_ok"] = locktrace_ok
 
+    protocol_ok = True
+    proto_report = {}
+    if ns.protocol:
+        proto_report, protocol_ok = run_protocol_lint(ns.protocol_impl,
+                                                      ns.disable)
+
     summary = {
         "gate": ("tracelint+suppressions+tier1"
                  + ("+chaos" if ns.chaos else "")
@@ -539,7 +598,8 @@ def main(argv=None):
                  + ("+fleet" if ns.fleet else "")
                  + ("+decode" if ns.decode else "")
                  + ("+perfproxy" if ns.perfproxy else "")
-                 + ("+concurrency" if ns.concurrency else "")),
+                 + ("+concurrency" if ns.concurrency else "")
+                 + ("+protocol" if ns.protocol else "")),
         "lint_ok": lint_ok,
         "lint_errors": report.get("errors", -1),
         "lint_warnings": report.get("warnings", 0),
@@ -571,12 +631,15 @@ def main(argv=None):
         "concurrency_run": bool(ns.concurrency),
         "concurrency_tpu3xx": conc_report.get("tpu3xx", 0),
         "locktrace_ok": conc_report.get("locktrace_ok", True),
+        "protocol_ok": protocol_ok,
+        "protocol_run": bool(ns.protocol),
+        "protocol_tpu4xx": proto_report.get("tpu4xx", 0),
     }
     print(json.dumps(summary))
     if not (lint_ok and audit_ok and tests_ok and chaos_ok
             and serving_ok and serving_chaos_ok and elastic_ok
             and artifacts_ok and fleet_ok and decode_ok
-            and perfproxy_ok and concurrency_ok):
+            and perfproxy_ok and concurrency_ok and protocol_ok):
         print("ci_gate: FAILED", file=sys.stderr)
         return 1
     return 0

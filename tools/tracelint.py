@@ -5,8 +5,8 @@ for paddle_tpu programs (driver for paddle_tpu.analysis).
 Usage:
     python tools/tracelint.py PATH [PATH ...]
         [--format text|json] [--disable TPU005,TPU007]
-        [--all-functions] [--registry] [--concurrency]
-        [--warnings-as-errors]
+        [--all-functions] [--registry] [--concurrency] [--protocol]
+        [--impl NAME=PATH] [--warnings-as-errors]
 
 Scans .py files (or whole packages) with the AST trace-safety passes
 (TPU0xx); ``--registry`` additionally imports paddle_tpu and audits the
@@ -14,15 +14,24 @@ live op registry (TPU2xx); ``--concurrency`` additionally builds one
 static lock model over ALL scanned files and runs the concurrency
 passes (TPU3xx: lock-order cycles, blocking calls under a lock,
 timeout-less waits, heuristic races, callbacks under a registry lock,
-and ``# tpu-lock-order: a < b`` declaration checks). By default only
+and ``# tpu-lock-order: a < b`` declaration checks); ``--protocol``
+additionally runs the TPU4xx wire-contract passes — unlike the other
+families these scan the implementation set DECLARED in
+``paddle_tpu/inference/wire_spec.py`` (the Python serving stack plus
+the Go/R/C clients), not the positional paths, diffing every
+implementation's constant tables against the spec and statically
+verifying the ok-or-retryable error taxonomy (``--impl name=path``
+points one implementation at an alternate file — how the planted-drift
+gate tests run). By default only
 functions that are demonstrably trace context (decorated
 @to_static/@jax.jit/..., or passed into apply_op / lax.cond / lax.scan)
 are checked by the AST passes; ``--all-functions`` treats every
 function as traced (useful for auditing a train-step module wholesale).
 
 JSON output carries a stable ``schema_version`` plus a per-pass-group
-``timings_s`` map ({"ast": ..., "registry": ..., "concurrency": ...})
-so CI consumers can key on the shape and attribute slow runs.
+``timings_s`` map ({"ast": ..., "registry": ..., "concurrency": ...,
+"protocol": ...}) so CI consumers can key on the shape and attribute
+slow runs.
 
 Exit status: 1 when any error-severity finding remains after
 suppression, else 0. Inline suppression: ``# tracelint: disable=TPU001``
@@ -61,22 +70,44 @@ def main(argv=None):
                          "--concurrency; skips the TPU0xx AST scan — "
                          "what ci_gate's --concurrency stage uses, "
                          "since its phase 1 already ran the AST family)")
+    ap.add_argument("--protocol", action="store_true",
+                    help="also run the TPU4xx wire-contract passes "
+                         "over the spec-declared implementation set "
+                         "(wire_spec.IMPLEMENTATIONS), independent of "
+                         "the positional paths")
+    ap.add_argument("--protocol-only", action="store_true",
+                    help="run ONLY the protocol passes (implies "
+                         "--protocol; skips the TPU0xx AST scan — what "
+                         "ci_gate's --protocol stage uses)")
+    ap.add_argument("--impl", action="append", default=[],
+                    metavar="NAME=PATH",
+                    help="override one wire-protocol implementation's "
+                         "source file (repeatable; gate tests plant "
+                         "drift in fixture copies this way)")
     ap.add_argument("--warnings-as-errors", action="store_true")
     ns = ap.parse_args(argv)
 
     from paddle_tpu.analysis import (LintResult, filter_diagnostics,
                                      lint_concurrency, lint_paths,
-                                     lint_registry)
+                                     lint_protocol, lint_registry)
 
     disabled = tuple(c.strip() for c in ns.disable.split(",") if c.strip())
     for p in ns.paths:
         if not os.path.exists(p):
             print(f"tracelint: no such path: {p}", file=sys.stderr)
             return 2
+    impl_files = {}
+    for ov in ns.impl:
+        name, _, path = ov.partition("=")
+        if not path:
+            print(f"tracelint: --impl wants NAME=PATH, got {ov!r}",
+                  file=sys.stderr)
+            return 2
+        impl_files[name] = path
     timings = {}
     diags = []
     files_scanned = 0
-    if not ns.concurrency_only:
+    if not (ns.concurrency_only or ns.protocol_only):
         t0 = time.monotonic()
         result = lint_paths(ns.paths, all_functions=ns.all_functions,
                             disabled=disabled)
@@ -89,12 +120,21 @@ def main(argv=None):
 
         diags += lint_registry(disabled=disabled).diagnostics
         timings["registry"] = time.monotonic() - t0
+    # family flags are ADDITIVE: an explicitly requested family always
+    # runs; the *-only spellings just skip the TPU0xx AST scan (so
+    # `--concurrency --protocol-only` runs BOTH TPU3xx and TPU4xx)
     if ns.concurrency or ns.concurrency_only:
         t0 = time.monotonic()
         conc = lint_concurrency(ns.paths, disabled=disabled)
         diags += conc.diagnostics
         timings["concurrency"] = time.monotonic() - t0
         files_scanned = max(files_scanned, conc.files_scanned)
+    if ns.protocol or ns.protocol_only:
+        t0 = time.monotonic()
+        proto = lint_protocol(files=impl_files or None, disabled=disabled)
+        diags += proto.diagnostics
+        timings["protocol"] = time.monotonic() - t0
+        files_scanned = max(files_scanned, proto.files_scanned)
     merged = LintResult(filter_diagnostics(diags),
                         files_scanned=files_scanned,
                         timings=timings)
