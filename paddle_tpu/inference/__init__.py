@@ -40,6 +40,9 @@ __all__ = [
     "Autoscaler", "subprocess_spawner", "tenant_id",
     # continuous-batching decode (lazy for the same reason)
     "DecodeEngine", "DecodeModel", "DecodeRequest",
+    # sharded multi-chip serving (lazy: sharding builds no state at
+    # import, but keeps the package surface consistent)
+    "ServingMesh",
 ]
 
 _FLEET_HOMES = {
@@ -50,6 +53,7 @@ _FLEET_HOMES = {
     "ReplicaRegistry": "registry",
     "DecodeEngine": "decode", "DecodeModel": "decode",
     "DecodeRequest": "decode",
+    "ServingMesh": "sharding",
 }
 
 

@@ -45,6 +45,12 @@ failure — not avoiding it — is what preserves throughput):
   death, poisoned buckets, and mid-batch failures in CI (the artifact
   store adds ``artifact.get`` / ``artifact.verify`` / ``artifact.put``
   / ``artifact.put.publish``).
+- **Sharded serving** (inference/sharding.py, opt-in via
+  ``mesh="tp2"`` / ``PADDLE_TPU_SERVING_MESH``): weights commit to a
+  device mesh once at load and every bucket program becomes a
+  per-(bucket, mesh) pjit program — models bigger than one chip's HBM
+  serve behind the same engine, wire-transparently (README "Sharded
+  serving" has the determinism contract per mesh).
 - **Persistent artifact store** (serialize/artifact_store.py, opt-in
   via ``PADDLE_TPU_ARTIFACT_DIR``): warmup and cold buckets consult a
   crash-safe on-disk store of exported programs before compiling —
@@ -109,6 +115,7 @@ from ..resilience import chaos
 from ..resilience.retry import _env_float, _env_int
 from ..serialize import artifact_store as _artifacts
 from ..serialize.export import deserialize_exported, serialize_exported
+from . import sharding as _sharding
 
 # Machine-checked lock order (tools/tracelint.py --concurrency, TPU309):
 # the engine lock is the SUBSYSTEM lock; obs instrument and registry
@@ -391,12 +398,25 @@ class AotLayerRunner:
     into the program) and the batch buffers donated.
     """
 
-    def __init__(self, layer, donate=True, store=None):
+    def __init__(self, layer, donate=True, store=None, mesh=None):
         import jax
 
         self._jax = jax
         self._layer = layer
         self._donate = donate
+        # serving mesh (inference/sharding.py): "single" runs the
+        # pre-sharding path byte-for-byte; a sharded mesh commits the
+        # resident weights to the device mesh ONCE here and every
+        # bucket program compiles with those shardings as in_shardings
+        # (weights stay runtime args shared across buckets). The
+        # canonical descriptor rides in every ArtifactKey: a sharded
+        # export can never satisfy a single-chip key or vice versa.
+        self._mesh = _sharding.resolve(mesh)
+        self.mesh_desc = self._mesh.descriptor
+        self._sharded_state = None
+        if not self._mesh.is_single:
+            self._mesh.build()  # fail fast: not enough devices = here,
+            # with the remedy named, never mid-request
         # persistent compiled-artifact store (serialize.artifact_store):
         # warmup and cold buckets consult it before compiling, and
         # inline compiles publish back so the NEXT process (a fresh
@@ -422,6 +442,16 @@ class AotLayerRunner:
                 "[InputSpec([None, ...], dtype)]) so dim 0 exports as a "
                 "symbolic size (BatchingEngine.for_callable is the "
                 "fallback for fixed-shape models)")
+        if not self._mesh.is_single:
+            # shard once at load: these placed arrays are the runtime
+            # args EVERY bucket program shares — per-device residency
+            # is what makes a bigger-than-one-chip model servable
+            params, p_sh = self._mesh.shard_arrays(
+                [p._value for p in layer._parameters.values()])
+            buffers, b_sh = self._mesh.shard_arrays(
+                [jax.numpy.asarray(b)
+                 for b in layer._loaded_buffers.values()])
+            self._sharded_state = (params, p_sh, buffers, b_sh)
         self._trailing = []
         self._dtypes = []
         for shape, dtype in specs:
@@ -457,14 +487,17 @@ class AotLayerRunner:
 
     def _artifact_key(self, bucket, sig):
         return _artifacts.ArtifactKey(self._fingerprint, bucket, sig,
-                                      mesh="single",
+                                      mesh=self.mesh_desc,
                                       quant=self.quant_mode)
 
     def _bucket_state(self, bucket, sig):
         """(flat_fn, param_arrays, buffer_arrays, specs, donate) for one
         bucket — shared by the inline compile and the export publish so
         the two can never drift (the published artifact IS the program
-        the inline path would have compiled)."""
+        the inline path would have compiled). Under a sharded mesh the
+        param/buffer arrays are the mesh-committed residents and every
+        spec carries its sharding, so the lowered program IS the
+        sharded pjit program."""
         jax = self._jax
         layer = self._layer
 
@@ -472,18 +505,48 @@ class AotLayerRunner:
             out = layer._call_fn(param_list, buffer_list, *inputs)
             return tuple(out) if isinstance(out, (tuple, list)) else (out,)
 
-        param_arrays = [p._value for p in layer._parameters.values()]
-        buffer_arrays = [jax.numpy.asarray(b)
-                         for b in layer._loaded_buffers.values()]
-        param_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
-                       for a in param_arrays]
-        buffer_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
-                        for a in buffer_arrays]
-        in_specs = [jax.ShapeDtypeStruct((bucket,) + tr, np.dtype(dt))
-                    for dt, tr in sig]
+        if self._sharded_state is not None:
+            param_arrays, p_sh, buffer_arrays, b_sh = self._sharded_state
+            repl = self._mesh.replicated()
+            param_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                sharding=s)
+                           for a, s in zip(param_arrays, p_sh)]
+            buffer_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                 sharding=s)
+                            for a, s in zip(buffer_arrays, b_sh)]
+            in_specs = [jax.ShapeDtypeStruct((bucket,) + tr,
+                                             np.dtype(dt), sharding=repl)
+                        for dt, tr in sig]
+        else:
+            param_arrays = [p._value for p in layer._parameters.values()]
+            buffer_arrays = [jax.numpy.asarray(b)
+                             for b in layer._loaded_buffers.values()]
+            param_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                           for a in param_arrays]
+            buffer_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                            for a in buffer_arrays]
+            in_specs = [jax.ShapeDtypeStruct((bucket,) + tr, np.dtype(dt))
+                        for dt, tr in sig]
         donate = tuple(range(2, 2 + len(sig))) if self._donate else ()
         return (flat_fn, param_arrays, buffer_arrays,
                 (param_specs, buffer_specs, in_specs), donate)
+
+    def _jit(self, flat_fn, donate, n_inputs):
+        """The one jit construction both the inline compile and the
+        export share. Single mesh: byte-for-byte the historical call
+        (no sharding kwargs — the committed perfproxy baseline pins
+        its fingerprints). Sharded: weights pinned to their discipline
+        layout, batch inputs and outputs replicated, so the host-side
+        engine (and the wire) see exactly the single-chip shapes."""
+        jax = self._jax
+        if self._sharded_state is None:
+            return jax.jit(flat_fn, donate_argnums=donate)
+        _, p_sh, _, b_sh = self._sharded_state
+        repl = self._mesh.replicated()
+        return jax.jit(flat_fn, donate_argnums=donate,
+                       in_shardings=(list(p_sh), list(b_sh),
+                                     *([repl] * n_inputs)),
+                       out_shardings=repl)
 
     def compile(self, bucket, sig, warming=False):
         """-> (run, source): the bucket's program, loaded from the
@@ -542,6 +605,12 @@ class AotLayerRunner:
         (_, param_arrays, buffer_arrays,
          (param_specs, buffer_specs, in_specs), _) = \
             state if state is not None else self._bucket_state(bucket, sig)
+        # mesh skew is a clean KEY miss in the normal flow; this gate
+        # is the defense in depth (copied store dir, hand-loaded blob):
+        # a program exported for N devices must never reach an engine
+        # whose mesh expects M
+        _sharding.check_nr_devices(
+            exported, None if self._sharded_state is None else self._mesh)
         # canonicalize through jax's dtype rules (x64 disabled traces
         # i64/f64 specs as i32/f32): the EXPORTED avals are always
         # canonical, and the inline path canonicalizes identically at
@@ -602,7 +671,6 @@ class AotLayerRunner:
         publish path serializes and the winner's own run is built on."""
         from jax import export as jax_export
 
-        jax = self._jax
         flat_fn, _, _, (param_specs, buffer_specs, in_specs), donate = \
             state if state is not None else self._bucket_state(bucket, sig)
         with warnings.catch_warnings():
@@ -611,7 +679,7 @@ class AotLayerRunner:
             warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable")
             return jax_export.export(
-                jax.jit(flat_fn, donate_argnums=donate))(
+                self._jit(flat_fn, donate, len(in_specs)))(
                     param_specs, buffer_specs, *in_specs)
 
     def _export_bytes(self, bucket, sig):
@@ -619,10 +687,15 @@ class AotLayerRunner:
         return serialize_exported(self._export(bucket, sig))
 
     def _quant_extra(self):
-        """Ledger-event mode tag. Empty for f32, so every historical
-        event shape (and the committed perfproxy baseline's f32
-        sections) stays byte-identical."""
-        return {"quant": self.quant_mode} if self.quant_mode else {}
+        """Ledger-event mode/mesh tags. Empty for f32/single, so every
+        historical event shape (and the committed perfproxy baseline's
+        f32 single-chip sections) stays byte-identical."""
+        extra = {}
+        if self.quant_mode:
+            extra["quant"] = self.quant_mode
+        if self.mesh_desc != _sharding.SINGLE:
+            extra["mesh"] = self.mesh_desc
+        return extra
 
     def store_stats(self):
         store = self._active_store()
@@ -633,7 +706,6 @@ class AotLayerRunner:
         """Lower + compile the bucket's program. Called once per bucket
         by the engine's cache; the compiled callable takes the padded
         numpy batch arrays and returns a list of numpy outputs."""
-        jax = self._jax
         (flat_fn, param_arrays, buffer_arrays,
          (param_specs, buffer_specs, in_specs), donate) = \
             self._bucket_state(bucket, sig)
@@ -644,7 +716,7 @@ class AotLayerRunner:
             # compile
             warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable")
-            compiled = (jax.jit(flat_fn, donate_argnums=donate)
+            compiled = (self._jit(flat_fn, donate, len(in_specs))
                         .lower(param_specs, buffer_specs, *in_specs)
                         .compile())
         # every AOT compile lands in the process compile ledger: bucket,
@@ -845,17 +917,20 @@ class BatchingEngine:
         self._m_restarts = M.Counter(
             "paddle_serving_scheduler_restarts_total",
             "Watchdog scheduler restarts", const_labels=cl)
-        # quant rides as a const label (it is a property of the served
-        # model, not of an individual compile): a mixed-precision fleet
-        # shows per-mode compile/store-load series on one dashboard
+        # quant and mesh ride as const labels (properties of the served
+        # model/engine, not of an individual compile): a mixed
+        # precision-and-topology fleet shows per-mode, per-mesh
+        # compile/store-load series on one dashboard
         quant = getattr(self._runner, "quant_mode", None) or "f32"
+        mesh = getattr(self._runner, "mesh_desc", None) or _sharding.SINGLE
         self._m_compiles = M.Counter(
             "paddle_serving_compiles_total",
             "Bucket program materializations (source: inline = a real "
             "XLA compile; store = deserialized from the persistent "
-            "artifact store; quant: the serving quantization mode)",
+            "artifact store; quant: the serving quantization mode; "
+            "mesh: the serving mesh descriptor)",
             labelnames=("bucket", "source"),
-            const_labels={**cl, "quant": quant})
+            const_labels={**cl, "quant": quant, "mesh": mesh})
         self._m_batches = M.Counter(
             "paddle_serving_batches_total",
             "Batches executed", labelnames=("bucket",), const_labels=cl)
@@ -910,14 +985,20 @@ class BatchingEngine:
 
     # ------------------------------------------------------- constructors
     @classmethod
-    def for_layer(cls, layer, donate=True, artifact_store=None, **kw):
+    def for_layer(cls, layer, donate=True, artifact_store=None,
+                  mesh=None, **kw):
         """Engine over a jit-loaded batch-polymorphic TranslatedLayer
         (per-bucket AOT compile, donation on the batch buffers).
         ``artifact_store``: a serialize.ArtifactStore for persistent
         cross-process program reuse (default: env-gated
-        ``default_store()`` — PADDLE_TPU_ARTIFACT_DIR opts in)."""
+        ``default_store()`` — PADDLE_TPU_ARTIFACT_DIR opts in).
+        ``mesh``: a serving mesh descriptor (``"tp2"``,
+        ``"fsdp2xtp2"``; default env ``PADDLE_TPU_SERVING_MESH``, else
+        single-chip) — weights shard once at load and every bucket
+        program becomes a per-(bucket, mesh) pjit program (README
+        "Sharded serving")."""
         return cls(AotLayerRunner(layer, donate=donate,
-                                  store=artifact_store), **kw)
+                                  store=artifact_store, mesh=mesh), **kw)
 
     @classmethod
     def for_callable(cls, fn, **kw):
@@ -1638,6 +1719,8 @@ class BatchingEngine:
             return {
                 "name": self.name,
                 "quant": getattr(self._runner, "quant_mode", None) or "f32",
+                "mesh": getattr(self._runner, "mesh_desc", None)
+                        or _sharding.SINGLE,
                 "max_batch_size": self.max_batch_size,
                 "max_wait_ms": round(self.max_wait_s * 1000.0, 3),
                 "max_queue": self.max_queue,
@@ -1695,6 +1778,8 @@ class BatchingEngine:
                 "quarantined_buckets": quarantined,
                 "cold_compiles_inflight": len(self._cold_inflight),
                 "declared_buckets": list(self._declared),
+                "mesh": getattr(self._runner, "mesh_desc", None)
+                        or _sharding.SINGLE,
                 "artifact_store": store_stats,
             }
 

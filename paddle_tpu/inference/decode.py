@@ -99,6 +99,11 @@ Env knobs (constructor kwargs override):
     PADDLE_TPU_DECODE_MAX_NEW_TOKENS   default per-request cap (64)
     PADDLE_TPU_DECODE_MAX_PROMPT_LEN   admission cap on prompt length
                                        (default max_seq_len)
+    PADDLE_TPU_SERVING_MESH            serving mesh descriptor ("tp2",
+                                       "fsdp2xtp2"; default single) —
+                                       params shard once and the whole
+                                       program ladder becomes
+                                       per-(bucket, mesh) pjit programs
     (breaker/watchdog knobs: the PADDLE_TPU_SERVING_* family)
 """
 import os
@@ -115,6 +120,7 @@ from ..obs.ledger import LEDGER
 from ..resilience import chaos
 from ..resilience.retry import _env_float, _env_int
 from ..serialize import artifact_store as _artifacts
+from . import sharding as _sharding
 from . import wire_spec as _wire_spec
 from ..serialize.export import (deserialize_exported, model_fingerprint,
                                 serialize_exported)
@@ -192,7 +198,7 @@ class _Programs:
     bucket ride in the signature) with the same single-flight /
     verify-then-quarantine / degrade-to-inline semantics."""
 
-    def __init__(self, model, store=None):
+    def __init__(self, model, store=None, mesh=None):
         import jax
 
         self._jax = jax
@@ -202,6 +208,20 @@ class _Programs:
         self._warmup_wait_s = _env_float(
             "PADDLE_TPU_ARTIFACT_WARMUP_WAIT_S", 120.0)
         self._fp_lock = threading.Lock()
+        # serving mesh: single runs the historical path byte-for-byte;
+        # sharded commits the params to the mesh ONCE here (the
+        # residents every phase program shares as runtime args) and
+        # every (phase, rows, seq) rung compiles as a pjit program
+        # with weight in_shardings + replicated batch/kv/outputs. The
+        # descriptor rides in every ArtifactKey: the sharded decode
+        # ladder is its own store identity.
+        self._mesh = _sharding.resolve(mesh)
+        self.mesh_desc = self._mesh.descriptor
+        self._sharded_params = None
+        if not self._mesh.is_single:
+            self._mesh.build()  # fail fast with the device-count remedy
+            self._sharded_params = self._mesh.shard_arrays(
+                [jax.numpy.asarray(p) for p in model.params])
 
     # ----------------------------------------------------------- identity
     def _fingerprint(self):
@@ -230,11 +250,16 @@ class _Programs:
         return self._store
 
     def _quant_extra(self):
-        """Ledger-event mode tag (empty for f32 — historical event
-        shapes and the committed perfproxy decode section stay
-        byte-identical)."""
+        """Ledger-event mode/mesh tags (empty for f32/single —
+        historical event shapes and the committed perfproxy decode
+        section stay byte-identical)."""
+        extra = {}
         q = getattr(self._model, "quant", None)
-        return {"quant": q} if q else {}
+        if q:
+            extra["quant"] = q
+        if self.mesh_desc != _sharding.SINGLE:
+            extra["mesh"] = self.mesh_desc
+        return extra
 
     def _artifact_key(self, phase, rows, seq):
         # the phase + seq bucket ride in the signature (the ArtifactKey
@@ -247,7 +272,7 @@ class _Programs:
         sig += tuple((str(dt), tr) for tr, dt in m.feature_spec)
         sig += ((f"vocab{m.vocab_size}", ()),)
         return _artifacts.ArtifactKey(self._fingerprint(), int(rows), sig,
-                                      mesh="single",
+                                      mesh=self.mesh_desc,
                                       quant=getattr(m, "quant", None))
 
     # ------------------------------------------------------------- shapes
@@ -280,10 +305,21 @@ class _Programs:
 
     def _state(self, phase, rows, seq):
         jax = self._jax
-        param_arrays = [jax.numpy.asarray(p) for p in self._model.params]
-        param_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
-                       for a in param_arrays]
-        in_specs = self._in_specs(phase, rows, seq)
+        if self._sharded_params is not None:
+            param_arrays, p_sh = self._sharded_params
+            repl = self._mesh.replicated()
+            param_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                sharding=s)
+                           for a, s in zip(param_arrays, p_sh)]
+            in_specs = [jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                             sharding=repl)
+                        for s in self._in_specs(phase, rows, seq)]
+        else:
+            param_arrays = [jax.numpy.asarray(p)
+                            for p in self._model.params]
+            param_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                           for a in param_arrays]
+            in_specs = self._in_specs(phase, rows, seq)
         donate = ()
         if phase == "step":
             # donate the gathered kv scratch buffers (args: params,
@@ -293,11 +329,25 @@ class _Programs:
             donate = tuple(range(3, 3 + nkv))
         return param_arrays, param_specs, in_specs, donate
 
+    def _jit(self, phase, donate, n_inputs):
+        """One jit construction for both the inline compile and the
+        export. Single mesh: the historical call, byte-for-byte.
+        Sharded: params in their discipline layout, every batch/kv
+        input and every output replicated — the host engine's shapes
+        (and the wire) are mesh-invariant."""
+        jax = self._jax
+        if self._sharded_params is None:
+            return jax.jit(self._flat_fn(phase), donate_argnums=donate)
+        _, p_sh = self._sharded_params
+        repl = self._mesh.replicated()
+        return jax.jit(self._flat_fn(phase), donate_argnums=donate,
+                       in_shardings=(list(p_sh), *([repl] * n_inputs)),
+                       out_shardings=repl)
+
     # ------------------------------------------------------------ compile
     def _export(self, phase, rows, seq, state=None):
         from jax import export as jax_export
 
-        jax = self._jax
         _, param_specs, in_specs, donate = \
             state if state is not None else self._state(phase, rows, seq)
         import warnings as _warnings
@@ -306,7 +356,7 @@ class _Programs:
             _warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable")
             return jax_export.export(
-                jax.jit(self._flat_fn(phase), donate_argnums=donate))(
+                self._jit(phase, donate, len(in_specs)))(
                     param_specs, *in_specs)
 
     def _probe_batch(self, phase, rows, seq):
@@ -345,6 +395,10 @@ class _Programs:
         mirrors AotLayerRunner._make_run."""
         param_arrays, param_specs, in_specs, _ = \
             state if state is not None else self._state(phase, rows, seq)
+        # defense in depth against a copied store dir / hand-loaded
+        # blob: key.mesh already makes skew a clean miss
+        _sharding.check_nr_devices(
+            exported, None if self._sharded_params is None else self._mesh)
         canon = self._jax.dtypes.canonicalize_dtype
         expect = [(tuple(s.shape), np.dtype(canon(s.dtype)))
                   for s in (*param_specs, *in_specs)]
@@ -363,7 +417,6 @@ class _Programs:
         return run
 
     def _compile_inline(self, phase, rows, seq):
-        jax = self._jax
         param_arrays, param_specs, in_specs, donate = \
             self._state(phase, rows, seq)
         t0 = time.monotonic()
@@ -372,8 +425,7 @@ class _Programs:
         with _warnings.catch_warnings():
             _warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable")
-            compiled = (jax.jit(self._flat_fn(phase),
-                                donate_argnums=donate)
+            compiled = (self._jit(phase, donate, len(in_specs))
                         .lower(param_specs, *in_specs).compile())
         LEDGER.record(f"decode/{phase}{rows}x{seq}",
                       duration_s=time.monotonic() - t0, compiled=compiled,
@@ -659,13 +711,19 @@ class DecodeEngine:
                  max_queue=None, min_seq_bucket=None, max_prompt_len=None,
                  default_max_new_tokens=None, name="decode", store=None,
                  breaker_threshold=None, breaker_cooldown=None,
-                 watchdog_interval=None, wedge_timeout=None, quant=None):
+                 watchdog_interval=None, wedge_timeout=None, quant=None,
+                 mesh=None):
         # quant: serve this model under a quantization mode ("w8" |
         # "bf16w"; env default PADDLE_TPU_SERVING_QUANT — the one-knob
         # fleet flip). An unquantized model is wrapped via
         # quantization.quantize_decode_model; a model ALREADY carrying
         # a mode must match the request (a replica told to serve w8
         # must never silently serve something else).
+        # mesh: serving mesh descriptor ("tp2" | "fsdp2xtp2" | ...; env
+        # default PADDLE_TPU_SERVING_MESH) — params shard once at
+        # construction and the whole (phase, rows, seq) program ladder
+        # becomes per-(bucket, mesh) pjit programs with their own
+        # artifact-store identities (README "Sharded serving").
         if quant is None:
             quant = os.environ.get("PADDLE_TPU_SERVING_QUANT") or None
         model_quant = getattr(model, "quant", None)
@@ -722,7 +780,8 @@ class DecodeEngine:
             wedge_timeout if wedge_timeout is not None
             else _env_float("PADDLE_TPU_SERVING_WEDGE_TIMEOUT", 30.0))
         self.name = name
-        self._programs = _Programs(model, store=store)
+        self._programs = _Programs(model, store=store, mesh=mesh)
+        self.mesh_desc = self._programs.mesh_desc
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._pending = []  # FIFO of DecodeRequest
@@ -787,9 +846,12 @@ class DecodeEngine:
             "paddle_decode_compiles_total",
             "Program materializations (source: inline = real XLA "
             "compile, store = artifact-store load; quant: the serving "
-            "quantization mode)",
+            "quantization mode; mesh: the serving mesh descriptor)",
             labelnames=("phase", "source"),
-            const_labels={**cl, "quant": getattr(self._model, "quant", None) or "f32"})
+            const_labels={
+                **cl,
+                "quant": getattr(self._model, "quant", None) or "f32",
+                "mesh": self.mesh_desc})
         self._m_steps = M.Counter(
             "paddle_decode_steps_total",
             "Model program dispatches, by phase",
@@ -1438,6 +1500,7 @@ class DecodeEngine:
             return {
                 "name": self.name,
                 "quant": getattr(self._model, "quant", None) or "f32",
+                "mesh": self.mesh_desc,
                 "max_slots": self.max_slots,
                 "max_seq_len": self.max_seq_len,
                 "max_queue": self.max_queue,
@@ -1484,6 +1547,7 @@ class DecodeEngine:
                 "queue_depth": len(self._pending),
                 "quarantined_programs": quarantined,
                 "declared_programs": len(self._declared),
+                "mesh": self.mesh_desc,
                 "artifact_store": store_stats,
             }
 

@@ -759,7 +759,7 @@ class PredictorServer:
 
 def serve_model(path_prefix, port=0, dynamic_batching=False,
                 max_batch_size=32, max_wait_ms=2.0, max_queue=256,
-                warmup=True, metrics_port=None, quant=None,
+                warmup=True, metrics_port=None, quant=None, mesh=None,
                 **engine_kwargs):
     """Load a jit-saved model and serve it (the C API's server side).
 
@@ -795,10 +795,25 @@ def serve_model(path_prefix, port=0, dynamic_batching=False,
     silently serve an f32 save (or vice versa). Unset = serve whatever
     the save recorded.
 
+    ``mesh`` (env default ``PADDLE_TPU_SERVING_MESH``) declares the
+    serving mesh this replica shards its weights over (``"single"`` |
+    ``"tp<k>"`` | ``"fsdp<m>"`` | ``"fsdp<m>xtp<k>"``; README "Sharded
+    serving"). Sharded serving runs through the batching engine
+    (``dynamic_batching=True``): weights are committed to the mesh once
+    at load and every bucket program is a per-(bucket, mesh) pjit
+    program with its own artifact-store identity — wire-transparent to
+    all four clients. A save that recorded an intended mesh
+    (``jit.save(..., mesh=...)``) is checked against the declared one
+    at load time AND on every hot reload; the mesh resolved at first
+    load is pinned, so a reload can never silently flip a replica's
+    topology. Unset = serve whatever the save recorded (or
+    single-chip).
+
     The returned server supports the ``reload`` wire command (cmd 4):
     re-save the model to the same (or a new) prefix and issue a reload
     to hot-swap weights with zero dropped requests."""
     from ..jit import load as jit_load
+    from .sharding import SINGLE, ServingMesh
 
     if quant is None:
         quant = os.environ.get("PADDLE_TPU_SERVING_QUANT") or None
@@ -809,6 +824,17 @@ def serve_model(path_prefix, port=0, dynamic_batching=False,
         from ..quantization.serving import check_mode
 
         check_mode(quant)
+    if mesh is None:
+        mesh = os.environ.get("PADDLE_TPU_SERVING_MESH") or None
+    # fail at entry with the valid descriptor grammar — same rationale
+    # as the quant knob (a typo'd mesh must not surface as a
+    # misleading save-mismatch error later)
+    declared_mesh = (None if mesh is None
+                     else ServingMesh.parse(mesh).descriptor)
+    # the mesh resolved at FIRST load is pinned for the server's
+    # lifetime: hot reload checks the new save against it, so a reload
+    # can change weights, never the replica's topology
+    pinned_mesh = {}
 
     def loader(prefix):
         layer = jit_load(prefix)
@@ -821,6 +847,24 @@ def serve_model(path_prefix, port=0, dynamic_batching=False,
                     "(PADDLE_TPU_SERVING_QUANT / serve_model(quant=)); "
                     "re-save with jit.save(..., quant=...) or fix the "
                     "deployment knob")
+        recorded_mesh = getattr(layer, "_serving_mesh", None)
+        want = (declared_mesh if declared_mesh is not None
+                else pinned_mesh.get("desc"))
+        if (want is not None and recorded_mesh is not None
+                and recorded_mesh != want):
+            raise ValueError(
+                f"{prefix}: saved serving mesh {recorded_mesh!r} does "
+                f"not match the declared mesh {want!r} "
+                "(PADDLE_TPU_SERVING_MESH / serve_model(mesh=)); "
+                "re-save with jit.save(..., mesh=...) or fix the "
+                "deployment knob")
+        eff_mesh = want or recorded_mesh or SINGLE
+        pinned_mesh.setdefault("desc", eff_mesh)
+        if eff_mesh != SINGLE and not dynamic_batching:
+            raise ValueError(
+                f"serving mesh {eff_mesh!r} needs the batching engine "
+                "(the per-bucket pjit programs live there): pass "
+                "dynamic_batching=True to serve_model")
 
         def run(*arrays):
             out = layer(*arrays)
@@ -833,7 +877,7 @@ def serve_model(path_prefix, port=0, dynamic_batching=False,
             engine = BatchingEngine.for_layer(
                 layer, max_batch_size=max_batch_size,
                 max_wait_ms=max_wait_ms, max_queue=max_queue,
-                **engine_kwargs)
+                mesh=eff_mesh, **engine_kwargs)
         return run, engine
 
     run, engine = loader(path_prefix)

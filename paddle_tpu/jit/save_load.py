@@ -78,7 +78,7 @@ def _build_input_specs(input_spec, polymorphic):
 
 
 def save(layer, path, input_spec=None, quant=None, quant_calib=None,
-         **configs):
+         mesh=None, **configs):
     """paddle.jit.save — export layer.forward at the given input spec.
 
     Dims given as None/-1 are exported batch-polymorphically (symbolic
@@ -97,9 +97,24 @@ def save(layer, path, input_spec=None, quant=None, quant_calib=None,
     accumulate). The mode is recorded in ``.pdmeta.json`` and folded
     into the model fingerprint, so quantized programs are distinct
     artifact-store identities — they persist, single-flight, and
-    cold-start-free across a replica fleet exactly like f32 ones."""
+    cold-start-free across a replica fleet exactly like f32 ones.
+
+    ``mesh`` records the SERVING MESH this save is intended for (a
+    canonical descriptor — ``"tp2"``, ``"fsdp2xtp2"``; README "Sharded
+    serving"). It does not change the exported program (sharding is a
+    load-time layout of the runtime-arg weights, applied by the
+    serving engines) — it is deployment intent, mirrored after the
+    quant field: ``serve_model`` refuses to serve a save whose
+    recorded mesh contradicts the declared one, at initial load AND on
+    every hot reload."""
     if input_spec is None:
         raise ValueError("jit.save requires input_spec (list of InputSpec or Tensors)")
+    if mesh is not None:
+        from ..inference.sharding import ServingMesh
+
+        # validate + canonicalize at save time: a typo'd descriptor
+        # must fail the save, not every later load
+        mesh = ServingMesh.parse(mesh).descriptor
     from ..quantization.serving import quantize_for_serving
 
     layer, quant_meta = quantize_for_serving(layer, quant,
@@ -160,7 +175,7 @@ def save(layer, path, input_spec=None, quant=None, quant_calib=None,
                     {n: np.asarray(a) for n, a in params.items()},
                     {n: np.asarray(a) for n, a in buffers.items()},
                     spec_candidates=spec_candidates,
-                    quant=quant, quant_meta=quant_meta)
+                    quant=quant, quant_meta=quant_meta, mesh=mesh)
 
 
 def _is_symbolic_dim(d):
@@ -175,7 +190,7 @@ def _json_spec(s):
 
 def write_artifacts(path, jitted_fn, state_specs, input_specs, params,
                     buffers, spec_candidates=None, quant=None,
-                    quant_meta=None):
+                    quant_meta=None, mesh=None):
     """Serialize the single on-disk model format (<prefix>.pdmodel StableHLO +
     .pdiparams npz + .pdmeta.json sidecar) shared by jit.save and
     static.save_inference_model. ``jitted_fn(params_like, buffers_like,
@@ -259,6 +274,11 @@ def write_artifacts(path, jitted_fn, state_specs, input_specs, params,
                    # fingerprint it computes from the module bytes
                    "quant": quant,
                    "quant_meta": quant_meta,
+                   # intended serving mesh (None = unconstrained):
+                   # serve_model fail-fasts on contradiction; the
+                   # program itself is mesh-independent (weights are
+                   # runtime args, sharded at load by the engines)
+                   "mesh": mesh,
                    "export_error": payload.get("export_error")}, f)
 
 
@@ -266,7 +286,8 @@ class TranslatedLayer(Layer):
     """Loaded inference layer (reference: dygraph/io.py TranslatedLayer)."""
 
     def __init__(self, call_fn, params, buffers, input_specs=None,
-                 polymorphic=False, fingerprint=None, quant=None):
+                 polymorphic=False, fingerprint=None, quant=None,
+                 mesh=None):
         super().__init__()
         self._call_fn = call_fn
         self._loaded_params = params
@@ -283,6 +304,10 @@ class TranslatedLayer(Layer):
         # threaded into engine ArtifactKeys, compile metrics, and
         # ledger events so a mixed-precision fleet is observable
         self._quant_mode = quant
+        # intended serving mesh recorded by jit.save(mesh=...) (None =
+        # unconstrained): serve_model refuses a contradicting declared
+        # mesh at load and on hot reload
+        self._serving_mesh = mesh
         for i, (n, a) in enumerate(params.items()):
             from ..core.tensor import Parameter
 
@@ -348,7 +373,8 @@ def load(path, **configs):
                                polymorphic=payload.get("polymorphic", False),
                                fingerprint=model_fingerprint(blob,
                                                              quant=quant),
-                               quant=quant)
+                               quant=quant,
+                               mesh=payload.get("mesh"))
     raise RuntimeError(
         f"model at {path} was saved without a serialized program "
         f"({payload.get('export_error')}); re-save with a supported spec")

@@ -78,6 +78,66 @@ class TestKey:
         assert "quant" not in k.canonical()
         assert _key(quant="w8").canonical()["quant"] == "w8"
 
+    def test_mesh_single_is_the_canonical_default_form(self):
+        """ISSUE 15 satellite: ``mesh="single"`` stays the canonical
+        omitted/DEFAULT form — a default-constructed key, an explicit
+        mesh="single", and the historical spelling all digest
+        identically, and the canonical dict (what every on-disk
+        MANIFEST records) is byte-unchanged vs the pre-sharding
+        schema. Sharded descriptors are distinct identities."""
+        k = _key()
+        # the default-constructed key IS the mesh="single" key
+        assert ArtifactKey("m" * 64, 8, (("float32", (4,)),),
+                           version="jax-test/jaxlib-test/cpu").digest() \
+            == k.digest()
+        # the canonical form is exactly the historical dict: mesh is
+        # PRESENT (it always was — PR 10's schema), spelled "single",
+        # with no extra keys — so every historical digest and every
+        # on-disk manifest stays byte-identical
+        assert k.canonical() == {
+            "model": "m" * 64, "bucket": 8,
+            "signature": [["float32", [4]]],
+            "mesh": "single",
+            "version": "jax-test/jaxlib-test/cpu"}
+        # the digest itself is pinned: a future schema edit that
+        # silently re-keys every fleet's store must fail THIS line,
+        # not surface as a cold fleet
+        assert k.digest() == "f42e62b6b2960a77c18f514088166d3c"
+        # every mesh descriptor is its own identity
+        assert len({_key(mesh=m).digest()
+                    for m in ("single", "tp2", "tp4", "fsdp2",
+                              "fsdp2xtp2")}) == 5
+        # mesh and quant compose into distinct identities
+        assert _key(mesh="tp2", quant="w8").digest() not in {
+            _key(mesh="tp2").digest(), _key(quant="w8").digest()}
+
+    def test_mesh_skew_is_clean_miss(self, tmp_path):
+        """ISSUE 15 satellite: a sharded artifact can never satisfy a
+        single-chip request and vice versa — the key mismatch is a
+        clean MISS (no quarantine, no corruption, artifact untouched),
+        in BOTH directions, and across different meshes."""
+        st = _store(tmp_path)
+        tp2 = _key(mesh="tp2")
+        assert st.put(tp2, b"tp2-program-bytes-0000")
+        before = _counters()
+        # a single-chip request never sees the sharded artifact
+        assert st.get(_key()) is None
+        # nor does any OTHER mesh
+        assert st.get(_key(mesh="tp4")) is None
+        assert st.get(_key(mesh="fsdp2xtp2")) is None
+        d = _delta(before)
+        assert d["misses"] == 3 and d["corrupt"] == 0
+        # the sharded artifact is untouched and still serves its mesh
+        assert st.get(tp2) == b"tp2-program-bytes-0000"
+        # reverse direction: a single-chip publish never serves a
+        # sharded request
+        single = _key(bucket=16)
+        assert st.put(single, b"single-program-bytes-0")
+        before = _counters()
+        assert st.get(_key(bucket=16, mesh="tp2")) is None
+        d = _delta(before)
+        assert d["misses"] == 1 and d["corrupt"] == 0
+
     def test_signature_normalization(self):
         # logically-equal signatures (list vs tuple, np dims) digest
         # identically

@@ -148,6 +148,16 @@ class TestBenchContract:
             assert by_name[
                 f"quant.{mode}.post_warmup_compiles"]["baseline"] == 0
             assert by_name[f"quant.{mode}.dtype_mix"]["ok"]
+        # the sharded ladder (ISSUE 15): exact per-mesh compile counts,
+        # zero post-warmup compiles, and the opcode contract (chk_ops
+        # fails if all-gather/all-reduce vanish — the proof the
+        # sharding actually reached the HLO)
+        for sec in ("serving", "decode"):
+            assert by_name[f"sharded.{sec}.warmup_compiles"]["ok"]
+            assert by_name[
+                f"sharded.{sec}.post_warmup_compiles"]["baseline"] == 0
+            assert by_name[f"sharded.{sec}.op_counts"]["ok"]
+        assert by_name["sharded.mesh"]["ok"]
 
     @pytest.mark.slow  # subprocess bench run
     def test_perfproxy_fails_loudly_on_injected_regression(self):
@@ -202,6 +212,15 @@ class TestBenchContract:
             assert q["post_warmup_compiles"] == 0
             marker = "parameter:bf16" if mode == "bf16w" else "parameter:s8"
             assert q["dtype_mix"].get(marker, 0) > 0
+        # ISSUE 15: the sharded section regenerates with the same
+        # discipline — additive, with the collective ops present (the
+        # sharding-reached-the-HLO witness)
+        sh = payload["sharded"]
+        assert sh["mesh"] == "tp2"
+        for sec in ("serving", "decode"):
+            assert sh[sec]["warmup_compiles"] > 0
+            assert sh[sec]["post_warmup_compiles"] == 0
+            assert sh[sec]["op_counts"].get("all-gather", 0) > 0
 
     @pytest.mark.slow  # subprocess pod launches; ci_gate --elastic
     @pytest.mark.elastic  # runs these as its own stage
@@ -314,6 +333,43 @@ class TestDecodeContract:
         assert rec["coldstart_inline_compiles"] == 0
         assert rec["coldstart_store_loads"] > 0
         assert rec["streams"] > 0 and rec["baseline_streams"] > 0
+
+    @pytest.mark.slow  # four decode-replica subprocesses + storms
+    @pytest.mark.sharded  # ci_gate --sharded runs this as its own stage
+    def test_sharded_mode_metric_fields(self):
+        """`bench.py sharded` (ISSUE 15 acceptance): the A/B against
+        the single-chip replica must report tokens/s + p99 per side
+        and the per-mesh weight-bytes proxy, and hard-fail unless (a)
+        the sharded replica's wire streams equal its solo decode
+        bitwise, (b) its tokens greedily agree with the single-chip
+        side, and (c) a fresh sharded replica rewarms its whole
+        (bucket, mesh) ladder with zero inline compiles."""
+        r = _run({"JAX_PLATFORMS": "cpu", "BENCH_SHARDED_SECS": "2.0",
+                  "BENCH_SHARDED_CLIENTS": "6"},
+                 timeout=540, argv=("sharded",))
+        assert r.returncode == 0, r.stderr[-1500:]
+        rec = _one_json_line(r.stdout)
+        assert rec["metric"] == \
+            "serving_decode_tokens_per_sec_sharded_mesh"
+        assert rec["unit"] == "tokens/s"
+        assert rec["mesh"] == "tp2" and rec["n_shards"] == 2
+        assert rec["tokens_per_sec"] > 0
+        assert rec["single_tokens_per_sec"] > 0
+        assert rec["p99_intertoken_ms"] > 0
+        assert rec["vs_baseline"] == pytest.approx(
+            rec["tokens_per_sec"] / rec["single_tokens_per_sec"],
+            rel=1e-3)
+        # the contracts the bench hard-fails on, re-asserted
+        assert rec["bitwise_solo_vs_batch"] is True
+        assert rec["tokens_agree_with_single_chip"] is True
+        assert rec["coldstart_inline_compiles"] == 0
+        assert rec["coldstart_store_loads"] > 0
+        # the point of sharding: per-device resident weight bytes
+        # shrink by the shard count (the toy model divides evenly)
+        assert rec["weight_bytes_per_device"] * rec["n_shards"] \
+            == rec["weight_bytes_total"]
+        assert rec["weight_bytes_ratio"] == pytest.approx(2.0)
+        assert rec["streams"] > 0 and rec["single_streams"] > 0
 
     @pytest.mark.slow  # nine decode-replica subprocesses + storms
     @pytest.mark.decode

@@ -336,6 +336,62 @@ def _mk_fleet_pair(run_a, run_b, tenants=(), **router_kwargs):
 
 
 class TestRouter:
+    @pytest.mark.sharded
+    def test_router_relays_sharded_replica_unmodified(self, tmp_path):
+        """ISSUE 15 satellite: a SHARDED replica behind the fleet
+        router answers byte-identically to a direct connection — the
+        router (like the wire) is mesh-invariant, and the sharded
+        replica's cmd-3 health relays its mesh descriptor through the
+        fleet tier unmodified."""
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.static import InputSpec
+
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        m.eval()
+        prefix = str(tmp_path / "m")
+        paddle.jit.save(m, prefix,
+                        input_spec=[InputSpec([None, 8], "float32")])
+        env = dict(os.environ)
+        env.pop("PADDLE_TPU_ARTIFACT_DIR", None)
+        env.pop("PADDLE_TPU_SERVING_MESH", None)
+        env.pop("PADDLE_TPU_SERVING_QUANT", None)
+        worker = os.path.join(REPO, "tests", "sharded_worker.py")
+        proc = subprocess.Popen(
+            [sys.executable, worker, "serve", prefix, "tp2"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        line = proc.stdout.readline()
+        assert line.startswith("PORT "), \
+            f"sharded replica failed: {line!r}\n{proc.stderr.read()[-2000:]}"
+        port = int(line.split()[1])
+        reg = ReplicaRegistry(heartbeat_interval=0)
+        reg.register("sharded", "127.0.0.1", port)
+        router = FleetRouter(reg, own_registry=True, retry_base=0.005,
+                             retry_max=0.02)
+        try:
+            x = np.random.RandomState(5).randn(3, 8).astype(np.float32)
+            frame = _frame([x])
+            direct_status, direct_payload = _request(port, frame,
+                                                     timeout=120)
+            routed_status, routed_payload = _request(router.port, frame,
+                                                     timeout=120)
+            assert direct_status == routed_status == 0
+            # relay is byte-exact: the router never re-encodes
+            assert routed_payload == direct_payload
+            # the replica's health (what the registry gossips) names
+            # its mesh
+            _, hp = _wire_cmd(port, wire_spec.CMD_HEALTH, timeout=120)
+            assert json.loads(hp.decode())["engine"]["mesh"] == "tp2"
+        finally:
+            router.stop()
+            try:
+                _wire_cmd(port, wire_spec.CMD_STOP, timeout=10)
+            except OSError:
+                pass
+            proc.wait(timeout=30)
+
     def test_retry_on_different_replica_after_shed(self):
         """Replica a sheds (status 2) every time; the router's retry
         must land on b and return ITS answer, not hammer a."""

@@ -515,6 +515,74 @@ def test_decode_double_run_guard_narrows_tier1():
     assert "decode or quant" in mod.DECODE_PYTEST_ARGS
 
 
+def test_sharded_stage_gates(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text(GOOD_SRC)
+    bad = tmp_path / "test_sharded_fail.py"
+    bad.write_text(
+        "import pytest\n"
+        "pytestmark = pytest.mark.sharded\n"
+        "def test_boom():\n    assert False\n")
+    r = _run(["--paths", str(good), "--skip-tests", "--sharded",
+              "--sharded-args",
+              f"{bad} -q -m sharded -p no:cacheprovider"])
+    assert r.returncode == 1
+    s = _summary(r)
+    assert s["sharded_run"] and not s["sharded_ok"]
+    assert "+sharded" in s["gate"]
+    ok = tmp_path / "test_sharded_ok.py"
+    ok.write_text(
+        "import pytest\n"
+        "pytestmark = pytest.mark.sharded\n"
+        "def test_fine():\n    assert True\n")
+    r = _run(["--paths", str(good), "--skip-tests", "--sharded",
+              "--sharded-args",
+              f"{ok} -q -m sharded -p no:cacheprovider"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert _summary(r)["sharded_ok"]
+
+
+def test_sharded_summary_keys_present_when_not_run(tmp_path):
+    f = tmp_path / "good.py"
+    f.write_text(GOOD_SRC)
+    r = _run(["--paths", str(f), "--skip-tests"])
+    s = _summary(r)
+    assert s["sharded_run"] is False and s["sharded_ok"] is True
+
+
+def test_sharded_double_run_guard_narrows_tier1_and_fleet():
+    """With --sharded, tier-1 excludes the sharded marker; with
+    --fleet AND --sharded, the fleet stage narrows to 'fleet and not
+    sharded' so the dual-marked router-relay case runs exactly once
+    (in the sharded stage, which owns -m sharded)."""
+    mod = _gate_module()
+    captured = {}
+
+    def fake_capturing(args):
+        captured.setdefault("args", []).append(args)
+        return 1, mod.load_known_failures()
+
+    mod.run_pytest = lambda args: (
+        captured.setdefault("args", []).append(args) or 0)
+    mod.run_pytest_capturing_failures = fake_capturing
+    mod.run_tracelint = lambda *a, **k: ({"errors": 0, "warnings": 0,
+                                          "findings": []}, 0)
+    mod.audit_suppressions = lambda *a, **k: ([], [])
+    rc = mod.main(["--fleet", "--sharded"])
+    assert rc == 0
+    tier1 = captured["args"][0]
+    assert "not sharded" in tier1 and "not fleet" in tier1 \
+        and "not slow" in tier1
+    stage_args = captured["args"][1:]
+    assert "'fleet and not sharded'" in stage_args[0]
+    assert stage_args[1] == mod.SHARDED_PYTEST_ARGS
+    # --fleet alone keeps the full fleet selection
+    captured.clear()
+    rc = mod.main(["--fleet"])
+    assert rc == 0
+    assert captured["args"][1] == mod.FLEET_PYTEST_ARGS
+
+
 def test_serialize_subsystem_is_suppression_free():
     """The artifact-store subsystem is a clean zone (DEFAULT_CLEAN_PATHS):
     no inline tracelint suppressions under paddle_tpu/serialize."""

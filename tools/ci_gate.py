@@ -54,7 +54,15 @@ slow ``bench.py decode`` storm contract, and the ISSUE 13 quant ladder
 — per-channel axis audit, w8/w8a8/bf16w export + engine + artifact-key
 contracts, ``decode --quant`` and quant-coldstart bench contracts),
 again with the compositional tier-1 double-run exclusion of BOTH
-markers. ``--perfproxy``
+markers. ``--sharded`` adds a stage running the sharded multi-chip
+serving suite (``-m sharded``: per-(bucket, mesh) pjit-program
+equivalence at engine AND wire level per wire dtype, mesh-keyed
+artifact-store round trips with clean skew misses, decode
+solo-vs-batch per mesh, the multi-process gloo mesh over the PR 9
+launcher, mesh fail-fasts, and the ``bench.py sharded`` contract),
+with the same compositional tier-1 exclusion — and when ``--fleet``
+runs too, the fleet stage narrows to ``fleet and not sharded`` so the
+dual-marked router-relay case runs once. ``--perfproxy``
 adds a stage running ``bench.py perfproxy`` on CPU against the
 committed PERFPROXY_BASELINE.json — compile counts, HLO op counts, and
 cost-analysis FLOPs must match, so single-chip perf can't silently rot
@@ -122,6 +130,12 @@ FLEET_PYTEST_ARGS = "tests/ -q -m fleet -p no:cacheprovider"
 # path's bandwidth lever, and a separate stage would re-pay the same
 # model/ladder setup
 DECODE_PYTEST_ARGS = "tests/ -q -m 'decode or quant' -p no:cacheprovider"
+# the sharded multi-chip serving suite: per-(bucket, mesh) engine/wire
+# equivalence, mesh-keyed store round trips + skew misses, the
+# multi-process gloo mesh via the PR 9 launcher, mesh fail-fasts, and
+# the `bench.py sharded` contract — subprocess-heavy (sharded engines
+# need more devices than the tier-1 process has), so it owns a stage
+SHARDED_PYTEST_ARGS = "tests/ -q -m sharded -p no:cacheprovider"
 # subsystems that must stay suppression-free: resilience (PR 2), the
 # serving stack (PRs 4-5), the telemetry layer (PR 7), and the analyzer
 # itself (PR 8) fix findings instead of silencing them. One carve-out:
@@ -444,6 +458,13 @@ def main(argv=None):
                          "quant axis audit + export/engine/store "
                          "contracts + quant bench contracts)")
     ap.add_argument("--decode-args", default=DECODE_PYTEST_ARGS)
+    ap.add_argument("--sharded", action="store_true",
+                    help="also run the sharded multi-chip serving "
+                         "suite (-m sharded: per-(bucket, mesh) "
+                         "engine/wire equivalence, mesh-keyed store "
+                         "round trips, multi-process gloo mesh, "
+                         "sharded bench contract)")
+    ap.add_argument("--sharded-args", default=SHARDED_PYTEST_ARGS)
     ap.add_argument("--known-failures", default=KNOWN_FAILURES_FILE,
                     help="JSON file naming the committed pre-existing "
                          "tier-1 failures the stage diffs against")
@@ -508,6 +529,8 @@ def main(argv=None):
                 # the decode stage owns BOTH markers (decode or quant)
                 excl.append("decode")
                 excl.append("quant")
+            if ns.sharded:
+                excl.append("sharded")
             if excl:
                 pytest_args = pytest_args.replace(
                     "'not slow'",
@@ -563,11 +586,21 @@ def main(argv=None):
 
     fleet_ok = True
     if ns.fleet:
-        fleet_ok = run_pytest(ns.fleet_args) == 0
+        fleet_args = ns.fleet_args
+        if ns.sharded and fleet_args == FLEET_PYTEST_ARGS:
+            # double-run guard: the sharded stage owns the fleet relay
+            # case that carries both markers
+            fleet_args = fleet_args.replace(
+                "-m fleet", "-m 'fleet and not sharded'")
+        fleet_ok = run_pytest(fleet_args) == 0
 
     decode_ok = True
     if ns.decode:
         decode_ok = run_pytest(ns.decode_args) == 0
+
+    sharded_ok = True
+    if ns.sharded:
+        sharded_ok = run_pytest(ns.sharded_args) == 0
 
     perfproxy_ok = True
     if ns.perfproxy:
@@ -597,6 +630,7 @@ def main(argv=None):
                  + ("+artifacts" if ns.artifacts else "")
                  + ("+fleet" if ns.fleet else "")
                  + ("+decode" if ns.decode else "")
+                 + ("+sharded" if ns.sharded else "")
                  + ("+perfproxy" if ns.perfproxy else "")
                  + ("+concurrency" if ns.concurrency else "")
                  + ("+protocol" if ns.protocol else "")),
@@ -625,6 +659,8 @@ def main(argv=None):
         "fleet_run": bool(ns.fleet),
         "decode_ok": decode_ok,
         "decode_run": bool(ns.decode),
+        "sharded_ok": sharded_ok,
+        "sharded_run": bool(ns.sharded),
         "perfproxy_ok": perfproxy_ok,
         "perfproxy_run": bool(ns.perfproxy),
         "concurrency_ok": concurrency_ok,
@@ -638,7 +674,7 @@ def main(argv=None):
     print(json.dumps(summary))
     if not (lint_ok and audit_ok and tests_ok and chaos_ok
             and serving_ok and serving_chaos_ok and elastic_ok
-            and artifacts_ok and fleet_ok and decode_ok
+            and artifacts_ok and fleet_ok and decode_ok and sharded_ok
             and perfproxy_ok and concurrency_ok and protocol_ok):
         print("ci_gate: FAILED", file=sys.stderr)
         return 1
