@@ -32,6 +32,16 @@ codes, severity, file:line, fix-it hint):
   per-thread lock-acquisition order and flagging inversions, so the
   static model is verified against observed behaviour in the chaos
   suites.
+- ``resources`` + ``resmodel`` (TPU501–TPU508): declared resource
+  model of the stack's acquire/release pairs (KV slots, pooled router
+  sockets, artifact lockfiles and tmp dirs, threads, breakers, signal
+  handlers) with machine-checked ``# tpu-resource:`` ownership
+  declarations and a per-function dataflow walk proving every acquire
+  is released on every path.
+- ``restrace``: the dynamic complement for resources — an opt-in
+  (``PADDLE_TPU_RESTRACE=1``) sanitizer keeping per-kind live-handle
+  censuses over the declared definition sites and flagging suites that
+  end nonzero (``PADDLE_TPU_RESTRACE_RAISE=1`` raises at violations).
 
 Surfaces: ``tools/tracelint.py`` (CLI), the ``jit/dy2static`` trace-
 failure hook (ranked diagnostics attached to the raised error), and the
@@ -44,9 +54,9 @@ from .diagnostics import (  # noqa: F401
 )
 from .runner import (  # noqa: F401
     LintResult, lint_concurrency, lint_file, lint_function, lint_paths,
-    lint_protocol, lint_registry, lint_source,
+    lint_protocol, lint_registry, lint_resources, lint_source,
 )
 from . import (  # noqa: F401
     ast_checks, concurrency, jaxpr_checks, lockmodel, locktrace,
-    protocol, registry_checks,
+    protocol, registry_checks, resmodel, resources, restrace,
 )

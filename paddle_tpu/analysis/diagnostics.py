@@ -14,6 +14,10 @@ documented in README.md §"Trace-safety rules":
 - ``TPU4xx`` — wire-contract passes (``analysis/protocol.py``; README
   §"Wire-contract rules"): cross-language protocol drift against
   ``inference/wire_spec.py`` and the ok-or-retryable error taxonomy.
+- ``TPU5xx`` — resource-lifecycle passes (``analysis/resources.py``;
+  README §"Resource lint (TPU5xx)"): acquire/release ownership over
+  the declared resource model (``analysis/resmodel.py``), runtime
+  complement in ``analysis/restrace.py``.
 
 Suppression: an inline ``# tracelint: disable=TPU001,TPU005`` comment on
 the flagged line silences those codes for that line; a file-level
@@ -178,6 +182,41 @@ CODES = {
                "(status 2) ahead of the broad arm; an unhandled escape "
                "is a client hang, a broad-to-status-1 arm without the "
                "retryable arm mis-maps sheds as permanent"),
+    # ---- resource-lifecycle passes (analysis/resources.py) ----
+    "TPU501": (SEVERITY_ERROR, "resource leak on an exception path",
+               "release the handle in a finally (or an except arm that "
+               "re-raises); a raise inside the acquire/release window "
+               "strands the handle"),
+    "TPU502": (SEVERITY_ERROR, "resource leak on an early exit",
+               "every return/break/continue between acquire and release "
+               "must release (or transfer) the handle first — use "
+               "try/finally or restructure the early exit"),
+    "TPU503": (SEVERITY_ERROR, "double release of a handle",
+               "a handle is released twice on one path; the second "
+               "release corrupts whoever re-acquired it in between"),
+    "TPU504": (SEVERITY_ERROR, "release of a handle never acquired here",
+               "on this path the handle is proven None (the acquire "
+               "returned None, or the name was rebound to None) — guard "
+               "the release on the acquire having succeeded"),
+    "TPU505": (SEVERITY_ERROR, "acquire/release window straddles a lock",
+               "the handle is acquired under a lock but released outside "
+               "it — a concurrent sweep between the two sees half-owned "
+               "state; move the release under the same lock"),
+    "TPU506": (SEVERITY_ERROR, "undeclared acquire/release of a modeled "
+               "resource kind",
+               "add '# tpu-resource: acquires=<kind>' / "
+               "'releases=<kind>' on the owning def (or manage the "
+               "handle with a with-block); the ownership map must stay "
+               "complete for the TPU5xx passes to mean anything"),
+    "TPU507": (SEVERITY_ERROR, "chaos site inside an acquire/release "
+               "window without a cleanup arm",
+               "a chaos.hit() between acquire and release can raise by "
+               "design; wrap the window in try/finally so injected "
+               "faults cannot leak the handle"),
+    "TPU508": (SEVERITY_ERROR, "escaping handle with no declared owner",
+               "the handle outlives this function (returned, stored, or "
+               "captured) but no '# tpu-resource: acquires=<kind>' "
+               "declaration records who must release it"),
 }
 
 
@@ -320,7 +359,9 @@ def format_text(diags):
 #: to the top-level keys or the per-finding fields — CI consumers key
 #: on it instead of sniffing the shape. v3: the ``timings_s`` map may
 #: carry a ``protocol`` pass group (the TPU4xx wire-contract family).
-JSON_SCHEMA_VERSION = 3
+#: v4: the ``timings_s`` map may carry a ``resources`` pass group (the
+#: TPU5xx resource-lifecycle family).
+JSON_SCHEMA_VERSION = 4
 
 
 def format_json(diags, timings=None):

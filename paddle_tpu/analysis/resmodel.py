@@ -1,0 +1,373 @@
+"""Static resource model for the TPU5xx lifecycle lint.
+
+The reference Paddle planned every buffer's lifetime statically (the
+SSA-graph memory-reuse and GC passes); the XLA-native stack delegates
+device buffers to the runtime but grew its own leak surface instead:
+decode KV slots, pooled router sockets, artifact-store ``O_EXCL``
+lockfiles and tmp dirs, worker threads, breaker states, installed
+signal handlers. This module is the *model* half of the static
+checker (``resources.py`` holds the TPU501-TPU508 passes; ``restrace``
+is the runtime complement): it extracts, from the AST plus real
+comments, everything the dataflow pass needs.
+
+Ownership is DECLARED, not inferred: a function that acquires or
+releases a modeled resource kind carries a machine-checked comment on
+(or immediately above) its ``def`` line::
+
+    # tpu-resource: acquires=kv_slot
+    def alloc(self): ...
+
+    def release(self, slot):  # tpu-resource: releases=kv_slot
+        ...
+
+Multiple kinds separate with commas; both clauses may appear on one
+line (``acquires=tmp_dir releases=tmp_dir``). The declaration is the
+unit of trust: call sites of declared acquirers hand the checker a
+tracked handle, call sites of declared releasers retire one, and the
+pass proves every handle is retired on every path. *Inside* a declared
+definition site the body is trusted (the runtime sanitizer audits it
+instead) — the static pass owns the flow BETWEEN declared sites.
+
+Primitive acquisitions (``socket.create_connection``, ``os.open`` with
+``O_EXCL``, ``tempfile.mkdtemp``, ``signal.signal``, a non-daemon
+``threading.Thread``) in a function with no covering declaration are
+TPU506 — the lint forces the ownership map to stay complete. A
+primitive managed by a ``with`` block is self-releasing and exempt.
+
+Call resolution is conservative, same posture as ``lockmodel``:
+``self.meth()`` resolves within the class (and resolvable bases),
+``self.attr.meth()`` through a proven attribute type (assigned from a
+known constructor), a bare ``fn()`` to a declared module function.
+An *unproven* ``obj.meth()`` matches a declared method name only when
+one of its arguments is an already-tracked handle of a matching kind —
+so ``registry.release(rid)`` (an inflight counter, not a resource)
+never fabricates a release event. False negatives are acceptable;
+the error-severity checks only fire on demonstrated evidence.
+"""
+import ast
+import io
+import os
+import re
+import tokenize
+
+__all__ = ["KINDS", "ResourceKind", "FuncRes", "ResModel", "build_model",
+           "in_scope", "markdown_table", "RES_RE"]
+
+
+class ResourceKind:
+    """One modeled acquire/release pair."""
+
+    __slots__ = ("name", "summary", "acquire", "release", "release_methods",
+                 "traced", "flows")
+
+    def __init__(self, name, summary, acquire, release,
+                 release_methods=(), traced=True, flows=True):
+        self.name = name
+        self.summary = summary
+        self.acquire = acquire
+        self.release = release
+        # method names that, called ON a tracked handle, release it
+        # (``sock.close()``); kept tiny and kind-specific on purpose.
+        self.release_methods = frozenset(release_methods)
+        self.traced = traced
+        # flows=False marks interior-state kinds: the "handle" lives
+        # inside the acquiring object (a breaker's OPEN state, the
+        # saved previous signal dispositions), nothing flows to the
+        # caller, so the dataflow pass only enforces the declaration
+        # discipline (TPU506) for them.
+        self.flows = flows
+
+
+KINDS = {k.name: k for k in (
+    ResourceKind(
+        "kv_slot", "decode KV-cache slot",
+        "`_KVSlots.alloc()`", "`_KVSlots.release(slot)`"),
+    ResourceKind(
+        "router_socket", "fleet-router replica connection (pooled)",
+        "`FleetRouter._conn_open()` / `_pool_get()`",
+        "`_pool_put(rid, sock)` / `_conn_close(sock)`",
+        release_methods=("close",)),
+    ResourceKind(
+        "flight_lock", "artifact-store `O_EXCL` compile lockfile",
+        "`ArtifactStore.try_acquire(key)` / `_acquire_or_wait(key)`",
+        "`ArtifactStore.release(lock)`"),
+    ResourceKind(
+        "tmp_dir", "artifact/fleet scratch directory",
+        "`tempfile.mkdtemp()` / `ArtifactStore._tmp_create()`",
+        "`shutil.rmtree(...)` / `ArtifactStore._tmp_done(tmp)`"),
+    ResourceKind(
+        "thread", "non-daemon worker thread",
+        "`threading.Thread(...)` without `daemon=True`, then `.start()`",
+        "`thread.join()`",
+        release_methods=("join",), traced=False),
+    ResourceKind(
+        "breaker", "circuit-breaker OPEN state",
+        "`_Breaker.record_failure()` trips OPEN",
+        "`_Breaker.record_success()` closes", traced=False, flows=False),
+    ResourceKind(
+        "signal_handler", "installed process signal handler",
+        "`signal.signal(...)` / `PreemptionHandler.install()`",
+        "`PreemptionHandler.uninstall()` restores the saved handlers",
+        flows=False),
+)}
+
+# The declaration comment syntax. Parsed from real comments only
+# (tokenize), never string literals — same discipline as the lock
+# hierarchy annotations of the TPU3xx family.
+RES_RE = re.compile(r"#\s*tpu-resource\s*:\s*(?P<rest>.*)$")
+_CLAUSE_RE = re.compile(r"(?P<verb>acquires|releases)\s*=\s*"
+                        r"(?P<kinds>[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+# Subtrees of paddle_tpu/ the dataflow pass audits. Anything *outside*
+# a recognizable paddle_tpu subtree (test fixtures, scratch files) is
+# always in scope, so planted-leak fixtures never silently pass.
+_SCOPED_SUBTREES = ("inference", "serialize", "resilience", "obs")
+
+
+def in_scope(filename):
+    """Is ``filename`` subject to the TPU5xx dataflow/primitive checks?"""
+    norm = (filename or "").replace(os.sep, "/")
+    if "paddle_tpu/" in norm:
+        tail = norm.rsplit("paddle_tpu/", 1)[1]
+        sub = tail.split("/", 1)[0]
+        return "/" in tail and sub in _SCOPED_SUBTREES
+    return True
+
+
+def product_scope(filename):
+    """Product code (the audited paddle_tpu subtrees) must DECLARE
+    ownership of every primitive acquisition — TPU506 is unconditional
+    there. Outside (tests, tools, fixtures) a primitive that is
+    demonstrably managed in the same function is fine undeclared."""
+    return "paddle_tpu/" in (filename or "").replace(os.sep, "/")
+
+
+class FuncRes:
+    """One function (method or module-level) of the analysed set."""
+
+    __slots__ = ("name", "qualname", "cls", "filename", "lineno", "node",
+                 "acquires", "releases")
+
+    def __init__(self, name, qualname, cls, filename, lineno, node):
+        self.name = name
+        self.qualname = qualname
+        self.cls = cls                  # enclosing class name or None
+        self.filename = filename
+        self.lineno = lineno
+        self.node = node
+        self.acquires = set()           # declared kinds
+        self.releases = set()
+
+    @property
+    def declared(self):
+        return bool(self.acquires or self.releases)
+
+    def covers(self, kind):
+        return kind in self.acquires or kind in self.releases
+
+
+class ResModel:
+    """Everything ``resources.check_model`` consumes."""
+
+    def __init__(self):
+        self.functions = []             # every FuncRes, in-scope files
+        self.errors = []                # (filename, line, message) -> TPU506
+        self.by_class = {}              # class -> {method -> FuncRes}
+        self.class_bases = {}           # class -> [base names]
+        self.attr_types = {}            # class -> {self-attr -> class}
+        self.module_funcs = {}          # name -> [declared module FuncRes]
+        self.method_decls = {}          # name -> [declared method FuncRes]
+
+    # ---------------------------------------------------- resolution
+    def _class_method(self, cls, meth):
+        seen = set()
+        while cls and cls not in seen:
+            seen.add(cls)
+            fr = self.by_class.get(cls, {}).get(meth)
+            if fr is not None:
+                return fr
+            bases = self.class_bases.get(cls, ())
+            cls = bases[0] if bases else None
+        return None
+
+    def resolve_call(self, call, caller):
+        """Classify ``call`` made from ``caller`` (a FuncRes).
+
+        Returns ``(acquires, releases, authoritative)`` — the declared
+        kind sets of the callee, and whether the resolution is proven
+        (exact definition found) rather than a name-match fallback.
+        Unresolvable calls return empty sets.
+        """
+        func = call.func
+        if isinstance(func, ast.Name):
+            frs = self.module_funcs.get(func.id, ())
+            acq, rel = set(), set()
+            for fr in frs:
+                acq |= fr.acquires
+                rel |= fr.releases
+            return acq, rel, bool(frs)
+        if not isinstance(func, ast.Attribute):
+            return set(), set(), False
+        meth, recv = func.attr, func.value
+        target_cls = None
+        if isinstance(recv, ast.Name) and recv.id == "self":
+            target_cls = caller.cls
+        elif (isinstance(recv, ast.Attribute)
+              and isinstance(recv.value, ast.Name)
+              and recv.value.id == "self" and caller.cls):
+            target_cls = self.attr_types.get(caller.cls, {}).get(recv.attr)
+        if target_cls is not None:
+            fr = self._class_method(target_cls, meth)
+            if fr is not None:
+                return set(fr.acquires), set(fr.releases), True
+            return set(), set(), False
+        # unproven receiver: name-match fallback (never authoritative)
+        acq, rel = set(), set()
+        for fr in self.method_decls.get(meth, ()):
+            acq |= fr.acquires
+            rel |= fr.releases
+        return acq, rel, False
+
+
+def _parse_decl_comments(text, filename, errors):
+    """line -> (acquires, releases) from real ``tpu-resource:`` comments."""
+    decls = {}
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(text).readline)
+        comments = [(t.start[0], t.string) for t in toks
+                    if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return decls
+    for line, comment in comments:
+        m = RES_RE.search(comment)
+        if m is None:
+            continue
+        rest = m.group("rest")
+        acq, rel = set(), set()
+        matched_span = 0
+        for cm in _CLAUSE_RE.finditer(rest):
+            matched_span += 1
+            kinds = [k.strip() for k in cm.group("kinds").split(",")]
+            bad = [k for k in kinds if k not in KINDS]
+            if bad:
+                errors.append((filename, line,
+                               "tpu-resource declaration names unknown "
+                               f"kind(s) {', '.join(sorted(bad))} "
+                               f"(modeled: {', '.join(sorted(KINDS))})"))
+            ok = [k for k in kinds if k in KINDS]
+            (acq if cm.group("verb") == "acquires" else rel).update(ok)
+        if not matched_span:
+            errors.append((filename, line,
+                           "malformed tpu-resource declaration: expected "
+                           "acquires=<kind>[,..] and/or releases=<kind>"
+                           f"[,..], got {rest.strip()!r}"))
+            continue
+        decls[line] = (acq, rel)
+    return decls
+
+
+def _decl_lines_for(node):
+    """Comment lines that may carry ``node``'s declaration: the def
+    line itself (trailing comment), the line above it, and the line
+    above the first decorator."""
+    lines = {node.lineno, node.lineno - 1}
+    if node.decorator_list:
+        lines.add(node.decorator_list[0].lineno - 1)
+    return lines
+
+
+def build_model(sources):
+    """Build one :class:`ResModel` over ``sources``: a list of
+    ``(text, filename)`` pairs (same contract as ``lockmodel``)."""
+    model = ResModel()
+    parsed = []
+    for text, filename in sources:
+        try:
+            tree = ast.parse(text, filename=filename)
+        except SyntaxError:
+            continue                    # the TPU0xx family reports these
+        parsed.append((text, filename, tree))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                model.class_bases.setdefault(
+                    node.name,
+                    [b.id for b in node.bases if isinstance(b, ast.Name)])
+    for text, filename, tree in parsed:
+        errors = []
+        decls = _parse_decl_comments(text, filename, errors)
+        claimed = set()
+
+        def visit(body, cls, prefix):
+            for node in body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{node.name}"
+                    fr = FuncRes(node.name, qual, cls, filename,
+                                 node.lineno, node)
+                    for line in _decl_lines_for(node):
+                        if line in decls:
+                            acq, rel = decls[line]
+                            fr.acquires |= acq
+                            fr.releases |= rel
+                            claimed.add(line)
+                    model.functions.append(fr)
+                    if cls is None:
+                        model.module_funcs.setdefault(
+                            node.name, []).append(fr)
+                    else:
+                        model.by_class.setdefault(cls, {})[node.name] = fr
+                        if fr.declared:
+                            model.method_decls.setdefault(
+                                node.name, []).append(fr)
+                    visit(node.body, cls, qual + ".")
+                elif isinstance(node, ast.ClassDef):
+                    visit(node.body, node.name, f"{node.name}.")
+
+        visit(tree.body, None, "")
+        for line in sorted(set(decls) - claimed):
+            errors.append((filename, line,
+                           "misplaced tpu-resource declaration: must sit "
+                           "on (or immediately above) the def it "
+                           "declares"))
+        model.errors.extend(errors)
+    # self-attribute types, now that every class is known
+    known = set(model.by_class) | set(model.class_bases)
+    for cls, methods in model.by_class.items():
+        for fr in methods.values():
+            for node in ast.walk(fr.node):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                ctor = node.value.func
+                cname = (ctor.id if isinstance(ctor, ast.Name)
+                         else ctor.attr if isinstance(ctor, ast.Attribute)
+                         else None)
+                if cname not in known:
+                    continue
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        model.attr_types.setdefault(
+                            cls, {})[tgt.attr] = cname
+    return model
+
+
+def markdown_table():
+    """The README "Resource lint (TPU5xx)" tables — codes then kinds.
+
+    ``tests/test_resource_lint.py`` asserts the README block between
+    the resource-spec sentinels is byte-identical to this string, the
+    same drift discipline as the wire-protocol tables.
+    """
+    from .diagnostics import CODES
+    lines = ["| Code | Severity | Check |", "|---|---|---|"]
+    for code in sorted(c for c in CODES if c.startswith("TPU5")):
+        sev, title, _ = CODES[code]
+        lines.append(f"| {code} | {sev} | {title} |")
+    lines += ["", "| Kind | Resource | Acquire | Release | restrace |",
+              "|---|---|---|---|---|"]
+    for kind in KINDS.values():
+        traced = "yes" if kind.traced else "static-only"
+        lines.append(f"| `{kind.name}` | {kind.summary} | {kind.acquire} "
+                     f"| {kind.release} | {traced} |")
+    return "\n".join(lines) + "\n"

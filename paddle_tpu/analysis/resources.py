@@ -1,0 +1,682 @@
+"""TPU5xx resource-lifecycle passes over the static resource model.
+
+Per-function symbolic walk proving every acquired handle has an owner
+that releases it on every path — normal return, each ``except`` arm,
+early ``return``/``break``/``continue`` inside loops, and implicit
+fall-through. Handles enter the walk at call sites of DECLARED
+acquirers (see ``resmodel``) and leave it at declared releasers,
+per-kind release methods (``sock.close()``, ``thread.join()``,
+``shutil.rmtree(tmp)``), or a sanctioned ownership transfer (returned
+from / stored by / captured into a constructor by a function that
+declares the kind).
+
+The checks:
+
+- **TPU501** leak-on-exception-path: a handle is live at a ``raise``
+  (or at a chaos-capable window, see TPU507) and no enclosing
+  ``except``/``finally`` arm releases it.
+- **TPU502** leak-on-early-return: live at ``return`` / ``break`` /
+  ``continue`` (for loop-local handles) / end of function, or the
+  binding is overwritten / the acquire result discarded.
+- **TPU503** double-release of the same local handle.
+- **TPU504** release-of-unacquired: a handle is released on a path
+  where it is proven unacquired (the acquire returned None, or the
+  name was rebound to None).
+- **TPU505** acquire under a ``with``-held lock whose release happens
+  outside that lock in the same function.
+- **TPU506** undeclared acquire/release of a modeled kind: a primitive
+  acquisition in a function with no covering ``tpu-resource``
+  declaration, or a malformed/misplaced declaration.
+- **TPU507** chaos-injection site inside a handle's live window with
+  no cleanup arm covering the handle.
+- **TPU508** escaping handle with no declared owner.
+
+Branch merging is optimistic (a release on either arm counts), the
+walk never follows calls (ownership transfers are declaration-scoped),
+and unproven receivers only match by name when an argument is an
+already-tracked handle — false negatives over false positives, the
+same posture as the TPU3xx family.
+"""
+import ast
+
+from . import resmodel
+from .diagnostics import Diagnostic
+
+__all__ = ["check_model", "check_sources"]
+
+
+def _diag(code, filename, line, message, func=""):
+    return Diagnostic(code=code, message=message, filename=filename,
+                      line=line, func=func)
+
+
+def check_sources(sources):
+    """Build the resource model over ``sources`` ([(text, filename)])
+    and run every TPU5xx pass; returns a list of Diagnostics."""
+    return check_model(resmodel.build_model(list(sources)))
+
+
+def check_model(model):
+    diags = []
+    for filename, line, message in model.errors:
+        diags.append(_diag("TPU506", filename, line, message))
+    for fr in model.functions:
+        if resmodel.in_scope(fr.filename):
+            _FuncWalk(fr, model, diags).run()
+    return diags
+
+
+# ------------------------------------------------------------ the walk
+
+
+class _Handle:
+    __slots__ = ("name", "kind", "line", "lock", "loop_depth", "dead")
+
+    def __init__(self, name, kind, line, lock, loop_depth):
+        self.name = name
+        self.kind = kind
+        self.line = line
+        self.lock = lock            # innermost with-lock at acquire
+        self.loop_depth = loop_depth
+        self.dead = False           # already reported: stop cascading
+
+
+class _State:
+    __slots__ = ("live", "released", "none", "terminated")
+
+    def __init__(self):
+        self.live = {}              # name -> _Handle (objects SHARED
+        self.released = {}          # name -> (kind, line)  across clones
+        self.none = {}              # name -> kind, proven-None bindings
+        self.terminated = False     # (`dead` dedupes leaks globally)
+
+    def clone(self):
+        st = _State()
+        st.live = dict(self.live)
+        st.released = dict(self.released)
+        st.none = dict(self.none)
+        st.terminated = self.terminated
+        return st
+
+
+def _expr_str(node):
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001 - display only
+        return "<lock>"
+
+
+def _leaf_name(func):
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def _ctor_like(name):
+    stripped = name.lstrip("_")
+    return bool(stripped) and stripped[0].isupper()
+
+
+def _primitive_kind(call):
+    """kind acquired by a raw stdlib call, or None."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)):
+        return None
+    mod, attr = f.value.id, f.attr
+    if mod == "socket" and attr in ("create_connection", "socket"):
+        return "router_socket"
+    if mod == "tempfile" and attr == "mkdtemp":
+        return "tmp_dir"
+    if mod == "signal" and attr == "signal":
+        return "signal_handler"
+    if mod == "os" and attr == "open":
+        if any(isinstance(n, ast.Attribute) and n.attr == "O_EXCL"
+               for a in call.args for n in ast.walk(a)):
+            return "flight_lock"
+        return None
+    if mod == "threading" and attr == "Thread":
+        for kw in call.keywords:
+            if (kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+                    and kw.value.value):
+                return None
+        return "thread"
+    return None
+
+
+def _is_chaos_hit(call):
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr == "hit"
+            and isinstance(f.value, ast.Name) and f.value.id == "chaos")
+
+
+class _FuncWalk:
+    def __init__(self, fr, model, diags):
+        self.fr = fr
+        self.model = model
+        self.diags = diags
+        self.lock_stack = []        # with-held lock exprs (strings)
+        self.frames = []            # (finally-release-names, handler-names)
+        self.loop_depth = 0
+        self.boolmap = {}           # bool var -> name it None-tests
+        self.chaos_reported = set()
+        self._managed = None        # lazy locally-managed-kind cache
+
+    def _locally_managed(self, kind):
+        """Permissive escape hatch for NON-product code (tests, tools):
+        an undeclared primitive acquisition is fine when the same
+        function visibly manages the kind — a `.join()` for threads, a
+        `.close()` for sockets/fds, a `shutil.rmtree` for tmp dirs, a
+        second `signal.signal` (the restore) for handlers. Like the
+        `_release_names` pre-scan, this only ever SUPPRESSES reports."""
+        if resmodel.product_scope(self.fr.filename):
+            return False
+        if self._managed is None:
+            joins = closes = rmtrees = signals = 0
+            for node in ast.walk(self.fr.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if not isinstance(f, ast.Attribute):
+                    continue
+                if f.attr == "join":
+                    joins += 1
+                elif f.attr == "close":
+                    closes += 1
+                elif (f.attr == "rmtree" and isinstance(f.value, ast.Name)
+                        and f.value.id == "shutil"):
+                    rmtrees += 1
+                elif (f.attr == "signal" and isinstance(f.value, ast.Name)
+                        and f.value.id == "signal"):
+                    signals += 1
+            self._managed = set()
+            if joins:
+                self._managed.add("thread")
+            if closes:
+                self._managed.update(("router_socket", "flight_lock"))
+            if rmtrees:
+                self._managed.add("tmp_dir")
+            if signals >= 2:        # install + restore
+                self._managed.add("signal_handler")
+        return kind in self._managed
+
+    # ------------------------------------------------------- plumbing
+    def _emit(self, code, line, message):
+        self.diags.append(_diag(code, self.fr.filename, line, message,
+                                func=self.fr.qualname))
+
+    def _protected(self, name, on_exception):
+        for fin_names, handler_names in self.frames:
+            if name in fin_names:
+                return True
+            if on_exception and name in handler_names:
+                return True
+        return False
+
+    def run(self):
+        st = _State()
+        self._block(self.fr.node.body, st)
+        if not st.terminated:
+            self._leak_sweep(st, self._end_line(),
+                             "at end of function", on_exception=False)
+
+    def _end_line(self):
+        return getattr(self.fr.node.body[-1], "end_lineno",
+                       self.fr.node.body[-1].lineno)
+
+    def _leak_sweep(self, st, line, where, on_exception):
+        for name, h in list(st.live.items()):
+            if h.dead or self._protected(name, on_exception):
+                continue
+            h.dead = True
+            code = "TPU501" if on_exception else "TPU502"
+            leak = ("no except/finally arm releases it"
+                    if on_exception else "it is never released on this path")
+            self._emit(code, line,
+                       f"{h.kind} handle '{name}' (acquired line {h.line}) "
+                       f"is live {where} and {leak}")
+
+    # -------------------------------------------------------- handles
+    def _bind(self, name, kind, line, st):
+        old = st.live.get(name)
+        if old is not None and not old.dead:
+            old.dead = True
+            self._emit("TPU502", line,
+                       f"{old.kind} handle '{name}' (acquired line "
+                       f"{old.line}) is overwritten here without being "
+                       "released")
+        st.live[name] = _Handle(name, kind, line,
+                                self.lock_stack[-1] if self.lock_stack
+                                else None, self.loop_depth)
+        st.released.pop(name, None)
+        st.none.pop(name, None)
+
+    def _release(self, name, line, st):
+        h = st.live.pop(name)
+        st.released[name] = (h.kind, line)
+        if h.lock is not None and h.lock not in self.lock_stack:
+            self._emit("TPU505", line,
+                       f"{h.kind} handle '{name}' was acquired under lock "
+                       f"`with {h.lock}` (line {h.line}) but is released "
+                       "outside it — the acquire/release window must not "
+                       "straddle the lock")
+
+    def _escape(self, name, line, st, via):
+        h = st.live.pop(name)
+        if not self.fr.covers(h.kind):
+            self._emit("TPU508", line,
+                       f"{h.kind} handle '{name}' escapes via {via} but "
+                       f"this function declares no ownership of {h.kind} "
+                       f"(add '# tpu-resource: acquires={h.kind}')")
+
+    def _closure_escape(self, body, st, line):
+        loads = {n.id for n in ast.walk(body)
+                 if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+        for name in [n for n in st.live if n in loads]:
+            self._escape(name, line, st, "a closure capture")
+
+    # ---------------------------------------------------- expressions
+    def _eval(self, expr, st, top_bind=False, with_exempt=frozenset()):
+        """Process every call in ``expr``. Returns the acquired kind
+        when ``expr`` itself is an acquire call in binding position."""
+        if expr is None:
+            return None
+        skip = set()
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Lambda):
+                self._closure_escape(node.body, st, node.lineno)
+                skip.update(id(sub) for sub in ast.walk(node.body))
+        top_kind = None
+        for node in ast.walk(expr):
+            if id(node) in skip or not isinstance(node, ast.Call):
+                continue
+            kind = self._call(node, st, exempt=id(node) in with_exempt)
+            if node is expr and kind is not None:
+                if top_bind:
+                    top_kind = kind
+                elif not self.fr.covers(kind):
+                    self._emit("TPU502", node.lineno,
+                               f"{kind} handle acquired here is discarded "
+                               "without a local owner — bind it and "
+                               "release it on every path")
+        return top_kind
+
+    def _call(self, call, st, exempt=False):
+        """Classify one call; returns the acquired kind (for binding)
+        when the call is a resolved acquire, else None."""
+        line = call.lineno
+        if _is_chaos_hit(call):
+            for name, h in st.live.items():
+                if h.dead or (name, line) in self.chaos_reported:
+                    continue
+                if self._protected(name, on_exception=True):
+                    continue
+                self.chaos_reported.add((name, line))
+                self._emit("TPU507", line,
+                           f"chaos injection site inside the live window "
+                           f"of {h.kind} handle '{name}' (acquired line "
+                           f"{h.line}) with no except/finally cleanup arm")
+            return None
+        func = call.func
+        # per-kind release method ON a tracked handle: sock.close(), ...
+        if isinstance(func, ast.Attribute) and isinstance(func.value,
+                                                         ast.Name):
+            recv = func.value.id
+            h = st.live.get(recv)
+            if (h is not None
+                    and func.attr in resmodel.KINDS[h.kind].release_methods):
+                self._release(recv, line, st)
+                return None
+            if recv in st.released:
+                kind, first = st.released[recv]
+                if func.attr in resmodel.KINDS[kind].release_methods:
+                    self._emit("TPU503", line,
+                               f"double release of {kind} handle '{recv}' "
+                               f"(first released line {first})")
+                    return None
+            if recv in st.none:
+                kind = st.none[recv]
+                if func.attr in resmodel.KINDS[kind].release_methods:
+                    self._emit("TPU504", line,
+                               f"releases {kind} handle '{recv}' on a path "
+                               "where it is proven None / never acquired")
+                    return None
+            if recv == "shutil" and func.attr == "rmtree":
+                for a in call.args[:1]:
+                    if isinstance(a, ast.Name):
+                        if (a.id in st.live
+                                and st.live[a.id].kind == "tmp_dir"):
+                            self._release(a.id, line, st)
+                            return None
+                        if (a.id in st.released
+                                and st.released[a.id][0] == "tmp_dir"):
+                            self._emit(
+                                "TPU503", line,
+                                f"double release of tmp_dir handle "
+                                f"'{a.id}' (first released line "
+                                f"{st.released[a.id][1]})")
+                            return None
+        acq, rel, auth = self.model.resolve_call(call, self.fr)
+        arg_names = [a for a in list(call.args)
+                     + [kw.value for kw in call.keywords]
+                     if isinstance(a, ast.Name)]
+        if rel:
+            for a in arg_names:
+                if a.id in st.live and st.live[a.id].kind in rel:
+                    self._release(a.id, line, st)
+                elif a.id in st.released and st.released[a.id][0] in rel:
+                    self._emit("TPU503", line,
+                               f"double release of {st.released[a.id][0]} "
+                               f"handle '{a.id}' (first released line "
+                               f"{st.released[a.id][1]})")
+                elif a.id in st.none and st.none[a.id] in rel:
+                    self._emit("TPU504", line,
+                               f"releases {st.none[a.id]} handle '{a.id}' "
+                               "on a path where it is proven None / never "
+                               "acquired")
+        if acq and auth:
+            # only authoritative resolution creates caller-side
+            # handles (a name-matched `super().__init__(...)` must
+            # not); a callee that both acquires AND releases the kind
+            # is self-contained — nothing flows to this caller.
+            kind = next(iter(acq)) if len(acq) == 1 else None
+            if (exempt or kind is None or kind in rel
+                    or not resmodel.KINDS[kind].flows):
+                return None         # with-managed, vague, or interior
+            return kind
+        if not rel:
+            prim = _primitive_kind(call)
+            if prim is not None and not exempt:
+                if (not self.fr.covers(prim)
+                        and not self._locally_managed(prim)):
+                    self._emit(
+                        "TPU506", line,
+                        f"undeclared {prim} acquisition: declare "
+                        f"'# tpu-resource: acquires={prim}' on the owning "
+                        "function (or manage the handle with a `with` "
+                        "block)")
+                return None
+        # tracked handles passed onward: a constructor captures
+        # (ownership transfer), a plain call only borrows
+        for a in arg_names:
+            if a.id in st.live and _ctor_like(_leaf_name(func)):
+                self._escape(a.id, line, st, f"{_leaf_name(func)}(...)")
+        return None
+
+    # ----------------------------------------------------- statements
+    def _block(self, stmts, st):
+        for s in stmts:
+            if st.terminated:
+                break
+            self._stmt(s, st)
+
+    def _stmt(self, s, st):  # noqa: C901 - one dispatch point
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for b in s.body:
+                self._closure_escape(b, st, s.lineno)
+            return
+        if isinstance(s, ast.ClassDef):
+            return
+        if isinstance(s, ast.Return):
+            self._return(s, st)
+        elif isinstance(s, ast.Raise):
+            self._eval(s.exc, st)
+            self._leak_sweep(st, s.lineno, "at this raise",
+                             on_exception=True)
+            st.terminated = True
+        elif isinstance(s, (ast.Break, ast.Continue)):
+            kw = "break" if isinstance(s, ast.Break) else "continue"
+            for name, h in list(st.live.items()):
+                if h.dead or h.loop_depth < self.loop_depth:
+                    continue        # acquired outside this loop: survives
+                if self._protected(name, on_exception=False):
+                    continue
+                h.dead = True
+                self._emit("TPU502", s.lineno,
+                           f"{h.kind} handle '{name}' (acquired line "
+                           f"{h.line}) leaks at this `{kw}` — the next "
+                           "iteration re-acquires without releasing")
+            st.terminated = True
+        elif isinstance(s, ast.If):
+            self._if(s, st)
+        elif isinstance(s, (ast.For, ast.AsyncFor, ast.While)):
+            self._loop(s, st)
+        elif isinstance(s, (ast.With, ast.AsyncWith)):
+            self._with(s, st)
+        elif isinstance(s, ast.Try):
+            self._try(s, st)
+        elif isinstance(s, ast.Assign):
+            self._assign(s, st)
+        elif isinstance(s, (ast.AnnAssign, ast.AugAssign)):
+            self._eval(s.value, st)
+        elif isinstance(s, ast.Expr):
+            self._eval(s.value, st, top_bind=False)
+        elif isinstance(s, ast.Delete):
+            for tgt in s.targets:
+                if isinstance(tgt, ast.Name):
+                    st.live.pop(tgt.id, None)
+        else:
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, ast.expr):
+                    self._eval(child, st)
+
+    def _return(self, s, st):
+        kind = self._eval(s.value, st, top_bind=True)
+        if kind is not None and not self.fr.covers(kind):
+            self._emit("TPU508", s.lineno,
+                       f"freshly acquired {kind} handle escapes via the "
+                       f"return value but this function declares no "
+                       f"ownership of {kind} (add '# tpu-resource: "
+                       f"acquires={kind}')")
+        value = s.value
+        elts = (value.elts if isinstance(value, ast.Tuple)
+                else [value] if value is not None else [])
+        for e in elts:
+            if isinstance(e, ast.Name) and e.id in st.live:
+                self._escape(e.id, s.lineno, st, "the return value")
+        self._leak_sweep(st, s.lineno, "at this early return",
+                         on_exception=False)
+        st.terminated = True
+
+    def _assign(self, s, st):
+        value = s.value
+        # record `flag = h is None` so a later `if flag:` narrows h
+        if (len(s.targets) == 1 and isinstance(s.targets[0], ast.Name)
+                and isinstance(value, ast.Compare)
+                and isinstance(value.left, ast.Name)
+                and len(value.ops) == 1
+                and isinstance(value.ops[0], (ast.Is, ast.IsNot))
+                and isinstance(value.comparators[0], ast.Constant)
+                and value.comparators[0].value is None):
+            sense = ("is_none" if isinstance(value.ops[0], ast.Is)
+                     else "not_none")
+            self.boolmap[s.targets[0].id] = (sense, value.left.id)
+            return
+        if isinstance(value, ast.Constant) and value.value is None:
+            for tgt in s.targets:   # `h = None`: the binding dies here
+                if not isinstance(tgt, ast.Name):
+                    continue
+                old = st.live.pop(tgt.id, None)
+                if old is None:
+                    continue
+                if not old.dead:
+                    old.dead = True
+                    self._emit("TPU502", s.lineno,
+                               f"{old.kind} handle '{tgt.id}' (acquired "
+                               f"line {old.line}) is overwritten with None "
+                               "without being released")
+                st.none[tgt.id] = old.kind
+            return
+        kind = self._eval(value, st, top_bind=True)
+        for tgt in s.targets:
+            if isinstance(tgt, ast.Name):
+                if kind is not None:
+                    self._bind(tgt.id, kind, s.lineno, st)
+            elif isinstance(tgt, ast.Tuple) and kind is not None:
+                for e in tgt.elts:   # `lock, payload = acquire_or_wait()`
+                    if isinstance(e, ast.Name):
+                        self._bind(e.id, kind, s.lineno, st)
+                        break
+            elif isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                if kind is not None and not self.fr.covers(kind):
+                    self._emit(
+                        "TPU508", s.lineno,
+                        f"{kind} handle is stored into "
+                        f"`{_expr_str(tgt)}` at birth but this function "
+                        f"declares no ownership of {kind} (add "
+                        f"'# tpu-resource: acquires={kind}')")
+                if isinstance(value, ast.Name) and value.id in st.live:
+                    self._escape(value.id, s.lineno, st,
+                                 f"`{_expr_str(tgt)}`")
+
+    # ------------------------------------------------------- branches
+    def _none_guard(self, test):
+        """(handle-name, branch-that-sees-None) or (None, None)."""
+        if isinstance(test, ast.Compare) and isinstance(test.left, ast.Name):
+            if (len(test.ops) == 1
+                    and isinstance(test.comparators[0], ast.Constant)
+                    and test.comparators[0].value is None):
+                if isinstance(test.ops[0], ast.Is):
+                    return test.left.id, "body"
+                if isinstance(test.ops[0], ast.IsNot):
+                    return test.left.id, "orelse"
+        if isinstance(test, ast.Name):
+            mapped = self.boolmap.get(test.id)
+            if mapped:
+                sense, name = mapped
+                return name, ("body" if sense == "is_none" else "orelse")
+            return test.id, "orelse"       # `if h:` — else-arm sees None
+        if (isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not)
+                and isinstance(test.operand, ast.Name)):
+            mapped = self.boolmap.get(test.operand.id)
+            if mapped:
+                sense, name = mapped
+                return name, ("orelse" if sense == "is_none" else "body")
+            return test.operand.id, "body"  # `if not h:` — body sees None
+        return None, None
+
+    def _merge(self, st, branches):
+        # a branch that terminated (returned/raised) contributes
+        # NOTHING to the fall-through state: a handler's
+        # release-then-raise must not mark the handle released on the
+        # surviving path (that made every later release a false
+        # TPU503).
+        alive = [b for b in branches if not b.terminated]
+        if not alive:
+            for b in branches:
+                st.released.update(b.released)
+            st.terminated = True
+            return
+        released = dict(st.released)
+        for b in alive:
+            released.update(b.released)
+        live = {}
+        for b in alive:
+            live.update(b.live)
+        for name in list(live):     # optimistic: released on a live arm
+            if any(name in b.released for b in alive):
+                live.pop(name)
+        none = {name: kind for name, kind in alive[0].none.items()
+                if all(name in b.none for b in alive)}
+        st.live = live
+        st.released = released
+        st.none = none
+        st.terminated = False
+
+    def _if(self, s, st):
+        self._eval(s.test, st)
+        guard_name, none_branch = self._none_guard(s.test)
+        body_st, else_st = st.clone(), st.clone()
+        if guard_name is not None:
+            narrowed = body_st if none_branch == "body" else else_st
+            h = narrowed.live.pop(guard_name, None)
+            if h is not None:       # proven-None on this arm: a release
+                narrowed.none[guard_name] = h.kind      # here is TPU504
+        self._block(s.body, body_st)
+        self._block(s.orelse, else_st)
+        self._merge(st, [body_st, else_st])
+
+    def _loop(self, s, st):
+        if isinstance(s, ast.While):
+            self._eval(s.test, st)
+        else:
+            self._eval(s.iter, st)
+        pre = st.clone()
+        body_st = st.clone()
+        self.loop_depth += 1
+        self._block(s.body, body_st)
+        self.loop_depth -= 1
+        self._merge(st, [pre, body_st])
+        if s.orelse and not st.terminated:
+            self._block(s.orelse, st)
+
+    def _with(self, s, st):
+        exempt = set()
+        pushed = 0
+        for item in s.items:
+            ce = item.context_expr
+            if isinstance(ce, ast.Call):
+                exempt.add(id(ce))   # self-managed: releases at exit
+                self._eval(ce, st, with_exempt=exempt)
+            elif isinstance(ce, (ast.Attribute, ast.Name)):
+                self.lock_stack.append(_expr_str(ce))
+                pushed += 1
+        self._block(s.body, st)
+        for _ in range(pushed):
+            self.lock_stack.pop()
+
+    def _release_names(self, stmts):
+        """Names released anywhere under ``stmts`` — the protection
+        pre-scan for except/finally arms (permissive on purpose: its
+        only job is suppressing leak reports, never creating them)."""
+        names = set()
+        for root in stmts:
+            for node in ast.walk(root):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if isinstance(func, ast.Attribute):
+                    if isinstance(func.value, ast.Name):
+                        recv = func.value.id
+                        if any(func.attr in k.release_methods
+                               for k in resmodel.KINDS.values()):
+                            names.add(recv)
+                        if recv == "shutil" and func.attr == "rmtree":
+                            names.update(a.id for a in node.args[:1]
+                                         if isinstance(a, ast.Name))
+                _acq, rel, _auth = self.model.resolve_call(node, self.fr)
+                if rel:
+                    names.update(a.id for a in list(node.args)
+                                 + [kw.value for kw in node.keywords]
+                                 if isinstance(a, ast.Name))
+        return names
+
+    def _try(self, s, st):
+        fin_names = self._release_names(s.finalbody)
+        handler_names = set()
+        for handler in s.handlers:
+            handler_names |= self._release_names(handler.body)
+        entry = st.clone()
+        self.frames.append((fin_names, handler_names))
+        self._block(s.body, st)
+        if not st.terminated:
+            self._block(s.orelse, st)
+        self.frames.pop()
+        handler_states = []
+        if s.finalbody:
+            self.frames.append((fin_names, set()))
+        for handler in s.handlers:
+            hst = entry.clone()
+            self._block(handler.body, hst)
+            handler_states.append(hst)
+        if s.finalbody:
+            self.frames.pop()
+        self._merge(st, [st.clone()] + handler_states)
+        if s.finalbody and not st.terminated:
+            self._block(s.finalbody, st)
+        elif s.finalbody:
+            fin_st = st.clone()
+            fin_st.terminated = False
+            self._block(s.finalbody, fin_st)
+            st.released.update(fin_st.released)

@@ -1,0 +1,281 @@
+"""restrace — runtime resource-leak sanitizer (the dynamic complement
+of the TPU5xx static passes, exactly as ``locktrace`` complements the
+TPU3xx lock model).
+
+Opt-in: set ``PADDLE_TPU_RESTRACE=1`` (the test conftest arms it for
+the whole pytest session) or call :func:`enable`. When armed, the
+declared acquire/release definition sites of every *traced* resource
+kind (see ``resmodel.KINDS``) are wrapped with per-kind live-handle
+registries:
+
+- ``kv_slot``        — ``decode._KVSlots.alloc`` / ``.release``
+- ``router_socket``  — ``router.FleetRouter._conn_open`` /
+  ``_pool_get`` / ``_pool_put`` / ``_conn_close``
+- ``flight_lock``    — ``artifact_store.ArtifactStore.try_acquire`` /
+  ``release`` (``_takeover`` only removes a stale peer's file; the
+  re-acquire goes through ``try_acquire``)
+- ``tmp_dir``        — ``ArtifactStore._tmp_create`` / ``_tmp_done``
+  and ``fleet._portdir_create`` / ``_portdir_done``
+- ``signal_handler`` — ``preemption.PreemptionHandler.install`` /
+  ``uninstall``
+
+(``thread`` and ``breaker`` are static-only: every stack thread is a
+daemon and breaker state is an aggregate, not a handle.)
+
+A release of a handle that is not live is recorded as a *violation*
+(the runtime mirror of TPU503/TPU504); a suite that ends with a
+nonzero census has leaked (the mirror of TPU501/TPU502). With
+``PADDLE_TPU_RESTRACE_RAISE=1`` violations raise at the offending
+call and :func:`assert_clean` (wired into the conftest session
+teardown) raises on a nonzero final census — how the ci_gate
+``--resources`` smoke runs the decode/fleet/artifact suites.
+
+Disabled mode is a true no-op: the original functions are restored
+and nothing records. All bookkeeping is guarded by one leaf lock, so
+running under ``locktrace`` at the same time adds no inversion edges.
+"""
+import os
+import sys
+import threading
+
+__all__ = ["ResourceLeak", "enable", "disable", "enabled", "reset",
+           "census", "live", "violations", "report", "assert_clean",
+           "maybe_enable_from_env", "note_acquire", "note_release"]
+
+
+class ResourceLeak(AssertionError):
+    """A resource-lifecycle violation observed at runtime."""
+
+
+_lock = threading.Lock()
+_enabled = False
+_raise = False
+_live = {}          # kind -> {key -> site}
+_violations = []    # human-readable strings
+_patches = []       # (obj, attr, original)
+
+
+def _site(depth=2):
+    f = sys._getframe(depth)
+    return f"{f.f_code.co_filename}:{f.f_lineno}"
+
+
+def note_acquire(kind, key, site=None):
+    """Record a live handle. Re-acquiring a live key refreshes its
+    site (idempotent installs stay one handle)."""
+    if not _enabled:
+        return
+    site = site or _site()
+    with _lock:
+        _live.setdefault(kind, {})[key] = site
+
+
+def note_release(kind, key, site=None, strict=True):
+    """Retire a live handle. ``strict`` releases of unknown keys are
+    violations (runtime double-release / release-of-unacquired)."""
+    if not _enabled:
+        return
+    site = site or _site()
+    with _lock:
+        handles = _live.setdefault(kind, {})
+        if key in handles:
+            handles.pop(key)
+            return
+        if not strict:
+            return
+        msg = (f"restrace: release of a {kind} handle that is not live "
+               f"(double release or release-of-unacquired) at {site}")
+        _violations.append(msg)
+    if _raise:
+        raise ResourceLeak(msg)
+
+
+# ------------------------------------------------------------ patching
+
+
+def _wrap(obj, attr, make):
+    orig = getattr(obj, attr)
+    wrapper = make(orig)
+    wrapper.__name__ = getattr(orig, "__name__", attr)
+    wrapper.__qualname__ = getattr(orig, "__qualname__", attr)
+    setattr(obj, attr, wrapper)
+    _patches.append((obj, attr, orig))
+
+
+def _acquiring(kind, key_of):
+    def make(orig):
+        def wrapper(*args, **kwargs):
+            out = orig(*args, **kwargs)
+            key = key_of(args, out)
+            if key is not None:
+                note_acquire(kind, key, site=_site())
+            return out
+        return wrapper
+    return make
+
+
+def _releasing(kind, key_of, strict=True):
+    def make(orig):
+        def wrapper(*args, **kwargs):
+            key = key_of(args, None)
+            out = orig(*args, **kwargs)
+            if key is not None:
+                note_release(kind, key, site=_site(), strict=strict)
+            return out
+        return wrapper
+    return make
+
+
+def _install_patches():
+    from paddle_tpu.inference import decode, fleet, router
+    from paddle_tpu.resilience import preemption
+    from paddle_tpu.serialize import artifact_store
+
+    # kv_slot: slots are small ints scoped to one _KVSlots instance
+    _wrap(decode._KVSlots, "alloc", _acquiring(
+        "kv_slot", lambda a, out: None if out is None else (id(a[0]), out)))
+    _wrap(decode._KVSlots, "release", _releasing(
+        "kv_slot", lambda a, out: (id(a[0]), a[1])))
+
+    # router_socket: checkout/return of one socket object
+    _wrap(router.FleetRouter, "_conn_open", _acquiring(
+        "router_socket", lambda a, out: id(out)))
+    _wrap(router.FleetRouter, "_pool_get", _acquiring(
+        "router_socket", lambda a, out: None if out is None else id(out)))
+    _wrap(router.FleetRouter, "_pool_put", _releasing(
+        "router_socket", lambda a, out: id(a[2])))
+    # closing a socket the router no longer owns (pool drain, stop())
+    # is cleanup, not a checked-out release — tolerate unknown keys
+    _wrap(router.FleetRouter, "_conn_close", _releasing(
+        "router_socket", lambda a, out: id(a[1]), strict=False))
+
+    # flight_lock: the O_EXCL compile lockfile
+    _wrap(artifact_store.ArtifactStore, "try_acquire", _acquiring(
+        "flight_lock", lambda a, out: None if out is None else id(out)))
+    # release() is deliberately defensive (None and foreign-token
+    # handles are designed no-ops), so unknown keys are tolerated —
+    # the census still catches a lock that is never released at all
+    _wrap(artifact_store.ArtifactStore, "release", _releasing(
+        "flight_lock", lambda a, out: (None if len(a) < 2 or a[1] is None
+                                       else id(a[1])), strict=False))
+
+    # tmp_dir: artifact-store staging dirs + fleet portfile dirs
+    _wrap(artifact_store.ArtifactStore, "_tmp_create", _acquiring(
+        "tmp_dir", lambda a, out: out))
+    _wrap(artifact_store.ArtifactStore, "_tmp_done", _releasing(
+        "tmp_dir", lambda a, out: a[1]))
+    _wrap(fleet, "_portdir_create", _acquiring(
+        "tmp_dir", lambda a, out: out))
+    _wrap(fleet, "_portdir_done", _releasing(
+        "tmp_dir", lambda a, out: a[0]))
+
+    # signal_handler: one handle per (handler, signal) pair
+    def make_install(orig):
+        def wrapper(self, *args, **kwargs):
+            out = orig(self, *args, **kwargs)
+            site = _site()
+            for s in list(self._prev):
+                note_acquire("signal_handler", (id(self), int(s)), site=site)
+            return out
+        return wrapper
+
+    def make_uninstall(orig):
+        def wrapper(self, *args, **kwargs):
+            keys = [(id(self), int(s)) for s in list(self._prev)]
+            out = orig(self, *args, **kwargs)
+            site = _site()
+            for key in keys:
+                note_release("signal_handler", key, site=site)
+            return out
+        return wrapper
+
+    _wrap(preemption.PreemptionHandler, "install", make_install)
+    _wrap(preemption.PreemptionHandler, "uninstall", make_uninstall)
+
+
+# ----------------------------------------------------------- public API
+
+
+def enable(raise_on_leak=None):
+    """Arm the sanitizer (idempotent). ``raise_on_leak`` switches the
+    violation behaviour without re-patching when already armed."""
+    global _enabled, _raise
+    if raise_on_leak is not None:
+        _raise = bool(raise_on_leak)
+    if _enabled:
+        return
+    _install_patches()
+    _enabled = True
+
+
+def disable():
+    """Restore every patched definition site and stop recording."""
+    global _enabled
+    if not _enabled:
+        return
+    _enabled = False
+    while _patches:
+        obj, attr, orig = _patches.pop()
+        setattr(obj, attr, orig)
+
+
+def enabled():
+    return _enabled
+
+
+def reset():
+    """Forget all live handles and violations (per-test hygiene)."""
+    with _lock:
+        _live.clear()
+        del _violations[:]
+
+
+def census():
+    """kind -> live-handle count (every modeled kind always present)."""
+    from . import resmodel
+    with _lock:
+        return {k: len(_live.get(k, ())) for k in resmodel.KINDS}
+
+
+def live():
+    """kind -> [acquire sites] of currently-live handles."""
+    with _lock:
+        return {k: sorted(v.values()) for k, v in _live.items() if v}
+
+
+def violations():
+    with _lock:
+        return list(_violations)
+
+
+def report():
+    return {"census": census(), "live": live(),
+            "violations": violations()}
+
+
+def assert_clean():
+    """Raise :class:`ResourceLeak` unless the census is zero and no
+    violation was recorded — the end-of-suite leak check."""
+    rep = report()
+    leaks = {k: n for k, n in rep["census"].items() if n}
+    if not leaks and not rep["violations"]:
+        return
+    lines = []
+    if leaks:
+        lines.append(f"nonzero end-of-suite live-handle census: {leaks}")
+        for kind, sites in rep["live"].items():
+            for s in sites:
+                lines.append(f"  live {kind} acquired at {s}")
+    lines.extend(rep["violations"])
+    raise ResourceLeak("restrace: " + "\n".join(lines))
+
+
+def maybe_enable_from_env():
+    """Arm iff ``PADDLE_TPU_RESTRACE`` is truthy (raise mode from
+    ``PADDLE_TPU_RESTRACE_RAISE``); returns whether armed."""
+    if os.environ.get("PADDLE_TPU_RESTRACE", "0") in ("0", "", "false"):
+        return False
+    raise_mode = os.environ.get(
+        "PADDLE_TPU_RESTRACE_RAISE", "0") not in ("0", "", "false")
+    enable(raise_on_leak=raise_mode)
+    return True

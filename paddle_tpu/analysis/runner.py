@@ -13,7 +13,7 @@ from .diagnostics import (Diagnostic, SuppressionIndex, filter_diagnostics,
 
 __all__ = ["lint_source", "lint_file", "lint_paths", "lint_function",
            "lint_registry", "lint_concurrency", "lint_protocol",
-           "LintResult"]
+           "lint_resources", "LintResult"]
 
 
 class LintResult:
@@ -135,6 +135,36 @@ def lint_concurrency(paths, disabled=()):
         except OSError:
             continue
     diags = concurrency.check_sources(sources)
+    suppression = {fn: SuppressionIndex(src) for src, fn in sources}
+    by_file = {}
+    for d in diags:
+        by_file.setdefault(d.filename, []).append(d)
+    out = []
+    for fn, group in by_file.items():
+        out.extend(filter_diagnostics(group, disabled=disabled,
+                                      suppression=suppression.get(fn)))
+    return LintResult(sorted(out, key=sort_key),
+                      files_scanned=len(sources))
+
+
+def lint_resources(paths, disabled=()):
+    """Resource-lifecycle pass family (TPU5xx) over files/packages.
+
+    Like the concurrency family, every .py file under ``paths`` feeds
+    ONE resource model (declared acquirers/releasers resolve across
+    files) before the per-function ownership walk runs. Inline
+    suppression (``# tpu-lint: disable=TPU50x  # why``) applies per
+    file/line as usual."""
+    from . import resources
+
+    sources = []
+    for path in _iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                sources.append((f.read(), path))
+        except OSError:
+            continue
+    diags = resources.check_sources(sources)
     suppression = {fn: SuppressionIndex(src) for src, fn in sources}
     by_file = {}
     for d in diags:

@@ -279,10 +279,12 @@ class _Breaker:
             return True
         return False
 
+    # tpu-resource: releases=breaker
     def record_success(self):
         self.failures = 0
         self.state = self.CLOSED
 
+    # tpu-resource: acquires=breaker
     def record_failure(self, now):
         self.failures += 1
         if self.threshold <= 0:
@@ -297,6 +299,7 @@ class _Breaker:
                 "trips": self.trips, "shed": self.shed}
 
 
+# tpu-resource: releases=flight_lock
 def _publish_in_background(store, key, lock, blob):
     """Publish off the hot path: the requester already has its
     program and the bytes are already serialized — only the store

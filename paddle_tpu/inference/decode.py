@@ -509,9 +509,11 @@ class _KVSlots:
     def free_count(self):
         return len(self._free)
 
+    # tpu-resource: acquires=kv_slot
     def alloc(self):
         return self._free.pop() if self._free else None
 
+    # tpu-resource: releases=kv_slot
     def release(self, slot):
         self._free.append(slot)
 
@@ -1053,6 +1055,7 @@ class DecodeEngine:
     def _drop_cancelled_locked(self):
         self._pending[:] = [r for r in self._pending if not r.cancelled]
 
+    # tpu-resource: releases=kv_slot
     def _purge_blown_budgets(self, gen):
         """Retire active sequences that were cancelled or blew their
         per-token budget — BEFORE the next step, so a dead client's
@@ -1089,6 +1092,7 @@ class DecodeEngine:
             self._notify_retired(s, reason, err)
 
     # ----------------------------------------------------------- prefill
+    # tpu-resource: acquires=kv_slot releases=kv_slot
     def _prefill(self, gen, joiners):
         rows = bucket_rows(max(len(joiners), 2), self._rows_cap)
         p_bucket = seq_bucket(max(r.prompt.size for r in joiners),
@@ -1190,6 +1194,7 @@ class DecodeEngine:
             self._notify_retired(s, reason, err)
 
     # ------------------------------------------------------- decode step
+    # tpu-resource: releases=kv_slot
     def _decode_step(self, gen):
         active = list(self._active)
         n = len(active)
@@ -1583,6 +1588,7 @@ class DecodeEngine:
             elif wedged:
                 self._restart_scheduler(gen, "wedged (heartbeat stale)")
 
+    # tpu-resource: releases=kv_slot
     def _restart_scheduler(self, observed_gen, reason):
         with self._cond:
             if self._closed or observed_gen != self._sched_gen:
@@ -1619,6 +1625,7 @@ class DecodeEngine:
                 r._fail(err)
 
     # -------------------------------------------------------------- close
+    # tpu-resource: releases=kv_slot
     def close(self, timeout=5.0):
         """Stop the scheduler. Active sequences fail retryable (a
         close mid-stream is a shed, not silent truncation); queued

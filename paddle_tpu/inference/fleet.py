@@ -47,6 +47,7 @@ Env knobs (constructor kwargs win):
 (plus the ROUTER/REGISTRY knobs — see router.py / registry.py.)
 """
 import os
+import shutil
 import socket
 import struct
 import subprocess
@@ -111,6 +112,22 @@ class ReplicaHandle:
                     pass  # un-reapable zombie; the OS owns it now
 
 
+# Portfile-dir lifecycle: a spawn's rendezvous dir lives exactly as
+# long as the spawn attempt — _portdir_done on every path (the replica
+# wrote its port into it; nothing reads it again). The TPU5xx lint and
+# the restrace sanitizer both key on this pair.
+# tpu-resource: acquires=tmp_dir
+def _portdir_create():
+    """One private dir for a replica's port rendezvous file."""
+    return tempfile.mkdtemp(prefix="fleet-")
+
+
+# tpu-resource: releases=tmp_dir
+def _portdir_done(path):
+    """Retire a port-rendezvous dir (bound, crashed, or timed out)."""
+    shutil.rmtree(path, ignore_errors=True)
+
+
 def subprocess_spawner(prefix, host="127.0.0.1", extra_env=None,
                        spawn_timeout=None, max_batch_size=8,
                        max_wait_ms=2.0, max_queue=256):
@@ -123,33 +140,38 @@ def subprocess_spawner(prefix, host="127.0.0.1", extra_env=None,
     repo = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
 
+    # tpu-resource: acquires=tmp_dir releases=tmp_dir
     def spawn(rid):
-        portfile = os.path.join(tempfile.mkdtemp(prefix="fleet-"),
-                                f"{rid}.port")
-        env = dict(os.environ)
-        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-        if extra_env:
-            env.update(extra_env)
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "paddle_tpu.inference.fleet",
-             "--replica", prefix, portfile,
-             str(max_batch_size), str(max_wait_ms), str(max_queue)],
-            env=env)
-        t_end = time.monotonic() + timeout
-        while time.monotonic() < t_end:
-            if os.path.exists(portfile):
-                with open(portfile) as f:
-                    return ReplicaHandle(rid, host, int(f.read()),
-                                         proc=proc)
-            if proc.poll() is not None:
-                raise RuntimeError(
-                    f"replica {rid} exited rc={proc.returncode} "
-                    "before binding")
-            time.sleep(0.02)
-        proc.kill()
-        proc.wait()
-        raise TimeoutError(f"replica {rid} did not bind within "
-                           f"{timeout:.0f}s")
+        portdir = _portdir_create()
+        try:
+            portfile = os.path.join(portdir, f"{rid}.port")
+            env = dict(os.environ)
+            env["PYTHONPATH"] = (repo + os.pathsep
+                                 + env.get("PYTHONPATH", ""))
+            if extra_env:
+                env.update(extra_env)
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.inference.fleet",
+                 "--replica", prefix, portfile,
+                 str(max_batch_size), str(max_wait_ms), str(max_queue)],
+                env=env)
+            t_end = time.monotonic() + timeout
+            while time.monotonic() < t_end:
+                if os.path.exists(portfile):
+                    with open(portfile) as f:
+                        return ReplicaHandle(rid, host, int(f.read()),
+                                             proc=proc)
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"replica {rid} exited rc={proc.returncode} "
+                        "before binding")
+                time.sleep(0.02)
+            proc.kill()
+            proc.wait()
+            raise TimeoutError(f"replica {rid} did not bind within "
+                               f"{timeout:.0f}s")
+        finally:
+            _portdir_done(portdir)
 
     return spawn
 

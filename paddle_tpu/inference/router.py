@@ -339,6 +339,7 @@ class FleetRouter:
     False``) or let it build one; ``tenants`` is an iterable of
     :class:`TenantPolicy`."""
 
+    # tpu-resource: acquires=router_socket
     def __init__(self, registry=None, port=0, host="127.0.0.1",
                  tenants=(), max_inflight=None, retry_attempts=None,
                  retry_base=None, retry_max=None, admit_timeout=None,
@@ -389,6 +390,11 @@ class FleetRouter:
         self.gate.add_tenant(policy)
 
     # ----------------------------------------------------------- backend
+    # Replica-connection lifecycle: every checkout comes from
+    # _pool_get/_conn_open and every checked-out socket ends in exactly
+    # one of _pool_put (clean reuse) or _conn_close (poison) — the
+    # TPU5xx lint and the restrace sanitizer both key on these four.
+    # tpu-resource: acquires=router_socket
     def _pool_get(self, rid):
         with self._pools_lock:
             pool = self._pools.get(rid)
@@ -396,6 +402,31 @@ class FleetRouter:
                 return pool.pop()
         return None
 
+    # tpu-resource: acquires=router_socket
+    def _conn_open(self, view):
+        """Dial one replica connection. TCP_NODELAY is set before the
+        socket escapes — a raise after the dial must close it, or the
+        half-configured socket leaks."""
+        sock = socket.create_connection((view.host, view.port),
+                                        timeout=self.registry.dial_timeout)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            sock.close()
+            raise
+        return sock
+
+    # tpu-resource: releases=router_socket
+    def _conn_close(self, sock):
+        """Poison one checked-out replica connection (best-effort,
+        never raises): timed-out, desynced, or client-gone sockets
+        must die here, never return to the pool."""
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    # tpu-resource: releases=router_socket
     def _pool_put(self, rid, sock):
         with self._pools_lock:
             if not self._stop.is_set():
@@ -435,9 +466,7 @@ class FleetRouter:
         sock = self._pool_get(view.rid)
         fresh = sock is None
         if fresh:
-            sock = socket.create_connection((view.host, view.port),
-                                            timeout=self.registry.dial_timeout)
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock = self._conn_open(view)
         hdr = b""
         t_send = time.monotonic()
         try:
@@ -450,16 +479,10 @@ class FleetRouter:
             # a SLOW replica, not a dead stream: resending would
             # double-execute the request and double the latency —
             # surface the timeout (caller ejects + fails over)
-            try:
-                sock.close()
-            except OSError:
-                pass
+            self._conn_close(sock)
             raise
         except (OSError, ConnectionError):
-            try:
-                sock.close()
-            except OSError:
-                pass
+            self._conn_close(sock)
             if not fresh and not hdr:
                 # the pooled connection was stale (closed by a replica
                 # restart between requests — reset/EOF before any
@@ -472,10 +495,15 @@ class FleetRouter:
                 return self._forward_fresh(view, frame, timeout,
                                            client_conn)
             raise
-        if (client_conn is not None and body
-                and body[0] == STATUS_STREAM):
-            return self._relay(view, sock, body, client_conn, timeout,
-                               t_send)
+        if body and body[0] == STATUS_STREAM:
+            if client_conn is not None:
+                return self._relay(view, sock, body, client_conn, timeout,
+                                   t_send)
+            # a replica streaming at a NON-streaming dispatch (version
+            # skew): the socket is mid-stream and desynced — poison it;
+            # pooling it would corrupt the next request on this replica
+            self._conn_close(sock)
+            return body
         self._pool_put(view.rid, sock)
         return body
 
@@ -490,11 +518,13 @@ class FleetRouter:
             return 0
         return sum(int(a.size) for a in arrays)
 
+    # tpu-resource: releases=router_socket
     def _relay(self, view, sock, first_body, client_conn, timeout,
                t_send):
         """Pump chunk frames replica -> client until the terminal
-        frame. Pools the replica socket on a clean terminal (the
-        stream ends exactly at a frame boundary). ``t_send`` is when
+        frame. Owns ``sock`` from here on: pools it on a clean
+        terminal (the stream ends exactly at a frame boundary),
+        poisons it on every other exit. ``t_send`` is when
         the request hit the replica's socket, so the FIRST gap really
         is time-to-first-token — the per-token SLO treats the first
         chunk as a token, and anchoring at relay start would hide
@@ -510,10 +540,7 @@ class FleetRouter:
                 # the client vanished: close the REPLICA socket too
                 # (never pooled — mid-stream), which makes the
                 # replica's own send fail and purge the KV slot
-                try:
-                    sock.close()
-                except OSError:
-                    pass
+                self._conn_close(sock)
                 raise _ClientGone(str(e)) from e
 
         body = first_body
@@ -533,10 +560,7 @@ class FleetRouter:
                 # replica died mid-stream: the client already consumed
                 # a prefix, so no transparent retry — terminate the
                 # stream retryably and report the replica
-                try:
-                    sock.close()
-                except OSError:
-                    pass
+                self._conn_close(sock)
                 self.registry.report_io_error(view.rid)
                 self._pool_drop(view.rid)
                 send(struct.pack("<B", STATUS_OVERLOADED))
@@ -544,25 +568,24 @@ class FleetRouter:
                                  replica_ok=False)
 
     def _forward_fresh(self, view, frame, timeout, client_conn=None):
-        sock = socket.create_connection((view.host, view.port),
-                                        timeout=self.registry.dial_timeout)
+        sock = self._conn_open(view)
         t_send = time.monotonic()
         try:
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             sock.settimeout(timeout)
             sock.sendall(frame)
             (blen,) = struct.unpack("<I", _read_all(sock, 4))
             body = _read_all(sock, blen)
         except (OSError, ConnectionError):
-            try:
-                sock.close()
-            except OSError:
-                pass
+            self._conn_close(sock)
             raise
-        if (client_conn is not None and body
-                and body[0] == STATUS_STREAM):
-            return self._relay(view, sock, body, client_conn, timeout,
-                               t_send)
+        if body and body[0] == STATUS_STREAM:
+            if client_conn is not None:
+                return self._relay(view, sock, body, client_conn, timeout,
+                                   t_send)
+            # same version-skew poison as _forward: mid-stream sockets
+            # never reach the pool
+            self._conn_close(sock)
+            return body
         self._pool_put(view.rid, sock)
         return body
 
@@ -926,6 +949,7 @@ class FleetRouter:
         }
 
     # -------------------------------------------------------------- close
+    # tpu-resource: releases=router_socket
     def stop(self):
         self._stop.set()
         try:

@@ -187,6 +187,7 @@ class PredictorServer:
     declared buckets are precompiled so no post-swap request pays a
     cold compile."""
 
+    # tpu-resource: acquires=router_socket
     def __init__(self, run_fn, port=0, host="127.0.0.1",
                  max_body=MAX_BODY_BYTES, recv_timeout=RECV_TIMEOUT,
                  engine=None, own_engine=False, loader=None, prefix=None,
@@ -684,6 +685,7 @@ class PredictorServer:
             with self._conns_lock:
                 self._conns.pop(threading.current_thread(), None)
 
+    # tpu-resource: releases=router_socket
     def stop(self, drain=True, timeout=DRAIN_TIMEOUT):
         """Graceful shutdown: stop accepting, let requests that are
         mid-processing finish (up to `timeout`), force-close idle

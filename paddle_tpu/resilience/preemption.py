@@ -61,6 +61,7 @@ class PreemptionHandler:
         self._installed = False
         self.signum = None  # which signal fired (telemetry)
 
+    # tpu-resource: acquires=signal_handler
     def install(self, signals=(signal.SIGTERM, signal.SIGINT)):
         if threading.current_thread() is not threading.main_thread():
             return self  # signal.signal only works on the main thread
@@ -74,6 +75,7 @@ class PreemptionHandler:
         self._installed = True
         return self
 
+    # tpu-resource: releases=signal_handler
     def uninstall(self):
         for s, prev in self._prev.items():
             try:
@@ -116,6 +118,7 @@ def get_preemption_handler():
         return _handler
 
 
+# tpu-resource: acquires=signal_handler
 def install(signals=(signal.SIGTERM, signal.SIGINT)):
     return get_preemption_handler().install(signals)
 

@@ -437,6 +437,25 @@ class ArtifactStore:
             self._invalidate_entries_cache()
         return bool(outcome)
 
+    # Staging-dir lifecycle: every tmp dir comes from _tmp_create and
+    # ends in exactly one _tmp_done (publish moves it aside first, so
+    # the rmtree is then a no-op on the corpse name). The TPU5xx lint
+    # and the restrace sanitizer both key on this pair.
+    # tpu-resource: acquires=tmp_dir
+    def _tmp_create(self, digest):
+        """Create one private staging dir (the tmp half of the
+        write-then-rename publish); the owner must _tmp_done() it on
+        every path, or gc() only reclaims it by age."""
+        os.makedirs(self.root, exist_ok=True)
+        tmp = self._next_tmp(digest)
+        os.makedirs(tmp)
+        return tmp
+
+    # tpu-resource: releases=tmp_dir
+    def _tmp_done(self, tmp):
+        """Retire a staging dir, published or abandoned."""
+        shutil.rmtree(tmp, ignore_errors=True)
+
     def _put_raising(self, key, payload):
         """-> "wrote" (we published it) | "present" (a peer already
         had) — both truthy "the artifact is live" outcomes."""
@@ -444,9 +463,7 @@ class ArtifactStore:
         final = self._final(digest)
         if os.path.isdir(final):
             return "present"  # content-addressed: a peer already published
-        os.makedirs(self.root, exist_ok=True)
-        tmp = self._next_tmp(digest)
-        os.makedirs(tmp)
+        tmp = self._tmp_create(digest)
         try:
             with open(os.path.join(tmp, PAYLOAD_NAME), "wb") as f:
                 f.write(payload)
@@ -471,12 +488,13 @@ class ArtifactStore:
                     return "present"  # lost the publish race: it exists
                 raise
         finally:
-            shutil.rmtree(tmp, ignore_errors=True)
+            self._tmp_done(tmp)
         _fsync_dir(self.root)
         self.gc()
         return "wrote"
 
     # -------------------------------------------------------- single-flight
+    # tpu-resource: acquires=flight_lock
     def try_acquire(self, key):
         """Non-blocking single-flight claim for compiling `key`.
         Returns a _FlightLock when this caller owns the compile+publish
@@ -513,6 +531,7 @@ class ArtifactStore:
         os.close(fd)
         return _FlightLock(digest, path, token)
 
+    # tpu-resource: releases=flight_lock
     def release(self, lock):
         """Drop a held lock. Only unlinks the file if it still carries
         our token — a takeover may have replaced it."""
@@ -578,6 +597,7 @@ class ArtifactStore:
         self._bump("takeovers")
         return True
 
+    # tpu-resource: acquires=flight_lock
     def acquire_or_wait(self, key, timeout=None):
         """Blocking single-flight for warmup: either WE own the compile
         (-> (lock, None)), or a peer published while we waited
@@ -596,6 +616,7 @@ class ArtifactStore:
                           "compiling inline without publish")
             return None, None
 
+    # tpu-resource: acquires=flight_lock
     def _acquire_or_wait(self, key, timeout):
         # timeout=0 means "try once, never park" (an operator setting
         # PADDLE_TPU_ARTIFACT_WARMUP_WAIT_S=0 asked for exactly that);

@@ -80,6 +80,34 @@ if os.environ.get("PADDLE_TPU_LOCKTRACE", "0") not in ("0", "", "false"):
         _lt_spec.loader.exec_module(_locktrace)
     _locktrace.enable()
 
+# Opt-in runtime resource-leak sanitizer (TPU5xx counterpart of
+# locktrace): with PADDLE_TPU_RESTRACE=1 the declared acquire/release
+# sites of every traced resource kind (KV slots, pooled router
+# sockets, compile lockfiles, scratch dirs, signal handlers) record
+# per-kind live-handle censuses, and the session-scoped guard below
+# fails the run if the suite ends with a live handle. Unlike
+# locktrace, restrace patches named definition sites (not a lock
+# factory), so the ordinary package import is safe here.
+_RESTRACE_ARMED = False
+if os.environ.get("PADDLE_TPU_RESTRACE", "0") not in ("0", "", "false"):
+    from paddle_tpu.analysis import restrace as _restrace
+
+    _RESTRACE_ARMED = _restrace.maybe_enable_from_env()
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _restrace_census_guard():
+    """End-of-suite leak check: when restrace is armed, a nonzero
+    live-handle census (or any recorded violation) fails the session
+    — this is how ci_gate --resources runs the decode/fleet/artifact
+    suites."""
+    yield
+    if _RESTRACE_ARMED:
+        from paddle_tpu.analysis import restrace
+
+        if restrace.enabled():
+            restrace.assert_clean()
+
 
 @pytest.fixture(autouse=True)
 def _seed():

@@ -100,7 +100,8 @@ def build_models():
         def forward(self, x):
             return paddle.logical_not(x)
 
-    root = tempfile.mkdtemp(prefix="sharded_models_")
+    root = tempfile.mkdtemp(  # tpu-lint: disable=TPU506  # session-lifetime model dir, reaped with the tmpfs
+        prefix="sharded_models_")
     out = {}
     for name, cls, dtype in (("f32", MLP, "float32"),
                              ("i32", IntOps, "int32"),

@@ -37,6 +37,22 @@ def _clean_chaos():
     chaos.reset()
 
 
+@pytest.fixture()
+def traced_resources():
+    """Arm the restrace leak sanitizer for one test: the slot-purge
+    assertions below then check the LIVE-HANDLE CENSUS, not hand
+    bookkeeping — the same counters ci_gate --resources fails on."""
+    from paddle_tpu.analysis import restrace
+
+    was = restrace.enabled()
+    restrace.enable(raise_on_leak=False)
+    restrace.reset()
+    yield restrace
+    restrace.reset()
+    if not was:
+        restrace.disable()
+
+
 def make_engine(model, **kw):
     kw.setdefault("max_slots", 4)
     kw.setdefault("max_seq_len", 32)
@@ -231,7 +247,7 @@ class TestRobustness:
             assert out.tolist() == reference_decode(
                 model, PROMPTS[0], 6, max_seq_len=32).tolist()
 
-    def test_cancel_mid_stream_purges_slot(self, model):
+    def test_cancel_mid_stream_purges_slot(self, model, traced_resources):
         """The ISSUE 12 slot-leak audit: a stream abandoned mid-flight
         frees its KV slot immediately (chaos-slowed steps guarantee
         the sequence is genuinely mid-decode when cancelled)."""
@@ -251,6 +267,10 @@ class TestRobustness:
                 h = eng.health()
             assert h["active"] == 0
             assert h["free_slots"] == eng.max_slots
+            # the runtime sanitizer agrees: every alloc'd KV slot was
+            # released — zero live handles, no double-free violations
+            assert traced_resources.census()["kv_slot"] == 0
+            assert traced_resources.violations() == []
             assert req.finish_reason == "cancelled"
             assert eng.stats()["retired"]["cancelled"] == 1
             # far fewer than 500 tokens were computed
@@ -295,7 +315,8 @@ class TestRobustness:
             st = eng.stats()
             assert st["quarantine_shed"] >= 1
 
-    def test_watchdog_restarts_dead_scheduler(self, model):
+    def test_watchdog_restarts_dead_scheduler(self, model,
+                                              traced_resources):
         with make_engine(model, watchdog_interval=0.05) as eng:
             eng.generate(PROMPTS[0], max_new_tokens=2, timeout=60)
             with chaos.fault("serving.decode.loop",
@@ -309,6 +330,10 @@ class TestRobustness:
             assert out.tolist() == reference_decode(
                 model, PROMPTS[0], 4, max_seq_len=32).tolist()
             assert eng.stats()["scheduler_restarts"] >= 1
+            # restart purged the dead scheduler's sequences: the
+            # sanitizer census confirms no KV slot survived it live
+            assert traced_resources.census()["kv_slot"] == 0
+            assert traced_resources.violations() == []
 
 
 class TestWarmupAndStore:

@@ -1,0 +1,740 @@
+"""Resource-lifecycle lint (TPU501–TPU508, paddle_tpu.analysis.resources)
++ the restrace runtime sanitizer: every code fires on a minimal bad
+fixture and stays silent on the disciplined rewrite, one planted leak
+per modeled kind fails red naming the kind and path, inline waivers
+scope to their code, the README table tracks the model, the repo-wide
+self-check keeps paddle_tpu clean, and the ci_gate --resources stage
+gates on both the static pass and the restrace smoke (mirroring
+tests/test_conclint.py + tests/test_tracelint_gate.py)."""
+import json
+import os
+import re
+import socket
+import struct
+import subprocess
+import sys
+import textwrap
+import threading
+import types
+
+import pytest
+
+from paddle_tpu.analysis import (CODES, lint_resources, resmodel,
+                                 resources, restrace)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRACELINT = os.path.join(REPO, "tools", "tracelint.py")
+GATE = os.path.join(REPO, "tools", "ci_gate.py")
+
+# declared module-level acquire/release pairs the dataflow fixtures
+# call (authoritative resolution: bare-name call -> declared def)
+HELPERS = """\
+# tpu-resource: acquires=kv_slot
+def kv_alloc():
+    return object()
+
+
+# tpu-resource: releases=kv_slot
+def kv_free(h):
+    pass
+
+
+# tpu-resource: acquires=router_socket
+def sock_open(addr):
+    return object()
+"""
+
+PROD = "paddle_tpu/inference/mod.py"   # product scope: TPU506 is strict
+
+
+def lint(src, filename="mod.py"):
+    return resources.check_sources(
+        [(HELPERS, "helpers.py"), (textwrap.dedent(src), filename)])
+
+
+def codes_of(diags):
+    return {d.code for d in diags}
+
+
+# ------------------------------------------------------------ per-pass pairs
+# one (bad, good) fixture pair per code
+
+CASES = {
+    # live handle at a raise with no cleanup arm
+    "TPU501": (
+        """
+def use():
+    h = kv_alloc()
+    risky()
+    raise RuntimeError("boom")
+""",
+        """
+def use():
+    h = kv_alloc()
+    try:
+        risky()
+        raise RuntimeError("boom")
+    finally:
+        kv_free(h)
+""",
+    ),
+    # live handle at an early return
+    "TPU502": (
+        """
+def use(flag):
+    h = kv_alloc()
+    if flag:
+        return 1
+    kv_free(h)
+    return 0
+""",
+        """
+def use(flag):
+    h = kv_alloc()
+    if flag:
+        kv_free(h)
+        return 1
+    kv_free(h)
+    return 0
+""",
+    ),
+    # releasing twice on one path
+    "TPU503": (
+        """
+def use():
+    h = kv_alloc()
+    kv_free(h)
+    kv_free(h)
+""",
+        """
+def use():
+    h = kv_alloc()
+    kv_free(h)
+""",
+    ),
+    # releasing on the arm where the acquire is proven None
+    "TPU504": (
+        """
+def use():
+    h = kv_alloc()
+    if h is None:
+        kv_free(h)
+        return
+    kv_free(h)
+""",
+        """
+def use():
+    h = kv_alloc()
+    if h is None:
+        return
+    kv_free(h)
+""",
+    ),
+    # acquire under a lock, release after dropping it
+    "TPU505": (
+        """
+def use(lk):
+    with lk:
+        h = kv_alloc()
+    kv_free(h)
+""",
+        """
+def use(lk):
+    with lk:
+        h = kv_alloc()
+        kv_free(h)
+""",
+    ),
+    # undeclared primitive acquisition in product code
+    "TPU506": (
+        """
+import socket
+
+
+def dial(addr):
+    s = socket.create_connection(addr)
+    s.close()
+""",
+        """
+import socket
+
+
+# tpu-resource: acquires=router_socket releases=router_socket
+def dial(addr):
+    s = socket.create_connection(addr)
+    s.close()
+""",
+    ),
+    # chaos injection site inside a live window with no cleanup arm
+    "TPU507": (
+        """
+def use():
+    h = kv_alloc()
+    chaos.hit("spot")
+    kv_free(h)
+""",
+        """
+def use():
+    h = kv_alloc()
+    try:
+        chaos.hit("spot")
+    finally:
+        kv_free(h)
+""",
+    ),
+    # handle escapes via the return value with no declared owner
+    "TPU508": (
+        """
+def use():
+    h = kv_alloc()
+    return h
+""",
+        """
+# tpu-resource: acquires=kv_slot
+def use():
+    h = kv_alloc()
+    return h
+""",
+    ),
+}
+
+
+@pytest.mark.parametrize("code", sorted(CASES))
+def test_code_fires_on_bad_and_not_on_good(code):
+    bad, good = CASES[code]
+    fname = PROD if code == "TPU506" else "mod.py"
+    assert code in codes_of(lint(bad, fname)), code
+    assert codes_of(lint(good, fname)) == set(), code
+
+
+def test_all_codes_registered():
+    for code in CASES:
+        assert code in CODES
+
+
+# --------------------------------------------------- more walker behaviour
+
+
+def test_discarded_acquire_is_tpu502():
+    diags = lint("""
+def use():
+    kv_alloc()
+""")
+    assert codes_of(diags) == {"TPU502"}
+    assert "discarded" in diags[0].message
+
+
+def test_overwrite_without_release_is_tpu502():
+    diags = lint("""
+def use():
+    h = kv_alloc()
+    h = kv_alloc()
+    kv_free(h)
+""")
+    assert [d.code for d in diags] == ["TPU502"]
+    assert "overwritten" in diags[0].message
+
+
+def test_rebind_to_none_is_tpu502_and_release_after_is_tpu504():
+    diags = lint("""
+def use():
+    h = kv_alloc()
+    h = None
+    kv_free(h)
+""")
+    assert codes_of(diags) == {"TPU502", "TPU504"}
+
+
+def test_closure_capture_without_owner_is_tpu508():
+    diags = lint("""
+def use():
+    h = kv_alloc()
+
+    def worker():
+        return h
+
+    return worker
+""")
+    assert "TPU508" in codes_of(diags)
+
+
+def test_attribute_store_at_birth_without_owner_is_tpu508():
+    diags = lint("""
+def use(obj):
+    h = kv_alloc()
+    obj.slot = h
+""")
+    assert codes_of(diags) == {"TPU508"}
+
+
+def test_release_then_raise_handler_does_not_poison_main_path():
+    # the surviving path's release must NOT become a false TPU503
+    # just because an except arm released-then-raised
+    assert codes_of(lint("""
+def use():
+    h = kv_alloc()
+    try:
+        work()
+    except OSError:
+        kv_free(h)
+        raise
+    kv_free(h)
+""")) == set()
+
+
+def test_self_contained_callee_result_may_be_discarded():
+    assert codes_of(lint("""
+# tpu-resource: acquires=kv_slot releases=kv_slot
+def roundtrip():
+    h = kv_alloc()
+    kv_free(h)
+
+
+def use():
+    roundtrip()
+""")) == set()
+
+
+def test_release_method_retires_tracked_handle():
+    assert codes_of(lint("""
+def use(addr):
+    s = sock_open(addr)
+    s.close()
+""")) == set()
+
+
+def test_with_managed_primitive_needs_no_declaration():
+    assert codes_of(lint("""
+import socket
+
+
+def ping(addr):
+    with socket.create_connection(addr) as s:
+        s.sendall(b"x")
+""", PROD)) == set()
+
+
+def test_primitive_inside_declared_owner_is_trusted():
+    assert codes_of(lint("""
+import socket
+
+
+# tpu-resource: acquires=router_socket
+def dial(addr):
+    return socket.create_connection(addr)
+""", PROD)) == set()
+
+
+def test_locally_managed_primitive_ok_outside_product_scope():
+    src = """
+import tempfile
+import shutil
+
+
+def scratch():
+    d = tempfile.mkdtemp()
+    shutil.rmtree(d)
+"""
+    assert codes_of(lint(src, "tools/helper.py")) == set()
+    assert "TPU506" in codes_of(lint(src.replace(
+        "    shutil.rmtree(d)", "    pass"), "tools/helper.py"))
+
+
+def test_declaration_model_errors_are_tpu506():
+    unknown = lint("""
+# tpu-resource: acquires=warp_core
+def use():
+    pass
+""")
+    assert codes_of(unknown) == {"TPU506"}
+    assert "unknown" in unknown[0].message
+
+    malformed = lint("""
+# tpu-resource: holds=kv_slot
+def use():
+    pass
+""")
+    assert codes_of(malformed) == {"TPU506"}
+    assert "malformed" in malformed[0].message
+
+    misplaced = lint("""
+x = 1
+# tpu-resource: acquires=kv_slot
+y = 2
+""")
+    assert codes_of(misplaced) == {"TPU506"}
+    assert "misplaced" in misplaced[0].message
+
+
+# --------------------------------------------------- planted leak per kind
+# one red fixture per modeled resource kind, failing with the kind and
+# the path in the report (breaker and signal_handler are interior-state
+# / declaration-discipline kinds — their planted failures are TPU506)
+
+PLANTED = {
+    "kv_slot": ("""
+def use():
+    h = kv_alloc()
+""", "mod.py", "TPU502"),
+    "router_socket": ("""
+import socket
+
+
+def dial(addr):
+    return socket.create_connection(addr)
+""", PROD, "TPU506"),
+    "flight_lock": ("""
+import os
+
+
+def lock(path):
+    return os.open(path, os.O_CREAT | os.O_EXCL)
+""", PROD, "TPU506"),
+    "tmp_dir": ("""
+import tempfile
+
+
+def scratch():
+    return tempfile.mkdtemp()
+""", PROD, "TPU506"),
+    "thread": ("""
+import threading
+
+
+def go(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+""", PROD, "TPU506"),
+    "signal_handler": ("""
+import signal
+
+
+def arm(fn):
+    signal.signal(signal.SIGTERM, fn)
+""", PROD, "TPU506"),
+    "breaker": ("""
+x = 1
+# tpu-resource: acquires=breaker
+y = 2
+""", PROD, "TPU506"),
+}
+
+
+@pytest.mark.parametrize("kind", sorted(resmodel.KINDS))
+def test_planted_leak_per_kind_fails_red(kind):
+    src, fname, expected = PLANTED[kind]
+    hits = [d for d in lint(src, fname) if d.code == expected]
+    assert hits, f"planted {kind} leak produced no {expected}"
+    assert hits[0].filename == fname
+    if kind == "breaker":            # misplaced-declaration discipline
+        assert "misplaced" in hits[0].message
+    else:
+        assert kind in hits[0].message
+
+
+def test_every_planted_kind_is_modeled():
+    assert set(PLANTED) == set(resmodel.KINDS)
+
+
+# ------------------------------------------------------------ waiver scope
+
+
+def test_same_line_waiver_suppresses_only_its_code(tmp_path):
+    body = ("\ndef use():\n"
+            "    h = kv_alloc()  # tpu-lint: disable={code}  # planted\n")
+    f = tmp_path / "mod.py"
+
+    f.write_text(HELPERS + body.format(code="TPU502"))
+    assert lint_resources([str(f)]).diagnostics == []
+
+    # a waiver for a DIFFERENT code must not suppress the leak
+    f.write_text(HELPERS + body.format(code="TPU503"))
+    diags = lint_resources([str(f)]).diagnostics
+    assert [d.code for d in diags] == ["TPU502"]
+
+
+def test_disabled_parameter_scopes_like_waivers(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(HELPERS + "\ndef use():\n    h = kv_alloc()\n")
+    assert [d.code for d in lint_resources([str(f)]).diagnostics] \
+        == ["TPU502"]
+    assert lint_resources([str(f)], disabled=("TPU502",)).diagnostics == []
+
+
+# ------------------------------------------------------- restrace sanitizer
+
+
+@pytest.fixture
+def traced():
+    was_enabled, was_raise = restrace.enabled(), restrace._raise
+    restrace.enable(raise_on_leak=True)
+    restrace.reset()
+    yield restrace
+    restrace.reset()
+    restrace._raise = was_raise
+    if not was_enabled:
+        restrace.disable()
+
+
+class TestRestrace:
+    def test_release_of_unacquired_raises(self, traced):
+        with pytest.raises(restrace.ResourceLeak):
+            traced.note_release("kv_slot", ("nope", 1))
+        assert traced.violations()
+
+    def test_strict_false_tolerates_unknown_keys(self, traced):
+        traced.note_release("flight_lock", ("foreign", 1), strict=False)
+        assert traced.violations() == []
+
+    def test_assert_clean_raises_on_live_census(self, traced):
+        traced.note_acquire("tmp_dir", "/tmp/x")
+        assert traced.census()["tmp_dir"] == 1
+        with pytest.raises(restrace.ResourceLeak, match="tmp_dir"):
+            traced.assert_clean()
+        traced.note_release("tmp_dir", "/tmp/x")
+        traced.assert_clean()        # balanced: no raise
+        assert traced.census()["tmp_dir"] == 0
+
+    def test_census_covers_every_modeled_kind(self, traced):
+        assert set(traced.census()) == set(resmodel.KINDS)
+
+    def test_disabled_is_a_true_noop(self, traced):
+        traced.note_acquire("kv_slot", ("live", 1))
+        restrace.disable()
+        try:
+            restrace.note_acquire("kv_slot", ("ignored", 2))
+            restrace.note_release("kv_slot", ("ignored", 3))
+        finally:
+            restrace.enable(raise_on_leak=True)
+        assert restrace.census()["kv_slot"] == 1
+        restrace.note_release("kv_slot", ("live", 1))
+
+    def test_maybe_enable_from_env(self, monkeypatch):
+        was_enabled, was_raise = restrace.enabled(), restrace._raise
+        monkeypatch.setenv("PADDLE_TPU_RESTRACE", "0")
+        assert restrace.maybe_enable_from_env() is False
+        monkeypatch.setenv("PADDLE_TPU_RESTRACE", "1")
+        monkeypatch.setenv("PADDLE_TPU_RESTRACE_RAISE", "1")
+        try:
+            assert restrace.maybe_enable_from_env() is True
+            assert restrace.enabled() and restrace._raise
+        finally:
+            restrace.reset()
+            restrace._raise = was_raise
+            if not was_enabled:
+                restrace.disable()
+
+
+# ------------------------------------------------- fixed-leak regressions
+
+
+def test_spawn_failure_reaps_portdir(monkeypatch):
+    """The fleet portdir leak: a replica that dies before binding must
+    not leave its port-rendezvous dir behind."""
+    from paddle_tpu.inference import fleet
+
+    created = []
+    real_create = fleet._portdir_create
+
+    def tracking_create():
+        d = real_create()
+        created.append(d)
+        return d
+
+    class DeadProc:
+        returncode = 1
+
+        def poll(self):
+            return 1
+
+        def kill(self):
+            pass
+
+        def wait(self):
+            pass
+
+    monkeypatch.setattr(fleet, "_portdir_create", tracking_create)
+    monkeypatch.setattr(fleet.subprocess, "Popen",
+                        lambda *a, **k: DeadProc())
+    spawn = fleet.subprocess_spawner("p", spawn_timeout=5.0)
+    with pytest.raises(RuntimeError, match="exited"):
+        spawn("r0")
+    assert created and not os.path.exists(created[0])
+
+
+def test_stream_reply_at_plain_dispatch_poisons_socket():
+    """The router STATUS_STREAM leak: a replica that streams at a
+    non-streaming dispatch (version skew) desyncs the connection — it
+    must be closed, never pooled."""
+    from paddle_tpu.inference import router as rt
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    saw_eof = []
+
+    def serve():
+        conn, _ = srv.accept()
+        buf = b""
+        while len(buf) < 4:
+            buf += conn.recv(4 - len(buf))
+        (n,) = struct.unpack("<I", buf)
+        while n:
+            n -= len(conn.recv(n))
+        body = bytes([rt.STATUS_STREAM]) + b"chunk"
+        conn.sendall(struct.pack("<I", len(body)) + body)
+        conn.settimeout(5.0)
+        saw_eof.append(conn.recv(1) == b"")   # client must CLOSE it
+        conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    r = rt.FleetRouter(registry=rt.ReplicaRegistry())
+    view = types.SimpleNamespace(rid="r0", host="127.0.0.1", port=port)
+    try:
+        body = r._forward(view, struct.pack("<I", 1) + b"p", timeout=5.0)
+    finally:
+        t.join(5.0)
+        srv.close()
+    assert body[0] == rt.STATUS_STREAM
+    assert r._pools.get("r0", []) == []       # poisoned, not pooled
+    assert saw_eof == [True]                  # and actually closed
+
+
+# ------------------------------------------------------ surfaces & drift
+
+
+def test_readme_resource_table_in_sync():
+    with open(os.path.join(REPO, "README.md"), encoding="utf-8") as f:
+        readme = f.read()
+    m = re.search(r"<!-- resource-spec:begin[^\n]*-->\n(.*?)\n"
+                  r"<!-- resource-spec:end -->", readme, re.S)
+    assert m, "README resource-spec sentinels missing"
+    assert m.group(1).strip("\n") == resmodel.markdown_table().strip("\n"), \
+        "README resource table drifted from resmodel.markdown_table()"
+
+
+def test_markdown_table_names_every_code_and_kind():
+    table = resmodel.markdown_table()
+    for code in CASES:
+        assert code in table
+    for kind in resmodel.KINDS:
+        assert kind in table
+
+
+def test_tracelint_resources_json_schema(tmp_path):
+    f = tmp_path / "clean.py"
+    f.write_text("def f():\n    return 1\n")
+    r = subprocess.run(
+        [sys.executable, TRACELINT, "--format", "json",
+         "--resources-only", str(f)],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    blob = json.loads(r.stdout)
+    assert blob["schema_version"] == 4
+    assert "resources" in blob["timings_s"]
+    assert blob["errors"] == 0
+
+
+def test_repo_tree_is_resource_clean():
+    r = subprocess.run(
+        [sys.executable, TRACELINT, "--format", "json",
+         "--resources-only", "paddle_tpu", "tools", "tests"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    blob = json.loads(r.stdout)
+    tpu5 = [f for f in blob["findings"]
+            if str(f["code"]).startswith("TPU5")]
+    assert tpu5 == [], tpu5
+
+
+# --------------------------------------------------------- ci_gate stage
+
+GATE_LEAK_SRC = HELPERS + """
+
+def use():
+    h = kv_alloc()
+    return 1
+"""
+GATE_GOOD_SRC = "def f(x):\n    return x\n"
+
+
+def _gate(args):
+    return subprocess.run([sys.executable, GATE, *args],
+                          capture_output=True, text=True, cwd=REPO)
+
+
+def _summary(r):
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def test_resources_stage_gates(tmp_path):
+    ok_test = tmp_path / "test_smoke_ok.py"
+    ok_test.write_text("def test_ok():\n    assert True\n")
+    rt_args = f"{ok_test} -q -p no:cacheprovider"
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(GATE_LEAK_SRC)
+    r = _gate(["--paths", str(bad), "--skip-tests", "--resources",
+               "--restrace-args", rt_args])
+    assert r.returncode == 1
+    s = _summary(r)
+    assert s["resources_run"] and not s["resources_ok"]
+    assert s["resources_tpu50x"] >= 1
+    assert "+resources" in s["gate"]
+    assert "TPU502" in r.stdout
+
+    good = tmp_path / "good.py"
+    good.write_text(GATE_GOOD_SRC)
+    r = _gate(["--paths", str(good), "--skip-tests", "--resources",
+               "--restrace-args", rt_args])
+    assert r.returncode == 0, r.stdout + r.stderr
+    s = _summary(r)
+    assert s["resources_ok"] and s["restrace_ok"]
+    assert s["resources_tpu50x"] == 0
+
+
+def test_resources_stage_fails_on_restrace_smoke(tmp_path):
+    """A red restrace smoke fails the stage even when the static
+    passes are clean."""
+    good = tmp_path / "good.py"
+    good.write_text(GATE_GOOD_SRC)
+    bad_test = tmp_path / "test_smoke_bad.py"
+    bad_test.write_text("def test_no():\n    assert False\n")
+    r = _gate(["--paths", str(good), "--skip-tests", "--resources",
+               "--restrace-args", f"{bad_test} -q -p no:cacheprovider"])
+    assert r.returncode == 1
+    s = _summary(r)
+    assert s["resources_run"] and not s["restrace_ok"]
+    assert not s["resources_ok"]
+
+
+def test_resources_summary_keys_present_when_not_run(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text(GATE_GOOD_SRC)
+    r = _gate(["--paths", str(good), "--skip-tests"])
+    s = _summary(r)
+    assert s["resources_run"] is False and s["resources_ok"] is True
+    assert s["restrace_ok"] is True and s["resources_tpu50x"] == 0
+
+
+def test_justified_tpu5_waiver_noted_not_violation(tmp_path):
+    """The clean-path carve-out extends to TPU5xx: a justified
+    tpu-lint waiver is listed but allowed; unjustified still fails."""
+    sub = tmp_path / "inference"
+    sub.mkdir()
+    f = sub / "mod.py"
+    f.write_text("x = 1  # tpu-lint: disable=TPU506  # session-lifetime "
+                 "dir, reaped with the tmpfs\n")
+    r = _gate(["--paths", str(tmp_path), "--skip-tests",
+               "--clean-paths", str(sub)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    s = _summary(r)
+    assert s["suppressions"] == 1 and s["suppression_violations"] == 0
+
+    f.write_text("x = 1  # tpu-lint: disable=TPU506\n")
+    r = _gate(["--paths", str(tmp_path), "--skip-tests",
+               "--clean-paths", str(sub)])
+    assert r.returncode == 1
+    assert _summary(r)["suppression_violations"] == 1

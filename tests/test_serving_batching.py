@@ -350,7 +350,7 @@ class TestOverloadShed:
         results, workers = [], []
 
         def submit_one():
-            t = threading.Thread(
+            t = threading.Thread(  # tpu-lint: disable=TPU506  # joined via workers[] in the enclosing test
                 target=lambda: results.append(engine.infer([x])))
             t.start()
             workers.append(t)
@@ -402,8 +402,8 @@ class TestOverloadShed:
         results, workers = [], []
 
         def submit_one():
-            t = threading.Thread(target=lambda: results.append(
-                engine.infer([x2])))
+            t = threading.Thread(  # tpu-lint: disable=TPU506  # joined via workers[] in the enclosing test
+                target=lambda: results.append(engine.infer([x2])))
             t.start()
             workers.append(t)
 

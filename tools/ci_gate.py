@@ -8,6 +8,7 @@ Usage:
         [--disable TPU005,...] [--chaos] [--serving] [--serving-chaos]
         [--elastic] [--artifacts] [--fleet] [--decode] [--perfproxy]
         [--concurrency] [--protocol] [--protocol-impl NAME=PATH]
+        [--resources]
         [--clean-paths paddle_tpu/resilience paddle_tpu/inference
          paddle_tpu/obs paddle_tpu/analysis]
 
@@ -83,7 +84,16 @@ taxonomy is statically verified over the Python serving stack, so the
 protocol can never drift one language at a time
 (``--protocol-impl name=path`` forwards an implementation override to
 tracelint — the planted-drift gate tests run the stage against mutated
-fixture copies this way). Exit 1 when any phase
+fixture copies this way). ``--resources`` adds a stage that (a) runs
+the TPU5xx resource-lifecycle passes (``tracelint.py
+--resources-only``) STRICTLY — any unsuppressed TPU50x finding fails:
+every declared acquire (KV slot, pooled router socket, compile
+lockfile, scratch dir, thread, breaker trip, signal handler) must have
+an owner that releases it on every path — and (b) runs the restrace
+smoke: the decode/fleet/artifact suites under ``PADDLE_TPU_RESTRACE=1
+PADDLE_TPU_RESTRACE_RAISE=1``, so the declared lifecycle sites are
+leak-checked at runtime and a suite ending with a nonzero live-handle
+census fails. Exit 1 when any phase
 fails; the JSON line printed last summarises all of them for log
 scrapers (mirroring tools/check_op_benchmark_result.py's contract).
 """
@@ -139,8 +149,9 @@ SHARDED_PYTEST_ARGS = "tests/ -q -m sharded -p no:cacheprovider"
 # subsystems that must stay suppression-free: resilience (PR 2), the
 # serving stack (PRs 4-5), the telemetry layer (PR 7), and the analyzer
 # itself (PR 8) fix findings instead of silencing them. One carve-out:
-# a `tpu-lint: disable=TPU3xx` (concurrency) or `=TPU4xx` (wire
-# contract) with a trailing justification is a *documented waiver*
+# a `tpu-lint: disable=TPU3xx` (concurrency), `=TPU4xx` (wire
+# contract) or `=TPU5xx` (resource lifecycle) with a trailing
+# justification is a *documented waiver*
 # (e.g. "GIL-atomic heartbeat bump", "intentionally partial client") —
 # the audit lists it for reviewers but does not fail the gate; the same
 # directive WITHOUT a justification, or any trace-safety `tracelint:`
@@ -184,6 +195,9 @@ def _nodeid_of_summary_line(rest):
     return rest
 
 LOCKTRACE_PYTEST_ARGS = "tests/test_locktrace.py -q -p no:cacheprovider"
+RESTRACE_PYTEST_ARGS = ("tests/test_decode.py tests/test_fleet.py "
+                        "tests/test_artifact_store.py -q "
+                        "-p no:cacheprovider")
 
 _SUPPRESS_RE = re.compile(
     r"#\s*(tracelint|tpu-lint)\s*:\s*disable(?:=([A-Z0-9,\s]+))?(.*)$")
@@ -267,7 +281,7 @@ def audit_suppressions(paths, clean_paths):
                 if not in_clean:
                     continue
                 waiver = (tag == "tpu-lint" and justified and entry["codes"]
-                          and all(c.startswith(("TPU3", "TPU4"))
+                          and all(c.startswith(("TPU3", "TPU4", "TPU5"))
                                   for c in entry["codes"]))
                 if not waiver:
                     violations.append(entry)
@@ -411,6 +425,47 @@ def run_locktrace_smoke(pytest_args):
     return proc.returncode
 
 
+def run_resources_lint(paths, disable=""):
+    """tracelint --resources-only, STRICT on the TPU5xx group: any
+    unsuppressed resource-lifecycle finding fails — the acceptance bar
+    is zero, with every waiver inline-annotated and justified (which
+    the suppression audit enforces separately)."""
+    cmd = [sys.executable, TRACELINT, "--format", "json",
+           "--resources-only", *paths]
+    if disable:
+        cmd += ["--disable", disable]
+    proc = subprocess.run(cmd, capture_output=True, text=True, cwd=REPO)
+    try:
+        report = json.loads(proc.stdout)
+    except json.JSONDecodeError:
+        crash = proc.stderr.strip()[-2000:]
+        print(f"resources: tracelint crashed:\n{crash}", file=sys.stderr)
+        return {"tpu50x": -1, "crash": crash}, False
+    tpu5 = [f for f in report.get("findings", [])
+            if str(f.get("code", "")).startswith("TPU5")]
+    for f in tpu5:
+        print(f"resources: {f['filename']}:{f['line']}: "
+              f"{f['code']} {f['message']}")
+    ok = proc.returncode == 0 and not tpu5
+    return {"tpu50x": len(tpu5),
+            "timing_s": report.get("timings_s", {}).get("resources")}, ok
+
+
+def run_restrace_smoke(pytest_args):
+    """The restrace-enabled smoke: the decode/fleet/artifact suites
+    with the runtime leak sanitizer armed (and raising) for the whole
+    pytest process, so every modeled acquire/release site those suites
+    drive is census-checked for real — a test session ending with a
+    live handle fails in the conftest teardown."""
+    cmd = [sys.executable, "-m", "pytest", *shlex.split(pytest_args)]
+    env = dict(os.environ,
+               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+               PADDLE_TPU_RESTRACE="1",
+               PADDLE_TPU_RESTRACE_RAISE="1")
+    proc = subprocess.run(cmd, cwd=REPO, env=env)
+    return proc.returncode
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="ci_gate")
     ap.add_argument("--paths", nargs="*", default=["paddle_tpu"])
@@ -482,6 +537,11 @@ def main(argv=None):
                          "strictly (zero unsuppressed findings): "
                          "cross-language protocol drift vs wire_spec "
                          "+ the ok-or-retryable taxonomy")
+    ap.add_argument("--resources", action="store_true",
+                    help="also run the TPU5xx resource-lifecycle "
+                         "passes strictly (zero unsuppressed findings) "
+                         "plus the restrace-enabled smoke suites")
+    ap.add_argument("--restrace-args", default=RESTRACE_PYTEST_ARGS)
     ap.add_argument("--protocol-impl", action="append", default=[],
                     metavar="NAME=PATH",
                     help="override one implementation's source file "
@@ -621,6 +681,14 @@ def main(argv=None):
         proto_report, protocol_ok = run_protocol_lint(ns.protocol_impl,
                                                       ns.disable)
 
+    resources_ok = True
+    res_report = {}
+    if ns.resources:
+        res_report, res_lint_ok = run_resources_lint(ns.paths, ns.disable)
+        restrace_ok = run_restrace_smoke(ns.restrace_args) == 0
+        resources_ok = res_lint_ok and restrace_ok
+        res_report["restrace_ok"] = restrace_ok
+
     summary = {
         "gate": ("tracelint+suppressions+tier1"
                  + ("+chaos" if ns.chaos else "")
@@ -633,7 +701,8 @@ def main(argv=None):
                  + ("+sharded" if ns.sharded else "")
                  + ("+perfproxy" if ns.perfproxy else "")
                  + ("+concurrency" if ns.concurrency else "")
-                 + ("+protocol" if ns.protocol else "")),
+                 + ("+protocol" if ns.protocol else "")
+                 + ("+resources" if ns.resources else "")),
         "lint_ok": lint_ok,
         "lint_errors": report.get("errors", -1),
         "lint_warnings": report.get("warnings", 0),
@@ -670,12 +739,17 @@ def main(argv=None):
         "protocol_ok": protocol_ok,
         "protocol_run": bool(ns.protocol),
         "protocol_tpu4xx": proto_report.get("tpu4xx", 0),
+        "resources_ok": resources_ok,
+        "resources_run": bool(ns.resources),
+        "resources_tpu50x": res_report.get("tpu50x", 0),
+        "restrace_ok": res_report.get("restrace_ok", True),
     }
     print(json.dumps(summary))
     if not (lint_ok and audit_ok and tests_ok and chaos_ok
             and serving_ok and serving_chaos_ok and elastic_ok
             and artifacts_ok and fleet_ok and decode_ok and sharded_ok
-            and perfproxy_ok and concurrency_ok and protocol_ok):
+            and perfproxy_ok and concurrency_ok and protocol_ok
+            and resources_ok):
         print("ci_gate: FAILED", file=sys.stderr)
         return 1
     return 0

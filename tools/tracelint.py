@@ -6,7 +6,7 @@ Usage:
     python tools/tracelint.py PATH [PATH ...]
         [--format text|json] [--disable TPU005,TPU007]
         [--all-functions] [--registry] [--concurrency] [--protocol]
-        [--impl NAME=PATH] [--warnings-as-errors]
+        [--resources] [--impl NAME=PATH] [--warnings-as-errors]
 
 Scans .py files (or whole packages) with the AST trace-safety passes
 (TPU0xx); ``--registry`` additionally imports paddle_tpu and audits the
@@ -22,7 +22,11 @@ the Go/R/C clients), not the positional paths, diffing every
 implementation's constant tables against the spec and statically
 verifying the ok-or-retryable error taxonomy (``--impl name=path``
 points one implementation at an alternate file — how the planted-drift
-gate tests run). By default only
+gate tests run); ``--resources`` additionally builds one static
+resource model over ALL scanned files and runs the TPU5xx
+resource-lifecycle passes (``# tpu-resource: acquires=/releases=``
+ownership declarations plus the acquire/release dataflow walk proving
+every handle is released on every path). By default only
 functions that are demonstrably trace context (decorated
 @to_static/@jax.jit/..., or passed into apply_op / lax.cond / lax.scan)
 are checked by the AST passes; ``--all-functions`` treats every
@@ -30,8 +34,8 @@ function as traced (useful for auditing a train-step module wholesale).
 
 JSON output carries a stable ``schema_version`` plus a per-pass-group
 ``timings_s`` map ({"ast": ..., "registry": ..., "concurrency": ...,
-"protocol": ...}) so CI consumers can key on the shape and attribute
-slow runs.
+"protocol": ..., "resources": ...}) so CI consumers can key on the
+shape and attribute slow runs.
 
 Exit status: 1 when any error-severity finding remains after
 suppression, else 0. Inline suppression: ``# tracelint: disable=TPU001``
@@ -79,6 +83,15 @@ def main(argv=None):
                     help="run ONLY the protocol passes (implies "
                          "--protocol; skips the TPU0xx AST scan — what "
                          "ci_gate's --protocol stage uses)")
+    ap.add_argument("--resources", action="store_true",
+                    help="also run the TPU5xx resource-lifecycle passes "
+                         "(one static resource model over every scanned "
+                         "file: tpu-resource ownership declarations plus "
+                         "the acquire/release dataflow walk)")
+    ap.add_argument("--resources-only", action="store_true",
+                    help="run ONLY the resource passes (implies "
+                         "--resources; skips the TPU0xx AST scan — what "
+                         "ci_gate's --resources stage uses)")
     ap.add_argument("--impl", action="append", default=[],
                     metavar="NAME=PATH",
                     help="override one wire-protocol implementation's "
@@ -89,7 +102,8 @@ def main(argv=None):
 
     from paddle_tpu.analysis import (LintResult, filter_diagnostics,
                                      lint_concurrency, lint_paths,
-                                     lint_protocol, lint_registry)
+                                     lint_protocol, lint_registry,
+                                     lint_resources)
 
     disabled = tuple(c.strip() for c in ns.disable.split(",") if c.strip())
     for p in ns.paths:
@@ -107,7 +121,7 @@ def main(argv=None):
     timings = {}
     diags = []
     files_scanned = 0
-    if not (ns.concurrency_only or ns.protocol_only):
+    if not (ns.concurrency_only or ns.protocol_only or ns.resources_only):
         t0 = time.monotonic()
         result = lint_paths(ns.paths, all_functions=ns.all_functions,
                             disabled=disabled)
@@ -135,6 +149,12 @@ def main(argv=None):
         diags += proto.diagnostics
         timings["protocol"] = time.monotonic() - t0
         files_scanned = max(files_scanned, proto.files_scanned)
+    if ns.resources or ns.resources_only:
+        t0 = time.monotonic()
+        res = lint_resources(ns.paths, disabled=disabled)
+        diags += res.diagnostics
+        timings["resources"] = time.monotonic() - t0
+        files_scanned = max(files_scanned, res.files_scanned)
     merged = LintResult(filter_diagnostics(diags),
                         files_scanned=files_scanned,
                         timings=timings)
