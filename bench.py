@@ -18,6 +18,17 @@
                   ladder from the shared artifact store with zero
                   inline XLA compiles.
                   BENCH_DECODE_{CLIENTS,SECS,SLOTS,NEW_TOKENS} tune it.
+                  `--resume` (ISSUE 17) adds the SIGKILL failover arm:
+                  concurrent streams through an in-proc FleetRouter
+                  stamping a KV-snapshot cadence, one replica KILLed
+                  mid-flight — hard-failed unless every broken stream
+                  resumes on the survivor with the full token sequence
+                  BITWISE the unbroken solo decode (zero duplicated,
+                  zero lost tokens), the per-token deadline budget
+                  rides through the outage un-reset, and the survivor
+                  absorbs every resume join with zero inline compiles.
+                  BENCH_RESUME_{STREAMS,NEW_TOKENS,SNAPSHOT_EVERY,
+                  DEADLINE_MS} tune it.
   sharded         CPU-only sharded multi-chip serving A/B (also:
                   `python bench.py sharded`): the same closed-loop
                   token-streaming storm against a single-chip decode
@@ -2029,7 +2040,11 @@ def run_decode_storm():
     compiles — quantized artifacts are distinct store identities, so
     the f32 ladder published earlier can never satisfy them. Also
     reports the weight-bytes proxy (bytes every decode step streams):
-    the 2-4x bandwidth lever the modes exist for."""
+    the 2-4x bandwidth lever the modes exist for.
+
+    ``--resume`` (ISSUE 17) additionally runs the SIGKILL failover
+    storm (see _decode_resume_record): mid-stream replica death with
+    live router-held KV snapshots must be invisible to clients."""
     import shutil
     import tempfile
 
@@ -2038,13 +2053,14 @@ def run_decode_storm():
     # with 15-program artifact stores
     store_dir = tempfile.mkdtemp(prefix="decode_bench_store_")
     quant_modes = (("w8", "bf16w") if "--quant" in sys.argv[1:] else ())
+    resume = "--resume" in sys.argv[1:]
     try:
-        return _decode_storm_measure(store_dir, quant_modes)
+        return _decode_storm_measure(store_dir, quant_modes, resume)
     finally:
         shutil.rmtree(store_dir, ignore_errors=True)
 
 
-def _decode_storm_measure(store_dir, quant_modes=()):
+def _decode_storm_measure(store_dir, quant_modes=(), resume=False):
     import struct
 
     from paddle_tpu.inference.server import (_encode_arrays,
@@ -2238,11 +2254,194 @@ def _decode_storm_measure(store_dir, quant_modes=()):
         for mode, q in quant_records.items():
             q["tokens_vs_f32"] = (round(q["tokens_per_sec"] / rate, 4)
                                   if rate else 0.0)
+    if resume:
+        rec["resume"] = _decode_resume_record(store_dir, slots)
+        r = rec["resume"]
+        log(f"resume: {r['streams']} streams, SIGKILL broke "
+            f"{r['killed_inflight']} mid-flight, {r['resumes_ok']} "
+            f"resumed bitwise-identical ({r['resumes_refused']} "
+            f"refused / {r['resumes_no_snapshot']} snapshotless), "
+            f"0 client-visible failures, survivor paid "
+            f"{r['survivor_inline_compiles']} inline compiles")
     log(f"continuous batching: {speedup:.2f}x tokens/s vs one-shot, "
         f"p99 inter-token {p99:.1f}ms vs {base_p99:.1f}ms, fresh "
         f"replica warmed {cold_stats['store_loads']} programs with "
         f"{cold_stats['compiles']} inline compiles")
     return rec
+
+
+def _decode_resume_record(store_dir, slots):
+    """SIGKILL failover arm (``--resume``, ISSUE 17) -> record dict.
+
+    Two warm replicas serve concurrent streamed decodes through an
+    in-process FleetRouter that stamps a KV-snapshot cadence into
+    every stream; once EVERY stream is past its first snapshot point,
+    whichever replica carries more in-flight streams is SIGKILLed.
+    Hard-failed contracts (any miss => bench failure record):
+
+    - ZERO client-visible failed streams: every stream ends with the
+      ok terminal status, broken or not;
+    - every broken stream's full token sequence is BITWISE the
+      unbroken solo decode over the same wire — zero duplicated and
+      zero lost tokens across the splice;
+    - the per-token deadline budget each request carries rides
+      through the outage un-reset (a blown budget would surface as a
+      non-ok terminal, caught by the first contract);
+    - at least one resume actually happened, none were refused or
+      snapshotless (the snapshots were demonstrably live);
+    - the survivor absorbed every resume join with ZERO inline
+      compiles (resume-join reuses the warmed decode ladder).
+    """
+    import signal as _signal
+    import socket
+    import struct
+    import threading
+
+    from paddle_tpu.inference import router as fleet_router
+    from paddle_tpu.inference.registry import ReplicaRegistry
+    from paddle_tpu.inference.router import FleetRouter
+    from paddle_tpu.inference.server import (_decode_arrays,
+                                             _encode_arrays,
+                                             _encode_decode_opts,
+                                             _encode_deadline, _read_all)
+    from paddle_tpu.inference.wire_spec import STATUS_STREAM
+
+    n_streams = int(os.environ.get("BENCH_RESUME_STREAMS", "6"))
+    new_tokens = int(os.environ.get("BENCH_RESUME_NEW_TOKENS", "24"))
+    snap_every = int(os.environ.get("BENCH_RESUME_SNAPSHOT_EVERY", "4"))
+    deadline_ms = float(os.environ.get("BENCH_RESUME_DEADLINE_MS",
+                                       "2000"))
+    prompt = np.array([3, 1, 4, 1, 5, 9], np.int32)
+
+    procs = {}
+    ports = {}
+    for rid in ("rA", "rB"):
+        procs[rid], ports[rid] = _spawn_decode_worker(store_dir, slots)
+
+    # unbroken solo oracle over the real wire (replica rA, idle)
+    ref = _decode_collect_stream(ports["rA"], prompt, new_tokens)
+
+    reg = ReplicaRegistry(heartbeat_interval=0.1)
+    for rid in ("rA", "rB"):
+        reg.register(rid, "127.0.0.1", ports[rid])
+    router = FleetRouter(registry=reg, own_registry=True,
+                         snapshot_every=snap_every)
+    resumes0 = {o: fleet_router._M_RESUMES.value(outcome=o)
+                for o in ("ok", "refused", "no_snapshot")}
+    victim = None
+    try:
+        t_up = time.monotonic() + 30
+        while len(reg.routable()) < 2:
+            if time.monotonic() > t_up:
+                fail("decode --resume: replicas never became routable")
+            time.sleep(0.05)
+
+        body = (struct.pack("<B", 1) + _encode_arrays([prompt])
+                + _encode_decode_opts(new_tokens)
+                + _encode_deadline(deadline_ms))
+        results = [None] * n_streams
+        counts = [0] * n_streams
+
+        def one(i, delay):
+            time.sleep(delay)
+            try:
+                with socket.create_connection(
+                        ("127.0.0.1", router.port)) as s:
+                    s.settimeout(240)
+                    s.sendall(struct.pack("<I", len(body)) + body)
+                    chunks = []
+                    while True:
+                        (blen,) = struct.unpack("<I", _read_all(s, 4))
+                        resp = _read_all(s, blen)
+                        if len(resp) > 1 and resp[0] in (0,
+                                                         STATUS_STREAM):
+                            arrs = _decode_arrays(resp[1:])
+                            if arrs and arrs[0].size:
+                                chunks.append(arrs[0])
+                                counts[i] += int(arrs[0].size)
+                        if resp[0] != STATUS_STREAM:
+                            results[i] = (resp[0], [int(t) for c in chunks
+                                                    for t in c])
+                            return
+            except Exception as e:  # recorded; hard-failed below
+                results[i] = e
+
+        threads = [threading.Thread(target=one, args=(i, 0.03 * i),
+                                    daemon=True)
+                   for i in range(n_streams)]
+        for t in threads:
+            t.start()
+
+        # kill once every stream is demonstrably past a snapshot point
+        # (so the router provably holds a resume point for each) and
+        # the victim still carries live streams
+        killed_inflight = 0
+        t_kill = time.monotonic() + 120
+        while True:
+            if time.monotonic() > t_kill:
+                fail("decode --resume: storm never reached the kill "
+                     f"point (counts={counts})")
+            ready = all(results[i] is not None or c > snap_every
+                        for i, c in enumerate(counts))
+            load = {rid: reg.inflight(rid) for rid in ("rA", "rB")}
+            if ready and max(load.values()) > 0:
+                victim = max(load, key=load.get)
+                killed_inflight = load[victim]
+                procs[victim].send_signal(_signal.SIGKILL)
+                break
+            time.sleep(0.005)
+        if killed_inflight == 0:
+            fail("decode --resume: SIGKILL broke no live stream")
+
+        for t in threads:
+            t.join(240)
+        resumes = {o: int(fleet_router._M_RESUMES.value(outcome=o)
+                          - resumes0[o])
+                   for o in ("ok", "refused", "no_snapshot")}
+
+        bad = [(i, r) for i, r in enumerate(results)
+               if not (isinstance(r, tuple) and r[0] == 0)]
+        if bad:
+            fail(f"decode --resume: client-visible stream failures "
+                 f"{bad} (victim {victim}, {killed_inflight} broken, "
+                 f"resumes {resumes})")
+        wrong = [i for i, r in enumerate(results) if r[1] != ref]
+        if wrong:
+            fail(f"decode --resume: streams {wrong} are not bitwise "
+                 f"the solo decode (got {[results[i][1] for i in wrong]}"
+                 f", want {ref})")
+        if resumes["ok"] < 1 or resumes["refused"] or \
+                resumes["no_snapshot"]:
+            fail(f"decode --resume: expected only ok resumes with live "
+                 f"snapshots, got {resumes}")
+
+        survivor = "rB" if victim == "rA" else "rA"
+        surv_stats = _decode_worker_stats(ports[survivor])["decode"]
+        if surv_stats["compiles"] != 0:
+            fail(f"decode --resume: survivor paid "
+                 f"{surv_stats['compiles']} inline compiles absorbing "
+                 f"resume joins")
+        return {
+            "streams": n_streams,
+            "new_tokens": new_tokens,
+            "snapshot_every": snap_every,
+            "deadline_ms": deadline_ms,
+            "killed_inflight": killed_inflight,
+            "resumes_ok": resumes["ok"],
+            "resumes_refused": resumes["refused"],
+            "resumes_no_snapshot": resumes["no_snapshot"],
+            "bitwise_resumed_vs_solo": True,
+            "client_visible_failures": 0,
+            "survivor_inline_compiles": int(surv_stats["compiles"]),
+            "survivor_store_loads": int(surv_stats["store_loads"]),
+        }
+    finally:
+        router.stop()
+        for rid, p in procs.items():
+            if rid == victim:
+                p.wait(timeout=20)
+            else:
+                _stop_decode_worker(p, ports[rid])
 
 
 def run_sharded():

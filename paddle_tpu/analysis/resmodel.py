@@ -88,6 +88,10 @@ KINDS = {k.name: k for k in (
         "`_pool_put(rid, sock)` / `_conn_close(sock)`",
         release_methods=("close",)),
     ResourceKind(
+        "kv_snapshot", "router-held decode resume point (full KV copy)",
+        "`FleetRouter._snap_hold(blob)`",
+        "`FleetRouter._snap_release(snap)`"),
+    ResourceKind(
         "flight_lock", "artifact-store `O_EXCL` compile lockfile",
         "`ArtifactStore.try_acquire(key)` / `_acquire_or_wait(key)`",
         "`ArtifactStore.release(lock)`"),

@@ -11,6 +11,8 @@ registries:
 - ``kv_slot``        — ``decode._KVSlots.alloc`` / ``.release``
 - ``router_socket``  — ``router.FleetRouter._conn_open`` /
   ``_pool_get`` / ``_pool_put`` / ``_conn_close``
+- ``kv_snapshot``    — ``router.FleetRouter._snap_hold`` /
+  ``_snap_release`` (the relay's retained decode resume point)
 - ``flight_lock``    — ``artifact_store.ArtifactStore.try_acquire`` /
   ``release`` (``_takeover`` only removes a stale peer's file; the
   re-acquire goes through ``try_acquire``)
@@ -148,6 +150,13 @@ def _install_patches():
     # is cleanup, not a checked-out release — tolerate unknown keys
     _wrap(router.FleetRouter, "_conn_close", _releasing(
         "router_socket", lambda a, out: id(a[1]), strict=False))
+
+    # kv_snapshot: the relay's retained resume point — one live
+    # handle per in-flight resumable stream, keyed by the held bytes
+    _wrap(router.FleetRouter, "_snap_hold", _acquiring(
+        "kv_snapshot", lambda a, out: id(out)))
+    _wrap(router.FleetRouter, "_snap_release", _releasing(
+        "kv_snapshot", lambda a, out: id(a[1])))
 
     # flight_lock: the O_EXCL compile lockfile
     _wrap(artifact_store.ArtifactStore, "try_acquire", _acquiring(

@@ -91,7 +91,27 @@ program materialization lands in the compile ledger under
 ``decode/...`` labels (what ``bench.py perfproxy``'s decode contract
 gates on).
 
+**Stream resume (PR 17).** A running sequence can be checkpointed into
+a self-describing *kv-snapshot block* (``wire_spec.encode_kv_snapshot``:
+paged KV prefix + prompt + generated-token tail + greedy scalars, under
+a versioned header carrying the model fingerprint, weights digest,
+quant mode, and mesh descriptor) and resumed on ANY replica of the same
+identity via
+:meth:`DecodeEngine.resume`, which re-enters the step loop at the exact
+sequence position. Greedy decode is RNG-free and the step ladder is
+shared, so the resumed suffix is bitwise identical to an unbroken solo
+decode — the PR 12 solo-vs-batch contract holds across the migration
+boundary. A replica whose identity skews from the header refuses with
+:class:`SnapshotRefused` (wire status 2), never silent wrong tokens.
+Requests opt in per-sequence (``snapshot_every=N`` — the wire cadence
+bits of the 0x5C field); snapshot assembly failures degrade to "no
+resume point", never to a failed stream (chaos sites
+``serving.decode.snapshot`` / ``serving.decode.resume``).
+
 Env knobs (constructor kwargs override):
+    PADDLE_TPU_DECODE_SNAPSHOT_EVERY   default snapshot cadence in
+                                       generated tokens (0 = never;
+                                       requests override per-sequence)
     PADDLE_TPU_DECODE_MAX_SLOTS        concurrent sequences (default 8)
     PADDLE_TPU_DECODE_MAX_SEQ_LEN      prompt+generated cap (default 256)
     PADDLE_TPU_DECODE_MAX_QUEUE        bounded wait queue (default 64)
@@ -106,6 +126,7 @@ Env knobs (constructor kwargs override):
                                        per-(bucket, mesh) pjit programs
     (breaker/watchdog knobs: the PADDLE_TPU_SERVING_* family)
 """
+import hashlib
 import os
 import threading
 import time
@@ -122,8 +143,8 @@ from ..resilience.retry import _env_float, _env_int
 from ..serialize import artifact_store as _artifacts
 from . import sharding as _sharding
 from . import wire_spec as _wire_spec
-from ..serialize.export import (deserialize_exported, model_fingerprint,
-                                serialize_exported)
+from ..serialize.export import (canonical_module_bytes, deserialize_exported,
+                                model_fingerprint, serialize_exported)
 from .batching import (BucketQuarantined, DeadlineExceeded, EngineClosed,
                        EngineOverloaded, RetryableError, SchedulerRestarted,
                        _Breaker, bucket_rows, store_backed_compile)
@@ -133,6 +154,14 @@ from .batching import (BucketQuarantined, DeadlineExceeded, EngineClosed,
 # dtype bit for bit)
 _TOKEN_DTYPES = frozenset(_wire_spec.NUMPY_BY_CODE[c]
                           for c in _wire_spec.TOKEN_DTYPE_CODES)
+
+
+class SnapshotRefused(RetryableError):
+    """A kv snapshot does not match this replica's identity
+    (fingerprint / quant / mesh / shape contract skew) or cannot fit
+    its configured limits. Maps to wire status 2: the stream is
+    resumable on a matching replica — refusing is ALWAYS preferable to
+    decoding garbage from a foreign KV layout."""
 
 # Machine-checked lock order (tools/tracelint.py --concurrency, TPU309):
 # the decode engine lock is a SUBSYSTEM lock like BatchingEngine's —
@@ -208,6 +237,7 @@ class _Programs:
         self._warmup_wait_s = _env_float(
             "PADDLE_TPU_ARTIFACT_WARMUP_WAIT_S", 120.0)
         self._fp_lock = threading.Lock()
+        self._weights_digest_cached = None
         # serving mesh: single runs the historical path byte-for-byte;
         # sharded commits the params to the mesh ONCE here (the
         # residents every phase program shares as runtime args) and
@@ -225,22 +255,46 @@ class _Programs:
 
     # ----------------------------------------------------------- identity
     def _fingerprint(self):
-        """Model identity for store keys, computed once: sha256 of the
-        step program's serialized export at the canonical (2, 8)
-        shape. Returns None when the model cannot export (store is
-        then skipped — inline compiles, the store-less behaviour)."""
+        """Model identity for store keys and KV-snapshot headers,
+        computed once: sha256 of the step program's *location-free*
+        module text at the canonical (2, 8) shape (the raw serialized
+        export embeds MLIR debug locations that vary with in-process
+        trace order — see ``canonical_module_bytes``; a snapshot resume
+        between replicas must compare program identity, not tracing
+        provenance). Returns None when the model cannot export (store
+        is then skipped — inline compiles, the store-less behaviour)."""
         m = self._model
         if m._fingerprint is None:
             with self._fp_lock:
                 if m._fingerprint is None:
                     try:
-                        blob = serialize_exported(
+                        blob = canonical_module_bytes(
                             self._export("step", 2, 8))
                         m._fingerprint = model_fingerprint(
                             blob, quant=getattr(m, "quant", None))
                     except Exception:  # noqa: BLE001 - store-less fallback
                         m._fingerprint = False
         return m._fingerprint or None
+
+    def _weights_digest(self):
+        """Parameter-VALUE identity for KV-snapshot headers: sha256
+        over every param's dtype/shape/bytes. The program fingerprint
+        deliberately excludes weight values (they are runtime args, so
+        compiled artifacts are reusable across fine-tunes) — but a KV
+        cache is a function of the weights, so resume must compare
+        them. Computed once per model; weights are immutable in a
+        serving replica."""
+        if self._weights_digest_cached is None:
+            with self._fp_lock:
+                if self._weights_digest_cached is None:
+                    h = hashlib.sha256()
+                    for p in self._model.params:
+                        a = np.ascontiguousarray(np.asarray(p))
+                        h.update(str(a.dtype).encode())
+                        h.update(str(a.shape).encode())
+                        h.update(a.tobytes())
+                    self._weights_digest_cached = h.hexdigest()
+        return self._weights_digest_cached
 
     def _active_store(self):
         if self._store is None or _artifacts.disabled():
@@ -547,6 +601,25 @@ class _KVSlots:
         for buf, e in zip(self._bufs[slot], entries):
             buf[pos] = e
 
+    def snapshot(self, slot, length):
+        """Copy slot ``slot``'s first ``length`` KV entries out (one
+        array per kv_spec entry) — the paged-KV payload of a resumable
+        stream snapshot. Pure read: the slot stays live."""
+        return [np.array(buf[:length]) for buf in self._bufs[slot]]
+
+    # tpu-resource: acquires=kv_slot
+    def restore(self, kv_arrays, length):
+        """Allocate a slot and install a snapshot's KV prefix into it
+        (the write_prefill of a resumed sequence). Returns the slot,
+        or None when no slot is free."""
+        slot = self.alloc()
+        if slot is None:
+            return None
+        self._ensure(slot, max(length, 1))
+        for buf, src in zip(self._bufs[slot], kv_arrays):
+            buf[:length] = src[:length]
+        return slot
+
     def gather(self, slots, lengths, rows_bucket, seq_b):
         """[rows_bucket, seq_b, *tr] per kv buffer: row i carries slot
         ``slots[i]``'s first ``lengths[i]`` entries, zeros elsewhere
@@ -587,8 +660,9 @@ class DecodeRequest:
 
     __slots__ = ("prompt", "features", "max_new_tokens", "eos_token_id",
                  "token_budget_s", "trace_id", "token_dtype", "t_enqueue",
-                 "_cond", "_tokens", "_taken", "_done", "_error",
-                 "finish_reason", "cancelled")
+                 "snapshot_every", "_cond", "_tokens", "_taken", "_done",
+                 "_error", "_snap", "_snap_fresh", "finish_reason",
+                 "cancelled")
 
     def __init__(self, prompt, features, max_new_tokens, eos_token_id,
                  token_budget_s, trace_id, token_dtype):
@@ -600,11 +674,14 @@ class DecodeRequest:
         self.trace_id = trace_id
         self.token_dtype = token_dtype
         self.t_enqueue = time.monotonic()
+        self.snapshot_every = 0
         self._cond = threading.Condition()
         self._tokens = []
         self._taken = 0
         self._done = False
         self._error = None
+        self._snap = None
+        self._snap_fresh = False
         self.finish_reason = None
         self.cancelled = False
 
@@ -630,6 +707,20 @@ class DecodeRequest:
                 self._error = error
                 self.finish_reason = "error"
                 self._cond.notify_all()
+
+    def _push_snapshot(self, blob, n_generated):
+        """Install the latest kv-snapshot block for this sequence
+        (engine side, at the request's cadence). Only the newest
+        snapshot is kept — a resume always restarts from the most
+        recent position. ``n_generated`` rides along so the server can
+        hold a snapshot frame until every token it covers is on the
+        wire (the router's dedup arithmetic needs delivered >= G)."""
+        with self._cond:
+            if self._done:
+                return
+            self._snap = (blob, int(n_generated))
+            self._snap_fresh = True
+            self._cond.notify_all()
 
     # ----------------------------------------------------- consumer side
     def cancel(self):
@@ -683,6 +774,23 @@ class DecodeRequest:
     def tokens_so_far(self):
         with self._cond:
             return list(self._tokens)
+
+    def take_snapshot(self):
+        """-> ``(block, n_generated)`` for the newest kv-snapshot not
+        yet taken, or None. Take-once semantics: the server handler
+        calls this after each token drain and forwards the block as a
+        snapshot frame once ``n_generated`` tokens have been sent."""
+        with self._cond:
+            if not self._snap_fresh:
+                return None
+            self._snap_fresh = False
+            return self._snap
+
+    def latest_snapshot(self):
+        """-> the newest kv-snapshot block (without consuming it), or
+        None if the sequence never reached its cadence."""
+        with self._cond:
+            return None if self._snap is None else self._snap[0]
 
 
 class _Seq:
@@ -758,6 +866,8 @@ class DecodeEngine:
         self.default_max_new_tokens = int(
             default_max_new_tokens if default_max_new_tokens is not None
             else _env_int("PADDLE_TPU_DECODE_MAX_NEW_TOKENS", 64))
+        self.default_snapshot_every = max(0, _env_int(
+            "PADDLE_TPU_DECODE_SNAPSHOT_EVERY", 0))
         if self.max_slots < 1:
             raise ValueError("max_slots must be >= 1")
         # row buckets are floored at 2 even for a max_slots=1 engine
@@ -787,6 +897,10 @@ class DecodeEngine:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._pending = []  # FIFO of DecodeRequest
+        self._pending_resume = []  # FIFO of (req, kv_arrays, state dict)
+        self._n_snapshots = 0       # blocks assembled (stats view)
+        self._n_resumes_ok = 0      # resume joins admitted
+        self._n_resumes_refused = 0  # identity-skew refusals
         self._active = []   # list of _Seq (scheduler-owned mutation)
         self._inflight_join = []  # joiners popped but not yet prefilled:
         # a scheduler that dies holding them must not strand them — the
@@ -904,7 +1018,8 @@ class DecodeEngine:
 
     # ------------------------------------------------------------ submit
     def submit(self, prompt, max_new_tokens=None, features=(),
-               token_budget_s=None, trace_id=None, eos_token_id=None):
+               token_budget_s=None, trace_id=None, eos_token_id=None,
+               snapshot_every=None):
         """Enqueue one sequence; -> :class:`DecodeRequest`.
 
         ``prompt``: 1-D (or [1, P]) int32/int64 token ids (the output
@@ -912,7 +1027,10 @@ class DecodeEngine:
         matching the model's ``feature_spec`` (any wire dtype).
         ``token_budget_s``: per-token SLO — bounds time-to-first-token
         and every inter-token gap; a blown budget fails the request
-        retryable and frees its slot."""
+        retryable and frees its slot. ``snapshot_every``: emit a
+        resumable kv-snapshot block every N generated tokens
+        (``DecodeRequest.take_snapshot``; 0 = never, None = the
+        engine's env-configured default)."""
         chaos.hit("serving.decode.admit")
         prompt = np.asarray(prompt)
         if prompt.ndim == 2 and prompt.shape[0] == 1:
@@ -956,6 +1074,9 @@ class DecodeEngine:
             trace_id = obs_tracing.current_trace_id()
         req = DecodeRequest(prompt_i32, features, max_new_tokens, eos,
                             token_budget_s, trace_id, token_dtype)
+        req.snapshot_every = max(0, int(
+            self.default_snapshot_every if snapshot_every is None
+            else snapshot_every))
         with self._cond:
             if self._closed:
                 raise EngineClosed(f"{self.name} is closed")
@@ -985,6 +1106,183 @@ class DecodeEngine:
             except ValueError:
                 pass  # already joined (or finished); scheduler purges
             self._cond.notify_all()
+
+    # ---------------------------------------------------- stream resume
+    def _build_snapshot(self, req, kv_copies, pos, last_token,
+                        n_generated):
+        """Encode one kv-snapshot block for a running sequence (runs
+        OUTSIDE the engine lock: the lazy fingerprint has its own
+        lock and must not nest inside ours)."""
+        m = self._model
+        header = {
+            "fingerprint": self._programs._fingerprint(),
+            "weights": self._programs._weights_digest(),
+            "quant": getattr(m, "quant", None) or "f32",
+            "mesh": self.mesh_desc,
+            "pos": int(pos),
+            "last_token": int(last_token),
+            "n_generated": int(n_generated),
+            "prompt_len": int(req.prompt.size),
+            "max_new_tokens": int(req.max_new_tokens),
+            "eos_token_id": req.eos_token_id,
+            "n_kv": len(m.kv_spec),
+        }
+        tail = np.asarray(req.tokens_so_far()[:n_generated],
+                          dtype=req.token_dtype)
+        arrays = [req.prompt, tail] + list(kv_copies) + list(req.features)
+        return _wire_spec.encode_kv_snapshot(header, arrays)
+
+    def _refuse(self, why):
+        with self._lock:
+            self._n_resumes_refused += 1
+        raise SnapshotRefused(f"{self.name}: snapshot refused ({why}); "
+                              "resume on a replica matching the "
+                              "snapshot's identity")
+
+    def check_snapshot(self, payload):
+        """Parse + validate one kv-snapshot block against THIS
+        replica's identity and limits; -> (header, arrays).
+
+        Raises ValueError for a malformed or internally inconsistent
+        block (permanent — wire status 1) and :class:`SnapshotRefused`
+        for an identity or capacity skew (retryable — wire status 2:
+        the snapshot is fine, this replica is the wrong home for it).
+        The cmd kv_put preflight and :meth:`resume` share this check:
+        validation cannot drift from what a resume actually demands."""
+        header, arrays, _ = _wire_spec.decode_kv_snapshot_off(payload)
+        m = self._model
+        pos = int(header["pos"])
+        n_gen = int(header["n_generated"])
+        plen = int(header["prompt_len"])
+        prompt, tail = arrays[0], arrays[1]
+        n_kv = int(header.get("n_kv", len(m.kv_spec)))
+        if n_gen < 1:
+            raise ValueError("kv snapshot carries no generated tokens")
+        if prompt.ndim != 1 or prompt.size != plen:
+            raise ValueError(
+                f"kv snapshot prompt shape {tuple(prompt.shape)} does "
+                f"not match its declared prompt_len {plen}")
+        if tail.ndim != 1 or tail.size != n_gen:
+            raise ValueError(
+                f"kv snapshot token tail of {tail.size} does not match "
+                f"its declared n_generated {n_gen}")
+        if tail.dtype not in _TOKEN_DTYPES:
+            raise ValueError(
+                f"kv snapshot token tail dtype {tail.dtype} is not a "
+                "token dtype (int32 / int64)")
+        if pos != plen + n_gen - 1:
+            raise ValueError(
+                f"kv snapshot position invariant broken: pos {pos} != "
+                f"prompt_len {plen} + n_generated {n_gen} - 1")
+        if int(header["last_token"]) != int(tail[-1]):
+            raise ValueError(
+                "kv snapshot last_token does not match its token tail")
+        fp = self._programs._fingerprint()
+        if header["fingerprint"] != fp:
+            self._refuse(f"model fingerprint "
+                         f"{header['fingerprint']!r} != {fp!r}")
+        wd = self._programs._weights_digest()
+        if header["weights"] != wd:
+            self._refuse("weights digest mismatch: same architecture, "
+                         "different parameter values — a foreign KV "
+                         "cache would decode garbage")
+        quant = getattr(m, "quant", None) or "f32"
+        if header["quant"] != quant:
+            self._refuse(f"quant mode {header['quant']!r} != {quant!r}")
+        if header["mesh"] != self.mesh_desc:
+            self._refuse(f"mesh {header['mesh']!r} != "
+                         f"{self.mesh_desc!r}")
+        if n_kv != len(m.kv_spec):
+            self._refuse(f"{n_kv} kv buffers != this model's "
+                         f"{len(m.kv_spec)}")
+        if len(arrays) != 2 + n_kv + len(m.feature_spec):
+            self._refuse(
+                f"{len(arrays)} arrays != prompt + tail + {n_kv} kv + "
+                f"{len(m.feature_spec)} features")
+        if pos > self.max_seq_len:
+            self._refuse(f"position {pos} exceeds this engine's "
+                         f"max_seq_len {self.max_seq_len}")
+        for a, (tr, dt) in zip(arrays[2:2 + n_kv], m.kv_spec):
+            if (a.ndim != 1 + len(tr) or tuple(a.shape[1:]) != tr
+                    or a.dtype != dt or a.shape[0] < pos):
+                self._refuse(
+                    f"kv buffer {tuple(a.shape)}/{a.dtype} does not "
+                    f"match kv_spec {tr}/{dt} at position {pos}")
+        for f, (tr, dt) in zip(arrays[2 + n_kv:], m.feature_spec):
+            if tuple(f.shape) != tr or f.dtype != dt:
+                self._refuse(
+                    f"feature {tuple(f.shape)}/{f.dtype} does not "
+                    f"match feature_spec {tr}/{dt}")
+        return header, arrays
+
+    def resume(self, snapshot, token_budget_s=None, trace_id=None,
+               snapshot_every=None, max_new_tokens=None):
+        """Resume a snapshotted sequence on THIS engine at its exact
+        position; -> :class:`DecodeRequest`.
+
+        The returned request's ``next_tokens`` yields only the tokens
+        AFTER the snapshot position (what a resumed wire stream must
+        carry) while ``result`` returns the full sequence including
+        the snapshot's tail. The join enters the step loop through the
+        already-warm (rows, seq) ladder — no new program shapes, so a
+        resume costs zero post-warmup compiles — and greedy decode is
+        RNG-free, so the suffix is bitwise identical to an unbroken
+        solo decode of the same prompt."""
+        chaos.hit("serving.decode.resume")
+        header, arrays = self.check_snapshot(snapshot)
+        m = self._model
+        n_kv = int(header.get("n_kv", len(m.kv_spec)))
+        prompt, tail = arrays[0], arrays[1]
+        kv_arrays = list(arrays[2:2 + n_kv])
+        feats = [np.ascontiguousarray(a) for a in arrays[2 + n_kv:]]
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else header.get("max_new_tokens")
+                      or self.default_max_new_tokens)
+        eos = header.get("eos_token_id")
+        pos = int(header["pos"])
+        n_gen = int(header["n_generated"])
+        last = int(header["last_token"])
+        if trace_id is None:
+            trace_id = obs_tracing.current_trace_id()
+        req = DecodeRequest(np.ascontiguousarray(prompt.astype(np.int32)),
+                            feats, max_new, eos, token_budget_s,
+                            trace_id, tail.dtype.type)
+        req.snapshot_every = max(0, int(
+            self.default_snapshot_every if snapshot_every is None
+            else snapshot_every))
+        # pre-fill the snapshot's tail as already-consumed: result()
+        # sees the full sequence, the stream re-emits nothing
+        req._tokens = [int(t) for t in tail]
+        req._taken = n_gen
+        # a snapshot taken AT a stop boundary resumes to an immediate
+        # clean finish — never a slot occupied for zero steps
+        if eos is not None and last == eos:
+            done = "eos"
+        elif n_gen >= max_new:
+            done = "max_tokens"
+        elif pos >= self.max_seq_len:
+            done = "max_seq_len"
+        else:
+            done = None
+        if done is not None:
+            with self._lock:
+                self._n_resumes_ok += 1
+            self._m_retired.inc(reason=done)
+            req._finish(done)
+            return req
+        state = {"pos": pos, "last_token": last, "n_generated": n_gen}
+        with self._cond:
+            if self._closed:
+                raise EngineClosed(f"{self.name} is closed")
+            if (len(self._pending) + len(self._pending_resume)
+                    >= self.max_queue):
+                self._m_shed.inc(reason="queue_full")
+                raise EngineOverloaded(
+                    f"{self.name} decode queue full; resume shed")
+            self._pending_resume.append((req, kv_arrays, state))
+            self._m_requests.inc()
+            self._cond.notify_all()
+        return req
 
     # --------------------------------------------------------- scheduler
     def _run_scheduler(self, gen):
@@ -1017,6 +1315,23 @@ class DecodeEngine:
         with self._lock:
             return self._sched_gen != gen or self._closed
 
+    # tpu-resource: acquires=kv_slot releases=kv_slot
+    def _join_resumes_locked(self, now):
+        """Re-admit resume joiners FIRST (they already paid their
+        prefill elsewhere), while slots are free, entirely under the
+        caller's ``_cond`` hold — restore is pure host memcpy, so a
+        resumed sequence can never be stranded in-flight by a
+        scheduler death. The restored slot is owned by the active
+        sequence from birth and freed through the normal retire
+        paths."""
+        while self._pending_resume and self._slots.free_count() > 0:
+            req, kv_arrays, st = self._pending_resume.pop(0)
+            slot = self._slots.restore(kv_arrays, st["pos"])
+            s = _Seq(req, slot, st["pos"], st["last_token"], now)
+            s.n_generated = st["n_generated"]
+            self._active.append(s)
+            self._n_resumes_ok += 1
+
     def _wait_for_work(self, gen):
         """Park until there is something to do; pop this iteration's
         joiners (bounded by free slots). None = exit this thread."""
@@ -1027,11 +1342,12 @@ class DecodeEngine:
                 now = time.monotonic()
                 self._purge_expired_pending_locked(now)
                 self._drop_cancelled_locked()
-                if self._active or self._pending:
+                if self._active or self._pending or self._pending_resume:
                     break
                 if self._closed:
                     return None
                 self._cond.wait()  # tpu-lint: disable=TPU303  # submit/cancel/close/restart all notify_all under _cond
+            self._join_resumes_locked(now)
             joiners = []
             free = self._slots.free_count()
             while self._pending and len(joiners) < free:
@@ -1041,7 +1357,8 @@ class DecodeEngine:
 
     def _purge_expired_pending_locked(self, now):
         """Per-token SLO on the FIRST token: a queued request whose
-        budget already elapsed is purged before any compute."""
+        budget already elapsed is purged before any compute (resume
+        joiners: the first RESUMED token — same clock, same status)."""
         expired = [r for r in self._pending
                    if r.token_budget_s is not None
                    and now - r.t_enqueue >= r.token_budget_s]
@@ -1051,9 +1368,21 @@ class DecodeEngine:
             r._fail(DeadlineExceeded(
                 f"{self.name}: per-token budget elapsed before the "
                 "sequence could join; dropped without compute"))
+        expired_resume = [e for e in self._pending_resume
+                          if e[0].token_budget_s is not None
+                          and now - e[0].t_enqueue
+                          >= e[0].token_budget_s]
+        for e in expired_resume:
+            self._pending_resume.remove(e)
+            self._m_deadline.inc(stage="expired")
+            e[0]._fail(DeadlineExceeded(
+                f"{self.name}: per-token budget elapsed before the "
+                "resumed sequence could join; dropped without compute"))
 
     def _drop_cancelled_locked(self):
         self._pending[:] = [r for r in self._pending if not r.cancelled]
+        self._pending_resume[:] = [e for e in self._pending_resume
+                                   if not e[0].cancelled]
 
     # tpu-resource: releases=kv_slot
     def _purge_blown_budgets(self, gen):
@@ -1258,6 +1587,9 @@ class DecodeEngine:
         logits = outs[0]
         entries = outs[1:]
         finished = []  # (seq, reason, err) — notified after the lock
+        snaps = []     # (seq, kv copies, pos, last, n_gen) — encoded
+        # after the lock: header assembly touches the fingerprint lock
+        # and json, neither of which may nest inside the engine lock
         with self._lock:
             if self._sched_gen != gen or self._closed:
                 # superseded mid-step: the restart failed these
@@ -1294,10 +1626,27 @@ class DecodeEngine:
                 reason = self._stop_reason(s)
                 if reason is None:
                     keep.append(s)
+                    if (s.req.snapshot_every
+                            and s.n_generated % s.req.snapshot_every
+                            == 0):
+                        snaps.append(
+                            (s, self._slots.snapshot(s.slot, s.pos),
+                             s.pos, s.last_token, s.n_generated))
                 else:
                     self._slots.release(s.slot)
                     finished.append((s, reason, None))
             self._active[:] = keep
+        for s, kv_copies, pos, last, n_gen in snaps:
+            try:
+                chaos.hit("serving.decode.snapshot")
+                s.req._push_snapshot(self._build_snapshot(
+                    s.req, kv_copies, pos, last, n_gen), n_gen)
+                with self._lock:
+                    self._n_snapshots += 1
+            except Exception:  # noqa: BLE001 - degraded, never fatal
+                # a failed snapshot just means no resume point for this
+                # window; the stream itself must keep flowing
+                pass
         for s, reason, err in finished:
             self._notify_retired(s, reason, err)
 
@@ -1521,6 +1870,10 @@ class DecodeEngine:
                 "deadline_late": int(
                     self._m_deadline.value(stage="late")),
                 "scheduler_restarts": int(self._m_restarts.value()),
+                "snapshots": self._n_snapshots,
+                "resume_queue_depth": len(self._pending_resume),
+                "resumes": {"ok": self._n_resumes_ok,
+                            "refused": self._n_resumes_refused},
                 "retired": {r: int(self._m_retired.value(reason=r))
                             for r in _RETIRE_REASONS},
                 "prefills": int(self._m_steps.value(phase="prefill")),
@@ -1638,6 +1991,8 @@ class DecodeEngine:
             self._closed_ev.set()
             pending = list(self._pending)
             self._pending[:] = []
+            pending += [e[0] for e in self._pending_resume]
+            self._pending_resume[:] = []
             active = list(self._active)
             self._active[:] = []
             for s in active:

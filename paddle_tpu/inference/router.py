@@ -41,6 +41,24 @@ cmd 8, so its own health announces ``accepting: false``), then waits
 for the router's in-flight count on that replica to reach zero.
 In-flight requests finish; new ones go elsewhere; nothing drops.
 
+Stream resume (PR 17): for relayed decode streams the "fails over to
+another replica" promise extends PAST the first token. The router
+stamps its snapshot cadence into the forwarded decode field, retains
+the newest kv-snapshot frame each replica interleaves into its stream
+(stripped before clients that never opted in — their bytes are
+identical with the feature on or off), and on a mid-stream replica
+death re-drives the remainder on another replica via the kv_resume
+command: delivered tokens are trimmed by sequence position (zero
+duplicated, zero lost), the resumed suffix is bitwise what the dead
+replica would have produced (the engine's solo-vs-batch contract), the
+per-token deadline clock keeps running across the outage, and a
+replica with a different model fingerprint / weights digest / quant
+mode / mesh refuses the hand-off (status 2, tried elsewhere) instead
+of decoding garbage. No snapshot yet, or every candidate refused →
+today's status-2 terminal frame. The held snapshot is a DECLARED
+kv_snapshot resource (``_snap_hold`` / ``_snap_release``): the TPU5xx
+lint and the restrace census prove every relay path drops it.
+
 Env knobs (constructor kwargs win):
     PADDLE_TPU_FLEET_RETRY_ATTEMPTS    total tries per request (3)
     PADDLE_TPU_FLEET_RETRY_BASE_S      first shed backoff      (0.05)
@@ -50,6 +68,8 @@ Env knobs (constructor kwargs win):
     PADDLE_TPU_FLEET_ADMIT_TIMEOUT_S   deadline-less admission
                                        wait cap                (5.0)
     PADDLE_TPU_FLEET_BACKEND_TIMEOUT_S per-attempt reply cap   (30.0)
+    PADDLE_TPU_DECODE_SNAPSHOT_EVERY   resume-point cadence in
+                                       tokens, 0 disables      (8)
 """
 import hashlib
 import json
@@ -69,11 +89,15 @@ from .registry import ReplicaRegistry, _env_float, _env_int
 from .server import MAX_BODY_BYTES, BodyTooLarge, _read_all
 # wire constants come from the ONE machine-readable spec (wire_spec.py;
 # the --protocol lint fails on hardcoded wire literals here)
-from .wire_spec import (CMD_DRAIN, CMD_HEALTH, CMD_INFER, CMD_METRICS,
-                        CMD_STATS, CMD_STOP, DEADLINE_MARKER,
-                        DECODE_MARKER, DECODE_ONESHOT_BIT, STATUS_ERROR,
+from .wire_spec import (CMD_DRAIN, CMD_HEALTH, CMD_INFER, CMD_KV_RESUME,
+                        CMD_METRICS, CMD_STATS, CMD_STOP, DEADLINE_MARKER,
+                        DECODE_MARKER, DECODE_ONESHOT_BIT,
+                        DECODE_SNAPSHOT_EVERY_MASK,
+                        DECODE_SNAPSHOT_EVERY_SHIFT, STATUS_ERROR,
                         STATUS_OK, STATUS_STREAM, TENANT_MARKER,
-                        TRACE_MARKER)
+                        TRACE_MARKER, build_request,
+                        decode_kv_snapshot_header, encode_arrays,
+                        is_kv_snapshot)
 from .wire_spec import STATUS_RETRYABLE as STATUS_OVERLOADED
 from .wire_spec import decode_arrays_off as _decode_arrays_off
 
@@ -152,7 +176,8 @@ _M_REQUESTS = obs_metrics.counter(
 _M_RETRIES = obs_metrics.counter(
     "paddle_fleet_retries_total",
     "Per-request replica retries, by cause (shed = status-2 rerouted "
-    "with backoff, io = dead-replica failover)",
+    "with backoff, io = dead-replica failover, stream_resume = "
+    "mid-stream decode failover re-driven from a kv snapshot)",
     labelnames=("cause",))
 _M_DEADLINE = obs_metrics.counter(
     "paddle_fleet_deadline_total",
@@ -161,6 +186,17 @@ _M_DEADLINE = obs_metrics.counter(
 _M_INFLIGHT = obs_metrics.gauge(
     "paddle_fleet_inflight",
     "Requests currently admitted through the router's fair gate")
+_M_RESUMES = obs_metrics.counter(
+    "paddle_decode_resumes_total",
+    "Mid-stream decode failovers at the router, by outcome (ok = the "
+    "stream was re-driven on another replica from a kv snapshot, "
+    "refused = every candidate refused or failed the hand-off, "
+    "no_snapshot = the replica died before any resume point existed)",
+    labelnames=("outcome",))
+_M_RESUME_SECONDS = obs_metrics.histogram(
+    "paddle_decode_resume_seconds",
+    "Replica-death-to-first-resumed-frame latency of successful "
+    "mid-stream decode failovers")
 
 
 class FairGate:
@@ -344,7 +380,8 @@ class FleetRouter:
                  tenants=(), max_inflight=None, retry_attempts=None,
                  retry_base=None, retry_max=None, admit_timeout=None,
                  backend_timeout=None, own_registry=None,
-                 max_body=MAX_BODY_BYTES, rng=random.random):
+                 max_body=MAX_BODY_BYTES, rng=random.random,
+                 snapshot_every=None):
         own = registry is None if own_registry is None else own_registry
         self.registry = registry if registry is not None \
             else ReplicaRegistry()
@@ -365,6 +402,13 @@ class FleetRouter:
             backend_timeout if backend_timeout is not None
             else _env_float("PADDLE_TPU_FLEET_BACKEND_TIMEOUT_S", 30.0))
         self.max_body = max_body
+        # snapshot cadence stamped onto forwarded decode requests so
+        # replicas interleave resume points into their streams; the
+        # router holds the newest one and fails a broken stream over
+        # to another replica. 0 disables router-managed resume.
+        self.snapshot_every = min(DECODE_SNAPSHOT_EVERY_MASK, max(0, (
+            snapshot_every if snapshot_every is not None
+            else _env_int("PADDLE_TPU_DECODE_SNAPSHOT_EVERY", 8))))
         self._rng = rng
         self.gate = FairGate(
             max_inflight if max_inflight is not None
@@ -446,7 +490,25 @@ class FleetRouter:
             except OSError:
                 pass
 
-    def _forward(self, view, frame, timeout, client_conn=None):
+    # Kv-snapshot lifecycle: a relayed stream RETAINS at most one
+    # resume point (a full KV copy — holding it past the stream's end
+    # pins accelerator-sized buffers per request). Every hold comes
+    # from _snap_hold and ends in exactly one _snap_release — the
+    # TPU5xx lint and the restrace sanitizer both key on this pair.
+    # tpu-resource: acquires=kv_snapshot
+    def _snap_hold(self, blob):
+        """Retain one kv-snapshot block as the stream's resume point."""
+        return bytes(blob)
+
+    # tpu-resource: releases=kv_snapshot
+    def _snap_release(self, snap):
+        """Drop a held resume point. The body is trivial on purpose:
+        the declared acquire/release pair is what lets the static lint
+        and the runtime census prove no relay path leaks a snapshot."""
+        return None
+
+    def _forward(self, view, frame, timeout, client_conn=None,
+                 stream_ctx=None):
         """Send one framed request to replica `view` over a pooled
         connection; return the raw response body (status byte +
         payload). Raises OSError/ConnectionError/TimeoutError on a
@@ -493,12 +555,12 @@ class FleetRouter:
                 # dial immediately. Nothing was relayed yet, so this
                 # is equally safe for the streaming path.
                 return self._forward_fresh(view, frame, timeout,
-                                           client_conn)
+                                           client_conn, stream_ctx)
             raise
         if body and body[0] == STATUS_STREAM:
             if client_conn is not None:
                 return self._relay(view, sock, body, client_conn, timeout,
-                                   t_send)
+                                   t_send, stream_ctx)
             # a replica streaming at a NON-streaming dispatch (version
             # skew): the socket is mid-stream and desynced — poison it;
             # pooling it would corrupt the next request on this replica
@@ -518,20 +580,110 @@ class FleetRouter:
             return 0
         return sum(int(a.size) for a in arrays)
 
+    @staticmethod
+    def _trim_chunk(body, skip):
+        """Drop up to ``skip`` leading tokens from one chunk frame
+        (the dedup step of a resumed stream: the new leg replays from
+        its snapshot position, which may trail what the client already
+        received). Returns ``(new_body_or_None, dropped)``; None means
+        the whole frame was already-delivered tokens on a non-terminal
+        chunk — nothing to forward. A frame whose payload is not a
+        token array passes through untouched."""
+        status = body[0]
+        try:
+            arrays, _ = _decode_arrays_off(body[1:])
+            arr = arrays[0]
+        except Exception:  # noqa: BLE001 - not a token chunk
+            return body, 0
+        dropped = min(int(skip), int(arr.size))
+        if dropped == 0:
+            return body, 0
+        arr = arr[dropped:]
+        if arr.size == 0 and status == STATUS_STREAM:
+            return None, dropped
+        return struct.pack("<B", status) + encode_arrays([arr]), dropped
+
+    # tpu-resource: acquires=router_socket releases=router_socket
+    def _resume_leg(self, snap, fields, timeout, dead):
+        """Re-drive a broken decode stream from the held snapshot
+        ``snap`` on each live replica not in ``dead``. On success
+        returns ``(view, sock, first_body)`` with the registry
+        in-flight slot for ``view.rid`` HELD by the caller; returns
+        None when no candidate accepted. The forwarded marker
+        ``fields`` ride along so the new leg keeps the original
+        per-token budget, trace id, and snapshot cadence. A status-2
+        first frame is a refusal (identity skew or shed) and a status-1
+        frame a hard reject — both leave the socket at a frame
+        boundary, so it is pooled and the next candidate tried."""
+        payload = snap + b"".join(
+            struct.pack("<B", m) + raw for m, raw in fields)
+        frame = build_request(CMD_KV_RESUME, payload)
+        for v in self.registry.routable():
+            if v.rid in dead:
+                continue
+            self.registry.acquire(v.rid)
+            sock = None
+            try:
+                sock = self._pool_get(v.rid)
+                if sock is None:
+                    sock = self._conn_open(v)
+                sock.settimeout(timeout)
+                sock.sendall(frame)
+                (blen,) = struct.unpack("<I", _read_all(sock, 4))
+                body = _read_all(sock, blen)
+            except (OSError, ConnectionError):
+                if sock is not None:
+                    self._conn_close(sock)
+                self.registry.report_io_error(v.rid)
+                self._pool_drop(v.rid)
+                self.registry.release(v.rid)
+                continue
+            if body and body[0] in (STATUS_STREAM, STATUS_OK):
+                return v, sock, body
+            self._pool_put(v.rid, sock)
+            self.registry.release(v.rid)
+        return None
+
     # tpu-resource: releases=router_socket
     def _relay(self, view, sock, first_body, client_conn, timeout,
-               t_send):
+               t_send, stream_ctx=None):
         """Pump chunk frames replica -> client until the terminal
-        frame. Owns ``sock`` from here on: pools it on a clean
-        terminal (the stream ends exactly at a frame boundary),
-        poisons it on every other exit. ``t_send`` is when
-        the request hit the replica's socket, so the FIRST gap really
-        is time-to-first-token — the per-token SLO treats the first
-        chunk as a token, and anchoring at relay start would hide
-        exactly the slow-admission case the SLO exists to catch."""
+        frame, surviving mid-stream replica death when a resume point
+        is held. Owns ``sock`` (and every failover socket it dials)
+        from here on: pools it on a clean terminal (the stream ends
+        exactly at a frame boundary), poisons it on every other exit.
+        ``t_send`` is when the request hit the replica's socket, so the
+        FIRST gap really is time-to-first-token — the per-token SLO
+        treats the first chunk as a token, and anchoring at relay
+        start would hide exactly the slow-admission case the SLO
+        exists to catch.
+
+        With ``stream_ctx`` the replica leg was asked for kv-snapshot
+        frames: the newest one is RETAINED (``_snap_hold`` /
+        ``_snap_release``), and on a mid-stream replica death the
+        stream is re-driven on another replica via the kv_resume
+        command. Already-delivered tokens are trimmed by sequence
+        position (never duplicated, never lost — a snapshot frame only
+        arrives after every token it covers is on the wire, so the
+        delivered count can never trail the held position), the
+        inter-token gap clock keeps running across the outage (a
+        failover does NOT refresh the last-frame timestamp or reset
+        TTFT accounting — the client really did wait), and a client
+        that never asked for snapshots sees byte-identical framing
+        throughout because injected snapshot frames are stripped here.
+        Without a held snapshot a death stays today's status-2
+        terminal."""
+        strip = bool(stream_ctx and stream_ctx.get("strip"))
+        fields = [] if stream_ctx is None else stream_ctx["fields"]
+        can_resume = stream_ctx is not None
         tokens = 0
         max_gap = 0.0
         t_last = t_send
+        rid = view.rid  # replica serving the CURRENT leg
+        owned = False   # True once rid's in-flight slot is OURS to drop
+        skip = 0        # duplicate tokens still to trim on this leg
+        dead = set()
+        snap = None
 
         def send(body):
             try:
@@ -543,31 +695,89 @@ class FleetRouter:
                 self._conn_close(sock)
                 raise _ClientGone(str(e)) from e
 
-        body = first_body
-        while True:
-            now = time.monotonic()
-            max_gap = max(max_gap, now - t_last)
-            t_last = now
-            tokens += self._chunk_tokens(body)
-            send(body)
-            if body[0] != STATUS_STREAM:
-                self._pool_put(view.rid, sock)
-                return _Streamed(body[0], tokens, max_gap)
-            try:
-                (blen,) = struct.unpack("<I", _read_all(sock, 4))
-                body = _read_all(sock, blen)
-            except (OSError, ConnectionError):
-                # replica died mid-stream: the client already consumed
-                # a prefix, so no transparent retry — terminate the
-                # stream retryably and report the replica
-                self._conn_close(sock)
-                self.registry.report_io_error(view.rid)
-                self._pool_drop(view.rid)
-                send(struct.pack("<B", STATUS_OVERLOADED))
-                return _Streamed(STATUS_OVERLOADED, tokens, max_gap,
-                                 replica_ok=False)
+        try:
+            body = first_body
+            while True:
+                if (can_resume and body[0] == STATUS_STREAM
+                        and is_kv_snapshot(body[1:])):
+                    # a resume point, not tokens: retain the newest
+                    if snap is not None:
+                        self._snap_release(snap)
+                    snap = self._snap_hold(body[1:])
+                    if not strip:
+                        # the client set its own cadence: it gets the
+                        # frame verbatim AND the router still uses it
+                        send(body)
+                else:
+                    if skip:
+                        body, dropped = self._trim_chunk(body, skip)
+                        skip -= dropped
+                    if body is not None:
+                        # duplicate-only frames are dropped above and
+                        # deliberately do NOT touch the gap clock: the
+                        # client is still waiting for its next NEW
+                        # token, so the outage counts against the
+                        # per-token budget
+                        now = time.monotonic()
+                        max_gap = max(max_gap, now - t_last)
+                        t_last = now
+                        tokens += self._chunk_tokens(body)
+                        send(body)
+                        if body[0] != STATUS_STREAM:
+                            self._pool_put(rid, sock)
+                            if rid != view.rid:
+                                # the stream finished on a failover
+                                # replica: report THAT one healthy (the
+                                # original was already reported dead;
+                                # replica_ok=False keeps the caller
+                                # from overwriting that report)
+                                self.registry.report_ok(rid)
+                            return _Streamed(body[0], tokens, max_gap,
+                                             replica_ok=rid == view.rid)
+                try:
+                    (blen,) = struct.unpack("<I", _read_all(sock, 4))
+                    body = _read_all(sock, blen)
+                except (OSError, ConnectionError):
+                    # replica died mid-stream: the client already
+                    # consumed a prefix, so no transparent re-send of
+                    # the request — fail over from the held resume
+                    # point, or terminate the stream retryably
+                    self._conn_close(sock)
+                    self.registry.report_io_error(rid)
+                    self._pool_drop(rid)
+                    dead.add(rid)
+                    if owned:
+                        self.registry.release(rid)
+                        owned = False
+                    t_died = time.monotonic()
+                    nxt = None
+                    if snap is not None:
+                        nxt = self._resume_leg(snap, fields, timeout,
+                                               dead)
+                    if nxt is None:
+                        _M_RESUMES.inc(
+                            outcome="no_snapshot" if snap is None
+                            else "refused")
+                        send(struct.pack("<B", STATUS_OVERLOADED))
+                        return _Streamed(STATUS_OVERLOADED, tokens,
+                                         max_gap, replica_ok=False)
+                    nview, sock, body = nxt
+                    rid = nview.rid
+                    owned = True
+                    _M_RETRIES.inc(cause="stream_resume")
+                    _M_RESUMES.inc(outcome="ok")
+                    _M_RESUME_SECONDS.observe(
+                        time.monotonic() - t_died)
+                    hdr = decode_kv_snapshot_header(snap)
+                    skip = max(0, tokens - int(hdr["n_generated"]))
+        finally:
+            if owned:
+                self.registry.release(rid)
+            if snap is not None:
+                self._snap_release(snap)
 
-    def _forward_fresh(self, view, frame, timeout, client_conn=None):
+    def _forward_fresh(self, view, frame, timeout, client_conn=None,
+                       stream_ctx=None):
         sock = self._conn_open(view)
         t_send = time.monotonic()
         try:
@@ -581,7 +791,7 @@ class FleetRouter:
         if body and body[0] == STATUS_STREAM:
             if client_conn is not None:
                 return self._relay(view, sock, body, client_conn, timeout,
-                                   t_send)
+                                   t_send, stream_ctx)
             # same version-skew poison as _forward: mid-stream sockets
             # never reach the pool
             self._conn_close(sock)
@@ -615,11 +825,38 @@ class FleetRouter:
         status 2 (except :class:`_ClientGone`: nobody left to tell)."""
         # forward everything except the tenant field (admission
         # happened here; replicas predating the field would stop
-        # parsing at it and miss a deadline/trace field behind it)
+        # parsing at it and miss a deadline/trace field behind it).
+        # For a relayed stream with router-managed resume enabled, the
+        # forwarded decode field additionally gets the router's
+        # snapshot cadence stamped into its spare bits when the client
+        # set none — the replica then interleaves resume points that
+        # the relay strips before the client (byte-identical framing
+        # for clients that never opted in) and uses for failover. A
+        # client that set its OWN cadence keeps it; its snapshot
+        # frames are forwarded verbatim AND double as the router's
+        # resume points.
+        fwd_fields = []
+        strip_snaps = False
+        client_cadence = 0
+        for m, raw in fields:
+            if m == TENANT_MARKER:
+                continue
+            if m == DECODE_MARKER and stream:
+                (val,) = struct.unpack("<Q", raw)
+                client_cadence = ((val >> DECODE_SNAPSHOT_EVERY_SHIFT)
+                                  & DECODE_SNAPSHOT_EVERY_MASK)
+                if not client_cadence and self.snapshot_every:
+                    val |= (self.snapshot_every
+                            << DECODE_SNAPSHOT_EVERY_SHIFT)
+                    raw = struct.pack("<Q", val)
+                    strip_snaps = True
+            fwd_fields.append((m, raw))
         fwd_body = arrays_bytes + b"".join(
-            struct.pack("<B", m) + raw for m, raw in fields
-            if m != TENANT_MARKER) + tail
+            struct.pack("<B", m) + raw for m, raw in fwd_fields) + tail
         frame = struct.pack("<I", len(fwd_body)) + fwd_body
+        stream_ctx = None
+        if stream and (strip_snaps or client_cadence):
+            stream_ctx = {"fields": fwd_fields, "strip": strip_snaps}
         delays = backoff_delays(self.retry_attempts, self.retry_base,
                                 self.retry_max, 0.5, self._rng)
         tried = set()
@@ -639,7 +876,8 @@ class FleetRouter:
             try:
                 resp = self._forward(
                     view, frame, timeout,
-                    client_conn=client_conn if stream else None)
+                    client_conn=client_conn if stream else None,
+                    stream_ctx=stream_ctx)
             except _ClientGone:
                 raise
             except (OSError, ConnectionError):

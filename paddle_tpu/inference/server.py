@@ -69,6 +69,22 @@ wire format (little-endian):
             drain, wait for the router's in-flight count to reach
             zero, then reload or cmd-7 stop). Response is the health
             JSON. `undrain` = cmd 8 with f64 < 0: re-open admission.
+        9 kv_put  payload = one kv-snapshot block (wire_spec
+            "KV snapshots"); stateless preflight: the server validates
+            the block against its own identity (model fingerprint,
+            weights digest, quant mode, mesh) and limits without
+            decoding anything. status 0 + the JSON header echoed =
+            this replica could resume it; 2 = valid block, wrong
+            replica (identity/capacity skew — try another); 1 =
+            malformed block.
+        10 kv_resume  payload = one kv-snapshot block, then the same
+            optional trailing marker fields as cmd 1. The server
+            restores the sequence at its exact position and replies
+            EXACTLY like a streaming cmd-1 decode request (status-3
+            chunks carrying only tokens AFTER the snapshot position,
+            then one terminal frame); an identity skew is a status-2
+            terminal, never silent wrong tokens. Servers without a
+            decode engine answer status 1.
         6 metrics  payload = (empty); response body is the Prometheus
             text exposition (format 0.0.4) of the process obs registry:
             engine counters, server conn/frame counters, resilience
@@ -102,10 +118,10 @@ from .batching import EngineClosed, RetryableError
 # can never drift from the spec (or from the Go/R/C clients, which the
 # same lint diffs against it)
 from . import wire_spec
-from .wire_spec import (CMD_DRAIN, CMD_HEALTH, CMD_INFER, CMD_METRICS,
-                        CMD_RELOAD, CMD_STATS, CMD_STOP, DEADLINE_MARKER,
-                        DECODE_MARKER, DECODE_ONESHOT_BIT, TENANT_MARKER,
-                        TRACE_MARKER)
+from .wire_spec import (CMD_DRAIN, CMD_HEALTH, CMD_INFER, CMD_KV_PUT,
+                        CMD_KV_RESUME, CMD_METRICS, CMD_RELOAD, CMD_STATS,
+                        CMD_STOP, DEADLINE_MARKER, DECODE_MARKER,
+                        DECODE_ONESHOT_BIT, TENANT_MARKER, TRACE_MARKER)
 
 # historical aliases (tests, bench.py, and the router import these
 # names from here): the tables live in wire_spec now
@@ -496,7 +512,9 @@ class PredictorServer:
         try:
             req = dec.submit(inputs[0], features=list(inputs[1:]),
                              max_new_tokens=opts.get("max_new_tokens"),
-                             token_budget_s=budget, trace_id=trace_id)
+                             token_budget_s=budget, trace_id=trace_id,
+                             snapshot_every=opts.get("snapshot_every")
+                             or None)
         except (RetryableError, EngineClosed):
             self._m_responses.inc(status=str(STATUS_OVERLOADED))
             conn.sendall(struct.pack("<IB", 1, STATUS_OVERLOADED))
@@ -529,7 +547,31 @@ class PredictorServer:
                     tokens=int(tokens.size))
             return
         # chunk stream: one frame per available token batch
-        sent = 0
+        self._stream_tokens(
+            conn, dec, req, t0, trace_id,
+            emit_snapshots=bool(opts.get("snapshot_every")))
+
+    def _stream_tokens(self, conn, dec, req, t0, trace_id,
+                       emit_snapshots=False, sent=0):
+        """Drain one decode request onto the wire as a chunk stream
+        (status-3 token frames, one terminal frame) — shared by a
+        streaming cmd-1 decode reply and a cmd kv_resume reply.
+
+        With ``emit_snapshots`` (the request carried a snapshot
+        cadence), each freshly-taken kv-snapshot block goes out as an
+        EXTRA status-3 frame — but only once every token it covers is
+        already on the wire (``sent`` >= its n_generated), so a
+        consumer holding the newest snapshot has always fully
+        delivered its position (the router's dedup arithmetic depends
+        on exactly this ordering). ``sent`` starts at the snapshot
+        position for a resumed stream: snapshot n_generated counts
+        from the start of the sequence.
+
+        If the client vanishes mid-stream (sendall fails) the request
+        is cancelled so its KV slot frees immediately — a dead reader
+        must never ride the batch to max_new_tokens against the slot
+        cap (the ISSUE 12 slot-leak audit)."""
+        pending = None
         try:
             while True:
                 try:
@@ -559,10 +601,111 @@ class PredictorServer:
                     return
                 self._send_frame(conn, STATUS_STREAM,
                                  _encode_arrays([arr]))
+                if emit_snapshots:
+                    got = req.take_snapshot()
+                    if got is not None:
+                        pending = got
+                    if pending is not None and pending[1] <= sent:
+                        self._send_frame(conn, STATUS_STREAM, pending[0])
+                        pending = None
         except (OSError, ConnectionError):
             # the reader is gone mid-stream: free the KV slot NOW
             dec.cancel(req)
             raise
+
+    def _serve_kv_put(self, conn, payload):
+        """cmd kv_put: validate-only snapshot preflight against THIS
+        replica (shares ``DecodeEngine.check_snapshot`` with the
+        resume path, so acceptance here can never drift from what a
+        resume actually demands). status 0 echoes the JSON header;
+        a refusal is status 2; a malformed block is status 1."""
+        dec = self._decode_engine
+        if dec is None:
+            self._m_responses.inc(status=str(STATUS_ERROR))
+            enc = b"no decode engine attached to this server"
+            conn.sendall(struct.pack("<IB", 1 + len(enc), STATUS_ERROR)
+                         + enc)
+            return
+        try:
+            header, _ = dec.check_snapshot(payload)
+        except (RetryableError, EngineClosed) as e:
+            self._m_responses.inc(status=str(STATUS_OVERLOADED))
+            enc = str(e).encode("utf-8", errors="replace")
+            conn.sendall(struct.pack("<IB", 1 + len(enc),
+                                     STATUS_OVERLOADED) + enc)
+            return
+        except Exception as e:  # noqa: BLE001 - malformed block
+            self._m_responses.inc(status=str(STATUS_ERROR))
+            enc = str(e).encode("utf-8", errors="replace")
+            conn.sendall(struct.pack("<IB", 1 + len(enc), STATUS_ERROR)
+                         + enc)
+            return
+        enc = json.dumps(header, sort_keys=True).encode("utf-8")
+        self._m_responses.inc(status=str(STATUS_OK))
+        conn.sendall(struct.pack("<IB", 1 + len(enc), STATUS_OK) + enc)
+
+    def _serve_kv_resume(self, conn, payload):
+        """cmd kv_resume: restore a snapshotted sequence on this
+        replica's decode engine and stream its continuation. The reply
+        shape is EXACTLY a streaming cmd-1 decode reply (status-3
+        chunks carrying only tokens AFTER the snapshot position, one
+        terminal frame), so the router's relay loop handles both
+        identically; an identity skew is a status-2 terminal."""
+        dec = self._decode_engine
+        if dec is None:
+            self._m_responses.inc(status=str(STATUS_ERROR))
+            enc = b"no decode engine attached to this server"
+            conn.sendall(struct.pack("<IB", 1 + len(enc), STATUS_ERROR)
+                         + enc)
+            return
+        t0 = time.perf_counter()
+        try:
+            (header, _arrays, budget, trace_id, opts,
+             snap_end) = wire_spec.decode_kv_resume(payload)
+        except Exception:  # noqa: BLE001 - malformed body
+            self._m_responses.inc(status=str(STATUS_ERROR))
+            conn.sendall(struct.pack("<IB", 1, STATUS_ERROR))
+            return
+        opts = opts or {}
+        try:
+            req = dec.resume(payload[:snap_end], token_budget_s=budget,
+                             trace_id=trace_id,
+                             snapshot_every=opts.get("snapshot_every"),
+                             max_new_tokens=opts.get("max_new_tokens"))
+        except (RetryableError, EngineClosed):
+            # identity/capacity skew or shed: the snapshot may resume
+            # elsewhere — a refusal is ALWAYS a status-2 terminal,
+            # never silent wrong tokens
+            self._m_responses.inc(status=str(STATUS_OVERLOADED))
+            self._send_frame(conn, STATUS_OVERLOADED)
+            return
+        except Exception:  # noqa: BLE001 - inconsistent block
+            self._m_responses.inc(status=str(STATUS_ERROR))
+            self._send_frame(conn, STATUS_ERROR)
+            return
+        if opts.get("oneshot"):
+            # collect-the-rest mode: one reply with the FULL sequence
+            try:
+                tokens = req.result(timeout=self._decode_stream_timeout)
+            except (RetryableError, EngineClosed, TimeoutError):
+                dec.cancel(req)
+                self._m_responses.inc(status=str(STATUS_OVERLOADED))
+                self._send_frame(conn, STATUS_OVERLOADED)
+                return
+            except Exception:  # noqa: BLE001 - protocol error status
+                dec.cancel(req)
+                self._m_responses.inc(status=str(STATUS_ERROR))
+                self._send_frame(conn, STATUS_ERROR)
+                return
+            enc = _encode_arrays([tokens])
+            self._m_responses.inc(status=str(STATUS_OK))
+            conn.sendall(struct.pack("<I", 1 + len(enc))
+                         + struct.pack("<B", STATUS_OK) + enc)
+            return
+        self._stream_tokens(
+            conn, dec, req, t0, trace_id,
+            emit_snapshots=bool(opts.get("snapshot_every")),
+            sent=int(header["n_generated"]))
 
     def _handle(self, conn):
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -638,6 +781,14 @@ class PredictorServer:
                     enc = self._health_json().encode("utf-8")
                     conn.sendall(struct.pack("<IB", 1 + len(enc),
                                              STATUS_OK) + enc)
+                    self._set_busy(False)
+                    continue
+                if cmd == CMD_KV_PUT:
+                    self._serve_kv_put(conn, body[1:])
+                    self._set_busy(False)
+                    continue
+                if cmd == CMD_KV_RESUME:
+                    self._serve_kv_resume(conn, body[1:])
                     self._set_busy(False)
                     continue
                 if cmd != CMD_INFER:

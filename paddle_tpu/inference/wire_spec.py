@@ -43,6 +43,32 @@ Only a client that sent 0x5C without bit 63 ever sees status 3, and a
 broken connection mid-stream is always surfaced retryable, never as a
 silent clean end.
 
+KV snapshots (stream resume & prefill→decode handoff)
+-----------------------------------------------------
+
+A *kv-snapshot block* is a self-describing serialization of one live
+decode sequence::
+
+    u8 KV_FRAME_MAGIC | u16 version | u32 header_len |
+    UTF-8 JSON header | array block (same per-array encoding as infer)
+
+The JSON header carries the replica-identity fields (model
+fingerprint, weights digest, quant mode, mesh descriptor) plus the
+greedy-state scalars
+(pos, last_token, n_generated, ...); the array block is
+``[prompt, generated-token tail, per-layer KV pages]`` reusing the
+dtype table above. A replica whose identity skews from the header
+refuses the snapshot with status 2 — never silent wrong tokens.
+
+When a streaming request sets the cadence bits of the 0x5C field, the
+reply stream interleaves status-3 *snapshot frames* (payload = one
+kv-snapshot block, first byte ``KV_FRAME_MAGIC``) between the ordinary
+token chunks. A token chunk's first payload byte is the u8 array count
+(always small), so the magic byte disambiguates unambiguously. Clients
+that never set the cadence bits never see a snapshot frame — the fleet
+router sets them on the replica leg and strips the snapshot frames
+before forwarding, so client-visible bytes are unchanged.
+
 Error taxonomy (the ok-or-retryable contract)
 ---------------------------------------------
 
@@ -54,6 +80,7 @@ stack raises; the protocol lint statically verifies that retryable
 classes only ever map to wire status 2, permanent classes to status 1,
 and that no unclassified exception can escape a handler into a hang.
 """
+import json
 import struct
 from collections import namedtuple
 
@@ -61,7 +88,7 @@ import numpy as np
 
 #: Bump on any change to the spec tables below — extracted by the
 #: protocol lint and recorded in its reports.
-SPEC_VERSION = 1
+SPEC_VERSION = 2
 
 # --------------------------------------------------------------- dtypes
 
@@ -122,8 +149,10 @@ STATUSES = {
                   "fleet-topology fault — back off and retry"),
     3: WireStatus(3, "stream", False,
                   "non-final chunk of a streaming decode reply (one "
-                  "token array; never sent unless the request carried "
-                  "the 0x5C field without its one-shot bit)"),
+                  "token array, or a kv-snapshot frame when the "
+                  "request set the cadence bits; never sent unless "
+                  "the request carried the 0x5C field without its "
+                  "one-shot bit)"),
 }
 
 STATUS_OK = 0
@@ -181,6 +210,26 @@ COMMANDS = {
         "status 0 + health JSON",
         "drain announce: health flips accepting=false so routers stop "
         "sending new work, but everything that arrives still serves"),
+    9: WireCommand(
+        9, "kv_put",
+        "one kv-snapshot block (magic, version, JSON header, arrays)",
+        "status 0 + UTF-8 JSON echo of the accepted header; status 2 "
+        "when the snapshot does not match this replica's identity "
+        "(fingerprint/quant/mesh skew); status 1 on a malformed block",
+        "validate a KV snapshot against this replica — the stateless "
+        "preflight of the resume/handoff flow (the prefill-to-decode "
+        "handoff rides the same block format)"),
+    10: WireCommand(
+        10, "kv_resume",
+        "one kv-snapshot block, then optional 9-byte marker fields, "
+        "any order (per-token budget, trace id, decode opts/cadence)",
+        "streaming decode grammar: status-3 chunk frames carrying the "
+        "tokens AFTER the snapshot position, then one terminal frame; "
+        "an identity-skewed replica refuses with status 2 before any "
+        "chunk",
+        "resume a decode stream from a snapshot at its exact sequence "
+        "position; the resumed suffix is bitwise identical to an "
+        "unbroken solo decode (greedy state is RNG-free)"),
 }
 
 CMD_INFER = 1
@@ -190,6 +239,8 @@ CMD_STATS = 5
 CMD_METRICS = 6
 CMD_STOP = 7
 CMD_DRAIN = 8
+CMD_KV_PUT = 9
+CMD_KV_RESUME = 10
 
 # -------------------------------------------------- trailing marker fields
 
@@ -217,6 +268,8 @@ MARKERS = {
                      "ignores it"),
     0x5C: WireMarker(0x5C, "decode", "<Q",
                      "u64 decode opts: low 32 bits max_new_tokens, "
+                     "bits 32-47 snapshot cadence (emit a kv-snapshot "
+                     "frame every N generated tokens; 0 = never), "
                      "bit 63 one-shot (collect the whole sequence into "
                      "a single reply instead of a chunk stream)"),
 }
@@ -231,6 +284,31 @@ DECODE_MARKER = 0x5C
 #: Bit 63 of the decode field's u64: one-shot single reply.
 DECODE_ONESHOT_BIT_SHIFT = 63
 DECODE_ONESHOT_BIT = 1 << DECODE_ONESHOT_BIT_SHIFT
+
+#: Bits 32-47 of the decode field's u64: snapshot cadence (emit a
+#: kv-snapshot frame every N generated tokens; 0 disables).
+DECODE_SNAPSHOT_EVERY_SHIFT = 32
+DECODE_SNAPSHOT_EVERY_MASK = 0xFFFF
+
+#: First payload byte of a kv-snapshot block (and of the status-3
+#: snapshot frames that carry one). A token chunk's first payload byte
+#: is its u8 array count, far below this value, so the two frame
+#: payloads can never be confused.
+KV_FRAME_MAGIC = 0xA7
+
+#: Version of the kv-snapshot block layout + JSON header schema.
+KV_SNAPSHOT_VERSION = 1
+
+#: JSON-header keys every kv-snapshot block must carry. Identity keys
+#: (fingerprint/weights/quant/mesh) gate resume: a mismatch is a
+#: refusal (status 2), never silent wrong tokens. ``fingerprint`` is
+#: the *program* identity (location-free module hash — weights are
+#: runtime arguments and deliberately absent from it), so ``weights``
+#: carries the parameter-value digest separately: two replicas with
+#: the same architecture but different weights must refuse each
+#: other's snapshots.
+KV_HEADER_REQUIRED = ("v", "fingerprint", "weights", "quant", "mesh",
+                      "pos", "last_token", "n_generated", "prompt_len")
 
 #: Total wire size of one marker field (marker byte + 8 payload bytes).
 FIELD_SIZE = 9
@@ -251,6 +329,8 @@ RETRYABLE_EXCEPTIONS = frozenset({
     "EngineClosed",        # raced a reload/stop; next attempt lands
     "ShedError",           # router-side shed (queue/deadline/replicas)
     "TimeoutError",        # an engine reply overran its bound
+    "SnapshotRefused",     # kv snapshot skewed from replica identity:
+                           # resume elsewhere; never silent wrong tokens
 })
 
 #: Exception classes that mean "the request itself is wrong": mapped to
@@ -325,7 +405,9 @@ IMPLEMENTATIONS = {
         dtypes=frozenset(DTYPES),
         streaming=True,
         partial="no tenant field (point WithEndpoints at the fleet "
-                "router, which stamps tenancy at admission)"),
+                "router, which stamps tenancy at admission); no KV "
+                "snapshot/resume commands (stream resume is "
+                "router-internal — clients never see a snapshot frame)"),
     "r-client": Implementation(
         "r-client", "r", "clients/r/predictor.R",
         commands=frozenset({CMD_INFER}),
@@ -334,7 +416,8 @@ IMPLEMENTATIONS = {
         dtypes=frozenset(DTYPES),
         streaming=True,
         partial="read-only stream path (pd_decode_stream sends i32 "
-                "prompts only) and no tenant field"),
+                "prompts only), no tenant field, and no KV "
+                "snapshot/resume commands (router-internal)"),
     "c-client": Implementation(
         "c-client", "c++", "paddle_tpu/native/c_api.cc",
         commands=frozenset({CMD_INFER, CMD_HEALTH}),
@@ -342,9 +425,10 @@ IMPLEMENTATIONS = {
         statuses=frozenset(STATUSES),
         dtypes=frozenset(DTYPES),
         streaming=True,
-        partial="no tenant field and no reload/stats/metrics/drain "
-                "commands (operational commands belong to the fleet "
-                "tooling, not the embedded client)"),
+        partial="no tenant field and no reload/stats/metrics/drain/"
+                "kv_put/kv_resume commands (operational and "
+                "fleet-internal commands belong to the fleet tooling, "
+                "not the embedded client)"),
 }
 
 # ------------------------------------------------------ codec (Python)
@@ -416,10 +500,13 @@ def encode_tenant(tenant_id):
     return struct.pack("<BQ", TENANT_MARKER, int(tenant_id))
 
 
-def encode_decode_opts(max_new_tokens, oneshot=False):
+def encode_decode_opts(max_new_tokens, oneshot=False, snapshot_every=0):
     """The optional trailing decode field (marker 0x5C + u64: low 32
-    bits max_new_tokens, bit 63 one-shot)."""
+    bits max_new_tokens, bits 32-47 snapshot cadence, bit 63
+    one-shot)."""
     val = int(max_new_tokens) & 0xFFFFFFFF
+    val |= (int(snapshot_every) & DECODE_SNAPSHOT_EVERY_MASK) \
+        << DECODE_SNAPSHOT_EVERY_SHIFT
     if oneshot:
         val |= DECODE_ONESHOT_BIT
     return struct.pack("<BQ", DECODE_MARKER, val)
@@ -430,8 +517,9 @@ FIELD_ENCODERS = {
     "deadline": encode_deadline,
     "trace": encode_trace,
     "tenant": encode_tenant,
-    "decode": lambda v: encode_decode_opts(v & 0xFFFFFFFF,
-                                           bool(v & DECODE_ONESHOT_BIT)),
+    "decode": lambda v: encode_decode_opts(
+        v & 0xFFFFFFFF, bool(v & DECODE_ONESHOT_BIT),
+        (v >> DECODE_SNAPSHOT_EVERY_SHIFT) & DECODE_SNAPSHOT_EVERY_MASK),
 }
 
 
@@ -464,11 +552,131 @@ def decode_request(payload):
             decode_opts = {
                 "max_new_tokens": int(val & 0xFFFFFFFF) or None,
                 "oneshot": bool(val & DECODE_ONESHOT_BIT),
+                "snapshot_every": int(
+                    (val >> DECODE_SNAPSHOT_EVERY_SHIFT)
+                    & DECODE_SNAPSHOT_EVERY_MASK),
             }
         else:
             break
         off += FIELD_SIZE
     return arrays, budget, trace_id, decode_opts
+
+
+def is_kv_snapshot(payload):
+    """Does this payload start with a kv-snapshot block? (The router's
+    frame-classification test: a token chunk's first byte is its u8
+    array count, never the magic.)"""
+    return len(payload) > 0 and payload[0] == KV_FRAME_MAGIC
+
+
+def encode_kv_snapshot(header, arrays):
+    """Encode one kv-snapshot block: magic + version + length-prefixed
+    JSON header + the standard array block (``[prompt, generated tail,
+    KV pages...]``). ``header`` must carry every KV_HEADER_REQUIRED
+    key; the version key is stamped here."""
+    hdr = dict(header)
+    hdr["v"] = KV_SNAPSHOT_VERSION
+    missing = [k for k in KV_HEADER_REQUIRED if k not in hdr]
+    if missing:
+        raise ValueError(f"kv-snapshot header missing keys: {missing}")
+    blob = json.dumps(hdr, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+    return (struct.pack("<BHI", KV_FRAME_MAGIC, KV_SNAPSHOT_VERSION,
+                        len(blob))
+            + blob + encode_arrays(arrays))
+
+
+def decode_kv_snapshot_off(payload, off=0):
+    """Decode one kv-snapshot block at ``off``; returns (header dict,
+    arrays, offset past the block). Raises ValueError on a bad magic,
+    an unknown version, or a short/garbled header — a permanent
+    request error, not a refusal."""
+    if len(payload) - off < 7:
+        raise ValueError("kv-snapshot block truncated")
+    magic, version, hdr_len = struct.unpack_from("<BHI", payload, off)
+    if magic != KV_FRAME_MAGIC:
+        raise ValueError(f"kv-snapshot magic mismatch: {magic}")
+    if version != KV_SNAPSHOT_VERSION:
+        raise ValueError(f"kv-snapshot version {version} is not "
+                         f"{KV_SNAPSHOT_VERSION}")
+    off += 7
+    if len(payload) - off < hdr_len:
+        raise ValueError("kv-snapshot header truncated")
+    try:
+        header = json.loads(bytes(payload[off:off + hdr_len]))
+    except ValueError as e:
+        raise ValueError(f"kv-snapshot header is not JSON: {e}")
+    if not isinstance(header, dict):
+        raise ValueError("kv-snapshot header is not a JSON object")
+    missing = [k for k in KV_HEADER_REQUIRED if k not in header]
+    if missing:
+        raise ValueError(f"kv-snapshot header missing keys: {missing}")
+    off += hdr_len
+    arrays, n = decode_arrays_off(payload[off:])
+    return header, arrays, off + n
+
+
+def decode_kv_snapshot_header(payload):
+    """Header-only parse of a kv-snapshot block (the array block is
+    not touched): what the router's dedup arithmetic needs per held
+    snapshot without paying an array copy. Same ValueError behaviour
+    as :func:`decode_kv_snapshot_off`."""
+    if len(payload) < 7:
+        raise ValueError("kv-snapshot block truncated")
+    magic, version, hdr_len = struct.unpack_from("<BHI", payload, 0)
+    if magic != KV_FRAME_MAGIC:
+        raise ValueError(f"kv-snapshot magic mismatch: {magic}")
+    if version != KV_SNAPSHOT_VERSION:
+        raise ValueError(f"kv-snapshot version {version} is not "
+                         f"{KV_SNAPSHOT_VERSION}")
+    if len(payload) - 7 < hdr_len:
+        raise ValueError("kv-snapshot header truncated")
+    try:
+        header = json.loads(bytes(payload[7:7 + hdr_len]))
+    except ValueError as e:
+        raise ValueError(f"kv-snapshot header is not JSON: {e}")
+    if not isinstance(header, dict):
+        raise ValueError("kv-snapshot header is not a JSON object")
+    missing = [k for k in KV_HEADER_REQUIRED if k not in header]
+    if missing:
+        raise ValueError(f"kv-snapshot header missing keys: {missing}")
+    return header
+
+
+def decode_kv_resume(payload):
+    """Decode a cmd kv_resume body: one kv-snapshot block then the
+    optional trailing marker fields (same loop and stop-at-unknown
+    rule as an infer body). Returns (header, arrays,
+    budget_seconds_or_None, trace_id_or_None, decode_opts_or_None,
+    snapshot_end_offset) — the last element lets a server slice the
+    raw block (``payload[:end]``) to re-validate/restore without
+    re-encoding it."""
+    header, arrays, snap_end = decode_kv_snapshot_off(payload)
+    off = snap_end
+    budget = None
+    trace_id = None
+    decode_opts = None
+    while len(payload) - off >= FIELD_SIZE:
+        marker = payload[off]
+        if marker == DEADLINE_MARKER and budget is None:
+            (timeout_ms,) = struct.unpack_from("<d", payload, off + 1)
+            budget = max(0.0, float(timeout_ms)) / 1000.0
+        elif marker == TRACE_MARKER and trace_id is None:
+            (tid,) = struct.unpack_from("<Q", payload, off + 1)
+            trace_id = tid or None
+        elif marker == DECODE_MARKER and decode_opts is None:
+            (val,) = struct.unpack_from("<Q", payload, off + 1)
+            decode_opts = {
+                "max_new_tokens": int(val & 0xFFFFFFFF) or None,
+                "oneshot": bool(val & DECODE_ONESHOT_BIT),
+                "snapshot_every": int(
+                    (val >> DECODE_SNAPSHOT_EVERY_SHIFT)
+                    & DECODE_SNAPSHOT_EVERY_MASK),
+            }
+        else:
+            break
+        off += FIELD_SIZE
+    return header, arrays, budget, trace_id, decode_opts, snap_end
 
 
 def build_request(cmd, payload=b""):

@@ -27,6 +27,7 @@ from .artifact_store import (  # noqa: F401
     default_store,
 )
 from .export import (  # noqa: F401
+    canonical_module_bytes,
     deserialize_exported,
     model_fingerprint,
     runtime_version,
@@ -37,5 +38,5 @@ __all__ = [
     "artifact_store", "export",
     "ArtifactKey", "ArtifactStore", "default_store",
     "serialize_exported", "deserialize_exported",
-    "model_fingerprint", "runtime_version",
+    "canonical_module_bytes", "model_fingerprint", "runtime_version",
 ]

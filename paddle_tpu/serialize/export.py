@@ -46,6 +46,66 @@ def deserialize_exported(blob):
     return jax_export.deserialize(blob)
 
 
+def canonical_module_bytes(exported):
+    """Location-free identity bytes for a ``jax.export.Exported``.
+
+    The serialized export embeds MLIR *debug locations* (``#locN``
+    tables and inline ``loc(...)`` attributes) whose numbering depends
+    on how many programs were traced earlier in the process — two
+    byte-for-byte identical models can serialize differently depending
+    on trace order (measured on jaxlib 0.4.37: the first trace in a
+    process carries a smaller loc table than later ones). Anything that
+    keys on *model identity* — the artifact store, the decode
+    KV-snapshot header — must therefore hash the module with every
+    location stripped, or a resume between two processes at different
+    trace positions is refused as "foreign model" when it is not.
+
+    Returns the pretty-printed StableHLO text with all ``loc``
+    attributes and ``#loc`` definition lines removed, UTF-8 encoded.
+    Computation structure, shapes, and dtypes are all still in the
+    text, so distinct programs still hash apart."""
+    out = []
+    for line in exported.mlir_module().splitlines():
+        if line.lstrip().startswith("#loc"):
+            continue
+        out.append(_strip_locs(line))
+    return "\n".join(out).encode("utf-8")
+
+
+def _strip_locs(line):
+    """Remove every balanced ``loc(...)`` attribute from one line of
+    MLIR text (quote-aware: parens inside string literals don't
+    count)."""
+    res = []
+    i, n = 0, len(line)
+    while i < n:
+        j = line.find("loc(", i)
+        # only a real loc attribute when at start or after a delimiter
+        while j > 0 and line[j - 1] not in " (,=":
+            j = line.find("loc(", j + 1)
+        if j == -1:
+            res.append(line[i:])
+            break
+        res.append(line[i:j].rstrip())
+        k, depth, in_str = j + 4, 1, False
+        while k < n and depth:
+            c = line[k]
+            if in_str:
+                if c == "\\":
+                    k += 1
+                elif c == '"':
+                    in_str = False
+            elif c == '"':
+                in_str = True
+            elif c == "(":
+                depth += 1
+            elif c == ")":
+                depth -= 1
+            k += 1
+        i = k
+    return "".join(res)
+
+
 def model_fingerprint(module_bytes, quant=None):
     """Content identity of a saved model: sha256 hex over its
     serialized exported-module bytes.

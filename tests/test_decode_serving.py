@@ -82,6 +82,20 @@ def stream_decode(port, prompt, max_new, **kw):
         return read_stream(s)
 
 
+def ledger_report(pred, timeout=5.0):
+    """Poll the serving ledger until ``pred(report)`` holds (or the
+    timeout passes) and return the report. The router records its
+    ledger entry AFTER relaying the terminal frame — the same frame
+    that unblocks the client — so reading the ledger immediately
+    after a stream returns races the handler thread's accounting."""
+    deadline = time.monotonic() + timeout
+    while True:
+        rep = obs_goodput.SERVING_LEDGER.report()
+        if pred(rep) or time.monotonic() >= deadline:
+            return rep
+        time.sleep(0.01)
+
+
 class TestStreamingWire:
     def test_stream_oneshot_and_plain_roundtrip(self, model):
         server, engine = make_server(model)
@@ -224,7 +238,7 @@ class TestRouterRelay:
             assert st == 0
             assert toks.tolist() == ref.tolist()
             assert frames >= 2  # relayed as chunks, not re-buffered
-            rep = obs_goodput.SERVING_LEDGER.report()
+            rep = ledger_report(lambda r: r["tokens"] >= 8)
             assert rep["tokens"] == 8
             assert rep["ok_tokens"] == 8
             assert rep["goodput_tokens"] == 1.0
@@ -303,7 +317,7 @@ class TestRouterRelay:
                              times=1000):
                 st, toks, _ = stream_decode(router.port, PROMPT, 4)
             assert st == 0 and toks.size == 4
-            rep = obs_goodput.SERVING_LEDGER.report()
+            rep = ledger_report(lambda r: "default" in r["tenants"])
             t = rep["tenants"]["default"]
             assert t["late"] >= 1, rep
             assert t["token_hit_rate"] < 1.0

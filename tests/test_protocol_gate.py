@@ -113,6 +113,33 @@ def test_named_status_drifted_onto_another_valid_value(tmp_path):
                for d in diags), diags
 
 
+def test_planted_kv_command_drift_fails(tmp_path):
+    """PR 17 regression: the KV snapshot hand-off commands are part of
+    the machine-checked contract. A server whose kv_resume constant
+    drifted onto another value must fail the named-command diff (and
+    an off-spec value the membership check)."""
+    fix = tmp_path / "kv_consts.py"
+    fix.write_text("CMD_INFER = 1\nCMD_HEALTH = 3\nCMD_RELOAD = 4\n"
+                   "CMD_STATS = 5\nCMD_METRICS = 6\nCMD_STOP = 7\n"
+                   "CMD_DRAIN = 8\nCMD_KV_PUT = 9\nCMD_KV_RESUME = 11\n")
+    diags = protocol.check_protocol(files={"python-server": str(fix)},
+                                    taxonomy=False)
+    assert any(d.code == "TPU404" and "CMD_KV_RESUME = 11" in d.message
+               for d in diags), diags
+
+
+def test_kv_command_tables_green(tmp_path):
+    """The green twin: spec-true KV command constants raise no
+    command-family finding."""
+    fix = tmp_path / "kv_consts_ok.py"
+    fix.write_text("CMD_INFER = 1\nCMD_HEALTH = 3\nCMD_RELOAD = 4\n"
+                   "CMD_STATS = 5\nCMD_METRICS = 6\nCMD_STOP = 7\n"
+                   "CMD_DRAIN = 8\nCMD_KV_PUT = 9\nCMD_KV_RESUME = 10\n")
+    diags = protocol.check_protocol(files={"python-server": str(fix)},
+                                    taxonomy=False)
+    assert not [d for d in diags if d.code == "TPU404"], diags
+
+
 def test_go_scanner_ignores_unrelated_compares_and_switches(tmp_path):
     """Review regression: only `resp[0] == N` records a status (not a
     second compare sharing the line) and only cases of a switch over

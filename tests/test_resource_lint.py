@@ -42,6 +42,11 @@ def kv_free(h):
 # tpu-resource: acquires=router_socket
 def sock_open(addr):
     return object()
+
+
+# tpu-resource: acquires=kv_snapshot
+def snap_hold(blob):
+    return bytes(blob)
 """
 
 PROD = "paddle_tpu/inference/mod.py"   # product scope: TPU506 is strict
@@ -375,6 +380,10 @@ PLANTED = {
     "kv_slot": ("""
 def use():
     h = kv_alloc()
+""", "mod.py", "TPU502"),
+    "kv_snapshot": ("""
+def use(blob):
+    snap = snap_hold(blob)
 """, "mod.py", "TPU502"),
     "router_socket": ("""
 import socket

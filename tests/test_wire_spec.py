@@ -51,8 +51,13 @@ def test_spec_tables_pinned():
         0: "ok", 1: "error", 2: "retryable", 3: "stream"}
     assert {c.code: c.name for c in ws.COMMANDS.values()} == {
         1: "infer", 3: "health", 4: "reload", 5: "stats",
-        6: "metrics", 7: "stop", 8: "drain"}
+        6: "metrics", 7: "stop", 8: "drain", 9: "kv_put",
+        10: "kv_resume"}
     assert ws.DECODE_ONESHOT_BIT == 1 << 63
+    assert ws.DECODE_SNAPSHOT_EVERY_SHIFT == 32
+    assert ws.DECODE_SNAPSHOT_EVERY_MASK == 0xFFFF
+    assert ws.KV_FRAME_MAGIC == 0xA7
+    assert ws.KV_SNAPSHOT_VERSION == 1
     assert ws.FIELD_SIZE == 9
     assert ws.STATUSES[ws.STATUS_STREAM].terminal is False
     assert all(ws.STATUSES[s].terminal
@@ -138,7 +143,8 @@ def test_every_dtype_x_field_order_permutation_roundtrips():
                 assert (trace == 0xDEADBEEF) == ("trace" in perm)
                 if "decode" in perm:
                     assert opts == {"max_new_tokens": 17,
-                                    "oneshot": True}
+                                    "oneshot": True,
+                                    "snapshot_every": 0}
                 else:
                     assert opts is None
                 count += 1
@@ -172,6 +178,11 @@ def test_every_command_frame_builds_and_parses():
     """Per-command grammar: request frames for all seven commands (and
     reply frames for all four statuses) build through the spec and
     re-parse to (cmd, payload)."""
+    snap = ws.encode_kv_snapshot(
+        {"v": 1, "fingerprint": "f" * 16, "weights": "w" * 16,
+         "quant": None, "mesh": None, "pos": 4, "last_token": 7,
+         "n_generated": 2, "prompt_len": 3},
+        [np.arange(3, dtype=np.int32)])
     payloads = {
         ws.CMD_INFER: ws.encode_arrays([_sample(0)]),
         ws.CMD_HEALTH: b"",
@@ -180,6 +191,8 @@ def test_every_command_frame_builds_and_parses():
         ws.CMD_METRICS: b"",
         ws.CMD_STOP: b"",
         ws.CMD_DRAIN: struct.pack("<d", 5.0),
+        ws.CMD_KV_PUT: snap,
+        ws.CMD_KV_RESUME: snap + ws.encode_deadline(250.0),
     }
     assert set(payloads) == set(ws.COMMANDS)
     for cmd, payload in payloads.items():
