@@ -146,6 +146,8 @@ elif "decode-roofline" in sys.argv[1:]:
     MODEL = "decode-roofline"  # CLI spelling: python bench.py decode-roofline
 elif "sharded" in sys.argv[1:]:
     MODEL = "sharded"  # CLI spelling: python bench.py sharded
+elif "disagg" in sys.argv[1:]:
+    MODEL = "disagg"  # CLI spelling: python bench.py disagg
 elif "decode" in sys.argv[1:]:
     MODEL = "decode"  # CLI spelling: python bench.py decode
 METRIC = {"resnet50": "resnet50_train_images_per_sec_per_chip",
@@ -158,11 +160,12 @@ METRIC = {"resnet50": "resnet50_train_images_per_sec_per_chip",
           "coldstart": "serving_coldstart_first_healthy_reply_seconds",
           "fleet": "serving_fleet_goodput_ratio_under_chaos",
           "sharded": "serving_decode_tokens_per_sec_sharded_mesh",
+          "disagg": "serving_decode_p99_intertoken_ms_under_prefill_bursts",
           "perfproxy": "perfproxy_compile_ledger_check"}.get(
               MODEL, "bert_base_pretrain_tokens_per_sec_per_chip")
 _UNIT = {"resnet50": "images/s", "flash": "TFLOP/s",
          "serving": "req/s", "goodput": "steps/h", "coldstart": "s",
-         "fleet": "ratio",
+         "fleet": "ratio", "disagg": "ms",
          "perfproxy": "ok"}.get(MODEL, "tokens/s")
 V5E_BF16_PEAK_TFLOPS = 197.0
 V5E_HBM_GBPS = 819.0
@@ -393,6 +396,14 @@ def main():
         # protocol properties, not chip properties
         jax.config.update("jax_platforms", "cpu")
         return run_sharded()
+
+    if MODEL == "disagg":
+        # CPU-only by design: the phase replicas are subprocesses on
+        # this host; prefill/decode isolation, handoff retry, and
+        # pool-loss degradation are protocol properties, not chip
+        # properties
+        jax.config.update("jax_platforms", "cpu")
+        return run_disagg()
 
     smoke = os.environ.get("BENCH_CPU") == "1"
     if smoke:
@@ -1875,14 +1886,15 @@ def _decode_client_proc(port, frame, secs, conns, barrier, out_q):
         out_q.put(e)
 
 
-def _spawn_decode_worker(store_dir, n_slots, quant="", mesh=""):
+def _spawn_decode_worker(store_dir, n_slots, quant="", mesh="",
+                         phase=""):
     """Spawn one tests/decode_worker.py replica -> (proc, port) —
-    shared by the decode and sharded benches. The bench's quant/mesh
-    axes are the DECODE_WORKER_* vars ALONE: an operator's exported
-    fleet knobs (PADDLE_TPU_SERVING_QUANT / PADDLE_TPU_SERVING_MESH)
-    are scrubbed so they can never silently quantize/shard — or
-    device-starve — a side of an A/B. A sharded worker gets exactly
-    mesh-width virtual devices."""
+    shared by the decode, sharded and disagg benches. The bench's
+    quant/mesh/phase axes are the DECODE_WORKER_* vars ALONE: an
+    operator's exported fleet knobs (PADDLE_TPU_SERVING_QUANT /
+    PADDLE_TPU_SERVING_MESH) are scrubbed so they can never silently
+    quantize/shard — or device-starve — a side of an A/B. A sharded
+    worker gets exactly mesh-width virtual devices."""
     import subprocess
 
     env = dict(os.environ,
@@ -1893,6 +1905,7 @@ def _spawn_decode_worker(store_dir, n_slots, quant="", mesh=""):
                DECODE_WORKER_WARM="1",
                DECODE_WORKER_QUANT=quant or "",
                DECODE_WORKER_MESH=mesh or "",
+               DECODE_WORKER_PHASE=phase or "",
                PADDLE_TPU_ARTIFACT_DIR=store_dir)
     env.pop("PADDLE_TPU_SERVING_QUANT", None)
     env.pop("PADDLE_TPU_SERVING_MESH", None)
@@ -2442,6 +2455,430 @@ def _decode_resume_record(store_dir, slots):
                 p.wait(timeout=20)
             else:
                 _stop_decode_worker(p, ports[rid])
+
+
+def _disagg_oneshot_admission(port, prompt, timeout=120.0):
+    """One long-prompt max_new=1 request (pure prefill work: admission
+    + a single token) -> terminal status byte. Raises into the CALLER
+    thread only — burst threads record, the main thread judges."""
+    import socket
+    import struct
+
+    from paddle_tpu.inference.server import (_encode_arrays,
+                                             _encode_decode_opts,
+                                             _read_all)
+    from paddle_tpu.inference.wire_spec import STATUS_STREAM
+
+    body = (struct.pack("<B", 1) + _encode_arrays([prompt])
+            + _encode_decode_opts(1))
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=timeout) as s:
+        s.settimeout(timeout)
+        s.sendall(struct.pack("<I", len(body)) + body)
+        while True:
+            (blen,) = struct.unpack("<I", _read_all(s, 4))
+            resp = _read_all(s, blen)
+            if resp[0] != STATUS_STREAM:
+                return resp[0]
+
+
+def _disagg_burst_storm(port, frame, secs, clients, label):
+    """The decode storm under prefill pressure: the closed-loop
+    short-prompt token streams are measured (inter-token gaps) while
+    volleys of long-prompt max_new=1 admissions — pure prefill work —
+    hammer the same router. -> (rate, p50, p99, streams, sheds,
+    burst_stats). The A/B this feeds is ISSUE 18's headline: on the
+    colocated side the bursts invade the very replicas carrying the
+    measured streams; on the disaggregated side they land on the
+    prefill pool and the decode pool's p99 is structurally
+    protected."""
+    import threading
+
+    from paddle_tpu.inference.wire_spec import STATUS_RETRYABLE
+
+    burst_n = int(os.environ.get("BENCH_DISAGG_BURST", "6"))
+    burst_gap = float(os.environ.get("BENCH_DISAGG_BURST_GAP", "0.15"))
+    # the longest prompt the workers admit (DECODE_WORKER_MAX_PROMPT)
+    long_prompt = np.array([3, 1, 4, 1, 5, 9, 2, 6], np.int32)
+    stop = threading.Event()
+    burst = {"admissions": 0, "sheds": 0, "errors": 0}
+    lock = threading.Lock()
+
+    def one_admission():
+        try:
+            status = _disagg_oneshot_admission(port, long_prompt)
+        except Exception:
+            status = None
+        with lock:
+            if status == 0:
+                burst["admissions"] += 1
+            elif status == STATUS_RETRYABLE:
+                burst["sheds"] += 1
+            else:
+                burst["errors"] += 1
+
+    def volley_loop():
+        while not stop.is_set():
+            ts = [threading.Thread(target=one_admission, daemon=True)
+                  for _ in range(burst_n)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(150)
+            stop.wait(burst_gap)
+
+    injector = threading.Thread(target=volley_loop, daemon=True)
+    injector.start()
+    try:
+        rate, p50, p99, streams, sheds = _decode_storm(
+            port, frame, secs, clients, label)
+    finally:
+        stop.set()
+        injector.join(180)
+    if burst["errors"]:
+        fail(f"disagg ({label}): {burst['errors']} prefill-burst "
+             f"admissions died with non-retryable errors "
+             f"({burst['admissions']} ok / {burst['sheds']} shed)")
+    if burst["admissions"] == 0:
+        fail(f"disagg ({label}): no prefill-burst admission ever "
+             f"completed — the burst arm measured nothing")
+    log(f"{label}: bursts {burst['admissions']} admissions "
+        f"({burst['sheds']} shed) of {burst_n}-wide long-prompt "
+        f"volleys every {burst_gap}s")
+    return rate, p50, p99, streams, sheds, burst
+
+
+def run_disagg():
+    """Disaggregated prefill/decode fleet bench (ISSUE 18 acceptance):
+    the same mixed long/short-prompt storm against a colocated fleet
+    (two both-phase replicas) and a disaggregated one (prefill pool +
+    decode pool behind the same router). The headline number is the
+    measured streams' p99 INTER-TOKEN latency under prefill bursts —
+    the interference disaggregation exists to remove. Hard-failed
+    contracts:
+
+    - the disaggregated side actually hands off (handoffs_ok > 0) and
+      no handoff fails outright;
+    - chaos arm: one SIGKILL per pool mid-storm — every client stream
+      either ends ok and BITWISE the solo decode (zero duplicated,
+      zero lost tokens across the prefill re-run / decode resume) or
+      sheds retryable BEFORE any token flowed; at least one decode
+      death rode the PR 17 resume path; never a torn stream;
+    - degraded arm: the decode pool ejected to zero — replies stay
+      byte-identical via colocated serving on the survivors, and the
+      degradation is counted (paddle_handoff_total{outcome=degraded}).
+    """
+    import shutil
+    import tempfile
+
+    store_dir = tempfile.mkdtemp(prefix="disagg_bench_store_")
+    try:
+        return _disagg_measure(store_dir)
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+
+
+def _disagg_measure(store_dir):
+    import signal as _signal
+    import socket
+    import struct
+    import threading
+
+    from paddle_tpu.inference import router as fleet_router
+    from paddle_tpu.inference.registry import ReplicaRegistry
+    from paddle_tpu.inference.router import FleetRouter
+    from paddle_tpu.inference.server import (_decode_arrays,
+                                             _encode_arrays,
+                                             _encode_decode_opts,
+                                             _encode_deadline, _read_all)
+    from paddle_tpu.inference.wire_spec import (STATUS_RETRYABLE,
+                                                STATUS_STREAM)
+
+    clients = int(os.environ.get("BENCH_DISAGG_CLIENTS", "8"))
+    secs = float(os.environ.get("BENCH_DISAGG_SECS", "3.0"))
+    slots = int(os.environ.get("BENCH_DISAGG_SLOTS", "8"))
+    new_tokens = int(os.environ.get("BENCH_DISAGG_NEW_TOKENS", "16"))
+    snap_every = int(os.environ.get("BENCH_DISAGG_SNAPSHOT_EVERY", "4"))
+    chaos_tokens = int(os.environ.get("BENCH_DISAGG_CHAOS_NEW_TOKENS",
+                                      "24"))
+    n_streams = int(os.environ.get("BENCH_DISAGG_CHAOS_STREAMS", "6"))
+    deadline_ms = float(os.environ.get("BENCH_DISAGG_DEADLINE_MS",
+                                       "2000"))
+
+    prompt = np.array([3, 1, 4, 1, 5, 9], np.int32)
+    req = (struct.pack("<B", 1) + _encode_arrays([prompt])
+           + _encode_decode_opts(new_tokens))
+    frame = struct.pack("<I", len(req)) + req
+
+    def handoff_counters():
+        c = {o: fleet_router._M_HANDOFF.value(outcome=o)
+             for o in ("ok", "retried", "degraded", "failed")}
+        c["handoff_retries"] = fleet_router._M_RETRIES.value(
+            cause="handoff")
+        c["resumes_ok"] = fleet_router._M_RESUMES.value(outcome="ok")
+        return c
+
+    def deltas(before):
+        now = handoff_counters()
+        return {k: int(now[k] - before[k]) for k in now}
+
+    def build_fleet(topology):
+        """topology: [(rid, phase)] -> (router, reg, procs, ports)."""
+        procs, ports = {}, {}
+        reg = ReplicaRegistry(heartbeat_interval=0.1)
+        for rid, phase in topology:
+            procs[rid], ports[rid] = _spawn_decode_worker(
+                store_dir, slots, phase=phase)
+            reg.register(rid, "127.0.0.1", ports[rid],
+                         phase=phase or "both")
+        router = FleetRouter(registry=reg, own_registry=True,
+                             snapshot_every=snap_every)
+        t_up = time.monotonic() + 60
+        while len(reg.routable()) < len(topology):
+            if time.monotonic() > t_up:
+                fail(f"disagg: fleet {topology} never became routable")
+            time.sleep(0.05)
+        return router, reg, procs, ports
+
+    def collect_via(port, body):
+        """One synchronous streamed decode -> (status, tokens)."""
+        with socket.create_connection(("127.0.0.1", port)) as s:
+            s.settimeout(240)
+            s.sendall(struct.pack("<I", len(body)) + body)
+            toks = []
+            while True:
+                (blen,) = struct.unpack("<I", _read_all(s, 4))
+                resp = _read_all(s, blen)
+                if len(resp) > 1 and resp[0] in (0, STATUS_STREAM):
+                    arrs = _decode_arrays(resp[1:])
+                    if arrs and arrs[0].size:
+                        toks.extend(int(t) for t in arrs[0])
+                if resp[0] != STATUS_STREAM:
+                    return resp[0], toks
+
+    # ------------------------------------------------ colocated side
+    # spawned first: replica c0 publishes the slot ladder every later
+    # worker (either phase) warms from the shared store
+    router, reg, procs, ports = build_fleet([("c0", ""), ("c1", "")])
+    try:
+        ref = _decode_collect_stream(ports["c0"], prompt, new_tokens)
+        ref_chaos = _decode_collect_stream(ports["c0"], prompt,
+                                           chaos_tokens)
+        c_rate, c_p50, c_p99, c_streams, c_sheds, c_burst = \
+            _disagg_burst_storm(router.port, frame, secs, clients,
+                                "colocated burst")
+    finally:
+        router.stop()
+        for rid, p in procs.items():
+            _stop_decode_worker(p, ports[rid])
+
+    # --------------------------------------------- disaggregated side
+    router, reg, procs, ports = build_fleet(
+        [("p0", "prefill"), ("p1", "prefill"),
+         ("d0", "decode"), ("d1", "decode")])
+    victims = []
+    try:
+        before = handoff_counters()
+        d_rate, d_p50, d_p99, d_streams, d_sheds, d_burst = \
+            _disagg_burst_storm(router.port, frame, secs, clients,
+                                "disagg burst")
+        storm_h = deltas(before)
+        if storm_h["failed"]:
+            fail(f"disagg storm: {storm_h['failed']} handoffs failed "
+                 f"outright (counters {storm_h})")
+        if not storm_h["ok"]:
+            fail("disagg storm: no handoff ever completed — the "
+                 "disaggregated side silently served colocated "
+                 f"(counters {storm_h})")
+
+        # ---------------- chaos arm: one SIGKILL per pool, mid-storm
+        before = handoff_counters()
+        body = (struct.pack("<B", 1) + _encode_arrays([prompt])
+                + _encode_decode_opts(chaos_tokens)
+                + _encode_deadline(deadline_ms))
+        results = [None] * (2 * n_streams)
+        counts = [0] * (2 * n_streams)
+
+        def one(i, delay):
+            time.sleep(delay)
+            try:
+                with socket.create_connection(
+                        ("127.0.0.1", router.port)) as s:
+                    s.settimeout(240)
+                    s.sendall(struct.pack("<I", len(body)) + body)
+                    chunks = []
+                    while True:
+                        (blen,) = struct.unpack("<I", _read_all(s, 4))
+                        resp = _read_all(s, blen)
+                        if len(resp) > 1 and resp[0] in (0,
+                                                         STATUS_STREAM):
+                            arrs = _decode_arrays(resp[1:])
+                            if arrs and arrs[0].size:
+                                chunks.append(arrs[0])
+                                counts[i] += int(arrs[0].size)
+                        if resp[0] != STATUS_STREAM:
+                            results[i] = (resp[0],
+                                          [int(t) for c in chunks
+                                           for t in c])
+                            return
+            except Exception as e:  # recorded; hard-failed below
+                results[i] = e
+
+        wave1 = [threading.Thread(target=one, args=(i, 0.03 * i),
+                                  daemon=True)
+                 for i in range(n_streams)]
+        for t in wave1:
+            t.start()
+
+        # kill once every wave-1 stream is demonstrably past a
+        # snapshot point (the router provably holds a resume point)
+        # and the decode victim still carries live streams
+        killed_inflight = 0
+        t_kill = time.monotonic() + 120
+        while True:
+            if time.monotonic() > t_kill:
+                fail("disagg chaos: storm never reached the kill "
+                     f"point (counts={counts[:n_streams]})")
+            ready = all(results[i] is not None
+                        or counts[i] > snap_every
+                        for i in range(n_streams))
+            load = {rid: reg.inflight(rid) for rid in ("d0", "d1")}
+            if ready and max(load.values()) > 0:
+                d_victim = max(load, key=load.get)
+                killed_inflight = load[d_victim]
+                procs[d_victim].send_signal(_signal.SIGKILL)
+                procs["p1"].send_signal(_signal.SIGKILL)
+                victims += [d_victim, "p1"]
+                break
+            time.sleep(0.005)
+        if killed_inflight == 0:
+            fail("disagg chaos: SIGKILL broke no live decode stream")
+
+        # wave 2 admits through the dead-prefill window: handoff
+        # placement retries ride onto the survivors
+        wave2 = [threading.Thread(target=one,
+                                  args=(n_streams + i, 0.03 * i),
+                                  daemon=True)
+                 for i in range(n_streams)]
+        for t in wave2:
+            t.start()
+        for t in wave1 + wave2:
+            t.join(240)
+        chaos_h = deltas(before)
+
+        hard = [(i, r) for i, r in enumerate(results)
+                if not (isinstance(r, tuple)
+                        and r[0] in (0, STATUS_RETRYABLE))]
+        if hard:
+            fail(f"disagg chaos: non-retryable client errors {hard} "
+                 f"(victims {victims}, counters {chaos_h})")
+        shed = [i for i, r in enumerate(results)
+                if r[0] == STATUS_RETRYABLE]
+        torn = [i for i in shed if results[i][1]]
+        if torn:
+            fail(f"disagg chaos: retryable shed AFTER tokens flowed — "
+                 f"torn streams {torn}")
+        wrong = [i for i, r in enumerate(results)
+                 if r[0] == 0 and r[1] != ref_chaos]
+        if wrong:
+            fail(f"disagg chaos: streams {wrong} are not bitwise the "
+                 f"solo decode (duplicate/lost tokens; want "
+                 f"{ref_chaos})")
+        if chaos_h["resumes_ok"] < 1:
+            fail("disagg chaos: the decode death never rode the "
+                 f"resume path (counters {chaos_h})")
+        if chaos_h["failed"]:
+            fail(f"disagg chaos: {chaos_h['failed']} handoffs failed "
+                 f"outright (counters {chaos_h})")
+        chaos_rec = {
+            "streams": 2 * n_streams,
+            "killed": list(victims),
+            "killed_decode_inflight": killed_inflight,
+            "retryable_sheds": len(shed),
+            "ok_streams": len(results) - len(shed),
+            "resumes_ok": chaos_h["resumes_ok"],
+            "handoff_retries": chaos_h["handoff_retries"],
+            "handoffs_retried": chaos_h["retried"],
+            "handoffs_degraded": chaos_h["degraded"],
+            "client_visible_nonretryable": 0,
+            "duplicate_or_lost_tokens": 0,
+            "bitwise_ok_vs_solo": True,
+        }
+        log(f"disagg chaos: killed {victims} "
+            f"({killed_inflight} streams broken), "
+            f"{chaos_rec['ok_streams']}/{2 * n_streams} streams ok "
+            f"bitwise, {len(shed)} shed clean, resumes_ok "
+            f"{chaos_h['resumes_ok']}, handoff retries "
+            f"{chaos_h['handoff_retries']}")
+
+        # --------------- degraded arm: decode pool ejected to zero
+        before = handoff_counters()
+        reg.deregister("d0")
+        reg.deregister("d1")
+        status, toks = collect_via(router.port, req)
+        degr_h = deltas(before)
+        if status != 0 or toks != ref:
+            fail(f"disagg degraded: pool-at-zero reply not "
+                 f"byte-identical (status {status}, got {toks}, "
+                 f"want {ref})")
+        if degr_h["degraded"] < 1:
+            fail("disagg degraded: the degradation was not counted "
+                 f"(counters {degr_h})")
+        log(f"disagg degraded: decode pool at zero -> colocated "
+            f"serving on the prefill survivor, byte-identical, "
+            f"counted {degr_h['degraded']}")
+    finally:
+        router.stop()
+        for rid, p in procs.items():
+            if rid in victims:
+                p.wait(timeout=20)
+            else:
+                _stop_decode_worker(p, ports[rid])
+
+    ratio = c_p99 / d_p99 if d_p99 else 0.0
+    rec = {
+        "metric": METRIC,
+        "value": round(d_p99, 3),
+        "unit": "ms",
+        # lower-is-better headline: vs_baseline = colocated p99 over
+        # disaggregated p99 under the same prefill bursts (>1 means
+        # the decode pool was protected from prefill admission work)
+        "vs_baseline": round(ratio, 4),
+        "clients": clients,
+        "slots": slots,
+        "new_tokens": new_tokens,
+        "prefill_replicas": 2,
+        "decode_replicas": 2,
+        "p99_intertoken_ms": round(d_p99, 3),
+        "p50_intertoken_ms": round(d_p50, 3),
+        "tokens_per_sec": round(d_rate, 1),
+        "streams": d_streams,
+        "shed_count": d_sheds,
+        "burst_admissions": d_burst["admissions"],
+        "burst_sheds": d_burst["sheds"],
+        "colocated_p99_intertoken_ms": round(c_p99, 3),
+        "colocated_p50_intertoken_ms": round(c_p50, 3),
+        "colocated_tokens_per_sec": round(c_rate, 1),
+        "colocated_streams": c_streams,
+        "colocated_shed_count": c_sheds,
+        "colocated_burst_admissions": c_burst["admissions"],
+        "colocated_burst_sheds": c_burst["sheds"],
+        "p99_ratio_colo_vs_disagg": round(ratio, 4),
+        "handoffs_ok": storm_h["ok"],
+        "handoffs_retried": storm_h["retried"],
+        "handoffs_degraded": storm_h["degraded"],
+        "handoffs_failed": 0,
+        "chaos": chaos_rec,
+        "degraded": {"degraded_count": degr_h["degraded"],
+                     "bitwise_vs_solo": True},
+        "smoke": True,
+    }
+    log(f"disagg: p99 inter-token under prefill bursts "
+        f"{d_p99:.2f}ms disaggregated vs {c_p99:.2f}ms colocated "
+        f"({ratio:.2f}x), {storm_h['ok']} handoffs ok "
+        f"({storm_h['retried']} retried, {storm_h['degraded']} "
+        f"degraded)")
+    return rec
 
 
 def run_sharded():

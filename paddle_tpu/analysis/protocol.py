@@ -43,6 +43,12 @@ Codes (README §"Wire-contract rules"):
           wire status
 - TPU410  dispatch path can mis-map or leak an exception (retryable
           swallowed as permanent, or no reply at all — a client hang)
+- TPU411  replica phase field not covered: an implementation declares
+          the health command but neither surfaces the cmd-3 ``phase``
+          field nor declares the gap in its ``partial`` text (the
+          Python server must additionally validate against the spec's
+          ``REPLICA_PHASES`` vocabulary, so a phase string drifting
+          outside the enum is a gate failure, not silent data)
 
 Suppression: the ``tpu-lint: disable=TPU40x  # justification`` waiver
 works in every language (``//``, ``#`` and R comments alike; the
@@ -639,6 +645,40 @@ def _diff_impl(ex, decl, spec):
     return diags
 
 
+# -------------------------------------------- phase coverage (TPU411)
+
+def _check_phase_coverage(name, decl, spec, source, path):
+    """TPU411: the cmd-3 health body's replica ``phase`` field (PR 18
+    disaggregated serving). Any implementation declaring the health
+    command must either surface the field (its source references
+    ``phase``) or declare the gap in its ``partial`` text — the same
+    declared-partial-not-silence rule the TPU405 coverage checks use.
+    The Python server additionally has to validate against the spec's
+    ``REPLICA_PHASES`` vocabulary: a router scales and degrades pools
+    by this string, so an out-of-enum value must die at the replica,
+    not midway through a handoff."""
+    diags = []
+    phases = getattr(spec, "REPLICA_PHASES", None)
+    if phases is None or spec.CMD_HEALTH not in decl.commands:
+        return diags
+    declared_gap = bool(decl.partial) and "phase" in decl.partial.lower()
+    refs_phase = re.search(r"\bphase\b", source, re.I) is not None
+    if not refs_phase and not declared_gap:
+        diags.append(_diag(
+            "TPU411", f"{name}: declares the health command "
+            f"(cmd {spec.CMD_HEALTH}) but never references the replica "
+            "phase field; surface it in the cmd-3 body or declare the "
+            "gap in its IMPLEMENTATIONS partial text", path, 1))
+    if name == "python-server" and "REPLICA_PHASES" not in source \
+            and not declared_gap:
+        diags.append(_diag(
+            "TPU411", f"{name}: emits the replica phase field without "
+            "validating it against wire_spec.REPLICA_PHASES "
+            f"({', '.join(sorted(phases))}) — an out-of-enum phase "
+            "would route/scale silently wrong at the fleet", path, 1))
+    return diags
+
+
 # --------------------------------------------- Python literal scan (407)
 
 _PACK_STATUS_ARG = {"<IB": 2, "<B": 1, "<Bd": 1}
@@ -1065,6 +1105,7 @@ def check_protocol(files=None, spec=None, root=None, taxonomy=True,
                 getattr(e, "lineno", 0) or 0))
             continue
         diags.extend(_diff_impl(ex, decl, spec))
+        diags.extend(_check_phase_coverage(name, decl, spec, source, path))
     if taxonomy:
         for rel in TAXONOMY_FILES:
             path = files.get(rel, os.path.join(root, rel))

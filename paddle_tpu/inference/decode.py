@@ -822,7 +822,7 @@ class DecodeEngine:
                  default_max_new_tokens=None, name="decode", store=None,
                  breaker_threshold=None, breaker_cooldown=None,
                  watchdog_interval=None, wedge_timeout=None, quant=None,
-                 mesh=None):
+                 mesh=None, phase=None):
         # quant: serve this model under a quantization mode ("w8" |
         # "bf16w"; env default PADDLE_TPU_SERVING_QUANT — the one-knob
         # fleet flip). An unquantized model is wrapped via
@@ -868,6 +868,19 @@ class DecodeEngine:
             else _env_int("PADDLE_TPU_DECODE_MAX_NEW_TOKENS", 64))
         self.default_snapshot_every = max(0, _env_int(
             "PADDLE_TPU_DECODE_SNAPSHOT_EVERY", 0))
+        # phase: this engine's pool in a disaggregated fleet ("prefill"
+        # | "decode" | "both"; env default PADDLE_TPU_DECODE_PHASE).
+        # Phase is a PLACEMENT attribute — it shapes the warmup ladder
+        # and is reported in health/stats for the router, but the
+        # engine still serves every request kind so a fleet whose other
+        # pool collapsed can degrade to colocated serving here.
+        if phase is None:
+            phase = os.environ.get("PADDLE_TPU_DECODE_PHASE") or "both"
+        if phase not in _wire_spec.REPLICA_PHASES:
+            raise ValueError(
+                f"unknown engine phase {phase!r} (expected one of "
+                f"{_wire_spec.REPLICA_PHASES})")
+        self.phase = phase
         if self.max_slots < 1:
             raise ValueError("max_slots must be >= 1")
         # row buckets are floored at 2 even for a max_slots=1 engine
@@ -943,7 +956,8 @@ class DecodeEngine:
             "Tokens generated", const_labels=cl)
         self._m_shed = M.Counter(
             "paddle_decode_shed_total",
-            "Requests shed (reason: queue_full | quarantine)",
+            "Requests shed (reason: queue_full | quarantine | "
+            "no_free_slot — the last is the kv_put seed preflight)",
             labelnames=("reason",), const_labels=cl)
         self._m_retired = M.Counter(
             "paddle_decode_retired_total",
@@ -1215,6 +1229,36 @@ class DecodeEngine:
                     f"match feature_spec {tr}/{dt}")
         return header, arrays
 
+    def seed_check(self, payload):
+        """cmd kv_put preflight for a prefill->decode handoff: validate
+        the block against THIS replica (sharing :meth:`check_snapshot`
+        with the resume path) AND confirm the engine can seed a FRESH
+        slot for it now; -> (header, arrays).
+
+        A handoff places the sequence before the stream commits, so a
+        replica with no free KV slot and a backed-up queue refuses
+        retryable here — the router tries the next decode replica —
+        instead of absorbing a sequence it cannot start. This is the
+        capacity half kv_put adds over a plain resume of a broken
+        stream (which already holds its position and must queue)."""
+        header, arrays = self.check_snapshot(payload)
+        with self._lock:
+            if self._closed:
+                raise EngineClosed(f"{self.name} is closed")
+            waiting = len(self._pending) + len(self._pending_resume)
+            if waiting >= self.max_queue:
+                self._m_shed.inc(reason="queue_full")
+                raise EngineOverloaded(
+                    f"{self.name} decode queue full; seed the handoff "
+                    "elsewhere")
+            if self._slots.free_count() == 0 and waiting > 0:
+                self._m_shed.inc(reason="no_free_slot")
+                raise EngineOverloaded(
+                    f"{self.name} has no free KV slot and {waiting} "
+                    "sequences already waiting; seed the handoff "
+                    "elsewhere")
+        return header, arrays
+
     def resume(self, snapshot, token_budget_s=None, trace_id=None,
                snapshot_every=None, max_new_tokens=None):
         """Resume a snapshotted sequence on THIS engine at its exact
@@ -1470,6 +1514,8 @@ class DecodeEngine:
         kv = outs[1:]
         stale = False
         finished = []  # (seq-or-req, reason, err) notified post-lock
+        snaps = []     # (seq, kv copies, pos, last, n_gen) — encoded
+        # after the lock, same discipline as the step path
         with self._lock:
             if self._sched_gen != gen or self._closed:
                 # a watchdog restart superseded us mid-prefill: the
@@ -1506,6 +1552,16 @@ class DecodeEngine:
                     s = _Seq(r, slot, r.prompt.size, tok, now)
                     self._m_ttft.observe(now - r.t_enqueue)
                     self._emit(s, tok, now, ttft=True)
+                    # prefill-boundary snapshot (cadence 1 only): the
+                    # n_generated=1 block IS the prefill->decode
+                    # handoff format, and it must exist even when the
+                    # sequence retires right here (a handoff request
+                    # runs with max_new_tokens=1) — so the kv copies
+                    # are taken BEFORE the slot can be released
+                    if r.snapshot_every == 1:
+                        snaps.append(
+                            (s, self._slots.snapshot(s.slot, s.pos),
+                             s.pos, s.last_token, s.n_generated))
                     reason = self._stop_reason(s)
                     if reason is None:
                         self._active.append(s)
@@ -1519,6 +1575,20 @@ class DecodeEngine:
             for r in joiners:
                 r._fail(err)
             return
+        # push snapshots BEFORE retirement notification: _push_snapshot
+        # on a finished request is a no-op, and the handoff flow needs
+        # the n_generated=1 block of a max_new_tokens=1 sequence
+        for s, kv_copies, pos, last, n_gen in snaps:
+            try:
+                chaos.hit("serving.decode.snapshot")
+                s.req._push_snapshot(self._build_snapshot(
+                    s.req, kv_copies, pos, last, n_gen), n_gen)
+                with self._lock:
+                    self._n_snapshots += 1
+            except Exception:  # noqa: BLE001 - degraded, never fatal
+                # a failed snapshot just means no resume point for this
+                # window; the stream itself must keep flowing
+                pass
         for s, reason, err in finished:
             self._notify_retired(s, reason, err)
 
@@ -1798,7 +1868,16 @@ class DecodeEngine:
         Defaults: slot buckets = the power-of-2 ladder up to
         ``max_slots``; seq/prompt buckets = the power-of-2 ladder from
         ``min_seq_bucket`` up to ``max_seq_len`` / ``max_prompt_len``.
-        Returns the declared (phase, rows, seq) list."""
+        Returns the declared (phase, rows, seq) list.
+
+        A phased engine narrows its default ladder to its pool's hot
+        programs: a ``prefill`` engine warms the full prompt ladder but
+        only the smallest step bucket (its sequences stop at the first
+        token; the residual step ladder exists solely for degraded
+        colocated traffic), a ``decode`` engine warms the full step
+        ladder but only the smallest prompt bucket (its sequences
+        arrive as KV snapshots that already paid prefill elsewhere).
+        Explicit bucket arguments always win."""
         def ladder(lo, hi):
             out, b = [], lo
             while b < hi:
@@ -1813,12 +1892,16 @@ class DecodeEngine:
             # max_slots=1 engine runs its one sequence at rows=2
             slot_buckets = ladder(2, self._rows_cap)
         if seq_buckets is None:
-            seq_buckets = ladder(self.min_seq_bucket, self.max_seq_len)
+            seq_buckets = (
+                [self.min_seq_bucket] if self.phase == "prefill"
+                else ladder(self.min_seq_bucket, self.max_seq_len))
         if prompt_buckets is None:
-            prompt_buckets = ladder(
-                self.min_seq_bucket,
-                seq_bucket(self.max_prompt_len, self.min_seq_bucket,
-                           self.max_seq_len))
+            prompt_buckets = (
+                [self.min_seq_bucket] if self.phase == "decode"
+                else ladder(
+                    self.min_seq_bucket,
+                    seq_bucket(self.max_prompt_len, self.min_seq_bucket,
+                               self.max_seq_len)))
         declared = []
         for rows in slot_buckets:
             rows = bucket_rows(int(rows), self._rows_cap)
@@ -1853,6 +1936,7 @@ class DecodeEngine:
                 programs[f"{phase}{rows}x{seq_b}"] = d
             return {
                 "name": self.name,
+                "phase": self.phase,
                 "quant": getattr(self._model, "quant", None) or "f32",
                 "mesh": self.mesh_desc,
                 "max_slots": self.max_slots,
@@ -1897,6 +1981,7 @@ class DecodeEngine:
             return {
                 "ok": alive and not self._closed,
                 "closed": self._closed,
+                "phase": self.phase,
                 "scheduler_alive": alive,
                 "heartbeat_age_s": round(now - self._heartbeat, 3),
                 "scheduler_restarts": int(self._m_restarts.value()),

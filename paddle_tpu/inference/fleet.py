@@ -34,6 +34,19 @@ The supervisor thread:
 time: drain -> wire cmd 4 reload -> undrain, so the fleet never has
 fewer than N-1 replicas taking traffic and no request ever drops.
 
+``pools`` disaggregates the fleet into phase pools (README
+"Disaggregated serving"): ``Fleet(spawn_fn, pools={"prefill": 1,
+"decode": 2})`` spawns phase-tagged replicas
+(``registry.register(..., phase=...)``), buries and respawns each
+pool's dead independently, and runs one :class:`Autoscaler` per pool
+over pool-local signals only (:meth:`Fleet.pool_signals`): the
+prefill controller sees admission-gate waiting (TTFT pressure), the
+decode controller sees its own replicas' backlog plus KV-slot
+saturation (inter-token pressure). A prefill burst therefore never
+scales the decode pool, and vice versa. Without ``pools`` nothing
+changes — one ``both`` pool, fleet-global signals, the 1-arg
+``spawn_fn`` contract.
+
 Env knobs (constructor kwargs win):
     PADDLE_TPU_FLEET_MIN_REPLICAS        (1)
     PADDLE_TPU_FLEET_MAX_REPLICAS        (4)
@@ -60,7 +73,7 @@ from ..obs import metrics as obs_metrics
 from .registry import EJECTED, ReplicaRegistry, _env_float, _env_int
 from .router import FleetRouter, TenantPolicy, tenant_id  # noqa: F401
 from .server import _read_all
-from .wire_spec import CMD_RELOAD, CMD_STOP
+from .wire_spec import CMD_RELOAD, CMD_STOP, REPLICA_PHASES
 
 _M_RESPAWNS = obs_metrics.counter(
     "paddle_fleet_respawns_total",
@@ -68,6 +81,10 @@ _M_RESPAWNS = obs_metrics.counter(
 _M_SCALE = obs_metrics.counter(
     "paddle_fleet_scale_events_total",
     "Autoscaler actions", labelnames=("direction",))
+_M_POOL_REPLICAS = obs_metrics.gauge(
+    "paddle_fleet_pool_replicas",
+    "Live replicas per phase pool (refreshed each supervisor tick)",
+    labelnames=("phase",))
 
 
 class ReplicaHandle:
@@ -141,7 +158,7 @@ def subprocess_spawner(prefix, host="127.0.0.1", extra_env=None,
         os.path.dirname(os.path.abspath(__file__))))
 
     # tpu-resource: acquires=tmp_dir releases=tmp_dir
-    def spawn(rid):
+    def spawn(rid, phase=None):
         portdir = _portdir_create()
         try:
             portfile = os.path.join(portdir, f"{rid}.port")
@@ -150,11 +167,13 @@ def subprocess_spawner(prefix, host="127.0.0.1", extra_env=None,
                                  + env.get("PYTHONPATH", ""))
             if extra_env:
                 env.update(extra_env)
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "paddle_tpu.inference.fleet",
-                 "--replica", prefix, portfile,
-                 str(max_batch_size), str(max_wait_ms), str(max_queue)],
-                env=env)
+            argv = [sys.executable, "-m", "paddle_tpu.inference.fleet",
+                    "--replica", prefix, portfile,
+                    str(max_batch_size), str(max_wait_ms),
+                    str(max_queue)]
+            if phase:  # pooled fleets spawn phase-tagged replicas
+                argv.append(phase)
+            proc = subprocess.Popen(argv, env=env)
             t_end = time.monotonic() + timeout
             while time.monotonic() < t_end:
                 if os.path.exists(portfile):
@@ -220,32 +239,71 @@ class Autoscaler:
         return 0
 
 
+class _Pool:
+    """One phase pool's supervision state: a spawn callable already
+    bound to the phase, an independent :class:`Autoscaler`, and the
+    pool's rid counter. The poolless (legacy) fleet is one ``both``
+    pool with ``replica-{n}`` rids; pooled fleets name replicas
+    ``{phase}-{n}`` so pool membership survives in logs and stats."""
+
+    def __init__(self, phase, spawn, autoscaler, n0, legacy=False):
+        self.phase = phase
+        self.spawn = spawn
+        self.autoscaler = autoscaler
+        self.n0 = n0
+        self.legacy = legacy
+        self.next_rid = 0
+
+    def new_rid(self):
+        n = self.next_rid
+        self.next_rid += 1
+        return f"replica-{n}" if self.legacy else f"{self.phase}-{n}"
+
+
 class Fleet:
     """Spawn, register, route, supervise (see module docstring).
 
     ``spawn_fn(rid) -> ReplicaHandle`` produces replicas;
     :func:`subprocess_spawner` builds the production one. With
     ``supervise=False`` nothing respawns or autoscales (tests drive
-    :meth:`supervise_once` manually)."""
+    :meth:`supervise_once` manually).
+
+    ``pools`` disaggregates the fleet into phase pools::
+
+        Fleet(spawn_fn, pools={"prefill": 1, "decode": 2})
+        Fleet(None, pools={
+            "prefill": {"replicas": 1, "spawn": spawn_p,
+                        "autoscaler": Autoscaler(max_replicas=2)},
+            "decode":  {"replicas": 2, "spawn": spawn_d},
+        })
+
+    With ``pools``, the shared ``spawn_fn`` (or a pool's own ``spawn``)
+    is called ``fn(rid, phase)`` so spawners can start phase-shaped
+    replicas (:func:`subprocess_spawner`'s spawn takes the second
+    argument); each pool gets its own :class:`Autoscaler` — pass one
+    via the dict form, int-form pools build a default — fed only
+    pool-local signals (:meth:`pool_signals`); a dead replica respawns
+    into its own pool. Without ``pools`` nothing changes: one ``both``
+    pool, the 1-arg ``spawn_fn`` contract, fleet-global autoscaling."""
 
     def __init__(self, spawn_fn, replicas=None, tenants=(),
                  registry=None, router_kwargs=None, autoscaler=None,
-                 supervise=True, supervise_interval=None):
+                 supervise=True, supervise_interval=None, pools=None):
         self._spawn_fn = spawn_fn
         self.autoscaler = autoscaler or Autoscaler()
-        n0 = (replicas if replicas is not None
-              else self.autoscaler.min_replicas)
+        self._pools = self._build_pools(spawn_fn, replicas, pools)
         self.registry = registry or ReplicaRegistry()
         self.router = FleetRouter(self.registry, tenants=tenants,
                                   own_registry=False,
                                   **(router_kwargs or {}))
         self._lock = threading.Lock()
         self._handles = {}  # rid -> ReplicaHandle
-        self._next_rid = 0
+        self._phases = {}   # rid -> phase (pool membership)
         self._closed = threading.Event()
         self.respawns = 0
-        for _ in range(n0):
-            self._spawn_one()
+        for pool in self._pools.values():
+            for _ in range(pool.n0):
+                self._spawn_one(pool.phase)
         self._thread = None
         if supervise:
             interval = (supervise_interval if supervise_interval is not None
@@ -256,6 +314,40 @@ class Fleet:
                                             daemon=True)
             self._thread.start()
 
+    def _build_pools(self, spawn_fn, replicas, pools):
+        if pools is None:
+            if spawn_fn is None:
+                raise ValueError("Fleet needs a spawn_fn")
+            n0 = (replicas if replicas is not None
+                  else self.autoscaler.min_replicas)
+            return {"both": _Pool("both", spawn_fn, self.autoscaler,
+                                  n0, legacy=True)}
+        out = {}
+        for phase, cfg in pools.items():
+            if phase not in REPLICA_PHASES:
+                raise ValueError(
+                    f"unknown pool phase {phase!r}; "
+                    f"expected one of {REPLICA_PHASES}")
+            if isinstance(cfg, dict):
+                fn = cfg.get("spawn") or spawn_fn
+                scaler = cfg.get("autoscaler") or Autoscaler()
+                n0 = cfg.get("replicas")
+            else:
+                fn, scaler, n0 = spawn_fn, Autoscaler(), int(cfg)
+            if fn is None:
+                raise ValueError(
+                    f"pool {phase!r} has no spawn callable (pass a "
+                    "shared spawn_fn or a per-pool 'spawn')")
+            if n0 is None:
+                n0 = scaler.min_replicas
+            # pooled contract: the spawn callable sees the phase so it
+            # can start a phase-shaped replica (warmup ladder, health)
+            bound = (lambda rid, _fn=fn, _ph=phase: _fn(rid, _ph))
+            out[phase] = _Pool(phase, bound, scaler, n0)
+        if not out:
+            raise ValueError("pools must name at least one phase")
+        return out
+
     @property
     def port(self):
         """The router's client-facing port."""
@@ -265,16 +357,27 @@ class Fleet:
         with self._lock:
             return dict(self._handles)
 
-    # ------------------------------------------------------------ scaling
-    def _new_rid(self):
+    def pools(self):
+        """Live pool membership: ``{phase: [rid, ...]}`` (sorted)."""
         with self._lock:
-            rid = f"replica-{self._next_rid}"
-            self._next_rid += 1
-        return rid
+            out = {phase: [] for phase in self._pools}
+            for rid in sorted(self._phases):
+                out[self._phases[rid]].append(rid)
+        return out
 
-    def _spawn_one(self):
-        rid = self._new_rid()
-        handle = self._spawn_fn(rid)
+    # ------------------------------------------------------------ scaling
+    def _only_pool(self):
+        if len(self._pools) == 1:
+            return next(iter(self._pools))
+        raise ValueError("phase required for a multi-pool fleet "
+                         f"(pools: {sorted(self._pools)})")
+
+    def _spawn_one(self, phase=None):
+        pool = self._pools[phase if phase is not None
+                           else self._only_pool()]
+        with self._lock:
+            rid = pool.new_rid()
+        handle = pool.spawn(rid)
         with self._lock:
             # a close() that raced this spawn (it can take the whole
             # subprocess startup) must not leak an orphan replica: the
@@ -283,11 +386,12 @@ class Fleet:
             aborted = self._closed.is_set()
             if not aborted:
                 self._handles[rid] = handle
+                self._phases[rid] = pool.phase
         if aborted:
             handle.stop()
             return None
         self.registry.register(rid, handle.host, handle.port,
-                               pid=handle.pid)
+                               pid=handle.pid, phase=pool.phase)
         return rid
 
     def _remove_one(self, rid, drain_deadline=10.0):
@@ -296,70 +400,123 @@ class Fleet:
         self.router.drain(rid, deadline_s=drain_deadline)
         with self._lock:
             handle = self._handles.pop(rid, None)
+            self._phases.pop(rid, None)
         self.registry.deregister(rid)
         if handle is not None:
             handle.stop()
 
-    def scale_to(self, n):
-        """Imperative scale (the autoscaler does this on pressure)."""
+    def _members(self, phase):
+        """Locked read of one pool's live rids, sorted."""
+        with self._lock:
+            return sorted(r for r, p in self._phases.items()
+                          if p == phase)
+
+    def scale_to(self, n, phase=None):
+        """Imperative scale of one pool (the autoscalers do this on
+        pressure). ``phase`` may be omitted for a single-pool fleet.
+        Scaling a pure pool to zero is legal: the router degrades the
+        affected handoffs to colocated serving on the surviving pool
+        (README "Disaggregated serving")."""
+        phase = phase if phase is not None else self._only_pool()
+        if phase not in self._pools:
+            raise ValueError(f"no such pool: {phase!r}")
         while True:
-            with self._lock:
-                current = len(self._handles)
-                victim = (sorted(self._handles)[-1]
-                          if current > n else None)
+            members = self._members(phase)
+            current = len(members)
             if current < n:
-                if self._spawn_one() is None:  # closing: stop scaling
+                if self._spawn_one(phase) is None:  # closing: stop
                     return
             elif current > n:
-                self._remove_one(victim)
+                self._remove_one(members[-1])
             else:
                 return
 
     # --------------------------------------------------------- supervisor
+    def pool_signals(self, phase, views=None):
+        """One pool's autoscaling signals: ``(waiting, backlog)``.
+
+        Admission-gate waiting is attributed to the pool that runs a
+        request's FIRST leg — the prefill pool when one exists (gate
+        pressure is TTFT pressure), else the colocated ``both`` pool,
+        else the decode pool — so a prefill burst never scales the
+        decode pool. Backlog sums router in-flight + engine queue
+        depth over this pool's replicas only; the decode pool
+        additionally counts KV-slot saturation (a replica reporting
+        zero free slots adds one scale-up-pressure unit — inter-token
+        pressure exists even when its admission queues are shallow).
+        The poolless fleet's single ``both`` pool sees the fleet-global
+        signals, exactly the pre-pool behavior."""
+        if views is None:
+            views = self.registry.snapshot()
+        first_leg = ("prefill" if "prefill" in self._pools
+                     else "both" if "both" in self._pools else "decode")
+        waiting = 0
+        if phase == first_leg:
+            waiting = sum(t["waiting"]
+                          for t in self.router.gate.stats().values())
+        with self._lock:
+            phases = dict(self._phases)
+        backlog = 0
+        for v in views:
+            if phases.get(v.rid, "both") != phase:
+                continue
+            backlog += v.inflight + v.queue_depth
+            if phase == "decode" and v.free_slots == 0:
+                backlog += self._pools[phase].autoscaler.scale_up_pressure
+        return waiting, backlog
+
     def supervise_once(self):
-        """One supervisor tick: bury+respawn dead replicas, then ask
-        the autoscaler. Runs unlocked except for handle-table reads and
+        """One supervisor tick: bury+respawn dead replicas into their
+        own pool, then ask each pool's autoscaler over pool-local
+        signals. Runs unlocked except for handle-table reads and
         writes — spawning (seconds) must not block drains or stats."""
         if self._closed.is_set():
             return {"dead": 0, "action": 0, "waiting": 0,
-                    "backlog": 0, "ejected": 0}
+                    "backlog": 0, "ejected": 0, "pools": {}}
         with self._lock:
-            dead = [(rid, h) for rid, h in self._handles.items()
-                    if not h.alive()]
-        for rid, handle in dead:
+            dead = [(rid, h, self._phases.get(rid))
+                    for rid, h in self._handles.items() if not h.alive()]
+        for rid, handle, phase in dead:
             with self._lock:
                 self._handles.pop(rid, None)
+                self._phases.pop(rid, None)
             self.registry.deregister(rid)
             try:
                 handle.stop(timeout=0.1)  # reap the corpse
             except Exception:  # noqa: BLE001 — already dead
                 pass
-            if self._spawn_one() is not None:
+            if phase not in self._pools:  # pool was reconfigured away
+                phase = next(iter(self._pools))
+            if self._spawn_one(phase) is not None:
                 self.respawns += 1
                 _M_RESPAWNS.inc()
-        waiting = sum(t["waiting"]
-                      for t in self.router.gate.stats().values())
-        backlog = 0
-        ejected = 0
-        for v in self.registry.snapshot():
-            backlog += v.inflight + v.queue_depth
-            ejected += v.state == EJECTED
-        with self._lock:
-            n = len(self._handles)
-        action = self.autoscaler.decide(n, waiting, backlog)
-        if action > 0:
-            self._spawn_one()
-            _M_SCALE.inc(direction="up")
-        elif action < 0:
-            with self._lock:
-                victim = sorted(self._handles)[-1] if self._handles \
-                    else None
-            if victim is not None:
-                self._remove_one(victim)
-                _M_SCALE.inc(direction="down")
-        return {"dead": len(dead), "action": action,
-                "waiting": waiting, "backlog": backlog,
-                "ejected": ejected}
+        views = self.registry.snapshot()
+        ejected = sum(v.state == EJECTED for v in views)
+        total_waiting = sum(t["waiting"]
+                            for t in self.router.gate.stats().values())
+        total_backlog = sum(v.inflight + v.queue_depth for v in views)
+        pools_out = {}
+        net_action = 0
+        for phase, pool in self._pools.items():
+            waiting, backlog = self.pool_signals(phase, views=views)
+            action = pool.autoscaler.decide(len(self._members(phase)),
+                                            waiting, backlog)
+            if action > 0:
+                self._spawn_one(phase)
+                _M_SCALE.inc(direction="up")
+            elif action < 0:
+                members = self._members(phase)
+                if members:
+                    self._remove_one(members[-1])
+                    _M_SCALE.inc(direction="down")
+            n_now = len(self._members(phase))
+            _M_POOL_REPLICAS.set(n_now, phase=phase)
+            net_action += action
+            pools_out[phase] = {"replicas": n_now, "waiting": waiting,
+                                "backlog": backlog, "action": action}
+        return {"dead": len(dead), "action": net_action,
+                "waiting": total_waiting, "backlog": total_backlog,
+                "ejected": ejected, "pools": pools_out}
 
     def _supervise_loop(self):
         while not self._closed.wait(self._interval):
@@ -374,10 +531,17 @@ class Fleet:
     def rolling_reload(self, prefix=None, drain_deadline=10.0):
         """Hot weight swap across the fleet, one replica at a time,
         zero dropped requests: drain -> cmd 4 reload -> undrain. The
-        fleet keeps serving on the other replicas throughout. Returns
-        the per-replica reload JSON replies."""
+        fleet keeps serving on the other replicas throughout. Pooled
+        fleets reload grouped by phase, still one replica at a time
+        fleet-wide — a single-replica pool briefly empties, which the
+        router covers by degrading its handoffs to colocated serving.
+        Returns the per-replica reload JSON replies."""
         out = {}
-        for rid, handle in sorted(self.handles().items()):
+        with self._lock:
+            order = sorted(self._handles, key=lambda r: (
+                self._phases.get(r, "both"), r))
+            todo = [(r, self._handles[r]) for r in order]
+        for rid, handle in todo:
             self.router.drain(rid, deadline_s=drain_deadline)
             try:
                 payload = struct.pack("<B", CMD_RELOAD) + (
@@ -404,6 +568,7 @@ class Fleet:
         with self._lock:
             handles = list(self._handles.values())
             self._handles = {}
+            self._phases = {}
         for h in handles:
             try:
                 h.stop()
@@ -420,17 +585,20 @@ class Fleet:
 
 def _replica_main(argv):
     """``python -m paddle_tpu.inference.fleet --replica PREFIX PORTFILE
-    [max_batch max_wait_ms max_queue]`` — one serve_model replica that
-    writes its bound port atomically and serves until cmd 7."""
+    [max_batch max_wait_ms max_queue [phase]]`` — one serve_model
+    replica that writes its bound port atomically and serves until
+    cmd 7. ``phase`` tags the replica's pool (prefill | decode | both)
+    in its cmd-3 health body."""
     prefix, portfile = argv[0], argv[1]
     max_batch = int(argv[2]) if len(argv) > 2 else 8
     max_wait_ms = float(argv[3]) if len(argv) > 3 else 2.0
     max_queue = int(argv[4]) if len(argv) > 4 else 256
+    phase = argv[5] if len(argv) > 5 else None
     from .server import serve_model
 
     srv = serve_model(prefix, dynamic_batching=True,
                       max_batch_size=max_batch, max_wait_ms=max_wait_ms,
-                      max_queue=max_queue)
+                      max_queue=max_queue, phase=phase)
     with open(portfile + ".tmp", "w") as f:
         f.write(str(srv.port))
     os.replace(portfile + ".tmp", portfile)
@@ -442,6 +610,6 @@ if __name__ == "__main__":
     if len(sys.argv) >= 4 and sys.argv[1] == "--replica":
         sys.exit(_replica_main(sys.argv[2:]))
     print("usage: python -m paddle_tpu.inference.fleet --replica "
-          "PREFIX PORTFILE [max_batch max_wait_ms max_queue]",
+          "PREFIX PORTFILE [max_batch max_wait_ms max_queue [phase]]",
           file=sys.stderr)
     sys.exit(2)

@@ -41,7 +41,7 @@ import time
 
 from ..obs import metrics as obs_metrics
 from ..resilience import chaos
-from .wire_spec import CMD_HEALTH, STATUS_OK
+from .wire_spec import CMD_HEALTH, REPLICA_PHASES, STATUS_OK
 
 # replica lifecycle (the eject/readmit state machine)
 OK = "ok"            # routable
@@ -81,11 +81,12 @@ class ReplicaView:
 
     __slots__ = ("rid", "host", "port", "state", "queue_depth",
                  "warm_buckets", "inflight", "draining_deadline_s",
-                 "heartbeat_age_s", "pid", "metrics_port")
+                 "heartbeat_age_s", "pid", "metrics_port", "phase",
+                 "free_slots")
 
     def __init__(self, rid, host, port, state, queue_depth, warm_buckets,
                  inflight, draining_deadline_s, heartbeat_age_s, pid,
-                 metrics_port=None):
+                 metrics_port=None, phase="both", free_slots=None):
         self.rid = rid
         self.host = host
         self.port = port
@@ -97,6 +98,12 @@ class ReplicaView:
         self.heartbeat_age_s = heartbeat_age_s
         self.pid = pid
         self.metrics_port = metrics_port
+        # pool membership (wire_spec.REPLICA_PHASES): registered intent,
+        # refreshed from the replica's own health body once it reports
+        self.phase = phase
+        # decode free KV slots from the last health probe (None until a
+        # decode engine reports) — the router's decode-placement signal
+        self.free_slots = free_slots
 
     def as_dict(self):
         return {s: getattr(self, s) for s in self.__slots__}
@@ -107,11 +114,14 @@ class _Replica:
     registry's single lock — probes and routing I/O happen OUTSIDE it
     on local snapshots."""
 
-    def __init__(self, rid, host, port, pid=None, metrics_port=None):
+    def __init__(self, rid, host, port, pid=None, metrics_port=None,
+                 phase="both"):
         self.rid = rid
         self.host = host
         self.port = port
         self.pid = pid  # for supervisors that respawn subprocesses
+        self.phase = phase  # pool membership (prefill | decode | both)
+        self.free_slots = None  # decode KV slots free at last probe
         # the replica's /metrics HTTP endpoint (obs.httpd.MetricsServer
         # reports the ephemeral port it bound as `.port`) so scrapers
         # can discover the whole fleet from the registry
@@ -190,15 +200,22 @@ class ReplicaRegistry:
         obs_metrics.REGISTRY.register_collector(self._collect)
 
     # --------------------------------------------------------- membership
-    def register(self, rid, host, port, pid=None, metrics_port=None):
+    def register(self, rid, host, port, pid=None, metrics_port=None,
+                 phase="both"):
         """Add (or re-add after a respawn) a replica. A re-registered
         rid starts fresh: OK state, zero misses. ``metrics_port`` is
         the replica's /metrics HTTP endpoint (advertise the ephemeral
-        port ``obs.httpd.MetricsServer`` bound)."""
+        port ``obs.httpd.MetricsServer`` bound). ``phase`` is the pool
+        the replica was spawned into (wire_spec.REPLICA_PHASES); the
+        replica's own health body overrides it once probes land."""
+        if phase not in REPLICA_PHASES:
+            raise ValueError(f"unknown replica phase {phase!r} "
+                             f"(expected one of {REPLICA_PHASES})")
         with self._lock:
             self._replicas[rid] = _Replica(rid, str(host), int(port),
                                            pid=pid,
-                                           metrics_port=metrics_port)
+                                           metrics_port=metrics_port,
+                                           phase=phase)
 
     def deregister(self, rid):
         with self._lock:
@@ -221,22 +238,35 @@ class ReplicaRegistry:
                 r.warm_buckets, r.inflight, r.draining_deadline_s,
                 (None if r.last_heartbeat is None
                  else round(now - r.last_heartbeat, 3)), r.pid,
-                r.metrics_port)
+                r.metrics_port, r.phase, r.free_slots)
                 for r in self._replicas.values()]
 
-    def routable(self):
+    def routable(self, phase=None):
         """Replicas the router may send NEW work to, least-loaded
         first: OK state, ordered by (router in-flight + last reported
         queue depth, colder-first warmth tie-break inverted — warmer
-        replicas win a tie because their bucket ladder is compiled)."""
+        replicas win a tie because their bucket ladder is compiled).
+
+        ``phase`` narrows to ONE pool of a disaggregated fleet
+        (replicas whose phase matches exactly — "both" replicas serve
+        the phase-blind default but belong to neither pure pool).
+        Decode placement sorts most-free-KV-slots first instead:
+        prefill cares about warm prompt buckets, decode about where a
+        resumed sequence can actually get a slot."""
         with self._lock:
             rows = [ReplicaView(
                 r.rid, r.host, r.port, r.state, r.queue_depth,
                 r.warm_buckets, r.inflight, r.draining_deadline_s,
-                None, r.pid)
-                for r in self._replicas.values() if r.state == OK]
-        rows.sort(key=lambda v: (v.inflight + v.queue_depth,
-                                 -v.warm_buckets, v.rid))
+                None, r.pid, r.metrics_port, r.phase, r.free_slots)
+                for r in self._replicas.values()
+                if r.state == OK and (phase is None or r.phase == phase)]
+        if phase == "decode":
+            rows.sort(key=lambda v: (
+                -(v.free_slots if v.free_slots is not None else 0),
+                v.inflight + v.queue_depth, v.rid))
+        else:
+            rows.sort(key=lambda v: (v.inflight + v.queue_depth,
+                                     -v.warm_buckets, v.rid))
         return rows
 
     def acquire(self, rid):
@@ -358,6 +388,15 @@ class ReplicaRegistry:
             r.warm_buckets = len((health.get("engine") or {})
                                  .get("declared_buckets") or [])
             r.draining_deadline_s = health.get("draining_deadline_s")
+            # the replica's own phase declaration wins over what the
+            # supervisor registered (a reconfigured replica re-pools
+            # itself on its next heartbeat); unknown values are ignored
+            # so a newer replica can't poison routing
+            phase = health.get("phase")
+            if phase in REPLICA_PHASES:
+                r.phase = phase
+            free = (health.get("decode") or {}).get("free_slots")
+            r.free_slots = int(free) if free is not None else None
             readmitted = False
             if r.state == PROBING:
                 # the half-open probe succeeded: readmit (into

@@ -59,6 +59,21 @@ today's status-2 terminal frame. The held snapshot is a DECLARED
 kv_snapshot resource (``_snap_hold`` / ``_snap_release``): the TPU5xx
 lint and the restrace census prove every relay path drops it.
 
+Disaggregated serving (PR 18): when the fleet is split into phase
+pools (``Fleet(pools=...)`` — the registry's health probes carry each
+replica's ``phase``), a genuine decode stream is served as a
+prefill->decode HANDOFF: the prefill leg runs the handoff-bit cmd 1 on
+a prefill replica (one kv-snapshot frame + the first token back), the
+first token goes straight to the client (TTFT never waits for decode
+placement), and the decode leg seeds a decode replica via kv_resume —
+retried once on a *different* decode replica, then on any surviving
+replica (outcome ``degraded``). A pure pool with nothing routable
+degrades the stream to plain colocated dispatch on whatever survives:
+counted (``paddle_handoff_total{outcome="degraded"}``), logged, and
+self-recovering. Every handoff path keeps the ok-or-retryable client
+contract, and the handoff snapshot rides the same declared
+kv_snapshot resource pair as stream resume.
+
 Env knobs (constructor kwargs win):
     PADDLE_TPU_FLEET_RETRY_ATTEMPTS    total tries per request (3)
     PADDLE_TPU_FLEET_RETRY_BASE_S      first shed backoff      (0.05)
@@ -68,11 +83,14 @@ Env knobs (constructor kwargs win):
     PADDLE_TPU_FLEET_ADMIT_TIMEOUT_S   deadline-less admission
                                        wait cap                (5.0)
     PADDLE_TPU_FLEET_BACKEND_TIMEOUT_S per-attempt reply cap   (30.0)
+    PADDLE_TPU_FLEET_HANDOFF_TIMEOUT_S per-attempt prefill/
+                                       decode handoff leg cap  (5.0)
     PADDLE_TPU_DECODE_SNAPSHOT_EVERY   resume-point cadence in
                                        tokens, 0 disables      (8)
 """
 import hashlib
 import json
+import logging
 import os
 import random
 import socket
@@ -91,8 +109,8 @@ from .server import MAX_BODY_BYTES, BodyTooLarge, _read_all
 # the --protocol lint fails on hardcoded wire literals here)
 from .wire_spec import (CMD_DRAIN, CMD_HEALTH, CMD_INFER, CMD_KV_RESUME,
                         CMD_METRICS, CMD_STATS, CMD_STOP, DEADLINE_MARKER,
-                        DECODE_MARKER, DECODE_ONESHOT_BIT,
-                        DECODE_SNAPSHOT_EVERY_MASK,
+                        DECODE_HANDOFF_BIT, DECODE_MARKER,
+                        DECODE_ONESHOT_BIT, DECODE_SNAPSHOT_EVERY_MASK,
                         DECODE_SNAPSHOT_EVERY_SHIFT, STATUS_ERROR,
                         STATUS_OK, STATUS_STREAM, TENANT_MARKER,
                         TRACE_MARKER, build_request,
@@ -177,7 +195,9 @@ _M_RETRIES = obs_metrics.counter(
     "paddle_fleet_retries_total",
     "Per-request replica retries, by cause (shed = status-2 rerouted "
     "with backoff, io = dead-replica failover, stream_resume = "
-    "mid-stream decode failover re-driven from a kv snapshot)",
+    "mid-stream decode failover re-driven from a kv snapshot, "
+    "handoff = a disaggregated prefill or decode leg re-run on "
+    "another replica)",
     labelnames=("cause",))
 _M_DEADLINE = obs_metrics.counter(
     "paddle_fleet_deadline_total",
@@ -197,6 +217,21 @@ _M_RESUME_SECONDS = obs_metrics.histogram(
     "paddle_decode_resume_seconds",
     "Replica-death-to-first-resumed-frame latency of successful "
     "mid-stream decode failovers")
+_M_HANDOFF = obs_metrics.counter(
+    "paddle_handoff_total",
+    "Disaggregated prefill->decode handoffs at the router, by outcome "
+    "(ok = first placement served the stream, retried = a prefill or "
+    "decode leg was re-run before success, degraded = served "
+    "colocated because a pure pool was empty or refused every "
+    "attempt, failed = the client saw a retryable terminal after the "
+    "handoff began)",
+    labelnames=("outcome",))
+_M_HANDOFF_SECONDS = obs_metrics.histogram(
+    "paddle_handoff_seconds",
+    "Prefill-snapshot-held to decode-replica-accepted latency of "
+    "successful disaggregated handoffs")
+
+_LOG = logging.getLogger("paddle_tpu.inference.router")
 
 
 class FairGate:
@@ -381,7 +416,7 @@ class FleetRouter:
                  retry_base=None, retry_max=None, admit_timeout=None,
                  backend_timeout=None, own_registry=None,
                  max_body=MAX_BODY_BYTES, rng=random.random,
-                 snapshot_every=None):
+                 snapshot_every=None, handoff_timeout=None):
         own = registry is None if own_registry is None else own_registry
         self.registry = registry if registry is not None \
             else ReplicaRegistry()
@@ -401,6 +436,12 @@ class FleetRouter:
         self.backend_timeout = (
             backend_timeout if backend_timeout is not None
             else _env_float("PADDLE_TPU_FLEET_BACKEND_TIMEOUT_S", 30.0))
+        # per-attempt cap on one disaggregated handoff leg (prefill
+        # run or decode placement): a stuck pool member must cost at
+        # most this before the leg moves to another replica
+        self.handoff_timeout = (
+            handoff_timeout if handoff_timeout is not None
+            else _env_float("PADDLE_TPU_FLEET_HANDOFF_TIMEOUT_S", 5.0))
         self.max_body = max_body
         # snapshot cadence stamped onto forwarded decode requests so
         # replicas interleave resume points into their streams; the
@@ -604,23 +645,38 @@ class FleetRouter:
         return struct.pack("<B", status) + encode_arrays([arr]), dropped
 
     # tpu-resource: acquires=router_socket releases=router_socket
-    def _resume_leg(self, snap, fields, timeout, dead):
+    def _resume_leg(self, snap, fields, timeout, dead, phase=None,
+                    max_attempts=None, tried=None):
         """Re-drive a broken decode stream from the held snapshot
         ``snap`` on each live replica not in ``dead``. On success
         returns ``(view, sock, first_body)`` with the registry
         in-flight slot for ``view.rid`` HELD by the caller; returns
         None when no candidate accepted. The forwarded marker
         ``fields`` ride along so the new leg keeps the original
-        per-token budget, trace id, and snapshot cadence. A status-2
-        first frame is a refusal (identity skew or shed) and a status-1
-        frame a hard reject — both leave the socket at a frame
-        boundary, so it is pooled and the next candidate tried."""
+        per-token budget, trace id, snapshot cadence — and, for the
+        disaggregated decode leg, the REAL max-new-tokens that
+        overrides the prefill snapshot's 1. A status-2 first frame is
+        a refusal (identity skew or shed) and a status-1 frame a hard
+        reject — both leave the socket at a frame boundary, so it is
+        pooled and the next candidate tried.
+
+        ``phase`` restricts candidates to one pool (the handoff's
+        decode placement — free-slot-richest first), ``max_attempts``
+        bounds distinct replicas tried this call, and ``tried`` (a
+        set) records and excludes candidates ACROSS calls so the
+        handoff's one retry provably lands on a different replica."""
         payload = snap + b"".join(
             struct.pack("<B", m) + raw for m, raw in fields)
         frame = build_request(CMD_KV_RESUME, payload)
-        for v in self.registry.routable():
-            if v.rid in dead:
+        attempts = 0
+        for v in self.registry.routable(phase):
+            if v.rid in dead or (tried is not None and v.rid in tried):
                 continue
+            if max_attempts is not None and attempts >= max_attempts:
+                break
+            attempts += 1
+            if tried is not None:
+                tried.add(v.rid)
             self.registry.acquire(v.rid)
             sock = None
             try:
@@ -646,7 +702,8 @@ class FleetRouter:
 
     # tpu-resource: releases=router_socket
     def _relay(self, view, sock, first_body, client_conn, timeout,
-               t_send, stream_ctx=None):
+               t_send, stream_ctx=None, init_snap=None, init_tokens=0,
+               init_max_gap=0.0, owns_slot=False):
         """Pump chunk frames replica -> client until the terminal
         frame, surviving mid-stream replica death when a resume point
         is held. Owns ``sock`` (and every failover socket it dials)
@@ -672,18 +729,27 @@ class FleetRouter:
         that never asked for snapshots sees byte-identical framing
         throughout because injected snapshot frames are stripped here.
         Without a held snapshot a death stays today's status-2
-        terminal."""
+        terminal.
+
+        The disaggregated decode leg enters here mid-stream:
+        ``init_snap`` is the prefill handoff snapshot (re-held locally
+        so this function's hold/release pairing stays self-contained),
+        ``init_tokens`` tokens were already delivered by the prefill
+        leg (the dedup arithmetic counts them), ``init_max_gap``
+        carries the client's observed TTFT gap, and ``owns_slot=True``
+        says ``view.rid``'s registry in-flight slot was acquired by
+        ``_resume_leg`` and is ours to drop."""
         strip = bool(stream_ctx and stream_ctx.get("strip"))
         fields = [] if stream_ctx is None else stream_ctx["fields"]
         can_resume = stream_ctx is not None
-        tokens = 0
-        max_gap = 0.0
+        tokens = init_tokens
+        max_gap = init_max_gap
         t_last = t_send
         rid = view.rid  # replica serving the CURRENT leg
-        owned = False   # True once rid's in-flight slot is OURS to drop
+        owned = owns_slot  # True while rid's in-flight slot is OURS
         skip = 0        # duplicate tokens still to trim on this leg
         dead = set()
-        snap = None
+        snap = None if init_snap is None else self._snap_hold(init_snap)
 
         def send(body):
             try:
@@ -776,6 +842,218 @@ class FleetRouter:
             if snap is not None:
                 self._snap_release(snap)
 
+    # ------------------------------------------------- disaggregation
+    def _disagg_plan(self):
+        """Placement decision for one genuine decode stream: ``None``
+        = colocated (poolless fleet — every routable replica serves
+        both phases), ``"handoff"`` = disaggregated prefill->decode
+        handoff (both pure pools have a routable member), and
+        ``"degraded"`` = the fleet IS pooled but a pure pool has
+        nothing routable — serve colocated on whatever survives
+        (counted + logged; recovers by itself once the missing pool
+        scales back up or its replicas probe back in)."""
+        views = self.registry.routable()
+        if not any(v.phase != "both" for v in views):
+            return None
+        has_pre = any(v.phase == "prefill" for v in views)
+        has_dec = any(v.phase == "decode" for v in views)
+        return "handoff" if (has_pre and has_dec) else "degraded"
+
+    @staticmethod
+    def _handoff_frame(arrays_bytes, fwd_fields, tail):
+        """The prefill leg's wire frame: the forwarded request with
+        the handoff bit set on its decode field — the replica runs
+        ONLY the prefill step and replies with one kv-snapshot frame
+        then the terminal first-token frame."""
+        out = []
+        for m, raw in fwd_fields:
+            if m == DECODE_MARKER:
+                (val,) = struct.unpack("<Q", raw)
+                raw = struct.pack("<Q", val | DECODE_HANDOFF_BIT)
+            out.append((m, raw))
+        body = arrays_bytes + b"".join(
+            struct.pack("<B", m) + raw for m, raw in out) + tail
+        return struct.pack("<I", len(body)) + body
+
+    # tpu-resource: acquires=router_socket releases=router_socket
+    def _prefill_leg(self, frame, timeout, deadline):
+        """Run the prefill step of a disaggregated stream on the
+        prefill pool (warm-bucket-first placement) and retry another
+        prefill replica on death or refusal — the client has seen
+        NOTHING yet, so a prefill replica SIGKILLed mid-handoff is
+        invisible: the prefill re-runs elsewhere. Returns
+        ``(view, raw_snap, term_body, t_send, retried)`` where
+        ``raw_snap`` is the raw handoff-snapshot blob — NOT yet held;
+        the caller takes ownership via ``_snap_hold`` — ``("error",
+        body)`` for a genuine status-1 request error (forwarded to
+        the client verbatim, never retried), or None when every
+        prefill replica refused or failed."""
+        attempts = 0
+        retried = False
+        for v in self.registry.routable("prefill"):
+            if attempts >= self.retry_attempts:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            attempts += 1
+            self.registry.acquire(v.rid)
+            sock = None
+            t_send = time.monotonic()
+            try:
+                sock = self._pool_get(v.rid)
+                if sock is None:
+                    sock = self._conn_open(v)
+                sock.settimeout(timeout)
+                sock.sendall(frame)
+                (blen,) = struct.unpack("<I", _read_all(sock, 4))
+                body = _read_all(sock, blen)
+                term = None
+                if body and body[0] == STATUS_STREAM \
+                        and is_kv_snapshot(body[1:]):
+                    (blen,) = struct.unpack("<I", _read_all(sock, 4))
+                    term = _read_all(sock, blen)
+            except (OSError, ConnectionError):
+                if sock is not None:
+                    self._conn_close(sock)
+                self.registry.report_io_error(v.rid)
+                self._pool_drop(v.rid)
+                _M_RETRIES.inc(cause="handoff")
+                retried = True
+                continue
+            finally:
+                self.registry.release(v.rid)
+            if term is not None and term[0] == STATUS_OK:
+                self._pool_put(v.rid, sock)
+                self.registry.report_ok(v.rid)
+                return v, body[1:], term, t_send, retried
+            if term is None and body and body[0] == STATUS_OVERLOADED:
+                # refusal at a frame boundary: pool it, try the next
+                self._pool_put(v.rid, sock)
+                _M_RETRIES.inc(cause="handoff")
+                retried = True
+                continue
+            if term is None and body and body[0] == STATUS_ERROR:
+                # the REQUEST is bad, not the replica: no retry
+                self._pool_put(v.rid, sock)
+                return ("error", body)
+            # surprise framing (version skew): poison, try another
+            self._conn_close(sock)
+            _M_RETRIES.inc(cause="handoff")
+            retried = True
+        return None
+
+    # tpu-resource: acquires=kv_snapshot releases=kv_snapshot
+    def _dispatch_handoff(self, arrays_bytes, fwd_fields, tail,
+                          deadline, client_conn, stream_ctx, max_new):
+        """Disaggregated dispatch of one decode stream (README
+        "Disaggregated serving"): prefill leg on the prefill pool
+        (handoff-bit cmd 1 -> kv snapshot + first token), the first
+        token straight to the client (TTFT never waits for decode
+        placement), then the decode leg seeds a decode replica via
+        kv_resume — retried once on a DIFFERENT decode replica, then
+        on any surviving replica (outcome ``degraded``) — and relays
+        the rest with the full mid-stream resume machinery behind it.
+        Returns a :class:`_Streamed` (the stream finished or ended
+        with a retryable terminal — the client always sees
+        ok-or-retryable, never a torn stream), a raw status-1 body
+        (genuine request error from prefill, nothing relayed), or
+        None (nothing reached the client and no prefill replica
+        cooperated: the caller degrades to colocated dispatch)."""
+        chaos.hit("fleet.handoff")
+        timeout = min(self.handoff_timeout, self.backend_timeout)
+        if deadline is not None:
+            timeout = min(timeout,
+                          max(0.05, deadline - time.monotonic()) + 1.0)
+        pre = self._prefill_leg(
+            self._handoff_frame(arrays_bytes, fwd_fields, tail),
+            timeout, deadline)
+        if pre is None:
+            return None
+        if pre[0] == "error":
+            return pre[1]
+        view, raw_snap, term, t_send, retried = pre
+        snap = self._snap_hold(raw_snap)
+        t_snap = time.monotonic()
+        try:
+            n_tok = self._chunk_tokens(term)
+            if max_new <= n_tok:
+                # the prefill token IS the whole stream (max_new 1):
+                # forward the terminal verbatim, no decode leg at all
+                try:
+                    client_conn.sendall(
+                        struct.pack("<I", len(term)) + term)
+                except (OSError, ConnectionError) as e:
+                    raise _ClientGone(str(e)) from e
+                _M_HANDOFF.inc(
+                    outcome="retried" if retried else "ok")
+                return _Streamed(STATUS_OK, n_tok,
+                                 time.monotonic() - t_send)
+            # first token to the client NOW, as a stream chunk
+            chunk = struct.pack("<B", STATUS_STREAM) + term[1:]
+            try:
+                client_conn.sendall(
+                    struct.pack("<I", len(chunk)) + chunk)
+            except (OSError, ConnectionError) as e:
+                raise _ClientGone(str(e)) from e
+            t_tok = time.monotonic()
+            # decode placement: best decode replica, one retry on a
+            # provably different one, then anywhere (degraded)
+            tried = set()
+            nxt = self._resume_leg(snap, fwd_fields, timeout, set(),
+                                   phase="decode", max_attempts=1,
+                                   tried=tried)
+            outcome = "retried" if retried else "ok"
+            if nxt is None and any(
+                    v.rid not in tried
+                    for v in self.registry.routable("decode")):
+                _M_RETRIES.inc(cause="handoff")
+                outcome = "retried"
+                nxt = self._resume_leg(snap, fwd_fields, timeout,
+                                       set(), phase="decode",
+                                       max_attempts=1, tried=tried)
+            if nxt is None:
+                nxt = self._resume_leg(snap, fwd_fields, timeout,
+                                       set(), tried=tried)
+                if nxt is not None:
+                    outcome = "degraded"
+                    _LOG.warning(
+                        "decode pool refused handoff: stream resumed "
+                        "on %s (degraded to colocated)", nxt[0].rid)
+            if nxt is None:
+                # a token was already delivered, so this stream can
+                # only END retryably — never silently torn
+                _M_HANDOFF.inc(outcome="failed")
+                try:
+                    client_conn.sendall(struct.pack(
+                        "<IB", 1, STATUS_OVERLOADED))
+                except (OSError, ConnectionError) as e:
+                    raise _ClientGone(str(e)) from e
+                return _Streamed(STATUS_OVERLOADED, n_tok,
+                                 t_tok - t_send, replica_ok=True)
+            dview, dsock, dbody = nxt
+            _M_HANDOFF.inc(outcome=outcome)
+            _M_HANDOFF_SECONDS.observe(time.monotonic() - t_snap)
+            # placement done: the relay reads at the normal per-reply
+            # cap, not the short per-attempt handoff cap
+            dsock.settimeout(self.backend_timeout)
+            # ownership of the held snapshot transfers to _relay (it
+            # re-holds init_snap on entry and releases on every exit
+            # path) — our finally must not double-release it
+            relay_snap, snap = snap, None
+            streamed = self._relay(dview, dsock, dbody, client_conn,
+                                   self.backend_timeout, t_tok,
+                                   stream_ctx=stream_ctx,
+                                   init_snap=relay_snap,
+                                   init_tokens=n_tok,
+                                   init_max_gap=t_tok - t_send,
+                                   owns_slot=True)
+            if streamed.replica_ok:
+                self.registry.report_ok(dview.rid)
+            return streamed
+        finally:
+            if snap is not None:
+                self._snap_release(snap)
+
     def _forward_fresh(self, view, frame, timeout, client_conn=None,
                        stream_ctx=None):
         sock = self._conn_open(view)
@@ -838,11 +1116,13 @@ class FleetRouter:
         fwd_fields = []
         strip_snaps = False
         client_cadence = 0
+        decode_val = 0
         for m, raw in fields:
             if m == TENANT_MARKER:
                 continue
             if m == DECODE_MARKER and stream:
                 (val,) = struct.unpack("<Q", raw)
+                decode_val = val
                 client_cadence = ((val >> DECODE_SNAPSHOT_EVERY_SHIFT)
                                   & DECODE_SNAPSHOT_EVERY_MASK)
                 if not client_cadence and self.snapshot_every:
@@ -857,6 +1137,26 @@ class FleetRouter:
         stream_ctx = None
         if stream and (strip_snaps or client_cadence):
             stream_ctx = {"fields": fwd_fields, "strip": strip_snaps}
+        if stream_ctx is not None and client_conn is not None:
+            # phase-pooled fleet: serve genuine streams as a
+            # prefill->decode handoff; degrade to plain colocated
+            # dispatch (below) when a pure pool has nothing routable
+            # or no prefill replica cooperated
+            plan = self._disagg_plan()
+            if plan is not None:
+                reason = "pool_empty"
+                if plan == "handoff":
+                    max_new = int(decode_val & 0xFFFFFFFF) or 64
+                    resp = self._dispatch_handoff(
+                        arrays_bytes, fwd_fields, tail, deadline,
+                        client_conn, stream_ctx, max_new)
+                    if resp is not None:
+                        return resp
+                    reason = "no_prefill_placement"
+                _M_HANDOFF.inc(outcome="degraded")
+                _LOG.warning(
+                    "disaggregated serving degraded to colocated "
+                    "(%s)", reason)
         delays = backoff_delays(self.retry_attempts, self.retry_base,
                                 self.retry_max, 0.5, self._rng)
         tried = set()
@@ -1165,6 +1465,10 @@ class FleetRouter:
         least one replica is routable."""
         replicas = [v.as_dict() for v in self.registry.snapshot()]
         routable = sum(1 for r in replicas if r["state"] == "ok")
+        pools = {}
+        for r in replicas:
+            ph = r.get("phase") or "both"
+            pools[ph] = pools.get(ph, 0) + 1
         return {
             "ok": routable > 0 and not self._stop.is_set(),
             "router": True,
@@ -1172,17 +1476,24 @@ class FleetRouter:
             "accepting": not self._stop.is_set(),
             "routable_replicas": routable,
             "replicas": replicas,
+            "pools": pools,
             "tenants": self.gate.stats(),
         }
 
     def stats(self):
+        replicas = [v.as_dict() for v in self.registry.snapshot()]
+        pools = {}
+        for r in replicas:
+            ph = r.get("phase") or "both"
+            pools[ph] = pools.get(ph, 0) + 1
         return {
             "router": True,
             "port": self.port,
             "retry_attempts": self.retry_attempts,
             "max_inflight": self.gate.capacity,
             "tenants": self.gate.stats(),
-            "replicas": [v.as_dict() for v in self.registry.snapshot()],
+            "replicas": replicas,
+            "pools": pools,
             "serving_goodput": obs_goodput.SERVING_LEDGER.report(),
         }
 
@@ -1209,6 +1520,19 @@ class FleetRouter:
                 c.close()
             except OSError:
                 pass
+        # quiesce in-flight handler threads: their finally blocks
+        # release every held kv_snapshot and backend socket, so
+        # stop() returning means the resource census has drained.
+        # Bounded — a handler wedged in a backend read must not hang
+        # shutdown (its daemon thread dies with the process).
+        with self._conns_lock:
+            handlers = list(self._conns.keys())
+        deadline = time.monotonic() + 5.0
+        me = threading.current_thread()
+        for t in handlers:
+            if t is me:
+                continue
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
         with self._pools_lock:
             pools = list(self._pools.values())
             self._pools = {}

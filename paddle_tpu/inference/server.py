@@ -207,9 +207,24 @@ class PredictorServer:
     def __init__(self, run_fn, port=0, host="127.0.0.1",
                  max_body=MAX_BODY_BYTES, recv_timeout=RECV_TIMEOUT,
                  engine=None, own_engine=False, loader=None, prefix=None,
-                 decode_engine=None, own_decode_engine=False):
+                 decode_engine=None, own_decode_engine=False, phase=None):
         self._run = run_fn
         self._engine = engine
+        # phase: this replica's pool in a disaggregated fleet
+        # (wire_spec.REPLICA_PHASES; env default
+        # PADDLE_TPU_SERVING_PHASE). Declared in the cmd-3 health body
+        # (and echoed by cmd 5) so the registry can pool replicas; an
+        # attached decode engine's own phase wins when none is given —
+        # the engine's warmup ladder is the thing the phase shapes.
+        if phase is None:
+            phase = (getattr(decode_engine, "phase", None)
+                     or os.environ.get("PADDLE_TPU_SERVING_PHASE")
+                     or "both")
+        if phase not in wire_spec.REPLICA_PHASES:
+            raise ValueError(
+                f"unknown replica phase {phase!r} (expected one of "
+                f"{wire_spec.REPLICA_PHASES})")
+        self.phase = phase
         # own_engine: this server is the engine's only handle (serve_model
         # builds one per server) and must close it on stop, or its
         # scheduler thread + compiled programs leak per server lifecycle
@@ -324,8 +339,9 @@ class PredictorServer:
         ``decode`` key when a decode engine is attached."""
         _, engine = self._backend()
         stats = {"engine": None} if engine is None else engine.stats()
+        stats = dict(stats)
+        stats["phase"] = self.phase
         if self._decode_engine is not None:
-            stats = dict(stats)
             stats["decode"] = self._decode_engine.stats()
         return json.dumps(stats)
 
@@ -346,6 +362,7 @@ class PredictorServer:
               and (dec is None or dec["ok"]))
         return json.dumps({
             "ok": ok,
+            "phase": self.phase,
             "decode": dec,
             "draining": draining,
             # readiness split (backward-compatible: absent fields mean
@@ -509,6 +526,10 @@ class PredictorServer:
                          + enc)
             return
         t0 = time.perf_counter()
+        if opts.get("handoff"):
+            self._serve_prefill_handoff(conn, dec, inputs, budget,
+                                        trace_id, t0)
+            return
         try:
             req = dec.submit(inputs[0], features=list(inputs[1:]),
                              max_new_tokens=opts.get("max_new_tokens"),
@@ -550,6 +571,61 @@ class PredictorServer:
         self._stream_tokens(
             conn, dec, req, t0, trace_id,
             emit_snapshots=bool(opts.get("snapshot_every")))
+
+    def _serve_prefill_handoff(self, conn, dec, inputs, budget,
+                               trace_id, t0):
+        """cmd-1 with the 0x5C prefill-handoff bit: the disaggregated
+        fleet's prefill leg. Runs ONLY the prefill step — the request
+        is forced to max_new_tokens=1 with snapshot cadence 1 so the
+        engine assembles the n_generated=1 block at the prefill
+        boundary — and replies deterministically with exactly two
+        frames: one status-3 kv-snapshot frame, then the terminal
+        status-0 frame carrying the first token. The router holds the
+        block, forwards the token, and seeds a decode replica over
+        kv_put/kv_resume. A replica that cannot produce the block
+        answers status 2 (retryable) so the leg re-runs elsewhere —
+        never a torn stream, never silent token loss."""
+        try:
+            req = dec.submit(inputs[0], features=list(inputs[1:]),
+                             max_new_tokens=1, token_budget_s=budget,
+                             trace_id=trace_id, snapshot_every=1)
+        except (RetryableError, EngineClosed):
+            self._m_responses.inc(status=str(STATUS_OVERLOADED))
+            self._send_frame(conn, STATUS_OVERLOADED)
+            return
+        except Exception:  # noqa: BLE001 - bad request (shape/dtype)
+            self._m_responses.inc(status=str(STATUS_ERROR))
+            self._send_frame(conn, STATUS_ERROR)
+            return
+        try:
+            tokens = req.result(timeout=self._decode_stream_timeout)
+        except (RetryableError, EngineClosed, TimeoutError):
+            dec.cancel(req)
+            self._m_responses.inc(status=str(STATUS_OVERLOADED))
+            self._send_frame(conn, STATUS_OVERLOADED)
+            return
+        except Exception:  # noqa: BLE001 - protocol error status
+            dec.cancel(req)
+            self._m_responses.inc(status=str(STATUS_ERROR))
+            self._send_frame(conn, STATUS_ERROR)
+            return
+        blob = req.latest_snapshot()
+        if blob is None:
+            # the boundary snapshot was dropped (snapshot assembly is
+            # degraded-never-fatal): without the block there is nothing
+            # to hand off — answer retryable so the router re-runs the
+            # prefill elsewhere or degrades to colocated serving
+            self._m_responses.inc(status=str(STATUS_OVERLOADED))
+            self._send_frame(conn, STATUS_OVERLOADED)
+            return
+        self._send_frame(conn, STATUS_STREAM, blob)
+        self._m_responses.inc(status=str(STATUS_OK))
+        self._send_frame(conn, STATUS_OK, _encode_arrays([tokens]))
+        if trace_id is not None:
+            obs_tracing.record_span(
+                "serving.reply", time.perf_counter() - t0,
+                trace_id=trace_id, port=self.port,
+                tokens=int(tokens.size))
 
     def _stream_tokens(self, conn, dec, req, t0, trace_id,
                        emit_snapshots=False, sent=0):
@@ -614,11 +690,14 @@ class PredictorServer:
             raise
 
     def _serve_kv_put(self, conn, payload):
-        """cmd kv_put: validate-only snapshot preflight against THIS
-        replica (shares ``DecodeEngine.check_snapshot`` with the
-        resume path, so acceptance here can never drift from what a
-        resume actually demands). status 0 echoes the JSON header;
-        a refusal is status 2; a malformed block is status 1."""
+        """cmd kv_put: snapshot preflight against THIS replica
+        (``DecodeEngine.seed_check`` — the identity validation shared
+        with the resume path, so acceptance here can never drift from
+        what a resume actually demands, PLUS a fresh-slot capacity
+        check: a prefill->decode handoff seeds a NEW sequence here, so
+        a replica that cannot admit one now refuses retryable instead
+        of absorbing it). status 0 echoes the JSON header; a refusal
+        is status 2; a malformed block is status 1."""
         dec = self._decode_engine
         if dec is None:
             self._m_responses.inc(status=str(STATUS_ERROR))
@@ -627,7 +706,7 @@ class PredictorServer:
                          + enc)
             return
         try:
-            header, _ = dec.check_snapshot(payload)
+            header, _ = dec.seed_check(payload)
         except (RetryableError, EngineClosed) as e:
             self._m_responses.inc(status=str(STATUS_OVERLOADED))
             enc = str(e).encode("utf-8", errors="replace")
@@ -913,7 +992,7 @@ class PredictorServer:
 def serve_model(path_prefix, port=0, dynamic_batching=False,
                 max_batch_size=32, max_wait_ms=2.0, max_queue=256,
                 warmup=True, metrics_port=None, quant=None, mesh=None,
-                **engine_kwargs):
+                phase=None, **engine_kwargs):
     """Load a jit-saved model and serve it (the C API's server side).
 
     With ``dynamic_batching=True`` (needs a batch-polymorphic save, see
@@ -961,6 +1040,15 @@ def serve_model(path_prefix, port=0, dynamic_batching=False,
     load is pinned, so a reload can never silently flip a replica's
     topology. Unset = serve whatever the save recorded (or
     single-chip).
+
+    ``phase`` (env default ``PADDLE_TPU_SERVING_PHASE``) declares the
+    replica's pool in a disaggregated prefill/decode fleet
+    (``"prefill"`` | ``"decode"`` | ``"both"``; README "Disaggregated
+    serving"): reported in the cmd-3 health body so a phase-pooled
+    ``Fleet`` routes prompt ingestion and token generation to the
+    right pool. Placement only — the replica still serves every
+    command, so a fleet whose other pool collapsed degrades to
+    colocated serving here.
 
     The returned server supports the ``reload`` wire command (cmd 4):
     re-save the model to the same (or a new) prefix and issue a reload
@@ -1038,7 +1126,8 @@ def serve_model(path_prefix, port=0, dynamic_batching=False,
         engine.warmup()
     server = PredictorServer(run, port=port, engine=engine,
                              own_engine=engine is not None,
-                             loader=loader, prefix=path_prefix)
+                             loader=loader, prefix=path_prefix,
+                             phase=phase)
     if metrics_port is not None:
         from ..obs.httpd import MetricsServer
 

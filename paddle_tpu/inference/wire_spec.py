@@ -183,9 +183,12 @@ COMMANDS = {
         "engine)"),
     3: WireCommand(
         3, "health", "(empty)",
-        "status 0 + UTF-8 JSON liveness/readiness body",
+        "status 0 + UTF-8 JSON liveness/readiness body (its `phase` "
+        "key declares the replica's pool: prefill | decode | both; "
+        "absent means both)",
         "liveness + readiness probe (accepting / draining_deadline_s "
-        "announce drains; absent fields mean accepting)"),
+        "announce drains; absent fields mean accepting; `phase` drives "
+        "the router's disaggregated prefill/decode placement)"),
     4: WireCommand(
         4, "reload", "optional UTF-8 model prefix (empty = same)",
         "status 0 + UTF-8 JSON, or status 1 + error text",
@@ -194,7 +197,8 @@ COMMANDS = {
         "compiles (serve_model servers only; the router refuses it)"),
     5: WireCommand(
         5, "stats", "(empty)",
-        "status 0 + UTF-8 JSON engine counters",
+        "status 0 + UTF-8 JSON engine counters (decode engines echo "
+        "their `phase` alongside the counters)",
         "batching/decode engine counters (per-bucket compiles/hits/"
         "latency, breaker states, queue depth, shed counts)"),
     6: WireCommand(
@@ -270,8 +274,12 @@ MARKERS = {
                      "u64 decode opts: low 32 bits max_new_tokens, "
                      "bits 32-47 snapshot cadence (emit a kv-snapshot "
                      "frame every N generated tokens; 0 = never), "
-                     "bit 63 one-shot (collect the whole sequence into "
-                     "a single reply instead of a chunk stream)"),
+                     "bit 62 prefill-handoff (run ONLY the prefill "
+                     "step and reply with one status-3 kv-snapshot "
+                     "frame then the terminal token frame — the "
+                     "router's disaggregated prefill leg), bit 63 "
+                     "one-shot (collect the whole sequence into a "
+                     "single reply instead of a chunk stream)"),
 }
 
 MARKER_BY_NAME = {m.name: m for m in MARKERS.values()}
@@ -289,6 +297,25 @@ DECODE_ONESHOT_BIT = 1 << DECODE_ONESHOT_BIT_SHIFT
 #: kv-snapshot frame every N generated tokens; 0 disables).
 DECODE_SNAPSHOT_EVERY_SHIFT = 32
 DECODE_SNAPSHOT_EVERY_MASK = 0xFFFF
+
+#: Bit 62 of the decode field's u64: prefill handoff. The server runs
+#: ONLY the prefill step (max_new_tokens is forced to 1) and replies
+#: deterministically with exactly two frames: one status-3 kv-snapshot
+#: frame at n_generated=1, then the terminal status-0 frame carrying
+#: the first token. The fleet router's disaggregated prefill leg — a
+#: snapshot handed to a decode replica over kv_put/kv_resume continues
+#: the stream bitwise-identically to colocated serving.
+DECODE_HANDOFF_BIT_SHIFT = 62
+DECODE_HANDOFF_BIT = 1 << DECODE_HANDOFF_BIT_SHIFT
+
+#: Replica phases a server may declare in its cmd-3 health body (and
+#: echo in cmd-5 stats): a `prefill` replica is placed for prompt
+#: ingestion (large prompt buckets), a `decode` replica for token
+#: generation (many KV slots), `both` serves colocated. Phase is a
+#: PLACEMENT attribute: every phase still serves every command, so a
+#: fleet whose other pool collapsed can degrade to colocated serving
+#: on the survivors instead of failing requests.
+REPLICA_PHASES = ("prefill", "decode", "both")
 
 #: First payload byte of a kv-snapshot block (and of the status-3
 #: snapshot frames that carry one). A token chunk's first payload byte
@@ -407,7 +434,9 @@ IMPLEMENTATIONS = {
         partial="no tenant field (point WithEndpoints at the fleet "
                 "router, which stamps tenancy at admission); no KV "
                 "snapshot/resume commands (stream resume is "
-                "router-internal — clients never see a snapshot frame)"),
+                "router-internal — clients never see a snapshot frame); "
+                "no health command, so the replica phase field is not "
+                "yet covered (phase-aware placement is fleet-internal)"),
     "r-client": Implementation(
         "r-client", "r", "clients/r/predictor.R",
         commands=frozenset({CMD_INFER}),
@@ -416,8 +445,9 @@ IMPLEMENTATIONS = {
         dtypes=frozenset(DTYPES),
         streaming=True,
         partial="read-only stream path (pd_decode_stream sends i32 "
-                "prompts only), no tenant field, and no KV "
-                "snapshot/resume commands (router-internal)"),
+                "prompts only), no tenant field, no KV snapshot/resume "
+                "commands (router-internal), and no health command so "
+                "the replica phase field is not yet covered"),
     "c-client": Implementation(
         "c-client", "c++", "paddle_tpu/native/c_api.cc",
         commands=frozenset({CMD_INFER, CMD_HEALTH}),
@@ -428,7 +458,9 @@ IMPLEMENTATIONS = {
         partial="no tenant field and no reload/stats/metrics/drain/"
                 "kv_put/kv_resume commands (operational and "
                 "fleet-internal commands belong to the fleet tooling, "
-                "not the embedded client)"),
+                "not the embedded client); the health body's replica "
+                "phase field is not yet covered (parsed as opaque "
+                "JSON — phase-aware placement is fleet-internal)"),
 }
 
 # ------------------------------------------------------ codec (Python)
@@ -500,13 +532,16 @@ def encode_tenant(tenant_id):
     return struct.pack("<BQ", TENANT_MARKER, int(tenant_id))
 
 
-def encode_decode_opts(max_new_tokens, oneshot=False, snapshot_every=0):
+def encode_decode_opts(max_new_tokens, oneshot=False, snapshot_every=0,
+                       handoff=False):
     """The optional trailing decode field (marker 0x5C + u64: low 32
-    bits max_new_tokens, bits 32-47 snapshot cadence, bit 63
-    one-shot)."""
+    bits max_new_tokens, bits 32-47 snapshot cadence, bit 62
+    prefill-handoff, bit 63 one-shot)."""
     val = int(max_new_tokens) & 0xFFFFFFFF
     val |= (int(snapshot_every) & DECODE_SNAPSHOT_EVERY_MASK) \
         << DECODE_SNAPSHOT_EVERY_SHIFT
+    if handoff:
+        val |= DECODE_HANDOFF_BIT
     if oneshot:
         val |= DECODE_ONESHOT_BIT
     return struct.pack("<BQ", DECODE_MARKER, val)
@@ -519,7 +554,8 @@ FIELD_ENCODERS = {
     "tenant": encode_tenant,
     "decode": lambda v: encode_decode_opts(
         v & 0xFFFFFFFF, bool(v & DECODE_ONESHOT_BIT),
-        (v >> DECODE_SNAPSHOT_EVERY_SHIFT) & DECODE_SNAPSHOT_EVERY_MASK),
+        (v >> DECODE_SNAPSHOT_EVERY_SHIFT) & DECODE_SNAPSHOT_EVERY_MASK,
+        bool(v & DECODE_HANDOFF_BIT)),
 }
 
 
@@ -552,6 +588,7 @@ def decode_request(payload):
             decode_opts = {
                 "max_new_tokens": int(val & 0xFFFFFFFF) or None,
                 "oneshot": bool(val & DECODE_ONESHOT_BIT),
+                "handoff": bool(val & DECODE_HANDOFF_BIT),
                 "snapshot_every": int(
                     (val >> DECODE_SNAPSHOT_EVERY_SHIFT)
                     & DECODE_SNAPSHOT_EVERY_MASK),
@@ -669,6 +706,7 @@ def decode_kv_resume(payload):
             decode_opts = {
                 "max_new_tokens": int(val & 0xFFFFFFFF) or None,
                 "oneshot": bool(val & DECODE_ONESHOT_BIT),
+                "handoff": bool(val & DECODE_HANDOFF_BIT),
                 "snapshot_every": int(
                     (val >> DECODE_SNAPSHOT_EVERY_SHIFT)
                     & DECODE_SNAPSHOT_EVERY_MASK),

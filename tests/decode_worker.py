@@ -22,6 +22,9 @@ bench and the serving tests drive. Env:
     DECODE_WORKER_WARM        1 = warm the ladder before PORT prints
     DECODE_WORKER_QUANT       serving quant mode ("w8" | "bf16w";
                               empty = f32)
+    DECODE_WORKER_PHASE       replica pool ("prefill" | "decode";
+                              empty = both) — shapes the warmup
+                              ladder and the health/stats phase field
     DECODE_WORKER_MESH        serving mesh descriptor ("tp2", ...;
                               empty = single-chip). The spawner must
                               also export an XLA device count >= the
@@ -160,6 +163,7 @@ def main():
         model,
         quant=os.environ.get("DECODE_WORKER_QUANT") or None,
         mesh=os.environ.get("DECODE_WORKER_MESH") or None,
+        phase=os.environ.get("DECODE_WORKER_PHASE") or None,
         max_slots=_env_int("DECODE_WORKER_MAX_SLOTS", 8),
         max_seq_len=_env_int("DECODE_WORKER_MAX_SEQ", 64),
         max_prompt_len=_env_int("DECODE_WORKER_MAX_PROMPT", 16),

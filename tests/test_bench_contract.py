@@ -371,6 +371,60 @@ class TestDecodeContract:
         assert rec["weight_bytes_ratio"] == pytest.approx(2.0)
         assert rec["streams"] > 0 and rec["single_streams"] > 0
 
+    @pytest.mark.slow  # eight phase-replica subprocesses + storms
+    @pytest.mark.disagg  # ci_gate --disagg runs this as its own stage
+    def test_disagg_mode_metric_fields(self):
+        """`bench.py disagg` (ISSUE 18 acceptance): the mixed
+        long/short-prompt storm A/B colocated vs disaggregated must
+        report p99 inter-token latency under prefill bursts for both
+        sides, prove the disaggregated side actually handed off, and
+        hard-fail (inside the bench) on any non-retryable client
+        error, torn stream, or duplicate/lost token across the
+        per-pool SIGKILL chaos arm and the pool-at-zero degraded arm.
+        The ratio's DIRECTION is not asserted: on the toy CPU model
+        the handoff round-trip can outweigh the trivial prefill work
+        it offloads — the structural contracts are the acceptance."""
+        r = _run({"JAX_PLATFORMS": "cpu", "BENCH_DISAGG_SECS": "2.0",
+                  "BENCH_DISAGG_CLIENTS": "6"},
+                 timeout=540, argv=("disagg",))
+        assert r.returncode == 0, r.stderr[-1500:]
+        rec = _one_json_line(r.stdout)
+        assert rec["metric"] == \
+            "serving_decode_p99_intertoken_ms_under_prefill_bursts"
+        assert rec["unit"] == "ms"
+        assert rec["value"] == rec["p99_intertoken_ms"] > 0
+        assert rec["colocated_p99_intertoken_ms"] > 0
+        # vs_baseline = colocated p99 / disaggregated p99 under the
+        # same bursts (lower-is-better metric, so >1 = disagg wins)
+        assert rec["vs_baseline"] == pytest.approx(
+            rec["colocated_p99_intertoken_ms"]
+            / rec["p99_intertoken_ms"], rel=1e-3)
+        assert rec["prefill_replicas"] == rec["decode_replicas"] == 2
+        assert rec["tokens_per_sec"] > 0
+        assert rec["colocated_tokens_per_sec"] > 0
+        assert rec["streams"] > 0 and rec["colocated_streams"] > 0
+        # the bursts actually exercised prefill on BOTH sides
+        assert rec["burst_admissions"] > 0
+        assert rec["colocated_burst_admissions"] > 0
+        # the disaggregated side really disaggregated
+        assert rec["handoffs_ok"] > 0
+        assert rec["handoffs_failed"] == 0
+        # chaos arm: one SIGKILL per pool, zero client-visible damage
+        ch = rec["chaos"]
+        assert len(ch["killed"]) == 2
+        assert ch["killed_decode_inflight"] >= 1
+        assert ch["resumes_ok"] >= 1
+        assert ch["client_visible_nonretryable"] == 0
+        assert ch["duplicate_or_lost_tokens"] == 0
+        assert ch["bitwise_ok_vs_solo"] is True
+        assert ch["ok_streams"] + ch["retryable_sheds"] \
+            == ch["streams"] == 12
+        # degraded arm: decode pool at zero stays byte-identical and
+        # is counted
+        assert rec["degraded"]["degraded_count"] >= 1
+        assert rec["degraded"]["bitwise_vs_solo"] is True
+        assert rec["smoke"] is True
+
     @pytest.mark.slow  # nine decode-replica subprocesses + storms
     @pytest.mark.decode
     @pytest.mark.quant  # ci_gate --decode runs 'decode or quant'

@@ -140,6 +140,62 @@ def test_kv_command_tables_green(tmp_path):
     assert not [d for d in diags if d.code == "TPU404"], diags
 
 
+def test_phase_field_dropped_from_health_is_tpu411(tmp_path):
+    """PR 18 regression (red): a server that declares the health
+    command but stops surfacing the replica phase field — without
+    declaring the gap in its partial text — fails the phase-coverage
+    check by name."""
+    fix = tmp_path / "server_nophase.py"
+    fix.write_text(
+        "CMD_INFER = 1\nCMD_HEALTH = 3\nCMD_RELOAD = 4\nCMD_STATS = 5\n"
+        "CMD_METRICS = 6\nCMD_STOP = 7\nCMD_DRAIN = 8\n"
+        "CMD_KV_PUT = 9\nCMD_KV_RESUME = 10\n")
+    diags = protocol.check_protocol(files={"python-server": str(fix)},
+                                    taxonomy=False)
+    assert any(d.code == "TPU411" and "python-server" in d.message
+               and "phase" in d.message for d in diags), diags
+
+
+def test_phase_without_enum_validation_is_tpu411(tmp_path):
+    """PR 18 regression (red): the Python server emitting a phase
+    string without validating it against wire_spec.REPLICA_PHASES is
+    its own finding — the fleet routes and scales by that string."""
+    fix = tmp_path / "server_novalidate.py"
+    fix.write_text(
+        "CMD_HEALTH = 3\n"
+        "def health():\n"
+        "    return {'phase': 'prefill'}\n")
+    diags = protocol.check_protocol(files={"python-server": str(fix)},
+                                    taxonomy=False)
+    hits = [d for d in diags if d.code == "TPU411"]
+    assert any("REPLICA_PHASES" in d.message for d in hits), diags
+    assert not any("never references" in d.message for d in hits), diags
+
+
+def test_phase_covered_and_validated_is_green(tmp_path):
+    """Green twin: phase surfaced + enum-validated raises no TPU411
+    (the real tree's green run is test_real_tree_is_green; this pins
+    the rule itself, independent of the live server's other content)."""
+    fix = tmp_path / "server_phase_ok.py"
+    fix.write_text(
+        "from paddle_tpu.inference.wire_spec import REPLICA_PHASES\n"
+        "CMD_HEALTH = 3\n"
+        "def health(phase):\n"
+        "    assert phase in REPLICA_PHASES\n"
+        "    return {'phase': phase}\n")
+    diags = protocol.check_protocol(files={"python-server": str(fix)},
+                                    taxonomy=False)
+    assert not [d for d in diags if d.code == "TPU411"], diags
+
+
+def test_declared_phase_gap_suppresses_tpu411():
+    """A client whose partial text declares the phase gap (the C
+    client: health body parsed as opaque JSON) is a documented partial
+    implementation, not drift — no TPU411 on the real tree's clients."""
+    diags = protocol.check_protocol(taxonomy=False)
+    assert not [d for d in diags if d.code == "TPU411"], diags
+
+
 def test_go_scanner_ignores_unrelated_compares_and_switches(tmp_path):
     """Review regression: only `resp[0] == N` records a status (not a
     second compare sharing the line) and only cases of a switch over

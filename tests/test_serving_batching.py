@@ -668,7 +668,10 @@ class TestServerWire:
     def test_stats_without_engine(self):
         server = PredictorServer(lambda *arrays: list(arrays))
         try:
-            assert _stats_over_wire(server.port) == {"engine": None}
+            # phase rides along even engine-less (README "Disaggregated
+            # serving"): every server declares its pool placement
+            assert _stats_over_wire(server.port) == {
+                "engine": None, "phase": "both"}
         finally:
             server.stop()
 

@@ -583,6 +583,66 @@ def test_sharded_double_run_guard_narrows_tier1_and_fleet():
     assert captured["args"][1] == mod.FLEET_PYTEST_ARGS
 
 
+def test_disagg_stage_gates(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text(GOOD_SRC)
+    bad = tmp_path / "test_disagg_fail.py"
+    bad.write_text(
+        "import pytest\n"
+        "pytestmark = pytest.mark.disagg\n"
+        "def test_boom():\n    assert False\n")
+    r = _run(["--paths", str(good), "--skip-tests", "--disagg",
+              "--disagg-args",
+              f"{bad} -q -m disagg -p no:cacheprovider"])
+    assert r.returncode == 1
+    s = _summary(r)
+    assert s["disagg_run"] and not s["disagg_ok"]
+    assert "+disagg" in s["gate"]
+    ok = tmp_path / "test_disagg_ok.py"
+    ok.write_text(
+        "import pytest\n"
+        "pytestmark = pytest.mark.disagg\n"
+        "def test_fine():\n    assert True\n")
+    r = _run(["--paths", str(good), "--skip-tests", "--disagg",
+              "--disagg-args",
+              f"{ok} -q -m disagg -p no:cacheprovider"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert _summary(r)["disagg_ok"]
+
+
+def test_disagg_summary_keys_present_when_not_run(tmp_path):
+    f = tmp_path / "good.py"
+    f.write_text(GOOD_SRC)
+    r = _run(["--paths", str(f), "--skip-tests"])
+    s = _summary(r)
+    assert s["disagg_run"] is False and s["disagg_ok"] is True
+
+
+def test_disagg_double_run_guard_narrows_tier1():
+    """With --disagg, tier-1 excludes the disagg marker (the stage owns
+    -m disagg, including its slow bench contract) and the stage runs
+    the full DISAGG_PYTEST_ARGS selection."""
+    mod = _gate_module()
+    captured = {}
+
+    def fake_capturing(args):
+        captured.setdefault("args", []).append(args)
+        return 1, mod.load_known_failures()
+
+    mod.run_pytest = lambda args: (
+        captured.setdefault("args", []).append(args) or 0)
+    mod.run_pytest_capturing_failures = fake_capturing
+    mod.run_tracelint = lambda *a, **k: ({"errors": 0, "warnings": 0,
+                                          "findings": []}, 0)
+    mod.audit_suppressions = lambda *a, **k: ([], [])
+    rc = mod.main(["--disagg"])
+    assert rc == 0
+    tier1 = captured["args"][0]
+    assert "not disagg" in tier1 and "not slow" in tier1
+    assert captured["args"][1] == mod.DISAGG_PYTEST_ARGS
+    assert "-m disagg" in mod.DISAGG_PYTEST_ARGS
+
+
 def test_serialize_subsystem_is_suppression_free():
     """The artifact-store subsystem is a clean zone (DEFAULT_CLEAN_PATHS):
     no inline tracelint suppressions under paddle_tpu/serialize."""

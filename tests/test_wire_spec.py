@@ -144,7 +144,8 @@ def test_every_dtype_x_field_order_permutation_roundtrips():
                 if "decode" in perm:
                     assert opts == {"max_new_tokens": 17,
                                     "oneshot": True,
-                                    "snapshot_every": 0}
+                                    "snapshot_every": 0,
+                                    "handoff": False}
                 else:
                     assert opts is None
                 count += 1

@@ -6,7 +6,8 @@ Usage:
     python tools/ci_gate.py [--paths paddle_tpu]
         [--skip-tests] [--pytest-args "tests/ -q -m 'not slow'"]
         [--disable TPU005,...] [--chaos] [--serving] [--serving-chaos]
-        [--elastic] [--artifacts] [--fleet] [--decode] [--perfproxy]
+        [--elastic] [--artifacts] [--fleet] [--decode] [--disagg]
+        [--perfproxy]
         [--concurrency] [--protocol] [--protocol-impl NAME=PATH]
         [--resources]
         [--clean-paths paddle_tpu/resilience paddle_tpu/inference
@@ -63,7 +64,13 @@ solo-vs-batch per mesh, the multi-process gloo mesh over the PR 9
 launcher, mesh fail-fasts, and the ``bench.py sharded`` contract),
 with the same compositional tier-1 exclusion — and when ``--fleet``
 runs too, the fleet stage narrows to ``fleet and not sharded`` so the
-dual-marked router-relay case runs once. ``--perfproxy``
+dual-marked router-relay case runs once. ``--disagg`` adds a stage
+running the disaggregated prefill/decode serving suite (``-m disagg``:
+phase-pool routing + handoff bitwise equivalence, prefill-death retry
+and decode-death resume chaos, pool-at-zero degradation, per-pool
+autoscaler isolation, handoff metrics exposition, and the slow
+``bench.py disagg`` storm contract), with the same compositional
+tier-1 double-run exclusion. ``--perfproxy``
 adds a stage running ``bench.py perfproxy`` on CPU against the
 committed PERFPROXY_BASELINE.json — compile counts, HLO op counts, and
 cost-analysis FLOPs must match, so single-chip perf can't silently rot
@@ -146,6 +153,12 @@ DECODE_PYTEST_ARGS = "tests/ -q -m 'decode or quant' -p no:cacheprovider"
 # the `bench.py sharded` contract — subprocess-heavy (sharded engines
 # need more devices than the tier-1 process has), so it owns a stage
 SHARDED_PYTEST_ARGS = "tests/ -q -m sharded -p no:cacheprovider"
+# the disaggregated prefill/decode serving suite: phase-pool routing,
+# handoff retry + pool-loss degradation chaos, per-pool autoscaler
+# isolation, handoff metrics exposition, and the `bench.py disagg`
+# contract — subprocess-heavy (one replica process per pool member),
+# so it owns a stage
+DISAGG_PYTEST_ARGS = "tests/ -q -m disagg -p no:cacheprovider"
 # subsystems that must stay suppression-free: resilience (PR 2), the
 # serving stack (PRs 4-5), the telemetry layer (PR 7), and the analyzer
 # itself (PR 8) fix findings instead of silencing them. One carve-out:
@@ -520,6 +533,14 @@ def main(argv=None):
                          "round trips, multi-process gloo mesh, "
                          "sharded bench contract)")
     ap.add_argument("--sharded-args", default=SHARDED_PYTEST_ARGS)
+    ap.add_argument("--disagg", action="store_true",
+                    help="also run the disaggregated prefill/decode "
+                         "serving suite (-m disagg: phase-pool routing "
+                         "+ handoff equivalence, handoff-retry and "
+                         "pool-loss chaos, per-pool autoscaler "
+                         "isolation, handoff metrics, disagg bench "
+                         "contract)")
+    ap.add_argument("--disagg-args", default=DISAGG_PYTEST_ARGS)
     ap.add_argument("--known-failures", default=KNOWN_FAILURES_FILE,
                     help="JSON file naming the committed pre-existing "
                          "tier-1 failures the stage diffs against")
@@ -591,6 +612,8 @@ def main(argv=None):
                 excl.append("quant")
             if ns.sharded:
                 excl.append("sharded")
+            if ns.disagg:
+                excl.append("disagg")
             if excl:
                 pytest_args = pytest_args.replace(
                     "'not slow'",
@@ -662,6 +685,10 @@ def main(argv=None):
     if ns.sharded:
         sharded_ok = run_pytest(ns.sharded_args) == 0
 
+    disagg_ok = True
+    if ns.disagg:
+        disagg_ok = run_pytest(ns.disagg_args) == 0
+
     perfproxy_ok = True
     if ns.perfproxy:
         perfproxy_ok = run_perfproxy() == 0
@@ -699,6 +726,7 @@ def main(argv=None):
                  + ("+fleet" if ns.fleet else "")
                  + ("+decode" if ns.decode else "")
                  + ("+sharded" if ns.sharded else "")
+                 + ("+disagg" if ns.disagg else "")
                  + ("+perfproxy" if ns.perfproxy else "")
                  + ("+concurrency" if ns.concurrency else "")
                  + ("+protocol" if ns.protocol else "")
@@ -730,6 +758,8 @@ def main(argv=None):
         "decode_run": bool(ns.decode),
         "sharded_ok": sharded_ok,
         "sharded_run": bool(ns.sharded),
+        "disagg_ok": disagg_ok,
+        "disagg_run": bool(ns.disagg),
         "perfproxy_ok": perfproxy_ok,
         "perfproxy_run": bool(ns.perfproxy),
         "concurrency_ok": concurrency_ok,
@@ -748,8 +778,8 @@ def main(argv=None):
     if not (lint_ok and audit_ok and tests_ok and chaos_ok
             and serving_ok and serving_chaos_ok and elastic_ok
             and artifacts_ok and fleet_ok and decode_ok and sharded_ok
-            and perfproxy_ok and concurrency_ok and protocol_ok
-            and resources_ok):
+            and disagg_ok and perfproxy_ok and concurrency_ok
+            and protocol_ok and resources_ok):
         print("ci_gate: FAILED", file=sys.stderr)
         return 1
     return 0
