@@ -113,6 +113,17 @@ KINDS = {k.name: k for k in (
         "`signal.signal(...)` / `PreemptionHandler.install()`",
         "`PreemptionHandler.uninstall()` restores the saved handlers",
         flows=False),
+    ResourceKind(
+        "kv_page", "refcounted KV-cache page (COW prefix sharing)",
+        "`_KVSlots._page_alloc()` (refcount 1; `retain_page` bumps)",
+        "`_KVSlots._page_reclaim(page)` when the refcount hits 0",
+        flows=False),
+    ResourceKind(
+        "prefix_entry", "content-addressed prefix-cache entry "
+        "(retains its kv pages)",
+        "`PrefixCache._hold(key)` on insert",
+        "`PrefixCache._drop(key)` on evict / clear",
+        flows=False),
 )}
 
 # The declaration comment syntax. Parsed from real comments only
@@ -328,10 +339,12 @@ def build_model(sources):
 
         visit(tree.body, None, "")
         for line in sorted(set(decls) - claimed):
+            acq, rel = decls[line]
+            kinds = ", ".join(sorted(acq | rel))
             errors.append((filename, line,
-                           "misplaced tpu-resource declaration: must sit "
-                           "on (or immediately above) the def it "
-                           "declares"))
+                           f"misplaced tpu-resource declaration "
+                           f"({kinds}): must sit on (or immediately "
+                           "above) the def it declares"))
         model.errors.extend(errors)
     # self-attribute types, now that every class is known
     known = set(model.by_class) | set(model.class_bases)

@@ -9,6 +9,11 @@ kind (see ``resmodel.KINDS``) are wrapped with per-kind live-handle
 registries:
 
 - ``kv_slot``        — ``decode._KVSlots.alloc`` / ``.release``
+- ``kv_page``        — ``decode._KVSlots._page_alloc`` /
+  ``_page_reclaim`` (refcounted COW pages: retain/drop are refcount
+  moves on one live handle; reclaim at zero retires it)
+- ``prefix_entry``   — ``prefix_cache.PrefixCache._hold`` / ``_drop``
+  (each entry retains the kv pages of one cached prefix)
 - ``router_socket``  — ``router.FleetRouter._conn_open`` /
   ``_pool_get`` / ``_pool_put`` / ``_conn_close``
 - ``kv_snapshot``    — ``router.FleetRouter._snap_hold`` /
@@ -129,7 +134,7 @@ def _releasing(kind, key_of, strict=True):
 
 
 def _install_patches():
-    from paddle_tpu.inference import decode, fleet, router
+    from paddle_tpu.inference import decode, fleet, prefix_cache, router
     from paddle_tpu.resilience import preemption
     from paddle_tpu.serialize import artifact_store
 
@@ -138,6 +143,23 @@ def _install_patches():
         "kv_slot", lambda a, out: None if out is None else (id(a[0]), out)))
     _wrap(decode._KVSlots, "release", _releasing(
         "kv_slot", lambda a, out: (id(a[0]), a[1])))
+
+    # kv_page: refcounted COW pages — a handle lives from _page_alloc
+    # (refcount 1) to _page_reclaim (refcount 0); retain/drop cycles
+    # in between are refcount moves on the SAME live handle, so a
+    # shared page released by every holder exactly once drains to a
+    # zero census and a double-reclaim is a recorded violation
+    _wrap(decode._KVSlots, "_page_alloc", _acquiring(
+        "kv_page", lambda a, out: (id(a[0]), out)))
+    _wrap(decode._KVSlots, "_page_reclaim", _releasing(
+        "kv_page", lambda a, out: (id(a[0]), a[1])))
+
+    # prefix_entry: content-addressed cache entries (each retains its
+    # kv pages; insert/evict/clear are the only transitions)
+    _wrap(prefix_cache.PrefixCache, "_hold", _acquiring(
+        "prefix_entry", lambda a, out: (id(a[0]), a[1])))
+    _wrap(prefix_cache.PrefixCache, "_drop", _releasing(
+        "prefix_entry", lambda a, out: (id(a[0]), a[1])))
 
     # router_socket: checkout/return of one socket object
     _wrap(router.FleetRouter, "_conn_open", _acquiring(

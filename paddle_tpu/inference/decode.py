@@ -108,10 +108,48 @@ bits of the 0x5C field); snapshot assembly failures degrade to "no
 resume point", never to a failed stream (chaos sites
 ``serving.decode.snapshot`` / ``serving.decode.resume``).
 
+**KV reuse ladder (PR 19).** Two rungs on top of the substrate above,
+both compiled to the same fixed (phase, rows, seq) program ladder —
+never data-dependent shapes. (a) *Content-addressed prefix caching*
+(:mod:`prefix_cache`): token prefixes hash at page-aligned boundaries
+(pages = ``min_seq_bucket`` tokens; chain hashes, so every boundary of
+a prompt costs one linear pass) and the KV pages of hot prefixes live
+once in the refcounted page pool of :class:`_KVSlots`. A hit installs
+the cached pages into a fresh slot by reference — copy-on-write: a
+slot writing into a shared page clones it first, and release
+decrements, never frees, a page another sequence (or the cache)
+holds — so model programs run only over the uncached suffix, fed
+token-by-token through the already-warm step rungs. To keep the PR 12
+bitwise contract, EVERY emitted first token comes from step-shaped
+math: a cold prefill gains one *finishing step* (re-feeding the last
+prompt token at its position — the KV row it writes is bitwise equal
+to the prefill program's, and its logits are bitwise equal to the
+prefill logits on this jaxlib), which is the identical computation the
+prefix-hit path's last suffix step performs — hit-vs-cold token
+equality holds by construction, not by tolerance. (b) *Speculative
+decoding*: a cheap ``DecodeModel.draft`` companion proposes k-1
+tokens per iteration and the target verifies all k positions in ONE
+batched ``verify`` program — k unrolled step_fn iterations fused in
+one jit, bitwise equal per position to k sequential step dispatches —
+so greedy accept/reject emits exactly the tokens non-speculative
+greedy would (rejected runs roll back by committing only accepted KV
+entries; the gathered device buffers are donated scratch). Clients
+opt in per request (wire 0x5C bit 61); non-opted streams are
+byte-identical.
+
 Env knobs (constructor kwargs override):
     PADDLE_TPU_DECODE_SNAPSHOT_EVERY   default snapshot cadence in
                                        generated tokens (0 = never;
                                        requests override per-sequence)
+    PADDLE_TPU_PREFIX_DIR              persistent prefix-cache tier
+                                       (artifact-store layout; unset =
+                                       in-memory tier only)
+    PADDLE_TPU_PREFIX_MAX_BYTES        prefix-cache byte budget
+                                       (default 256 MiB)
+    PADDLE_TPU_PREFIX_DISABLE          "1" disables prefix caching
+    PADDLE_TPU_SPEC_K                  speculative tokens per verify
+                                       (k >= 2 enables speculation on
+                                       draft-equipped engines; 0 = off)
     PADDLE_TPU_DECODE_MAX_SLOTS        concurrent sequences (default 8)
     PADDLE_TPU_DECODE_MAX_SEQ_LEN      prompt+generated cap (default 256)
     PADDLE_TPU_DECODE_MAX_QUEUE        bounded wait queue (default 64)
@@ -143,6 +181,7 @@ from ..resilience.retry import _env_float, _env_int
 from ..serialize import artifact_store as _artifacts
 from . import sharding as _sharding
 from . import wire_spec as _wire_spec
+from .prefix_cache import PrefixCache, feature_seed, prefix_hashes
 from ..serialize.export import (canonical_module_bytes, deserialize_exported,
                                 model_fingerprint, serialize_exported)
 from .batching import (BucketQuarantined, DeadlineExceeded, EngineClosed,
@@ -199,11 +238,18 @@ class DecodeModel:
     None = f32). It rides in every program ArtifactKey, ledger event,
     and compile metric, and folds into the lazy fingerprint — a
     quantized decode ladder never collides with the f32 one in the
-    artifact store."""
+    artifact store.
+
+    ``draft``: an optional companion DecodeModel for speculative
+    decoding — a much cheaper model over the SAME vocab and
+    feature_spec (its kv_spec may differ freely). The engine drives it
+    through its own program ladder and KV pool; greedy output stays
+    bitwise-equal to decoding without it, so a draft can only ever buy
+    speed, never change tokens."""
 
     def __init__(self, params, prefill_fn, step_fn, kv_spec, vocab_size,
                  feature_spec=(), eos_token_id=None, fingerprint=None,
-                 quant=None):
+                 quant=None, draft=None):
         self.params = list(params)
         self.prefill_fn = prefill_fn
         self.step_fn = step_fn
@@ -216,6 +262,7 @@ class DecodeModel:
                              else int(eos_token_id))
         self._fingerprint = fingerprint
         self.quant = quant
+        self.draft = draft
 
 
 class _Programs:
@@ -227,11 +274,18 @@ class _Programs:
     bucket ride in the signature) with the same single-flight /
     verify-then-quarantine / degrade-to-inline semantics."""
 
-    def __init__(self, model, store=None, mesh=None):
+    def __init__(self, model, store=None, mesh=None, spec_k=0):
         import jax
 
         self._jax = jax
         self._model = model
+        # k for the "verify" phase: one program checks k speculative
+        # positions per dispatch — k unrolled step_fn iterations fused
+        # in one jit, each reading the KV entries the previous ones
+        # wrote. Bitwise equal per position to k sequential step
+        # dispatches (measured on this jaxlib: the per-position math
+        # is the step program's, only the dispatch boundary moves).
+        self._spec_k = int(spec_k)
         self._store = store if store is not None \
             else _artifacts.default_store()
         self._warmup_wait_s = _env_float(
@@ -321,7 +375,12 @@ class _Programs:
         # ("decode:<phase>", (seq,)) keys them unambiguously alongside
         # the kv/feature avals
         m = self._model
-        sig = ((f"decode:{phase}", (int(seq),)),)
+        if phase == "verify":
+            # k is part of the program's identity: a k=3 verify ladder
+            # never collides with a k=4 one in the store
+            sig = (("decode:verify", (int(seq), self._spec_k)),)
+        else:
+            sig = ((f"decode:{phase}", (int(seq),)),)
         sig += tuple((str(dt), tr) for tr, dt in m.kv_spec)
         sig += tuple((str(dt), tr) for tr, dt in m.feature_spec)
         sig += ((f"vocab{m.vocab_size}", ()),)
@@ -338,6 +397,11 @@ class _Programs:
         if phase == "prefill":
             specs = [jax.ShapeDtypeStruct((rows, seq), i32),   # tokens
                      jax.ShapeDtypeStruct((rows,), i32)]       # lengths
+        elif phase == "verify":
+            specs = [jax.ShapeDtypeStruct((rows, self._spec_k), i32),
+                     jax.ShapeDtypeStruct((rows,), i32)]       # start pos
+            specs += [jax.ShapeDtypeStruct((rows, seq) + tr, dt)
+                      for tr, dt in m.kv_spec]
         else:
             specs = [jax.ShapeDtypeStruct((rows,), i32),       # tokens
                      jax.ShapeDtypeStruct((rows,), i32)]       # positions
@@ -349,11 +413,46 @@ class _Programs:
 
     def _flat_fn(self, phase):
         m = self._model
+        if phase == "verify":
+            return self._verify_fn()
 
         def flat(param_list, *args):
             fn = m.prefill_fn if phase == "prefill" else m.step_fn
             out = fn(param_list, *args)
             return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+        return flat
+
+    def _verify_fn(self):
+        """The batched speculative-verify program, auto-derived from
+        the model's step_fn: k unrolled step-shaped iterations in ONE
+        jit. Iteration i feeds ``tokens[:, i]`` at ``positions + i``
+        against the KV written so far (the incoming gathered buffers
+        plus the i entries the earlier iterations produced — committed
+        in-program with the same ``.at[...].set`` write the host
+        performs between sequential dispatches). Returns the per-
+        position logits ``[rows, k, vocab]`` and the fresh KV entries
+        ``[rows, k, *tr]``; the HOST commits only the accepted prefix
+        of those entries (rejected-run rollback = don't write)."""
+        m = self._model
+        K = self._spec_k
+        nkv = len(m.kv_spec)
+        jnp = self._jax.numpy
+
+        def flat(param_list, tokens, positions, *rest):
+            kv = list(rest[:nkv])
+            feats = rest[nkv:]
+            rows = jnp.arange(tokens.shape[0])
+            logits, entries = [], [[] for _ in range(nkv)]
+            for i in range(K):
+                out = m.step_fn(param_list, tokens[:, i], positions + i,
+                                *kv, *feats)
+                logits.append(out[0])
+                for j in range(nkv):
+                    entries[j].append(out[1 + j])
+                    kv[j] = kv[j].at[rows, positions + i].set(out[1 + j])
+            return ((jnp.stack(logits, axis=1),)
+                    + tuple(jnp.stack(e, axis=1) for e in entries))
 
         return flat
 
@@ -375,7 +474,7 @@ class _Programs:
                            for a in param_arrays]
             in_specs = self._in_specs(phase, rows, seq)
         donate = ()
-        if phase == "step":
+        if phase in ("step", "verify"):
             # donate the gathered kv scratch buffers (args: params,
             # tokens, positions, kv..., feat...): they are rebuilt
             # host-side every step, so the program may overwrite them
@@ -418,6 +517,11 @@ class _Programs:
         i32 = np.int32
         if phase == "prefill":
             batch = [np.zeros((rows, seq), i32), np.ones((rows,), i32)]
+        elif phase == "verify":
+            batch = [np.zeros((rows, self._spec_k), i32),
+                     np.zeros((rows,), i32)]
+            batch += [np.zeros((rows, seq) + tr, dt)
+                      for tr, dt in m.kv_spec]
         else:
             batch = [np.zeros((rows,), i32), np.zeros((rows,), i32)]
             batch += [np.zeros((rows, seq) + tr, dt)
@@ -433,10 +537,12 @@ class _Programs:
                 f"{phase} program returned {len(outs)} outputs, "
                 f"expected logits + {len(m.kv_spec)} kv arrays")
         lg = outs[0]
-        if tuple(getattr(lg, "shape", ())) != (rows, m.vocab_size):
+        want_lg = ((rows, self._spec_k, m.vocab_size)
+                   if phase == "verify" else (rows, m.vocab_size))
+        if tuple(getattr(lg, "shape", ())) != want_lg:
             raise ValueError(
                 f"{phase} logits shape {getattr(lg, 'shape', ())} != "
-                f"({rows}, {m.vocab_size})")
+                f"{want_lg}")
         for o in outs[1:]:
             if getattr(o, "ndim", 0) == 0 or o.shape[0] != rows:
                 raise ValueError(
@@ -544,68 +650,189 @@ class _Programs:
 
 
 class _KVSlots:
-    """Paged per-sequence KV storage. Each slot's buffers grow in
-    power-of-2 pages (doubling), so host memory tracks actual sequence
-    lengths; freed slots keep their pages for the next occupant (no
-    realloc churn at steady state). ``gather`` assembles the
-    fixed-shape step batch, zero-filling rows beyond each sequence's
-    length so stale contents never reach a program."""
+    """Paged per-sequence KV storage over a REFCOUNTED page pool.
+
+    Each slot's KV is a list of fixed-size pages (``page_len`` =
+    ``min_bucket`` tokens) drawn from a shared pool, so host memory
+    tracks actual sequence lengths AND hot prefixes can live once:
+    the prefix cache installs its pages into a fresh slot by reference
+    (:meth:`install_shared`). Sharing is copy-on-write — any write
+    into a page with refcount > 1 clones it first, so two sequences
+    sharing a prefix then diverging can never see each other's pages.
+    Release DECREMENTS, never frees: a page the cache or another
+    sequence still holds survives a slot's release (the shared-page
+    half of the exactly-once release discipline — a watchdog restart's
+    sweep decrefs shared pages, it cannot double-free them). ``gather``
+    assembles the fixed-shape step batch, zero-filling rows beyond
+    each sequence's length so stale contents never reach a program."""
 
     def __init__(self, max_slots, max_seq_len, kv_spec, min_bucket=8):
         self.max_slots = int(max_slots)
         self.max_seq_len = int(max_seq_len)
         self.kv_spec = kv_spec
         self.min_bucket = int(min_bucket)
+        self.page_len = self.min_bucket
         self._free = list(range(self.max_slots - 1, -1, -1))
-        self._bufs = [None] * self.max_slots  # slot -> [np [cap, *tr]]
-        self._caps = [0] * self.max_slots
+        self._slot_pages = [[] for _ in range(self.max_slots)]
+        self._pages = {}       # page id -> [np (page_len, *tr) per kv]
+        self._rc = {}          # page id -> refcount
+        self._spare = []       # recycled page array lists (no realloc
+        self._next_pid = 0     # churn at steady state)
 
     def free_count(self):
         return len(self._free)
 
+    def page_bytes(self):
+        """Host bytes of ONE page across every kv buffer (what the
+        prefix cache budgets with)."""
+        return sum(self.page_len * int(np.prod(tr)) * dt.itemsize
+                   for tr, dt in self.kv_spec)
+
+    # ------------------------------------------------------------ pages
+    # tpu-resource: acquires=kv_page
+    def _page_alloc(self):
+        """One fresh page (refcount 1) — recycled arrays when possible.
+        Recycled contents are NOT zeroed: every read path copies only
+        positions a sequence actually wrote (gather/snapshot bound by
+        length), so stale bytes can never reach a program."""
+        pid = self._next_pid
+        self._next_pid += 1
+        if self._spare:
+            self._pages[pid] = self._spare.pop()
+        else:
+            self._pages[pid] = [np.zeros((self.page_len,) + tr, dt)
+                                for tr, dt in self.kv_spec]
+        self._rc[pid] = 1
+        return pid
+
+    # tpu-resource: releases=kv_page
+    def _page_reclaim(self, pid):
+        """Refcount hit zero: return the arrays to the spare pool."""
+        self._spare.append(self._pages.pop(pid))
+        del self._rc[pid]
+
+    def retain_page(self, pid):
+        self._rc[pid] += 1
+
+    def drop_page(self, pid):
+        rc = self._rc[pid] - 1
+        if rc:
+            self._rc[pid] = rc
+        else:
+            self._page_reclaim(pid)
+
+    def shared_pages(self):
+        """Pages held by more than one owner (slots + cache entries)."""
+        return sum(1 for rc in self._rc.values() if rc > 1)
+
+    def live_pages(self):
+        return len(self._pages)
+
+    def _ensure(self, slot, n):
+        """Grow the slot's page list to cover n positions."""
+        if n > self.max_seq_len:
+            raise ValueError(f"sequence length {n} exceeds max_seq_len "
+                             f"{self.max_seq_len}")
+        pages = self._slot_pages[slot]
+        need = -(-n // self.page_len)
+        while len(pages) < need:
+            pages.append(self._page_alloc())
+
+    def _writable(self, slot, page_idx):
+        """The slot's page arrays at ``page_idx``, cloned first if the
+        page is shared — the copy-on-write barrier every write path
+        goes through."""
+        pages = self._slot_pages[slot]
+        pid = pages[page_idx]
+        if self._rc[pid] > 1:
+            new = self._page_alloc()
+            for dst, src in zip(self._pages[new], self._pages[pid]):
+                dst[:] = src
+            self.drop_page(pid)
+            pages[page_idx] = new
+            pid = new
+        return self._pages[pid]
+
+    # ------------------------------------------------------------ slots
     # tpu-resource: acquires=kv_slot
     def alloc(self):
         return self._free.pop() if self._free else None
 
     # tpu-resource: releases=kv_slot
     def release(self, slot):
+        """Free the slot: DECREMENT every page (reclaimed only when no
+        other sequence or cache entry holds it)."""
+        pages = self._slot_pages[slot]
+        self._slot_pages[slot] = []
+        for pid in pages:
+            self.drop_page(pid)
         self._free.append(slot)
 
-    def _ensure(self, slot, n):
-        """Grow slot capacity to the page (pow2 bucket) covering n."""
-        if n > self.max_seq_len:
-            raise ValueError(f"sequence length {n} exceeds max_seq_len "
-                             f"{self.max_seq_len}")
-        cap = self._caps[slot]
-        if cap >= n:
-            return
-        new_cap = seq_bucket(n, self.min_bucket, self.max_seq_len)
-        bufs = self._bufs[slot]
-        new = [np.zeros((new_cap,) + tr, dt) for tr, dt in self.kv_spec]
-        if bufs is not None and cap:
-            for dst, src in zip(new, bufs):
-                dst[:cap] = src[:cap]
-        self._bufs[slot] = new
-        self._caps[slot] = new_cap
+    def install_shared(self, slot, pages):
+        """Seed a freshly-allocated slot with cached prefix pages by
+        reference (each page's refcount grows; the first divergent
+        write clones via :meth:`_writable`)."""
+        for pid in pages:
+            self.retain_page(pid)
+        self._slot_pages[slot] = list(pages)
 
+    def export_pages(self, slot, n_pages):
+        """The slot's first ``n_pages`` page ids (for the prefix cache
+        to retain — the pages themselves stay put)."""
+        return list(self._slot_pages[slot][:n_pages])
+
+    def pages_from_arrays(self, kv_arrays, length):
+        """Materialize contiguous KV arrays (a store-loaded prefix)
+        into fresh pool pages; returns the page ids, refcount 1 each,
+        owned by the caller."""
+        pages = []
+        pl = self.page_len
+        for pi in range(-(-length // pl)):
+            pid = self._page_alloc()
+            lo = pi * pl
+            m = min(pl, length - lo)
+            for a, src in zip(self._pages[pid], kv_arrays):
+                a[:m] = src[lo:lo + m]
+            pages.append(pid)
+        return pages
+
+    # ----------------------------------------------------------- writes
     def write_prefill(self, slot, kv_arrays, length):
         """Install a fresh sequence's prompt kv (row slices of the
         prefill program's [rows, prompt_bucket, ...] outputs)."""
-        self._ensure(slot, max(length, 1))
-        for buf, src in zip(self._bufs[slot], kv_arrays):
-            buf[:length] = src[:length]
+        length = max(length, 1)
+        self._ensure(slot, length)
+        pl = self.page_len
+        for pi in range(-(-length // pl)):
+            lo = pi * pl
+            m = min(pl, length - lo)
+            arrays = self._writable(slot, pi)
+            for a, src in zip(arrays, kv_arrays):
+                a[:m] = src[lo:lo + m]
 
     def write_entry(self, slot, pos, entries):
         """Append one decode step's kv entries at position ``pos``."""
         self._ensure(slot, pos + 1)
-        for buf, e in zip(self._bufs[slot], entries):
-            buf[pos] = e
+        arrays = self._writable(slot, pos // self.page_len)
+        o = pos % self.page_len
+        for a, e in zip(arrays, entries):
+            a[o] = e
 
+    # ------------------------------------------------------------ reads
     def snapshot(self, slot, length):
         """Copy slot ``slot``'s first ``length`` KV entries out (one
-        array per kv_spec entry) — the paged-KV payload of a resumable
-        stream snapshot. Pure read: the slot stays live."""
-        return [np.array(buf[:length]) for buf in self._bufs[slot]]
+        contiguous array per kv_spec entry) — the paged-KV payload of
+        a resumable stream snapshot. Pure read: the slot stays live."""
+        out = [np.zeros((length,) + tr, dt) for tr, dt in self.kv_spec]
+        pl = self.page_len
+        for pi, pid in enumerate(self._slot_pages[slot]):
+            lo = pi * pl
+            if lo >= length:
+                break
+            m = min(pl, length - lo)
+            for o, a in zip(out, self._pages[pid]):
+                o[lo:lo + m] = a[:m]
+        return out
 
     # tpu-resource: acquires=kv_slot
     def restore(self, kv_arrays, length):
@@ -615,9 +842,7 @@ class _KVSlots:
         slot = self.alloc()
         if slot is None:
             return None
-        self._ensure(slot, max(length, 1))
-        for buf, src in zip(self._bufs[slot], kv_arrays):
-            buf[:length] = src[:length]
+        self.write_prefill(slot, kv_arrays, length)
         return slot
 
     def gather(self, slots, lengths, rows_bucket, seq_b):
@@ -627,13 +852,18 @@ class _KVSlots:
         construction, masked out by the model)."""
         out = [np.zeros((rows_bucket, seq_b) + tr, dt)
                for tr, dt in self.kv_spec]
+        pl = self.page_len
         for i, (slot, n) in enumerate(zip(slots, lengths)):
             n = min(n, seq_b)
             if n <= 0:
                 continue
-            bufs = self._bufs[slot]
-            for o, buf in zip(out, bufs):
-                o[i, :n] = buf[:n]
+            for pi, pid in enumerate(self._slot_pages[slot]):
+                lo = pi * pl
+                if lo >= n:
+                    break
+                m = min(pl, n - lo)
+                for o, a in zip(out, self._pages[pid]):
+                    o[i, lo:lo + m] = a[:m]
         return out
 
 
@@ -660,9 +890,9 @@ class DecodeRequest:
 
     __slots__ = ("prompt", "features", "max_new_tokens", "eos_token_id",
                  "token_budget_s", "trace_id", "token_dtype", "t_enqueue",
-                 "snapshot_every", "_cond", "_tokens", "_taken", "_done",
-                 "_error", "_snap", "_snap_fresh", "finish_reason",
-                 "cancelled")
+                 "snapshot_every", "speculative", "_cond", "_tokens",
+                 "_taken", "_done", "_error", "_snap", "_snap_fresh",
+                 "finish_reason", "cancelled")
 
     def __init__(self, prompt, features, max_new_tokens, eos_token_id,
                  token_budget_s, trace_id, token_dtype):
@@ -675,6 +905,7 @@ class DecodeRequest:
         self.token_dtype = token_dtype
         self.t_enqueue = time.monotonic()
         self.snapshot_every = 0
+        self.speculative = False
         self._cond = threading.Condition()
         self._tokens = []
         self._taken = 0
@@ -794,10 +1025,14 @@ class DecodeRequest:
 
 
 class _Seq:
-    """One RUNNING sequence: its request, KV slot, and positions."""
+    """One RUNNING sequence: its request, KV slot, and positions.
+    ``draft_slot``/``draft_pos`` track the speculative companion's KV
+    (allocated lazily on the first speculative iteration; rollback
+    after a rejected run is just moving ``draft_pos`` back — the stale
+    entries beyond it are never gathered)."""
 
     __slots__ = ("req", "slot", "pos", "last_token", "n_generated",
-                 "t_last")
+                 "t_last", "draft_slot", "draft_pos")
 
     def __init__(self, req, slot, pos, last_token, now):
         self.req = req
@@ -806,6 +1041,8 @@ class _Seq:
         self.last_token = last_token
         self.n_generated = 1  # prefill emitted the first token
         self.t_last = now
+        self.draft_slot = None
+        self.draft_pos = 0
 
 
 class DecodeEngine:
@@ -822,7 +1059,8 @@ class DecodeEngine:
                  default_max_new_tokens=None, name="decode", store=None,
                  breaker_threshold=None, breaker_cooldown=None,
                  watchdog_interval=None, wedge_timeout=None, quant=None,
-                 mesh=None, phase=None):
+                 mesh=None, phase=None, spec_k=None, prefix=None,
+                 prefix_dir=None, prefix_max_bytes=None):
         # quant: serve this model under a quantization mode ("w8" |
         # "bf16w"; env default PADDLE_TPU_SERVING_QUANT — the one-knob
         # fleet flip). An unquantized model is wrapped via
@@ -836,6 +1074,12 @@ class DecodeEngine:
         # artifact-store identities (README "Sharded serving").
         if quant is None:
             quant = os.environ.get("PADDLE_TPU_SERVING_QUANT") or None
+        # capture the draft companion BEFORE any quant wrapping:
+        # quantize_decode_model builds a NEW DecodeModel and would drop
+        # the attribute. The draft follows the target's serving mode
+        # unless it already carries its own (a pre-quantized draft —
+        # the bf16w/w8 draft of the ISSUE contract — wins).
+        draft_model = getattr(model, "draft", None)
         model_quant = getattr(model, "quant", None)
         if quant is not None and quant != (model_quant or "f32"):
             if model_quant is not None:
@@ -846,6 +1090,19 @@ class DecodeEngine:
                 from ..quantization.serving import quantize_decode_model
 
                 model = quantize_decode_model(model, quant)
+                if (draft_model is not None
+                        and getattr(draft_model, "quant", None) is None):
+                    draft_model = quantize_decode_model(draft_model, quant)
+        if draft_model is not None:
+            if draft_model.vocab_size != model.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_model.vocab_size} != target "
+                    f"vocab {model.vocab_size}; speculative verify "
+                    "compares argmaxes over the SAME vocab")
+            if draft_model.feature_spec != model.feature_spec:
+                raise ValueError(
+                    "draft feature_spec differs from the target's; "
+                    "both models consume the request's feature arrays")
         self._model = model
         self.max_slots = int(
             max_slots if max_slots is not None
@@ -905,8 +1162,25 @@ class DecodeEngine:
             wedge_timeout if wedge_timeout is not None
             else _env_float("PADDLE_TPU_SERVING_WEDGE_TIMEOUT", 30.0))
         self.name = name
-        self._programs = _Programs(model, store=store, mesh=mesh)
+        # speculative decode: active only with a draft companion AND
+        # k >= 2 (k-1 proposed tokens + the always-correct first
+        # position per verify dispatch)
+        self._spec_k = int(spec_k if spec_k is not None
+                           else _env_int("PADDLE_TPU_SPEC_K", 0))
+        if draft_model is None or self._spec_k < 2:
+            self._spec_k = 0
+        self.spec_enabled = self._spec_k >= 2
+        self._programs = _Programs(model, store=store, mesh=mesh,
+                                   spec_k=self._spec_k)
         self.mesh_desc = self._programs.mesh_desc
+        self._draft_programs = None
+        self._draft_slots = None
+        if self.spec_enabled:
+            self._draft_programs = _Programs(draft_model, store=store,
+                                             mesh=mesh)
+            self._draft_slots = _KVSlots(
+                self.max_slots, self.max_seq_len, draft_model.kv_spec,
+                min_bucket=self.min_seq_bucket)
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._pending = []  # FIFO of DecodeRequest
@@ -914,6 +1188,8 @@ class DecodeEngine:
         self._n_snapshots = 0       # blocks assembled (stats view)
         self._n_resumes_ok = 0      # resume joins admitted
         self._n_resumes_refused = 0  # identity-skew refusals
+        self._n_spec_iters = 0      # speculative bursts applied
+        self._n_spec_accepted = 0   # draft tokens accepted by verify
         self._active = []   # list of _Seq (scheduler-owned mutation)
         self._inflight_join = []  # joiners popped but not yet prefilled:
         # a scheduler that dies holding them must not strand them — the
@@ -922,6 +1198,17 @@ class DecodeEngine:
         self._slots = _KVSlots(self.max_slots, self.max_seq_len,
                                model.kv_spec,
                                min_bucket=self.min_seq_bucket)
+        # content-addressed prefix cache over the slot page pool (ON
+        # by default — in-memory sharing alone; the persistent tier
+        # needs PADDLE_TPU_PREFIX_DIR)
+        if prefix is None:
+            prefix = os.environ.get("PADDLE_TPU_PREFIX_DISABLE") != "1"
+        self._prefix = None
+        if prefix:
+            self._prefix = PrefixCache(
+                self._slots, identity_fn=self._prefix_identity,
+                max_bytes=prefix_max_bytes, store_dir=prefix_dir,
+                name=f"{name}-prefix")
         self._cache = {}      # (phase, rows, seq) -> run
         self._compiling = {}  # (phase, rows, seq) -> Event
         self._breakers = {}   # (phase, rows, seq) -> _Breaker
@@ -1009,12 +1296,44 @@ class DecodeEngine:
         self._m_queue = M.Gauge(
             "paddle_decode_queue_depth",
             "Requests waiting for a slot", const_labels=cl)
+        self._m_prefix_hits = M.Counter(
+            "paddle_prefix_hits_total",
+            "Prefix-cache hits (a joiner installed cached KV pages and "
+            "skipped prefill over them)", const_labels=cl)
+        self._m_prefix_misses = M.Counter(
+            "paddle_prefix_misses_total",
+            "Prefix-cache misses (hashed prompts with no cached "
+            "boundary)", const_labels=cl)
+        self._m_prefix_evictions = M.Counter(
+            "paddle_prefix_evictions_total",
+            "Prefix-cache entries evicted under the byte budget",
+            const_labels=cl)
+        self._m_shared_pages = M.Gauge(
+            "paddle_decode_shared_pages",
+            "KV pages referenced by more than one owner (slots + "
+            "prefix-cache entries)", const_labels=cl)
+        self._m_live_pages = M.Gauge(
+            "paddle_decode_live_pages",
+            "KV pages currently allocated (target + draft pools)",
+            const_labels=cl)
+        self._m_spec_accept = M.Histogram(
+            "paddle_spec_accept_ratio",
+            "Accepted draft tokens / proposed (k-1) per speculative "
+            "verify",
+            const_labels={
+                **cl,
+                "quant": getattr(self._model, "quant", None) or "f32",
+                "mesh": self.mesh_desc},
+            buckets=(0.0, 0.25, 0.5, 0.75, 1.0))
         self._instruments = [
             self._m_requests, self._m_tokens, self._m_shed,
             self._m_retired, self._m_deadline, self._m_restarts,
             self._m_compiles, self._m_steps, self._m_ttft,
             self._m_intertoken, self._m_step_exec, self._m_occupancy,
-            self._m_active, self._m_queue]
+            self._m_active, self._m_queue, self._m_prefix_hits,
+            self._m_prefix_misses, self._m_prefix_evictions,
+            self._m_shared_pages, self._m_live_pages,
+            self._m_spec_accept]
         ref = weakref.ref(self)
 
         def _collector():
@@ -1028,12 +1347,32 @@ class DecodeEngine:
         with self._lock:
             self._m_queue.set(len(self._pending))
             self._m_active.set(len(self._active))
+            shared = self._slots.shared_pages()
+            live = self._slots.live_pages()
+            if self._draft_slots is not None:
+                shared += self._draft_slots.shared_pages()
+                live += self._draft_slots.live_pages()
+            self._m_shared_pages.set(shared)
+            self._m_live_pages.set(live)
             return [m.collect() for m in self._instruments]
+
+    def _prefix_identity(self):
+        """Replica identity for persistent prefix-cache keys/headers —
+        the same fields a kv-snapshot resume compares (PR 17's skew-
+        refusal discipline). Called lazily, OUTSIDE the engine lock
+        (the fingerprint has its own lock)."""
+        fp = self._programs._fingerprint()
+        if fp is None:
+            return None
+        return {"fingerprint": fp,
+                "weights": self._programs._weights_digest(),
+                "quant": getattr(self._model, "quant", None) or "f32",
+                "mesh": self.mesh_desc}
 
     # ------------------------------------------------------------ submit
     def submit(self, prompt, max_new_tokens=None, features=(),
                token_budget_s=None, trace_id=None, eos_token_id=None,
-               snapshot_every=None):
+               snapshot_every=None, speculative=False):
         """Enqueue one sequence; -> :class:`DecodeRequest`.
 
         ``prompt``: 1-D (or [1, P]) int32/int64 token ids (the output
@@ -1044,7 +1383,10 @@ class DecodeEngine:
         retryable and frees its slot. ``snapshot_every``: emit a
         resumable kv-snapshot block every N generated tokens
         (``DecodeRequest.take_snapshot``; 0 = never, None = the
-        engine's env-configured default)."""
+        engine's env-configured default). ``speculative``: opt in to
+        draft-and-verify decoding (wire 0x5C bit 61) — tokens stay
+        bitwise-equal to non-speculative greedy; a no-op on an engine
+        without a draft model."""
         chaos.hit("serving.decode.admit")
         prompt = np.asarray(prompt)
         if prompt.ndim == 2 and prompt.shape[0] == 1:
@@ -1091,6 +1433,7 @@ class DecodeEngine:
         req.snapshot_every = max(0, int(
             self.default_snapshot_every if snapshot_every is None
             else snapshot_every))
+        req.speculative = bool(speculative)
         with self._cond:
             if self._closed:
                 raise EngineClosed(f"{self.name} is closed")
@@ -1260,7 +1603,8 @@ class DecodeEngine:
         return header, arrays
 
     def resume(self, snapshot, token_budget_s=None, trace_id=None,
-               snapshot_every=None, max_new_tokens=None):
+               snapshot_every=None, max_new_tokens=None,
+               speculative=False):
         """Resume a snapshotted sequence on THIS engine at its exact
         position; -> :class:`DecodeRequest`.
 
@@ -1294,6 +1638,7 @@ class DecodeEngine:
         req.snapshot_every = max(0, int(
             self.default_snapshot_every if snapshot_every is None
             else snapshot_every))
+        req.speculative = bool(speculative)
         # pre-fill the snapshot's tail as already-consumed: result()
         # sees the full sequence, the stream re-emits nothing
         req._tokens = [int(t) for t in tail]
@@ -1450,14 +1795,14 @@ class DecodeEngine:
             for s in self._active:
                 if s.req.cancelled:
                     purged.append((s, "cancelled", None))
-                    self._slots.release(s.slot)
+                    self._release_seq(s)
                 elif (s.req.token_budget_s is not None
                         and now - s.t_last > s.req.token_budget_s):
                     purged.append((s, "deadline", DeadlineExceeded(
                         f"{self.name}: per-token budget "
                         f"{s.req.token_budget_s}s blown after "
                         f"{s.n_generated} tokens; slot purged")))
-                    self._slots.release(s.slot)
+                    self._release_seq(s)
                 else:
                     keep.append(s)
             self._active[:] = keep
@@ -1467,107 +1812,121 @@ class DecodeEngine:
     # ----------------------------------------------------------- prefill
     # tpu-resource: acquires=kv_slot releases=kv_slot
     def _prefill(self, gen, joiners):
-        rows = bucket_rows(max(len(joiners), 2), self._rows_cap)
-        p_bucket = seq_bucket(max(r.prompt.size for r in joiners),
-                              self.min_seq_bucket, self.max_seq_len)
-        key = ("prefill", rows, p_bucket)
-        if not self._breaker_allows(key, joiners):
-            with self._lock:
-                if self._sched_gen == gen and not self._closed:
-                    # stale schedulers must not wipe the REPLACEMENT
-                    # scheduler's in-flight joiner record
-                    self._inflight_join = []
-            return
-        t0 = time.monotonic()
-        try:
-            run = self._program(key, warming=False,
-                                trace_id=next((r.trace_id for r in joiners
-                                               if r.trace_id is not None),
-                                              None))
-            tokens = np.zeros((rows, p_bucket), np.int32)
-            lengths = np.ones((rows,), np.int32)  # pad rows: length 1
-            for i, r in enumerate(joiners):
-                tokens[i, :r.prompt.size] = r.prompt
-                lengths[i] = r.prompt.size
-            batch = [tokens, lengths] + self._feature_batch(joiners, rows)
-            chaos.hit("serving.decode.prefill")
-            outs = run(batch)
-        except Exception as e:  # noqa: BLE001 - fail only these joiners
-            self._record_breaker(key, ok=False)
-            err = e if isinstance(e, RetryableError) else RetryableError(
-                f"{self.name}: prefill failed ({type(e).__name__}: {e}); "
-                "retry the request")
-            with self._lock:
-                if self._sched_gen == gen and not self._closed:
-                    self._inflight_join = []
-            for r in joiners:
-                r._fail(err)
-                self._m_retired.inc(reason="error")
-            return
-        self._record_breaker(key, ok=True)
+        """Admit joiners: consult the prefix cache, run the prefill
+        program over cache-miss prompts ONLY, install shared pages for
+        hits, then feed every joiner's uncached suffix token-by-token
+        through the already-warm step rungs. The LAST suffix step is
+        the *finishing step* — the last prompt token fed at position
+        P-1 — and its logits produce the first emitted token for cold
+        and hit joiners alike, so the first token always comes from
+        the identical step-shaped computation: prefix-hit-vs-cold
+        bitwise equality holds by construction, not by tolerance."""
+        plans = []
+        for r in joiners:
+            plan = {"req": r, "hashes": [], "hit": None, "load": None}
+            if self._prefix is not None:
+                hashes = prefix_hashes(r.prompt, self._slots.page_len,
+                                       feature_seed(r.features))
+                plan["hashes"] = hashes
+                if hashes:
+                    hit = self._prefix.lookup(hashes)
+                    if hit is not None:
+                        plan["hit"] = hit
+                        self._m_prefix_hits.inc()
+                    else:
+                        self._m_prefix_misses.inc()
+                        # persistent tier: file IO, engine lock NOT held
+                        plan["load"] = self._prefix.load_store(
+                            hashes, r.prompt)
+            plans.append(plan)
+        cold = [p for p in plans
+                if p["hit"] is None and p["load"] is None]
+        kv_cold = None
+        if cold:
+            rows = bucket_rows(max(len(cold), 2), self._rows_cap)
+            p_bucket = seq_bucket(max(p["req"].prompt.size for p in cold),
+                                  self.min_seq_bucket, self.max_seq_len)
+            key = ("prefill", rows, p_bucket)
+            if not self._breaker_allows(key, joiners):
+                with self._lock:
+                    if self._sched_gen == gen and not self._closed:
+                        # stale schedulers must not wipe the REPLACEMENT
+                        # scheduler's in-flight joiner record
+                        self._inflight_join = []
+                return
+            t0 = time.monotonic()
+            try:
+                run = self._program(key, warming=False,
+                                    trace_id=next(
+                                        (r.trace_id for r in joiners
+                                         if r.trace_id is not None),
+                                        None))
+                tokens = np.zeros((rows, p_bucket), np.int32)
+                lengths = np.ones((rows,), np.int32)  # pad rows: len 1
+                for i, p in enumerate(cold):
+                    tokens[i, :p["req"].prompt.size] = p["req"].prompt
+                    lengths[i] = p["req"].prompt.size
+                batch = [tokens, lengths] + self._feature_batch(
+                    [p["req"] for p in cold], rows)
+                chaos.hit("serving.decode.prefill")
+                outs = run(batch)
+            except Exception as e:  # noqa: BLE001 - fail these joiners
+                self._record_breaker(key, ok=False)
+                err = e if isinstance(e, RetryableError) \
+                    else RetryableError(
+                        f"{self.name}: prefill failed "
+                        f"({type(e).__name__}: {e}); retry the request")
+                with self._lock:
+                    if self._sched_gen == gen and not self._closed:
+                        self._inflight_join = []
+                for r in joiners:
+                    r._fail(err)
+                    self._m_retired.inc(reason="error")
+                return
+            self._record_breaker(key, ok=True)
+            dt = time.monotonic() - t0
+            self._m_steps.inc(phase="prefill")
+            self._m_step_exec.observe(dt, phase="prefill")
+            obs_tracing.observe("serving.decode.prefill", dt)
+            kv_cold = outs[1:]
+            for i, p in enumerate(cold):
+                p["cold_row"] = i
+        # --- install: slots alloc + page installs + active-list entry
+        # in ONE lock acquisition, so the sequences are restart-visible
+        # from the instant they hold slots (a watchdog sweep releases
+        # and fails exactly these — no leak window)
         now = time.monotonic()
-        dt = now - t0
-        self._m_steps.inc(phase="prefill")
-        self._m_step_exec.observe(dt, phase="prefill")
-        obs_tracing.observe("serving.decode.prefill", dt)
-        logits = outs[0]
-        kv = outs[1:]
+        admitted = []  # feed state: {"s", "q", "installed", "plan"}
         stale = False
-        finished = []  # (seq-or-req, reason, err) notified post-lock
-        snaps = []     # (seq, kv copies, pos, last, n_gen) — encoded
-        # after the lock, same discipline as the step path
         with self._lock:
             if self._sched_gen != gen or self._closed:
-                # a watchdog restart superseded us mid-prefill: the
-                # restart already failed what it knew about; these
-                # joiners must fail too (no slot was allocated yet),
-                # and this thread must not touch slot state — nor the
-                # REPLACEMENT scheduler's _inflight_join record
                 stale = True
             else:
                 self._inflight_join = []
-                # one lock acquisition for slot allocs + kv installs +
-                # emits + the active-list update — atomic against a
-                # restart's release sweep, like the step path
-                for i, r in enumerate(joiners):
-                    tok = int(np.argmax(logits[i]))
-                    if (r.token_budget_s is not None
-                            and now - r.t_enqueue > r.token_budget_s):
-                        # the FIRST token is a token too: a blown TTFT
-                        # budget fails retryable before the sequence
-                        # ever occupies a slot (slot -1: never held)
-                        finished.append((
-                            _Seq(r, -1, r.prompt.size, tok, now),
-                            "deadline",
-                            DeadlineExceeded(
-                                f"{self.name}: first token arrived "
-                                f"past the per-token budget "
-                                f"{r.token_budget_s}s")))
-                        continue
+                for p in plans:
+                    r = p["req"]
+                    P = r.prompt.size
                     # guaranteed non-None: admission was bounded by
                     # the free count
                     slot = self._slots.alloc()
-                    self._slots.write_prefill(slot, [k[i] for k in kv],
-                                              r.prompt.size)
-                    s = _Seq(r, slot, r.prompt.size, tok, now)
-                    self._m_ttft.observe(now - r.t_enqueue)
-                    self._emit(s, tok, now, ttft=True)
-                    # prefill-boundary snapshot (cadence 1 only): the
-                    # n_generated=1 block IS the prefill->decode
-                    # handoff format, and it must exist even when the
-                    # sequence retires right here (a handoff request
-                    # runs with max_new_tokens=1) — so the kv copies
-                    # are taken BEFORE the slot can be released
-                    if r.snapshot_every == 1:
-                        snaps.append(
-                            (s, self._slots.snapshot(s.slot, s.pos),
-                             s.pos, s.last_token, s.n_generated))
-                    reason = self._stop_reason(s)
-                    if reason is None:
-                        self._active.append(s)
+                    if p["hit"] is not None:
+                        installed, pages = p["hit"]
+                        self._slots.install_shared(slot, pages)
+                    elif p["load"] is not None:
+                        hx, installed, kv_arrays = p["load"]
+                        pages = self._prefix.install_arrays(
+                            hx, installed, kv_arrays)
+                        self._slots.install_shared(slot, pages)
                     else:
-                        self._slots.release(s.slot)
-                        finished.append((s, reason, None))
+                        installed = P
+                        self._slots.write_prefill(
+                            slot, [k[p["cold_row"]] for k in kv_cold], P)
+                    s = _Seq(r, slot, installed, 0, now)
+                    s.n_generated = 0  # nothing emitted until the
+                    # finishing step's logits land
+                    self._active.append(s)
+                    admitted.append({"s": s, "q": min(installed, P - 1),
+                                     "installed": installed, "plan": p})
         if stale:
             err = SchedulerRestarted(
                 f"{self.name} decode scheduler was restarted while this "
@@ -1575,6 +1934,142 @@ class DecodeEngine:
             for r in joiners:
                 r._fail(err)
             return
+        # --- suffix feed: token-by-token through the step ladder,
+        # every joiner in one batch (a cold joiner feeds exactly its
+        # finishing step; a hit joiner feeds positions c..P-1)
+        feed = list(admitted)
+        prefill_chaos = not cold  # admissions with zero cold prompts
+        # still traverse the prefill chaos site exactly once
+        while feed:
+            n = len(feed)
+            rows = bucket_rows(max(n, 2), self._rows_cap)
+            need = max(f["q"] + 1 for f in feed)
+            seq_b = seq_bucket(need, self.min_seq_bucket,
+                               self.max_seq_len)
+            key = ("step", rows, seq_b)
+            if not self._breaker_allows(key, [f["s"].req
+                                              for f in admitted]):
+                self._drop_admitted(gen, admitted)
+                return
+            t0 = time.monotonic()
+            try:
+                run = self._program(key, warming=False,
+                                    trace_id=next(
+                                        (f["s"].req.trace_id
+                                         for f in feed
+                                         if f["s"].req.trace_id
+                                         is not None), None))
+                tokens = np.zeros((rows,), np.int32)
+                positions = np.zeros((rows,), np.int32)
+                for i, f in enumerate(feed):
+                    tokens[i] = int(f["s"].req.prompt[f["q"]])
+                    positions[i] = f["q"]
+                kv = self._slots.gather([f["s"].slot for f in feed],
+                                        [f["q"] for f in feed],
+                                        rows, seq_b)
+                batch = ([tokens, positions] + kv
+                         + self._feature_batch(
+                             [f["s"].req for f in feed], rows))
+                if prefill_chaos:
+                    chaos.hit("serving.decode.prefill")
+                    prefill_chaos = False
+                outs = run(batch)
+            except Exception as e:  # noqa: BLE001 - abort the admission
+                self._record_breaker(key, ok=False)
+                err = e if isinstance(e, RetryableError) \
+                    else RetryableError(
+                        f"{self.name}: prefix fill failed "
+                        f"({type(e).__name__}: {e}); retry the request")
+                self._drop_admitted(gen, admitted, err)
+                return
+            self._record_breaker(key, ok=True)
+            dt = time.monotonic() - t0
+            self._m_steps.inc(phase="prefix_fill")
+            self._m_step_exec.observe(dt, phase="prefix_fill")
+            obs_tracing.observe("serving.decode.prefix_fill", dt)
+            logits = outs[0]
+            entries = outs[1:]
+            with self._lock:
+                if self._sched_gen != gen or self._closed:
+                    return  # restart failed + released the admitted
+                for i, f in enumerate(feed):
+                    s = f["s"]
+                    if f["q"] >= f["installed"]:
+                        self._slots.write_entry(s.slot, f["q"],
+                                                [e[i] for e in entries])
+                    # else: the computed KV row is bitwise equal to the
+                    # installed shared page — skip the host write so
+                    # COW never clones over an identical value
+                    if f["q"] == s.req.prompt.size - 1:
+                        f["first"] = int(np.argmax(logits[i]))
+                    f["q"] += 1
+            feed = [f for f in feed if f["q"] < f["s"].req.prompt.size]
+        # --- emit first tokens + cache inserts, one lock acquisition
+        now = time.monotonic()
+        finished = []  # (seq, reason, err) notified post-lock
+        snaps = []     # (seq, kv copies, pos, last, n_gen) — encoded
+        # after the lock, same discipline as the step path
+        pubs = []      # persistent-tier publishes (file IO, post-lock)
+        with self._lock:
+            if self._sched_gen != gen or self._closed:
+                return  # restart failed + released the admitted
+            drop = set()
+            for f in admitted:
+                s = f["s"]
+                r = s.req
+                tok = f["first"]
+                s.pos = r.prompt.size
+                s.last_token = tok
+                s.n_generated = 1
+                if (r.token_budget_s is not None
+                        and now - r.t_enqueue > r.token_budget_s):
+                    # the FIRST token is a token too: a blown TTFT
+                    # budget fails retryable and frees the slot
+                    drop.add(id(s))
+                    self._release_seq(s)
+                    finished.append((s, "deadline", DeadlineExceeded(
+                        f"{self.name}: first token arrived past the "
+                        f"per-token budget {r.token_budget_s}s")))
+                    continue
+                self._m_ttft.observe(now - r.t_enqueue)
+                self._emit(s, tok, now, ttft=True)
+                # prefill-boundary snapshot (cadence 1 only): the
+                # n_generated=1 block IS the prefill->decode handoff
+                # format, and it must exist even when the sequence
+                # retires right here (a handoff request runs with
+                # max_new_tokens=1) — so the kv copies are taken
+                # BEFORE the slot can be released
+                if r.snapshot_every == 1:
+                    snaps.append(
+                        (s, self._slots.snapshot(s.slot, s.pos),
+                         s.pos, s.last_token, s.n_generated))
+                hashes = f["plan"]["hashes"]
+                if self._prefix is not None and hashes:
+                    # retain EVERY chain boundary (pages are shared
+                    # between them, so a shorter shared prefix still
+                    # hits); evictions ride the LRU byte budget
+                    ev = 0
+                    for n_tok, hx in hashes:
+                        ev += self._prefix.insert(
+                            hx, n_tok,
+                            self._slots.export_pages(
+                                s.slot,
+                                n_tok // self._slots.page_len))
+                    if ev:
+                        self._m_prefix_evictions.inc(ev)
+                    n_tok, hx = hashes[-1]
+                    if self._prefix.needs_publish(hx):
+                        pubs.append((hx, n_tok, r.prompt,
+                                     self._slots.snapshot(s.slot,
+                                                          n_tok)))
+                reason = self._stop_reason(s)
+                if reason is not None:
+                    drop.add(id(s))
+                    self._release_seq(s)
+                    finished.append((s, reason, None))
+            if drop:
+                self._active[:] = [x for x in self._active
+                                   if id(x) not in drop]
         # push snapshots BEFORE retirement notification: _push_snapshot
         # on a finished request is a no-op, and the handoff flow needs
         # the n_generated=1 block of a max_new_tokens=1 sequence
@@ -1589,13 +2084,59 @@ class DecodeEngine:
                 # a failed snapshot just means no resume point for this
                 # window; the stream itself must keep flowing
                 pass
+        for hx, n_tok, prompt, kv_copies in pubs:
+            try:
+                self._prefix.publish(hx, n_tok, prompt, kv_copies)
+            except Exception:  # noqa: BLE001 - publish is best-effort
+                pass
         for s, reason, err in finished:
             self._notify_retired(s, reason, err)
 
-    # ------------------------------------------------------- decode step
     # tpu-resource: releases=kv_slot
+    def _drop_admitted(self, gen, admitted, err=None):
+        """Abort a mid-prefill admission: pull the sequences off the
+        active list and free their slots atomically against a restart
+        sweep; fail the requests when ``err`` is given (a breaker shed
+        already failed them in ``_breaker_allows``)."""
+        with self._lock:
+            if self._sched_gen != gen or self._closed:
+                return  # the restart swept these already
+            drop = {id(f["s"]) for f in admitted}
+            self._active[:] = [x for x in self._active
+                               if id(x) not in drop]
+            for f in admitted:
+                self._release_seq(f["s"])
+        if err is not None:
+            for f in admitted:
+                self._m_retired.inc(reason="error")
+                f["s"].req._fail(err)
+
+    # ------------------------------------------------------- decode step
     def _decode_step(self, gen):
+        """One scheduler iteration over the active set: members that
+        opted into speculation (and have headroom) take a draft+verify
+        burst; everyone else takes one plain step. A draft-side
+        failure NEVER fails a request — the speculative group falls
+        back to the plain step path for this iteration."""
         active = list(self._active)
+        spec, normal = [], []
+        for s in active:
+            (spec if self._spec_ok(s) else normal).append(s)
+        if spec and not self._spec_group(gen, spec):
+            normal += spec  # draft fallback: plain-step this iteration
+        if normal:
+            self._step_group(gen, normal)
+
+    def _spec_ok(self, s):
+        """May this sequence take a K-token speculative burst now?
+        Needs opt-in, room for K kv entries, and at least 2 tokens of
+        budget left (a 1-token tail is cheaper as a plain step)."""
+        return (self.spec_enabled and s.req.speculative
+                and s.pos + self._spec_k <= self.max_seq_len
+                and s.req.max_new_tokens - s.n_generated >= 2)
+
+    # tpu-resource: releases=kv_slot
+    def _step_group(self, gen, active):
         n = len(active)
         rows = bucket_rows(max(n, 2), self._rows_cap)
         need = max(s.pos + 1 for s in active)
@@ -1604,9 +2145,11 @@ class DecodeEngine:
         if not self._breaker_allows(key, [s.req for s in active]):
             with self._lock:
                 if self._sched_gen == gen and not self._closed:
+                    drop = {id(s) for s in active}
                     for s in active:
-                        self._slots.release(s.slot)
-                    self._active[:] = []
+                        self._release_seq(s)
+                    self._active[:] = [x for x in self._active
+                                       if id(x) not in drop]
             return
         t0 = time.monotonic()
         try:
@@ -1640,9 +2183,11 @@ class DecodeEngine:
             with self._lock:
                 if self._sched_gen != gen or self._closed:
                     return  # restart already failed + released all
+                drop = {id(s) for s in active}
                 for s in active:
-                    self._slots.release(s.slot)
-                self._active[:] = []
+                    self._release_seq(s)
+                self._active[:] = [x for x in self._active
+                                   if id(x) not in drop]
             for s in active:
                 self._m_retired.inc(reason="error")
                 s.req._fail(err)
@@ -1670,7 +2215,7 @@ class DecodeEngine:
             # the whole result application is ONE lock acquisition:
             # slot writes/releases and the active-list update can
             # never interleave with a restart's release sweep
-            keep = []
+            drop = set()
             for i, s in enumerate(active):
                 self._slots.write_entry(s.slot, s.pos,
                                         [e[i] for e in entries])
@@ -1685,7 +2230,8 @@ class DecodeEngine:
                 # on time
                 if (s.req.token_budget_s is not None
                         and now - s.t_last > s.req.token_budget_s):
-                    self._slots.release(s.slot)
+                    self._release_seq(s)
+                    drop.add(id(s))
                     finished.append((s, "deadline", DeadlineExceeded(
                         f"{self.name}: token {s.n_generated} arrived "
                         f"{now - s.t_last:.3f}s after the previous one "
@@ -1695,7 +2241,6 @@ class DecodeEngine:
                 self._emit(s, tok, now)
                 reason = self._stop_reason(s)
                 if reason is None:
-                    keep.append(s)
                     if (s.req.snapshot_every
                             and s.n_generated % s.req.snapshot_every
                             == 0):
@@ -1703,9 +2248,12 @@ class DecodeEngine:
                             (s, self._slots.snapshot(s.slot, s.pos),
                              s.pos, s.last_token, s.n_generated))
                 else:
-                    self._slots.release(s.slot)
+                    self._release_seq(s)
+                    drop.add(id(s))
                     finished.append((s, reason, None))
-            self._active[:] = keep
+            if drop:
+                self._active[:] = [x for x in self._active
+                                   if id(x) not in drop]
         for s, kv_copies, pos, last, n_gen in snaps:
             try:
                 chaos.hit("serving.decode.snapshot")
@@ -1719,6 +2267,248 @@ class DecodeEngine:
                 pass
         for s, reason, err in finished:
             self._notify_retired(s, reason, err)
+
+    def _token_at(self, s, p):
+        """The sequence's REAL token at absolute position ``p`` — the
+        draft catch-up feed. Invariant: s.pos = plen + n_generated - 1,
+        so positions below plen come from the prompt, s.pos carries
+        last_token, and the span between is already-emitted output."""
+        plen = s.req.prompt.size
+        if p < plen:
+            return int(s.req.prompt[p])
+        if p == s.pos:
+            return int(s.last_token)
+        return int(s.req.tokens_so_far()[p - plen])
+
+    # ---------------------------------------------------- speculative
+    # tpu-resource: releases=kv_slot
+    def _spec_group(self, gen, group):
+        """One draft+verify burst for ``group``. Returns False when the
+        DRAFT side cannot run (program failure, quarantine) — the
+        caller then plain-steps the group, so draft trouble degrades
+        throughput, never correctness. A VERIFY-side failure also
+        falls back: no engine state mutates until verify results are
+        applied host-side under the lock.
+
+        Greedy equivalence: verify feeds [last_token, d_1..d_{K-1}] at
+        positions pos..pos+K-1 through K UNROLLED step_fn iterations in
+        one program — bitwise-identical per position to K sequential
+        step dispatches — and the accept loop enters position j+1 only
+        while d_j == argmax(logits_j), so every emitted token and every
+        committed kv entry is exactly what non-speculative greedy
+        decode would have produced. Rejected-run rollback is simply
+        never writing the rejected entries."""
+        K = self._spec_k
+        # --- draft prefill for members that never drafted before
+        fresh = [s for s in group if s.draft_slot is None]
+        if fresh:
+            rows = bucket_rows(max(len(fresh), 2), self._rows_cap)
+            p_bucket = seq_bucket(max(s.req.prompt.size for s in fresh),
+                                  self.min_seq_bucket, self.max_seq_len)
+            key = ("draft_prefill", rows, p_bucket)
+            if not self._breaker_probe(key):
+                return False
+            t0 = time.monotonic()
+            try:
+                run = self._program(key, warming=False)
+                tokens = np.zeros((rows, p_bucket), np.int32)
+                lengths = np.ones((rows,), np.int32)
+                for i, s in enumerate(fresh):
+                    tokens[i, :s.req.prompt.size] = s.req.prompt
+                    lengths[i] = s.req.prompt.size
+                batch = [tokens, lengths] + self._feature_batch(
+                    [s.req for s in fresh], rows)
+                outs = run(batch)
+            except Exception:  # noqa: BLE001 - draft is best-effort
+                self._record_breaker(key, ok=False)
+                return False
+            self._record_breaker(key, ok=True)
+            self._m_steps.inc(phase="draft_prefill")
+            self._m_step_exec.observe(time.monotonic() - t0,
+                                      phase="draft_prefill")
+            kv = outs[1:]
+            with self._lock:
+                if self._sched_gen != gen or self._closed:
+                    return True  # restart owns the group now
+                for i, s in enumerate(fresh):
+                    # bounded: one draft slot per active sequence and
+                    # the draft pool is sized like the target pool
+                    s.draft_slot = self._draft_slots.alloc()
+                    self._draft_slots.write_prefill(
+                        s.draft_slot, [k[i] for k in kv],
+                        s.req.prompt.size)
+                    s.draft_pos = s.req.prompt.size
+        # --- catch-up + propose: feed the draft model one token per
+        # dispatch until every member's draft saw positions
+        # 0..pos+K-2; feeds at >= pos come from last_token then the
+        # draft's own proposals (the logits of feeds at >= pos ARE the
+        # proposals d_1..d_{K-1})
+        drafts = {id(s): [] for s in group}
+        while True:
+            todo = [s for s in group if s.draft_pos < s.pos + K - 1]
+            if not todo:
+                break
+            rows = bucket_rows(max(len(todo), 2), self._rows_cap)
+            need = max(s.draft_pos + 1 for s in todo)
+            seq_b = seq_bucket(need, self.min_seq_bucket,
+                               self.max_seq_len)
+            key = ("draft_step", rows, seq_b)
+            if not self._breaker_probe(key):
+                return False
+            feeds = []
+            for s in todo:
+                p = s.draft_pos
+                if p <= s.pos:
+                    feeds.append(self._token_at(s, p))
+                else:
+                    feeds.append(drafts[id(s)][p - s.pos - 1])
+            t0 = time.monotonic()
+            try:
+                run = self._program(key, warming=False)
+                tokens = np.zeros((rows,), np.int32)
+                positions = np.zeros((rows,), np.int32)
+                for i, s in enumerate(todo):
+                    tokens[i] = feeds[i]
+                    positions[i] = s.draft_pos
+                kv = self._draft_slots.gather(
+                    [s.draft_slot for s in todo],
+                    [s.draft_pos for s in todo], rows, seq_b)
+                batch = ([tokens, positions] + kv
+                         + self._feature_batch([s.req for s in todo],
+                                               rows))
+                outs = run(batch)
+            except Exception:  # noqa: BLE001 - draft is best-effort
+                self._record_breaker(key, ok=False)
+                return False
+            self._record_breaker(key, ok=True)
+            self._m_steps.inc(phase="draft_step")
+            self._m_step_exec.observe(time.monotonic() - t0,
+                                      phase="draft_step")
+            logits = outs[0]
+            entries = outs[1:]
+            with self._lock:
+                if self._sched_gen != gen or self._closed:
+                    return True  # restart owns the group now
+                for i, s in enumerate(todo):
+                    self._draft_slots.write_entry(
+                        s.draft_slot, s.draft_pos,
+                        [e[i] for e in entries])
+                    if s.draft_pos >= s.pos:
+                        drafts[id(s)].append(int(np.argmax(logits[i])))
+                    s.draft_pos += 1
+        # --- verify: ONE batched target program over all K positions
+        rows = bucket_rows(max(len(group), 2), self._rows_cap)
+        need = max(s.pos + K for s in group)
+        seq_b = seq_bucket(need, self.min_seq_bucket, self.max_seq_len)
+        key = ("verify", rows, seq_b)
+        if not self._breaker_probe(key):
+            return False
+        t0 = time.monotonic()
+        try:
+            run = self._program(key, warming=False,
+                                trace_id=next((s.req.trace_id
+                                               for s in group
+                                               if s.req.trace_id
+                                               is not None), None))
+            tokens = np.zeros((rows, K), np.int32)
+            positions = np.zeros((rows,), np.int32)
+            for i, s in enumerate(group):
+                tokens[i, 0] = s.last_token
+                tokens[i, 1:] = drafts[id(s)]
+                positions[i] = s.pos
+            kv = self._slots.gather([s.slot for s in group],
+                                    [s.pos for s in group], rows, seq_b)
+            batch = ([tokens, positions] + kv
+                     + self._feature_batch([s.req for s in group], rows))
+            outs = run(batch)
+        except Exception:  # noqa: BLE001 - fall back, requests unharmed
+            self._record_breaker(key, ok=False)
+            return False
+        self._record_breaker(key, ok=True)
+        now = time.monotonic()
+        dt = now - t0
+        self._m_steps.inc(phase="verify")
+        self._m_step_exec.observe(dt, phase="verify")
+        obs_tracing.observe("serving.decode.verify", dt)
+        logits = outs[0]    # (rows, K, vocab)
+        entries = outs[1:]  # each (rows, K, ...)
+        finished = []
+        snaps = []
+        with self._lock:
+            if self._sched_gen != gen or self._closed:
+                return True  # restart owns the group now
+            drop = set()
+            for i, s in enumerate(group):
+                d = drafts[id(s)]
+                n0 = s.n_generated
+                accepted = 0
+                retired = False
+                for j in range(K):
+                    u = int(np.argmax(logits[i, j]))
+                    # iteration j runs only while the fed token at j
+                    # is the REAL token (j=0 feeds last_token; j>0
+                    # guarded by the d[j-1]==u break below), so this
+                    # kv entry is exactly the plain-step entry —
+                    # rejected entries are simply never written
+                    self._slots.write_entry(s.slot, s.pos,
+                                            [e[i, j] for e in entries])
+                    s.pos += 1
+                    s.last_token = u
+                    s.n_generated += 1
+                    if j > 0:
+                        accepted += 1
+                    if (s.req.token_budget_s is not None
+                            and now - s.t_last > s.req.token_budget_s):
+                        self._release_seq(s)
+                        drop.add(id(s))
+                        finished.append((s, "deadline",
+                                         DeadlineExceeded(
+                            f"{self.name}: token {s.n_generated} "
+                            f"arrived {now - s.t_last:.3f}s after the "
+                            f"previous one (per-token budget "
+                            f"{s.req.token_budget_s}s); slot purged")))
+                        retired = True
+                        break
+                    self._emit(s, u, now)
+                    reason = self._stop_reason(s)
+                    if reason is not None:
+                        self._release_seq(s)
+                        drop.add(id(s))
+                        finished.append((s, reason, None))
+                        retired = True
+                        break
+                    if j + 1 < K and d[j] != u:
+                        break  # first rejection ends the burst
+                self._m_spec_accept.observe(accepted / (K - 1))
+                self._n_spec_iters += 1
+                self._n_spec_accepted += accepted
+                if retired:
+                    continue
+                # rollback-by-pointer: draft entries past the accepted
+                # run were computed from rejected tokens; the next
+                # catch-up overwrites them before they become visible
+                s.draft_pos = min(s.draft_pos, s.pos)
+                if (s.req.snapshot_every
+                        and s.n_generated // s.req.snapshot_every
+                        > n0 // s.req.snapshot_every):
+                    snaps.append(
+                        (s, self._slots.snapshot(s.slot, s.pos),
+                         s.pos, s.last_token, s.n_generated))
+            if drop:
+                self._active[:] = [x for x in self._active
+                                   if id(x) not in drop]
+        for s, kv_copies, pos, last, n_gen in snaps:
+            try:
+                chaos.hit("serving.decode.snapshot")
+                s.req._push_snapshot(self._build_snapshot(
+                    s.req, kv_copies, pos, last, n_gen), n_gen)
+                with self._lock:
+                    self._n_snapshots += 1
+            except Exception:  # noqa: BLE001 - degraded, never fatal
+                pass
+        for s, reason, err in finished:
+            self._notify_retired(s, reason, err)
+        return True
 
     # ----------------------------------------------------------- helpers
     def _feature_batch(self, reqs, rows):
@@ -1773,6 +2563,30 @@ class DecodeEngine:
                     trace_id=s.req.trace_id, engine=self.name,
                     tokens=s.n_generated, reason=reason)
 
+    # tpu-resource: releases=kv_slot
+    def _release_seq(self, s):
+        """Free EVERY slot an active sequence holds (target + draft).
+        The single release point for active sequences: keeping the
+        exactly-once discipline in one place is what keeps the shared-
+        page refcounts balanced across purge / retire / restart /
+        close paths. Callers hold the engine lock."""
+        self._slots.release(s.slot)
+        if s.draft_slot is not None:
+            self._draft_slots.release(s.draft_slot)
+            s.draft_slot = None
+
+    def _breaker_probe(self, key):
+        """Breaker check WITHOUT the fail-fast side effect — for the
+        draft/verify ladder, where a quarantined program means 'fall
+        back to plain steps', never 'fail the requests'."""
+        now = time.monotonic()
+        with self._lock:
+            br = self._breakers.get(key)
+            if br is None:
+                br = self._breakers[key] = _Breaker(
+                    self.breaker_threshold, self.breaker_cooldown)
+            return br.allow(now)
+
     def _breaker_allows(self, key, reqs):
         """Check/trip the program-key breaker; on shed, fail ``reqs``
         fast with the retryable quarantine status."""
@@ -1807,7 +2621,9 @@ class DecodeEngine:
     def _program(self, key, warming=False, trace_id=None):
         """Materialize-once per (phase, rows, seq) — the decode twin of
         BatchingEngine._compiled (in-flight event so warmup and the
-        scheduler never compile the same key twice)."""
+        scheduler never compile the same key twice). ``draft_*`` phases
+        route to the draft model's program set; they share this cache,
+        the compile counters, and the breakers under their full key."""
         phase, rows, seq_b = key
         while True:
             with self._lock:
@@ -1834,8 +2650,13 @@ class DecodeEngine:
             try:
                 chaos.hit("serving.decode.compile")
                 t0 = time.monotonic()
-                run, source = self._programs.compile(phase, rows, seq_b,
-                                                     warming=warming)
+                if phase.startswith("draft_"):
+                    run, source = self._draft_programs.compile(
+                        phase[len("draft_"):], rows, seq_b,
+                        warming=warming)
+                else:
+                    run, source = self._programs.compile(
+                        phase, rows, seq_b, warming=warming)
             except BaseException:
                 with self._lock:
                     self._compiling.pop(key, None)
@@ -1892,8 +2713,15 @@ class DecodeEngine:
             # max_slots=1 engine runs its one sequence at rows=2
             slot_buckets = ladder(2, self._rows_cap)
         if seq_buckets is None:
+            # a prefill-phase engine still runs the suffix-feed /
+            # finishing step through the step ladder, so its step
+            # rungs must reach the prompt bucket (not just the
+            # smallest one)
             seq_buckets = (
-                [self.min_seq_bucket] if self.phase == "prefill"
+                ladder(self.min_seq_bucket,
+                       seq_bucket(self.max_prompt_len,
+                                  self.min_seq_bucket, self.max_seq_len))
+                if self.phase == "prefill"
                 else ladder(self.min_seq_bucket, self.max_seq_len))
         if prompt_buckets is None:
             prompt_buckets = (
@@ -1913,6 +2741,20 @@ class DecodeEngine:
                 declared.append(("prefill", rows,
                                  seq_bucket(int(pb), self.min_seq_bucket,
                                             self.max_seq_len)))
+            if self.spec_enabled:
+                # the speculative rungs: K-token verify + the draft
+                # model's own step/prefill ladders — all plain
+                # (phase, rows, seq) ArtifactKeys, warmed exactly
+                # like the base ladder
+                for sb in ladder(self.min_seq_bucket, self.max_seq_len):
+                    declared.append(("verify", rows, sb))
+                    declared.append(("draft_step", rows, sb))
+                for pb in ladder(
+                        self.min_seq_bucket,
+                        seq_bucket(self.max_prompt_len,
+                                   self.min_seq_bucket,
+                                   self.max_seq_len)):
+                    declared.append(("draft_prefill", rows, pb))
         declared = sorted(set(declared))
         for key in declared:
             self._program(key, warming=True)
@@ -1962,6 +2804,30 @@ class DecodeEngine:
                             for r in _RETIRE_REASONS},
                 "prefills": int(self._m_steps.value(phase="prefill")),
                 "steps": int(self._m_steps.value(phase="step")),
+                "prefix_fill_steps": int(
+                    self._m_steps.value(phase="prefix_fill")),
+                "prefix": (self._prefix.stats()
+                           if self._prefix is not None else None),
+                "shared_pages": (
+                    self._slots.shared_pages()
+                    + (self._draft_slots.shared_pages()
+                       if self._draft_slots is not None else 0)),
+                "live_pages": (
+                    self._slots.live_pages()
+                    + (self._draft_slots.live_pages()
+                       if self._draft_slots is not None else 0)),
+                "spec": {
+                    "enabled": self.spec_enabled,
+                    "k": self._spec_k,
+                    "iterations": self._n_spec_iters,
+                    "accepted": self._n_spec_accepted,
+                    "verify_steps": int(
+                        self._m_steps.value(phase="verify")),
+                    "draft_steps": int(
+                        self._m_steps.value(phase="draft_step")),
+                    "draft_prefills": int(
+                        self._m_steps.value(phase="draft_prefill")),
+                },
                 "compiles": sum(cc.get("inline", 0)
                                 for cc in self._compile_counts.values()),
                 "store_loads": sum(cc.get("store", 0)
@@ -1992,6 +2858,9 @@ class DecodeEngine:
                 "declared_programs": len(self._declared),
                 "mesh": self.mesh_desc,
                 "artifact_store": store_stats,
+                "prefix_entries": (self._prefix.stats()["entries"]
+                                   if self._prefix is not None else 0),
+                "spec_enabled": self.spec_enabled,
             }
 
     # ----------------------------------------------------------- watchdog
@@ -2038,7 +2907,10 @@ class DecodeEngine:
             stranded_join = list(self._inflight_join)
             self._inflight_join = []
             for s in stranded:
-                self._slots.release(s.slot)
+                # refcount-aware sweep: pages shared with the prefix
+                # cache (or other survivors) are DECREMENTED here,
+                # never freed out from under their other holders
+                self._release_seq(s)
             self._m_restarts.inc()
             self._heartbeat = time.monotonic()
             t = threading.Thread(target=self._run_scheduler, args=(gen,),
@@ -2081,7 +2953,11 @@ class DecodeEngine:
             active = list(self._active)
             self._active[:] = []
             for s in active:
-                self._slots.release(s.slot)
+                self._release_seq(s)
+            if self._prefix is not None:
+                # drop the cache's page references AFTER the active
+                # sweep so every kv page's refcount walks to zero
+                self._prefix.clear()
             self._cond.notify_all()
             sched = self._scheduler
         obs_metrics.REGISTRY.unregister_collector(self._obs_collector)

@@ -1,0 +1,357 @@
+"""Content-addressed KV prefix cache (ROADMAP item 2, PR 19).
+
+Real decode traffic is dominated by shared prefixes — system prompts,
+few-shot templates, multi-turn history. Prefill cost is O(prompt) per
+request even when the first k tokens (and therefore their KV rows,
+which depend only on the token prefix and the per-sequence features)
+are identical across requests. This module turns that O(prompt) into
+O(suffix): token prefixes are hashed at page-aligned boundaries, the
+resulting KV pages live once in the engine's refcounted page pool
+(:class:`decode._KVSlots`), and a hit installs them into a fresh slot
+by reference — copy-on-write page sharing — so only the uncached
+suffix ever runs through a model program.
+
+**Chain hashing.** The prefix hash at boundary ``k + page`` extends
+the hash at ``k``::
+
+    h_0       = sha256("pfx0:" + feature_digest)
+    h_{i+1}   = sha256(h_i || tokens[i*page : (i+1)*page].tobytes())
+
+so hashing every boundary of a P-token prompt is one linear pass, and
+two prompts share a cache entry exactly when their token pages AND
+their feature bytes agree (KV rows are a function of both under the
+DecodeModel contract — a feature-skewed hit would install foreign KV).
+
+**Two tiers.** The in-memory tier maps ``hash -> (n_tokens, page ids)``
+into the engine's page pool with LRU eviction under a byte budget.
+The optional persistent tier reuses the PR 10 artifact-store machinery
+(`PADDLE_TPU_PREFIX_DIR`): entries are PR 17 kv-snapshot blocks under
+an :class:`~paddle_tpu.serialize.artifact_store.ArtifactKey` built
+from model fingerprint + weights digest + quant + mesh + prefix hash,
+so a fresh replica — or a PR 18 prefill-pool replica — inherits the
+fleet's warm prefixes with zero prefill work. A block whose header
+identity skews from this replica (foreign weights, quant, mesh, or
+page geometry) is REFUSED exactly like a snapshot resume would be:
+counted, never installed — wrong-model KV must never decode garbage.
+
+Env knobs:
+    PADDLE_TPU_PREFIX_DIR        persistent tier root (unset = memory
+                                 tier only)
+    PADDLE_TPU_PREFIX_MAX_BYTES  byte budget for BOTH tiers (in-memory
+                                 page bytes; artifact-store gc cap);
+                                 default 256 MiB
+    PADDLE_TPU_PREFIX_DISABLE    "1" disables prefix caching entirely
+
+Concurrency: the page pool is only ever mutated under the owning
+engine's lock (the scheduler thread); every method documented as
+"pool-mutating" REQUIRES the caller to hold it. The cache's own lock
+only guards the entry map and counters so stats exposition never
+blocks the decode loop.
+"""
+import hashlib
+import os
+import threading
+
+import numpy as np
+
+from ..resilience.retry import _env_int
+from ..serialize import artifact_store as _artifacts
+from . import wire_spec as _wire_spec
+
+__all__ = ["PrefixCache", "feature_seed", "prefix_hashes"]
+
+# Machine-checked lock order (tools/tracelint.py --concurrency): the
+# cache lock is a leaf under the engine lock — entry-map updates run
+# inside the scheduler's pool mutations, never the reverse.
+# tpu-lock-order: DecodeEngine._lock < PrefixCache._lock  # pool -> entry map
+
+_DEFAULT_MAX_BYTES = 256 << 20
+
+
+def feature_seed(features):
+    """Digest of a request's feature arrays (dtype/shape/bytes) — the
+    chain-hash seed. KV rows are a function of tokens AND features
+    under the DecodeModel contract, so feature-skewed requests must
+    never share prefix entries."""
+    h = hashlib.sha256(b"pfx-feat:")
+    for f in features:
+        a = np.ascontiguousarray(np.asarray(f))
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.digest()
+
+
+def prefix_hashes(prompt_i32, page_len, seed=b""):
+    """Chain hashes at every full-page boundary of ``prompt_i32``:
+    ``[(page_len, h1), (2*page_len, h2), ...]`` (hex digests), longest
+    last. ``hash(p[:k+page])`` extends ``hash(p[:k])`` — one linear
+    pass hashes every boundary."""
+    prompt = np.ascontiguousarray(np.asarray(prompt_i32, dtype=np.int32))
+    h = hashlib.sha256(b"pfx0:" + seed)
+    out = []
+    n_pages = prompt.size // int(page_len)
+    for i in range(n_pages):
+        page = prompt[i * page_len:(i + 1) * page_len]
+        h = hashlib.sha256(h.digest() + page.tobytes())
+        out.append(((i + 1) * int(page_len), h.hexdigest()))
+    return out
+
+
+class PrefixCache:
+    """Content-addressed prefix store over an engine's page pool (see
+    module docstring). ``slots`` is the owning engine's
+    :class:`decode._KVSlots`; ``identity_fn`` returns the replica
+    identity dict (fingerprint/weights/quant/mesh) for the persistent
+    tier — called lazily because the fingerprint is."""
+
+    def __init__(self, slots, identity_fn=None, max_bytes=None,
+                 store_dir=None, name="prefix"):
+        self._slots = slots
+        self.page_len = int(slots.page_len)
+        self._identity_fn = identity_fn
+        self.name = name
+        if max_bytes is None:
+            max_bytes = _env_int("PADDLE_TPU_PREFIX_MAX_BYTES",
+                                 _DEFAULT_MAX_BYTES)
+        self.max_bytes = int(max_bytes)
+        page_bytes = max(1, slots.page_bytes())
+        self.max_pages = max(1, self.max_bytes // page_bytes)
+        if store_dir is None:
+            store_dir = os.environ.get("PADDLE_TPU_PREFIX_DIR") or None
+        self._store = None
+        if store_dir:
+            self._store = _artifacts.ArtifactStore(
+                store_dir, max_bytes=self.max_bytes)
+        self._lock = threading.Lock()
+        self._entries = {}   # hash hex -> [n_tokens, [page ids], tick]
+        self._tick = 0
+        self._published = set()  # hashes already pushed to the store
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.store_hits = 0
+        self.store_refused = 0
+
+    # ------------------------------------------------------------ restrace
+    # The runtime sanitizer pairs these: every page set the cache
+    # retains must be dropped (eviction / clear) before teardown.
+    # tpu-resource: acquires=prefix_entry
+    def _hold(self, key):
+        return key
+
+    # tpu-resource: releases=prefix_entry
+    def _drop(self, key):
+        return key
+
+    # ------------------------------------------------------- memory tier
+    def lookup(self, hashes):
+        """Longest cached prefix among ``hashes`` (the chain, longest
+        last) -> ``(n_tokens, page_ids)`` or None. Entry-map read only;
+        the caller installs under the pool lock (scheduler thread, so
+        the pages cannot be evicted in between — eviction only happens
+        on the same thread, inside :meth:`insert`)."""
+        with self._lock:
+            for n_tokens, hx in reversed(hashes):
+                e = self._entries.get(hx)
+                if e is not None:
+                    self._tick += 1
+                    e[2] = self._tick
+                    self.hits += 1
+                    return e[0], list(e[1])
+            self.misses += 1
+            return None
+
+    def insert(self, hx, n_tokens, pages):
+        """Retain ``pages`` (ids in the pool) as the entry for ``hx``.
+        POOL-MUTATING: caller holds the engine lock. Evicts LRU entries
+        beyond the page budget. Returns the number of entries evicted
+        (0 when ``hx`` was already cached — a duplicate insert retains
+        nothing and evicts nothing)."""
+        with self._lock:
+            if hx in self._entries:
+                return 0
+            for pid in pages:
+                self._slots.retain_page(pid)
+            self._tick += 1
+            self._entries[hx] = [int(n_tokens), list(pages), self._tick]
+            self._hold(hx)
+            evict = []
+            while (sum(len(e[1]) for e in self._entries.values())
+                    > self.max_pages and len(self._entries) > 1):
+                lru = min(self._entries, key=lambda k: self._entries[k][2])
+                if lru == hx and len(self._entries) == 1:
+                    break
+                evict.append((lru, self._entries.pop(lru)))
+            for lru, e in evict:
+                for pid in e[1]:
+                    self._slots.drop_page(pid)
+                self._drop(lru)
+                self.evictions += 1
+            return len(evict)
+
+    def needs_publish(self, hx):
+        """Would :meth:`publish` actually write ``hx``? Lets the engine
+        skip the kv snapshot copy when there is no persistent tier or
+        the prefix already shipped."""
+        if self._store is None or _artifacts.disabled():
+            return False
+        with self._lock:
+            return hx not in self._published
+
+    def clear(self):
+        """Drop every entry (pool-mutating: caller holds the engine
+        lock) — engine close calls this so the page census drains."""
+        with self._lock:
+            entries = list(self._entries.items())
+            self._entries.clear()
+        for hx, e in entries:
+            for pid in e[1]:
+                self._slots.drop_page(pid)
+            self._drop(hx)
+
+    # --------------------------------------------------- persistent tier
+    def _identity(self):
+        ident = self._identity_fn() if self._identity_fn else None
+        if not ident or not ident.get("fingerprint"):
+            return None
+        return ident
+
+    def _store_key(self, hx, n_tokens, ident):
+        sig = (("decode:prefix", (self.page_len, int(n_tokens))),
+               (hx, ()),
+               ("w" + ident["weights"][:16], ()))
+        return _artifacts.ArtifactKey(
+            ident["fingerprint"], int(n_tokens) // self.page_len, sig,
+            mesh=ident["mesh"], quant=ident["quant"])
+
+    def load_store(self, hashes, prompt_i32):
+        """Longest persistent-tier prefix among ``hashes`` ->
+        ``(hx, n_tokens, kv_arrays)`` or None. File IO — call WITHOUT
+        the engine lock; the caller materializes pages under it via
+        :meth:`install_arrays`. A block whose header identity skews
+        from this replica is refused (counted), never installed."""
+        if self._store is None or _artifacts.disabled():
+            return None
+        ident = self._identity()
+        if ident is None:
+            return None
+        for n_tokens, hx in reversed(hashes):
+            payload = self._store.get(self._store_key(hx, n_tokens, ident))
+            if payload is None:
+                continue
+            got = self._check_block(payload, hx, n_tokens, ident,
+                                    prompt_i32)
+            if got is not None:
+                with self._lock:
+                    self.store_hits += 1
+                return hx, n_tokens, got
+        return None
+
+    def _check_block(self, payload, hx, n_tokens, ident, prompt_i32):
+        """PR 17 skew-refusal discipline over a prefix block: identity
+        + geometry + content must all match, else refuse (a foreign
+        KV prefix decodes garbage — a miss is always preferable)."""
+        try:
+            header, arrays, _ = _wire_spec.decode_kv_snapshot_off(payload)
+        except Exception:  # noqa: BLE001 - corrupt block is a refusal
+            with self._lock:
+                self.store_refused += 1
+            return None
+        kv_spec = self._slots.kv_spec
+        ok = (header.get("fingerprint") == ident["fingerprint"]
+              and header.get("weights") == ident["weights"]
+              and header.get("quant") == ident["quant"]
+              and header.get("mesh") == ident["mesh"]
+              and int(header.get("page_len", -1)) == self.page_len
+              and int(header.get("pos", -1)) == int(n_tokens)
+              and header.get("prefix_hash") == hx
+              and len(arrays) == 2 + len(kv_spec))
+        if ok:
+            stored_prompt = arrays[0]
+            ok = (stored_prompt.ndim == 1
+                  and stored_prompt.size == int(n_tokens)
+                  and np.array_equal(
+                      stored_prompt,
+                      np.asarray(prompt_i32[:n_tokens], dtype=np.int32)))
+        if ok:
+            kv = arrays[2:]
+            for a, (tr, dt) in zip(kv, kv_spec):
+                if (tuple(a.shape) != (int(n_tokens),) + tr
+                        or a.dtype != dt):
+                    ok = False
+                    break
+        if not ok:
+            with self._lock:
+                self.store_refused += 1
+            return None
+        return list(arrays[2:])
+
+    def install_arrays(self, hx, n_tokens, kv_arrays):
+        """Materialize a store-loaded prefix into pool pages and insert
+        the entry. POOL-MUTATING: caller holds the engine lock.
+        Returns the page id list."""
+        pages = self._slots.pages_from_arrays(kv_arrays, n_tokens)
+        with self._lock:
+            if hx in self._entries:
+                # raced ourselves via an identical in-flight prompt:
+                # keep the existing entry, drop the fresh pages
+                for pid in pages:
+                    self._slots.drop_page(pid)
+                e = self._entries[hx]
+                return list(e[1])
+            self._tick += 1
+            self._entries[hx] = [int(n_tokens), list(pages), self._tick]
+            self._hold(hx)
+        return pages
+
+    def publish(self, hx, n_tokens, prompt_i32, kv_copies):
+        """Best-effort persistent publish (file IO — call WITHOUT the
+        engine lock). The payload is a PR 17 kv-snapshot block whose
+        header carries the full replica identity + page geometry —
+        what :meth:`load_store` refuses on at the other end."""
+        if self._store is None or _artifacts.disabled():
+            return False
+        with self._lock:
+            if hx in self._published:
+                return False
+            self._published.add(hx)
+        ident = self._identity()
+        if ident is None:
+            return False
+        prompt = np.ascontiguousarray(
+            np.asarray(prompt_i32[:n_tokens], dtype=np.int32))
+        header = {
+            "fingerprint": ident["fingerprint"],
+            "weights": ident["weights"],
+            "quant": ident["quant"],
+            "mesh": ident["mesh"],
+            "pos": int(n_tokens),
+            "last_token": int(prompt[-1]),
+            "n_generated": 0,
+            "prompt_len": int(n_tokens),
+            "page_len": self.page_len,
+            "prefix_hash": hx,
+        }
+        arrays = [prompt, np.zeros((0,), np.int32)] + list(kv_copies)
+        try:
+            blob = _wire_spec.encode_kv_snapshot(header, arrays)
+        except Exception:  # noqa: BLE001 - publish is best-effort
+            return False
+        return self._store.put(self._store_key(hx, n_tokens, ident), blob)
+
+    # --------------------------------------------------------------- views
+    def stats(self):
+        with self._lock:
+            pages = sum(len(e[1]) for e in self._entries.values())
+            return {
+                "entries": len(self._entries),
+                "pages": pages,
+                "max_pages": self.max_pages,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "store_hits": self.store_hits,
+                "store_refused": self.store_refused,
+                "persistent": self._store is not None,
+            }

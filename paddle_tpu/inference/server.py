@@ -535,7 +535,8 @@ class PredictorServer:
                              max_new_tokens=opts.get("max_new_tokens"),
                              token_budget_s=budget, trace_id=trace_id,
                              snapshot_every=opts.get("snapshot_every")
-                             or None)
+                             or None,
+                             speculative=bool(opts.get("speculative")))
         except (RetryableError, EngineClosed):
             self._m_responses.inc(status=str(STATUS_OVERLOADED))
             conn.sendall(struct.pack("<IB", 1, STATUS_OVERLOADED))
@@ -748,6 +749,7 @@ class PredictorServer:
         opts = opts or {}
         try:
             req = dec.resume(payload[:snap_end], token_budget_s=budget,
+                             speculative=bool(opts.get("speculative")),
                              trace_id=trace_id,
                              snapshot_every=opts.get("snapshot_every"),
                              max_new_tokens=opts.get("max_new_tokens"))

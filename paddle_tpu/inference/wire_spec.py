@@ -274,11 +274,16 @@ MARKERS = {
                      "u64 decode opts: low 32 bits max_new_tokens, "
                      "bits 32-47 snapshot cadence (emit a kv-snapshot "
                      "frame every N generated tokens; 0 = never), "
-                     "bit 62 prefill-handoff (run ONLY the prefill "
-                     "step and reply with one status-3 kv-snapshot "
-                     "frame then the terminal token frame — the "
-                     "router's disaggregated prefill leg), bit 63 "
-                     "one-shot (collect the whole sequence into a "
+                     "bit 61 speculative decode opt-in (the engine may "
+                     "draft-and-verify k tokens per iteration; emitted "
+                     "tokens stay bitwise-equal to non-speculative "
+                     "greedy, only chunk cadence may change — clients "
+                     "that do not set the bit see byte-identical "
+                     "streams), bit 62 prefill-handoff (run ONLY the "
+                     "prefill step and reply with one status-3 "
+                     "kv-snapshot frame then the terminal token frame "
+                     "— the router's disaggregated prefill leg), bit "
+                     "63 one-shot (collect the whole sequence into a "
                      "single reply instead of a chunk stream)"),
 }
 
@@ -307,6 +312,17 @@ DECODE_SNAPSHOT_EVERY_MASK = 0xFFFF
 #: the stream bitwise-identically to colocated serving.
 DECODE_HANDOFF_BIT_SHIFT = 62
 DECODE_HANDOFF_BIT = 1 << DECODE_HANDOFF_BIT_SHIFT
+
+#: Bit 61 of the decode field's u64: speculative-decode opt-in. The
+#: engine may run a draft model ahead and verify k tokens per
+#: iteration in one batched program; greedy accept/reject keeps the
+#: emitted tokens bitwise-equal to non-speculative greedy decode, so
+#: the only observable change is chunk cadence (several tokens may
+#: land in one status-3 frame). Requests WITHOUT the bit decode
+#: non-speculatively and their byte streams are identical to a
+#: pre-speculation server's — cadence bits only, never content.
+DECODE_SPEC_BIT_SHIFT = 61
+DECODE_SPEC_BIT = 1 << DECODE_SPEC_BIT_SHIFT
 
 #: Replica phases a server may declare in its cmd-3 health body (and
 #: echo in cmd-5 stats): a `prefill` replica is placed for prompt
@@ -533,13 +549,15 @@ def encode_tenant(tenant_id):
 
 
 def encode_decode_opts(max_new_tokens, oneshot=False, snapshot_every=0,
-                       handoff=False):
+                       handoff=False, speculative=False):
     """The optional trailing decode field (marker 0x5C + u64: low 32
-    bits max_new_tokens, bits 32-47 snapshot cadence, bit 62
-    prefill-handoff, bit 63 one-shot)."""
+    bits max_new_tokens, bits 32-47 snapshot cadence, bit 61
+    speculative opt-in, bit 62 prefill-handoff, bit 63 one-shot)."""
     val = int(max_new_tokens) & 0xFFFFFFFF
     val |= (int(snapshot_every) & DECODE_SNAPSHOT_EVERY_MASK) \
         << DECODE_SNAPSHOT_EVERY_SHIFT
+    if speculative:
+        val |= DECODE_SPEC_BIT
     if handoff:
         val |= DECODE_HANDOFF_BIT
     if oneshot:
@@ -555,7 +573,7 @@ FIELD_ENCODERS = {
     "decode": lambda v: encode_decode_opts(
         v & 0xFFFFFFFF, bool(v & DECODE_ONESHOT_BIT),
         (v >> DECODE_SNAPSHOT_EVERY_SHIFT) & DECODE_SNAPSHOT_EVERY_MASK,
-        bool(v & DECODE_HANDOFF_BIT)),
+        bool(v & DECODE_HANDOFF_BIT), bool(v & DECODE_SPEC_BIT)),
 }
 
 
@@ -589,6 +607,7 @@ def decode_request(payload):
                 "max_new_tokens": int(val & 0xFFFFFFFF) or None,
                 "oneshot": bool(val & DECODE_ONESHOT_BIT),
                 "handoff": bool(val & DECODE_HANDOFF_BIT),
+                "speculative": bool(val & DECODE_SPEC_BIT),
                 "snapshot_every": int(
                     (val >> DECODE_SNAPSHOT_EVERY_SHIFT)
                     & DECODE_SNAPSHOT_EVERY_MASK),
@@ -707,6 +726,7 @@ def decode_kv_resume(payload):
                 "max_new_tokens": int(val & 0xFFFFFFFF) or None,
                 "oneshot": bool(val & DECODE_ONESHOT_BIT),
                 "handoff": bool(val & DECODE_HANDOFF_BIT),
+                "speculative": bool(val & DECODE_SPEC_BIT),
                 "snapshot_every": int(
                     (val >> DECODE_SNAPSHOT_EVERY_SHIFT)
                     & DECODE_SNAPSHOT_EVERY_MASK),

@@ -29,7 +29,17 @@ bench and the serving tests drive. Env:
                               empty = single-chip). The spawner must
                               also export an XLA device count >= the
                               mesh width (bench.py sharded does).
+    DECODE_WORKER_DRAFT       1 = attach a draft companion model
+                              (speculative decoding; pair with
+                              PADDLE_TPU_SPEC_K >= 2)
+    DECODE_WORKER_DRAFT_HIDDEN  draft hidden width          (8)
+    DECODE_WORKER_ANCHOR      shared token-transition bias strength
+                              (float; 0 = off) — raises draft/target
+                              greedy agreement, see toy_decode_model
     PADDLE_TPU_ARTIFACT_DIR   artifact store (zero-cold-start rewarm)
+    PADDLE_TPU_PREFIX_DIR     persistent prefix-cache tier (warm-
+                              prefix inheritance across replicas)
+    PADDLE_TPU_SPEC_K         speculative burst width (engine knob)
 """
 import os
 import sys
@@ -38,7 +48,7 @@ import numpy as np
 
 
 def toy_decode_model(hidden=32, vocab=64, seed=0, feature_spec=(),
-                     eos_token_id=None):
+                     eos_token_id=None, anchor=0.0, draft=None):
     """Deterministic toy decoder following the DecodeModel contract.
 
     ``feature_spec``: optional per-sequence feature arrays (any wire
@@ -46,6 +56,18 @@ def toy_decode_model(hidden=32, vocab=64, seed=0, feature_spec=(),
     added to the pre-logits hidden state, so every feature byte
     influences every generated token — a bitwise-equivalence test
     over features is therefore a real test, not a dead input.
+
+    ``anchor``: strength of a shared token-transition bias — a fixed
+    (vocab, vocab) matrix drawn from ``RandomState(777)`` regardless
+    of ``seed``/``hidden``, added to the logits as
+    ``anchor * A[last_token]``. Two models with different widths or
+    seeds but the same nonzero anchor mostly agree on the greedy next
+    token, which is exactly the draft/target correlation speculative
+    decoding needs (>0.5 acceptance on the toy). anchor=0 (default)
+    adds NOTHING: existing models stay byte-identical.
+
+    ``draft``: optional companion DecodeModel (same vocab + feature
+    spec) attached as ``model.draft`` for speculative decoding.
     """
     import jax
     import jax.numpy as jnp
@@ -65,6 +87,11 @@ def toy_decode_model(hidden=32, vocab=64, seed=0, feature_spec=(),
         mk(hidden, hidden),  # Wo
         mk(hidden, vocab),   # U   unembedding
     ]
+    if anchor:
+        A = jnp.asarray(
+            (np.random.RandomState(777).randn(vocab, vocab)
+             * 0.5).astype(np.float32))
+        params = params + [A * float(anchor)]
 
     def _feat_bias(feats):
         # one scalar per row from each feature array: mean over the
@@ -79,7 +106,7 @@ def toy_decode_model(hidden=32, vocab=64, seed=0, feature_spec=(),
         return bias * 0.1
 
     def prefill_fn(p, tokens, lengths, *feats):
-        E, Wq, Wk, Wv, Wo, U = p
+        E, Wq, Wk, Wv, Wo, U = p[:6]
         emb = E[tokens]                       # [b,s,h]
         q, k, v = emb @ Wq, emb @ Wk, emb @ Wv
         s = tokens.shape[1]
@@ -96,10 +123,13 @@ def toy_decode_model(hidden=32, vocab=64, seed=0, feature_spec=(),
         if feats:
             last = last + _feat_bias(feats)[:, None]
         logits = last @ U
+        if anchor:
+            last_tok = tokens[jnp.arange(tokens.shape[0]), lengths - 1]
+            logits = logits + p[6][last_tok]
         return (logits, k, v)
 
     def step_fn(p, tokens, positions, kv_k, kv_v, *feats):
-        E, Wq, Wk, Wv, Wo, U = p
+        E, Wq, Wk, Wv, Wo, U = p[:6]
         emb = E[tokens]                       # [b,h]
         q, k, v = emb @ Wq, emb @ Wk, emb @ Wv
         b = tokens.shape[0]
@@ -116,13 +146,15 @@ def toy_decode_model(hidden=32, vocab=64, seed=0, feature_spec=(),
         if feats:
             h = h + _feat_bias(feats)[:, None]
         logits = h @ U
+        if anchor:
+            logits = logits + p[6][tokens]
         return (logits, k, v)
 
     return DecodeModel(
         params, prefill_fn, step_fn,
         kv_spec=(((hidden,), np.float32), ((hidden,), np.float32)),
         vocab_size=vocab, feature_spec=feature_spec,
-        eos_token_id=eos_token_id)
+        eos_token_id=eos_token_id, draft=draft)
 
 
 def reference_decode(model, prompt, max_new_tokens, features=(),
@@ -155,10 +187,17 @@ def main():
     from paddle_tpu.inference.decode import DecodeEngine
     from paddle_tpu.inference.server import PredictorServer
 
+    anchor = float(os.environ.get("DECODE_WORKER_ANCHOR", "0") or 0)
+    vocab = _env_int("DECODE_WORKER_VOCAB", 64)
+    seed = _env_int("DECODE_WORKER_SEED", 0)
+    draft = None
+    if os.environ.get("DECODE_WORKER_DRAFT") == "1":
+        draft = toy_decode_model(
+            hidden=_env_int("DECODE_WORKER_DRAFT_HIDDEN", 8),
+            vocab=vocab, seed=seed + 1, anchor=anchor)
     model = toy_decode_model(
         hidden=_env_int("DECODE_WORKER_HIDDEN", 32),
-        vocab=_env_int("DECODE_WORKER_VOCAB", 64),
-        seed=_env_int("DECODE_WORKER_SEED", 0))
+        vocab=vocab, seed=seed, anchor=anchor, draft=draft)
     engine = DecodeEngine(
         model,
         quant=os.environ.get("DECODE_WORKER_QUANT") or None,

@@ -221,6 +221,17 @@ class TestBenchContract:
             assert sh[sec]["warmup_compiles"] > 0
             assert sh[sec]["post_warmup_compiles"] == 0
             assert sh[sec]["op_counts"].get("all-gather", 0) > 0
+        # ISSUE 19: the KV-reuse ladder regenerates additively too,
+        # with the batched-verify witness intact (one program per
+        # verify rung, dot count spec_k x a step's) and the storm
+        # adding zero compiles past warmup
+        ps = payload["prefix_spec"]
+        assert ps["warmup_compiles"] > 0
+        assert ps["post_warmup_compiles"] == 0
+        assert ps["spec_k"] >= 2
+        assert ps["verify_one_program_per_rung"] is True
+        assert ps["verify_dot_unroll_ratio"] == ps["spec_k"]
+        assert any(n.startswith("verify") for n in ps["programs"])
 
     @pytest.mark.slow  # subprocess pod launches; ci_gate --elastic
     @pytest.mark.elastic  # runs these as its own stage

@@ -426,6 +426,19 @@ x = 1
 # tpu-resource: acquires=breaker
 y = 2
 """, PROD, "TPU506"),
+    # kv_page / prefix_entry are interior-state kinds like breaker
+    # (refcounts and cache entries, no caller-side handle) — their
+    # planted failure is the declaration-discipline TPU506
+    "kv_page": ("""
+x = 1
+# tpu-resource: acquires=kv_page
+y = 2
+""", PROD, "TPU506"),
+    "prefix_entry": ("""
+x = 1
+# tpu-resource: acquires=prefix_entry
+y = 2
+""", PROD, "TPU506"),
 }
 
 

@@ -490,9 +490,9 @@ def test_decode_summary_keys_present_when_not_run(tmp_path):
 
 
 def test_decode_double_run_guard_narrows_tier1():
-    """With --decode, tier-1 must exclude BOTH the decode and the
-    quant markers (the decode stage owns '-m decode or quant',
-    including the slow storm-bench + quant-ladder contracts)."""
+    """With --decode, tier-1 must exclude ALL THREE markers the decode
+    stage owns ('-m decode or quant or prefix', including the slow
+    storm-bench, quant-ladder, and prefix/spec contracts)."""
     mod = _gate_module()
     captured = {}
 
@@ -511,8 +511,39 @@ def test_decode_double_run_guard_narrows_tier1():
     tier1 = captured["args"][0]
     assert "not decode" in tier1 and "not slow" in tier1
     assert "not quant" in tier1
+    assert "not prefix" in tier1
     assert captured["args"][1] == mod.DECODE_PYTEST_ARGS
-    assert "decode or quant" in mod.DECODE_PYTEST_ARGS
+    assert "decode or quant or prefix" in mod.DECODE_PYTEST_ARGS
+
+
+def test_prefix_marker_rides_decode_stage(tmp_path):
+    """Red/green for the prefix marker through the decode stage: a
+    failing prefix-marked test must gate --decode red; a passing one
+    leaves it green (the marker is folded, not a separate stage)."""
+    good = tmp_path / "good.py"
+    good.write_text(GOOD_SRC)
+    bad = tmp_path / "test_prefix_fail.py"
+    bad.write_text(
+        "import pytest\n"
+        "pytestmark = pytest.mark.prefix\n"
+        "def test_boom():\n    assert False\n")
+    r = _run(["--paths", str(good), "--skip-tests", "--decode",
+              "--decode-args",
+              f"{bad} -q -m 'decode or quant or prefix' "
+              "-p no:cacheprovider"])
+    assert r.returncode == 1
+    s = _summary(r)
+    assert s["decode_run"] and not s["decode_ok"]
+    ok = tmp_path / "test_prefix_ok.py"
+    ok.write_text(
+        "import pytest\n"
+        "pytestmark = pytest.mark.prefix\n"
+        "def test_fine():\n    assert True\n")
+    r = _run(["--paths", str(good), "--skip-tests", "--decode",
+              "--decode-args",
+              f"{ok} -q -m 'decode or quant or prefix' "
+              "-p no:cacheprovider"])
+    assert _summary(r)["decode_ok"]
 
 
 def test_sharded_stage_gates(tmp_path):

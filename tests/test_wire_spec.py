@@ -145,7 +145,8 @@ def test_every_dtype_x_field_order_permutation_roundtrips():
                     assert opts == {"max_new_tokens": 17,
                                     "oneshot": True,
                                     "snapshot_every": 0,
-                                    "handoff": False}
+                                    "handoff": False,
+                                    "speculative": False}
                 else:
                     assert opts is None
                 count += 1

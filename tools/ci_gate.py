@@ -49,7 +49,7 @@ drain-zero-drops units, the chaos-kill multi-replica e2e, and the
 ``bench.py fleet`` goodput + SLO-isolation contract), with the same
 compositional tier-1 exclusion. ``--decode`` adds a stage running the
 continuous-batching decode suite plus the quantized-serving suite
-(``-m 'decode or quant'``: bitwise solo-vs-batch equivalence across
+(``-m 'decode or quant or prefix'``: bitwise solo-vs-batch equivalence across
 join/leave events and every wire dtype, per-token SLO enforcement,
 streaming-wire + router-relay tests, the slot-purge chaos audit, the
 slow ``bench.py decode`` storm contract, and the ISSUE 13 quant ladder
@@ -146,7 +146,8 @@ FLEET_PYTEST_ARGS = "tests/ -q -m fleet -p no:cacheprovider"
 # bench contracts) rides in this stage — quantization is the decode
 # path's bandwidth lever, and a separate stage would re-pay the same
 # model/ladder setup
-DECODE_PYTEST_ARGS = "tests/ -q -m 'decode or quant' -p no:cacheprovider"
+DECODE_PYTEST_ARGS = ("tests/ -q -m 'decode or quant or prefix' "
+                      "-p no:cacheprovider")
 # the sharded multi-chip serving suite: per-(bucket, mesh) engine/wire
 # equivalence, mesh-keyed store round trips + skew misses, the
 # multi-process gloo mesh via the PR 9 launcher, mesh fail-fasts, and
@@ -607,9 +608,11 @@ def main(argv=None):
             if ns.fleet:
                 excl.append("fleet")
             if ns.decode:
-                # the decode stage owns BOTH markers (decode or quant)
+                # the decode stage owns ALL THREE markers
+                # (decode or quant or prefix)
                 excl.append("decode")
                 excl.append("quant")
+                excl.append("prefix")
             if ns.sharded:
                 excl.append("sharded")
             if ns.disagg:
